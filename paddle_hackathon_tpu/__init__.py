@@ -1,0 +1,72 @@
+"""paddle_hackathon_tpu — a TPU-native deep-learning framework.
+
+Brand-new implementation of the capability surface of the reference
+(ccw1996/Paddle_hackathon ≈ PaddlePaddle v2.3, surveyed in /root/repo/SURVEY.md)
+built idiomatically on JAX/XLA/Pallas/pjit:
+
+- eager "dygraph" mode: per-op taped autograd over jax ops (``core.autograd``)
+- jit/static mode: tracing to jaxpr/StableHLO via ``jit.to_static`` — XLA is
+  the executor (replaces ProgramDesc + Executor/InterpreterCore/ParallelExecutor)
+- distributed: ``jax.sharding.Mesh`` + pjit/shard_map collectives over ICI/DCN
+  (replaces NCCL ProcessGroups / fleet meta-optimizers) — see ``parallel/``
+- fused kernels: Pallas (replaces the fused CUDA ops) — see ``incubate/``
+
+The public API mirrors paddle's: ``to_tensor``, ``nn.Layer``, ``optimizer.*``,
+``amp``, ``io.DataLoader``, ``jit.to_static``, ``distributed.fleet``.
+"""
+
+__version__ = "0.1.0"
+
+import jax as _jax
+
+from .core import autograd, device, dtype as _dtype_mod, flags
+
+# float32 means float32: full-precision accumulate for f32 matmul/conv
+# (see FLAGS_matmul_precision in core/flags.py). bf16 tensors still hit the
+# MXU single-pass path, which is what AMP/bench use.
+_jax.config.update("jax_default_matmul_precision",
+                   flags.flag("matmul_precision"))
+from .core.autograd import enable_grad, grad, is_grad_enabled, no_grad, set_grad_enabled
+from .core.device import (Place, current_place, device_count, get_device,
+                          is_compiled_with_tpu, set_device, synchronize)
+from .core.dtype import (bfloat16, bool_, complex64, complex128, float16,
+                         float32, float64, get_default_dtype, int8, int16,
+                         int32, int64, set_default_dtype, uint8)
+from .core.flags import get_flags, set_flags
+from .core.random import get_rng_state, get_rng_state_tracker, set_rng_state
+from .core.random import seed as _seed_fn
+from .core.tensor import Tensor, to_tensor
+
+from . import ops
+from .ops import *  # noqa: F401,F403 — the paddle.* tensor-op surface
+
+
+def seed(s):
+    """paddle.seed equivalent."""
+    _seed_fn(s)
+
+
+bool = bool_  # noqa: A001 — paddle.bool
+
+
+def is_grad_enabled_():
+    return autograd.is_grad_enabled()
+
+
+# Subpackages (nn → ops → core dependency order). Optional ones are imported
+# when present so the package stays importable mid-build.
+import importlib as _importlib
+
+for _sub in ("nn", "optimizer", "io", "amp", "metric", "framework",
+             "jit", "distributed", "vision", "incubate", "profiler", "hapi"):
+    try:
+        globals()[_sub] = _importlib.import_module(f"{__name__}.{_sub}")
+    except ModuleNotFoundError as _e:
+        if f"{__name__}.{_sub}" not in str(_e):
+            raise
+
+if "framework" in globals():
+    from .framework.io import load, save  # noqa: E402
+if "nn" in globals():
+    from .nn.layer import Layer  # noqa: E402
+    from .nn.parameter import Parameter, create_parameter  # noqa: E402

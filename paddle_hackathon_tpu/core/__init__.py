@@ -1,0 +1,11 @@
+"""Core runtime: Tensor, autograd engine, device/place, dtypes, flags, RNG.
+
+Equivalent of the reference's ``paddle/phi/core`` + ``paddle/fluid/eager`` +
+``paddle/fluid/platform`` stack, collapsed onto JAX/PJRT (see SURVEY.md §7
+phase 1).
+"""
+
+from . import autograd, device, dtype, flags, random
+from .autograd import enable_grad, grad, is_grad_enabled, no_grad, set_grad_enabled
+from .device import Place, current_place, get_device, set_device
+from .tensor import Tensor, to_tensor

@@ -1,0 +1,394 @@
+"""Eager reverse-mode autograd engine.
+
+TPU-native equivalent of the reference's eager autograd
+(``paddle/fluid/eager/``): ``GradNode`` mirrors ``egr::GradNodeBase``
+(``eager/grad_node_info.h:168``), gradient accumulation mirrors
+``GradTensorHolder`` (``eager/grad_tensor_holder.cc``), and the engine is the
+same ready-queue / in-degree-counting walk as ``egr::RunBackward``
+(``eager/backward.cc:556``).
+
+The key architectural difference from the reference: instead of a hand-written
+grad kernel per op (generated from ``legacy_backward.yaml``), every op's VJP is
+obtained from ``jax.vjp`` at forward time — XLA is the single lowering path, so
+the "backward kernel" is just the transposed jaxpr, fused by XLA like any other
+computation. Saved tensors (the reference's ``TensorWrapper``,
+``eager/tensor_wrapper.h``) are the vjp residuals captured in the closure.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+import threading
+from collections import deque
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from . import flags
+
+_tls = threading.local()
+
+# Injected by tensor.py at import time to avoid a circular import.
+Tensor = None  # type: ignore
+
+
+def _set_tensor_class(cls) -> None:
+    global Tensor
+    Tensor = cls
+
+
+# ---------------------------------------------------------------------------
+# Grad mode
+# ---------------------------------------------------------------------------
+
+def is_grad_enabled() -> bool:
+    return getattr(_tls, "grad_enabled", True)
+
+
+@contextlib.contextmanager
+def no_grad():
+    """paddle.no_grad equivalent — suspends tape recording."""
+    prev = is_grad_enabled()
+    _tls.grad_enabled = False
+    try:
+        yield
+    finally:
+        _tls.grad_enabled = prev
+
+
+@contextlib.contextmanager
+def enable_grad():
+    prev = is_grad_enabled()
+    _tls.grad_enabled = True
+    try:
+        yield
+    finally:
+        _tls.grad_enabled = prev
+
+
+def set_grad_enabled(mode: bool):
+    @contextlib.contextmanager
+    def _ctx():
+        prev = is_grad_enabled()
+        _tls.grad_enabled = bool(mode)
+        try:
+            yield
+        finally:
+            _tls.grad_enabled = prev
+
+    return _ctx()
+
+
+# ---------------------------------------------------------------------------
+# Graph nodes
+# ---------------------------------------------------------------------------
+
+class _LeafSlot:
+    """Accumulation target for a leaf tensor (ref GradNodeAccumulation,
+    ``eager/accumulation/accumulation_node.h``)."""
+
+    __slots__ = ("tensor",)
+
+    def __init__(self, tensor):
+        self.tensor = tensor
+
+
+class GradNode:
+    """One recorded op on the tape.
+
+    ``vjp_fn`` maps the op's output cotangents (a tuple, one entry per output)
+    to input cotangents (a tuple, one per differentiable input).
+    ``parents[i]`` is either ``(GradNode, out_idx)`` for a non-leaf input or a
+    ``_LeafSlot`` for a leaf input.
+    """
+
+    __slots__ = ("name", "vjp_fn", "parents", "n_outputs", "out_avals",
+                 "hooks", "_buffer", "_arrived", "_expected", "__weakref__")
+
+    def __init__(self, name: str, vjp_fn: Callable, parents: list,
+                 n_outputs: int, out_avals: list):
+        self.name = name
+        self.vjp_fn = vjp_fn
+        self.parents = parents
+        self.n_outputs = n_outputs
+        self.out_avals = out_avals  # (shape, dtype) per output, for zero-fill
+        self.hooks: Optional[dict] = None  # out_idx -> [hook fns]
+        self._buffer: Optional[list] = None
+        self._arrived = 0
+        self._expected = 0
+
+    def release(self) -> None:
+        """Drop saved residuals (retain_graph=False semantics)."""
+        self.vjp_fn = None
+        self.parents = []
+
+
+# ---------------------------------------------------------------------------
+# Engine — ready-queue over the GradNode DAG (ref egr::RunBackward,
+# eager/backward.cc:556: in-degree counting + queue).
+# ---------------------------------------------------------------------------
+
+def run_backward(tensors: Sequence, grad_tensors: Sequence, retain_graph: bool = False):
+    roots: List[Tuple[GradNode, int, Any]] = []
+    for t, g in zip(tensors, grad_tensors):
+        if t._grad_node is None:
+            # Backward on a leaf: its grad is just the incoming cotangent.
+            _accumulate_leaf(t, g)
+            continue
+        roots.append((t._grad_node, t._out_idx, g))
+    if not roots:
+        return
+
+    # Pass 1: count, for every reachable node, how many cotangent deliveries it
+    # will receive (edges from consumer nodes reachable from the roots).
+    expected = {}
+    visited = set()
+    stack = [n for n, _, _ in roots]
+    for n, _, _ in roots:
+        expected[n] = expected.get(n, 0)
+    while stack:
+        node = stack.pop()
+        if id(node) in visited:
+            continue
+        visited.add(id(node))
+        for parent in node.parents:
+            if isinstance(parent, _LeafSlot):
+                continue
+            pnode, _ = parent
+            expected[pnode] = expected.get(pnode, 0) + 1
+            if id(pnode) not in visited:
+                stack.append(pnode)
+
+    for n, _, g in roots:
+        expected[n] = expected.get(n, 0) + 1
+
+    # Pass 2: ready queue.
+    queue: deque = deque()
+
+    def deliver(node: GradNode, out_idx: int, grad) -> None:
+        if node._buffer is None:
+            node._buffer = [None] * node.n_outputs
+            node._arrived = 0
+            node._expected = expected[node]
+        buf = node._buffer
+        buf[out_idx] = grad if buf[out_idx] is None else buf[out_idx] + grad
+        node._arrived += 1
+        if node._arrived == node._expected:
+            queue.append(node)
+
+    for n, idx, g in roots:
+        deliver(n, idx, g)
+
+    while queue:
+        node = queue.popleft()
+        cotangents = tuple(
+            buf if buf is not None else jnp.zeros(shape, dtype)
+            for buf, (shape, dtype) in zip(node._buffer, node.out_avals)
+        )
+        if node.hooks:
+            cotangents = list(cotangents)
+            for out_idx, hook_fns in node.hooks.items():
+                for hook in hook_fns:
+                    res = hook(_wrap_hook_arg(cotangents[out_idx]))
+                    if res is not None:
+                        cotangents[out_idx] = (
+                            res._value if isinstance(res, Tensor) else res)
+            cotangents = tuple(cotangents)
+        node._buffer = None
+        if node.vjp_fn is None:
+            raise RuntimeError(
+                f"grad node {node.name} has been released; call backward with "
+                "retain_graph=True to backprop through the graph twice")
+        in_grads = node.vjp_fn(cotangents)
+        parents = node.parents
+        if not retain_graph:
+            node.release()
+        for parent, grad in zip(parents, in_grads):
+            if isinstance(parent, _LeafSlot):
+                _accumulate_leaf(parent.tensor, grad)
+            else:
+                pnode, out_idx = parent
+                deliver(pnode, out_idx, grad)
+
+
+def _accumulate_leaf(tensor, grad) -> None:
+    for hook in tensor._grad_hooks:
+        out = hook(_wrap_hook_arg(grad))
+        if out is not None:
+            grad = out._value if isinstance(out, Tensor) else out
+    if tensor.stop_gradient:
+        return
+    if tensor._grad_value is None:
+        tensor._grad_value = grad
+    else:
+        tensor._grad_value = tensor._grad_value + grad
+
+
+def _wrap_hook_arg(grad):
+    t = Tensor(grad, stop_gradient=True)
+    return t
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph=False, allow_unused=False):
+    """paddle.grad equivalent (ref ``egr::GeneralGrad``, eager/backward.cc:38).
+
+    Computes gradients of ``outputs`` w.r.t. ``inputs`` without touching
+    ``.grad`` of other leaves. ``create_graph`` (double grad) is not supported
+    by the eager tape; use the jit path (jax.grad composition) for higher-order
+    derivatives.
+    """
+    if create_graph:
+        raise NotImplementedError(
+            "create_graph=True is not supported on the eager tape; wrap the "
+            "computation in paddle_hackathon_tpu.jit.to_static and compose "
+            "jax.grad for higher-order derivatives")
+    outputs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    if grad_outputs is None:
+        grad_outputs = [None] * len(outputs)
+    grad_outputs = [jnp.ones(o.shape, o.dtype) if g is None else g._value
+                    for o, g in zip(outputs, grad_outputs)]
+
+    # Temporarily swap leaf accumulation: stash and restore .grad of leaves that
+    # are not requested, capture grads of requested inputs.
+    saved = [(t, t._grad_value) for t in _all_leaves(outputs)]
+    for t, _ in saved:
+        t._grad_value = None
+    try:
+        run_backward(outputs, grad_outputs,
+                     retain_graph=bool(retain_graph))
+        results = []
+        for inp in inputs:
+            g = inp._grad_value
+            if g is None and not allow_unused:
+                raise ValueError(
+                    "one of the input tensors receives no gradient; pass "
+                    "allow_unused=True to return None for it")
+            results.append(None if g is None else Tensor(g, stop_gradient=True))
+        return results
+    finally:
+        for t, old in saved:
+            t._grad_value = old
+
+
+def _all_leaves(outputs):
+    leaves = []
+    seen = set()
+    stack = [t._grad_node for t in outputs if t._grad_node is not None]
+    visited = set()
+    while stack:
+        node = stack.pop()
+        if id(node) in visited:
+            continue
+        visited.add(id(node))
+        for parent in node.parents:
+            if isinstance(parent, _LeafSlot):
+                if id(parent.tensor) not in seen:
+                    seen.add(id(parent.tensor))
+                    leaves.append(parent.tensor)
+            else:
+                stack.append(parent[0])
+    return leaves
+
+
+# ---------------------------------------------------------------------------
+# Op application — the single entry every framework op goes through.
+# Equivalent of the generated ``*_final_state_dygraph_function`` bodies
+# (eager_gen.py): forward compute + conditional GradNode construction.
+# ---------------------------------------------------------------------------
+
+def _check_nan_inf(name, vals):
+    for v in vals:
+        if isinstance(v, jax.Array) and jnp.issubdtype(v.dtype, jnp.floating):
+            if bool(jnp.any(~jnp.isfinite(v))):
+                raise FloatingPointError(
+                    f"NaN or Inf detected in output of op {name!r} "
+                    "(FLAGS_check_nan_inf; ref eager/nan_inf_utils.cc)")
+
+
+def apply_op(name: str, fn: Callable, args: Sequence[Any], n_outputs: int = 1):
+    """Run ``fn(*jax_args)`` and record a GradNode if any input needs grad.
+
+    ``args`` may mix Tensors, jax arrays, python scalars and None. Tensors with
+    ``stop_gradient=False`` and floating dtype become vjp-differentiable inputs;
+    everything else is closed over as a constant.
+    """
+    jax_args = []
+    diff_positions = []
+    tape_on = is_grad_enabled()
+    for i, a in enumerate(args):
+        if isinstance(a, Tensor):
+            v = a._value
+            jax_args.append(v)
+            if tape_on and not a.stop_gradient and jnp.issubdtype(
+                    jnp.result_type(v), jnp.inexact):
+                diff_positions.append(i)
+        else:
+            jax_args.append(a)
+
+    if not diff_positions:
+        out = fn(*jax_args)
+        return _wrap_outputs(name, out, n_outputs, node=None)
+
+    const_args = list(jax_args)
+
+    def closed(*diff_vals):
+        call = list(const_args)
+        for pos, val in zip(diff_positions, diff_vals):
+            call[pos] = val
+        return fn(*call)
+
+    diff_vals = [jax_args[i] for i in diff_positions]
+    out, vjp_fn = jax.vjp(closed, *diff_vals)
+
+    parents = []
+    for pos in diff_positions:
+        src = args[pos]
+        if src._grad_node is not None:
+            parents.append((src._grad_node, src._out_idx))
+        else:
+            parents.append(_LeafSlot(src))
+
+    outs = out if isinstance(out, tuple) else (out,)
+    out_avals = [(o.shape, o.dtype) for o in outs]
+
+    def node_vjp(cotangents, _vjp=vjp_fn, _single=not isinstance(out, tuple)):
+        with no_grad():
+            return _vjp(cotangents[0] if _single else cotangents)
+
+    node = GradNode(name, node_vjp, parents, len(outs), out_avals)
+    return _wrap_outputs(name, out, n_outputs, node=node)
+
+
+def _wrap_outputs(name, out, n_outputs, node):
+    if flags.flag("check_nan_inf"):
+        _check_nan_inf(name, out if isinstance(out, tuple) else (out,))
+    stop = node is None
+    if isinstance(out, tuple):
+        return tuple(
+            Tensor(o, stop_gradient=stop, _grad_node=node, _out_idx=i)
+            for i, o in enumerate(out))
+    return Tensor(out, stop_gradient=stop, _grad_node=node, _out_idx=0)
+
+
+def primitive(name: str):
+    """Decorator turning a pure jax function into a taped framework op.
+
+    The wrapped function receives jax values; the public wrapper accepts
+    Tensors / scalars. Keyword arguments are static (non-differentiable) and
+    folded into the closure.
+    """
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            call = functools.partial(fn, **kwargs) if kwargs else fn
+            return apply_op(name, call, args)
+
+        wrapper.__framework_op__ = name
+        return wrapper
+
+    return deco

@@ -1,0 +1,79 @@
+"""Dtype table and promotion helpers.
+
+Equivalent of the reference's ``paddle/phi/common/data_type.h`` dtype enum and the
+per-op dtype plumbing in ``phi/api/lib/kernel_dispatch.h``. On TPU the canonical
+floating type is bfloat16 (MXU-native); float32 stays the default user-facing
+dtype, matching the reference's defaults.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from . import flags
+
+# Public dtype aliases (paddle.float32 etc.)
+bool_ = jnp.bool_
+uint8 = jnp.uint8
+int8 = jnp.int8
+int16 = jnp.int16
+int32 = jnp.int32
+int64 = jnp.int64
+float16 = jnp.float16
+bfloat16 = jnp.bfloat16
+float32 = jnp.float32
+float64 = jnp.float64
+complex64 = jnp.complex64
+complex128 = jnp.complex128
+
+_STR2DTYPE = {
+    "bool": bool_,
+    "uint8": uint8,
+    "int8": int8,
+    "int16": int16,
+    "int32": int32,
+    "int64": int64,
+    "float16": float16,
+    "bfloat16": bfloat16,
+    "float32": float32,
+    "float64": float64,
+    "complex64": complex64,
+    "complex128": complex128,
+}
+
+
+def convert_dtype(dtype):
+    """Normalise str/np/jnp dtype spellings to a jnp dtype."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        try:
+            return _STR2DTYPE[dtype]
+        except KeyError:
+            raise ValueError(f"unknown dtype: {dtype!r}") from None
+    return jnp.dtype(dtype).type
+
+
+def default_float_dtype():
+    return convert_dtype(flags.flag("default_dtype"))
+
+
+def set_default_dtype(dtype):
+    """paddle.set_default_dtype equivalent."""
+    d = convert_dtype(dtype)
+    if d not in (float16, bfloat16, float32, float64):
+        raise ValueError("default dtype must be a floating dtype")
+    flags.set_flags({"default_dtype": np.dtype(d).name})
+
+
+def get_default_dtype() -> str:
+    return flags.flag("default_dtype")
+
+
+def is_floating(dtype) -> bool:
+    return jnp.issubdtype(jnp.dtype(dtype), jnp.floating)
+
+
+def is_integer(dtype) -> bool:
+    return jnp.issubdtype(jnp.dtype(dtype), jnp.integer)

@@ -1,0 +1,97 @@
+"""Global flag registry.
+
+TPU-native equivalent of the reference's exported-gflags system
+(``paddle/fluid/platform/flags.cc:36-157``, 62 ``PADDLE_DEFINE_EXPORTED_*`` flags,
+exposed to Python via ``global_value_getter_setter.cc`` and
+``paddle.set_flags/get_flags`` at ``python/paddle/fluid/framework.py:7125,7149``).
+
+Here flags are a plain in-process registry seeded from ``FLAGS_*`` environment
+variables at import time, mirroring the reference's env-var override behaviour.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Dict, Iterable, Mapping, Union
+
+_lock = threading.RLock()
+_registry: Dict[str, Any] = {}
+_defs: Dict[str, dict] = {}
+
+
+def _coerce(value: Any, proto: Any) -> Any:
+    if isinstance(proto, bool):
+        if isinstance(value, str):
+            return value.lower() in ("1", "true", "yes", "on")
+        return bool(value)
+    if isinstance(proto, int) and not isinstance(proto, bool):
+        return int(value)
+    if isinstance(proto, float):
+        return float(value)
+    return value
+
+
+def define_flag(name: str, default: Any, doc: str = "") -> None:
+    """Register a flag with its default; honours a FLAGS_<name> env override."""
+    with _lock:
+        if name in _defs:
+            return
+        _defs[name] = {"default": default, "doc": doc}
+        env = os.environ.get("FLAGS_" + name)
+        _registry[name] = _coerce(env, default) if env is not None else default
+
+
+def set_flags(flags: Mapping[str, Any]) -> None:
+    """paddle.set_flags equivalent (``fluid/framework.py:7125``)."""
+    with _lock:
+        for name, value in flags.items():
+            if name.startswith("FLAGS_"):
+                name = name[len("FLAGS_"):]
+            if name not in _defs:
+                raise ValueError(f"unknown flag: {name}")
+            _registry[name] = _coerce(value, _defs[name]["default"])
+
+
+def get_flags(flags: Union[str, Iterable[str], None] = None) -> Dict[str, Any]:
+    """paddle.get_flags equivalent (``fluid/framework.py:7149``)."""
+    with _lock:
+        if flags is None:
+            return dict(_registry)
+        if isinstance(flags, str):
+            flags = [flags]
+        out = {}
+        for name in flags:
+            key = name[len("FLAGS_"):] if name.startswith("FLAGS_") else name
+            if key not in _registry:
+                raise ValueError(f"unknown flag: {name}")
+            out[name] = _registry[key]
+        return out
+
+
+def flag(name: str) -> Any:
+    """Fast internal read of a single flag value."""
+    return _registry[name]
+
+
+# ---------------------------------------------------------------------------
+# Core flag set (subset of the reference's flags.cc that is meaningful on TPU).
+# ---------------------------------------------------------------------------
+define_flag("check_nan_inf", False,
+            "Check outputs of every op for NaN/Inf (ref: FLAGS_check_nan_inf, "
+            "eager/nan_inf_utils.cc).")
+define_flag("benchmark", False, "Sync after each op for timing (ref FLAGS_benchmark).")
+define_flag("use_fused_kernels", True,
+            "Use Pallas fused kernels (flash attention, fused layernorm) when "
+            "available; falls back to pure-XLA compositions.")
+define_flag("allocator_strategy", "auto_growth",
+            "Informational on TPU: XLA/PJRT owns HBM (ref FLAGS_allocator_strategy).")
+define_flag("default_dtype", "float32", "Default floating dtype for new tensors.")
+define_flag("jit_cache_size", 256, "Max entries in the to_static program cache.")
+define_flag("matmul_precision", "highest",
+            "XLA dot/conv precision for float32 operands: 'highest' = true f32 "
+            "accumulate (6-pass bf16 on the MXU), 'high' = TF32-like 3-pass, "
+            "'default' = fastest 1-pass bf16. bf16 tensors always take the "
+            "native MXU path. Analog of the reference's TF32 switch "
+            "(paddle/fluid/platform/device/gpu/cuda/cuda_device_function.h).")
+define_flag("log_level", 0, "VLOG-style verbosity for the framework itself.")

@@ -1,0 +1,109 @@
+"""Global RNG state.
+
+Equivalent of the reference's generator machinery (``paddle/phi/core/generator.h``
+and ``paddle.seed``). JAX PRNG is functional (explicit keys); to present Paddle's
+stateful API we keep a process-global key that stateful ops split from. Under a
+jit trace (``to_static`` / functional training steps) stateful splitting would
+bake a constant key into the compiled program, so traced programs thread an
+explicit key through :func:`rng_scope` — the same design as the reference's
+``get_rng_state_tracker`` used by tensor-parallel dropout
+(``fleet/meta_parallel/parallel_layers/random.py``).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Optional
+
+import jax
+
+_state = threading.local()
+_GLOBAL_SEED = 0
+_global_key = None
+_lock = threading.Lock()
+
+
+def seed(s: int) -> None:
+    """paddle.seed equivalent: reset the global generator."""
+    global _GLOBAL_SEED, _global_key
+    with _lock:
+        _GLOBAL_SEED = int(s)
+        _global_key = jax.random.key(int(s))
+
+
+def get_rng_state():
+    global _global_key
+    with _lock:
+        if _global_key is None:
+            _global_key = jax.random.key(_GLOBAL_SEED)
+        return _global_key
+
+
+def set_rng_state(key) -> None:
+    global _global_key
+    with _lock:
+        _global_key = key
+
+
+def split_key() -> jax.Array:
+    """Return a fresh key, advancing whichever RNG scope is active."""
+    scope_key = getattr(_state, "key", None)
+    if scope_key is not None:
+        # Inside an rng_scope (possibly a jit trace): split the scoped key.
+        new_key, sub = jax.random.split(scope_key)
+        _state.key = new_key
+        return sub
+    global _global_key
+    with _lock:
+        if _global_key is None:
+            _global_key = jax.random.key(_GLOBAL_SEED)
+        _global_key, sub = jax.random.split(_global_key)
+        return sub
+
+
+@contextlib.contextmanager
+def rng_scope(key: Optional[jax.Array]):
+    """Thread an explicit PRNG key through stateful random ops.
+
+    Used by the jit/static path so dropout etc. consume a traced key argument
+    instead of baking a constant.
+    """
+    prev = getattr(_state, "key", None)
+    _state.key = key
+    try:
+        yield
+    finally:
+        _state.key = prev
+
+
+class RNGStatesTracker:
+    """Named RNG states for tensor-parallel dropout
+    (ref ``parallel_layers/random.py`` ``get_rng_state_tracker``): the 'local'
+    state differs per model-parallel rank, the 'global' state is identical,
+    so dropout masks on sharded activations decorrelate while replicated
+    activations stay consistent."""
+
+    def __init__(self):
+        self.states = {}
+
+    def add(self, name: str, seed_: int) -> None:
+        if name in self.states:
+            raise ValueError(f"rng state {name!r} already exists")
+        self.states[name] = jax.random.key(int(seed_))
+
+    @contextlib.contextmanager
+    def rng_state(self, name: str = "model_parallel_rng"):
+        if name not in self.states:
+            self.add(name, _GLOBAL_SEED + hash(name) % (2 ** 16))
+        key, sub = jax.random.split(self.states[name])
+        self.states[name] = key
+        with rng_scope(sub):
+            yield
+
+
+_tracker = RNGStatesTracker()
+
+
+def get_rng_state_tracker() -> RNGStatesTracker:
+    return _tracker

@@ -1,0 +1,134 @@
+"""Tensor creation ops (ref ``python/paddle/tensor/creation.py``).
+
+Each op is a single XLA lowering via jax.numpy — the reference's per-backend
+kernel forest (``paddle/phi/kernels/cpu|gpu/...``) collapses into one path.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import autograd
+from ..core.dtype import convert_dtype, default_float_dtype
+from ..core.tensor import Tensor, to_tensor  # re-export to_tensor
+
+
+def _dt(dtype, default=None):
+    d = convert_dtype(dtype)
+    if d is None:
+        d = default if default is not None else default_float_dtype()
+    return d
+
+
+def zeros(shape, dtype=None) -> Tensor:
+    return Tensor(jnp.zeros(_shape(shape), _dt(dtype)))
+
+
+def ones(shape, dtype=None) -> Tensor:
+    return Tensor(jnp.ones(_shape(shape), _dt(dtype)))
+
+
+def full(shape, fill_value, dtype=None) -> Tensor:
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value._value
+    return Tensor(jnp.full(_shape(shape), fill_value, _dt(dtype)))
+
+
+def empty(shape, dtype=None) -> Tensor:
+    return zeros(shape, dtype)
+
+
+def zeros_like(x, dtype=None) -> Tensor:
+    return autograd.apply_op("zeros_like", lambda v: jnp.zeros_like(v, dtype=convert_dtype(dtype)), [x])
+
+
+def ones_like(x, dtype=None) -> Tensor:
+    return autograd.apply_op("ones_like", lambda v: jnp.ones_like(v, dtype=convert_dtype(dtype)), [x])
+
+
+def full_like(x, fill_value, dtype=None) -> Tensor:
+    return autograd.apply_op(
+        "full_like", lambda v: jnp.full_like(v, fill_value, dtype=convert_dtype(dtype)), [x])
+
+
+def empty_like(x, dtype=None) -> Tensor:
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None) -> Tensor:
+    if end is None:
+        start, end = 0, start
+    for v in (start, end, step):
+        if isinstance(v, Tensor):
+            raise TypeError("arange bounds must be python numbers (static shapes on TPU)")
+    d = convert_dtype(dtype)
+    if d is None:
+        # Default int dtype is int32: TPU-native (int64 requires x64 mode and
+        # is slow on the VPU); the reference defaults to int64 on CPU/GPU.
+        d = (default_float_dtype()
+             if any(isinstance(v, float) for v in (start, end, step)) else jnp.int32)
+    return Tensor(jnp.arange(start, end, step, dtype=d))
+
+
+def linspace(start, stop, num, dtype=None) -> Tensor:
+    return Tensor(jnp.linspace(float(start), float(stop), int(num), dtype=_dt(dtype)))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None) -> Tensor:
+    return Tensor(jnp.logspace(float(start), float(stop), int(num), base=base, dtype=_dt(dtype)))
+
+
+def eye(num_rows, num_columns=None, dtype=None) -> Tensor:
+    return Tensor(jnp.eye(num_rows, num_columns, dtype=_dt(dtype)))
+
+
+def diag(x, offset=0, padding_value=0) -> Tensor:
+    def fn(v):
+        if v.ndim == 1 and padding_value != 0:
+            d = jnp.diag(v, k=offset)
+            mask = jnp.eye(d.shape[0], d.shape[1], k=offset, dtype=bool)
+            return jnp.where(mask, d, jnp.asarray(padding_value, d.dtype))
+        return jnp.diag(v, k=offset)
+    return autograd.apply_op("diag", fn, [x])
+
+
+def diagflat(x, offset=0) -> Tensor:
+    return autograd.apply_op("diagflat", lambda v: jnp.diagflat(v, k=offset), [x])
+
+
+def tril(x, diagonal=0) -> Tensor:
+    return autograd.apply_op("tril", lambda v: jnp.tril(v, k=diagonal), [x])
+
+
+def triu(x, diagonal=0) -> Tensor:
+    return autograd.apply_op("triu", lambda v: jnp.triu(v, k=diagonal), [x])
+
+
+def meshgrid(*args):
+    arrs = [a._value if isinstance(a, Tensor) else jnp.asarray(a) for a in args]
+    return [Tensor(m) for m in jnp.meshgrid(*arrs, indexing="ij")]
+
+
+def assign(x, output=None) -> Tensor:
+    src = x._value if isinstance(x, Tensor) else jnp.asarray(np.asarray(x))
+    if output is not None:
+        output._set_value(src)
+        return output
+    return Tensor(src)
+
+
+def clone(x) -> Tensor:
+    return x.clone()
+
+
+def numel(x) -> Tensor:
+    return Tensor(jnp.asarray(x.size, jnp.int32))
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in np.asarray(shape._value))
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s) for s in shape)

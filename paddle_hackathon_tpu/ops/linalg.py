@@ -1,0 +1,209 @@
+"""Linear algebra ops (ref ``python/paddle/tensor/linalg.py``; kernels ref
+``paddle/phi/kernels/matmul_kernel.h:24`` and ``phi/kernels/*/cholesky_*`` etc.).
+
+matmul is THE op on TPU — it is lowered straight to an MXU dot_general. All
+other decompositions ride jax.numpy.linalg (XLA custom calls on TPU).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.autograd import apply_op
+from ..core.tensor import Tensor
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    """MXU matmul (ref ``phi::MatmulKernel`` ``matmul_kernel.h:24``).
+
+    bf16/f32 inputs hit the systolic array directly; the transpose flags fold
+    into dot_general dimension numbers (no materialised transpose).
+    """
+    def fn(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
+        return jnp.matmul(a, b)
+    return apply_op("matmul", fn, [_t(x), _t(y)])
+
+
+def mm(input, mat2, name=None):  # noqa: A002
+    return matmul(input, mat2)
+
+
+def bmm(x, y, name=None):
+    return matmul(x, y)
+
+
+def dot(x, y, name=None):
+    def fn(a, b):
+        return jnp.sum(a * b, axis=-1)
+    return apply_op("dot", fn, [_t(x), _t(y)])
+
+
+def mv(x, vec, name=None):
+    return apply_op("mv", lambda a, v: a @ v, [_t(x), _t(vec)])
+
+
+def norm(x, p="fro", axis=None, keepdim=False, name=None):
+    def fn(v):
+        if axis is None and p in ("fro", 2):
+            return jnp.sqrt(jnp.sum(jnp.square(v)))
+        ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+        if p == "fro":
+            return jnp.sqrt(jnp.sum(jnp.square(v), axis=ax, keepdims=keepdim))
+        if p == float("inf"):
+            return jnp.max(jnp.abs(v), axis=ax, keepdims=keepdim)
+        if p == float("-inf"):
+            return jnp.min(jnp.abs(v), axis=ax, keepdims=keepdim)
+        if p == 0:
+            return jnp.sum((v != 0).astype(v.dtype), axis=ax, keepdims=keepdim)
+        return jnp.sum(jnp.abs(v) ** p, axis=ax, keepdims=keepdim) ** (1.0 / p)
+    return apply_op("p_norm", fn, [_t(x)])
+
+
+def dist(x, y, p=2, name=None):
+    return norm(x - y, p=float(p) if p not in ("fro",) else p)
+
+
+def cross(x, y, axis=9, name=None):
+    def fn(a, b):
+        ax = axis if axis != 9 else next(
+            (i for i, s in enumerate(a.shape) if s == 3), -1)
+        return jnp.cross(a, b, axis=ax)
+    return apply_op("cross", fn, [_t(x), _t(y)])
+
+
+def einsum(equation, *operands):
+    tensors = [_t(o) for o in operands]
+    return apply_op("einsum",
+                    lambda *vs: jnp.einsum(equation, *vs), tensors)
+
+
+def cholesky(x, upper=False, name=None):
+    def fn(v):
+        c = jnp.linalg.cholesky(v)
+        return jnp.swapaxes(c, -1, -2) if upper else c
+    return apply_op("cholesky", fn, [_t(x)])
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    def fn(b, L):
+        return jax.scipy.linalg.cho_solve((L, not upper), b)
+    return apply_op("cholesky_solve", fn, [_t(x), _t(y)])
+
+
+def qr(x, mode="reduced", name=None):
+    outs = apply_op("qr", lambda v: tuple(jnp.linalg.qr(v, mode=mode)), [_t(x)])
+    return outs
+
+
+def svd(x, full_matrices=False, name=None):
+    return apply_op("svd",
+                    lambda v: tuple(jnp.linalg.svd(v, full_matrices=full_matrices)),
+                    [_t(x)])
+
+
+def eig(x, name=None):
+    # TPU lacks a nonsymmetric eig custom call; route through host CPU.
+    import numpy as np
+    w, v = np.linalg.eig(np.asarray(_t(x)._value))
+    return Tensor(jnp.asarray(w)), Tensor(jnp.asarray(v))
+
+
+def eigh(x, UPLO="L", name=None):
+    return apply_op("eigh", lambda v: tuple(jnp.linalg.eigh(v, UPLO=UPLO)), [_t(x)])
+
+
+def eigvals(x, name=None):
+    import numpy as np
+    return Tensor(jnp.asarray(np.linalg.eigvals(np.asarray(_t(x)._value))))
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return apply_op("eigvalsh", lambda v: jnp.linalg.eigvalsh(v, UPLO=UPLO), [_t(x)])
+
+
+def inverse(x, name=None):
+    return apply_op("inverse", jnp.linalg.inv, [_t(x)])
+
+
+inv = inverse
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return apply_op("pinv",
+                    lambda v: jnp.linalg.pinv(v, rtol=rcond, hermitian=hermitian), [_t(x)])
+
+
+def solve(x, y, name=None):
+    return apply_op("solve", jnp.linalg.solve, [_t(x), _t(y)])
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False, name=None):
+    def fn(a, b):
+        return jax.scipy.linalg.solve_triangular(
+            a, b, lower=not upper, trans=1 if transpose else 0,
+            unit_diagonal=unitriangular)
+    return apply_op("triangular_solve", fn, [_t(x), _t(y)])
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    outs = apply_op("lstsq",
+                    lambda a, b: tuple(jnp.linalg.lstsq(a, b, rcond=rcond)),
+                    [_t(x), _t(y)])
+    return outs
+
+
+def matrix_power(x, n, name=None):
+    return apply_op("matrix_power", lambda v: jnp.linalg.matrix_power(v, n), [_t(x)])
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    return apply_op("matrix_rank",
+                    lambda v: jnp.linalg.matrix_rank(v, rtol=tol), [_t(x)])
+
+
+def det(x, name=None):
+    return apply_op("determinant", jnp.linalg.det, [_t(x)])
+
+
+def slogdet(x, name=None):
+    return apply_op("slogdet", lambda v: tuple(jnp.linalg.slogdet(v)), [_t(x)])
+
+
+def multi_dot(x, name=None):
+    tensors = [_t(v) for v in x]
+    return apply_op("multi_dot", lambda *vs: jnp.linalg.multi_dot(vs), tensors)
+
+
+def householder_product(x, tau, name=None):
+    def fn(a, t):
+        m, n = a.shape[-2], a.shape[-1]
+        eye = jnp.eye(m, dtype=a.dtype)
+        q = jnp.broadcast_to(eye, a.shape[:-2] + (m, m))
+        for i in range(t.shape[-1]):
+            v = jnp.concatenate(
+                [jnp.zeros(a.shape[:-2] + (i,), a.dtype),
+                 jnp.ones(a.shape[:-2] + (1,), a.dtype),
+                 a[..., i + 1:, i]], axis=-1)
+            ti = t[..., i:i + 1, None]
+            h = jnp.eye(m, dtype=a.dtype) - ti * v[..., :, None] * v[..., None, :]
+            q = q @ h
+        return q[..., :, :n]
+    return apply_op("householder_product", fn, [_t(x), _t(tau)])
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return apply_op("corrcoef", lambda v: jnp.corrcoef(v, rowvar=rowvar), [_t(x)])
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    return apply_op("cov",
+                    lambda v: jnp.cov(v, rowvar=rowvar, ddof=1 if ddof else 0), [_t(x)])

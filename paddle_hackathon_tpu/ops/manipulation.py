@@ -1,0 +1,435 @@
+"""Shape / layout manipulation ops (ref ``python/paddle/tensor/manipulation.py``).
+
+All shapes are static — a deliberate TPU/XLA constraint: the reference permits
+dynamic shapes per-op; here anything shape-like must be concrete Python ints so
+jit traces stay re-usable (SURVEY §7 design stance).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import autograd
+from ..core.autograd import apply_op
+from ..core.dtype import convert_dtype
+from ..core.tensor import Tensor
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+
+
+def _ints(seq):
+    if isinstance(seq, Tensor):
+        seq = np.asarray(seq._value).tolist()
+    if isinstance(seq, (int, np.integer)):
+        return (int(seq),)
+    return tuple(int(s._value) if isinstance(s, Tensor) else int(s) for s in seq)
+
+
+def reshape(x, shape, name=None):
+    return apply_op("reshape", lambda v: v.reshape(_ints(shape)), [_t(x)])
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    def fn(v):
+        nd = v.ndim
+        s, e = start_axis % nd, stop_axis % nd
+        new_shape = v.shape[:s] + (-1,) + v.shape[e + 1:]
+        return v.reshape(new_shape)
+    return apply_op("flatten", fn, [_t(x)])
+
+
+def transpose(x, perm, name=None):
+    return apply_op("transpose", lambda v: jnp.transpose(v, _ints(perm)), [_t(x)])
+
+
+def t(x, name=None):
+    return apply_op("t", lambda v: v.T, [_t(x)])
+
+
+def moveaxis(x, source, destination, name=None):
+    return apply_op("moveaxis",
+                    lambda v: jnp.moveaxis(v, _ints(source), _ints(destination)), [_t(x)])
+
+
+def swapaxes(x, axis0, axis1, name=None):
+    return apply_op("swapaxes", lambda v: jnp.swapaxes(v, int(axis0), int(axis1)), [_t(x)])
+
+
+def squeeze(x, axis=None, name=None):
+    def fn(v):
+        if axis is None:
+            return jnp.squeeze(v)
+        axes = _ints(axis if isinstance(axis, (list, tuple)) else [axis])
+        axes = tuple(a for a in axes if v.shape[a] == 1)
+        return jnp.squeeze(v, axis=axes) if axes else v
+    return apply_op("squeeze", fn, [_t(x)])
+
+
+def unsqueeze(x, axis, name=None):
+    axes = _ints(axis if isinstance(axis, (list, tuple, Tensor)) else [axis])
+    return apply_op("unsqueeze", lambda v: jnp.expand_dims(v, axes), [_t(x)])
+
+
+def concat(x, axis=0, name=None):
+    tensors = [_t(v) for v in x]
+    ax = int(axis._value) if isinstance(axis, Tensor) else int(axis)
+    return apply_op("concat", lambda *vs: jnp.concatenate(vs, axis=ax), tensors)
+
+
+def stack(x, axis=0, name=None):
+    tensors = [_t(v) for v in x]
+    return apply_op("stack", lambda *vs: jnp.stack(vs, axis=int(axis)), tensors)
+
+
+def unstack(x, axis=0, num=None, name=None):
+    x = _t(x)
+    n = num if num is not None else x.shape[axis]
+    outs = apply_op(
+        "unstack",
+        lambda v: tuple(jnp.moveaxis(v, axis, 0)[i] for i in range(n)),
+        [x])
+    return list(outs)
+
+
+def unbind(input, axis=0):  # noqa: A002
+    return unstack(input, axis=axis)
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    x = _t(x)
+    ax = int(axis._value) if isinstance(axis, Tensor) else int(axis)
+    dim = x.shape[ax]
+    if isinstance(num_or_sections, int):
+        sizes = [dim // num_or_sections] * num_or_sections
+    else:
+        sizes = [int(s) for s in num_or_sections]
+        if any(s == -1 for s in sizes):
+            rest = dim - sum(s for s in sizes if s != -1)
+            sizes = [rest if s == -1 else s for s in sizes]
+    offsets = np.cumsum([0] + sizes)[:-1]
+
+    def fn(v):
+        return tuple(jax.lax.dynamic_slice_in_dim(v, int(o), int(s), axis=ax)
+                     for o, s in zip(offsets, sizes))
+
+    return list(apply_op("split", fn, [x]))
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis=axis)
+
+
+def tile(x, repeat_times, name=None):
+    return apply_op("tile", lambda v: jnp.tile(v, _ints(repeat_times)), [_t(x)])
+
+
+def expand(x, shape, name=None):
+    tgt = _ints(shape)
+
+    def fn(v):
+        full = list(tgt)
+        off = len(full) - v.ndim
+        for i in range(v.ndim):
+            if full[off + i] == -1:
+                full[off + i] = v.shape[i]
+        return jnp.broadcast_to(v, tuple(full))
+    return apply_op("expand", fn, [_t(x)])
+
+
+def expand_as(x, y, name=None):
+    return apply_op("expand_as", lambda v, w: jnp.broadcast_to(v, w.shape), [_t(x), _t(y)])
+
+
+def broadcast_to(x, shape, name=None):
+    return apply_op("broadcast_to", lambda v: jnp.broadcast_to(v, _ints(shape)), [_t(x)])
+
+
+def broadcast_tensors(inputs, name=None):
+    tensors = [_t(v) for v in inputs]
+    outs = apply_op("broadcast_tensors",
+                    lambda *vs: tuple(jnp.broadcast_arrays(*vs)), tensors)
+    return list(outs)
+
+
+def flip(x, axis, name=None):
+    return apply_op("flip", lambda v: jnp.flip(v, _ints(axis)), [_t(x)])
+
+
+def roll(x, shifts, axis=None, name=None):
+    def fn(v):
+        if axis is None:
+            return jnp.roll(v.reshape(-1), _ints(shifts)[0]).reshape(v.shape)
+        return jnp.roll(v, _ints(shifts), _ints(axis))
+    return apply_op("roll", fn, [_t(x)])
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return apply_op("rot90", lambda v: jnp.rot90(v, k=k, axes=tuple(axes)), [_t(x)])
+
+
+def cast(x, dtype):
+    d = convert_dtype(dtype)
+    return apply_op("cast", lambda v: v.astype(d), [_t(x)])
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):  # noqa: A002
+    """paddle.nn.functional.pad-compatible core (ref phi PadKernel).
+
+    ``pad`` is the flat paddle format: either len==2*ndim covering all dims
+    (pairs from the last dim backward is numpy order here: we use per-dim
+    pairs in order), or len==2/4 applied to the trailing spatial dims of the
+    given data_format.
+    """
+    x = _t(x)
+    nd = x.ndim
+    p = _ints(pad)
+    if len(p) == 2 * nd:
+        width = [(p[2 * i], p[2 * i + 1]) for i in range(nd)]
+    else:
+        # spatial padding: paddle orders [left, right, top, bottom,...]
+        # applied to W (last), H, ... of the format's spatial dims.
+        width = [(0, 0)] * nd
+        spatial = []
+        if data_format.endswith("C"):  # NHWC / NLC / NDHWC
+            spatial = list(range(1, nd - 1))
+        else:  # NCHW / NCL / NCDHW
+            spatial = list(range(2, nd))
+        pairs = [(p[i], p[i + 1]) for i in range(0, len(p), 2)]
+        # paddle lists pads from the last spatial dim backward
+        for dim, pair in zip(reversed(spatial), pairs):
+            width[dim] = pair
+    jmode = {"constant": "constant", "reflect": "reflect",
+             "replicate": "edge", "circular": "wrap"}[mode]
+    kwargs = {"constant_values": value} if jmode == "constant" else {}
+    return apply_op("pad", lambda v: jnp.pad(v, width, mode=jmode, **kwargs), [x])
+
+
+# -- gather / scatter -------------------------------------------------------
+def gather(x, index, axis=0, name=None):
+    ax = int(axis._value) if isinstance(axis, Tensor) else int(axis)
+    return apply_op("gather",
+                    lambda v, i: jnp.take(v, i.reshape(-1), axis=ax),
+                    [_t(x), _t(index)])
+
+
+def gather_nd(x, index, name=None):
+    def fn(v, idx):
+        k = idx.shape[-1]
+        flat_idx = tuple(idx[..., i] for i in range(k))
+        return v[flat_idx]
+    return apply_op("gather_nd", fn, [_t(x), _t(index)])
+
+
+def take_along_axis(arr, indices, axis, broadcast=True, name=None):
+    return apply_op("take_along_axis",
+                    lambda v, i: jnp.take_along_axis(v, i, axis=axis),
+                    [_t(arr), _t(indices)])
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign", name=None):  # noqa: A002
+    def fn(v, i, val):
+        val = jnp.broadcast_to(jnp.asarray(val, v.dtype), i.shape)
+        if reduce == "assign":
+            return jnp.put_along_axis(v, i, val, axis=axis, inplace=False)
+        mode = {"add": "add", "mul": "multiply", "multiply": "multiply"}[reduce]
+        dim_idx = [jnp.arange(s).reshape([-1 if d == k else 1 for k in range(i.ndim)])
+                   for d, s in enumerate(i.shape)]
+        full = tuple(i if d == axis % v.ndim else jnp.broadcast_to(dim_idx[d], i.shape)
+                     for d in range(v.ndim))
+        at = v.at[full]
+        return at.add(val) if mode == "add" else at.multiply(val)
+    vt = values if isinstance(values, Tensor) else Tensor(jnp.asarray(values))
+    return apply_op("put_along_axis", fn, [_t(arr), _t(indices), vt])
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    def fn(v, i, u):
+        i = i.reshape(-1)
+        if overwrite:
+            return v.at[i].set(u)
+        base = v.at[i].set(jnp.zeros_like(u))
+        return base.at[i].add(u)
+    return apply_op("scatter", fn, [_t(x), _t(index), _t(updates)])
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    def fn(v, i, u):
+        k = i.shape[-1]
+        idx = tuple(i[..., d] for d in range(k))
+        return v.at[idx].add(u)
+    return apply_op("scatter_nd_add", fn, [_t(x), _t(index), _t(updates)])
+
+
+def scatter_nd(index, updates, shape, name=None):
+    zeros_shape = _ints(shape)
+
+    def fn(i, u):
+        k = i.shape[-1]
+        idx = tuple(i[..., d] for d in range(k))
+        return jnp.zeros(zeros_shape, u.dtype).at[idx].add(u)
+    return apply_op("scatter_nd", fn, [_t(index), _t(updates)])
+
+
+def index_select(x, index, axis=0, name=None):
+    return apply_op("index_select",
+                    lambda v, i: jnp.take(v, i.reshape(-1), axis=axis),
+                    [_t(x), _t(index)])
+
+
+def index_sample(x, index):
+    return apply_op("index_sample",
+                    lambda v, i: jnp.take_along_axis(v, i, axis=1),
+                    [_t(x), _t(index)])
+
+
+def index_add(x, index, axis, value, name=None):
+    def fn(v, i, u):
+        idx = [slice(None)] * v.ndim
+        idx[axis] = i.reshape(-1)
+        return v.at[tuple(idx)].add(u)
+    return apply_op("index_add", fn, [_t(x), _t(index), _t(value)])
+
+
+def index_put(x, indices, value, accumulate=False, name=None):
+    def fn(v, u, *idx):
+        at = v.at[tuple(idx)]
+        return at.add(u) if accumulate else at.set(u)
+    idx_t = [_t(i) for i in indices]
+    return apply_op("index_put", fn, [_t(x), _t(value)] + idx_t)
+
+
+def masked_select(x, mask, name=None):
+    # Dynamic output shape — host-side op, not jittable (XLA static shapes).
+    x, mask = _t(x), _t(mask)
+    return Tensor(x._value[np.asarray(mask._value)])
+
+
+def masked_fill(x, mask, value, name=None):
+    v = value._value if isinstance(value, Tensor) else value
+    return apply_op("masked_fill",
+                    lambda a, m: jnp.where(m, jnp.asarray(v, a.dtype), a),
+                    [_t(x), _t(mask)])
+
+
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=True)
+    return apply_op("where",
+                    lambda c, a, b: jnp.where(c, a, b),
+                    [_t(condition), _t(x), _t(y)])
+
+
+def nonzero(x, as_tuple=False):
+    # Dynamic output shape — host-side (ref WhereIndexKernel).
+    arr = np.asarray(_t(x)._value)
+    idx = np.nonzero(arr)
+    if as_tuple:
+        return tuple(Tensor(jnp.asarray(i)) for i in idx)
+    return Tensor(jnp.asarray(np.stack(idx, axis=1)))
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None, dtype="int64", name=None):
+    # Dynamic output shape — host-side (ref UniqueKernel).
+    arr = np.asarray(_t(x)._value)
+    res = np.unique(arr, return_index=return_index,
+                    return_inverse=return_inverse, return_counts=return_counts,
+                    axis=axis)
+    if not isinstance(res, tuple):
+        return Tensor(jnp.asarray(res))
+    return tuple(Tensor(jnp.asarray(r)) for r in res)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None,
+                       dtype="int64", name=None):
+    arr = np.asarray(_t(x)._value)
+    if axis is None:
+        arr = arr.reshape(-1)
+    change = np.ones(arr.shape[0], dtype=bool)
+    change[1:] = np.any(
+        (arr[1:] != arr[:-1]).reshape(arr.shape[0] - 1, -1), axis=1)
+    starts = np.nonzero(change)[0]
+    out = [Tensor(jnp.asarray(arr[starts]))]
+    if return_inverse:
+        out.append(Tensor(jnp.asarray(np.cumsum(change) - 1)))
+    if return_counts:
+        counts = np.diff(np.append(starts, arr.shape[0]))
+        out.append(Tensor(jnp.asarray(counts)))
+    return out[0] if len(out) == 1 else tuple(out)
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    if isinstance(repeats, Tensor):
+        repeats = np.asarray(repeats._value).tolist()
+
+    def fn(v):
+        return jnp.repeat(v, repeats, axis=axis,
+                          total_repeat_length=None if isinstance(repeats, int)
+                          else int(np.sum(repeats)))
+    return apply_op("repeat_interleave", fn, [_t(x)])
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    def fn(v):
+        idx = [slice(None)] * v.ndim
+        for ax, s, e, st in zip(_ints(axes), _ints(starts), _ints(ends), _ints(strides)):
+            idx[ax] = slice(s, e, st)
+        return v[tuple(idx)]
+    return apply_op("strided_slice", fn, [_t(x)])
+
+
+def slice(x, axes, starts, ends, name=None):  # noqa: A001
+    return strided_slice(x, axes, starts, ends, [1] * len(_ints(axes)))
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    x = _t(x)
+    shp = _ints(shape) if shape is not None else tuple(x.shape)
+    offs = _ints(offsets) if offsets is not None else (0,) * x.ndim
+    return apply_op("crop",
+                    lambda v: jax.lax.dynamic_slice(v, offs, shp), [x])
+
+
+def as_complex(x, name=None):
+    return apply_op("as_complex", lambda v: jax.lax.complex(v[..., 0], v[..., 1]), [_t(x)])
+
+
+def as_real(x, name=None):
+    return apply_op("as_real",
+                    lambda v: jnp.stack([jnp.real(v), jnp.imag(v)], axis=-1), [_t(x)])
+
+
+def view(x, shape_or_dtype, name=None):
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return reshape(x, shape_or_dtype)
+    d = convert_dtype(shape_or_dtype)
+    return apply_op("view_dtype", lambda v: v.view(d), [_t(x)])
+
+
+def atleast_1d(*inputs):
+    outs = [apply_op("atleast_1d", jnp.atleast_1d, [_t(x)]) for x in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_2d(*inputs):
+    outs = [apply_op("atleast_2d", jnp.atleast_2d, [_t(x)]) for x in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_3d(*inputs):
+    outs = [apply_op("atleast_3d", jnp.atleast_3d, [_t(x)]) for x in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):  # noqa: A002
+    def fn(v):
+        shard_size = (index_num + nshards - 1) // nshards
+        lo, hi = shard_id * shard_size, (shard_id + 1) * shard_size
+        in_shard = (v >= lo) & (v < hi)
+        return jnp.where(in_shard, v - lo, ignore_value)
+    with autograd.no_grad():
+        return apply_op("shard_index", fn, [_t(input)])

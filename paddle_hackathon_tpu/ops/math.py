@@ -1,0 +1,324 @@
+"""Elementwise math + reduction ops (ref ``python/paddle/tensor/math.py``,
+``python/paddle/tensor/stat.py``; kernels ref ``paddle/phi/kernels/*``).
+
+Every op is a taped jax.numpy composition — XLA fuses chains of these into
+single HBM-bandwidth-bound kernels, which is what the reference's
+``ir/fusion_group`` NVRTC JIT pass does by hand.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core import autograd
+from ..core.autograd import apply_op
+from ..core.tensor import Tensor
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+
+
+def _unary(name, fn):
+    def op(x, name=None):
+        return apply_op(name_, fn, [_t(x)])
+    name_ = name
+    op.__name__ = name
+    op.__qualname__ = name
+    op.__doc__ = f"Elementwise {name} (ref phi::{name.capitalize()}Kernel)."
+    return op
+
+
+def _binary(name, fn):
+    def op(x, y, name=None):
+        return apply_op(name_, fn, [_t(x), _t(y)])
+    name_ = name
+    op.__name__ = name
+    op.__qualname__ = name
+    op.__doc__ = f"Elementwise {name} with numpy broadcasting."
+    return op
+
+
+# -- unary ------------------------------------------------------------------
+exp = _unary("exp", jnp.exp)
+expm1 = _unary("expm1", jnp.expm1)
+log = _unary("log", jnp.log)
+log2 = _unary("log2", jnp.log2)
+log10 = _unary("log10", jnp.log10)
+log1p = _unary("log1p", jnp.log1p)
+sqrt = _unary("sqrt", jnp.sqrt)
+rsqrt = _unary("rsqrt", jax.lax.rsqrt)
+abs = _unary("abs", jnp.abs)  # noqa: A001 - matches paddle.abs
+sign = _unary("sign", jnp.sign)
+floor = _unary("floor", jnp.floor)
+ceil = _unary("ceil", jnp.ceil)
+round = _unary("round", jnp.round)  # noqa: A001
+trunc = _unary("trunc", jnp.trunc)
+frac = _unary("frac", lambda x: x - jnp.trunc(x))
+sin = _unary("sin", jnp.sin)
+cos = _unary("cos", jnp.cos)
+tan = _unary("tan", jnp.tan)
+asin = _unary("asin", jnp.arcsin)
+acos = _unary("acos", jnp.arccos)
+atan = _unary("atan", jnp.arctan)
+sinh = _unary("sinh", jnp.sinh)
+cosh = _unary("cosh", jnp.cosh)
+tanh = _unary("tanh", jnp.tanh)
+asinh = _unary("asinh", jnp.arcsinh)
+acosh = _unary("acosh", jnp.arccosh)
+atanh = _unary("atanh", jnp.arctanh)
+reciprocal = _unary("reciprocal", lambda x: 1.0 / x)
+square = _unary("square", jnp.square)
+neg = _unary("neg", jnp.negative)
+erf = _unary("erf", jax.scipy.special.erf)
+erfinv = _unary("erfinv", jax.scipy.special.erfinv)
+digamma = _unary("digamma", jax.scipy.special.digamma)
+lgamma = _unary("lgamma", jax.scipy.special.gammaln)
+angle = _unary("angle", jnp.angle)
+conj = _unary("conj", jnp.conj)
+real = _unary("real", jnp.real)
+imag = _unary("imag", jnp.imag)
+
+# -- binary -----------------------------------------------------------------
+add = _binary("add", jnp.add)
+subtract = _binary("subtract", jnp.subtract)
+multiply = _binary("multiply", jnp.multiply)
+divide = _binary("divide", jnp.divide)
+floor_divide = _binary("floor_divide", jnp.floor_divide)
+remainder = _binary("remainder", jnp.remainder)
+mod = remainder
+floor_mod = remainder
+pow = _binary("pow", jnp.power)  # noqa: A001
+maximum = _binary("maximum", jnp.maximum)
+minimum = _binary("minimum", jnp.minimum)
+fmax = _binary("fmax", jnp.fmax)
+fmin = _binary("fmin", jnp.fmin)
+atan2 = _binary("atan2", jnp.arctan2)
+logaddexp = _binary("logaddexp", jnp.logaddexp)
+heaviside = _binary("heaviside", jnp.heaviside)
+hypot = _binary("hypot", jnp.hypot)
+gcd = _binary("gcd", jnp.gcd)
+lcm = _binary("lcm", jnp.lcm)
+kron = _binary("kron", jnp.kron)
+inner = _binary("inner", jnp.inner)
+outer = _binary("outer", jnp.outer)
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    """paddle.scale (ref phi ScaleKernel)."""
+    def fn(v, s, b):
+        return v * s + b if bias_after_scale else (v + b) * s
+    out = apply_op("scale", lambda v: fn(v, scale, bias), [_t(x)])
+    if act == "relu":
+        return apply_op("relu", jax.nn.relu, [out])
+    return out
+
+
+def clip(x, min=None, max=None, name=None):  # noqa: A002
+    lo = min._value if isinstance(min, Tensor) else min
+    hi = max._value if isinstance(max, Tensor) else max
+    return apply_op("clip", lambda v: jnp.clip(v, lo, hi), [_t(x)])
+
+
+def lerp(x, y, weight, name=None):
+    w = weight if isinstance(weight, Tensor) else Tensor(jnp.asarray(weight))
+    return apply_op("lerp", lambda a, b, t: a + t * (b - a), [_t(x), _t(y), w])
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):  # noqa: A002
+    return apply_op("addmm", lambda i, a, b: beta * i + alpha * (a @ b),
+                    [_t(input), _t(x), _t(y)])
+
+
+def multiplex(inputs, index, name=None):
+    stacked = stack(inputs, axis=0)
+    idx = index._value.reshape(-1)
+    return apply_op("multiplex",
+                    lambda s: s[idx, jnp.arange(s.shape[1])], [stacked])
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    return apply_op("nan_to_num",
+                    lambda v: jnp.nan_to_num(v, nan=nan, posinf=posinf, neginf=neginf),
+                    [_t(x)])
+
+
+def isnan(x, name=None):
+    with autograd.no_grad():
+        return apply_op("isnan", jnp.isnan, [_t(x)])
+
+
+def isinf(x, name=None):
+    with autograd.no_grad():
+        return apply_op("isinf", jnp.isinf, [_t(x)])
+
+
+def isfinite(x, name=None):
+    with autograd.no_grad():
+        return apply_op("isfinite", jnp.isfinite, [_t(x)])
+
+
+def isclose(x, y, rtol=1e-5, atol=1e-8, equal_nan=False, name=None):
+    with autograd.no_grad():
+        return apply_op("isclose",
+                        lambda a, b: jnp.isclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan),
+                        [_t(x), _t(y)])
+
+
+def allclose(x, y, rtol=1e-5, atol=1e-8, equal_nan=False, name=None):
+    with autograd.no_grad():
+        return apply_op("allclose",
+                        lambda a, b: jnp.allclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan),
+                        [_t(x), _t(y)])
+
+
+def equal_all(x, y, name=None):
+    with autograd.no_grad():
+        return apply_op("equal_all", lambda a, b: jnp.array_equal(a, b), [_t(x), _t(y)])
+
+
+# -- logical ----------------------------------------------------------------
+def _logical(name, fn):
+    def op(x, y=None, out=None, name=None):
+        with autograd.no_grad():
+            if y is None:
+                return apply_op(name_, fn, [_t(x)])
+            return apply_op(name_, fn, [_t(x), _t(y)])
+    name_ = name
+    op.__name__ = name
+    return op
+
+
+logical_and = _logical("logical_and", jnp.logical_and)
+logical_or = _logical("logical_or", jnp.logical_or)
+logical_xor = _logical("logical_xor", jnp.logical_xor)
+logical_not = _logical("logical_not", jnp.logical_not)
+bitwise_and = _logical("bitwise_and", jnp.bitwise_and)
+bitwise_or = _logical("bitwise_or", jnp.bitwise_or)
+bitwise_xor = _logical("bitwise_xor", jnp.bitwise_xor)
+bitwise_not = _logical("bitwise_not", jnp.bitwise_not)
+
+equal = _logical("equal", jnp.equal)
+not_equal = _logical("not_equal", jnp.not_equal)
+less_than = _logical("less_than", jnp.less)
+less_equal = _logical("less_equal", jnp.less_equal)
+greater_than = _logical("greater_than", jnp.greater)
+greater_equal = _logical("greater_equal", jnp.greater_equal)
+
+
+# -- reductions -------------------------------------------------------------
+def _axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, Tensor):
+        axis = axis.tolist()
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def _reduce(name, fn):
+    def op(x, axis=None, keepdim=False, name=None):
+        ax = _axis(axis)
+        return apply_op(name_, lambda v: fn(v, axis=ax, keepdims=keepdim), [_t(x)])
+    name_ = name
+    op.__name__ = name
+    op.__doc__ = f"Reduce-{name} (ref phi Reduce{name.capitalize()}Kernel)."
+    return op
+
+
+sum = _reduce("sum", jnp.sum)  # noqa: A001
+mean = _reduce("mean", jnp.mean)
+prod = _reduce("prod", jnp.prod)
+max = _reduce("max", jnp.max)  # noqa: A001
+min = _reduce("min", jnp.min)  # noqa: A001
+amax = _reduce("amax", jnp.max)
+amin = _reduce("amin", jnp.min)
+nansum = _reduce("nansum", jnp.nansum)
+nanmean = _reduce("nanmean", jnp.nanmean)
+logsumexp = _reduce("logsumexp", jax.scipy.special.logsumexp)
+
+
+def all(x, axis=None, keepdim=False, name=None):  # noqa: A001
+    with autograd.no_grad():
+        return apply_op("all", lambda v: jnp.all(v, axis=_axis(axis), keepdims=keepdim), [_t(x)])
+
+
+def any(x, axis=None, keepdim=False, name=None):  # noqa: A001
+    with autograd.no_grad():
+        return apply_op("any", lambda v: jnp.any(v, axis=_axis(axis), keepdims=keepdim), [_t(x)])
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    ddof = 1 if unbiased else 0
+    return apply_op("std", lambda v: jnp.std(v, axis=_axis(axis), ddof=ddof, keepdims=keepdim), [_t(x)])
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    ddof = 1 if unbiased else 0
+    return apply_op("var", lambda v: jnp.var(v, axis=_axis(axis), ddof=ddof, keepdims=keepdim), [_t(x)])
+
+
+def median(x, axis=None, keepdim=False, name=None):
+    return apply_op("median", lambda v: jnp.median(v, axis=_axis(axis), keepdims=keepdim), [_t(x)])
+
+
+def quantile(x, q, axis=None, keepdim=False, name=None):
+    return apply_op("quantile",
+                    lambda v: jnp.quantile(v, jnp.asarray(q), axis=_axis(axis), keepdims=keepdim),
+                    [_t(x)])
+
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    def fn(v):
+        if axis is None:
+            v = v.reshape(-1)
+            return jnp.cumsum(v)
+        return jnp.cumsum(v, axis=int(axis))
+    return apply_op("cumsum", fn, [_t(x)])
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    def fn(v):
+        if dim is None:
+            return jnp.cumprod(v.reshape(-1))
+        return jnp.cumprod(v, axis=int(dim))
+    return apply_op("cumprod", fn, [_t(x)])
+
+
+def cummax(x, axis=None, name=None):
+    def fn(v):
+        a = 0 if axis is None else int(axis)
+        vv = v.reshape(-1) if axis is None else v
+        return jax.lax.associative_scan(jnp.maximum, vv, axis=a)
+    return apply_op("cummax", fn, [_t(x)])
+
+
+def cummin(x, axis=None, name=None):
+    def fn(v):
+        a = 0 if axis is None else int(axis)
+        vv = v.reshape(-1) if axis is None else v
+        return jax.lax.associative_scan(jnp.minimum, vv, axis=a)
+    return apply_op("cummin", fn, [_t(x)])
+
+
+def diff(x, n=1, axis=-1, name=None):
+    return apply_op("diff", lambda v: jnp.diff(v, n=n, axis=axis), [_t(x)])
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply_op("trace",
+                    lambda v: jnp.trace(v, offset=offset, axis1=axis1, axis2=axis2), [_t(x)])
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    with autograd.no_grad():
+        return apply_op("count_nonzero",
+                        lambda v: jnp.count_nonzero(v, axis=_axis(axis), keepdims=keepdim), [_t(x)])
+
+
+# needed by multiplex; full version lives in manipulation.py
+def stack(x, axis=0, name=None):
+    tensors = [_t(v) for v in x]
+    return apply_op("stack", lambda *vs: jnp.stack(vs, axis=axis), tensors)

@@ -1,0 +1,126 @@
+"""Random ops (ref ``python/paddle/tensor/random.py``).
+
+Stateful API over JAX's functional PRNG: each call splits a subkey from the
+global generator (``core.random``), or from the active :func:`rng_scope` key
+when tracing (so compiled programs get fresh randomness per step via an
+explicit key input — the TPU-native replacement for the reference's per-device
+curand states).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core import random as core_random
+from ..core.autograd import apply_op
+from ..core.dtype import convert_dtype, default_float_dtype
+from ..core.tensor import Tensor
+from .creation import _shape
+
+
+def _dt(dtype):
+    d = convert_dtype(dtype)
+    return d if d is not None else default_float_dtype()
+
+
+def rand(shape, dtype=None, name=None) -> Tensor:
+    key = core_random.split_key()
+    return Tensor(jax.random.uniform(key, _shape(shape), _dt(dtype)))
+
+
+def randn(shape, dtype=None, name=None) -> Tensor:
+    key = core_random.split_key()
+    return Tensor(jax.random.normal(key, _shape(shape), _dt(dtype)))
+
+
+def standard_normal(shape, dtype=None, name=None) -> Tensor:
+    return randn(shape, dtype)
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None) -> Tensor:
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = mean._value if isinstance(mean, Tensor) else mean
+        s = std._value if isinstance(std, Tensor) else std
+        shp = jnp.broadcast_shapes(
+            jnp.shape(m), jnp.shape(s)) if shape is None else _shape(shape)
+        key = core_random.split_key()
+        return Tensor(jax.random.normal(key, shp, default_float_dtype()) * s + m)
+    key = core_random.split_key()
+    return Tensor(
+        jax.random.normal(key, _shape(shape or [1]), default_float_dtype()) * std + mean)
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None) -> Tensor:  # noqa: A002
+    key = jax.random.key(seed) if seed else core_random.split_key()
+    return Tensor(jax.random.uniform(
+        key, _shape(shape), _dt(dtype), minval=min, maxval=max))
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64", name=None) -> Tensor:
+    if high is None:
+        low, high = 0, low
+    key = core_random.split_key()
+    d = convert_dtype(dtype)
+    d = jnp.int32 if d == jnp.int64 else d  # int32 is the TPU-native int
+    return Tensor(jax.random.randint(key, _shape(shape), low, high, dtype=d))
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None) -> Tensor:
+    shape = x.shape if isinstance(x, Tensor) else jnp.shape(x)
+    return randint(low, high, shape, dtype or "int32")
+
+
+def randperm(n, dtype="int64", name=None) -> Tensor:
+    key = core_random.split_key()
+    d = convert_dtype(dtype)
+    d = jnp.int32 if d == jnp.int64 else d
+    return Tensor(jax.random.permutation(key, n).astype(d))
+
+
+def bernoulli(x, name=None) -> Tensor:
+    key = core_random.split_key()
+    return apply_op(
+        "bernoulli",
+        lambda p: jax.random.bernoulli(key, p).astype(p.dtype), [x])
+
+
+def poisson(x, name=None) -> Tensor:
+    key = core_random.split_key()
+    return apply_op("poisson",
+                    lambda lam: jax.random.poisson(key, lam).astype(lam.dtype), [x])
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None) -> Tensor:
+    key = core_random.split_key()
+
+    def fn(p):
+        logits = jnp.log(jnp.maximum(p, 1e-30))
+        if replacement:
+            return jax.random.categorical(key, logits, axis=-1,
+                                          shape=p.shape[:-1] + (num_samples,))
+        # without replacement: Gumbel top-k trick
+        g = jax.random.gumbel(key, p.shape, p.dtype)
+        _, idx = jax.lax.top_k(logits + g, num_samples)
+        return idx
+    t = x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+    out = apply_op("multinomial", fn, [t])
+    return Tensor(out._value.astype(jnp.int32))
+
+
+def exponential_(x, lam=1.0, name=None) -> Tensor:
+    key = core_random.split_key()
+    x._set_value(jax.random.exponential(key, tuple(x.shape), x.dtype) / lam)
+    return x
+
+
+def normal_(x, mean=0.0, std=1.0, name=None) -> Tensor:
+    key = core_random.split_key()
+    x._set_value(jax.random.normal(key, tuple(x.shape), x.dtype) * std + mean)
+    return x
+
+
+def uniform_(x, min=-1.0, max=1.0, name=None) -> Tensor:  # noqa: A002
+    key = core_random.split_key()
+    x._set_value(jax.random.uniform(key, tuple(x.shape), x.dtype, min, max))
+    return x

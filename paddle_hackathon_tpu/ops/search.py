@@ -1,0 +1,145 @@
+"""Search / sort ops (ref ``python/paddle/tensor/search.py``)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core import autograd
+from ..core.autograd import apply_op
+from ..core.dtype import convert_dtype
+from ..core.tensor import Tensor
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    with autograd.no_grad():
+        def fn(v):
+            out = jnp.argmax(v if axis is not None else v.reshape(-1),
+                             axis=axis if axis is not None else 0)
+            if keepdim and axis is not None:
+                out = jnp.expand_dims(out, axis)
+            return out.astype(jnp.int32)
+        return apply_op("argmax", fn, [_t(x)])
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    with autograd.no_grad():
+        def fn(v):
+            out = jnp.argmin(v if axis is not None else v.reshape(-1),
+                             axis=axis if axis is not None else 0)
+            if keepdim and axis is not None:
+                out = jnp.expand_dims(out, axis)
+            return out.astype(jnp.int32)
+        return apply_op("argmin", fn, [_t(x)])
+
+
+def argsort(x, axis=-1, descending=False, stable=True, name=None):
+    with autograd.no_grad():
+        def fn(v):
+            idx = jnp.argsort(v, axis=axis, stable=stable,
+                              descending=descending)
+            return idx.astype(jnp.int32)
+        return apply_op("argsort", fn, [_t(x)])
+
+
+def sort(x, axis=-1, descending=False, stable=True, name=None):
+    def fn(v):
+        out = jnp.sort(v, axis=axis, stable=stable, descending=descending)
+        return out
+    return apply_op("sort", fn, [_t(x)])
+
+
+def topk(x, k, axis=-1, largest=True, sorted=True, name=None):  # noqa: A002
+    """Top-k (ref phi TopkKernel) — lowered to lax.top_k on the last axis."""
+    if isinstance(k, Tensor):
+        k = int(k.item())
+
+    def fn(v):
+        ax = axis % v.ndim
+        moved = jnp.moveaxis(v, ax, -1)
+        vals, idx = jax.lax.top_k(moved if largest else -moved, k)
+        if not largest:
+            vals = -vals
+        return (jnp.moveaxis(vals, -1, ax),
+                jnp.moveaxis(idx, -1, ax).astype(jnp.int32))
+    vals, idx = apply_op("topk", fn, [_t(x)])
+    idx.stop_gradient = True
+    return vals, idx
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    def fn(v):
+        ax = axis % v.ndim
+        moved = jnp.moveaxis(v, ax, -1)
+        sorted_v = jnp.sort(moved, axis=-1)
+        sorted_i = jnp.argsort(moved, axis=-1)
+        vals = sorted_v[..., k - 1]
+        idx = sorted_i[..., k - 1]
+        if keepdim:
+            vals, idx = jnp.expand_dims(vals, ax), jnp.expand_dims(idx, ax)
+        return vals, idx.astype(jnp.int32)
+    vals, idx = apply_op("kthvalue", fn, [_t(x)])
+    idx.stop_gradient = True
+    return vals, idx
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    def fn(v):
+        ax = axis % v.ndim
+        moved = jnp.moveaxis(v, ax, -1)
+        sorted_v = jnp.sort(moved, axis=-1)
+        n = sorted_v.shape[-1]
+        runs = jnp.sum(sorted_v[..., :, None] == sorted_v[..., None, :], axis=-1)
+        best = jnp.argmax(runs, axis=-1)
+        vals = jnp.take_along_axis(sorted_v, best[..., None], axis=-1)[..., 0]
+        idx = jnp.argmax(moved == vals[..., None], axis=-1)
+        if keepdim:
+            vals, idx = jnp.expand_dims(vals, ax), jnp.expand_dims(idx, ax)
+        return vals, idx.astype(jnp.int32)
+    vals, idx = apply_op("mode", fn, [_t(x)])
+    idx.stop_gradient = True
+    return vals, idx
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
+    with autograd.no_grad():
+        def fn(seq, v):
+            side = "right" if right else "left"
+            if seq.ndim == 1:
+                out = jnp.searchsorted(seq, v, side=side)
+            else:
+                out = jax.vmap(lambda s, q: jnp.searchsorted(s, q, side=side))(
+                    seq.reshape(-1, seq.shape[-1]), v.reshape(-1, v.shape[-1])
+                ).reshape(v.shape)
+            return out.astype(jnp.int32 if out_int32 else jnp.int32)
+        return apply_op("searchsorted", fn, [_t(sorted_sequence), _t(values)])
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    return searchsorted(sorted_sequence, x, out_int32=out_int32, right=right)
+
+
+def histogram(input, bins=100, min=0, max=0, name=None):  # noqa: A002
+    with autograd.no_grad():
+        def fn(v):
+            lo, hi = (min, max) if (min != 0 or max != 0) else (v.min(), v.max())
+            h, _ = jnp.histogram(v, bins=bins, range=(lo, hi))
+            return h.astype(jnp.int32)
+        return apply_op("histogram", fn, [_t(input)])
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    with autograd.no_grad():
+        arr = _t(x)
+        n = int(max(int(jnp.max(arr._value)) + 1 if arr.size else 1, minlength))
+
+        def fn(v, *w):
+            return jnp.bincount(v.reshape(-1),
+                                weights=w[0].reshape(-1) if w else None,
+                                minlength=n, length=n)
+        args = [arr] + ([_t(weights)] if weights is not None else [])
+        return apply_op("bincount", fn, args)

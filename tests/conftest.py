@@ -1,0 +1,34 @@
+"""Test harness configuration.
+
+Forces an 8-device virtual CPU mesh (the pattern SURVEY.md §7 prescribes for
+testing multi-chip sharding without TPU hardware — analogous to how the
+reference tests distributed code with multi-process-on-one-host,
+``test_dist_base.py:786``). Must run before jax is imported anywhere.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# The axon environment's sitecustomize force-sets jax_platforms="axon,cpu",
+# overriding the env var — set it back so tests run on the virtual 8-device
+# CPU mesh, not through the real-chip tunnel.
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _seeded():
+    import paddle_hackathon_tpu as paddle
+
+    np.random.seed(0)
+    paddle.seed(0)
+    yield

@@ -1,0 +1,159 @@
+"""Eager autograd engine tests.
+
+Mirrors the reference's eager-mode tests
+(``python/paddle/fluid/tests/unittests/test_imperative_*``): correctness of the
+ready-queue backward walk, accumulation, hooks, no_grad, paddle.grad.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_hackathon_tpu as paddle
+
+
+def test_simple_chain():
+    x = paddle.to_tensor([1.0, 2.0, 3.0], stop_gradient=False)
+    y = (x * 2 + 1).sum()
+    y.backward()
+    assert np.allclose(x.grad.numpy(), [2.0, 2.0, 2.0])
+
+
+def test_matmul_grad_matches_jax():
+    import jax
+    import jax.numpy as jnp
+
+    a = np.random.randn(4, 3).astype("float32")
+    b = np.random.randn(3, 5).astype("float32")
+    x = paddle.to_tensor(a, stop_gradient=False)
+    w = paddle.to_tensor(b, stop_gradient=False)
+    loss = paddle.tanh(paddle.matmul(x, w)).mean()
+    loss.backward()
+
+    f = lambda p, q: jnp.mean(jnp.tanh(p @ q))
+    ga, gb = jax.grad(f, argnums=(0, 1))(a, b)
+    np.testing.assert_allclose(x.grad.numpy(), ga, atol=1e-5)
+    np.testing.assert_allclose(w.grad.numpy(), gb, atol=1e-5)
+
+
+def test_diamond_accumulation():
+    a = paddle.to_tensor([2.0], stop_gradient=False)
+    b = a * a
+    c = b + 3 * b
+    c.backward()
+    assert np.allclose(a.grad.numpy(), [16.0])  # d/da 4a^2 = 8a
+
+
+def test_grad_accumulates_across_backwards():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    (x * 2).backward()
+    (x * 3).backward()
+    assert np.allclose(x.grad.numpy(), [5.0])
+    x.clear_grad()
+    assert x.grad is None
+
+
+def test_retain_graph():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = x * x
+    y.backward(retain_graph=True)
+    y.backward()
+    assert np.allclose(x.grad.numpy(), [4.0])
+
+
+def test_released_graph_raises():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = x * x
+    y.backward()
+    with pytest.raises(RuntimeError):
+        y.backward()
+
+
+def test_no_grad():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    with paddle.no_grad():
+        y = x * 2
+    assert y.stop_gradient
+    assert y._grad_node is None
+
+
+def test_stop_gradient_cuts_graph():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = (x * 2).detach()
+    z = y * 3
+    assert z.stop_gradient
+
+
+def test_paddle_grad_api():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = (x ** 3).sum()
+    (g,) = paddle.grad(y, [x])
+    np.testing.assert_allclose(g.numpy(), 3 * x.numpy() ** 2, atol=1e-5)
+    assert x.grad is None  # .grad untouched
+
+
+def test_grad_allow_unused():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    z = paddle.to_tensor([1.0], stop_gradient=False)
+    y = x * 2
+    with pytest.raises(ValueError):
+        paddle.grad(y, [z])
+    y = x * 2  # the failed call consumed the graph (retain_graph=False)
+    (g,) = paddle.grad(y, [z], allow_unused=True)
+    assert g is None
+
+
+def test_leaf_hook_modifies_grad():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    x.register_hook(lambda g: g * 10)
+    (x * 2).backward()
+    assert np.allclose(x.grad.numpy(), [20.0])
+
+
+def test_intermediate_hook_observes_grad():
+    seen = []
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    mid = x * 2
+    mid.register_hook(lambda g: seen.append(g.numpy()))
+    (mid * 3).backward()
+    assert np.allclose(seen[0], [3.0])
+    assert np.allclose(x.grad.numpy(), [6.0])
+
+
+def test_multi_output_op_grads():
+    x = paddle.to_tensor(np.arange(6, dtype="float32").reshape(2, 3),
+                         stop_gradient=False)
+    a, b = paddle.split(x, 2, axis=0)
+    (a.sum() * 2 + b.sum() * 3).backward()
+    expected = np.array([[2, 2, 2], [3, 3, 3]], dtype="float32")
+    np.testing.assert_allclose(x.grad.numpy(), expected)
+
+
+def test_backward_with_grad_tensor():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = x * 3
+    y.backward(paddle.to_tensor([1.0, 10.0]))
+    np.testing.assert_allclose(x.grad.numpy(), [3.0, 30.0])
+
+
+def test_int_tensors_not_differentiable():
+    x = paddle.to_tensor([1, 2, 3], stop_gradient=False)
+    y = x + 1
+    assert y._grad_node is None
+
+
+def test_setitem_on_tape():
+    x = paddle.to_tensor([1.0, 2.0, 3.0], stop_gradient=False)
+    y = x * 2
+    y[1] = 0.0
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0, 0.0, 2.0])
+
+
+def test_nan_check_flag():
+    paddle.set_flags({"check_nan_inf": True})
+    try:
+        x = paddle.to_tensor([-1.0])
+        with pytest.raises(FloatingPointError):
+            paddle.log(x)
+    finally:
+        paddle.set_flags({"check_nan_inf": False})

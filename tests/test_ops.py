@@ -1,0 +1,219 @@
+"""Op library correctness vs NumPy — the OpTest pattern of the reference
+(``python/paddle/fluid/tests/unittests/op_test.py:309`` check_output/check_grad
+against NumPy references), collapsed into direct comparisons."""
+
+import numpy as np
+import pytest
+
+import paddle_hackathon_tpu as paddle
+
+
+def test_creation_ops():
+    assert paddle.zeros([2, 3]).numpy().sum() == 0
+    assert paddle.ones([2, 3]).numpy().sum() == 6
+    np.testing.assert_allclose(paddle.full([2], 7).numpy(), [7, 7])
+    np.testing.assert_allclose(paddle.arange(5).numpy(), np.arange(5))
+    np.testing.assert_allclose(paddle.arange(1, 10, 2).numpy(), np.arange(1, 10, 2))
+    np.testing.assert_allclose(paddle.linspace(0, 1, 5).numpy(), np.linspace(0, 1, 5))
+    np.testing.assert_allclose(paddle.eye(3).numpy(), np.eye(3))
+    x = paddle.to_tensor([1.0, 2.0])
+    np.testing.assert_allclose(paddle.zeros_like(x).numpy(), [0, 0])
+    np.testing.assert_allclose(paddle.full_like(x, 3).numpy(), [3, 3])
+
+
+def test_elementwise_vs_numpy():
+    a = np.random.rand(3, 4).astype("float32") + 0.5
+    t = paddle.to_tensor(a)
+    for pd_op, np_op in [
+        (paddle.exp, np.exp), (paddle.log, np.log), (paddle.sqrt, np.sqrt),
+        (paddle.tanh, np.tanh), (paddle.floor, np.floor),
+        (paddle.ceil, np.ceil), (paddle.sign, np.sign),
+        (paddle.square, np.square), (paddle.abs, np.abs),
+        (paddle.sin, np.sin), (paddle.cos, np.cos),
+    ]:
+        np.testing.assert_allclose(pd_op(t).numpy(), np_op(a), rtol=1e-3,
+                                   atol=1e-6, err_msg=pd_op.__name__)
+
+
+def test_binary_broadcasting():
+    a = np.random.rand(3, 1, 4).astype("float32")
+    b = np.random.rand(2, 4).astype("float32")
+    out = paddle.add(paddle.to_tensor(a), paddle.to_tensor(b))
+    np.testing.assert_allclose(out.numpy(), a + b, rtol=1e-6)
+    out = paddle.maximum(paddle.to_tensor(a), paddle.to_tensor(b))
+    np.testing.assert_allclose(out.numpy(), np.maximum(a, b))
+
+
+def test_reductions():
+    a = np.random.rand(2, 3, 4).astype("float32")
+    t = paddle.to_tensor(a)
+    np.testing.assert_allclose(t.sum().numpy(), a.sum(), rtol=1e-5)
+    np.testing.assert_allclose(paddle.sum(t, axis=1).numpy(), a.sum(1), rtol=1e-5)
+    np.testing.assert_allclose(paddle.mean(t, axis=[0, 2]).numpy(),
+                               a.mean((0, 2)), rtol=1e-5)
+    np.testing.assert_allclose(paddle.max(t, axis=1, keepdim=True).numpy(),
+                               a.max(1, keepdims=True))
+    np.testing.assert_allclose(paddle.var(t).numpy(), a.var(ddof=1), rtol=1e-4)
+    np.testing.assert_allclose(paddle.std(t, unbiased=False).numpy(),
+                               a.std(), rtol=1e-4)
+    np.testing.assert_allclose(paddle.logsumexp(t, axis=-1).numpy(),
+                               np.log(np.exp(a).sum(-1)), rtol=1e-4)
+    np.testing.assert_allclose(paddle.cumsum(t, axis=1).numpy(),
+                               a.cumsum(1), rtol=1e-5)
+
+
+def test_manipulation():
+    a = np.arange(24, dtype="float32").reshape(2, 3, 4)
+    t = paddle.to_tensor(a)
+    assert paddle.reshape(t, [6, 4]).shape == [6, 4]
+    assert paddle.flatten(t).shape == [24]
+    assert paddle.flatten(t, 1, 2).shape == [2, 12]
+    assert paddle.transpose(t, [2, 0, 1]).shape == [4, 2, 3]
+    assert paddle.squeeze(paddle.ones([1, 3, 1])).shape == [3]
+    assert paddle.unsqueeze(t, [0, 4]).shape == [1, 2, 3, 4, 1]
+    np.testing.assert_allclose(paddle.flip(t, [0]).numpy(), a[::-1])
+    np.testing.assert_allclose(paddle.roll(t, 1, 0).numpy(), np.roll(a, 1, 0))
+    assert paddle.tile(t, [2, 1, 1]).shape == [4, 3, 4]
+    assert paddle.expand(paddle.ones([1, 3]), [5, 3]).shape == [5, 3]
+    np.testing.assert_allclose(paddle.concat([t, t], axis=1).numpy(),
+                               np.concatenate([a, a], 1))
+    np.testing.assert_allclose(paddle.stack([t, t]).numpy(), np.stack([a, a]))
+    parts = paddle.split(t, [1, 2], axis=1)
+    assert parts[0].shape == [2, 1, 4] and parts[1].shape == [2, 2, 4]
+    np.testing.assert_allclose(parts[1].numpy(), a[:, 1:, :])
+    pieces = paddle.unstack(t, axis=0)
+    assert len(pieces) == 2 and pieces[0].shape == [3, 4]
+
+
+def test_pad():
+    a = np.ones((1, 2, 3, 3), "float32")
+    out = paddle.ops.manipulation.pad(paddle.to_tensor(a), [1, 1, 2, 2])
+    assert out.shape == [1, 2, 7, 5]  # H += 4 (top/bottom), W += 2 (l/r)
+    out2 = paddle.ops.manipulation.pad(paddle.to_tensor(a), [0, 0, 0, 0, 1, 1, 1, 1])
+    assert out2.shape == [1, 2, 5, 5]
+
+
+def test_gather_scatter():
+    a = np.arange(12, dtype="float32").reshape(4, 3)
+    t = paddle.to_tensor(a)
+    idx = paddle.to_tensor([0, 2])
+    np.testing.assert_allclose(paddle.gather(t, idx).numpy(), a[[0, 2]])
+    np.testing.assert_allclose(
+        paddle.index_select(t, idx, axis=1).numpy(), a[:, [0, 2]])
+    upd = paddle.to_tensor(np.ones((2, 3), "float32"))
+    out = paddle.scatter(t, idx, upd)
+    np.testing.assert_allclose(out.numpy()[0], [1, 1, 1])
+    nd_idx = paddle.to_tensor(np.array([[0, 0], [2, 1]]))
+    np.testing.assert_allclose(paddle.gather_nd(t, nd_idx).numpy(), [0.0, 7.0])
+    out = paddle.scatter_nd_add(t, nd_idx, paddle.to_tensor([10.0, 10.0]))
+    assert out.numpy()[0, 0] == 10 and out.numpy()[2, 1] == 17
+
+
+def test_where_masked():
+    a = np.array([[1.0, -2.0], [-3.0, 4.0]], dtype="float32")
+    t = paddle.to_tensor(a)
+    out = paddle.where(t > 0, t, paddle.zeros_like(t))
+    np.testing.assert_allclose(out.numpy(), np.where(a > 0, a, 0))
+    np.testing.assert_allclose(
+        paddle.masked_fill(t, t < 0, 9.0).numpy(), np.where(a < 0, 9, a))
+    sel = paddle.masked_select(t, t > 0)
+    np.testing.assert_allclose(np.sort(sel.numpy()), [1, 4])
+    nz = paddle.nonzero(t > 0)
+    assert nz.shape == [2, 2]
+
+
+def test_linalg():
+    a = np.random.rand(4, 4).astype("float32")
+    spd = a @ a.T + 4 * np.eye(4, dtype="float32")
+    t = paddle.to_tensor(spd)
+    np.testing.assert_allclose(
+        paddle.matmul(t, t).numpy(), spd @ spd, rtol=1e-4)
+    np.testing.assert_allclose(
+        paddle.matmul(t, t, transpose_y=True).numpy(), spd @ spd.T, rtol=1e-4)
+    inv = paddle.inverse(t).numpy()
+    np.testing.assert_allclose(inv @ spd, np.eye(4), atol=1e-4)
+    L = paddle.cholesky(t).numpy()
+    np.testing.assert_allclose(L @ L.T, spd, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(paddle.norm(t).numpy(),
+                               np.linalg.norm(spd), rtol=1e-5)
+    s = paddle.svd(t)[1]
+    np.testing.assert_allclose(np.sort(s.numpy()),
+                               np.sort(np.linalg.svd(spd)[1]), rtol=1e-4)
+    e = paddle.einsum("ij,jk->ik", t, t)
+    np.testing.assert_allclose(e.numpy(), spd @ spd, rtol=1e-4)
+    b = paddle.to_tensor(np.random.rand(4, 2).astype("float32"))
+    x = paddle.solve(t, b)
+    np.testing.assert_allclose(spd @ x.numpy(), b.numpy(), atol=1e-4)
+
+
+def test_search_sort():
+    a = np.array([[3.0, 1.0, 2.0], [9.0, 7.0, 8.0]], dtype="float32")
+    t = paddle.to_tensor(a)
+    np.testing.assert_allclose(paddle.sort(t, axis=1).numpy(), np.sort(a, 1))
+    np.testing.assert_allclose(paddle.argsort(t, axis=1).numpy(),
+                               np.argsort(a, 1))
+    np.testing.assert_allclose(paddle.argmax(t, axis=1).numpy(), [0, 0])
+    v, i = paddle.topk(t, 2, axis=1)
+    np.testing.assert_allclose(v.numpy(), [[3, 2], [9, 8]])
+    v, i = paddle.kthvalue(t, 2, axis=1)
+    np.testing.assert_allclose(v.numpy(), [2, 8])
+    seq = paddle.to_tensor([1.0, 3.0, 5.0, 7.0])
+    np.testing.assert_allclose(
+        paddle.searchsorted(seq, paddle.to_tensor([2.0, 6.0])).numpy(), [1, 3])
+
+
+def test_random_ops():
+    paddle.seed(1)
+    u = paddle.uniform([1000], min=0, max=1)
+    assert 0 <= u.numpy().min() and u.numpy().max() <= 1
+    assert abs(u.numpy().mean() - 0.5) < 0.05
+    n = paddle.randn([1000])
+    assert abs(n.numpy().mean()) < 0.1
+    r = paddle.randint(0, 10, [100])
+    assert r.numpy().min() >= 0 and r.numpy().max() < 10
+    p = paddle.randperm(10)
+    assert sorted(p.numpy().tolist()) == list(range(10))
+    m = paddle.multinomial(paddle.to_tensor([0.0, 0.0, 1.0]), 1)
+    assert m.numpy().item() == 2
+
+
+def test_unique():
+    t = paddle.to_tensor([3, 1, 2, 1, 3])
+    u = paddle.unique(t)
+    np.testing.assert_allclose(u.numpy(), [1, 2, 3])
+    u, counts = paddle.unique(t, return_counts=True)
+    np.testing.assert_allclose(counts.numpy(), [2, 1, 2])
+
+
+def test_clip_scale():
+    t = paddle.to_tensor([-2.0, 0.5, 3.0])
+    np.testing.assert_allclose(paddle.clip(t, 0.0, 1.0).numpy(), [0, 0.5, 1])
+    np.testing.assert_allclose(paddle.scale(t, 2.0, 1.0).numpy(), [-3, 2, 7])
+
+
+def test_grad_through_ops():
+    """check_grad analog: finite differences on a composite op chain."""
+    a = np.random.rand(3, 3).astype("float32") + 0.1
+    x = paddle.to_tensor(a, stop_gradient=False)
+    y = paddle.sum(paddle.log(x) * paddle.sqrt(x))
+    y.backward()
+    eps = 1e-3
+    fd = np.zeros_like(a)
+    for i in range(3):
+        for j in range(3):
+            ap, am = a.copy(), a.copy()
+            ap[i, j] += eps
+            am[i, j] -= eps
+            fd[i, j] = ((np.log(ap) * np.sqrt(ap)).sum()
+                        - (np.log(am) * np.sqrt(am)).sum()) / (2 * eps)
+    np.testing.assert_allclose(x.grad.numpy(), fd, rtol=1e-2, atol=1e-3)
+
+
+def test_take_along_put_along():
+    a = np.array([[1.0, 2.0], [3.0, 4.0]], dtype="float32")
+    t = paddle.to_tensor(a)
+    idx = paddle.to_tensor(np.array([[0], [1]]))
+    np.testing.assert_allclose(
+        paddle.take_along_axis(t, idx, axis=1).numpy(), [[1], [4]])
+    out = paddle.put_along_axis(t, idx, 9.0, axis=1)
+    assert out.numpy()[0, 0] == 9 and out.numpy()[1, 1] == 9

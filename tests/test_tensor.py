@@ -1,0 +1,124 @@
+"""Tensor surface tests (ref ``test_var_base.py`` / ``test_math_op_patch.py``)."""
+
+import numpy as np
+import pytest
+
+import paddle_hackathon_tpu as paddle
+
+
+def test_to_tensor_basics():
+    t = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+    assert t.shape == [2, 2]
+    assert t.ndim == 2
+    assert t.size == 4
+    assert str(t.dtype) == "float32"
+    np.testing.assert_allclose(t.numpy(), [[1, 2], [3, 4]])
+
+
+def test_float64_numpy_downcast():
+    t = paddle.to_tensor(np.zeros((2,)))  # float64 numpy → float32 tensor
+    assert str(t.dtype) == "float32"
+
+
+def test_dtype_conversions():
+    t = paddle.to_tensor([1, 2, 3])
+    f = t.astype("float32")
+    assert str(f.dtype) == "float32"
+    assert str(t.astype(paddle.int32).dtype) == "int32"
+
+
+def test_operators():
+    a = paddle.to_tensor([4.0, 9.0])
+    b = paddle.to_tensor([2.0, 3.0])
+    np.testing.assert_allclose((a + b).numpy(), [6, 12])
+    np.testing.assert_allclose((a - b).numpy(), [2, 6])
+    np.testing.assert_allclose((a * b).numpy(), [8, 27])
+    np.testing.assert_allclose((a / b).numpy(), [2, 3])
+    np.testing.assert_allclose((a ** 0.5).numpy(), [2, 3], rtol=1e-5)
+    np.testing.assert_allclose((a @ b).numpy(), 35)
+    np.testing.assert_allclose((-a).numpy(), [-4, -9])
+    np.testing.assert_allclose((1 - b).numpy(), [-1, -2])
+    np.testing.assert_allclose((10 / b).numpy(), [5, 10 / 3], rtol=1e-6)
+    assert (a > b).numpy().all()
+    assert (a == a).numpy().all()
+
+
+def test_item_and_scalars():
+    t = paddle.to_tensor(3.5)
+    assert t.item() == pytest.approx(3.5)
+    assert float(t) == pytest.approx(3.5)
+    assert int(paddle.to_tensor(7)) == 7
+    assert bool(paddle.to_tensor(True))
+
+
+def test_getitem_setitem():
+    t = paddle.to_tensor(np.arange(12, dtype="float32").reshape(3, 4))
+    row = t[1]
+    np.testing.assert_allclose(row.numpy(), [4, 5, 6, 7])
+    np.testing.assert_allclose(t[0:2, 1].numpy(), [1, 5])
+    t[0] = 0.0
+    np.testing.assert_allclose(t[0].numpy(), [0, 0, 0, 0])
+    mask_idx = paddle.to_tensor([0, 2])
+    np.testing.assert_allclose(t[mask_idx].numpy()[1], [8, 9, 10, 11])
+
+
+def test_detach_clone():
+    t = paddle.to_tensor([1.0], stop_gradient=False)
+    d = t.detach()
+    assert d.stop_gradient
+    c = t.clone()
+    assert not c.stop_gradient
+    c.backward()
+    assert np.allclose(t.grad.numpy(), [1.0])
+
+
+def test_fill_zero_inplace():
+    t = paddle.to_tensor([1.0, 2.0])
+    t.fill_(7.0)
+    np.testing.assert_allclose(t.numpy(), [7, 7])
+    t.zero_()
+    np.testing.assert_allclose(t.numpy(), [0, 0])
+
+
+def test_set_value():
+    t = paddle.to_tensor([1.0, 2.0])
+    t.set_value(np.array([5.0, 6.0]))
+    np.testing.assert_allclose(t.numpy(), [5, 6])
+
+
+def test_tensor_method_patching():
+    t = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+    assert t.sum().item() == 10
+    assert t.mean().item() == 2.5
+    assert t.reshape([4]).shape == [4]
+    assert t.transpose([1, 0]).shape == [2, 2]
+    assert t.exp().shape == [2, 2]
+    assert t.max().item() == 4
+    assert t.argmax().item() == 3
+    np.testing.assert_allclose(t.t().numpy(), t.numpy().T)
+
+
+def test_len_iter_shape0():
+    t = paddle.to_tensor(np.zeros((5, 2), "float32"))
+    assert len(t) == 5
+    with pytest.raises(TypeError):
+        len(paddle.to_tensor(1.0))
+
+
+def test_repr_smoke():
+    assert "Tensor(" in repr(paddle.to_tensor([1.0]))
+
+
+def test_seed_reproducible():
+    paddle.seed(42)
+    a = paddle.randn([3])
+    paddle.seed(42)
+    b = paddle.randn([3])
+    np.testing.assert_allclose(a.numpy(), b.numpy())
+
+
+def test_device_api():
+    assert paddle.device_count("cpu") >= 1
+    p = paddle.set_device("cpu")
+    assert p.is_cpu_place()
+    assert paddle.get_device().startswith("cpu")
