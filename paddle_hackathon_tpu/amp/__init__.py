@@ -1,0 +1,206 @@
+"""Automatic mixed precision.
+
+Ref ``python/paddle/amp/`` — ``auto_cast`` white/black op lists
+(``fluid/dygraph/amp/auto_cast.py:91-107``) and ``GradScaler``
+(``amp/grad_scaler.py:26``) with dynamic loss scaling backed by the
+``update_loss_scaling`` / ``check_finite_and_unscale`` ops
+(``paddle/fluid/operators/amp/``).
+
+TPU-native choice: the low-precision dtype is **bfloat16** (MXU-native, same
+exponent range as f32), so loss scaling is unnecessary — construct
+``GradScaler(enable=False)`` for bf16 runs (pass-through semantics); the
+enabled scaler implements the reference's full dynamic scaling for float16.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+_tls = threading.local()
+
+# Ref auto_cast.py:91-107 — ops numerically safe in low precision...
+WHITE_LIST = {
+    "matmul", "linear", "conv1d", "conv2d", "conv3d", "conv1d_transpose",
+    "conv2d_transpose", "conv3d_transpose", "einsum", "bmm", "mm", "mv",
+    "scaled_dot_product_attention", "addmm", "flash_attention",
+}
+# ...and ops that must stay f32 (reductions / transcendentals).
+BLACK_LIST = {
+    "exp", "log", "log2", "log10", "log1p", "expm1", "pow", "square",
+    "softmax", "log_softmax", "cross_entropy", "nll_loss", "bce",
+    "bce_with_logits", "mse_loss", "l1_loss", "kl_div", "smooth_l1_loss",
+    "layer_norm", "batch_norm", "group_norm", "instance_norm", "rms_norm",
+    "p_norm", "norm", "cumsum", "logsumexp", "softmax_with_cross_entropy",
+    "mean", "sum", "erf", "erfinv", "ctc_loss",
+}
+
+
+def _amp_state():
+    return getattr(_tls, "state", None)
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
+              level="O1", dtype="bfloat16"):
+    """paddle.amp.auto_cast equivalent."""
+    if level not in ("O0", "O1", "O2"):
+        raise ValueError(f"level must be O0/O1/O2, got {level}")
+    prev = _amp_state()
+    if not enable or level == "O0":
+        _tls.state = None
+    else:
+        white = set(WHITE_LIST)
+        black = set(BLACK_LIST)
+        if custom_white_list:
+            white |= set(custom_white_list)
+            black -= set(custom_white_list)
+        if custom_black_list:
+            black |= set(custom_black_list)
+            white -= set(custom_black_list)
+        _tls.state = {
+            "dtype": jnp.bfloat16 if dtype == "bfloat16" else jnp.float16,
+            "white": white, "black": black, "level": level,
+        }
+    try:
+        yield
+    finally:
+        _tls.state = prev
+
+
+amp_guard = auto_cast
+
+
+def cast_inputs_for_op(op_name, jax_args):
+    """Called from the op dispatch path (core.autograd.apply_op) — the analog
+    of the generated AMP auto-cast preamble in every eager op
+    (``eager_gen.py:363`` AMP logic)."""
+    state = _amp_state()
+    if state is None:
+        return jax_args
+    low = state["dtype"]
+    if op_name in state["white"]:
+        return [a.astype(low)
+                if hasattr(a, "dtype") and a.dtype == jnp.float32 else a
+                for a in jax_args]
+    if op_name in state["black"]:
+        return [a.astype(jnp.float32)
+                if hasattr(a, "dtype") and a.dtype == low else a
+                for a in jax_args]
+    # O2: everything not blacklisted runs in low precision
+    if state["level"] == "O2":
+        return [a.astype(low)
+                if hasattr(a, "dtype") and a.dtype == jnp.float32 else a
+                for a in jax_args]
+    return jax_args
+
+
+def decorate(models, optimizers=None, level="O1", dtype="bfloat16",
+             master_weight=None, save_dtype=None):
+    """paddle.amp.decorate — O2 casts model params to the low dtype (master
+    weights stay f32 inside the optimizer accumulators, which are always f32
+    here)."""
+    single_model = not isinstance(models, (list, tuple))
+    model_list = [models] if single_model else list(models)
+    if level == "O2":
+        for m in model_list:
+            m.to(dtype=dtype)
+    if optimizers is None:
+        return models if single_model else model_list
+    return (models if single_model else model_list), optimizers
+
+
+class GradScaler:
+    """Dynamic loss scaling (ref ``amp/grad_scaler.py:26``).
+
+    With bf16 (TPU default) scaling is unnecessary — enable=False behaves as
+    pass-through with step/minimize still usable.
+    """
+
+    def __init__(self, enable=True, init_loss_scaling=2.0 ** 15,
+                 incr_ratio=2.0, decr_ratio=0.5, incr_every_n_steps=1000,
+                 decr_every_n_nan_or_inf=2, use_dynamic_loss_scaling=True):
+        self._enable = enable
+        self._scale = float(init_loss_scaling) if enable else 1.0
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every = incr_every_n_steps
+        self._decr_every = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._found_inf = False
+        self._already_unscaled = False
+
+    def scale(self, loss: Tensor) -> Tensor:
+        if not self._enable:
+            return loss
+        return loss * self._scale
+
+    def unscale_(self, optimizer):
+        """check_finite_and_unscale (ref grad_scaler.py:243). Guarded against
+        double unscaling within one step (unscale_ → clip → step pattern)."""
+        if not self._enable:
+            self._found_inf = False
+            return
+        if self._already_unscaled:
+            return
+        found = False
+        inv = 1.0 / self._scale
+        for p in optimizer._parameter_list:
+            if p._grad_value is None:
+                continue
+            g = p._grad_value * inv
+            if bool(jnp.any(~jnp.isfinite(g))):
+                found = True
+            p._grad_value = g
+        self._found_inf = found
+        self._already_unscaled = True
+
+    def step(self, optimizer):
+        self.unscale_(optimizer)
+        if not self._found_inf:
+            optimizer.step()
+        self.update()
+        self._already_unscaled = False
+
+    def minimize(self, optimizer, scaled_loss):
+        self.step(optimizer)
+
+    def update(self):
+        if not (self._enable and self._dynamic):
+            return
+        if self._found_inf:
+            self._bad_steps += 1
+            self._good_steps = 0
+            if self._bad_steps >= self._decr_every:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad_steps = 0
+        else:
+            self._good_steps += 1
+            self._bad_steps = 0
+            if self._good_steps >= self._incr_every:
+                self._scale *= self._incr_ratio
+                self._good_steps = 0
+
+    def is_enable(self):
+        return self._enable
+
+    def get_loss_scaling(self):
+        return self._scale
+
+    def set_init_loss_scaling(self, v):
+        self._scale = float(v)
+
+    def state_dict(self):
+        return {"scale": self._scale, "good_steps": self._good_steps,
+                "bad_steps": self._bad_steps, "enable": self._enable}
+
+    def load_state_dict(self, state):
+        self._scale = state["scale"]
+        self._good_steps = state["good_steps"]
+        self._bad_steps = state["bad_steps"]

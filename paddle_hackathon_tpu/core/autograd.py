@@ -32,6 +32,7 @@ _tls = threading.local()
 
 # Injected by tensor.py at import time to avoid a circular import.
 Tensor = None  # type: ignore
+_amp_mod = None  # lazily bound amp module (AMP cast hook)
 
 
 def _set_tensor_class(cls) -> None:
@@ -173,6 +174,12 @@ def run_backward(tensors: Sequence, grad_tensors: Sequence, retain_graph: bool =
             node._arrived = 0
             node._expected = expected[node]
         buf = node._buffer
+        # Cast cotangent to the producing op's output dtype — the AMP boundary
+        # transform (a blacklisted f32 op may feed back into a bf16 producer;
+        # ref fluid data_type_transform.cc on the grad path).
+        out_dtype = node.out_avals[out_idx][1]
+        if grad.dtype != out_dtype:
+            grad = grad.astype(out_dtype)
         buf[out_idx] = grad if buf[out_idx] is None else buf[out_idx] + grad
         node._arrived += 1
         if node._arrived == node._expected:
@@ -252,6 +259,17 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
     grad_outputs = [jnp.ones(o.shape, o.dtype) if g is None else g._value
                     for o, g in zip(outputs, grad_outputs)]
 
+    # Non-leaf (intermediate) inputs: capture their accumulated cotangent via
+    # a temporary node hook (the engine applies hooks when the producing node
+    # becomes ready) — mirrors GeneralGrad's input-node capture.
+    captures = {}
+    temp_hooks = []
+    for inp in inputs:
+        if inp._grad_node is not None:
+            def _capture(g, _key=id(inp)):
+                captures[_key] = g._value
+            temp_hooks.append(inp.register_hook(_capture))
+
     # Temporarily swap leaf accumulation: stash and restore .grad of leaves that
     # are not requested, capture grads of requested inputs.
     saved = [(t, t._grad_value) for t in _all_leaves(outputs)]
@@ -262,7 +280,10 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
                      retain_graph=bool(retain_graph))
         results = []
         for inp in inputs:
-            g = inp._grad_value
+            if inp._grad_node is not None:
+                g = captures.get(id(inp))
+            else:
+                g = inp._grad_value
             if g is None and not allow_unused:
                 raise ValueError(
                     "one of the input tensors receives no gradient; pass "
@@ -272,6 +293,8 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
     finally:
         for t, old in saved:
             t._grad_value = old
+        for h in temp_hooks:
+            h.remove()
 
 
 def _all_leaves(outputs):
@@ -328,6 +351,14 @@ def apply_op(name: str, fn: Callable, args: Sequence[Any], n_outputs: int = 1):
                 diff_positions.append(i)
         else:
             jax_args.append(a)
+
+    # AMP auto-cast preamble (ref eager_gen.py:363 generated AMP logic).
+    global _amp_mod
+    if _amp_mod is None:
+        from .. import amp as _amp_mod_  # late import: amp depends on tensor
+        _amp_mod = _amp_mod_
+    if _amp_mod._amp_state() is not None:
+        jax_args = _amp_mod.cast_inputs_for_op(name, jax_args)
 
     if not diff_positions:
         out = fn(*jax_args)

@@ -1,0 +1,16 @@
+"""Framework-level utilities: save/load, in_dynamic_mode, etc."""
+
+from .io import load, save  # noqa: F401
+
+
+def in_dynamic_mode() -> bool:
+    """True when executing eagerly (not inside a to_static trace)."""
+    try:
+        from ..jit import _trace_state
+        return not _trace_state.tracing
+    except ImportError:
+        return True
+
+
+def in_dygraph_mode() -> bool:
+    return in_dynamic_mode()

@@ -1,0 +1,86 @@
+"""paddle.save / paddle.load.
+
+Ref ``python/paddle/framework/io.py:574,791`` — the reference pickles a nested
+state_dict of numpy-ified tensors. Same wire idea here, but arrays are stored
+in an npz member next to a pickled skeleton so loads are zero-copy into numpy
+(and the pickle never contains executable array payloads).
+"""
+
+from __future__ import annotations
+
+import io as _io
+import os
+import pickle
+import zipfile
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..nn.parameter import Parameter
+
+_MAGIC = "paddle_hackathon_tpu.save.v1"
+
+
+def _disassemble(obj, arrays, path=""):
+    if isinstance(obj, Tensor):
+        key = f"t{len(arrays)}"
+        arrays[key] = np.asarray(obj._value)
+        return {"__tensor__": key,
+                "__param__": isinstance(obj, Parameter),
+                "name": obj.name,
+                "stop_gradient": obj.stop_gradient}
+    if isinstance(obj, dict):
+        return {k: _disassemble(v, arrays, f"{path}.{k}") for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        out = [_disassemble(v, arrays, f"{path}[{i}]") for i, v in enumerate(obj)]
+        return {"__seq__": type(obj).__name__, "items": out}
+    return obj
+
+
+def _reassemble(obj, arrays):
+    if isinstance(obj, dict):
+        if "__tensor__" in obj:
+            arr = arrays[obj["__tensor__"]]
+            if obj.get("__param__"):
+                t = Parameter(arr, name=obj.get("name"))
+                t.stop_gradient = obj.get("stop_gradient", False)
+                return t
+            t = Tensor(arr, stop_gradient=obj.get("stop_gradient", True))
+            t.name = obj.get("name")
+            return t
+        if "__seq__" in obj:
+            seq = [_reassemble(v, arrays) for v in obj["items"]]
+            return tuple(seq) if obj["__seq__"] == "tuple" else seq
+        return {k: _reassemble(v, arrays) for k, v in obj.items()}
+    return obj
+
+
+def save(obj, path, protocol=4, **configs):
+    """paddle.save equivalent — state_dicts, nested dicts/lists of Tensors,
+    and plain picklable python objects."""
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    arrays = {}
+    skeleton = _disassemble(obj, arrays)
+    with zipfile.ZipFile(path, "w", zipfile.ZIP_STORED) as zf:
+        zf.writestr("MAGIC", _MAGIC)
+        zf.writestr("skeleton.pkl", pickle.dumps(skeleton, protocol=protocol))
+        buf = _io.BytesIO()
+        np.savez(buf, **arrays)
+        zf.writestr("arrays.npz", buf.getvalue())
+
+
+def load(path, **configs):
+    """paddle.load equivalent."""
+    if not os.path.exists(path):
+        raise FileNotFoundError(path)
+    with zipfile.ZipFile(path, "r") as zf:
+        magic = zf.read("MAGIC").decode()
+        if magic != _MAGIC:
+            raise ValueError(f"not a paddle_hackathon_tpu checkpoint: {path}")
+        skeleton = pickle.loads(zf.read("skeleton.pkl"))
+        with zf.open("arrays.npz") as f:
+            npz = np.load(_io.BytesIO(f.read()))
+            arrays = {k: npz[k] for k in npz.files}
+    return _reassemble(skeleton, arrays)

@@ -1,0 +1,17 @@
+"""paddle.io equivalent — Dataset / Sampler / DataLoader.
+
+Ref ``python/paddle/io/`` + ``fluid/reader.py:275`` (DataLoader),
+``fluid/dataloader/dataloader_iter.py:148,342``. The reference feeds GPUs with
+worker *processes* + shared-memory tensports; on TPU the input path is
+host-side numpy → a background-thread prefetch pipeline that overlaps batch
+assembly with device compute, then one device_put per batch (PJRT pins and
+DMAs). A native C++ ring buffer backs the prefetcher when built (see
+``native/``).
+"""
+
+from .dataset import (ChainDataset, ComposeDataset, ConcatDataset, Dataset,  # noqa: F401
+                      IterableDataset, Subset, TensorDataset, random_split)
+from .sampler import (BatchSampler, DistributedBatchSampler, RandomSampler,  # noqa: F401
+                      Sampler, SequenceSampler, SubsetRandomSampler,
+                      WeightedRandomSampler)
+from .dataloader import DataLoader, default_collate_fn, get_worker_info  # noqa: F401

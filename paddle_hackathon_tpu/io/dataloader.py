@@ -1,0 +1,182 @@
+"""DataLoader (ref ``fluid/reader.py:275`` DataLoader;
+``fluid/dataloader/dataloader_iter.py`` single/multi-process iterators).
+
+TPU-native design: batches are assembled on the host by a pool of worker
+threads feeding a bounded prefetch queue (the reference uses worker processes +
+shared-memory because CUDA pins per-process memory; PJRT transfers are
+zero-copy from numpy so threads suffice — numpy/image decode releases the
+GIL). ``prefetch_factor`` batches are kept in flight, overlapping input
+assembly with device compute like the reference's ``buffered_reader.cc``.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, Optional
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from .dataset import IterableDataset
+from .sampler import BatchSampler
+
+_worker_info = threading.local()
+
+
+def get_worker_info():
+    return getattr(_worker_info, "info", None)
+
+
+class WorkerInfo:
+    def __init__(self, wid, num_workers, dataset):
+        self.id = wid
+        self.num_workers = num_workers
+        self.dataset = dataset
+
+
+def default_collate_fn(batch):
+    """Stack samples into batched Tensors (ref
+    ``fluid/dataloader/collate.py`` default_collate_fn)."""
+    sample = batch[0]
+    if isinstance(sample, Tensor):
+        import jax.numpy as jnp
+        return Tensor(jnp.stack([s._value for s in batch]))
+    if isinstance(sample, np.ndarray):
+        return Tensor(np.stack(batch))
+    if isinstance(sample, (int, float, np.integer, np.floating)):
+        return Tensor(np.asarray(batch))
+    if isinstance(sample, (list, tuple)):
+        return tuple(default_collate_fn([s[i] for s in batch])
+                     for i in range(len(sample)))
+    if isinstance(sample, dict):
+        return {k: default_collate_fn([s[k] for s in batch]) for k in sample}
+    raise TypeError(f"cannot collate type {type(sample)}")
+
+
+class DataLoader:
+    def __init__(self, dataset, feed_list=None, places=None,
+                 return_list=True, batch_sampler=None, batch_size=1,
+                 shuffle=False, drop_last=False, collate_fn=None,
+                 num_workers=0, use_buffer_reader=True, prefetch_factor=2,
+                 use_shared_memory=True, timeout=0, worker_init_fn=None,
+                 persistent_workers=False):
+        self.dataset = dataset
+        self.collate_fn = collate_fn or default_collate_fn
+        self.num_workers = num_workers
+        self.prefetch_factor = max(prefetch_factor, 1)
+        self.timeout = timeout
+        self.worker_init_fn = worker_init_fn
+        self._iterable_mode = isinstance(dataset, IterableDataset)
+        if self._iterable_mode:
+            self.batch_sampler = None
+            self.batch_size = batch_size
+            self.drop_last = drop_last
+        elif batch_sampler is not None:
+            self.batch_sampler = batch_sampler
+        else:
+            self.batch_sampler = BatchSampler(
+                dataset, shuffle=shuffle,
+                batch_size=batch_size if batch_size is not None else 1,
+                drop_last=drop_last)
+        self._no_batch = batch_size is None
+
+    def __len__(self):
+        if self._iterable_mode:
+            raise TypeError("length of IterableDataset DataLoader is unknown")
+        return len(self.batch_sampler)
+
+    def __call__(self):
+        return self.__iter__()
+
+    def __iter__(self):
+        if self._iterable_mode:
+            return self._iter_iterable()
+        if self.num_workers == 0:
+            return self._iter_single()
+        return iter(_PrefetchIter(self))
+
+    def _iter_single(self):
+        for batch_idx in self.batch_sampler:
+            samples = [self.dataset[i] for i in batch_idx]
+            if self._no_batch:
+                yield samples[0]
+            else:
+                yield self.collate_fn(samples)
+
+    def _iter_iterable(self):
+        batch = []
+        for sample in self.dataset:
+            batch.append(sample)
+            if len(batch) == (self.batch_size or 1):
+                yield self.collate_fn(batch)
+                batch = []
+        if batch and not getattr(self, "drop_last", False):
+            yield self.collate_fn(batch)
+
+
+class _PrefetchIter:
+    """Thread-pool prefetching iterator (ref
+    ``_DataLoaderIterMultiProcess`` ``dataloader_iter.py:342``: outstanding
+    batch queue + in-order reordering)."""
+
+    _SENTINEL = object()
+
+    def __init__(self, loader: DataLoader):
+        self.loader = loader
+        self.batches = list(loader.batch_sampler)
+        self.max_outstanding = loader.num_workers * loader.prefetch_factor
+        self.task_q: "queue.Queue" = queue.Queue()
+        self.results = {}
+        self.next_emit = 0
+        self.lock = threading.Lock()
+        self.cv = threading.Condition(self.lock)
+        self.error = None
+        for i, b in enumerate(self.batches):
+            self.task_q.put((i, b))
+        self.n_tasks = len(self.batches)
+        self.workers = []
+        for wid in range(loader.num_workers):
+            t = threading.Thread(target=self._worker, args=(wid,), daemon=True)
+            t.start()
+            self.workers.append(t)
+
+    def _worker(self, wid):
+        _worker_info.info = WorkerInfo(wid, self.loader.num_workers,
+                                       self.loader.dataset)
+        if self.loader.worker_init_fn is not None:
+            self.loader.worker_init_fn(wid)
+        while True:
+            try:
+                i, idxs = self.task_q.get_nowait()
+            except queue.Empty:
+                return
+            try:
+                samples = [self.loader.dataset[j] for j in idxs]
+                batch = self.loader.collate_fn(samples)
+            except Exception as e:  # propagate to consumer
+                with self.cv:
+                    self.error = e
+                    self.cv.notify_all()
+                return
+            with self.cv:
+                while i > self.next_emit + self.max_outstanding and self.error is None:
+                    self.cv.wait(timeout=1.0)
+                self.results[i] = batch
+                self.cv.notify_all()
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self.next_emit >= self.n_tasks:
+            raise StopIteration
+        with self.cv:
+            while self.next_emit not in self.results and self.error is None:
+                self.cv.wait(timeout=1.0)
+            if self.error is not None:
+                raise self.error
+            batch = self.results.pop(self.next_emit)
+            self.next_emit += 1
+            self.cv.notify_all()
+        return batch

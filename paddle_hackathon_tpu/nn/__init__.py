@@ -1,0 +1,12 @@
+"""paddle.nn equivalent — Layers, containers, functional, initializers.
+
+Ref ``python/paddle/nn/__init__.py``; built on the TPU-native core
+(SURVEY.md §7 phase 3).
+"""
+
+from . import functional  # noqa: F401
+from . import initializer  # noqa: F401
+from .container import Identity, LayerDict, LayerList, ParameterList, Sequential  # noqa: F401
+from .layer import Layer, functional_call  # noqa: F401
+from .layers import *  # noqa: F401,F403
+from .parameter import ParamAttr, Parameter, create_parameter  # noqa: F401

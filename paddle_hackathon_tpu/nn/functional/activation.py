@@ -1,0 +1,201 @@
+"""Activation functionals (ref ``python/paddle/nn/functional/activation.py``).
+
+Pure elementwise jax.nn compositions; XLA fuses them into adjacent matmuls —
+the hand-written fused epilogues of the reference
+(``operators/fused/fused_gemm_epilogue_op.cu``) come for free.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.autograd import apply_op
+from ...core.tensor import Tensor
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+
+
+def relu(x, name=None):
+    return apply_op("relu", jax.nn.relu, [_t(x)])
+
+
+def relu6(x, name=None):
+    return apply_op("relu6", jax.nn.relu6, [_t(x)])
+
+
+def relu_(x):
+    out = relu(x)
+    # in-place rebind keeps the tape consistent (same as Tensor.__setitem__)
+    x._value = out._value
+    x._grad_node = out._grad_node
+    x._out_idx = out._out_idx
+    x.stop_gradient = out.stop_gradient
+    return x
+
+
+def sigmoid(x, name=None):
+    return apply_op("sigmoid", jax.nn.sigmoid, [_t(x)])
+
+
+def tanh(x, name=None):
+    return apply_op("tanh", jnp.tanh, [_t(x)])
+
+
+def gelu(x, approximate=False, name=None):
+    return apply_op("gelu",
+                    lambda v: jax.nn.gelu(v, approximate=approximate), [_t(x)])
+
+
+def silu(x, name=None):
+    return apply_op("silu", jax.nn.silu, [_t(x)])
+
+
+def swish(x, name=None):
+    return silu(x)
+
+
+def mish(x, name=None):
+    return apply_op("mish", lambda v: v * jnp.tanh(jax.nn.softplus(v)), [_t(x)])
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return apply_op("leaky_relu",
+                    lambda v: jax.nn.leaky_relu(v, negative_slope), [_t(x)])
+
+
+def elu(x, alpha=1.0, name=None):
+    return apply_op("elu", lambda v: jax.nn.elu(v, alpha), [_t(x)])
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return apply_op(
+        "selu", lambda v: scale * jnp.where(v > 0, v, alpha * jnp.expm1(v)),
+        [_t(x)])
+
+
+def celu(x, alpha=1.0, name=None):
+    return apply_op("celu", lambda v: jax.nn.celu(v, alpha), [_t(x)])
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    def fn(v, w):
+        if w.size > 1:
+            shape = [1] * v.ndim
+            ch_axis = 1 if data_format[1] == "C" else v.ndim - 1
+            shape[ch_axis] = w.size
+            w = w.reshape(shape)
+        return jnp.where(v > 0, v, w * v)
+    return apply_op("prelu", fn, [_t(x), _t(weight)])
+
+
+def rrelu(x, lower=1.0 / 8.0, upper=1.0 / 3.0, training=True, name=None):
+    from ...core import random as core_random
+    if training:
+        key = core_random.split_key()
+
+        def fn(v):
+            r = jax.random.uniform(key, v.shape, v.dtype, lower, upper)
+            return jnp.where(v >= 0, v, r * v)
+        return apply_op("rrelu", fn, [_t(x)])
+    mid = (lower + upper) / 2.0
+    return leaky_relu(x, mid)
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):  # noqa: A002
+    return apply_op("hardtanh", lambda v: jnp.clip(v, min, max), [_t(x)])
+
+
+def hardsigmoid(x, slope=1.0 / 6.0, offset=0.5, name=None):
+    return apply_op("hardsigmoid",
+                    lambda v: jnp.clip(v * slope + offset, 0.0, 1.0), [_t(x)])
+
+
+def hardswish(x, name=None):
+    return apply_op("hardswish",
+                    lambda v: v * jnp.clip(v / 6.0 + 0.5, 0.0, 1.0), [_t(x)])
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return apply_op("hardshrink",
+                    lambda v: jnp.where(jnp.abs(v) > threshold, v, 0.0), [_t(x)])
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return apply_op(
+        "softshrink",
+        lambda v: jnp.sign(v) * jnp.maximum(jnp.abs(v) - threshold, 0.0), [_t(x)])
+
+
+def tanhshrink(x, name=None):
+    return apply_op("tanhshrink", lambda v: v - jnp.tanh(v), [_t(x)])
+
+
+def thresholded_relu(x, threshold=1.0, name=None):
+    return apply_op("thresholded_relu",
+                    lambda v: jnp.where(v > threshold, v, 0.0), [_t(x)])
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    return apply_op(
+        "softplus",
+        lambda v: jnp.where(v * beta > threshold, v,
+                            jax.nn.softplus(v * beta) / beta), [_t(x)])
+
+
+def softsign(x, name=None):
+    return apply_op("softsign", jax.nn.soft_sign, [_t(x)])
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    return apply_op("softmax", lambda v: jax.nn.softmax(v, axis=axis), [_t(x)])
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    return apply_op("log_softmax",
+                    lambda v: jax.nn.log_softmax(v, axis=axis), [_t(x)])
+
+
+def softmax_(x, axis=-1):
+    out = softmax(x, axis)
+    x._value = out._value
+    x._grad_node = out._grad_node
+    x._out_idx = out._out_idx
+    x.stop_gradient = out.stop_gradient
+    return x
+
+
+def log_sigmoid(x, name=None):
+    return apply_op("log_sigmoid", jax.nn.log_sigmoid, [_t(x)])
+
+
+def maxout(x, groups, axis=1, name=None):
+    def fn(v):
+        ax = axis % v.ndim
+        c = v.shape[ax]
+        new_shape = v.shape[:ax] + (c // groups, groups) + v.shape[ax + 1:]
+        return jnp.max(v.reshape(new_shape), axis=ax + 1)
+    return apply_op("maxout", fn, [_t(x)])
+
+
+def glu(x, axis=-1, name=None):
+    return apply_op("glu", lambda v: jax.nn.glu(v, axis=axis), [_t(x)])
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    from ...core import random as core_random
+    key = core_random.split_key()
+
+    def fn(v):
+        g = jax.random.gumbel(key, v.shape, v.dtype)
+        y = jax.nn.softmax((v + g) / temperature, axis=axis)
+        if hard:
+            idx = jnp.argmax(y, axis=axis, keepdims=True)
+            onehot = jnp.zeros_like(y)
+            onehot = jnp.put_along_axis(onehot, idx, 1.0, axis=axis,
+                                        inplace=False)
+            y = onehot + y - jax.lax.stop_gradient(y)
+        return y
+    return apply_op("gumbel_softmax", fn, [_t(x)])

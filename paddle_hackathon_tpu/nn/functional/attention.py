@@ -1,0 +1,85 @@
+"""Attention functionals.
+
+Equivalent of the reference's fused attention CUDA ops
+(``paddle/fluid/operators/fused/fused_attention_op.cu``, ``fmha_ref.h``) —
+but as a flash-style computation: when the Pallas kernel is available
+(``incubate.flash_attention``) it is used; otherwise a pure-XLA softmax(QK)V
+composition runs (still fused reasonably by XLA).
+
+The reference has no flash attention (SURVEY §5.7) — this is a
+capability-parity-plus feature required for long-context work.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ...core import flags
+from ...core.autograd import apply_op
+from ...core.tensor import Tensor
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False,
+                                 training=True, name=None):
+    """SDPA over (batch, seq, heads, head_dim) tensors (paddle layout).
+
+    Uses the Pallas flash kernel on TPU when FLAGS_use_fused_kernels is on and
+    shapes qualify; falls back to the reference composition otherwise.
+    """
+    if flags.flag("use_fused_kernels") and attn_mask is None and dropout_p == 0.0:
+        try:
+            from ...incubate.nn.functional import flash_attention_bshd
+            return flash_attention_bshd(_t(query), _t(key), _t(value),
+                                        causal=is_causal)
+        except Exception:
+            pass  # fall back to the XLA composition
+
+    scale = 1.0 / math.sqrt(query.shape[-1])
+    drop_key = None
+    if dropout_p > 0.0 and training:
+        from ...core import random as core_random
+        drop_key = core_random.split_key()
+
+    def fn(q, k, v, *rest):
+        # bshd -> bhsd
+        qh = jnp.swapaxes(q, 1, 2)
+        kh = jnp.swapaxes(k, 1, 2)
+        vh = jnp.swapaxes(v, 1, 2)
+        logits = jnp.einsum("bhsd,bhtd->bhst", qh, kh) * scale
+        if is_causal:
+            s, t = logits.shape[-2], logits.shape[-1]
+            mask = jnp.tril(jnp.ones((s, t), bool))
+            logits = jnp.where(mask, logits, -1e30)
+        if rest:
+            logits = logits + rest[0]
+        probs = jax.nn.softmax(logits, axis=-1)
+        if drop_key is not None:
+            keep = jax.random.bernoulli(drop_key, 1.0 - dropout_p, probs.shape)
+            probs = jnp.where(keep, probs / (1.0 - dropout_p), 0.0
+                              ).astype(probs.dtype)
+        out = jnp.einsum("bhst,bhtd->bhsd", probs, vh)
+        return jnp.swapaxes(out, 1, 2)
+
+    args = [_t(query), _t(key), _t(value)]
+    if attn_mask is not None:
+        args.append(_t(attn_mask))
+    return apply_op("scaled_dot_product_attention", fn, args)
+
+
+def sequence_mask(lengths, maxlen=None, dtype="int64"):
+    from ...core.dtype import convert_dtype
+    lengths = _t(lengths)
+    n = maxlen or int(jnp.max(lengths._value))
+    d = convert_dtype(dtype)
+
+    def fn(l):
+        return (jnp.arange(n)[None, :] < l[:, None]).astype(d)
+    return apply_op("sequence_mask", fn, [lengths])

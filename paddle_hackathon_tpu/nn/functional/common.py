@@ -1,0 +1,328 @@
+"""Common functionals: linear, dropout, embedding, one_hot, normalize,
+interpolate, pixel_shuffle, unfold (ref ``python/paddle/nn/functional/common.py``,
+``input.py``, ``vision.py``).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core import random as core_random
+from ...core.autograd import apply_op
+from ...core.dtype import convert_dtype
+from ...core.tensor import Tensor
+from ...ops.manipulation import pad as _pad_op
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+
+
+def linear(x, weight, bias=None, name=None):
+    """y = x @ W + b (ref ``F.linear`` ``nn/functional/common.py:1637``;
+    the reference composes matmul+add in ``eager_final_state_custom_python_api.h:32-44``
+    — here XLA fuses the bias add into the MXU matmul epilogue)."""
+    if bias is None:
+        return apply_op("linear", lambda v, w: v @ w, [_t(x), _t(weight)])
+    return apply_op("linear", lambda v, w, b: v @ w + b,
+                    [_t(x), _t(weight), _t(bias)])
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
+            name=None):
+    """Dropout (ref phi DropoutKernel). Draws its mask key from the active
+    rng scope so jitted programs stay replayable."""
+    if not training or p == 0.0:
+        return _t(x)
+    if p == 1.0:
+        return apply_op("dropout", lambda v: jnp.zeros_like(v), [_t(x)])
+    key = core_random.split_key()
+
+    def fn(v):
+        shape = list(v.shape)
+        if axis is not None:
+            axes = [axis] if isinstance(axis, int) else list(axis)
+            shape = [s if i in axes else 1 for i, s in enumerate(shape)]
+        keep = jax.random.bernoulli(key, 1.0 - p, tuple(shape))
+        if mode == "upscale_in_train":
+            return jnp.where(keep, v / (1.0 - p), 0.0).astype(v.dtype)
+        return jnp.where(keep, v, 0.0).astype(v.dtype)
+    return apply_op("dropout", fn, [_t(x)])
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    axis = [0, 1] if data_format == "NCHW" else [0, 3]
+    return dropout(x, p=p, axis=axis, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    axis = [0, 1] if data_format == "NCDHW" else [0, 4]
+    return dropout(x, p=p, axis=axis, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    if not training or p == 0.0:
+        return _t(x)
+    key = core_random.split_key()
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+
+    def fn(v):
+        keep = jax.random.bernoulli(key, 1.0 - p, v.shape)
+        a = (1.0 / ((1 - p) * (1 + p * alpha_p ** 2)) ** 0.5)
+        b = -a * alpha_p * p
+        return (a * jnp.where(keep, v, alpha_p) + b).astype(v.dtype)
+    return apply_op("alpha_dropout", fn, [_t(x)])
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    """Embedding lookup (ref phi EmbeddingKernel) — a gather on the MXU-free
+    path; the TP variant lives in parallel/mp_layers."""
+    def fn(i, w):
+        out = jnp.take(w, i, axis=0)
+        if padding_idx is not None:
+            mask = (i == padding_idx)[..., None]
+            out = jnp.where(mask, 0.0, out)
+        return out
+    return apply_op("embedding", fn, [_t(x), _t(weight)])
+
+
+def one_hot(x, num_classes, name=None):
+    return apply_op("one_hot",
+                    lambda i: jax.nn.one_hot(i, num_classes), [_t(x)])
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    if prior_dist is not None:
+        return apply_op("label_smooth",
+                        lambda l, p: (1 - epsilon) * l + epsilon * p,
+                        [_t(label), _t(prior_dist)])
+    return apply_op("label_smooth",
+                    lambda l: (1 - epsilon) * l + epsilon / l.shape[-1],
+                    [_t(label)])
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    def fn(v):
+        if p == 2:
+            n = jnp.sqrt(jnp.sum(jnp.square(v), axis=axis, keepdims=True))
+        else:
+            n = jnp.sum(jnp.abs(v) ** p, axis=axis, keepdims=True) ** (1.0 / p)
+        return v / jnp.maximum(n, epsilon)
+    return apply_op("normalize", fn, [_t(x)])
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8):
+    def fn(a, b):
+        dot = jnp.sum(a * b, axis=axis)
+        na = jnp.sqrt(jnp.sum(a * a, axis=axis))
+        nb = jnp.sqrt(jnp.sum(b * b, axis=axis))
+        return dot / jnp.maximum(na * nb, eps)
+    return apply_op("cosine_similarity", fn, [_t(x1), _t(x2)])
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):  # noqa: A002
+    return _pad_op(x, pad, mode=mode, value=value, data_format=data_format)
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, align_mode=0, data_format="NCHW",
+                name=None):
+    """Resize (ref phi InterpolateKernel) via jax.image.resize."""
+    x = _t(x)
+    nd = x.ndim
+    channel_last = data_format[-1] == "C"
+    spatial = list(range(1, nd - 1)) if channel_last else list(range(2, nd))
+    in_sizes = [x.shape[i] for i in spatial]
+    if size is not None:
+        if isinstance(size, Tensor):
+            size = size.tolist()
+        out_sizes = [int(s) for s in size]
+    else:
+        if isinstance(scale_factor, (int, float)):
+            scale_factor = [scale_factor] * len(spatial)
+        out_sizes = [int(s * f) for s, f in zip(in_sizes, scale_factor)]
+    method = {"nearest": "nearest", "bilinear": "linear", "linear": "linear",
+              "bicubic": "cubic", "trilinear": "linear", "area": "linear"}[mode]
+
+    def fn(v):
+        full = list(v.shape)
+        for dim, s in zip(spatial, out_sizes):
+            full[dim] = s
+        return jax.image.resize(v, tuple(full), method=method)
+    return apply_op("interpolate", fn, [x])
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest",
+             align_corners=False, align_mode=0, data_format="NCHW", name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners, align_mode,
+                       data_format)
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    r = upscale_factor
+
+    def fn(v):
+        if data_format == "NCHW":
+            n, c, h, w = v.shape
+            v = v.reshape(n, c // (r * r), r, r, h, w)
+            v = v.transpose(0, 1, 4, 2, 5, 3)
+            return v.reshape(n, c // (r * r), h * r, w * r)
+        n, h, w, c = v.shape
+        v = v.reshape(n, h, w, r, r, c // (r * r))
+        v = v.transpose(0, 1, 3, 2, 4, 5)
+        return v.reshape(n, h * r, w * r, c // (r * r))
+    return apply_op("pixel_shuffle", fn, [_t(x)])
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    r = downscale_factor
+
+    def fn(v):
+        if data_format == "NCHW":
+            n, c, h, w = v.shape
+            v = v.reshape(n, c, h // r, r, w // r, r)
+            v = v.transpose(0, 1, 3, 5, 2, 4)
+            return v.reshape(n, c * r * r, h // r, w // r)
+        n, h, w, c = v.shape
+        v = v.reshape(n, h // r, r, w // r, r, c)
+        v = v.transpose(0, 1, 3, 2, 4, 5)
+        return v.reshape(n, h // r, w // r, c * r * r)
+    return apply_op("pixel_unshuffle", fn, [_t(x)])
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    def fn(v):
+        if data_format == "NCHW":
+            n, c, h, w = v.shape
+            v = v.reshape(n, groups, c // groups, h, w)
+            return v.transpose(0, 2, 1, 3, 4).reshape(n, c, h, w)
+        n, h, w, c = v.shape
+        v = v.reshape(n, h, w, groups, c // groups)
+        return v.transpose(0, 1, 2, 4, 3).reshape(n, h, w, c)
+    return apply_op("channel_shuffle", fn, [_t(x)])
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    """im2col (ref phi UnfoldKernel)."""
+    def _pair(v):
+        return (v, v) if isinstance(v, int) else tuple(v)
+    kh, kw = _pair(kernel_sizes)
+    sh, sw = _pair(strides)
+    dh, dw = _pair(dilations)
+    p = _pair(paddings) if isinstance(paddings, int) or len(paddings) == 2 \
+        else tuple(paddings)
+    if len(p) == 2:
+        pt, pb, pl, pr = p[0], p[0], p[1], p[1]
+    else:
+        pt, pb, pl, pr = p
+
+    def fn(v):
+        n, c, h, w = v.shape
+        v = jnp.pad(v, [(0, 0), (0, 0), (pt, pb), (pl, pr)])
+        oh = (v.shape[2] - (dh * (kh - 1) + 1)) // sh + 1
+        ow = (v.shape[3] - (dw * (kw - 1) + 1)) // sw + 1
+        patches = jax.lax.conv_general_dilated_patches(
+            v, (kh, kw), (sh, sw), "VALID", rhs_dilation=(dh, dw),
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        return patches.reshape(n, c * kh * kw, oh * ow)
+    return apply_op("unfold", fn, [_t(x)])
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1,
+         name=None):
+    """col2im — adjoint of unfold, implemented via the VJP of unfold so the
+    pair stays exactly mutually adjoint."""
+    def _pair(v):
+        return (v, v) if isinstance(v, int) else tuple(v)
+    oh, ow = _pair(output_sizes)
+    kh, kw = _pair(kernel_sizes)
+
+    def fn(col):
+        n = col.shape[0]
+        c = col.shape[1] // (kh * kw)
+
+        def unfold_pure(img):
+            t = unfold(Tensor(img), kernel_sizes, strides, paddings, dilations)
+            return t._value
+        img0 = jnp.zeros((n, c, oh, ow), col.dtype)
+        _, vjp = jax.vjp(unfold_pure, img0)
+        (out,) = vjp(col)
+        return out
+    return apply_op("fold", fn, [_t(x)])
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    def fn(th):
+        n, _, _ = th.shape
+        _, _, h, w = out_shape
+        ys = jnp.linspace(-1, 1, h) if align_corners else \
+            (jnp.arange(h) * 2 + 1) / h - 1
+        xs = jnp.linspace(-1, 1, w) if align_corners else \
+            (jnp.arange(w) * 2 + 1) / w - 1
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+        ones = jnp.ones_like(gx)
+        base = jnp.stack([gx, gy, ones], axis=-1).reshape(1, h * w, 3)
+        grid = base @ jnp.swapaxes(th, 1, 2)
+        return grid.reshape(n, h, w, 2)
+    return apply_op("affine_grid", fn, [_t(theta)])
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    def fn(v, g):
+        n, c, h, w = v.shape
+        gx, gy = g[..., 0], g[..., 1]
+        if align_corners:
+            ix = (gx + 1) * (w - 1) / 2
+            iy = (gy + 1) * (h - 1) / 2
+        else:
+            ix = ((gx + 1) * w - 1) / 2
+            iy = ((gy + 1) * h - 1) / 2
+        if mode == "nearest":
+            ix_r, iy_r = jnp.round(ix), jnp.round(iy)
+
+            def nearest_one(img, yy, xx):
+                valid = (xx >= 0) & (xx < w) & (yy >= 0) & (yy < h)
+                xc = jnp.clip(xx, 0, w - 1).astype(jnp.int32)
+                yc = jnp.clip(yy, 0, h - 1).astype(jnp.int32)
+                return jnp.where(valid[None], img[:, yc, xc], 0.0)
+            return jax.vmap(nearest_one)(v, iy_r, ix_r)
+        x0 = jnp.floor(ix)
+        y0 = jnp.floor(iy)
+        x1, y1 = x0 + 1, y0 + 1
+
+        def sample(img, yy, xx):
+            valid = (xx >= 0) & (xx < w) & (yy >= 0) & (yy < h)
+            xc = jnp.clip(xx, 0, w - 1).astype(jnp.int32)
+            yc = jnp.clip(yy, 0, h - 1).astype(jnp.int32)
+            # img: (c,h,w); yy/xx: (ho,wo)
+            vals = img[:, yc, xc]
+            return jnp.where(valid[None], vals, 0.0)
+
+        def per_image(img, yy0, xx0, yy1, xx1, ixx, iyy):
+            Ia = sample(img, yy0, xx0)
+            Ib = sample(img, yy1, xx0)
+            Ic = sample(img, yy0, xx1)
+            Id = sample(img, yy1, xx1)
+            wa = (xx1 - ixx) * (yy1 - iyy)
+            wb = (xx1 - ixx) * (iyy - yy0)
+            wc = (ixx - xx0) * (yy1 - iyy)
+            wd = (ixx - xx0) * (iyy - yy0)
+            return Ia * wa + Ib * wb + Ic * wc + Id * wd
+        return jax.vmap(per_image)(v, y0, x0, y1, x1, ix, iy)
+    return apply_op("grid_sample", fn, [_t(x), _t(grid)])
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    def fn(a, b, w, *rest):
+        out = jnp.einsum("bm,omn,bn->bo", a, w, b)
+        if rest:
+            out = out + rest[0]
+        return out
+    args = [_t(x1), _t(x2), _t(weight)]
+    if bias is not None:
+        args.append(_t(bias))
+    return apply_op("bilinear", fn, args)

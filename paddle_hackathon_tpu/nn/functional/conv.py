@@ -1,0 +1,157 @@
+"""Convolution functionals (ref ``python/paddle/nn/functional/conv.py``;
+kernels ref ``paddle/phi/kernels/gpudnn/conv_*``).
+
+All convs lower to one ``lax.conv_general_dilated`` — XLA maps it onto the MXU
+(space-to-depth + matmul tiling), replacing the reference's cudnn algo search +
+autotune cache (``phi/kernels/autotune``).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.autograd import apply_op
+from ...core.tensor import Tensor
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+
+
+def _tuple(v, n):
+    if isinstance(v, int):
+        return (v,) * n
+    v = tuple(int(i) for i in v)
+    if len(v) == 1:
+        return v * n
+    return v
+
+
+def _norm_padding(padding, n):
+    """Normalise paddle padding spec to lax format: str | [(lo,hi)]*n."""
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, int):
+        return [(padding, padding)] * n
+    padding = list(padding)
+    if len(padding) == n and all(isinstance(p, int) for p in padding):
+        return [(p, p) for p in padding]
+    if len(padding) == 2 * n:
+        return [(padding[2 * i], padding[2 * i + 1]) for i in range(n)]
+    return [tuple(p) for p in padding]
+
+
+def _conv_nd(x, weight, bias, stride, padding, dilation, groups, n,
+             channel_last, op_name):
+    spatial = "DHW"[3 - n:]
+    if channel_last:
+        lhs_spec = "N" + spatial + "C"
+    else:
+        lhs_spec = "NC" + spatial
+    dn = (lhs_spec, "OI" + spatial, lhs_spec)
+    strides = _tuple(stride, n)
+    dil = _tuple(dilation, n)
+    pad = _norm_padding(padding, n)
+
+    def fn(v, w, *rest):
+        out = jax.lax.conv_general_dilated(
+            v, w, window_strides=strides, padding=pad,
+            rhs_dilation=dil, dimension_numbers=dn,
+            feature_group_count=groups)
+        if rest:
+            b = rest[0]
+            shape = [1] * out.ndim
+            shape[1 if not channel_last else out.ndim - 1] = b.shape[0]
+            out = out + b.reshape(shape)
+        return out
+
+    args = [_t(x), _t(weight)]
+    if bias is not None:
+        args.append(_t(bias))
+    return apply_op(op_name, fn, args)
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, 1,
+                    data_format == "NLC", "conv1d")
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, 2,
+                    data_format == "NHWC", "conv2d")
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, 3,
+                    data_format == "NDHWC", "conv3d")
+
+
+def _conv_transpose_nd(x, weight, bias, stride, padding, output_padding,
+                       dilation, groups, n, channel_last, op_name):
+    spatial = "DHW"[3 - n:]
+    lhs_spec = ("N" + spatial + "C") if channel_last else ("NC" + spatial)
+    # paddle transpose-conv weight layout: (in_channels, out_channels/groups, *k)
+    dn = (lhs_spec, "IO" + spatial, lhs_spec)
+    strides = _tuple(stride, n)
+    dil = _tuple(dilation, n)
+    opad = _tuple(output_padding, n)
+
+    def fn(v, w, *rest):
+        if isinstance(padding, str):
+            pad = padding.upper()
+        else:
+            p = _norm_padding(padding, n)
+            k = [w.shape[2 + i] for i in range(n)]
+            # gradient-of-conv padding transformation
+            pad = [(dil[i] * (k[i] - 1) - p[i][0],
+                    dil[i] * (k[i] - 1) - p[i][1] + opad[i]) for i in range(n)]
+        out = jax.lax.conv_general_dilated(
+            v, w, window_strides=(1,) * n, padding=pad,
+            lhs_dilation=strides, rhs_dilation=dil, dimension_numbers=dn,
+            feature_group_count=groups)
+        if rest:
+            b = rest[0]
+            shape = [1] * out.ndim
+            shape[1 if not channel_last else out.ndim - 1] = b.shape[0]
+            out = out + b.reshape(shape)
+        return out
+
+    # kernel spatial flip for true transpose conv
+    def flip_w(w):
+        return jnp.flip(w, axis=tuple(range(2, 2 + n)))
+
+    args = [_t(x), _t(weight)]
+    if bias is not None:
+        args.append(_t(bias))
+
+    def wrapped(v, w, *rest):
+        return fn(v, flip_w(w), *rest)
+    return apply_op(op_name, wrapped, args)
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCL", name=None):
+    return _conv_transpose_nd(x, weight, bias, stride, padding, output_padding,
+                              dilation, groups, 1, data_format == "NLC",
+                              "conv1d_transpose")
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCHW", name=None):
+    return _conv_transpose_nd(x, weight, bias, stride, padding, output_padding,
+                              dilation, groups, 2, data_format == "NHWC",
+                              "conv2d_transpose")
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCDHW", name=None):
+    return _conv_transpose_nd(x, weight, bias, stride, padding, output_padding,
+                              dilation, groups, 3, data_format == "NDHWC",
+                              "conv3d_transpose")

@@ -1,0 +1,288 @@
+"""Loss functionals (ref ``python/paddle/nn/functional/loss.py``; kernels ref
+``paddle/phi/kernels/gpu/cross_entropy_kernel.cu`` etc.).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.autograd import apply_op
+from ...core.tensor import Tensor
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+
+
+def _reduce(out, reduction):
+    if reduction == "mean":
+        return jnp.mean(out)
+    if reduction == "sum":
+        return jnp.sum(out)
+    return out
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100,  # noqa: A002
+                  reduction="mean", soft_label=False, axis=-1,
+                  use_softmax=True, label_smoothing=0.0, name=None):
+    """Softmax cross entropy (ref ``CrossEntropyWithSoftmaxKernel``).
+
+    Computed as log_softmax + gather — one fused XLA reduction chain, no
+    materialised softmax.
+    """
+    def fn(logits, lbl, *rest):
+        lp = jax.nn.log_softmax(logits, axis=axis) if use_softmax else \
+            jnp.log(jnp.maximum(logits, 1e-30))
+        if soft_label:
+            tgt = lbl
+            if label_smoothing > 0.0:
+                k = lp.shape[axis]
+                tgt = tgt * (1 - label_smoothing) + label_smoothing / k
+            loss = -jnp.sum(tgt * lp, axis=axis)
+        else:
+            lbl_i = lbl.astype(jnp.int32)
+            if lbl_i.ndim == lp.ndim:
+                lbl_i = jnp.squeeze(lbl_i, axis=axis)
+            if label_smoothing > 0.0:
+                k = lp.shape[axis]
+                onehot = jax.nn.one_hot(lbl_i, k, axis=axis, dtype=lp.dtype)
+                tgt = onehot * (1 - label_smoothing) + label_smoothing / k
+                loss = -jnp.sum(tgt * lp, axis=axis)
+            else:
+                loss = -jnp.take_along_axis(
+                    lp, jnp.expand_dims(lbl_i, axis), axis=axis
+                ).squeeze(axis)
+            mask = (lbl_i != ignore_index)
+            loss = jnp.where(mask, loss, 0.0)
+            if rest:
+                w = jnp.take(rest[0], jnp.maximum(lbl_i, 0), axis=0)
+                loss = loss * jnp.where(mask, w, 0.0)
+                if reduction == "mean":
+                    return jnp.sum(loss) / jnp.maximum(
+                        jnp.sum(jnp.where(mask, w, 0.0)), 1e-12)
+            elif reduction == "mean":
+                return jnp.sum(loss) / jnp.maximum(
+                    jnp.sum(mask.astype(lp.dtype)), 1.0)
+        return _reduce(loss, reduction)
+
+    args = [_t(input), _t(label)]
+    if weight is not None:
+        args.append(_t(weight))
+    return apply_op("cross_entropy", fn, args)
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               ignore_index=-100, numeric_stable_mode=True,
+                               return_softmax=False, axis=-1):
+    loss = cross_entropy(logits, label, soft_label=soft_label,
+                         ignore_index=ignore_index, reduction="none",
+                         axis=axis)
+    loss = apply_op("unsqueeze", lambda v: jnp.expand_dims(v, axis), [loss])
+    if return_softmax:
+        from .activation import softmax as softmax_fn
+        return loss, softmax_fn(logits, axis=axis)
+    return loss
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100,  # noqa: A002
+             reduction="mean", name=None):
+    def fn(lp, lbl, *rest):
+        lbl_i = lbl.astype(jnp.int32)
+        loss = -jnp.take_along_axis(
+            lp, jnp.expand_dims(lbl_i, 1), axis=1).squeeze(1)
+        mask = (lbl_i != ignore_index)
+        loss = jnp.where(mask, loss, 0.0)
+        if rest:
+            w = jnp.take(rest[0], jnp.maximum(lbl_i, 0), axis=0)
+            loss = loss * jnp.where(mask, w, 0.0)
+            if reduction == "mean":
+                return jnp.sum(loss) / jnp.maximum(jnp.sum(jnp.where(mask, w, 0.0)), 1e-12)
+        if reduction == "mean":
+            return jnp.sum(loss) / jnp.maximum(jnp.sum(mask.astype(lp.dtype)), 1.0)
+        return _reduce(loss, reduction)
+    args = [_t(input), _t(label)]
+    if weight is not None:
+        args.append(_t(weight))
+    return apply_op("nll_loss", fn, args)
+
+
+def mse_loss(input, label, reduction="mean", name=None):  # noqa: A002
+    return apply_op("mse_loss",
+                    lambda a, b: _reduce(jnp.square(a - b), reduction),
+                    [_t(input), _t(label)])
+
+
+def l1_loss(input, label, reduction="mean", name=None):  # noqa: A002
+    return apply_op("l1_loss",
+                    lambda a, b: _reduce(jnp.abs(a - b), reduction),
+                    [_t(input), _t(label)])
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):  # noqa: A002
+    def fn(a, b):
+        d = jnp.abs(a - b)
+        loss = jnp.where(d < delta, 0.5 * d * d / delta, d - 0.5 * delta)
+        return _reduce(loss, reduction)
+    return apply_op("smooth_l1_loss", fn, [_t(input), _t(label)])
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean",  # noqa: A002
+                         name=None):
+    def fn(p, y, *rest):
+        p = jnp.clip(p, 1e-12, 1.0 - 1e-7)
+        loss = -(y * jnp.log(p) + (1 - y) * jnp.log(1 - p))
+        if rest:
+            loss = loss * rest[0]
+        return _reduce(loss, reduction)
+    args = [_t(input), _t(label)]
+    if weight is not None:
+        args.append(_t(weight))
+    return apply_op("bce", fn, args)
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None,
+                                     reduction="mean", pos_weight=None,
+                                     name=None):
+    def fn(z, y, *rest):
+        i = 0
+        w = None
+        if weight is not None:
+            w = rest[i]
+            i += 1
+        pw = rest[i] if pos_weight is not None else None
+        # stable: max(z,0) - z*y + log(1+exp(-|z|))
+        base = jnp.maximum(z, 0) - z * y + jnp.log1p(jnp.exp(-jnp.abs(z)))
+        if pw is not None:
+            log_sig = jax.nn.log_sigmoid(z)
+            log_sig_neg = jax.nn.log_sigmoid(-z)
+            base = -(pw * y * log_sig + (1 - y) * log_sig_neg)
+        if w is not None:
+            base = base * w
+        return _reduce(base, reduction)
+    args = [_t(logit), _t(label)]
+    if weight is not None:
+        args.append(_t(weight))
+    if pos_weight is not None:
+        args.append(_t(pos_weight))
+    return apply_op("bce_with_logits", fn, args)
+
+
+def kl_div(input, label, reduction="mean", name=None):  # noqa: A002
+    def fn(lp, y):
+        loss = y * (jnp.log(jnp.maximum(y, 1e-30)) - lp)
+        if reduction == "batchmean":
+            return jnp.sum(loss) / lp.shape[0]
+        return _reduce(loss, reduction)
+    return apply_op("kl_div", fn, [_t(input), _t(label)])
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean",  # noqa: A002
+                         name=None):
+    def fn(x, y):
+        loss = jnp.where(y == 1, x, jnp.maximum(0.0, margin - x))
+        return _reduce(loss, reduction)
+    return apply_op("hinge_embedding_loss", fn, [_t(input), _t(label)])
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean",  # noqa: A002
+                        name=None):
+    def fn(a, b, y):
+        loss = jnp.maximum(0.0, -y * (a - b) + margin)
+        return _reduce(loss, reduction)
+    return apply_op("margin_ranking_loss", fn, [_t(input), _t(other), _t(label)])
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0, reduction="mean",
+                          name=None):
+    def fn(a, b, y):
+        cos = jnp.sum(a * b, -1) / jnp.maximum(
+            jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1), 1e-12)
+        loss = jnp.where(y == 1, 1 - cos, jnp.maximum(0.0, cos - margin))
+        return _reduce(loss, reduction)
+    return apply_op("cosine_embedding_loss", fn,
+                    [_t(input1), _t(input2), _t(label)])
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2,  # noqa: A002
+                        epsilon=1e-6, swap=False, reduction="mean", name=None):
+    def fn(a, pos, neg):
+        dp = jnp.sum(jnp.abs(a - pos) ** p, -1) ** (1.0 / p)
+        dn = jnp.sum(jnp.abs(a - neg) ** p, -1) ** (1.0 / p)
+        if swap:
+            dsn = jnp.sum(jnp.abs(pos - neg) ** p, -1) ** (1.0 / p)
+            dn = jnp.minimum(dn, dsn)
+        return _reduce(jnp.maximum(dp - dn + margin, 0.0), reduction)
+    return apply_op("triplet_margin_loss", fn,
+                    [_t(input), _t(positive), _t(negative)])
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    """CTC loss (ref ``warpctc_op``) — forward-backward in log space via scan."""
+    def fn(lp, lbl, in_len, lbl_len):
+        # lp: (T, B, C) paddle layout
+        T, B, C = lp.shape
+        S = lbl.shape[1]
+        ext = jnp.full((B, 2 * S + 1), blank, dtype=jnp.int32)
+        ext = ext.at[:, 1::2].set(lbl.astype(jnp.int32))
+        neg_inf = jnp.asarray(-1e30, lp.dtype)
+        alpha0 = jnp.full((B, 2 * S + 1), neg_inf)
+        alpha0 = alpha0.at[:, 0].set(lp[0, :, blank])
+        alpha0 = alpha0.at[:, 1].set(
+            jnp.take_along_axis(lp[0], ext[:, 1:2], axis=1)[:, 0])
+
+        def step(alpha, lp_t):
+            shift1 = jnp.concatenate(
+                [jnp.full((B, 1), neg_inf), alpha[:, :-1]], axis=1)
+            shift2 = jnp.concatenate(
+                [jnp.full((B, 2), neg_inf), alpha[:, :-2]], axis=1)
+            same = jnp.concatenate(
+                [jnp.full((B, 2), True),
+                 ext[:, 2:] == ext[:, :-2]], axis=1)
+            cand = jnp.where(same,
+                             jnp.logaddexp(alpha, shift1),
+                             jnp.logaddexp(jnp.logaddexp(alpha, shift1), shift2))
+            emit = jnp.take_along_axis(lp_t, ext, axis=1)
+            return cand + emit, None
+
+        def scan_step(carry, t):
+            alpha = carry
+            new_alpha, _ = step(alpha, lp[t])
+            alpha = jnp.where((t < in_len)[:, None], new_alpha, alpha)
+            return alpha, None
+
+        alpha, _ = jax.lax.scan(scan_step, alpha0, jnp.arange(1, T))
+        last = 2 * lbl_len.astype(jnp.int32)
+        a_last = jnp.take_along_axis(alpha, last[:, None], axis=1)[:, 0]
+        a_prev = jnp.take_along_axis(
+            alpha, jnp.maximum(last - 1, 0)[:, None], axis=1)[:, 0]
+        ll = jnp.logaddexp(a_last, a_prev)
+        loss = -ll
+        if reduction == "mean":
+            return jnp.mean(loss / jnp.maximum(lbl_len.astype(lp.dtype), 1.0))
+        return _reduce(loss, reduction)
+    return apply_op("ctc_loss", fn, [_t(log_probs), _t(labels),
+                                     _t(input_lengths), _t(label_lengths)])
+
+
+def square_error_cost(input, label):  # noqa: A002
+    return apply_op("square_error_cost",
+                    lambda a, b: jnp.square(a - b), [_t(input), _t(label)])
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum", name=None):
+    def fn(z, y, *rest):
+        p = jax.nn.sigmoid(z)
+        ce = jnp.maximum(z, 0) - z * y + jnp.log1p(jnp.exp(-jnp.abs(z)))
+        p_t = p * y + (1 - p) * (1 - y)
+        a_t = alpha * y + (1 - alpha) * (1 - y)
+        loss = a_t * ((1 - p_t) ** gamma) * ce
+        if rest:
+            loss = loss / rest[0]
+        return _reduce(loss, reduction)
+    args = [_t(logit), _t(label)]
+    if normalizer is not None:
+        args.append(_t(normalizer))
+    return apply_op("sigmoid_focal_loss", fn, args)

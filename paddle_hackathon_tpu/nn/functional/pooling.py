@@ -1,0 +1,213 @@
+"""Pooling functionals (ref ``python/paddle/nn/functional/pooling.py``;
+kernels ref ``paddle/phi/kernels/funcs/pooling.h``).
+
+All pools lower to ``lax.reduce_window`` — XLA's windowed reduction maps to
+the VPU with HBM-friendly tiling.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ...core.autograd import apply_op
+from ...core.tensor import Tensor
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+
+
+def _tuple(v, n):
+    if v is None:
+        return None
+    if isinstance(v, int):
+        return (v,) * n
+    v = tuple(int(i) for i in v)
+    return v * n if len(v) == 1 else v
+
+
+def _pad_spec(padding, n):
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, int):
+        return [(padding, padding)] * n
+    padding = list(padding)
+    if len(padding) == n and all(isinstance(p, int) for p in padding):
+        return [(p, p) for p in padding]
+    if len(padding) == 2 * n:
+        return [(padding[2 * i], padding[2 * i + 1]) for i in range(n)]
+    return [tuple(p) for p in padding]
+
+
+def _reduce_window(v, init, op, window, strides, pad, channel_last, n):
+    if channel_last:
+        dims = (1,) + window + (1,)
+        strd = (1,) + strides + (1,)
+        padc = [(0, 0)] + list(pad) + [(0, 0)] if not isinstance(pad, str) else pad
+    else:
+        dims = (1, 1) + window
+        strd = (1, 1) + strides
+        padc = [(0, 0), (0, 0)] + list(pad) if not isinstance(pad, str) else pad
+    return jax.lax.reduce_window(v, init, op, dims, strd, padc)
+
+
+def _ceil_extend(pad, v_shape, window, strides, channel_last, n):
+    """Extra high-side padding so the last partial window is emitted
+    (ceil_mode=True semantics, ref funcs/pooling.h AdaptStartEndIndex)."""
+    spatial = (list(range(1, 1 + n)) if channel_last
+               else list(range(2, 2 + n)))
+    out = []
+    for i, (lo, hi) in enumerate(pad):
+        size = v_shape[spatial[i]]
+        eff = size + lo + hi - window[i]
+        out_floor = eff // strides[i] + 1
+        out_ceil = -(-eff // strides[i]) + 1
+        extra = (out_ceil - out_floor) * strides[i]
+        out.append((lo, hi + extra))
+    return out
+
+
+def _max_pool(x, kernel_size, stride, padding, ceil_mode, n, channel_last,
+              name, return_mask=False):
+    if return_mask:
+        raise NotImplementedError(
+            "return_mask=True is not supported (no argmax pooling op on the "
+            "XLA path yet)")
+    window = _tuple(kernel_size, n)
+    strides = _tuple(stride, n) if stride is not None else window
+    pad = _pad_spec(padding, n)
+
+    def fn(v):
+        p = pad
+        if ceil_mode and not isinstance(p, str):
+            p = _ceil_extend(p, v.shape, window, strides, channel_last, n)
+        return _reduce_window(v, -jnp.inf if jnp.issubdtype(v.dtype, jnp.floating)
+                              else jnp.iinfo(v.dtype).min,
+                              jax.lax.max, window, strides, p,
+                              channel_last, n)
+    return apply_op(name, fn, [_t(x)])
+
+
+def _avg_pool(x, kernel_size, stride, padding, exclusive, n, channel_last,
+              name, ceil_mode=False, divisor_override=None):
+    window = _tuple(kernel_size, n)
+    strides = _tuple(stride, n) if stride is not None else window
+    pad = _pad_spec(padding, n)
+
+    def fn(v):
+        p = pad
+        if ceil_mode and not isinstance(p, str):
+            p = _ceil_extend(p, v.shape, window, strides, channel_last, n)
+        s = _reduce_window(v.astype(jnp.float32), 0.0, jax.lax.add, window,
+                           strides, p, channel_last, n)
+        if divisor_override is not None:
+            return (s / float(divisor_override)).astype(v.dtype)
+        if (exclusive or ceil_mode) and not isinstance(p, str):
+            ones = jnp.ones_like(v, jnp.float32)
+            cnt = _reduce_window(ones, 0.0, jax.lax.add, window, strides, p,
+                                 channel_last, n)
+            return (s / cnt).astype(v.dtype)
+        return (s / float(np.prod(window))).astype(v.dtype)
+    return apply_op(name, fn, [_t(x)])
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCL", name=None):
+    return _max_pool(x, kernel_size, stride, padding, ceil_mode, 1,
+                     data_format == "NLC", "max_pool1d", return_mask)
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCHW", name=None):
+    return _max_pool(x, kernel_size, stride, padding, ceil_mode, 2,
+                     data_format == "NHWC", "max_pool2d", return_mask)
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCDHW", name=None):
+    return _max_pool(x, kernel_size, stride, padding, ceil_mode, 3,
+                     data_format == "NDHWC", "max_pool3d", return_mask)
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, data_format="NCL", name=None):
+    return _avg_pool(x, kernel_size, stride, padding, exclusive, 1,
+                     data_format == "NLC", "avg_pool1d", ceil_mode)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW",
+               name=None):
+    return _avg_pool(x, kernel_size, stride, padding, exclusive, 2,
+                     data_format == "NHWC", "avg_pool2d", ceil_mode,
+                     divisor_override)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW",
+               name=None):
+    return _avg_pool(x, kernel_size, stride, padding, exclusive, 3,
+                     data_format == "NDHWC", "avg_pool3d", ceil_mode,
+                     divisor_override)
+
+
+def _adaptive_pool(x, output_size, n, channel_last, reducer, name):
+    out_sizes = _tuple(output_size, n)
+
+    def fn(v):
+        spatial_axes = (list(range(1, 1 + n)) if channel_last
+                        else list(range(2, 2 + n)))
+        out = v
+        for i, ax in enumerate(spatial_axes):
+            osz = out_sizes[i]
+            if osz is None:
+                continue
+            isz = out.shape[ax]
+            if isz % osz == 0:
+                k = isz // osz
+                new_shape = (out.shape[:ax] + (osz, k) + out.shape[ax + 1:])
+                out = reducer(out.reshape(new_shape), axis=ax + 1)
+            else:
+                # general case: per-output-bin slices
+                starts = [int(np.floor(j * isz / osz)) for j in range(osz)]
+                ends = [int(np.ceil((j + 1) * isz / osz)) for j in range(osz)]
+                pieces = [
+                    reducer(jax.lax.slice_in_dim(out, s, e, axis=ax), axis=ax,
+                            keepdims=True)
+                    for s, e in zip(starts, ends)]
+                out = jnp.concatenate(pieces, axis=ax)
+        return out
+    return apply_op(name, fn, [_t(x)])
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive_pool(x, output_size, 1, False, jnp.mean,
+                          "adaptive_avg_pool1d")
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive_pool(x, output_size, 2, data_format == "NHWC", jnp.mean,
+                          "adaptive_avg_pool2d")
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive_pool(x, output_size, 3, data_format == "NDHWC", jnp.mean,
+                          "adaptive_avg_pool3d")
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, 1, False, jnp.max,
+                          "adaptive_max_pool1d")
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, 2, False, jnp.max,
+                          "adaptive_max_pool2d")
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, 3, False, jnp.max,
+                          "adaptive_max_pool3d")

@@ -1,0 +1,370 @@
+"""The Layer module system.
+
+Equivalent of the reference's dygraph ``Layer``
+(``python/paddle/fluid/dygraph/layers.py:84``): parameter/sublayer/buffer
+registries via ``__setattr__`` routing, forward pre/post hooks, train/eval
+mode, ``state_dict``/``set_state_dict``, ``to(device/dtype)``.
+
+A TPU-native addition: :meth:`functional_state` + module-level
+:func:`functional_call` give a pure params->output view of any Layer, which is
+what the jit/pjit path differentiates with ``jax.grad`` (the eager tape stays
+out of traced programs).
+"""
+
+from __future__ import annotations
+
+import contextlib
+from collections import OrderedDict
+from typing import Callable, Dict, Iterator, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import autograd
+from ..core.dtype import convert_dtype
+from ..core.tensor import Tensor
+from .parameter import Parameter
+
+_name_counter: Dict[str, int] = {}
+
+
+class Layer:
+    def __init__(self, name_scope: Optional[str] = None, dtype="float32"):
+        cls = name_scope or self.__class__.__name__.lower()
+        idx = _name_counter.get(cls, 0)
+        _name_counter[cls] = idx + 1
+        object.__setattr__(self, "_full_name", f"{cls}_{idx}")
+        object.__setattr__(self, "_parameters", OrderedDict())
+        object.__setattr__(self, "_sub_layers", OrderedDict())
+        object.__setattr__(self, "_buffers", OrderedDict())
+        object.__setattr__(self, "_non_persistable_buffer_names", set())
+        object.__setattr__(self, "training", True)
+        object.__setattr__(self, "_forward_pre_hooks", OrderedDict())
+        object.__setattr__(self, "_forward_post_hooks", OrderedDict())
+        object.__setattr__(self, "_hook_id", 0)
+        object.__setattr__(self, "_dtype", dtype)
+
+    # -- attribute routing (ref layers.py __setattr__) ---------------------
+    def __setattr__(self, name, value):
+        if isinstance(value, Parameter):
+            self._parameters[name] = value
+            self.__dict__.pop(name, None)
+        elif isinstance(value, Layer):
+            self._sub_layers[name] = value
+            self.__dict__.pop(name, None)
+        else:
+            if name in self._parameters:
+                del self._parameters[name]
+            if name in self._sub_layers:
+                del self._sub_layers[name]
+            if name in self._buffers:
+                if value is None or isinstance(value, Tensor):
+                    self._buffers[name] = value
+                    return
+                del self._buffers[name]
+            object.__setattr__(self, name, value)
+
+    def __getattr__(self, name):
+        # only called when normal lookup fails
+        for registry in ("_parameters", "_sub_layers", "_buffers"):
+            d = self.__dict__.get(registry)
+            if d is not None and name in d:
+                return d[name]
+        raise AttributeError(
+            f"'{type(self).__name__}' object has no attribute '{name}'")
+
+    def __delattr__(self, name):
+        for registry in (self._parameters, self._sub_layers, self._buffers):
+            if name in registry:
+                del registry[name]
+                return
+        object.__delattr__(self, name)
+
+    # -- registration ------------------------------------------------------
+    def add_parameter(self, name: str, parameter: Optional[Parameter]):
+        self._parameters[name] = parameter
+        return parameter
+
+    def add_sublayer(self, name: str, sublayer: "Layer"):
+        self._sub_layers[name] = sublayer
+        return sublayer
+
+    def register_buffer(self, name: str, tensor: Optional[Tensor],
+                        persistable: bool = True):
+        self._buffers[name] = tensor
+        if not persistable:
+            self._non_persistable_buffer_names.add(name)
+        return tensor
+
+    def create_parameter(self, shape, attr=None, dtype=None, is_bias=False,
+                         default_initializer=None) -> Parameter:
+        from .parameter import ParamAttr, create_parameter
+        attr = ParamAttr._to_attr(attr)
+        if attr is False:
+            return None
+        return create_parameter(shape, dtype=dtype or self._dtype, attr=attr,
+                                is_bias=is_bias,
+                                default_initializer=default_initializer)
+
+    # -- traversal ---------------------------------------------------------
+    def parameters(self, include_sublayers: bool = True):
+        return [p for _, p in self.named_parameters(
+            include_sublayers=include_sublayers)]
+
+    def named_parameters(self, prefix: str = "", include_sublayers: bool = True
+                         ) -> Iterator[Tuple[str, Parameter]]:
+        seen = set()
+        for name, layer in self._traverse(prefix, include_sublayers):
+            for pname, p in layer._parameters.items():
+                if p is not None and id(p) not in seen:
+                    seen.add(id(p))
+                    yield (f"{name}.{pname}" if name else pname), p
+
+    def buffers(self, include_sublayers: bool = True):
+        return [b for _, b in self.named_buffers(
+            include_sublayers=include_sublayers)]
+
+    def named_buffers(self, prefix: str = "", include_sublayers: bool = True):
+        seen = set()
+        for name, layer in self._traverse(prefix, include_sublayers):
+            for bname, b in layer._buffers.items():
+                if b is not None and id(b) not in seen:
+                    seen.add(id(b))
+                    yield (f"{name}.{bname}" if name else bname), b
+
+    def _traverse(self, prefix: str, include_sublayers: bool):
+        yield prefix, self
+        if include_sublayers:
+            for name, sub in self._sub_layers.items():
+                if sub is None:
+                    continue
+                sub_prefix = f"{prefix}.{name}" if prefix else name
+                yield from sub._traverse(sub_prefix, True)
+
+    def children(self) -> Iterator["Layer"]:
+        for _, l in self.named_children():
+            yield l
+
+    def named_children(self) -> Iterator[Tuple[str, "Layer"]]:
+        for name, sub in self._sub_layers.items():
+            if sub is not None:
+                yield name, sub
+
+    def sublayers(self, include_self: bool = False):
+        out = []
+        for name, layer in self._traverse("", True):
+            if layer is self and not include_self:
+                continue
+            out.append(layer)
+        return out
+
+    def named_sublayers(self, prefix: str = "", include_self: bool = False):
+        for name, layer in self._traverse(prefix, True):
+            if layer is self and not include_self:
+                continue
+            yield name, layer
+
+    def apply(self, fn: Callable[["Layer"], None]) -> "Layer":
+        for layer in self.sublayers(include_self=True):
+            fn(layer)
+        return self
+
+    def full_name(self) -> str:
+        return self._full_name
+
+    # -- mode --------------------------------------------------------------
+    def train(self) -> "Layer":
+        for layer in self.sublayers(include_self=True):
+            object.__setattr__(layer, "training", True)
+        return self
+
+    def eval(self) -> "Layer":
+        for layer in self.sublayers(include_self=True):
+            object.__setattr__(layer, "training", False)
+        return self
+
+    # -- hooks (ref layers.py register_forward_{pre,post}_hook) ------------
+    def register_forward_pre_hook(self, hook):
+        hid = self._hook_id
+        object.__setattr__(self, "_hook_id", hid + 1)
+        self._forward_pre_hooks[hid] = hook
+        return _LayerHookHandle(self._forward_pre_hooks, hid)
+
+    def register_forward_post_hook(self, hook):
+        hid = self._hook_id
+        object.__setattr__(self, "_hook_id", hid + 1)
+        self._forward_post_hooks[hid] = hook
+        return _LayerHookHandle(self._forward_post_hooks, hid)
+
+    # -- call --------------------------------------------------------------
+    def __call__(self, *inputs, **kwargs):
+        for hook in self._forward_pre_hooks.values():
+            result = hook(self, inputs)
+            if result is not None:
+                inputs = result if isinstance(result, tuple) else (result,)
+        outputs = self.forward(*inputs, **kwargs)
+        for hook in self._forward_post_hooks.values():
+            result = hook(self, inputs, outputs)
+            if result is not None:
+                outputs = result
+        return outputs
+
+    def forward(self, *inputs, **kwargs):
+        raise NotImplementedError(
+            f"{type(self).__name__} must implement forward()")
+
+    # -- state dict --------------------------------------------------------
+    def state_dict(self, destination=None, include_sublayers: bool = True,
+                   use_hook: bool = True) -> "OrderedDict[str, Tensor]":
+        dest = destination if destination is not None else OrderedDict()
+        for name, p in self.named_parameters(include_sublayers=include_sublayers):
+            dest[name] = p
+        for name, layer in self._traverse("", include_sublayers):
+            for bname, b in layer._buffers.items():
+                if b is None or bname in layer._non_persistable_buffer_names:
+                    continue
+                key = f"{name}.{bname}" if name else bname
+                dest[key] = b
+        return dest
+
+    def set_state_dict(self, state_dict, use_structured_name: bool = True):
+        """Load a state dict (ref ``layers.py`` set_state_dict); returns
+        (missing_keys, unexpected_keys) like the reference logs."""
+        own = self.state_dict()
+        missing, unexpected = [], []
+        for key, value in state_dict.items():
+            if key not in own:
+                unexpected.append(key)
+                continue
+            target = own[key]
+            arr = value._value if isinstance(value, Tensor) else jnp.asarray(
+                np.asarray(value))
+            if tuple(arr.shape) != tuple(target._value.shape):
+                raise ValueError(
+                    f"shape mismatch for {key}: loaded {tuple(arr.shape)} vs "
+                    f"expected {tuple(target._value.shape)}")
+            target._set_value(arr.astype(target._value.dtype))
+        for key in own:
+            if key not in state_dict:
+                missing.append(key)
+        return missing, unexpected
+
+    load_dict = set_state_dict
+
+    # -- device / dtype ----------------------------------------------------
+    def to(self, device=None, dtype=None, blocking=None) -> "Layer":
+        from ..core import device as device_mod
+        dev = None
+        if device is not None:
+            if isinstance(device, str):
+                dt, _, idx = device.partition(":")
+                dev = device_mod.Place(dt, int(idx or 0)).jax_device
+            else:
+                dev = device.jax_device
+        d = convert_dtype(dtype)
+        for t in list(self.parameters()) + list(self.buffers()):
+            v = t._value
+            if d is not None and jnp.issubdtype(v.dtype, jnp.floating):
+                v = v.astype(d)
+            if dev is not None:
+                v = jax.device_put(v, dev)
+            t._set_value(v)
+        if dtype is not None:
+            for layer in self.sublayers(include_self=True):
+                object.__setattr__(layer, "_dtype", np.dtype(d).name if d else dtype)
+        return self
+
+    def astype(self, dtype):
+        return self.to(dtype=dtype)
+
+    def float(self):
+        return self.to(dtype="float32")
+
+    def bfloat16(self):
+        return self.to(dtype="bfloat16")
+
+    def clear_gradients(self):
+        for p in self.parameters():
+            p.clear_grad()
+
+    # -- functional view (TPU-native: used by jit/pjit paths) --------------
+    def functional_state(self):
+        """Return (param_arrays, buffer_arrays) name-keyed dicts of payloads."""
+        params = {k: p._value for k, p in self.named_parameters()}
+        bufs = {}
+        for name, layer in self._traverse("", True):
+            for bname, b in layer._buffers.items():
+                if b is not None:
+                    bufs[f"{name}.{bname}" if name else bname] = b._value
+        return params, bufs
+
+    @contextlib.contextmanager
+    def _swap_state(self, params=None, buffers=None):
+        """Temporarily substitute payloads (tracer-safe) into the live layer."""
+        entries = []
+        lookup = dict(self.named_parameters())
+        if params:
+            for k, v in params.items():
+                t = lookup[k]
+                entries.append((t, t._value))
+                t._value = v
+        if buffers:
+            buf_lookup = {}
+            for name, layer in self._traverse("", True):
+                for bname, b in layer._buffers.items():
+                    if b is not None:
+                        buf_lookup[f"{name}.{bname}" if name else bname] = b
+            for k, v in buffers.items():
+                t = buf_lookup[k]
+                entries.append((t, t._value))
+                t._value = v
+        try:
+            yield
+        finally:
+            for t, old in entries:
+                t._value = old
+
+    def __repr__(self):
+        extra = self.extra_repr()
+        lines = []
+        for name, sub in self._sub_layers.items():
+            sub_repr = repr(sub).split("\n")
+            lines.append(f"  ({name}): " + "\n  ".join(sub_repr))
+        body = ""
+        if extra and not lines:
+            body = extra
+        elif lines:
+            body = "\n" + "\n".join(lines) + "\n"
+        return f"{type(self).__name__}({body})"
+
+    def extra_repr(self) -> str:
+        return ""
+
+
+class _LayerHookHandle:
+    def __init__(self, registry, hid):
+        self._registry, self._hid = registry, hid
+
+    def remove(self):
+        self._registry.pop(self._hid, None)
+
+
+def functional_call(layer: Layer, params: dict, args=(), kwargs=None,
+                    buffers: Optional[dict] = None, training: Optional[bool] = None):
+    """Run ``layer`` with payloads substituted from ``params`` — pure w.r.t.
+    the tree, so it can sit under ``jax.grad``/``jax.jit``/``pjit``. The eager
+    tape is disabled inside (gradients come from the jax transform)."""
+    kwargs = kwargs or {}
+    prev_mode = layer.training
+    if training is not None and training != prev_mode:
+        layer.train() if training else layer.eval()
+    try:
+        with layer._swap_state(params, buffers), autograd.no_grad():
+            out = layer(*args, **kwargs)
+        return jax.tree.map(
+            lambda t: t._value if isinstance(t, Tensor) else t, out,
+            is_leaf=lambda t: isinstance(t, Tensor))
+    finally:
+        if training is not None and training != prev_mode:
+            layer.train() if prev_mode else layer.eval()

@@ -1,0 +1,106 @@
+"""Pooling layers (ref ``python/paddle/nn/layer/pooling.py``)."""
+
+from __future__ import annotations
+
+from .. import functional as F
+from ..layer import Layer
+
+
+class _Pool(Layer):
+    def __init__(self, fn_name, kernel_size=None, stride=None, padding=0,
+                 **kwargs):
+        super().__init__()
+        self._fn = getattr(F, fn_name)
+        self.kernel_size = kernel_size
+        self.stride = stride
+        self.padding = padding
+        self._kwargs = kwargs
+
+    def forward(self, x):
+        return self._fn(x, self.kernel_size, self.stride, self.padding,
+                        **self._kwargs)
+
+
+class MaxPool1D(_Pool):
+    def __init__(self, kernel_size, stride=None, padding=0, return_mask=False,
+                 ceil_mode=False, name=None):
+        super().__init__("max_pool1d", kernel_size, stride, padding)
+
+
+class MaxPool2D(_Pool):
+    def __init__(self, kernel_size, stride=None, padding=0, return_mask=False,
+                 ceil_mode=False, data_format="NCHW", name=None):
+        super().__init__("max_pool2d", kernel_size, stride, padding,
+                         data_format=data_format)
+
+
+class MaxPool3D(_Pool):
+    def __init__(self, kernel_size, stride=None, padding=0, return_mask=False,
+                 ceil_mode=False, data_format="NCDHW", name=None):
+        super().__init__("max_pool3d", kernel_size, stride, padding,
+                         data_format=data_format)
+
+
+class AvgPool1D(_Pool):
+    def __init__(self, kernel_size, stride=None, padding=0, exclusive=True,
+                 ceil_mode=False, name=None):
+        super().__init__("avg_pool1d", kernel_size, stride, padding)
+
+
+class AvgPool2D(_Pool):
+    def __init__(self, kernel_size, stride=None, padding=0, ceil_mode=False,
+                 exclusive=True, divisor_override=None, data_format="NCHW",
+                 name=None):
+        super().__init__("avg_pool2d", kernel_size, stride, padding,
+                         data_format=data_format)
+
+
+class AvgPool3D(_Pool):
+    def __init__(self, kernel_size, stride=None, padding=0, ceil_mode=False,
+                 exclusive=True, divisor_override=None, data_format="NCDHW",
+                 name=None):
+        super().__init__("avg_pool3d", kernel_size, stride, padding,
+                         data_format=data_format)
+
+
+class _AdaptivePool(Layer):
+    def __init__(self, fn_name, output_size, **kwargs):
+        super().__init__()
+        self._fn = getattr(F, fn_name)
+        self.output_size = output_size
+        self._kwargs = kwargs
+
+    def forward(self, x):
+        return self._fn(x, self.output_size, **self._kwargs)
+
+
+class AdaptiveAvgPool1D(_AdaptivePool):
+    def __init__(self, output_size, name=None):
+        super().__init__("adaptive_avg_pool1d", output_size)
+
+
+class AdaptiveAvgPool2D(_AdaptivePool):
+    def __init__(self, output_size, data_format="NCHW", name=None):
+        super().__init__("adaptive_avg_pool2d", output_size,
+                         data_format=data_format)
+
+
+class AdaptiveAvgPool3D(_AdaptivePool):
+    def __init__(self, output_size, data_format="NCDHW", name=None):
+        super().__init__("adaptive_avg_pool3d", output_size,
+                         data_format=data_format)
+
+
+class AdaptiveMaxPool1D(_AdaptivePool):
+    def __init__(self, output_size, return_mask=False, name=None):
+        super().__init__("adaptive_max_pool1d", output_size)
+
+
+class AdaptiveMaxPool2D(_AdaptivePool):
+    def __init__(self, output_size, return_mask=False, name=None):
+        super().__init__("adaptive_max_pool2d", output_size)
+
+
+class AdaptiveMaxPool3D(_AdaptivePool):
+    def __init__(self, output_size, return_mask=False, name=None):
+        super().__init__("adaptive_max_pool3d", output_size)

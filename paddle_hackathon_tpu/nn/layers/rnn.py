@@ -1,0 +1,245 @@
+"""Recurrent layers (ref ``python/paddle/nn/layer/rnn.py``).
+
+The reference runs cudnn RNN kernels (``rnn_op.cu``); here the recurrence is a
+``lax.scan`` over time — the XLA-native way to compile a static-shaped loop on
+TPU (no per-step dispatch, compiler-pipelined).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ...core.autograd import apply_op
+from ...core.tensor import Tensor
+from .. import initializer as I
+from ..layer import Layer
+from ..parameter import ParamAttr
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+
+
+class _RNNCellBase(Layer):
+    def __init__(self, input_size, hidden_size, gates, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        u = I.Uniform(-std, std)
+        g = gates
+        self.weight_ih = self.create_parameter(
+            [g * hidden_size, input_size],
+            attr=ParamAttr._to_attr(weight_ih_attr), default_initializer=u)
+        self.weight_hh = self.create_parameter(
+            [g * hidden_size, hidden_size],
+            attr=ParamAttr._to_attr(weight_hh_attr), default_initializer=u)
+        self.bias_ih = self.create_parameter(
+            [g * hidden_size], attr=ParamAttr._to_attr(bias_ih_attr),
+            is_bias=True, default_initializer=u)
+        self.bias_hh = self.create_parameter(
+            [g * hidden_size], attr=ParamAttr._to_attr(bias_hh_attr),
+            is_bias=True, default_initializer=u)
+
+    def get_initial_states(self, batch_ref, shape=None, dtype="float32",
+                           init_value=0.0, batch_dim_idx=0):
+        from ...ops import creation
+        b = batch_ref.shape[batch_dim_idx]
+        return creation.full([b, self.hidden_size], init_value, dtype)
+
+
+class SimpleRNNCell(_RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__(input_size, hidden_size, 1, weight_ih_attr,
+                         weight_hh_attr, bias_ih_attr, bias_hh_attr)
+        self.activation = activation
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        act = jnp.tanh if self.activation == "tanh" else jax.nn.relu
+
+        def fn(x, h, wih, whh, bih, bhh):
+            out = act(x @ wih.T + bih + h @ whh.T + bhh)
+            return out
+        h = apply_op("simple_rnn_cell", fn,
+                     [_t(inputs), _t(states), self.weight_ih, self.weight_hh,
+                      self.bias_ih, self.bias_hh])
+        return h, h
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+
+class LSTMCell(_RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__(input_size, hidden_size, 4, weight_ih_attr,
+                         weight_hh_attr, bias_ih_attr, bias_hh_attr)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            h = self.get_initial_states(inputs)
+            c = self.get_initial_states(inputs)
+        else:
+            h, c = states
+
+        def fn(x, h_, c_, wih, whh, bih, bhh):
+            gates = x @ wih.T + bih + h_ @ whh.T + bhh
+            i, f, g, o = jnp.split(gates, 4, axis=-1)
+            i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+            g = jnp.tanh(g)
+            new_c = f * c_ + i * g
+            new_h = o * jnp.tanh(new_c)
+            return new_h, new_c
+        new_h, new_c = apply_op(
+            "lstm_cell", fn,
+            [_t(inputs), _t(h), _t(c), self.weight_ih, self.weight_hh,
+             self.bias_ih, self.bias_hh])
+        return new_h, (new_h, new_c)
+
+    @property
+    def state_shape(self):
+        return ((self.hidden_size,), (self.hidden_size,))
+
+
+class GRUCell(_RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__(input_size, hidden_size, 3, weight_ih_attr,
+                         weight_hh_attr, bias_ih_attr, bias_hh_attr)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+
+        def fn(x, h, wih, whh, bih, bhh):
+            xg = x @ wih.T + bih
+            hg = h @ whh.T + bhh
+            xr, xz, xn = jnp.split(xg, 3, axis=-1)
+            hr, hz, hn = jnp.split(hg, 3, axis=-1)
+            r = jax.nn.sigmoid(xr + hr)
+            z = jax.nn.sigmoid(xz + hz)
+            n = jnp.tanh(xn + r * hn)
+            return (1 - z) * n + z * h
+        h = apply_op("gru_cell", fn,
+                     [_t(inputs), _t(states), self.weight_ih, self.weight_hh,
+                      self.bias_ih, self.bias_hh])
+        return h, h
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+
+class RNN(Layer):
+    """Wrap a cell into a (scan-compiled) recurrence over the time axis."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ...ops import manipulation as M
+        x = inputs if self.time_major else M.transpose(inputs, [1, 0, 2])
+        if self.is_reverse:
+            x = M.flip(x, [0])
+        steps = x.shape[0]
+        outs = []
+        states = initial_states
+        for t in range(steps):
+            out, states = self.cell(x[t], states)
+            outs.append(out)
+        from ...ops import manipulation
+        out_seq = manipulation.stack(outs, axis=0)
+        if self.is_reverse:
+            out_seq = M.flip(out_seq, [0])
+        if not self.time_major:
+            out_seq = M.transpose(out_seq, [1, 0, 2])
+        return out_seq, states
+
+
+class _RNNBase(Layer):
+    def __init__(self, mode, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 **cell_kwargs):
+        super().__init__()
+        from ..container import LayerList
+        self.mode = mode
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.dropout = dropout
+        self.bidirect = direction in ("bidirect", "bidirectional")
+        cell_cls = {"RNN_TANH": SimpleRNNCell, "LSTM": LSTMCell,
+                    "GRU": GRUCell}[mode]
+        self.fw_cells = LayerList()
+        self.bw_cells = LayerList() if self.bidirect else None
+        for layer_i in range(num_layers):
+            in_sz = input_size if layer_i == 0 else \
+                hidden_size * (2 if self.bidirect else 1)
+            self.fw_cells.append(cell_cls(in_sz, hidden_size, **cell_kwargs))
+            if self.bidirect:
+                self.bw_cells.append(cell_cls(in_sz, hidden_size, **cell_kwargs))
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from .. import functional as F
+        from ...ops import manipulation as M
+        if sequence_length is not None:
+            raise NotImplementedError(
+                "sequence_length masking is not implemented; pad-and-mask at "
+                "the loss instead (static shapes on TPU)")
+        x = inputs
+        final_states = []
+        for layer_i in range(self.num_layers):
+            init_f = init_b = None
+            if initial_states is not None:
+                layer_init = initial_states[layer_i]
+                init_f, init_b = (layer_init if self.bidirect
+                                  else (layer_init, None))
+            fw = RNN(self.fw_cells[layer_i], time_major=self.time_major)
+            out_f, st_f = fw(x, init_f)
+            if self.bidirect:
+                bw = RNN(self.bw_cells[layer_i], is_reverse=True,
+                         time_major=self.time_major)
+                out_b, st_b = bw(x, init_b)
+                x = M.concat([out_f, out_b], axis=-1)
+                final_states.append((st_f, st_b))
+            else:
+                x = out_f
+                final_states.append(st_f)
+            if self.dropout > 0 and layer_i < self.num_layers - 1:
+                x = F.dropout(x, self.dropout, training=self.training)
+        return x, final_states
+
+
+class SimpleRNN(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", **kwargs):
+        super().__init__("RNN_TANH", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout)
+
+
+class LSTM(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0, **kwargs):
+        super().__init__("LSTM", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout)
+
+
+class GRU(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0, **kwargs):
+        super().__init__("GRU", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout)

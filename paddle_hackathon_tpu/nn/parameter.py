@@ -1,0 +1,91 @@
+"""Parameter — a trainable Tensor.
+
+Equivalent of the reference's ``Parameter``/``EagerParamBase``
+(``python/paddle/fluid/framework.py``): a Tensor with ``stop_gradient=False``
+by default, a ``trainable`` switch and an attached initializer.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from ..core.dtype import convert_dtype, default_float_dtype
+from ..core.tensor import Tensor
+
+_param_counter = [0]
+
+
+class Parameter(Tensor):
+    __slots__ = ("optimize_attr", "regularizer", "do_model_average", "is_distributed")
+
+    def __init__(self, value, trainable: bool = True, name: Optional[str] = None):
+        if name is None:
+            name = f"param_{_param_counter[0]}"
+            _param_counter[0] += 1
+        super().__init__(value, stop_gradient=not trainable, name=name)
+        self.persistable = True
+        self.optimize_attr = {"learning_rate": 1.0}
+        self.regularizer = None
+        self.do_model_average = None
+        self.is_distributed = False
+
+    @property
+    def trainable(self) -> bool:
+        return not self.stop_gradient
+
+    @trainable.setter
+    def trainable(self, value: bool) -> None:
+        self.stop_gradient = not value
+
+    def __repr__(self):
+        return "Parameter containing:\n" + super().__repr__()
+
+
+def create_parameter(shape, dtype=None, name=None, attr=None,
+                     is_bias: bool = False, default_initializer=None) -> Parameter:
+    """paddle.create_parameter equivalent (ref ``fluid/layer_helper_base.py``)."""
+    from . import initializer as I
+
+    d = convert_dtype(dtype) or default_float_dtype()
+    init = default_initializer
+    if attr is not None and getattr(attr, "initializer", None) is not None:
+        init = attr.initializer
+    if init is None:
+        init = I.Constant(0.0) if is_bias else I.XavierUniform()
+    value = init(tuple(int(s) for s in shape), d)
+    trainable = True
+    if attr is not None and getattr(attr, "trainable", True) is False:
+        trainable = False
+    p = Parameter(value, trainable=trainable,
+                  name=getattr(attr, "name", None) or name)
+    if attr is not None and getattr(attr, "learning_rate", None) is not None:
+        p.optimize_attr["learning_rate"] = attr.learning_rate
+    return p
+
+
+class ParamAttr:
+    """paddle.ParamAttr equivalent (``python/paddle/fluid/param_attr.py``)."""
+
+    def __init__(self, name=None, initializer=None, learning_rate=1.0,
+                 regularizer=None, trainable=True, do_model_average=True,
+                 need_clip=True):
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+        self.do_model_average = do_model_average
+        self.need_clip = need_clip
+
+    @staticmethod
+    def _to_attr(attr):
+        if attr is None or isinstance(attr, ParamAttr):
+            return attr
+        if attr is False:
+            return False
+        if isinstance(attr, str):
+            return ParamAttr(name=attr)
+        # an initializer instance
+        return ParamAttr(initializer=attr)

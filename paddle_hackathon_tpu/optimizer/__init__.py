@@ -1,0 +1,6 @@
+"""paddle.optimizer equivalent (ref ``python/paddle/optimizer/``)."""
+
+from . import lr  # noqa: F401
+from .optimizer import L1Decay, L2Decay, Optimizer  # noqa: F401
+from .optimizers import (Adadelta, Adagrad, Adam, Adamax, AdamW, Lamb,  # noqa: F401
+                         Momentum, RMSProp, SGD)

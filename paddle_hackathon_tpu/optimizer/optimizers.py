@@ -1,0 +1,244 @@
+"""Concrete optimizers (ref ``python/paddle/optimizer/{sgd,momentum,adam,
+adamw,adagrad,rmsprop,adadelta,adamax,lamb}.py``; fused kernels ref
+``paddle/phi/kernels/gpu/adam_kernel.cu`` etc. — here every rule is fused by
+XLA across the whole parameter tree, see optimizer.py).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .optimizer import Optimizer
+
+
+class SGD(Optimizer):
+    def _apply_one(self, v, g, s, lr, step_t):
+        return v - lr * g, s
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+        self._momentum = momentum
+        self._nesterov = use_nesterov
+
+    def _init_accumulators(self, p):
+        return {"velocity": jnp.zeros(p._value.shape, jnp.float32)}
+
+    def _apply_one(self, v, g, s, lr, step_t):
+        vel = self._momentum * s["velocity"] + g
+        if self._nesterov:
+            new_v = v - lr * (g + self._momentum * vel)
+        else:
+            new_v = v - lr * vel
+        return new_v, {"velocity": vel}
+
+
+class Adam(Optimizer):
+    """Adam (ref ``optimizer/adam.py:317`` → fused ``final_state_adam_``)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=False,
+                 use_multi_tensor=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._eps = epsilon
+
+    def _init_accumulators(self, p):
+        return {"moment1": jnp.zeros(p._value.shape, jnp.float32),
+                "moment2": jnp.zeros(p._value.shape, jnp.float32)}
+
+    def _apply_one(self, v, g, s, lr, step_t):
+        g32 = g.astype(jnp.float32)
+        m = self._beta1 * s["moment1"] + (1 - self._beta1) * g32
+        u = self._beta2 * s["moment2"] + (1 - self._beta2) * jnp.square(g32)
+        t = step_t.astype(jnp.float32)
+        mhat = m / (1 - self._beta1 ** t)
+        uhat = u / (1 - self._beta2 ** t)
+        new_v = v.astype(jnp.float32) - lr * mhat / (jnp.sqrt(uhat) + self._eps)
+        return new_v, {"moment1": m, "moment2": u}
+
+
+class AdamW(Adam):
+    """AdamW with decoupled weight decay (ref ``optimizer/adamw.py``)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=0.01,
+                 lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
+                 lazy_mode=False, multi_precision=False, name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         None, grad_clip, lazy_mode, multi_precision,
+                         name=name)
+        self._wd_coeff = float(weight_decay) if not hasattr(
+            weight_decay, "coeff") else weight_decay.coeff
+        self._apply_decay_param_fun = apply_decay_param_fun
+        self._decay_mask = None
+
+    def _decoupled_weight_decay(self):
+        return True
+
+    def step(self):
+        if self._apply_decay_param_fun is not None and self._decay_mask is None:
+            self._decay_mask = {
+                id(p): bool(self._apply_decay_param_fun(p.name))
+                for p in self._parameter_list}
+        super().step()
+
+    def _apply_one(self, v, g, s, lr, step_t):
+        new_v, ns = super()._apply_one(v, g, s, lr, step_t)
+        decay = self._wd_coeff
+        new_v = new_v - lr * decay * v.astype(jnp.float32)
+        return new_v, ns
+
+    def _update_all(self, vals, grads, states, lr, step_t, param_lrs):
+        if self._decay_mask is not None:
+            # parameters excluded from decay (e.g. biases/LN) use plain Adam
+            params = [p for p in self._parameter_list
+                      if p.trainable and p._grad_value is not None]
+            new_vals, new_states = [], []
+            if self._grad_clip is not None:
+                grads = self._grad_clip._clip(grads)
+            for p, v, g, s, plr in zip(params, vals, grads, states, param_lrs):
+                g32 = g.astype(jnp.float32)
+                nv, ns = Adam._apply_one(self, v, g32, s, lr * plr, step_t)
+                if self._decay_mask.get(id(p), True):
+                    nv = nv - lr * plr * self._wd_coeff * v.astype(jnp.float32)
+                new_vals.append(nv.astype(v.dtype))
+                new_states.append(ns)
+            return new_vals, new_states
+        return super()._update_all(vals, grads, states, lr, step_t, param_lrs)
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None,
+                 initial_accumulator_value=0.0, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name=name)
+        self._eps = epsilon
+        self._init_val = initial_accumulator_value
+
+    def _init_accumulators(self, p):
+        return {"moment": jnp.full(p._value.shape, self._init_val, jnp.float32)}
+
+    def _apply_one(self, v, g, s, lr, step_t):
+        g32 = g.astype(jnp.float32)
+        mom = s["moment"] + jnp.square(g32)
+        new_v = v.astype(jnp.float32) - lr * g32 / (jnp.sqrt(mom) + self._eps)
+        return new_v, {"moment": mom}
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name=name)
+        self._rho = rho
+        self._eps = epsilon
+        self._momentum = momentum
+        self._centered = centered
+
+    def _init_accumulators(self, p):
+        s = {"mean_square": jnp.zeros(p._value.shape, jnp.float32),
+             "momentum": jnp.zeros(p._value.shape, jnp.float32)}
+        if self._centered:
+            s["mean_grad"] = jnp.zeros(p._value.shape, jnp.float32)
+        return s
+
+    def _apply_one(self, v, g, s, lr, step_t):
+        g32 = g.astype(jnp.float32)
+        ms = self._rho * s["mean_square"] + (1 - self._rho) * jnp.square(g32)
+        out = dict(s, mean_square=ms)
+        denom = ms
+        if self._centered:
+            mg = self._rho * s["mean_grad"] + (1 - self._rho) * g32
+            out["mean_grad"] = mg
+            denom = ms - jnp.square(mg)
+        mom = self._momentum * s["momentum"] + lr * g32 / jnp.sqrt(
+            denom + self._eps)
+        out["momentum"] = mom
+        return v.astype(jnp.float32) - mom, out
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name=name)
+        self._rho = rho
+        self._eps = epsilon
+
+    def _init_accumulators(self, p):
+        return {"avg_squared_grad": jnp.zeros(p._value.shape, jnp.float32),
+                "avg_squared_update": jnp.zeros(p._value.shape, jnp.float32)}
+
+    def _apply_one(self, v, g, s, lr, step_t):
+        g32 = g.astype(jnp.float32)
+        asg = self._rho * s["avg_squared_grad"] + (1 - self._rho) * jnp.square(g32)
+        update = (jnp.sqrt(s["avg_squared_update"] + self._eps) /
+                  jnp.sqrt(asg + self._eps)) * g32
+        asu = self._rho * s["avg_squared_update"] + (1 - self._rho) * jnp.square(update)
+        return v.astype(jnp.float32) - lr * update, {
+            "avg_squared_grad": asg, "avg_squared_update": asu}
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name=name)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+
+    def _init_accumulators(self, p):
+        return {"moment": jnp.zeros(p._value.shape, jnp.float32),
+                "inf_norm": jnp.zeros(p._value.shape, jnp.float32)}
+
+    def _apply_one(self, v, g, s, lr, step_t):
+        g32 = g.astype(jnp.float32)
+        m = self._beta1 * s["moment"] + (1 - self._beta1) * g32
+        inf = jnp.maximum(self._beta2 * s["inf_norm"], jnp.abs(g32))
+        t = step_t.astype(jnp.float32)
+        new_v = v.astype(jnp.float32) - (lr / (1 - self._beta1 ** t)) * m / (
+            inf + self._eps)
+        return new_v, {"moment": m, "inf_norm": inf}
+
+
+class Lamb(Optimizer):
+    """LAMB (ref ``optimizer/lamb.py``; fused-sharded variant
+    ``incubate/optimizer/distributed_fused_lamb.py:86``)."""
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+                 beta2=0.999, epsilon=1e-6, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay_fn=None, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip,
+                         multi_precision, name)
+        self._wd = lamb_weight_decay
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _init_accumulators(self, p):
+        return {"moment1": jnp.zeros(p._value.shape, jnp.float32),
+                "moment2": jnp.zeros(p._value.shape, jnp.float32)}
+
+    def _apply_one(self, v, g, s, lr, step_t):
+        g32 = g.astype(jnp.float32)
+        v32 = v.astype(jnp.float32)
+        m = self._beta1 * s["moment1"] + (1 - self._beta1) * g32
+        u = self._beta2 * s["moment2"] + (1 - self._beta2) * jnp.square(g32)
+        t = step_t.astype(jnp.float32)
+        mhat = m / (1 - self._beta1 ** t)
+        uhat = u / (1 - self._beta2 ** t)
+        r = mhat / (jnp.sqrt(uhat) + self._eps) + self._wd * v32
+        w_norm = jnp.sqrt(jnp.sum(jnp.square(v32)))
+        r_norm = jnp.sqrt(jnp.sum(jnp.square(r)))
+        trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+        return v32 - lr * trust * r, {"moment1": m, "moment2": u}

@@ -157,3 +157,11 @@ def test_nan_check_flag():
             paddle.log(x)
     finally:
         paddle.set_flags({"check_nan_inf": False})
+
+
+def test_grad_on_intermediate_tensor():
+    x = paddle.to_tensor([3.0], stop_gradient=False)
+    y = x * 2
+    z = (y * y).sum()
+    (gy,) = paddle.grad(z, [y])
+    np.testing.assert_allclose(gy.numpy(), [12.0])  # dz/dy = 2y = 12

@@ -1,0 +1,204 @@
+"""DataLoader / save-load / AMP tests (ref ``test_dataloader_*``,
+``test_imperative_auto_mixed_precision.py``)."""
+
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import paddle_hackathon_tpu as paddle
+from paddle_hackathon_tpu import amp, io, nn, optimizer as optim
+
+
+class _SquareDataset(io.Dataset):
+    def __init__(self, n=20):
+        self.n = n
+
+    def __getitem__(self, i):
+        return np.float32([i]), np.float32([i * i])
+
+    def __len__(self):
+        return self.n
+
+
+def test_dataloader_batching():
+    loader = io.DataLoader(_SquareDataset(), batch_size=4)
+    batches = list(loader)
+    assert len(batches) == 5
+    x, y = batches[0]
+    assert x.shape == [4, 1]
+    np.testing.assert_allclose(x.numpy().ravel(), [0, 1, 2, 3])
+
+
+def test_dataloader_drop_last_and_shuffle():
+    loader = io.DataLoader(_SquareDataset(10), batch_size=3, drop_last=True)
+    assert len(loader) == 3
+    loader = io.DataLoader(_SquareDataset(10), batch_size=3, shuffle=True)
+    seen = np.concatenate([b[0].numpy().ravel() for b in loader])
+    assert sorted(seen.tolist()) == list(range(10))
+
+
+def test_dataloader_multiworker_order_and_values():
+    loader = io.DataLoader(_SquareDataset(37), batch_size=5, num_workers=3)
+    xs = np.concatenate([x.numpy().ravel() for x, _ in loader])
+    np.testing.assert_allclose(xs, np.arange(37))
+
+
+def test_dataloader_worker_error_propagates():
+    class Bad(io.Dataset):
+        def __len__(self):
+            return 4
+
+        def __getitem__(self, i):
+            raise RuntimeError("boom")
+
+    loader = io.DataLoader(Bad(), batch_size=2, num_workers=1)
+    with pytest.raises(RuntimeError, match="boom"):
+        list(loader)
+
+
+def test_tensor_dataset_and_split():
+    xs = paddle.randn([10, 3])
+    ys = paddle.randn([10])
+    ds = io.TensorDataset([xs, ys])
+    assert len(ds) == 10
+    a, b = io.random_split(ds, [7, 3])
+    assert len(a) == 7 and len(b) == 3
+
+
+def test_batch_sampler_len():
+    ds = _SquareDataset(10)
+    bs = io.BatchSampler(ds, batch_size=4, drop_last=False)
+    assert len(bs) == 3
+    assert sum(len(b) for b in bs) == 10
+
+
+def test_distributed_batch_sampler_partition():
+    ds = _SquareDataset(10)
+    all_idx = []
+    for rank in range(2):
+        s = io.DistributedBatchSampler(ds, batch_size=2, num_replicas=2,
+                                       rank=rank)
+        for b in s:
+            all_idx.extend(b)
+    assert sorted(all_idx) == list(range(10))
+
+
+def test_save_load_state_dict():
+    model = nn.Sequential(nn.Linear(3, 4), nn.Linear(4, 2))
+    opt = optim.Adam(learning_rate=0.1, parameters=model.parameters())
+    x = paddle.randn([2, 3])
+    model(x).sum().backward()
+    opt.step()
+    with tempfile.TemporaryDirectory() as d:
+        paddle.save(model.state_dict(), os.path.join(d, "model.pdparams"))
+        paddle.save(opt.state_dict(), os.path.join(d, "opt.pdopt"))
+        sd = paddle.load(os.path.join(d, "model.pdparams"))
+        od = paddle.load(os.path.join(d, "opt.pdopt"))
+    model2 = nn.Sequential(nn.Linear(3, 4), nn.Linear(4, 2))
+    model2.set_state_dict(sd)
+    np.testing.assert_allclose(model(x).numpy(), model2(x).numpy(), atol=1e-6)
+    opt2 = optim.Adam(learning_rate=0.1, parameters=model2.parameters())
+    opt2.set_state_dict(od)
+    assert opt2._step_count == 1
+
+
+def test_save_load_nested():
+    obj = {"a": paddle.to_tensor([1.0, 2.0]), "b": [paddle.to_tensor(3),
+                                                    {"c": 4}], "d": "text"}
+    with tempfile.TemporaryDirectory() as dd:
+        p = os.path.join(dd, "obj.pd")
+        paddle.save(obj, p)
+        back = paddle.load(p)
+    np.testing.assert_allclose(back["a"].numpy(), [1, 2])
+    assert back["b"][1]["c"] == 4
+    assert back["d"] == "text"
+
+
+def test_load_rejects_foreign_file():
+    with tempfile.TemporaryDirectory() as d:
+        p = os.path.join(d, "x.zip")
+        import zipfile
+        with zipfile.ZipFile(p, "w") as zf:
+            zf.writestr("MAGIC", "other")
+        with pytest.raises(ValueError):
+            paddle.load(p)
+
+
+def test_auto_cast_white_black():
+    x = paddle.randn([4, 4])
+    w = paddle.randn([4, 4])
+    with amp.auto_cast(level="O1"):
+        y = paddle.matmul(x, w)
+        assert y.dtype == paddle.bfloat16
+        z = paddle.nn.functional.softmax(y)
+        assert z.dtype == paddle.float32  # blacklisted op upcasts
+    y2 = paddle.matmul(x, w)
+    assert y2.dtype == paddle.float32
+
+
+def test_auto_cast_custom_lists():
+    x = paddle.randn([4, 4])
+    with amp.auto_cast(custom_black_list={"matmul"}):
+        y = paddle.matmul(x, x)
+        assert y.dtype == paddle.float32
+
+
+def test_grad_scaler_skips_on_inf():
+    w = paddle.create_parameter([2])
+    w.set_value(np.array([1.0, 1.0], "float32"))
+    opt = optim.SGD(learning_rate=1.0, parameters=[w])
+    scaler = amp.GradScaler(init_loss_scaling=4.0, enable=True)
+    w._grad_value = paddle.to_tensor([np.inf, 1.0])._value
+    scaler.step(opt)
+    np.testing.assert_allclose(w.numpy(), [1.0, 1.0])  # skipped
+    assert scaler.get_loss_scaling() == 4.0  # decr after decr_every=2 bad steps
+    w._grad_value = paddle.to_tensor([np.inf, 1.0])._value
+    scaler.step(opt)
+    assert scaler.get_loss_scaling() == 2.0
+
+
+def test_grad_scaler_training_loop():
+    model = nn.Linear(4, 1)
+    opt = optim.SGD(learning_rate=0.05, parameters=model.parameters())
+    scaler = amp.GradScaler(init_loss_scaling=1024.0)
+    x = paddle.randn([16, 4])
+    y = paddle.randn([16, 1])
+    losses = []
+    for _ in range(20):
+        with amp.auto_cast():
+            loss = ((model(x) - y) ** 2).mean()
+        scaler.scale(loss).backward()
+        scaler.step(opt)
+        opt.clear_grad()
+        losses.append(loss.item())
+    assert losses[-1] < losses[0]
+
+
+def test_metrics():
+    from paddle_hackathon_tpu import metric
+    acc = metric.Accuracy()
+    pred = paddle.to_tensor([[0.1, 0.9], [0.8, 0.2], [0.3, 0.7]])
+    label = paddle.to_tensor([[1], [0], [0]])
+    correct = acc.compute(pred, label)
+    acc.update(correct)
+    assert acc.accumulate() == pytest.approx(2 / 3)
+
+    p = metric.Precision()
+    p.update(np.array([0.9, 0.9, 0.1]), np.array([1, 0, 1]))
+    assert p.accumulate() == pytest.approx(0.5)
+
+    a = metric.accuracy(pred, paddle.to_tensor([1, 0, 0]))
+    assert float(a.numpy()) == pytest.approx(2 / 3)
+
+
+def test_grad_scaler_no_double_unscale():
+    w = paddle.create_parameter([1])
+    w.set_value(np.array([0.0], "float32"))
+    opt = optim.SGD(learning_rate=1.0, parameters=[w])
+    scaler = amp.GradScaler(init_loss_scaling=4.0)
+    w._grad_value = paddle.to_tensor([8.0])._value
+    scaler.unscale_(opt)  # user unscales to clip manually
+    scaler.step(opt)      # must not unscale again
+    np.testing.assert_allclose(w.numpy(), [-2.0])  # 8/4 = 2, once

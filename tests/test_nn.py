@@ -1,0 +1,383 @@
+"""nn.Layer system + functional correctness.
+
+Numeric parity checks use torch CPU as the reference implementation — the
+same role NumPy plays in the reference's OpTest (``op_test.py:309``).
+"""
+
+import numpy as np
+import pytest
+
+import paddle_hackathon_tpu as paddle
+from paddle_hackathon_tpu import nn
+from paddle_hackathon_tpu.nn import functional as F
+
+
+def test_layer_registration():
+    class M(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(4, 3)
+            self.w = paddle.create_parameter([2, 2])
+            self.register_buffer("buf", paddle.to_tensor([1.0]))
+
+        def forward(self, x):
+            return self.fc(x)
+
+    m = M()
+    names = dict(m.named_parameters())
+    assert "fc.weight" in names and "fc.bias" in names and "w" in names
+    assert len(m.parameters()) == 3
+    sd = m.state_dict()
+    assert "buf" in sd
+    assert isinstance(m.fc, nn.Linear)
+
+
+def test_train_eval_mode():
+    m = nn.Sequential(nn.Linear(4, 4), nn.Dropout(0.5))
+    assert m.training
+    m.eval()
+    assert not m[1].training
+    x = paddle.randn([8, 4])
+    np.testing.assert_allclose(m(x).numpy(), m(x).numpy())  # deterministic
+    m.train()
+    assert m[1].training
+
+
+def test_forward_hooks():
+    m = nn.Linear(2, 2)
+    calls = []
+    h1 = m.register_forward_pre_hook(lambda layer, inp: calls.append("pre"))
+    h2 = m.register_forward_post_hook(
+        lambda layer, inp, out: calls.append("post"))
+    m(paddle.randn([1, 2]))
+    assert calls == ["pre", "post"]
+    h1.remove()
+    h2.remove()
+    m(paddle.randn([1, 2]))
+    assert calls == ["pre", "post"]
+
+
+def test_state_dict_roundtrip():
+    m1 = nn.Sequential(nn.Linear(3, 4), nn.ReLU(), nn.Linear(4, 2))
+    m2 = nn.Sequential(nn.Linear(3, 4), nn.ReLU(), nn.Linear(4, 2))
+    missing, unexpected = m2.set_state_dict(m1.state_dict())
+    assert not missing and not unexpected
+    x = paddle.randn([5, 3])
+    np.testing.assert_allclose(m1(x).numpy(), m2(x).numpy(), atol=1e-6)
+
+
+def test_containers():
+    ll = nn.LayerList([nn.Linear(2, 2) for _ in range(3)])
+    assert len(ll) == 3
+    ll.append(nn.Linear(2, 2))
+    assert len(ll) == 4
+    assert len(list(ll[1:3])) == 2
+    pl = nn.ParameterList([paddle.create_parameter([2])])
+    assert len(list(pl)) == 1
+    ld = nn.LayerDict({"a": nn.Linear(2, 2)})
+    assert "a" in ld
+    seq = nn.Sequential(("fc1", nn.Linear(2, 3)), ("fc2", nn.Linear(3, 1)))
+    assert seq(paddle.randn([1, 2])).shape == [1, 1]
+
+
+def test_linear_matches_torch():
+    import torch
+    x = np.random.randn(4, 8).astype("float32")
+    w = np.random.randn(8, 5).astype("float32")
+    b = np.random.randn(5).astype("float32")
+    ours = F.linear(paddle.to_tensor(x), paddle.to_tensor(w),
+                    paddle.to_tensor(b)).numpy()
+    theirs = torch.nn.functional.linear(
+        torch.tensor(x), torch.tensor(w.T), torch.tensor(b)).numpy()
+    np.testing.assert_allclose(ours, theirs, atol=1e-5)
+
+
+@pytest.mark.parametrize("stride,padding,dilation,groups", [
+    (1, 0, 1, 1), (2, 1, 1, 1), (1, 2, 2, 1), (1, 1, 1, 2)])
+def test_conv2d_matches_torch(stride, padding, dilation, groups):
+    import torch
+    x = np.random.randn(2, 4, 9, 9).astype("float32")
+    w = np.random.randn(6, 4 // groups, 3, 3).astype("float32")
+    b = np.random.randn(6).astype("float32")
+    ours = F.conv2d(paddle.to_tensor(x), paddle.to_tensor(w),
+                    paddle.to_tensor(b), stride=stride, padding=padding,
+                    dilation=dilation, groups=groups).numpy()
+    theirs = torch.nn.functional.conv2d(
+        torch.tensor(x), torch.tensor(w), torch.tensor(b), stride=stride,
+        padding=padding, dilation=dilation, groups=groups).numpy()
+    np.testing.assert_allclose(ours, theirs, atol=1e-4)
+
+
+def test_conv2d_transpose_matches_torch():
+    import torch
+    x = np.random.randn(2, 4, 7, 7).astype("float32")
+    w = np.random.randn(4, 5, 3, 3).astype("float32")
+    ours = F.conv2d_transpose(paddle.to_tensor(x), paddle.to_tensor(w),
+                              stride=2, padding=1).numpy()
+    theirs = torch.nn.functional.conv_transpose2d(
+        torch.tensor(x), torch.tensor(w), stride=2, padding=1).numpy()
+    np.testing.assert_allclose(ours, theirs, atol=1e-4)
+
+
+def test_conv1d_3d_smoke():
+    assert F.conv1d(paddle.randn([2, 3, 16]),
+                    paddle.randn([5, 3, 3]), padding=1).shape == [2, 5, 16]
+    assert F.conv3d(paddle.randn([1, 2, 5, 5, 5]),
+                    paddle.randn([4, 2, 3, 3, 3]), padding=1).shape == \
+        [1, 4, 5, 5, 5]
+
+
+def test_pools_match_torch():
+    import torch
+    x = np.random.randn(2, 3, 8, 8).astype("float32")
+    ours = F.max_pool2d(paddle.to_tensor(x), 2, 2).numpy()
+    theirs = torch.nn.functional.max_pool2d(torch.tensor(x), 2, 2).numpy()
+    np.testing.assert_allclose(ours, theirs)
+    ours = F.avg_pool2d(paddle.to_tensor(x), 3, 2, 1).numpy()
+    theirs = torch.nn.functional.avg_pool2d(
+        torch.tensor(x), 3, 2, 1, count_include_pad=False).numpy()
+    np.testing.assert_allclose(ours, theirs, atol=1e-6)
+    ours = F.adaptive_avg_pool2d(paddle.to_tensor(x), 4).numpy()
+    theirs = torch.nn.functional.adaptive_avg_pool2d(
+        torch.tensor(x), 4).numpy()
+    np.testing.assert_allclose(ours, theirs, atol=1e-6)
+    ours = F.adaptive_avg_pool2d(paddle.to_tensor(x), 3).numpy()
+    theirs = torch.nn.functional.adaptive_avg_pool2d(
+        torch.tensor(x), 3).numpy()
+    np.testing.assert_allclose(ours, theirs, atol=1e-6)
+
+
+def test_batch_norm_train_eval():
+    import torch
+    x = np.random.randn(8, 3, 4, 4).astype("float32")
+    bn = nn.BatchNorm2D(3)
+    tbn = torch.nn.BatchNorm2d(3, momentum=0.1)
+    out = bn(paddle.to_tensor(x))
+    tout = tbn(torch.tensor(x))
+    np.testing.assert_allclose(out.numpy(), tout.detach().numpy(), atol=1e-4)
+    # running stats updated (paddle momentum 0.9 == torch 0.1 complement)
+    np.testing.assert_allclose(bn._mean.numpy(),
+                               tbn.running_mean.numpy(), atol=1e-4)
+    np.testing.assert_allclose(bn._variance.numpy(),
+                               tbn.running_var.numpy(), atol=1e-4)
+    bn.eval()
+    tbn.eval()
+    out = bn(paddle.to_tensor(x))
+    tout = tbn(torch.tensor(x))
+    np.testing.assert_allclose(out.numpy(), tout.detach().numpy(), atol=1e-4)
+
+
+def test_layer_norm_matches_torch():
+    import torch
+    x = np.random.randn(4, 6, 10).astype("float32")
+    ln = nn.LayerNorm(10)
+    tln = torch.nn.LayerNorm(10)
+    out = ln(paddle.to_tensor(x)).numpy()
+    tout = tln(torch.tensor(x)).detach().numpy()
+    np.testing.assert_allclose(out, tout, atol=1e-5)
+
+
+def test_group_norm_matches_torch():
+    import torch
+    x = np.random.randn(2, 6, 5, 5).astype("float32")
+    out = F.group_norm(paddle.to_tensor(x), 3).numpy()
+    tout = torch.nn.functional.group_norm(torch.tensor(x), 3).numpy()
+    np.testing.assert_allclose(out, tout, atol=1e-5)
+
+
+def test_embedding_padding_idx():
+    emb = nn.Embedding(10, 4, padding_idx=0)
+    out = emb(paddle.to_tensor([[0, 3], [5, 0]]))
+    assert out.shape == [2, 2, 4]
+    assert np.allclose(out.numpy()[0, 0], 0)
+    assert np.allclose(out.numpy()[1, 1], 0)
+    assert not np.allclose(out.numpy()[0, 1], 0)
+
+
+def test_cross_entropy_matches_torch():
+    import torch
+    logits = np.random.randn(8, 5).astype("float32")
+    labels = np.random.randint(0, 5, (8,))
+    ours = F.cross_entropy(paddle.to_tensor(logits),
+                           paddle.to_tensor(labels)).numpy()
+    theirs = torch.nn.functional.cross_entropy(
+        torch.tensor(logits), torch.tensor(labels)).numpy()
+    np.testing.assert_allclose(ours, theirs, atol=1e-5)
+    # ignore_index + weight
+    labels[0] = 3
+    w = np.random.rand(5).astype("float32") + 0.5
+    ours = F.cross_entropy(paddle.to_tensor(logits), paddle.to_tensor(labels),
+                           weight=paddle.to_tensor(w), ignore_index=3).numpy()
+    theirs = torch.nn.functional.cross_entropy(
+        torch.tensor(logits), torch.tensor(labels), weight=torch.tensor(w),
+        ignore_index=3).numpy()
+    np.testing.assert_allclose(ours, theirs, atol=1e-5)
+
+
+def test_losses_match_torch():
+    import torch
+    a = np.random.randn(6, 4).astype("float32")
+    b = np.random.randn(6, 4).astype("float32")
+    pairs = [
+        (F.mse_loss, torch.nn.functional.mse_loss),
+        (F.l1_loss, torch.nn.functional.l1_loss),
+        (F.smooth_l1_loss, torch.nn.functional.smooth_l1_loss),
+    ]
+    for ours_fn, theirs_fn in pairs:
+        ours = ours_fn(paddle.to_tensor(a), paddle.to_tensor(b)).numpy()
+        theirs = theirs_fn(torch.tensor(a), torch.tensor(b)).numpy()
+        np.testing.assert_allclose(ours, theirs, atol=1e-5,
+                                   err_msg=str(ours_fn))
+    logit = np.random.randn(6).astype("float32")
+    y = (np.random.rand(6) > 0.5).astype("float32")
+    ours = F.binary_cross_entropy_with_logits(
+        paddle.to_tensor(logit), paddle.to_tensor(y)).numpy()
+    theirs = torch.nn.functional.binary_cross_entropy_with_logits(
+        torch.tensor(logit), torch.tensor(y)).numpy()
+    np.testing.assert_allclose(ours, theirs, atol=1e-5)
+
+
+def test_activations_match_torch():
+    import torch
+    x = np.random.randn(4, 7).astype("float32")
+    tx = torch.tensor(x)
+    pairs = [
+        (F.relu, torch.nn.functional.relu),
+        (F.gelu, lambda v: torch.nn.functional.gelu(v)),
+        (F.silu, torch.nn.functional.silu),
+        (F.sigmoid, torch.sigmoid),
+        (F.softplus, torch.nn.functional.softplus),
+        (F.leaky_relu, torch.nn.functional.leaky_relu),
+        (F.elu, torch.nn.functional.elu),
+        (F.hardswish, torch.nn.functional.hardswish),
+        (F.log_sigmoid, torch.nn.functional.logsigmoid),
+        (F.softsign, torch.nn.functional.softsign),
+        (F.mish, torch.nn.functional.mish),
+    ]
+    for ours_fn, theirs_fn in pairs:
+        np.testing.assert_allclose(
+            ours_fn(paddle.to_tensor(x)).numpy(), theirs_fn(tx).numpy(),
+            atol=2e-5, err_msg=str(ours_fn))
+    np.testing.assert_allclose(
+        F.softmax(paddle.to_tensor(x)).numpy(),
+        torch.softmax(tx, -1).numpy(), atol=1e-6)
+
+
+def test_sdpa_matches_reference():
+    import torch
+    q = np.random.randn(2, 6, 4, 8).astype("float32")  # bshd
+    k = np.random.randn(2, 6, 4, 8).astype("float32")
+    v = np.random.randn(2, 6, 4, 8).astype("float32")
+    out = F.scaled_dot_product_attention(
+        paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v),
+        is_causal=True).numpy()
+    tout = torch.nn.functional.scaled_dot_product_attention(
+        torch.tensor(q).permute(0, 2, 1, 3), torch.tensor(k).permute(0, 2, 1, 3),
+        torch.tensor(v).permute(0, 2, 1, 3), is_causal=True
+    ).permute(0, 2, 1, 3).numpy()
+    np.testing.assert_allclose(out, tout, atol=1e-4)
+
+
+def test_mha_self_attention():
+    mha = nn.MultiHeadAttention(32, 4, dropout=0.0)
+    x = paddle.randn([2, 10, 32])
+    out = mha(x)
+    assert out.shape == [2, 10, 32]
+    # cache path
+    cache = mha.gen_cache(x)
+    out1, cache = mha(x[:, 0:1], x[:, 0:1], x[:, 0:1], cache=cache)
+    assert out1.shape == [2, 1, 32]
+    assert cache.k.shape[1] == 1
+
+
+def test_transformer_full():
+    model = nn.Transformer(d_model=32, nhead=4, num_encoder_layers=2,
+                           num_decoder_layers=2, dim_feedforward=64,
+                           dropout=0.0)
+    src = paddle.randn([2, 8, 32])
+    tgt = paddle.randn([2, 6, 32])
+    out = model(src, tgt)
+    assert out.shape == [2, 6, 32]
+    mask = nn.Transformer.generate_square_subsequent_mask(6)
+    assert mask.shape == [6, 6]
+
+
+def test_dropout_statistics():
+    x = paddle.ones([1000])
+    out = F.dropout(x, 0.5, training=True)
+    kept = (out.numpy() != 0).mean()
+    assert 0.4 < kept < 0.6
+    np.testing.assert_allclose(out.numpy()[out.numpy() != 0], 2.0)
+    out_eval = F.dropout(x, 0.5, training=False)
+    np.testing.assert_allclose(out_eval.numpy(), x.numpy())
+
+
+def test_interpolate():
+    x = paddle.randn([1, 3, 8, 8])
+    assert F.interpolate(x, size=[16, 16], mode="nearest").shape == [1, 3, 16, 16]
+    assert F.interpolate(x, scale_factor=0.5, mode="bilinear").shape == [1, 3, 4, 4]
+
+
+def test_grad_flows_through_layers():
+    model = nn.Sequential(nn.Linear(4, 8), nn.GELU(), nn.LayerNorm(8),
+                          nn.Linear(8, 1))
+    x = paddle.randn([16, 4])
+    loss = model(x).mean()
+    loss.backward()
+    for name, p in model.named_parameters():
+        assert p.grad is not None, name
+        assert p.grad.shape == p.shape
+
+
+def test_relu_inplace_grad():
+    x = paddle.to_tensor([[-1.0, 2.0]], stop_gradient=False)
+    h = x * 3
+    F.relu_(h)
+    h.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [[0.0, 3.0]])
+
+
+def test_avg_pool_ceil_mode_matches_torch():
+    import torch
+    x = np.random.randn(1, 2, 7, 7).astype("float32")
+    ours = F.avg_pool2d(paddle.to_tensor(x), 3, 2, 0, ceil_mode=True).numpy()
+    theirs = torch.nn.functional.avg_pool2d(
+        torch.tensor(x), 3, 2, 0, ceil_mode=True).numpy()
+    np.testing.assert_allclose(ours, theirs, atol=1e-6)
+    ours = F.max_pool2d(paddle.to_tensor(x), 3, 2, 0, ceil_mode=True).numpy()
+    theirs = torch.nn.functional.max_pool2d(
+        torch.tensor(x), 3, 2, 0, ceil_mode=True).numpy()
+    np.testing.assert_allclose(ours, theirs)
+
+
+def test_sdpa_dropout_active_in_training():
+    q = paddle.randn([1, 8, 2, 4])
+    out1 = F.scaled_dot_product_attention(q, q, q, dropout_p=0.5,
+                                          training=True)
+    out2 = F.scaled_dot_product_attention(q, q, q, dropout_p=0.5,
+                                          training=False)
+    assert not np.allclose(out1.numpy(), out2.numpy())
+
+
+def test_lstm_initial_states_respected():
+    lstm = nn.LSTM(4, 8)
+    x = paddle.randn([2, 5, 4])
+    h0 = paddle.ones([2, 8])
+    c0 = paddle.ones([2, 8])
+    out_zero, _ = lstm(x)
+    out_init, _ = lstm(x, initial_states=[(h0, c0)])
+    assert not np.allclose(out_zero.numpy(), out_init.numpy())
+
+
+def test_label_smooth_prior_dist():
+    label = paddle.to_tensor([[1.0, 0.0]])
+    prior = paddle.to_tensor([[0.2, 0.8]])
+    out = F.label_smooth(label, prior_dist=prior, epsilon=0.1)
+    np.testing.assert_allclose(out.numpy(), [[0.92, 0.08]], atol=1e-6)
+
+
+def test_grid_sample_nearest_shape():
+    x = paddle.randn([2, 3, 4, 4])
+    grid = paddle.zeros([2, 5, 6, 2])
+    out = F.grid_sample(x, grid, mode="nearest")
+    assert out.shape == [2, 3, 5, 6]

@@ -1,0 +1,198 @@
+"""Optimizer + LR scheduler + grad-clip tests (ref
+``test_adam_op.py`` / ``test_sgd_op.py`` family)."""
+
+import numpy as np
+import pytest
+
+import paddle_hackathon_tpu as paddle
+from paddle_hackathon_tpu import nn, optimizer as optim
+
+
+def _quadratic_steps(opt_cls, n=60, steps=None, **kwargs):
+    n = steps or n
+    w = paddle.create_parameter([4], default_initializer=None)
+    w.set_value(np.array([5.0, -3.0, 2.0, 4.0], "float32"))
+    opt = opt_cls(parameters=[w], **kwargs)
+    for _ in range(n):
+        loss = (w * w).sum()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    return w, opt
+
+
+@pytest.mark.parametrize("opt_cls,kwargs", [
+    (optim.SGD, {"learning_rate": 0.1}),
+    (optim.Momentum, {"learning_rate": 0.05}),
+    (optim.Adam, {"learning_rate": 0.3}),
+    (optim.AdamW, {"learning_rate": 0.3}),
+    (optim.Adagrad, {"learning_rate": 1.0}),
+    (optim.RMSProp, {"learning_rate": 0.1}),
+    (optim.Adamax, {"learning_rate": 0.5}),
+    (optim.Adadelta, {"learning_rate": 5.0, "steps": 800}),
+    (optim.Lamb, {"learning_rate": 0.1}),
+])
+def test_optimizers_minimize_quadratic(opt_cls, kwargs):
+    w, _ = _quadratic_steps(opt_cls, **kwargs)
+    assert float(np.abs(w.numpy()).max()) < 0.5, w.numpy()
+
+
+def test_adam_matches_torch():
+    import torch
+    w0 = np.random.randn(6).astype("float32")
+    grads = [np.random.randn(6).astype("float32") for _ in range(5)]
+
+    w = paddle.create_parameter([6])
+    w.set_value(w0)
+    opt = optim.Adam(learning_rate=0.01, parameters=[w])
+    for g in grads:
+        w._grad_value = paddle.to_tensor(g)._value
+        opt.step()
+        opt.clear_grad()
+
+    tw = torch.nn.Parameter(torch.tensor(w0.copy()))
+    topt = torch.optim.Adam([tw], lr=0.01)
+    for g in grads:
+        tw.grad = torch.tensor(g)
+        topt.step()
+        topt.zero_grad()
+    np.testing.assert_allclose(w.numpy(), tw.detach().numpy(), atol=1e-5)
+
+
+def test_adamw_matches_torch():
+    import torch
+    w0 = np.random.randn(6).astype("float32")
+    grads = [np.random.randn(6).astype("float32") for _ in range(5)]
+    w = paddle.create_parameter([6])
+    w.set_value(w0)
+    opt = optim.AdamW(learning_rate=0.01, parameters=[w], weight_decay=0.1)
+    for g in grads:
+        w._grad_value = paddle.to_tensor(g)._value
+        opt.step()
+        opt.clear_grad()
+    tw = torch.nn.Parameter(torch.tensor(w0.copy()))
+    topt = torch.optim.AdamW([tw], lr=0.01, weight_decay=0.1)
+    for g in grads:
+        tw.grad = torch.tensor(g)
+        topt.step()
+        topt.zero_grad()
+    np.testing.assert_allclose(w.numpy(), tw.detach().numpy(), atol=1e-5)
+
+
+def test_momentum_matches_torch():
+    import torch
+    w0 = np.random.randn(4).astype("float32")
+    grads = [np.random.randn(4).astype("float32") for _ in range(4)]
+    w = paddle.create_parameter([4])
+    w.set_value(w0)
+    opt = optim.Momentum(learning_rate=0.1, momentum=0.9, parameters=[w])
+    for g in grads:
+        w._grad_value = paddle.to_tensor(g)._value
+        opt.step()
+        opt.clear_grad()
+    tw = torch.nn.Parameter(torch.tensor(w0.copy()))
+    topt = torch.optim.SGD([tw], lr=0.1, momentum=0.9)
+    for g in grads:
+        tw.grad = torch.tensor(g)
+        topt.step()
+        topt.zero_grad()
+    np.testing.assert_allclose(w.numpy(), tw.detach().numpy(), atol=1e-5)
+
+
+def test_weight_decay_l2():
+    w = paddle.create_parameter([2])
+    w.set_value(np.array([1.0, 1.0], "float32"))
+    opt = optim.SGD(learning_rate=0.1, parameters=[w],
+                    weight_decay=optim.L2Decay(0.5))
+    w._grad_value = paddle.zeros([2])._value
+    opt.step()
+    # grad = 0 + 0.5*w → w_new = w - 0.1*0.5*w = 0.95
+    np.testing.assert_allclose(w.numpy(), [0.95, 0.95], atol=1e-6)
+
+
+def test_grad_clip_global_norm():
+    w1 = paddle.create_parameter([2])
+    w2 = paddle.create_parameter([2])
+    w1.set_value(np.zeros(2, "float32"))
+    w2.set_value(np.zeros(2, "float32"))
+    opt = optim.SGD(learning_rate=1.0, parameters=[w1, w2],
+                    grad_clip=nn.clip.ClipGradByGlobalNorm(1.0))
+    w1._grad_value = paddle.to_tensor([3.0, 0.0])._value
+    w2._grad_value = paddle.to_tensor([0.0, 4.0])._value
+    opt.step()
+    # global norm 5 → scale 1/5
+    np.testing.assert_allclose(w1.numpy(), [-0.6, 0.0], atol=1e-6)
+    np.testing.assert_allclose(w2.numpy(), [0.0, -0.8], atol=1e-6)
+
+
+def test_grad_clip_value():
+    w = paddle.create_parameter([3])
+    w.set_value(np.zeros(3, "float32"))
+    opt = optim.SGD(learning_rate=1.0, parameters=[w],
+                    grad_clip=nn.clip.ClipGradByValue(0.5))
+    w._grad_value = paddle.to_tensor([2.0, -2.0, 0.1])._value
+    opt.step()
+    np.testing.assert_allclose(w.numpy(), [-0.5, 0.5, -0.1], atol=1e-6)
+
+
+def test_lr_scheduler_basic():
+    sched = optim.lr.StepDecay(learning_rate=0.1, step_size=2, gamma=0.5)
+    w = paddle.create_parameter([1])
+    opt = optim.SGD(learning_rate=sched, parameters=[w])
+    lrs = []
+    for _ in range(6):
+        lrs.append(opt.get_lr())
+        sched.step()
+    np.testing.assert_allclose(lrs, [0.1, 0.1, 0.05, 0.05, 0.025, 0.025])
+
+
+def test_lr_schedulers_values():
+    s = optim.lr.CosineAnnealingDecay(1.0, T_max=10)
+    assert s() == pytest.approx(1.0)
+    s.step(10)
+    assert s() == pytest.approx(0.0, abs=1e-6)
+
+    warm = optim.lr.LinearWarmup(0.5, warmup_steps=5, start_lr=0.0, end_lr=0.5)
+    assert warm() == pytest.approx(0.0)
+    warm.step(5)
+    assert warm() == pytest.approx(0.5)
+
+    noam = optim.lr.NoamDecay(d_model=64, warmup_steps=100)
+    noam.step(50)
+    lr50 = noam()
+    noam.step(100)
+    lr100 = noam()
+    assert lr100 > lr50  # still warming up
+
+    piece = optim.lr.PiecewiseDecay([3, 6], [0.1, 0.01, 0.001])
+    piece.step(4)
+    assert piece() == pytest.approx(0.01)
+
+
+def test_optimizer_state_dict_roundtrip():
+    w = paddle.create_parameter([3], name="w0")
+    opt = optim.Adam(learning_rate=0.1, parameters=[w])
+    w._grad_value = paddle.to_tensor([1.0, 2.0, 3.0])._value
+    opt.step()
+    sd = opt.state_dict()
+    assert sd["@step"] == 1
+
+    w2 = paddle.create_parameter([3], name="w0")
+    opt2 = optim.Adam(learning_rate=0.1, parameters=[w2])
+    opt2.set_state_dict(sd)
+    assert opt2._step_count == 1
+    m1 = opt._accumulators[id(w)]["moment1"]
+    m2 = opt2._accumulators[id(w2)]["moment1"]
+    np.testing.assert_allclose(np.asarray(m1), np.asarray(m2))
+
+
+def test_set_lr_and_param_lr():
+    w = paddle.create_parameter([1])
+    opt = optim.SGD(learning_rate=0.1, parameters=[w])
+    opt.set_lr(0.5)
+    assert opt.get_lr() == 0.5
+    w.optimize_attr["learning_rate"] = 0.1  # per-param lr scale
+    w.set_value(np.array([1.0], "float32"))
+    w._grad_value = paddle.to_tensor([1.0])._value
+    opt.step()
+    np.testing.assert_allclose(w.numpy(), [1.0 - 0.5 * 0.1], atol=1e-6)
