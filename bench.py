@@ -1,0 +1,97 @@
+"""Benchmark: GPT pretraining tokens/sec/chip on the local accelerator.
+
+North-star metric (BASELINE.md): ERNIE/GPT-class LM pretraining throughput.
+Runs a full jitted train step (forward + backward + global-norm clip + Adam)
+in bfloat16 on one chip and prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+``vs_baseline`` compares against the previous recorded run (BENCH_r*.json) if
+present, else 1.0 (the reference publishes no in-repo numbers — SURVEY §6).
+"""
+
+import glob
+import json
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def main():
+    import paddle_hackathon_tpu as paddle
+    from paddle_hackathon_tpu import parallel
+    from paddle_hackathon_tpu.models import GPTForCausalLM, gpt_config, param_sharding_spec
+
+    paddle.seed(0)
+
+    on_tpu = any(d.platform in ("tpu", "axon") for d in jax.devices())
+    if on_tpu:
+        cfg = gpt_config("gpt2-small-en", hidden_dropout_prob=0.0,
+                         attention_dropout_prob=0.0)
+        batch, seqlen = 8, 1024
+        steps, warmup = 10, 3
+        param_dtype = jnp.bfloat16
+    else:  # CPU smoke path so the script always works
+        cfg = gpt_config("gpt2-small-en", num_layers=2, hidden_size=128,
+                         num_heads=4, vocab_size=1024,
+                         hidden_dropout_prob=0.0, attention_dropout_prob=0.0)
+        batch, seqlen = 2, 128
+        steps, warmup = 3, 1
+        param_dtype = jnp.float32
+    cfg.max_position_embeddings = max(cfg.max_position_embeddings, seqlen)
+
+    model = GPTForCausalLM(cfg)
+    mesh = parallel.create_mesh({"dp": 1}, devices=jax.devices()[:1])
+    step, state = parallel.make_sharded_train_step(
+        model, mesh, rule=param_sharding_spec, learning_rate=1e-4,
+        zero_stage=0, param_dtype=param_dtype)
+
+    rng = np.random.RandomState(0)
+    ids = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, seqlen)), jnp.int32)
+    labels = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, seqlen)), jnp.int32)
+    key = jax.random.key(0)
+
+    for i in range(warmup):
+        state, loss = step(state, ids, labels, jax.random.fold_in(key, i))
+    float(loss)  # hard sync (device->host) — block_until_ready alone is not
+    # trustworthy through the axon tunnel
+
+    t0 = time.perf_counter()
+    for i in range(steps):
+        state, loss = step(state, ids, labels, jax.random.fold_in(key, 100 + i))
+    final_loss = float(loss)
+    dt = time.perf_counter() - t0
+    assert np.isfinite(final_loss)
+
+    tokens_per_sec = batch * seqlen * steps / dt
+
+    prev = None
+    import re
+    bench_files = glob.glob(os.path.join(os.path.dirname(__file__) or ".",
+                                         "BENCH_r*.json"))
+
+    def round_no(p):
+        m = re.search(r"BENCH_r(\d+)\.json$", p)
+        return int(m.group(1)) if m else -1
+
+    for f in sorted(bench_files, key=round_no):
+        try:
+            with open(f) as fh:
+                prev = json.load(fh).get("value")
+        except Exception:
+            pass
+    vs_baseline = (tokens_per_sec / prev) if prev else 1.0
+
+    print(json.dumps({
+        "metric": "gpt2_small_pretrain_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(vs_baseline, 4),
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -7,7 +7,7 @@ def in_dynamic_mode() -> bool:
     """True when executing eagerly (not inside a to_static trace)."""
     try:
         from ..jit import _trace_state
-        return not _trace_state.tracing
+        return not getattr(_trace_state, "tracing", False)
     except ImportError:
         return True
 

@@ -1,0 +1,18 @@
+"""paddle.jit equivalent — dygraph→static compilation.
+
+Ref ``python/paddle/jit`` + ``fluid/dygraph/dygraph_to_static/``. The
+reference rewrites Python AST into ProgramDesc ops and runs them through the
+``run_program`` op (``program_translator.py:340``, ``partial_program.py``).
+
+TPU-native replacement (SURVEY §7 phase 4): the *same* Python code that runs
+eagerly is traced by jax.jit into a jaxpr/StableHLO program — no AST rewriting
+needed because ops are jax-traceable and Python control flow is resolved at
+trace time (per input-spec specialization, cached like the reference's
+``get_concrete_program`` cache ``program_translator.py:441,475``). Training
+through a compiled program attaches ONE tape node wrapping the program's
+``jax.vjp`` — the exact role of the reference's ``run_program`` grad.
+"""
+
+from .api import (InputSpec, StaticFunction, _trace_state, ignore_module,  # noqa: F401
+                  not_to_static, to_static)
+from .save_load import TranslatedLayer, load, save  # noqa: F401

@@ -1,0 +1,4 @@
+"""Model zoo (ref ``python/paddle/vision/models`` + PaddleNLP GPT/ERNIE)."""
+
+from .gpt import (GPTConfig, GPTForCausalLM, GPTModel, gpt_config,  # noqa: F401
+                  param_sharding_spec)

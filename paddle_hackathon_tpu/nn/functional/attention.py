@@ -28,13 +28,16 @@ def _t(x):
 
 def scaled_dot_product_attention(query, key, value, attn_mask=None,
                                  dropout_p=0.0, is_causal=False,
-                                 training=True, name=None):
+                                 training=True, use_flash=None, name=None):
     """SDPA over (batch, seq, heads, head_dim) tensors (paddle layout).
 
-    Uses the Pallas flash kernel on TPU when FLAGS_use_fused_kernels is on and
-    shapes qualify; falls back to the reference composition otherwise.
+    Uses the Pallas flash kernel on TPU when enabled (``use_flash`` overrides
+    FLAGS_use_fused_kernels) and shapes qualify; falls back to the pure-XLA
+    composition otherwise.
     """
-    if flags.flag("use_fused_kernels") and attn_mask is None and dropout_p == 0.0:
+    flash_ok = (use_flash if use_flash is not None
+                else flags.flag("use_fused_kernels"))
+    if flash_ok and attn_mask is None and dropout_p == 0.0:
         try:
             from ...incubate.nn.functional import flash_attention_bshd
             return flash_attention_bshd(_t(query), _t(key), _t(value),

@@ -1,0 +1,22 @@
+"""Distributed / parallel execution.
+
+TPU-native replacement for the reference's entire distributed stack
+(SURVEY §2.4, §5.8): ``jax.sharding.Mesh`` with named axes plays the role of
+``CommunicateTopology``'s 4-D cartesian rank mesh (``topology.py:52``);
+pjit/GSPMD sharding propagation replaces the fleet meta-optimizers' program
+rewrites; explicit ``shard_map`` collectives replace the ``c_*`` comm ops;
+``jax.distributed.initialize`` replaces TCPStore rendezvous.
+
+Axis naming convention (matches fleet's ``[data, pipe, sharding, model]``
+plus the new sequence axis):
+  - ``dp``  data parallel (batch)
+  - ``pp``  pipeline stages
+  - ``sharding``  ZeRO parameter/grad/optimizer-state sharding
+  - ``mp``  tensor (model) parallel
+  - ``sp``  sequence/context parallel (ring attention / Ulysses)
+"""
+
+from .api import (create_mesh, get_mesh, make_sharded_train_step,  # noqa: F401
+                  set_mesh, shard_params)
+from .env import (get_rank, get_world_size, init_parallel_env,  # noqa: F401
+                  is_initialized)

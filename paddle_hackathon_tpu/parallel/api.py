@@ -1,0 +1,227 @@
+"""Mesh construction + sharded training step.
+
+The reference composes parallelism by rewriting programs per-strategy
+(``fleet/meta_optimizers/``, 20 program-rewrite passes) or wrapping models
+(``meta_parallel/``). Here a single mechanism covers DP/TP/ZeRO: annotate
+parameter and batch shardings over a named mesh and let GSPMD insert the
+collectives (psum for DP grads = the EagerReducer's fused allreduce;
+all-gather/reduce-scatter for ZeRO = sharding stage 1-3; TP collectives =
+c_identity/c_allreduce pairs). PP and SP are explicit shard_map programs
+(see pipeline.py / sequence.py).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, Dict, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..core.tensor import Tensor
+from ..nn.layer import Layer
+
+_current_mesh: Optional[Mesh] = None
+
+AXES = ("pp", "dp", "sharding", "mp", "sp")
+
+
+def create_mesh(mesh_dims: Dict[str, int], devices=None) -> Mesh:
+    """Build a named-axis device mesh (ref ``CommunicateTopology``
+    ``topology.py:52`` — the cartesian [data,pipe,sharding,model] mesh).
+
+    ``mesh_dims`` maps axis name -> size, e.g. {"dp": 2, "mp": 4}. Axes are
+    ordered (pp, dp, sharding, mp, sp) — outermost first, so 'mp' and 'sp'
+    land on the innermost (fastest ICI) device dimension, matching the
+    reference's hybrid-parallel ordering where model-parallel groups are
+    nearest neighbours.
+    """
+    devices = devices if devices is not None else jax.devices()
+    names = [a for a in AXES if mesh_dims.get(a, 1) > 1 or a in mesh_dims]
+    if not names:
+        names = ["dp"]
+        mesh_dims = {"dp": len(devices)}
+    sizes = [mesh_dims.get(a, 1) for a in names]
+    total = int(np.prod(sizes))
+    if total != len(devices):
+        raise ValueError(
+            f"mesh dims {dict(zip(names, sizes))} require {total} devices, "
+            f"but {len(devices)} are visible")
+    arr = np.asarray(devices).reshape(sizes)
+    mesh = Mesh(arr, tuple(names))
+    set_mesh(mesh)
+    return mesh
+
+
+def set_mesh(mesh: Mesh) -> None:
+    global _current_mesh
+    _current_mesh = mesh
+
+
+def get_mesh() -> Optional[Mesh]:
+    return _current_mesh
+
+
+def _filter_spec(spec, mesh: Mesh):
+    """Drop axis names the mesh doesn't have; keep dims aligned."""
+    return tuple(a if (a in mesh.axis_names) else None for a in spec)
+
+
+def shard_params(model: Layer, mesh: Mesh,
+                 rule: Optional[Callable] = None,
+                 zero_stage: int = 0) -> Dict[str, jax.Array]:
+    """Place model parameters onto the mesh per a sharding rule.
+
+    ``rule(name, shape) -> spec tuple`` supplies TP specs (e.g.
+    ``models.gpt.param_sharding_spec``); ``zero_stage>=3`` additionally shards
+    the largest replicated dim over the 'sharding' axis (FSDP/stage-3,
+    ref ``group_sharded_stage3.py:60``).
+    Parameters are updated in place to device-sharded arrays.
+    """
+    placed = {}
+    for name, p in model.named_parameters():
+        spec = list(rule(name, p.shape)) if rule else [None] * p.ndim
+        spec = list(_filter_spec(spec, mesh))
+        if zero_stage >= 3 and "sharding" in mesh.axis_names:
+            shard_n = mesh.shape["sharding"]
+            for i, (dim, s) in enumerate(zip(p.shape, spec)):
+                if s is None and dim % shard_n == 0:
+                    spec[i] = "sharding"
+                    break
+        sharding = NamedSharding(mesh, P(*spec))
+        arr = jax.device_put(p._value, sharding)
+        p._set_value(arr)
+        placed[name] = arr
+    return placed
+
+
+def batch_spec(mesh: Mesh) -> P:
+    """Batch axis sharded over every data-like axis present (dp x sharding:
+    the reference's dp-degree x sharding-degree both consume batch)."""
+    data_axes = tuple(a for a in ("dp", "sharding") if a in mesh.axis_names)
+    if not data_axes:
+        return P()
+    return P(data_axes)
+
+
+def make_sharded_train_step(model: Layer, mesh: Mesh,
+                            rule: Optional[Callable] = None,
+                            learning_rate: float = 1e-4,
+                            zero_stage: int = 1,
+                            loss_fn: Optional[Callable] = None,
+                            param_dtype=None,
+                            grad_clip_norm: Optional[float] = 1.0):
+    """Build (step_fn, state) — one compiled SPMD program per step covering
+    forward, backward, grad psum over dp, Adam update on (optionally
+    'sharding'-sharded) optimizer state.
+
+    This one function subsumes: EagerReducer fused allreduce (DP), sharding
+    stage-1/2 (optimizer state + grads live sharded — XLA keeps them
+    reduce-scattered), stage-3/FSDP (zero_stage=3 shards params too), and TP
+    (rule specs). Ref: SURVEY §2.4 table.
+    """
+    from ..nn.layer import functional_call
+
+    if param_dtype is not None:
+        for _, p in model.named_parameters():
+            if jnp.issubdtype(p._value.dtype, jnp.floating):
+                p._set_value(p._value.astype(param_dtype))
+    shard_params(model, mesh, rule, zero_stage)
+    params = {k: p._value for k, p in model.named_parameters()}
+    _, buffers = model.functional_state()
+
+    def opt_state_spec(name, arr):
+        spec = list(rule(name, arr.shape)) if rule else [None] * arr.ndim
+        spec = list(_filter_spec(spec, mesh))
+        if zero_stage >= 1 and "sharding" in mesh.axis_names:
+            n = mesh.shape["sharding"]
+            for i, (dim, s) in enumerate(zip(arr.shape, spec)):
+                if s is None and dim % n == 0:
+                    spec[i] = "sharding"
+                    break
+        return NamedSharding(mesh, P(*spec))
+
+    opt_state = {
+        k: {"m": jax.device_put(jnp.zeros(v.shape, jnp.float32),
+                                opt_state_spec(k, v)),
+            "v": jax.device_put(jnp.zeros(v.shape, jnp.float32),
+                                opt_state_spec(k, v)),
+            }
+        for k, v in params.items()}
+    step_no = jnp.zeros((), jnp.int32)
+
+    if loss_fn is None:
+        def loss_fn(model, params, buffers, batch, rng):
+            ids, labels = batch
+            from ..core import random as core_random
+            with core_random.rng_scope(rng):
+                logits = functional_call(model, params, (Tensor(ids),),
+                                         buffers={k: v for k, v in buffers.items()})
+            vocab = logits.shape[-1]
+            lp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+            onehot_ll = jnp.take_along_axis(lp, labels[..., None], axis=-1)
+            return -jnp.mean(onehot_ll)
+
+    b1, b2, eps = 0.9, 0.95, 1e-8
+
+    def train_step(params, opt_state, step_no, batch, rng):
+        def pure_loss(p):
+            return loss_fn(model, p, buffers, batch, rng)
+
+        loss, grads = jax.value_and_grad(pure_loss)(params)
+        if grad_clip_norm is not None:
+            gnorm = jnp.sqrt(sum(
+                jnp.sum(jnp.square(g.astype(jnp.float32)))
+                for g in jax.tree.leaves(grads)))
+            scale = grad_clip_norm / jnp.maximum(gnorm, grad_clip_norm)
+            grads = jax.tree.map(lambda g: g * scale.astype(g.dtype), grads)
+        t = (step_no + 1).astype(jnp.float32)
+        new_params, new_opt = {}, {}
+        for k in params:
+            g = grads[k].astype(jnp.float32)
+            m = b1 * opt_state[k]["m"] + (1 - b1) * g
+            v = b2 * opt_state[k]["v"] + (1 - b2) * jnp.square(g)
+            mhat = m / (1 - b1 ** t)
+            vhat = v / (1 - b2 ** t)
+            upd = learning_rate * mhat / (jnp.sqrt(vhat) + eps)
+            new_params[k] = (params[k].astype(jnp.float32) - upd).astype(
+                params[k].dtype)
+            new_opt[k] = {"m": m, "v": v}
+        return new_params, new_opt, step_no + 1, loss
+
+    bspec = batch_spec(mesh)
+    param_sh = jax.tree.map(lambda a: a.sharding, params)
+    opt_sh = jax.tree.map(lambda a: a.sharding, opt_state)
+    scalar_sh = NamedSharding(mesh, P())
+    jitted = jax.jit(
+        train_step,
+        donate_argnums=(0, 1, 2),
+        in_shardings=(
+            param_sh, opt_sh, scalar_sh,
+            (NamedSharding(mesh, bspec), NamedSharding(mesh, bspec)),
+            None,
+        ),
+        # pin output shardings to the input layout — without this XLA may pick
+        # a different layout for the updated params, forcing a re-jit (and a
+        # second full compile) on the next step.
+        out_shardings=(param_sh, opt_sh, scalar_sh, scalar_sh),
+    )
+
+    state = {"params": params, "opt_state": opt_state, "step": step_no}
+    param_tensors = dict(model.named_parameters())
+
+    def step(state, ids, labels, rng):
+        new_params, new_opt, new_step, loss = jitted(
+            state["params"], state["opt_state"], state["step"],
+            (ids, labels), rng)
+        # The old param buffers were donated; rebind the live model's tensors
+        # to the updated arrays so the Layer stays usable (eval, jit.save,
+        # checkpointing) throughout training.
+        for k, v in new_params.items():
+            param_tensors[k]._set_value(v)
+        return ({"params": new_params, "opt_state": new_opt,
+                 "step": new_step}, loss)
+
+    return step, state

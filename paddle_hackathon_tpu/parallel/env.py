@@ -1,0 +1,57 @@
+"""Process / environment bootstrap.
+
+Ref ``paddle.distributed.init_parallel_env`` (``parallel.py:94``): the
+reference rendezvouses via TCPStore + NCCL unique-id broadcast
+(``tcp_store.h:120``, ``gen_comm_id_helper.cc:365``). On TPU,
+``jax.distributed.initialize`` speaks to the JAX coordinator service which
+plays exactly TCPStore's role; within one host the mesh covers all local
+devices with no process boundary at all (single-controller SPMD).
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+_initialized = False
+
+
+def init_parallel_env(coordinator_address=None, num_processes=None,
+                      process_id=None) -> None:
+    """Multi-host bootstrap. Single-process usage (one host, N chips) needs no
+    initialization — call this only under a multi-host launcher.
+
+    Env-var protocol (set by ``paddle_hackathon_tpu.distributed.launch``):
+    ``PADDLE_MASTER`` (host:port), ``PADDLE_TRAINERS_NUM``,
+    ``PADDLE_TRAINER_ID`` — same names as the reference launcher
+    (``launch/main.py``).
+    """
+    global _initialized
+    if _initialized:
+        return
+    coordinator_address = coordinator_address or os.environ.get("PADDLE_MASTER")
+    if coordinator_address is None:
+        _initialized = True  # single-process mode
+        return
+    num_processes = num_processes or int(os.environ.get("PADDLE_TRAINERS_NUM", 1))
+    process_id = process_id if process_id is not None else int(
+        os.environ.get("PADDLE_TRAINER_ID", 0))
+    jax.distributed.initialize(coordinator_address, num_processes, process_id)
+    _initialized = True
+
+
+def is_initialized() -> bool:
+    return _initialized
+
+
+def get_world_size() -> int:
+    """Total participating processes (ref ``paddle.distributed.get_world_size``).
+
+    NOTE: in SPMD terms the *device* count is usually what matters; this
+    mirrors paddle's process-level semantics."""
+    return jax.process_count()
+
+
+def get_rank() -> int:
+    return jax.process_index()

@@ -1,0 +1,151 @@
+"""to_static / jit save-load tests.
+
+Mirrors the reference's dygraph↔static parity test pattern
+(``dygraph_to_static/`` tests run both modes and compare numerics, SURVEY §4).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import paddle_hackathon_tpu as paddle
+from paddle_hackathon_tpu import jit, nn, optimizer as optim
+
+
+def _mlp():
+    return nn.Sequential(nn.Linear(4, 8), nn.GELU(), nn.LayerNorm(8),
+                         nn.Linear(8, 2))
+
+
+def test_static_inference_parity():
+    model = _mlp()
+    model.eval()
+    x = paddle.randn([3, 4])
+    eager = model(x).numpy()
+    static = jit.to_static(model)
+    np.testing.assert_allclose(eager, static(x).numpy(), atol=1e-5)
+
+
+def test_program_cache_per_shape_and_mode():
+    model = _mlp()
+    static = jit.to_static(model)
+    static(paddle.randn([3, 4]))
+    static(paddle.randn([3, 4]))
+    assert len(model.forward._cache) == 1
+    static(paddle.randn([7, 4]))
+    assert len(model.forward._cache) == 2
+    model.eval()
+    static(paddle.randn([3, 4]))  # new key: training flag changed
+    assert len(model.forward._cache) == 3
+
+
+def test_static_gradients_match_eager():
+    paddle.seed(3)
+    model = nn.Sequential(nn.Linear(4, 8), nn.Tanh(), nn.Linear(8, 1))
+    x = paddle.randn([16, 4])
+    y = paddle.randn([16, 1])
+
+    loss_e = ((model(x) - y) ** 2).mean()
+    loss_e.backward()
+    eager_grads = {k: p.grad.numpy().copy()
+                   for k, p in model.named_parameters()}
+    model.clear_gradients()
+
+    static = jit.to_static(model)
+    loss_s = ((static(x) - y) ** 2).mean()
+    assert loss_s.item() == pytest.approx(loss_e.item(), abs=1e-6)
+    loss_s.backward()
+    for k, p in model.named_parameters():
+        np.testing.assert_allclose(p.grad.numpy(), eager_grads[k], atol=1e-5,
+                                   err_msg=k)
+
+
+def test_static_training_trajectory_matches_eager():
+    def run(static_mode):
+        paddle.seed(11)
+        model = nn.Sequential(nn.Linear(4, 8), nn.Tanh(), nn.Linear(8, 1))
+        fwd = jit.to_static(model) if static_mode else model
+        opt = optim.Adam(learning_rate=0.05, parameters=model.parameters())
+        x = paddle.randn([16, 4])
+        y = paddle.randn([16, 1])
+        losses = []
+        for _ in range(15):
+            loss = ((fwd(x) - y) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(loss.item())
+        return losses
+
+    eager_losses = run(False)
+    static_losses = run(True)
+    np.testing.assert_allclose(static_losses, eager_losses, rtol=1e-4)
+    assert static_losses[-1] < static_losses[0]
+
+
+def test_batchnorm_buffers_update_through_trace():
+    model = nn.Sequential(nn.Conv2D(1, 2, 3, padding=1), nn.BatchNorm2D(2))
+    static = jit.to_static(model)
+    before = model[1]._mean.numpy().copy()
+    static(paddle.randn([4, 1, 5, 5]))
+    assert not np.allclose(before, model[1]._mean.numpy())
+
+
+def test_dropout_randomness_through_trace():
+    model = nn.Sequential(nn.Linear(8, 8), nn.Dropout(0.5))
+    static = jit.to_static(model)
+    x = paddle.ones([4, 8])
+    a = static(x).numpy()
+    b = static(x).numpy()
+    assert not np.allclose(a, b)  # fresh key each call, same compiled program
+    assert len(model.forward._cache) == 1
+
+
+def test_to_static_plain_function():
+    @jit.to_static
+    def f(a, b):
+        return paddle.tanh(a) + b * 2
+
+    x = paddle.randn([3])
+    y = paddle.randn([3])
+    np.testing.assert_allclose(f(x, y).numpy(),
+                               np.tanh(x.numpy()) + y.numpy() * 2, atol=1e-6)
+
+
+def test_python_control_flow_specializes():
+    @jit.to_static
+    def f(x, flag):
+        if flag:  # resolved at trace time, cached per flag value
+            return x * 2
+        return x * 3
+
+    x = paddle.to_tensor([1.0])
+    assert f(x, True).item() == 2.0
+    assert f(x, False).item() == 3.0
+
+
+def test_jit_save_load_roundtrip(tmp_path):
+    model = _mlp()
+    model.eval()
+    x = paddle.randn([3, 4])
+    expected = model(x).numpy()
+    p = jit.save(model, str(tmp_path / "m"),
+                 input_spec=[jit.InputSpec([3, 4])])
+    assert os.path.exists(p)
+    loaded = jit.load(p)
+    np.testing.assert_allclose(expected, loaded(x).numpy(), atol=1e-5)
+
+
+def test_jit_save_requires_spec():
+    model = _mlp()
+    with pytest.raises(ValueError):
+        jit.save(model, "/tmp/should_not_exist")
+
+
+def test_input_spec():
+    s = jit.InputSpec([None, 4], "float32", name="x")
+    assert s.shape == (-1, 4)
+    t = paddle.randn([2, 3])
+    s2 = jit.InputSpec.from_tensor(t)
+    assert s2.shape == (2, 3)
