@@ -18,7 +18,8 @@ _param_counter = [0]
 
 
 class Parameter(Tensor):
-    __slots__ = ("optimize_attr", "regularizer", "do_model_average", "is_distributed")
+    __slots__ = ("optimize_attr", "regularizer", "do_model_average",
+                 "is_distributed", "pspec")
 
     def __init__(self, value, trainable: bool = True, name: Optional[str] = None):
         if name is None:
@@ -30,6 +31,9 @@ class Parameter(Tensor):
         self.regularizer = None
         self.do_model_average = None
         self.is_distributed = False
+        # named-axis PartitionSpec entries set by parallel layers
+        # (parallel/mp_layers.py); consumed by sharding_rule_from_model
+        self.pspec = None
 
     @property
     def trainable(self) -> bool:

@@ -20,3 +20,21 @@ from .api import (create_mesh, get_mesh, make_sharded_train_step,  # noqa: F401
                   set_mesh, shard_params)
 from .env import (get_rank, get_world_size, init_parallel_env,  # noqa: F401
                   is_initialized)
+from . import collective  # noqa: F401
+from .collective import (Group, ReduceOp, all_gather, all_reduce,  # noqa: F401
+                         alltoall, barrier, broadcast, new_group, ppermute,
+                         reduce, reduce_scatter, scatter, shift)
+from .topology import (CommunicateTopology, HybridCommunicateGroup,  # noqa: F401
+                       ParallelMode, get_hybrid_communicate_group,
+                       init_hybrid_parallel, set_hybrid_communicate_group)
+from .mp_layers import (ColumnParallelLinear, ParallelCrossEntropy,  # noqa: F401
+                        RowParallelLinear, VocabParallelEmbedding,
+                        mark_sharding, sharding_rule_from_model)
+from .pipeline import (LayerDesc, SharedLayerDesc, pipeline_apply,  # noqa: F401
+                       stack_layer_params, unstack_into_layers)
+from .sequence import ring_attention, ulysses_attention  # noqa: F401
+from .moe import GShardGate, MoELayer, NaiveGate, SwitchGate  # noqa: F401
+from .sharding import (group_sharded_parallel,  # noqa: F401
+                       save_group_sharded_model)
+from .fleet import (DistributedStrategy, distributed_model,  # noqa: F401
+                    distributed_optimizer, fleet)

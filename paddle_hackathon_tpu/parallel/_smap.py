@@ -1,0 +1,33 @@
+"""shard_map invocation helper for jax 0.9 semantics.
+
+Partial-manual shard_map (some mesh axes manual, the rest GSPMD-auto) must
+run inside ``jit`` under an ambient ``jax.set_mesh`` context — but
+``set_mesh`` is forbidden while tracing. This helper picks the right mode:
+
+- top-level (eager) call: wrap in ``jit`` under ``set_mesh``;
+- already inside a trace with all axes manual: pass ``mesh=`` directly;
+- already inside a trace with auto axes remaining: rely on the caller's
+  ambient mesh (the outer jit must run under ``jax.set_mesh``).
+"""
+
+from __future__ import annotations
+
+import jax
+from jax import shard_map
+
+
+def run_shard_map(fn, mesh, in_specs, out_specs, manual_axes, args):
+    manual = frozenset(manual_axes)
+    from jax._src import core as _core
+    if _core.trace_state_clean():
+        sm = shard_map(fn, in_specs=in_specs, out_specs=out_specs,
+                       axis_names=manual, check_vma=False)
+        with jax.set_mesh(mesh):
+            return jax.jit(sm)(*args)
+    if manual == frozenset(mesh.axis_names):
+        sm = shard_map(fn, mesh=mesh, in_specs=in_specs,
+                       out_specs=out_specs, check_vma=False)
+        return sm(*args)
+    sm = shard_map(fn, in_specs=in_specs, out_specs=out_specs,
+                   axis_names=manual, check_vma=False)
+    return sm(*args)

@@ -1,0 +1,209 @@
+"""Mixture-of-Experts with expert parallelism.
+
+Ref ``python/paddle/incubate/distributed/models/moe/moe_layer.py:244``
+(``MoELayer``), gates ``moe/gate/{naive,gshard,switch}_gate.py``, dispatch
+via the ``global_scatter``/``global_gather`` CUDA all-to-all ops
+(``operators/collective/global_scatter_op.cc:20``) and MoE-aware grad clip
+(``moe/grad_clip.py``).
+
+TPU-native design (GShard): dispatch is expressed as dense einsums with a
+static per-expert ``capacity`` — no ragged a2a, no dynamic shapes (XLA
+requirement). Expert weights carry a leading expert dim sharded over the
+'ep' (or 'mp') mesh axis; with tokens batch-sharded and experts
+expert-sharded, XLA lowers the dispatch/combine einsums to exactly the
+all_to_all pair ``global_scatter``/``global_gather`` implement by hand.
+The full forward is one taped op (``apply_op``) so eager autograd flows
+through routing, dispatch and the expert FFNs.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.autograd import apply_op
+from ..core.tensor import Tensor
+from ..nn import initializer as I
+from ..nn.layer import Layer
+from ..nn.parameter import ParamAttr
+
+
+def _one_hot(idx, n, dtype=jnp.float32):
+    return jax.nn.one_hot(idx, n, dtype=dtype)
+
+
+def _balance_loss(probs, idx, num_experts):
+    """GShard/Switch load-balance aux: E * sum_e mean(gate_e) * frac_e."""
+    me = probs.mean(0)
+    ce = _one_hot(idx[:, 0], num_experts).mean(0)
+    return num_experts * jnp.sum(me * ce)
+
+
+class NaiveGate(Layer):
+    """Plain top-k softmax gate (ref ``moe/gate/naive_gate.py``)."""
+
+    aux = False
+
+    def __init__(self, d_model: int, num_experts: int, topk: int = 2):
+        super().__init__()
+        self.num_experts, self.topk = num_experts, topk
+        self.weight = self.create_parameter(
+            [d_model, num_experts],
+            attr=ParamAttr(initializer=I.Normal(0.0, 0.02)))
+
+    def route(self, logits, noise=None):
+        """Pure routing: logits (n, E) -> (gate_vals (n,k), idx (n,k), aux)."""
+        probs = jax.nn.softmax(logits, axis=-1)
+        gate_vals, idx = jax.lax.top_k(probs, self.topk)
+        gate_vals = gate_vals / jnp.maximum(
+            gate_vals.sum(-1, keepdims=True), 1e-9)
+        aux = (_balance_loss(probs, idx, self.num_experts) if self.aux
+               else jnp.zeros((), jnp.float32))
+        return gate_vals, idx, aux
+
+    def forward(self, x):
+        xv = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+        return self.route(xv @ self.weight._value)
+
+
+class GShardGate(NaiveGate):
+    """Top-2 gate with load-balance aux loss and randomized second-expert
+    dispatch (ref ``gshard_gate.py``; Lepikhin et al.: route to the 2nd
+    expert only with probability proportional to its gate weight)."""
+
+    aux = True
+
+    def __init__(self, d_model, num_experts, topk: int = 2,
+                 random_routing: bool = True):
+        super().__init__(d_model, num_experts, topk)
+        self.random_routing = random_routing
+
+    def route(self, logits, noise=None):
+        gate_vals, idx, aux = super().route(logits)
+        if noise is not None and self.random_routing and self.topk >= 2:
+            keep2 = noise < 2.0 * gate_vals[:, 1]
+            gate_vals = gate_vals.at[:, 1].multiply(
+                keep2.astype(gate_vals.dtype))
+        return gate_vals, idx, aux
+
+
+class SwitchGate(NaiveGate):
+    """Top-1 switch gate with input jitter (ref ``switch_gate.py``;
+    Fedus et al.). Jitter noise is sampled by the MoELayer and multiplied
+    into the gate input during training."""
+
+    aux = True
+
+    def __init__(self, d_model, num_experts, jitter: float = 0.01):
+        super().__init__(d_model, num_experts, topk=1)
+        self.jitter = jitter
+
+
+GATES = {"naive": NaiveGate, "gshard": GShardGate, "switch": SwitchGate}
+
+
+class MoELayer(Layer):
+    """Expert-parallel FFN block (ref ``moe_layer.py:244``).
+
+    Expert weights are stacked (E, ...) with pspec ('ep', ...) so the expert
+    dim shards over the 'ep' mesh axis; capacity-based einsum dispatch keeps
+    all shapes static. The aux (load-balance) loss lands in ``self.l_aux``
+    after each forward, mirroring the reference.
+    """
+
+    def __init__(self, d_model: int, d_hidden: int, num_experts: int,
+                 gate: str = "gshard", topk: int = 2,
+                 capacity_factor: float = 1.25,
+                 act: Optional[Callable] = None):
+        super().__init__()
+        self.d_model, self.d_hidden = d_model, d_hidden
+        self.num_experts = num_experts
+        self.capacity_factor = capacity_factor
+        # raw (jax-level) activation — runs inside the taped op
+        self.act = act or (lambda a: jax.nn.gelu(a, approximate=True))
+        if isinstance(gate, str):
+            kwargs = {"topk": topk} if gate != "switch" else {}
+            gate = GATES[gate](d_model, num_experts, **kwargs)
+        self.gate = gate
+        init = ParamAttr(initializer=I.Normal(0.0, 0.02))
+        self.w1 = self.create_parameter([num_experts, d_model, d_hidden],
+                                        attr=init)
+        self.b1 = self.create_parameter([num_experts, d_hidden], is_bias=True)
+        self.w2 = self.create_parameter([num_experts, d_hidden, d_model],
+                                        attr=init)
+        self.b2 = self.create_parameter([num_experts, d_model], is_bias=True)
+        for p, spec in ((self.w1, ("ep", None, "mp")),
+                        (self.b1, ("ep", "mp")),
+                        (self.w2, ("ep", "mp", None)),
+                        (self.b2, ("ep", None))):
+            p.pspec = spec
+            p.is_distributed = True
+        self.l_aux = None
+
+    def capacity(self, n_tokens: int) -> int:
+        k = self.gate.topk
+        return max(4, int(math.ceil(
+            k * n_tokens * self.capacity_factor / self.num_experts)))
+
+    def forward(self, x):
+        xt = x if isinstance(x, Tensor) else Tensor(x)
+        orig_shape = tuple(xt._value.shape)
+        d = orig_shape[-1]
+        n = int(np.prod(orig_shape[:-1]))
+        E, C, K = self.num_experts, self.capacity(n), self.gate.topk
+        route, act = self.gate.route, self.act
+
+        # stateful randomness is sampled OUTSIDE the pure taped fn
+        # (jax.vjp would bake a constant key otherwise)
+        jitter_noise = route_noise = None
+        if self.training:
+            from ..core import random as core_random
+            if isinstance(self.gate, SwitchGate) and self.gate.jitter > 0:
+                j = self.gate.jitter
+                jitter_noise = jax.random.uniform(
+                    core_random.split_key(), (n, d), xt._value.dtype,
+                    1 - j, 1 + j)
+            elif (isinstance(self.gate, GShardGate)
+                  and self.gate.random_routing):
+                route_noise = jax.random.uniform(
+                    core_random.split_key(), (n,), jnp.float32)
+
+        def moe_fn(tokens_in, gate_w, w1, b1, w2, b2):
+            tokens = tokens_in.reshape(n, d)
+            gate_in = (tokens * jitter_noise if jitter_noise is not None
+                       else tokens)
+            gate_vals, idx, aux = route(gate_in @ gate_w, route_noise)
+
+            # position of each (token, k) slot in its expert's capacity queue
+            flat_idx = idx.reshape(-1)
+            oh = _one_hot(flat_idx, E)                      # (n*k, E)
+            pos = (jnp.cumsum(oh, axis=0) - 1.0) * oh
+            pos = pos.sum(-1).astype(jnp.int32).reshape(n, K)
+            keep = pos < C                                  # overflow drop
+            gate_vals = gate_vals * keep.astype(gate_vals.dtype)
+
+            # GShard dispatch/combine tensors (n, E, C)
+            slot = _one_hot(jnp.where(keep, pos, C), C + 1)[..., :C]
+            sel = _one_hot(idx, E)                          # (n, K, E)
+            disp = (sel[..., None] * slot[:, :, None, :]).sum(1)
+            comb = (gate_vals[..., None, None] * sel[..., None]
+                    * slot[:, :, None, :]).sum(1)
+
+            expert_in = jnp.einsum("nec,nd->ecd", disp.astype(tokens.dtype),
+                                   tokens)                  # (E, C, d)
+            h = act(jnp.einsum("ecd,edh->ech", expert_in, w1)
+                    + b1[:, None])
+            expert_out = jnp.einsum("ech,ehd->ecd", h, w2) + b2[:, None]
+            y = jnp.einsum("nec,ecd->nd", comb.astype(expert_out.dtype),
+                           expert_out)
+            return y.reshape(orig_shape), aux
+
+        y, aux = apply_op("moe_layer", moe_fn,
+                          [xt, self.gate.weight, self.w1, self.b1,
+                           self.w2, self.b2], n_outputs=2)
+        self.l_aux = aux
+        return y

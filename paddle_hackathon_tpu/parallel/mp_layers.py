@@ -1,0 +1,188 @@
+"""Tensor-parallel (Megatron-style) layers.
+
+Ref ``python/paddle/distributed/fleet/meta_parallel/parallel_layers/
+mp_layers.py``: ``VocabParallelEmbedding`` (:30), ``ColumnParallelLinear``
+(:95), ``RowParallelLinear`` (:171), ``ParallelCrossEntropy`` (:251) — each
+hand-places ``c_identity``/``c_allreduce`` autograd pairs and slices weights
+per mp-rank at construction.
+
+TPU-native design: the layer holds the FULL (logical) weight and records a
+named-axis PartitionSpec on it (``Parameter.pspec``). Under a mesh, GSPMD
+partitions the weight over the 'mp' axis and inserts exactly the collectives
+the reference hand-writes: column-parallel matmul needs none forward /
+psum backward (= c_identity fwd pair), row-parallel emits a psum forward
+(= c_allreduce), vocab-parallel embedding lowers to a partitioned gather +
+psum (= the ``c_embedding`` CUDA kernel). ``mark_sharding`` constrains the
+activations so the pattern is explicit rather than left to propagation.
+
+This keeps eager single-device semantics identical to the plain layers
+(the reference's TP tests check exactly this: TP layers == single-card
+equivalents, ``hybrid_parallel_mp_layers.py``).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+from .. import ops
+from ..core.tensor import Tensor
+from ..nn import functional as F
+from ..nn.layer import Layer
+from ..nn.parameter import ParamAttr
+from . import api as _mesh_api
+
+
+def mark_sharding(x, *spec):
+    """Constrain an activation's sharding under the current mesh (the
+    GSPMD-native replacement for the reference's explicit ``c_identity`` /
+    ``c_allreduce`` insertion points). No-op without a mesh or when the
+    named axes aren't in it."""
+    mesh = _mesh_api.get_mesh()
+    if mesh is None:
+        return x
+    ndim = len(x.shape)
+    if len(spec) > ndim:
+        raise ValueError(
+            f"mark_sharding spec {spec} has more entries than the "
+            f"array's {ndim} dims")
+    filtered = tuple(a if (a is None or a in mesh.axis_names) else None
+                     for a in spec)
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    ns = NamedSharding(mesh, P(*filtered))
+    if isinstance(x, Tensor):
+        # taped op: the constraint's vjp is identity (+ constraint), so
+        # eager autograd flows through — the c_identity/c_allreduce autograd
+        # pairs of the reference come out of XLA's partitioner instead.
+        from ..core.autograd import apply_op
+        return apply_op(
+            "sharding_constraint",
+            lambda v: jax.lax.with_sharding_constraint(v, ns), [x])
+    return jax.lax.with_sharding_constraint(x, ns)
+
+
+class ColumnParallelLinear(Layer):
+    """Output-dim split linear (ref ``mp_layers.py:95``). Weight (in, out)
+    partitioned (None, 'mp'); with ``gather_output=False`` the activation
+    stays 'mp'-sharded for a following RowParallelLinear."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, gather_output=True, fuse_matmul_bias=False,
+                 mp_group=None, name=None):
+        super().__init__()
+        self.in_features, self.out_features = in_features, out_features
+        self.gather_output = gather_output
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=ParamAttr._to_attr(weight_attr))
+        self.weight.pspec = (None, "mp")
+        self.weight.is_distributed = True
+        self.bias = None
+        if has_bias:
+            self.bias = self.create_parameter(
+                [out_features], attr=None, is_bias=True)
+            self.bias.pspec = ("mp",)
+            self.bias.is_distributed = True
+
+    def forward(self, x):
+        # fwd: x replicated over mp, W column-sharded -> y column-sharded
+        # (no collective); bwd dL/dx needs psum over mp — the c_identity
+        # fwd/allreduce bwd pair, emitted by GSPMD from the shardings.
+        y = F.linear(x, self.weight, self.bias)
+        if self.gather_output:
+            y = mark_sharding(y, *((None,) * (len(y.shape) - 1)))
+        else:
+            y = mark_sharding(y, *((None,) * (len(y.shape) - 1) + ("mp",)))
+        return y
+
+
+class RowParallelLinear(Layer):
+    """Input-dim split linear (ref ``mp_layers.py:171``). Weight (in, out)
+    partitioned ('mp', None); forward emits the psum the reference codes as
+    ``c_allreduce_sum``. ``input_is_parallel`` means x arrives 'mp'-sharded
+    from a ColumnParallelLinear."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, input_is_parallel=False,
+                 fuse_matmul_bias=False, mp_group=None, name=None):
+        super().__init__()
+        self.in_features, self.out_features = in_features, out_features
+        self.input_is_parallel = input_is_parallel
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=ParamAttr._to_attr(weight_attr))
+        self.weight.pspec = ("mp", None)
+        self.weight.is_distributed = True
+        self.bias = None
+        if has_bias:
+            # bias added after the reduction -> replicated
+            self.bias = self.create_parameter(
+                [out_features], attr=None, is_bias=True)
+            self.bias.pspec = (None,)
+
+    def forward(self, x):
+        if self.input_is_parallel:
+            x = mark_sharding(x, *((None,) * (len(x.shape) - 1) + ("mp",)))
+        y = ops.matmul(x, self.weight)
+        y = mark_sharding(y, *((None,) * len(y.shape)))
+        if self.bias is not None:
+            y = y + self.bias
+        return y
+
+
+class VocabParallelEmbedding(Layer):
+    """Vocab-dim split embedding (ref ``mp_layers.py:30`` + the
+    ``c_embedding`` kernel ``operators/collective/c_embedding_op.cu``):
+    weight (vocab, hidden) partitioned ('mp', None); XLA lowers the gather
+    on a partitioned operand to local-gather + psum — the same
+    mask-out-of-range + allreduce the CUDA kernel does."""
+
+    def __init__(self, num_embeddings, embedding_dim, weight_attr=None,
+                 mp_group=None, name=None):
+        super().__init__()
+        from ..nn import initializer as I
+        self.num_embeddings = num_embeddings
+        self.embedding_dim = embedding_dim
+        self.weight = self.create_parameter(
+            [num_embeddings, embedding_dim],
+            attr=ParamAttr._to_attr(weight_attr),
+            default_initializer=I.Normal(0.0, 1.0))
+        self.weight.pspec = ("mp", None)
+        self.weight.is_distributed = True
+
+    def forward(self, x):
+        y = F.embedding(x, self.weight)
+        return mark_sharding(y, *((None,) * len(y.shape)))
+
+
+class ParallelCrossEntropy(Layer):
+    """Vocab-parallel softmax cross-entropy (ref ``mp_layers.py:251`` +
+    ``c_softmax_with_cross_entropy_op.cu``): logits arrive 'mp'-sharded on
+    the vocab dim; the log-sum-exp reduction psums over mp. Written as plain
+    softmax-CE with a sharding constraint — XLA partitions the reductions."""
+
+    def __init__(self, mp_group=None, name=None, ignore_index=-100):
+        super().__init__()
+        self.ignore_index = ignore_index
+
+    def forward(self, logits, label):
+        logits = mark_sharding(
+            logits, *((None,) * (len(logits.shape) - 1) + ("mp",)))
+        return F.cross_entropy(logits, label, reduction="none",
+                               ignore_index=self.ignore_index)
+
+
+def sharding_rule_from_model(model: Layer, default=None):
+    """Build a ``rule(name, shape) -> spec`` for
+    :func:`parallel.make_sharded_train_step` from ``Parameter.pspec``
+    annotations placed by the parallel layers (the TPU analog of the
+    reference's per-layer weight slicing at construction)."""
+    specs = {name: getattr(p, "pspec", None)
+             for name, p in model.named_parameters()}
+
+    def rule(name, shape):
+        spec = specs.get(name)
+        if spec is None:
+            spec = default(name, shape) if default else (None,) * len(shape)
+        return spec
+
+    return rule

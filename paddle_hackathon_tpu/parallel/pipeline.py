@@ -1,0 +1,150 @@
+"""Pipeline parallelism as a single SPMD program.
+
+Ref ``python/paddle/distributed/fleet/meta_parallel/pipeline_parallel.py``:
+``PipelineParallel.forward_backward_pipeline`` (:82-152) runs a 1F1B
+schedule with explicit p2p send/recv between per-stage processes
+(``pp_utils/p2p_communication.py:276``), microbatches = accumulate_steps,
+and ``PipelineLayer`` (``parallel_layers/pp_layers.py:162``) segments a
+layer list across stages.
+
+TPU-native design (single-controller SPMD — there is no per-stage process
+to run a 1F1B loop in): the whole pipeline is ONE jitted program over the
+'pp' mesh axis. Stage weights live sharded on 'pp' (leading stage dim);
+a ``lax.scan`` over ``n_micro + n_stages - 1`` ticks runs every stage in
+lockstep, handing activations to the next stage with ``ppermute`` — the
+collective-permute schedule SURVEY §7 prescribes. ``jax.grad`` through the
+scan + ppermute yields the reverse pipeline automatically (the backward
+bubble mirrors the forward one), and XLA's latency-hiding scheduler
+overlaps the permute transfers with stage compute — the role of the
+reference's dedicated comm streams. Other mesh axes (dp/mp/sharding) stay
+GSPMD-managed via ``shard_map(..., auto=...)``, so PP composes with
+TP/DP/ZeRO exactly like the reference's 4-D hybrid.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def num_stages(mesh: Mesh) -> int:
+    return mesh.shape.get("pp", 1)
+
+
+def pipeline_apply(block_fn: Callable, stage_params: Any, x_mb: jax.Array,
+                   mesh: Mesh, extra: Any = None):
+    """Run microbatches through ``n_stages`` sequential stage applications.
+
+    Args:
+      block_fn: ``(params_slice, x, extra) -> y`` — one stage's compute.
+        ``params_slice`` leaves have leading dim ``layers_per_stage`` (the
+        stage's chunk of the stacked layer params); ``x`` and ``y`` must have
+        identical shape/dtype (transformer-block invariant).
+      stage_params: pytree whose leaves are stacked over stages on dim 0
+        (total leading dim = n_stages * layers_per_stage), sharded P('pp').
+      x_mb: (n_micro, mb, ...) microbatched stage-0 input, replicated on pp.
+      extra: per-microbatch side input pytree, leaves (n_micro, ...), passed
+        to every stage (e.g. position ids); replicated on pp.
+
+    Returns (n_micro, mb, ...) last-stage outputs, replicated over 'pp'.
+    """
+    n_stages_ = num_stages(mesh)
+    n_micro = x_mb.shape[0]
+
+    if n_stages_ == 1:
+        if extra is not None:
+            return jax.vmap(
+                lambda x, e: block_fn(stage_params, x, e))(x_mb, extra)
+        return jax.vmap(lambda x: block_fn(stage_params, x, None))(x_mb)
+
+    def spmd(params, xs, ex):
+        # params leaves: (layers_per_stage, ...) local slice
+        stage = jax.lax.axis_index("pp")
+        is_first = stage == 0
+        is_last = stage == n_stages_ - 1
+        perm = [(i, (i + 1) % n_stages_) for i in range(n_stages_)]
+
+        zero_state = jnp.zeros(xs.shape[1:], xs.dtype)
+        outputs = jnp.zeros_like(xs)
+
+        def tick(carry, t):
+            recv, outputs = carry
+            mb_idx = jnp.clip(t, 0, n_micro - 1)
+            x_in = jax.lax.dynamic_index_in_dim(xs, mb_idx, 0, keepdims=False)
+            state = jnp.where(is_first, x_in, recv)
+            e_t = None
+            if ex is not None:
+                # stage s at tick t is processing microbatch t - s
+                my_mb = jnp.clip(t - stage, 0, n_micro - 1)
+                e_t = jax.tree.map(
+                    lambda a: jax.lax.dynamic_index_in_dim(
+                        a, my_mb, 0, keepdims=False), ex)
+            y = block_fn(params, state, e_t)
+            out_idx = t - (n_stages_ - 1)
+            idx = jnp.maximum(out_idx, 0)
+            cur = jax.lax.dynamic_index_in_dim(outputs, idx, 0,
+                                               keepdims=False)
+            newval = jnp.where(out_idx >= 0, y, cur)
+            outputs = jax.lax.dynamic_update_index_in_dim(
+                outputs, newval, idx, 0)
+            send = jax.lax.ppermute(y, "pp", perm)
+            return (send, outputs), None
+
+        (_, outputs), _ = jax.lax.scan(
+            tick, (zero_state, outputs), jnp.arange(n_micro + n_stages_ - 1))
+        # only the last stage holds real outputs — replicate over pp
+        mask = jnp.where(is_last, 1.0, 0.0).astype(outputs.dtype)
+        return jax.lax.psum(outputs * mask, "pp")
+
+    from ._smap import run_shard_map
+    return run_shard_map(
+        spmd, mesh,
+        in_specs=(jax.tree.map(lambda _: P("pp"), stage_params),
+                  P(), jax.tree.map(lambda _: P(), extra)
+                  if extra is not None else P()),
+        out_specs=P(),
+        manual_axes={"pp"},
+        args=(stage_params, x_mb, extra))
+
+
+class LayerDesc:
+    """Deferred layer construction for stage segmentation
+    (ref ``parallel_layers/pp_layers.py:120`` ``LayerDesc``)."""
+
+    def __init__(self, layer_cls, *args, **kwargs):
+        self.layer_cls = layer_cls
+        self.args, self.kwargs = args, kwargs
+
+    def build(self):
+        return self.layer_cls(*self.args, **self.kwargs)
+
+
+class SharedLayerDesc(LayerDesc):
+    """Ref ``pp_layers.py:77`` — weight shared across stages (e.g. tied
+    embedding/head). In SPMD the tied weight simply lives replicated on
+    'pp'; the grad-allreduce the reference does by hand
+    (``pipeline_parallel.py:149``) falls out of AD."""
+
+    def __init__(self, key, layer_cls, *args, forward_func=None, **kwargs):
+        super().__init__(layer_cls, *args, **kwargs)
+        self.key = key
+        self.forward_func = forward_func
+
+
+def stack_layer_params(layers) -> dict:
+    """Stack the parameters of N structurally-identical layers into single
+    arrays with a leading layer dim — the layout ``pipeline_apply`` (and
+    ``lax.scan`` over layers) consumes. Returns {param_name: (N, ...)}."""
+    all_params = [dict(l.named_parameters()) for l in layers]
+    keys = list(all_params[0].keys())
+    return {k: jnp.stack([p[k]._value for p in all_params]) for k in keys}
+
+
+def unstack_into_layers(layers, stacked: dict) -> None:
+    """Write stacked (N, ...) arrays back into N layers' parameters."""
+    for i, l in enumerate(layers):
+        for k, p in dict(l.named_parameters()).items():
+            p._set_value(stacked[k][i])

@@ -1,0 +1,160 @@
+"""Sequence / context parallelism: ring attention + Ulysses all-to-all.
+
+The reference has NO sequence parallelism (SURVEY §5.7 — repo-wide grep
+confirms absence); its long-sequence story is TP head-splitting + activation
+recompute (``fleet/utils/recompute.py:350``). This module supplies the
+capability at parity with the north star, TPU-native:
+
+- **Ring attention** (`ring_attention`): sequence sharded over the 'sp'
+  mesh axis; K/V blocks rotate around the ring with ``ppermute`` while each
+  device accumulates flash-style online softmax — O(s/n) activation memory
+  per device, compute/comm overlapped by XLA's latency-hiding scheduler
+  over ICI. (Liu et al. 2023 ring attention; blockwise softmax from flash
+  attention.)
+- **Ulysses** (`ulysses_attention`): all-to-all re-shard seq->heads before
+  attention and heads->seq after — one a2a pair instead of a ring, best
+  when num_heads >= sp_degree.
+
+Both are written with ``shard_map`` over 'sp' (other axes stay
+GSPMD-managed) and are exact — tests check equality with single-device
+attention.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def _block_attn(q, k, v, scale, bias):
+    """One (q-block x kv-block) attention partial: returns (out_unnorm,
+    row_max, row_sumexp) for online-softmax accumulation.
+    q: (b, sq, h, d), k/v: (b, sk, h, d)."""
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if bias is not None:
+        s = s + bias
+    m = jnp.max(s, axis=-1)                       # (b, h, q)
+    p = jnp.exp(s - m[..., None])
+    l = jnp.sum(p, axis=-1)                       # (b, h, q)
+    o = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+    return o, m, l
+
+
+def ring_attention(q, k, v, mesh: Mesh, axis: str = "sp",
+                   causal: bool = True, scale: Optional[float] = None):
+    """Exact attention over a sequence sharded on ``axis``.
+
+    q, k, v: (b, s, h, d) global arrays with s sharded over ``axis``
+    (P(None, axis, None, None)). Returns same-shaped, same-sharded output.
+    """
+    n = mesh.shape.get(axis, 1)
+    if n == 1:
+        return _plain_attention(q, k, v, causal, scale)
+    scale_ = scale if scale is not None else q.shape[-1] ** -0.5
+    seq_local = q.shape[1] // n
+
+    def spmd(ql, kl, vl):
+        # ql/kl/vl: (b, s/n, h, d) — this device's sequence chunk
+        my = jax.lax.axis_index(axis)
+        neg = jnp.finfo(jnp.float32).min
+
+        def chunk_bias(kv_rank):
+            if not causal:
+                return None
+            # global positions: q rows my*seq_local + i, k cols kv_rank*seq_local + j
+            qpos = my * seq_local + jnp.arange(seq_local)
+            kpos = kv_rank * seq_local + jnp.arange(seq_local)
+            mask = qpos[:, None] >= kpos[None, :]
+            return jnp.where(mask, 0.0, neg)[None, None]  # (1,1,sq,sk)
+
+        perm = [(i, (i + 1) % n) for i in range(n)]
+
+        def step(carry, i):
+            kc, vc, o, m, l = carry
+            kv_rank = (my - i) % n  # whose chunk we currently hold
+            bias = chunk_bias(kv_rank)
+            oi, mi, li = _block_attn(ql.astype(jnp.float32),
+                                     kc.astype(jnp.float32),
+                                     vc.astype(jnp.float32), scale_, bias)
+            m_new = jnp.maximum(m, mi)
+            alpha = jnp.exp(m - m_new)        # rescale old accumulator
+            beta = jnp.exp(mi - m_new)
+            l_new = l * alpha + li * beta
+            o_new = (o * alpha.transpose(0, 2, 1)[..., None]
+                     + oi * beta.transpose(0, 2, 1)[..., None])
+            kc = jax.lax.ppermute(kc, axis, perm)
+            vc = jax.lax.ppermute(vc, axis, perm)
+            return (kc, vc, o_new, m_new, l_new), None
+
+        b, sl, h, d = ql.shape
+        o0 = jnp.zeros((b, sl, h, d), jnp.float32)
+        m0 = jnp.full((b, h, sl), jnp.finfo(jnp.float32).min)
+        l0 = jnp.zeros((b, h, sl))
+        (kc, vc, o, m, l), _ = jax.lax.scan(
+            step, (kl, vl, o0, m0, l0), jnp.arange(n))
+        out = o / jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
+        return out.astype(ql.dtype)
+
+    from ._smap import run_shard_map
+    return run_shard_map(
+        spmd, mesh,
+        in_specs=(P(None, axis), P(None, axis), P(None, axis)),
+        out_specs=P(None, axis),
+        manual_axes={axis},
+        args=(q, k, v))
+
+
+def ulysses_attention(q, k, v, mesh: Mesh, axis: str = "sp",
+                      causal: bool = True, scale: Optional[float] = None):
+    """DeepSpeed-Ulysses style SP: a2a seq->head shards, full-sequence local
+    attention over h/n heads, a2a back. Requires num_heads % sp == 0."""
+    n = mesh.shape.get(axis, 1)
+    if n == 1:
+        return _plain_attention(q, k, v, causal, scale)
+    scale_ = scale if scale is not None else q.shape[-1] ** -0.5
+    assert q.shape[2] % n == 0, "ulysses needs num_heads divisible by sp"
+
+    def spmd(ql, kl, vl):
+        def seq_to_heads(x):
+            # (b, s/n, h, d) -> (b, s, h/n, d)
+            return jax.lax.all_to_all(x, axis, split_axis=2, concat_axis=1,
+                                      tiled=True)
+
+        def heads_to_seq(x):
+            return jax.lax.all_to_all(x, axis, split_axis=1, concat_axis=2,
+                                      tiled=True)
+
+        qh, kh, vh = seq_to_heads(ql), seq_to_heads(kl), seq_to_heads(vl)
+        bias = None
+        if causal:
+            s = qh.shape[1]
+            mask = jnp.tril(jnp.ones((s, s), bool))
+            bias = jnp.where(mask, 0.0, jnp.finfo(jnp.float32).min)[None, None]
+        o, m, l = _block_attn(qh.astype(jnp.float32), kh.astype(jnp.float32),
+                              vh.astype(jnp.float32), scale_, bias)
+        out = o / jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
+        return heads_to_seq(out.astype(ql.dtype))
+
+    from ._smap import run_shard_map
+    return run_shard_map(
+        spmd, mesh,
+        in_specs=(P(None, axis), P(None, axis), P(None, axis)),
+        out_specs=P(None, axis),
+        manual_axes={axis},
+        args=(q, k, v))
+
+
+def _plain_attention(q, k, v, causal, scale):
+    scale_ = scale if scale is not None else q.shape[-1] ** -0.5
+    bias = None
+    if causal:
+        s, sk = q.shape[1], k.shape[1]
+        mask = jnp.tril(jnp.ones((s, sk), bool), k=sk - s)
+        bias = jnp.where(mask, 0.0, jnp.finfo(jnp.float32).min)[None, None]
+    o, m, l = _block_attn(q.astype(jnp.float32), k.astype(jnp.float32),
+                          v.astype(jnp.float32), scale_, bias)
+    out = o / jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)

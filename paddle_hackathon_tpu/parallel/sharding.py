@@ -1,0 +1,100 @@
+"""Group-sharded (ZeRO) data parallel — user-facing API.
+
+Ref ``python/paddle/distributed/sharding/group_sharded.py:40``
+(``group_sharded_parallel``: level 'os' = stage 1, 'os_g' = stage 2,
+'p_g_os' = stage 3) and the stage implementations
+``group_sharded_stage2.py:49`` / ``group_sharded_stage3.py:60`` +
+``GroupShardedOptimizerStage2`` (param-to-rank assignment, grad slice
+reduce) and flat storage ``group_sharded_storage.py``.
+
+TPU-native design: "assign param/grad/state shards to ranks" becomes
+"shard the arrays over the 'sharding' mesh axis" — XLA then keeps grads
+reduce-scattered and gathers params on use (stage-3/FSDP) automatically;
+the hand-written bucket storage, slice-reduce hooks and gather-on-forward
+of the reference all fall out of GSPMD sharding propagation. In the
+one-program training path (``parallel.make_sharded_train_step``) this is
+the ``zero_stage`` argument; this module provides the same capability for
+the *eager* model+optimizer workflow.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..nn.layer import Layer
+from . import api as _mesh_api
+
+_LEVELS = ("os", "os_g", "p_g_os")
+
+
+def _shard_spec_for(shape, mesh, axis="sharding", existing=None):
+    """Shard the first divisible, unsharded dim over ``axis``."""
+    spec = list(existing) if existing else [None] * len(shape)
+    n = mesh.shape.get(axis, 1)
+    if n > 1 and axis not in spec:
+        for i, (dim, s) in enumerate(zip(shape, spec)):
+            if s is None and dim % n == 0:
+                spec[i] = axis
+                break
+    return tuple(a if a in mesh.axis_names or a is None else None
+                 for a in spec)
+
+
+def group_sharded_parallel(model: Layer, optimizer, level: str = "os_g",
+                           scaler=None, group=None, offload: bool = False,
+                           sync_buffers: bool = False, buffer_max_size=None,
+                           segment_size=None, sync_comm: bool = False):
+    """Shard a model/optimizer over the 'sharding' mesh axis
+    (ref ``group_sharded.py:40`` — same signature shape).
+
+    level:
+      'os'     — optimizer states sharded (ZeRO-1)
+      'os_g'   — + gradients effectively reduce-scattered (ZeRO-2); with
+                 XLA this is the same placement, grads inherit it
+      'p_g_os' — + parameters sharded, gathered on use (ZeRO-3 / FSDP)
+    """
+    if level not in _LEVELS:
+        raise ValueError(f"level must be one of {_LEVELS}, got {level!r}")
+    mesh = _mesh_api.get_mesh()
+    if mesh is None or mesh.shape.get("sharding", 1) <= 1:
+        return model, optimizer, scaler  # degenerate: nothing to shard over
+
+    if level == "p_g_os":
+        for name, p in model.named_parameters():
+            spec = _shard_spec_for(p.shape, mesh,
+                                   existing=getattr(p, "pspec", None))
+            p._set_value(jax.device_put(
+                p._value, NamedSharding(mesh, P(*spec))))
+            p.pspec = spec
+
+    # optimizer states always shard (that's stage >= 1): wrap accumulator
+    # creation so every new state lands 'sharding'-sharded.
+    orig_init = optimizer._init_accumulators
+
+    def sharded_init(param):
+        acc = orig_init(param)
+        out = {}
+        for k, v in acc.items():
+            spec = _shard_spec_for(v.shape, mesh,
+                                   existing=getattr(param, "pspec", None))
+            out[k] = jax.device_put(v, NamedSharding(mesh, P(*spec)))
+        return out
+
+    optimizer._init_accumulators = sharded_init
+    return model, optimizer, scaler
+
+
+def save_group_sharded_model(model: Layer, output: str, optimizer=None):
+    """Ref ``group_sharded.py`` ``save_group_sharded_model`` — gathers shards
+    (device_get replicates) and saves full state."""
+    import os
+    from ..framework import io as fio
+    os.makedirs(output, exist_ok=True)
+    fio.save(model.state_dict(), os.path.join(output, "model.pdparams"))
+    if optimizer is not None:
+        fio.save(optimizer.state_dict(), os.path.join(output, "model.pdopt"))

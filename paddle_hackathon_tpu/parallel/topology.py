@@ -1,0 +1,204 @@
+"""4-D hybrid-parallel topology.
+
+Ref ``python/paddle/distributed/fleet/base/topology.py`` —
+``CommunicateTopology`` (``topology.py:52``) builds a cartesian rank mesh
+over axes ``[data, pipe, sharding, model]`` and ``HybridCommunicateGroup``
+(``topology.py:134``) derives the per-axis process groups + the rank's
+``ParallelMode`` (``topology.py:198-205``).
+
+TPU-native: the cartesian rank mesh IS a ``jax.sharding.Mesh`` — axis
+groups are just named axes, and "which group does rank r belong to" is
+implicit in SPMD. This module keeps the reference's query API (ranks,
+coords, per-axis groups/degrees) so hybrid strategies can be composed the
+same way, while the actual communicators are :class:`collective.Group`
+objects over mesh axes.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+from jax.sharding import Mesh
+
+from . import api as _mesh_api
+from .collective import Group
+
+
+class ParallelMode:
+    """Ref ``topology.py:30`` enum."""
+    DATA_PARALLEL = "data_parallel"
+    TENSOR_PARALLEL = "tensor_parallel"
+    PIPELINE_PARALLEL = "pipeline_parallel"
+    SHARDING_PARALLEL = "sharding_parallel"
+    SEQUENCE_PARALLEL = "sequence_parallel"
+    EXPERT_PARALLEL = "expert_parallel"
+
+
+# reference axis names -> this framework's mesh axis names
+_AXIS_ALIASES = {"data": "dp", "pipe": "pp", "model": "mp",
+                 "sharding": "sharding", "sep": "sp", "expert": "ep"}
+
+
+def _canon(axis: str) -> str:
+    return _AXIS_ALIASES.get(axis, axis)
+
+
+class CommunicateTopology:
+    """Cartesian rank topology (ref ``topology.py:52``)."""
+
+    def __init__(self, hybrid_group_names: Sequence[str] = ("data", "pipe",
+                                                            "sharding",
+                                                            "model"),
+                 dims: Sequence[int] = (1, 1, 1, 1)):
+        self._parallel_names = [_canon(n) for n in hybrid_group_names]
+        self._dims = list(dims)
+        self._world_size = int(np.prod(dims))
+        self._coord_to_rank = {
+            coord: rank for rank, coord in enumerate(
+                itertools.product(*(range(d) for d in dims)))}
+        self._rank_to_coord = {r: c for c, r in self._coord_to_rank.items()}
+
+    def get_hybrid_group_names(self) -> List[str]:
+        return list(self._parallel_names)
+
+    def get_dim(self, axis_name: str) -> int:
+        return self._dims[self._parallel_names.index(_canon(axis_name))]
+
+    get_dim_size = get_dim
+
+    def world_size(self) -> int:
+        return self._world_size
+
+    def get_rank(self, **kwargs) -> int:
+        coord = tuple(kwargs[n] for n in self._parallel_names)
+        return self._coord_to_rank[coord]
+
+    def get_coord(self, rank: int) -> Tuple[int, ...]:
+        return self._rank_to_coord[rank]
+
+    def get_axis_list(self, axis_name: str, index: int) -> List[int]:
+        """All ranks whose coordinate on ``axis_name`` equals ``index``."""
+        ax = self._parallel_names.index(_canon(axis_name))
+        return sorted(r for r, c in self._rank_to_coord.items()
+                      if c[ax] == index)
+
+    def get_comm_list(self, axis_name: str) -> List[List[int]]:
+        """Rank groups that communicate along ``axis_name`` (all coords on the
+        other axes fixed) — ref ``topology.py:109``."""
+        ax = self._parallel_names.index(_canon(axis_name))
+        other_ranges = [range(d) for i, d in enumerate(self._dims) if i != ax]
+        groups = []
+        for combo in itertools.product(*other_ranges):
+            group = []
+            for k in range(self._dims[ax]):
+                coord = list(combo)
+                coord.insert(ax, k)
+                group.append(self._coord_to_rank[tuple(coord)])
+            groups.append(group)
+        return groups
+
+
+class HybridCommunicateGroup:
+    """Per-axis communicators + parallel-mode selection
+    (ref ``topology.py:134``)."""
+
+    def __init__(self, topology: CommunicateTopology,
+                 mesh: Optional[Mesh] = None):
+        self._topo = topology
+        self._mesh = mesh or _mesh_api.get_mesh()
+        names = topology.get_hybrid_group_names()
+        self._degrees = {n: topology.get_dim(n) for n in names}
+
+    # --- degrees (ref topology.py:160-175) ---
+    def get_data_parallel_world_size(self) -> int:
+        return self._degrees.get("dp", 1)
+
+    def get_model_parallel_world_size(self) -> int:
+        return self._degrees.get("mp", 1)
+
+    def get_pipe_parallel_world_size(self) -> int:
+        return self._degrees.get("pp", 1)
+
+    def get_sharding_parallel_world_size(self) -> int:
+        return self._degrees.get("sharding", 1)
+
+    def get_sequence_parallel_world_size(self) -> int:
+        return self._degrees.get("sp", 1)
+
+    # --- groups: named-axis communicators ---
+    def _group(self, axis: str) -> Group:
+        return Group(axis, self._mesh)
+
+    def get_data_parallel_group(self) -> Group:
+        return self._group("dp")
+
+    def get_model_parallel_group(self) -> Group:
+        return self._group("mp")
+
+    def get_pipe_parallel_group(self) -> Group:
+        return self._group("pp")
+
+    def get_sharding_parallel_group(self) -> Group:
+        return self._group("sharding")
+
+    def get_sequence_parallel_group(self) -> Group:
+        return self._group("sp")
+
+    def get_check_parallel_group(self) -> Group:
+        """Everything except dp — used by the reference for parameter-sync
+        sanity checks across the non-data axes."""
+        axes = tuple(a for a in self._topo.get_hybrid_group_names()
+                     if a != "dp" and self._degrees.get(a, 1) > 1)
+        return Group(axes or ("dp",), self._mesh)
+
+    def get_parallel_mode(self) -> str:
+        """Ref ``topology.py:198-205`` priority: sharding > mp > pp > dp."""
+        if self._degrees.get("sharding", 1) > 1 and all(
+                self._degrees.get(a, 1) == 1 for a in ("mp", "pp")):
+            return ParallelMode.SHARDING_PARALLEL
+        if self._degrees.get("pp", 1) > 1:
+            return ParallelMode.PIPELINE_PARALLEL
+        if self._degrees.get("mp", 1) > 1:
+            return ParallelMode.TENSOR_PARALLEL
+        return ParallelMode.DATA_PARALLEL
+
+    @property
+    def topology(self) -> CommunicateTopology:
+        return self._topo
+
+    @property
+    def mesh(self) -> Mesh:
+        return self._mesh
+
+
+_hcg: Optional[HybridCommunicateGroup] = None
+
+
+def set_hybrid_communicate_group(hcg: HybridCommunicateGroup) -> None:
+    global _hcg
+    _hcg = hcg
+
+
+def get_hybrid_communicate_group() -> Optional[HybridCommunicateGroup]:
+    return _hcg
+
+
+def init_hybrid_parallel(dp: int = 1, mp: int = 1, pp: int = 1,
+                         sharding: int = 1, sp: int = 1,
+                         devices=None) -> HybridCommunicateGroup:
+    """One-call hybrid setup (ref ``fleet_base.py:381-408``
+    ``_init_hybrid_parallel_env``): builds the mesh (axis order pp, dp,
+    sharding, mp, sp — model axes innermost on ICI, matching the reference's
+    ordering where mp groups are nearest neighbours), the topology, and the
+    HCG."""
+    dims = {"pp": pp, "dp": dp, "sharding": sharding, "mp": mp, "sp": sp}
+    active = {k: v for k, v in dims.items() if v > 1}
+    if not active:
+        active = {"dp": 1}
+    mesh = _mesh_api.create_mesh(active, devices=devices)
+    topo = CommunicateTopology(list(active.keys()), list(active.values()))
+    hcg = HybridCommunicateGroup(topo, mesh)
+    set_hybrid_communicate_group(hcg)
+    return hcg
