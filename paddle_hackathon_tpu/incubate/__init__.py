@@ -1,0 +1,19 @@
+"""paddle.incubate equivalent — staging surface.
+
+Ref ``python/paddle/incubate/``: fused transformer layers + functionals
+(Pallas flash attention on TPU), ASP n:m sparsity, functional autograd
+(jvp/vjp/Jacobian/Hessian), LookAhead/ModelAverage optimizers. MoE lives in
+``parallel.moe`` (re-exported here as ``incubate.distributed`` namespace
+parity).
+"""
+
+from . import asp, autograd, nn, optimizer  # noqa: F401
+from .optimizer import DistributedFusedLamb, LookAhead, ModelAverage  # noqa: F401
+
+
+def autotune(config=None):
+    """paddle.incubate.autotune stub — on TPU, kernel autotuning is XLA's
+    job (autotuner runs inside the compiler); layout autotune is subsumed by
+    XLA layout assignment. Accepts and ignores the reference's config dict
+    (ref incubate/autotune.py)."""
+    return None

@@ -1,0 +1,89 @@
+"""ASP — automatic structured (n:m) sparsity.
+
+Ref ``python/paddle/incubate/asp/`` — ``prune_model``, ``decorate``,
+``calculate_density``, mask algorithms (mask_1d / best-in-group by
+magnitude). The reference targets Ampere sparse tensor cores; on TPU n:m
+masks are a magnitude-pruning capability (XLA has no sparse MXU path), so
+the semantics — masks computed once, re-applied after every optimizer step
+so pruned weights stay zero — are preserved exactly.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+_excluded = set()
+_masks = {}  # id(param) -> mask array
+
+
+def set_excluded_layers(param_names, main_program=None):
+    _excluded.update(param_names)
+
+
+def reset_excluded_layers(main_program=None):
+    _excluded.clear()
+
+
+def calculate_density(x) -> float:
+    arr = np.asarray(getattr(x, "_value", x))
+    return float((arr != 0).sum() / arr.size)
+
+
+def _nm_mask_1d(w: np.ndarray, n: int, m: int) -> np.ndarray:
+    """Keep the n largest-|w| entries in every group of m along the last
+    axis (ref sparsity/utils.py get_mask_1d)."""
+    orig = w.shape
+    flat = w.reshape(-1, orig[-1])
+    cols = orig[-1]
+    pad = (-cols) % m
+    if pad:
+        flat = np.pad(flat, ((0, 0), (0, pad)))
+    g = flat.reshape(flat.shape[0], -1, m)
+    idx = np.argsort(np.abs(g), axis=-1)[..., : m - n]  # smallest m-n -> drop
+    mask = np.ones_like(g, dtype=bool)
+    np.put_along_axis(mask, idx, False, axis=-1)
+    mask = mask.reshape(flat.shape[0], -1)[:, :cols]
+    return mask.reshape(orig)
+
+
+def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
+    """Compute and apply n:m masks to every prunable parameter of
+    ``model`` (2-D+ weights, not biases/norms, not excluded)."""
+    pruned = {}
+    for name, p in model.named_parameters():
+        if name in _excluded or p.ndim < 2:
+            continue
+        w = np.asarray(p._value)
+        mask = _nm_mask_1d(w, n, m)
+        p._set_value(jnp.asarray(w * mask, dtype=p._value.dtype))
+        if with_mask:
+            _masks[id(p)] = jnp.asarray(mask, dtype=p._value.dtype)
+        pruned[name] = mask
+    return pruned
+
+
+class OptimizerWithSparsityGuarantee:
+    """Wraps an optimizer: after each step, re-applies the stored masks so
+    pruned entries stay zero (ref asp.py ASPHelper._decorate)."""
+
+    def __init__(self, optimizer):
+        self._optimizer = optimizer
+
+    def __getattr__(self, item):
+        return getattr(self._optimizer, item)
+
+    def step(self):
+        self._optimizer.step()
+        for p in self._optimizer._parameter_list:
+            mask = _masks.get(id(p))
+            if mask is not None:
+                p._set_value(p._value * mask)
+
+
+def decorate(optimizer):
+    return OptimizerWithSparsityGuarantee(optimizer)
+
+
+__all__ = ["prune_model", "decorate", "calculate_density",
+           "set_excluded_layers", "reset_excluded_layers"]

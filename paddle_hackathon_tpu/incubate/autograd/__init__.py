@@ -1,0 +1,142 @@
+"""Functional autograd transforms (ref ``python/paddle/incubate/autograd/
+functional.py`` — jvp:23, vjp:81, Jacobian:172, plus Hessian).
+
+The reference implements these with its primitive-rule AD (``primx.py``,
+``primrules.py``); here they are direct applications of JAX's functional
+transforms — the framework's ops are jax-traceable, so forward- and
+reverse-mode compose for free (including the higher-order cases the eager
+tape declines).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+
+
+def _unwrap(xs):
+    if isinstance(xs, (list, tuple)):
+        return tuple(x._value if isinstance(x, Tensor) else jnp.asarray(x)
+                     for x in xs)
+    return (xs._value if isinstance(xs, Tensor) else jnp.asarray(xs),)
+
+
+def _wrap(vals):
+    if isinstance(vals, (list, tuple)):
+        out = tuple(Tensor(v, stop_gradient=True) for v in vals)
+        return out[0] if len(out) == 1 else out
+    return Tensor(vals, stop_gradient=True)
+
+
+def _as_jax_fn(func):
+    """Lift a Tensor->Tensor function to a pure jax function."""
+
+    def fn(*jax_xs):
+        with_tensors = [Tensor(x, stop_gradient=False) for x in jax_xs]
+        out = func(*with_tensors)
+        if isinstance(out, (list, tuple)):
+            return tuple(o._value if isinstance(o, Tensor) else o for o in out)
+        return out._value if isinstance(out, Tensor) else out
+
+    return fn
+
+
+def jvp(func, xs, v=None):
+    """Forward-mode Jacobian-vector product. Returns (outputs, jvp)."""
+    jax_xs = _unwrap(xs)
+    tangents = (_unwrap(v) if v is not None
+                else tuple(jnp.ones_like(x) for x in jax_xs))
+    out, tangent_out = jax.jvp(_as_jax_fn(func), jax_xs, tangents)
+    return _wrap(out), _wrap(tangent_out)
+
+
+def vjp(func, xs, v=None):
+    """Reverse-mode vector-Jacobian product. Returns (outputs, vjp)."""
+    jax_xs = _unwrap(xs)
+    out, vjp_fn = jax.vjp(_as_jax_fn(func), *jax_xs)
+    if v is None:
+        cot = (jax.tree_util.tree_map(jnp.ones_like, out)
+               if isinstance(out, tuple) else jnp.ones_like(out))
+    else:
+        cot = _unwrap(v)
+        cot = cot if isinstance(out, tuple) else cot[0]
+    grads = vjp_fn(cot)
+    return _wrap(out), _wrap(grads)
+
+
+class Jacobian:
+    """Lazy full Jacobian (ref functional.py:172). Index as J[:] or J[i, j]."""
+
+    def __init__(self, func, xs, is_batched=False):
+        jax_xs = _unwrap(xs)
+        jac = jax.jacrev(_as_jax_fn(func), argnums=tuple(range(len(jax_xs))))(
+            *jax_xs)
+        if len(jax_xs) == 1 and not isinstance(jac, tuple):
+            jac = (jac,)
+        flat = []
+        for j in jac if isinstance(jac, tuple) else (jac,):
+            arr = j
+            if is_batched:
+                b = arr.shape[0]
+                flat.append(arr.reshape(b, -1, *([1] if arr.ndim < 3 else []))
+                            if arr.ndim < 3 else
+                            arr.reshape(b, arr.shape[1], -1))
+            else:
+                flat.append(arr.reshape(_rows(arr), -1))
+        self._value = jnp.concatenate(flat, axis=-1)
+        self.is_batched = is_batched
+
+    @property
+    def shape(self):
+        return tuple(self._value.shape)
+
+    def __getitem__(self, idx):
+        return Tensor(self._value[idx], stop_gradient=True)
+
+    def numpy(self):
+        import numpy as np
+        return np.asarray(self._value)
+
+
+def _rows(arr):
+    # output dims come first in jacrev's result; collapse to 2-D [out, in]
+    return arr.shape[0] if arr.ndim >= 1 else 1
+
+
+class Hessian:
+    """Full Hessian of a scalar function (ref functional.py Hessian)."""
+
+    def __init__(self, func, xs, is_batched=False):
+        jax_xs = _unwrap(xs)
+        hes = jax.hessian(_as_jax_fn(func), argnums=tuple(range(len(jax_xs))))(
+            *jax_xs)
+        if len(jax_xs) == 1:
+            h = hes[0][0] if isinstance(hes, tuple) else hes
+            n = 1
+            for s in jax_xs[0].shape:
+                n *= s
+            self._value = jnp.reshape(h, (n, n))
+        else:
+            blocks = []
+            sizes = [int(jnp.size(x)) for x in jax_xs]
+            for i, row in enumerate(hes):
+                blocks.append(jnp.concatenate(
+                    [jnp.reshape(row[j], (sizes[i], sizes[j]))
+                     for j in range(len(jax_xs))], axis=1))
+            self._value = jnp.concatenate(blocks, axis=0)
+
+    @property
+    def shape(self):
+        return tuple(self._value.shape)
+
+    def __getitem__(self, idx):
+        return Tensor(self._value[idx], stop_gradient=True)
+
+    def numpy(self):
+        import numpy as np
+        return np.asarray(self._value)
+
+
+__all__ = ["jvp", "vjp", "Jacobian", "Hessian"]

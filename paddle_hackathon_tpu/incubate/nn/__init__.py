@@ -1,0 +1,4 @@
+from . import functional, kernels  # noqa: F401
+from .layer.fused_transformer import (  # noqa: F401
+    FusedFeedForward, FusedMultiHeadAttention, FusedMultiTransformer,
+    FusedTransformerEncoderLayer)

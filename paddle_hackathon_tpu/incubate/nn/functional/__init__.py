@@ -1,0 +1,229 @@
+"""Fused functionals (ref ``python/paddle/incubate/nn/functional/``).
+
+The reference backs these with hand-written fused CUDA kernels
+(``paddle/fluid/operators/fused/fused_attention_op.cu``,
+``fused_feedforward_op.cu``, ``fused_gemm_epilogue_op.cu``,
+``fused_layernorm_residual_dropout_bias.h``). Here attention is a Pallas
+TPU kernel; the elementwise chains (layernorm+residual+dropout,
+gemm+bias+activation) are expressed as single taped ops whose bodies XLA
+fuses into one HBM pass — the TPU-correct way to get what the CUDA fusions
+buy, without hand-scheduling.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ....core.autograd import apply_op
+from ....core.tensor import Tensor
+from ..kernels import flash_attention as _fa
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+
+
+def _pad_lanes(x, d):
+    pad = (-d) % 128
+    if pad:
+        x = jnp.pad(x, ((0, 0), (0, 0), (0, pad)))
+    return x
+
+
+def flash_attention_bshd(query, key, value, causal=False, sm_scale=None):
+    """Flash attention over paddle-layout (batch, seq, heads, head_dim).
+
+    Falls back to the caller's XLA path by raising if shapes don't qualify.
+    """
+    b, sq, h, d = query.shape
+    skv = key.shape[1]
+    if not _fa.supported(sq, skv):
+        raise ValueError(f"flash kernel unsupported for seq ({sq},{skv})")
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
+
+    def fn(q, k, v):
+        def to_bhd(x, s):
+            x = jnp.swapaxes(x, 1, 2)           # b h s d
+            x = x.reshape(b * h, s, d)
+            return _pad_lanes(x, d)
+
+        out = _fa.flash_attention_bhd(
+            to_bhd(q, sq), to_bhd(k, skv), to_bhd(v, skv), causal, scale)
+        out = out[:, :, :d].reshape(b, h, sq, d)
+        return jnp.swapaxes(out, 1, 2)          # b s h d
+
+    return apply_op("flash_attention", fn, [_t(query), _t(key), _t(value)])
+
+
+def flash_attention(query, key, value, dropout=0.0, causal=False,
+                    return_softmax=False, name=None):
+    """paddle.incubate flash_attention-style API: returns (out, softmax)."""
+    assert not return_softmax, "flash kernel never materialises softmax"
+    if dropout:
+        raise NotImplementedError(
+            "attention-probability dropout inside the flash kernel is not "
+            "implemented; use nn.functional.scaled_dot_product_attention "
+            "(XLA path) when dropout_p > 0")
+    out = flash_attention_bshd(query, key, value, causal=causal)
+    return out, None
+
+
+def fused_layer_norm(x, norm_weight=None, norm_bias=None, epsilon=1e-5,
+                     residual=None, bias=None, dropout_rate=0.0,
+                     training=True, rng_key=None):
+    """layernorm(residual + dropout(x + bias)) in one taped op.
+
+    Ref ``fused_layernorm_residual_dropout_bias.h`` — one HBM pass; XLA
+    fuses this body into a single loop the same way.
+    Returns (out, residual_out).
+    """
+    args = [_t(x)]
+    names = ["x"]
+    for nm, v in (("norm_weight", norm_weight), ("norm_bias", norm_bias),
+                  ("residual", residual), ("bias", bias)):
+        if v is not None:
+            args.append(_t(v))
+            names.append(nm)
+
+    drop_key = None
+    if dropout_rate > 0.0 and training:
+        if rng_key is None:
+            from ....core import random as core_random
+            drop_key = core_random.split_key()
+        else:
+            drop_key = rng_key
+
+    def fn(*vals):
+        d = dict(zip(names, vals))
+        h = d["x"]
+        if "bias" in d:
+            h = h + d["bias"]
+        if drop_key is not None:
+            keep = jax.random.bernoulli(drop_key, 1.0 - dropout_rate, h.shape)
+            h = jnp.where(keep, h / (1.0 - dropout_rate), 0.0).astype(h.dtype)
+        if "residual" in d:
+            h = h + d["residual"]
+        res_out = h
+        mu = jnp.mean(h, axis=-1, keepdims=True)
+        var = jnp.mean(jnp.square(h - mu), axis=-1, keepdims=True)
+        y = (h - mu) * jax.lax.rsqrt(var + epsilon)
+        if "norm_weight" in d:
+            y = y * d["norm_weight"]
+        if "norm_bias" in d:
+            y = y + d["norm_bias"]
+        return y.astype(h.dtype), res_out
+
+    return apply_op("fused_layer_norm", fn, args, n_outputs=2)
+
+
+def fused_dropout_add(x, y, p=0.0, training=True, mode="upscale_in_train"):
+    """dropout(x) + y as one op (ref fused_dropout_add in incubate)."""
+    drop_key = None
+    if p > 0.0 and training:
+        from ....core import random as core_random
+        drop_key = core_random.split_key()
+
+    def fn(a, b):
+        if drop_key is None:
+            # upscale_in_train: eval is identity (train already rescaled);
+            # downscale_in_infer: eval scales by the keep probability.
+            if not training and p > 0.0 and mode != "upscale_in_train":
+                a = a * (1.0 - p)
+            return a + b
+        keep = jax.random.bernoulli(drop_key, 1.0 - p, a.shape)
+        if mode == "upscale_in_train":
+            a = jnp.where(keep, a / (1.0 - p), 0.0).astype(a.dtype)
+        else:
+            a = jnp.where(keep, a, 0.0).astype(a.dtype)
+        return a + b
+
+    return apply_op("fused_dropout_add", fn, [_t(x), _t(y)])
+
+
+def fused_linear(x, weight, bias=None, transpose_weight=False,
+                 activation=None, name=None):
+    """matmul + bias + activation epilogue (ref fused_gemm_epilogue_op.cu,
+    cublasLt epilogue). XLA fuses the epilogue into the MXU matmul."""
+    args = [_t(x), _t(weight)] + ([_t(bias)] if bias is not None else [])
+
+    def fn(xv, wv, *rest):
+        if transpose_weight:
+            wv = wv.T
+        out = jnp.matmul(xv, wv)
+        if rest:
+            out = out + rest[0]
+        if activation in ("gelu",):
+            out = jax.nn.gelu(out)
+        elif activation in ("relu",):
+            out = jax.nn.relu(out)
+        return out
+
+    return apply_op("fused_linear", fn, args)
+
+
+def fused_feedforward(x, linear1_weight, linear1_bias, linear2_weight,
+                      linear2_bias, ln1_scale=None, ln1_bias=None,
+                      dropout1_rate=0.5, dropout2_rate=0.5,
+                      activation="relu", ln1_epsilon=1e-5,
+                      pre_layer_norm=False, training=True):
+    """Transformer FFN block as one taped op (ref fused_feedforward_op.cu).
+
+    out = residual + dropout2(linear2(dropout1(act(linear1(ln(x))))))
+    (post-LN applies layer_norm at the end instead).
+    """
+    args = [_t(x), _t(linear1_weight), _t(linear1_bias), _t(linear2_weight),
+            _t(linear2_bias)]
+    names = ["x", "w1", "b1", "w2", "b2"]
+    for nm, v in (("ln_scale", ln1_scale), ("ln_bias", ln1_bias)):
+        if v is not None:
+            args.append(_t(v))
+            names.append(nm)
+
+    keys = [None, None]
+    if training:
+        from ....core import random as core_random
+        if dropout1_rate > 0.0:
+            keys[0] = core_random.split_key()
+        if dropout2_rate > 0.0:
+            keys[1] = core_random.split_key()
+
+    def _drop(h, rate, key):
+        if key is None:
+            return h
+        keep = jax.random.bernoulli(key, 1.0 - rate, h.shape)
+        return jnp.where(keep, h / (1.0 - rate), 0.0).astype(h.dtype)
+
+    def _ln(h, d, eps):
+        mu = jnp.mean(h, axis=-1, keepdims=True)
+        var = jnp.mean(jnp.square(h - mu), axis=-1, keepdims=True)
+        y = (h - mu) * jax.lax.rsqrt(var + eps)
+        if "ln_scale" in d:
+            y = y * d["ln_scale"]
+        if "ln_bias" in d:
+            y = y + d["ln_bias"]
+        return y.astype(h.dtype)
+
+    def fn(*vals):
+        d = dict(zip(names, vals))
+        residual = d["x"]
+        h = _ln(d["x"], d, ln1_epsilon) if pre_layer_norm else d["x"]
+        h = jnp.matmul(h, d["w1"]) + d["b1"]
+        h = jax.nn.gelu(h) if activation == "gelu" else jax.nn.relu(h)
+        h = _drop(h, dropout1_rate, keys[0])
+        h = jnp.matmul(h, d["w2"]) + d["b2"]
+        h = _drop(h, dropout2_rate, keys[1])
+        out = residual + h
+        if not pre_layer_norm:
+            out = _ln(out, d, ln1_epsilon)
+        return out
+
+    return apply_op("fused_feedforward", fn, args)
+
+
+__all__ = [
+    "flash_attention", "flash_attention_bshd", "fused_layer_norm",
+    "fused_dropout_add", "fused_linear", "fused_feedforward",
+]

@@ -1,0 +1,1 @@
+from . import flash_attention  # noqa: F401

@@ -1,0 +1,356 @@
+"""Pallas TPU flash attention — forward and backward kernels.
+
+The reference has no flash attention; its fused attention CUDA ops
+(``paddle/fluid/operators/fused/fused_attention_op.cu``, ``fmha_ref.h``)
+materialise the full (s, s) probability matrix. On TPU the memory-bound
+classic attention wastes HBM bandwidth and caps sequence length, so the
+framework's fused-attention slot is filled with an online-softmax tiled
+kernel instead: O(s) memory, MXU-shaped (block_q x d) @ (d x block_kv)
+tiles, f32 accumulators in VMEM scratch.
+
+Layout contract: (batch*heads, seq, head_dim) arrays, head_dim padded to a
+lane multiple (128) by the caller. Gradients follow the standard two-kernel
+split (dk/dv accumulate over q blocks; dq accumulates over kv blocks) with
+the log-sum-exp saved from the forward pass and ``delta = rowsum(dO * O)``
+precomputed in XLA.
+
+On non-TPU backends the same kernels run under the Pallas interpreter so
+numerics are testable on the virtual CPU mesh.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+_NEG_INF = -1e30  # large-but-finite: keeps exp()=0 without inf-inf NaNs
+_LANES = 128
+
+
+def _prec(dtype):
+    # f32 operands: keep full precision (DEFAULT would run them at bf16
+    # MXU rate and lose bits). bf16 operands: DEFAULT — the global
+    # 'highest' default would request an fp32 contract Mosaic rejects.
+    return (jax.lax.Precision.HIGHEST if dtype == jnp.float32
+            else jax.lax.Precision.DEFAULT)
+
+
+def _interpret() -> bool:
+    return jax.default_backend() not in ("tpu", "axon")
+
+
+def _block_sizes(sq: int, skv: int):
+    bq = min(128, sq)
+    bkv = min(128, skv)
+    if sq % bq or skv % bkv:
+        return None
+    return bq, bkv
+
+
+def supported(sq: int, skv: int) -> bool:
+    """Whether the kernel handles these sequence lengths (else XLA path)."""
+    return _block_sizes(sq, skv) is not None
+
+
+# ---------------------------------------------------------------------------
+# Forward
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, kt_ref, v_ref, o_ref, lse_ref,
+                acc_ref, m_ref, l_ref, *, sm_scale, causal, block_q,
+                block_kv, n_kv):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    def _body():
+        q = q_ref[0]
+        kt = kt_ref[0]                           # (d, block_kv) pre-transposed
+        v = v_ref[0]
+        # standard (1),(0) contraction — the only dot shape Mosaic's bf16
+        # matmul supports; the k transpose happens once in XLA outside
+        s = jax.lax.dot_general(q, kt, (((1,), (0,)), ((), ())),
+                                preferred_element_type=jnp.float32,
+                                precision=_prec(q.dtype))
+        s = s * sm_scale
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_kv), 0)
+            k_pos = ki * block_kv + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_kv), 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+
+        m_prev = m_ref[...]                      # (block_q, LANES)
+        l_prev = l_ref[...]
+        m_cur = jnp.max(s, axis=1, keepdims=True)        # (block_q, 1)
+        m_next = jnp.maximum(m_prev, m_cur)              # (block_q, LANES)
+        alpha = jnp.exp(m_prev - m_next)
+        p = jnp.exp(s - m_next[:, :1])                   # (block_q, block_kv)
+        l_ref[...] = l_prev * alpha + jnp.sum(
+            p, axis=1, keepdims=True) * jnp.ones_like(l_prev)
+        pv = jax.lax.dot_general(p.astype(v.dtype), v,
+                                 (((1,), (0,)), ((), ())),
+                                 preferred_element_type=jnp.float32,
+                                 precision=_prec(v.dtype))
+        acc_ref[...] = acc_ref[...] * alpha[:, :1] + pv
+        m_ref[...] = m_next
+
+    if causal:
+        @pl.when(ki * block_kv <= qi * block_q + block_q - 1)
+        def _run():
+            _body()
+    else:
+        _body()
+
+    @pl.when(ki == n_kv - 1)
+    def _finish():
+        l = l_ref[:, :1]
+        l = jnp.where(l == 0.0, 1.0, l)  # fully-masked row -> zeros out
+        o_ref[0] = (acc_ref[...] / l).astype(o_ref.dtype)
+        # all lanes of m/l are identical; store lse lane-broadcast so the
+        # block keeps TPU-legal (sublane, lane) = (block_q, 128) tiling
+        lse_ref[0] = m_ref[...] + jnp.log(jnp.maximum(l_ref[...], 1e-30))
+
+
+def _fwd(q, k, v, causal, sm_scale):
+    bh, sq, d = q.shape
+    skv = k.shape[1]
+    bq, bkv = _block_sizes(sq, skv)
+    n_q, n_kv = sq // bq, skv // bkv
+
+    kernel = functools.partial(
+        _fwd_kernel, sm_scale=sm_scale, causal=causal, block_q=bq,
+        block_kv=bkv, n_kv=n_kv)
+    kt = jnp.swapaxes(k, 1, 2)  # (bh, d, skv)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=(bh, n_q, n_kv),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, d, bkv), lambda b, i, j: (b, 0, j)),
+            pl.BlockSpec((1, bkv, d), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bq, _LANES), lambda b, i, j: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, sq, _LANES), jnp.float32),
+        ],
+        scratch_shapes=_fwd_scratch(bq, d),
+        interpret=_interpret(),
+    )(q, kt, v)
+    return out, lse
+
+
+def _fwd_scratch(bq, d):
+    from jax.experimental.pallas import tpu as pltpu
+    return [
+        pltpu.VMEM((bq, d), jnp.float32),       # acc
+        pltpu.VMEM((bq, _LANES), jnp.float32),  # m
+        pltpu.VMEM((bq, _LANES), jnp.float32),  # l
+    ]
+
+
+# ---------------------------------------------------------------------------
+# Backward
+# ---------------------------------------------------------------------------
+
+def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                     dk_ref, dv_ref, dk_acc, dv_acc, *, sm_scale, causal,
+                     block_q, block_kv, n_q):
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    def _body():
+        # f32 throughout: Mosaic's bf16 matmul rejects transposed
+        # contractions, and grads accumulate in f32 anyway
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0][:, :1]                 # (block_q, 1)
+        delta = delta_ref[0][:, :1]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST)
+        s = s * sm_scale
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_kv), 0)
+            k_pos = ki * block_kv + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_kv), 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        p = jnp.exp(s - lse)                    # (block_q, block_kv)
+        # dv += p^T @ dO
+        dv_acc[...] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST)
+        # dp = dO @ v^T ; ds = p * (dp - delta) * scale
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST)
+        ds = p * (dp - delta) * sm_scale
+        # dk += ds^T @ q
+        dk_acc[...] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST)
+
+    if causal:
+        @pl.when(qi * block_q + block_q - 1 >= ki * block_kv)
+        def _run():
+            _body()
+    else:
+        _body()
+
+    @pl.when(qi == n_q - 1)
+    def _finish():
+        dk_ref[0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   dq_ref, dq_acc, *, sm_scale, causal, block_q, block_kv,
+                   n_kv):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_acc[...] = jnp.zeros_like(dq_acc)
+
+    def _body():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0][:, :1]
+        delta = delta_ref[0][:, :1]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST)
+        s = s * sm_scale
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_kv), 0)
+            k_pos = ki * block_kv + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_kv), 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        p = jnp.exp(s - lse)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST)
+        ds = p * (dp - delta) * sm_scale
+        dq_acc[...] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST)
+
+    if causal:
+        @pl.when(ki * block_kv <= qi * block_q + block_q - 1)
+        def _run():
+            _body()
+    else:
+        _body()
+
+    @pl.when(ki == n_kv - 1)
+    def _finish():
+        dq_ref[0] = dq_acc[...].astype(dq_ref.dtype)
+
+
+def _bwd(causal, sm_scale, res, do):
+    q, k, v, out, lse = res
+    bh, sq, d = q.shape
+    skv = k.shape[1]
+    bq, bkv = _block_sizes(sq, skv)
+    n_q, n_kv = sq // bq, skv // bkv
+    from jax.experimental.pallas import tpu as pltpu
+
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1)
+    delta = jnp.broadcast_to(delta[..., None], (bh, sq, _LANES))
+
+    dkdv = functools.partial(
+        _bwd_dkdv_kernel, sm_scale=sm_scale, causal=causal, block_q=bq,
+        block_kv=bkv, n_q=n_q)
+    dk, dv = pl.pallas_call(
+        dkdv,
+        grid=(bh, n_kv, n_q),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0)),    # q
+            pl.BlockSpec((1, bkv, d), lambda b, j, i: (b, j, 0)),   # k
+            pl.BlockSpec((1, bkv, d), lambda b, j, i: (b, j, 0)),   # v
+            pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0)),    # do
+            pl.BlockSpec((1, bq, _LANES), lambda b, j, i: (b, i, 0)),  # lse
+            pl.BlockSpec((1, bq, _LANES), lambda b, j, i: (b, i, 0)),  # delta
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bkv, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, bkv, d), lambda b, j, i: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, skv, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, skv, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bkv, d), jnp.float32),
+            pltpu.VMEM((bkv, d), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(q, k, v, do, lse, delta)
+
+    dqk = functools.partial(
+        _bwd_dq_kernel, sm_scale=sm_scale, causal=causal, block_q=bq,
+        block_kv=bkv, n_kv=n_kv)
+    dq = pl.pallas_call(
+        dqk,
+        grid=(bh, n_q, n_kv),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bkv, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bkv, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bq, _LANES), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bq, _LANES), lambda b, i, j: (b, i, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        interpret=_interpret(),
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# custom_vjp wrapper
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def flash_attention_bhd(q, k, v, causal, sm_scale):
+    """Flash attention over (batch*heads, seq, head_dim) arrays."""
+    out, _ = _fwd(q, k, v, causal, sm_scale)
+    return out
+
+
+def _vjp_fwd(q, k, v, causal, sm_scale):
+    out, lse = _fwd(q, k, v, causal, sm_scale)
+    return out, (q, k, v, out, lse)
+
+
+flash_attention_bhd.defvjp(_vjp_fwd, _bwd)

@@ -1,0 +1,3 @@
+from .fused_transformer import (  # noqa: F401
+    FusedFeedForward, FusedMultiHeadAttention, FusedMultiTransformer,
+    FusedTransformerEncoderLayer)

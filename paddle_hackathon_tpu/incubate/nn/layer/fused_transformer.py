@@ -1,0 +1,181 @@
+"""Fused transformer layers.
+
+Ref ``python/paddle/incubate/nn/layer/fused_transformer.py`` —
+``FusedMultiHeadAttention`` (:176), ``FusedFeedForward`` (:437),
+``FusedTransformerEncoderLayer`` (:641), ``FusedMultiTransformer`` (:914).
+The reference dispatches to monolithic CUDA kernels; here each layer calls
+the incubate fused functionals (Pallas flash attention + XLA-fused chains).
+"""
+
+from __future__ import annotations
+
+import math
+
+from ....nn import initializer as I
+from ....nn.layer import Layer
+from ....ops import manipulation as M
+from .. import functional as FF
+
+
+class FusedMultiHeadAttention(Layer):
+    """Pre/post-LN multi-head self-attention with fused residual+dropout."""
+
+    def __init__(self, embed_dim, num_heads, dropout_rate=0.5,
+                 attn_dropout_rate=0.5, kdim=None, vdim=None,
+                 normalize_before=False, need_weights=False,
+                 qkv_weight_attr=None, qkv_bias_attr=None,
+                 linear_weight_attr=None, linear_bias_attr=None,
+                 pre_ln_scale_attr=None, pre_ln_bias_attr=None,
+                 ln_scale_attr=None, ln_bias_attr=None, epsilon=1e-5,
+                 nranks=1, ring_id=-1, name=None):
+        super().__init__()
+        assert embed_dim % num_heads == 0
+        self.embed_dim = embed_dim
+        self.num_heads = num_heads
+        self.head_dim = embed_dim // num_heads
+        self.dropout_rate = dropout_rate
+        self.attn_dropout_rate = attn_dropout_rate
+        self.normalize_before = normalize_before
+        self.epsilon = epsilon
+        self.qkv_weight = self.create_parameter(
+            [embed_dim, 3 * embed_dim], attr=qkv_weight_attr)
+        self.qkv_bias = self.create_parameter(
+            [3 * embed_dim], attr=qkv_bias_attr, is_bias=True)
+        self.linear_weight = self.create_parameter(
+            [embed_dim, embed_dim], attr=linear_weight_attr)
+        self.linear_bias = self.create_parameter(
+            [embed_dim], attr=linear_bias_attr, is_bias=True)
+        self.ln_scale = self.create_parameter(
+            [embed_dim], attr=ln_scale_attr, default_initializer=I.Constant(1.0))
+        self.ln_bias = self.create_parameter(
+            [embed_dim], attr=ln_bias_attr, is_bias=True)
+
+    def forward(self, query, key=None, value=None, attn_mask=None, cache=None):
+        x = query
+        residual = x
+        if self.normalize_before:
+            x, _ = FF.fused_layer_norm(x, self.ln_scale, self.ln_bias,
+                                       epsilon=self.epsilon,
+                                       training=self.training)
+        qkv = FF.fused_linear(x, self.qkv_weight, self.qkv_bias)
+        b, s = qkv.shape[0], qkv.shape[1]
+        qkv = M.reshape(qkv, [b, s, 3, self.num_heads, self.head_dim])
+        q = M.squeeze(M.slice(qkv, [2], [0], [1]), axis=[2])
+        k = M.squeeze(M.slice(qkv, [2], [1], [2]), axis=[2])
+        v = M.squeeze(M.slice(qkv, [2], [2], [3]), axis=[2])
+        from ....nn import functional as F
+        out = F.scaled_dot_product_attention(
+            q, k, v, attn_mask=attn_mask,
+            dropout_p=self.attn_dropout_rate if self.training else 0.0,
+            training=self.training)
+        out = M.reshape(out, [b, s, self.embed_dim])
+        if self.normalize_before:
+            out = FF.fused_linear(out, self.linear_weight, self.linear_bias)
+            out = FF.fused_dropout_add(out, residual, p=self.dropout_rate,
+                                       training=self.training)
+        else:
+            out = FF.fused_linear(out, self.linear_weight)
+            out, _ = FF.fused_layer_norm(
+                out, self.ln_scale, self.ln_bias, epsilon=self.epsilon,
+                residual=residual, bias=self.linear_bias,
+                dropout_rate=self.dropout_rate, training=self.training)
+        return out
+
+    def extra_repr(self):
+        return (f"embed_dim={self.embed_dim}, num_heads={self.num_heads}, "
+                f"normalize_before={self.normalize_before}")
+
+
+class FusedFeedForward(Layer):
+    def __init__(self, d_model, dim_feedforward, dropout_rate=0.1,
+                 epsilon=1e-5, activation="relu", act_dropout_rate=None,
+                 normalize_before=False, linear1_weight_attr=None,
+                 linear1_bias_attr=None, linear2_weight_attr=None,
+                 linear2_bias_attr=None, ln1_scale_attr=None,
+                 ln1_bias_attr=None, ln2_scale_attr=None, ln2_bias_attr=None,
+                 nranks=1, ring_id=-1, name=None):
+        super().__init__()
+        self.d_model = d_model
+        self.dim_feedforward = dim_feedforward
+        self.dropout_rate = dropout_rate
+        self.act_dropout_rate = (act_dropout_rate if act_dropout_rate
+                                 is not None else dropout_rate)
+        self.activation = activation
+        self.epsilon = epsilon
+        self.normalize_before = normalize_before
+        self.linear1_weight = self.create_parameter(
+            [d_model, dim_feedforward], attr=linear1_weight_attr)
+        self.linear1_bias = self.create_parameter(
+            [dim_feedforward], attr=linear1_bias_attr, is_bias=True)
+        self.linear2_weight = self.create_parameter(
+            [dim_feedforward, d_model], attr=linear2_weight_attr)
+        self.linear2_bias = self.create_parameter(
+            [d_model], attr=linear2_bias_attr, is_bias=True)
+        self.ln_scale = self.create_parameter(
+            [d_model], attr=ln1_scale_attr, default_initializer=I.Constant(1.0))
+        self.ln_bias = self.create_parameter(
+            [d_model], attr=ln1_bias_attr, is_bias=True)
+
+    def forward(self, src, cache=None):
+        return FF.fused_feedforward(
+            src, self.linear1_weight, self.linear1_bias, self.linear2_weight,
+            self.linear2_bias, ln1_scale=self.ln_scale, ln1_bias=self.ln_bias,
+            dropout1_rate=self.act_dropout_rate,
+            dropout2_rate=self.dropout_rate, activation=self.activation,
+            ln1_epsilon=self.epsilon, pre_layer_norm=self.normalize_before,
+            training=self.training)
+
+    def extra_repr(self):
+        return (f"d_model={self.d_model}, "
+                f"dim_feedforward={self.dim_feedforward}")
+
+
+class FusedTransformerEncoderLayer(Layer):
+    def __init__(self, d_model, nhead, dim_feedforward, dropout_rate=0.1,
+                 activation="relu", attn_dropout_rate=None,
+                 act_dropout_rate=None, normalize_before=False,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        attn_dropout_rate = (attn_dropout_rate if attn_dropout_rate
+                             is not None else dropout_rate)
+        act_dropout_rate = (act_dropout_rate if act_dropout_rate
+                            is not None else dropout_rate)
+        self.fused_attn = FusedMultiHeadAttention(
+            d_model, nhead, dropout_rate=dropout_rate,
+            attn_dropout_rate=attn_dropout_rate,
+            normalize_before=normalize_before)
+        self.ffn = FusedFeedForward(
+            d_model, dim_feedforward, dropout_rate=dropout_rate,
+            activation=activation, act_dropout_rate=act_dropout_rate,
+            normalize_before=normalize_before)
+
+    def forward(self, src, src_mask=None, cache=None):
+        out = self.fused_attn(src, attn_mask=src_mask)
+        return self.ffn(out)
+
+
+class FusedMultiTransformer(Layer):
+    """Stack of fused decoder blocks (ref :914 — the inference-serving path
+    of ERNIE/GPT; here the same layers drive the Pallas attention)."""
+
+    def __init__(self, embed_dim, num_heads, dim_feedforward,
+                 dropout_rate=0.0, activation="gelu", normalize_before=True,
+                 num_layers=1, epsilon=1e-5):
+        super().__init__()
+        from ....nn.container import LayerList
+        self.layers = LayerList([
+            FusedTransformerEncoderLayer(
+                embed_dim, num_heads, dim_feedforward,
+                dropout_rate=dropout_rate, activation=activation,
+                normalize_before=normalize_before)
+            for _ in range(num_layers)])
+
+    def forward(self, src, attn_mask=None, caches=None):
+        out = src
+        for layer in self.layers:
+            out = layer(out, src_mask=attn_mask)
+        return out
+
+
+__all__ = ["FusedMultiHeadAttention", "FusedFeedForward",
+           "FusedTransformerEncoderLayer", "FusedMultiTransformer"]

@@ -1,0 +1,133 @@
+"""Incubate optimizers (ref ``python/paddle/incubate/optimizer/``):
+``LookAhead`` (lookahead.py:26), ``ModelAverage`` (modelaverage.py:28),
+``DistributedFusedLamb`` (distributed_fused_lamb.py:86 — on TPU the fused
+sharded LAMB is ``optimizer.Lamb`` under a ZeRO sharding rule; see
+``parallel.sharding``, so only the alias lives here).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+from ...optimizer.optimizer import Optimizer
+from ...optimizer.optimizers import Lamb as DistributedFusedLamb  # noqa: F401
+
+
+class LookAhead(Optimizer):
+    """Wraps an inner optimizer; every k steps pulls fast weights toward the
+    slow (lookahead) copy: slow += alpha * (fast - slow); fast = slow."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        self.inner_optimizer = inner_optimizer
+        self.alpha = alpha
+        self.k = k
+        self._slow = {}
+        self._step_num = 0
+        # not calling super().__init__: this is a wrapper, state lives inner
+
+    @property
+    def _parameter_list(self):
+        return self.inner_optimizer._parameter_list
+
+    def get_lr(self):
+        return self.inner_optimizer.get_lr()
+
+    def set_lr(self, value):
+        return self.inner_optimizer.set_lr(value)
+
+    def clear_grad(self, set_to_zero=False):
+        return self.inner_optimizer.clear_grad(set_to_zero)
+
+    def step(self):
+        self.inner_optimizer.step()
+        self._step_num += 1
+        if self._step_num % self.k:
+            return
+        for p in self.inner_optimizer._parameter_list:
+            slow = self._slow.get(id(p))
+            if slow is None:
+                slow = p._value
+            slow = slow + self.alpha * (p._value - slow)
+            self._slow[id(p)] = slow
+            p._set_value(slow)
+
+    def state_dict(self):
+        sd = self.inner_optimizer.state_dict()
+        sd["lookahead_step"] = self._step_num
+        return sd
+
+    def set_state_dict(self, state):
+        self._step_num = state.pop("lookahead_step", 0)
+        self.inner_optimizer.set_state_dict(state)
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+
+
+class ModelAverage(Optimizer):
+    """Running average of parameters for evaluation
+    (ref modelaverage.py:28). ``apply()`` swaps averaged weights in,
+    ``restore()`` swaps them back."""
+
+    def __init__(self, average_window_rate=0.15, parameters=None,
+                 min_average_window=10000, max_average_window=10000,
+                 name=None):
+        super().__init__(learning_rate=0.0, parameters=parameters)
+        self.avg_rate = average_window_rate
+        self.min_window = min_average_window
+        self.max_window = max_average_window
+        self._sum = {}
+        self._count = {}
+        self._saved = None
+
+    def step(self):
+        for p in self._parameter_list:
+            s = self._sum.get(id(p))
+            if s is None:
+                s, c = jnp.zeros_like(p._value), 0
+            else:
+                c = self._count[id(p)]
+            if c >= self.max_window:
+                # restart window (ref: num_accumulates window rotation)
+                s, c = jnp.zeros_like(p._value), 0
+            self._sum[id(p)] = s + p._value
+            self._count[id(p)] = c + 1
+
+    def apply(self, executor=None, need_restore=True):
+        self._saved = {id(p): p._value for p in self._parameter_list}
+        for p in self._parameter_list:
+            c = self._count.get(id(p), 0)
+            if c:
+                p._set_value(self._sum[id(p)] / c)
+        return _RestoreCtx(self) if need_restore else None
+
+    def restore(self, executor=None):
+        if self._saved is None:
+            return
+        for p in self._parameter_list:
+            saved = self._saved.get(id(p))
+            if saved is not None:
+                p._set_value(saved)
+        self._saved = None
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        self.step()
+
+
+class _RestoreCtx:
+    def __init__(self, ma):
+        self._ma = ma
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self._ma.restore()
+
+
+__all__ = ["LookAhead", "ModelAverage", "DistributedFusedLamb"]

@@ -16,6 +16,8 @@ from ..core.autograd import apply_op
 from ..core.dtype import convert_dtype
 from ..core.tensor import Tensor
 
+_py_slice = slice  # the `slice` op below shadows the builtin
+
 
 def _t(x):
     return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
@@ -288,7 +290,7 @@ def index_sample(x, index):
 
 def index_add(x, index, axis, value, name=None):
     def fn(v, i, u):
-        idx = [slice(None)] * v.ndim
+        idx = [_py_slice(None)] * v.ndim
         idx[axis] = i.reshape(-1)
         return v.at[tuple(idx)].add(u)
     return apply_op("index_add", fn, [_t(x), _t(index), _t(value)])
@@ -375,9 +377,9 @@ def repeat_interleave(x, repeats, axis=None, name=None):
 
 def strided_slice(x, axes, starts, ends, strides, name=None):
     def fn(v):
-        idx = [slice(None)] * v.ndim
+        idx = [_py_slice(None)] * v.ndim
         for ax, s, e, st in zip(_ints(axes), _ints(starts), _ints(ends), _ints(strides)):
-            idx[ax] = slice(s, e, st)
+            idx[ax] = _py_slice(s, e, st)
         return v[tuple(idx)]
     return apply_op("strided_slice", fn, [_t(x)])
 

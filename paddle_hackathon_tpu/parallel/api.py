@@ -80,16 +80,13 @@ def shard_params(model: Layer, mesh: Mesh,
     ref ``group_sharded_stage3.py:60``).
     Parameters are updated in place to device-sharded arrays.
     """
+    from .sharding import _shard_spec_for
     placed = {}
     for name, p in model.named_parameters():
         spec = list(rule(name, p.shape)) if rule else [None] * p.ndim
         spec = list(_filter_spec(spec, mesh))
-        if zero_stage >= 3 and "sharding" in mesh.axis_names:
-            shard_n = mesh.shape["sharding"]
-            for i, (dim, s) in enumerate(zip(p.shape, spec)):
-                if s is None and dim % shard_n == 0:
-                    spec[i] = "sharding"
-                    break
+        if zero_stage >= 3:
+            spec = list(_shard_spec_for(p.shape, mesh, existing=spec))
         sharding = NamedSharding(mesh, P(*spec))
         arr = jax.device_put(p._value, sharding)
         p._set_value(arr)
@@ -132,15 +129,13 @@ def make_sharded_train_step(model: Layer, mesh: Mesh,
     params = {k: p._value for k, p in model.named_parameters()}
     _, buffers = model.functional_state()
 
+    from .sharding import _shard_spec_for
+
     def opt_state_spec(name, arr):
         spec = list(rule(name, arr.shape)) if rule else [None] * arr.ndim
         spec = list(_filter_spec(spec, mesh))
-        if zero_stage >= 1 and "sharding" in mesh.axis_names:
-            n = mesh.shape["sharding"]
-            for i, (dim, s) in enumerate(zip(arr.shape, spec)):
-                if s is None and dim % n == 0:
-                    spec[i] = "sharding"
-                    break
+        if zero_stage >= 1:
+            spec = list(_shard_spec_for(arr.shape, mesh, existing=spec))
         return NamedSharding(mesh, P(*spec))
 
     opt_state = {

@@ -28,7 +28,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from ..nn.layer import Layer
 from . import api as _mesh_api
 from . import env as _env
-from .sharding import _shard_spec_for, group_sharded_parallel
+from .sharding import group_sharded_parallel
 from .topology import (CommunicateTopology, HybridCommunicateGroup,
                        get_hybrid_communicate_group, init_hybrid_parallel,
                        set_hybrid_communicate_group)
@@ -109,7 +109,10 @@ class _Fleet:
             return model
         from .api import shard_params
         from .mp_layers import sharding_rule_from_model
-        zero = 3 if (self._strategy and self._strategy.sharding) else 0
+        zero = 0
+        if self._strategy and self._strategy.sharding:
+            cfg = getattr(self._strategy, "sharding_configs", None) or {}
+            zero = int(cfg.get("stage", 1))
         shard_params(model, mesh, rule=sharding_rule_from_model(model),
                      zero_stage=zero)
         return model

@@ -59,7 +59,7 @@ def ring_attention(q, k, v, mesh: Mesh, axis: str = "sp",
     def spmd(ql, kl, vl):
         # ql/kl/vl: (b, s/n, h, d) — this device's sequence chunk
         my = jax.lax.axis_index(axis)
-        neg = jnp.finfo(jnp.float32).min
+        neg = -1e30  # finite: exp()=0 without the inf-inf NaNs of finfo.min
 
         def chunk_bias(kv_rank):
             if not causal:

@@ -1,0 +1,182 @@
+"""incubate surface: Pallas flash attention (interpret mode on CPU), fused
+layers, ASP n:m sparsity, functional autograd, LookAhead/ModelAverage.
+
+Mirrors the reference's test style: fused results checked against the
+plain composition (ref test_fused_attention_op.py pattern — fused vs
+separate-op numerics).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_hackathon_tpu as paddle
+from paddle_hackathon_tpu import incubate, nn, optimizer
+from paddle_hackathon_tpu.core.tensor import Tensor
+
+
+def _sdpa_ref(q, k, v, causal):
+    qh = np.swapaxes(q, 1, 2).astype(np.float32)
+    kh = np.swapaxes(k, 1, 2).astype(np.float32)
+    vh = np.swapaxes(v, 1, 2).astype(np.float32)
+    s = np.einsum("bhsd,bhtd->bhst", qh, kh) / np.sqrt(q.shape[-1])
+    if causal:
+        m = np.tril(np.ones(s.shape[-2:], bool))
+        s = np.where(m, s, -1e30)
+    p = np.exp(s - s.max(-1, keepdims=True))
+    p = p / p.sum(-1, keepdims=True)
+    o = np.einsum("bhst,bhtd->bhsd", p, vh)
+    return np.swapaxes(o, 1, 2)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_attention_forward(causal):
+    rng = np.random.RandomState(0)
+    b, s, h, d = 1, 256, 2, 32
+    q = rng.randn(b, s, h, d).astype(np.float32) * 0.3
+    k = rng.randn(b, s, h, d).astype(np.float32) * 0.3
+    v = rng.randn(b, s, h, d).astype(np.float32)
+    out = incubate.nn.functional.flash_attention_bshd(
+        Tensor(q), Tensor(k), Tensor(v), causal=causal)
+    ref = _sdpa_ref(q, k, v, causal)
+    np.testing.assert_allclose(out.numpy(), ref, rtol=2e-4, atol=2e-4)
+
+
+def test_flash_attention_grad_matches_xla():
+    rng = np.random.RandomState(1)
+    b, s, h, d = 1, 128, 2, 16
+    q0 = rng.randn(b, s, h, d).astype(np.float32) * 0.3
+    k0 = rng.randn(b, s, h, d).astype(np.float32) * 0.3
+    v0 = rng.randn(b, s, h, d).astype(np.float32)
+
+    grads = {}
+    for use_flash in (True, False):
+        q = Tensor(q0.copy(), stop_gradient=False)
+        k = Tensor(k0.copy(), stop_gradient=False)
+        v = Tensor(v0.copy(), stop_gradient=False)
+        if use_flash:
+            out = incubate.nn.functional.flash_attention_bshd(
+                q, k, v, causal=True)
+        else:
+            out = nn.functional.scaled_dot_product_attention(
+                q, k, v, is_causal=True, use_flash=False)
+        (out * out).sum().backward()
+        grads[use_flash] = (q.grad.numpy(), k.grad.numpy(), v.grad.numpy())
+
+    for gf, gx in zip(grads[True], grads[False]):
+        np.testing.assert_allclose(gf, gx, rtol=3e-3, atol=3e-3)
+
+
+def test_sdpa_routes_to_flash():
+    # default flags: use_fused_kernels=True, no mask, no dropout -> flash
+    rng = np.random.RandomState(2)
+    x = rng.randn(1, 128, 2, 16).astype(np.float32)
+    out = nn.functional.scaled_dot_product_attention(
+        Tensor(x), Tensor(x), Tensor(x), is_causal=True)
+    ref = _sdpa_ref(x, x, x, True)
+    np.testing.assert_allclose(out.numpy(), ref, rtol=2e-4, atol=2e-4)
+
+
+def test_fused_layer_norm_matches_composition():
+    rng = np.random.RandomState(3)
+    x = rng.randn(2, 8, 16).astype(np.float32)
+    res = rng.randn(2, 8, 16).astype(np.float32)
+    bias = rng.randn(16).astype(np.float32)
+    w = rng.rand(16).astype(np.float32) + 0.5
+    b = rng.randn(16).astype(np.float32)
+    out, res_out = incubate.nn.functional.fused_layer_norm(
+        Tensor(x), Tensor(w), Tensor(b), residual=Tensor(res),
+        bias=Tensor(bias), dropout_rate=0.0)
+    h = x + bias + res
+    mu = h.mean(-1, keepdims=True)
+    var = h.var(-1, keepdims=True)
+    ref = (h - mu) / np.sqrt(var + 1e-5) * w + b
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(res_out.numpy(), h, rtol=1e-6, atol=1e-6)
+
+
+def test_fused_encoder_layer_runs_and_backprops():
+    layer = incubate.nn.FusedTransformerEncoderLayer(
+        d_model=32, nhead=4, dim_feedforward=64, dropout_rate=0.0)
+    x = Tensor(np.random.randn(2, 16, 32).astype(np.float32),
+               stop_gradient=False)
+    out = layer(x)
+    assert out.shape == [2, 16, 32]
+    out.sum().backward()
+    for _, p in layer.named_parameters():
+        assert p.grad is not None
+
+
+def test_fused_multi_transformer():
+    m = incubate.nn.FusedMultiTransformer(32, 4, 64, num_layers=2)
+    x = Tensor(np.random.randn(2, 8, 32).astype(np.float32))
+    assert m(x).shape == [2, 8, 32]
+
+
+def test_asp_prune_and_decorate():
+    lin = nn.Linear(16, 8)
+    incubate.asp.prune_model(lin, n=2, m=4)
+    w = lin.weight.numpy()
+    # every group of 4 along the last axis has exactly 2 zeros
+    g = w.reshape(16, 2, 4)
+    nz = (g != 0).sum(-1)
+    assert (nz <= 2).all()
+    assert abs(incubate.asp.calculate_density(lin.weight) - 0.5) < 1e-6
+
+    opt = incubate.asp.decorate(
+        optimizer.SGD(learning_rate=0.1, parameters=lin.parameters()))
+    x = Tensor(np.random.randn(4, 16).astype(np.float32))
+    lin(x).sum().backward()
+    opt.step()
+    w2 = lin.weight.numpy()
+    assert (w2[w == 0] == 0).all()  # pruned entries stayed zero
+    assert (w2 != w).any()          # but training actually moved weights
+
+
+def test_functional_jvp_vjp():
+    def f(x):
+        return (x * x).sum()
+
+    x = Tensor(np.arange(4, dtype=np.float32))
+    _, tangent = incubate.autograd.jvp(f, [x])
+    assert float(tangent.numpy()) == pytest.approx(2 * (0 + 1 + 2 + 3))
+    _, grads = incubate.autograd.vjp(f, [x])
+    np.testing.assert_allclose(grads.numpy(), 2 * np.arange(4), rtol=1e-6)
+
+
+def test_jacobian_hessian():
+    def f(x):
+        return x * x
+
+    x = Tensor(np.array([1.0, 2.0, 3.0], np.float32))
+    J = incubate.autograd.Jacobian(f, [x])
+    np.testing.assert_allclose(np.asarray(J[:].numpy()),
+                               np.diag([2.0, 4.0, 6.0]), rtol=1e-6)
+
+    def g(x):
+        return (x * x * x).sum()
+
+    H = incubate.autograd.Hessian(g, [x])
+    np.testing.assert_allclose(np.asarray(H[:].numpy()),
+                               np.diag([6.0, 12.0, 18.0]), rtol=1e-6)
+
+
+def test_lookahead_and_model_average():
+    lin = nn.Linear(4, 2)
+    inner = optimizer.SGD(learning_rate=0.1, parameters=lin.parameters())
+    opt = incubate.LookAhead(inner, alpha=0.5, k=2)
+    x = Tensor(np.ones((2, 4), np.float32))
+    for _ in range(4):
+        lin(x).sum().backward()
+        opt.step()
+        opt.clear_grad()
+
+    ma = incubate.ModelAverage(parameters=lin.parameters())
+    w_before = lin.weight.numpy().copy()
+    ma.step()
+    lin.weight._set_value(lin.weight._value + 1.0)
+    ma.step()
+    with ma.apply():
+        np.testing.assert_allclose(lin.weight.numpy(), w_before + 0.5,
+                                   rtol=1e-6)
+    np.testing.assert_allclose(lin.weight.numpy(), w_before + 1.0, rtol=1e-6)
