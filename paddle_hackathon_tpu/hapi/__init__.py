@@ -1,0 +1,5 @@
+"""paddle.hapi equivalent — Keras-like Model.fit (ref ``python/paddle/hapi/``)."""
+
+from . import callbacks  # noqa: F401
+from .model import Model  # noqa: F401
+from .summary import summary  # noqa: F401

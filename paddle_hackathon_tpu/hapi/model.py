@@ -1,0 +1,260 @@
+"""Keras-like high-level Model API.
+
+Ref ``python/paddle/hapi/model.py`` — ``Model`` (:915), ``fit`` (:1574),
+``train_batch`` (:1055), evaluate/predict, save/load. The reference
+branches into dygraph vs static adapters; here there is one eager path
+(jit-compiling happens inside the layers / fused ops).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+
+from ..core.autograd import no_grad
+from ..core.tensor import Tensor
+from ..metric import Metric
+from .callbacks import CallbackList, ModelCheckpoint, ProgBarLogger
+
+
+def _to_list(x):
+    if x is None:
+        return []
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+def _as_tensor(x):
+    if isinstance(x, Tensor):
+        return x
+    return Tensor(np.asarray(x))
+
+
+class Model:
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._inputs = inputs
+        self._labels = labels
+        self._optimizer = None
+        self._loss = None
+        self._metrics = []
+        self.stop_training = False
+
+    # -- configuration ----------------------------------------------------
+    def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        metrics = _to_list(metrics)
+        for m in metrics:
+            assert isinstance(m, Metric), (
+                f"metrics must be paddle.metric.Metric instances, got {m}")
+        self._metrics = metrics
+
+    # -- single-batch ops (ref train_batch:1055) --------------------------
+    def train_batch(self, inputs, labels=None, update=True):
+        self.network.train()
+        inputs = [_as_tensor(x) for x in _to_list(inputs)]
+        labels = [_as_tensor(x) for x in _to_list(labels)]
+        outputs = self.network(*inputs)
+        outs = _to_list(outputs)
+        losses = _to_list(self._loss(*(outs + labels)))
+        total = losses[0]
+        for l in losses[1:]:
+            total = total + l
+        total.backward()
+        if update:
+            self._optimizer.step()
+            self._optimizer.clear_grad()
+        metrics = self._update_metrics(outs, labels)
+        out_loss = [float(l.numpy()) for l in losses]
+        return (out_loss, metrics) if metrics else out_loss
+
+    def eval_batch(self, inputs, labels=None):
+        self.network.eval()
+        inputs = [_as_tensor(x) for x in _to_list(inputs)]
+        labels = [_as_tensor(x) for x in _to_list(labels)]
+        with no_grad():
+            outputs = self.network(*inputs)
+            outs = _to_list(outputs)
+            losses = _to_list(self._loss(*(outs + labels))) if self._loss else []
+        metrics = self._update_metrics(outs, labels)
+        out_loss = [float(l.numpy()) for l in losses]
+        return (out_loss, metrics) if metrics else out_loss
+
+    def _update_metrics(self, outs, labels):
+        metrics = []
+        for m in self._metrics:
+            # Metric protocol (ref hapi/model.py _update_metrics): compute()
+            # turns (preds, labels) into the per-batch statistic update()
+            # consumes; metrics without compute take raw outputs.
+            if hasattr(m, "compute"):
+                stat = m.compute(*(outs + labels))
+                m.update(*[np.asarray(s_.numpy()) if isinstance(s_, Tensor)
+                           else np.asarray(s_) for s_ in _to_list(stat)])
+            else:
+                m.update(*[t.numpy() for t in outs + labels])
+            metrics.append(m.accumulate())
+        return metrics
+
+    def predict_batch(self, inputs):
+        self.network.eval()
+        inputs = [_as_tensor(x) for x in _to_list(inputs)]
+        with no_grad():
+            outputs = self.network(*inputs)
+        return [o.numpy() for o in _to_list(outputs)]
+
+    # -- loops (ref fit:1574) ---------------------------------------------
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None,
+            accumulate_grad_batches=1, num_iters=None):
+        train_loader = self._to_loader(train_data, batch_size, shuffle,
+                                       drop_last, num_workers)
+        eval_loader = (self._to_loader(eval_data, batch_size, False, False,
+                                       num_workers)
+                       if eval_data is not None else None)
+        cbks = _to_list(callbacks) or [ProgBarLogger(log_freq, verbose)]
+        if save_dir:
+            cbks.append(ModelCheckpoint(save_freq, save_dir))
+        cbk = CallbackList(cbks)
+        cbk.set_model(self)
+        try:
+            steps = len(train_loader)
+        except TypeError:
+            steps = None
+        cbk.set_params({"epochs": epochs, "steps": steps, "verbose": verbose})
+
+        self.stop_training = False
+        cbk.on_train_begin()
+        for epoch in range(epochs):
+            cbk.on_epoch_begin(epoch)
+            for m in self._metrics:
+                m.reset()
+            logs = {}
+            for step, batch in enumerate(train_loader):
+                if num_iters is not None and step >= num_iters:
+                    break
+                cbk.on_train_batch_begin(step)
+                ins, lbs = self._split_batch(batch)
+                update = ((step + 1) % accumulate_grad_batches == 0)
+                res = self.train_batch(ins, lbs, update=update)
+                logs = self._pack_logs(res)
+                cbk.on_train_batch_end(step, logs)
+                if self.stop_training:
+                    break
+            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                eval_logs = self.evaluate(eval_loader, verbose=0,
+                                          _callbacks=cbk)
+                logs.update({f"eval_{k}": v for k, v in eval_logs.items()})
+            cbk.on_epoch_end(epoch, logs)
+            if self.stop_training:
+                break
+        cbk.on_train_end(logs)
+        return logs
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, num_iters=None,
+                 _callbacks=None):
+        loader = self._to_loader(eval_data, batch_size, False, False,
+                                 num_workers)
+        cbk = _callbacks or CallbackList(_to_list(callbacks))
+        for m in self._metrics:
+            m.reset()
+        cbk.on_eval_begin()
+        logs = {}
+        for step, batch in enumerate(loader):
+            if num_iters is not None and step >= num_iters:
+                break
+            cbk.on_eval_batch_begin(step)
+            ins, lbs = self._split_batch(batch)
+            res = self.eval_batch(ins, lbs)
+            logs = self._pack_logs(res)
+            cbk.on_eval_batch_end(step, logs)
+        cbk.on_eval_end(logs)
+        return logs
+
+    def predict(self, test_data, batch_size=1, num_workers=0,
+                stack_outputs=False, callbacks=None, num_iters=None):
+        loader = self._to_loader(test_data, batch_size, False, False,
+                                 num_workers)
+        outputs = []
+        for step, batch in enumerate(loader):
+            if num_iters is not None and step >= num_iters:
+                break
+            ins, _ = self._split_batch(batch, has_labels=False)
+            outputs.append(self.predict_batch(ins))
+        if stack_outputs and outputs:
+            n_out = len(outputs[0])
+            return [np.concatenate([o[i] for o in outputs])
+                    for i in range(n_out)]
+        return outputs
+
+    # -- save / load (ref model.py save:1373) -----------------------------
+    def save(self, path, training=True):
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        from ..framework.io import save as fsave
+        fsave(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            fsave(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        from ..framework.io import load as fload
+        self.network.set_state_dict(fload(path + ".pdparams"))
+        if not reset_optimizer and self._optimizer is not None and \
+                os.path.exists(path + ".pdopt"):
+            self._optimizer.set_state_dict(fload(path + ".pdopt"))
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters(*args, **kwargs)
+
+    def summary(self, input_size=None, dtype=None):
+        from .summary import summary
+        return summary(self.network, input_size, dtypes=dtype)
+
+    # -- helpers ----------------------------------------------------------
+    def _to_loader(self, data, batch_size, shuffle, drop_last, num_workers):
+        from ..io.dataloader import DataLoader
+        from ..io.dataset import Dataset
+        if isinstance(data, Dataset):
+            return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
+                              drop_last=drop_last, num_workers=num_workers)
+        return data  # already a loader/iterable
+
+    def _split_batch(self, batch, has_labels=True):
+        batch = list(batch) if isinstance(batch, (list, tuple)) else [batch]
+        n_in = len(_to_list(self._inputs))
+        if not n_in:
+            if has_labels and len(batch) > 1:
+                n_in = len(batch) - 1
+            else:
+                # no inputs spec: cap at the network's forward arity so a
+                # labelled dataset still works for predict()
+                import inspect
+                try:
+                    sig = inspect.signature(self.network.forward)
+                    n_pos = sum(
+                        1 for p in sig.parameters.values()
+                        if p.kind in (p.POSITIONAL_ONLY,
+                                      p.POSITIONAL_OR_KEYWORD))
+                    n_in = min(len(batch), n_pos)
+                except (TypeError, ValueError):
+                    n_in = len(batch)
+        ins = batch[:n_in]
+        lbs = batch[n_in:] if has_labels else []
+        return ins, lbs
+
+    def _pack_logs(self, res):
+        logs = {}
+        if isinstance(res, tuple):
+            losses, metrics = res
+            for m, v in zip(self._metrics, metrics):
+                name = m.name()
+                logs[name if isinstance(name, str) else name[0]] = (
+                    v if not isinstance(v, (list, tuple)) else v[0])
+        else:
+            losses = res
+        logs["loss"] = losses[0] if isinstance(losses, list) else losses
+        return logs

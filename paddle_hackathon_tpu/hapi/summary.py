@@ -1,0 +1,30 @@
+"""Model summary (ref ``python/paddle/hapi/model_summary.py``)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+
+def summary(net, input_size=None, dtypes=None, input=None):  # noqa: A002
+    """Print a per-layer parameter table; returns totals dict."""
+    rows = []
+    total = 0
+    trainable = 0
+    for name, p in net.named_parameters():
+        n = int(np.prod(p.shape)) if p.shape else 1
+        total += n
+        if p.trainable:
+            trainable += n
+        rows.append((name, tuple(p.shape), n))
+    width = max([len(r[0]) for r in rows], default=20) + 2
+    print(f"{'Layer (param)':<{width}}{'Shape':<24}{'Param #':>12}")
+    print("-" * (width + 36))
+    for name, shape, n in rows:
+        print(f"{name:<{width}}{str(shape):<24}{n:>12,}")
+    print("-" * (width + 36))
+    print(f"Total params: {total:,}")
+    print(f"Trainable params: {trainable:,}")
+    print(f"Non-trainable params: {total - trainable:,}")
+    return {"total_params": total, "trainable_params": trainable}

@@ -2,12 +2,12 @@
 
 Ref ``python/paddle/incubate/``: fused transformer layers + functionals
 (Pallas flash attention on TPU), ASP n:m sparsity, functional autograd
-(jvp/vjp/Jacobian/Hessian), LookAhead/ModelAverage optimizers. MoE lives in
-``parallel.moe`` (re-exported here as ``incubate.distributed`` namespace
-parity).
+(jvp/vjp/Jacobian/Hessian), LookAhead/ModelAverage optimizers,
+``incubate.distributed.models.moe`` (the MoE layer, shared with
+``parallel.moe``).
 """
 
-from . import asp, autograd, nn, optimizer  # noqa: F401
+from . import asp, autograd, distributed, nn, optimizer  # noqa: F401
 from .optimizer import DistributedFusedLamb, LookAhead, ModelAverage  # noqa: F401
 
 

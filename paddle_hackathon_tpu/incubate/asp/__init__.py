@@ -14,7 +14,6 @@ import jax.numpy as jnp
 import numpy as np
 
 _excluded = set()
-_masks = {}  # id(param) -> mask array
 
 
 def set_excluded_layers(param_names, main_program=None):
@@ -58,7 +57,9 @@ def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
         mask = _nm_mask_1d(w, n, m)
         p._set_value(jnp.asarray(w * mask, dtype=p._value.dtype))
         if with_mask:
-            _masks[id(p)] = jnp.asarray(mask, dtype=p._value.dtype)
+            # stored on the parameter itself: dies with it (a global map
+            # keyed by id() would leak and could collide on id reuse)
+            p._asp_mask = jnp.asarray(mask, dtype=p._value.dtype)
         pruned[name] = mask
     return pruned
 
@@ -76,7 +77,7 @@ class OptimizerWithSparsityGuarantee:
     def step(self):
         self._optimizer.step()
         for p in self._optimizer._parameter_list:
-            mask = _masks.get(id(p))
+            mask = getattr(p, "_asp_mask", None)
             if mask is not None:
                 p._set_value(p._value * mask)
 

@@ -6,9 +6,16 @@ The reference implements these with its primitive-rule AD (``primx.py``,
 transforms — the framework's ops are jax-traceable, so forward- and
 reverse-mode compose for free (including the higher-order cases the eager
 tape declines).
+
+Jacobian/Hessian follow the reference's matrix view: every input is
+flattened to length N, every output to length M, giving J of shape [M, N]
+(or [B, M, N] with ``is_batched=True``, where flattening excludes the
+leading batch dim).
 """
 
 from __future__ import annotations
+
+import numpy as np
 
 import jax
 import jax.numpy as jnp
@@ -66,27 +73,49 @@ def vjp(func, xs, v=None):
     return _wrap(out), _wrap(grads)
 
 
+def _flat_fn(fn, template_xs):
+    """Wrap fn to map one flat 1-D input vector -> one flat output vector."""
+    sizes = [max(int(np.prod(x.shape)), 1) for x in template_xs]
+    shapes = [x.shape for x in template_xs]
+
+    def flat_fn(flat_x):
+        parts, o = [], 0
+        for shp, n in zip(shapes, sizes):
+            parts.append(flat_x[o:o + n].reshape(shp))
+            o += n
+        out = fn(*parts)
+        outs = out if isinstance(out, (list, tuple)) else (out,)
+        return jnp.concatenate([jnp.ravel(o_) for o_ in outs])
+
+    return flat_fn, sizes
+
+
+def _pack(jax_xs):
+    return jnp.concatenate([jnp.ravel(x) for x in jax_xs])
+
+
 class Jacobian:
-    """Lazy full Jacobian (ref functional.py:172). Index as J[:] or J[i, j]."""
+    """Full Jacobian as an [M, N] matrix ([B, M, N] when batched)."""
 
     def __init__(self, func, xs, is_batched=False):
         jax_xs = _unwrap(xs)
-        jac = jax.jacrev(_as_jax_fn(func), argnums=tuple(range(len(jax_xs))))(
-            *jax_xs)
-        if len(jax_xs) == 1 and not isinstance(jac, tuple):
-            jac = (jac,)
-        flat = []
-        for j in jac if isinstance(jac, tuple) else (jac,):
-            arr = j
-            if is_batched:
-                b = arr.shape[0]
-                flat.append(arr.reshape(b, -1, *([1] if arr.ndim < 3 else []))
-                            if arr.ndim < 3 else
-                            arr.reshape(b, arr.shape[1], -1))
-            else:
-                flat.append(arr.reshape(_rows(arr), -1))
-        self._value = jnp.concatenate(flat, axis=-1)
+        fn = _as_jax_fn(func)
         self.is_batched = is_batched
+        if not is_batched:
+            flat_fn, _ = _flat_fn(fn, jax_xs)
+            self._value = jax.jacrev(flat_fn)(_pack(jax_xs))
+        else:
+            sample_xs = tuple(x[0] for x in jax_xs)
+
+            def sample_fn(*sample):
+                # re-add the batch dim the user's fn expects, strip it after
+                out = fn(*[s[None] for s in sample])
+                outs = out if isinstance(out, (list, tuple)) else (out,)
+                return jnp.concatenate([jnp.ravel(o_) for o_ in outs])
+
+            flat_sample_fn, _ = _flat_fn(sample_fn, sample_xs)
+            per_sample = jax.jacrev(flat_sample_fn)
+            self._value = jax.vmap(lambda *s: per_sample(_pack(s)))(*jax_xs)
 
     @property
     def shape(self):
@@ -96,36 +125,33 @@ class Jacobian:
         return Tensor(self._value[idx], stop_gradient=True)
 
     def numpy(self):
-        import numpy as np
         return np.asarray(self._value)
 
 
-def _rows(arr):
-    # output dims come first in jacrev's result; collapse to 2-D [out, in]
-    return arr.shape[0] if arr.ndim >= 1 else 1
-
-
 class Hessian:
-    """Full Hessian of a scalar function (ref functional.py Hessian)."""
+    """Hessian of a scalar function as an [N, N] matrix ([B, N, N] when
+    batched: the function maps each sample to a scalar)."""
 
     def __init__(self, func, xs, is_batched=False):
         jax_xs = _unwrap(xs)
-        hes = jax.hessian(_as_jax_fn(func), argnums=tuple(range(len(jax_xs))))(
-            *jax_xs)
-        if len(jax_xs) == 1:
-            h = hes[0][0] if isinstance(hes, tuple) else hes
-            n = 1
-            for s in jax_xs[0].shape:
-                n *= s
-            self._value = jnp.reshape(h, (n, n))
+        fn = _as_jax_fn(func)
+        self.is_batched = is_batched
+
+        if not is_batched:
+            flat_fn, _ = _flat_fn(fn, jax_xs)
+            self._value = jax.hessian(
+                lambda fx: flat_fn(fx).sum())(_pack(jax_xs))
         else:
-            blocks = []
-            sizes = [int(jnp.size(x)) for x in jax_xs]
-            for i, row in enumerate(hes):
-                blocks.append(jnp.concatenate(
-                    [jnp.reshape(row[j], (sizes[i], sizes[j]))
-                     for j in range(len(jax_xs))], axis=1))
-            self._value = jnp.concatenate(blocks, axis=0)
+            sample_xs = tuple(x[0] for x in jax_xs)
+
+            def sample_fn(*sample):
+                out = fn(*[s[None] for s in sample])
+                outs = out if isinstance(out, (list, tuple)) else (out,)
+                return jnp.concatenate([jnp.ravel(o_) for o_ in outs])
+
+            flat_sample_fn, _ = _flat_fn(sample_fn, sample_xs)
+            hess = jax.hessian(lambda fx: flat_sample_fn(fx).sum())
+            self._value = jax.vmap(lambda *s: hess(_pack(s)))(*jax_xs)
 
     @property
     def shape(self):
@@ -135,7 +161,6 @@ class Hessian:
         return Tensor(self._value[idx], stop_gradient=True)
 
     def numpy(self):
-        import numpy as np
         return np.asarray(self._value)
 
 

@@ -1,0 +1,4 @@
+"""paddle.incubate.distributed namespace (ref ``python/paddle/incubate/
+distributed/``): MoE lives under models.moe, implemented in parallel.moe."""
+
+from . import models  # noqa: F401

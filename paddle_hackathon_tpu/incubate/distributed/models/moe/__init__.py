@@ -1,0 +1,8 @@
+"""Re-export of the MoE stack (ref ``python/paddle/incubate/distributed/
+models/moe/moe_layer.py:244``); implementation in ``parallel.moe``."""
+
+from paddle_hackathon_tpu.parallel import moe as _impl
+from paddle_hackathon_tpu.parallel.moe import *  # noqa: F401,F403
+
+__all__ = getattr(_impl, "__all__", [n for n in dir(_impl)
+                                     if not n.startswith("_")])

@@ -26,6 +26,16 @@ def _t(x):
     return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
 
 
+def _dropout(h, rate, key, mode="upscale_in_train"):
+    """Shared dropout body for the fused chains. key=None -> identity."""
+    if key is None:
+        return h
+    keep = jax.random.bernoulli(key, 1.0 - rate, h.shape)
+    if mode == "upscale_in_train":
+        return jnp.where(keep, h / (1.0 - rate), 0.0).astype(h.dtype)
+    return jnp.where(keep, h, 0.0).astype(h.dtype)
+
+
 def _pad_lanes(x, d):
     pad = (-d) % 128
     if pad:
@@ -101,9 +111,7 @@ def fused_layer_norm(x, norm_weight=None, norm_bias=None, epsilon=1e-5,
         h = d["x"]
         if "bias" in d:
             h = h + d["bias"]
-        if drop_key is not None:
-            keep = jax.random.bernoulli(drop_key, 1.0 - dropout_rate, h.shape)
-            h = jnp.where(keep, h / (1.0 - dropout_rate), 0.0).astype(h.dtype)
+        h = _dropout(h, dropout_rate, drop_key)
         if "residual" in d:
             h = h + d["residual"]
         res_out = h
@@ -133,12 +141,7 @@ def fused_dropout_add(x, y, p=0.0, training=True, mode="upscale_in_train"):
             if not training and p > 0.0 and mode != "upscale_in_train":
                 a = a * (1.0 - p)
             return a + b
-        keep = jax.random.bernoulli(drop_key, 1.0 - p, a.shape)
-        if mode == "upscale_in_train":
-            a = jnp.where(keep, a / (1.0 - p), 0.0).astype(a.dtype)
-        else:
-            a = jnp.where(keep, a, 0.0).astype(a.dtype)
-        return a + b
+        return _dropout(a, p, drop_key, mode) + b
 
     return apply_op("fused_dropout_add", fn, [_t(x), _t(y)])
 
@@ -190,12 +193,6 @@ def fused_feedforward(x, linear1_weight, linear1_bias, linear2_weight,
         if dropout2_rate > 0.0:
             keys[1] = core_random.split_key()
 
-    def _drop(h, rate, key):
-        if key is None:
-            return h
-        keep = jax.random.bernoulli(key, 1.0 - rate, h.shape)
-        return jnp.where(keep, h / (1.0 - rate), 0.0).astype(h.dtype)
-
     def _ln(h, d, eps):
         mu = jnp.mean(h, axis=-1, keepdims=True)
         var = jnp.mean(jnp.square(h - mu), axis=-1, keepdims=True)
@@ -212,9 +209,9 @@ def fused_feedforward(x, linear1_weight, linear1_bias, linear2_weight,
         h = _ln(d["x"], d, ln1_epsilon) if pre_layer_norm else d["x"]
         h = jnp.matmul(h, d["w1"]) + d["b1"]
         h = jax.nn.gelu(h) if activation == "gelu" else jax.nn.relu(h)
-        h = _drop(h, dropout1_rate, keys[0])
+        h = _dropout(h, dropout1_rate, keys[0])
         h = jnp.matmul(h, d["w2"]) + d["b2"]
-        h = _drop(h, dropout2_rate, keys[1])
+        h = _dropout(h, dropout2_rate, keys[1])
         out = residual + h
         if not pre_layer_norm:
             out = _ln(out, d, ln1_epsilon)

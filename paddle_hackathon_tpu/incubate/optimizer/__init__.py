@@ -22,7 +22,10 @@ class LookAhead(Optimizer):
         self.inner_optimizer = inner_optimizer
         self.alpha = alpha
         self.k = k
-        self._slow = {}
+        # snapshot at construction (ref lookahead.py: slow params start as
+        # the initial weights, so the first sync damps the whole window)
+        self._slow = {id(p): p._value
+                      for p in inner_optimizer._parameter_list}
         self._step_num = 0
         # not calling super().__init__: this is a wrapper, state lives inner
 
@@ -45,9 +48,7 @@ class LookAhead(Optimizer):
         if self._step_num % self.k:
             return
         for p in self.inner_optimizer._parameter_list:
-            slow = self._slow.get(id(p))
-            if slow is None:
-                slow = p._value
+            slow = self._slow.get(id(p), p._value)
             slow = slow + self.alpha * (p._value - slow)
             self._slow[id(p)] = slow
             p._set_value(slow)

@@ -19,7 +19,7 @@ _param_counter = [0]
 
 class Parameter(Tensor):
     __slots__ = ("optimize_attr", "regularizer", "do_model_average",
-                 "is_distributed", "pspec")
+                 "is_distributed", "pspec", "_asp_mask")
 
     def __init__(self, value, trainable: bool = True, name: Optional[str] = None):
         if name is None:

@@ -1,0 +1,5 @@
+"""paddle.vision equivalent: model zoo, transforms, datasets, detection ops
+(ref ``python/paddle/vision/``)."""
+
+from . import datasets, models, ops, transforms  # noqa: F401
+from .models import *  # noqa: F401,F403

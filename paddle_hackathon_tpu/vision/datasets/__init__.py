@@ -1,0 +1,152 @@
+"""Vision datasets (ref ``python/paddle/vision/datasets/`` — MNIST
+``mnist.py``, Cifar ``cifar.py``, FashionMNIST, Flowers).
+
+The reference downloads archives on first use; this environment has no
+network egress, so each dataset reads the standard on-disk format from
+``data_file``/``data_dir`` when present and raises a clear error otherwise.
+``FakeData`` provides deterministic synthetic samples for tests and smoke
+runs (mirrors the role of the reference's unittest fake readers).
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import struct
+import tarfile
+
+import numpy as np
+
+from ...io.dataset import Dataset
+
+__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "FakeData"]
+
+
+def _maybe(tf, img, label):
+    return img, label
+
+
+class MNIST(Dataset):
+    """IDX-format MNIST (ref ``vision/datasets/mnist.py``)."""
+
+    NAME = "mnist"
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=False, backend=None):
+        self.mode = mode.lower()
+        self.transform = transform
+        if image_path is None or label_path is None:
+            base = os.environ.get("PADDLE_DATA_HOME",
+                                  os.path.expanduser("~/.cache/paddle/datasets"))
+            stem = "train" if self.mode == "train" else "t10k"
+            image_path = image_path or os.path.join(
+                base, self.NAME, f"{stem}-images-idx3-ubyte.gz")
+            label_path = label_path or os.path.join(
+                base, self.NAME, f"{stem}-labels-idx1-ubyte.gz")
+        if not (os.path.exists(image_path) and os.path.exists(label_path)):
+            raise FileNotFoundError(
+                f"{self.NAME} files not found at {image_path}; this build "
+                "has no network access — place the IDX archives there or "
+                "use vision.datasets.FakeData for smoke runs")
+        self.images = self._read_idx(image_path, 3)
+        self.labels = self._read_idx(label_path, 1)
+
+    @staticmethod
+    def _read_idx(path, ndim):
+        op = gzip.open if path.endswith(".gz") else open
+        with op(path, "rb") as f:
+            magic = struct.unpack(">I", f.read(4))[0]
+            dims = [struct.unpack(">I", f.read(4))[0]
+                    for _ in range(magic & 0xFF)]
+            data = np.frombuffer(f.read(), np.uint8)
+        return data.reshape(dims)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        label = np.asarray(self.labels[idx], np.int64)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, label
+
+    def __len__(self):
+        return len(self.images)
+
+
+class FashionMNIST(MNIST):
+    NAME = "fashion-mnist"
+
+
+class Cifar10(Dataset):
+    """CIFAR pickle batches from the standard tar.gz (ref cifar.py)."""
+
+    _ARCHIVE = "cifar-10-python.tar.gz"
+    _PREFIX = "cifar-10-batches-py"
+    _LABEL_KEY = b"labels"
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=False, backend=None):
+        self.mode = mode.lower()
+        self.transform = transform
+        if data_file is None:
+            base = os.environ.get("PADDLE_DATA_HOME",
+                                  os.path.expanduser("~/.cache/paddle/datasets"))
+            data_file = os.path.join(base, "cifar", self._ARCHIVE)
+        if not os.path.exists(data_file):
+            raise FileNotFoundError(
+                f"cifar archive not found at {data_file}; this build has no "
+                "network access — place the archive there or use FakeData")
+        names = ([f"data_batch_{i}" for i in range(1, 6)]
+                 if self.mode == "train" else ["test_batch"])
+        if self._PREFIX == "cifar-100-python":
+            names = ["train"] if self.mode == "train" else ["test"]
+        imgs, labels = [], []
+        with tarfile.open(data_file, "r:gz") as tf:
+            for n in names:
+                f = tf.extractfile(f"{self._PREFIX}/{n}")
+                batch = pickle.load(f, encoding="bytes")
+                imgs.append(batch[b"data"])
+                labels.extend(batch[self._LABEL_KEY])
+        self.images = np.concatenate(imgs).reshape(-1, 3, 32, 32)
+        self.labels = np.asarray(labels, np.int64)
+
+    def __getitem__(self, idx):
+        img = np.transpose(self.images[idx], (1, 2, 0))  # HWC for transforms
+        label = self.labels[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, label
+
+    def __len__(self):
+        return len(self.images)
+
+
+class Cifar100(Cifar10):
+    _ARCHIVE = "cifar-100-python.tar.gz"
+    _PREFIX = "cifar-100-python"
+    _LABEL_KEY = b"fine_labels"
+
+
+class FakeData(Dataset):
+    """Deterministic synthetic image classification data (for tests and
+    benchmarks; fills the role of the reference's fake data feeds)."""
+
+    def __init__(self, num_samples=100, image_shape=(3, 32, 32),
+                 num_classes=10, transform=None, seed=0):
+        self.num_samples = num_samples
+        self.image_shape = tuple(image_shape)
+        self.num_classes = num_classes
+        self.transform = transform
+        self.seed = seed
+
+    def __getitem__(self, idx):
+        rng = np.random.RandomState(self.seed + idx)
+        img = rng.randint(0, 256, self.image_shape[1:] + (self.image_shape[0],),
+                          dtype=np.uint8)  # HWC like real loaders
+        label = np.asarray(rng.randint(0, self.num_classes), np.int64)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, label
+
+    def __len__(self):
+        return self.num_samples

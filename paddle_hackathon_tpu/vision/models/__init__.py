@@ -1,0 +1,19 @@
+"""Model zoo (ref ``python/paddle/vision/models/``)."""
+
+from .lenet import LeNet
+from .mobilenet import (MobileNetV1, MobileNetV2, MobileNetV3, mobilenet_v1,
+                        mobilenet_v2, mobilenet_v3_large, mobilenet_v3_small)
+from .resnet import (BasicBlock, BottleneckBlock, ResNet, resnet18, resnet34,
+                     resnet50, resnet101, resnet152, resnext50_32x4d,
+                     resnext101_32x4d, resnext152_32x4d, wide_resnet50_2,
+                     wide_resnet101_2)
+from .vgg import VGG, vgg11, vgg13, vgg16, vgg19
+
+__all__ = [
+    "LeNet", "ResNet", "BasicBlock", "BottleneckBlock", "resnet18",
+    "resnet34", "resnet50", "resnet101", "resnet152", "resnext50_32x4d",
+    "resnext101_32x4d", "resnext152_32x4d", "wide_resnet50_2",
+    "wide_resnet101_2", "VGG", "vgg11", "vgg13", "vgg16", "vgg19",
+    "MobileNetV1", "MobileNetV2", "MobileNetV3", "mobilenet_v1",
+    "mobilenet_v2", "mobilenet_v3_large", "mobilenet_v3_small",
+]

@@ -1,0 +1,264 @@
+"""MobileNet v1/v2/v3 (ref ``python/paddle/vision/models/mobilenetv1.py``,
+``mobilenetv2.py``, ``mobilenetv3.py``).
+
+Depthwise convs are grouped convs (groups == in_channels) — XLA lowers
+these to VPU-friendly per-channel loops on TPU.
+"""
+
+from __future__ import annotations
+
+from ... import nn
+from ...ops import manipulation as M
+
+
+def _make_divisible(v, divisor=8, min_value=None):
+    min_value = min_value or divisor
+    new_v = max(min_value, int(v + divisor / 2) // divisor * divisor)
+    if new_v < 0.9 * v:
+        new_v += divisor
+    return new_v
+
+
+class ConvBNLayer(nn.Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, groups=1, activation=nn.ReLU):
+        super().__init__()
+        self.conv = nn.Conv2D(in_channels, out_channels, kernel_size,
+                              stride=stride, padding=padding, groups=groups,
+                              bias_attr=False)
+        self.bn = nn.BatchNorm2D(out_channels)
+        self.act = activation() if activation is not None else None
+
+    def forward(self, x):
+        x = self.bn(self.conv(x))
+        return self.act(x) if self.act is not None else x
+
+
+# ---------------------------------------------------------------------------
+# v1
+# ---------------------------------------------------------------------------
+
+class DepthwiseSeparable(nn.Layer):
+    def __init__(self, in_channels, out_channels1, out_channels2, num_groups,
+                 stride, scale):
+        super().__init__()
+        self.dw = ConvBNLayer(in_channels, int(out_channels1 * scale), 3,
+                              stride=stride, padding=1,
+                              groups=int(num_groups * scale))
+        self.pw = ConvBNLayer(int(out_channels1 * scale),
+                              int(out_channels2 * scale), 1)
+
+    def forward(self, x):
+        return self.pw(self.dw(x))
+
+
+class MobileNetV1(nn.Layer):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.scale = scale
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.conv1 = ConvBNLayer(3, int(32 * scale), 3, stride=2, padding=1)
+        cfg = [  # in, c1, c2, groups, stride
+            (32, 32, 64, 32, 1), (64, 64, 128, 64, 2),
+            (128, 128, 128, 128, 1), (128, 128, 256, 128, 2),
+            (256, 256, 256, 256, 1), (256, 256, 512, 256, 2),
+            (512, 512, 512, 512, 1), (512, 512, 512, 512, 1),
+            (512, 512, 512, 512, 1), (512, 512, 512, 512, 1),
+            (512, 512, 512, 512, 1), (512, 512, 1024, 512, 2),
+            (1024, 1024, 1024, 1024, 1)]
+        self.blocks = nn.Sequential(*[
+            DepthwiseSeparable(int(i * scale), c1, c2, g, s, scale)
+            for i, c1, c2, g, s in cfg])
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = nn.Linear(int(1024 * scale), num_classes)
+
+    def forward(self, x):
+        x = self.blocks(self.conv1(x))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(M.flatten(x, 1))
+        return x
+
+
+# ---------------------------------------------------------------------------
+# v2
+# ---------------------------------------------------------------------------
+
+class InvertedResidual(nn.Layer):
+    def __init__(self, inp, oup, stride, expand_ratio):
+        super().__init__()
+        self.stride = stride
+        hidden_dim = int(round(inp * expand_ratio))
+        self.use_res_connect = stride == 1 and inp == oup
+        layers = []
+        if expand_ratio != 1:
+            layers.append(ConvBNLayer(inp, hidden_dim, 1,
+                                      activation=nn.ReLU6))
+        layers += [
+            ConvBNLayer(hidden_dim, hidden_dim, 3, stride=stride, padding=1,
+                        groups=hidden_dim, activation=nn.ReLU6),
+            ConvBNLayer(hidden_dim, oup, 1, activation=None)]
+        self.conv = nn.Sequential(*layers)
+
+    def forward(self, x):
+        out = self.conv(x)
+        return x + out if self.use_res_connect else out
+
+
+class MobileNetV2(nn.Layer):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        input_channel = _make_divisible(32 * scale)
+        cfg = [  # t, c, n, s
+            (1, 16, 1, 1), (6, 24, 2, 2), (6, 32, 3, 2), (6, 64, 4, 2),
+            (6, 96, 3, 1), (6, 160, 3, 2), (6, 320, 1, 1)]
+        features = [ConvBNLayer(3, input_channel, 3, stride=2, padding=1,
+                                activation=nn.ReLU6)]
+        for t, c, n, s in cfg:
+            output_channel = _make_divisible(c * scale)
+            for i in range(n):
+                features.append(InvertedResidual(
+                    input_channel, output_channel, s if i == 0 else 1, t))
+                input_channel = output_channel
+        self.last_channel = _make_divisible(1280 * max(1.0, scale))
+        features.append(ConvBNLayer(input_channel, self.last_channel, 1,
+                                    activation=nn.ReLU6))
+        self.features = nn.Sequential(*features)
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.classifier = nn.Sequential(
+                nn.Dropout(0.2), nn.Linear(self.last_channel, num_classes))
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.classifier(M.flatten(x, 1))
+        return x
+
+
+# ---------------------------------------------------------------------------
+# v3
+# ---------------------------------------------------------------------------
+
+class SqueezeExcite(nn.Layer):
+    def __init__(self, channel, reduction=4):
+        super().__init__()
+        mid = _make_divisible(channel // reduction)
+        self.pool = nn.AdaptiveAvgPool2D(1)
+        self.fc1 = nn.Conv2D(channel, mid, 1)
+        self.fc2 = nn.Conv2D(mid, channel, 1)
+
+    def forward(self, x):
+        from ...nn import functional as F
+        s = self.pool(x)
+        s = F.relu(self.fc1(s))
+        s = F.hardsigmoid(self.fc2(s))
+        return x * s
+
+
+class _V3Block(nn.Layer):
+    def __init__(self, inp, hidden, out, kernel, stride, use_se, act):
+        super().__init__()
+        self.use_res = stride == 1 and inp == out
+        A = nn.Hardswish if act == "HS" else nn.ReLU
+        layers = []
+        if hidden != inp:
+            layers.append(ConvBNLayer(inp, hidden, 1, activation=A))
+        layers.append(ConvBNLayer(hidden, hidden, kernel, stride=stride,
+                                  padding=kernel // 2, groups=hidden,
+                                  activation=A))
+        if use_se:
+            layers.append(SqueezeExcite(hidden))
+        layers.append(ConvBNLayer(hidden, out, 1, activation=None))
+        self.block = nn.Sequential(*layers)
+
+    def forward(self, x):
+        out = self.block(x)
+        return x + out if self.use_res else out
+
+
+_V3_LARGE = [  # k, exp, out, se, act, s
+    (3, 16, 16, False, "RE", 1), (3, 64, 24, False, "RE", 2),
+    (3, 72, 24, False, "RE", 1), (5, 72, 40, True, "RE", 2),
+    (5, 120, 40, True, "RE", 1), (5, 120, 40, True, "RE", 1),
+    (3, 240, 80, False, "HS", 2), (3, 200, 80, False, "HS", 1),
+    (3, 184, 80, False, "HS", 1), (3, 184, 80, False, "HS", 1),
+    (3, 480, 112, True, "HS", 1), (3, 672, 112, True, "HS", 1),
+    (5, 672, 160, True, "HS", 2), (5, 960, 160, True, "HS", 1),
+    (5, 960, 160, True, "HS", 1)]
+
+_V3_SMALL = [
+    (3, 16, 16, True, "RE", 2), (3, 72, 24, False, "RE", 2),
+    (3, 88, 24, False, "RE", 1), (5, 96, 40, True, "HS", 2),
+    (5, 240, 40, True, "HS", 1), (5, 240, 40, True, "HS", 1),
+    (5, 120, 48, True, "HS", 1), (5, 144, 48, True, "HS", 1),
+    (5, 288, 96, True, "HS", 2), (5, 576, 96, True, "HS", 1),
+    (5, 576, 96, True, "HS", 1)]
+
+
+class MobileNetV3(nn.Layer):
+    def __init__(self, config, last_channel, scale=1.0, num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        inp = _make_divisible(16 * scale)
+        layers = [ConvBNLayer(3, inp, 3, stride=2, padding=1,
+                              activation=nn.Hardswish)]
+        for k, exp, out, se, act, s in config:
+            exp_c = _make_divisible(exp * scale)
+            out_c = _make_divisible(out * scale)
+            layers.append(_V3Block(inp, exp_c, out_c, k, s, se, act))
+            inp = out_c
+        last_exp = _make_divisible(config[-1][1] * scale)
+        layers.append(ConvBNLayer(inp, last_exp, 1, activation=nn.Hardswish))
+        self.features = nn.Sequential(*layers)
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.classifier = nn.Sequential(
+                nn.Linear(last_exp, last_channel),
+                nn.Hardswish(),
+                nn.Dropout(0.2),
+                nn.Linear(last_channel, num_classes))
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.classifier(M.flatten(x, 1))
+        return x
+
+
+def mobilenet_v1(pretrained=False, scale=1.0, **kwargs):
+    if pretrained:
+        raise NotImplementedError("pretrained weights are not bundled")
+    return MobileNetV1(scale=scale, **kwargs)
+
+
+def mobilenet_v2(pretrained=False, scale=1.0, **kwargs):
+    if pretrained:
+        raise NotImplementedError("pretrained weights are not bundled")
+    return MobileNetV2(scale=scale, **kwargs)
+
+
+def mobilenet_v3_large(pretrained=False, scale=1.0, **kwargs):
+    if pretrained:
+        raise NotImplementedError("pretrained weights are not bundled")
+    return MobileNetV3(_V3_LARGE, 1280, scale=scale, **kwargs)
+
+
+def mobilenet_v3_small(pretrained=False, scale=1.0, **kwargs):
+    if pretrained:
+        raise NotImplementedError("pretrained weights are not bundled")
+    return MobileNetV3(_V3_SMALL, 1024, scale=scale, **kwargs)

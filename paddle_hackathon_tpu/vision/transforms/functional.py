@@ -1,0 +1,180 @@
+"""Functional image transforms (ref ``python/paddle/vision/transforms/
+functional.py`` + ``functional_cv2.py``).
+
+Operate on numpy HWC uint8/float arrays (the reference's cv2/PIL backends)
+or on framework Tensors (CHW); transforms run on host as part of the input
+pipeline — device work starts at ``to_tensor``.
+"""
+
+from __future__ import annotations
+
+import numbers
+
+import numpy as np
+
+from ...core.tensor import Tensor
+
+
+def _as_hwc(img):
+    if isinstance(img, Tensor):
+        img = np.asarray(img._value)
+    img = np.asarray(img)
+    if img.ndim == 2:
+        img = img[:, :, None]
+    return img
+
+
+def to_tensor(pic, data_format="CHW"):
+    """HWC [0,255] uint8 (or float) image -> float32 tensor scaled to [0,1]."""
+    img = _as_hwc(pic)
+    if img.dtype == np.uint8:
+        img = img.astype(np.float32) / 255.0
+    else:
+        img = img.astype(np.float32)
+    if data_format == "CHW":
+        img = np.transpose(img, (2, 0, 1))
+    return Tensor(img)
+
+
+def normalize(img, mean, std, data_format="CHW", to_rgb=False):
+    if isinstance(img, Tensor):
+        arr = np.asarray(img._value).astype(np.float32)
+    else:
+        arr = np.asarray(img).astype(np.float32)
+    mean = np.asarray(mean, np.float32)
+    std = np.asarray(std, np.float32)
+    ch = arr.shape[0] if data_format == "CHW" else arr.shape[-1]
+    if mean.ndim and mean.shape[0] not in (1, ch):
+        raise ValueError(
+            f"normalize mean has {mean.shape[0]} entries but the image has "
+            f"{ch} channels")
+    if data_format == "CHW":
+        arr = (arr - mean.reshape(-1, 1, 1)) / std.reshape(-1, 1, 1)
+    else:
+        arr = (arr - mean) / std
+    return Tensor(arr) if isinstance(img, Tensor) else arr
+
+
+def _interp_resize(img, size):
+    """Bilinear resize of an HWC numpy image (no cv2/PIL dependency)."""
+    h, w = img.shape[:2]
+    if isinstance(size, numbers.Number):
+        # shorter edge -> size, keep aspect (paddle semantics)
+        if h <= w:
+            oh, ow = int(size), max(int(size * w / h), 1)
+        else:
+            oh, ow = max(int(size * h / w), 1), int(size)
+    else:
+        oh, ow = size
+    if (oh, ow) == (h, w):
+        return img
+    ys = (np.arange(oh) + 0.5) * h / oh - 0.5
+    xs = (np.arange(ow) + 0.5) * w / ow - 0.5
+    y0 = np.clip(np.floor(ys).astype(int), 0, h - 1)
+    x0 = np.clip(np.floor(xs).astype(int), 0, w - 1)
+    y1 = np.clip(y0 + 1, 0, h - 1)
+    x1 = np.clip(x0 + 1, 0, w - 1)
+    wy = np.clip(ys - y0, 0, 1)[:, None, None]
+    wx = np.clip(xs - x0, 0, 1)[None, :, None]
+    f = img.astype(np.float32)
+    out = ((f[y0][:, x0] * (1 - wy) + f[y1][:, x0] * wy) * (1 - wx)
+           + (f[y0][:, x1] * (1 - wy) + f[y1][:, x1] * wy) * wx)
+    return out.astype(img.dtype) if img.dtype == np.uint8 else out
+
+
+def resize(img, size, interpolation="bilinear"):
+    return _interp_resize(_as_hwc(img), size)
+
+
+def crop(img, top, left, height, width):
+    return _as_hwc(img)[top:top + height, left:left + width]
+
+
+def center_crop(img, output_size):
+    img = _as_hwc(img)
+    if isinstance(output_size, numbers.Number):
+        output_size = (int(output_size), int(output_size))
+    h, w = img.shape[:2]
+    th, tw = output_size
+    top = int(round((h - th) / 2.0))
+    left = int(round((w - tw) / 2.0))
+    return crop(img, top, left, th, tw)
+
+
+def hflip(img):
+    return _as_hwc(img)[:, ::-1]
+
+
+def vflip(img):
+    return _as_hwc(img)[::-1]
+
+
+def pad(img, padding, fill=0, padding_mode="constant"):
+    img = _as_hwc(img)
+    if isinstance(padding, numbers.Number):
+        padding = (padding,) * 4
+    elif len(padding) == 2:
+        padding = (padding[0], padding[1], padding[0], padding[1])
+    left, top, right, bottom = padding
+    pads = ((top, bottom), (left, right), (0, 0))
+    if padding_mode == "constant":
+        return np.pad(img, pads, mode="constant", constant_values=fill)
+    return np.pad(img, pads, mode={"edge": "edge", "reflect": "reflect",
+                                   "symmetric": "symmetric"}[padding_mode])
+
+
+def rotate(img, angle, interpolation="nearest", expand=False, center=None,
+           fill=0):
+    """Nearest-neighbour rotation (host-side; ref functional_cv2.rotate)."""
+    img = _as_hwc(img)
+    h, w = img.shape[:2]
+    cy, cx = ((h - 1) / 2.0, (w - 1) / 2.0) if center is None else center[::-1]
+    theta = np.deg2rad(angle)
+    cos, sin = np.cos(theta), np.sin(theta)
+    yy, xx = np.meshgrid(np.arange(h), np.arange(w), indexing="ij")
+    ys = cos * (yy - cy) - sin * (xx - cx) + cy
+    xs = sin * (yy - cy) + cos * (xx - cx) + cx
+    yi = np.round(ys).astype(int)
+    xi = np.round(xs).astype(int)
+    valid = (yi >= 0) & (yi < h) & (xi >= 0) & (xi < w)
+    out = np.full_like(img, fill)
+    out[valid] = img[yi[valid], xi[valid]]
+    return out
+
+
+def adjust_brightness(img, brightness_factor):
+    img = _as_hwc(img)
+    out = img.astype(np.float32) * brightness_factor
+    return np.clip(out, 0, 255).astype(img.dtype)
+
+
+def adjust_contrast(img, contrast_factor):
+    img = _as_hwc(img)
+    mean = img.astype(np.float32).mean()
+    out = (img.astype(np.float32) - mean) * contrast_factor + mean
+    return np.clip(out, 0, 255).astype(img.dtype)
+
+
+def adjust_hue(img, hue_factor):
+    """Cheap hue shift by channel rotation interpolation."""
+    img = _as_hwc(img).astype(np.float32)
+    if img.shape[2] < 3:
+        return img.astype(np.uint8)
+    shifted = np.roll(img[:, :, :3], 1, axis=2)
+    t = abs(hue_factor) * 2.0
+    out = img.copy()
+    out[:, :, :3] = img[:, :, :3] * (1 - t) + shifted * t
+    return np.clip(out, 0, 255).astype(np.uint8)
+
+
+def to_grayscale(img, num_output_channels=1):
+    img = _as_hwc(img).astype(np.float32)
+    if img.shape[2] >= 3:
+        g = (0.299 * img[:, :, 0] + 0.587 * img[:, :, 1]
+             + 0.114 * img[:, :, 2])
+    else:
+        g = img[:, :, 0]
+    g = g[:, :, None]
+    if num_output_channels == 3:
+        g = np.repeat(g, 3, axis=2)
+    return np.clip(g, 0, 255).astype(np.uint8)

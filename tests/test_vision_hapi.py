@@ -1,0 +1,176 @@
+"""vision (models/transforms/datasets/ops) + hapi Model.fit.
+
+Mirrors the reference's test style: model zoo forward-shape tests
+(test_vision_models.py pattern), transform output checks
+(test_transforms.py), Model.fit smoke on synthetic data (test_model.py).
+"""
+
+import numpy as np
+import pytest
+
+import paddle_hackathon_tpu as paddle
+from paddle_hackathon_tpu import hapi, metric, nn, optimizer, vision
+from paddle_hackathon_tpu.core.tensor import Tensor
+
+
+def _img_batch(n=2, c=3, hw=32):
+    return Tensor(np.random.randn(n, c, hw, hw).astype(np.float32))
+
+
+@pytest.mark.parametrize("ctor,kwargs", [
+    (vision.models.resnet18, {}),
+    (vision.models.resnet50, {}),
+    (vision.models.resnext50_32x4d, {}),
+    (vision.models.wide_resnet50_2, {}),
+])
+def test_resnet_family_forward(ctor, kwargs):
+    m = ctor(num_classes=7, **kwargs)
+    m.eval()
+    out = m(_img_batch(hw=64))
+    assert out.shape == [2, 7]
+
+
+def test_vgg_forward():
+    m = vision.models.vgg11(num_classes=5)
+    m.eval()
+    assert m(_img_batch(hw=224)).shape == [2, 5]
+
+
+@pytest.mark.parametrize("ctor", [
+    vision.models.mobilenet_v1,
+    vision.models.mobilenet_v2,
+    vision.models.mobilenet_v3_small,
+])
+def test_mobilenet_forward(ctor):
+    m = ctor(num_classes=4)
+    m.eval()
+    assert m(_img_batch(hw=64)).shape == [2, 4]
+
+
+def test_lenet_forward_backward():
+    m = vision.models.LeNet()
+    x = Tensor(np.random.randn(4, 1, 28, 28).astype(np.float32),
+               stop_gradient=False)
+    out = m(x)
+    assert out.shape == [4, 10]
+    out.sum().backward()
+    assert m.features[0].weight.grad is not None
+
+
+def test_transforms_pipeline():
+    T = vision.transforms
+    tf = T.Compose([
+        T.Resize(40),
+        T.CenterCrop(32),
+        T.RandomHorizontalFlip(0.5),
+        T.ToTensor(),
+        T.Normalize(mean=[0.5, 0.5, 0.5], std=[0.5, 0.5, 0.5]),
+    ])
+    img = np.random.randint(0, 256, (50, 60, 3), np.uint8)
+    out = tf(img)
+    assert out.shape == [3, 32, 32]
+    arr = out.numpy()
+    assert arr.min() >= -1.001 and arr.max() <= 1.001
+
+
+def test_transforms_resize_semantics():
+    img = np.zeros((40, 80, 3), np.uint8)
+    out = vision.transforms.functional.resize(img, 20)
+    assert out.shape[:2] == (20, 40)  # shorter edge -> 20, aspect kept
+    out2 = vision.transforms.functional.resize(img, (10, 15))
+    assert out2.shape[:2] == (10, 15)
+
+
+def test_fake_dataset_and_loader():
+    ds = vision.datasets.FakeData(num_samples=16, image_shape=(1, 28, 28),
+                                  transform=vision.transforms.ToTensor())
+    img, label = ds[0]
+    assert img.shape == [1, 28, 28]
+    img2, _ = ds[0]
+    np.testing.assert_allclose(img.numpy(), img2.numpy())  # deterministic
+
+
+def test_mnist_missing_file_message(tmp_path):
+    with pytest.raises(FileNotFoundError, match="no network access"):
+        vision.datasets.MNIST(image_path=str(tmp_path / "x.gz"),
+                              label_path=str(tmp_path / "y.gz"))
+
+
+def test_nms():
+    boxes = np.array([[0, 0, 10, 10], [1, 1, 11, 11], [20, 20, 30, 30]],
+                     np.float32)
+    scores = np.array([0.9, 0.8, 0.7], np.float32)
+    keep = vision.ops.nms(Tensor(boxes), iou_threshold=0.5,
+                          scores=Tensor(scores))
+    assert sorted(keep.numpy().tolist()) == [0, 2]
+
+
+def test_box_iou_and_roi_align():
+    b1 = np.array([[0, 0, 10, 10]], np.float32)
+    b2 = np.array([[0, 0, 10, 10], [5, 5, 15, 15]], np.float32)
+    iou = vision.ops.box_iou(Tensor(b1), Tensor(b2)).numpy()
+    assert iou[0, 0] == pytest.approx(1.0)
+    assert iou[0, 1] == pytest.approx(25.0 / 175.0, rel=1e-4)
+
+    feat = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    rois = np.array([[0, 0, 4, 4]], np.float32)
+    out = vision.ops.roi_align(Tensor(feat), Tensor(rois), Tensor(np.array([1])),
+                               output_size=2, sampling_ratio=1)
+    assert out.shape == [1, 1, 2, 2]
+
+
+def test_hapi_model_fit_evaluate_predict(tmp_path):
+    ds = vision.datasets.FakeData(num_samples=32, image_shape=(1, 28, 28),
+                                  num_classes=10,
+                                  transform=vision.transforms.ToTensor())
+    net = vision.models.LeNet()
+    model = hapi.Model(net)
+    model.prepare(
+        optimizer=optimizer.Adam(learning_rate=1e-3,
+                                 parameters=net.parameters()),
+        loss=nn.CrossEntropyLoss(),
+        metrics=metric.Accuracy())
+    logs = model.fit(ds, epochs=1, batch_size=8, verbose=0)
+    assert "loss" in logs
+
+    eval_logs = model.evaluate(ds, batch_size=8, verbose=0)
+    assert "acc" in eval_logs or "loss" in eval_logs
+
+    preds = model.predict(ds, batch_size=8, stack_outputs=True)
+    assert preds[0].shape == (32, 10)
+
+    path = str(tmp_path / "ckpt" / "model")
+    model.save(path)
+    model2 = hapi.Model(vision.models.LeNet())
+    model2.prepare(optimizer=optimizer.Adam(
+        learning_rate=1e-3, parameters=model2.network.parameters()),
+        loss=nn.CrossEntropyLoss())
+    model2.load(path)
+    w1 = net.state_dict()
+    w2 = model2.network.state_dict()
+    for k in w1:
+        np.testing.assert_allclose(np.asarray(w1[k].numpy()),
+                                   np.asarray(w2[k].numpy()))
+
+
+def test_hapi_early_stopping():
+    ds = vision.datasets.FakeData(num_samples=16, image_shape=(1, 28, 28),
+                                  transform=vision.transforms.ToTensor())
+    net = vision.models.LeNet()
+    model = hapi.Model(net)
+    model.prepare(optimizer=optimizer.SGD(learning_rate=0.0,
+                                          parameters=net.parameters()),
+                  loss=nn.CrossEntropyLoss(),
+                  metrics=metric.Accuracy())
+    es = hapi.callbacks.EarlyStopping(monitor="loss", patience=0, verbose=0)
+    model.fit(ds, eval_data=ds, epochs=3, batch_size=8, verbose=0,
+              callbacks=[hapi.callbacks.ProgBarLogger(1, 0), es])
+    # zero LR -> no improvement -> stops after the patience window
+    assert model.stop_training
+
+
+def test_model_summary(capsys):
+    model = hapi.Model(vision.models.LeNet())
+    info = model.summary()
+    assert info["total_params"] > 0
+    assert "Total params" in capsys.readouterr().out
