@@ -46,6 +46,23 @@ def seed(s):
     _seed_fn(s)
 
 
+def enable_static():
+    """Switch to static-graph mode (ref paddle.enable_static)."""
+    from .static import program as _sprog
+    _sprog.enable_static()
+
+
+def disable_static():
+    from .static import program as _sprog
+    _sprog.disable_static()
+
+
+def in_dynamic_mode():
+    from .core import autograd as _ag
+    sm = _ag._static_module
+    return not (sm is not None and sm.in_static_mode())
+
+
 bool = bool_  # noqa: A001 — paddle.bool
 
 
@@ -58,7 +75,8 @@ def is_grad_enabled_():
 import importlib as _importlib
 
 for _sub in ("nn", "optimizer", "io", "amp", "metric", "framework",
-             "jit", "distributed", "vision", "incubate", "profiler", "hapi"):
+             "jit", "distributed", "vision", "incubate", "profiler", "hapi",
+             "static"):
     try:
         globals()[_sub] = _importlib.import_module(f"{__name__}.{_sub}")
     except ModuleNotFoundError as _e:

@@ -33,6 +33,10 @@ _tls = threading.local()
 # Injected by tensor.py at import time to avoid a circular import.
 Tensor = None  # type: ignore
 _amp_mod = None  # lazily bound amp module (AMP cast hook)
+# Injected by static/program.py at import time: static-graph recording hook.
+_static_module = None
+# Set by profiler while recording: name -> context-manager factory.
+_profiler_hook = None
 
 
 def _set_tensor_class(cls) -> None:
@@ -338,7 +342,23 @@ def apply_op(name: str, fn: Callable, args: Sequence[Any], n_outputs: int = 1):
     ``args`` may mix Tensors, jax arrays, python scalars and None. Tensors with
     ``stop_gradient=False`` and floating dtype become vjp-differentiable inputs;
     everything else is closed over as a constant.
+
+    In static-graph mode, ops touching a symbolic Variable append an
+    instruction to the current Program instead of executing (ref the
+    append_op path of ``fluid/framework.py``).
     """
+    sm = _static_module
+    if (sm is not None and sm.in_static_mode()
+            and any(isinstance(a, sm.Variable) for a in args)):
+        return sm.default_main_program().record_op(name, fn, args, n_outputs)
+    hook = _profiler_hook
+    if hook is not None:
+        with hook(name):
+            return _apply_op_impl(name, fn, args, n_outputs)
+    return _apply_op_impl(name, fn, args, n_outputs)
+
+
+def _apply_op_impl(name: str, fn: Callable, args: Sequence[Any], n_outputs: int = 1):
     jax_args = []
     diff_positions = []
     tape_on = is_grad_enabled()

@@ -1,0 +1,438 @@
+"""paddle.distribution equivalent (ref ``python/paddle/distribution/``).
+
+Probability distributions over framework Tensors; sampling uses the
+framework RNG stream (``core.random``), densities are taped ops so
+log_prob backprops like any other op.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..core import random as core_random
+from ..core.autograd import apply_op
+from ..core.tensor import Tensor
+
+__all__ = ["Distribution", "Normal", "Uniform", "Categorical", "Bernoulli",
+           "Beta", "Dirichlet", "Exponential", "Gamma", "Laplace",
+           "LogNormal", "Gumbel", "Multinomial", "kl_divergence",
+           "register_kl"]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x, jnp.float32))
+
+
+def _v(x):
+    return x._value if isinstance(x, Tensor) else jnp.asarray(x, jnp.float32)
+
+
+class Distribution:
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self):
+        return self._batch_shape
+
+    @property
+    def event_shape(self):
+        return self._event_shape
+
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def rsample(self, shape=()):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        return apply_op("dist_prob", lambda lp: jnp.exp(lp),
+                        [self.log_prob(value)])
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        return kl_divergence(self, other)
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        super().__init__(batch_shape=tuple(jnp.broadcast_shapes(
+            self.loc._value.shape, self.scale._value.shape)))
+
+    def sample(self, shape=(), seed=0):
+        key = core_random.split_key()
+        shp = tuple(shape) + self.batch_shape
+        eps = jax.random.normal(key, shp)
+        return apply_op("normal_sample",
+                        lambda l, s: l + s * eps, [self.loc, self.scale])
+
+    rsample = sample
+
+    def log_prob(self, value):
+        def fn(v, l, s):
+            var = s * s
+            return (-jnp.square(v - l) / (2 * var)
+                    - jnp.log(s) - 0.5 * math.log(2 * math.pi))
+        return apply_op("normal_log_prob", fn,
+                        [_t(value), self.loc, self.scale])
+
+    def entropy(self):
+        return apply_op(
+            "normal_entropy",
+            lambda s: 0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(s),
+            [self.scale])
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def variance(self):
+        return apply_op("normal_var", lambda s: s * s, [self.scale])
+
+
+class LogNormal(Distribution):
+    def __init__(self, loc, scale):
+        self._base = Normal(loc, scale)
+        super().__init__(batch_shape=self._base.batch_shape)
+
+    def sample(self, shape=()):
+        return apply_op("lognormal_sample", jnp.exp,
+                        [self._base.sample(shape)])
+
+    def log_prob(self, value):
+        def fn(v, l, s):
+            lv = jnp.log(v)
+            var = s * s
+            return (-jnp.square(lv - l) / (2 * var) - jnp.log(s)
+                    - 0.5 * math.log(2 * math.pi) - lv)
+        return apply_op("lognormal_log_prob", fn,
+                        [_t(value), self._base.loc, self._base.scale])
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = _t(low)
+        self.high = _t(high)
+        super().__init__(batch_shape=tuple(jnp.broadcast_shapes(
+            self.low._value.shape, self.high._value.shape)))
+
+    def sample(self, shape=(), seed=0):
+        key = core_random.split_key()
+        shp = tuple(shape) + self.batch_shape
+        u = jax.random.uniform(key, shp)
+        return apply_op("uniform_sample",
+                        lambda lo, hi: lo + (hi - lo) * u,
+                        [self.low, self.high])
+
+    rsample = sample
+
+    def log_prob(self, value):
+        def fn(v, lo, hi):
+            inside = (v >= lo) & (v < hi)
+            return jnp.where(inside, -jnp.log(hi - lo), -jnp.inf)
+        return apply_op("uniform_log_prob", fn,
+                        [_t(value), self.low, self.high])
+
+    def entropy(self):
+        return apply_op("uniform_entropy",
+                        lambda lo, hi: jnp.log(hi - lo),
+                        [self.low, self.high])
+
+
+class Categorical(Distribution):
+    def __init__(self, logits=None, probs=None, name=None):
+        if logits is None and probs is None:
+            raise ValueError("either logits or probs must be given")
+        if logits is not None:
+            self.logits = _t(logits)
+        else:
+            self.logits = apply_op("cat_logits", jnp.log, [_t(probs)])
+        super().__init__(batch_shape=self.logits._value.shape[:-1])
+
+    @property
+    def probs(self):
+        return apply_op("cat_probs",
+                        lambda l: jax.nn.softmax(l, axis=-1), [self.logits])
+
+    def sample(self, shape=()):
+        key = core_random.split_key()
+        shp = tuple(shape) + self.batch_shape
+        logits = self.logits._value
+        out = jax.random.categorical(key, logits, shape=shp)
+        return Tensor(out)
+
+    def log_prob(self, value):
+        def fn(l, v):
+            lp = jax.nn.log_softmax(l, axis=-1)
+            return jnp.take_along_axis(
+                lp, v[..., None].astype(jnp.int32), axis=-1)[..., 0]
+        return apply_op("cat_log_prob", fn, [self.logits, _t(value)])
+
+    def entropy(self):
+        def fn(l):
+            lp = jax.nn.log_softmax(l, axis=-1)
+            return -jnp.sum(jnp.exp(lp) * lp, axis=-1)
+        return apply_op("cat_entropy", fn, [self.logits])
+
+
+class Bernoulli(Distribution):
+    def __init__(self, probs):
+        self.probs = _t(probs)
+        super().__init__(batch_shape=self.probs._value.shape)
+
+    def sample(self, shape=()):
+        key = core_random.split_key()
+        shp = tuple(shape) + self.batch_shape
+        return Tensor(jax.random.bernoulli(
+            key, self.probs._value, shp).astype(jnp.float32))
+
+    def log_prob(self, value):
+        def fn(p, v):
+            p = jnp.clip(p, 1e-7, 1 - 1e-7)
+            return v * jnp.log(p) + (1 - v) * jnp.log1p(-p)
+        return apply_op("bern_log_prob", fn, [self.probs, _t(value)])
+
+    def entropy(self):
+        def fn(p):
+            p = jnp.clip(p, 1e-7, 1 - 1e-7)
+            return -(p * jnp.log(p) + (1 - p) * jnp.log1p(-p))
+        return apply_op("bern_entropy", fn, [self.probs])
+
+
+class Beta(Distribution):
+    def __init__(self, alpha, beta):
+        self.alpha = _t(alpha)
+        self.beta = _t(beta)
+        super().__init__(batch_shape=tuple(jnp.broadcast_shapes(
+            self.alpha._value.shape, self.beta._value.shape)))
+
+    def sample(self, shape=()):
+        key = core_random.split_key()
+        shp = tuple(shape) + self.batch_shape
+        return Tensor(jax.random.beta(key, self.alpha._value,
+                                      self.beta._value, shp))
+
+    def log_prob(self, value):
+        def fn(v, a, b):
+            return ((a - 1) * jnp.log(v) + (b - 1) * jnp.log1p(-v)
+                    - (jax.scipy.special.betaln(a, b)))
+        return apply_op("beta_log_prob", fn,
+                        [_t(value), self.alpha, self.beta])
+
+    def entropy(self):
+        def fn(a, b):
+            dg = jax.scipy.special.digamma
+            return (jax.scipy.special.betaln(a, b)
+                    - (a - 1) * dg(a) - (b - 1) * dg(b)
+                    + (a + b - 2) * dg(a + b))
+        return apply_op("beta_entropy", fn, [self.alpha, self.beta])
+
+
+class Dirichlet(Distribution):
+    def __init__(self, concentration):
+        self.concentration = _t(concentration)
+        shp = self.concentration._value.shape
+        super().__init__(batch_shape=shp[:-1], event_shape=shp[-1:])
+
+    def sample(self, shape=()):
+        key = core_random.split_key()
+        shp = tuple(shape) + self.batch_shape
+        return Tensor(jax.random.dirichlet(
+            key, self.concentration._value, shp or None))
+
+    def log_prob(self, value):
+        def fn(v, c):
+            return (jnp.sum((c - 1) * jnp.log(v), axis=-1)
+                    + jax.scipy.special.gammaln(jnp.sum(c, axis=-1))
+                    - jnp.sum(jax.scipy.special.gammaln(c), axis=-1))
+        return apply_op("dirichlet_log_prob", fn,
+                        [_t(value), self.concentration])
+
+
+class Exponential(Distribution):
+    def __init__(self, rate):
+        self.rate = _t(rate)
+        super().__init__(batch_shape=self.rate._value.shape)
+
+    def sample(self, shape=()):
+        key = core_random.split_key()
+        shp = tuple(shape) + self.batch_shape
+        e = jax.random.exponential(key, shp)
+        return apply_op("exp_sample", lambda r: e / r, [self.rate])
+
+    def log_prob(self, value):
+        return apply_op("exp_log_prob",
+                        lambda v, r: jnp.log(r) - r * v,
+                        [_t(value), self.rate])
+
+
+class Gamma(Distribution):
+    def __init__(self, concentration, rate):
+        self.concentration = _t(concentration)
+        self.rate = _t(rate)
+        super().__init__(batch_shape=tuple(jnp.broadcast_shapes(
+            self.concentration._value.shape, self.rate._value.shape)))
+
+    def sample(self, shape=()):
+        key = core_random.split_key()
+        shp = tuple(shape) + self.batch_shape
+        g = jax.random.gamma(key, self.concentration._value, shp)
+        return apply_op("gamma_sample", lambda r: g / r, [self.rate])
+
+    def log_prob(self, value):
+        def fn(v, c, r):
+            return (c * jnp.log(r) + (c - 1) * jnp.log(v) - r * v
+                    - jax.scipy.special.gammaln(c))
+        return apply_op("gamma_log_prob", fn,
+                        [_t(value), self.concentration, self.rate])
+
+
+class Laplace(Distribution):
+    def __init__(self, loc, scale):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        super().__init__(batch_shape=tuple(jnp.broadcast_shapes(
+            self.loc._value.shape, self.scale._value.shape)))
+
+    def sample(self, shape=()):
+        key = core_random.split_key()
+        shp = tuple(shape) + self.batch_shape
+        e = jax.random.laplace(key, shp)
+        return apply_op("laplace_sample",
+                        lambda l, s: l + s * e, [self.loc, self.scale])
+
+    def log_prob(self, value):
+        return apply_op(
+            "laplace_log_prob",
+            lambda v, l, s: -jnp.abs(v - l) / s - jnp.log(2 * s),
+            [_t(value), self.loc, self.scale])
+
+
+class Gumbel(Distribution):
+    def __init__(self, loc, scale):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        super().__init__(batch_shape=tuple(jnp.broadcast_shapes(
+            self.loc._value.shape, self.scale._value.shape)))
+
+    def sample(self, shape=()):
+        key = core_random.split_key()
+        shp = tuple(shape) + self.batch_shape
+        g = jax.random.gumbel(key, shp)
+        return apply_op("gumbel_sample",
+                        lambda l, s: l + s * g, [self.loc, self.scale])
+
+    def log_prob(self, value):
+        def fn(v, l, s):
+            z = (v - l) / s
+            return -(z + jnp.exp(-z)) - jnp.log(s)
+        return apply_op("gumbel_log_prob", fn,
+                        [_t(value), self.loc, self.scale])
+
+
+class Multinomial(Distribution):
+    def __init__(self, total_count, probs):
+        self.total_count = int(total_count)
+        self.probs = _t(probs)
+        shp = self.probs._value.shape
+        super().__init__(batch_shape=shp[:-1], event_shape=shp[-1:])
+
+    def sample(self, shape=()):
+        key = core_random.split_key()
+        n = self.probs._value.shape[-1]
+        logits = jnp.log(jnp.clip(self.probs._value, 1e-12))
+        draws = jax.random.categorical(
+            key, logits, shape=tuple(shape) + self.batch_shape
+            + (self.total_count,))
+        counts = jax.nn.one_hot(draws, n).sum(axis=-2)
+        return Tensor(counts)
+
+    def log_prob(self, value):
+        def fn(v, p):
+            logp = jnp.log(jnp.clip(p, 1e-12))
+            gl = jax.scipy.special.gammaln
+            return (gl(jnp.asarray(self.total_count + 1.0))
+                    - jnp.sum(gl(v + 1.0), axis=-1)
+                    + jnp.sum(v * logp, axis=-1))
+        return apply_op("multinomial_log_prob", fn, [_t(value), self.probs])
+
+
+# ---------------------------------------------------------------------------
+# KL divergence registry (ref distribution/kl.py register_kl)
+# ---------------------------------------------------------------------------
+
+_KL_REGISTRY = {}
+
+
+def register_kl(p_cls, q_cls):
+    def deco(fn):
+        _KL_REGISTRY[(p_cls, q_cls)] = fn
+        return fn
+    return deco
+
+
+def kl_divergence(p, q):
+    fn = _KL_REGISTRY.get((type(p), type(q)))
+    if fn is None:
+        raise NotImplementedError(
+            f"no KL registered for ({type(p).__name__}, {type(q).__name__})")
+    return fn(p, q)
+
+
+@register_kl(Normal, Normal)
+def _kl_normal_normal(p, q):
+    def fn(pl, ps, ql, qs):
+        vr = jnp.square(ps / qs)
+        return 0.5 * (vr + jnp.square(ql - pl) / jnp.square(qs)
+                      - 1.0 - jnp.log(vr))
+    return apply_op("kl_normal", fn, [p.loc, p.scale, q.loc, q.scale])
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform_uniform(p, q):
+    def fn(pl, ph, ql, qh):
+        inside = (ql <= pl) & (ph <= qh)
+        return jnp.where(inside, jnp.log((qh - ql) / (ph - pl)), jnp.inf)
+    return apply_op("kl_uniform", fn, [p.low, p.high, q.low, q.high])
+
+
+@register_kl(Categorical, Categorical)
+def _kl_cat_cat(p, q):
+    def fn(pl, ql):
+        plog = jax.nn.log_softmax(pl, axis=-1)
+        qlog = jax.nn.log_softmax(ql, axis=-1)
+        return jnp.sum(jnp.exp(plog) * (plog - qlog), axis=-1)
+    return apply_op("kl_categorical", fn, [p.logits, q.logits])
+
+
+@register_kl(Bernoulli, Bernoulli)
+def _kl_bern_bern(p, q):
+    def fn(pp, qp):
+        pp = jnp.clip(pp, 1e-7, 1 - 1e-7)
+        qp = jnp.clip(qp, 1e-7, 1 - 1e-7)
+        return (pp * (jnp.log(pp) - jnp.log(qp))
+                + (1 - pp) * (jnp.log1p(-pp) - jnp.log1p(-qp)))
+    return apply_op("kl_bernoulli", fn, [p.probs, q.probs])
+
+
+@register_kl(Beta, Beta)
+def _kl_beta_beta(p, q):
+    def fn(pa, pb, qa, qb):
+        dg = jax.scipy.special.digamma
+        bl = jax.scipy.special.betaln
+        return (bl(qa, qb) - bl(pa, pb)
+                + (pa - qa) * dg(pa) + (pb - qb) * dg(pb)
+                + (qa - pa + qb - pb) * dg(pa + pb))
+    return apply_op("kl_beta", fn, [p.alpha, p.beta, q.alpha, q.beta])

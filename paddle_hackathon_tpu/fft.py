@@ -1,0 +1,69 @@
+"""paddle.fft equivalent (ref ``python/paddle/fft.py`` — pocketfft there;
+XLA's FFT HLO here, one lowering path for CPU/TPU)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .core.autograd import apply_op
+from .core.tensor import Tensor
+
+__all__ = ["fft", "ifft", "rfft", "irfft", "hfft", "ihfft", "fft2", "ifft2",
+           "rfft2", "irfft2", "fftn", "ifftn", "rfftn", "irfftn",
+           "fftfreq", "rfftfreq", "fftshift", "ifftshift"]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+
+
+def _norm(norm):
+    return None if norm in (None, "backward") else norm
+
+
+def _mk1(name, jfn):
+    def op(x, n=None, axis=-1, norm="backward", name_=None):
+        return apply_op(name, lambda v: jfn(v, n=n, axis=axis,
+                                            norm=_norm(norm)), [_t(x)])
+    op.__name__ = name
+    return op
+
+
+def _mk2(name, jfn):
+    def op(x, s=None, axes=(-2, -1), norm="backward", name_=None):
+        return apply_op(name, lambda v: jfn(v, s=s, axes=axes,
+                                            norm=_norm(norm)), [_t(x)])
+    op.__name__ = name
+    return op
+
+
+fft = _mk1("fft", jnp.fft.fft)
+ifft = _mk1("ifft", jnp.fft.ifft)
+rfft = _mk1("rfft", jnp.fft.rfft)
+irfft = _mk1("irfft", jnp.fft.irfft)
+hfft = _mk1("hfft", jnp.fft.hfft)
+ihfft = _mk1("ihfft", jnp.fft.ihfft)
+fft2 = _mk2("fft2", jnp.fft.fft2)
+ifft2 = _mk2("ifft2", jnp.fft.ifft2)
+rfft2 = _mk2("rfft2", jnp.fft.rfft2)
+irfft2 = _mk2("irfft2", jnp.fft.irfft2)
+fftn = _mk2("fftn", jnp.fft.fftn)
+ifftn = _mk2("ifftn", jnp.fft.ifftn)
+rfftn = _mk2("rfftn", jnp.fft.rfftn)
+irfftn = _mk2("irfftn", jnp.fft.irfftn)
+
+
+def fftfreq(n, d=1.0, dtype="float32", name=None):
+    return Tensor(jnp.fft.fftfreq(n, d).astype(dtype))
+
+
+def rfftfreq(n, d=1.0, dtype="float32", name=None):
+    return Tensor(jnp.fft.rfftfreq(n, d).astype(dtype))
+
+
+def fftshift(x, axes=None, name=None):
+    return apply_op("fftshift", lambda v: jnp.fft.fftshift(v, axes), [_t(x)])
+
+
+def ifftshift(x, axes=None, name=None):
+    return apply_op("ifftshift", lambda v: jnp.fft.ifftshift(v, axes), [_t(x)])

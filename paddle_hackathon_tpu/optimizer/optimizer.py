@@ -41,9 +41,14 @@ class Optimizer:
     def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
                  grad_clip=None, multi_precision=False, name=None):
         if parameters is None:
-            raise ValueError(
-                "parameters must be given in dygraph mode "
-                "(pass model.parameters())")
+            from ..core import autograd as _ag
+            sm = _ag._static_module
+            if not (sm is not None and sm.in_static_mode()):
+                raise ValueError(
+                    "parameters must be given in dygraph mode "
+                    "(pass model.parameters()); in static mode the program's "
+                    "parameters are collected by minimize()")
+            parameters = []
         self._parameter_list = list(parameters)
         self._learning_rate = learning_rate
         self._grad_clip = grad_clip
@@ -174,6 +179,22 @@ class Optimizer:
                     found = True
             if found:
                 self._accumulators[id(p)] = acc
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        """Dygraph: backward+step+clear. Static mode: records the
+        backward+update extension onto the loss's Program (the reference's
+        append-backward + optimizer-op rewrite, ``optimizer.py:1232``
+        static branch); the Executor compiles it into the train program."""
+        from ..core import autograd as _ag
+        sm = _ag._static_module
+        if sm is not None and isinstance(loss, sm.Variable):
+            loss._program._minimize = (self, loss)
+            return None, None
+        loss.backward()
+        self.step()
+        self.clear_grad()
+        return None, None
 
     def _append_optimize_op(self, *a, **k):  # static-graph shim (not used)
         raise NotImplementedError("static graph path handled by jit module")

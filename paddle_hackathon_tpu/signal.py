@@ -1,0 +1,116 @@
+"""paddle.signal equivalent (ref ``python/paddle/signal.py`` — stft/istft)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .core.autograd import apply_op
+from .core.tensor import Tensor
+
+__all__ = ["stft", "istft", "frame", "overlap_add"]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+
+
+def frame(x, frame_length, hop_length, axis=-1, name=None):
+    """Slice overlapping frames (ref signal.frame). axis=-1 ->
+    (..., frame_length, num_frames); axis=0 -> (num_frames, frame_length, ...)."""
+    def fn(v):
+        if axis not in (-1, v.ndim - 1, 0):
+            raise ValueError("frame supports axis 0 or -1")
+        v2 = jnp.moveaxis(v, 0, -1) if axis == 0 else v
+        n = v2.shape[-1]
+        num = 1 + (n - frame_length) // hop_length
+        idx = (jnp.arange(num)[:, None] * hop_length
+               + jnp.arange(frame_length)[None, :])
+        framed = v2[..., idx]                    # (..., num, frame_length)
+        framed = jnp.swapaxes(framed, -1, -2)    # (..., frame_length, num)
+        if axis == 0:
+            framed = jnp.moveaxis(framed, (-2, -1), (1, 0))
+        return framed
+    return apply_op("frame", fn, [_t(x)])
+
+
+def overlap_add(x, hop_length, axis=-1, name=None):
+    def fn(v):
+        # v: (..., frames, frame_length) with axis pointing at frame_length
+        moved = jnp.moveaxis(v, axis, -1)
+        frames, flen = moved.shape[-2], moved.shape[-1]
+        out_len = (frames - 1) * hop_length + flen
+        out = jnp.zeros(moved.shape[:-2] + (out_len,), moved.dtype)
+        for i in range(frames):
+            out = out.at[..., i * hop_length:i * hop_length + flen].add(
+                moved[..., i, :])
+        return out
+    return apply_op("overlap_add", fn, [_t(x)])
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None,
+         center=True, pad_mode="reflect", normalized=False, onesided=True,
+         name=None):
+    """Short-time Fourier transform (ref signal.stft)."""
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    win = window._value if isinstance(window, Tensor) else (
+        jnp.asarray(window) if window is not None
+        else jnp.ones((win_length,), jnp.float32))
+    if win_length < n_fft:
+        lp = (n_fft - win_length) // 2
+        win = jnp.pad(win, (lp, n_fft - win_length - lp))
+
+    def fn(v):
+        sig = v
+        if center:
+            pad = n_fft // 2
+            sig = jnp.pad(sig, [(0, 0)] * (sig.ndim - 1) + [(pad, pad)],
+                          mode=pad_mode)
+        n = sig.shape[-1]
+        num = 1 + (n - n_fft) // hop_length
+        starts = jnp.arange(num) * hop_length
+        idx = starts[:, None] + jnp.arange(n_fft)[None, :]
+        frames = sig[..., idx] * win                    # (..., num, n_fft)
+        spec = (jnp.fft.rfft(frames, axis=-1) if onesided
+                else jnp.fft.fft(frames, axis=-1))
+        if normalized:
+            spec = spec / jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+        return jnp.swapaxes(spec, -1, -2)               # (..., freq, frames)
+    return apply_op("stft", fn, [_t(x)])
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None,
+          center=True, normalized=False, onesided=True, length=None,
+          return_complex=False, name=None):
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    win = window._value if isinstance(window, Tensor) else (
+        jnp.asarray(window) if window is not None
+        else jnp.ones((win_length,), jnp.float32))
+    if win_length < n_fft:
+        lp = (n_fft - win_length) // 2
+        win = jnp.pad(win, (lp, n_fft - win_length - lp))
+
+    def fn(v):
+        spec = jnp.swapaxes(v, -1, -2)                  # (..., frames, freq)
+        if normalized:
+            spec = spec * jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+        frames = (jnp.fft.irfft(spec, n=n_fft, axis=-1) if onesided
+                  else jnp.fft.ifft(spec, axis=-1).real)
+        frames = frames * win
+        num = frames.shape[-2]
+        out_len = (num - 1) * hop_length + n_fft
+        out = jnp.zeros(frames.shape[:-2] + (out_len,), frames.dtype)
+        wsum = jnp.zeros((out_len,), frames.dtype)
+        for i in range(num):
+            sl = slice(i * hop_length, i * hop_length + n_fft)
+            out = out.at[..., sl].add(frames[..., i, :])
+            wsum = wsum.at[sl].add(win * win)
+        out = out / jnp.where(wsum > 1e-10, wsum, 1.0)
+        if center:
+            pad = n_fft // 2
+            out = out[..., pad:out.shape[-1] - pad]
+        if length is not None:
+            out = out[..., :length]
+        return out
+    return apply_op("istft", fn, [_t(x)])

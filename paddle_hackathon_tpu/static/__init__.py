@@ -1,0 +1,123 @@
+"""paddle.static equivalent (ref ``python/paddle/static/``).
+
+Program = recorded instruction list (ProgramDesc analog), Executor = one
+jax.jit replay (InterpreterCore analog — XLA schedules/fuses), data() =
+feed Variable, save/load_inference_model = StableHLO export.
+"""
+
+from __future__ import annotations
+
+import jax
+
+from ..jit.api import InputSpec  # noqa: F401
+from .executor import Executor  # noqa: F401
+from .io import load_inference_model, save_inference_model  # noqa: F401
+from .program import (Program, Variable, default_main_program,  # noqa: F401
+                      default_startup_program, program_guard)
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    """Declare a feed variable in the current main program
+    (ref ``static/input.py data``)."""
+    return default_main_program().add_feed(name, list(shape), dtype)
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    """Static backward (ref ``fluid/backward.py gradients``): records grad
+    instructions computing d(sum(targets))/d(inputs) into the program."""
+    targets = targets if isinstance(targets, (list, tuple)) else [targets]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    prog = default_main_program()
+
+    # Replay-based grad: one instruction whose fn closes over a sub-replay
+    # of everything already recorded. Inputs to the instruction are the
+    # program's feeds + params (so the Executor wires them in).
+    sub_instructions = list(prog._instructions)
+    feeds = list(prog._feeds)
+    params = prog.all_parameters()
+
+    import jax.numpy as jnp
+
+    target_ids = [t._var_id for t in targets]
+    input_ids = [x._var_id for x in inputs]
+    feed_ids = [f._var_id for f in feeds]
+
+    def grad_fn(*vals):
+        feed_vals = list(vals[:len(feed_ids)])
+        param_vals = list(vals[len(feed_ids):])
+
+        def replay_loss(wrt_vals):
+            env = dict(zip(feed_ids, feed_vals))
+            pmap = dict(zip((id(p) for p in params), param_vals))
+            for vid, v in zip(input_ids, wrt_vals):
+                env[vid] = v
+            for ins in sub_instructions:
+                if set(ins.out_ids) <= set(env):
+                    continue
+                ivals = []
+                for kind, ref in ins.inputs:
+                    if kind == "var":
+                        ivals.append(env[ref])
+                    elif kind == "param":
+                        ivals.append(pmap[id(ref)])
+                    else:
+                        ivals.append(ref)
+                out = ins.fn(*ivals)
+                outs = (out,) if ins.n_outputs == 1 and not isinstance(
+                    out, tuple) else out
+                for vid, val in zip(ins.out_ids, outs):
+                    env[vid] = val
+            total = None
+            for tid in target_ids:
+                s = jnp.sum(env[tid].astype(jnp.float32))
+                total = s if total is None else total + s
+            return total
+
+        # grads w.r.t. the inputs' current env values: recompute forward to
+        # the inputs first (inputs are themselves vars in env or feeds)
+        env0 = dict(zip(feed_ids, feed_vals))
+        pmap0 = dict(zip((id(p) for p in params), param_vals))
+        for ins in sub_instructions:
+            ivals = []
+            for kind, ref in ins.inputs:
+                if kind == "var":
+                    ivals.append(env0[ref])
+                elif kind == "param":
+                    ivals.append(pmap0[id(ref)])
+                else:
+                    ivals.append(ref)
+            out = ins.fn(*ivals)
+            outs = (out,) if ins.n_outputs == 1 and not isinstance(
+                out, tuple) else out
+            for vid, val in zip(ins.out_ids, outs):
+                env0[vid] = val
+        wrt = [env0[i] for i in input_ids]
+        g = jax.grad(replay_loss)(wrt)
+        return tuple(g) if len(g) > 1 else g[0]
+
+    args = feeds + params
+    out = prog.record_op("gradients", grad_fn, args,
+                         n_outputs=len(input_ids))
+    return list(out) if isinstance(out, tuple) else [out]
+
+
+# `nn` compatibility namespace: the reference's paddle.static.nn re-exports
+# fc/embedding-style layer functions; the dynamic layers cover these.
+class _StaticNN:
+    @staticmethod
+    def fc(x, size, num_flatten_dims=1, activation=None, name=None):
+        from .. import nn as _nn
+        from ..ops import manipulation as M
+        flat = M.flatten(x, num_flatten_dims) if x.ndim > 2 else x
+        lin = _nn.Linear(int(flat.shape[-1]), size)
+        out = lin(flat)
+        if activation:
+            out = getattr(_nn.functional, activation)(out)
+        return out
+
+
+nn = _StaticNN()
+
+__all__ = ["data", "Executor", "Program", "Variable", "program_guard",
+           "default_main_program", "default_startup_program", "InputSpec",
+           "save_inference_model", "load_inference_model", "gradients"]

@@ -1,0 +1,125 @@
+"""Static-graph Executor.
+
+Ref ``Executor.run`` ``python/paddle/fluid/executor.py:1104`` and the C++
+executors (§3.2 of SURVEY): here a Program's instruction list is replayed
+inside one ``jax.jit`` per (program version, feed signature, fetch set) —
+XLA is the InterpreterCore: dependency scheduling, fusion, stream
+management and memory planning all happen in the compiler. ``minimize``
+(recorded by ``Optimizer.minimize``) extends the traced program with
+``jax.grad`` over the replay plus the optimizer's own ``_update_all``
+fused update — the equivalent of the reference's append-backward +
+optimizer-op program rewrite.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from .program import Program, Variable, default_main_program
+
+
+class Executor:
+    def __init__(self, place=None):
+        self.place = place
+        self._cache = {}
+
+    def run(self, program: Optional[Program] = None, feed: Optional[Dict] = None,
+            fetch_list: Optional[Sequence] = None, return_numpy: bool = True):
+        program = program if program is not None else default_main_program()
+        feed = feed or {}
+        fetch_list = list(fetch_list or [])
+        if not program._instructions and not fetch_list:
+            return []  # startup program: params are initialized eagerly
+
+        fetch_vars = [program.var(f) if isinstance(f, str) else f
+                      for f in fetch_list]
+        feed_map = {}
+        for v in program._feeds:
+            if v.name not in feed:
+                raise ValueError(f"missing feed {v.name!r}")
+            feed_map[v._var_id] = jnp.asarray(feed[v.name])
+
+        key = (id(program), len(program._instructions),
+               tuple(sorted((vid, arr.shape, str(arr.dtype))
+                            for vid, arr in feed_map.items())),
+               tuple(v._var_id for v in fetch_vars),
+               program._minimize is not None)
+        if key not in self._cache:
+            self._cache[key] = self._compile(program, sorted(feed_map),
+                                             fetch_vars)
+        run_fn, params, opt = self._cache[key]
+
+        feed_arrays = [feed_map[vid] for vid in sorted(feed_map)]
+        param_arrays = [p._value for p in params]
+        if opt is None:
+            fetches = run_fn(feed_arrays, param_arrays)
+        else:
+            optimizer, _ = program._minimize
+            states = [optimizer._get_accumulators(p) for p in params]
+            lr = jnp.asarray(optimizer.get_lr(), jnp.float32)
+            step_t = jnp.asarray(optimizer._step_count + 1, jnp.int32)
+            fetches, new_vals, new_states = run_fn(
+                feed_arrays, param_arrays, states, lr, step_t)
+            for p, v, s in zip(params, new_vals, new_states):
+                p._set_value(v)
+                optimizer._accumulators[id(p)] = s
+            optimizer._step_count += 1
+        if return_numpy:
+            return [np.asarray(f) for f in fetches]
+        return [Tensor(f) for f in fetches]
+
+    def _compile(self, program: Program, feed_ids: List[int], fetch_vars):
+        params = program.all_parameters()
+        trainable = [p for p in params
+                     if getattr(p, "trainable", False)]
+        minimize = program._minimize
+
+        def replay_with(feed_arrays, param_arrays):
+            feed_values = dict(zip(feed_ids, feed_arrays))
+            param_values = {id(p): v for p, v in zip(params, param_arrays)}
+            return program.replay(feed_values, param_values)
+
+        if minimize is None:
+            def run_fn(feed_arrays, param_arrays):
+                env = replay_with(feed_arrays, param_arrays)
+                return [env[v._var_id] for v in fetch_vars]
+
+            return jax.jit(run_fn), params, None
+
+        optimizer, loss_var = minimize
+        t_idx = [i for i, p in enumerate(params)
+                 if getattr(p, "trainable", False)]
+
+        def run_fn(feed_arrays, param_arrays, states, lr, step_t):
+            def loss_of(train_arrays):
+                full = list(param_arrays)
+                for i, v in zip(t_idx, train_arrays):
+                    full[i] = v
+                env = replay_with(feed_arrays, full)
+                return env[loss_var._var_id], env
+
+            train_arrays = [param_arrays[i] for i in t_idx]
+            (loss, env), grads = jax.value_and_grad(loss_of, has_aux=True)(
+                train_arrays)
+            t_states = [states[i] for i in t_idx]
+            plrs = tuple(params[i].optimize_attr.get("learning_rate", 1.0)
+                         for i in t_idx)
+            new_train, new_t_states = optimizer._update_all(
+                train_arrays, grads, t_states, lr, step_t, plrs)
+            new_vals = list(param_arrays)
+            new_states = list(states)
+            for i, v, s in zip(t_idx, new_train, new_t_states):
+                new_vals[i] = v
+                new_states[i] = s
+            fetches = [env[v._var_id] for v in fetch_vars]
+            return fetches, new_vals, new_states
+
+        return jax.jit(run_fn), params, optimizer
+
+    def close(self):
+        self._cache.clear()

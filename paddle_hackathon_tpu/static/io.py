@@ -1,0 +1,79 @@
+"""Static-graph inference artifacts.
+
+Ref ``python/paddle/static/io.py`` save/load_inference_model. The
+reference serializes a pruned ProgramDesc + params; the TPU-native
+artifact is a StableHLO export of the feed->fetch computation via
+``jax.export`` (portable, loadable without Python model code — the same
+deployment property the reference's ``__model__`` file gives
+AnalysisPredictor), alongside the parameter arrays.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .program import Program, default_main_program
+
+
+def save_inference_model(path_prefix: str, feed_vars, fetch_vars,
+                         executor=None, program=None, **kwargs):
+    program = program or default_main_program()
+    feed_vars = feed_vars if isinstance(feed_vars, (list, tuple)) else [feed_vars]
+    fetch_vars = (fetch_vars if isinstance(fetch_vars, (list, tuple))
+                  else [fetch_vars])
+    params = program.all_parameters()
+
+    def fn(feed_arrays, param_arrays):
+        feed_values = {v._var_id: a for v, a in zip(feed_vars, feed_arrays)}
+        param_values = {id(p): a for p, a in zip(params, param_arrays)}
+        env = program.replay(feed_values, param_values)
+        return [env[v._var_id] for v in fetch_vars]
+
+    feed_avals = [jax.ShapeDtypeStruct(v._value.shape, v._value.dtype)
+                  for v in feed_vars]
+    param_avals = [jax.ShapeDtypeStruct(p._value.shape, p._value.dtype)
+                   for p in params]
+    exported = jax.export.export(jax.jit(fn))(feed_avals, param_avals)
+    blob = exported.serialize()
+
+    d = os.path.dirname(path_prefix)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path_prefix + ".pdmodel", "wb") as f:
+        f.write(blob)
+    with open(path_prefix + ".pdiparams", "wb") as f:
+        pickle.dump({"params": [np.asarray(p._value) for p in params],
+                     "feed_names": [v.name for v in feed_vars],
+                     "fetch_count": len(fetch_vars)}, f)
+    return path_prefix
+
+
+class _InferenceProgram:
+    """Loaded artifact: a callable StableHLO program + params."""
+
+    def __init__(self, exported, params, feed_names, fetch_count):
+        self._exported = exported
+        self._params = params
+        self.feed_names = feed_names
+        self.fetch_count = fetch_count
+
+    def run(self, *feeds):
+        feeds = [jnp.asarray(f) for f in feeds]
+        return self._exported.call(feeds, self._params)
+
+
+def load_inference_model(path_prefix: str, executor=None, **kwargs):
+    with open(path_prefix + ".pdmodel", "rb") as f:
+        exported = jax.export.deserialize(f.read())
+    with open(path_prefix + ".pdiparams", "rb") as f:
+        meta = pickle.load(f)
+    params = [jnp.asarray(p) for p in meta["params"]]
+    prog = _InferenceProgram(exported, params, meta["feed_names"],
+                             meta["fetch_count"])
+    # reference returns (program, feed_target_names, fetch_targets)
+    return prog, meta["feed_names"], list(range(meta["fetch_count"]))

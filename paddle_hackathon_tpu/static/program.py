@@ -1,0 +1,251 @@
+"""Static-graph Program IR.
+
+TPU-native equivalent of the reference's ProgramDesc + graph build
+(``paddle/fluid/framework/framework.proto:236``, ``python/paddle/fluid/
+framework.py`` Program/Variable): in static mode every framework op —
+they all funnel through ``core.autograd.apply_op`` — appends an
+instruction ``(op name, pure fn, input refs)`` to the current Program
+instead of executing. Shape/dtype propagation (the reference's InferMeta
+pass) is ``jax.eval_shape`` over the same fn. The Executor then replays
+the instruction list inside one ``jax.jit`` — XLA plays the role of all
+three reference executors (op-by-op Executor, InterpreterCore,
+ParallelExecutor) with fusion and scheduling done by the compiler.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..core import autograd as _autograd
+from ..core.tensor import Tensor
+
+_tls = threading.local()
+
+
+def in_static_mode() -> bool:
+    return getattr(_tls, "static_mode", False)
+
+
+def enable_static() -> None:
+    _tls.static_mode = True
+    if getattr(_tls, "main_program", None) is None:
+        _tls.main_program = Program()
+        _tls.startup_program = Program()
+
+
+def disable_static() -> None:
+    _tls.static_mode = False
+
+
+def default_main_program() -> "Program":
+    if getattr(_tls, "main_program", None) is None:
+        _tls.main_program = Program()
+    return _tls.main_program
+
+
+def default_startup_program() -> "Program":
+    if getattr(_tls, "startup_program", None) is None:
+        _tls.startup_program = Program()
+    return _tls.startup_program
+
+
+@contextlib.contextmanager
+def program_guard(main_program: "Program", startup_program: Optional["Program"] = None):
+    prev_main = getattr(_tls, "main_program", None)
+    prev_startup = getattr(_tls, "startup_program", None)
+    _tls.main_program = main_program
+    if startup_program is not None:
+        _tls.startup_program = startup_program
+    try:
+        yield
+    finally:
+        _tls.main_program = prev_main
+        _tls.startup_program = prev_startup
+
+
+class Variable(Tensor):
+    """A symbolic SSA value in a Program (ref ``VarDesc``
+    ``framework.proto:191`` / python Variable).
+
+    ``_value`` holds a ``jax.ShapeDtypeStruct`` (the aval) — enough for the
+    shape/dtype properties every layer reads during graph build. Real values
+    exist only inside the Executor's traced replay.
+    """
+
+    __slots__ = ("_program", "_var_id", "_is_feed", "_dynamic_dims")
+
+    def __init__(self, program: "Program", var_id: int, aval,
+                 name: Optional[str] = None, is_feed: bool = False,
+                 dynamic_dims: Sequence[int] = ()):
+        # bypass Tensor.__init__'s jnp.asarray: the aval is symbolic
+        self._value = aval
+        self.stop_gradient = True
+        self.name = name or f"var_{var_id}"
+        self.persistable = False
+        self._grad_node = None
+        self._out_idx = 0
+        self._grad_value = None
+        self._grad_hooks = []
+        self._program = program
+        self._var_id = var_id
+        self._is_feed = is_feed
+        self._dynamic_dims = tuple(dynamic_dims)
+
+    def numpy(self):
+        raise RuntimeError(
+            f"Variable {self.name!r} has no value at graph-build time; run "
+            "it through static.Executor.run(fetch_list=[...]) first")
+
+    def __repr__(self):
+        return (f"Variable(name={self.name}, shape={list(self._value.shape)}, "
+                f"dtype={self._value.dtype})")
+
+
+class _Instruction:
+    __slots__ = ("name", "fn", "inputs", "out_ids", "n_outputs")
+
+    def __init__(self, name, fn, inputs, out_ids, n_outputs):
+        self.name = name      # op name (for introspection / repr)
+        self.fn = fn          # pure jax fn
+        self.inputs = inputs  # list of ('var', id) | ('param', Tensor) | ('const', value)
+        self.out_ids = out_ids
+        self.n_outputs = n_outputs
+
+
+class Program:
+    """Instruction-list IR (ref ``ProgramDesc``). ``global_block()`` returns
+    self — the block hierarchy collapses because control flow in the TPU
+    build is ``lax.cond/scan`` inside single ops, not nested blocks."""
+
+    def __init__(self):
+        self._instructions: List[_Instruction] = []
+        self._vars: Dict[int, Variable] = {}
+        self._feeds: List[Variable] = []
+        self._next_id = 0
+        self._minimize: Optional[Tuple[Any, Variable]] = None  # (optimizer, loss)
+        self.random_seed = None
+
+    # -- build -------------------------------------------------------------
+    def _new_var(self, aval, name=None, is_feed=False, dynamic_dims=()):
+        vid = self._next_id
+        self._next_id += 1
+        v = Variable(self, vid, aval, name=name, is_feed=is_feed,
+                     dynamic_dims=dynamic_dims)
+        self._vars[vid] = v
+        if is_feed:
+            self._feeds.append(v)
+        return v
+
+    def add_feed(self, name, shape, dtype):
+        shape = [1 if (s is None or s < 0) else int(s) for s in shape], \
+                [i for i, s in enumerate(shape) if s is None or (isinstance(s, int) and s < 0)]
+        concrete, dyn = shape
+        aval = jax.ShapeDtypeStruct(tuple(concrete), jnp.dtype(dtype))
+        return self._new_var(aval, name=name, is_feed=True, dynamic_dims=dyn)
+
+    def record_op(self, name, fn, args, n_outputs=1):
+        """Append an instruction; infer output avals via eval_shape (the
+        InferMeta step)."""
+        inputs = []
+        shape_args = []
+        for a in args:
+            if isinstance(a, Variable):
+                inputs.append(("var", a._var_id))
+                shape_args.append(a._value)  # ShapeDtypeStruct
+            elif isinstance(a, Tensor):
+                inputs.append(("param", a))
+                shape_args.append(jax.ShapeDtypeStruct(a._value.shape,
+                                                       a._value.dtype))
+            else:
+                inputs.append(("const", a))
+                shape_args.append(a)
+
+        def shape_fn(*symbolic):
+            return fn(*symbolic)
+
+        out_aval = jax.eval_shape(shape_fn, *shape_args)
+        single = not isinstance(out_aval, tuple)
+        outs_avals = (out_aval,) if single else out_aval
+        out_vars = [self._new_var(av) for av in outs_avals]
+        self._instructions.append(_Instruction(
+            name, fn, inputs, [v._var_id for v in out_vars],
+            len(outs_avals)))
+        return out_vars[0] if single else tuple(out_vars)
+
+    # -- introspection ------------------------------------------------------
+    def global_block(self):
+        return self
+
+    @property
+    def ops(self):
+        return self._instructions
+
+    def all_parameters(self):
+        seen, out = set(), []
+        for ins in self._instructions:
+            for kind, ref in ins.inputs:
+                if kind == "param" and id(ref) not in seen:
+                    seen.add(id(ref))
+                    out.append(ref)
+        return out
+
+    def var(self, name):
+        for v in self._vars.values():
+            if v.name == name:
+                return v
+        raise ValueError(f"no variable named {name!r} in program")
+
+    def list_vars(self):
+        return list(self._vars.values())
+
+    def __repr__(self):
+        lines = [f"Program({len(self._instructions)} ops, "
+                 f"{len(self._feeds)} feeds)"]
+        for ins in self._instructions[:50]:
+            ins_repr = ", ".join(
+                f"v{r}" if k == "var" else (getattr(r, "name", "param")
+                                            if k == "param" else repr(r)[:20])
+                for k, r in ins.inputs)
+            outs = ", ".join(f"v{i}" for i in ins.out_ids)
+            lines.append(f"  {outs} = {ins.name}({ins_repr})")
+        return "\n".join(lines)
+
+    # -- replay (used by Executor) ------------------------------------------
+    def replay(self, feed_values: Dict[int, Any],
+               param_values: Optional[Dict[int, Any]] = None):
+        """Execute the instruction list with concrete/traced values.
+
+        ``feed_values``: var_id -> array for feeds. ``param_values``: id(param
+        Tensor) -> array overrides (used for grad-of-params in minimize).
+        Returns env var_id -> value.
+        """
+        env: Dict[int, Any] = dict(feed_values)
+        for ins in self._instructions:
+            vals = []
+            for kind, ref in ins.inputs:
+                if kind == "var":
+                    vals.append(env[ref])
+                elif kind == "param":
+                    if param_values is not None and id(ref) in param_values:
+                        vals.append(param_values[id(ref)])
+                    else:
+                        vals.append(ref._value)
+                else:
+                    vals.append(ref)
+            out = ins.fn(*vals)
+            outs = (out,) if ins.n_outputs == 1 and not isinstance(out, tuple) \
+                else out
+            for vid, val in zip(ins.out_ids, outs):
+                env[vid] = val
+        return env
+
+
+# register the static-mode hook with the op-application layer
+import sys as _sys
+
+_autograd._static_module = _sys.modules[__name__]

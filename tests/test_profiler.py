@@ -1,0 +1,82 @@
+"""Profiler: scheduler state machine, RecordEvent, chrome trace export,
+op instrumentation, throughput timer (ref test_profiler.py /
+test_newprofiler.py patterns)."""
+
+import json
+import os
+
+import numpy as np
+
+import paddle_hackathon_tpu as paddle
+from paddle_hackathon_tpu import profiler
+from paddle_hackathon_tpu.profiler import (Profiler, ProfilerState,
+                                           RecordEvent, export_chrome_tracing,
+                                           make_scheduler)
+
+
+def test_make_scheduler_windows():
+    sched = make_scheduler(closed=1, ready=1, record=2, repeat=2,
+                           skip_first=1)
+    states = [sched(i) for i in range(10)]
+    assert states[0] == ProfilerState.CLOSED          # skip_first
+    assert states[1] == ProfilerState.CLOSED
+    assert states[2] == ProfilerState.READY
+    assert states[3] == ProfilerState.RECORD
+    assert states[4] == ProfilerState.RECORD_AND_RETURN
+    assert states[5] == ProfilerState.CLOSED          # cycle 2
+    assert states[9] == ProfilerState.CLOSED          # repeat exhausted
+
+
+def test_profiler_records_ops_and_exports(tmp_path):
+    out_dir = str(tmp_path / "traces")
+    p = Profiler(scheduler=make_scheduler(closed=0, ready=0, record=2,
+                                          repeat=1),
+                 on_trace_ready=export_chrome_tracing(out_dir),
+                 use_device_tracer=False)
+    p.start()
+    with RecordEvent("user_scope"):
+        x = paddle.randn([8, 8])
+        y = paddle.matmul(x, x)
+        _ = float(y.sum().numpy())
+    p.step()
+    p.step()
+    p.stop()
+
+    files = os.listdir(out_dir)
+    assert files, "no chrome trace written"
+    with open(os.path.join(out_dir, files[0])) as f:
+        trace = json.load(f)
+    names = {e["name"] for e in trace["traceEvents"]}
+    assert "user_scope" in names
+    assert "matmul" in names  # op instrumentation hooked apply_op
+
+
+def test_profiler_summary(capsys):
+    p = Profiler(use_device_tracer=False)
+    p.start()
+    x = paddle.ones([4, 4])
+    for _ in range(3):
+        x = x + 1.0
+    p._stop_record()
+    agg = p.summary()
+    assert agg.get("add", [0])[0] >= 3
+    assert "Calls" in capsys.readouterr().out
+
+
+def test_profiler_off_has_no_overhead_hook():
+    from paddle_hackathon_tpu.core import autograd
+    assert autograd._profiler_hook is None
+    x = paddle.ones([2])
+    _ = x + 1  # must not record
+    assert not profiler._recorder.events
+
+
+def test_benchmark_timer():
+    p = Profiler(timer_only=True)
+    p.start()
+    for _ in range(3):
+        p.step(num_samples=32)
+    p.stop()
+    s = p.benchmark_summary()
+    assert s["steps"] == 3
+    assert s["ips"] > 0
