@@ -51,6 +51,13 @@ def set_flags(flags: Mapping[str, Any]) -> None:
             if name not in _defs:
                 raise ValueError(f"unknown flag: {name}")
             _registry[name] = _coerce(value, _defs[name]["default"])
+    # mirror into the native registry so C++ components observe updates
+    # (ref global_value_getter_setter.cc)
+    try:
+        from . import native as _native
+        _native.sync_flags({k: _registry[k] for k in _registry})
+    except Exception:
+        pass
 
 
 def get_flags(flags: Union[str, Iterable[str], None] = None) -> Dict[str, Any]:

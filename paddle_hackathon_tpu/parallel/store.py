@@ -1,0 +1,150 @@
+"""Rendezvous key-value store over the native TCP server.
+
+TPU-native counterpart of the reference's ``TCPStore``
+(``paddle/fluid/distributed/store/tcp_store.h:120``; python surface
+``paddle.distributed.parallel`` rendezvous at ``parallel.py:240-264``):
+rank 0 hosts a socket KV server (implemented in C++, see
+``native/runtime.cc``); every rank connects a client with set/get/add/
+wait/barrier. In-cluster JAX bootstrap itself uses
+``jax.distributed.initialize`` (the coordinator service plays this role
+for device runtime state); this store serves framework-level rendezvous:
+launcher/elastic heartbeats, parameter-server discovery, and tests.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import time
+from typing import Optional
+
+from ..core import native as _native
+
+__all__ = ["TCPStore", "MasterStore"]
+
+
+class TCPStore:
+    """Client (and optionally host) of the rendezvous store.
+
+    Parameters mirror the reference's TCPStore: the master rank starts the
+    server; everyone (including the master) connects a client.
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 is_master: bool = False, timeout: float = 30.0):
+        lib = _native.load()
+        if lib is None:
+            raise RuntimeError("native runtime unavailable (g++ missing?)")
+        self._lib = lib
+        self._server = None
+        self.host = host
+        if is_master:
+            self._server = lib.pht_store_server_start(port)
+            if not self._server:
+                raise RuntimeError(f"failed to bind store server on :{port}")
+            port = lib.pht_store_server_port(self._server)
+        self.port = port
+        self._client = lib.pht_store_connect(
+            host.encode(), port, int(timeout * 1000))
+        if not self._client:
+            self.close()
+            raise TimeoutError(f"could not connect to store {host}:{port}")
+        self.timeout = timeout
+
+    # -- KV ops -------------------------------------------------------------
+    def set(self, key: str, value) -> None:
+        if isinstance(value, str):
+            value = value.encode()
+        buf = (ctypes.c_uint8 * len(value)).from_buffer_copy(value) \
+            if value else None
+        rc = self._lib.pht_store_set(self._client, key.encode(), buf,
+                                     len(value))
+        if rc != 0:
+            raise RuntimeError(f"store set({key!r}) failed")
+
+    def get(self, key: str, timeout: Optional[float] = None) -> bytes:
+        """Blocking wait-until-present get (reference wait+get semantics)."""
+        t = self.timeout if timeout is None else timeout
+        tms = -1 if t is None or t < 0 else int(t * 1000)
+        n = 1 << 16
+        while True:
+            buf = (ctypes.c_uint8 * n)()
+            rc = self._lib.pht_store_get(self._client, key.encode(), buf, n,
+                                         tms)
+            if rc == -1:
+                raise TimeoutError(f"store get({key!r}) timed out")
+            if rc == -2:
+                raise RuntimeError("store connection lost")
+            if rc <= n:
+                return bytes(buf[:rc])
+            n = rc  # retry with exact-size buffer
+
+    def add(self, key: str, delta: int = 1) -> int:
+        v = self._lib.pht_store_add(self._client, key.encode(), delta)
+        if v == -(2 ** 63):
+            raise RuntimeError("store connection lost")
+        return int(v)
+
+    def wait(self, key: str, timeout: Optional[float] = None) -> None:
+        self.get(key, timeout=timeout)
+
+    def check(self, key: str) -> bool:
+        rc = self._lib.pht_store_check(self._client, key.encode())
+        if rc < 0:
+            raise RuntimeError("store connection lost")
+        return rc == 1
+
+    def delete_key(self, key: str) -> bool:
+        rc = self._lib.pht_store_delete(self._client, key.encode())
+        if rc < 0:
+            raise RuntimeError("store connection lost")
+        return rc == 1
+
+    # -- composite ops ------------------------------------------------------
+    def barrier(self, name: str, rank: int, world_size: int,
+                timeout: Optional[float] = None) -> None:
+        """All-rank barrier built from add+wait (the reference's init
+        barrier, ``parallel.py:264``)."""
+        arrived = self.add(f"__barrier/{name}/count", 1)
+        if arrived == world_size:
+            self.set(f"__barrier/{name}/done", b"1")
+        self.wait(f"__barrier/{name}/done", timeout=timeout)
+
+    def close(self) -> None:
+        if getattr(self, "_client", None):
+            self._lib.pht_store_disconnect(self._client)
+            self._client = None
+        if getattr(self, "_server", None):
+            self._lib.pht_store_server_stop(self._server)
+            self._server = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def MasterStore(port: int = 0) -> TCPStore:
+    """Start a store server on this process (rank-0 helper)."""
+    return TCPStore(host="127.0.0.1", port=port, is_master=True)
+
+
+def store_from_env(timeout: float = 60.0) -> TCPStore:
+    """Build a client from launcher env (MASTER_ADDR/MASTER_PORT analog,
+    ref ``parallel.py:240-245``)."""
+    host = os.environ.get("PADDLE_MASTER_ADDR", "127.0.0.1")
+    port = int(os.environ.get("PADDLE_MASTER_PORT", "0"))
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    if port == 0:
+        raise RuntimeError("PADDLE_MASTER_PORT not set")
+    is_master = rank == 0 and os.environ.get("PADDLE_STORE_HOSTED", "") != "1"
+    deadline = time.time() + timeout
+    last = None
+    while time.time() < deadline:
+        try:
+            return TCPStore(host, port, is_master=is_master, timeout=timeout)
+        except Exception as e:  # master may not be up yet
+            last = e
+            time.sleep(0.2)
+    raise TimeoutError(f"store_from_env failed: {last}")

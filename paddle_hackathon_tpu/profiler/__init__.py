@@ -253,8 +253,8 @@ class Profiler:
 
     def stop(self):
         self._benchmark.end()
-        if self.current_state in (ProfilerState.RECORD,
-                                  ProfilerState.RECORD_AND_RETURN):
+        if not self.timer_only and self.current_state in (
+                ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN):
             self._stop_record()
             if self.on_trace_ready:
                 self.on_trace_ready(self)
@@ -290,6 +290,13 @@ class Profiler:
     def _start_record(self):
         _recorder.active = True
         _autograd._profiler_hook = _op_hook
+        # also arm the native host tracer (C++ workqueue/dataloader spans)
+        try:
+            from ..core import native as _native
+            if _native.available():
+                _native.trace_enable(True)
+        except Exception:
+            pass
         if self._use_device_tracer and ProfilerTarget.TPU in self.targets:
             try:
                 import jax
@@ -305,6 +312,27 @@ class Profiler:
         _autograd._profiler_hook = None
         _recorder.active = False
         self._events = _recorder.drain()
+        # drain native host-tracer events into the same stream
+        try:
+            from ..core import native as _native
+            if _native.available():
+                _native.trace_enable(False)
+            if _native.available() and _native.trace_count():
+                import tempfile
+                with tempfile.NamedTemporaryFile("r", suffix=".json",
+                                                 delete=False) as f:
+                    path = f.name
+                _native.trace_dump_chrome(path)
+                _native.trace_clear()
+                with open(path) as f:
+                    for ev in json.load(f)["traceEvents"]:
+                        start = int(ev["ts"] * 1000)
+                        self._events.append(_HostEvent(
+                            ev["name"], start, start + int(ev["dur"] * 1000),
+                            ev["tid"], "Native"))
+                os.unlink(path)
+        except Exception:
+            pass
         if self._device_active:
             try:
                 import jax
