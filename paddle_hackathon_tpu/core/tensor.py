@@ -27,7 +27,32 @@ from .dtype import convert_dtype, default_float_dtype
 class Tensor:
     __slots__ = ("_value", "stop_gradient", "name", "persistable",
                  "_grad_node", "_out_idx", "_grad_value", "_grad_hooks",
+                 "_process_mesh", "_shard_spec",  # auto_parallel annotations
                  "__weakref__")
+
+    # auto_parallel annotations (set by parallel.auto_parallel.shard_tensor);
+    # default None without paying per-construction init cost
+    @property
+    def process_mesh(self):
+        try:
+            return self._process_mesh
+        except AttributeError:
+            return None
+
+    @process_mesh.setter
+    def process_mesh(self, value):
+        self._process_mesh = value
+
+    @property
+    def shard_spec(self):
+        try:
+            return self._shard_spec
+        except AttributeError:
+            return None
+
+    @shard_spec.setter
+    def shard_spec(self, value):
+        self._shard_spec = value
 
     def __init__(self, value, stop_gradient: bool = True, name: Optional[str] = None,
                  _grad_node=None, _out_idx: int = 0):

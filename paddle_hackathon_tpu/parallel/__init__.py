@@ -38,3 +38,7 @@ from .sharding import (group_sharded_parallel,  # noqa: F401
                        save_group_sharded_model)
 from .fleet import (DistributedStrategy, distributed_model,  # noqa: F401
                     distributed_optimizer, fleet)
+from . import auto_parallel  # noqa: F401
+from .auto_parallel import (Engine, ProcessMesh, shard_op,  # noqa: F401
+                            shard_tensor)
+from .store import TCPStore  # noqa: F401
