@@ -38,6 +38,11 @@ from .sharding import (group_sharded_parallel,  # noqa: F401
                        save_group_sharded_model)
 from .fleet import (DistributedStrategy, distributed_model,  # noqa: F401
                     distributed_optimizer, fleet)
+from .recompute import (jit_recompute, recompute,  # noqa: F401
+                        recompute_sequential)
+from .strategies import (DGCMomentumOptimizer,  # noqa: F401
+                         FP16AllReduceOptimizer, GradientMergeOptimizer,
+                         LocalSGDOptimizer)
 from . import auto_parallel  # noqa: F401
 from .auto_parallel import (Engine, ProcessMesh, shard_op,  # noqa: F401
                             shard_tensor)

@@ -109,7 +109,9 @@ def make_sharded_train_step(model: Layer, mesh: Mesh,
                             zero_stage: int = 1,
                             loss_fn: Optional[Callable] = None,
                             param_dtype=None,
-                            grad_clip_norm: Optional[float] = 1.0):
+                            grad_clip_norm: Optional[float] = 1.0,
+                            recompute: bool = False,
+                            recompute_policy: Optional[str] = None):
     """Build (step_fn, state) — one compiled SPMD program per step covering
     forward, backward, grad psum over dp, Adam update on (optionally
     'sharding'-sharded) optimizer state.
@@ -165,6 +167,11 @@ def make_sharded_train_step(model: Layer, mesh: Mesh,
         def pure_loss(p):
             return loss_fn(model, p, buffers, batch, rng)
 
+        if recompute:
+            # remat the whole forward (ref recompute meta-optimizer /
+            # auto_parallel_recompute pass) — XLA re-runs it in backward.
+            from .recompute import jit_recompute
+            pure_loss = jit_recompute(pure_loss, policy=recompute_policy)
         loss, grads = jax.value_and_grad(pure_loss)(params)
         if grad_clip_norm is not None:
             gnorm = jnp.sqrt(sum(

@@ -190,7 +190,12 @@ def shard_op(op_fn: Callable, process_mesh: Optional[ProcessMesh] = None,
             for i, spec in enumerate(out_shard_specs):
                 if spec is not None and i < len(outs):
                     outs[i] = shard_tensor(outs[i], pm, spec)
-            out = type(out)(outs) if isinstance(out, (tuple, list)) else outs[0]
+            if isinstance(out, tuple) and hasattr(out, "_fields"):
+                out = type(out)(*outs)  # namedtuple
+            elif isinstance(out, (tuple, list)):
+                out = type(out)(outs)
+            else:
+                out = outs[0]
         return out
 
     return wrapped
@@ -286,9 +291,16 @@ class Engine:
             opt_states = [opt._get_accumulators(p) for p in plist]
             if self.strategy.sharding and self.strategy.sharding_stage >= 1:
                 from .sharding import _shard_spec_for
+                # ZeRO shards optimizer state across data-parallel replicas:
+                # use the dedicated 'sharding' axis when the mesh has one,
+                # else fall back to the dp axis (ref sharding_optimizer.py
+                # partitions over the dp ring when no mp/sharding ring exists)
+                zaxis = ("sharding" if mesh.shape.get("sharding", 1) > 1
+                         else "dp")
                 placed = []
                 for p, st in zip(plist, opt_states):
-                    spec = _shard_spec_for(p.shape, mesh, existing=None)
+                    spec = _shard_spec_for(p.shape, mesh, axis=zaxis,
+                                           existing=None)
                     sh = NamedSharding(mesh, P(*spec))
                     placed.append({k: jax.device_put(v, sh)
                                    for k, v in st.items()})
@@ -459,16 +471,18 @@ class Engine:
                 p, o, s, loss = step_fn(st["params"], st["opt_states"],
                                         st["step"], lr, (x, y))
                 st.update(params=p, opt_states=o, step=s)
-                lval = float(loss)
-                history.append(lval)
-                self._history["loss"].append(lval)
+                # keep the raw device array: float() would force a host sync
+                # every step and stall async dispatch
+                history.append(loss)
                 if verbose and i % log_freq == 0:
                     print(f"[auto_parallel] epoch {epoch} step {i} "
-                          f"loss {lval:.5f}")
+                          f"loss {float(loss):.5f}")
             if valid_data is not None:
                 self.evaluate(valid_data, batch_size=batch_size,
                               verbose=verbose)
         self._sync_back()
+        history = [float(l) for l in history]
+        self._history["loss"].extend(history)
         return {"loss": history}
 
     def evaluate(self, valid_data, batch_size: int = 1, steps=None,

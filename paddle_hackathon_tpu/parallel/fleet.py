@@ -54,6 +54,16 @@ class DistributedStrategy:
     gradient_merge: bool = False
     gradient_merge_configs: Dict[str, Any] = dataclasses.field(
         default_factory=lambda: {"k_steps": 1})
+    localsgd: bool = False
+    localsgd_configs: Dict[str, Any] = dataclasses.field(
+        default_factory=lambda: {"k_steps": 1})
+    dgc: bool = False
+    dgc_configs: Dict[str, Any] = dataclasses.field(
+        default_factory=lambda: {"rampup_begin_step": 0,
+                                 "sparsity": [0.999]})
+    fp16_allreduce: bool = False
+    lars: bool = False
+    lamb: bool = False
     hybrid_configs: Dict[str, int] = dataclasses.field(
         default_factory=lambda: {"dp_degree": 1, "mp_degree": 1,
                                  "pp_degree": 1, "sharding_degree": 1,
@@ -79,11 +89,20 @@ class _Fleet:
         self._strategy = strategy or DistributedStrategy()
         _env.init_parallel_env()
         hc = self._strategy.hybrid_configs
-        self._hcg = init_hybrid_parallel(
-            dp=hc.get("dp_degree", 1), mp=hc.get("mp_degree", 1),
-            pp=hc.get("pp_degree", 1),
-            sharding=hc.get("sharding_degree", 1),
-            sp=hc.get("sep_degree", 1))
+        mp = hc.get("mp_degree", 1)
+        pp = hc.get("pp_degree", 1)
+        sh = hc.get("sharding_degree", 1)
+        sp = hc.get("sep_degree", 1)
+        dp = hc.get("dp_degree", 1)
+        # Like the reference launcher, an unset dp_degree absorbs the
+        # remaining world size; an explicit dp_degree > 1 is honoured as-is
+        # (create_mesh raises on a genuine mismatch).
+        ndev = len(jax.devices())
+        model_degree = mp * pp * sh * sp
+        if dp == 1 and model_degree != ndev and ndev % model_degree == 0:
+            dp = ndev // model_degree
+        self._hcg = init_hybrid_parallel(dp=dp, mp=mp, pp=pp, sharding=sh,
+                                         sp=sp)
         self._initialized = True
         return self
 
@@ -128,6 +147,25 @@ class _Fleet:
                      or mesh.shape.get("sharding", 1) > 1)):
             _, optimizer, _ = group_sharded_parallel(
                 _EmptyModel(), optimizer, level="os")
+        if strategy is not None:
+            from . import strategies as _st
+            if strategy.dgc:
+                cfg = strategy.dgc_configs or {}
+                optimizer = _st.DGCMomentumOptimizer(
+                    optimizer,
+                    rampup_begin_step=int(cfg.get("rampup_begin_step", 0)),
+                    sparsity=cfg.get("sparsity", [0.999]))
+            if strategy.fp16_allreduce:
+                optimizer = _st.FP16AllReduceOptimizer(optimizer)
+            if strategy.gradient_merge:
+                cfg = strategy.gradient_merge_configs or {}
+                optimizer = _st.GradientMergeOptimizer(
+                    optimizer, k_steps=int(cfg.get("k_steps", 1)),
+                    avg=bool(cfg.get("avg", True)))
+            if strategy.localsgd:
+                cfg = strategy.localsgd_configs or {}
+                optimizer = _st.LocalSGDOptimizer(
+                    optimizer, k_steps=int(cfg.get("k_steps", 1)))
         return optimizer
 
 

@@ -1,0 +1,252 @@
+"""Activation recomputation (gradient checkpointing).
+
+Capability parity with the reference's ``fleet/utils/recompute.py``
+(``RecomputeFunction`` ``:207``, public ``recompute`` ``:350``): forward runs
+without saving intermediate activations; backward re-runs the forward to
+rebuild them, replaying the RNG state so dropout masks match
+(``preserve_rng_state``).
+
+TPU-native mechanism: the reference re-enters its eager tracer inside a
+``PyLayer`` backward; here the replay is ``jax.vjp`` over a *pure* re-execution
+of the wrapped function — parameters are temporarily swapped for traced values
+(``Layer._swap_state``) so the whole recompute block becomes one transposed
+jaxpr that XLA fuses like any other computation. For jitted/functional
+training steps use :func:`jit_recompute`, which is ``jax.checkpoint`` with the
+reference's knob names (``recompute_configs`` of
+``distributed_strategy.proto``).
+
+``offload`` mirrors ``recompute_offload`` (pp_layers.py:170-172): saved inputs
+are moved to host RAM between forward and backward, trading HBM for PCIe/ICI
+traffic.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Callable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..core import autograd as _ag
+from ..core import random as _random
+from ..core.autograd import GradNode, _LeafSlot, no_grad
+from ..core.tensor import Tensor
+from ..nn.layer import Layer
+
+__all__ = ["recompute", "recompute_sequential", "jit_recompute",
+           "checkpoint_policy"]
+
+
+def _collect_params(function, params) -> List[Tensor]:
+    """Parameters whose grads must flow through the recompute boundary."""
+    if params is not None:
+        return [p for p in params if not p.stop_gradient]
+    owner = None
+    if isinstance(function, Layer):
+        owner = function
+    elif hasattr(function, "__self__") and isinstance(function.__self__, Layer):
+        owner = function.__self__
+    if owner is not None:
+        return [p for p in owner.parameters() if not p.stop_gradient]
+    return []
+
+
+def recompute(function: Callable, *args, preserve_rng_state: bool = True,
+              offload: bool = False, params: Optional[Sequence[Tensor]] = None,
+              **kwargs):
+    """Run ``function(*args, **kwargs)`` without storing activations.
+
+    ``function`` is typically a ``Layer`` (grads flow to its parameters
+    automatically); for a free function pass ``params=`` explicitly.
+    Tensor positional args participate in autodiff; kwargs are static.
+    """
+    if not _ag.is_grad_enabled():
+        with no_grad():
+            return function(*args, **kwargs)
+
+    param_leaves = _collect_params(function, params)
+
+    # Snapshot the RNG so the replay sees identical dropout masks
+    # (ref recompute.py: fwd/bwd CUDA+CPU state capture).
+    rng_key = _random.split_key() if preserve_rng_state else None
+
+    diff_pos = [i for i, a in enumerate(args)
+                if isinstance(a, Tensor) and not a.stop_gradient
+                and jnp.issubdtype(jnp.result_type(a._value), jnp.inexact)]
+    arg_vals = [a._value if isinstance(a, Tensor) else a for a in args]
+
+    def run_pure(diff_vals, param_vals):
+        """Re-execute the block as a pure function of (args, params)."""
+        call_args = list(arg_vals)
+        for pos, v in zip(diff_pos, diff_vals):
+            call_args[pos] = Tensor(v, stop_gradient=True)
+        for i, a in enumerate(call_args):
+            if i not in diff_pos and isinstance(args[i], Tensor):
+                call_args[i] = args[i]
+        old_vals = [p._value for p in param_leaves]
+        for p, v in zip(param_leaves, param_vals):
+            p._value = v
+        try:
+            ctx = (_random.rng_scope(rng_key) if rng_key is not None
+                   else _null_ctx())
+            with no_grad(), ctx:
+                out = function(*call_args, **kwargs)
+        finally:
+            for p, v in zip(param_leaves, old_vals):
+                p._value = v
+        flat, _ = _flatten_out(out)
+        return tuple(t._value for t in flat), out
+
+    # Forward pass: compute values only (no residuals kept).
+    diff_vals = [arg_vals[i] for i in diff_pos]
+    param_vals = [p._value for p in param_leaves]
+    out_flat_vals, out_structure = run_pure(diff_vals, param_vals)
+
+    saved_diff = ([jax.device_get(v) for v in diff_vals] if offload
+                  else list(diff_vals))
+    saved_params = param_vals  # params live on device regardless
+
+    flat_out, rebuild = _flatten_out(out_structure)
+    out_avals = [(v.shape, v.dtype) for v in out_flat_vals]
+
+    parents: list = []
+    for pos in diff_pos:
+        src = args[pos]
+        if src._grad_node is not None:
+            parents.append((src._grad_node, src._out_idx))
+        else:
+            parents.append(_LeafSlot(src))
+    for p in param_leaves:
+        if p._grad_node is not None:
+            parents.append((p._grad_node, p._out_idx))
+        else:
+            parents.append(_LeafSlot(p))
+
+    def vjp_fn(cotangents):
+        d_vals = ([jax.device_put(v) for v in saved_diff] if offload
+                  else saved_diff)
+
+        def pure(*flat_ins):
+            nd = len(d_vals)
+            outs, _ = run_pure(list(flat_ins[:nd]), list(flat_ins[nd:]))
+            return outs
+
+        with no_grad():
+            _, vjp = jax.vjp(pure, *d_vals, *saved_params)
+            return vjp(tuple(cotangents))
+
+    node = GradNode("recompute", vjp_fn, parents, len(out_flat_vals),
+                    out_avals)
+    new_flat = [Tensor(v, stop_gradient=False, _grad_node=node, _out_idx=i)
+                for i, v in enumerate(out_flat_vals)]
+    return rebuild(new_flat)
+
+
+def recompute_sequential(ctx: Optional[dict], functions, *args):
+    """Apply a sequence of layers, recomputing in ``segments`` chunks.
+
+    Mirrors ``paddle.incubate.distributed.fleet.recompute_sequential``:
+    ``ctx`` may carry ``{"segments": N, "preserve_rng_state": bool}``. Layers
+    are called positionally, chunk output feeding the next chunk (the
+    reference's ``_run_func`` does the same — no kwargs reach the layers).
+    """
+    ctx = ctx or {}
+    segments = int(ctx.get("segments", 1))
+    preserve = bool(ctx.get("preserve_rng_state", True))
+    layers = list(functions)
+    if segments <= 0:
+        segments = 1
+    seg_size = max(1, (len(layers) + segments - 1) // segments)
+
+    out = args
+    for start in range(0, len(layers), seg_size):
+        chunk = layers[start:start + seg_size]
+
+        def run_chunk(*xs, _chunk=chunk):
+            y = xs
+            for lyr in _chunk:
+                y = lyr(*y) if isinstance(y, tuple) else lyr(y)
+                if not isinstance(y, tuple):
+                    y = (y,)
+            return y if len(y) > 1 else y[0]
+
+        chunk_params: List[Tensor] = []
+        for lyr in chunk:
+            if isinstance(lyr, Layer):
+                chunk_params.extend(
+                    p for p in lyr.parameters() if not p.stop_gradient)
+        out = recompute(run_chunk, *(out if isinstance(out, tuple) else (out,)),
+                        preserve_rng_state=preserve, params=chunk_params)
+        if not isinstance(out, tuple):
+            out = (out,)
+    return out if len(out) > 1 else out[0]
+
+
+# ---------------------------------------------------------------------------
+# jit / functional path — jax.checkpoint with the reference's knob names
+# ---------------------------------------------------------------------------
+
+def checkpoint_policy(name: Optional[str]):
+    """Map a policy name to a jax.checkpoint policy callable."""
+    if name in (None, "full", "nothing_saveable"):
+        return jax.checkpoint_policies.nothing_saveable
+    if name == "dots_saveable":
+        return jax.checkpoint_policies.dots_saveable
+    if name == "dots_with_no_batch_dims_saveable":
+        return jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+    if name == "everything_saveable":
+        return jax.checkpoint_policies.everything_saveable
+    raise ValueError(f"unknown recompute policy {name!r}")
+
+
+def jit_recompute(fn: Callable, policy: Optional[str] = None,
+                  prevent_cse: bool = True) -> Callable:
+    """``jax.checkpoint`` for functional/jitted code paths.
+
+    This is the mechanism the sharded train step uses when
+    ``DistributedStrategy.recompute`` is on — equivalent to the reference's
+    static-graph recompute pass (``distributed/passes/auto_parallel_recompute``)
+    but expressed as a remat annotation XLA honours directly.
+    """
+    return jax.checkpoint(fn, policy=checkpoint_policy(policy),
+                          prevent_cse=prevent_cse)
+
+
+def _flatten_out(out):
+    """Flatten nested (tuple/list/dict) Tensor outputs; return rebuilder."""
+    if isinstance(out, Tensor):
+        return [out], lambda flat: flat[0]
+    if isinstance(out, (tuple, list)):
+        flats: List[Tensor] = []
+        specs = []
+        for o in out:
+            sub_flat, sub_rebuild = _flatten_out(o)
+            specs.append((len(flats), len(sub_flat), sub_rebuild))
+            flats.extend(sub_flat)
+        typ = type(out)
+
+        def rebuild(flat, _specs=specs, _typ=typ):
+            return _typ(r(flat[s:s + n]) for s, n, r in _specs)
+
+        return flats, rebuild
+    if isinstance(out, dict):
+        keys = list(out.keys())
+        flats = []
+        specs = []
+        for k in keys:
+            sub_flat, sub_rebuild = _flatten_out(out[k])
+            specs.append((k, len(flats), len(sub_flat), sub_rebuild))
+            flats.extend(sub_flat)
+
+        def rebuild(flat, _specs=specs):
+            return {k: r(flat[s:s + n]) for k, s, n, r in _specs}
+
+        return flats, rebuild
+    raise TypeError(f"recompute output must be Tensors/containers, got "
+                    f"{type(out)!r}")
+
+
+@contextlib.contextmanager
+def _null_ctx():
+    yield
