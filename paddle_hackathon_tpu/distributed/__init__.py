@@ -1,0 +1,18 @@
+"""paddle.distributed — public distributed API namespace.
+
+The implementation lives in ``paddle_hackathon_tpu.parallel`` (mesh/pjit
+collectives, fleet, hybrid topology — SURVEY §2.4); this package gives it
+the reference's import surface (``python/paddle/distributed/__init__.py``)
+and hosts the process-level subsystems: ``launch`` (the
+``python -m paddle.distributed.launch`` equivalent, ref ``launch/main.py:18``),
+``elastic`` (ref ``fleet/elastic/manager.py:131``), ``ps`` (parameter
+server, ref ``paddle/fluid/distributed/ps``) and ``fleet_executor``-style
+pipeline orchestration.
+"""
+
+from ..parallel import *  # noqa: F401,F403
+from ..parallel import (collective, auto_parallel, fleet,  # noqa: F401
+                        get_rank, get_world_size, init_parallel_env)
+from ..parallel.collective import (all_gather, all_reduce, alltoall,  # noqa: F401
+                                   barrier, broadcast, new_group, reduce,
+                                   reduce_scatter, scatter)

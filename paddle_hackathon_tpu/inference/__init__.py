@@ -1,0 +1,30 @@
+"""paddle.inference — the deployment engine.
+
+TPU-native equivalent of the reference's inference stack
+(``paddle/fluid/inference``): ``AnalysisConfig``
+(``api/analysis_config.cc``), ``AnalysisPredictor``
+(``api/analysis_predictor.h:95``) with ``ZeroCopyRun`` (``:182``) and the
+pass pipeline (``api/paddle_pass_builder.h:38``).
+
+Architecture (TPU-first, not a port):
+- the "optimized program" is a serialized **StableHLO** export (produced by
+  ``paddle.static.save_inference_model`` or ``paddle.jit.save``) — XLA plays
+  the role of the analysis passes + NaiveExecutor + TensorRT engine: graph
+  fusion, memory planning and kernel selection all happen in one compile.
+- ``Config`` carries the knobs the reference exposes (memory optim ↦ XLA
+  buffer donation, optim cache dir ↦ XLA persistent compilation cache,
+  cpu math threads, device selection).
+- ``Predictor`` keeps parameters resident on the target device and runs the
+  program through a cached ``jax.jit`` wrapper — the ZeroCopy analog: feeds
+  are device_put once per ``copy_from_cpu``, outputs stay on device until
+  ``copy_to_cpu``.
+"""
+
+from .config import Config, AnalysisConfig, PassBuilder
+from .predictor import (Predictor, PredictorPool, Tensor as InferTensor,
+                        create_predictor, get_version)
+
+__all__ = [
+    "Config", "AnalysisConfig", "PassBuilder", "Predictor", "PredictorPool",
+    "InferTensor", "create_predictor", "get_version",
+]

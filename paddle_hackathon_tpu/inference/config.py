@@ -1,0 +1,208 @@
+"""Inference Config + pass pipeline.
+
+Ref ``AnalysisConfig`` (``paddle/fluid/inference/api/analysis_config.cc``)
+and ``PaddlePassBuilder`` (``api/paddle_pass_builder.h:38``). The reference
+builds a list of named IR passes (fusions, memory optimisation, subgraph
+engines) that rewrite the program before execution; on TPU, XLA performs
+fusion/layout/memory planning during compilation, so passes here are
+*program-level wrappers* applied by the Predictor at build time (dtype
+autocast, buffer donation, input validation) rather than graph rewrites.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional
+
+
+class PassBuilder:
+    """Ordered, named pass pipeline (ref ``paddle_pass_builder.h:38``).
+
+    A pass is ``name -> fn(predictor_build_ctx) -> None`` mutating the build
+    context (compile options, wrappers). Users can delete/insert passes like
+    the reference's ``config.pass_builder().DeletePass(...)``.
+    """
+
+    _registry: Dict[str, Callable] = {}
+
+    def __init__(self, passes: Optional[List[str]] = None):
+        self._passes: List[str] = list(passes) if passes is not None else [
+            "donate_feed_buffers_pass",      # memory-optim: donate feed HBM
+            "persistent_cache_pass",         # XLA compilation cache
+            "resident_params_pass",          # pin weights on device
+        ]
+
+    @classmethod
+    def register(cls, name: str):
+        def deco(fn):
+            cls._registry[name] = fn
+            return fn
+        return deco
+
+    def all_passes(self) -> List[str]:
+        return list(self._passes)
+
+    def append_pass(self, name: str):
+        self._passes.append(name)
+
+    def insert_pass(self, idx: int, name: str):
+        self._passes.insert(idx, name)
+
+    def delete_pass(self, name: str):
+        self._passes = [p for p in self._passes if p != name]
+
+    def apply(self, ctx) -> None:
+        for name in self._passes:
+            fn = self._registry.get(name)
+            if fn is not None:
+                fn(ctx)
+
+
+class Config:
+    """Ref ``AnalysisConfig`` (``api/analysis_config.cc``).
+
+    ``enable_use_gpu`` maps to TPU device selection; ``enable_memory_optim``
+    maps to XLA buffer donation of feeds; ``set_optim_cache_dir`` maps to
+    the XLA persistent compilation cache (the analog of caching the
+    optimized program / TRT engines).
+    """
+
+    def __init__(self, prog_file: Optional[str] = None,
+                 params_file: Optional[str] = None):
+        # path prefix (static artifact) or .pdmodel zip (jit artifact)
+        self._prog_file = prog_file
+        self._params_file = params_file
+        self._use_device = "tpu"
+        self._device_id = 0
+        self._memory_optim = False
+        self._ir_optim = True
+        self._cpu_math_threads = 1
+        self._optim_cache_dir: Optional[str] = None
+        self._profile = False
+        self._glog_info = True
+        self._pass_builder = PassBuilder()
+        self._exec_stream = None  # API-parity no-op: XLA orders execution
+
+    # -- model location ----------------------------------------------------
+    def set_model(self, prog_file: str, params_file: Optional[str] = None):
+        self._prog_file = prog_file
+        self._params_file = params_file
+
+    def set_prog_file(self, f: str):
+        self._prog_file = f
+
+    def set_params_file(self, f: str):
+        self._params_file = f
+
+    def prog_file(self) -> Optional[str]:
+        return self._prog_file
+
+    def params_file(self) -> Optional[str]:
+        return self._params_file
+
+    # -- device ------------------------------------------------------------
+    def enable_use_gpu(self, memory_pool_init_size_mb: int = 100,
+                       device_id: int = 0):
+        # accepted for API parity; "gpu" means "the accelerator" = TPU here
+        self._use_device = "tpu"
+        self._device_id = device_id
+
+    def enable_tpu(self, device_id: int = 0):
+        self._use_device = "tpu"
+        self._device_id = device_id
+
+    def disable_gpu(self):
+        self._use_device = "cpu"
+
+    def use_gpu(self) -> bool:
+        return self._use_device == "tpu"
+
+    def gpu_device_id(self) -> int:
+        return self._device_id
+
+    # -- optimisation knobs -------------------------------------------------
+    def enable_memory_optim(self, x: bool = True):
+        self._memory_optim = x
+
+    def enable_memory_optim_(self):  # C++-style spelling
+        self._memory_optim = True
+
+    def memory_optim_enabled(self) -> bool:
+        return self._memory_optim
+
+    def switch_ir_optim(self, x: bool = True):
+        self._ir_optim = x
+
+    def ir_optim(self) -> bool:
+        return self._ir_optim
+
+    def set_cpu_math_library_num_threads(self, n: int):
+        self._cpu_math_threads = n
+
+    def cpu_math_library_num_threads(self) -> int:
+        return self._cpu_math_threads
+
+    def set_optim_cache_dir(self, d: str):
+        self._optim_cache_dir = d
+
+    # -- diagnostics ---------------------------------------------------------
+    def enable_profile(self):
+        self._profile = True
+
+    def disable_glog_info(self):
+        self._glog_info = False
+
+    def glog_info_disabled(self) -> bool:
+        return not self._glog_info
+
+    def pass_builder(self) -> PassBuilder:
+        return self._pass_builder
+
+    def summary(self) -> str:
+        rows = [
+            ("model_file", self._prog_file),
+            ("params_file", self._params_file),
+            ("device", f"{self._use_device}:{self._device_id}"),
+            ("memory_optim", self._memory_optim),
+            ("ir_optim", self._ir_optim),
+            ("cpu_math_threads", self._cpu_math_threads),
+            ("optim_cache_dir", self._optim_cache_dir),
+            ("passes", ",".join(self._pass_builder.all_passes())),
+        ]
+        w = max(len(k) for k, _ in rows)
+        return "\n".join(f"{k.ljust(w)}  {v}" for k, v in rows)
+
+
+# the reference aliases AnalysisConfig == Config in paddle.inference
+AnalysisConfig = Config
+
+
+# ---------------------------------------------------------------------------
+# built-in passes
+# ---------------------------------------------------------------------------
+
+@PassBuilder.register("donate_feed_buffers_pass")
+def _donate_feed_buffers_pass(ctx):
+    """memory-optim analog of ``analysis/passes/memory_optimize_pass``:
+    donate feed HBM buffers to the computation when memory optim is on."""
+    if ctx.config.memory_optim_enabled():
+        ctx.donate_feeds = True
+
+
+@PassBuilder.register("persistent_cache_pass")
+def _persistent_cache_pass(ctx):
+    """Map ``set_optim_cache_dir`` onto the XLA persistent compilation
+    cache — the analog of serializing the optimized program/TRT engine."""
+    d = ctx.config._optim_cache_dir
+    if d:
+        import jax
+        try:
+            jax.config.update("jax_compilation_cache_dir", d)
+            jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        except Exception:
+            pass
+
+
+@PassBuilder.register("resident_params_pass")
+def _resident_params_pass(ctx):
+    """Pin parameters on the target device once (ZeroCopy weights)."""
+    ctx.resident_params = True

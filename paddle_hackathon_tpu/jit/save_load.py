@@ -76,7 +76,7 @@ def save(layer, path, input_spec=None, **configs):
         else:
             param_list, buffer_list = [], []
         key = jax.random.key(0)
-        exported = jax.export.export(jitted)(
+        exported = jax.export.export(jitted, platforms=("cpu", "tpu"))(
             param_list, buffer_list, key, *example_args)
     finally:
         if target_layer is not None and was_training:

@@ -74,16 +74,17 @@ def recompute(function: Callable, *args, preserve_rng_state: bool = True,
     diff_pos = [i for i, a in enumerate(args)
                 if isinstance(a, Tensor) and not a.stop_gradient
                 and jnp.issubdtype(jnp.result_type(a._value), jnp.inexact)]
-    arg_vals = [a._value if isinstance(a, Tensor) else a for a in args]
+    diff_set = set(diff_pos)
+    # Closure must NOT retain device buffers of differentiable args: with
+    # offload=True those are re-fed from host copies at backward, and keeping
+    # them here would pin the HBM the offload is meant to release.
+    static_args = [None if i in diff_set else a for i, a in enumerate(args)]
 
     def run_pure(diff_vals, param_vals):
         """Re-execute the block as a pure function of (args, params)."""
-        call_args = list(arg_vals)
+        call_args = list(static_args)
         for pos, v in zip(diff_pos, diff_vals):
             call_args[pos] = Tensor(v, stop_gradient=True)
-        for i, a in enumerate(call_args):
-            if i not in diff_pos and isinstance(args[i], Tensor):
-                call_args[i] = args[i]
         old_vals = [p._value for p in param_leaves]
         for p, v in zip(param_leaves, param_vals):
             p._value = v
@@ -99,7 +100,7 @@ def recompute(function: Callable, *args, preserve_rng_state: bool = True,
         return tuple(t._value for t in flat), out
 
     # Forward pass: compute values only (no residuals kept).
-    diff_vals = [arg_vals[i] for i in diff_pos]
+    diff_vals = [args[i]._value for i in diff_pos]
     param_vals = [p._value for p in param_leaves]
     out_flat_vals, out_structure = run_pure(diff_vals, param_vals)
 
@@ -214,37 +215,18 @@ def jit_recompute(fn: Callable, policy: Optional[str] = None,
 
 
 def _flatten_out(out):
-    """Flatten nested (tuple/list/dict) Tensor outputs; return rebuilder."""
-    if isinstance(out, Tensor):
-        return [out], lambda flat: flat[0]
-    if isinstance(out, (tuple, list)):
-        flats: List[Tensor] = []
-        specs = []
-        for o in out:
-            sub_flat, sub_rebuild = _flatten_out(o)
-            specs.append((len(flats), len(sub_flat), sub_rebuild))
-            flats.extend(sub_flat)
-        typ = type(out)
+    """Flatten nested Tensor outputs via jax pytrees; return rebuilder.
 
-        def rebuild(flat, _specs=specs, _typ=typ):
-            return _typ(r(flat[s:s + n]) for s, n, r in _specs)
-
-        return flats, rebuild
-    if isinstance(out, dict):
-        keys = list(out.keys())
-        flats = []
-        specs = []
-        for k in keys:
-            sub_flat, sub_rebuild = _flatten_out(out[k])
-            specs.append((k, len(flats), len(sub_flat), sub_rebuild))
-            flats.extend(sub_flat)
-
-        def rebuild(flat, _specs=specs):
-            return {k: r(flat[s:s + n]) for k, s, n, r in _specs}
-
-        return flats, rebuild
-    raise TypeError(f"recompute output must be Tensors/containers, got "
-                    f"{type(out)!r}")
+    Tensors are kept whole via ``is_leaf`` (Tensor is pytree-registered, so
+    by default tree_flatten would descend into its _value); tree_util handles
+    tuples/lists/dicts/namedtuples (and any registered pytree) natively."""
+    leaves, treedef = jax.tree_util.tree_flatten(
+        out, is_leaf=lambda x: isinstance(x, Tensor))
+    bad = [type(l).__name__ for l in leaves if not isinstance(l, Tensor)]
+    if bad:
+        raise TypeError("recompute output must be Tensors/containers of "
+                        f"Tensors, got leaf types {bad}")
+    return leaves, lambda flat: jax.tree_util.tree_unflatten(treedef, flat)
 
 
 @contextlib.contextmanager

@@ -28,15 +28,19 @@ __all__ = ["GradientMergeOptimizer", "LocalSGDOptimizer",
            "DGCMomentumOptimizer", "FP16AllReduceOptimizer"]
 
 
-@functools.partial(jax.jit, static_argnums=(1,))
-def _dgc_sparsify(v, k):
+@functools.partial(jax.jit, static_argnums=(2,))
+def _dgc_sparsify(v, u, k):
+    """Top-k selection with momentum factor masking (arXiv:1712.01887 §3.2):
+    communicated coordinates are cleared from BOTH the error accumulator v
+    and the velocity u, so already-applied history is not re-injected."""
     flat = v.reshape(-1)
     thresh_vals, _ = jax.lax.top_k(jnp.abs(flat), k)
     thresh = thresh_vals[-1]
-    mask = jnp.abs(flat) >= thresh
-    kept = jnp.where(mask, flat, 0.0).reshape(v.shape)
-    residual = jnp.where(mask, 0.0, flat).reshape(v.shape)
-    return kept, residual
+    mask = (jnp.abs(flat) >= thresh).reshape(v.shape)
+    kept = jnp.where(mask, v, 0.0)
+    residual = jnp.where(mask, 0.0, v)
+    u_masked = jnp.where(mask, 0.0, u)
+    return kept, residual, u_masked
 
 
 class _OptimizerWrapper:
@@ -46,33 +50,29 @@ class _OptimizerWrapper:
         self._inner = inner
 
     def __getattr__(self, name):
-        # Full Optimizer surface (minimize, _get_accumulators, ...) delegates
-        # to the wrapped optimizer; only step()/grad handling is overridden.
+        # Full Optimizer surface (_get_accumulators, get_lr, state_dict, ...)
+        # delegates to the wrapped optimizer; step()/minimize() are the
+        # strategy override points.
         return getattr(self._inner, name)
-
-    @property
-    def _parameter_list(self):
-        return self._inner._parameter_list
-
-    def get_lr(self):
-        return self._inner.get_lr()
-
-    def set_lr(self, v):
-        self._inner.set_lr(v)
-
-    def clear_grad(self, set_to_zero: bool = False):
-        self._inner.clear_grad(set_to_zero)
-
-    clear_gradients = clear_grad
-
-    def state_dict(self):
-        return self._inner.state_dict()
-
-    def set_state_dict(self, sd):
-        return self._inner.set_state_dict(sd)
 
     def step(self):
         raise NotImplementedError
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        # Must route through the *wrapper's* step() — delegating minimize to
+        # the inner optimizer would silently disable the strategy.
+        from ..core import autograd as _ag
+        sm = _ag._static_module
+        if sm is not None and isinstance(loss, sm.Variable):
+            # static mode: strategies are eager-mode wrappers; the program
+            # records the inner optimizer's update.
+            return self._inner.minimize(loss, startup_program, parameters,
+                                        no_grad_set)
+        loss.backward()
+        self.step()
+        self.clear_grad()
+        return None, None
 
 
 class GradientMergeOptimizer(_OptimizerWrapper):
@@ -152,6 +152,15 @@ class DGCMomentumOptimizer(_OptimizerWrapper):
                  sparsity: Sequence[float] = (0.999,),
                  comm_fn: Optional[Callable] = None):
         super().__init__(inner)
+        # This wrapper IS the momentum optimizer (like the reference's
+        # DGCMomentumOptimizer replacing Momentum): the inner must be a
+        # momentum-free update or momentum would be applied twice.
+        if float(getattr(inner, "_momentum", 0.0) or 0.0) != 0.0:
+            raise ValueError(
+                "DGCMomentumOptimizer applies momentum itself; wrap a "
+                "momentum-free optimizer (e.g. SGD), not "
+                f"{type(inner).__name__} with momentum="
+                f"{inner._momentum}")
         self.momentum = float(momentum)
         self.rampup_begin_step = int(rampup_begin_step)
         self.sparsity = list(sparsity)
@@ -161,20 +170,32 @@ class DGCMomentumOptimizer(_OptimizerWrapper):
         self._step_no = 0
 
     def _current_sparsity(self) -> float:
-        i = min(self._step_no, len(self.sparsity) - 1)
+        # 0-based position in the ramp: first compressed step (the one right
+        # after rampup_begin_step warm-up steps) uses sparsity[0].
+        i = min(max(self._step_no - self.rampup_begin_step - 1, 0),
+                len(self.sparsity) - 1)
         return float(self.sparsity[i])
 
     @staticmethod
-    def _sparsify(v, k):
-        return _dgc_sparsify(v, k)
+    def _sparsify(v, u, k):
+        return _dgc_sparsify(v, u, k)
 
     def step(self):
         self._step_no += 1
+        m = self.momentum
         if self._step_no <= self.rampup_begin_step:
-            # warm-up: plain dense momentum handled by the inner optimizer
+            # warm-up: dense, but with the SAME momentum rule, so the update
+            # dynamics are continuous across rampup_begin_step
+            for p in self._parameter_list:
+                g = p._grad_value
+                if g is None:
+                    continue
+                u = self._u.get(id(p))
+                u = g if u is None else m * u + g
+                self._u[id(p)] = u
+                p._grad_value = u
             self._inner.step()
             return
-        m = self.momentum
         sp = self._current_sparsity()
         for p in self._parameter_list:
             g = p._grad_value
@@ -189,7 +210,8 @@ class DGCMomentumOptimizer(_OptimizerWrapper):
             if k >= n:
                 kept, residual = v, jnp.zeros_like(v)
             else:
-                kept, residual = self._sparsify(v, k)
+                # momentum factor masking: clear u too at sent coordinates
+                kept, residual, u = self._sparsify(v, u, k)
             self._u[id(p)] = u
             self._v[id(p)] = residual
             if self._comm_fn is not None:

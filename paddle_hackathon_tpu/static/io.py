@@ -38,7 +38,10 @@ def save_inference_model(path_prefix: str, feed_vars, fetch_vars,
                   for v in feed_vars]
     param_avals = [jax.ShapeDtypeStruct(p._value.shape, p._value.dtype)
                    for p in params]
-    exported = jax.export.export(jax.jit(fn))(feed_avals, param_avals)
+    # export for both cpu and tpu so the artifact deploys anywhere (the
+    # portability ProgramDesc gives the reference's AnalysisPredictor)
+    exported = jax.export.export(
+        jax.jit(fn), platforms=("cpu", "tpu"))(feed_avals, param_avals)
     blob = exported.serialize()
 
     d = os.path.dirname(path_prefix)

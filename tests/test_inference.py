@@ -1,0 +1,123 @@
+"""Inference engine tests (ref AnalysisPredictor behavior,
+``paddle/fluid/inference/api/analysis_predictor.h:95``)."""
+
+import numpy as np
+import pytest
+
+import paddle_hackathon_tpu as paddle
+from paddle_hackathon_tpu import inference, nn
+from paddle_hackathon_tpu.jit import InputSpec
+
+
+class _Net(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(8, 16)
+        self.fc2 = nn.Linear(16, 4)
+
+    def forward(self, x):
+        return self.fc2(paddle.nn.functional.relu(self.fc1(x)))
+
+
+@pytest.fixture(scope="module")
+def artifact(tmp_path_factory):
+    paddle.seed(0)
+    net = _Net()
+    net.eval()
+    path = str(tmp_path_factory.mktemp("infer") / "net")
+    paddle.jit.save(net, path, input_spec=[InputSpec([-1, 8], "float32")])
+    x = np.random.RandomState(0).randn(3, 8).astype(np.float32)
+    import paddle_hackathon_tpu.nn.layer as L
+    expect = np.asarray(net(paddle.to_tensor(x)).numpy())
+    return path + ".pdmodel", x, expect
+
+
+def test_predictor_zero_copy_run(artifact):
+    model_path, x, expect = artifact
+    cfg = inference.Config(model_path)
+    cfg.disable_gpu()
+    cfg.enable_memory_optim()
+    pred = inference.create_predictor(cfg)
+    names = pred.get_input_names()
+    assert len(names) == 1
+    h = pred.get_input_handle(names[0])
+    h.copy_from_cpu(x)
+    pred.run()
+    out_names = pred.get_output_names()
+    out = pred.get_output_handle(out_names[0]).copy_to_cpu()
+    np.testing.assert_allclose(out, expect, rtol=1e-5, atol=1e-5)
+
+
+def test_predictor_convenience_run_and_clone(artifact):
+    model_path, x, expect = artifact
+    cfg = inference.Config()
+    cfg.set_model(model_path)
+    cfg.disable_gpu()
+    pred = inference.create_predictor(cfg)
+    outs = pred.run([x])
+    np.testing.assert_allclose(outs[0], expect, rtol=1e-5, atol=1e-5)
+    # clone shares weights/executable but has independent handles
+    c = pred.clone()
+    outs2 = c.run([x * 2])
+    assert not np.allclose(outs2[0], outs[0])
+
+
+def test_predictor_shape_polymorphic_batch(artifact):
+    model_path, x, _ = artifact
+    cfg = inference.Config(model_path)
+    cfg.disable_gpu()
+    pred = inference.create_predictor(cfg)
+    for bs in (1, 5):
+        xb = np.random.randn(bs, 8).astype(np.float32)
+        (out,) = pred.run([xb])
+        assert out.shape == (bs, 4)
+
+
+def test_predictor_pool(artifact):
+    model_path, x, expect = artifact
+    cfg = inference.Config(model_path)
+    cfg.disable_gpu()
+    pool = inference.PredictorPool(cfg, size=2)
+    for i in range(2):
+        (out,) = pool.retrieve(i).run([x])
+        np.testing.assert_allclose(out, expect, rtol=1e-5, atol=1e-5)
+
+
+def test_config_surface():
+    cfg = inference.Config("m.pdmodel", "m.pdiparams")
+    cfg.enable_use_gpu(100, 0)
+    assert cfg.use_gpu()
+    cfg.switch_ir_optim(False)
+    assert not cfg.ir_optim()
+    cfg.set_cpu_math_library_num_threads(4)
+    assert cfg.cpu_math_library_num_threads() == 4
+    pb = cfg.pass_builder()
+    pb.delete_pass("persistent_cache_pass")
+    assert "persistent_cache_pass" not in pb.all_passes()
+    assert "memory_optim" in cfg.summary()
+
+
+def test_static_artifact_predictor(tmp_path):
+    """Predictor over a static save_inference_model artifact."""
+    import paddle_hackathon_tpu.static as static
+    paddle.enable_static()
+    try:
+        main, start = static.Program(), static.Program()
+        with static.program_guard(main, start):
+            x = static.data("x", [4, 6], "float32")
+            lin = nn.Linear(6, 3)
+            y = lin(x)
+        exe = static.Executor()
+        prefix = str(tmp_path / "smodel")
+        static.save_inference_model(prefix, [x], [y], exe, program=main)
+    finally:
+        paddle.disable_static()
+
+    cfg = inference.Config(prefix)
+    cfg.disable_gpu()
+    pred = inference.create_predictor(cfg)
+    xv = np.random.randn(4, 6).astype(np.float32)
+    pred.get_input_handle(pred.get_input_names()[0]).copy_from_cpu(xv)
+    pred.run()
+    out = pred.get_output_handle(pred.get_output_names()[0]).copy_to_cpu()
+    assert out.shape == (4, 3)

@@ -217,3 +217,48 @@ class TestFleetStrategyWiring:
             SGD(learning_rate=0.01, parameters=m.parameters()))
         assert isinstance(opt, GradientMergeOptimizer)
         assert opt.k_steps == 4
+
+
+class TestReviewRegressions:
+    def test_minimize_routes_through_wrapper_step(self):
+        # minimize() must honor the strategy (gradient merge), not bypass it
+        # by delegating to the inner optimizer's minimize.
+        from paddle_hackathon_tpu.optimizer import SGD
+        m = _mlp(0)
+        opt = GradientMergeOptimizer(
+            SGD(learning_rate=0.1, parameters=m.parameters()), k_steps=4)
+        w0 = m[0].weight.numpy().copy()
+        x = paddle.to_tensor(np.ones((2, 8), np.float32))
+        loss = m(x).sum()
+        opt.minimize(loss)  # micro-step 1 of 4: must NOT update weights
+        np.testing.assert_array_equal(m[0].weight.numpy(), w0)
+
+    def test_recompute_namedtuple_output(self):
+        import collections
+        NT = collections.namedtuple("NT", ["out", "aux"])
+        m = _mlp(0)
+        x = paddle.to_tensor(np.ones((2, 8), np.float32))
+
+        def fn(x):
+            y = m(x)
+            return NT(out=y, aux=y.sum())
+
+        r = recompute(fn, x, params=list(m.parameters()))
+        assert isinstance(r, NT)
+        r.out.sum().backward()
+        assert m[0].weight._grad_value is not None
+
+    def test_dgc_rampup_starts_at_first_sparsity(self):
+        from paddle_hackathon_tpu.optimizer import SGD
+        m = _mlp(0)
+        opt = DGCMomentumOptimizer(
+            SGD(learning_rate=0.1, parameters=m.parameters()),
+            rampup_begin_step=2, sparsity=[0.75, 0.9375, 0.99])
+        # warm-up steps use dense grads
+        opt._step_no = 2  # pretend warm-up done
+        opt._step_no += 1
+        assert opt._current_sparsity() == 0.75
+        opt._step_no += 1
+        assert opt._current_sparsity() == 0.9375
+        opt._step_no += 10
+        assert opt._current_sparsity() == 0.99
