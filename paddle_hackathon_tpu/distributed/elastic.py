@@ -1,0 +1,217 @@
+"""Elastic training manager: node registry, heartbeats, scale events.
+
+Ref ``fleet/elastic/manager.py:131`` (``ElasticManager``): the reference
+keeps per-node keys under an etcd job prefix with TTL leases + a heartbeat
+thread, watches for membership changes, and relaunches the local trainer
+with a rewritten rank map. Here the registry is an abstract ``LeaseStore``
+(TTL-lease KV): the default backing is the framework's native TCPStore on
+the master node; tests use the in-memory ``MemLeaseStore`` the way the
+reference's elastic tests mock etcd (``test_fleet_elastic_manager.py``).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+__all__ = ["ElasticStatus", "LeaseStore", "MemLeaseStore", "TCPLeaseStore",
+           "ElasticManager"]
+
+
+class ElasticStatus:
+    COMPLETED = "completed"
+    ERROR = "error"
+    HOLD = "hold"       # membership below np range: wait
+    RESTART = "restart"  # membership changed: relaunch with new ranks
+    EXIT = "exit"
+
+
+class LeaseStore:
+    """TTL-lease KV interface (the slice of etcd the manager needs)."""
+
+    def put_with_lease(self, key: str, value: str, ttl: float) -> None:
+        raise NotImplementedError
+
+    def refresh(self, key: str, ttl: float) -> bool:
+        raise NotImplementedError
+
+    def delete(self, key: str) -> None:
+        raise NotImplementedError
+
+    def list_prefix(self, prefix: str) -> Dict[str, str]:
+        raise NotImplementedError
+
+
+class MemLeaseStore(LeaseStore):
+    """In-memory lease store (test double; ref mocked-etcd elastic tests)."""
+
+    def __init__(self):
+        self._data: Dict[str, tuple] = {}  # key -> (value, expiry)
+        self._lock = threading.Lock()
+
+    def put_with_lease(self, key, value, ttl):
+        with self._lock:
+            self._data[key] = (value, time.monotonic() + ttl)
+
+    def refresh(self, key, ttl):
+        with self._lock:
+            if key not in self._data:
+                return False
+            v, _ = self._data[key]
+            self._data[key] = (v, time.monotonic() + ttl)
+            return True
+
+    def delete(self, key):
+        with self._lock:
+            self._data.pop(key, None)
+
+    def list_prefix(self, prefix):
+        now = time.monotonic()
+        with self._lock:
+            self._data = {k: ve for k, ve in self._data.items()
+                          if ve[1] > now}
+            return {k: v for k, (v, e) in self._data.items()
+                    if k.startswith(prefix)}
+
+
+class TCPLeaseStore(LeaseStore):
+    """Lease store over the native TCPStore: value is ``payload|expiry``;
+    expiry is refreshed by heartbeats and filtered on read (TTL semantics
+    without server-side timers)."""
+
+    def __init__(self, store):
+        self._s = store
+        self._registered = set()
+
+    def put_with_lease(self, key, value, ttl):
+        self._s.set(key, f"{value}|{time.time() + ttl}")
+        if key not in self._registered:
+            # enumeration index: the store has no prefix scan, so members
+            # claim an atomic slot (add) and publish their key under it;
+            # deleted members leave tombstone slots filtered by check()
+            slot = self._s.add("__elastic_index/n", 1) - 1
+            self._s.set(f"__elastic_index/{slot}", key)
+            self._registered.add(key)
+
+    def refresh(self, key, ttl):
+        if not self._s.check(key):
+            return False
+        raw = self._s.get(key).decode()
+        payload = raw.rsplit("|", 1)[0]
+        self._s.set(key, f"{payload}|{time.time() + ttl}")
+        return True
+
+    def delete(self, key):
+        self._s.delete_key(key)
+        self._registered.discard(key)
+
+    def _index(self) -> List[str]:
+        if not self._s.check("__elastic_index/n"):
+            return []
+        n = self._s.add("__elastic_index/n", 0)
+        keys = []
+        for i in range(n):
+            if self._s.check(f"__elastic_index/{i}"):
+                k = self._s.get(f"__elastic_index/{i}").decode()
+                if k not in keys:
+                    keys.append(k)
+        return keys
+
+    def list_prefix(self, prefix):
+        out = {}
+        now = time.time()
+        for k in self._index():
+            if not k.startswith(prefix) or not self._s.check(k):
+                continue
+            payload, expiry = self._s.get(k).decode().rsplit("|", 1)
+            if float(expiry) > now:
+                out[k] = payload
+        return out
+
+
+class ElasticManager:
+    """Ref ``ElasticManager`` (``fleet/elastic/manager.py:131``).
+
+    ``np`` may be "N" or "N:M" (min:max nodes, the elastic range). The
+    manager registers this node under ``/{job}/nodes/{host}``, heartbeats
+    the lease (``manager.py:250-290``), and reports membership health;
+    ``watch()`` returns an ``ElasticStatus`` the launcher acts on
+    (``fleet/elastic/collective.py`` relaunch path).
+    """
+
+    def __init__(self, job_id: str, np: str, host: str,
+                 store: Optional[LeaseStore] = None,
+                 heartbeat_interval: float = 1.0, ttl: float = 5.0,
+                 on_change: Optional[Callable[[List[str]], None]] = None):
+        self.job_id = job_id
+        parts = str(np).split(":")
+        self.np_min = int(parts[0])
+        self.np_max = int(parts[-1])
+        self.host = host
+        self.store = store or MemLeaseStore()
+        self.interval = heartbeat_interval
+        self.ttl = ttl
+        self.on_change = on_change
+        self.enable = self.np_min != self.np_max or ":" in str(np)
+        self._prefix = f"/{job_id}/nodes/"
+        self._key = f"{self._prefix}{host}"
+        self._stop = threading.Event()
+        self._hb_thread: Optional[threading.Thread] = None
+        self._last_members: List[str] = []
+        self.elastic_startup_time: Optional[float] = None
+
+    # -- registration / heartbeat -------------------------------------------
+    def register(self) -> None:
+        self.store.put_with_lease(self._key, self.host, self.ttl)
+        self._last_members = self.hosts()
+        self._hb_thread = threading.Thread(target=self._heartbeat,
+                                           daemon=True)
+        self._hb_thread.start()
+
+    def _heartbeat(self) -> None:
+        while not self._stop.wait(self.interval):
+            if not self.store.refresh(self._key, self.ttl):
+                # lease lost (e.g. store restarted): re-register
+                self.store.put_with_lease(self._key, self.host, self.ttl)
+
+    def exit(self, completed: bool = True) -> None:
+        self._stop.set()
+        if self._hb_thread is not None:
+            self._hb_thread.join(timeout=2 * self.interval)
+        self.store.delete(self._key)
+
+    # -- membership ---------------------------------------------------------
+    def hosts(self) -> List[str]:
+        return sorted(self.store.list_prefix(self._prefix).values())
+
+    def _stable(self) -> bool:
+        n = len(self.hosts())
+        return self.np_min <= n <= self.np_max
+
+    def health(self) -> str:
+        n = len(self.hosts())
+        if n < self.np_min:
+            return ElasticStatus.HOLD
+        return "ok"
+
+    def rank_map(self) -> Dict[str, int]:
+        """Deterministic host→rank assignment after a scale event (the
+        reference rewrites ``PADDLE_TRAINER_ENDPOINTS`` the same way)."""
+        return {h: i for i, h in enumerate(self.hosts())}
+
+    def watch(self, timeout: Optional[float] = None) -> str:
+        """Block until membership changes or timeout; classify the event."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            cur = self.hosts()
+            if cur != self._last_members:
+                self._last_members = cur
+                if self.on_change is not None:
+                    self.on_change(cur)
+                if len(cur) < self.np_min:
+                    return ElasticStatus.HOLD
+                return ElasticStatus.RESTART
+            if deadline is not None and time.monotonic() >= deadline:
+                return ElasticStatus.COMPLETED
+            time.sleep(min(self.interval, 0.1))

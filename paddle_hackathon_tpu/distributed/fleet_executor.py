@@ -1,0 +1,269 @@
+"""Actor-style pipeline runtime: interceptors + message bus.
+
+Ref ``paddle/fluid/distributed/fleet_executor/``: ``FleetExecutor``
+(``fleet_executor.cc``), ``Carrier`` (``carrier.cc``), ``Interceptor`` /
+``ComputeInterceptor`` / ``AmplifierInterceptor`` (``*.cc``), ``MessageBus``
+(``message_bus.cc``, brpc inter-rank) and ``TaskNode`` (``task_node.cc``).
+
+TPU-native stance: *within* a slice, pipeline parallelism is compiled into
+one SPMD program (``parallel/pipeline.py``) — XLA schedules it. This runtime
+covers what compilation cannot: host-side orchestration of heterogeneous
+stages (data feeders, eval loops, multi-program serving, DCN-separated
+super-stages) with back-pressure. Messages are Python objects on bounded
+in-process queues; the bus interface mirrors the brpc one so a TCP transport
+can plug in for multi-controller deployments.
+
+Flow control follows the reference's credit scheme (ComputeInterceptor's
+``DATA_IS_READY`` / ``DATA_IS_USELESS`` pair): an edge has a buffer depth;
+upstream may only fire while it holds credits, downstream returns a credit
+when it consumes a message — 1F1B falls out of depth-1 buffers.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+__all__ = ["TaskNode", "Carrier", "FleetExecutor", "Interceptor",
+           "ComputeInterceptor", "AmplifierInterceptor", "MessageBus"]
+
+
+# -- messages ----------------------------------------------------------------
+
+DATA_IS_READY = "DATA_IS_READY"
+DATA_IS_USELESS = "DATA_IS_USELESS"   # credit return
+STOP = "STOP"
+
+
+@dataclass
+class InterceptorMessage:
+    """Ref ``interceptor_message.proto``."""
+    src: int
+    dst: int
+    type: str
+    payload: Any = None
+    scope_idx: int = 0  # microbatch index
+
+
+# -- task graph --------------------------------------------------------------
+
+@dataclass
+class TaskNode:
+    """Ref ``task_node.cc``: a stage of work replicated over microbatches."""
+    task_id: int
+    fn: Optional[Callable[[Any, int], Any]] = None  # (payload, mb_idx) -> out
+    role: str = "compute"
+    max_run_times: int = 1           # number of microbatches
+    run_per_steps: int = 1           # amplifier: fire every k inputs
+    run_at_offset: int = 0
+    downstream: Dict[int, int] = field(default_factory=dict)  # id -> buffsize
+    upstream: Dict[int, int] = field(default_factory=dict)
+
+    def add_downstream_task(self, task_id: int, buff_size: int = 2) -> None:
+        self.downstream[task_id] = buff_size
+
+    def add_upstream_task(self, task_id: int, buff_size: int = 2) -> None:
+        self.upstream[task_id] = buff_size
+
+
+class MessageBus:
+    """In-process bus (ref ``message_bus.cc``); route by interceptor id."""
+
+    def __init__(self):
+        self._boxes: Dict[int, "queue.Queue[InterceptorMessage]"] = {}
+
+    def register(self, interceptor_id: int) -> "queue.Queue":
+        q = queue.Queue()
+        self._boxes[interceptor_id] = q
+        return q
+
+    def send(self, msg: InterceptorMessage) -> bool:
+        box = self._boxes.get(msg.dst)
+        if box is None:
+            return False
+        box.put(msg)
+        return True
+
+
+# -- interceptors ------------------------------------------------------------
+
+class Interceptor(threading.Thread):
+    """Ref ``interceptor.cc``: an actor with an inbox and a handler."""
+
+    def __init__(self, node: TaskNode, bus: MessageBus, carrier: "Carrier"):
+        super().__init__(daemon=True, name=f"interceptor-{node.task_id}")
+        self.node = node
+        self.bus = bus
+        self.carrier = carrier
+        self.inbox = bus.register(node.task_id)
+        self._stopped = False
+
+    def send(self, dst: int, mtype: str, payload: Any = None,
+             scope_idx: int = 0) -> None:
+        self.bus.send(InterceptorMessage(self.node.task_id, dst, mtype,
+                                         payload, scope_idx))
+
+    def run(self) -> None:
+        while not self._stopped:
+            msg = self.inbox.get()
+            if msg.type == STOP:
+                self._stopped = True
+                break
+            self.handle(msg)
+
+    def handle(self, msg: InterceptorMessage) -> None:
+        raise NotImplementedError
+
+
+class ComputeInterceptor(Interceptor):
+    """Ref ``compute_interceptor.cc``: credit-based fire rule.
+
+    Fires when (a) every upstream edge holds a ready input, and (b) every
+    downstream edge has a free credit; consuming an input returns a credit
+    upstream (``DATA_IS_USELESS``).
+    """
+
+    def __init__(self, node, bus, carrier):
+        super().__init__(node, bus, carrier)
+        self._ready: Dict[int, List[InterceptorMessage]] = {
+            u: [] for u in node.upstream}
+        self._credits: Dict[int, int] = dict(node.downstream)
+        self._run_count = 0
+
+    def _try_fire(self) -> None:
+        while (self._run_count < self.node.max_run_times
+               and all(q for q in self._ready.values())
+               and all(c > 0 for c in self._credits.values())):
+            mb = self._run_count
+            inputs = {}
+            for u, q in self._ready.items():
+                m = q.pop(0)
+                inputs[u] = m.payload
+                self.send(u, DATA_IS_USELESS, scope_idx=m.scope_idx)
+            payload = (inputs if len(inputs) > 1 else
+                       next(iter(inputs.values())) if inputs else None)
+            out = self.node.fn(payload, mb) if self.node.fn else payload
+            self._run_count += 1
+            for d in self._credits:
+                self._credits[d] -= 1
+                self.send(d, DATA_IS_READY, out, scope_idx=mb)
+            if not self.node.downstream:
+                self.carrier.collect(self.node.task_id, mb, out)
+            if self._run_count >= self.node.max_run_times:
+                self.carrier.done(self.node.task_id)
+
+    def handle(self, msg: InterceptorMessage) -> None:
+        if msg.type == DATA_IS_READY:
+            if msg.src in self._ready:
+                self._ready[msg.src].append(msg)
+            # else: kickoff trigger for a source node — nothing to buffer
+        elif msg.type == DATA_IS_USELESS:
+            self._credits[msg.src] += 1
+        self._try_fire()
+
+    def kickoff(self) -> None:
+        """Source nodes (no upstream) self-start; credits pace them."""
+        if not self.node.upstream:
+            self.inbox.put(InterceptorMessage(-1, self.node.task_id,
+                                              DATA_IS_READY, None))
+
+
+class AmplifierInterceptor(ComputeInterceptor):
+    """Ref ``amplifier_interceptor.cc``: fire every ``run_per_steps`` inputs
+    at ``run_at_offset`` (gradient-accumulation / LR-step style nodes)."""
+
+    def __init__(self, node, bus, carrier):
+        super().__init__(node, bus, carrier)
+        self._seen = 0
+        self._pending: List[Any] = []
+
+    def handle(self, msg: InterceptorMessage) -> None:
+        if msg.type == DATA_IS_READY:
+            self._seen += 1
+            self._pending.append(msg.payload)
+            self.send(msg.src, DATA_IS_USELESS, scope_idx=msg.scope_idx)
+            k = self.node.run_per_steps
+            if (self._seen - self.node.run_at_offset) % k == 0:
+                mb = self._run_count
+                out = (self.node.fn(list(self._pending), mb)
+                       if self.node.fn else list(self._pending))
+                self._pending.clear()
+                self._run_count += 1
+                for d in self._credits:
+                    self.send(d, DATA_IS_READY, out, scope_idx=mb)
+                if not self.node.downstream:
+                    self.carrier.collect(self.node.task_id, mb, out)
+                if self._run_count >= self.node.max_run_times:
+                    self.carrier.done(self.node.task_id)
+        elif msg.type == DATA_IS_USELESS:
+            self._credits[msg.src] += 1
+
+
+# -- carrier / executor ------------------------------------------------------
+
+class Carrier:
+    """Ref ``carrier.cc``: owns this rank's interceptors and the bus."""
+
+    INTERCEPTOR_TYPES = {"compute": ComputeInterceptor,
+                         "amplifier": AmplifierInterceptor}
+
+    def __init__(self, nodes: List[TaskNode]):
+        self.bus = MessageBus()
+        self.nodes = {n.task_id: n for n in nodes}
+        # wire reverse edges
+        for n in nodes:
+            for d, buff in n.downstream.items():
+                self.nodes[d].upstream.setdefault(n.task_id, buff)
+        self.interceptors: Dict[int, Interceptor] = {}
+        self.results: Dict[int, Dict[int, Any]] = {}
+        self._done = threading.Event()
+        self._finished: set = set()
+        self._sinks = {n.task_id for n in nodes if not n.downstream}
+        self._lock = threading.Lock()
+
+    def collect(self, task_id: int, mb: int, value: Any) -> None:
+        self.results.setdefault(task_id, {})[mb] = value
+
+    def done(self, task_id: int) -> None:
+        with self._lock:
+            self._finished.add(task_id)
+            if self._sinks <= self._finished:
+                self._done.set()
+
+    def start(self) -> None:
+        for n in self.nodes.values():
+            cls = self.INTERCEPTOR_TYPES.get(n.role, ComputeInterceptor)
+            self.interceptors[n.task_id] = cls(n, self.bus, self)
+        for i in self.interceptors.values():
+            i.start()
+        for i in self.interceptors.values():
+            if isinstance(i, ComputeInterceptor):
+                i.kickoff()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        return self._done.wait(timeout)
+
+    def stop(self) -> None:
+        for i in self.interceptors.values():
+            self.bus.send(InterceptorMessage(-1, i.node.task_id, STOP))
+        for i in self.interceptors.values():
+            i.join(timeout=1.0)
+
+
+class FleetExecutor:
+    """Ref ``fleet_executor.cc``: run a task graph for N microbatches."""
+
+    def __init__(self, nodes: List[TaskNode]):
+        self.nodes = nodes
+        self.carrier: Optional[Carrier] = None
+
+    def run(self, timeout: Optional[float] = 60.0) -> Dict[int, Dict[int, Any]]:
+        self.carrier = Carrier(self.nodes)
+        self.carrier.start()
+        ok = self.carrier.wait(timeout)
+        self.carrier.stop()
+        if not ok:
+            raise TimeoutError("fleet_executor: pipeline did not finish")
+        return self.carrier.results

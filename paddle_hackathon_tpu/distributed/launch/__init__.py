@@ -1,0 +1,16 @@
+"""Distributed job launcher.
+
+TPU-native equivalent of ``python -m paddle.distributed.launch``
+(ref ``python/paddle/distributed/launch/main.py:18``): parses job topology,
+rendezvouses multi-node peers through the framework TCPStore (the role the
+reference's HTTP/etcd master plays, ``launch/controllers/master.py``), spawns
+one OS process per rank with the ``PADDLE_*`` env protocol, redirects
+per-rank logs, watches exit codes and applies the restart policy.
+
+On TPU pods the natural layout is one process per host (each owning all
+local chips, SPMD inside), so ``--nproc_per_node`` defaults to 1; CPU-mesh
+testing can raise it.
+"""
+
+from .main import launch  # noqa: F401
+from .context import Context  # noqa: F401

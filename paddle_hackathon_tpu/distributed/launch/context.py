@@ -1,0 +1,112 @@
+"""Launcher context: arguments + node environment.
+
+Ref ``launch/context/__init__.py:25`` (``Context``) and
+``launch/context/node.py`` (local device discovery). Arguments mirror the
+reference CLI (``launch/main.py`` argparse block) minus the vendor-specific
+knobs that have no TPU meaning.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import socket
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@dataclass
+class Node:
+    ip: str = "127.0.0.1"
+    device_count: int = 1
+
+    @classmethod
+    def detect(cls) -> "Node":
+        try:
+            ip = socket.gethostbyname(socket.gethostname())
+        except OSError:
+            ip = "127.0.0.1"
+        # count local accelerators lazily; jax import is heavy, so allow env
+        # override (the reference reads CUDA_VISIBLE_DEVICES analogously)
+        n = os.environ.get("PHT_VISIBLE_DEVICES")
+        if n is not None:
+            count = len([d for d in n.split(",") if d != ""])
+        else:
+            count = 1
+        return cls(ip=ip, device_count=max(1, count))
+
+
+@dataclass
+class Args:
+    master: Optional[str] = None          # host:port of rendezvous store
+    nnodes: int = 1
+    nproc_per_node: Optional[int] = None
+    rank: int = -1                        # node rank; -1 = assigned by master
+    job_id: str = "default"
+    log_dir: str = "log"
+    log_level: str = "INFO"
+    run_mode: str = "collective"          # collective | ps
+    server_num: int = 0                   # ps mode
+    trainer_num: int = 0                  # ps mode
+    max_restart: int = 3
+    elastic_level: int = -1               # -1 off, >=0 on (ref elastic)
+    training_script: str = ""
+    training_script_args: List[str] = field(default_factory=list)
+
+
+def parse_args(argv: Optional[List[str]] = None) -> Args:
+    p = argparse.ArgumentParser(
+        prog="paddle_hackathon_tpu.distributed.launch",
+        description="TPU-native distributed launcher")
+    p.add_argument("--master", default=None,
+                   help="rendezvous store endpoint host:port")
+    p.add_argument("--nnodes", type=str, default="1",
+                   help="number of nodes (or N:M elastic range)")
+    p.add_argument("--nproc_per_node", type=int, default=None)
+    p.add_argument("--rank", type=int, default=-1)
+    p.add_argument("--job_id", default="default")
+    p.add_argument("--log_dir", default="log")
+    p.add_argument("--log_level", default="INFO")
+    p.add_argument("--run_mode", default="collective",
+                   choices=["collective", "ps"])
+    p.add_argument("--server_num", type=int, default=0)
+    p.add_argument("--trainer_num", type=int, default=0)
+    p.add_argument("--max_restart", type=int, default=3)
+    p.add_argument("--elastic_level", type=int, default=-1)
+    p.add_argument("training_script")
+    p.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    ns = p.parse_args(argv)
+    nnodes = str(ns.nnodes).split(":")[0]  # elastic N:M → N for now
+    return Args(master=ns.master, nnodes=int(nnodes),
+                nproc_per_node=ns.nproc_per_node, rank=ns.rank,
+                job_id=ns.job_id, log_dir=ns.log_dir,
+                log_level=ns.log_level, run_mode=ns.run_mode,
+                server_num=ns.server_num, trainer_num=ns.trainer_num,
+                max_restart=ns.max_restart, elastic_level=ns.elastic_level,
+                training_script=ns.training_script,
+                training_script_args=list(ns.training_script_args))
+
+
+class Context:
+    """Ref ``launch/context/__init__.py:25``."""
+
+    def __init__(self, args: Optional[Args] = None,
+                 envs: Optional[dict] = None):
+        self.args = args or Args()
+        self.envs = dict(os.environ if envs is None else envs)
+        self.node = Node.detect()
+        self.status = "ready"
+
+    def is_multi_node(self) -> bool:
+        return self.args.nnodes > 1
+
+    def nprocs(self) -> int:
+        if self.args.nproc_per_node is not None:
+            return self.args.nproc_per_node
+        return 1  # one SPMD process per host on TPU

@@ -1,0 +1,46 @@
+"""Launcher entry point (ref ``launch/main.py:18``).
+
+Usage::
+
+    python -m paddle_hackathon_tpu.distributed.launch \
+        --nproc_per_node 4 train.py --my-arg 1
+
+    python -m paddle_hackathon_tpu.distributed.launch \
+        --master 10.0.0.1:6170 --nnodes 2 train.py
+"""
+
+from __future__ import annotations
+
+import signal
+import sys
+from typing import List, Optional
+
+from .context import Context, parse_args
+from .controllers import make_controller
+
+
+def launch(argv: Optional[List[str]] = None) -> int:
+    ctx = Context(parse_args(argv))
+    c = make_controller(ctx)
+
+    def _sig(signum, frame):
+        c.stop()
+        sys.exit(128 + signum)
+
+    try:
+        signal.signal(signal.SIGTERM, _sig)
+        signal.signal(signal.SIGINT, _sig)
+    except ValueError:
+        pass  # not main thread (tests)
+    try:
+        return c.run()
+    finally:
+        c.stop()
+
+
+def main() -> None:
+    sys.exit(launch())
+
+
+if __name__ == "__main__":
+    main()

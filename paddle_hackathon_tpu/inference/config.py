@@ -191,18 +191,32 @@ def _donate_feed_buffers_pass(ctx):
 @PassBuilder.register("persistent_cache_pass")
 def _persistent_cache_pass(ctx):
     """Map ``set_optim_cache_dir`` onto the XLA persistent compilation
-    cache — the analog of serializing the optimized program/TRT engine."""
+    cache — the analog of serializing the optimized program/TRT engine.
+
+    The XLA cache is process-global in jax; the first predictor to set a
+    dir wins, and a conflicting later dir is reported, not silently
+    applied."""
     d = ctx.config._optim_cache_dir
-    if d:
-        import jax
-        try:
-            jax.config.update("jax_compilation_cache_dir", d)
-            jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
-        except Exception:
-            pass
+    if not d:
+        return
+    import warnings
+
+    import jax
+    current = jax.config.jax_compilation_cache_dir
+    if current and current != d:
+        warnings.warn(
+            f"XLA compilation cache already set to {current!r}; ignoring "
+            f"optim_cache_dir {d!r} (the cache is process-global)")
+        return
+    try:
+        jax.config.update("jax_compilation_cache_dir", d)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    except Exception as e:
+        warnings.warn(f"could not enable XLA compilation cache at {d!r}: {e}")
 
 
 @PassBuilder.register("resident_params_pass")
 def _resident_params_pass(ctx):
-    """Pin parameters on the target device once (ZeroCopy weights)."""
+    """Pin parameters on the target device once (ZeroCopy weights).
+    Without this pass, weights stay on host and transfer every run."""
     ctx.resident_params = True

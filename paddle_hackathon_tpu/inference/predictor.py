@@ -42,14 +42,22 @@ class Tensor:
         self.name = name
         self._device = device
         self._value = None  # jax.Array on the target device
+        self._shape_hint = None
 
     # -- input side --------------------------------------------------------
     def reshape(self, shape):
-        # API parity: shapes are taken from the bound array at run time
-        self._shape_hint = tuple(shape)
+        """Declare the expected shape; validated on the next bind (shapes
+        are otherwise taken from the bound array at run time)."""
+        self._shape_hint = tuple(int(d) for d in shape)
 
     def copy_from_cpu(self, arr):
-        self._value = jax.device_put(np.asarray(arr), self._device)
+        arr = np.asarray(arr)
+        hint = self._shape_hint
+        if hint is not None and tuple(arr.shape) != hint:
+            raise ValueError(
+                f"tensor '{self.name}': bound array shape {arr.shape} does "
+                f"not match reshape({list(hint)})")
+        self._value = jax.device_put(arr, self._device)
 
     def share_external_data(self, tensor):
         """Bind an already-on-device array without a copy."""
@@ -88,18 +96,21 @@ def _load_artifact(config: Config):
     if zipfile.is_zipfile(path):
         with zipfile.ZipFile(path, "r") as zf:
             names = zf.namelist()
-            if "MAGIC" in names and zf.read("MAGIC").decode() == _JIT_MAGIC:
-                exported = jax.export.deserialize(zf.read("program.stablehlo"))
-                meta = json.loads(zf.read("meta.json"))
-                npz = np.load(_io.BytesIO(zf.read("params.npz")))
-                params = [npz[f"p{i}"] for i in range(meta["n_params"])]
-                buffers = [npz[f"b{i}"] for i in range(meta["n_buffers"])]
-                feed_names = [f"x{i}" for i in range(len(meta["input_specs"]))]
-                # out tree is (outputs..., new_buffers...): recover the
-                # user-visible output count from the exported signature so
-                # get_output_names() is correct before the first run()
-                n_out = len(exported.out_avals) - meta["n_buffers"]
-                return ("jit", exported, params, buffers, feed_names, n_out)
+            if "MAGIC" not in names or zf.read("MAGIC").decode() != _JIT_MAGIC:
+                raise ValueError(
+                    f"not a jit inference artifact: {path} (missing or "
+                    f"unsupported MAGIC; expected {_JIT_MAGIC!r})")
+            exported = jax.export.deserialize(zf.read("program.stablehlo"))
+            meta = json.loads(zf.read("meta.json"))
+            npz = np.load(_io.BytesIO(zf.read("params.npz")))
+            params = [npz[f"p{i}"] for i in range(meta["n_params"])]
+            buffers = [npz[f"b{i}"] for i in range(meta["n_buffers"])]
+            feed_names = [f"x{i}" for i in range(len(meta["input_specs"]))]
+            # out tree is (outputs..., new_buffers...): recover the
+            # user-visible output count from the exported signature so
+            # get_output_names() is correct before the first run()
+            n_out = len(exported.out_avals) - meta["n_buffers"]
+            return ("jit", exported, params, buffers, feed_names, n_out)
     with open(path, "rb") as f:
         exported = jax.export.deserialize(f.read())
     params_path = config.params_file()
@@ -135,7 +146,13 @@ class Predictor:
         else:
             (self._kind, self._exported, params, bufs, feed_names,
              self._fetch_count) = _load_artifact(config)
-            put = (lambda a: jax.device_put(jnp.asarray(a), self._device))
+            if self._ctx.resident_params:
+                # ZeroCopy weights: pinned on the target device once
+                put = (lambda a: jax.device_put(jnp.asarray(a), self._device))
+            else:
+                # pass is disabled (or ir_optim off): weights stay on host
+                # and transfer on each run
+                put = np.asarray
             self._params = [put(p) for p in params]
             self._bufs = [put(b) for b in bufs] if bufs is not None else None
             self._compiled = self._build_runner()

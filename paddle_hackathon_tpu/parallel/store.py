@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import ctypes
 import os
+import threading
 import time
 from typing import Optional
 
@@ -44,6 +45,11 @@ class TCPStore:
                 raise RuntimeError(f"failed to bind store server on :{port}")
             port = lib.pht_store_server_port(self._server)
         self.port = port
+        # One request/response exchange at a time per connection: the wire
+        # protocol has no framing for interleaved requests, so concurrent
+        # callers (e.g. an elastic heartbeat thread + a membership watcher)
+        # must serialize on the client.
+        self._lock = threading.Lock()
         self._client = lib.pht_store_connect(
             host.encode(), port, int(timeout * 1000))
         if not self._client:
@@ -57,22 +63,37 @@ class TCPStore:
             value = value.encode()
         buf = (ctypes.c_uint8 * len(value)).from_buffer_copy(value) \
             if value else None
-        rc = self._lib.pht_store_set(self._client, key.encode(), buf,
-                                     len(value))
+        with self._lock:
+            rc = self._lib.pht_store_set(self._client, key.encode(), buf,
+                                         len(value))
         if rc != 0:
             raise RuntimeError(f"store set({key!r}) failed")
 
     def get(self, key: str, timeout: Optional[float] = None) -> bytes:
-        """Blocking wait-until-present get (reference wait+get semantics)."""
+        """Blocking wait-until-present get (reference wait+get semantics).
+
+        The server-side wait is polled in short slices so the client lock is
+        released between polls — a blocking get must not starve other
+        threads' set()/add() on the same connection (e.g. an elastic
+        heartbeat while a watcher waits on a key)."""
         t = self.timeout if timeout is None else timeout
-        tms = -1 if t is None or t < 0 else int(t * 1000)
+        deadline = None if t is None or t < 0 else time.monotonic() + t
+        slice_ms = 100
         n = 1 << 16
         while True:
+            if deadline is None:
+                tms = slice_ms
+            else:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    raise TimeoutError(f"store get({key!r}) timed out")
+                tms = min(slice_ms, max(1, int(left * 1000)))
             buf = (ctypes.c_uint8 * n)()
-            rc = self._lib.pht_store_get(self._client, key.encode(), buf, n,
-                                         tms)
+            with self._lock:
+                rc = self._lib.pht_store_get(self._client, key.encode(), buf,
+                                             n, tms)
             if rc == -1:
-                raise TimeoutError(f"store get({key!r}) timed out")
+                continue  # slice elapsed; re-poll (lock released meanwhile)
             if rc == -2:
                 raise RuntimeError("store connection lost")
             if rc <= n:
@@ -80,7 +101,8 @@ class TCPStore:
             n = rc  # retry with exact-size buffer
 
     def add(self, key: str, delta: int = 1) -> int:
-        v = self._lib.pht_store_add(self._client, key.encode(), delta)
+        with self._lock:
+            v = self._lib.pht_store_add(self._client, key.encode(), delta)
         if v == -(2 ** 63):
             raise RuntimeError("store connection lost")
         return int(v)
@@ -89,13 +111,15 @@ class TCPStore:
         self.get(key, timeout=timeout)
 
     def check(self, key: str) -> bool:
-        rc = self._lib.pht_store_check(self._client, key.encode())
+        with self._lock:
+            rc = self._lib.pht_store_check(self._client, key.encode())
         if rc < 0:
             raise RuntimeError("store connection lost")
         return rc == 1
 
     def delete_key(self, key: str) -> bool:
-        rc = self._lib.pht_store_delete(self._client, key.encode())
+        with self._lock:
+            rc = self._lib.pht_store_delete(self._client, key.encode())
         if rc < 0:
             raise RuntimeError("store connection lost")
         return rc == 1
