@@ -185,7 +185,8 @@ class PSController(Controller):
             env = dict(common, PADDLE_ROLE="PSERVER", PADDLE_PORT=ep.split(":")[1],
                        PADDLE_SERVER_ID=str(i))
             self.pod.add(Container(self._script_cmd(), env,
-                                   self._log_path(f"server{i}")))
+                                   self._log_path(f"server{i}"),
+                                   essential=False))
         for i in range(n_trn):
             env = dict(common, PADDLE_ROLE="TRAINER", PADDLE_TRAINER_ID=str(i))
             self.pod.add(Container(self._script_cmd(), env,

@@ -17,11 +17,16 @@ class Container:
     """One rank's OS process (ref ``launch/job/container.py``)."""
 
     def __init__(self, entrypoint: List[str], env: Dict[str, str],
-                 out_path: str, err_path: Optional[str] = None):
+                 out_path: str, err_path: Optional[str] = None,
+                 essential: bool = True):
         self.entrypoint = list(entrypoint)
         self.env = dict(env)
         self.out_path = out_path
         self.err_path = err_path or out_path
+        # essential containers define job completion (trainers); a PS server
+        # is non-essential: it serves until the trainers are done, then is
+        # stopped by the pod (ref launch watcher stopping pserver pods)
+        self.essential = essential
         self._proc: Optional[subprocess.Popen] = None
         self._out_f = None
         self._err_f = None
@@ -102,21 +107,42 @@ class Pod:
         return any(rc not in (None, 0) for rc in self.exit_codes())
 
     def join(self, poll_interval: float = 0.2) -> int:
-        """Wait for all containers; on any failure stop the rest.
-        Returns the first non-zero exit code (0 if all succeeded)."""
+        """Wait until every essential container exits; on any failure stop
+        the rest. Non-essential containers (PS servers) are stopped once the
+        essential set completes. Returns the first non-zero exit code
+        (0 on success)."""
         while True:
-            codes = self.exit_codes()
-            bad = [rc for rc in codes if rc not in (None, 0)]
+            # essential success is checked FIRST: once every trainer has
+            # exited 0 the job succeeded — a PS server exiting non-zero
+            # when its trainer connections drop must not fail the run
+            essential = [c.exit_code() for c in self.containers if c.essential]
+            if essential and all(rc == 0 for rc in essential):
+                self.stop_graceful()  # reap the non-essential servers
+                return 0
+            bad = [rc for rc in self.exit_codes() if rc not in (None, 0)]
             if bad:
                 self.stop(force=True)
                 return bad[0]
-            if all(rc == 0 for rc in codes):
+            if not essential and all(rc == 0 for rc in self.exit_codes()):
                 return 0
             time.sleep(poll_interval)
 
     def stop(self, force: bool = False) -> None:
         for c in self.containers:
             c.terminate(force=force)
+
+    def stop_graceful(self, grace: float = 5.0) -> None:
+        """SIGTERM, bounded wait, then SIGKILL stragglers — lets PS servers
+        flush/save on shutdown (the reference's watcher stops pserver pods
+        gracefully)."""
+        for c in self.containers:
+            c.terminate(force=False)
+        deadline = time.monotonic() + grace
+        for c in self.containers:
+            c.wait(timeout=max(0.0, deadline - time.monotonic()))
+        for c in self.containers:
+            if c.is_running():
+                c.terminate(force=True)
 
     def restart(self) -> None:
         self.stop(force=True)

@@ -1,0 +1,15 @@
+"""Parameter-server stack.
+
+TPU-native counterpart of the reference's "the one PS"
+(``paddle/fluid/distributed/ps``: brpc server/client, memory sparse/dense
+tables, sparse SGD rules, CTR accessor; python runtime
+``fleet/runtime/the_one_ps.py``; architecture ``ps/README.md``). The server
+is native C++ (``native/ps.cc``), holding host-resident sparse embedding
+state; the TPU keeps the dense compute. ``fleet.init_server/init_worker``
+(ref ``fleet_base.py:625,669``) route here when the launcher sets
+``PADDLE_ROLE``.
+"""
+
+from .api import (PsServerHandle, PsClient, AsyncCommunicator,  # noqa: F401
+                  SparseEmbedding, TableConfig, init_server, init_worker,
+                  run_server, stop_server, get_client, shutdown)

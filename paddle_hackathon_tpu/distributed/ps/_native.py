@@ -1,0 +1,98 @@
+"""Build/load + ctypes declarations for the native PS (``native/ps.cc``)."""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+from pathlib import Path
+from typing import Optional
+
+_SRC = Path(__file__).resolve().parent.parent.parent / "native" / "ps.cc"
+_BUILD_DIR = _SRC.parent / "_build"
+
+_lib = None
+_lib_failed = False
+_lock = threading.Lock()
+
+
+def _build() -> Path:
+    src = _SRC.read_bytes()
+    tag = hashlib.sha256(src).hexdigest()[:16]
+    out = _BUILD_DIR / f"libphtps_{tag}.so"
+    if out.exists():
+        return out
+    _BUILD_DIR.mkdir(exist_ok=True)
+    tmp = out.with_suffix(".so.tmp%d" % os.getpid())
+    cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
+           "-fvisibility=hidden", str(_SRC), "-o", str(tmp)]
+    subprocess.run(cmd, check=True, capture_output=True)
+    os.replace(tmp, out)
+    return out
+
+
+def load() -> Optional[ctypes.CDLL]:
+    global _lib, _lib_failed
+    if _lib is not None:
+        return _lib
+    if _lib_failed:
+        return None
+    with _lock:
+        if _lib is not None or _lib_failed:
+            return _lib
+        try:
+            lib = ctypes.CDLL(str(_build()))
+        except Exception:
+            _lib_failed = True
+            return None
+        _declare(lib)
+        _lib = lib
+    return _lib
+
+
+def _declare(lib: ctypes.CDLL) -> None:
+    c = ctypes
+    u64p = c.POINTER(c.c_uint64)
+    f32p = c.POINTER(c.c_float)
+    lib.pht_ps_server_start.argtypes = [c.c_int32]
+    lib.pht_ps_server_start.restype = c.c_void_p
+    lib.pht_ps_server_port.argtypes = [c.c_void_p]
+    lib.pht_ps_server_port.restype = c.c_int32
+    lib.pht_ps_server_stop.argtypes = [c.c_void_p]
+    lib.pht_ps_connect.argtypes = [c.c_char_p, c.c_int32, c.c_int32]
+    lib.pht_ps_connect.restype = c.c_void_p
+    lib.pht_ps_disconnect.argtypes = [c.c_void_p]
+    lib.pht_ps_create_table.argtypes = [c.c_void_p, c.c_uint32, c.c_uint32,
+                                        c.c_uint8, c.c_uint8, c.c_float,
+                                        c.c_float]
+    lib.pht_ps_create_table.restype = c.c_int32
+    lib.pht_ps_pull_sparse.argtypes = [c.c_void_p, c.c_uint32, u64p,
+                                       c.c_uint32, f32p, c.c_uint32]
+    lib.pht_ps_pull_sparse.restype = c.c_int32
+    lib.pht_ps_push_sparse.argtypes = [c.c_void_p, c.c_uint32, u64p,
+                                       c.c_uint32, f32p, c.c_uint32]
+    lib.pht_ps_push_sparse.restype = c.c_int32
+    lib.pht_ps_pull_dense.argtypes = [c.c_void_p, c.c_uint32, f32p,
+                                      c.c_uint32]
+    lib.pht_ps_pull_dense.restype = c.c_int32
+    lib.pht_ps_push_dense.argtypes = [c.c_void_p, c.c_uint32, f32p,
+                                      c.c_uint32]
+    lib.pht_ps_push_dense.restype = c.c_int32
+    lib.pht_ps_set_dense.argtypes = [c.c_void_p, c.c_uint32, f32p, c.c_uint32]
+    lib.pht_ps_set_dense.restype = c.c_int32
+    lib.pht_ps_push_show_click.argtypes = [c.c_void_p, c.c_uint32, u64p,
+                                           c.c_uint32, f32p, f32p]
+    lib.pht_ps_push_show_click.restype = c.c_int32
+    lib.pht_ps_table_nkeys.argtypes = [c.c_void_p, c.c_uint32]
+    lib.pht_ps_table_nkeys.restype = c.c_int64
+    lib.pht_ps_shrink.argtypes = [c.c_void_p, c.c_uint32, c.c_uint32]
+    lib.pht_ps_shrink.restype = c.c_int64
+    lib.pht_ps_save.argtypes = [c.c_void_p, c.c_char_p]
+    lib.pht_ps_save.restype = c.c_int32
+    lib.pht_ps_load.argtypes = [c.c_void_p, c.c_char_p]
+    lib.pht_ps_load.restype = c.c_int32
+    lib.pht_ps_barrier.argtypes = [c.c_void_p, c.c_char_p, c.c_uint32,
+                                   c.c_int32]
+    lib.pht_ps_barrier.restype = c.c_int32

@@ -1,0 +1,435 @@
+"""PS python surface: tables, sharded client, async communicator,
+sparse-embedding layer, and the fleet server/worker lifecycle.
+
+Ref mapping:
+- ``TableConfig``            — the table section of ``the_one_ps.proto``
+- ``PsClient``               — ``BrpcPsClient`` (client-side sharding of ids
+                               across servers, ``brpc_ps_client.cc``)
+- ``AsyncCommunicator``      — ``ps/service/communicator/`` (background
+                               batched push)
+- ``SparseEmbedding``        — the distributed lookup-table path
+                               (``pscore`` send/recv ops + embedding layer)
+- ``init_server/init_worker``— ``fleet_base.py:625,669`` / ``the_one_ps.py``
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import threading
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from . import _native
+
+RULES = {"sgd": 0, "adagrad": 1}
+
+
+@dataclass
+class TableConfig:
+    table_id: int
+    dim: int
+    rule: str = "sgd"
+    lr: float = 0.01
+    init_range: float = 0.01
+    dense: bool = False
+
+
+class PsServerHandle:
+    """A running in-process PS server (native thread pool owns the port)."""
+
+    def __init__(self, port: int = 0):
+        lib = _native.load()
+        if lib is None:
+            raise RuntimeError("native PS unavailable (g++ missing?)")
+        self._lib = lib
+        self._h = lib.pht_ps_server_start(port)
+        if not self._h:
+            raise RuntimeError(f"PS server failed to bind port {port}")
+        self.port = lib.pht_ps_server_port(self._h)
+
+    def stop(self):
+        if self._h:
+            self._lib.pht_ps_server_stop(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.stop()
+        except Exception:
+            pass
+
+
+class _Conn:
+    """One server connection (serialized; the wire protocol is not framed
+    for interleaving — same rule as the TCPStore client)."""
+
+    def __init__(self, host: str, port: int, timeout_ms: int = 30000):
+        lib = _native.load()
+        if lib is None:
+            raise RuntimeError("native PS unavailable")
+        self._lib = lib
+        self._h = lib.pht_ps_connect(host.encode(), port, timeout_ms)
+        if not self._h:
+            raise TimeoutError(f"cannot reach PS server {host}:{port}")
+        self._lock = threading.Lock()
+
+    def close(self):
+        if self._h:
+            self._lib.pht_ps_disconnect(self._h)
+            self._h = None
+
+
+def _f32p(a):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+
+def _u64p(a):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64))
+
+
+class PsClient:
+    """Sharded client over N servers: sparse ids route to server
+    ``id % n_servers`` (the reference shards by id hash the same way);
+    dense tables live on server 0."""
+
+    def __init__(self, endpoints: Sequence[str], timeout: float = 30.0):
+        self.endpoints = list(endpoints)
+        self._conns = []
+        for ep in self.endpoints:
+            host, port = ep.rsplit(":", 1)
+            self._conns.append(_Conn(host, int(port), int(timeout * 1000)))
+        self._tables: Dict[int, TableConfig] = {}
+
+    @property
+    def n_servers(self) -> int:
+        return len(self._conns)
+
+    def close(self):
+        for c in self._conns:
+            c.close()
+
+    # -- table lifecycle ----------------------------------------------------
+    def create_table(self, cfg: TableConfig) -> None:
+        for c in self._conns:
+            with c._lock:
+                rc = c._lib.pht_ps_create_table(
+                    c._h, cfg.table_id, cfg.dim, RULES[cfg.rule],
+                    1 if cfg.dense else 0, cfg.lr, cfg.init_range)
+            if rc != 0:
+                raise RuntimeError(
+                    f"create_table({cfg.table_id}) rejected (spec conflict?)")
+        self._tables[cfg.table_id] = cfg
+
+    def _dim(self, table_id: int) -> int:
+        return self._tables[table_id].dim
+
+    # -- sparse -------------------------------------------------------------
+    def _route(self, ids: np.ndarray):
+        srv = (ids % np.uint64(self.n_servers)).astype(np.int64)
+        return [np.nonzero(srv == s)[0] for s in range(self.n_servers)]
+
+    def pull_sparse(self, table_id: int, ids) -> np.ndarray:
+        ids = np.ascontiguousarray(np.asarray(ids, np.uint64).reshape(-1))
+        dim = self._dim(table_id)
+        out = np.empty((ids.size, dim), np.float32)
+        for s, idx in enumerate(self._route(ids)):
+            if idx.size == 0:
+                continue
+            sub = np.ascontiguousarray(ids[idx])
+            buf = np.empty((idx.size, dim), np.float32)
+            c = self._conns[s]
+            with c._lock:
+                rc = c._lib.pht_ps_pull_sparse(
+                    c._h, table_id, _u64p(sub), idx.size, _f32p(buf), dim)
+            if rc < 0:
+                raise RuntimeError(f"pull_sparse failed on server {s}: {rc}")
+            out[idx] = buf
+        return out
+
+    def push_sparse(self, table_id: int, ids, grads) -> None:
+        ids = np.ascontiguousarray(np.asarray(ids, np.uint64).reshape(-1))
+        dim = self._dim(table_id)
+        grads = np.ascontiguousarray(
+            np.asarray(grads, np.float32).reshape(ids.size, dim))
+        # aggregate duplicate ids client-side so server-side optimizer rules
+        # (adagrad) see one update per key per push
+        uniq, inv = np.unique(ids, return_inverse=True)
+        agg = np.zeros((uniq.size, dim), np.float32)
+        np.add.at(agg, inv, grads)
+        for s, idx in enumerate(self._route(uniq)):
+            if idx.size == 0:
+                continue
+            sub = np.ascontiguousarray(uniq[idx])
+            g = np.ascontiguousarray(agg[idx])
+            c = self._conns[s]
+            with c._lock:
+                rc = c._lib.pht_ps_push_sparse(
+                    c._h, table_id, _u64p(sub), idx.size, _f32p(g), dim)
+            if rc != 0:
+                raise RuntimeError(f"push_sparse failed on server {s}: {rc}")
+
+    def push_show_click(self, table_id: int, ids, shows, clicks) -> None:
+        ids = np.ascontiguousarray(np.asarray(ids, np.uint64).reshape(-1))
+        shows = np.ascontiguousarray(np.asarray(shows, np.float32).reshape(-1))
+        clicks = np.ascontiguousarray(
+            np.asarray(clicks, np.float32).reshape(-1))
+        for s, idx in enumerate(self._route(ids)):
+            if idx.size == 0:
+                continue
+            c = self._conns[s]
+            sub, sh, cl = (np.ascontiguousarray(a[idx])
+                           for a in (ids, shows, clicks))
+            with c._lock:
+                rc = c._lib.pht_ps_push_show_click(
+                    c._h, table_id, _u64p(sub), idx.size, _f32p(sh),
+                    _f32p(cl))
+            if rc != 0:
+                raise RuntimeError(f"push_show_click failed: {rc}")
+
+    # -- dense --------------------------------------------------------------
+    def pull_dense(self, table_id: int) -> np.ndarray:
+        dim = self._dim(table_id)
+        out = np.empty((dim,), np.float32)
+        c = self._conns[0]
+        with c._lock:
+            rc = c._lib.pht_ps_pull_dense(c._h, table_id, _f32p(out), dim)
+        if rc < 0:
+            raise RuntimeError(f"pull_dense failed: {rc}")
+        return out
+
+    def push_dense(self, table_id: int, grads) -> None:
+        g = np.ascontiguousarray(np.asarray(grads, np.float32).reshape(-1))
+        c = self._conns[0]
+        with c._lock:
+            rc = c._lib.pht_ps_push_dense(c._h, table_id, _f32p(g), g.size)
+        if rc != 0:
+            raise RuntimeError(f"push_dense failed: {rc}")
+
+    def set_dense(self, table_id: int, values) -> None:
+        v = np.ascontiguousarray(np.asarray(values, np.float32).reshape(-1))
+        c = self._conns[0]
+        with c._lock:
+            rc = c._lib.pht_ps_set_dense(c._h, table_id, _f32p(v), v.size)
+        if rc != 0:
+            raise RuntimeError(f"set_dense failed: {rc}")
+
+    # -- maintenance --------------------------------------------------------
+    def table_nkeys(self, table_id: int) -> int:
+        total = 0
+        for c in self._conns:
+            with c._lock:
+                n = c._lib.pht_ps_table_nkeys(c._h, table_id)
+            if n < 0:
+                raise RuntimeError("stats failed")
+            total += n
+        return total
+
+    def shrink(self, table_id: int, max_unseen: int = 1) -> int:
+        dropped = 0
+        for c in self._conns:
+            with c._lock:
+                d = c._lib.pht_ps_shrink(c._h, table_id, max_unseen)
+            if d < 0:
+                raise RuntimeError("shrink failed")
+            dropped += d
+        return dropped
+
+    def save(self, dirname: str) -> None:
+        os.makedirs(dirname, exist_ok=True)
+        for s, c in enumerate(self._conns):
+            with c._lock:
+                rc = c._lib.pht_ps_save(
+                    c._h, os.path.join(dirname, f"shard{s}.bin").encode())
+            if rc != 0:
+                raise RuntimeError(f"save failed on server {s}")
+
+    def load(self, dirname: str) -> None:
+        for s, c in enumerate(self._conns):
+            with c._lock:
+                rc = c._lib.pht_ps_load(
+                    c._h, os.path.join(dirname, f"shard{s}.bin").encode())
+            if rc != 0:
+                raise RuntimeError(f"load failed on server {s}")
+
+    def barrier(self, name: str, world: int, timeout: float = 600.0) -> None:
+        # Dedicated connection: a barrier blocks server-side until all
+        # participants arrive, so it must not hold the shared connection's
+        # lock (concurrent participants would deadlock behind it).
+        host, port = self.endpoints[0].rsplit(":", 1)
+        c = _Conn(host, int(port), int(timeout * 1000))
+        try:
+            rc = c._lib.pht_ps_barrier(c._h, name.encode(), world,
+                                       int(timeout * 1000))
+            if rc != 0:
+                raise TimeoutError(f"ps barrier {name!r} failed")
+        finally:
+            c.close()
+
+
+class AsyncCommunicator:
+    """Background batched push (ref ``ps/service/communicator/``:
+    trainers enqueue grads; a send thread merges and flushes)."""
+
+    def __init__(self, client: PsClient, flush_interval: float = 0.05,
+                 max_pending: int = 64):
+        self.client = client
+        self.interval = flush_interval
+        self._pending: List[tuple] = []
+        self._cv = threading.Condition()
+        self._stop = False
+        self._max = max_pending
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def push_sparse_async(self, table_id: int, ids, grads) -> None:
+        with self._cv:
+            self._pending.append((table_id, np.asarray(ids, np.uint64),
+                                  np.asarray(grads, np.float32)))
+            if len(self._pending) >= self._max:
+                self._cv.notify()
+
+    def _loop(self):
+        while True:
+            with self._cv:
+                self._cv.wait(timeout=self.interval)
+                batch, self._pending = self._pending, []
+                stop = self._stop
+            self._flush(batch)
+            if stop:
+                return
+
+    def _flush(self, batch):
+        by_table: Dict[int, list] = {}
+        for tid, ids, grads in batch:
+            by_table.setdefault(tid, []).append((ids, grads))
+        for tid, items in by_table.items():
+            ids = np.concatenate([i.reshape(-1) for i, _ in items])
+            dim = self.client._dim(tid)
+            grads = np.concatenate([g.reshape(-1, dim) for _, g in items])
+            self.client.push_sparse(tid, ids, grads)
+
+    def flush(self) -> None:
+        with self._cv:
+            batch, self._pending = self._pending, []
+        self._flush(batch)
+
+    def stop(self) -> None:
+        with self._cv:
+            self._stop = True
+            self._cv.notify()
+        self._thread.join(timeout=5.0)
+        self.flush()
+
+
+class SparseEmbedding:
+    """Distributed embedding lookup backed by the PS.
+
+    Forward pulls rows for the batch's ids; backward pushes the row grads
+    (optimizer rule applies server-side) — the reference's distributed
+    lookup-table path (``pscore`` ops + ``communicator``). Use inside eager
+    training; the dense model below it trains with a normal optimizer.
+    """
+
+    def __init__(self, client: PsClient, table_id: int, dim: int,
+                 communicator: Optional[AsyncCommunicator] = None,
+                 rule: str = "adagrad", lr: float = 0.05,
+                 init_range: float = 0.05):
+        self.client = client
+        self.table_id = table_id
+        self.dim = dim
+        self.comm = communicator
+        if table_id not in client._tables:
+            client.create_table(TableConfig(table_id, dim, rule=rule, lr=lr,
+                                            init_range=init_range))
+
+    def __call__(self, ids):
+        from ...core.autograd import GradNode, is_grad_enabled
+        from ...core.tensor import Tensor
+        import jax.numpy as jnp
+
+        ids_np = np.asarray(
+            ids.numpy() if isinstance(ids, Tensor) else ids)
+        flat = ids_np.reshape(-1)
+        rows = self.client.pull_sparse(self.table_id, flat)
+        out_np = rows.reshape(ids_np.shape + (self.dim,))
+        val = jnp.asarray(out_np)
+        if not is_grad_enabled():
+            return Tensor(val)
+
+        client, comm, tid = self.client, self.comm, self.table_id
+
+        def vjp_fn(cotangents):
+            g = np.asarray(cotangents[0]).reshape(flat.size, self.dim)
+            if comm is not None:
+                comm.push_sparse_async(tid, flat, g)
+            else:
+                client.push_sparse(tid, flat, g)
+            return ()
+
+        node = GradNode("ps_embedding", vjp_fn, [], 1,
+                        [(val.shape, val.dtype)])
+        return Tensor(val, stop_gradient=False, _grad_node=node, _out_idx=0)
+
+
+# ---------------------------------------------------------------------------
+# fleet-style lifecycle driven by the launcher's env protocol
+# ---------------------------------------------------------------------------
+
+_server: Optional[PsServerHandle] = None
+_client: Optional[PsClient] = None
+
+
+def init_server(port: Optional[int] = None) -> PsServerHandle:
+    """Start this process's PS shard (ref ``fleet.init_server``)."""
+    global _server
+    if _server is None:
+        p = port if port is not None else int(os.environ.get("PADDLE_PORT", 0))
+        _server = PsServerHandle(p)
+    return _server
+
+
+def run_server() -> None:
+    """Serve until terminated (ref ``fleet.run_server`` blocking loop)."""
+    srv = init_server()
+    try:
+        while srv._h:
+            time.sleep(0.5)
+    except KeyboardInterrupt:
+        pass
+
+
+def stop_server() -> None:
+    global _server
+    if _server is not None:
+        _server.stop()
+        _server = None
+
+
+def init_worker(endpoints: Optional[Sequence[str]] = None) -> PsClient:
+    """Connect this trainer to all PS shards (ref ``fleet.init_worker``)."""
+    global _client
+    if _client is None:
+        eps = (list(endpoints) if endpoints is not None else
+               os.environ.get("PADDLE_PSERVER_ENDPOINTS", "").split(","))
+        eps = [e for e in eps if e]
+        if not eps:
+            raise RuntimeError("no PS endpoints: set PADDLE_PSERVER_ENDPOINTS "
+                               "or pass endpoints=")
+        _client = PsClient(eps)
+    return _client
+
+
+def get_client() -> Optional[PsClient]:
+    return _client
+
+
+def shutdown() -> None:
+    global _client
+    if _client is not None:
+        _client.close()
+        _client = None
+    stop_server()

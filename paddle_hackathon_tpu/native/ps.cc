@@ -1,0 +1,763 @@
+// Native parameter-server core.
+//
+// TPU-native counterpart of the reference's C++ PS
+// (paddle/fluid/distributed/ps: brpc_ps_server.cc / brpc_ps_client.cc,
+// tables memory_sparse_table.cc + memory_dense_table.cc, update rules
+// sparse_sgd_rule.cc, CTR accessor ctr_accessor.cc). Brand-new design:
+// a plain-TCP request/response protocol (no brpc), sharded in-memory
+// sparse tables with server-side optimizer rules, thread-per-connection.
+//
+// The dense compute path stays on the accelerator via XLA; this server owns
+// the host-resident sparse state (massive embedding tables) that does not
+// fit or belong in HBM — the same division of labor the reference's
+// CPU-PS + GPU-trainer "heter" mode uses.
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+#define PHT_API extern "C" __attribute__((visibility("default")))
+
+namespace {
+
+// ---------------------------------------------------------------- io utils
+bool read_full(int fd, void* dst, size_t n) {
+  auto* p = static_cast<uint8_t*>(dst);
+  while (n > 0) {
+    ssize_t r = ::recv(fd, p, n, 0);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool write_full(int fd, const void* src, size_t n) {
+  auto* p = static_cast<const uint8_t*>(src);
+  while (n > 0) {
+    ssize_t r = ::send(fd, p, n, MSG_NOSIGNAL);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+// ------------------------------------------------------------------ tables
+enum Rule : uint8_t { kSGD = 0, kAdagrad = 1 };
+
+enum Op : uint8_t {
+  opCreate = 1,
+  opPullSparse = 2,
+  opPushSparse = 3,
+  opPullDense = 4,
+  opPushDense = 5,
+  opSetDense = 6,
+  opSave = 7,
+  opLoad = 8,
+  opStats = 9,
+  opShrink = 10,
+  opPushShowClick = 11,
+  opBarrier = 12,
+};
+
+// deterministic per-id init in (-range, range): splitmix64 hash
+float init_val(uint64_t id, uint32_t j, float range) {
+  uint64_t z = id * 0x9E3779B97F4A7C15ull + j + 1;
+  z = (z ^ (z >> 30)) * 0xBF58476D1CE4E5B9ull;
+  z = (z ^ (z >> 27)) * 0x94D049BB133111EBull;
+  z = z ^ (z >> 31);
+  double u = static_cast<double>(z >> 11) / 9007199254740992.0;  // [0,1)
+  return static_cast<float>((u * 2.0 - 1.0) * range);
+}
+
+struct Row {
+  std::vector<float> w;      // dim weights
+  std::vector<float> slot;   // adagrad: accumulated g^2 (dim), else empty
+  float show = 0.f, click = 0.f;  // CTR accessor counters
+  uint32_t unseen = 0;            // shrink: rounds since last pull
+};
+
+struct SparseShard {
+  std::mutex mu;
+  std::unordered_map<uint64_t, Row> rows;
+};
+
+constexpr int kShards = 32;
+
+struct Table {
+  uint32_t dim = 0;
+  Rule rule = kSGD;
+  float lr = 0.01f;
+  float init_range = 0.01f;
+  bool dense = false;
+
+  // dense
+  std::mutex dmu;
+  std::vector<float> dense_w;
+  std::vector<float> dense_slot;
+
+  SparseShard shards[kShards];
+
+  SparseShard& shard(uint64_t id) {
+    return shards[(id * 0x9E3779B97F4A7C15ull >> 58) & (kShards - 1)];
+  }
+
+  Row& row(SparseShard& s, uint64_t id) {  // caller holds s.mu
+    auto it = s.rows.find(id);
+    if (it == s.rows.end()) {
+      Row r;
+      r.w.resize(dim);
+      for (uint32_t j = 0; j < dim; j++) r.w[j] = init_val(id, j, init_range);
+      if (rule == kAdagrad) r.slot.assign(dim, 0.f);
+      it = s.rows.emplace(id, std::move(r)).first;
+    }
+    return it->second;
+  }
+
+  void apply(float* w, float* slot, const float* g) {
+    switch (rule) {
+      case kSGD:
+        for (uint32_t j = 0; j < dim; j++) w[j] -= lr * g[j];
+        break;
+      case kAdagrad:
+        for (uint32_t j = 0; j < dim; j++) {
+          slot[j] += g[j] * g[j];
+          w[j] -= lr * g[j] / (std::sqrt(slot[j]) + 1e-6f);
+        }
+        break;
+    }
+  }
+
+  uint64_t nkeys() {
+    uint64_t n = 0;
+    for (auto& s : shards) {
+      std::lock_guard<std::mutex> g(s.mu);
+      n += s.rows.size();
+    }
+    return n;
+  }
+};
+
+struct PsServer {
+  int listen_fd = -1;
+  int port = 0;
+  std::thread accept_thread;
+  std::vector<std::thread> handlers;
+  std::mutex handlers_mu;
+  std::atomic<bool> stopping{false};
+
+  std::mutex tables_mu;
+  std::unordered_map<uint32_t, Table*> tables;
+
+  std::mutex barrier_mu;
+  std::unordered_map<std::string, int> barrier_counts;
+
+  ~PsServer() {
+    for (auto& kv : tables) delete kv.second;
+  }
+
+  Table* table(uint32_t id) {
+    std::lock_guard<std::mutex> g(tables_mu);
+    auto it = tables.find(id);
+    return it == tables.end() ? nullptr : it->second;
+  }
+
+  bool start(int want_port) {
+    listen_fd = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (listen_fd < 0) return false;
+    int one = 1;
+    ::setsockopt(listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = htonl(INADDR_ANY);
+    addr.sin_port = htons(static_cast<uint16_t>(want_port));
+    if (::bind(listen_fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) <
+        0)
+      return false;
+    if (::listen(listen_fd, 256) < 0) return false;
+    socklen_t len = sizeof(addr);
+    ::getsockname(listen_fd, reinterpret_cast<sockaddr*>(&addr), &len);
+    port = ntohs(addr.sin_port);
+    accept_thread = std::thread([this] { accept_loop(); });
+    return true;
+  }
+
+  void accept_loop() {
+    for (;;) {
+      int fd = ::accept(listen_fd, nullptr, nullptr);
+      if (fd < 0) {
+        if (stopping.load()) return;
+        continue;
+      }
+      int one = 1;
+      ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      std::lock_guard<std::mutex> g(handlers_mu);
+      handlers.emplace_back([this, fd] { handle(fd); });
+    }
+  }
+
+  void handle(int fd);
+
+  bool save(const std::string& path);
+  bool load_file(const std::string& path);
+
+  void shutdown() {
+    stopping = true;
+    ::shutdown(listen_fd, SHUT_RDWR);
+    ::close(listen_fd);
+    if (accept_thread.joinable()) accept_thread.join();
+    std::lock_guard<std::mutex> g(handlers_mu);
+    for (auto& t : handlers)
+      if (t.joinable()) t.detach();
+    handlers.clear();
+  }
+};
+
+void PsServer::handle(int fd) {
+  for (;;) {
+    uint8_t op;
+    if (!read_full(fd, &op, 1)) break;
+    uint32_t tid;
+    if (!read_full(fd, &tid, 4)) break;
+
+    if (op == opCreate) {
+      struct {
+        uint32_t dim;
+        uint8_t rule;
+        uint8_t dense;
+        float lr;
+        float init_range;
+      } __attribute__((packed)) req;
+      if (!read_full(fd, &req, sizeof(req))) break;
+      uint8_t ok = 1;
+      {
+        // idempotent: every worker declares the same tables at init
+        // (ref the_one_ps worker init); first declaration wins, a
+        // conflicting respec of a live table is rejected
+        std::lock_guard<std::mutex> g(tables_mu);
+        auto it = tables.find(tid);
+        if (it != tables.end()) {
+          Table* old = it->second;
+          ok = (old->dim == req.dim && old->rule == req.rule &&
+                old->dense == (req.dense != 0))
+                   ? 1
+                   : 0;
+        } else {
+          auto* t = new Table();
+          t->dim = req.dim;
+          t->rule = static_cast<Rule>(req.rule);
+          t->dense = req.dense != 0;
+          t->lr = req.lr;
+          t->init_range = req.init_range;
+          if (t->dense) {
+            t->dense_w.resize(req.dim, 0.f);
+            if (t->rule == kAdagrad) t->dense_slot.assign(req.dim, 0.f);
+          }
+          tables[tid] = t;
+        }
+      }
+      if (!write_full(fd, &ok, 1)) break;
+
+    } else if (op == opPullSparse || op == opPushSparse) {
+      uint32_t n;
+      if (!read_full(fd, &n, 4)) break;
+      std::vector<uint64_t> ids(n);
+      if (n && !read_full(fd, ids.data(), 8ull * n)) break;
+      Table* t = table(tid);
+      if (op == opPullSparse) {
+        uint32_t dim = t ? t->dim : 0;
+        std::vector<float> out(static_cast<size_t>(n) * dim);
+        if (t) {
+          for (uint32_t i = 0; i < n; i++) {
+            auto& s = t->shard(ids[i]);
+            std::lock_guard<std::mutex> g(s.mu);
+            Row& r = t->row(s, ids[i]);
+            r.unseen = 0;
+            std::memcpy(&out[static_cast<size_t>(i) * dim], r.w.data(),
+                        sizeof(float) * dim);
+          }
+        }
+        if (!write_full(fd, &dim, 4)) break;
+        if (!out.empty() &&
+            !write_full(fd, out.data(), out.size() * sizeof(float)))
+          break;
+      } else {
+        // client frames its dim so the wire never desyncs on a dim
+        // mismatch or a missing table — always drain n*dim floats
+        uint32_t dim;
+        if (!read_full(fd, &dim, 4)) break;
+        std::vector<float> grads(static_cast<size_t>(n) * dim);
+        if (!grads.empty() &&
+            !read_full(fd, grads.data(), grads.size() * sizeof(float)))
+          break;
+        bool match = t && dim == t->dim;
+        if (match) {
+          for (uint32_t i = 0; i < n; i++) {
+            auto& s = t->shard(ids[i]);
+            std::lock_guard<std::mutex> g(s.mu);
+            Row& r = t->row(s, ids[i]);
+            t->apply(r.w.data(), r.slot.empty() ? nullptr : r.slot.data(),
+                     &grads[static_cast<size_t>(i) * dim]);
+          }
+        }
+        uint8_t ok = match ? 1 : 0;
+        if (!write_full(fd, &ok, 1)) break;
+      }
+
+    } else if (op == opPullDense) {
+      Table* t = table(tid);
+      uint32_t len = (t && t->dense) ? t->dim : 0;
+      if (!write_full(fd, &len, 4)) break;
+      if (len) {
+        std::lock_guard<std::mutex> g(t->dmu);
+        if (!write_full(fd, t->dense_w.data(), sizeof(float) * len)) break;
+      }
+
+    } else if (op == opPushDense || op == opSetDense) {
+      uint32_t n;
+      if (!read_full(fd, &n, 4)) break;
+      std::vector<float> vals(n);
+      if (n && !read_full(fd, vals.data(), sizeof(float) * n)) break;
+      Table* t = table(tid);
+      uint8_t ok = 0;
+      if (t && t->dense && n == t->dim) {
+        std::lock_guard<std::mutex> g(t->dmu);
+        if (op == opSetDense) {
+          t->dense_w = vals;
+        } else {
+          t->apply(t->dense_w.data(),
+                   t->dense_slot.empty() ? nullptr : t->dense_slot.data(),
+                   vals.data());
+        }
+        ok = 1;
+      }
+      if (!write_full(fd, &ok, 1)) break;
+
+    } else if (op == opPushShowClick) {
+      uint32_t n;
+      if (!read_full(fd, &n, 4)) break;
+      std::vector<uint64_t> ids(n);
+      std::vector<float> shows(n), clicks(n);
+      if (n && (!read_full(fd, ids.data(), 8ull * n) ||
+                !read_full(fd, shows.data(), 4ull * n) ||
+                !read_full(fd, clicks.data(), 4ull * n)))
+        break;
+      Table* t = table(tid);
+      if (t) {
+        for (uint32_t i = 0; i < n; i++) {
+          auto& s = t->shard(ids[i]);
+          std::lock_guard<std::mutex> g(s.mu);
+          Row& r = t->row(s, ids[i]);
+          r.show += shows[i];
+          r.click += clicks[i];
+        }
+      }
+      uint8_t ok = t ? 1 : 0;
+      if (!write_full(fd, &ok, 1)) break;
+
+    } else if (op == opStats) {
+      Table* t = table(tid);
+      uint64_t n = t ? t->nkeys() : 0;
+      uint64_t bytes =
+          t ? n * (sizeof(Row) + sizeof(float) * t->dim *
+                                     (t->rule == kAdagrad ? 2 : 1))
+            : 0;
+      if (!write_full(fd, &n, 8)) break;
+      if (!write_full(fd, &bytes, 8)) break;
+
+    } else if (op == opShrink) {
+      // age-based shrink (ref memory_sparse_table shrink by unseen_days):
+      // drop rows not pulled in the last `max_unseen` shrink rounds
+      uint32_t max_unseen;
+      if (!read_full(fd, &max_unseen, 4)) break;
+      Table* t = table(tid);
+      uint64_t dropped = 0;
+      if (t) {
+        for (auto& s : t->shards) {
+          std::lock_guard<std::mutex> g(s.mu);
+          for (auto it = s.rows.begin(); it != s.rows.end();) {
+            if (++it->second.unseen > max_unseen) {
+              it = s.rows.erase(it);
+              dropped++;
+            } else {
+              ++it;
+            }
+          }
+        }
+      }
+      if (!write_full(fd, &dropped, 8)) break;
+
+    } else if (op == opSave || op == opLoad) {
+      uint32_t plen;
+      if (!read_full(fd, &plen, 4)) break;
+      std::string path(plen, '\0');
+      if (plen && !read_full(fd, &path[0], plen)) break;
+      uint8_t ok = (op == opSave) ? save(path) : load_file(path);
+      if (!write_full(fd, &ok, 1)) break;
+
+    } else if (op == opBarrier) {
+      // tid = world size; payload: name
+      uint32_t plen;
+      if (!read_full(fd, &plen, 4)) break;
+      std::string name(plen, '\0');
+      if (plen && !read_full(fd, &name[0], plen)) break;
+      {
+        std::unique_lock<std::mutex> lk(barrier_mu);
+        barrier_counts[name]++;
+      }
+      // poll until count reaches world (simple, connection-held barrier)
+      uint8_t ok = 0;
+      for (int spins = 0; spins < 600000; spins++) {
+        {
+          std::lock_guard<std::mutex> lk(barrier_mu);
+          if (barrier_counts[name] >= static_cast<int>(tid)) {
+            ok = 1;
+            break;
+          }
+        }
+        std::this_thread::sleep_for(std::chrono::milliseconds(1));
+      }
+      if (!write_full(fd, &ok, 1)) break;
+
+    } else {
+      break;
+    }
+  }
+  ::close(fd);
+}
+
+// binary snapshot: [u32 ntables]{u32 tid,u32 dim,u8 rule,u8 dense,f32 lr,
+// f32 range, dense?{f32 w[dim] f32 slot[dim]} :
+// {u64 nrows}{u64 id,f32 w[dim],f32 slot[dim or 0],f32 show,f32 click}}
+bool PsServer::save(const std::string& path) {
+  FILE* f = std::fopen(path.c_str(), "wb");
+  if (!f) return false;
+  std::lock_guard<std::mutex> tg(tables_mu);
+  uint32_t nt = tables.size();
+  std::fwrite(&nt, 4, 1, f);
+  for (auto& kv : tables) {
+    Table* t = kv.second;
+    uint32_t tid = kv.first;
+    uint8_t rule = t->rule, dense = t->dense ? 1 : 0;
+    std::fwrite(&tid, 4, 1, f);
+    std::fwrite(&t->dim, 4, 1, f);
+    std::fwrite(&rule, 1, 1, f);
+    std::fwrite(&dense, 1, 1, f);
+    std::fwrite(&t->lr, 4, 1, f);
+    std::fwrite(&t->init_range, 4, 1, f);
+    if (t->dense) {
+      std::lock_guard<std::mutex> g(t->dmu);
+      std::fwrite(t->dense_w.data(), 4, t->dim, f);
+      std::vector<float> slot = t->dense_slot;
+      slot.resize(t->dim, 0.f);
+      std::fwrite(slot.data(), 4, t->dim, f);
+    } else {
+      uint64_t nrows = t->nkeys();
+      std::fwrite(&nrows, 8, 1, f);
+      uint32_t slot_dim = (t->rule == kAdagrad) ? t->dim : 0;
+      for (auto& s : t->shards) {
+        std::lock_guard<std::mutex> g(s.mu);
+        for (auto& rkv : s.rows) {
+          std::fwrite(&rkv.first, 8, 1, f);
+          std::fwrite(rkv.second.w.data(), 4, t->dim, f);
+          if (slot_dim) std::fwrite(rkv.second.slot.data(), 4, slot_dim, f);
+          std::fwrite(&rkv.second.show, 4, 1, f);
+          std::fwrite(&rkv.second.click, 4, 1, f);
+        }
+      }
+    }
+  }
+  std::fclose(f);
+  return true;
+}
+
+bool PsServer::load_file(const std::string& path) {
+  FILE* f = std::fopen(path.c_str(), "rb");
+  if (!f) return false;
+  uint32_t nt;
+  if (std::fread(&nt, 4, 1, f) != 1) {
+    std::fclose(f);
+    return false;
+  }
+  bool ok = true;
+  std::lock_guard<std::mutex> tg(tables_mu);
+  for (uint32_t ti = 0; ti < nt && ok; ti++) {
+    uint32_t tid, dim;
+    uint8_t rule, dense;
+    float lr, range;
+    ok = std::fread(&tid, 4, 1, f) == 1 && std::fread(&dim, 4, 1, f) == 1 &&
+         std::fread(&rule, 1, 1, f) == 1 &&
+         std::fread(&dense, 1, 1, f) == 1 && std::fread(&lr, 4, 1, f) == 1 &&
+         std::fread(&range, 4, 1, f) == 1;
+    if (!ok) break;
+    auto* t = new Table();
+    t->dim = dim;
+    t->rule = static_cast<Rule>(rule);
+    t->dense = dense != 0;
+    t->lr = lr;
+    t->init_range = range;
+    if (t->dense) {
+      t->dense_w.resize(dim);
+      t->dense_slot.resize(dim);
+      ok = std::fread(t->dense_w.data(), 4, dim, f) == dim &&
+           std::fread(t->dense_slot.data(), 4, dim, f) == dim;
+      if (t->rule != kAdagrad) t->dense_slot.clear();
+    } else {
+      uint64_t nrows;
+      ok = std::fread(&nrows, 8, 1, f) == 1;
+      uint32_t slot_dim = (t->rule == kAdagrad) ? dim : 0;
+      for (uint64_t i = 0; i < nrows && ok; i++) {
+        uint64_t id;
+        Row r;
+        r.w.resize(dim);
+        ok = std::fread(&id, 8, 1, f) == 1 &&
+             std::fread(r.w.data(), 4, dim, f) == dim;
+        if (ok && slot_dim) {
+          r.slot.resize(slot_dim);
+          ok = std::fread(r.slot.data(), 4, slot_dim, f) == slot_dim;
+        }
+        if (ok)
+          ok = std::fread(&r.show, 4, 1, f) == 1 &&
+               std::fread(&r.click, 4, 1, f) == 1;
+        if (ok) {
+          auto& s = t->shard(id);
+          std::lock_guard<std::mutex> g(s.mu);
+          s.rows.emplace(id, std::move(r));
+        }
+      }
+    }
+    if (ok) {
+      auto it = tables.find(tid);
+      if (it != tables.end()) delete it->second;
+      tables[tid] = t;
+    } else {
+      delete t;
+    }
+  }
+  std::fclose(f);
+  return ok;
+}
+
+// ------------------------------------------------------------------ client
+struct PsClient {
+  int fd = -1;
+  bool rpc_hdr(uint8_t op, uint32_t tid) {
+    return write_full(fd, &op, 1) && write_full(fd, &tid, 4);
+  }
+};
+
+}  // namespace
+
+// ------------------------------------------------------------------ C API
+
+PHT_API void* pht_ps_server_start(int32_t port) {
+  auto* s = new PsServer();
+  if (!s->start(port)) {
+    delete s;
+    return nullptr;
+  }
+  return s;
+}
+
+PHT_API int32_t pht_ps_server_port(void* h) {
+  return static_cast<PsServer*>(h)->port;
+}
+
+PHT_API void pht_ps_server_stop(void* h) {
+  auto* s = static_cast<PsServer*>(h);
+  s->shutdown();
+  delete s;
+}
+
+PHT_API void* pht_ps_connect(const char* host, int32_t port,
+                             int32_t timeout_ms) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return nullptr;
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  ::inet_pton(AF_INET, host, &addr.sin_addr);
+  int deadline = timeout_ms;
+  while (::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0) {
+    if (deadline <= 0) {
+      ::close(fd);
+      return nullptr;
+    }
+    ::usleep(50 * 1000);
+    deadline -= 50;
+    ::close(fd);
+    fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  }
+  int one = 1;
+  ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  auto* c = new PsClient();
+  c->fd = fd;
+  return c;
+}
+
+PHT_API void pht_ps_disconnect(void* h) {
+  auto* c = static_cast<PsClient*>(h);
+  ::close(c->fd);
+  delete c;
+}
+
+PHT_API int32_t pht_ps_create_table(void* h, uint32_t tid, uint32_t dim,
+                                    uint8_t rule, uint8_t dense, float lr,
+                                    float init_range) {
+  auto* c = static_cast<PsClient*>(h);
+  struct {
+    uint32_t dim;
+    uint8_t rule;
+    uint8_t dense;
+    float lr;
+    float init_range;
+  } __attribute__((packed)) req{dim, rule, dense, lr, init_range};
+  if (!c->rpc_hdr(opCreate, tid) || !write_full(c->fd, &req, sizeof(req)))
+    return -1;
+  uint8_t ok;
+  if (!read_full(c->fd, &ok, 1)) return -1;
+  return ok ? 0 : -1;
+}
+
+PHT_API int32_t pht_ps_pull_sparse(void* h, uint32_t tid, const uint64_t* ids,
+                                   uint32_t n, float* out, uint32_t out_dim) {
+  auto* c = static_cast<PsClient*>(h);
+  if (!c->rpc_hdr(opPullSparse, tid) || !write_full(c->fd, &n, 4) ||
+      (n && !write_full(c->fd, ids, 8ull * n)))
+    return -1;
+  uint32_t dim;
+  if (!read_full(c->fd, &dim, 4)) return -1;
+  if (dim == 0) return -2;  // no such table
+  std::vector<float> buf(static_cast<size_t>(n) * dim);
+  if (n && !read_full(c->fd, buf.data(), buf.size() * sizeof(float)))
+    return -1;
+  if (dim != out_dim) return -3;
+  std::memcpy(out, buf.data(), buf.size() * sizeof(float));
+  return static_cast<int32_t>(dim);
+}
+
+PHT_API int32_t pht_ps_push_sparse(void* h, uint32_t tid,
+                                   const uint64_t* ids, uint32_t n,
+                                   const float* grads, uint32_t dim) {
+  auto* c = static_cast<PsClient*>(h);
+  if (!c->rpc_hdr(opPushSparse, tid) || !write_full(c->fd, &n, 4) ||
+      (n && !write_full(c->fd, ids, 8ull * n)) ||
+      !write_full(c->fd, &dim, 4) ||
+      (n && !write_full(c->fd, grads, sizeof(float) * n * dim)))
+    return -1;
+  uint8_t ok;
+  if (!read_full(c->fd, &ok, 1)) return -1;
+  return ok ? 0 : -2;
+}
+
+PHT_API int32_t pht_ps_pull_dense(void* h, uint32_t tid, float* out,
+                                  uint32_t cap) {
+  auto* c = static_cast<PsClient*>(h);
+  if (!c->rpc_hdr(opPullDense, tid)) return -1;
+  uint32_t len;
+  if (!read_full(c->fd, &len, 4)) return -1;
+  if (len == 0) return -2;
+  std::vector<float> buf(len);
+  if (!read_full(c->fd, buf.data(), sizeof(float) * len)) return -1;
+  if (len > cap) return -3;
+  std::memcpy(out, buf.data(), sizeof(float) * len);
+  return static_cast<int32_t>(len);
+}
+
+static int32_t push_dense_impl(PsClient* c, uint8_t op, uint32_t tid,
+                               const float* vals, uint32_t n) {
+  if (!c->rpc_hdr(op, tid) || !write_full(c->fd, &n, 4) ||
+      (n && !write_full(c->fd, vals, sizeof(float) * n)))
+    return -1;
+  uint8_t ok;
+  if (!read_full(c->fd, &ok, 1)) return -1;
+  return ok ? 0 : -2;
+}
+
+PHT_API int32_t pht_ps_push_dense(void* h, uint32_t tid, const float* g,
+                                  uint32_t n) {
+  return push_dense_impl(static_cast<PsClient*>(h), opPushDense, tid, g, n);
+}
+
+PHT_API int32_t pht_ps_set_dense(void* h, uint32_t tid, const float* v,
+                                 uint32_t n) {
+  return push_dense_impl(static_cast<PsClient*>(h), opSetDense, tid, v, n);
+}
+
+PHT_API int32_t pht_ps_push_show_click(void* h, uint32_t tid,
+                                       const uint64_t* ids, uint32_t n,
+                                       const float* shows,
+                                       const float* clicks) {
+  auto* c = static_cast<PsClient*>(h);
+  if (!c->rpc_hdr(opPushShowClick, tid) || !write_full(c->fd, &n, 4) ||
+      (n && (!write_full(c->fd, ids, 8ull * n) ||
+             !write_full(c->fd, shows, 4ull * n) ||
+             !write_full(c->fd, clicks, 4ull * n))))
+    return -1;
+  uint8_t ok;
+  if (!read_full(c->fd, &ok, 1)) return -1;
+  return ok ? 0 : -2;
+}
+
+PHT_API int64_t pht_ps_table_nkeys(void* h, uint32_t tid) {
+  auto* c = static_cast<PsClient*>(h);
+  if (!c->rpc_hdr(opStats, tid)) return -1;
+  uint64_t n, bytes;
+  if (!read_full(c->fd, &n, 8) || !read_full(c->fd, &bytes, 8)) return -1;
+  return static_cast<int64_t>(n);
+}
+
+PHT_API int64_t pht_ps_shrink(void* h, uint32_t tid, uint32_t max_unseen) {
+  auto* c = static_cast<PsClient*>(h);
+  if (!c->rpc_hdr(opShrink, tid) || !write_full(c->fd, &max_unseen, 4))
+    return -1;
+  uint64_t dropped;
+  if (!read_full(c->fd, &dropped, 8)) return -1;
+  return static_cast<int64_t>(dropped);
+}
+
+static int32_t path_op(PsClient* c, uint8_t op, const char* path) {
+  uint32_t plen = std::strlen(path);
+  if (!c->rpc_hdr(op, 0) || !write_full(c->fd, &plen, 4) ||
+      !write_full(c->fd, path, plen))
+    return -1;
+  uint8_t ok;
+  if (!read_full(c->fd, &ok, 1)) return -1;
+  return ok ? 0 : -2;
+}
+
+PHT_API int32_t pht_ps_save(void* h, const char* path) {
+  return path_op(static_cast<PsClient*>(h), opSave, path);
+}
+
+PHT_API int32_t pht_ps_load(void* h, const char* path) {
+  return path_op(static_cast<PsClient*>(h), opLoad, path);
+}
+
+PHT_API int32_t pht_ps_barrier(void* h, const char* name, uint32_t world,
+                               int32_t timeout_ms) {
+  (void)timeout_ms;  // server bounds the wait
+  auto* c = static_cast<PsClient*>(h);
+  uint32_t plen = std::strlen(name);
+  if (!c->rpc_hdr(opBarrier, world) || !write_full(c->fd, &plen, 4) ||
+      !write_full(c->fd, name, plen))
+    return -1;
+  uint8_t ok;
+  if (!read_full(c->fd, &ok, 1)) return -1;
+  return ok ? 0 : -2;
+}

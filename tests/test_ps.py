@@ -1,0 +1,220 @@
+"""Parameter-server tests (ref ps/table + brpc client/server behavior;
+multi-process trainer flow mirrors test_dist_base.py's in-host pattern)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from paddle_hackathon_tpu.distributed import ps as psmod
+from paddle_hackathon_tpu.distributed.ps import (AsyncCommunicator, PsClient,
+                                                 PsServerHandle,
+                                                 SparseEmbedding, TableConfig)
+
+
+@pytest.fixture()
+def cluster():
+    """Two in-process PS shards + one client."""
+    try:
+        servers = [PsServerHandle(), PsServerHandle()]
+    except RuntimeError:
+        pytest.skip("native PS unavailable")
+    client = PsClient([f"127.0.0.1:{s.port}" for s in servers])
+    yield client
+    client.close()
+    for s in servers:
+        s.stop()
+
+
+class TestTables:
+    def test_sparse_pull_deterministic_init(self, cluster):
+        cluster.create_table(TableConfig(1, dim=8, rule="sgd", lr=0.1,
+                                         init_range=0.5))
+        ids = np.array([3, 7, 3, 12345678901], np.uint64)
+        a = cluster.pull_sparse(1, ids)
+        b = cluster.pull_sparse(1, ids)
+        np.testing.assert_array_equal(a, b)     # stable init
+        np.testing.assert_array_equal(a[0], a[2])  # same id, same row
+        assert np.abs(a).max() <= 0.5
+        assert cluster.table_nkeys(1) == 3
+
+    def test_sparse_sgd_update(self, cluster):
+        cluster.create_table(TableConfig(2, dim=4, rule="sgd", lr=0.5,
+                                         init_range=0.0))
+        ids = np.array([10, 11], np.uint64)
+        w0 = cluster.pull_sparse(2, ids)
+        g = np.ones((2, 4), np.float32)
+        cluster.push_sparse(2, ids, g)
+        w1 = cluster.pull_sparse(2, ids)
+        np.testing.assert_allclose(w1, w0 - 0.5 * g, rtol=1e-6)
+
+    def test_duplicate_ids_aggregate(self, cluster):
+        cluster.create_table(TableConfig(3, dim=2, rule="sgd", lr=1.0,
+                                         init_range=0.0))
+        ids = np.array([5, 5, 5], np.uint64)
+        g = np.ones((3, 2), np.float32)
+        cluster.push_sparse(3, ids, g)  # aggregated to one update of 3.0
+        w = cluster.pull_sparse(3, np.array([5], np.uint64))
+        np.testing.assert_allclose(w, -3.0 * np.ones((1, 2)), rtol=1e-6)
+
+    def test_adagrad_rule(self, cluster):
+        cluster.create_table(TableConfig(4, dim=2, rule="adagrad", lr=1.0,
+                                         init_range=0.0))
+        ids = np.array([1], np.uint64)
+        g = np.full((1, 2), 2.0, np.float32)
+        cluster.push_sparse(4, ids, g)
+        w = cluster.pull_sparse(4, ids)
+        # w = 0 - 1.0 * 2 / (sqrt(4) + 1e-6) = -1.0
+        np.testing.assert_allclose(w, -1.0 * np.ones((1, 2)), rtol=1e-4)
+
+    def test_dense_table(self, cluster):
+        cluster.create_table(TableConfig(5, dim=6, rule="sgd", lr=0.1,
+                                         dense=True))
+        cluster.set_dense(5, np.arange(6, dtype=np.float32))
+        v = cluster.pull_dense(5)
+        np.testing.assert_array_equal(v, np.arange(6, dtype=np.float32))
+        cluster.push_dense(5, np.ones(6, np.float32))
+        np.testing.assert_allclose(cluster.pull_dense(5), v - 0.1, rtol=1e-6)
+
+    def test_show_click_and_shrink(self, cluster):
+        cluster.create_table(TableConfig(6, dim=2, rule="sgd"))
+        hot = np.array([100], np.uint64)
+        cold = np.array([200], np.uint64)
+        cluster.pull_sparse(6, np.concatenate([hot, cold]))
+        cluster.push_show_click(6, hot, [1.0], [1.0])
+        assert cluster.table_nkeys(6) == 2
+        # round 1: both aged; hot re-pulled to reset its age
+        assert cluster.shrink(6, max_unseen=1) == 0
+        cluster.pull_sparse(6, hot)
+        assert cluster.shrink(6, max_unseen=1) == 1  # cold dropped
+        assert cluster.table_nkeys(6) == 1
+
+    def test_save_load_roundtrip(self, cluster, tmp_path):
+        cluster.create_table(TableConfig(7, dim=3, rule="sgd", lr=0.1,
+                                         init_range=0.2))
+        ids = np.array([42, 43], np.uint64)
+        w = cluster.pull_sparse(7, ids)
+        cluster.push_sparse(7, ids, np.ones((2, 3), np.float32))
+        w1 = cluster.pull_sparse(7, ids)
+        d = str(tmp_path / "snap")
+        cluster.save(d)
+        cluster.push_sparse(7, ids, np.ones((2, 3), np.float32))
+        cluster.load(d)  # restore to snapshot state
+        np.testing.assert_allclose(cluster.pull_sparse(7, ids), w1, rtol=1e-6)
+
+    def test_table_spec_conflict_rejected(self, cluster):
+        cluster.create_table(TableConfig(8, dim=4))
+        with pytest.raises(RuntimeError):
+            cluster.create_table(TableConfig(8, dim=5))
+        # identical respec is idempotent
+        cluster.create_table(TableConfig(8, dim=4))
+
+
+class TestCommunicatorAndEmbedding:
+    def test_async_communicator_flush(self, cluster):
+        cluster.create_table(TableConfig(10, dim=2, rule="sgd", lr=1.0,
+                                         init_range=0.0))
+        comm = AsyncCommunicator(cluster, flush_interval=0.01)
+        ids = np.array([7], np.uint64)
+        comm.push_sparse_async(10, ids, np.ones((1, 2), np.float32))
+        comm.push_sparse_async(10, ids, np.ones((1, 2), np.float32))
+        comm.stop()
+        w = cluster.pull_sparse(10, ids)
+        np.testing.assert_allclose(w, -2.0 * np.ones((1, 2)), rtol=1e-6)
+
+    def test_sparse_embedding_train_converges(self, cluster):
+        """CTR-style slice: PS embedding + dense layer; the embedding learns
+        through server-side updates (the §3.5 train_from_dataset path)."""
+        import paddle_hackathon_tpu as paddle
+
+        paddle.seed(0)
+        emb = SparseEmbedding(cluster, table_id=20, dim=4, rule="sgd",
+                              lr=0.05, init_range=0.01)
+        ids = np.array([[1, 2], [3, 4]], np.int64)  # batch of 2, 2 slots
+        target = np.array([[1.0], [-1.0]], np.float32)
+
+        losses = []
+        for _ in range(60):
+            e = emb(ids)                      # [2, 2, 4]
+            pred = e.sum(axis=[1, 2]).reshape([2, 1])
+            loss = ((pred - paddle.to_tensor(target)) ** 2).mean()
+            loss.backward()
+            losses.append(float(loss))
+        assert losses[-1] < losses[0] * 0.05, (losses[0], losses[-1])
+
+    def test_barrier(self, cluster):
+        import threading
+        done = []
+
+        def worker(i):
+            cluster.barrier("b1", 2)
+            done.append(i)
+
+        ts = [threading.Thread(target=worker, args=(i,)) for i in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=10)
+        assert sorted(done) == [0, 1]
+
+
+class TestLifecycle:
+    def test_env_driven_server_worker(self, monkeypatch):
+        try:
+            srv = psmod.init_server(port=0)
+        except RuntimeError:
+            pytest.skip("native PS unavailable")
+        try:
+            monkeypatch.setenv("PADDLE_PSERVER_ENDPOINTS",
+                               f"127.0.0.1:{srv.port}")
+            cli = psmod.init_worker()
+            cli.create_table(TableConfig(1, dim=2))
+            assert cli.pull_sparse(1, np.array([1], np.uint64)).shape == (1, 2)
+        finally:
+            psmod.shutdown()
+
+
+class TestMultiProcessPs:
+    def test_launcher_ps_job_end_to_end(self, tmp_path):
+        """Full §3.5 flow: launcher spawns 2 PS servers + 2 trainers;
+        trainers do pull->compute->push and barrier; servers are reaped when
+        trainers finish (ref test_dist_base.py _run_cluster)."""
+        import textwrap
+        from paddle_hackathon_tpu.distributed.launch import launch
+
+        script = tmp_path / "ps_job.py"
+        script.write_text(textwrap.dedent("""
+            import os, sys
+            sys.path.insert(0, %r)
+            import numpy as np
+            from paddle_hackathon_tpu.distributed import ps
+
+            role = os.environ["PADDLE_ROLE"]
+            if role == "PSERVER":
+                ps.init_server()
+                ps.run_server()
+            else:
+                cli = ps.init_worker()
+                tid = int(os.environ["PADDLE_TRAINER_ID"])
+                world = int(os.environ["PADDLE_TRAINERS_NUM"])
+                cli.create_table(ps.TableConfig(1, dim=4, rule="sgd",
+                                                lr=0.5, init_range=0.0))
+                cli.barrier("init", world)
+                ids = np.array([100 + tid], np.uint64)
+                cli.push_sparse(1, ids, np.ones((1, 4), np.float32))
+                cli.barrier("pushed", world)
+                # every trainer sees every other trainer's row
+                all_ids = np.array([100, 101], np.uint64)
+                w = cli.pull_sparse(1, all_ids)
+                np.testing.assert_allclose(w, -0.5 * np.ones((2, 4)),
+                                           rtol=1e-6)
+                print("TRAINER_OK", tid)
+        """ % os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))))
+        rc = launch(["--run_mode", "ps", "--server_num", "2",
+                     "--trainer_num", "2", "--max_restart", "0",
+                     "--log_dir", str(tmp_path / "logs"),
+                     "--job_id", "psjob", str(script)])
+        logs = "".join(f.read_text()
+                       for f in sorted((tmp_path / "logs").iterdir()))
+        assert rc == 0, logs
+        assert logs.count("TRAINER_OK") == 2, logs
