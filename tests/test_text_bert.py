@@ -1,0 +1,137 @@
+"""BERT/ERNIE family + paddle.text datasets tests."""
+
+import numpy as np
+import pytest
+
+import paddle_hackathon_tpu as paddle
+from paddle_hackathon_tpu import text
+from paddle_hackathon_tpu.models import (BertConfig, BertForPretraining,
+                                         BertForSequenceClassification,
+                                         BertModel, ErnieModel, bert_config,
+                                         bert_param_sharding_spec)
+
+
+def _tiny(**kw):
+    base = dict(vocab_size=128, hidden_size=32, num_layers=2, num_heads=4,
+                max_position_embeddings=32, hidden_dropout_prob=0.0,
+                attention_dropout_prob=0.0, use_flash_attention=False)
+    base.update(kw)
+    return BertConfig(**base)
+
+
+class TestBert:
+    def test_trunk_shapes_and_padding_mask(self):
+        paddle.seed(0)
+        m = BertModel(_tiny())
+        m.eval()
+        ids = np.random.RandomState(0).randint(0, 128, (2, 16)).astype(np.int64)
+        mask = np.ones((2, 16), np.int64)
+        mask[1, 8:] = 0
+        seq, pooled = m(paddle.to_tensor(ids), attention_mask=mask)
+        assert seq.shape == [2, 16, 32] and pooled.shape == [2, 32]
+        # padded positions must not influence unpadded outputs: change padded
+        # tokens, outputs for row 1's visible prefix stay identical
+        ids2 = ids.copy()
+        ids2[1, 8:] = (ids2[1, 8:] + 1) % 128
+        seq2, _ = m(paddle.to_tensor(ids2), attention_mask=mask)
+        np.testing.assert_allclose(seq.numpy()[1, :8], seq2.numpy()[1, :8],
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_pretraining_loss_and_grads(self):
+        paddle.seed(1)
+        m = BertForPretraining(_tiny())
+        ids = np.random.RandomState(1).randint(0, 128, (2, 12)).astype(np.int64)
+        mlm = np.full((2, 12), -100)
+        mlm[:, 2] = 5
+        loss = m.loss(paddle.to_tensor(ids), mlm,
+                      paddle.to_tensor(np.array([0, 1], np.int64)))
+        loss.backward()
+        g = m.bert.embeddings.word_embeddings.weight.grad
+        assert g is not None and np.isfinite(g.numpy()).all()
+
+    def test_classifier_overfits_tiny_batch(self):
+        from paddle_hackathon_tpu.optimizer import Adam
+        paddle.seed(2)
+        m = BertForSequenceClassification(_tiny(), num_classes=2)
+        opt = Adam(learning_rate=1e-3, parameters=m.parameters())
+        ids = np.random.RandomState(3).randint(0, 128, (4, 8)).astype(np.int64)
+        y = paddle.to_tensor(np.array([0, 1, 0, 1], np.int64))
+        first = None
+        for _ in range(30):
+            loss = paddle.nn.functional.cross_entropy(
+                m(paddle.to_tensor(ids)), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            if first is None:
+                first = float(loss)
+        assert float(loss) < first * 0.5
+
+    def test_presets_and_ernie_alias(self):
+        cfg = bert_config("ernie-3.0-base-zh")
+        assert cfg.vocab_size == 40000 and cfg.type_vocab_size == 4
+        assert ErnieModel is BertModel
+        cfg2 = bert_config("ernie-1.0")
+        assert cfg2.hidden_act == "relu"
+
+    def test_sharding_spec(self):
+        assert bert_param_sharding_spec("encoder.0.attention.qkv_proj.weight",
+                                        (32, 96)) == (None, "mp")
+        assert bert_param_sharding_spec(
+            "bert.embeddings.word_embeddings.weight", (128, 32)) == ("mp", None)
+        assert bert_param_sharding_spec("encoder.0.ln_1.weight", (32,)) == \
+            (None,)
+
+
+class TestTextDatasets:
+    def test_uci_housing(self):
+        tr = text.UCIHousing(mode="train")
+        te = text.UCIHousing(mode="test")
+        assert len(tr) == 404 and len(te) == 102
+        x, y = tr[0]
+        assert x.shape == (13,) and y.shape == (1,)
+        x2, _ = text.UCIHousing(mode="train")[0]
+        np.testing.assert_array_equal(x, x2)  # deterministic
+
+    def test_imdb(self):
+        ds = text.Imdb(mode="train")
+        doc, label = ds[0]
+        assert doc.dtype == np.int64 and label in (0, 1)
+        assert "<unk>" in ds.word_idx
+        assert len(ds) == 1000
+
+    def test_imikolov_ngram_and_seq(self):
+        ng = text.Imikolov(data_type="NGRAM", window_size=5, mode="train")
+        assert len(ng[0]) == 5
+        seq = text.Imikolov(data_type="SEQ", mode="test")
+        assert seq[0].ndim == 1
+
+    def test_movielens(self):
+        ds = text.Movielens(mode="train")
+        item = ds[0]
+        assert len(item) == 8
+        assert 1 <= item[-1] <= 5
+
+    def test_conll05(self):
+        ds = text.Conll05st(mode="train")
+        words, pred, mark, labels = ds[0]
+        assert words.shape == mark.shape == labels.shape
+        assert mark.sum() == 1
+        wd, vd, ld = ds.get_dict()
+        assert len(ld) == 106
+
+    def test_wmt(self):
+        ds = text.WMT16(mode="train", src_dict_size=1000, trg_dict_size=800)
+        src, trg_in, trg_next = ds[0]
+        assert trg_in[0] == 0          # <s>
+        assert trg_next[-1] == 1       # <e>
+        np.testing.assert_array_equal(trg_in[1:], trg_next[:-1])
+        sd, td = ds.get_dict()
+        assert len(sd) == 1000 and len(td) == 800
+
+    def test_dataloader_integration(self):
+        from paddle_hackathon_tpu.io import DataLoader
+        ds = text.UCIHousing(mode="test")
+        dl = DataLoader(ds, batch_size=32, shuffle=False)
+        xb, yb = next(iter(dl))
+        assert list(xb.shape) == [32, 13] and list(yb.shape) == [32, 1]
