@@ -9,6 +9,7 @@ Ref ``python/paddle/incubate/``: fused transformer layers + functionals
 
 from . import asp, autograd, distributed, nn, optimizer  # noqa: F401
 from .optimizer import DistributedFusedLamb, LookAhead, ModelAverage  # noqa: F401
+from .. import sparse  # noqa: F401 — paddle.incubate.sparse surface
 
 
 def autotune(config=None):

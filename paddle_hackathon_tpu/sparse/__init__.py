@@ -1,0 +1,29 @@
+"""Sparse tensors: COO/CSR formats + sparse ops + SelectedRows.
+
+TPU-native counterpart of phi's sparse types and kernels
+(``paddle/phi/core/sparse_coo_tensor.h:30``, ``sparse_csr_tensor.h:33``,
+``paddle/phi/kernels/sparse/``) and the ``paddle.incubate.sparse`` python
+surface, plus ``SelectedRows`` (``paddle/phi/core/selected_rows.h:27``) —
+the rows+values sparse-gradient format embedding layers emit.
+
+Mechanism: formats hold static index structure (host numpy) alongside
+values that are framework Tensors, so sparse ops tape into the same
+autograd engine as dense ops (unary ops differentiate through values; spmm
+differentiates through both values and the dense operand). Kernels lower
+to XLA gather/segment-sum with static nnz — the shapes XLA can tile for
+TPU; for training-speed n:m sparsity see ``incubate.asp``.
+"""
+
+from .tensors import (SelectedRows, SparseCooTensor, SparseCsrTensor,  # noqa: F401
+                      sparse_coo_tensor, sparse_csr_tensor, to_sparse_coo,
+                      to_sparse_csr)
+from .ops import (add, coalesce, masked_matmul, matmul, mv,  # noqa: F401
+                  relu, sin, sqrt, tanh, transpose)
+from . import nn  # noqa: F401
+
+__all__ = [
+    "SparseCooTensor", "SparseCsrTensor", "SelectedRows",
+    "sparse_coo_tensor", "sparse_csr_tensor", "to_sparse_coo",
+    "to_sparse_csr", "add", "coalesce", "masked_matmul", "matmul", "mv",
+    "relu", "sin", "sqrt", "tanh", "transpose", "nn",
+]

@@ -1,0 +1,42 @@
+"""Sparse layers (ref ``paddle.incubate.sparse.nn``: ReLU, Softmax ...)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.autograd import apply_op
+from ..nn.layer import Layer
+from . import ops as sops
+from .tensors import SparseCsrTensor
+
+
+class ReLU(Layer):
+    def forward(self, x):
+        return sops.relu(x)
+
+
+class Softmax(Layer):
+    """Row-wise softmax over a CSR matrix's stored entries (ref
+    ``sparse/softmax_kernel``: only nonzeros participate)."""
+
+    def __init__(self, axis: int = -1):
+        super().__init__()
+        if axis != -1:
+            raise ValueError("sparse softmax supports axis=-1 (rows)")
+
+    def forward(self, x: SparseCsrTensor):
+        if not isinstance(x, SparseCsrTensor):
+            raise TypeError("sparse Softmax expects a SparseCsrTensor")
+        rows = jnp.asarray(x._row_ids(), jnp.int32)
+        m = x._shape[0]
+
+        def fn(vals):
+            row_max = jax.ops.segment_max(vals, rows, num_segments=m)
+            e = jnp.exp(vals - row_max[rows])
+            denom = jax.ops.segment_sum(e, rows, num_segments=m)
+            return e / denom[rows]
+
+        return SparseCsrTensor(x._crows, x._cols,
+                               apply_op("sparse_softmax", fn, [x._values]),
+                               x._shape)

@@ -1,0 +1,116 @@
+"""Sparse ops (ref ``paddle/phi/kernels/sparse/`` + the
+``paddle.incubate.sparse`` functional surface). Unary ops act on values
+(zero-preserving functions only, matching the reference's ``unary_kernel``
+set); ``matmul`` contracts sparse x dense as gather + segment-sum with
+static nnz, which XLA tiles efficiently on TPU."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.autograd import apply_op
+from ..core.tensor import Tensor
+from .tensors import SparseCooTensor, SparseCsrTensor
+
+
+def _unary(name, fn, x):
+    if isinstance(x, SparseCooTensor):
+        return SparseCooTensor(x._indices, apply_op(name, fn, [x._values]),
+                               x._shape, coalesced=x._coalesced)
+    if isinstance(x, SparseCsrTensor):
+        return SparseCsrTensor(x._crows, x._cols,
+                               apply_op(name, fn, [x._values]), x._shape)
+    raise TypeError(f"sparse.{name} expects a sparse tensor, got {type(x)}")
+
+
+def relu(x):
+    return _unary("sparse_relu", lambda v: jnp.maximum(v, 0), x)
+
+
+def tanh(x):
+    return _unary("sparse_tanh", jnp.tanh, x)
+
+
+def sqrt(x):
+    return _unary("sparse_sqrt", jnp.sqrt, x)
+
+
+def sin(x):
+    return _unary("sparse_sin", jnp.sin, x)
+
+
+def coalesce(x: SparseCooTensor) -> SparseCooTensor:
+    return x.coalesce()
+
+
+def transpose(x: SparseCooTensor, perm) -> SparseCooTensor:
+    if not isinstance(x, SparseCooTensor):
+        raise TypeError("sparse.transpose expects a SparseCooTensor")
+    perm = list(perm)
+    if len(perm) != x.sparse_dim:
+        raise ValueError("transpose currently permutes sparse dims only")
+    new_idx = x._indices[jnp.asarray(perm, jnp.int32)]
+    new_shape = tuple(x._shape[p] for p in perm) + tuple(
+        x._shape[x.sparse_dim:])
+    return SparseCooTensor(new_idx, x._values, new_shape)
+
+
+def add(a, b):
+    """sparse + sparse (same shape) -> sparse (union of patterns)."""
+    if isinstance(a, SparseCooTensor) and isinstance(b, SparseCooTensor):
+        if a._shape != b._shape:
+            raise ValueError(f"shape mismatch {a._shape} vs {b._shape}")
+        idx = jnp.concatenate([a._indices, b._indices], axis=1)
+        vals = apply_op("sparse_concat_values",
+                        lambda va, vb: jnp.concatenate([va, vb], axis=0),
+                        [a._values, b._values])
+        return SparseCooTensor(idx, vals, a._shape).coalesce()
+    raise TypeError("sparse.add expects two SparseCooTensors")
+
+
+def matmul(a, b):
+    """sparse[m,k] @ dense[k,n] -> dense[m,n] (ref
+    ``sparse/cpu|gpu/matmul_kernel``). Grad flows to both the sparse values
+    and the dense operand."""
+    if isinstance(a, SparseCsrTensor):
+        a = a.to_sparse_coo()
+    if not isinstance(a, SparseCooTensor):
+        raise TypeError("sparse.matmul expects sparse lhs")
+    if a.sparse_dim != 2 or len(a._shape) != 2:
+        raise ValueError(
+            f"matmul supports a purely 2-D sparse lhs; got shape "
+            f"{list(a._shape)} with sparse_dim={a.sparse_dim}")
+    rows, cols = a._indices[0], a._indices[1]
+    m = a._shape[0]
+    bt = b if isinstance(b, Tensor) else Tensor(jnp.asarray(b))
+
+    def fn(vals, dense):
+        contrib = vals[:, None] * dense[cols]          # [nnz, n]
+        return jax.ops.segment_sum(contrib, rows, num_segments=m)
+
+    return apply_op("sparse_matmul", fn, [a._values, bt])
+
+
+def mv(a, x):
+    """sparse[m,k] @ dense[k] -> dense[m]."""
+    xt = x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+    out = matmul(a, apply_op("reshape", lambda v: v[:, None], [xt]))
+    return apply_op("reshape", lambda v: v[:, 0], [out])
+
+
+def masked_matmul(x, y, mask):
+    """dense[m,k] @ dense[k,n], evaluated only at ``mask``'s nonzero
+    coordinates -> sparse (ref ``masked_matmul_kernel``; the SDDMM op)."""
+    if not isinstance(mask, SparseCooTensor):
+        raise TypeError("mask must be a SparseCooTensor")
+    rows, cols = mask._indices[0], mask._indices[1]
+    xt = x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+    yt = y if isinstance(y, Tensor) else Tensor(jnp.asarray(y))
+
+    def fn(xa, ya):
+        return jnp.einsum("nk,nk->n", xa[rows], ya.T[cols])
+
+    vals = apply_op("sparse_masked_matmul", fn, [xt, yt])
+    return SparseCooTensor(mask._indices, vals, mask._shape,
+                           coalesced=mask._coalesced)

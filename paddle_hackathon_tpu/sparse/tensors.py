@@ -1,0 +1,266 @@
+"""Sparse tensor types (ref ``phi/core/sparse_coo_tensor.h:30``,
+``sparse_csr_tensor.h:33``, ``selected_rows.h:27``)."""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+
+
+def _as_tensor(x, dtype=None) -> Tensor:
+    if isinstance(x, Tensor):
+        return x
+    v = jnp.asarray(x, dtype)
+    return Tensor(v)
+
+
+class SparseCooTensor:
+    """Coordinate-format sparse tensor.
+
+    ``indices`` is a dense [sparse_dim, nnz] int array (static); ``values``
+    is a framework Tensor [nnz, *dense_dims] participating in autograd.
+    Mirrors phi's invariant layout (``sparse_coo_tensor.h:30``).
+    """
+
+    def __init__(self, indices, values: Tensor, shape: Sequence[int],
+                 coalesced: bool = False):
+        self._indices = jnp.asarray(indices, jnp.int32)
+        self._values = values if isinstance(values, Tensor) else Tensor(
+            jnp.asarray(values))
+        self._shape = tuple(int(d) for d in shape)
+        self._coalesced = coalesced
+
+    # -- phi-parity accessors ----------------------------------------------
+    def indices(self) -> Tensor:
+        return Tensor(self._indices)
+
+    def values(self) -> Tensor:
+        return self._values
+
+    @property
+    def shape(self):
+        return list(self._shape)
+
+    @property
+    def nnz(self) -> int:
+        return int(self._indices.shape[1])
+
+    @property
+    def sparse_dim(self) -> int:
+        return int(self._indices.shape[0])
+
+    @property
+    def dtype(self):
+        return self._values.dtype
+
+    def is_sparse_coo(self) -> bool:
+        return True
+
+    def is_sparse_csr(self) -> bool:
+        return False
+
+    # -- conversions ---------------------------------------------------------
+    def to_dense(self) -> Tensor:
+        from ..core.autograd import apply_op
+        idx = self._indices
+        shape = self._shape
+
+        def fn(vals):
+            out = jnp.zeros(shape, vals.dtype)
+            return out.at[tuple(idx)].add(vals)
+
+        return apply_op("sparse_coo_to_dense", fn, [self._values])
+
+    def to_sparse_csr(self) -> "SparseCsrTensor":
+        if len(self._shape) != 2:
+            raise ValueError("to_sparse_csr supports 2-D tensors")
+        coo = self.coalesce()
+        rows = np.asarray(coo._indices[0])
+        cols = np.asarray(coo._indices[1])
+        order = np.lexsort((cols, rows))
+        crows = np.zeros(self._shape[0] + 1, np.int32)
+        np.add.at(crows[1:], rows[order], 1)
+        crows = np.cumsum(crows).astype(np.int32)
+        vals = coo._values
+        perm = jnp.asarray(order, jnp.int32)
+        from ..core.autograd import apply_op
+        sorted_vals = apply_op("sparse_reorder",
+                               lambda v: jnp.take(v, perm, axis=0), [vals])
+        return SparseCsrTensor(crows, cols[order], sorted_vals, self._shape)
+
+    def coalesce(self) -> "SparseCooTensor":
+        """Merge duplicate coordinates (ref sparse_coo_tensor coalesced
+        invariant)."""
+        if self._coalesced:
+            return self
+        idx_np = np.asarray(self._indices)
+        flat = np.ravel_multi_index(
+            idx_np, self._shape[:self.sparse_dim])
+        uniq, inv = np.unique(flat, return_inverse=True)
+        new_idx = np.stack(np.unravel_index(
+            uniq, self._shape[:self.sparse_dim])).astype(np.int32)
+        seg = jnp.asarray(inv, jnp.int32)
+        n_out = int(uniq.size)
+        from ..core.autograd import apply_op
+        new_vals = apply_op(
+            "sparse_coalesce",
+            lambda v: jax.ops.segment_sum(v, seg, num_segments=n_out),
+            [self._values])
+        return SparseCooTensor(new_idx, new_vals, self._shape, coalesced=True)
+
+    def __repr__(self):
+        return (f"SparseCooTensor(shape={self.shape}, nnz={self.nnz}, "
+                f"dtype={self.dtype})")
+
+
+class SparseCsrTensor:
+    """Compressed-sparse-row tensor (2-D; ref ``sparse_csr_tensor.h:33``)."""
+
+    def __init__(self, crows, cols, values: Tensor, shape: Sequence[int]):
+        self._crows = jnp.asarray(crows, jnp.int32)
+        self._cols = jnp.asarray(cols, jnp.int32)
+        self._values = values if isinstance(values, Tensor) else Tensor(
+            jnp.asarray(values))
+        self._shape = tuple(int(d) for d in shape)
+
+    def crows(self) -> Tensor:
+        return Tensor(self._crows)
+
+    def cols(self) -> Tensor:
+        return Tensor(self._cols)
+
+    def values(self) -> Tensor:
+        return self._values
+
+    @property
+    def shape(self):
+        return list(self._shape)
+
+    @property
+    def nnz(self) -> int:
+        return int(self._cols.shape[0])
+
+    @property
+    def dtype(self):
+        return self._values.dtype
+
+    def is_sparse_coo(self) -> bool:
+        return False
+
+    def is_sparse_csr(self) -> bool:
+        return True
+
+    def _row_ids(self) -> np.ndarray:
+        """Expand crows to one row id per stored entry."""
+        counts = np.diff(np.asarray(self._crows))
+        return np.repeat(np.arange(self._shape[0]), counts).astype(np.int32)
+
+    def to_sparse_coo(self, sparse_dim: int = 2) -> SparseCooTensor:
+        idx = np.stack([self._row_ids(), np.asarray(self._cols)])
+        return SparseCooTensor(idx, self._values, self._shape, coalesced=True)
+
+    def to_dense(self) -> Tensor:
+        return self.to_sparse_coo().to_dense()
+
+    def __repr__(self):
+        return (f"SparseCsrTensor(shape={self.shape}, nnz={self.nnz}, "
+                f"dtype={self.dtype})")
+
+
+class SelectedRows:
+    """Rows+values sparse gradient (ref ``phi/core/selected_rows.h:27``):
+    the format a vocab-size embedding grad takes — only touched rows are
+    materialized. ``height`` is the full first-dim size."""
+
+    def __init__(self, rows, values: Tensor, height: int):
+        self.rows = jnp.asarray(rows, jnp.int32)
+        self.value = values if isinstance(values, Tensor) else Tensor(
+            jnp.asarray(values))
+        self.height = int(height)
+
+    def to_dense(self) -> Tensor:
+        from ..core.autograd import apply_op
+        rows = self.rows
+        h = self.height
+
+        def fn(v):
+            out = jnp.zeros((h,) + v.shape[1:], v.dtype)
+            return out.at[rows].add(v)
+
+        return apply_op("selected_rows_to_dense", fn, [self.value])
+
+    def merge_add(self) -> "SelectedRows":
+        """Merge duplicate rows (ref ``merge_selected_rows`` op)."""
+        rows_np = np.asarray(self.rows)
+        uniq, inv = np.unique(rows_np, return_inverse=True)
+        seg = jnp.asarray(inv, jnp.int32)
+        n = int(uniq.size)
+        from ..core.autograd import apply_op
+        merged = apply_op(
+            "selected_rows_merge",
+            lambda v: jax.ops.segment_sum(v, seg, num_segments=n),
+            [self.value])
+        return SelectedRows(uniq.astype(np.int32), merged, self.height)
+
+
+# ---------------------------------------------------------------------------
+# factories
+# ---------------------------------------------------------------------------
+
+def _values_with_grad_flag(values, dtype, stop_gradient: bool) -> Tensor:
+    vals = _as_tensor(values, dtype)
+    if not stop_gradient and vals.stop_gradient:
+        if isinstance(values, Tensor):
+            # don't mutate the caller's tensor: the factory's stop_gradient
+            # applies to the sparse tensor's values view only
+            vals = Tensor(vals._value, stop_gradient=False)
+        else:
+            vals.stop_gradient = False
+    return vals
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None,
+                      stop_gradient: bool = True) -> SparseCooTensor:
+    """Ref ``paddle.incubate.sparse.sparse_coo_tensor``."""
+    idx = np.asarray(indices)
+    vals = _values_with_grad_flag(values, dtype, stop_gradient)
+    if shape is None:
+        if idx.size == 0:
+            raise ValueError("shape= is required for an empty (nnz=0) "
+                             "sparse tensor; it cannot be inferred")
+        shape = tuple(int(m) + 1 for m in idx.max(axis=1)) + tuple(
+            vals._value.shape[1:])
+    return SparseCooTensor(idx, vals, shape)
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None,
+                      stop_gradient: bool = True) -> SparseCsrTensor:
+    vals = _values_with_grad_flag(values, dtype, stop_gradient)
+    return SparseCsrTensor(crows, cols, vals, shape)
+
+
+def to_sparse_coo(x: Tensor, sparse_dim: Optional[int] = None
+                  ) -> SparseCooTensor:
+    """Dense -> COO (ref ``Tensor.to_sparse_coo``). Nonzero structure is
+    computed on host (dynamic nnz is data-dependent — not a jit-safe op,
+    same as the reference's eager-only conversion)."""
+    arr = np.asarray(x._value if isinstance(x, Tensor) else x)
+    sd = sparse_dim or arr.ndim
+    flat = arr.reshape(arr.shape[:sd] + (-1,))
+    mask = np.abs(flat).sum(axis=-1) != 0 if flat.ndim > sd else flat != 0
+    idx = np.stack(np.nonzero(mask)).astype(np.int32)
+    from ..core.autograd import apply_op
+    jidx = tuple(jnp.asarray(i) for i in idx)
+    vals = apply_op("dense_to_sparse_coo",
+                    lambda v: v[jidx],
+                    [x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))])
+    return SparseCooTensor(idx, vals, arr.shape, coalesced=True)
+
+
+def to_sparse_csr(x: Tensor) -> SparseCsrTensor:
+    return to_sparse_coo(x, 2).to_sparse_csr()

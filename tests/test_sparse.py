@@ -1,0 +1,155 @@
+"""Sparse tensor subsystem tests (ref phi sparse kernels tests +
+paddle.incubate.sparse API)."""
+
+import numpy as np
+import pytest
+
+import paddle_hackathon_tpu as paddle
+from paddle_hackathon_tpu import sparse
+
+
+def _coo():
+    # [[0, 1, 0], [2, 0, 3]]
+    return sparse.sparse_coo_tensor(
+        [[0, 1, 1], [1, 0, 2]], [1.0, 2.0, 3.0], [2, 3])
+
+
+class TestFormats:
+    def test_coo_roundtrip(self):
+        s = _coo()
+        assert s.nnz == 3 and s.shape == [2, 3]
+        d = s.to_dense().numpy()
+        np.testing.assert_array_equal(d, [[0, 1, 0], [2, 0, 3]])
+
+    def test_dense_to_coo_and_back(self):
+        x = paddle.to_tensor(np.array([[0., 5., 0.], [0., 0., 7.]], np.float32))
+        s = sparse.to_sparse_coo(x)
+        assert s.nnz == 2
+        np.testing.assert_array_equal(s.to_dense().numpy(), x.numpy())
+
+    def test_coo_to_csr_roundtrip(self):
+        s = _coo()
+        c = s.to_sparse_csr()
+        np.testing.assert_array_equal(np.asarray(c._crows), [0, 1, 3])
+        np.testing.assert_array_equal(c.to_dense().numpy(),
+                                      s.to_dense().numpy())
+        back = c.to_sparse_coo()
+        np.testing.assert_array_equal(back.to_dense().numpy(),
+                                      s.to_dense().numpy())
+
+    def test_coalesce_merges_duplicates(self):
+        s = sparse.sparse_coo_tensor([[0, 0], [1, 1]], [1.0, 4.0], [2, 3])
+        c = s.coalesce()
+        assert c.nnz == 1
+        assert float(c.values().numpy()[0]) == 5.0
+
+    def test_uncoalesced_to_dense_adds(self):
+        s = sparse.sparse_coo_tensor([[0, 0], [1, 1]], [1.0, 4.0], [2, 3])
+        assert float(s.to_dense().numpy()[0, 1]) == 5.0
+
+
+class TestOps:
+    def test_unary_relu(self):
+        s = sparse.sparse_coo_tensor([[0, 1], [0, 1]], [-1.0, 2.0], [2, 2])
+        r = sparse.relu(s)
+        np.testing.assert_array_equal(r.values().numpy(), [0.0, 2.0])
+
+    def test_add_union(self):
+        a = sparse.sparse_coo_tensor([[0], [0]], [1.0], [2, 2])
+        b = sparse.sparse_coo_tensor([[0, 1], [0, 1]], [2.0, 3.0], [2, 2])
+        c = sparse.add(a, b)
+        np.testing.assert_array_equal(c.to_dense().numpy(),
+                                      [[3.0, 0.0], [0.0, 3.0]])
+
+    def test_matmul_matches_dense(self):
+        s = _coo()
+        rng = np.random.RandomState(0)
+        b = rng.randn(3, 4).astype(np.float32)
+        out = sparse.matmul(s, paddle.to_tensor(b))
+        ref = s.to_dense().numpy() @ b
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5)
+
+    def test_matmul_grads_flow(self):
+        vals = paddle.to_tensor(np.array([1.0, 2.0, 3.0], np.float32),
+                                stop_gradient=False)
+        s = sparse.SparseCooTensor([[0, 1, 1], [1, 0, 2]], vals, [2, 3])
+        b = paddle.to_tensor(np.ones((3, 2), np.float32), stop_gradient=False)
+        out = sparse.matmul(s, b)
+        out.sum().backward()
+        assert vals.grad is not None and b.grad is not None
+        # d(sum)/d(vals_i) = sum of dense row selected = 2.0 each
+        np.testing.assert_allclose(vals.grad.numpy(), [2.0, 2.0, 2.0])
+
+    def test_mv(self):
+        s = _coo()
+        x = np.array([1.0, 2.0, 3.0], np.float32)
+        np.testing.assert_allclose(sparse.mv(s, x).numpy(),
+                                   s.to_dense().numpy() @ x, rtol=1e-5)
+
+    def test_masked_matmul(self):
+        rng = np.random.RandomState(1)
+        x = rng.randn(2, 3).astype(np.float32)
+        y = rng.randn(3, 2).astype(np.float32)
+        mask = sparse.sparse_coo_tensor([[0, 1], [1, 0]], [1.0, 1.0], [2, 2])
+        out = sparse.masked_matmul(x, y, mask)
+        full = x @ y
+        np.testing.assert_allclose(out.values().numpy(),
+                                   [full[0, 1], full[1, 0]], rtol=1e-5,
+                                   atol=1e-6)
+
+    def test_transpose(self):
+        s = _coo()
+        t = sparse.transpose(s, [1, 0])
+        np.testing.assert_array_equal(t.to_dense().numpy(),
+                                      s.to_dense().numpy().T)
+
+    def test_csr_softmax(self):
+        s = _coo().to_sparse_csr()
+        sm = sparse.nn.Softmax()(s)
+        d = sm.to_dense().numpy()
+        # row sums over stored entries == 1
+        np.testing.assert_allclose(d.sum(axis=1), [1.0, 1.0], rtol=1e-5)
+
+
+class TestSelectedRows:
+    def test_to_dense_and_merge(self):
+        sr = sparse.SelectedRows([1, 3, 1],
+                                 np.ones((3, 2), np.float32), height=5)
+        merged = sr.merge_add()
+        assert list(np.asarray(merged.rows)) == [1, 3]
+        d = sr.to_dense().numpy()
+        assert d.shape == (5, 2)
+        np.testing.assert_array_equal(d[1], [2.0, 2.0])
+        np.testing.assert_array_equal(d[0], [0.0, 0.0])
+
+    def test_grad_flows_through_to_dense(self):
+        vals = paddle.to_tensor(np.ones((2, 3), np.float32),
+                                stop_gradient=False)
+        sr = sparse.SelectedRows([0, 2], vals, height=4)
+        sr.to_dense().sum().backward()
+        np.testing.assert_array_equal(vals.grad.numpy(),
+                                      np.ones((2, 3), np.float32))
+
+
+class TestReviewRegressions:
+    def test_matmul_rejects_hybrid_coo(self):
+        s = sparse.sparse_coo_tensor([[0, 1], [1, 0]],
+                                     np.ones((2, 3), np.float32), [2, 2, 3])
+        with pytest.raises(ValueError, match="purely 2-D"):
+            sparse.matmul(s, np.ones((2, 3), np.float32))
+
+    def test_factory_does_not_mutate_caller_tensor(self):
+        t = paddle.to_tensor(np.ones(2, np.float32))
+        assert t.stop_gradient
+        s = sparse.sparse_coo_tensor([[0, 1], [0, 1]], t, [2, 2],
+                                     stop_gradient=False)
+        assert t.stop_gradient            # caller unchanged
+        assert not s.values().stop_gradient
+
+    def test_empty_sparse_requires_shape(self):
+        with pytest.raises(ValueError, match="shape"):
+            sparse.sparse_coo_tensor(np.zeros((2, 0), np.int32),
+                                     np.zeros((0,), np.float32))
+        s = sparse.sparse_coo_tensor(np.zeros((2, 0), np.int32),
+                                     np.zeros((0,), np.float32), [3, 3])
+        np.testing.assert_array_equal(s.to_dense().numpy(), np.zeros((3, 3)))
