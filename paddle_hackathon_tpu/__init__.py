@@ -76,7 +76,8 @@ import importlib as _importlib
 
 for _sub in ("nn", "optimizer", "io", "amp", "metric", "framework",
              "jit", "distributed", "vision", "incubate", "profiler", "hapi",
-             "static", "text", "inference", "distribution", "sparse"):
+             "static", "text", "inference", "distribution", "sparse",
+             "utils", "onnx"):
     try:
         globals()[_sub] = _importlib.import_module(f"{__name__}.{_sub}")
     except ModuleNotFoundError as _e:

@@ -1,0 +1,13 @@
+"""paddle.onnx — ONNX export wrapper.
+
+Ref ``python/paddle/onnx/export.py``: the reference delegates to the
+external ``paddle2onnx`` converter. Here export goes StableHLO-first: the
+model is traced and serialized with ``paddle.jit.save`` (the portable
+deployment artifact of this framework); when the optional ``onnx`` package
+is installed the traced program is additionally converted via jax's ONNX
+bridge if available. Without it, a clear error explains the path.
+"""
+
+from .export import export  # noqa: F401
+
+__all__ = ["export"]

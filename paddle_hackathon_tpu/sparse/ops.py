@@ -84,6 +84,12 @@ def matmul(a, b):
     rows, cols = a._indices[0], a._indices[1]
     m = a._shape[0]
     bt = b if isinstance(b, Tensor) else Tensor(jnp.asarray(b))
+    if bt._value.ndim != 2 or bt._value.shape[0] != a._shape[1]:
+        # must be explicit: jax's clamped gather would otherwise return
+        # silently wrong numbers on a contraction-dim mismatch
+        raise ValueError(
+            f"sparse.matmul shape mismatch: sparse {list(a._shape)} @ "
+            f"dense {list(bt._value.shape)}")
 
     def fn(vals, dense):
         contrib = vals[:, None] * dense[cols]          # [nnz, n]

@@ -87,10 +87,16 @@ class SparseCooTensor:
         np.add.at(crows[1:], rows[order], 1)
         crows = np.cumsum(crows).astype(np.int32)
         vals = coo._values
-        perm = jnp.asarray(order, jnp.int32)
-        from ..core.autograd import apply_op
-        sorted_vals = apply_op("sparse_reorder",
-                               lambda v: jnp.take(v, perm, axis=0), [vals])
+        if np.array_equal(order, np.arange(order.size)):
+            # coalesce() emits row-major order, so the permutation is the
+            # identity there; only user-constructed coalesced=True tensors
+            # with unsorted indices pay the reorder gather
+            sorted_vals = vals
+        else:
+            perm = jnp.asarray(order, jnp.int32)
+            from ..core.autograd import apply_op
+            sorted_vals = apply_op("sparse_reorder",
+                                   lambda v: jnp.take(v, perm, axis=0), [vals])
         return SparseCsrTensor(crows, cols[order], sorted_vals, self._shape)
 
     def coalesce(self) -> "SparseCooTensor":

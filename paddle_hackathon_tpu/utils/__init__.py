@@ -1,0 +1,3 @@
+"""Utility surface: filesystem abstraction + misc helpers."""
+
+from .fs import FS, LocalFS, HDFSClient  # noqa: F401

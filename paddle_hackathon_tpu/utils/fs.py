@@ -1,0 +1,150 @@
+"""Filesystem abstraction (ref ``fleet/utils/fs.py``: ``FS`` base,
+``LocalFS:120``, ``HDFSClient:428``).
+
+Checkpoint machinery (auto-checkpoint, fleet save) writes through this
+interface so remote stores can back it. ``HDFSClient`` keeps the
+reference's API but requires a configured ``hadoop`` binary; in this build
+it degrades to an informative error unless one is present.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+from typing import List, Optional
+
+
+class FS:
+    def ls_dir(self, path):
+        raise NotImplementedError
+
+    def is_exist(self, path):
+        raise NotImplementedError
+
+    def mkdirs(self, path):
+        raise NotImplementedError
+
+    def delete(self, path):
+        raise NotImplementedError
+
+    def mv(self, src, dst, overwrite=False):
+        raise NotImplementedError
+
+    def upload(self, local, remote):
+        raise NotImplementedError
+
+    def download(self, remote, local):
+        raise NotImplementedError
+
+
+class LocalFS(FS):
+    """Ref ``LocalFS`` (``fleet/utils/fs.py:120``)."""
+
+    def ls_dir(self, path) -> tuple:
+        if not self.is_exist(path):
+            return [], []
+        dirs, files = [], []
+        for e in sorted(os.listdir(path)):
+            (dirs if os.path.isdir(os.path.join(path, e)) else files).append(e)
+        return dirs, files
+
+    def is_exist(self, path) -> bool:
+        return os.path.exists(path)
+
+    def is_dir(self, path) -> bool:
+        return os.path.isdir(path)
+
+    def is_file(self, path) -> bool:
+        return os.path.isfile(path)
+
+    def mkdirs(self, path) -> None:
+        os.makedirs(path, exist_ok=True)
+
+    def delete(self, path) -> None:
+        if os.path.isdir(path):
+            shutil.rmtree(path, ignore_errors=True)
+        elif os.path.exists(path):
+            os.remove(path)
+
+    def mv(self, src, dst, overwrite: bool = False) -> None:
+        if os.path.exists(dst):
+            if not overwrite:
+                raise FileExistsError(
+                    f"mv destination exists: {dst} (pass overwrite=True)")
+            self.delete(dst)
+        if os.path.isfile(src):
+            os.replace(src, dst)
+        else:
+            shutil.move(src, dst)
+
+    def upload(self, local, remote) -> None:
+        self.mkdirs(os.path.dirname(remote) or ".")
+        if os.path.isdir(local):
+            shutil.copytree(local, remote, dirs_exist_ok=True)
+        else:
+            shutil.copy2(local, remote)
+
+    def download(self, remote, local) -> None:
+        self.upload(remote, local)
+
+    def touch(self, path, exist_ok: bool = True) -> None:
+        if os.path.exists(path) and not exist_ok:
+            raise FileExistsError(path)
+        self.mkdirs(os.path.dirname(path) or ".")
+        with open(path, "a"):
+            pass
+
+
+class HDFSClient(FS):
+    """Ref ``HDFSClient`` (``fleet/utils/fs.py:428``) — shells out to the
+    ``hadoop fs`` CLI with the same configs dict."""
+
+    def __init__(self, hadoop_home: str, configs: Optional[dict] = None,
+                 time_out: int = 300000, sleep_inter: int = 1000):
+        self._base = [os.path.join(hadoop_home, "bin", "hadoop"), "fs"]
+        for k, v in (configs or {}).items():
+            self._base += [f"-D{k}={v}"]
+        if not os.path.exists(self._base[0]):
+            raise RuntimeError(
+                f"hadoop binary not found at {self._base[0]}; HDFSClient "
+                "requires a hadoop install (use LocalFS otherwise)")
+
+    def _run(self, *args) -> str:
+        out = subprocess.run(self._base + list(args), capture_output=True,
+                             text=True)
+        if out.returncode != 0:
+            raise RuntimeError(f"hadoop fs {' '.join(args)}: {out.stderr}")
+        return out.stdout
+
+    def ls_dir(self, path):
+        lines = self._run("-ls", path).splitlines()
+        dirs, files = [], []
+        for ln in lines:
+            parts = ln.split()
+            if len(parts) < 8:
+                continue
+            name = os.path.basename(parts[-1])
+            (dirs if parts[0].startswith("d") else files).append(name)
+        return dirs, files
+
+    def is_exist(self, path) -> bool:
+        return subprocess.run(self._base + ["-test", "-e", path],
+                              capture_output=True).returncode == 0
+
+    def mkdirs(self, path) -> None:
+        self._run("-mkdir", "-p", path)
+
+    def delete(self, path) -> None:
+        self._run("-rm", "-r", "-f", path)
+
+    def mv(self, src, dst, overwrite: bool = False) -> None:
+        if overwrite and self.is_exist(dst):
+            self.delete(dst)
+        self._run("-mv", src, dst)
+
+    def upload(self, local, remote) -> None:
+        self._run("-put", "-f", local, remote)
+
+    def download(self, remote, local) -> None:
+        self._run("-get", remote, local)

@@ -1,0 +1,162 @@
+"""Aux subsystem tests: auto-checkpoint, fs abstraction, onnx export,
+NaN/Inf checker flag (SURVEY §5.3-§5.5)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import paddle_hackathon_tpu as paddle
+from paddle_hackathon_tpu import nn
+from paddle_hackathon_tpu.incubate.checkpoint import TrainEpochRange
+from paddle_hackathon_tpu.optimizer import SGD
+from paddle_hackathon_tpu.utils.fs import LocalFS
+
+
+class TestLocalFS:
+    def test_basic_ops(self, tmp_path):
+        fs = LocalFS()
+        d = str(tmp_path / "a" / "b")
+        fs.mkdirs(d)
+        assert fs.is_exist(d) and fs.is_dir(d)
+        f = os.path.join(d, "x.txt")
+        fs.touch(f)
+        assert fs.is_file(f)
+        dirs, files = fs.ls_dir(str(tmp_path / "a"))
+        assert dirs == ["b"]
+        fs.mv(f, os.path.join(d, "y.txt"))
+        assert not fs.is_exist(f)
+        fs.delete(d)
+        assert not fs.is_exist(d)
+
+
+class TestAutoCheckpoint:
+    def _mk(self, tmp_path, job="j1"):
+        os.environ["PADDLE_JOB_ID"] = job
+        m = nn.Linear(4, 2)
+        opt = SGD(learning_rate=0.1, parameters=m.parameters())
+        tr = TrainEpochRange(5, checkpoint_dir=str(tmp_path))
+        tr.register(model=m, opt=opt)
+        return m, opt, tr
+
+    def test_fresh_run_covers_all_epochs(self, tmp_path):
+        _, _, tr = self._mk(tmp_path)
+        assert list(tr) == [0, 1, 2, 3, 4]
+
+    def test_crash_resume_continues(self, tmp_path):
+        m1, _, tr1 = self._mk(tmp_path)
+        x = paddle.to_tensor(np.ones((2, 4), np.float32))
+        seen = []
+        for epoch in tr1:
+            m1(x).sum().backward()
+            seen.append(epoch)
+            if epoch == 2:
+                break  # simulated crash AFTER epoch-2 checkpoint...
+        tr1.save_checkpoint(2)
+        w_at_crash = m1.weight.numpy().copy()
+
+        # relaunch: same job id, fresh objects
+        m2, _, tr2 = self._mk(tmp_path)
+        assert tr2.restored_from == 2
+        np.testing.assert_array_equal(m2.weight.numpy(), w_at_crash)
+        assert list(tr2) == [3, 4]
+
+    def test_jobs_are_isolated(self, tmp_path):
+        _, _, tr1 = self._mk(tmp_path, job="jobA")
+        tr1.save_checkpoint(3)
+        _, _, tr2 = self._mk(tmp_path, job="jobB")
+        assert tr2.restored_from == -1
+
+
+class TestOnnxExport:
+    def test_writes_stablehlo_artifact(self, tmp_path):
+        from paddle_hackathon_tpu.jit import InputSpec
+        from paddle_hackathon_tpu.onnx import export
+        net = nn.Linear(4, 2)
+        net.eval()
+        p = export(net, str(tmp_path / "m"),
+                   input_spec=[InputSpec([-1, 4], "float32")])
+        assert os.path.exists(p)
+        # artifact loads through the inference engine
+        from paddle_hackathon_tpu import inference
+        cfg = inference.Config(p)
+        cfg.disable_gpu()
+        pred = inference.create_predictor(cfg)
+        (out,) = pred.run([np.ones((2, 4), np.float32)])
+        assert out.shape == (2, 2)
+
+    def test_onnx_checker_demand_raises(self, tmp_path):
+        from paddle_hackathon_tpu.jit import InputSpec
+        from paddle_hackathon_tpu.onnx import export
+        net = nn.Linear(4, 2)
+        net.eval()
+        with pytest.raises(RuntimeError, match="onnx"):
+            export(net, str(tmp_path / "m2"),
+                   input_spec=[InputSpec([2, 4], "float32")],
+                   enable_onnx_checker=True)
+
+
+class TestNanInfChecker:
+    def test_flag_catches_nan(self):
+        paddle.set_flags({"check_nan_inf": True})
+        try:
+            x = paddle.to_tensor(np.array([1.0, 0.0], np.float32))
+            with pytest.raises(FloatingPointError, match="check_nan_inf"):
+                _ = x / 0.0
+        finally:
+            paddle.set_flags({"check_nan_inf": False})
+
+
+class TestReviewRegressions:
+    def test_mv_overwrite_false_raises(self, tmp_path):
+        fs = LocalFS()
+        a, b = str(tmp_path / "a"), str(tmp_path / "b")
+        for p in (a, b):
+            with open(p, "w") as f:
+                f.write(p)
+        with pytest.raises(FileExistsError):
+            fs.mv(a, b)
+        fs.mv(a, b, overwrite=True)
+        with open(b) as f:
+            assert f.read() == a
+
+    def test_sparse_matmul_shape_mismatch_raises(self):
+        from paddle_hackathon_tpu import sparse
+        s = sparse.sparse_coo_tensor([[0, 1], [1, 2]], [1.0, 1.0], [2, 3])
+        with pytest.raises(ValueError, match="shape mismatch"):
+            sparse.matmul(s, np.ones((2, 4), np.float32))
+
+    def test_remote_fs_checkpoint_roundtrip(self, tmp_path, monkeypatch):
+        """A non-LocalFS store must work via upload/download."""
+        from paddle_hackathon_tpu.utils.fs import FS, LocalFS
+
+        class FakeRemoteFS(FS):
+            # same host paths, but only reachable through upload/download
+            def __init__(self):
+                self._l = LocalFS()
+
+            def is_exist(self, p):
+                return self._l.is_exist(p)
+
+            def mkdirs(self, p):
+                self._l.mkdirs(p)
+
+            def upload(self, local, remote):
+                self._l.upload(local, remote)
+
+            def download(self, remote, local):
+                self._l.upload(remote, local)
+
+        monkeypatch.setenv("PADDLE_JOB_ID", "remote_job")
+        m = nn.Linear(3, 1)
+        opt = SGD(learning_rate=0.1, parameters=m.parameters())
+        tr = TrainEpochRange(3, checkpoint_dir=str(tmp_path),
+                             fs=FakeRemoteFS())
+        tr.register(model=m, opt=opt)
+        tr.save_checkpoint(1)
+        m2 = nn.Linear(3, 1)
+        tr2 = TrainEpochRange(3, checkpoint_dir=str(tmp_path),
+                              fs=FakeRemoteFS())
+        tr2.register(model=m2)
+        assert tr2.restored_from == 1
+        np.testing.assert_array_equal(m2.weight.numpy(), m.weight.numpy())
