@@ -89,3 +89,121 @@ if "framework" in globals():
 if "nn" in globals():
     from .nn.layer import Layer  # noqa: E402
     from .nn.parameter import Parameter, create_parameter  # noqa: E402
+
+if "hapi" in globals():
+    from .hapi import Model  # noqa: E402
+    from .hapi.summary import flops, summary  # noqa: E402
+if "nn" in globals():
+    from .nn.parameter import ParamAttr  # noqa: E402
+
+import numpy as _np
+dtype = _np.dtype  # paddle.dtype — dtypes are numpy/jnp dtype objects
+
+
+# -- Places (ref phi/common/place.h CPUPlace...CustomPlace) ------------------
+# On TPU every accelerator place maps to the local chip; the classes exist
+# for API parity so device-annotated user code imports cleanly.
+def _place_alias(type_name):
+    def ctor(device_id=0):
+        return Place(type_name, device_id)
+    ctor.__name__ = f"{type_name.upper()}Place"
+    return ctor
+
+
+CPUPlace = lambda: Place("cpu")  # noqa: E731
+TPUPlace = _place_alias("tpu")
+CUDAPlace = _place_alias("tpu")  # CUDA-annotated code runs on the chip
+CUDAPinnedPlace = lambda: Place("cpu")  # noqa: E731
+NPUPlace = _place_alias("tpu")
+XPUPlace = _place_alias("tpu")
+MLUPlace = _place_alias("tpu")
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    """Ref paddle.set_printoptions (tensor repr goes through numpy)."""
+    import numpy as _np
+    kw = {}
+    if precision is not None:
+        kw["precision"] = precision
+    if threshold is not None:
+        kw["threshold"] = threshold
+    if edgeitems is not None:
+        kw["edgeitems"] = edgeitems
+    if linewidth is not None:
+        kw["linewidth"] = linewidth
+    if sci_mode is not None:
+        kw["suppress"] = not sci_mode
+    _np.set_printoptions(**kw)
+
+
+def disable_signal_handler():
+    """No-op for parity (the reference unhooks its C++ signal handlers)."""
+
+
+def check_shape(shape):
+    """Validate a shape argument (ref paddle.check_shape)."""
+    import numpy as _np
+    for s in (shape.tolist() if isinstance(shape, Tensor) else shape):
+        if not isinstance(s, (int, _np.integer)) and s is not None:
+            raise TypeError(f"invalid dim {s!r} in shape")
+
+
+# CUDA rng-state aliases: one generator drives the accelerator (core.random)
+get_cuda_rng_state = get_rng_state
+set_cuda_rng_state = set_rng_state
+
+
+def batch(reader, batch_size, drop_last=False):
+    """Legacy paddle.batch: wrap a sample reader into a batch reader
+    (ref python/paddle/reader/decorator.py)."""
+    def batched():
+        buf = []
+        for sample in reader():
+            buf.append(sample)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+    return batched
+
+
+import os as _os
+
+runtime_include_dir = _os.path.join(_os.path.dirname(__file__), "native")
+
+
+if "nn" in globals():
+    class DataParallel(Layer):
+        """Dygraph data-parallel wrapper (ref ``fluid/dygraph/parallel.py:419``).
+
+        TPU-native: parameters are placed (replicated) on the current mesh and
+        the training step runs SPMD under pjit, where XLA inserts the gradient
+        psum over the 'dp' axis — there is no reducer/bucket machinery to manage
+        (SURVEY §2.4 DP row). Outside a mesh context it is a transparent wrapper.
+        """
+
+        def __init__(self, layers, strategy=None, comm_buffer_size=25,
+                     last_comm_buffer_size=1, find_unused_parameters=False):
+            super().__init__()
+            self._layers = layers
+            from .parallel import api as _papi
+            mesh = _papi.get_mesh()
+            if mesh is not None:
+                _papi.shard_params(layers, mesh, rule=None)
+
+        def forward(self, *inputs, **kwargs):
+            return self._layers(*inputs, **kwargs)
+
+        def state_dict(self, *args, **kwargs):
+            return self._layers.state_dict(*args, **kwargs)
+
+        def set_state_dict(self, state_dict, *args, **kwargs):
+            return self._layers.set_state_dict(state_dict, *args, **kwargs)
+
+        def scale_loss(self, loss):  # ref parallel.py scale_loss (no-op: psum averages)
+            return loss
+
+        def apply_collective_grads(self):  # grads already reduced by XLA
+            pass

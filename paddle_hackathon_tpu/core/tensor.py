@@ -287,6 +287,18 @@ class Tensor:
     def __rpow__(self, o):
         return self._binop(o, lambda a, b: b ** a, "rpow")
 
+    def __and__(self, o):
+        return self._binop(o, jnp.bitwise_and, "bitwise_and")
+
+    def __or__(self, o):
+        return self._binop(o, jnp.bitwise_or, "bitwise_or")
+
+    def __xor__(self, o):
+        return self._binop(o, jnp.bitwise_xor, "bitwise_xor")
+
+    def __invert__(self):
+        return autograd.apply_op("bitwise_not", jnp.bitwise_not, [self])
+
     def __matmul__(self, o):
         return self._binop(o, lambda a, b: a @ b, "matmul")
 

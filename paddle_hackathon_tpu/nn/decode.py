@@ -1,0 +1,142 @@
+"""Beam-search decoding (ref ``python/paddle/nn/decode.py`` —
+``BeamSearchDecoder`` and ``dynamic_decode``, built in the reference on
+``fluid/layers/rnn.py`` control-flow ops).
+
+TPU-native design: the decode loop runs step-by-step in eager mode (each
+step is jit-fused by XLA); ``gather_tree`` backtracks the beams at the end.
+Scores use log-probabilities; finished beams are frozen by masking their
+step log-probs to one-hot(EOS)=0.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..ops import creation, manipulation, math as _math, search
+from . import functional as F
+from .layer import Layer
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+
+
+class BeamSearchDecoder:
+    """Beam-search wrapper around a cell (ref decode.py BeamSearchDecoder).
+
+    ``embedding_fn`` maps token ids -> embeddings; ``output_fn`` maps cell
+    outputs -> vocab logits (both optional if the cell does it).
+    """
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    # -- helpers (shapes: B=batch, W=beam, V=vocab) ------------------------
+    def _merge(self, x):  # (B, W, ...) -> (B*W, ...)
+        return x.reshape((-1,) + tuple(x.shape[2:]))
+
+    def _split(self, x, batch):  # (B*W, ...) -> (B, W, ...)
+        return x.reshape((batch, self.beam_size) + tuple(x.shape[1:]))
+
+    def initialize(self, initial_cell_states):
+        """Tile cell states across beams; first beam active, rest -inf."""
+        def tile(s):
+            v = _t(s)._value
+            b = v.shape[0]
+            return Tensor(jnp.repeat(v, self.beam_size, axis=0))
+        cell_states = _tree_map(tile, initial_cell_states)
+        batch = _t(_tree_first(initial_cell_states))._value.shape[0]
+        ids = creation.full([batch, self.beam_size], self.start_token, "int64")
+        log_probs = Tensor(jnp.tile(
+            jnp.asarray([[0.0] + [-1e9] * (self.beam_size - 1)], jnp.float32),
+            (batch, 1)))
+        finished = Tensor(jnp.zeros((batch, self.beam_size), bool))
+        return ids, cell_states, log_probs, finished
+
+    def step(self, inputs, states, log_probs, finished):
+        """One decode step: expand each beam over the vocab, take top-W."""
+        if self.embedding_fn is not None:
+            inputs = self.embedding_fn(inputs)
+        batch = inputs.shape[0]
+        flat_in = self._merge(inputs) if inputs._value.ndim > 2 else inputs
+        out, next_states = self.cell(flat_in, states)
+        if self.output_fn is not None:
+            out = self.output_fn(out)
+        logits = out._value  # (B*W, V)
+        vocab = logits.shape[-1]
+        step_lp = jax.nn.log_softmax(logits, -1)
+        step_lp = step_lp.reshape(batch, self.beam_size, vocab)
+        # frozen beams only extend with EOS at 0 cost
+        eos = jnp.full((vocab,), -1e9).at[self.end_token].set(0.0)
+        fin = finished._value[..., None]
+        step_lp = jnp.where(fin, eos, step_lp)
+        total = log_probs._value[..., None] + step_lp  # (B, W, V)
+        flat = total.reshape(batch, -1)
+        top_lp, top_idx = jax.lax.top_k(flat, self.beam_size)
+        parent = (top_idx // vocab).astype(jnp.int64)  # (B, W)
+        token = (top_idx % vocab).astype(jnp.int64)
+        # reorder states by parent beam
+        def reorder(s):
+            v = _t(s)._value.reshape((batch, self.beam_size) + _t(s)._value.shape[1:])
+            g = jnp.take_along_axis(
+                v, parent.reshape((batch, self.beam_size) + (1,) * (v.ndim - 2)),
+                axis=1)
+            return Tensor(g.reshape((-1,) + tuple(v.shape[2:])))
+        next_states = _tree_map(reorder, next_states)
+        new_fin = jnp.take_along_axis(finished._value, parent, 1) | (
+            token == self.end_token)
+        return (Tensor(token), Tensor(parent), next_states,
+                Tensor(top_lp), Tensor(new_fin))
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=64, output_time_major=False,
+                   **kwargs):
+    """Run the decoder until all beams finish or max steps (ref
+    decode.py dynamic_decode). Returns (ids, final_log_probs): ids of shape
+    (B, T, W) — backtracked with gather_tree."""
+    ids, states, log_probs, finished = decoder.initialize(inits)
+    step_ids = [ids]  # predicted tokens per step
+    parents = []
+    tokens = ids
+    for _ in range(int(max_step_num)):
+        token, parent, states, log_probs, finished = decoder.step(
+            tokens, states, log_probs, finished)
+        step_ids.append(token)
+        parents.append(parent)
+        tokens = token
+        if bool(finished._value.all()):
+            break
+    ids_seq = manipulation.stack(step_ids[1:], axis=0)  # (T, B, W)
+    par_seq = manipulation.stack(parents, axis=0)
+    final = F.gather_tree(ids_seq, par_seq)  # (T, B, W)
+    out = manipulation.transpose(final, [1, 0, 2])  # (B, T, W)
+    if output_time_major:
+        out = final
+    return out, log_probs
+
+
+def _tree_map(fn, tree):
+    if isinstance(tree, (list, tuple)):
+        return type(tree)(_tree_map(fn, t) for t in tree)
+    if isinstance(tree, dict):
+        return {k: _tree_map(fn, v) for k, v in tree.items()}
+    return fn(tree)
+
+
+def _tree_first(tree):
+    if isinstance(tree, (list, tuple)):
+        return _tree_first(tree[0])
+    if isinstance(tree, dict):
+        return _tree_first(next(iter(tree.values())))
+    return tree
+
+
+import jax  # noqa: E402  (used in step for top_k/log_softmax)

@@ -199,3 +199,25 @@ def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
             y = onehot + y - jax.lax.stop_gradient(y)
         return y
     return apply_op("gumbel_softmax", fn, [_t(x)])
+
+
+def tanh_(x, name=None):
+    """In-place tanh (tape-consistent rebind, see ops.inplace)."""
+    from ...ops import inplace as _inp
+    from ...ops import math as _math
+    return _inp._rebind(_t(x), _math.tanh(x))
+
+
+def elu_(x, alpha=1.0, name=None):
+    from ...ops import inplace as _inp
+    return _inp._rebind(_t(x), elu(x, alpha))
+
+
+def relu_(x, name=None):
+    from ...ops import inplace as _inp
+    return _inp._rebind(_t(x), relu(x))
+
+
+def softmax_(x, axis=-1, dtype=None, name=None):
+    from ...ops import inplace as _inp
+    return _inp._rebind(_t(x), softmax(x, axis=axis, dtype=dtype))

@@ -326,3 +326,102 @@ def bilinear(x1, x2, weight, bias=None, name=None):
     if bias is not None:
         args.append(_t(bias))
     return apply_op("bilinear", fn, args)
+
+
+def diag_embed(input, offset=0, dim1=-2, dim2=-1, name=None):  # noqa: A002
+    """Batch diagonal embed (ref phi DiagEmbedKernel)."""
+    def fn(v):
+        n = v.shape[-1] + abs(offset)
+        base = jnp.zeros(v.shape[:-1] + (n, n), v.dtype)
+        i = jnp.arange(v.shape[-1])
+        r = i + max(-offset, 0)
+        c = i + max(offset, 0)
+        out = base.at[..., r, c].set(v)
+        nd = out.ndim
+        d1, d2 = dim1 % nd, dim2 % nd
+        # move the two trailing matrix dims to (dim1, dim2)
+        perm = [ax for ax in range(nd) if ax not in (nd - 2, nd - 1)]
+        order = sorted([(d1, nd - 2), (d2, nd - 1)])
+        for pos, src in order:
+            perm.insert(pos, src)
+        return jnp.transpose(out, perm)
+    return apply_op("diag_embed", fn, [_t(input)])
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    l, r, top, bot = (padding.tolist() if isinstance(padding, Tensor)
+                      else list(padding))
+    def fn(v):
+        if data_format == "NCHW":
+            cfg = [(0, 0), (0, 0), (top, bot), (l, r)]
+        else:
+            cfg = [(0, 0), (top, bot), (l, r), (0, 0)]
+        return jnp.pad(v, cfg)
+    return apply_op("zeropad2d", fn, [_t(x)])
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW",
+                   name=None):
+    """Temporal Shift Module op (ref phi TemporalShiftKernel): shift a
+    fraction of channels forward/backward along the segment (time) axis."""
+    def fn(v):
+        if data_format == "NHWC":
+            v = jnp.moveaxis(v, -1, 1)
+        nt, c, h, w = v.shape
+        n = nt // seg_num
+        v5 = v.reshape(n, seg_num, c, h, w)
+        c1 = int(c * shift_ratio)
+        c2 = int(c * 2 * shift_ratio)
+        back = jnp.pad(v5[:, 1:, :c1], [(0, 0), (0, 1), (0, 0), (0, 0), (0, 0)])
+        fwd = jnp.pad(v5[:, :-1, c1:c2], [(0, 0), (1, 0), (0, 0), (0, 0), (0, 0)])
+        keep = v5[:, :, c2:]
+        out = jnp.concatenate([back, fwd, keep], axis=2)
+        out = out.reshape(nt, c, h, w)
+        if data_format == "NHWC":
+            out = jnp.moveaxis(out, 1, -1)
+        return out
+    return apply_op("temporal_shift", fn, [_t(x)])
+
+
+def gather_tree(ids, parents):
+    """Backtrack beam-search parent pointers into full sequences
+    (ref phi GatherTreeKernel). ids/parents: (T, B, beam)."""
+    def fn(i, par):
+        T = i.shape[0]
+        def step(carry, t):
+            beams = carry  # (B, beam) beam index at time t+1
+            out = jnp.take_along_axis(i[t], beams, axis=-1)
+            nxt = jnp.take_along_axis(par[t], beams, axis=-1)
+            return nxt, out
+        init = jnp.broadcast_to(jnp.arange(i.shape[-1]), i.shape[1:])
+        _, outs = jax.lax.scan(step, init, jnp.arange(T - 1, -1, -1))
+        return jnp.flip(outs, 0)
+    from ...core import autograd as _ag
+    with _ag.no_grad():
+        return apply_op("gather_tree", fn, [_t(ids), _t(parents)])
+
+
+def sparse_attention(query, key, value, sparse_csr_offset, sparse_csr_columns,
+                     key_padding_mask=None, attn_mask=None, name=None):
+    """Block-sparse attention with a CSR connectivity pattern (ref
+    ``operators/sparse_attention_op.cu``). Computed as dense attention with
+    a -inf mask built from the CSR pattern — XLA fuses the masking; a Pallas
+    blocked kernel (incubate.flash_attention) is the long-context path."""
+    def fn(q, k, v, off, cols):
+        b, h, s, d = q.shape
+        logits = jnp.einsum("bhsd,bhtd->bhst", q, k) / (d ** 0.5)
+        pos = jnp.arange(cols.shape[-1])
+
+        def one_mask(off_r, cols_r):
+            # row id of each nnz: searchsorted over cumulative offsets
+            row = jnp.clip(jnp.searchsorted(off_r, pos, side="right") - 1,
+                           0, s - 1)
+            return jnp.zeros((s, s), bool).at[row, cols_r].set(True)
+
+        mask = jax.vmap(jax.vmap(one_mask))(off, cols)  # (b, h, s, s)
+        logits = jnp.where(mask, logits, -1e30)
+        probs = jax.nn.softmax(logits, -1)
+        return jnp.einsum("bhst,bhtd->bhsd", probs, v)
+    return apply_op("sparse_attention", fn,
+                    [_t(query), _t(key), _t(value),
+                     _t(sparse_csr_offset), _t(sparse_csr_columns)])

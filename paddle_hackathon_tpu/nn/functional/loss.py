@@ -286,3 +286,162 @@ def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
     if normalizer is not None:
         args.append(_t(normalizer))
     return apply_op("sigmoid_focal_loss", fn, args)
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):  # noqa: A002
+    """Dice loss for segmentation (ref phi DiceLossKernel): label is
+    int class ids with trailing dim 1."""
+    def fn(x, y):
+        y1 = jax.nn.one_hot(y.squeeze(-1), x.shape[-1], dtype=x.dtype)
+        red = tuple(range(1, x.ndim))
+        inter = jnp.sum(x * y1, axis=red)
+        union = jnp.sum(x, axis=red) + jnp.sum(y1, axis=red)
+        return jnp.mean(1.0 - (2.0 * inter + epsilon) / (union + epsilon))
+    return apply_op("dice_loss", fn, [_t(input), _t(label)])
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):  # noqa: A002
+    """Negative log loss for binary probability input (ref log_loss_op)."""
+    def fn(x, y):
+        return -y * jnp.log(x + epsilon) - (1.0 - y) * jnp.log(1.0 - x + epsilon)
+    return apply_op("log_loss", fn, [_t(input), _t(label)])
+
+
+def soft_margin_loss(input, label, reduction="mean", name=None):  # noqa: A002
+    def fn(x, y):
+        return _reduce(jnp.log1p(jnp.exp(-y * x)), reduction)
+    return apply_op("soft_margin_loss", fn, [_t(input), _t(label)])
+
+
+def multi_label_soft_margin_loss(input, label, weight=None,  # noqa: A002
+                                 reduction="mean", name=None):
+    def fn(x, y, *w):
+        loss = -(y * jax.nn.log_sigmoid(x) + (1 - y) * jax.nn.log_sigmoid(-x))
+        if w:
+            loss = loss * w[0]
+        return _reduce(jnp.mean(loss, axis=-1), reduction)
+    args = [_t(input), _t(label)] + ([_t(weight)] if weight is not None else [])
+    return apply_op("multi_label_soft_margin_loss", fn, args)
+
+
+def triplet_margin_with_distance_loss(input, positive, negative,  # noqa: A002
+                                      distance_function=None, margin=1.0,
+                                      swap=False, reduction="mean", name=None):
+    dfn = distance_function
+    if dfn is None:
+        def dfn(a, b):
+            from ...ops import linalg as _lin
+            return _lin.norm(a - b, p=2, axis=-1)
+    dp = dfn(_t(input), _t(positive))
+    dn = dfn(_t(input), _t(negative))
+    if swap:
+        dpn = dfn(_t(positive), _t(negative))
+        dn = apply_op("minimum", jnp.minimum, [_t(dn), _t(dpn)])
+    def fn(a, b):
+        return _reduce(jnp.maximum(a - b + margin, 0.0), reduction)
+    return apply_op("triplet_margin_with_distance_loss", fn, [_t(dp), _t(dn)])
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002, name=None):
+    """N-pair loss (ref npair_loss in python/paddle/nn/functional/loss.py):
+    softmax-CE over anchor·positiveᵀ similarity with same-label targets."""
+    def fn(a, p, y):
+        reg = l2_reg * (jnp.mean(jnp.sum(a * a, -1)) + jnp.mean(jnp.sum(p * p, -1))) * 0.25
+        sim = a @ p.T
+        same = (y[:, None] == y[None, :]).astype(a.dtype)
+        tgt = same / jnp.sum(same, -1, keepdims=True)
+        ce = jnp.mean(jnp.sum(-tgt * jax.nn.log_softmax(sim, -1), -1))
+        return ce + reg
+    return apply_op("npair_loss", fn, [_t(anchor), _t(positive), _t(labels)])
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,  # noqa: A002
+                  path_table=None, path_code=None, is_sparse=False, name=None):
+    """Hierarchical sigmoid loss (ref phi HSigmoidLossKernel). Default
+    complete-binary-tree coding over ``num_classes`` leaves; custom trees via
+    path_table (node ids per step) + path_code (0/1 branch per step)."""
+    import numpy as np_
+
+    code_len = max(int(np_.ceil(np_.log2(max(num_classes, 2)))), 1)
+    if path_table is None:
+        # complete binary tree: internal node ids 0..num_classes-2; leaf c's
+        # path from root follows the bits of (c + num_classes) >> k.
+        # Shorter-than-code_len paths are padded with node id -1, which the
+        # kernel masks out (the reference masks by per-leaf code length).
+        tab, code = [], []
+        for c in range(num_classes):
+            node, bits = [], []
+            idx = c + num_classes  # heap position of the leaf
+            while idx > 1:
+                parent = idx // 2
+                node.append(parent - 1)      # internal node id
+                bits.append(idx & 1)         # which child we are
+                idx = parent
+            node = node[::-1] + [-1] * (code_len - len(node))
+            bits = bits[::-1] + [0] * (code_len - len(bits))
+            tab.append(node[:code_len])
+            code.append(bits[:code_len])
+        path_table = Tensor(jnp.asarray(tab, jnp.int32))
+        path_code = Tensor(jnp.asarray(code, jnp.int32))
+
+    def fn(x, y, w, tab, code, *b):
+        y = y.reshape(-1)
+        nodes = tab[y]                         # (B, L) internal node ids
+        valid = (nodes >= 0).astype(x.dtype)   # padded steps contribute 0
+        nodes = jnp.maximum(nodes, 0)
+        bits = code[y].astype(x.dtype)         # (B, L) 0/1
+        wv = w[nodes]                          # (B, L, D)
+        logits = jnp.einsum("bld,bd->bl", wv, x)
+        if b:
+            logits = logits + b[0].reshape(-1)[nodes]
+        # P(branch) = sigmoid(logit) if bit==1 else sigmoid(-logit)
+        sgn = 2.0 * bits - 1.0
+        return jnp.mean(-jnp.sum(jax.nn.log_sigmoid(sgn * logits) * valid, -1))
+
+    args = [_t(input), _t(label), _t(weight), _t(path_table), _t(path_code)]
+    if bias is not None:
+        args.append(_t(bias))
+    return apply_op("hsigmoid_loss", fn, args)
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5, margin3=0.0,
+                         scale=64.0, group=None, return_softmax=False,
+                         reduction="mean", name=None):
+    """ArcFace/CosFace-style margin softmax CE (ref
+    ``operators/margin_cross_entropy_op.cu``). ``logits`` are cosines; the
+    target class angle is transformed cos(m1*θ + m2) - m3, then scaled.
+    TP vocab-sharded variant: shard logits over the model axis with pjit —
+    the softmax is computed globally by XLA."""
+    def fn(lg, y):
+        y = y.reshape(-1)
+        onehot = jax.nn.one_hot(y, lg.shape[-1], dtype=lg.dtype)
+        theta = jnp.arccos(jnp.clip(lg, -1.0 + 1e-7, 1.0 - 1e-7))
+        tgt = jnp.cos(margin1 * theta + margin2) - margin3
+        out = jnp.where(onehot > 0, tgt, lg) * scale
+        logp = jax.nn.log_softmax(out, -1)
+        loss = -jnp.sum(onehot * logp, -1)
+        return _reduce(loss, reduction), jnp.exp(logp)
+    loss, sm = apply_op("margin_cross_entropy", fn,
+                        [_t(logits), _t(label)], n_outputs=2)
+    return (loss, sm) if return_softmax else loss
+
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    """Sample negative class centers (ref class_center_sample_op): returns
+    (remapped_label, sampled_class_indices). Positive classes always kept;
+    negatives fill up to num_samples deterministically from the generator."""
+    from ...core import random as core_random
+    lab = _t(label)
+    y = lab._value.reshape(-1)
+    pos = jnp.unique(y, size=min(int(y.size), num_classes),
+                     fill_value=num_classes)
+    key = core_random.split_key()
+    perm = jax.random.permutation(key, num_classes)
+    ispos = jnp.isin(perm, pos)
+    order = jnp.argsort(~ispos, stable=True)  # positives first, then random negs
+    sampled = jnp.sort(perm[order][:num_samples])
+    remap = jnp.searchsorted(sampled, y)
+    from ...core import autograd as _ag
+    with _ag.no_grad():
+        return (Tensor(remap.reshape(lab._value.shape).astype(y.dtype)),
+                Tensor(sampled.astype(y.dtype)))

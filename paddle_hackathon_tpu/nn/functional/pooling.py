@@ -73,9 +73,10 @@ def _ceil_extend(pad, v_shape, window, strides, channel_last, n):
 def _max_pool(x, kernel_size, stride, padding, ceil_mode, n, channel_last,
               name, return_mask=False):
     if return_mask:
-        raise NotImplementedError(
-            "return_mask=True is not supported (no argmax pooling op on the "
-            "XLA path yet)")
+        if ceil_mode:
+            raise NotImplementedError("return_mask with ceil_mode")
+        return _max_pool_with_mask(x, kernel_size, stride, padding, n,
+                                   channel_last, name)
     window = _tuple(kernel_size, n)
     strides = _tuple(stride, n) if stride is not None else window
     pad = _pad_spec(padding, n)
@@ -211,3 +212,116 @@ def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
 def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
     return _adaptive_pool(x, output_size, 3, False, jnp.max,
                           "adaptive_max_pool3d")
+
+
+def _max_pool_with_mask(x, kernel_size, stride, padding, n, channel_last,
+                        name):
+    """Max pool returning (out, mask) where mask holds flat spatial argmax
+    indices into the unpadded input (paddle max_pool return_mask semantics;
+    ref phi MaxPoolWithIndexKernel). Gather-based: O(out*k) reads — XLA
+    turns the window gather into vectorized loads."""
+    window = _tuple(kernel_size, n)
+    strides = _tuple(stride, n) if stride is not None else window
+    pad = _pad_spec(padding, n)
+    if isinstance(pad, str):
+        raise NotImplementedError("string padding with return_mask")
+
+    def fn(v):
+        if channel_last:  # normalize to channel-first for the math
+            v = jnp.moveaxis(v, -1, 1)
+        spatial = v.shape[2:]
+        neg = (-jnp.inf if jnp.issubdtype(v.dtype, jnp.floating)
+               else jnp.iinfo(v.dtype).min)
+        cfg = [(0, 0), (0, 0)] + [tuple(p) for p in pad]
+        vp = jnp.pad(v, cfg, constant_values=neg)
+        # windowed view: iteratively gather each spatial dim
+        out_sizes = [ (spatial[i] + sum(pad[i]) - window[i]) // strides[i] + 1
+                      for i in range(n) ]
+        w = vp
+        # after loop: shape (N, C, o1, k1, o2, k2, ...)
+        for i in range(n):
+            axis = 2 + 2 * i  # current spatial dim position
+            starts = jnp.arange(out_sizes[i]) * strides[i]
+            idx = starts[:, None] + jnp.arange(window[i])[None, :]
+            w = jnp.take(w, idx, axis=axis)
+        # -> (N, C, o1..on, k1..kn)
+        perm = ([0, 1] + [2 + 2 * i for i in range(n)]
+                + [3 + 2 * i for i in range(n)])
+        w = jnp.transpose(w, perm)
+        lead = w.shape[:2 + n]
+        w = w.reshape(lead + (-1,))
+        out = jnp.max(w, -1)
+        local = jnp.argmax(w, -1)  # flat index within the window
+        # local -> per-dim offsets -> global unpadded flat index
+        flat = jnp.zeros(local.shape, jnp.int32)
+        rem = local
+        for i in range(n):
+            kprod = 1
+            for j in range(i + 1, n):
+                kprod *= window[j]
+            off = rem // kprod
+            rem = rem % kprod
+            starts = (jnp.arange(out_sizes[i]) * strides[i] - pad[i][0])
+            shape = [1] * (2 + n)
+            shape[2 + i] = out_sizes[i]
+            gpos = starts.reshape(shape) + off
+            sprod = 1
+            for j in range(i + 1, n):
+                sprod *= spatial[j]
+            flat = flat + gpos.astype(jnp.int32) * sprod
+        if channel_last:
+            out = jnp.moveaxis(out, 1, -1)
+            flat = jnp.moveaxis(flat, 1, -1)
+        return out, flat
+
+    return apply_op(name, fn, [_t(x)], n_outputs=2)
+
+
+def _max_unpool(x, indices, kernel_size, stride, padding, output_size, n,
+                channel_last, name):
+    """Scatter pooled values back by mask indices (ref phi MaxUnpool kernels)."""
+    window = _tuple(kernel_size, n)
+    strides = _tuple(stride, n) if stride is not None else window
+    pad = _pad_spec(padding, n)
+
+    def fn(v, idx):
+        if channel_last:
+            v = jnp.moveaxis(v, -1, 1)
+            idx = jnp.moveaxis(idx, -1, 1)
+        spatial = v.shape[2:]
+        if output_size is not None:
+            out_sp = tuple(output_size)[-n:]
+        else:
+            out_sp = tuple((spatial[i] - 1) * strides[i] - 2 * pad[i][0]
+                           + window[i] for i in range(n))
+        os = 1
+        for s in out_sp:
+            os *= s
+        nb, c = v.shape[0], v.shape[1]
+        vf = v.reshape(nb * c, -1)
+        idxf = idx.reshape(nb * c, -1).astype(jnp.int32)
+        scat = jax.vmap(lambda i, val: jnp.zeros((os,), v.dtype).at[i].set(val))
+        out = scat(idxf, vf).reshape((nb, c) + out_sp)
+        if channel_last:
+            out = jnp.moveaxis(out, 1, -1)
+        return out
+
+    return apply_op(name, fn, [_t(x), _t(indices)])
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+    return _max_unpool(x, indices, kernel_size, stride, padding, output_size,
+                       1, data_format == "NLC", "max_unpool1d")
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+    return _max_unpool(x, indices, kernel_size, stride, padding, output_size,
+                       2, data_format == "NHWC", "max_unpool2d")
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+    return _max_unpool(x, indices, kernel_size, stride, padding, output_size,
+                       3, data_format == "NDHWC", "max_unpool3d")

@@ -180,3 +180,16 @@ class GLU(Layer):
 
     def forward(self, x):
         return F.glu(x, self.axis)
+
+
+Silu = SiLU  # paddle spells it Silu (``python/paddle/nn/__init__.py``)
+
+
+class Softmax2D(Layer):
+    """Softmax over the channel axis of NCHW inputs (ref nn.Softmax2D)."""
+
+    def __init__(self, name=None):
+        super().__init__()
+
+    def forward(self, x):
+        return F.softmax(x, axis=-3)

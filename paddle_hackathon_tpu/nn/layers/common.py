@@ -232,3 +232,19 @@ class Fold(Layer):
     def forward(self, x):
         return F.fold(x, self.output_sizes, self.kernel_sizes, self.strides,
                       self.paddings, self.dilations)
+
+
+class PairwiseDistance(Layer):
+    """p-norm distance between pairs (ref nn.PairwiseDistance,
+    ``python/paddle/nn/layer/distance.py``)."""
+
+    def __init__(self, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+        super().__init__()
+        self.p = p
+        self.epsilon = epsilon
+        self.keepdim = keepdim
+
+    def forward(self, x, y):
+        from ...ops import linalg as _lin
+        diff = x - y + self.epsilon
+        return _lin.norm(diff, p=self.p, axis=-1, keepdim=self.keepdim)

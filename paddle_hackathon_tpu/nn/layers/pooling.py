@@ -24,13 +24,15 @@ class _Pool(Layer):
 class MaxPool1D(_Pool):
     def __init__(self, kernel_size, stride=None, padding=0, return_mask=False,
                  ceil_mode=False, name=None):
-        super().__init__("max_pool1d", kernel_size, stride, padding)
+        super().__init__("max_pool1d", kernel_size, stride, padding,
+                         return_mask=return_mask, ceil_mode=ceil_mode)
 
 
 class MaxPool2D(_Pool):
     def __init__(self, kernel_size, stride=None, padding=0, return_mask=False,
                  ceil_mode=False, data_format="NCHW", name=None):
         super().__init__("max_pool2d", kernel_size, stride, padding,
+                         return_mask=return_mask, ceil_mode=ceil_mode,
                          data_format=data_format)
 
 
@@ -38,6 +40,7 @@ class MaxPool3D(_Pool):
     def __init__(self, kernel_size, stride=None, padding=0, return_mask=False,
                  ceil_mode=False, data_format="NCDHW", name=None):
         super().__init__("max_pool3d", kernel_size, stride, padding,
+                         return_mask=return_mask, ceil_mode=ceil_mode,
                          data_format=data_format)
 
 
@@ -104,3 +107,41 @@ class AdaptiveMaxPool2D(_AdaptivePool):
 class AdaptiveMaxPool3D(_AdaptivePool):
     def __init__(self, output_size, return_mask=False, name=None):
         super().__init__("adaptive_max_pool3d", output_size)
+
+
+class _MaxUnPool(Layer):
+    def __init__(self, fn_name, kernel_size, stride=None, padding=0,
+                 data_format=None, output_size=None):
+        super().__init__()
+        self._fn = getattr(F, fn_name)
+        self.kernel_size = kernel_size
+        self.stride = stride
+        self.padding = padding
+        self.data_format = data_format
+        self.output_size = output_size
+
+    def forward(self, x, indices):
+        return self._fn(x, indices, self.kernel_size, self.stride,
+                        self.padding, data_format=self.data_format,
+                        output_size=self.output_size)
+
+
+class MaxUnPool1D(_MaxUnPool):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+        super().__init__("max_unpool1d", kernel_size, stride, padding,
+                         data_format, output_size)
+
+
+class MaxUnPool2D(_MaxUnPool):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+        super().__init__("max_unpool2d", kernel_size, stride, padding,
+                         data_format, output_size)
+
+
+class MaxUnPool3D(_MaxUnPool):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+        super().__init__("max_unpool3d", kernel_size, stride, padding,
+                         data_format, output_size)

@@ -243,3 +243,32 @@ class GRU(_RNNBase):
                  direction="forward", time_major=False, dropout=0.0, **kwargs):
         super().__init__("GRU", input_size, hidden_size, num_layers,
                          direction, time_major, dropout)
+
+
+RNNCellBase = _RNNCellBase  # public name (ref nn.RNNCellBase, rnn.py:143)
+
+
+class BiRNN(Layer):
+    """Bidirectional cell wrapper (ref nn.BiRNN): runs ``cell_fw`` forward
+    and ``cell_bw`` reversed, concatenating outputs on the feature axis."""
+
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.cell_fw = cell_fw
+        self.cell_bw = cell_bw
+        self.rnn_fw = RNN(cell_fw, is_reverse=False, time_major=time_major)
+        self.rnn_bw = RNN(cell_bw, is_reverse=True, time_major=time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ...ops import manipulation as M
+        if sequence_length is not None:
+            raise NotImplementedError(
+                "BiRNN with sequence_length (variable-length flip) is not "
+                "supported; mask or bucket the batch instead")
+        if initial_states is None:
+            fw0 = bw0 = None
+        else:
+            fw0, bw0 = initial_states
+        out_f, st_f = self.rnn_fw(inputs, fw0, sequence_length)
+        out_b, st_b = self.rnn_bw(inputs, bw0, sequence_length)
+        return M.concat([out_f, out_b], axis=-1), (st_f, st_b)

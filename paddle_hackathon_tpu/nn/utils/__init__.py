@@ -1,0 +1,81 @@
+"""nn.utils — weight re-parametrizations (ref ``python/paddle/nn/utils/``:
+``weight_norm_hook.py``, ``spectral_norm_hook.py``) and param vector helpers.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.autograd import apply_op
+from ...core.tensor import Tensor
+from ..parameter import Parameter
+
+
+def _norm_except(v, dim):
+    """L2 norm over all axes except ``dim``."""
+    if dim is None:
+        return jnp.sqrt(jnp.sum(v * v))
+    axes = tuple(i for i in range(v.ndim) if i != dim)
+    return jnp.sqrt(jnp.sum(v * v, axis=axes, keepdims=True))
+
+
+def weight_norm(layer, name="weight", dim=0):
+    """Decompose ``layer.<name>`` into magnitude ``<name>_g`` and direction
+    ``<name>_v``; the effective weight g * v/||v|| is recomputed before
+    every forward (ref weight_norm_hook.py)."""
+    w = getattr(layer, name)
+    v0 = w._value
+    g0 = _norm_except(v0, dim)
+    del layer._parameters[name]
+    layer.add_parameter(name + "_v", Parameter(v0, trainable=True))
+    layer.add_parameter(name + "_g", Parameter(jnp.asarray(g0), trainable=True))
+
+    def _recompute(lyr, inputs):
+        v = getattr(lyr, name + "_v")
+        g = getattr(lyr, name + "_g")
+        def fn(vv, gg):
+            return gg * vv / (_norm_except(vv, dim) + 1e-12)
+        # plain attribute (not a registered parameter): the effective weight
+        object.__setattr__(lyr, name, apply_op("weight_norm", fn, [v, g]))
+        return None
+
+    handle = layer.register_forward_pre_hook(_recompute)
+    layer._weight_norm_handle = (handle, name, dim)
+    _recompute(layer, None)
+    return layer
+
+
+def remove_weight_norm(layer, name="weight"):
+    """Undo :func:`weight_norm`, baking the current effective weight back
+    into a single parameter."""
+    handle, n, dim = getattr(layer, "_weight_norm_handle", (None, name, 0))
+    if handle is not None:
+        handle.remove()
+    v = getattr(layer, name + "_v")
+    g = getattr(layer, name + "_g")
+    eff = g._value * v._value / (np.asarray(_norm_except(v._value, dim)) + 1e-12)
+    del layer._parameters[name + "_v"]
+    del layer._parameters[name + "_g"]
+    if hasattr(layer, "_weight_norm_handle"):
+        del layer._weight_norm_handle
+    if name in layer.__dict__:
+        del layer.__dict__[name]
+    layer.add_parameter(name, Parameter(jnp.asarray(eff), trainable=True))
+    return layer
+
+
+def parameters_to_vector(parameters, name=None):
+    """Flatten a parameter list into one 1-D tensor (ref utils.py)."""
+    vals = [p._value.reshape(-1) for p in parameters]
+    return Tensor(jnp.concatenate(vals))
+
+
+def vector_to_parameters(vec, parameters, name=None):
+    """Scatter a flat vector back into the parameter list."""
+    off = 0
+    v = vec._value if isinstance(vec, Tensor) else jnp.asarray(vec)
+    for p in parameters:
+        n = int(np.prod(p.shape)) if p.shape else 1
+        p._set_value(v[off:off + n].reshape(tuple(p.shape)))
+        off += n

@@ -91,3 +91,25 @@ def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
 OP_TABLE["diagonal"] = diagonal
 _patch_tensor_methods()
 Tensor.diagonal = diagonal
+
+# In-place variants (<op>_) — built from the out-of-place table and patched
+# onto Tensor (ref yaml `inplace:` annotations; varbase_patch_methods.py).
+from . import inplace as _inplace_mod  # noqa: E402
+
+_ns = {}
+for _mod in (math, manipulation, linalg, search, creation, random):
+    for _name in dir(_mod):
+        if not _name.startswith("_"):
+            _ns.setdefault(_name, getattr(_mod, _name))
+for _name, _fn in _inplace_mod.install(_ns).items():
+    globals()[_name] = _fn
+    OP_TABLE.setdefault(_name, _fn)
+
+for _name in ("cond", "lu", "lu_unpack", "tensordot", "logit", "stanh",
+              "rad2deg", "deg2rad", "logcumsumexp", "renorm", "nanmedian",
+              "nanquantile", "tolist", "is_complex", "is_integer",
+              "is_floating_point", "is_empty", "rank", "increment"):
+    _fn = globals().get(_name) or OP_TABLE.get(_name)
+    if _fn is not None and not hasattr(Tensor, _name):
+        setattr(Tensor, _name, _fn)
+        OP_TABLE.setdefault(_name, _fn)

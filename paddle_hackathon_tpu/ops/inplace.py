@@ -1,0 +1,68 @@
+"""In-place op variants (``add_``, ``reshape_``, ``tanh_``...).
+
+Ref: the reference generates ``<op>_`` inplace entry points from
+``legacy_api.yaml`` (``inplace : (x -> out)`` annotations, e.g. adam_
+``legacy_api.yaml:51``) and monkey-patches them onto Tensor
+(``fluid/dygraph/varbase_patch_methods.py``).
+
+Here each inplace op runs the taped out-of-place computation and rebinds the
+tensor's identity to the result (value + grad node), the same tape-consistent
+rebind ``Tensor.__setitem__`` uses. Gradients therefore flow exactly as for
+the out-of-place op, matching paddle's inplace autograd semantics.
+"""
+
+from __future__ import annotations
+
+from ..core.tensor import Tensor
+
+_INPLACE_SPECS = [
+    # (inplace name, out-of-place op name in the ops namespace)
+    ("add_", "add"), ("subtract_", "subtract"), ("multiply_", "multiply"),
+    ("divide_", "divide"), ("remainder_", "remainder"),
+    ("clip_", "clip"), ("scale_", "scale"), ("lerp_", "lerp"),
+    ("pow_", "pow"),
+    ("exp_", "exp"), ("sqrt_", "sqrt"), ("rsqrt_", "rsqrt"),
+    ("ceil_", "ceil"), ("floor_", "floor"), ("round_", "round"),
+    ("reciprocal_", "reciprocal"), ("erfinv_", "erfinv"),
+    ("tanh_", "tanh"), ("sigmoid_", "sigmoid"), ("abs_", "abs"),
+    ("neg_", "neg"), ("sign_", "sign"), ("trunc_", "trunc"),
+    ("frac_", "frac"),
+    ("reshape_", "reshape"), ("squeeze_", "squeeze"),
+    ("unsqueeze_", "unsqueeze"), ("flatten_", "flatten"),
+    ("scatter_", "scatter"), ("put_along_axis_", "put_along_axis"),
+    ("gather_", "gather"), ("cast_", "cast"),
+]
+
+
+def _rebind(x: Tensor, out: Tensor) -> Tensor:
+    x._value = out._value
+    x._grad_node = out._grad_node
+    x._out_idx = out._out_idx
+    x.stop_gradient = out.stop_gradient
+    return x
+
+
+def _make_inplace(base):
+    def op(x, *args, **kwargs):
+        return _rebind(x, base(x, *args, **kwargs))
+    op.__name__ = base.__name__ + "_"
+    op.__qualname__ = op.__name__
+    op.__doc__ = (f"In-place variant of ``{base.__name__}`` (tape-consistent "
+                  "rebind; ref yaml `inplace:` entries).")
+    return op
+
+
+def install(namespace: dict) -> dict:
+    """Build every inplace op from ``namespace`` (the ops module dict) and
+    patch them onto Tensor. Returns {name: fn} for re-export."""
+    built = {}
+    for iname, oname in _INPLACE_SPECS:
+        base = namespace.get(oname)
+        if base is None:
+            continue
+        fn = _make_inplace(base)
+        fn.__name__ = iname
+        fn.__qualname__ = iname
+        built[iname] = fn
+        setattr(Tensor, iname, fn)
+    return built

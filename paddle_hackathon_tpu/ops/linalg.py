@@ -207,3 +207,67 @@ def corrcoef(x, rowvar=True, name=None):
 def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
     return apply_op("cov",
                     lambda v: jnp.cov(v, rowvar=rowvar, ddof=1 if ddof else 0), [_t(x)])
+
+
+def cond(x, p=None, name=None):
+    """Condition number (ref phi CondKernel). p in {None,'fro','nuc',1,-1,2,-2,inf,-inf}."""
+    def fn(a):
+        pp = 2 if p is None else p
+        if pp in ("fro", "nuc") or isinstance(pp, (int, float)):
+            if pp == "fro":
+                return (jnp.linalg.norm(a, "fro", axis=(-2, -1))
+                        * jnp.linalg.norm(jnp.linalg.inv(a), "fro", axis=(-2, -1)))
+            if pp == "nuc":
+                s = jnp.linalg.svd(a, compute_uv=False)
+                si = jnp.linalg.svd(jnp.linalg.inv(a), compute_uv=False)
+                return s.sum(-1) * si.sum(-1)
+            if pp in (2, -2):
+                s = jnp.linalg.svd(a, compute_uv=False)
+                r = s[..., 0] / s[..., -1]
+                return r if pp == 2 else 1.0 / r
+            return (jnp.linalg.norm(a, pp, axis=(-2, -1))
+                    * jnp.linalg.norm(jnp.linalg.inv(a), pp, axis=(-2, -1)))
+        raise ValueError(f"unsupported p={p!r}")
+    return apply_op("cond", fn, [_t(x)])
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    """LU factorization packed as the reference returns it
+    (ref phi LuKernel): (LU, pivots[, infos])."""
+    import jax.scipy.linalg as jsl
+
+    def fn(a):
+        lu_, piv = jsl.lu_factor(a)
+        return lu_, (piv + 1).astype(jnp.int32)  # 1-based like the reference
+    out, piv = apply_op("lu", fn, [_t(x)], n_outputs=2)
+    if get_infos:
+        from ..core import autograd as _ag
+        with _ag.no_grad():
+            infos = Tensor(jnp.zeros(x._value.shape[:-2] or (1,), jnp.int32))
+        return out, piv, infos
+    return out, piv
+
+
+def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
+    """Unpack lu()'s outputs into P, L, U (ref phi LuUnpackKernel)."""
+    def fn(lu_, piv):
+        m, n = lu_.shape[-2], lu_.shape[-1]
+        k = min(m, n)
+        L = jnp.tril(lu_[..., :, :k], -1) + jnp.eye(m, k, dtype=lu_.dtype)
+        U = jnp.triu(lu_[..., :k, :])
+
+        # pivots (1-based sequential row swaps) -> permutation matrix,
+        # vmapped over any batch dims
+        def perm_mat(pv):
+            perm = jnp.arange(m)
+            for i in range(pv.shape[-1]):
+                j = pv[i] - 1
+                pi, pj = perm[i], perm[j]
+                perm = perm.at[i].set(pj).at[j].set(pi)
+            return jnp.eye(m, dtype=lu_.dtype)[perm].T
+
+        pm = perm_mat
+        for _ in range(piv.ndim - 1):
+            pm = jax.vmap(pm)
+        return pm(piv), L, U
+    return apply_op("lu_unpack", fn, [_t(x), _t(y)], n_outputs=3)

@@ -435,3 +435,27 @@ def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):  # noqa: 
         return jnp.where(in_shard, v - lo, ignore_value)
     with autograd.no_grad():
         return apply_op("shard_index", fn, [_t(input)])
+
+
+def reverse(x, axis, name=None):
+    """Legacy paddle.reverse (= flip; ref reverse_op)."""
+    ax = [axis] if isinstance(axis, int) else list(axis)
+    return apply_op("reverse", lambda v: jnp.flip(v, ax), [_t(x)])
+
+
+def tril_indices(row, col=None, offset=0, dtype="int64", name=None):
+    from ..core.dtype import convert_dtype
+    from ..core import autograd as _ag
+    col = row if col is None else col
+    with _ag.no_grad():
+        r, c = jnp.tril_indices(row, offset, col)
+        return Tensor(jnp.stack([r, c]).astype(convert_dtype(dtype)))
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64", name=None):
+    from ..core.dtype import convert_dtype
+    from ..core import autograd as _ag
+    col = row if col is None else col
+    with _ag.no_grad():
+        r, c = jnp.triu_indices(row, offset, col)
+        return Tensor(jnp.stack([r, c]).astype(convert_dtype(dtype)))

@@ -322,3 +322,130 @@ def count_nonzero(x, axis=None, keepdim=False, name=None):
 def stack(x, axis=0, name=None):
     tensors = [_t(v) for v in x]
     return apply_op("stack", lambda *vs: jnp.stack(vs, axis=axis), tensors)
+
+
+# -- round-out ops (reference top-level exports python/paddle/__init__.py) ---
+def logit(x, eps=None, name=None):
+    """log(x / (1-x)); inputs clamped to [eps, 1-eps] when eps given
+    (ref phi LogitKernel)."""
+    def fn(v):
+        vv = jnp.clip(v, eps, 1.0 - eps) if eps is not None else v
+        return jax.scipy.special.logit(vv)
+    return apply_op("logit", fn, [_t(x)])
+
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    """paddle.stanh: scale_b * tanh(scale_a * x) (ref phi StanhKernel)."""
+    return apply_op("stanh", lambda v: scale_b * jnp.tanh(scale_a * v), [_t(x)])
+
+
+rad2deg = _unary("rad2deg", jnp.rad2deg)
+deg2rad = _unary("deg2rad", jnp.deg2rad)
+
+
+def logcumsumexp(x, axis=None, dtype=None, name=None):
+    def fn(v):
+        vv = v.reshape(-1) if axis is None else v
+        a = 0 if axis is None else int(axis)
+        out = jax.lax.associative_scan(jnp.logaddexp, vv, axis=a)
+        return out.astype(dtype) if dtype else out
+    return apply_op("logcumsumexp", fn, [_t(x)])
+
+
+def renorm(x, p, axis, max_norm, name=None):
+    """Renormalize slices along ``axis`` to at most ``max_norm`` in p-norm
+    (ref phi RenormKernel)."""
+    def fn(v):
+        red = tuple(i for i in range(v.ndim) if i != axis % v.ndim)
+        norms = jnp.sum(jnp.abs(v) ** p, axis=red, keepdims=True) ** (1.0 / p)
+        factor = jnp.where(norms > max_norm, max_norm / (norms + 1e-7), 1.0)
+        return v * factor
+    return apply_op("renorm", fn, [_t(x)])
+
+
+def nanmedian(x, axis=None, keepdim=False, name=None):
+    return apply_op("nanmedian",
+                    lambda v: jnp.nanmedian(v, axis=_axis(axis), keepdims=keepdim),
+                    [_t(x)])
+
+
+def nanquantile(x, q, axis=None, keepdim=False, name=None):
+    return apply_op("nanquantile",
+                    lambda v: jnp.nanquantile(v, q, axis=_axis(axis), keepdims=keepdim),
+                    [_t(x)])
+
+
+def complex(real, imag, name=None):  # noqa: A001
+    """Build a complex tensor from real/imaginary parts (ref phi ComplexKernel)."""
+    return apply_op("complex", jax.lax.complex, [_t(real), _t(imag)])
+
+
+def add_n(inputs, name=None):
+    """Sum a list of tensors (ref sum_op / add_n)."""
+    if isinstance(inputs, Tensor):
+        return inputs
+    tensors = [_t(v) for v in inputs]
+    import functools
+    return apply_op("add_n",
+                    lambda *vs: functools.reduce(jnp.add, vs), tensors)
+
+
+def increment(x, value=1.0, name=None):
+    """In-place add a scalar (ref increment_op); returns ``x``."""
+    x._set_value(x._value + value)
+    return x
+
+
+def tensordot(x, y, axes=2, name=None):
+    def fn(a, b):
+        ax = axes
+        if isinstance(ax, Tensor):
+            ax = ax.tolist()
+        if isinstance(ax, (list, tuple)):
+            ax = tuple(tuple(int(i) for i in (a_ if isinstance(a_, (list, tuple)) else [a_]))
+                       for a_ in ax)
+            if len(ax) == 1:
+                ax = (ax[0], ax[0])
+        return jnp.tensordot(a, b, axes=ax)
+    return apply_op("tensordot", fn, [_t(x), _t(y)])
+
+
+def broadcast_shape(x_shape, y_shape):
+    import numpy as _np
+    return list(_np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def rank(input, name=None):  # noqa: A002
+    with autograd.no_grad():
+        return Tensor(jnp.asarray(_t(input).ndim, jnp.int32))
+
+
+def shape(input, name=None):  # noqa: A002
+    with autograd.no_grad():
+        return Tensor(jnp.asarray(_t(input).shape, jnp.int32))
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def is_complex(x):
+    return jnp.issubdtype(_t(x)._value.dtype, jnp.complexfloating)
+
+
+def is_integer(x):
+    return jnp.issubdtype(_t(x)._value.dtype, jnp.integer)
+
+
+def is_floating_point(x):
+    return jnp.issubdtype(_t(x)._value.dtype, jnp.floating)
+
+
+def is_empty(x, name=None):
+    with autograd.no_grad():
+        return Tensor(jnp.asarray(_t(x)._value.size == 0))
+
+
+def tolist(x):
+    return _t(x).tolist()

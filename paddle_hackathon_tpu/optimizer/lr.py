@@ -272,3 +272,23 @@ class ReduceOnPlateau(LRScheduler):
                 self.last_lr = max(self.last_lr * self.factor, self.min_lr)
                 self.num_bad = 0
                 self.cooldown_counter = self.cooldown
+
+
+class MultiplicativeDecay(LRScheduler):
+    """lr_{t} = lr_{t-1} * lr_lambda(t) (ref optimizer/lr.py:1533)."""
+
+    def __init__(self, learning_rate, lr_lambda, last_epoch=-1, verbose=False):
+        self.lr_lambda = lr_lambda
+        self._cum = 1.0
+        self._cum_epoch = 0
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def get_lr(self):
+        # incremental product (one lambda call per new epoch); a backward
+        # jump (step(epoch=N) with N < current) recomputes from scratch
+        if self.last_epoch < self._cum_epoch:
+            self._cum, self._cum_epoch = 1.0, 0
+        while self._cum_epoch < self.last_epoch:
+            self._cum_epoch += 1
+            self._cum *= self.lr_lambda(self._cum_epoch)
+        return self.base_lr * self._cum

@@ -1,0 +1,166 @@
+"""Custom C++ operator extension.
+
+TPU-native counterpart of the reference's out-of-tree op machinery:
+``PD_BUILD_OP`` (``paddle/phi/api/ext/op_meta_info.h:635``), the runtime
+loader ``framework/custom_operator.cc`` and the JIT build helper
+``paddle.utils.cpp_extension`` (``custom_op`` test suite pattern:
+setup.py/JIT-compiled C++ registered into the framework).
+
+Architecture (necessarily different from CUDA custom ops): TPU device code
+is only programmable through XLA/Pallas, so a *C++ custom op* here is a
+**host kernel**: the C++ function runs on CPU inside the XLA program via
+``jax.pure_callback`` (device arrays stream D2H, the host kernel runs, the
+result streams back). This is the same contract as the reference's CPU
+custom ops; for device-speed custom kernels write Pallas (see
+``incubate/nn/kernels``).
+
+C ABI (ours, documented here — not the reference's):
+
+.. code-block:: c
+
+    // forward: n_in float32 input buffers with explicit sizes, one output
+    extern "C" void <name>(int32_t n_in, const float** ins,
+                           const int64_t* sizes, float* out,
+                           int64_t out_size);
+    // optional backward: same inputs + upstream grad -> per-input grads
+    extern "C" void <name>_grad(int32_t n_in, const float** ins,
+                                const int64_t* sizes, const float* gout,
+                                int64_t out_size, float** gins);
+
+Usage::
+
+    relu2 = load(name="relu2", sources=["relu2.cc"])   # compiles + binds
+    y = relu2(x)            # taped: backward uses relu2_grad if exported
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import tempfile
+from pathlib import Path
+from typing import Callable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_BUILD_DIR = Path(tempfile.gettempdir()) / "pht_cpp_extensions"
+
+
+def _compile(sources: Sequence[str], name: str,
+             extra_cflags: Optional[List[str]] = None) -> Path:
+    srcs = [Path(s) for s in sources]
+    blob = b"".join(p.read_bytes() for p in srcs)
+    tag = hashlib.sha256(blob).hexdigest()[:16]
+    out = _BUILD_DIR / f"{name}_{tag}.so"
+    if out.exists():
+        return out
+    _BUILD_DIR.mkdir(exist_ok=True)
+    cmd = (["g++", "-O2", "-std=c++17", "-shared", "-fPIC"]
+           + (extra_cflags or [])
+           + [str(p) for p in srcs] + ["-o", str(out)])
+    proc = subprocess.run(cmd, capture_output=True, text=True)
+    if proc.returncode != 0:
+        raise RuntimeError(f"custom op build failed:\n{proc.stderr}")
+    return out
+
+
+class CustomOp:
+    """A loaded custom operator, callable on framework Tensors."""
+
+    def __init__(self, lib: ctypes.CDLL, name: str,
+                 out_shape_fn: Optional[Callable] = None):
+        self.name = name
+        self._fwd = getattr(lib, name)
+        self._fwd.restype = None
+        self._bwd = getattr(lib, f"{name}_grad", None)
+        if self._bwd is not None:
+            self._bwd.restype = None
+        # default: output shaped like the first input (elementwise family)
+        self._out_shape_fn = out_shape_fn or (lambda *shapes: shapes[0])
+        self._fn = self._jax_fn()  # built once: stable identity for jit/vjp caching
+
+    # -- host kernels --------------------------------------------------------
+    def _run_fwd(self, *arrays):
+        arrays = [np.ascontiguousarray(a, np.float32) for a in arrays]
+        out_shape = self._out_shape_fn(*[a.shape for a in arrays])
+        out = np.empty(out_shape, np.float32)
+        n = len(arrays)
+        ins = (ctypes.POINTER(ctypes.c_float) * n)(
+            *[a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+              for a in arrays])
+        sizes = (ctypes.c_int64 * n)(*[a.size for a in arrays])
+        self._fwd(ctypes.c_int32(n), ins, sizes,
+                  out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                  ctypes.c_int64(out.size))
+        return out
+
+    def _run_bwd(self, gout, *arrays):
+        arrays = [np.ascontiguousarray(a, np.float32) for a in arrays]
+        gout = np.ascontiguousarray(gout, np.float32)
+        n = len(arrays)
+        gins = [np.zeros_like(a) for a in arrays]
+        ins = (ctypes.POINTER(ctypes.c_float) * n)(
+            *[a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+              for a in arrays])
+        sizes = (ctypes.c_int64 * n)(*[a.size for a in arrays])
+        gptrs = (ctypes.POINTER(ctypes.c_float) * n)(
+            *[g.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+              for g in gins])
+        self._bwd(ctypes.c_int32(n), ins, sizes,
+                  gout.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                  ctypes.c_int64(gout.size), gptrs)
+        return tuple(gins)
+
+    # -- jax integration -----------------------------------------------------
+    def _jax_fn(self):
+        op = self
+
+        def base(*args):
+            out_shape = op._out_shape_fn(*[a.shape for a in args])
+            result_aval = jax.ShapeDtypeStruct(out_shape, jnp.float32)
+            return jax.pure_callback(
+                lambda *hs: op._run_fwd(*hs), result_aval, *args,
+                vmap_method="sequential")
+
+        if self._bwd is None:
+            return base
+
+        @jax.custom_vjp
+        def fn(*args):
+            return base(*args)
+
+        def fwd(*args):
+            return base(*args), args
+
+        def bwd(res, gout):
+            avals = tuple(jax.ShapeDtypeStruct(a.shape, jnp.float32)
+                          for a in res)
+            return jax.pure_callback(
+                lambda g, *hs: op._run_bwd(g, *hs), avals, gout, *res,
+                vmap_method="sequential")
+
+        fn.defvjp(fwd, bwd)
+        return fn
+
+    def __call__(self, *tensors):
+        from ..core.autograd import apply_op
+        return apply_op(f"custom_op.{self.name}", self._fn, tensors)
+
+
+def load(name: str, sources: Sequence[str],
+         extra_cflags: Optional[List[str]] = None,
+         out_shape_fn: Optional[Callable] = None,
+         verbose: bool = False) -> CustomOp:
+    """JIT-compile ``sources`` and bind op ``name`` (ref
+    ``paddle.utils.cpp_extension.load``)."""
+    so = _compile(sources, name, extra_cflags)
+    lib = ctypes.CDLL(str(so))
+    if not hasattr(lib, name):
+        raise RuntimeError(
+            f"{so} does not export required symbol {name!r} (see the C ABI "
+            "in paddle_hackathon_tpu.utils.cpp_extension)")
+    return CustomOp(lib, name, out_shape_fn)
