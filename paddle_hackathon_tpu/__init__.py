@@ -28,7 +28,11 @@ _jax.config.update("jax_default_matmul_precision",
                    flags.flag("matmul_precision"))
 from .core.autograd import enable_grad, grad, is_grad_enabled, no_grad, set_grad_enabled
 from .core.device import (Place, current_place, device_count, get_device,
-                          is_compiled_with_tpu, set_device, synchronize)
+                          get_cudnn_version, is_compiled_with_cinn,
+                          is_compiled_with_cuda, is_compiled_with_ipu,
+                          is_compiled_with_mlu, is_compiled_with_npu,
+                          is_compiled_with_rocm, is_compiled_with_tpu,
+                          is_compiled_with_xpu, set_device, synchronize)
 from .core.dtype import (bfloat16, bool_, complex64, complex128, float16,
                          float32, float64, get_default_dtype, int8, int16,
                          int32, int64, set_default_dtype, uint8)
@@ -77,7 +81,7 @@ import importlib as _importlib
 for _sub in ("nn", "optimizer", "io", "amp", "metric", "framework",
              "jit", "distributed", "vision", "incubate", "profiler", "hapi",
              "static", "text", "inference", "distribution", "sparse",
-             "utils", "onnx"):
+             "utils", "onnx", "fft", "signal", "device", "autograd", "linalg"):
     try:
         globals()[_sub] = _importlib.import_module(f"{__name__}.{_sub}")
     except ModuleNotFoundError as _e:
@@ -115,8 +119,8 @@ TPUPlace = _place_alias("tpu")
 CUDAPlace = _place_alias("tpu")  # CUDA-annotated code runs on the chip
 CUDAPinnedPlace = lambda: Place("cpu")  # noqa: E731
 NPUPlace = _place_alias("tpu")
-XPUPlace = _place_alias("tpu")
-MLUPlace = _place_alias("tpu")
+# single definitions live in core.device (also exported as paddle.device.*)
+from .core.device import IPUPlace, MLUPlace, XPUPlace  # noqa: E402
 
 
 def set_printoptions(precision=None, threshold=None, edgeitems=None,

@@ -1,0 +1,149 @@
+"""paddle.autograd — custom autograd functions + backward entry point.
+
+Ref ``python/paddle/autograd/__init__.py`` (PyLayer/PyLayerContext from
+``py_layer.py``; C++ engine hook ``fluid/eager/pylayer``). Here ``PyLayer``
+records a :class:`~..core.autograd.GradNode` on the eager tape whose vjp
+calls the user's ``backward`` — the same mechanism generated ops use, so
+custom functions compose with hooks, ``grad()`` and higher-order ops.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core import autograd as _core_ag
+from ..core.autograd import (enable_grad, grad, is_grad_enabled,  # noqa: F401
+                             no_grad, run_backward, set_grad_enabled)
+from ..core.tensor import Tensor
+
+__all__ = ["PyLayer", "PyLayerContext", "backward", "grad", "no_grad",
+           "enable_grad", "set_grad_enabled", "is_grad_enabled"]
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    """paddle.autograd.backward (ref autograd/backward_mode.py)."""
+    if isinstance(tensors, Tensor):
+        tensors = [tensors]
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+    elif isinstance(grad_tensors, Tensor):
+        grad_tensors = [grad_tensors]
+    gts = []
+    for t, g in zip(tensors, grad_tensors):
+        gts.append(g if g is not None
+                   else Tensor(jnp.ones_like(t._value)))
+    run_backward(tensors, gts, retain_graph=retain_graph)
+
+
+class PyLayerContext:
+    """Carries state from forward to backward (ref py_layer.py
+    PyLayerContext: save_for_backward/saved_tensor + free attrs)."""
+
+    def __init__(self):
+        self._saved = ()
+        self._non_differentiable = ()
+        self._materialize_grads = True
+
+    def save_for_backward(self, *tensors):
+        self._saved = tensors
+
+    def saved_tensor(self):
+        return list(self._saved)
+
+    def mark_non_differentiable(self, *tensors):
+        self._non_differentiable = tensors
+
+    def set_materialize_grads(self, value: bool):
+        self._materialize_grads = bool(value)
+
+
+class PyLayer:
+    """Custom autograd function (ref py_layer.py PyLayer).
+
+    Subclass with static ``forward(ctx, *args)`` and ``backward(ctx,
+    *output_grads)``; call via ``MyLayer.apply(*args)``. ``backward`` must
+    return one grad per *tensor* input of forward (None for inputs that
+    don't need grad), exactly the reference contract.
+    """
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError("implement PyLayer.forward")
+
+    @staticmethod
+    def backward(ctx, *args):
+        raise NotImplementedError("implement PyLayer.backward")
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        ctx = PyLayerContext()
+        tape_on = is_grad_enabled()
+
+        with no_grad():
+            outs = cls.forward(ctx, *args, **kwargs)
+        single = not isinstance(outs, (tuple, list))
+        out_list = [outs] if single else list(outs)
+
+        # tensor inputs (positional and keyword); those needing grad
+        # become tape parents (the reference tracks kwarg tensors too)
+        all_inputs = list(args) + [kwargs[k] for k in sorted(kwargs)]
+        tensor_positions = [i for i, a in enumerate(all_inputs)
+                            if isinstance(a, Tensor)]
+        diff_positions = [i for i in tensor_positions
+                          if not all_inputs[i].stop_gradient
+                          and jnp.issubdtype(
+                              jnp.result_type(all_inputs[i]._value),
+                              jnp.inexact)]
+        if not tape_on or not diff_positions:
+            return outs
+
+        parents = []
+        for i in diff_positions:
+            src = all_inputs[i]
+            if src._grad_node is not None:
+                parents.append((src._grad_node, src._out_idx))
+            else:
+                parents.append(_core_ag._LeafSlot(src))
+
+        non_diff_ids = {id(t) for t in ctx._non_differentiable}
+        out_ids = [id(o) for o in out_list]
+        out_avals = [(o._value.shape, o._value.dtype) for o in out_list]
+
+        def node_vjp(cotangents):
+            with no_grad():
+                gouts = []
+                for ct, oid, (shape, dtype) in zip(cotangents, out_ids,
+                                                   out_avals):
+                    if oid in non_diff_ids:
+                        gouts.append(None)
+                    elif ct is None and ctx._materialize_grads:
+                        gouts.append(Tensor(jnp.zeros(shape, dtype)))
+                    else:
+                        gouts.append(Tensor(ct) if ct is not None else None)
+                grads = cls.backward(ctx, *(gouts if not single
+                                            else [gouts[0]]))
+            if isinstance(grads, Tensor) or grads is None:
+                grads = (grads,)
+            grads = list(grads)
+            if len(grads) == len(tensor_positions) > len(diff_positions):
+                # backward returned one grad per tensor input; select the
+                # differentiable ones
+                by_pos = dict(zip(tensor_positions, grads))
+                grads = [by_pos[i] for i in diff_positions]
+            if len(grads) != len(diff_positions):
+                raise ValueError(
+                    f"{cls.__name__}.backward returned {len(grads)} grads "
+                    f"for {len(diff_positions)} differentiable inputs")
+            return tuple(g._value if isinstance(g, Tensor) else g
+                         for g in grads)
+
+        node = _core_ag.GradNode(f"pylayer.{cls.__name__}", node_vjp,
+                                 parents, len(out_list), out_avals)
+        wrapped = [Tensor(o._value, stop_gradient=False, _grad_node=node,
+                          _out_idx=i) for i, o in enumerate(out_list)]
+        out_list.clear()  # node_vjp keeps only ids/avals, not the buffers
+        return wrapped[0] if single else tuple(wrapped)
+
+
+# legacy alias used by some reference code paths
+LegacyPyLayer = PyLayer

@@ -154,3 +154,70 @@ def max_memory_allocated(place: Optional[Place] = None) -> int:
 
 def memory_allocated(place: Optional[Place] = None) -> int:
     return memory_stats(place)["allocated.current"]
+
+
+# -- capability probes + vendor Places (ref python/paddle/device/__init__.py)
+# On this framework every accelerator place is the TPU chip; the CUDA/ROCm/
+# NPU/MLU/XPU/IPU probes answer False so device-branching user code takes
+# its generic path.
+def get_cudnn_version():
+    return None
+
+
+def is_compiled_with_cuda():
+    return False
+
+
+def is_compiled_with_rocm():
+    return False
+
+
+def is_compiled_with_xpu():
+    return False
+
+
+def is_compiled_with_npu():
+    return False
+
+
+def is_compiled_with_mlu():
+    return False
+
+
+def is_compiled_with_ipu():
+    return False
+
+
+def is_compiled_with_cinn():
+    # XLA plays CINN's role (compiler backend) but the flag answers the
+    # reference's question "is the CINN backend present" -> False
+    return False
+
+
+def XPUPlace(dev_id=0):
+    return Place("tpu", dev_id)
+
+
+def IPUPlace(dev_id=0):
+    return Place("tpu", dev_id)
+
+
+def MLUPlace(dev_id=0):
+    return Place("tpu", dev_id)
+
+
+def get_all_device_type():
+    return sorted({d.platform for d in jax.devices()} | {"cpu"})
+
+
+def get_all_custom_device_type():
+    return [t for t in get_all_device_type() if t not in ("cpu", "gpu", "tpu")]
+
+
+def get_available_device():
+    return [f"{d.platform}:{d.id}" for d in jax.devices()]
+
+
+def get_available_custom_device():
+    return [d for d in get_available_device()
+            if d.split(":")[0] not in ("cpu", "gpu", "tpu")]

@@ -28,6 +28,7 @@ class Tensor:
     __slots__ = ("_value", "stop_gradient", "name", "persistable",
                  "_grad_node", "_out_idx", "_grad_value", "_grad_hooks",
                  "_process_mesh", "_shard_spec",  # auto_parallel annotations
+                 "_lod",  # legacy LoD offsets (static.nn sequence_* ops)
                  "__weakref__")
 
     # auto_parallel annotations (set by parallel.auto_parallel.shard_tensor);

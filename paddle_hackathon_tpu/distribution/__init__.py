@@ -436,3 +436,160 @@ def _kl_beta_beta(p, q):
                 + (pa - qa) * dg(pa) + (pb - qb) * dg(pb)
                 + (qa - pa + qb - pb) * dg(pa + pb))
     return apply_op("kl_beta", fn, [p.alpha, p.beta, q.alpha, q.beta])
+
+
+class ExponentialFamily(Distribution):
+    """Base for exponential-family distributions (ref
+    distribution/exponential_family.py): entropy via the Bregman identity
+    over the log-normalizer (autodiff replaces the reference's manual
+    gradient of _log_normalizer)."""
+
+    @property
+    def _natural_parameters(self):
+        raise NotImplementedError
+
+    def _log_normalizer(self, *natural_params):
+        raise NotImplementedError
+
+    @property
+    def _mean_carrier_measure(self):
+        return 0.0
+
+    def entropy(self):
+        import jax as _jax
+        nats = [n._value if isinstance(n, Tensor) else jnp.asarray(n)
+                for n in self._natural_parameters]
+
+        def lognorm(*ns):
+            out = self._log_normalizer(*[Tensor(n) for n in ns])
+            return (out._value if isinstance(out, Tensor) else out).sum()
+
+        val = self._log_normalizer(*[Tensor(n) for n in nats])
+        val = val._value if isinstance(val, Tensor) else val
+        grads = _jax.grad(lognorm, argnums=tuple(range(len(nats))))(*nats)
+        ent = val - self._mean_carrier_measure
+        for n, g in zip(nats, grads):
+            ent = ent - n * g
+        return Tensor(ent)
+
+
+class Independent(Distribution):
+    """Reinterprets batch dims of a base distribution as event dims
+    (ref distribution/independent.py)."""
+
+    def __init__(self, base, reinterpreted_batch_rank):
+        if reinterpreted_batch_rank > len(base.batch_shape):
+            raise ValueError("reinterpreted_batch_rank exceeds batch rank")
+        self._base = base
+        self._rank = int(reinterpreted_batch_rank)
+        shape = tuple(base.batch_shape)
+        cut = len(shape) - self._rank
+        super().__init__(shape[:cut], shape[cut:] + tuple(base.event_shape))
+
+    def sample(self, shape=()):
+        return self._base.sample(shape)
+
+    def rsample(self, shape=()):
+        return self._base.rsample(shape)
+
+    def log_prob(self, value):
+        lp = self._base.log_prob(value)
+        axes = tuple(range(-self._rank, 0))
+        return apply_op("independent_log_prob",
+                        lambda v: jnp.sum(v, axis=axes), [_t(lp)])
+
+    def entropy(self):
+        ent = self._base.entropy()
+        axes = tuple(range(-self._rank, 0))
+        return apply_op("independent_entropy",
+                        lambda v: jnp.sum(v, axis=axes), [_t(ent)])
+
+    @property
+    def mean(self):
+        return self._base.mean
+
+    @property
+    def variance(self):
+        return self._base.variance
+
+
+class Transform:
+    """Bijective transform base (minimal surface used by
+    TransformedDistribution; ref distribution/transform.py)."""
+
+    def forward(self, x):
+        raise NotImplementedError
+
+    def inverse(self, y):
+        raise NotImplementedError
+
+    def forward_log_det_jacobian(self, x):
+        raise NotImplementedError
+
+
+class AffineTransform(Transform):
+    def __init__(self, loc, scale):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+
+    def forward(self, x):
+        return apply_op("affine_fwd", lambda v, l, s: v * s + l,
+                        [_t(x), self.loc, self.scale])
+
+    def inverse(self, y):
+        return apply_op("affine_inv", lambda v, l, s: (v - l) / s,
+                        [_t(y), self.loc, self.scale])
+
+    def forward_log_det_jacobian(self, x):
+        return apply_op("affine_ldj",
+                        lambda v, s: jnp.broadcast_to(jnp.log(jnp.abs(s)),
+                                                      v.shape),
+                        [_t(x), self.scale])
+
+
+class ExpTransform(Transform):
+    def forward(self, x):
+        return apply_op("exp_fwd", jnp.exp, [_t(x)])
+
+    def inverse(self, y):
+        return apply_op("exp_inv", jnp.log, [_t(y)])
+
+    def forward_log_det_jacobian(self, x):
+        return _t(x)
+
+
+class TransformedDistribution(Distribution):
+    """Pushforward of a base distribution through transforms
+    (ref distribution/transformed_distribution.py)."""
+
+    def __init__(self, base, transforms):
+        self._base = base
+        self._transforms = list(transforms)
+        super().__init__(base.batch_shape, base.event_shape)
+
+    def sample(self, shape=()):
+        x = self._base.sample(shape)
+        for t in self._transforms:
+            x = t.forward(x)
+        return x
+
+    def rsample(self, shape=()):
+        x = self._base.rsample(shape)
+        for t in self._transforms:
+            x = t.forward(x)
+        return x
+
+    def log_prob(self, value):
+        lp = None
+        y = _t(value)
+        for t in reversed(self._transforms):
+            x = t.inverse(y)
+            ldj = t.forward_log_det_jacobian(x)
+            lp = ldj if lp is None else lp + ldj
+            y = x
+        base_lp = self._base.log_prob(y)
+        return base_lp - lp if lp is not None else base_lp
+
+
+__all__ += ["ExponentialFamily", "Independent", "TransformedDistribution",
+            "Transform", "AffineTransform", "ExpTransform"]

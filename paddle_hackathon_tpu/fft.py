@@ -67,3 +67,46 @@ def fftshift(x, axes=None, name=None):
 
 def ifftshift(x, axes=None, name=None):
     return apply_op("ifftshift", lambda v: jnp.fft.ifftshift(v, axes), [_t(x)])
+
+
+def _hermitian_nd(v, s, axes, norm, inverse):
+    """n-D FFT with Hermitian symmetry on the last axis: regular (i)fft on
+    the leading axes, 1-D hfft/ihfft on the last (how the reference defines
+    hfft2/hfftn — fft_c2r on last axis, c2c elsewhere)."""
+    if axes is None:
+        axes = tuple(range(v.ndim))
+    axes = tuple(a % v.ndim for a in axes)
+    sizes = dict(zip(axes, s)) if s is not None else {}
+    lead, last = axes[:-1], axes[-1]
+    nrm = _norm(norm)
+    if inverse:
+        v = jnp.fft.ihfft(v, n=sizes.get(last), axis=last, norm=nrm)
+        for a in lead:
+            v = jnp.fft.ifft(v, n=sizes.get(a), axis=a, norm=nrm)
+        return v
+    for a in lead:
+        v = jnp.fft.fft(v, n=sizes.get(a), axis=a, norm=nrm)
+    return jnp.fft.hfft(v, n=sizes.get(last), axis=last, norm=nrm)
+
+
+def hfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return apply_op("hfft2",
+                    lambda v: _hermitian_nd(v, s, axes, norm, False), [_t(x)])
+
+
+def ihfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return apply_op("ihfft2",
+                    lambda v: _hermitian_nd(v, s, axes, norm, True), [_t(x)])
+
+
+def hfftn(x, s=None, axes=None, norm="backward", name=None):
+    return apply_op("hfftn",
+                    lambda v: _hermitian_nd(v, s, axes, norm, False), [_t(x)])
+
+
+def ihfftn(x, s=None, axes=None, norm="backward", name=None):
+    return apply_op("ihfftn",
+                    lambda v: _hermitian_nd(v, s, axes, norm, True), [_t(x)])
+
+
+__all__ += ["hfft2", "ihfft2", "hfftn", "ihfftn"]

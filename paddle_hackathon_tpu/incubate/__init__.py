@@ -7,7 +7,12 @@ Ref ``python/paddle/incubate/``: fused transformer layers + functionals
 ``parallel.moe``).
 """
 
-from . import asp, autograd, distributed, nn, optimizer  # noqa: F401
+from . import asp, autograd, distributed, nn, operators, optimizer  # noqa: F401
+from .operators import (graph_khop_sampler, graph_reindex,  # noqa: F401
+                        graph_sample_neighbors, graph_send_recv,
+                        identity_loss, segment_max, segment_mean,
+                        segment_min, segment_sum, softmax_mask_fuse,
+                        softmax_mask_fuse_upper_triangle)
 from .optimizer import DistributedFusedLamb, LookAhead, ModelAverage  # noqa: F401
 from .. import sparse  # noqa: F401 — paddle.incubate.sparse surface
 

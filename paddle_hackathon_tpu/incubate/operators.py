@@ -1,0 +1,232 @@
+"""incubate functional ops: segment reductions, graph message passing,
+fused-softmax masks, identity_loss.
+
+Ref ``python/paddle/incubate/__init__.py`` exports; kernels ref
+``paddle/phi/kernels/{segment_pool,graph_send_recv,graph_reindex,
+graph_khop_sampler,graph_sample_neighbors}_kernel.*`` and
+``operators/fused/fused_softmax_mask{,_upper_triangle}_op.cu``.
+
+TPU notes: segment/send-recv reductions lower to XLA scatter-reduce
+(``jax.ops.segment_*``) which the compiler vectorizes; the sampling ops
+(khop/reindex/neighbors) are host-side (data-dependent output shapes can't
+live under jit — the reference runs them outside the compiled region too,
+in its dataloader-side graph pipeline).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.autograd import apply_op, no_grad
+from ..core.tensor import Tensor
+
+__all__ = [
+    "segment_sum", "segment_mean", "segment_max", "segment_min",
+    "graph_send_recv", "graph_reindex", "graph_khop_sampler",
+    "graph_sample_neighbors", "softmax_mask_fuse",
+    "softmax_mask_fuse_upper_triangle", "identity_loss",
+]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+
+
+def _nseg(segment_ids):
+    ids = segment_ids._value if isinstance(segment_ids, Tensor) else segment_ids
+    try:
+        arr = np.asarray(ids)
+    except Exception as e:  # jit tracer: num_segments is data-dependent
+        raise NotImplementedError(
+            "segment_* ops need concrete segment_ids (num_segments = "
+            "max(ids)+1 is data-dependent, which XLA cannot shape); call "
+            "them eagerly, outside jit.to_static") from e
+    return int(arr.max()) + 1 if arr.size else 0
+
+
+def _segment(name, reducer, empty_fill):
+    def op(data, segment_ids, name_=None):
+        n = _nseg(segment_ids)
+
+        def fn(d, ids):
+            out = reducer(d, ids.astype(jnp.int32), num_segments=n)
+            if empty_fill is not None:
+                # empty segments produce +-inf for max/min; the reference
+                # writes 0 there (segment_pool_kernel)
+                counts = jax.ops.segment_sum(jnp.ones_like(ids, jnp.int32),
+                                             ids.astype(jnp.int32),
+                                             num_segments=n)
+                shape = (n,) + (1,) * (d.ndim - 1)
+                out = jnp.where(counts.reshape(shape) > 0, out, empty_fill)
+            return out
+        return apply_op(name, fn, [_t(data), _t(segment_ids)])
+    op.__name__ = name
+    return op
+
+
+segment_sum = _segment("segment_sum", jax.ops.segment_sum, None)
+segment_mean = _segment(
+    "segment_mean",
+    lambda d, ids, num_segments: jax.ops.segment_sum(d, ids, num_segments)
+    / jnp.maximum(jax.ops.segment_sum(
+        jnp.ones(d.shape[:1], d.dtype), ids, num_segments), 1.0
+    ).reshape((num_segments,) + (1,) * (d.ndim - 1)),
+    None)
+segment_max = _segment("segment_max", jax.ops.segment_max, 0.0)
+segment_min = _segment("segment_min", jax.ops.segment_min, 0.0)
+
+
+def graph_send_recv(x, src_index, dst_index, pool_type="sum",
+                    out_size=None, name=None):
+    """Gather x[src], reduce onto dst (ref phi GraphSendRecvKernel)."""
+    pool = pool_type.lower()
+    red = {"sum": jax.ops.segment_sum, "mean": None,
+           "max": jax.ops.segment_max, "min": jax.ops.segment_min}[pool]
+    n = (int(out_size) if out_size
+         else int(np.asarray(_t(x)._value.shape[0])))
+
+    def fn(v, src, dst):
+        msgs = v[src.astype(jnp.int32)]
+        dsti = dst.astype(jnp.int32)
+        if pool == "mean":
+            s = jax.ops.segment_sum(msgs, dsti, num_segments=n)
+            c = jax.ops.segment_sum(jnp.ones_like(dsti, v.dtype), dsti,
+                                    num_segments=n)
+            return s / jnp.maximum(c, 1.0).reshape((n,) + (1,) * (v.ndim - 1))
+        out = red(msgs, dsti, num_segments=n)
+        if pool in ("max", "min"):
+            c = jax.ops.segment_sum(jnp.ones_like(dsti, jnp.int32), dsti,
+                                    num_segments=n)
+            out = jnp.where(c.reshape((n,) + (1,) * (v.ndim - 1)) > 0, out, 0)
+        return out
+    return apply_op("graph_send_recv", fn,
+                    [_t(x), _t(src_index), _t(dst_index)])
+
+
+def graph_reindex(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  flag_buffer_hashtable=False, name=None):
+    """Reindex a sampled subgraph to local ids (ref phi GraphReindexKernel).
+    Host-side: output shape depends on the unique node set."""
+    with no_grad():
+        xs = np.asarray(_t(x)._value)
+        nb = np.asarray(_t(neighbors)._value)
+        cnt = np.asarray(_t(count)._value)
+        uniq, inv = np.unique(np.concatenate([xs, nb]), return_inverse=True)
+        # reference keeps input-x ids first in the local numbering
+        order = {int(v): i for i, v in enumerate(xs)}
+        for v in uniq:
+            if int(v) not in order:
+                order[int(v)] = len(order)
+        remap = np.array([order[int(v)] for v in np.concatenate([xs, nb])])
+        reindex_src = remap[len(xs):]
+        # dst: each x[i] repeated count[i] times
+        reindex_dst = np.repeat(np.arange(len(xs)), cnt)
+        out_nodes = np.array(sorted(order, key=order.get))
+        return (Tensor(jnp.asarray(reindex_src, jnp.int64)),
+                Tensor(jnp.asarray(reindex_dst, jnp.int64)),
+                Tensor(jnp.asarray(out_nodes, jnp.int64)))
+
+
+def graph_sample_neighbors(row, colptr, input_nodes, eids=None,
+                           perm_buffer=None, sample_size=-1,
+                           return_eids=False, flag_perm_buffer=False,
+                           name=None):
+    """Sample up to ``sample_size`` neighbors per input node from CSC
+    (ref phi GraphSampleNeighborsKernel). Host-side sampling."""
+    with no_grad():
+        r = np.asarray(_t(row)._value)
+        cp = np.asarray(_t(colptr)._value)
+        nodes = np.asarray(_t(input_nodes)._value)
+        from ..core import random as _core_random
+        rng = np.random.default_rng(
+            int(jax.random.key_data(_core_random.split_key())[-1]))
+        out_nb, out_cnt, out_eids = [], [], []
+        for nval in nodes:
+            lo, hi = int(cp[nval]), int(cp[nval + 1])
+            neigh = r[lo:hi]
+            idx = np.arange(lo, hi)
+            if sample_size > 0 and len(neigh) > sample_size:
+                sel = rng.choice(len(neigh), sample_size, replace=False)
+                neigh, idx = neigh[sel], idx[sel]
+            out_nb.append(neigh)
+            out_cnt.append(len(neigh))
+            out_eids.append(idx)
+        nb = Tensor(jnp.asarray(np.concatenate(out_nb) if out_nb else
+                                np.zeros(0, r.dtype)))
+        cnt = Tensor(jnp.asarray(np.asarray(out_cnt, np.int32)))
+        if return_eids:
+            ev = (np.asarray(_t(eids)._value)[np.concatenate(out_eids)]
+                  if eids is not None else np.concatenate(out_eids))
+            return nb, cnt, Tensor(jnp.asarray(ev))
+        return nb, cnt
+
+
+def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
+                       sorted_eids=None, return_eids=False, name=None):
+    """Multi-hop neighbor sampling + reindex (ref phi
+    GraphKhopSamplerKernel). Host-side."""
+    with no_grad():
+        frontier = np.asarray(_t(input_nodes)._value)
+        all_src, all_dst = [], []
+        seen = list(frontier)
+        for size in sample_sizes:
+            nb, cnt = graph_sample_neighbors(row, colptr, Tensor(jnp.asarray(frontier)),
+                                             sample_size=size)
+            nbv = np.asarray(nb._value)
+            cntv = np.asarray(cnt._value)
+            all_src.append(nbv)
+            all_dst.append(np.repeat(frontier, cntv))
+            new = np.setdiff1d(nbv, np.asarray(seen))
+            seen.extend(new.tolist())
+            frontier = new
+        src = np.concatenate(all_src) if all_src else np.zeros(0, np.int64)
+        dst = np.concatenate(all_dst) if all_dst else np.zeros(0, np.int64)
+        order = {int(v): i for i, v in enumerate(dict.fromkeys(seen))}
+        remap_src = np.array([order[int(v)] for v in src], np.int64)
+        remap_dst = np.array([order[int(v)] for v in dst], np.int64)
+        nodes = np.array(list(order.keys()), np.int64)
+        inputs0 = np.asarray(_t(input_nodes)._value)
+        # reindex_x: positions of the query nodes inside `nodes`
+        # (reference contract: edge_src, edge_dst, sample_index, reindex_x)
+        reindex_x = np.array([order[int(v)] for v in inputs0], np.int64)
+        outs = (Tensor(jnp.asarray(remap_src)), Tensor(jnp.asarray(remap_dst)),
+                Tensor(jnp.asarray(nodes)), Tensor(jnp.asarray(reindex_x)))
+        if return_eids:
+            eids = np.arange(len(src), dtype=np.int64)
+            if sorted_eids is not None:
+                se = np.asarray(_t(sorted_eids)._value)
+                eids = se[eids % max(len(se), 1)]
+            return outs + (Tensor(jnp.asarray(eids)),)
+        return outs
+
+
+def softmax_mask_fuse(x, mask, name=None):
+    """softmax(x + mask) in one fused pass (ref
+    fused_softmax_mask_op.cu) — XLA fuses the add into the softmax."""
+    return apply_op("softmax_mask_fuse",
+                    lambda v, m: jax.nn.softmax(v + m, -1), [_t(x), _t(mask)])
+
+
+def softmax_mask_fuse_upper_triangle(x, name=None):
+    """Causal-masked softmax (ref fused_softmax_mask_upper_triangle_op.cu):
+    positions above the diagonal get -inf."""
+    def fn(v):
+        s, t = v.shape[-2], v.shape[-1]
+        mask = jnp.tril(jnp.ones((s, t), bool))
+        return jax.nn.softmax(jnp.where(mask, v, -1e4 if v.dtype == jnp.float16
+                                        else -1e30), -1)
+    return apply_op("softmax_mask_fuse_upper_triangle", fn, [_t(x)])
+
+
+def identity_loss(x, reduction="none"):
+    """Mark a loss for IPU-style pipelining (ref identity_loss op); on TPU
+    it is just the reduction."""
+    red = {0: "sum", 1: "mean", 2: "none", "sum": "sum", "mean": "mean",
+           "none": "none"}[reduction]
+    if red == "sum":
+        return apply_op("identity_loss", jnp.sum, [_t(x)])
+    if red == "mean":
+        return apply_op("identity_loss", jnp.mean, [_t(x)])
+    return _t(x)

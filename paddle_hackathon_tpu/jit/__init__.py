@@ -16,3 +16,58 @@ through a compiled program attaches ONE tape node wrapping the program's
 from .api import (InputSpec, StaticFunction, _trace_state, ignore_module,  # noqa: F401
                   not_to_static, to_static)
 from .save_load import TranslatedLayer, load, save  # noqa: F401
+
+
+def set_code_level(level=100, also_to_stdout=False):
+    """Ref jit/dy2static logging: here tracing is jax.jit, so 'code level'
+    maps to printing the traced jaxpr; stored for StaticFunction to honor."""
+    from . import api as _api
+    _api._trace_state.code_level = level
+
+
+def set_verbosity(level=0, also_to_stdout=False):
+    from . import api as _api
+    _api._trace_state.verbosity = level
+
+
+class ProgramTranslator:
+    """Singleton toggling dy2static globally (ref program_translator.py
+    ProgramTranslator.enable)."""
+
+    _instance = None
+
+    def __new__(cls):
+        if cls._instance is None:
+            cls._instance = super().__new__(cls)
+        return cls._instance
+
+    def enable(self, enable_to_static=True):
+        from . import api as _api
+        _api._trace_state.enabled = bool(enable_to_static)
+
+    @staticmethod
+    def get_instance():
+        return ProgramTranslator()
+
+
+class TracedLayer:
+    """Ref fluid/dygraph/jit.py TracedLayer: trace a dygraph layer into a
+    compiled callable. Here = jit.to_static specialization + save."""
+
+    def __init__(self, layer, fn):
+        self._layer = layer
+        self._fn = fn
+
+    @staticmethod
+    def trace(layer, inputs):
+        from .api import to_static
+        fn = to_static(layer)
+        outs = fn(*inputs)
+        return outs, TracedLayer(layer, fn)
+
+    def __call__(self, *args, **kwargs):
+        return self._fn(*args, **kwargs)
+
+    def save_inference_model(self, path, feed=None, fetch=None):
+        from .save_load import save as jit_save
+        jit_save(self._layer, path)

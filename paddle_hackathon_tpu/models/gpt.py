@@ -39,7 +39,7 @@ class GPTConfig:
     hidden_dropout_prob: float = 0.1
     attention_dropout_prob: float = 0.1
     initializer_range: float = 0.02
-    use_flash_attention: bool = True
+    use_flash_attention: bool = None  # None = auto (seq-length heuristic)
 
     @property
     def ffn_size(self):

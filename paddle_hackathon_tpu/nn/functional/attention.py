@@ -35,8 +35,11 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
     FLAGS_use_fused_kernels) and shapes qualify; falls back to the pure-XLA
     composition otherwise.
     """
-    flash_ok = (use_flash if use_flash is not None
-                else flags.flag("use_fused_kernels"))
+    if use_flash is None:  # auto: flash only where it beats XLA (long seq)
+        flash_ok = (flags.flag("use_fused_kernels")
+                    and query.shape[1] >= flags.flag("flash_attention_min_seqlen"))
+    else:
+        flash_ok = use_flash
     if flash_ok and attn_mask is None and dropout_p == 0.0:
         try:
             from ...incubate.nn.functional import flash_attention_bshd

@@ -413,3 +413,19 @@ class _Timer:
         if total_samples:
             out["ips"] = total_samples / float(st.sum())
         return out
+
+
+class SortedKeys(Enum):
+    """Sort key for the stats report (ref profiler/profiler_statistic.py
+    SortedKeys)."""
+    CPUTotal = 0
+    CPUAvg = 1
+    CPUMax = 2
+    CPUMin = 3
+    GPUTotal = 4
+    GPUAvg = 5
+    GPUMax = 6
+    GPUMin = 7
+
+
+__all__.append("SortedKeys")

@@ -101,23 +101,12 @@ def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
     return list(out) if isinstance(out, tuple) else [out]
 
 
-# `nn` compatibility namespace: the reference's paddle.static.nn re-exports
-# fc/embedding-style layer functions; the dynamic layers cover these.
-class _StaticNN:
-    @staticmethod
-    def fc(x, size, num_flatten_dims=1, activation=None, name=None):
-        from .. import nn as _nn
-        from ..ops import manipulation as M
-        flat = M.flatten(x, num_flatten_dims) if x.ndim > 2 else x
-        lin = _nn.Linear(int(flat.shape[-1]), size)
-        out = lin(flat)
-        if activation:
-            out = getattr(_nn.functional, activation)(out)
-        return out
+from . import nn  # noqa: E402  (the legacy static.nn layer functions)
+from .compat import *  # noqa: F401,F403,E402  (strategies, scopes, EMA, serialization)
+from .compat import Print, __all__ as _compat_all  # noqa: E402
+from .nn import py_func  # noqa: E402  (also exported at static top level)
 
-
-nn = _StaticNN()
-
-__all__ = ["data", "Executor", "Program", "Variable", "program_guard",
-           "default_main_program", "default_startup_program", "InputSpec",
-           "save_inference_model", "load_inference_model", "gradients"]
+__all__ = (["data", "Executor", "Program", "Variable", "program_guard",
+            "default_main_program", "default_startup_program", "InputSpec",
+            "save_inference_model", "load_inference_model", "gradients",
+            "nn", "py_func"] + list(_compat_all))

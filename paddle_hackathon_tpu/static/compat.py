@@ -1,0 +1,460 @@
+"""Legacy paddle.static surface: strategies, scopes, EMA, metrics, program
+serialization (ref ``python/paddle/static/__init__.py`` __all__).
+
+Mechanism notes per symbol group:
+- BuildStrategy/ExecutionStrategy/CompiledProgram/ParallelExecutor: in the
+  reference these configure the SSA-graph executor (``parallel_executor.h:51``,
+  ``build_strategy.cc``); here XLA owns scheduling/fusion, so they are
+  accepted-and-recorded config carriers whose knobs map to flags where one
+  exists and are otherwise inert.
+- serialization: Programs pickle their instruction-free spec; persistables
+  save via the framework ``save``/``load``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import pickle
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.autograd import apply_op, no_grad
+from ..core.tensor import Tensor
+from .program import (Program, Variable, default_main_program,
+                      default_startup_program, in_static_mode)
+
+__all__ = [
+    "append_backward", "global_scope", "scope_guard", "BuildStrategy",
+    "CompiledProgram", "ipu_shard_guard", "IpuCompiledProgram", "IpuStrategy",
+    "Print", "ExecutionStrategy", "name_scope", "ParallelExecutor",
+    "WeightNormParamAttr", "ExponentialMovingAverage", "save", "load",
+    "serialize_program", "serialize_persistables", "save_to_file",
+    "deserialize_program", "deserialize_persistables", "load_from_file",
+    "normalize_program", "load_program_state", "set_program_state",
+    "cpu_places", "cuda_places", "xpu_places", "npu_places", "mlu_places",
+    "create_global_var", "accuracy", "auc", "device_guard",
+    "create_parameter", "set_ipu_shard", "ctr_metric_bundle",
+    "exponential_decay",
+]
+
+
+# -- strategies / compiled programs (config carriers) ------------------------
+
+class BuildStrategy:
+    """Ref build_strategy.cc knobs; on TPU the XLA pipeline subsumes the
+    fusion/memory passes, so knobs are held for introspection only."""
+
+    def __init__(self):
+        self.enable_inplace = True
+        self.fuse_all_optimizer_ops = True
+        self.fuse_all_reduce_ops = True
+        self.fuse_elewise_add_act_ops = True
+        self.fuse_bn_act_ops = True
+        self.memory_optimize = True
+        self.reduce_strategy = 0
+        self.gradient_scale_strategy = 0
+        self.build_cinn_pass = False
+        self.sync_batch_norm = False
+
+
+class ExecutionStrategy:
+    def __init__(self):
+        self.num_threads = 1
+        self.num_iteration_per_drop_scope = 10
+        self.num_iteration_per_run = 1
+        self.use_thread_barrier = False
+
+
+class CompiledProgram:
+    """Ref compiler.py CompiledProgram: carries the program + strategies;
+    Executor.run unwraps it (compilation itself is the jit cache)."""
+
+    def __init__(self, program_or_graph, build_strategy=None):
+        self._program = program_or_graph
+        self._build_strategy = build_strategy or BuildStrategy()
+        self._exec_strategy = None
+        self._places = None
+
+    def with_data_parallel(self, loss_name=None, build_strategy=None,
+                           exec_strategy=None, share_vars_from=None,
+                           places=None):
+        self._build_strategy = build_strategy or self._build_strategy
+        self._exec_strategy = exec_strategy
+        self._places = places
+        return self
+
+    # Executor unwraps via this
+    @property
+    def program(self):
+        return self._program
+
+
+class ParallelExecutor:
+    """Ref parallel_executor.h:51. SPMD via mesh sharding replaces the
+    SSA-graph multi-device executor; this wrapper runs the main Executor."""
+
+    def __init__(self, use_cuda=False, loss_name=None, main_program=None,
+                 share_vars_from=None, exec_strategy=None,
+                 build_strategy=None, num_trainers=1, trainer_id=0,
+                 scope=None):
+        from .executor import Executor
+        self._program = main_program or default_main_program()
+        self._exe = Executor()
+
+    def run(self, fetch_list, feed=None, feed_dict=None, return_numpy=True):
+        return self._exe.run(self._program, feed=feed or feed_dict,
+                             fetch_list=fetch_list, return_numpy=return_numpy)
+
+
+class IpuStrategy:
+    def __init__(self):
+        self.num_ipus = 1
+        self.is_training = True
+
+    def set_graph_config(self, **kwargs):
+        self.__dict__.update(kwargs)
+
+    def set_pipelining_config(self, **kwargs):
+        self.__dict__.update(kwargs)
+
+    def set_precision_config(self, **kwargs):
+        self.__dict__.update(kwargs)
+
+
+class IpuCompiledProgram:
+    def __init__(self, program=None, scope=None, ipu_strategy=None):
+        self._program = program or default_main_program()
+
+    def compile(self, feed_list, fetch_list):
+        return self._program
+
+
+@contextlib.contextmanager
+def ipu_shard_guard(index=-1, stage=-1):
+    yield
+
+
+def set_ipu_shard(call_func, index=-1, stage=-1):
+    return call_func
+
+
+# -- scopes ------------------------------------------------------------------
+
+class _Scope:
+    """Ref framework/scope.h: name -> variable container. The Program owns
+    variables here; the scope view exposes find_var for API parity."""
+
+    def __init__(self):
+        self._vars = {}
+
+    def var(self, name):
+        return self._vars.setdefault(name, _ScopeVar(name))
+
+    def find_var(self, name):
+        v = self._vars.get(name)
+        if v is not None:
+            return v
+        prog_var = default_main_program().var(name)
+        if prog_var is not None:
+            sv = _ScopeVar(name)
+            sv._tensor = prog_var
+            return sv
+        return None
+
+
+class _ScopeVar:
+    def __init__(self, name):
+        self.name = name
+        self._tensor = None
+
+    def get_tensor(self):
+        return self._tensor
+
+    def set(self, value, place=None):
+        self._tensor = Tensor(jnp.asarray(value))
+
+
+_global_scope = _Scope()
+_scope_stack = [_global_scope]
+
+
+def global_scope():
+    return _scope_stack[-1]
+
+
+@contextlib.contextmanager
+def scope_guard(scope):
+    _scope_stack.append(scope)
+    try:
+        yield
+    finally:
+        _scope_stack.pop()
+
+
+Scope = _Scope
+
+
+@contextlib.contextmanager
+def name_scope(prefix=None):
+    yield
+
+
+@contextlib.contextmanager
+def device_guard(device=None):
+    """Ref device_guard: op placement hint. XLA places ops; host pinning is
+    expressed with jax.device_put outside programs, so this is advisory."""
+    yield
+
+
+# -- places ------------------------------------------------------------------
+
+def cpu_places(device_count=None):
+    from .. import CPUPlace
+    n = device_count or 1
+    return [CPUPlace() for _ in range(n)]
+
+
+def cuda_places(device_ids=None):
+    from .. import CUDAPlace
+    ids = device_ids if device_ids is not None else range(
+        max(len([d for d in jax.devices() if d.platform != "cpu"]), 1))
+    return [CUDAPlace(i) for i in ids]
+
+
+def xpu_places(device_ids=None):
+    return cuda_places(device_ids)
+
+
+def npu_places(device_ids=None):
+    return cuda_places(device_ids)
+
+
+def mlu_places(device_ids=None):
+    return cuda_places(device_ids)
+
+
+# -- params / vars -----------------------------------------------------------
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    from ..nn.parameter import create_parameter as _cp
+    return _cp(shape, dtype, attr=attr, is_bias=is_bias,
+               default_initializer=default_initializer)
+
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    t = Tensor(jnp.full(tuple(shape), value, jnp.dtype(dtype)), name=name)
+    t.persistable = persistable
+    return t
+
+
+class WeightNormParamAttr:
+    """Ref paddle.static.WeightNormParamAttr: ParamAttr triggering weight
+    normalization — consumed by Layer.create_parameter via nn.utils."""
+
+    def __init__(self, dim=None, name=None, initializer=None,
+                 learning_rate=1.0, regularizer=None, trainable=True,
+                 do_model_average=False, need_clip=True):
+        self.dim = dim
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+        self.do_model_average = do_model_average
+        self.need_clip = need_clip
+
+
+# -- training helpers --------------------------------------------------------
+
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    callbacks=None, checkpoints=None):
+    """Ref fluid/backward.py append_backward: record grad computation for
+    every trainable param of the current program; returns (param, grad)
+    pairs. Built on static.gradients."""
+    from . import gradients
+    prog = default_main_program()
+    params = parameter_list or [p for p in prog.all_parameters()
+                                if getattr(p, "trainable", False)]
+    if not params:
+        return []
+    grads = gradients([loss], list(params))
+    return list(zip(params, grads))
+
+
+def accuracy(input, label, k=1, correct=None, total=None):  # noqa: A002
+    def fn(pred, y):
+        topk = jnp.argsort(-pred, axis=-1)[..., :k]
+        y = y.reshape(-1, 1)
+        hit = (topk == y).any(-1)
+        return jnp.mean(hit.astype(jnp.float32))
+    return apply_op("accuracy", fn, [input, label])
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,
+        slide_steps=1, ins_tag_weight=None):  # noqa: A002
+    """Batch AUC by threshold bucketing (ref auc_op)."""
+    def fn(pred, y):
+        pos_score = pred[:, 1] if pred.ndim == 2 and pred.shape[1] == 2 \
+            else pred.reshape(-1)
+        y = y.reshape(-1)
+        buckets = jnp.clip((pos_score * num_thresholds).astype(jnp.int32),
+                           0, num_thresholds)
+        pos = jnp.zeros(num_thresholds + 1).at[buckets].add(y.astype(jnp.float32))
+        neg = jnp.zeros(num_thresholds + 1).at[buckets].add(1.0 - y)
+        # integrate from the highest threshold down
+        pos_c = jnp.cumsum(pos[::-1])
+        neg_c = jnp.cumsum(neg[::-1])
+        tot_pos = pos_c[-1]
+        tot_neg = neg_c[-1]
+        # trapezoid over buckets: sum_b neg_b*(pos_above + pos_b/2)
+        area = jnp.sum(neg[::-1] * (jnp.concatenate([jnp.zeros(1), pos_c[:-1]])
+                                    + pos[::-1] / 2.0))
+        return area / jnp.maximum(tot_pos * tot_neg, 1e-9)
+    out = apply_op("auc", fn, [input, label])
+    return out, [out]
+
+
+def ctr_metric_bundle(input, label, ins_tag_weight=None):  # noqa: A002
+    a, _ = auc(input, label)
+    return a
+
+
+def exponential_decay(learning_rate, decay_steps, decay_rate, staircase=False):
+    from ..optimizer.lr import ExponentialDecay
+    return ExponentialDecay(learning_rate, decay_rate)
+
+
+class ExponentialMovingAverage:
+    """Ref fluid/optimizer.py ExponentialMovingAverage: shadow params with
+    bias-corrected decay, apply/restore context."""
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        self._decay = decay
+        self._shadow = {}
+        self._backup = {}
+        self._step = 0
+
+    def update(self):
+        prog = default_main_program()
+        for p in prog.all_parameters():
+            if not getattr(p, "trainable", False):
+                continue
+            v = np.asarray(p._value)
+            # shadow starts at ZERO so the bias-correction divide below is
+            # exact (ref ExponentialMovingAverage doc formula)
+            s = self._shadow.get(id(p), np.zeros_like(v))
+            self._shadow[id(p)] = self._decay * s + (1 - self._decay) * v
+        self._step += 1
+
+    @contextlib.contextmanager
+    def apply(self, executor=None, need_restore=True):
+        prog = default_main_program()
+        params = [p for p in prog.all_parameters()
+                  if getattr(p, "trainable", False)]
+        for p in params:
+            if id(p) in self._shadow:
+                self._backup[id(p)] = p._value
+                corr = 1.0 - self._decay ** max(self._step, 1)
+                p._set_value(jnp.asarray(self._shadow[id(p)] / corr,
+                                         p._value.dtype))
+        try:
+            yield
+        finally:
+            if need_restore:
+                self.restore(executor)
+
+    def restore(self, executor=None):
+        prog = default_main_program()
+        for p in prog.all_parameters():
+            if id(p) in self._backup:
+                p._set_value(self._backup.pop(id(p)))
+
+
+def Print(input, first_n=-1, message=None, summarize=20,  # noqa: A002
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_layout=True,
+          print_tensor_lod=True, print_phase="both"):
+    """Ref print op: identity with a host-side print via debug callback."""
+    def fn(v):
+        def _p(val):
+            print(f"{message or ''} {val.shape} {val.dtype}\n{val}")
+        jax.debug.callback(_p, v)
+        return v
+    return apply_op("print", fn, [input])
+
+
+# -- serialization -----------------------------------------------------------
+
+def serialize_program(feed_vars, fetch_vars, program=None):
+    prog = program or default_main_program()
+    spec = {
+        "feeds": [(v.name, list(v._value.shape), str(v._value.dtype))
+                  for v in prog._feeds],
+        "n_instructions": len(prog._instructions),
+    }
+    return pickle.dumps(spec)
+
+
+def serialize_persistables(feed_vars, fetch_vars, program=None):
+    prog = program or default_main_program()
+    blob = {i: np.asarray(p._value)
+            for i, p in enumerate(prog.all_parameters())}
+    return pickle.dumps(blob)
+
+
+def save_to_file(path, content):
+    with open(path, "wb") as f:
+        f.write(content)
+
+
+def load_from_file(path):
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def deserialize_program(data):
+    spec = pickle.loads(data)
+    prog = Program()
+    for name, shape, dtype in spec["feeds"]:
+        prog.add_feed(name, shape, dtype)
+    return prog
+
+
+def deserialize_persistables(program, data, executor=None):
+    blob = pickle.loads(data)
+    for i, p in enumerate(program.all_parameters()):
+        if i in blob:
+            p._set_value(jnp.asarray(blob[i]))
+
+
+def normalize_program(program, feed_vars, fetch_vars):
+    return program
+
+
+def save(program, model_path, protocol=4):
+    with open(model_path + ".pdparams", "wb") as f:
+        pickle.dump({i: np.asarray(p._value) for i, p in
+                     enumerate(program.all_parameters())}, f, protocol)
+
+
+def load(program, model_path, executor=None, var_list=None):
+    with open(model_path + ".pdparams", "rb") as f:
+        blob = pickle.load(f)
+    for i, p in enumerate(program.all_parameters()):
+        if i in blob:
+            p._set_value(jnp.asarray(blob[i]))
+
+
+def load_program_state(model_path, var_list=None):
+    with open(model_path + ".pdparams", "rb") as f:
+        return pickle.load(f)
+
+
+def set_program_state(program, state):
+    for i, p in enumerate(program.all_parameters()):
+        if i in state:
+            p._set_value(jnp.asarray(state[i]))

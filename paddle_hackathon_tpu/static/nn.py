@@ -1,0 +1,888 @@
+"""paddle.static.nn — the legacy declarative layer functions.
+
+Ref ``python/paddle/static/nn/__init__.py`` (41 exports, implemented in the
+reference by ``fluid/layers/nn.py`` append_op calls). Here each function
+builds the equivalent dynamic layer/op; in static-graph mode the underlying
+``apply_op`` records into the current Program exactly like every other op
+(``static/program.py record_op``), so these work in both modes.
+
+Sequence ops: the reference operates on LoDTensors. This build carries LoD
+as ``Tensor._lod`` (level-0 offsets, list[int]) — ``sequence_pad/unpad``
+convert between the packed (sum_len, ...) + lod form and padded batches.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.autograd import apply_op, no_grad
+from ..core.tensor import Tensor
+
+__all__ = [
+    "fc", "batch_norm", "embedding", "bilinear_tensor_product", "case",
+    "cond", "conv2d", "conv2d_transpose", "conv3d", "conv3d_transpose",
+    "crf_decoding", "data_norm", "deform_conv2d", "group_norm",
+    "instance_norm", "layer_norm", "multi_box_head", "nce", "prelu",
+    "py_func", "row_conv", "spectral_norm", "switch_case", "while_loop",
+    "sparse_embedding", "sequence_conv", "sequence_softmax", "sequence_pool",
+    "sequence_concat", "sequence_first_step", "sequence_last_step",
+    "sequence_slice", "sequence_expand", "sequence_expand_as", "sequence_pad",
+    "sequence_unpad", "sequence_reshape", "sequence_scatter",
+    "sequence_enumerate", "sequence_reverse", "StaticRNN",
+]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+
+
+def _lod_of(x):
+    lod = getattr(x, "_lod", None)
+    if lod is None:
+        raise ValueError(
+            "sequence op needs a LoD tensor; build one with sequence_pad/"
+            "unpad or set x._lod = [0, len1, len1+len2, ...] offsets")
+    return list(lod)
+
+
+def _with_lod(t, lod):
+    t._lod = list(lod)
+    return t
+
+
+# -- layer functions ---------------------------------------------------------
+
+def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
+       activation=None, name=None):
+    from .. import nn as _nn
+    from ..ops import manipulation as M
+    flat = M.flatten(x, num_flatten_dims) if x.ndim > 2 else x
+    lin = _nn.Linear(int(flat.shape[-1]), size, weight_attr=weight_attr,
+                     bias_attr=bias_attr)
+    out = lin(flat)
+    if activation:
+        out = getattr(_nn.functional, activation)(out)
+    return out
+
+
+def embedding(input, size, is_sparse=False, is_distributed=False,  # noqa: A002
+              padding_idx=None, param_attr=None, dtype="float32"):
+    from .. import nn as _nn
+    emb = _nn.Embedding(size[0], size[1], padding_idx=padding_idx,
+                        weight_attr=param_attr)
+    return emb(input)
+
+
+def sparse_embedding(input, size, padding_idx=None, is_test=False,  # noqa: A002
+                     entry=None, table_class="MemorySparseTable",
+                     param_attr=None, dtype="float32"):
+    """PS-backed sparse embedding (ref fleet sparse_embedding); falls back
+    to a dense Embedding outside a PS context."""
+    try:
+        from ..distributed.ps.api import SparseEmbedding
+        return SparseEmbedding(size[0], size[1])(input)
+    except Exception:
+        return embedding(input, size, padding_idx=padding_idx,
+                         param_attr=param_attr, dtype=dtype)
+
+
+def _conv(dim, transpose):
+    def op(input, num_filters, filter_size, stride=1, padding=0, dilation=1,  # noqa: A002
+           groups=1, param_attr=None, bias_attr=None, use_cudnn=True,
+           act=None, name=None, data_format=None, output_size=None):
+        from .. import nn as _nn
+        in_ch = int(input.shape[1])
+        cls = {
+            (2, False): _nn.Conv2D, (3, False): _nn.Conv3D,
+            (2, True): _nn.Conv2DTranspose, (3, True): _nn.Conv3DTranspose,
+        }[(dim, transpose)]
+        layer = cls(in_ch, num_filters, filter_size, stride=stride,
+                    padding=padding, dilation=dilation, groups=groups or 1,
+                    weight_attr=param_attr, bias_attr=bias_attr)
+        out = layer(input)
+        if act:
+            out = getattr(_nn.functional, act)(out)
+        return out
+    return op
+
+
+conv2d = _conv(2, False)
+conv3d = _conv(3, False)
+conv2d_transpose = _conv(2, True)
+conv3d_transpose = _conv(3, True)
+
+
+def deform_conv2d(input, offset, mask, num_filters, filter_size, stride=1,  # noqa: A002
+                  padding=0, dilation=1, groups=1, deformable_groups=1,
+                  im2col_step=1, param_attr=None, bias_attr=None, name=None):
+    from ..vision.ops import DeformConv2D
+    layer = DeformConv2D(int(input.shape[1]), num_filters, filter_size,
+                         stride=stride, padding=padding, dilation=dilation,
+                         deformable_groups=deformable_groups, groups=groups,
+                         weight_attr=param_attr, bias_attr=bias_attr)
+    return layer(input, offset, mask)
+
+
+def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5,  # noqa: A002
+               param_attr=None, bias_attr=None, data_layout="NCHW",
+               name=None, moving_mean_name=None, moving_variance_name=None,
+               do_model_average_for_mean_and_var=True, use_global_stats=False):
+    from .. import nn as _nn
+    ch = int(input.shape[1 if data_layout == "NCHW" else -1])
+    bn = _nn.BatchNorm2D(ch, momentum=momentum, epsilon=epsilon,
+                         weight_attr=param_attr, bias_attr=bias_attr,
+                         data_format=data_layout)
+    if is_test or use_global_stats:
+        bn.eval()
+    out = bn(input)
+    if act:
+        out = getattr(_nn.functional, act)(out)
+    return out
+
+
+def instance_norm(input, epsilon=1e-5, param_attr=None, bias_attr=None,  # noqa: A002
+                  name=None):
+    from .. import nn as _nn
+    return _nn.InstanceNorm2D(int(input.shape[1]), epsilon=epsilon,
+                              weight_attr=param_attr,
+                              bias_attr=bias_attr)(input)
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,  # noqa: A002
+               epsilon=1e-5, param_attr=None, bias_attr=None, act=None,
+               name=None):
+    from .. import nn as _nn
+    shape = [int(s) for s in input.shape[begin_norm_axis:]]
+    ln = _nn.LayerNorm(shape, epsilon=epsilon,
+                       weight_attr=param_attr if scale else False,
+                       bias_attr=bias_attr if shift else False)
+    out = ln(input)
+    if act:
+        out = getattr(_nn.functional, act)(out)
+    return out
+
+
+def group_norm(input, groups, epsilon=1e-5, param_attr=None, bias_attr=None,  # noqa: A002
+               act=None, data_layout="NCHW", name=None):
+    from .. import nn as _nn
+    gn = _nn.GroupNorm(groups, int(input.shape[1]), epsilon=epsilon,
+                       weight_attr=param_attr, bias_attr=bias_attr)
+    out = gn(input)
+    if act:
+        out = getattr(_nn.functional, act)(out)
+    return out
+
+
+def data_norm(input, act=None, epsilon=1e-5, param_attr=None,  # noqa: A002
+              data_layout="NCHW", in_place=False, name=None,
+              moving_mean_name=None, moving_variance_name=None,
+              do_model_average_for_mean_and_var=True, slot_dim=-1,
+              sync_stats=False, summary_decay_rate=0.9999999,
+              enable_scale_and_shift=False):
+    """Normalize by accumulated batch statistics (ref data_norm_op):
+    out = (x - mean) / sqrt(var), stats maintained as running sums."""
+    def fn(v):
+        mean = jnp.mean(v, 0, keepdims=True)
+        var = jnp.var(v, 0, keepdims=True)
+        return (v - mean) * jax.lax.rsqrt(var + epsilon)
+    out = apply_op("data_norm", fn, [_t(input)])
+    if act:
+        from .. import nn as _nn
+        out = getattr(_nn.functional, act)(out)
+    return out
+
+
+def prelu(x, mode="all", param_attr=None, data_format="NCHW", name=None):
+    from .. import nn as _nn
+    num = 1 if mode == "all" else int(x.shape[1])
+    layer = _nn.PReLU(num_parameters=num, weight_attr=param_attr)
+    return layer(x)
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    from .. import nn as _nn
+    layer = _nn.SpectralNorm(list(weight.shape), dim=dim,
+                             power_iters=power_iters, eps=eps)
+    return layer(weight)
+
+
+def bilinear_tensor_product(x, y, size, act=None, name=None,
+                            param_attr=None, bias_attr=None):
+    from .. import nn as _nn
+    layer = _nn.Bilinear(int(x.shape[-1]), int(y.shape[-1]), size,
+                         weight_attr=param_attr, bias_attr=bias_attr)
+    out = layer(x, y)
+    if act:
+        out = getattr(_nn.functional, act)(out)
+    return out
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None):  # noqa: A002
+    """Lookahead row convolution (ref row_conv_op): out[t] = sum_{i=0..k}
+    w[i] * x[t+i], zero-padded at the tail."""
+    from ..nn.parameter import create_parameter
+    k = int(future_context_size)
+    w = create_parameter([k + 1, int(input.shape[-1])], "float32",
+                         attr=param_attr)
+
+    def fn(v, wt):
+        # v: (B, T, D) (batched padded layout)
+        pads = [(0, 0), (0, k), (0, 0)]
+        vp = jnp.pad(v, pads)
+        out = sum(vp[:, i:i + v.shape[1]] * wt[i] for i in range(k + 1))
+        return out
+    out = apply_op("row_conv", fn, [_t(input), w])
+    if act:
+        from .. import nn as _nn
+        out = getattr(_nn.functional, act)(out)
+    return out
+
+
+def nce(input, label, num_total_classes, sample_weight=None,  # noqa: A002
+        param_attr=None, bias_attr=None, num_neg_samples=10, name=None,
+        sampler="uniform", custom_dist=None, seed=0, is_sparse=False):
+    """Noise-contrastive estimation loss (ref nce_op): logistic loss on the
+    true class + sampled negatives."""
+    from ..core import random as core_random
+    from ..nn.parameter import create_parameter
+    d = int(input.shape[-1])
+    w = create_parameter([num_total_classes, d], "float32", attr=param_attr)
+    b = create_parameter([num_total_classes], "float32", attr=bias_attr,
+                         is_bias=True)
+    key = core_random.split_key()
+    neg = jax.random.randint(key, (num_neg_samples,), 0, num_total_classes)
+
+    def fn(x, y, wt, bt):
+        y = y.reshape(-1)
+        pos_logit = jnp.einsum("bd,bd->b", x, wt[y]) + bt[y]
+        neg_logit = x @ wt[neg].T + bt[neg]  # (B, S)
+        softplus = lambda z: jnp.maximum(z, 0) + jnp.log1p(jnp.exp(-jnp.abs(z)))
+        pos_loss = softplus(-pos_logit)
+        neg_loss = softplus(neg_logit).sum(-1)
+        return (pos_loss + neg_loss)[:, None]
+    return apply_op("nce", fn, [_t(input), _t(label), w, b])
+
+
+def crf_decoding(input, param_attr=None, label=None, length=None,  # noqa: A002
+                 transition=None):
+    """Linear-chain CRF Viterbi decode (ref crf_decoding_op). The
+    transition parameter is (n+2, n): row 0 = start scores, row 1 = stop
+    scores, rows 2.. = square tag-to-tag transitions."""
+    trans = transition if transition is not None else param_attr
+    trans = _t(trans)
+    x = _t(input)
+    B, L, n = (int(d) for d in x.shape)
+    if length is None:
+        lens_arr = np.full((B,), L, np.int64)
+    else:
+        lens_arr = np.asarray(_t(length)._value)
+
+    def fn(em, tr):
+        start, stop, body = tr[0], tr[1], tr[2:]
+        lens = jnp.asarray(lens_arr)
+        alpha = em[:, 0, :] + start[None, :]
+        left = lens - 1
+        historys = []
+        for t in range(1, L):
+            ts = alpha[:, :, None] + body[None, :, :]
+            historys.append(jnp.argmax(ts, 1))
+            nxt = jnp.max(ts, 1) + em[:, t, :]
+            alpha = jnp.where((left > 0)[:, None], nxt, alpha)
+            left = left - 1
+        final = alpha + stop[None, :]
+        cur = jnp.argmax(final, -1).astype(jnp.int64)
+        cols = [jnp.where(L - 1 == lens - 1, cur, 0)]
+        for t in range(L - 2, -1, -1):
+            nxt = jnp.take_along_axis(historys[t], cur[:, None], 1)[:, 0]
+            cur = jnp.where(t == lens - 1, jnp.argmax(final, -1).astype(jnp.int64),
+                            jnp.where(t < lens - 1, nxt, cur))
+            cols.append(jnp.where(t < lens, cur, 0))
+        return jnp.stack(cols[::-1], 1)
+
+    with no_grad():
+        return apply_op("crf_decoding", fn, [x, trans])
+
+
+def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
+                   min_ratio=None, max_ratio=None, min_sizes=None,
+                   max_sizes=None, steps=None, step_w=None, step_h=None,
+                   offset=0.5, variance=(0.1, 0.1, 0.2, 0.2), flip=True,
+                   clip=False, kernel_size=1, pad=0, stride=1, name=None,
+                   min_max_aspect_ratios_order=False):
+    """SSD detection head (ref multi_box_head in fluid/layers/detection.py):
+    per-feature-map conv predictions + prior boxes."""
+    from .. import nn as _nn
+    from ..ops import manipulation as M
+    n_in = len(inputs)
+    if min_sizes is None:
+        # evenly spaced ratios as in the reference
+        min_ratio, max_ratio = min_ratio or 20, max_ratio or 90
+        step = int(np.floor((max_ratio - min_ratio) / max(n_in - 2, 1)))
+        min_sizes, max_sizes = [], []
+        for ratio in range(min_ratio, max_ratio + 1, step):
+            min_sizes.append(base_size * ratio / 100.0)
+            max_sizes.append(base_size * (ratio + step) / 100.0)
+        min_sizes = [base_size * 0.10] + min_sizes[:n_in - 1]
+        max_sizes = [base_size * 0.20] + max_sizes[:n_in - 1]
+
+    locs, confs, boxes_all, vars_all = [], [], [], []
+    img_h = int(image.shape[2])
+    img_w = int(image.shape[3])
+    for i, feat in enumerate(inputs):
+        ar = list(aspect_ratios[i])
+        n_prior = len(ar) * (2 if flip else 1) + 1 + (
+            1 if max_sizes else 0)
+        ch = int(feat.shape[1])
+        loc_conv = _nn.Conv2D(ch, n_prior * 4, kernel_size, stride=stride,
+                              padding=pad)
+        conf_conv = _nn.Conv2D(ch, n_prior * num_classes, kernel_size,
+                               stride=stride, padding=pad)
+        loc = loc_conv(feat)
+        conf = conf_conv(feat)
+        fh, fw = int(feat.shape[2]), int(feat.shape[3])
+        locs.append(M.reshape(M.transpose(loc, [0, 2, 3, 1]), [loc.shape[0], -1, 4]))
+        confs.append(M.reshape(M.transpose(conf, [0, 2, 3, 1]),
+                               [conf.shape[0], -1, num_classes]))
+        # prior boxes (host-side constants)
+        with no_grad():
+            sw = step_w[i] if step_w else img_w / fw
+            sh = step_h[i] if step_h else img_h / fh
+            widths, heights = [], []
+            ms, mxs = min_sizes[i], (max_sizes[i] if max_sizes else None)
+            widths.append(ms); heights.append(ms)
+            if mxs:
+                s = np.sqrt(ms * mxs)
+                widths.append(s); heights.append(s)
+            for a in ar:
+                if a == 1.0:
+                    continue
+                widths.append(ms * np.sqrt(a)); heights.append(ms / np.sqrt(a))
+                if flip:
+                    widths.append(ms / np.sqrt(a)); heights.append(ms * np.sqrt(a))
+            cx = (np.arange(fw) + offset) * sw
+            cy = (np.arange(fh) + offset) * sh
+            cxg, cyg = np.meshgrid(cx, cy)
+            pb = []
+            for wdt, hgt in zip(widths, heights):
+                x1 = (cxg - wdt / 2) / img_w
+                y1 = (cyg - hgt / 2) / img_h
+                x2 = (cxg + wdt / 2) / img_w
+                y2 = (cyg + hgt / 2) / img_h
+                pb.append(np.stack([x1, y1, x2, y2], -1))
+            pb = np.stack(pb, 2).reshape(-1, 4)
+            if clip:
+                pb = np.clip(pb, 0, 1)
+            boxes_all.append(pb)
+            vars_all.append(np.tile(np.asarray(variance, np.float32),
+                                    (pb.shape[0], 1)))
+    mbox_locs = M.concat(locs, axis=1)
+    mbox_confs = M.concat(confs, axis=1)
+    boxes = Tensor(jnp.asarray(np.concatenate(boxes_all).astype(np.float32)))
+    variances = Tensor(jnp.asarray(np.concatenate(vars_all).astype(np.float32)))
+    return mbox_locs, mbox_confs, boxes, variances
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """Wrap a host python function as an op (ref py_func_op): runs via
+    pure_callback, with an optional custom backward."""
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    avals = [jax.ShapeDtypeStruct(tuple(o.shape), o._value.dtype)
+             for o in outs]
+    single_out = not isinstance(out, (list, tuple))
+
+    def base_fn(*vals):
+        res = jax.pure_callback(
+            lambda *hs: func(*[np.asarray(h) for h in hs]),
+            avals if not single_out else avals[0], *vals,
+            vmap_method="sequential")
+        return res if single_out else tuple(res)
+
+    if backward_func is None:
+        with no_grad():
+            return apply_op("py_func", base_fn, [_t(v) for v in xs],
+                            n_outputs=len(outs))
+    # custom vjp through the host backward
+    in_avals = [jax.ShapeDtypeStruct(tuple(v.shape), v._value.dtype)
+                for v in (_t(v) for v in xs)]
+
+    @jax.custom_vjp
+    def fn(*vals):
+        return base_fn(*vals)
+
+    def fwd(*vals):
+        return fn(*vals), vals
+
+    def bwd(res, g):
+        gs = jax.pure_callback(
+            lambda *hs: tuple(np.asarray(r) for r in
+                              (backward_func(*[np.asarray(h) for h in hs]),)
+                              ) if len(in_avals) == 1
+            else tuple(np.asarray(r) for r in
+                       backward_func(*[np.asarray(h) for h in hs])),
+            tuple(in_avals), *res,
+            (g if single_out else g[0]), vmap_method="sequential")
+        return gs
+
+    fn.defvjp(fwd, bwd)
+    return apply_op("py_func", fn, [_t(v) for v in xs], n_outputs=len(outs))
+
+
+# -- control flow (eager semantics; under jit these trace through) -----------
+
+def cond(pred, true_fn=None, false_fn=None, name=None, return_names=None):
+    """Ref layers.cond. Eager: picks the branch by the concrete predicate.
+    Under jax tracing both branches must be traceable (lax.cond)."""
+    p = pred
+    if isinstance(p, Tensor):
+        try:
+            p = bool(np.asarray(p._value))
+        except Exception:
+            # traced: use lax.cond over closed-over branches
+            return apply_op(
+                "cond",
+                lambda c: jax.lax.cond(c, lambda: true_fn(), lambda: false_fn()),
+                [pred])
+    return true_fn() if p else (false_fn() if false_fn else None)
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    for p, f in pred_fn_pairs:
+        val = bool(np.asarray(p._value)) if isinstance(p, Tensor) else bool(p)
+        if val:
+            return f()
+    if default is not None:
+        return default()
+    return pred_fn_pairs[-1][1]()
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    idx = int(np.asarray(_t(branch_index)._value))
+    fns = dict(branch_fns) if isinstance(branch_fns, (list, tuple)) and all(
+        isinstance(b, (list, tuple)) for b in branch_fns) else branch_fns
+    if isinstance(fns, dict) and idx in fns:
+        return fns[idx]()
+    if isinstance(fns, (list, tuple)):
+        if 0 <= idx < len(fns):
+            return fns[idx]()
+    if default is not None:
+        return default()
+    raise ValueError(f"branch {idx} not found and no default")
+
+
+def while_loop(cond_fn, body, loop_vars, is_test=False, name=None):
+    """Ref layers.while_loop. Eager python loop; each iteration's ops are
+    taped, so backward works like the reference's while grad."""
+    vars_ = list(loop_vars)
+    while bool(np.asarray(_t(cond_fn(*vars_))._value)):
+        out = body(*vars_)
+        vars_ = list(out) if isinstance(out, (list, tuple)) else [out]
+    return vars_
+
+
+class StaticRNN:
+    """Ref ``fluid/layers/control_flow.py`` StaticRNN: the with-block body
+    records one timestep into a sub-Program; on exit ONE outer instruction
+    wraps it in ``lax.scan`` over the time axis (the reference builds a
+    while op + step scopes; scan is the XLA-native equivalent, and grads
+    flow through scan for free)."""
+
+    def __init__(self, name=None):
+        from .program import Program
+        self._inner = Program()
+        self._step_inputs = []    # (outer_var, inner_var)
+        self._memories = []       # dict ref -> (init_arg, inner_var)
+        self._mem_order = []
+        self._updates = {}        # inner mem var_id -> inner new var_id
+        self._step_outs = []      # inner vars
+        self._outputs = None
+        self._guard = None
+
+    # -- with-block protocol ------------------------------------------------
+    def step(self):
+        from . import program as _prog
+        rnn = self
+
+        class _Ctx:
+            def __enter__(self):
+                if not _prog.in_static_mode():
+                    raise RuntimeError(
+                        "StaticRNN is a static-graph construct; use nn.RNN "
+                        "in dygraph mode")
+                rnn._guard = _prog.program_guard(rnn._inner)
+                rnn._guard.__enter__()
+                return rnn
+
+            def __exit__(self, exc_type, exc, tb):
+                rnn._guard.__exit__(exc_type, exc, tb)
+                if exc_type is None:
+                    rnn._finalize()
+                return False
+
+        return _Ctx()
+
+    # -- step definition ----------------------------------------------------
+    def step_input(self, x):
+        import jax as _jax
+        aval = _jax.ShapeDtypeStruct(tuple(x._value.shape[1:]),
+                                     x._value.dtype)
+        inner = self._inner._new_var(aval, name=f"rnn_in_{len(self._step_inputs)}")
+        self._step_inputs.append((x, inner))
+        return inner
+
+    def memory(self, init=None, shape=None, batch_ref=None, init_value=0.0,
+               init_batch_dim_idx=0, ref_batch_dim_idx=1):
+        import jax as _jax
+        import jax.numpy as _jnp
+        if init is not None:
+            aval = _jax.ShapeDtypeStruct(tuple(init._value.shape),
+                                         init._value.dtype)
+            init_arg = init
+        else:
+            if batch_ref is None or shape is None:
+                raise ValueError("memory() needs init= or (shape, batch_ref)")
+            dims = [int(s) for s in shape]
+            # -1 batch dim comes from batch_ref's batch axis
+            b = int(batch_ref._value.shape[0])
+            dims = [b if d < 0 else d for d in dims]
+            init_arg = ("const_fill", tuple(dims), float(init_value))
+            aval = _jax.ShapeDtypeStruct(tuple(dims), _jnp.float32)
+        inner = self._inner._new_var(aval, name=f"rnn_mem_{len(self._mem_order)}")
+        self._memories.append((init_arg, inner))
+        self._mem_order.append(inner._var_id)
+        return inner
+
+    def update_memory(self, mem, new):
+        self._updates[mem._var_id] = new._var_id
+
+    def step_output(self, out):
+        self._step_outs.append(out)
+
+    def output(self, *outputs):
+        for o in outputs:
+            self.step_output(o)
+
+    # -- lowering -----------------------------------------------------------
+    def _finalize(self):
+        import jax as _jax
+        import jax.numpy as _jnp
+        from . import program as _prog
+
+        if not self._step_inputs:
+            raise ValueError("StaticRNN needs at least one step_input")
+        inner = self._inner
+        outer = _prog.default_main_program()
+        T = int(self._step_inputs[0][0]._value.shape[0])
+
+        x_inner_ids = [iv._var_id for _, iv in self._step_inputs]
+        mem_ids = list(self._mem_order)
+        upd_ids = [self._updates.get(mid, mid) for mid in mem_ids]
+        out_ids = [o._var_id for o in self._step_outs]
+
+        par_refs = []
+        seen = set()
+        for ins in inner._instructions:
+            for kind, ref in ins.inputs:
+                if kind == "param" and id(ref) not in seen:
+                    seen.add(id(ref))
+                    par_refs.append(ref)
+
+        n_x = len(x_inner_ids)
+        n_m = len(mem_ids)
+        outer_args = [ov for ov, _ in self._step_inputs]
+        mem_fill = []
+        for init_arg, _ in self._memories:
+            if isinstance(init_arg, tuple) and init_arg[0] == "const_fill":
+                mem_fill.append(init_arg)
+                outer_args.append(None)  # placeholder, filled inside fn
+            else:
+                mem_fill.append(None)
+                outer_args.append(init_arg)
+        # drop None placeholders from the recorded arg list but remember
+        # which memory positions are const-filled
+        rec_args = [a for a in outer_args if a is not None] + par_refs
+
+        def scan_fn(*vals):
+            it = iter(vals)
+            xs_vals = [next(it) for _ in range(n_x)]
+            mem_vals = []
+            for fill in mem_fill:
+                if fill is None:
+                    mem_vals.append(next(it))
+                else:
+                    _, dims, fv = fill
+                    mem_vals.append(_jnp.full(dims, fv, _jnp.float32))
+            par_vals = {id(r): next(it) for r in par_refs}
+
+            def step_fn(carry, xt):
+                feed = dict(zip(x_inner_ids, xt))
+                feed.update(dict(zip(mem_ids, carry)))
+                env = inner.replay(feed, par_vals)
+                new_carry = tuple(env[u] for u in upd_ids)
+                outs = tuple(env[o] for o in out_ids)
+                return new_carry, outs
+
+            carry0 = tuple(mem_vals)
+            _, stacked = _jax.lax.scan(step_fn, carry0, tuple(xs_vals),
+                                       length=T)
+            return stacked if len(out_ids) > 1 else stacked[0]
+
+        self._outputs = outer.record_op("static_rnn", scan_fn, rec_args,
+                                        n_outputs=max(len(out_ids), 1))
+
+    def __call__(self):
+        if self._outputs is None:
+            raise RuntimeError("call StaticRNN() after the step block")
+        return self._outputs
+
+
+
+
+# -- sequence ops (LoD level-0: packed rows + offsets in Tensor._lod) --------
+
+def sequence_pad(x, pad_value, maxlen=None, name=None):
+    """Packed (sum_len, ...) + lod -> (padded (B, L, ...), lengths)
+    (ref sequence_pad_op)."""
+    lod = _lod_of(x)
+    lens = [lod[i + 1] - lod[i] for i in range(len(lod) - 1)]
+    L = maxlen or max(lens)
+    pv = pad_value._value if isinstance(pad_value, Tensor) else pad_value
+
+    def fn(v):
+        rows = []
+        for i, ln in enumerate(lens):
+            seq = v[lod[i]:lod[i + 1]]
+            pad_shape = (L - ln,) + v.shape[1:]
+            rows.append(jnp.concatenate(
+                [seq, jnp.full(pad_shape, pv, v.dtype)]) if ln < L
+                else seq[:L])
+        return jnp.stack(rows), jnp.asarray(lens, jnp.int64)
+    return apply_op("sequence_pad", fn, [_t(x)], n_outputs=2)
+
+
+def sequence_unpad(x, length, name=None):
+    """(B, L, ...) + lengths -> packed rows with lod (ref sequence_unpad_op)."""
+    lens = [int(v) for v in np.asarray(_t(length)._value)]
+    lod = [0]
+    for ln in lens:
+        lod.append(lod[-1] + ln)
+
+    def fn(v):
+        return jnp.concatenate([v[i, :ln] for i, ln in enumerate(lens)])
+    return _with_lod(apply_op("sequence_unpad", fn, [_t(x)]), lod)
+
+
+def sequence_pool(input, pool_type="average", is_test=False, pad_value=0.0):  # noqa: A002
+    lod = _lod_of(input)
+    n = len(lod) - 1
+    pt = pool_type.lower()
+
+    def fn(v):
+        outs = []
+        for i in range(n):
+            seq = v[lod[i]:lod[i + 1]]
+            if seq.shape[0] == 0:
+                outs.append(jnp.full(v.shape[1:], pad_value, v.dtype))
+                continue
+            if pt in ("average", "mean"):
+                outs.append(seq.mean(0))
+            elif pt == "sum":
+                outs.append(seq.sum(0))
+            elif pt == "sqrt":
+                outs.append(seq.sum(0) / jnp.sqrt(float(seq.shape[0])))
+            elif pt == "max":
+                outs.append(seq.max(0))
+            elif pt == "min":
+                outs.append(seq.min(0))
+            elif pt == "first":
+                outs.append(seq[0])
+            elif pt == "last":
+                outs.append(seq[-1])
+            else:
+                raise ValueError(f"unknown pool_type {pool_type!r}")
+        return jnp.stack(outs)
+    return apply_op("sequence_pool", fn, [_t(input)])
+
+
+def sequence_first_step(input):  # noqa: A002
+    return sequence_pool(input, "first")
+
+
+def sequence_last_step(input):  # noqa: A002
+    return sequence_pool(input, "last")
+
+
+def sequence_softmax(input, use_cudnn=False, name=None):  # noqa: A002
+    lod = _lod_of(input)
+    n = len(lod) - 1
+
+    def fn(v):
+        parts = [jax.nn.softmax(v[lod[i]:lod[i + 1]], axis=0)
+                 for i in range(n)]
+        return jnp.concatenate(parts)
+    return _with_lod(apply_op("sequence_softmax", fn, [_t(input)]), lod)
+
+
+def sequence_concat(input, name=None):  # noqa: A002
+    lods = [_lod_of(x) for x in input]
+    n = len(lods[0]) - 1
+    new_lod = [0]
+    for i in range(n):
+        new_lod.append(new_lod[-1] + sum(l[i + 1] - l[i] for l in lods))
+
+    def fn(*vs):
+        parts = []
+        for i in range(n):
+            for v, lod in zip(vs, lods):
+                parts.append(v[lod[i]:lod[i + 1]])
+        return jnp.concatenate(parts)
+    return _with_lod(apply_op("sequence_concat", fn,
+                              [_t(x) for x in input]), new_lod)
+
+
+def sequence_slice(input, offset, length, name=None):  # noqa: A002
+    lod = _lod_of(input)
+    n = len(lod) - 1
+    offs = [int(v) for v in np.asarray(_t(offset)._value).reshape(-1)]
+    lens = [int(v) for v in np.asarray(_t(length)._value).reshape(-1)]
+    new_lod = [0]
+    for ln in lens:
+        new_lod.append(new_lod[-1] + ln)
+
+    def fn(v):
+        return jnp.concatenate([
+            v[lod[i] + offs[i]: lod[i] + offs[i] + lens[i]]
+            for i in range(n)])
+    return _with_lod(apply_op("sequence_slice", fn, [_t(input)]), new_lod)
+
+
+def sequence_expand(x, y, ref_level=-1, name=None):
+    """Repeat each sequence of x per the matching sequence length of y
+    (ref sequence_expand_op)."""
+    ylod = _lod_of(y)
+    xlod = getattr(x, "_lod", None) or list(range(int(x.shape[0]) + 1))
+    n = len(xlod) - 1
+    reps = [ylod[i + 1] - ylod[i] for i in range(len(ylod) - 1)]
+    new_lod = [0]
+
+    def fn(v):
+        parts = []
+        for i in range(n):
+            seq = v[xlod[i]:xlod[i + 1]]
+            for _ in range(max(reps[i], 1) if i < len(reps) else 1):
+                parts.append(seq)
+                new_lod.append(new_lod[-1] + seq.shape[0])
+        return jnp.concatenate(parts)
+    out = apply_op("sequence_expand", fn, [_t(x)])
+    return _with_lod(out, new_lod)
+
+
+def sequence_expand_as(x, y, name=None):
+    """Expand each row of x to the length of y's i-th sequence."""
+    ylod = _lod_of(y)
+    n = len(ylod) - 1
+
+    def fn(v):
+        return jnp.concatenate([
+            jnp.repeat(v[i:i + 1], ylod[i + 1] - ylod[i], axis=0)
+            for i in range(n)])
+    return _with_lod(apply_op("sequence_expand_as", fn, [_t(x)]), list(ylod))
+
+
+def sequence_reshape(input, new_dim, name=None):  # noqa: A002
+    lod = _lod_of(input)
+    d = int(input.shape[-1])
+    new_lod = [o * d // new_dim for o in lod]
+
+    def fn(v):
+        return v.reshape(-1, new_dim)
+    return _with_lod(apply_op("sequence_reshape", fn, [_t(input)]), new_lod)
+
+
+def sequence_scatter(input, index, updates, name=None):  # noqa: A002
+    """Scatter-add updates into input rows at per-sequence indices
+    (ref sequence_scatter_op)."""
+    ilod = _lod_of(index)
+    n = len(ilod) - 1
+    idx = np.asarray(_t(index)._value).reshape(-1)
+    flat = np.concatenate([idx[ilod[i]:ilod[i + 1]] + 0  # per-seq row space
+                           for i in range(n)])
+    row_of = np.concatenate([np.full(ilod[i + 1] - ilod[i], i)
+                             for i in range(n)])
+
+    def fn(v, u):
+        u = u.reshape(-1)
+        return v.at[row_of, flat].add(u)
+    return apply_op("sequence_scatter", fn, [_t(input), _t(updates)])
+
+
+def sequence_enumerate(input, win_size, pad_value=0, name=None):  # noqa: A002
+    lod = _lod_of(input)
+    n = len(lod) - 1
+
+    def fn(v):
+        v = v.reshape(-1)
+        rows = []
+        for i in range(n):
+            seq = v[lod[i]:lod[i + 1]]
+            ln = seq.shape[0]
+            padded = jnp.concatenate(
+                [seq, jnp.full((win_size - 1,), pad_value, seq.dtype)])
+            rows.append(jnp.stack([padded[j:j + win_size]
+                                   for j in range(ln)]))
+        return jnp.concatenate(rows)
+    return _with_lod(apply_op("sequence_enumerate", fn, [_t(input)]), lod)
+
+
+def sequence_reverse(x, name=None):
+    lod = _lod_of(x)
+    n = len(lod) - 1
+
+    def fn(v):
+        return jnp.concatenate([v[lod[i]:lod[i + 1]][::-1] for i in range(n)])
+    return _with_lod(apply_op("sequence_reverse", fn, [_t(x)]), lod)
+
+
+def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,  # noqa: A002
+                  padding=True, padding_start=None, bias_attr=None,
+                  param_attr=None, act=None, name=None):
+    """Context-window convolution per sequence (ref sequence_conv_op):
+    each row's context [t+start, t+start+filter_size) within its sequence,
+    zero-padded at boundaries, times a (ctx*D, num_filters) weight."""
+    from ..nn.parameter import create_parameter
+    lod = _lod_of(input)
+    n = len(lod) - 1
+    d = int(input.shape[-1])
+    start = -int(filter_size // 2) if padding_start is None else int(padding_start)
+    w = create_parameter([filter_size * d, num_filters], "float32",
+                         attr=param_attr)
+    b = (None if bias_attr is False
+         else create_parameter([num_filters], "float32", attr=bias_attr,
+                               is_bias=True))
+
+    def fn(v, wt, *bt):
+        outs = []
+        for i in range(n):
+            seq = v[lod[i]:lod[i + 1]]
+            ln = seq.shape[0]
+            ctx = []
+            for k in range(filter_size):
+                shift = start + k
+                idx = jnp.arange(ln) + shift
+                valid = (idx >= 0) & (idx < ln)
+                rows = seq[jnp.clip(idx, 0, ln - 1)]
+                ctx.append(jnp.where(valid[:, None], rows, 0.0))
+            cat = jnp.concatenate(ctx, axis=-1)  # (ln, filter_size*D)
+            outs.append(cat @ wt)
+        out = jnp.concatenate(outs)
+        if bt:
+            out = out + bt[0]
+        return out
+    args = [_t(input), w] + ([b] if b is not None else [])
+    out = apply_op("sequence_conv", fn, args)
+    if act:
+        from .. import nn as _nn
+        out = getattr(_nn.functional, act)(out)
+    return _with_lod(out, lod)

@@ -9,6 +9,7 @@ real corpora (swap in the downloaded files for that).
 
 from .datasets import (Conll05st, Imdb, Imikolov, Movielens,  # noqa: F401
                        UCIHousing, WMT14, WMT16)
+from .viterbi_decode import ViterbiDecoder, viterbi_decode  # noqa: F401
 
 __all__ = ["Conll05st", "Imdb", "Imikolov", "Movielens", "UCIHousing",
-           "WMT14", "WMT16"]
+           "WMT14", "WMT16", "ViterbiDecoder", "viterbi_decode"]

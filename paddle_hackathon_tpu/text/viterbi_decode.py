@@ -1,0 +1,85 @@
+"""Viterbi decoding (ref ``python/paddle/text/viterbi_decode.py``; kernel
+``paddle/phi/kernels/cpu/viterbi_decode_kernel.cc:159``).
+
+Masked DP exactly mirroring the kernel: with ``include_bos_eos_tag`` the
+last transition row is the start tag and the second-to-last row the stop
+tag (``viterbi_decode_kernel.cc:222-246``); sequences shorter than the
+batch max freeze their alpha once exhausted and pad their path with 0.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.autograd import apply_op, no_grad
+from ..core.tensor import Tensor
+from ..nn.layer import Layer
+
+__all__ = ["viterbi_decode", "ViterbiDecoder"]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+
+
+def viterbi_decode(potentials, transition_params, lengths,
+                   include_bos_eos_tag=True, name=None):
+    """Returns (scores (B,), paths (B, max_len) int64, zero-padded)."""
+
+    def fn(pot, trans, lens):
+        b, L, n = pot.shape
+        lens = lens.astype(jnp.int32)
+        left = lens  # "left_length" in the kernel
+        alpha = pot[:, 0, :]
+        if include_bos_eos_tag:
+            alpha = alpha + trans[n - 1][None, :]
+            alpha = alpha + trans[n - 2][None, :] * (left == 1)[:, None]
+        left = left - 1
+        historys = []
+        for i in range(1, L):
+            # best previous label for each current label
+            trn_sum = alpha[:, :, None] + trans[None, :, :]  # (B, prev, cur)
+            hist = jnp.argmax(trn_sum, axis=1)               # (B, cur)
+            alpha_max = jnp.max(trn_sum, axis=1)
+            alpha_nxt = alpha_max + pot[:, i, :]
+            live = (left > 0)[:, None]
+            alpha = jnp.where(live, alpha_nxt, alpha)
+            if include_bos_eos_tag:
+                alpha = alpha + trans[n - 2][None, :] * (left == 1)[:, None]
+            left = left - 1
+            historys.append(hist)
+        scores = jnp.max(alpha, -1)
+        last_ids = jnp.argmax(alpha, -1).astype(jnp.int64)
+
+        # backtrack (kernel lines 281-315): path[t] = historys[t][path[t+1]]
+        cur = last_ids
+        cols = []
+        t = L - 1
+        cols.append(jnp.where(t == lens - 1, cur, 0))
+        for t in range(L - 2, -1, -1):
+            nxt = jnp.take_along_axis(historys[t], cur[:, None], 1)[:, 0]
+            cur = jnp.where(t == lens - 1, last_ids,
+                            jnp.where(t < lens - 1, nxt, cur))
+            cols.append(jnp.where(t < lens, cur, 0))
+        path = jnp.stack(cols[::-1], axis=1).astype(jnp.int64)
+        return scores, path
+
+    with no_grad():
+        return apply_op("viterbi_decode", fn,
+                        [_t(potentials), _t(transition_params), _t(lengths)],
+                        n_outputs=2)
+
+
+class ViterbiDecoder(Layer):
+    """Layer wrapper owning the transition matrix argument order
+    (ref viterbi_decode.py:92)."""
+
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        super().__init__()
+        self.transitions = transitions
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def forward(self, potentials, lengths):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)

@@ -1,3 +1,66 @@
 """Utility surface: filesystem abstraction + misc helpers."""
 
 from .fs import FS, LocalFS, HDFSClient  # noqa: F401
+
+import functools as _functools
+import importlib as _importlib
+import warnings as _warnings
+
+from . import cpp_extension  # noqa: F401
+
+
+def deprecated(update_to="", since="", reason="", level=0):
+    """Decorator marking an API deprecated (ref utils/deprecated.py)."""
+    def wrap(fn):
+        @_functools.wraps(fn)
+        def inner(*args, **kwargs):
+            msg = (f"API {fn.__module__}.{fn.__name__} is deprecated"
+                   + (f" since {since}" if since else "")
+                   + (f", use {update_to} instead" if update_to else "")
+                   + (f": {reason}" if reason else ""))
+            if level == 2:
+                raise RuntimeError(msg)
+            _warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            return fn(*args, **kwargs)
+        return inner
+    return wrap
+
+
+def try_import(module_name, err_msg=None):
+    """Import or raise with an install hint (ref utils/lazy_import.py)."""
+    try:
+        return _importlib.import_module(module_name)
+    except ImportError:
+        raise ImportError(err_msg or f"Failed to import {module_name}. "
+                          f"Install it to use this feature.")
+
+
+def require_version(min_version, max_version=None):
+    """Check the framework version is in range (ref utils/install_check.py)."""
+    from .. import __version__
+
+    def to_tuple(v):
+        import re as _re
+        parts = []
+        for x in str(v).split(".")[:3]:
+            m = _re.match(r"\d+", x)
+            parts.append(int(m.group()) if m else 0)
+        return tuple(parts)
+    cur = to_tuple(__version__)
+    if to_tuple(min_version) > cur:
+        raise Exception(f"version {__version__} < required {min_version}")
+    if max_version is not None and to_tuple(max_version) < cur:
+        raise Exception(f"version {__version__} > allowed {max_version}")
+    return True
+
+
+def run_check():
+    """Sanity-check the install: one matmul on the default device
+    (ref utils/install_check.py run_check)."""
+    import numpy as _np
+    from ..core.tensor import Tensor
+    import jax.numpy as _jnp
+    a = Tensor(_jnp.asarray(_np.ones((2, 2), _np.float32)))
+    out = (a @ a).numpy()
+    assert (out == 2).all()
+    print("paddle_hackathon_tpu is installed successfully!")

@@ -3,3 +3,39 @@
 
 from . import datasets, models, ops, transforms  # noqa: F401
 from .models import *  # noqa: F401,F403
+
+_image_backend = "pil"
+
+
+def set_image_backend(backend):
+    """Ref vision/image.py set_image_backend ('pil'|'cv2'|'tensor')."""
+    global _image_backend
+    if backend not in ("pil", "cv2", "tensor"):
+        raise ValueError(f"unsupported image backend {backend!r}")
+    _image_backend = backend
+
+
+def get_image_backend():
+    return _image_backend
+
+
+def image_load(path, backend=None):
+    """Load an image file (ref vision/image.py image_load). With the
+    'tensor' backend returns an HWC uint8 framework Tensor."""
+    backend = backend or _image_backend
+    try:
+        from PIL import Image
+    except ImportError:
+        Image = None
+    import numpy as _np
+    if backend == "cv2":
+        cv2 = __import__("cv2")
+        return cv2.imread(str(path))
+    if Image is None:
+        raise RuntimeError("PIL is unavailable; use the 'cv2' backend")
+    img = Image.open(path)
+    if backend == "pil":
+        return img
+    from ..core.tensor import Tensor
+    import jax.numpy as _jnp
+    return Tensor(_jnp.asarray(_np.asarray(img)))

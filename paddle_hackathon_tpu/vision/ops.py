@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..core.autograd import apply_op
 from ..core.tensor import Tensor
@@ -154,3 +155,492 @@ def box_iou(boxes1, boxes2):
                                    1e-10)
 
     return apply_op("box_iou", fn, [b1, b2])
+
+
+class RoIAlign(object):
+    """Layer wrapper of roi_align (ref vision/ops.py RoIAlign)."""
+
+    def __init__(self, output_size, spatial_scale=1.0):
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def __call__(self, x, boxes, boxes_num, aligned=True):
+        return roi_align(x, boxes, boxes_num, self.output_size,
+                         self.spatial_scale, aligned=aligned)
+
+
+class RoIPool(object):
+    def __init__(self, output_size, spatial_scale=1.0):
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def __call__(self, x, boxes, boxes_num):
+        return roi_pool(x, boxes, boxes_num, self.output_size,
+                        self.spatial_scale)
+
+
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0):
+    """Position-sensitive RoI pooling (ref phi PsroiPoolKernel): channel
+    block (c, i, j) feeds output bin (i, j)."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+    x = _t(x)
+    C = x.shape[1]
+    if C % (ph * pw):
+        raise ValueError(f"channels {C} must be divisible by {ph}*{pw}")
+    out_c = C // (ph * pw)
+    # sample each position-sensitive block densely then select its own bin
+    full = roi_align(x, boxes, boxes_num, output_size,
+                     spatial_scale=spatial_scale, sampling_ratio=1,
+                     aligned=False)  # (R, C, ph, pw)
+
+    def fn(v):
+        R = v.shape[0]
+        v = v.reshape(R, out_c, ph, pw, ph, pw)
+        ii = jnp.arange(ph)
+        jj = jnp.arange(pw)
+        return v[:, :, ii[:, None], jj[None, :], ii[:, None], jj[None, :]]
+    return apply_op("psroi_pool", fn, [full])
+
+
+class PSRoIPool(object):
+    def __init__(self, output_size, spatial_scale=1.0):
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def __call__(self, x, boxes, boxes_num):
+        return psroi_pool(x, boxes, boxes_num, self.output_size,
+                          self.spatial_scale)
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None):
+    """Deformable conv v1/v2 (ref phi DeformableConvKernel): bilinear
+    sampling at offset kernel taps, then a dense contraction — the gather
+    feeds the MXU matmul, the TPU-native formulation."""
+    def pair(v):
+        return (v, v) if isinstance(v, int) else tuple(v)
+
+    sh, sw = pair(stride)
+    ph_, pw_ = pair(padding)
+    dh, dw = pair(dilation)
+    x = _t(x)
+    offset = _t(offset)
+    weight = _t(weight)
+
+    def fn(v, off, w, *rest):
+        msk = rest[0] if mask is not None else None
+        N, Cin, H, W = v.shape
+        Cout, _, kh, kw = w.shape
+        Ho = (H + 2 * ph_ - dh * (kh - 1) - 1) // sh + 1
+        Wo = (W + 2 * pw_ - dw * (kw - 1) - 1) // sw + 1
+        K = kh * kw
+        dg = deformable_groups
+        off = off.reshape(N, dg, K, 2, Ho, Wo)  # (y, x) per tap
+        # base sampling positions
+        hh = jnp.arange(Ho) * sh - ph_
+        ww = jnp.arange(Wo) * sw - pw_
+        ky = jnp.arange(kh) * dh
+        kx = jnp.arange(kw) * dw
+        taps_y = jnp.repeat(ky, kw).reshape(K, 1, 1)
+        taps_x = jnp.tile(kx, kh).reshape(K, 1, 1)
+        pos_y = hh[None, :, None] + taps_y  # (K, Ho, 1)
+        pos_x = ww[None, None, :] + taps_x  # (K, 1, Wo)
+        # add offsets: (N, dg, K, Ho, Wo)
+        sy = pos_y[None, None] + off[:, :, :, 0]
+        sx = pos_x[None, None] + off[:, :, :, 1]
+
+        y0 = jnp.floor(sy)
+        x0 = jnp.floor(sx)
+        wy = sy - y0
+        wx = sx - x0
+
+        def gather(yi, xi):
+            yc = jnp.clip(yi.astype(jnp.int32), 0, H - 1)
+            xc = jnp.clip(xi.astype(jnp.int32), 0, W - 1)
+            inb = ((yi >= 0) & (yi <= H - 1) & (xi >= 0) & (xi <= W - 1)
+                   ).astype(v.dtype)
+            # v: (N, Cin, H, W) -> samples (N, dg, cpg, K, Ho, Wo)
+            cpg = Cin // dg
+            vg = v.reshape(N, dg, cpg, H, W)
+            flat = yc * W + xc  # (N, dg, K, Ho, Wo)
+            vgf = vg.reshape(N, dg, cpg, H * W)
+            g = jnp.take_along_axis(
+                vgf[:, :, :, None, :],
+                flat[:, :, None, :, :, :].reshape(N, dg, 1, K, Ho * Wo),
+                axis=-1)
+            return (g.reshape(N, dg, cpg, K, Ho, Wo)
+                    * inb[:, :, None]), None
+
+        (v00, _) = gather(y0, x0)
+        (v01, _) = gather(y0, x0 + 1)
+        (v10, _) = gather(y0 + 1, x0)
+        (v11, _) = gather(y0 + 1, x0 + 1)
+        wy_ = wy[:, :, None]
+        wx_ = wx[:, :, None]
+        samp = (v00 * (1 - wy_) * (1 - wx_) + v01 * (1 - wy_) * wx_
+                + v10 * wy_ * (1 - wx_) + v11 * wy_ * wx_)
+        if msk is not None:
+            samp = samp * msk.reshape(N, dg, 1, K, Ho, Wo)
+        samp = samp.reshape(N, Cin, K, Ho, Wo)
+        # contraction: (Cout, Cin/groups, K) x (N, Cin, K, Ho, Wo)
+        wk = w.reshape(Cout, -1, K)
+        if groups == 1:
+            out = jnp.einsum("ock,nckhw->nohw", wk, samp)
+        else:
+            cpg_in = Cin // groups
+            cpg_out = Cout // groups
+            sampg = samp.reshape(N, groups, cpg_in, K, Ho, Wo)
+            wg = wk.reshape(groups, cpg_out, cpg_in, K)
+            out = jnp.einsum("gock,ngckhw->ngohw", wg, sampg
+                             ).reshape(N, Cout, Ho, Wo)
+        if rest and mask is None:
+            out = out + rest[0].reshape(1, -1, 1, 1)
+        elif len(rest) > 1:
+            out = out + rest[1].reshape(1, -1, 1, 1)
+        return out
+
+    args = [x, offset, weight]
+    if mask is not None:
+        args.append(_t(mask))
+    if bias is not None:
+        args.append(_t(bias))
+    return apply_op("deform_conv2d", fn, args)
+
+
+class DeformConv2D:
+    """Deformable conv layer owning weight/bias (ref vision/ops.py
+    DeformConv2D). Import under nn-layer protocol lazily to keep vision.ops
+    importable standalone."""
+
+    def __new__(cls, in_channels, out_channels, kernel_size, stride=1,
+                padding=0, dilation=1, deformable_groups=1, groups=1,
+                weight_attr=None, bias_attr=None):
+        from ..nn.layer import Layer
+        from ..nn.parameter import create_parameter
+
+        def pair(v):
+            return (v, v) if isinstance(v, int) else tuple(v)
+
+        class _DeformConv2D(Layer):
+            def __init__(self):
+                super().__init__()
+                kh, kw = pair(kernel_size)
+                self.weight = create_parameter(
+                    [out_channels, in_channels // groups, kh, kw], "float32",
+                    attr=weight_attr)
+                self.bias = (None if bias_attr is False else create_parameter(
+                    [out_channels], "float32", attr=bias_attr, is_bias=True))
+
+            def forward(self, x, offset, mask=None):
+                return deform_conv2d(x, offset, self.weight, self.bias,
+                                     stride, padding, dilation,
+                                     deformable_groups, groups, mask)
+
+        return _DeformConv2D()
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh, downsample_ratio,
+             clip_bbox=True, scale_x_y=1.0, iou_aware=False,
+             iou_aware_factor=0.5, name=None):
+    """Decode a YOLOv3 head into boxes + scores (ref phi YoloBoxKernel)."""
+    x = _t(x)
+    img_size = _t(img_size)
+    an = np.asarray(anchors, np.float32).reshape(-1, 2)
+    na = an.shape[0]
+
+    def fn(v, imgs):
+        N, C, H, W = v.shape
+        v = v.reshape(N, na, -1, H, W)  # (N, A, 5+cls[(+1 iou)], H, W)
+        if iou_aware:
+            ioup = jax.nn.sigmoid(v[:, :, -1])
+            v = v[:, :, :-1]
+        tx, ty, tw, th, tobj = (v[:, :, i] for i in range(5))
+        cls_logits = v[:, :, 5:5 + class_num]
+        gx = jnp.arange(W)[None, None, None, :]
+        gy = jnp.arange(H)[None, None, :, None]
+        bx = ((jax.nn.sigmoid(tx) - 0.5) * scale_x_y + 0.5 + gx) / W
+        by = ((jax.nn.sigmoid(ty) - 0.5) * scale_x_y + 0.5 + gy) / H
+        anw = an[:, 0][None, :, None, None]
+        anh = an[:, 1][None, :, None, None]
+        bw = jnp.exp(tw) * anw / (W * downsample_ratio)
+        bh = jnp.exp(th) * anh / (H * downsample_ratio)
+        obj = jax.nn.sigmoid(tobj)
+        if iou_aware:
+            obj = obj ** (1 - iou_aware_factor) * ioup ** iou_aware_factor
+        imh = imgs[:, 0].astype(v.dtype)[:, None, None, None]
+        imw = imgs[:, 1].astype(v.dtype)[:, None, None, None]
+        x1 = (bx - bw / 2) * imw
+        y1 = (by - bh / 2) * imh
+        x2 = (bx + bw / 2) * imw
+        y2 = (by + bh / 2) * imh
+        if clip_bbox:
+            x1 = jnp.clip(x1, 0, imw - 1)
+            y1 = jnp.clip(y1, 0, imh - 1)
+            x2 = jnp.clip(x2, 0, imw - 1)
+            y2 = jnp.clip(y2, 0, imh - 1)
+        boxes = jnp.stack([x1, y1, x2, y2], -1).reshape(N, -1, 4)
+        probs = jax.nn.sigmoid(cls_logits) * obj[:, :, None]
+        probs = jnp.where(obj[:, :, None] < conf_thresh, 0.0, probs)
+        scores = probs.transpose(0, 1, 3, 4, 2).reshape(N, -1, class_num)
+        return boxes, scores
+
+    return apply_op("yolo_box", fn, [x, img_size], n_outputs=2)
+
+
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+              ignore_thresh, downsample_ratio, gt_score=None,
+              use_label_smooth=True, scale_x_y=1.0, name=None):
+    """YOLOv3 loss (ref yolov3_loss op): box regression on responsible
+    anchors + objectness with ignore region + classification."""
+    x = _t(x)
+    gt_box = _t(gt_box)
+    gt_label = _t(gt_label)
+    an_full = np.asarray(anchors, np.float32).reshape(-1, 2)
+    msk = list(anchor_mask)
+    an = an_full[msk]
+    na = len(msk)
+
+    def fn(v, gb, gl, *rest):
+        gs = rest[0] if rest else None
+        N, C, H, W = v.shape
+        in_w = W * downsample_ratio
+        in_h = H * downsample_ratio
+        v = v.reshape(N, na, 5 + class_num, H, W)
+        tx, ty, tw, th, tobj = (v[:, :, i] for i in range(5))
+        tcls = v[:, :, 5:]
+        B = gb.shape[1]
+        # gt in [0,1] cx,cy,w,h (relative); responsible cell + anchor
+        gx, gy = gb[..., 0], gb[..., 1]
+        gw, gh = gb[..., 2], gb[..., 3]
+        gi = jnp.clip((gx * W).astype(jnp.int32), 0, W - 1)
+        gj = jnp.clip((gy * H).astype(jnp.int32), 0, H - 1)
+        valid = (gw > 0) & (gh > 0)
+        # best anchor by IoU of (w,h) vs all anchors (shifted to origin)
+        aw = an_full[:, 0] / in_w
+        ah = an_full[:, 1] / in_h
+        inter = (jnp.minimum(gw[..., None], aw) * jnp.minimum(gh[..., None], ah))
+        union = gw[..., None] * gh[..., None] + aw * ah - inter
+        best = jnp.argmax(inter / (union + 1e-9), -1)  # (N, B) global anchor id
+        # map to local mask position (or -1)
+        local = jnp.full_like(best, -1)
+        for li, g in enumerate(msk):
+            local = jnp.where(best == g, li, local)
+        resp = valid & (local >= 0)
+        # predicted boxes for ignore mask
+        cellx = (jax.nn.sigmoid(tx) - 0.5) * scale_x_y + 0.5
+        celly = (jax.nn.sigmoid(ty) - 0.5) * scale_x_y + 0.5
+        px = (cellx + jnp.arange(W)[None, None, None, :]) / W
+        py = (celly + jnp.arange(H)[None, None, :, None]) / H
+        pw = jnp.exp(tw) * an[:, 0][None, :, None, None] / in_w
+        ph2 = jnp.exp(th) * an[:, 1][None, :, None, None] / in_h
+        # IoU of each prediction with each gt (N, A, H, W, B)
+        px1, px2 = px - pw / 2, px + pw / 2
+        py1, py2 = py - ph2 / 2, py + ph2 / 2
+        gx1, gx2 = gx - gw / 2, gx + gw / 2
+        gy1, gy2 = gy - gh / 2, gy + gh / 2
+        ix1 = jnp.maximum(px1[..., None], gx1[:, None, None, None, :])
+        ix2 = jnp.minimum(px2[..., None], gx2[:, None, None, None, :])
+        iy1 = jnp.maximum(py1[..., None], gy1[:, None, None, None, :])
+        iy2 = jnp.minimum(py2[..., None], gy2[:, None, None, None, :])
+        inter2 = (jnp.clip(ix2 - ix1, 0) * jnp.clip(iy2 - iy1, 0))
+        area_p = pw * ph2
+        area_g = (gw * gh)[:, None, None, None, :]
+        iou = inter2 / (area_p[..., None] + area_g - inter2 + 1e-9)
+        iou = jnp.where(valid[:, None, None, None, :], iou, 0.0)
+        ignore = (jnp.max(iou, -1) > ignore_thresh)
+        # objectness target: scatter 1 at responsible (n, local, gj, gi)
+        obj_t = jnp.zeros((N, na, H, W))
+        score_w = gs if gs is not None else jnp.ones((N, B))
+        nidx = jnp.repeat(jnp.arange(N)[:, None], B, 1)
+        sel = resp
+        obj_t = obj_t.at[nidx, jnp.maximum(local, 0), gj, gi].max(
+            jnp.where(sel, score_w, 0.0))
+        obj_mask = jnp.zeros((N, na, H, W), bool).at[
+            nidx, jnp.maximum(local, 0), gj, gi].max(sel)
+        noobj_mask = (~obj_mask) & (~ignore)
+        # losses
+        bce = lambda lg, t: jnp.maximum(lg, 0) - lg * t + jnp.log1p(
+            jnp.exp(-jnp.abs(lg)))
+        obj_loss = (jnp.where(obj_mask, bce(tobj, obj_t), 0.0).sum((1, 2, 3))
+                    + jnp.where(noobj_mask, bce(tobj, 0.0), 0.0).sum((1, 2, 3)))
+        # box loss at responsible cells
+        tgt_x = gx * W - gi
+        tgt_y = gy * H - gj
+        sel_aw = an_full[jnp.maximum(best, 0), 0]
+        sel_ah = an_full[jnp.maximum(best, 0), 1]
+        tgt_w = jnp.log(jnp.clip(gw * in_w / sel_aw, 1e-9, None))
+        tgt_h = jnp.log(jnp.clip(gh * in_h / sel_ah, 1e-9, None))
+        scale_box = 2.0 - gw * gh
+        lx = tx[nidx, jnp.maximum(local, 0), gj, gi]
+        ly = ty[nidx, jnp.maximum(local, 0), gj, gi]
+        lw = tw[nidx, jnp.maximum(local, 0), gj, gi]
+        lh = th[nidx, jnp.maximum(local, 0), gj, gi]
+        box_loss = jnp.where(
+            sel,
+            (bce(lx, tgt_x) + bce(ly, tgt_y)) * scale_box * score_w
+            + (jnp.abs(lw - tgt_w) + jnp.abs(lh - tgt_h)) * scale_box * score_w,
+            0.0).sum(-1)
+        # cls loss
+        smooth = 1.0 / class_num if (use_label_smooth and class_num > 1) else 0.0
+        onehot = jax.nn.one_hot(jnp.clip(gl, 0, class_num - 1), class_num)
+        onehot = onehot * (1 - smooth) + smooth / class_num
+        lcls = tcls.transpose(0, 1, 3, 4, 2)[nidx, jnp.maximum(local, 0), gj, gi]
+        cls_loss = jnp.where(sel[..., None],
+                             bce(lcls, onehot) * score_w[..., None],
+                             0.0).sum((-1, -2))
+        return obj_loss + box_loss + cls_loss
+
+    args = [x, gt_box, gt_label]
+    if gt_score is not None:
+        args.append(_t(gt_score))
+    return apply_op("yolo_loss", fn, args)
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, pixel_offset=False, rois_num=None,
+                             name=None):
+    """Assign RoIs to FPN levels by scale (ref phi
+    DistributeFpnProposalsKernel). Host-side (ragged outputs)."""
+    from ..core.autograd import no_grad
+    with no_grad():
+        rois = np.asarray(_t(fpn_rois)._value)
+        off = 1.0 if pixel_offset else 0.0
+        w = rois[:, 2] - rois[:, 0] + off
+        h = rois[:, 3] - rois[:, 1] + off
+        scale = np.sqrt(np.clip(w * h, 0, None))
+        lvl = np.floor(np.log2(scale / refer_scale + 1e-8)) + refer_level
+        lvl = np.clip(lvl, min_level, max_level).astype(int)
+        # per-image grouping: img_id of each roi from rois_num
+        if rois_num is not None:
+            rn = np.asarray(_t(rois_num)._value, np.int64)
+            img_of = np.repeat(np.arange(len(rn)), rn)
+            n_img = len(rn)
+        else:
+            img_of = np.zeros(len(rois), np.int64)
+            n_img = 1
+        outs, nums, order = [], [], []
+        for L in range(min_level, max_level + 1):
+            idx = np.nonzero(lvl == L)[0]
+            # keep image-major order within the level (reference layout)
+            idx = idx[np.argsort(img_of[idx], kind="stable")]
+            outs.append(Tensor(jnp.asarray(rois[idx])))
+            per_img = np.bincount(img_of[idx], minlength=n_img).astype(np.int32)
+            nums.append(Tensor(jnp.asarray(per_img)))
+            order.extend(idx.tolist())
+        restore = np.argsort(np.asarray(order, np.int64))
+        return outs, Tensor(jnp.asarray(restore.astype(np.int32))), nums
+
+
+def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       pixel_offset=False, return_rois_num=False, name=None):
+    """RPN proposal generation (ref phi GenerateProposalsV2Kernel):
+    decode anchors+deltas, clip, filter small, NMS. Host-side."""
+    from ..core.autograd import no_grad
+    with no_grad():
+        sc = np.asarray(_t(scores)._value)          # (N, A, H, W)
+        bd = np.asarray(_t(bbox_deltas)._value)     # (N, A*4, H, W)
+        ims = np.asarray(_t(img_size)._value)       # (N, 2) h, w
+        anc = np.asarray(_t(anchors)._value).reshape(-1, 4)
+        var = np.asarray(_t(variances)._value).reshape(-1, 4)
+        N, A, H, W = sc.shape
+        all_rois, all_nums, all_scores = [], [], []
+        off = 1.0 if pixel_offset else 0.0
+        for n in range(N):
+            s = sc[n].transpose(1, 2, 0).reshape(-1)           # H*W*A
+            d = bd[n].reshape(A, 4, H, W).transpose(2, 3, 0, 1).reshape(-1, 4)
+            # anchors/variances arrive as (H*W*A, 4) position-major
+            a = anc.reshape(-1, 4)
+            if a.shape[0] == A:  # per-anchor only: broadcast over positions
+                a = np.broadcast_to(a[None, None], (H, W, A, 4)).reshape(-1, 4)
+            v = var.reshape(-1, 4)
+            if v.shape[0] == A:
+                v = np.broadcast_to(v[None, None], (H, W, A, 4)).reshape(-1, 4)
+            order = np.argsort(-s)[:pre_nms_top_n]
+            s, d, a, v = s[order], d[order], a[order], v[order]
+            aw = a[:, 2] - a[:, 0] + off
+            ah = a[:, 3] - a[:, 1] + off
+            acx = a[:, 0] + aw / 2
+            acy = a[:, 1] + ah / 2
+            cx = v[:, 0] * d[:, 0] * aw + acx
+            cy = v[:, 1] * d[:, 1] * ah + acy
+            wN = np.exp(np.clip(v[:, 2] * d[:, 2], None, 10)) * aw
+            hN = np.exp(np.clip(v[:, 3] * d[:, 3], None, 10)) * ah
+            x1 = cx - wN / 2
+            y1 = cy - hN / 2
+            x2 = cx + wN / 2 - off
+            y2 = cy + hN / 2 - off
+            imh, imw = ims[n]
+            x1 = np.clip(x1, 0, imw - off)
+            y1 = np.clip(y1, 0, imh - off)
+            x2 = np.clip(x2, 0, imw - off)
+            y2 = np.clip(y2, 0, imh - off)
+            keep = ((x2 - x1 + off >= min_size)
+                    & (y2 - y1 + off >= min_size))
+            boxes = np.stack([x1, y1, x2, y2], 1)[keep]
+            s = s[keep]
+            # greedy NMS
+            sel = []
+            idxs = np.argsort(-s)
+            while len(idxs) and len(sel) < post_nms_top_n:
+                i = idxs[0]
+                sel.append(i)
+                if len(idxs) == 1:
+                    break
+                rest = idxs[1:]
+                xx1 = np.maximum(boxes[i, 0], boxes[rest, 0])
+                yy1 = np.maximum(boxes[i, 1], boxes[rest, 1])
+                xx2 = np.minimum(boxes[i, 2], boxes[rest, 2])
+                yy2 = np.minimum(boxes[i, 3], boxes[rest, 3])
+                iw = np.clip(xx2 - xx1 + off, 0, None)
+                ih = np.clip(yy2 - yy1 + off, 0, None)
+                inter = iw * ih
+                ai = ((boxes[i, 2] - boxes[i, 0] + off)
+                      * (boxes[i, 3] - boxes[i, 1] + off))
+                ar = ((boxes[rest, 2] - boxes[rest, 0] + off)
+                      * (boxes[rest, 3] - boxes[rest, 1] + off))
+                iou = inter / (ai + ar - inter + 1e-9)
+                idxs = rest[iou <= nms_thresh]
+            sel = np.asarray(sel, int)
+            all_rois.append(boxes[sel])
+            all_scores.append(s[sel])
+            all_nums.append(len(sel))
+        rois = Tensor(jnp.asarray(np.concatenate(all_rois)
+                                  if all_rois else np.zeros((0, 4))))
+        rscores = Tensor(jnp.asarray(np.concatenate(all_scores)
+                                     if all_scores else np.zeros((0,))))
+        nums = Tensor(jnp.asarray(np.asarray(all_nums, np.int32)))
+        if return_rois_num:
+            return rois, rscores, nums
+        return rois, rscores
+
+
+def read_file(filename, name=None):
+    """Read raw bytes into a uint8 tensor (ref phi ReadFileKernel)."""
+    data = np.fromfile(filename, dtype=np.uint8)
+    return Tensor(jnp.asarray(data))
+
+
+def decode_jpeg(x, mode="unchanged", name=None):
+    """Decode a JPEG byte tensor to CHW uint8 (ref phi DecodeJpegKernel —
+    nvjpeg there; PIL on host here)."""
+    import io as _io
+    try:
+        from PIL import Image
+    except ImportError as e:
+        raise RuntimeError("decode_jpeg needs PIL on this build") from e
+    data = bytes(np.asarray(_t(x)._value, np.uint8))
+    img = Image.open(_io.BytesIO(data))
+    if mode == "gray":
+        img = img.convert("L")
+    elif mode == "rgb":
+        img = img.convert("RGB")
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[None]
+    else:
+        arr = arr.transpose(2, 0, 1)
+    return Tensor(jnp.asarray(arr))

@@ -1,6 +1,12 @@
 from . import functional  # noqa: F401
-from .transforms import (BaseTransform, CenterCrop, ColorJitter,  # noqa: F401
-                         Compose, Grayscale, Normalize, Pad, RandomCrop,
-                         RandomHorizontalFlip, RandomResizedCrop,
-                         RandomRotation, RandomVerticalFlip, Resize, ToTensor,
-                         Transpose)
+from .functional import (adjust_brightness, adjust_contrast,  # noqa: F401
+                         adjust_hue, adjust_saturation, affine, center_crop,
+                         crop, erase, hflip, normalize, pad, perspective,
+                         resize, rotate, to_grayscale, to_tensor, vflip)
+from .transforms import (BaseTransform, BrightnessTransform,  # noqa: F401
+                         CenterCrop, ColorJitter, Compose, ContrastTransform,
+                         Grayscale, HueTransform, Normalize, Pad, RandomAffine,
+                         RandomCrop, RandomErasing, RandomHorizontalFlip,
+                         RandomPerspective, RandomResizedCrop, RandomRotation,
+                         RandomVerticalFlip, Resize, SaturationTransform,
+                         ToTensor, Transpose)

@@ -178,3 +178,82 @@ def to_grayscale(img, num_output_channels=1):
     if num_output_channels == 3:
         g = np.repeat(g, 3, axis=2)
     return np.clip(g, 0, 255).astype(np.uint8)
+
+
+def adjust_saturation(img, saturation_factor):
+    """Blend with grayscale (ref functional_tensor.adjust_saturation)."""
+    img = _as_hwc(img)
+    gray = (0.299 * img[..., 0] + 0.587 * img[..., 1]
+            + 0.114 * img[..., 2])[..., None]
+    out = gray + saturation_factor * (img.astype(np.float64) - gray)
+    return np.clip(out, 0, 255 if img.dtype == np.uint8 else 1.0
+                   ).astype(img.dtype)
+
+
+def erase(img, i, j, h, w, v, inplace=False):
+    """Erase a region with value v (ref functional.erase). Works on HWC
+    numpy or framework tensors (CHW Tensor path mirrors the reference)."""
+    from ...core.tensor import Tensor as _FT
+    if isinstance(img, _FT):
+        import jax.numpy as _jnp
+        arr = img._value
+        val = v._value if isinstance(v, _FT) else _jnp.asarray(v)
+        patch = _jnp.broadcast_to(val, arr[..., i:i + h, j:j + w].shape)
+        return _FT(arr.at[..., i:i + h, j:j + w].set(patch.astype(arr.dtype)))
+    img2 = img if inplace else np.array(img)
+    img2[i:i + h, j:j + w] = v
+    return img2
+
+
+def _solve_perspective(src, dst):
+    """8-dof homography coefficients mapping dst->src (cv2.getPerspectiveTransform)."""
+    A, b = [], []
+    for (x, y), (u, v) in zip(dst, src):
+        A.append([x, y, 1, 0, 0, 0, -u * x, -u * y])
+        A.append([0, 0, 0, x, y, 1, -v * x, -v * y])
+        b += [u, v]
+    return np.linalg.solve(np.asarray(A, np.float64), np.asarray(b, np.float64))
+
+
+def perspective(img, startpoints, endpoints, interpolation="nearest", fill=0):
+    """Perspective warp via inverse homography, nearest sampling
+    (ref functional.perspective)."""
+    img = _as_hwc(img)
+    h, w = img.shape[:2]
+    coef = _solve_perspective(startpoints, endpoints)
+    a, b, c, d, e, f, g, hh = coef
+    yy, xx = np.meshgrid(np.arange(h), np.arange(w), indexing="ij")
+    den = g * xx + hh * yy + 1.0
+    xs = (a * xx + b * yy + c) / den
+    ys = (d * xx + e * yy + f) / den
+    xi, yi = np.round(xs).astype(int), np.round(ys).astype(int)
+    valid = (yi >= 0) & (yi < h) & (xi >= 0) & (xi < w)
+    out = np.full_like(img, fill)
+    out[valid] = img[yi[valid], xi[valid]]
+    return out
+
+
+def affine(img, angle, translate, scale, shear, interpolation="nearest",
+           fill=0, center=None):
+    """Affine warp: rotate+translate+scale+shear by inverse mapping
+    (ref functional.affine)."""
+    img = _as_hwc(img)
+    h, w = img.shape[:2]
+    cy, cx = ((h - 1) / 2.0, (w - 1) / 2.0) if center is None else center[::-1]
+    theta = np.deg2rad(angle)
+    sx, sy = (np.deg2rad(s) for s in (shear if isinstance(shear, (list, tuple))
+                                      else (shear, 0.0)))
+    # forward matrix: T(center) R(angle) Sh(shear) S(scale) T(-center) + translate
+    R = np.array([[np.cos(theta + sy), -np.sin(theta + sx)],
+                  [np.sin(theta + sy), np.cos(theta + sx)]]) * scale
+    inv = np.linalg.inv(R)
+    tx, ty = translate
+    yy, xx = np.meshgrid(np.arange(h), np.arange(w), indexing="ij")
+    pts = np.stack([xx - cx - tx, yy - cy - ty])
+    src = np.einsum("ij,jhw->ihw", inv, pts)
+    xs, ys = src[0] + cx, src[1] + cy
+    xi, yi = np.round(xs).astype(int), np.round(ys).astype(int)
+    valid = (yi >= 0) & (yi < h) & (xi >= 0) & (xi < w)
+    out = np.full_like(img, fill)
+    out[valid] = img[yi[valid], xi[valid]]
+    return out

@@ -219,3 +219,151 @@ class Transpose(BaseTransform):
 
     def _apply_image(self, img):
         return np.transpose(np.asarray(img), self.order)
+
+
+class BrightnessTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        f = np.random.uniform(max(0, 1 - self.value), 1 + self.value)
+        return F.adjust_brightness(img, f)
+
+
+class ContrastTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        if value < 0:
+            raise ValueError("contrast value must be non-negative")
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        f = np.random.uniform(max(0, 1 - self.value), 1 + self.value)
+        return F.adjust_contrast(img, f)
+
+
+class SaturationTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        f = np.random.uniform(max(0, 1 - self.value), 1 + self.value)
+        return F.adjust_saturation(img, f)
+
+
+class HueTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        if not 0 <= value <= 0.5:
+            raise ValueError("hue value must be in [0, 0.5]")
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        f = np.random.uniform(-self.value, self.value)
+        return F.adjust_hue(img, f)
+
+
+class RandomAffine(BaseTransform):
+    """Random affine transformation (ref transforms.RandomAffine)."""
+
+    def __init__(self, degrees, translate=None, scale=None, shear=None,
+                 interpolation="nearest", fill=0, center=None, keys=None):
+        super().__init__(keys)
+        self.degrees = ((-degrees, degrees) if np.isscalar(degrees)
+                        else tuple(degrees))
+        self.translate = translate
+        self.scale = scale
+        self.shear = shear
+        self.interpolation = interpolation
+        self.fill = fill
+        self.center = center
+
+    def _apply_image(self, img):
+        h, w = np.asarray(img).shape[:2]
+        angle = np.random.uniform(*self.degrees)
+        translate = (0, 0)
+        if self.translate is not None:
+            tx, ty = self.translate
+            translate = (np.random.uniform(-tx, tx) * w,
+                         np.random.uniform(-ty, ty) * h)
+        scale = (np.random.uniform(*self.scale) if self.scale else 1.0)
+        shear = 0.0
+        if self.shear is not None:
+            sh = ((-self.shear, self.shear) if np.isscalar(self.shear)
+                  else tuple(self.shear))
+            shear = np.random.uniform(sh[0], sh[1])
+        return F.affine(img, angle, translate, scale, shear,
+                        self.interpolation, self.fill, self.center)
+
+
+class RandomErasing(BaseTransform):
+    """Randomly erase a rectangle (ref transforms.RandomErasing)."""
+
+    def __init__(self, prob=0.5, scale=(0.02, 0.33), ratio=(0.3, 3.3),
+                 value=0, inplace=False, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+        self.scale = scale
+        self.ratio = ratio
+        self.value = value
+        self.inplace = inplace
+
+    def _apply_image(self, img):
+        if np.random.rand() >= self.prob:
+            return img
+        from ...core.tensor import Tensor as _FT
+        chw = isinstance(img, _FT)  # framework tensors are CHW; arrays HWC
+        shape = tuple(img.shape) if chw else np.asarray(img).shape
+        h, w = shape[-2:] if chw else shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = np.random.uniform(*self.scale) * area
+            ar = np.exp(np.random.uniform(np.log(self.ratio[0]),
+                                          np.log(self.ratio[1])))
+            eh = int(round(np.sqrt(target * ar)))
+            ew = int(round(np.sqrt(target / ar)))
+            if eh < h and ew < w:
+                i = np.random.randint(0, h - eh)
+                j = np.random.randint(0, w - ew)
+                if self.value == "random":
+                    v = (np.random.rand(*shape[:-2], eh, ew) if chw
+                         else np.random.rand(eh, ew, *shape[2:]))
+                else:
+                    v = self.value
+                return F.erase(img, i, j, eh, ew, v, self.inplace)
+        return img
+
+
+class RandomPerspective(BaseTransform):
+    """Random perspective distortion (ref transforms.RandomPerspective)."""
+
+    def __init__(self, prob=0.5, distortion_scale=0.5,
+                 interpolation="nearest", fill=0, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+        self.distortion_scale = distortion_scale
+        self.interpolation = interpolation
+        self.fill = fill
+
+    def _apply_image(self, img):
+        if np.random.rand() >= self.prob:
+            return img
+        h, w = np.asarray(img).shape[:2]
+        d = self.distortion_scale
+        dx, dy = int(d * w / 2), int(d * h / 2)
+        start = [(0, 0), (w - 1, 0), (w - 1, h - 1), (0, h - 1)]
+        end = [(np.random.randint(0, dx + 1), np.random.randint(0, dy + 1)),
+               (w - 1 - np.random.randint(0, dx + 1), np.random.randint(0, dy + 1)),
+               (w - 1 - np.random.randint(0, dx + 1), h - 1 - np.random.randint(0, dy + 1)),
+               (np.random.randint(0, dx + 1), h - 1 - np.random.randint(0, dy + 1))]
+        return F.perspective(img, start, end, self.interpolation, self.fill)

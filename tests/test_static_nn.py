@@ -1,0 +1,205 @@
+"""paddle.static legacy-surface tests: static.nn layer functions, sequence
+(LoD) ops, StaticRNN scan lowering, crf_decoding, compat symbols."""
+
+import numpy as np
+import pytest
+
+import paddle_hackathon_tpu as paddle
+import paddle_hackathon_tpu.static as static
+import paddle_hackathon_tpu.static.nn as snn
+
+
+@pytest.fixture
+def lod_x():
+    x = paddle.to_tensor(np.arange(10, dtype=np.float32).reshape(5, 2))
+    x._lod = [0, 3, 5]
+    return x
+
+
+def test_sequence_pad_unpad_roundtrip(lod_x):
+    padded, lens = snn.sequence_pad(lod_x, 0.0)
+    assert padded.shape == [2, 3, 2]
+    assert lens.numpy().tolist() == [3, 2]
+    back = snn.sequence_unpad(padded, lens)
+    np.testing.assert_allclose(back.numpy(), lod_x.numpy())
+    assert back._lod == [0, 3, 5]
+
+
+def test_sequence_pool_variants(lod_x):
+    np.testing.assert_allclose(snn.sequence_pool(lod_x, "sum").numpy(),
+                               [[6, 9], [14, 16]])
+    np.testing.assert_allclose(snn.sequence_first_step(lod_x).numpy(),
+                               [[0, 1], [6, 7]])
+    np.testing.assert_allclose(snn.sequence_last_step(lod_x).numpy(),
+                               [[4, 5], [8, 9]])
+
+
+def test_sequence_softmax_normalizes_per_sequence(lod_x):
+    sm = snn.sequence_softmax(lod_x).numpy()
+    np.testing.assert_allclose(sm[:3].sum(0), [1, 1], rtol=1e-5)
+    np.testing.assert_allclose(sm[3:].sum(0), [1, 1], rtol=1e-5)
+
+
+def test_sequence_reverse_concat_expand(lod_x):
+    rev = snn.sequence_reverse(lod_x)
+    np.testing.assert_allclose(rev.numpy()[:3], lod_x.numpy()[:3][::-1])
+    cc = snn.sequence_concat([lod_x, lod_x])
+    assert cc._lod == [0, 6, 10]
+    ex = snn.sequence_expand_as(
+        paddle.to_tensor(np.array([[1.0], [2.0]], np.float32)), lod_x)
+    np.testing.assert_allclose(ex.numpy().reshape(-1), [1, 1, 1, 2, 2])
+
+
+def test_sequence_enumerate_windows():
+    ids = paddle.to_tensor(np.array([1, 2, 3, 4, 5]))
+    ids._lod = [0, 3, 5]
+    en = snn.sequence_enumerate(ids, 2)
+    np.testing.assert_array_equal(en.numpy(),
+                                  [[1, 2], [2, 3], [3, 0], [4, 5], [5, 0]])
+
+
+def test_sequence_conv_and_slice(lod_x):
+    paddle.seed(0)
+    sc = snn.sequence_conv(lod_x, 4)
+    assert sc.shape == [5, 4] and sc._lod == [0, 3, 5]
+    sl = snn.sequence_slice(lod_x, paddle.to_tensor(np.array([1, 0])),
+                            paddle.to_tensor(np.array([2, 1])))
+    assert sl.shape[0] == 3 and sl._lod == [0, 2, 3]
+
+
+def test_static_rnn_scan_matches_loop():
+    paddle.enable_static()
+    try:
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [5, 3, 4])
+            rnn = snn.StaticRNN()
+            with rnn.step():
+                xt = rnn.step_input(x)
+                prev = rnn.memory(shape=[-1, 4], batch_ref=xt)
+                h = paddle.tanh(xt + prev)
+                rnn.update_memory(prev, h)
+                rnn.step_output(h)
+            out = rnn()
+        exe = static.Executor()
+        xv = np.random.RandomState(0).randn(5, 3, 4).astype(np.float32)
+        res, = exe.run(prog, feed={"x": xv}, fetch_list=[out])
+    finally:
+        paddle.disable_static()
+    hprev = np.zeros((3, 4), np.float32)
+    ref = [hprev := np.tanh(xv[t] + hprev) for t in range(5)]
+    np.testing.assert_allclose(res, np.stack(ref), rtol=1e-5)
+
+
+def test_crf_decoding_matches_bruteforce():
+    def ref_crf(em, trans, lens):
+        start, stop, body = trans[0], trans[1], trans[2:]
+        B, L, n = em.shape
+        out = np.zeros((B, L), np.int64)
+        for b in range(B):
+            ln = lens[b]
+            alpha = em[b, 0] + start
+            hist = []
+            for t in range(1, ln):
+                ts = alpha[:, None] + body
+                hist.append(ts.argmax(0))
+                alpha = ts.max(0) + em[b, t]
+            final = alpha + stop
+            cur = int(final.argmax())
+            path = [cur]
+            for h in reversed(hist):
+                cur = int(h[cur])
+                path.append(cur)
+            out[b, :ln] = path[::-1]
+        return out
+
+    rng = np.random.RandomState(3)
+    em = rng.rand(3, 6, 4).astype(np.float32)
+    trans = rng.rand(6, 4).astype(np.float32)
+    lens = np.array([6, 3, 1], np.int64)
+    path = snn.crf_decoding(paddle.to_tensor(em),
+                            transition=paddle.to_tensor(trans),
+                            length=paddle.to_tensor(lens))
+    np.testing.assert_array_equal(path.numpy(), ref_crf(em, trans, lens))
+
+
+def test_static_nn_layer_functions_eager():
+    paddle.seed(0)
+    img = paddle.to_tensor(
+        np.random.RandomState(0).randn(2, 3, 8, 8).astype(np.float32))
+    assert snn.conv2d(img, 4, 3, act="relu").shape == [2, 4, 6, 6]
+    assert snn.batch_norm(img).shape == [2, 3, 8, 8]
+    assert snn.fc(paddle.to_tensor(np.ones((2, 5), np.float32)), 3
+                  ).shape == [2, 3]
+    assert snn.row_conv(paddle.to_tensor(np.ones((2, 6, 4), np.float32)),
+                        2).shape == [2, 6, 4]
+    out = snn.nce(paddle.to_tensor(
+        np.random.randn(3, 8).astype(np.float32)),
+        paddle.to_tensor(np.array([1, 2, 3])), 10)
+    assert out.shape == [3, 1] and np.isfinite(out.numpy()).all()
+
+
+def test_control_flow_eager():
+    assert snn.cond(paddle.to_tensor(True), lambda: paddle.to_tensor([1.0]),
+                    lambda: paddle.to_tensor([2.0])).numpy()[0] == 1.0
+    res = snn.while_loop(lambda i: i < 5, lambda i: i + 1,
+                         [paddle.to_tensor(0)])
+    assert int(res[0].numpy()) == 5
+    assert snn.switch_case(
+        paddle.to_tensor(1),
+        {0: lambda: paddle.to_tensor(0.0),
+         1: lambda: paddle.to_tensor(10.0)}).numpy() == 10.0
+
+
+def test_py_func_forward_and_backward():
+    def host_fn(a):
+        return a * a
+
+    def host_bwd(a, g):
+        return (2 * a * g).astype(np.float32)
+
+    x = paddle.to_tensor(np.array([2.0, 3.0], np.float32),
+                         stop_gradient=False)
+    out_proto = paddle.to_tensor(np.zeros((2,), np.float32))
+    y = snn.py_func(host_fn, x, out_proto, backward_func=host_bwd)
+    np.testing.assert_allclose(y.numpy(), [4.0, 9.0])
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [4.0, 6.0])
+
+
+def test_static_compat_symbols():
+    acc = static.accuracy(
+        paddle.to_tensor(np.array([[0.1, 0.9], [0.8, 0.2]], np.float32)),
+        paddle.to_tensor(np.array([[1], [1]])))
+    assert float(acc.numpy()) == 0.5
+    a, _ = static.auc(
+        paddle.to_tensor(np.array([[0.3, 0.7], [0.6, 0.4], [0.2, 0.8],
+                                   [0.9, 0.1]], np.float32)),
+        paddle.to_tensor(np.array([1, 0, 1, 0])))
+    assert 0.9 < float(a.numpy()) <= 1.0
+    assert static.BuildStrategy().memory_optimize
+    assert static.cpu_places(2) and static.cuda_places([0])
+    gv = static.create_global_var([2, 2], 1.5, "float32")
+    assert (gv.numpy() == 1.5).all()
+
+
+def test_exponential_moving_average():
+    paddle.enable_static()
+    try:
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [2, 2])
+            y = snn.fc(x, 2)
+        ema = static.ExponentialMovingAverage(0.5)
+        params = prog.all_parameters()
+        w0 = params[0].numpy().copy()
+        ema.update()
+        params[0]._set_value(params[0]._value * 0.0)
+        ema.update()
+        with ema.apply():
+            applied = params[0].numpy().copy()
+        restored = params[0].numpy()
+        np.testing.assert_allclose(restored, 0 * w0)
+        assert np.isfinite(applied).all()
+    finally:
+        paddle.disable_static()
