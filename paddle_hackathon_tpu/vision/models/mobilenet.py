@@ -255,10 +255,29 @@ def mobilenet_v2(pretrained=False, scale=1.0, **kwargs):
 def mobilenet_v3_large(pretrained=False, scale=1.0, **kwargs):
     if pretrained:
         raise NotImplementedError("pretrained weights are not bundled")
-    return MobileNetV3(_V3_LARGE, 1280, scale=scale, **kwargs)
+    return MobileNetV3Large(scale=scale, **kwargs)
 
 
 def mobilenet_v3_small(pretrained=False, scale=1.0, **kwargs):
     if pretrained:
         raise NotImplementedError("pretrained weights are not bundled")
-    return MobileNetV3(_V3_SMALL, 1024, scale=scale, **kwargs)
+    return MobileNetV3Small(scale=scale, **kwargs)
+
+
+class MobileNetV3Small(MobileNetV3):
+    """Ref mobilenetv3.py MobileNetV3Small (last_channel scales with
+    `scale`: _make_divisible(1024 * scale))."""
+
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__(_V3_SMALL, _make_divisible(1024 * scale),
+                         scale=scale, num_classes=num_classes,
+                         with_pool=with_pool)
+
+
+class MobileNetV3Large(MobileNetV3):
+    """Ref mobilenetv3.py MobileNetV3Large."""
+
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__(_V3_LARGE, _make_divisible(1280 * scale),
+                         scale=scale, num_classes=num_classes,
+                         with_pool=with_pool)

@@ -187,3 +187,18 @@ def wide_resnet50_2(pretrained=False, **kwargs):
 def wide_resnet101_2(pretrained=False, **kwargs):
     return _resnet("wide_resnet101_2", BottleneckBlock, 101, pretrained,
                    width=128, **kwargs)
+
+
+def resnext50_64x4d(pretrained=False, **kwargs):
+    return _resnet("resnext50_64x4d", BottleneckBlock, 50, pretrained,
+                   groups=64, width=4, **kwargs)
+
+
+def resnext101_64x4d(pretrained=False, **kwargs):
+    return _resnet("resnext101_64x4d", BottleneckBlock, 101, pretrained,
+                   groups=64, width=4, **kwargs)
+
+
+def resnext152_64x4d(pretrained=False, **kwargs):
+    return _resnet("resnext152_64x4d", BottleneckBlock, 152, pretrained,
+                   groups=64, width=4, **kwargs)

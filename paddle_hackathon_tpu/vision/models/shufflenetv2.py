@@ -1,0 +1,132 @@
+"""ShuffleNetV2 (ref ``python/paddle/vision/models/shufflenetv2.py``)."""
+
+from __future__ import annotations
+
+from ... import nn
+from ...ops import manipulation as M
+
+
+def _channel_shuffle(x, groups):
+    from ...nn import functional as F
+    return F.channel_shuffle(x, groups)
+
+
+class _InvertedResidual(nn.Layer):
+    def __init__(self, in_ch, out_ch, stride, act_cls):
+        super().__init__()
+        self.stride = stride
+        branch = out_ch // 2
+        if stride == 1:
+            self.branch2 = nn.Sequential(
+                nn.Conv2D(in_ch // 2, branch, 1, bias_attr=False),
+                nn.BatchNorm2D(branch), act_cls(),
+                nn.Conv2D(branch, branch, 3, stride=1, padding=1,
+                          groups=branch, bias_attr=False),
+                nn.BatchNorm2D(branch),
+                nn.Conv2D(branch, branch, 1, bias_attr=False),
+                nn.BatchNorm2D(branch), act_cls())
+            self.branch1 = None
+        else:
+            self.branch1 = nn.Sequential(
+                nn.Conv2D(in_ch, in_ch, 3, stride=stride, padding=1,
+                          groups=in_ch, bias_attr=False),
+                nn.BatchNorm2D(in_ch),
+                nn.Conv2D(in_ch, branch, 1, bias_attr=False),
+                nn.BatchNorm2D(branch), act_cls())
+            self.branch2 = nn.Sequential(
+                nn.Conv2D(in_ch, branch, 1, bias_attr=False),
+                nn.BatchNorm2D(branch), act_cls(),
+                nn.Conv2D(branch, branch, 3, stride=stride, padding=1,
+                          groups=branch, bias_attr=False),
+                nn.BatchNorm2D(branch),
+                nn.Conv2D(branch, branch, 1, bias_attr=False),
+                nn.BatchNorm2D(branch), act_cls())
+
+    def forward(self, x):
+        if self.stride == 1:
+            half = x.shape[1] // 2
+            x1 = x[:, :half]
+            x2 = x[:, half:]
+            out = M.concat([x1, self.branch2(x2)], axis=1)
+        else:
+            out = M.concat([self.branch1(x), self.branch2(x)], axis=1)
+        return _channel_shuffle(out, 2)
+
+
+_STAGES = {  # scale -> per-stage out channels + final conv
+    0.25: ([24, 48, 96], 512), 0.33: ([32, 64, 128], 512),
+    0.5: ([48, 96, 192], 1024), 1.0: ([116, 232, 464], 1024),
+    1.5: ([176, 352, 704], 1024), 2.0: ([224, 488, 976], 2048),
+}
+_REPEATS = [4, 8, 4]
+
+
+class ShuffleNetV2(nn.Layer):
+    def __init__(self, scale=1.0, act="relu", num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        act_cls = nn.Swish if act == "swish" else nn.ReLU
+        chans, final = _STAGES[scale]
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.conv1 = nn.Sequential(
+            nn.Conv2D(3, 24, 3, stride=2, padding=1, bias_attr=False),
+            nn.BatchNorm2D(24), act_cls())
+        self.max_pool = nn.MaxPool2D(3, 2, padding=1)
+        blocks = []
+        in_ch = 24
+        for out_ch, rep in zip(chans, _REPEATS):
+            blocks.append(_InvertedResidual(in_ch, out_ch, 2, act_cls))
+            for _ in range(rep - 1):
+                blocks.append(_InvertedResidual(out_ch, out_ch, 1, act_cls))
+            in_ch = out_ch
+        self.blocks = nn.Sequential(*blocks)
+        self.conv_last = nn.Sequential(
+            nn.Conv2D(in_ch, final, 1, bias_attr=False),
+            nn.BatchNorm2D(final), act_cls())
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = nn.Linear(final, num_classes)
+
+    def forward(self, x):
+        x = self.conv_last(self.blocks(self.max_pool(self.conv1(x))))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(M.flatten(x, 1))
+        return x
+
+
+def _shufflenet(scale, act, pretrained, **kwargs):
+    if pretrained:
+        raise NotImplementedError("pretrained weights are not bundled")
+    return ShuffleNetV2(scale=scale, act=act, **kwargs)
+
+
+def shufflenet_v2_x0_25(pretrained=False, **kwargs):
+    return _shufflenet(0.25, "relu", pretrained, **kwargs)
+
+
+def shufflenet_v2_x0_33(pretrained=False, **kwargs):
+    return _shufflenet(0.33, "relu", pretrained, **kwargs)
+
+
+def shufflenet_v2_x0_5(pretrained=False, **kwargs):
+    return _shufflenet(0.5, "relu", pretrained, **kwargs)
+
+
+def shufflenet_v2_x1_0(pretrained=False, **kwargs):
+    return _shufflenet(1.0, "relu", pretrained, **kwargs)
+
+
+def shufflenet_v2_x1_5(pretrained=False, **kwargs):
+    return _shufflenet(1.5, "relu", pretrained, **kwargs)
+
+
+def shufflenet_v2_x2_0(pretrained=False, **kwargs):
+    return _shufflenet(2.0, "relu", pretrained, **kwargs)
+
+
+def shufflenet_v2_swish(pretrained=False, **kwargs):
+    return _shufflenet(1.0, "swish", pretrained, **kwargs)

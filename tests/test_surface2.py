@@ -223,3 +223,50 @@ def test_flash_dispatch_is_seqlen_aware():
     # short seq (auto) must take the XLA path and still be correct
     out = scaled_dot_product_attention(q, q, q, is_causal=True)
     assert out.shape == [1, 64, 2, 8]
+
+
+def test_model_zoo_surface_complete():
+    import ast
+    try:
+        tree = ast.parse(open(
+            "/root/reference/python/paddle/vision/models/__init__.py").read())
+    except OSError:
+        pytest.skip("reference not mounted")
+    names = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if getattr(t, "id", None) == "__all__":
+                    names = [ast.literal_eval(e) for e in node.value.elts]
+    missing = [n for n in names if not hasattr(paddle.vision.models, n)]
+    assert missing == []
+
+
+def test_new_models_forward():
+    m = paddle.vision.models
+    paddle.seed(0)
+    x = paddle.to_tensor(
+        np.random.RandomState(0).randn(1, 3, 64, 64).astype(np.float32))
+    for ctor in (m.densenet121, m.squeezenet1_1, m.shufflenet_v2_x0_25,
+                 m.MobileNetV3Small):
+        out = ctor(num_classes=7)(x)
+        assert out.shape == [1, 7]
+
+
+def test_static_namespace_surface_complete():
+    import ast
+    import paddle_hackathon_tpu.static as st
+    for path, mod in [("static/__init__.py", st), ("static/nn/__init__.py",
+                                                   st.nn)]:
+        try:
+            tree = ast.parse(open(
+                f"/root/reference/python/paddle/{path}").read())
+        except OSError:
+            pytest.skip("reference not mounted")
+        names = []
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if getattr(t, "id", None) == "__all__":
+                        names = [ast.literal_eval(e) for e in node.value.elts]
+        assert [n for n in names if not hasattr(mod, n)] == []
