@@ -116,6 +116,17 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.pht_store_get.restype = c.c_int32
     lib.pht_store_add.argtypes = [c.c_void_p, c.c_char_p, c.c_int64]
     lib.pht_store_add.restype = c.c_int64
+    lib.pht_reader_create.argtypes = [c.c_int32, c.c_int64]
+    lib.pht_reader_create.restype = c.c_void_p
+    lib.pht_reader_stage.argtypes = [c.c_void_p, c.c_void_p, c.c_int64,
+                                     c.c_int64]
+    lib.pht_reader_stage.restype = c.c_int32
+    lib.pht_reader_next.argtypes = [c.c_void_p, c.POINTER(c.c_void_p),
+                                    c.POINTER(c.c_int64), c.c_int64]
+    lib.pht_reader_next.restype = c.c_int32
+    lib.pht_reader_release.argtypes = [c.c_void_p, c.c_int32]
+    lib.pht_reader_close.argtypes = [c.c_void_p]
+    lib.pht_reader_destroy.argtypes = [c.c_void_p]
     lib.pht_store_check.argtypes = [c.c_void_p, c.c_char_p]
     lib.pht_store_check.restype = c.c_int32
     lib.pht_store_delete.argtypes = [c.c_void_p, c.c_char_p]
@@ -327,3 +338,58 @@ def flag_get(name: str) -> Optional[str]:
     if n < 0:
         return None
     return buf.value.decode()
+
+
+class StagingRing:
+    """Native staging ring for DataLoader batches (ref buffered_reader.cc).
+
+    Producer threads call :meth:`stage` (the batch memcpy runs in C++ with
+    the GIL released); the consumer pops in sequence order with
+    :meth:`next` and returns slots via :meth:`release`.
+    """
+
+    def __init__(self, n_slots: int = 4, slot_bytes: int = 1 << 20):
+        lib = load()
+        if lib is None:
+            raise RuntimeError("native runtime unavailable")
+        self._lib = lib
+        self._ring = lib.pht_reader_create(n_slots, slot_bytes)
+
+    def stage(self, array, seq: int) -> int:
+        import numpy as np
+        a = np.ascontiguousarray(array)
+        return self._lib.pht_reader_stage(
+            self._ring, a.ctypes.data_as(ctypes.c_void_p), a.nbytes, seq)
+
+    def next(self, dtype, shape, timeout_ms: int = 60000):
+        """Pop the next staged block viewed as (dtype, shape) numpy array.
+        Returns (slot, array-copy-free-view) or (None, None) when drained."""
+        import numpy as np
+        ptr = ctypes.c_void_p()
+        nbytes = ctypes.c_int64()
+        slot = self._lib.pht_reader_next(self._ring, ctypes.byref(ptr),
+                                         ctypes.byref(nbytes), timeout_ms)
+        if slot == -1:
+            raise TimeoutError("staging ring timed out")
+        if slot == -2:
+            return None, None
+        n = nbytes.value
+        buf = (ctypes.c_char * n).from_address(ptr.value)
+        arr = np.frombuffer(buf, dtype=dtype).reshape(shape)
+        return slot, arr
+
+    def release(self, slot: int) -> None:
+        self._lib.pht_reader_release(self._ring, slot)
+
+    def close(self) -> None:
+        if getattr(self, "_ring", None):
+            self._lib.pht_reader_close(self._ring)
+
+    def __del__(self):
+        try:
+            self.close()
+            if getattr(self, "_ring", None):
+                self._lib.pht_reader_destroy(self._ring)
+                self._ring = None
+        except Exception:
+            pass

@@ -64,6 +64,7 @@ class DataLoader:
         self.dataset = dataset
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = num_workers
+        self.use_buffer_reader = use_buffer_reader
         self.prefetch_factor = max(prefetch_factor, 1)
         self.timeout = timeout
         self.worker_init_fn = worker_init_fn
@@ -94,6 +95,10 @@ class DataLoader:
             return self._iter_iterable()
         if self.num_workers == 0:
             return self._iter_single()
+        if self.use_buffer_reader:
+            from ..core import native
+            if native.available():
+                return iter(_BufferedPrefetchIter(self))
         return iter(_PrefetchIter(self))
 
     def _iter_single(self):
@@ -180,3 +185,161 @@ class _PrefetchIter:
             self.next_emit += 1
             self.cv.notify_all()
         return batch
+
+
+class _BufferedPrefetchIter:
+    """Prefetch iterator with the native staging ring (ref
+    ``operators/reader/buffered_reader.cc``).
+
+    Pipeline: worker threads (dataset fetch + collate, Python) -> stager
+    thread (C++ memcpy into recycled slots, GIL released during the copy) ->
+    consumer (copies to a device buffer, then recycles the slot).
+
+    Metadata for each batch is queued BEFORE its arrays are staged so the
+    consumer can drain slots while the stager fills them — a batch with more
+    arrays than ring slots therefore streams through instead of
+    deadlocking. Object/str arrays (non-numeric dtypes) bypass the ring and
+    travel on the metadata queue directly.
+    """
+
+    def __init__(self, loader: DataLoader):
+        from ..core import native
+        self.inner = _PrefetchIter(loader)
+        slot_bytes = 1 << 20
+        n_slots = max(4, loader.num_workers * loader.prefetch_factor * 2)
+        self.ring = native.StagingRing(n_slots=n_slots, slot_bytes=slot_bytes)
+        self.meta_q: "queue.Queue" = queue.Queue()
+        self._closed = False
+        # the thread target closes over (inner, ring, meta_q) directly — NOT
+        # self — so an abandoned iterator can be garbage-collected, firing
+        # __del__ -> close() -> ring.close(), which unblocks this thread
+        self._stager = threading.Thread(
+            target=_stage_loop, args=(self.inner, self.ring, self.meta_q),
+            daemon=True)
+        self._stager.start()
+
+    def close(self):
+        """Unblock and tear down (also called on abandonment via __del__)."""
+        if self._closed:
+            return
+        self._closed = True
+        self.ring.close()  # unblocks a stager stuck waiting for a free slot
+        with self.inner.cv:
+            if self.inner.error is None:
+                self.inner.error = GeneratorExit("DataLoader iterator closed")
+            self.inner.cv.notify_all()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        item = self.meta_q.get()
+        if item is None:
+            self.close()
+            raise StopIteration
+        if isinstance(item, Exception):
+            self.close()
+            raise item
+        metas, structure = item
+        import jax.numpy as jnp
+        import numpy as np
+        from ..core.tensor import Tensor
+        arrays = []
+        for meta in metas:
+            if meta[0] == "raw":
+                arrays.append(Tensor(jnp.asarray(meta[1]))
+                              if np.asarray(meta[1]).dtype.kind not in "OUSV"
+                              else meta[1])
+                continue
+            dtype, shape = meta
+            slot, view = self.ring.next(dtype, shape)
+            if slot is None:
+                self.close()
+                raise RuntimeError(
+                    "staging ring drained mid-batch (stager failed)")
+            # jnp.array(copy=True) + block: the device buffer owns its data
+            # before the slot is recycled (CPU backend may otherwise alias,
+            # TPU H2D is async)
+            dev = jnp.array(view, copy=True)
+            dev.block_until_ready()
+            arrays.append(Tensor(dev))
+            self.ring.release(slot)
+        return _unflatten_batch(arrays, structure)
+
+
+def _stage_loop(inner, ring, meta_q):
+    """Stager thread body (module-level: must not keep the iterator alive)."""
+    seq = 0
+    try:
+        for batch in inner:
+            arrays, structure = _flatten_batch(batch)
+            metas = []
+            ringable = []
+            for a in arrays:
+                if a.dtype.kind in "OUSV":  # object/str: bypass ring
+                    metas.append(("raw", a))
+                else:
+                    metas.append((a.dtype, a.shape))
+                    ringable.append(a)
+            # meta first: the consumer starts draining slots while the
+            # arrays stream through the ring (no capacity deadlock)
+            meta_q.put((metas, structure))
+            for a in ringable:
+                if ring.stage(a, seq) < 0:
+                    raise RuntimeError("staging ring closed mid-epoch")
+                seq += 1
+        meta_q.put(None)
+    except Exception as e:
+        meta_q.put(e)
+    except BaseException:  # GeneratorExit from close(): silent exit
+        meta_q.put(None)
+    finally:
+        ring.close()
+
+
+def _flatten_batch(batch):
+    """Split a collated batch into (list of numpy arrays, structure)."""
+    import numpy as np
+    from ..core.tensor import Tensor
+    if isinstance(batch, dict):
+        arrays, struct = [], []
+        for k in batch:
+            a, s = _flatten_batch(batch[k])
+            struct.append((k, len(a), s))
+            arrays.extend(a)
+        return arrays, ("dict", struct)
+    if isinstance(batch, (list, tuple)):
+        arrays, struct = [], []
+        for item in batch:
+            a, s = _flatten_batch(item)
+            struct.append((len(a), s))
+            arrays.extend(a)
+        return arrays, (type(batch).__name__, struct)
+    if isinstance(batch, Tensor):
+        return [np.asarray(batch.numpy())], "tensor"
+    return [np.asarray(batch)], "array"
+
+
+def _unflatten_batch(arrays, structure):
+    if structure in ("tensor", "array"):
+        return arrays[0]
+    kind, struct = structure
+    if kind == "dict":
+        out = {}
+        i = 0
+        for k, n, s in struct:
+            out[k] = _unflatten_batch(arrays[i:i + n], s)
+            i += n
+        return out
+    out = []
+    i = 0
+    for n, s in struct:
+        out.append(_unflatten_batch(arrays[i:i + n], s))
+        i += n
+    return tuple(out) if kind == "tuple" else out

@@ -764,3 +764,124 @@ PHT_API int32_t pht_store_delete(void* client, const char* key) {
   if (!read_full(c->fd, &erased, 1)) return -1;
   return erased;
 }
+
+// ---------------------------------------------------------------------------
+// Buffered reader: staging ring for DataLoader batches
+// (ref: paddle/fluid/operators/reader/buffered_reader.cc — double-buffered
+//  host staging overlapping input pipeline with compute; here the staging
+//  memcpy runs on C++ threads with the GIL released, and slots recycle to
+//  avoid per-batch allocator churn)
+// ---------------------------------------------------------------------------
+
+struct StagingRing {
+  struct Slot {
+    std::vector<char> buf;
+    int64_t nbytes = 0;
+    int64_t seq = -1;
+  };
+  std::vector<Slot> slots;
+  std::deque<int32_t> free_slots;
+  // ready queue ordered by sequence number so batches emit in order
+  std::deque<int32_t> ready;
+  std::mutex mu;
+  std::condition_variable cv;
+  bool closed = false;
+  int64_t next_seq = 0;  // strict in-order delivery cursor
+
+  explicit StagingRing(int32_t n, int64_t slot_bytes) : slots(n) {
+    for (int32_t i = 0; i < n; i++) {
+      slots[static_cast<size_t>(i)].buf.reserve(
+          static_cast<size_t>(slot_bytes));
+      free_slots.push_back(i);
+    }
+  }
+};
+
+PHT_API void* pht_reader_create(int32_t n_slots, int64_t slot_bytes) {
+  if (n_slots < 2) n_slots = 2;
+  return new StagingRing(n_slots, slot_bytes);
+}
+
+// Claim a free slot, copy `src` into it, enqueue as ready. Blocks while all
+// slots are in flight (bounded prefetch). Returns slot id or -1 if closed.
+PHT_API int32_t pht_reader_stage(void* ring, const void* src, int64_t nbytes,
+                                 int64_t seq) {
+  auto* r = static_cast<StagingRing*>(ring);
+  int32_t idx;
+  {
+    std::unique_lock<std::mutex> lk(r->mu);
+    r->cv.wait(lk, [r] { return r->closed || !r->free_slots.empty(); });
+    if (r->closed) return -1;
+    idx = r->free_slots.front();
+    r->free_slots.pop_front();
+  }
+  auto& slot = r->slots[static_cast<size_t>(idx)];
+  slot.buf.resize(static_cast<size_t>(nbytes));
+  std::memcpy(slot.buf.data(), src, static_cast<size_t>(nbytes));
+  slot.nbytes = nbytes;
+  slot.seq = seq;
+  {
+    std::lock_guard<std::mutex> g(r->mu);
+    // insert keeping ready ordered by seq (workers may finish out of order)
+    auto it = r->ready.begin();
+    while (it != r->ready.end()
+           && r->slots[static_cast<size_t>(*it)].seq < seq) ++it;
+    r->ready.insert(it, idx);
+  }
+  r->cv.notify_all();
+  return idx;
+}
+
+// Pop the next ready slot (lowest staged seq). Returns slot id, or -1 on
+// timeout, -2 when closed and drained. *ptr/*nbytes describe the data.
+PHT_API int32_t pht_reader_next(void* ring, void** ptr, int64_t* nbytes,
+                                int64_t timeout_ms) {
+  auto* r = static_cast<StagingRing*>(ring);
+  std::unique_lock<std::mutex> lk(r->mu);
+  // wait until the exact next sequence number is staged (producers may
+  // finish out of order; delivery is strict FIFO by seq)
+  bool ok = r->cv.wait_for(lk, std::chrono::milliseconds(timeout_ms), [r] {
+    if (r->closed) return true;
+    return !r->ready.empty()
+        && r->slots[static_cast<size_t>(r->ready.front())].seq == r->next_seq;
+  });
+  if (!ok) return -1;
+  if (r->ready.empty()
+      || r->slots[static_cast<size_t>(r->ready.front())].seq != r->next_seq) {
+    if (r->closed && r->ready.empty()) return -2;  // closed + drained
+    if (r->closed) {
+      // closed with a gap: emit what is there (best effort)
+    } else {
+      return -1;
+    }
+  }
+  int32_t idx = r->ready.front();
+  r->ready.pop_front();
+  r->next_seq = r->slots[static_cast<size_t>(idx)].seq + 1;
+  auto& slot = r->slots[static_cast<size_t>(idx)];
+  *ptr = slot.buf.data();
+  *nbytes = slot.nbytes;
+  return idx;
+}
+
+PHT_API void pht_reader_release(void* ring, int32_t slot) {
+  auto* r = static_cast<StagingRing*>(ring);
+  {
+    std::lock_guard<std::mutex> g(r->mu);
+    r->free_slots.push_back(slot);
+  }
+  r->cv.notify_all();
+}
+
+PHT_API void pht_reader_close(void* ring) {
+  auto* r = static_cast<StagingRing*>(ring);
+  {
+    std::lock_guard<std::mutex> g(r->mu);
+    r->closed = true;
+  }
+  r->cv.notify_all();
+}
+
+PHT_API void pht_reader_destroy(void* ring) {
+  delete static_cast<StagingRing*>(ring);
+}

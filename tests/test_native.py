@@ -276,3 +276,55 @@ class TestTCPStore:
         final = master.add("counter", 0)
         assert final == sum(range(1, world + 1))
         master.close()
+
+
+def test_staging_ring_strict_order():
+    import threading
+
+    import numpy as np
+
+    from paddle_hackathon_tpu.core import native
+    if not native.available():
+        pytest.skip("native runtime unavailable")
+    ring = native.StagingRing(n_slots=4, slot_bytes=256)
+    data = [np.full((4,), i, np.float32) for i in range(8)]
+
+    def producer():
+        for i in [1, 0, 2, 4, 3, 5, 7, 6]:  # out-of-order within window
+            ring.stage(data[i], i)
+        ring.close()
+
+    t = threading.Thread(target=producer)
+    t.start()
+    got = []
+    while True:
+        slot, arr = ring.next(np.float32, (4,))
+        if slot is None:
+            break
+        got.append(int(arr[0]))
+        ring.release(slot)
+    t.join()
+    assert got == list(range(8))
+
+
+def test_buffered_dataloader_in_order_and_structured():
+    import numpy as np
+
+    from paddle_hackathon_tpu.core import native
+    from paddle_hackathon_tpu.io import DataLoader, Dataset
+    if not native.available():
+        pytest.skip("native runtime unavailable")
+
+    class DS(Dataset):
+        def __len__(self):
+            return 23
+
+        def __getitem__(self, i):
+            return np.full((3,), i, np.float32), np.int64(i)
+
+    seen = []
+    for xb, yb in DataLoader(DS(), batch_size=4, num_workers=2,
+                             use_buffer_reader=True):
+        assert xb.shape[1] == 3
+        seen.extend(yb.numpy().tolist())
+    assert seen == list(range(23))
