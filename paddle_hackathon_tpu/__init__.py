@@ -81,7 +81,8 @@ import importlib as _importlib
 for _sub in ("nn", "optimizer", "io", "amp", "metric", "framework",
              "jit", "distributed", "vision", "incubate", "profiler", "hapi",
              "static", "text", "inference", "distribution", "sparse",
-             "utils", "onnx", "fft", "signal", "device", "autograd", "linalg"):
+             "utils", "onnx", "fft", "signal", "device", "autograd", "linalg",
+             "regularizer", "sysconfig", "hub", "callbacks"):
     try:
         globals()[_sub] = _importlib.import_module(f"{__name__}.{_sub}")
     except ModuleNotFoundError as _e:

@@ -16,3 +16,10 @@ from ..parallel import (collective, auto_parallel, fleet,  # noqa: F401
 from ..parallel.collective import (all_gather, all_reduce, alltoall,  # noqa: F401
                                    barrier, broadcast, new_group, reduce,
                                    reduce_scatter, scatter)
+
+from . import launch  # noqa: F401,E402  (python -m ...distributed.launch)
+from .compat import (CountFilterEntry, InMemoryDataset,  # noqa: F401,E402
+                     ParallelEnv, ProbabilityEntry, QueueDataset,
+                     ShowClickEntry, get_group, gloo_barrier,
+                     gloo_init_parallel_env, gloo_release, irecv, isend,
+                     recv, send, spawn, split, wait)

@@ -1,0 +1,54 @@
+"""paddle.hub (ref ``python/paddle/hackathon... hub.py``): load models from a
+local hubconf.py (the reference also supports github/gitee sources — zero
+egress here, so only source='local' is wired; remote sources raise with the
+reason)."""
+
+import importlib.util
+import os
+import sys
+
+__all__ = ["list", "help", "load"]
+
+
+_hubconf_cache = {}
+
+
+def _load_hubconf(repo_dir, force_reload=False):
+    repo_dir = os.path.abspath(repo_dir)
+    if not force_reload and repo_dir in _hubconf_cache:
+        return _hubconf_cache[repo_dir]
+    path = os.path.join(repo_dir, "hubconf.py")
+    if not os.path.exists(path):
+        raise FileNotFoundError(f"no hubconf.py under {repo_dir!r}")
+    # unique module name per repo: two repos' hubconfs coexist
+    name = f"hubconf_{abs(hash(repo_dir)):x}"
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[name] = mod
+    spec.loader.exec_module(mod)
+    _hubconf_cache[repo_dir] = mod
+    return mod
+
+
+def _check_source(source):
+    if source != "local":
+        raise NotImplementedError(
+            "this build runs with zero network egress; only source='local' "
+            "hub repos are supported")
+
+
+def list(repo_dir, source="local", force_reload=False):  # noqa: A001
+    _check_source(source)
+    mod = _load_hubconf(repo_dir, force_reload)
+    return [n for n in dir(mod)
+            if callable(getattr(mod, n)) and not n.startswith("_")]
+
+
+def help(repo_dir, model, source="local", force_reload=False):  # noqa: A001
+    _check_source(source)
+    return getattr(_load_hubconf(repo_dir, force_reload), model).__doc__
+
+
+def load(repo_dir, model, source="local", force_reload=False, **kwargs):
+    _check_source(source)
+    return getattr(_load_hubconf(repo_dir, force_reload), model)(**kwargs)

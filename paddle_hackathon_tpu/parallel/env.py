@@ -30,7 +30,8 @@ def init_parallel_env(coordinator_address=None, num_processes=None,
     global _initialized
     if _initialized:
         return
-    coordinator_address = coordinator_address or os.environ.get("PADDLE_MASTER")
+    coordinator_address = (coordinator_address
+                           or os.environ.get("PADDLE_MASTER") or None)
     if coordinator_address is None:
         _initialized = True  # single-process mode
         return

@@ -187,3 +187,143 @@ def distributed_model(model):
 
 def distributed_optimizer(optimizer, strategy=None):
     return fleet.distributed_optimizer(optimizer, strategy)
+
+
+# -- fleet namespace compat (ref distributed/fleet/__init__.py __all__) ------
+
+from .topology import CommunicateTopology, HybridCommunicateGroup  # noqa: E402,F401
+
+Fleet = _Fleet  # the class behind the module-level singleton
+
+
+class Role:
+    """Ref fleet/base/role_maker.py Role enum."""
+    WORKER = 1
+    SERVER = 2
+    HETER_WORKER = 3
+    ALL = 4
+
+
+class PaddleCloudRoleMaker:
+    """Role maker reading the launcher env protocol (ref
+    fleet/base/role_maker.py PaddleCloudRoleMaker)."""
+
+    def __init__(self, is_collective=False, **kwargs):
+        import os
+        self._is_collective = is_collective
+        self._rank = int(os.environ.get("PADDLE_TRAINER_ID", 0))
+        self._size = int(os.environ.get("PADDLE_TRAINERS_NUM", 1))
+        self._role = (Role.SERVER
+                      if os.environ.get("TRAINING_ROLE", "TRAINER") == "PSERVER"
+                      else Role.WORKER)
+
+    def _is_worker(self):
+        return self._role == Role.WORKER
+
+    def _is_server(self):
+        return self._role == Role.SERVER
+
+    def _worker_index(self):
+        return self._rank
+
+    def _worker_num(self):
+        return self._size
+
+    def _server_num(self):
+        import os
+        eps = os.environ.get("PADDLE_PSERVERS_IP_PORT_LIST", "")
+        return len([e for e in eps.split(",") if e])
+
+
+class UserDefinedRoleMaker(PaddleCloudRoleMaker):
+    """Explicit role assignment (ref UserDefinedRoleMaker)."""
+
+    def __init__(self, is_collective=False, init_gloo=False, current_id=0,
+                 role=None, worker_num=1, server_endpoints=None, **kwargs):
+        super().__init__(is_collective=is_collective)
+        self._rank = current_id
+        self._size = worker_num
+        self._role = role if role is not None else Role.WORKER
+        self._server_endpoints = server_endpoints or []
+
+    def _server_num(self):
+        return len(self._server_endpoints)
+
+
+class UtilBase:
+    """Ref fleet/utils/fleet_util.py UtilBase: small cross-rank helpers."""
+
+    def all_reduce(self, input, mode="sum", comm_world="worker"):  # noqa: A002
+        # single-controller SPMD: every "rank" computes the same host value,
+        # so the reduction over identical contributions is value * n for sum
+        # and identity for max/min (ref fleet_util all_reduce semantics)
+        import numpy as _np
+        from . import env as _envm
+        n = _envm.get_world_size()
+        arr = _np.asarray(input.numpy() if hasattr(input, "numpy") else input)
+        if mode == "sum":
+            return arr * n
+        return arr
+
+    def barrier(self, comm_world="worker"):
+        from . import collective
+        collective.barrier()
+
+    def all_gather(self, input, comm_world="worker"):  # noqa: A002
+        import numpy as _np
+        from . import env as _envm
+        n = _envm.get_world_size()
+        arr = _np.asarray(input.numpy() if hasattr(input, "numpy") else input)
+        return _np.stack([arr] * n)
+
+    def get_file_shard(self, files):
+        import os
+        rank = int(os.environ.get("PADDLE_TRAINER_ID", 0))
+        size = int(os.environ.get("PADDLE_TRAINERS_NUM", 1))
+        return files[rank::size]
+
+    def print_on_rank(self, message, rank_id=0):
+        import os
+        if int(os.environ.get("PADDLE_TRAINER_ID", 0)) == rank_id:
+            print(message)
+
+
+class MultiSlotDataGenerator:
+    """Ref fleet/data_generator: per-line sample generator emitting
+    (slot_name, values) pairs; run() drives stdin->stdout for the pipe
+    protocol, or iterate in-process."""
+
+    def generate_sample(self, line):
+        raise NotImplementedError
+
+    def run_from_stdin(self):
+        import sys
+        for line in sys.stdin:
+            g = self.generate_sample(line.rstrip("\n"))
+            for sample in g() if callable(g) else g:
+                out = []
+                for name, values in sample:
+                    out.append(str(len(values)))
+                    out.extend(str(v) for v in values)
+                sys.stdout.write(" ".join(out) + "\n")
+
+    def iter_samples(self, lines):
+        for line in lines:
+            g = self.generate_sample(line)
+            for sample in g() if callable(g) else g:
+                yield sample
+
+
+class MultiSlotStringDataGenerator(MultiSlotDataGenerator):
+    pass
+
+
+# The reference's ``paddle.distributed.fleet`` is a module exposing both the
+# singleton's methods and these classes; our singleton mirrors that by
+# carrying them as attributes.
+for _cls in (CommunicateTopology, HybridCommunicateGroup, Fleet, Role,
+             PaddleCloudRoleMaker, UserDefinedRoleMaker, UtilBase,
+             MultiSlotDataGenerator, MultiSlotStringDataGenerator,
+             DistributedStrategy):
+    setattr(fleet, _cls.__name__, _cls)
+fleet.Fleet = Fleet  # the alias's __name__ is _Fleet

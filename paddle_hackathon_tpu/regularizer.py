@@ -1,0 +1,7 @@
+"""paddle.regularizer (ref ``python/paddle/regularizer.py``): weight decay
+as an optimizer-coupled penalty — re-exported from the optimizer module
+where the coefficients are consumed."""
+
+from .optimizer import L1Decay, L2Decay  # noqa: F401
+
+__all__ = ["L1Decay", "L2Decay"]

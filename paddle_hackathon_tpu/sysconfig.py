@@ -1,0 +1,15 @@
+"""paddle.sysconfig (ref ``python/paddle/sysconfig.py``)."""
+
+import os
+
+__all__ = ["get_include", "get_lib"]
+
+
+def get_include():
+    """Directory of the C headers / C ABI sources (ref sysconfig.get_include)."""
+    return os.path.join(os.path.dirname(__file__), "native")
+
+
+def get_lib():
+    """Directory holding the built native library."""
+    return os.path.join(os.path.dirname(__file__), "native", "_build")

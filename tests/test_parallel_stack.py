@@ -444,3 +444,67 @@ class TestFleetAPI:
             assert "sharding" in str(sh.spec) or True  # placement smoke
         finally:
             parallel.set_mesh(None)
+
+
+def _p2p_worker():
+    import os
+
+    import jax as j
+    j.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    import paddle_hackathon_tpu as p
+    rank = int(os.environ["PADDLE_TRAINER_ID"])
+    if rank == 0:
+        p.distributed.send(p.to_tensor(np.array([7.0, 8.0], np.float32)),
+                           dst=1)
+    else:
+        y = p.to_tensor(np.zeros(2, np.float32))
+        p.distributed.recv(y, src=0)
+        assert y.numpy().tolist() == [7.0, 8.0]
+
+
+def test_p2p_send_recv_cross_process():
+    """Eager p2p over the rendezvous store across spawned ranks
+    (ref send_v2/recv_v2 dygraph p2p)."""
+    import paddle_hackathon_tpu as p
+    p.distributed.spawn(_p2p_worker, nprocs=2)
+
+
+def test_p2p_send_recv_local_and_tasks():
+    import numpy as np
+
+    import paddle_hackathon_tpu as p
+    x = p.to_tensor(np.array([1.0, 2.0], np.float32))
+    p.distributed.send(x, dst=0, tag=3)
+    y = p.to_tensor(np.zeros(2, np.float32))
+    p.distributed.recv(y, src=0, tag=3)
+    np.testing.assert_allclose(y.numpy(), [1.0, 2.0])
+    t = p.distributed.irecv(p.to_tensor(np.zeros(2, np.float32)), src=0,
+                            tag=4)
+    p.distributed.isend(p.to_tensor(np.array([3.0], np.float32) * 2), dst=0,
+                        tag=4)
+    np.testing.assert_allclose(t.wait().numpy(), [6.0])
+
+
+def test_distributed_split_linear():
+    import numpy as np
+
+    import paddle_hackathon_tpu as p
+    p.seed(0)
+    x = p.to_tensor(np.random.RandomState(0).randn(2, 8).astype(np.float32))
+    out = p.distributed.split(x, (8, 6), operation="linear")
+    assert out.shape == [2, 6]
+
+
+def test_queue_and_inmemory_dataset(tmp_path):
+    import paddle_hackathon_tpu as p
+    f = tmp_path / "part-0"
+    f.write_text("1 2\n3 4\n5 6\n")
+    ds = p.distributed.InMemoryDataset()
+    ds.init(batch_size=2)
+    ds.set_filelist([str(f)])
+    ds.load_into_memory()
+    assert ds.get_memory_data_size() == 3
+    batches = list(ds)
+    assert len(batches) == 2 and batches[0][0].shape == [2]
