@@ -82,7 +82,7 @@ for _sub in ("nn", "optimizer", "io", "amp", "metric", "framework",
              "jit", "distributed", "vision", "incubate", "profiler", "hapi",
              "static", "text", "inference", "distribution", "sparse",
              "utils", "onnx", "fft", "signal", "device", "autograd", "linalg",
-             "regularizer", "sysconfig", "hub", "callbacks"):
+             "regularizer", "sysconfig", "hub", "callbacks", "version"):
     try:
         globals()[_sub] = _importlib.import_module(f"{__name__}.{_sub}")
     except ModuleNotFoundError as _e:

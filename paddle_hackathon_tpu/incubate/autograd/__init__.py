@@ -164,4 +164,100 @@ class Hessian:
         return np.asarray(self._value)
 
 
-__all__ = ["jvp", "vjp", "Jacobian", "Hessian"]
+__all__ = ["jvp", "vjp", "Jacobian", "Hessian", "forward_grad", "grad", "enable_prim", "disable_prim", "prim_enabled"]
+
+
+# -- primitive-mode API (ref incubate/autograd/primx.py enable_prim etc.) ----
+# In the reference, "prim" mode lowers ops to primitive rules so the static
+# AD pass can transpose them. Here every op IS already differentiable jax
+# primitives — prim mode is the permanent state — so the toggles record
+# intent only.
+_prim_enabled = [False]
+
+
+def enable_prim():
+    _prim_enabled[0] = True
+
+
+def disable_prim():
+    _prim_enabled[0] = False
+
+
+def prim_enabled():
+    return _prim_enabled[0]
+
+
+def forward_grad(outputs, inputs, grad_inputs=None):
+    """Forward-mode AD over the static Program (ref
+    incubate/autograd/primapi.py forward_grad — static-only there too):
+    records a JVP-replay instruction computing d outputs / d inputs with
+    the given input tangents (default ones)."""
+    from ...static import program as _prog
+    if not _prog.in_static_mode():
+        raise RuntimeError(
+            "forward_grad is a static-graph API (as in the reference); "
+            "use incubate.autograd.jvp for eager forward-mode")
+    singles = not isinstance(outputs, (list, tuple))
+    outs = [outputs] if singles else list(outputs)
+    ins = [inputs] if not isinstance(inputs, (list, tuple)) else list(inputs)
+    tangent_args = (None if grad_inputs is None else
+                    ([grad_inputs] if not isinstance(grad_inputs,
+                                                     (list, tuple))
+                     else list(grad_inputs)))
+    prog = _prog.default_main_program()
+    sub = list(prog._instructions)
+    feeds = list(prog._feeds)
+    params = prog.all_parameters()
+    feed_ids = [f._var_id for f in feeds]
+    in_ids = [x._var_id for x in ins]
+    out_ids = [o._var_id for o in outs]
+    n_tan = len(tangent_args) if tangent_args else 0
+
+    def _replay(env, param_vals, want):
+        for ins_ in sub:
+            if set(ins_.out_ids) <= set(env):
+                continue
+            vals_ = []
+            for kind, ref in ins_.inputs:
+                if kind == "var":
+                    vals_.append(env[ref])
+                elif kind == "param":
+                    vals_.append(param_vals[id(ref)])
+                else:
+                    vals_.append(ref)
+            o = ins_.fn(*vals_)
+            os_ = (o,) if ins_.n_outputs == 1 and not isinstance(
+                o, tuple) else o
+            for vid, val in zip(ins_.out_ids, os_):
+                env[vid] = val
+        return tuple(env[i] for i in want)
+
+    def jvp_fn(*vals):
+        feed_vals = list(vals[:len(feed_ids)])
+        tan_vals = list(vals[len(feed_ids):len(feed_ids) + n_tan])
+        param_vals = dict(zip((id(p) for p in params),
+                              vals[len(feed_ids) + n_tan:]))
+
+        def forward(wrt):
+            env = dict(zip(feed_ids, feed_vals))
+            env.update(zip(in_ids, wrt))
+            return _replay(env, param_vals, out_ids)
+
+        primals = _replay(dict(zip(feed_ids, feed_vals)), param_vals, in_ids)
+        tangents = (tuple(tan_vals) if tan_vals
+                    else tuple(jnp.ones_like(p) for p in primals))
+        _, out_tangents = jax.jvp(forward, (primals,), (tangents,))
+        return out_tangents if len(out_ids) > 1 else out_tangents[0]
+
+    rec_args = feeds + (tangent_args or []) + params
+    res = prog.record_op("forward_grad", jvp_fn, rec_args,
+                         n_outputs=len(out_ids))
+    return res
+
+
+def grad(outputs, inputs, grad_outputs=None):
+    """Reverse-mode grad (ref incubate.autograd.grad) — delegates to the
+    eager engine's grad()."""
+    from ...core.autograd import grad as _grad
+    return _grad(outputs, inputs, grad_outputs=grad_outputs,
+                 allow_unused=True)

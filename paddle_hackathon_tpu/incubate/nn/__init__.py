@@ -1,4 +1,5 @@
 from . import functional, kernels  # noqa: F401
 from .layer.fused_transformer import (  # noqa: F401
-    FusedFeedForward, FusedMultiHeadAttention, FusedMultiTransformer,
+    FusedBiasDropoutResidualLayerNorm, FusedFeedForward, FusedLinear,
+    FusedMultiHeadAttention, FusedMultiTransformer,
     FusedTransformerEncoderLayer)

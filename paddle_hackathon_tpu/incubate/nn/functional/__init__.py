@@ -177,9 +177,12 @@ def fused_feedforward(x, linear1_weight, linear1_bias, linear2_weight,
     out = residual + dropout2(linear2(dropout1(act(linear1(ln(x))))))
     (post-LN applies layer_norm at the end instead).
     """
-    args = [_t(x), _t(linear1_weight), _t(linear1_bias), _t(linear2_weight),
-            _t(linear2_bias)]
-    names = ["x", "w1", "b1", "w2", "b2"]
+    args = [_t(x), _t(linear1_weight), _t(linear2_weight)]
+    names = ["x", "w1", "w2"]
+    for nm, v in (("b1", linear1_bias), ("b2", linear2_bias)):
+        if v is not None:
+            args.append(_t(v))
+            names.append(nm)
     for nm, v in (("ln_scale", ln1_scale), ("ln_bias", ln1_bias)):
         if v is not None:
             args.append(_t(v))
@@ -207,10 +210,14 @@ def fused_feedforward(x, linear1_weight, linear1_bias, linear2_weight,
         d = dict(zip(names, vals))
         residual = d["x"]
         h = _ln(d["x"], d, ln1_epsilon) if pre_layer_norm else d["x"]
-        h = jnp.matmul(h, d["w1"]) + d["b1"]
+        h = jnp.matmul(h, d["w1"])
+        if "b1" in d:
+            h = h + d["b1"]
         h = jax.nn.gelu(h) if activation == "gelu" else jax.nn.relu(h)
         h = _dropout(h, dropout1_rate, keys[0])
-        h = jnp.matmul(h, d["w2"]) + d["b2"]
+        h = jnp.matmul(h, d["w2"])
+        if "b2" in d:
+            h = h + d["b2"]
         h = _dropout(h, dropout2_rate, keys[1])
         out = residual + h
         if not pre_layer_norm:
@@ -224,3 +231,141 @@ __all__ = [
     "flash_attention", "flash_attention_bshd", "fused_layer_norm",
     "fused_dropout_add", "fused_linear", "fused_feedforward",
 ]
+
+
+def fused_matmul_bias(x, y, bias=None, transpose_x=False, transpose_y=False,
+                      name=None):
+    """matmul + bias epilogue (ref fused_gemm_epilogue via cublasLt)."""
+    args = [_t(x), _t(y)] + ([_t(bias)] if bias is not None else [])
+
+    def fn(xv, yv, *rest):
+        if transpose_x:
+            xv = jnp.swapaxes(xv, -1, -2)
+        if transpose_y:
+            yv = jnp.swapaxes(yv, -1, -2)
+        out = jnp.matmul(xv, yv)
+        if rest:
+            out = out + rest[0]
+        return out
+    return apply_op("fused_matmul_bias", fn, args)
+
+
+def fused_bias_dropout_residual_layer_norm(
+        x, residual, bias=None, ln_scale=None, ln_bias=None,
+        dropout_rate=0.5, ln_epsilon=1e-5, training=True,
+        mode="upscale_in_train", name=None):
+    """LN(residual + dropout(x + bias)) in one fused op (ref
+    fused_bias_dropout_residual_layer_norm op)."""
+    out, _ = fused_layer_norm(x, ln_scale, ln_bias, epsilon=ln_epsilon,
+                              residual=residual, bias=bias,
+                              dropout_rate=dropout_rate, training=training)
+    return out
+
+
+def fused_multi_head_attention(
+        x, qkv_weight, linear_weight, pre_layer_norm=False,
+        pre_ln_scale=None, pre_ln_bias=None, ln_scale=None, ln_bias=None,
+        pre_ln_epsilon=1e-5, qkv_bias=None, linear_bias=None,
+        cache_kv=None, attn_mask=None, dropout_rate=0.5,
+        attn_dropout_rate=0.5, ln_epsilon=1e-5, training=True,
+        mode="upscale_in_train", ring_id=-1, add_residual=True, name=None):
+    """Functional form of the fused attention block (ref
+    fused_attention_op.cu): optional pre-LN -> qkv -> MHA -> out proj ->
+    bias+dropout+residual(+post-LN)."""
+    import math as _math
+    h = _t(x)
+    residual = h
+    if pre_layer_norm:
+        h, _ = fused_layer_norm(h, pre_ln_scale, pre_ln_bias,
+                                epsilon=pre_ln_epsilon)
+    if cache_kv is not None:
+        raise NotImplementedError(
+            "cache_kv incremental decoding is not wired in this build; run "
+            "full-sequence attention or use the models' own KV caching")
+    qkvw = _t(qkv_weight)  # (3, num_heads, head_dim, embed)
+    _, n_heads, head_dim, embed = qkvw.shape
+    has_bias = qkv_bias is not None
+    has_mask = attn_mask is not None
+
+    drop_key = None
+    if attn_dropout_rate > 0.0 and training:
+        from ....core import random as core_random
+        drop_key = core_random.split_key()
+
+    def qkv_fn(hv, wv, *rest):
+        it = iter(rest)
+        b = next(it) if has_bias else None
+        mask = next(it) if has_mask else None
+        q, k, v = (jnp.einsum("bsd,hed->bshe", hv, wv[i])
+                   for i in range(3))
+        if b is not None:
+            q = q + b[0][None, None]
+            k = k + b[1][None, None]
+            v = v + b[2][None, None]
+        logits = jnp.einsum("bshe,bthe->bhst", q, k) / _math.sqrt(head_dim)
+        if mask is not None:
+            logits = logits + mask
+        probs = jax.nn.softmax(logits, -1)
+        probs = _dropout(probs, attn_dropout_rate, drop_key)
+        ctx = jnp.einsum("bhst,bthe->bshe", probs, v)
+        return ctx.reshape(ctx.shape[0], ctx.shape[1], -1)
+
+    args = [h, qkvw]
+    if has_bias:
+        args.append(_t(qkv_bias))
+    if has_mask:
+        args.append(_t(attn_mask))
+    ctx = apply_op("fused_mha_core", qkv_fn, args)
+    out = fused_linear(ctx, linear_weight, linear_bias)
+    if add_residual:
+        out = fused_dropout_add(out, residual, p=dropout_rate,
+                                training=training, mode=mode)
+    if not pre_layer_norm:
+        out, _ = fused_layer_norm(out, ln_scale, ln_bias, epsilon=ln_epsilon)
+    return out
+
+
+def fused_multi_transformer(
+        x, ln_scales, ln_biases, qkv_weights, qkv_biases, linear_weights,
+        linear_biases, ffn_ln_scales, ffn_ln_biases, ffn1_weights,
+        ffn1_biases, ffn2_weights, ffn2_biases, pre_layer_norm=True,
+        epsilon=1e-5, cache_kvs=None, time_step=None, attn_mask=None,
+        dropout_rate=0.0, activation="gelu", training=False,
+        mode="upscale_in_train", trans_qkvw=True, ring_id=-1, name=None):
+    """Stacked fused transformer decoder blocks (ref
+    fused_multi_transformer_op.cu). Returns (out, cache_kvs)."""
+    h = _t(x)
+    n_layers = len(qkv_weights)
+    if not trans_qkvw:
+        # weights arrive (embed, 3, heads, head_dim): move embed last to the
+        # (3, heads, head_dim, embed) layout the attention core consumes
+        from ....ops import manipulation as _M
+        qkv_weights = [_M.transpose(_t(w), [1, 2, 3, 0])
+                       for w in qkv_weights]
+    if cache_kvs is not None or time_step is not None:
+        raise NotImplementedError(
+            "fused_multi_transformer cache_kvs/time_step decoding is not "
+            "wired in this build")
+    for i in range(n_layers):
+        h = fused_multi_head_attention(
+            h, qkv_weights[i], linear_weights[i],
+            pre_layer_norm=pre_layer_norm,
+            pre_ln_scale=ln_scales[i] if ln_scales else None,
+            pre_ln_bias=ln_biases[i] if ln_biases else None,
+            qkv_bias=qkv_biases[i] if qkv_biases else None,
+            linear_bias=linear_biases[i] if linear_biases else None,
+            attn_mask=attn_mask, dropout_rate=dropout_rate,
+            attn_dropout_rate=dropout_rate, training=training, mode=mode)
+        h = fused_feedforward(
+            h, ffn1_weights[i], ffn1_biases[i] if ffn1_biases else None,
+            ffn2_weights[i], ffn2_biases[i] if ffn2_biases else None,
+            ln1_scale=ffn_ln_scales[i] if ffn_ln_scales else None,
+            ln1_bias=ffn_ln_biases[i] if ffn_ln_biases else None,
+            dropout1_rate=dropout_rate, dropout2_rate=dropout_rate,
+            activation=activation, pre_layer_norm=pre_layer_norm,
+            training=training)
+    return h, cache_kvs
+
+
+__all__ += ["fused_matmul_bias", "fused_bias_dropout_residual_layer_norm",
+            "fused_multi_head_attention", "fused_multi_transformer"]

@@ -179,3 +179,48 @@ class FusedMultiTransformer(Layer):
 
 __all__ = ["FusedMultiHeadAttention", "FusedFeedForward",
            "FusedTransformerEncoderLayer", "FusedMultiTransformer"]
+
+
+class FusedLinear(Layer):
+    """Linear with the gemm-epilogue fused op (ref incubate FusedLinear)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 bias_attr=None, transpose_weight=False, name=None):
+        super().__init__()
+        shape = ([out_features, in_features] if transpose_weight
+                 else [in_features, out_features])
+        self.weight = self.create_parameter(shape, attr=weight_attr,
+                                            dtype="float32")
+        self.bias = (None if bias_attr is False else self.create_parameter(
+            [out_features], attr=bias_attr, dtype="float32", is_bias=True))
+        self.transpose_weight = transpose_weight
+
+    def forward(self, x):
+        from .. import functional as F
+        return F.fused_linear(x, self.weight, self.bias,
+                              transpose_weight=self.transpose_weight)
+
+
+class FusedBiasDropoutResidualLayerNorm(Layer):
+    """LN(residual + dropout(x + bias)) (ref incubate layer)."""
+
+    def __init__(self, embed_dim, dropout_rate=0.5, weight_attr=None,
+                 bias_attr=None, epsilon=1e-5, name=None):
+        super().__init__()
+        self.dropout_rate = dropout_rate
+        self.epsilon = epsilon
+        from ....nn import initializer as I
+        self.linear_bias = self.create_parameter([embed_dim],
+                                                 dtype="float32", is_bias=True)
+        self.ln_scale = self.create_parameter(
+            [embed_dim], attr=weight_attr, dtype="float32",
+            default_initializer=I.Constant(1.0))
+        self.ln_bias = self.create_parameter([embed_dim], attr=bias_attr,
+                                             dtype="float32", is_bias=True)
+
+    def forward(self, x, residual):
+        from .. import functional as F
+        return F.fused_bias_dropout_residual_layer_norm(
+            x, residual, self.linear_bias, self.ln_scale, self.ln_bias,
+            dropout_rate=self.dropout_rate, ln_epsilon=self.epsilon,
+            training=self.training)
