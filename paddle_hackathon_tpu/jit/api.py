@@ -30,6 +30,14 @@ class _TraceState(threading.local):
 _trace_state = _TraceState()
 
 
+def _trace_state_clean() -> bool:
+    """True when no jax trace is active (safe to enter our own jit)."""
+    try:
+        return jax.core.trace_state_clean()
+    except AttributeError:  # older/newer jax: conservative probe
+        return not isinstance(jnp.zeros(()) + 0, jax.core.Tracer)
+
+
 class InputSpec:
     """paddle.static.InputSpec equivalent."""
 
@@ -129,6 +137,29 @@ class StaticFunction:
     # -- execution ---------------------------------------------------------
     def __call__(self, *args):
         layer = self._layer
+        # Nested-trace transparency: when invoked inside another jax trace
+        # (e.g. a to_static layer used from a compiled train step /
+        # functional_call), inline the raw function into the enclosing trace
+        # instead of nesting jax.jit — nesting re-traces needlessly and a
+        # split of the global RNG under the outer trace would poison it with
+        # a tracer (the run_program op composes for the same reason in the
+        # reference). Detected from the trace state itself so raw-array /
+        # container / closure tracers are covered too.
+        if not _trace_state_clean():
+            if layer is None:
+                return self._raw_fn(*args)
+            # guard in-place buffer updates (BN stats): if the enclosing
+            # caller did not swap state (functional_call does), a traced
+            # update would corrupt the live layer — snapshot and drop any
+            # buffer value that became a tracer.
+            bufs = list(_buffer_tensors(layer))
+            saved = [b._value for b in bufs]
+            try:
+                return self._raw_fn(*args)
+            finally:
+                for b, old in zip(bufs, saved):
+                    if isinstance(b._value, jax.core.Tracer):
+                        b._value = old
         jitted, (param_keys, buffer_keys) = self.get_concrete_program(*args)
         if layer is not None:
             params, buffers = layer.functional_state()

@@ -1,0 +1,110 @@
+"""OpTest — the operator-testing workhorse, mirroring the reference's
+``python/paddle/fluid/tests/unittests/op_test.py:309``:
+
+- ``check_output``: run the framework op and compare against a NumPy
+  reference across dtypes (the reference compares against its CPU kernel /
+  numpy model across places).
+- ``check_grad``: central-difference numerical Jacobian-vector products vs
+  the tape's analytic gradients (ref ``check_grad`` :1861 — same
+  perturbation scheme: per-element eps with a max-relative-error gate).
+
+Usage::
+
+    class TestTanh(OpTest):
+        def setup(self):
+            self.op = paddle.tanh
+            self.inputs = {"x": np.random.rand(3, 4).astype("float32")}
+            self.ref = np.tanh
+
+    def test_tanh(): TestTanh().check_output(); TestTanh().check_grad(["x"])
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import paddle_hackathon_tpu as paddle
+
+
+class OpTest:
+    op = None            # callable taking Tensors (+ attrs)
+    inputs: dict = {}    # name -> np array (positional order preserved)
+    attrs: dict = {}     # keyword attrs for the op
+    ref = None           # numpy reference fn over the raw arrays
+
+    def __init__(self):
+        self.setup()
+
+    def setup(self):
+        raise NotImplementedError
+
+    # -- forward -----------------------------------------------------------
+    def _run_op(self, np_inputs):
+        tensors = [paddle.to_tensor(v, stop_gradient=False)
+                   for v in np_inputs.values()]
+        out = self.op(*tensors, **self.attrs)
+        return tensors, out
+
+    def check_output(self, rtol=1e-5, atol=1e-6):
+        _, out = self._run_op(self.inputs)
+        expect = self.ref(*self.inputs.values())
+        outs = out if isinstance(out, (tuple, list)) else [out]
+        expects = expect if isinstance(expect, (tuple, list)) else [expect]
+        assert len(outs) == len(expects), (
+            f"op produced {len(outs)} outputs, reference {len(expects)}")
+        for o, e in zip(outs, expects):
+            np.testing.assert_allclose(o.numpy(), e, rtol=rtol, atol=atol)
+
+    # -- backward ----------------------------------------------------------
+    def _analytic_grads(self, wrt, cotangent=None):
+        """Returns (grads dict, cotangent) — single forward+backward pass.
+        Multi-output ops are rejected (use per-output harnesses, as the
+        reference splits them into separate OpTests)."""
+        tensors, out = self._run_op(self.inputs)
+        if isinstance(out, (tuple, list)):
+            raise NotImplementedError(
+                "check_grad supports single-output ops; wrap the op to "
+                "select one output")
+        by_name = dict(zip(self.inputs.keys(), tensors))
+        if cotangent is None:
+            rng = np.random.RandomState(7)
+            cotangent = rng.uniform(0.5, 1.0, out.shape).astype(np.float64)
+        (out * paddle.to_tensor(cotangent.astype(np.float32))
+         ).sum().backward()
+        return {n: by_name[n].grad.numpy() for n in wrt}, cotangent
+
+    def _numeric_grad(self, name, cotangent, eps):
+        """Central differences of <cotangent, op(inputs)> w.r.t. inputs[name]
+        (exactly the reference's get_numeric_gradient loop)."""
+        base = {k: v.copy() for k, v in self.inputs.items()}
+        x = base[name]
+        grad = np.zeros_like(x, dtype=np.float64)
+        flat = x.reshape(-1)
+        gflat = grad.reshape(-1)
+
+        def scalar_loss():
+            _, out = self._run_op(base)
+            return float((out.numpy().astype(np.float64) * cotangent).sum())
+
+        for i in range(flat.size):
+            orig = flat[i]
+            flat[i] = orig + eps
+            hi = scalar_loss()
+            flat[i] = orig - eps
+            lo = scalar_loss()
+            flat[i] = orig
+            gflat[i] = (hi - lo) / (2 * eps)
+        return grad
+
+    def check_grad(self, inputs_to_check, max_relative_error=5e-3,
+                   eps=1e-3, numeric_grad_delta=None):
+        eps = numeric_grad_delta or eps
+        analytic, cotangent = self._analytic_grads(inputs_to_check)
+        for name in inputs_to_check:
+            numeric = self._numeric_grad(name, cotangent, eps)
+            a = analytic[name].astype(np.float64)
+            denom = np.maximum(np.abs(numeric), 1e-3)
+            rel = np.abs(a - numeric) / denom
+            assert rel.max() <= max_relative_error, (
+                f"grad check failed for {name!r}: max rel err {rel.max():.2e}"
+                f" > {max_relative_error:.2e}\nanalytic={a}\nnumeric={numeric}")

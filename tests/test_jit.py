@@ -149,3 +149,48 @@ def test_input_spec():
     t = paddle.randn([2, 3])
     s2 = jit.InputSpec.from_tensor(t)
     assert s2.shape == (2, 3)
+
+
+def test_to_static_layer_composes_with_compiled_train_step():
+    """A to_static-wrapped layer used inside another jax trace must inline
+    into the enclosing trace (regression: nested jit leaked a traced RNG
+    key into the global generator)."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_hackathon_tpu import parallel
+    from paddle_hackathon_tpu.core.tensor import Tensor
+    from paddle_hackathon_tpu.nn.layer import functional_call
+
+    paddle.seed(0)
+    net = nn.Sequential(nn.Conv2D(3, 4, 3), nn.BatchNorm2D(4), nn.ReLU(),
+                        nn.Flatten(), nn.Linear(4 * 36, 5))
+    x = paddle.to_tensor(
+        np.random.RandomState(0).randn(2, 3, 8, 8).astype(np.float32))
+    y = paddle.to_tensor(np.random.RandomState(1).randint(0, 5, (2,)))
+    net.eval()
+    sfn = jit.to_static(net)
+    with paddle.no_grad():
+        for _ in range(3):
+            out = sfn(x)
+    net.train()
+    mesh = parallel.create_mesh({"dp": 1}, devices=jax.devices()[:1])
+
+    def loss_fn(model, params, buffers, batch, rng_):
+        xb, yb = batch
+        logits = functional_call(model, params, (Tensor(xb),),
+                                 buffers=dict(buffers))
+        lp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
+        return -jnp.mean(jnp.take_along_axis(lp, yb[:, None], -1))
+
+    step, state = parallel.make_sharded_train_step(
+        net, mesh, rule=None, learning_rate=0.1, zero_stage=0,
+        loss_fn=loss_fn)
+    xb = jnp.asarray(x.numpy())
+    yb = jnp.asarray(y.numpy())
+    key = jax.random.key(0)
+    for i in range(2):
+        state, loss = step(state, xb, yb, jax.random.fold_in(key, i))
+    assert np.isfinite(float(loss))
+    # and the global generator is still usable afterwards
+    paddle.randn([2, 2]).numpy()

@@ -1,0 +1,109 @@
+"""Operator tests through the OpTest harness (the reference's per-op test
+pattern, SURVEY §4): forward vs NumPy reference + numerical-vs-analytic
+gradient checks."""
+
+import numpy as np
+from scipy import special as sps
+
+import paddle_hackathon_tpu as paddle
+from op_test import OpTest
+
+
+class TanhOp(OpTest):
+    def setup(self):
+        self.op = paddle.tanh
+        self.inputs = {"x": np.random.RandomState(0).uniform(
+            -2, 2, (3, 4)).astype("float32")}
+        self.ref = np.tanh
+
+
+class SigmoidOp(OpTest):
+    def setup(self):
+        self.op = paddle.nn.functional.sigmoid
+        self.inputs = {"x": np.random.RandomState(1).uniform(
+            -3, 3, (2, 5)).astype("float32")}
+        self.ref = sps.expit
+
+
+class MatmulOp(OpTest):
+    def setup(self):
+        self.op = paddle.matmul
+        rng = np.random.RandomState(2)
+        self.inputs = {"x": rng.rand(3, 4).astype("float32"),
+                       "y": rng.rand(4, 5).astype("float32")}
+        self.ref = np.matmul
+
+
+class LogSumExpOp(OpTest):
+    def setup(self):
+        self.op = paddle.logsumexp
+        self.inputs = {"x": np.random.RandomState(3).uniform(
+            -1, 1, (4, 3)).astype("float32")}
+        self.ref = lambda x: sps.logsumexp(x)
+
+
+class SoftmaxOp(OpTest):
+    def setup(self):
+        self.op = paddle.nn.functional.softmax
+        self.inputs = {"x": np.random.RandomState(4).uniform(
+            -2, 2, (3, 6)).astype("float32")}
+        self.ref = lambda x: sps.softmax(x, axis=-1)
+
+
+class StanhOp(OpTest):
+    def setup(self):
+        self.op = paddle.stanh
+        self.inputs = {"x": np.random.RandomState(5).uniform(
+            -2, 2, (8,)).astype("float32")}
+        self.ref = lambda x: 1.7159 * np.tanh(0.67 * x)
+
+
+class RenormGradOp(OpTest):
+    def setup(self):
+        self.op = paddle.renorm
+        self.attrs = {"p": 2.0, "axis": 1, "max_norm": 1.0}
+        self.inputs = {"x": np.random.RandomState(6).uniform(
+            0.5, 2, (2, 3, 2)).astype("float32")}
+
+        def ref(x):
+            norms = (np.abs(x) ** 2).sum(axis=(0, 2), keepdims=True) ** 0.5
+            factor = np.where(norms > 1.0, 1.0 / (norms + 1e-7), 1.0)
+            return x * factor
+        self.ref = ref
+
+
+def test_tanh_forward_and_grad():
+    TanhOp().check_output()
+    TanhOp().check_grad(["x"])
+
+
+def test_sigmoid_forward_and_grad():
+    SigmoidOp().check_output()
+    SigmoidOp().check_grad(["x"])
+
+
+def test_matmul_forward_and_grad_both_inputs():
+    MatmulOp().check_output(rtol=1e-4)
+    MatmulOp().check_grad(["x", "y"], max_relative_error=1e-2)
+
+
+def test_logsumexp_forward_and_grad():
+    LogSumExpOp().check_output(rtol=1e-4)
+    LogSumExpOp().check_grad(["x"], max_relative_error=1e-2)
+
+
+def test_softmax_forward_and_grad():
+    SoftmaxOp().check_output(rtol=1e-4)
+    # f32 central differences on softmax are noisy (tiny grads / roundoff);
+    # the reference whitelists softmax-family ops the same way
+    # (unittests/white_list/op_accuracy_white_list.py)
+    SoftmaxOp().check_grad(["x"], max_relative_error=5e-2)
+
+
+def test_stanh_forward_and_grad():
+    StanhOp().check_output(rtol=1e-4)
+    StanhOp().check_grad(["x"])
+
+
+def test_renorm_forward():
+    RenormGradOp().check_output(rtol=1e-4)
