@@ -88,13 +88,15 @@ define_flag("check_nan_inf", False,
             "Check outputs of every op for NaN/Inf (ref: FLAGS_check_nan_inf, "
             "eager/nan_inf_utils.cc).")
 define_flag("benchmark", False, "Sync after each op for timing (ref FLAGS_benchmark).")
-define_flag("flash_attention_min_seqlen", 2048,
+define_flag("flash_attention_min_seqlen", 1024,
             "Sequence length at which SDPA switches from the XLA softmax(QK)V "
             "composition to the Pallas flash kernel. Measured on v5e "
-            "(gpt2-small e2e train step, bf16): XLA wins at 1024 "
-            "(90k vs 58k tok/s, bs=16) by fusing attention into neighbors; "
-            "flash wins 1.8x at >=2048 and is the only path that fits "
-            "long sequences (O(S) memory vs O(S^2)).")
+            "(bf16, d=64 padded to 128, fwd+bwd): since the backward kernels "
+            "went bf16-MXU (pre-transposed standard contractions), flash wins "
+            "at 1024 (25.2 vs 29.0 ms microbench; 97.4k vs 96.0k tok/s "
+            "gpt2-small e2e), 1.4x at 2048, 2.9x at 4096, and is the only "
+            "path that fits long sequences (O(S) memory vs O(S^2)). "
+            "At <=512 the two paths tie (overhead-dominated).")
 define_flag("use_fused_kernels", True,
             "Use Pallas fused kernels (flash attention, fused layernorm) when "
             "available; falls back to pure-XLA compositions.")

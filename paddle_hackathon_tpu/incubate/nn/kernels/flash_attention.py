@@ -172,9 +172,20 @@ def _fwd_scratch(bq, d):
 # Backward
 # ---------------------------------------------------------------------------
 
-def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+def _bwd_dkdv_kernel(q_ref, qt_ref, k_ref, v_ref, do_ref, dot_ref,
+                     lse_ref, delta_ref,
                      dk_ref, dv_ref, dk_acc, dv_acc, *, sm_scale, causal,
                      block_q, block_kv, n_q):
+    """dk/dv in transposed (kv, q) layout.
+
+    Every contraction is a standard (1),(0) dot — the only shape Mosaic's
+    native bf16 matmul supports — by computing s^T = k @ q^T and feeding q
+    and dO both natural (block_q, d) and pre-transposed (d, block_q) from
+    XLA (the transposes are tiny next to the O(s^2) matmuls this replaces).
+    lse/delta arrive as (8, block_q) sublane-broadcast rows. bf16 operands
+    stay bf16 on the MXU (f32 accumulate); only softmax/elementwise math
+    is f32.
+    """
     ki = pl.program_id(1)
     qi = pl.program_id(2)
 
@@ -184,40 +195,39 @@ def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_acc[...] = jnp.zeros_like(dv_acc)
 
     def _body():
-        # f32 throughout: Mosaic's bf16 matmul rejects transposed
-        # contractions, and grads accumulate in f32 anyway
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
-        lse = lse_ref[0][:, :1]                 # (block_q, 1)
-        delta = delta_ref[0][:, :1]
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32,
-            precision=jax.lax.Precision.HIGHEST)
-        s = s * sm_scale
-        if causal:
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_kv), 0)
-            k_pos = ki * block_kv + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_kv), 1)
-            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
-        p = jnp.exp(s - lse)                    # (block_q, block_kv)
-        # dv += p^T @ dO
-        dv_acc[...] += jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-            precision=jax.lax.Precision.HIGHEST)
-        # dp = dO @ v^T ; ds = p * (dp - delta) * scale
-        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+        q = q_ref[0]                            # (block_q, d)
+        qt = qt_ref[0]                          # (d, block_q)
+        k = k_ref[0]                            # (block_kv, d)
+        v = v_ref[0]
+        do = do_ref[0]                          # (block_q, d)
+        dot_ = dot_ref[0]                       # (d, block_q) = dO^T
+        lse = lse_ref[0][:1, :]                 # (1, block_q)
+        delta = delta_ref[0][:1, :]
+        # s^T = (k @ q^T) * scale                 (block_kv, block_q)
+        st = jax.lax.dot_general(k, qt, (((1,), (0,)), ((), ())),
                                  preferred_element_type=jnp.float32,
-            precision=jax.lax.Precision.HIGHEST)
-        ds = p * (dp - delta) * sm_scale
-        # dk += ds^T @ q
+                                 precision=_prec(k.dtype))
+        st = st * sm_scale
+        if causal:
+            k_pos = ki * block_kv + jax.lax.broadcasted_iota(
+                jnp.int32, (block_kv, block_q), 0)
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_kv, block_q), 1)
+            st = jnp.where(q_pos >= k_pos, st, _NEG_INF)
+        pt = jnp.exp(st - lse)                  # (block_kv, block_q)
+        # dv += p^T @ dO                          (block_kv, d)
+        dv_acc[...] += jax.lax.dot_general(
+            pt.astype(v.dtype), do, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=_prec(v.dtype))
+        # dp^T = v @ dO^T                         (block_kv, block_q)
+        dpt = jax.lax.dot_general(v, dot_, (((1,), (0,)), ((), ())),
+                                  preferred_element_type=jnp.float32,
+                                  precision=_prec(v.dtype))
+        dst = pt * (dpt - delta) * sm_scale
+        # dk += ds^T @ q                          (block_kv, d)
         dk_acc[...] += jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-            precision=jax.lax.Precision.HIGHEST)
+            dst.astype(k.dtype), q, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=_prec(k.dtype))
 
     if causal:
         @pl.when(qi * block_q + block_q - 1 >= ki * block_kv)
@@ -232,9 +242,11 @@ def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
 
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+def _bwd_dq_kernel(q_ref, kt_ref, k_ref, vt_ref, do_ref, lse_ref, delta_ref,
                    dq_ref, dq_acc, *, sm_scale, causal, block_q, block_kv,
                    n_kv):
+    """dq in natural (q, kv) layout; k/v arrive pre-transposed (d, block_kv)
+    so every dot is a standard (1),(0) bf16 contraction (see dkdv kernel)."""
     qi = pl.program_id(1)
     ki = pl.program_id(2)
 
@@ -243,15 +255,16 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dq_acc[...] = jnp.zeros_like(dq_acc)
 
     def _body():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        q = q_ref[0]                            # (block_q, d)
+        kt = kt_ref[0]                          # (d, block_kv)
+        k = k_ref[0]                            # (block_kv, d)
+        vt = vt_ref[0]                          # (d, block_kv)
+        do = do_ref[0]                          # (block_q, d)
         lse = lse_ref[0][:, :1]
         delta = delta_ref[0][:, :1]
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+        s = jax.lax.dot_general(q, kt, (((1,), (0,)), ((), ())),
                                 preferred_element_type=jnp.float32,
-            precision=jax.lax.Precision.HIGHEST)
+                                precision=_prec(q.dtype))
         s = s * sm_scale
         if causal:
             q_pos = qi * block_q + jax.lax.broadcasted_iota(
@@ -260,14 +273,15 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 jnp.int32, (block_q, block_kv), 1)
             s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
         p = jnp.exp(s - lse)
-        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+        # dp = dO @ v^T                           (block_q, block_kv)
+        dp = jax.lax.dot_general(do, vt, (((1,), (0,)), ((), ())),
                                  preferred_element_type=jnp.float32,
-            precision=jax.lax.Precision.HIGHEST)
+                                 precision=_prec(do.dtype))
         ds = p * (dp - delta) * sm_scale
+        # dq += ds @ k                            (block_q, d)
         dq_acc[...] += jax.lax.dot_general(
-            ds, k, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-            precision=jax.lax.Precision.HIGHEST)
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=_prec(k.dtype))
 
     if causal:
         @pl.when(ki * block_kv <= qi * block_q + block_q - 1)
@@ -289,9 +303,18 @@ def _bwd(causal, sm_scale, res, do):
     n_q, n_kv = sq // bq, skv // bkv
     from jax.experimental.pallas import tpu as pltpu
 
-    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
-                    axis=-1)
-    delta = jnp.broadcast_to(delta[..., None], (bh, sq, _LANES))
+    delta_row = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                        axis=-1)                          # (bh, sq)
+    delta = jnp.broadcast_to(delta_row[..., None], (bh, sq, _LANES))
+    # (8, sq) sublane-broadcast rows for the transposed dkdv layout
+    _SUB = 8
+    lse_row = lse[:, :, 0]                                # (bh, sq)
+    lse_t = jnp.broadcast_to(lse_row[:, None, :], (bh, _SUB, sq))
+    delta_t = jnp.broadcast_to(delta_row[:, None, :], (bh, _SUB, sq))
+    qt = jnp.swapaxes(q, 1, 2)                            # (bh, d, sq)
+    dot_ = jnp.swapaxes(do, 1, 2)
+    kt = jnp.swapaxes(k, 1, 2)                            # (bh, d, skv)
+    vt = jnp.swapaxes(v, 1, 2)
 
     dkdv = functools.partial(
         _bwd_dkdv_kernel, sm_scale=sm_scale, causal=causal, block_q=bq,
@@ -301,11 +324,13 @@ def _bwd(causal, sm_scale, res, do):
         grid=(bh, n_kv, n_q),
         in_specs=[
             pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0)),    # q
+            pl.BlockSpec((1, d, bq), lambda b, j, i: (b, 0, i)),    # q^T
             pl.BlockSpec((1, bkv, d), lambda b, j, i: (b, j, 0)),   # k
             pl.BlockSpec((1, bkv, d), lambda b, j, i: (b, j, 0)),   # v
             pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0)),    # do
-            pl.BlockSpec((1, bq, _LANES), lambda b, j, i: (b, i, 0)),  # lse
-            pl.BlockSpec((1, bq, _LANES), lambda b, j, i: (b, i, 0)),  # delta
+            pl.BlockSpec((1, d, bq), lambda b, j, i: (b, 0, i)),    # do^T
+            pl.BlockSpec((1, _SUB, bq), lambda b, j, i: (b, 0, i)),  # lse^T
+            pl.BlockSpec((1, _SUB, bq), lambda b, j, i: (b, 0, i)),  # delta^T
         ],
         out_specs=[
             pl.BlockSpec((1, bkv, d), lambda b, j, i: (b, j, 0)),
@@ -320,7 +345,7 @@ def _bwd(causal, sm_scale, res, do):
             pltpu.VMEM((bkv, d), jnp.float32),
         ],
         interpret=_interpret(),
-    )(q, k, v, do, lse, delta)
+    )(q, qt, k, v, do, dot_, lse_t, delta_t)
 
     dqk = functools.partial(
         _bwd_dq_kernel, sm_scale=sm_scale, causal=causal, block_q=bq,
@@ -329,10 +354,11 @@ def _bwd(causal, sm_scale, res, do):
         dqk,
         grid=(bh, n_q, n_kv),
         in_specs=[
-            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, bkv, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, bkv, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),    # q
+            pl.BlockSpec((1, d, bkv), lambda b, i, j: (b, 0, j)),   # k^T
+            pl.BlockSpec((1, bkv, d), lambda b, i, j: (b, j, 0)),   # k
+            pl.BlockSpec((1, d, bkv), lambda b, i, j: (b, 0, j)),   # v^T
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),    # do
             pl.BlockSpec((1, bq, _LANES), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, bq, _LANES), lambda b, i, j: (b, i, 0)),
         ],
@@ -340,7 +366,7 @@ def _bwd(causal, sm_scale, res, do):
         out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
         interpret=_interpret(),
-    )(q, k, v, do, lse, delta)
+    )(q, kt, k, vt, do, lse, delta)
     return dq, dk, dv
 
 

@@ -23,17 +23,36 @@ def _reduce(out, reduction):
     return out
 
 
+def fused_softmax_ce_rows(logits, labels_i, axis=-1):
+    """Per-row -log softmax(logits)[label] as f32: logsumexp - gathered logit.
+
+    Gathering from the raw logits (not from a log-softmax array) lets XLA
+    fuse the logsumexp reduction into the logits producer instead of
+    materialising a full [rows, V] log-softmax — at LM vocab sizes that
+    buffer is the single largest HBM round-trip in the loss.
+    """
+    lse = jax.nn.logsumexp(logits.astype(jnp.float32), axis=axis)
+    tgt = jnp.take_along_axis(
+        logits, jnp.expand_dims(labels_i, axis), axis=axis
+    ).squeeze(axis).astype(jnp.float32)
+    return lse - tgt
+
+
 def cross_entropy(input, label, weight=None, ignore_index=-100,  # noqa: A002
                   reduction="mean", soft_label=False, axis=-1,
                   use_softmax=True, label_smoothing=0.0, name=None):
     """Softmax cross entropy (ref ``CrossEntropyWithSoftmaxKernel``).
 
-    Computed as log_softmax + gather — one fused XLA reduction chain, no
-    materialised softmax.
+    Hard labels use the fused logsumexp-gather form (f32 accumulation, no
+    materialised log-softmax); soft/smoothed labels need the full
+    log-probability matrix and keep the log_softmax composition.
     """
     def fn(logits, lbl, *rest):
-        lp = jax.nn.log_softmax(logits, axis=axis) if use_softmax else \
-            jnp.log(jnp.maximum(logits, 1e-30))
+        fused = use_softmax and not soft_label and label_smoothing == 0.0
+        lp = None
+        if not fused:
+            lp = jax.nn.log_softmax(logits, axis=axis) if use_softmax else \
+                jnp.log(jnp.maximum(logits, 1e-30))
         if soft_label:
             tgt = lbl
             if label_smoothing > 0.0:
@@ -42,28 +61,38 @@ def cross_entropy(input, label, weight=None, ignore_index=-100,  # noqa: A002
             loss = -jnp.sum(tgt * lp, axis=axis)
         else:
             lbl_i = lbl.astype(jnp.int32)
-            if lbl_i.ndim == lp.ndim:
+            if lbl_i.ndim == logits.ndim:
                 lbl_i = jnp.squeeze(lbl_i, axis=axis)
             if label_smoothing > 0.0:
                 k = lp.shape[axis]
                 onehot = jax.nn.one_hot(lbl_i, k, axis=axis, dtype=lp.dtype)
                 tgt = onehot * (1 - label_smoothing) + label_smoothing / k
                 loss = -jnp.sum(tgt * lp, axis=axis)
+            elif fused:
+                loss = fused_softmax_ce_rows(logits, lbl_i, axis=axis)
             else:
                 loss = -jnp.take_along_axis(
                     lp, jnp.expand_dims(lbl_i, axis), axis=axis
                 ).squeeze(axis)
+            # accumulate the masked sum / token count in f32 even when the
+            # logits (and lp) are bf16 — the reductions, not the per-row
+            # values, are where low-precision accumulation visibly drifts
+            loss = loss.astype(jnp.float32)
             mask = (lbl_i != ignore_index)
             loss = jnp.where(mask, loss, 0.0)
+            out_dtype = logits.dtype if jnp.issubdtype(
+                logits.dtype, jnp.floating) else loss.dtype
             if rest:
                 w = jnp.take(rest[0], jnp.maximum(lbl_i, 0), axis=0)
                 loss = loss * jnp.where(mask, w, 0.0)
                 if reduction == "mean":
-                    return jnp.sum(loss) / jnp.maximum(
-                        jnp.sum(jnp.where(mask, w, 0.0)), 1e-12)
+                    return (jnp.sum(loss) / jnp.maximum(
+                        jnp.sum(jnp.where(mask, w.astype(loss.dtype), 0.0)),
+                        1e-12)).astype(out_dtype)
             elif reduction == "mean":
-                return jnp.sum(loss) / jnp.maximum(
-                    jnp.sum(mask.astype(lp.dtype)), 1.0)
+                return (jnp.sum(loss) / jnp.maximum(
+                    jnp.sum(mask.astype(loss.dtype)), 1.0)).astype(out_dtype)
+            return _reduce(loss, reduction).astype(out_dtype)
         return _reduce(loss, reduction)
 
     args = [_t(input), _t(label)]

@@ -156,15 +156,9 @@ def make_sharded_train_step(model: Layer, mesh: Mesh,
             with core_random.rng_scope(rng):
                 logits = functional_call(model, params, (Tensor(ids),),
                                          buffers={k: v for k, v in buffers.items()})
-            # -log p(label) = logsumexp(logits) - logits[label]; gathering from
-            # the bf16 logits and reducing in f32 avoids materialising a full
-            # f32 log-softmax over the vocab (a [B*S, V] HBM round-trip — the
-            # single largest buffer in LM training at GPT vocab sizes).
+            from ..nn.functional.loss import fused_softmax_ce_rows
             lg = logits._value if isinstance(logits, Tensor) else logits
-            lse = jax.nn.logsumexp(lg.astype(jnp.float32), axis=-1)
-            tgt = jnp.take_along_axis(
-                lg, labels[..., None], axis=-1)[..., 0].astype(jnp.float32)
-            return jnp.mean(lse - tgt)
+            return jnp.mean(fused_softmax_ce_rows(lg, labels))
 
     b1, b2, eps = 0.9, 0.95, 1e-8
 
