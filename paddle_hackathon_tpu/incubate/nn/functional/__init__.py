@@ -36,13 +36,6 @@ def _dropout(h, rate, key, mode="upscale_in_train"):
     return jnp.where(keep, h, 0.0).astype(h.dtype)
 
 
-def _pad_lanes(x, d):
-    pad = (-d) % 128
-    if pad:
-        x = jnp.pad(x, ((0, 0), (0, 0), (0, pad)))
-    return x
-
-
 def flash_attention_bshd(query, key, value, causal=False, sm_scale=None):
     """Flash attention over paddle-layout (batch, seq, heads, head_dim).
 
@@ -56,13 +49,15 @@ def flash_attention_bshd(query, key, value, causal=False, sm_scale=None):
 
     def fn(q, k, v):
         def to_bhd(x, s):
+            # no explicit lane padding: Mosaic pads d<128 in-register, and an
+            # explicit pad materialises 2x HBM copies of q/k/v (measured -8%
+            # e2e on gpt2-small); odd head dims (80/96/256) verified native
             x = jnp.swapaxes(x, 1, 2)           # b h s d
-            x = x.reshape(b * h, s, d)
-            return _pad_lanes(x, d)
+            return x.reshape(b * h, s, d)
 
         out = _fa.flash_attention_bhd(
             to_bhd(q, sq), to_bhd(k, skv), to_bhd(v, skv), causal, scale)
-        out = out[:, :, :d].reshape(b, h, sq, d)
+        out = out.reshape(b, h, sq, d)
         return jnp.swapaxes(out, 1, 2)          # b s h d
 
     return apply_op("flash_attention", fn, [_t(query), _t(key), _t(value)])

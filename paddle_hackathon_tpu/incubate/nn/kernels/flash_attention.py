@@ -8,8 +8,9 @@ framework's fused-attention slot is filled with an online-softmax tiled
 kernel instead: O(s) memory, MXU-shaped (block_q x d) @ (d x block_kv)
 tiles, f32 accumulators in VMEM scratch.
 
-Layout contract: (batch*heads, seq, head_dim) arrays, head_dim padded to a
-lane multiple (128) by the caller. Gradients follow the standard two-kernel
+Layout contract: (batch*heads, seq, head_dim) arrays; head_dim needs no
+explicit lane padding (Mosaic pads sub-128 lanes in VMEM; explicit padding
+would cost real HBM copies). Gradients follow the standard two-kernel
 split (dk/dv accumulate over q blocks; dq accumulates over kv blocks) with
 the log-sum-exp saved from the forward pass and ``delta = rowsum(dO * O)``
 precomputed in XLA.
@@ -28,6 +29,7 @@ from jax.experimental import pallas as pl
 
 _NEG_INF = -1e30  # large-but-finite: keeps exp()=0 without inf-inf NaNs
 _LANES = 128
+_SUB = 8  # sublane count of the (8, seq) stats (lse/delta) layout
 
 
 def _prec(dtype):
@@ -42,16 +44,19 @@ def _interpret() -> bool:
     return jax.default_backend() not in ("tpu", "axon")
 
 
-def _block_sizes(sq: int, skv: int):
-    """Pick (block_q, block_kv). Measured on v5e (fwd+bwd, bf16, d=64):
-    (1024, 512) is ~1.6x faster than (128, 128) — bigger q blocks amortize
-    the kv streaming, bigger kv blocks cut grid/copy overhead. VMEM at
-    (1024, 512): s/p blocks 2 MB f32 each + accumulators ≈ 6 MB, well
-    under the ~16 MB budget."""
+def _block_sizes(sq: int, skv: int, dtype=jnp.bfloat16):
+    """Pick (block_q, block_kv). Swept on v5e (fwd+bwd, bf16, d=64,
+    B*H=288): square 1024x1024 blocks win at every seq length that admits
+    them — 12.9 ms vs 19.5 for (1024,512) at S=1024, 23.7 vs 25.8 at
+    S=4096. Wider blocks blow the 16 MB scoped-VMEM budget (the s/p
+    temporaries are f32 (bq, bkv): 4 MB at 1024^2); with f32 *operands*
+    the backward's doubled input blocks push a 1024^2 grid cell past the
+    budget too, so f32 caps at 512."""
+    cap = 1024 if jnp.dtype(dtype).itemsize <= 2 else 512
     bq = next((b for b in (1024, 512, 256, 128, 64, 32, 16, 8)
-               if b <= sq and sq % b == 0), None)
-    bkv = next((b for b in (512, 256, 128, 64, 32, 16, 8)
-                if b <= skv and skv % b == 0), None)
+               if b <= min(sq, cap) and sq % b == 0), None)
+    bkv = next((b for b in (1024, 512, 256, 128, 64, 32, 16, 8)
+                if b <= min(skv, cap) and skv % b == 0), None)
     if bq is None or bkv is None:
         return None
     return bq, bkv
@@ -122,15 +127,18 @@ def _fwd_kernel(q_ref, kt_ref, v_ref, o_ref, lse_ref,
         l = l_ref[:, :1]
         l = jnp.where(l == 0.0, 1.0, l)  # fully-masked row -> zeros out
         o_ref[0] = (acc_ref[...] / l).astype(o_ref.dtype)
-        # all lanes of m/l are identical; store lse lane-broadcast so the
-        # block keeps TPU-legal (sublane, lane) = (block_q, 128) tiling
-        lse_ref[0] = m_ref[...] + jnp.log(jnp.maximum(l_ref[...], 1e-30))
+        # store lse transposed as (8, block_q) sublane-broadcast rows: a
+        # (bh, 8, sq) stats array costs 8 f32 lanes per token in HBM where
+        # the old lane-broadcast (bh, sq, 128) layout cost 128 — at
+        # B*H=288, S=1024 that is 9.4 MB vs 151 MB of residual per layer
+        lse2d = m_ref[...] + jnp.log(jnp.maximum(l_ref[...], 1e-30))
+        lse_ref[0] = jnp.swapaxes(lse2d[:, :_SUB], 0, 1)
 
 
 def _fwd(q, k, v, causal, sm_scale):
     bh, sq, d = q.shape
     skv = k.shape[1]
-    bq, bkv = _block_sizes(sq, skv)
+    bq, bkv = _block_sizes(sq, skv, q.dtype)
     n_q, n_kv = sq // bq, skv // bkv
 
     kernel = functools.partial(
@@ -147,11 +155,11 @@ def _fwd(q, k, v, causal, sm_scale):
         ],
         out_specs=[
             pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, bq, _LANES), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, _SUB, bq), lambda b, i, j: (b, 0, i)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, sq, _LANES), jnp.float32),
+            jax.ShapeDtypeStruct((bh, _SUB, sq), jnp.float32),
         ],
         scratch_shapes=_fwd_scratch(bq, d),
         interpret=_interpret(),
@@ -246,7 +254,9 @@ def _bwd_dq_kernel(q_ref, kt_ref, k_ref, vt_ref, do_ref, lse_ref, delta_ref,
                    dq_ref, dq_acc, *, sm_scale, causal, block_q, block_kv,
                    n_kv):
     """dq in natural (q, kv) layout; k/v arrive pre-transposed (d, block_kv)
-    so every dot is a standard (1),(0) bf16 contraction (see dkdv kernel)."""
+    so every dot is a standard (1),(0) bf16 contraction (see dkdv kernel).
+    lse/delta arrive in the (8, block_q) stats layout and are transposed to
+    a (block_q, 1) column in-VMEM (a cheap sublane/lane swap)."""
     qi = pl.program_id(1)
     ki = pl.program_id(2)
 
@@ -260,8 +270,8 @@ def _bwd_dq_kernel(q_ref, kt_ref, k_ref, vt_ref, do_ref, lse_ref, delta_ref,
         k = k_ref[0]                            # (block_kv, d)
         vt = vt_ref[0]                          # (d, block_kv)
         do = do_ref[0]                          # (block_q, d)
-        lse = lse_ref[0][:, :1]
-        delta = delta_ref[0][:, :1]
+        lse = jnp.swapaxes(lse_ref[0], 0, 1)[:, :1]     # (block_q, 1)
+        delta = jnp.swapaxes(delta_ref[0], 0, 1)[:, :1]
         s = jax.lax.dot_general(q, kt, (((1,), (0,)), ((), ())),
                                 preferred_element_type=jnp.float32,
                                 precision=_prec(q.dtype))
@@ -299,18 +309,14 @@ def _bwd(causal, sm_scale, res, do):
     q, k, v, out, lse = res
     bh, sq, d = q.shape
     skv = k.shape[1]
-    bq, bkv = _block_sizes(sq, skv)
+    bq, bkv = _block_sizes(sq, skv, q.dtype)
     n_q, n_kv = sq // bq, skv // bkv
     from jax.experimental.pallas import tpu as pltpu
 
     delta_row = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
                         axis=-1)                          # (bh, sq)
-    delta = jnp.broadcast_to(delta_row[..., None], (bh, sq, _LANES))
-    # (8, sq) sublane-broadcast rows for the transposed dkdv layout
-    _SUB = 8
-    lse_row = lse[:, :, 0]                                # (bh, sq)
-    lse_t = jnp.broadcast_to(lse_row[:, None, :], (bh, _SUB, sq))
     delta_t = jnp.broadcast_to(delta_row[:, None, :], (bh, _SUB, sq))
+    lse_t = lse                                           # (bh, 8, sq) from fwd
     qt = jnp.swapaxes(q, 1, 2)                            # (bh, d, sq)
     dot_ = jnp.swapaxes(do, 1, 2)
     kt = jnp.swapaxes(k, 1, 2)                            # (bh, d, skv)
@@ -359,14 +365,14 @@ def _bwd(causal, sm_scale, res, do):
             pl.BlockSpec((1, bkv, d), lambda b, i, j: (b, j, 0)),   # k
             pl.BlockSpec((1, d, bkv), lambda b, i, j: (b, 0, j)),   # v^T
             pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),    # do
-            pl.BlockSpec((1, bq, _LANES), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, bq, _LANES), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, _SUB, bq), lambda b, i, j: (b, 0, i)),  # lse
+            pl.BlockSpec((1, _SUB, bq), lambda b, i, j: (b, 0, i)),  # delta
         ],
         out_specs=pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
         interpret=_interpret(),
-    )(q, kt, k, vt, do, lse, delta)
+    )(q, kt, k, vt, do, lse_t, delta_t)
     return dq, dk, dv
 
 
