@@ -513,59 +513,29 @@ class Independent(Distribution):
         return self._base.variance
 
 
-class Transform:
-    """Bijective transform base (minimal surface used by
-    TransformedDistribution; ref distribution/transform.py)."""
-
-    def forward(self, x):
-        raise NotImplementedError
-
-    def inverse(self, y):
-        raise NotImplementedError
-
-    def forward_log_det_jacobian(self, x):
-        raise NotImplementedError
-
-
-class AffineTransform(Transform):
-    def __init__(self, loc, scale):
-        self.loc = _t(loc)
-        self.scale = _t(scale)
-
-    def forward(self, x):
-        return apply_op("affine_fwd", lambda v, l, s: v * s + l,
-                        [_t(x), self.loc, self.scale])
-
-    def inverse(self, y):
-        return apply_op("affine_inv", lambda v, l, s: (v - l) / s,
-                        [_t(y), self.loc, self.scale])
-
-    def forward_log_det_jacobian(self, x):
-        return apply_op("affine_ldj",
-                        lambda v, s: jnp.broadcast_to(jnp.log(jnp.abs(s)),
-                                                      v.shape),
-                        [_t(x), self.scale])
-
-
-class ExpTransform(Transform):
-    def forward(self, x):
-        return apply_op("exp_fwd", jnp.exp, [_t(x)])
-
-    def inverse(self, y):
-        return apply_op("exp_inv", jnp.log, [_t(y)])
-
-    def forward_log_det_jacobian(self, x):
-        return _t(x)
-
-
 class TransformedDistribution(Distribution):
     """Pushforward of a base distribution through transforms
     (ref distribution/transformed_distribution.py)."""
 
     def __init__(self, base, transforms):
+        from .transform import Transform, ChainTransform
+        if isinstance(transforms, Transform):
+            transforms = [transforms]
+        if not all(isinstance(t, Transform) for t in transforms):
+            raise TypeError("transforms must be Transform instances")
         self._base = base
         self._transforms = list(transforms)
-        super().__init__(base.batch_shape, base.event_shape)
+        chain = ChainTransform(self._transforms) if self._transforms else None
+        base_shape = tuple(base.batch_shape) + tuple(base.event_shape)
+        if chain and len(base_shape) < chain._domain.event_rank:
+            raise ValueError(
+                f"base distribution rank {len(base_shape)} is smaller than "
+                f"the chain's domain event rank {chain._domain.event_rank}")
+        shape = chain.forward_shape(base_shape) if chain else base_shape
+        event_rank = max(len(base.event_shape),
+                         chain._codomain.event_rank if chain else 0)
+        super().__init__(shape[:len(shape) - event_rank],
+                         shape[len(shape) - event_rank:])
 
     def sample(self, shape=()):
         x = self._base.sample(shape)
@@ -580,16 +550,34 @@ class TransformedDistribution(Distribution):
         return x
 
     def log_prob(self, value):
+        from .transform import _sum_rightmost
+
         lp = None
         y = _t(value)
+        event_rank = len(self.event_shape)
         for t in reversed(self._transforms):
             x = t.inverse(y)
-            ldj = t.forward_log_det_jacobian(x)
+            event_rank += t._domain.event_rank - t._codomain.event_rank
+            ldj = _sum_rightmost(t.forward_log_det_jacobian(x),
+                                 event_rank - t._domain.event_rank)
             lp = ldj if lp is None else lp + ldj
             y = x
-        base_lp = self._base.log_prob(y)
+        base_lp = _sum_rightmost(self._base.log_prob(y),
+                                 event_rank - len(self._base.event_shape))
         return base_lp - lp if lp is not None else base_lp
 
 
+from . import constraint, variable  # noqa: E402
+from .transform import (  # noqa: E402
+    Transform, AbsTransform, AffineTransform, ChainTransform, ExpTransform,
+    IndependentTransform, PowerTransform, ReshapeTransform, SigmoidTransform,
+    SoftmaxTransform, StackTransform, StickBreakingTransform, TanhTransform)
+
+Lognormal = LogNormal  # reference exports both spellings
+
 __all__ += ["ExponentialFamily", "Independent", "TransformedDistribution",
-            "Transform", "AffineTransform", "ExpTransform"]
+            "Transform", "AbsTransform", "AffineTransform", "ChainTransform",
+            "ExpTransform", "IndependentTransform", "PowerTransform",
+            "ReshapeTransform", "SigmoidTransform", "SoftmaxTransform",
+            "StackTransform", "StickBreakingTransform", "TanhTransform",
+            "Lognormal", "constraint", "variable"]

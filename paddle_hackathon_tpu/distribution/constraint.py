@@ -1,0 +1,56 @@
+"""Value constraints for random variables (ref
+``python/paddle/distribution/constraint.py:17-52``)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.autograd import apply_op
+from ..core.tensor import Tensor
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+
+
+class Constraint:
+    """Constraint condition for random variable (ref ``constraint.py:17``)."""
+
+    def __call__(self, value):
+        raise NotImplementedError
+
+
+class Real(Constraint):
+    def __call__(self, value):
+        return apply_op("constraint_real", lambda v: v == v, [_t(value)])
+
+
+class Range(Constraint):
+    def __init__(self, lower, upper):
+        self._lower = lower
+        self._upper = upper
+        super().__init__()
+
+    def __call__(self, value):
+        return apply_op(
+            "constraint_range",
+            lambda v: (self._lower <= v) & (v <= self._upper), [_t(value)])
+
+
+class Positive(Constraint):
+    def __call__(self, value):
+        return apply_op("constraint_positive", lambda v: v >= 0.0,
+                        [_t(value)])
+
+
+class Simplex(Constraint):
+    def __call__(self, value):
+        def fn(v):
+            return jnp.all(v >= 0, axis=-1) & (
+                jnp.abs(jnp.sum(v, axis=-1) - 1.0) < 1e-6)
+        return apply_op("constraint_simplex", fn, [_t(value)])
+
+
+real = Real()
+positive = Positive()
+simplex = Simplex()

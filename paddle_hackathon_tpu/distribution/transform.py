@@ -1,0 +1,696 @@
+"""Random-variable transformations (ref
+``python/paddle/distribution/transform.py:35-1266``).
+
+Each ``Transform`` maps a random variable through a function with a
+tractable log-det-Jacobian, the building block of
+``TransformedDistribution``.  The full reference family is implemented:
+Abs, Affine, Chain, Exp, Independent, Power, Reshape, Sigmoid, Softmax,
+Stack, StickBreaking, Tanh.  Math runs on jax through the framework's
+taped ``apply_op`` so transforms are differentiable in eager mode.
+"""
+
+from __future__ import annotations
+
+import enum
+import functools
+import math
+import operator
+
+import jax
+import jax.numpy as jnp
+
+from ..core.autograd import apply_op
+from ..core.tensor import Tensor
+from . import constraint, variable
+
+__all__ = [
+    'Transform', 'AbsTransform', 'AffineTransform', 'ChainTransform',
+    'ExpTransform', 'IndependentTransform', 'PowerTransform',
+    'ReshapeTransform', 'SigmoidTransform', 'SoftmaxTransform',
+    'StackTransform', 'StickBreakingTransform', 'TanhTransform',
+]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+
+
+def _op(name, fn, *tensors):
+    return apply_op(name, fn, [_t(x) for x in tensors])
+
+
+def _sum_rightmost(value, n):
+    """Sum the rightmost ``n`` axes (shared by ChainTransform and
+    TransformedDistribution.log_prob)."""
+    if n <= 0:
+        return _t(value)
+    return _op("sum_rightmost",
+               lambda v: jnp.sum(v, axis=tuple(range(-n, 0))), value)
+
+
+class Type(enum.Enum):
+    """Mapping type of a transformation (ref ``transform.py:35``)."""
+    BIJECTION = 'bijection'      # bijective (injective and surjective)
+    INJECTION = 'injection'      # injective only
+    SURJECTION = 'surjection'    # surjective only
+    OTHER = 'other'              # general
+
+    @classmethod
+    def is_injective(cls, _type):
+        return _type in (cls.BIJECTION, cls.INJECTION)
+
+
+class Transform:
+    r"""Base class for transformations of random variables
+    (ref ``transform.py:50``).
+
+    Subclasses implement ``_forward``/``_inverse`` and one of
+    ``_forward_log_det_jacobian`` / ``_inverse_log_det_jacobian``; the
+    public methods derive the other direction.
+    """
+
+    _type = Type.INJECTION
+
+    @classmethod
+    def _is_injective(cls):
+        return Type.is_injective(cls._type)
+
+    def __call__(self, input):  # noqa: A002
+        """Apply as a function: a Distribution input builds a
+        TransformedDistribution, a Transform composes a chain."""
+        from . import Distribution, TransformedDistribution
+        if isinstance(input, Distribution):
+            return TransformedDistribution(input, [self])
+        if isinstance(input, Transform):
+            return ChainTransform([self, input])
+        return self.forward(_t(input))
+
+    def forward(self, x):
+        """y = f(x)."""
+        return self._forward(_t(x))
+
+    def inverse(self, y):
+        """x = f^{-1}(y)."""
+        return self._inverse(_t(y))
+
+    def forward_log_det_jacobian(self, x):
+        """log|det J_f(x)|."""
+        if not self._is_injective():
+            raise NotImplementedError(
+                "forward_log_det_jacobian is only defined for injective "
+                "transforms")
+        x = _t(x)
+        if hasattr(type(self), '_forward_log_det_jacobian') and \
+                type(self)._forward_log_det_jacobian is not \
+                Transform._forward_log_det_jacobian:
+            return self._forward_log_det_jacobian(x)
+        return -self._inverse_log_det_jacobian(self.forward(x))
+
+    def inverse_log_det_jacobian(self, y):
+        """log|det J_{f^{-1}}(y)| = -log|det J_f(f^{-1}(y))|."""
+        y = _t(y)
+        if hasattr(type(self), '_inverse_log_det_jacobian') and \
+                type(self)._inverse_log_det_jacobian is not \
+                Transform._inverse_log_det_jacobian:
+            return self._inverse_log_det_jacobian(y)
+        return -self.forward_log_det_jacobian(self.inverse(y))
+
+    def forward_shape(self, shape):
+        """Shape of forward(x) given shape of x."""
+        return self._forward_shape(tuple(shape))
+
+    def inverse_shape(self, shape):
+        return self._inverse_shape(tuple(shape))
+
+    @property
+    def _domain(self):
+        return variable.real
+
+    @property
+    def _codomain(self):
+        return variable.real
+
+    def _forward(self, x):
+        raise NotImplementedError
+
+    def _inverse(self, y):
+        raise NotImplementedError
+
+    def _forward_log_det_jacobian(self, x):
+        raise NotImplementedError(
+            f'{type(self).__name__} implements neither '
+            '_forward_log_det_jacobian nor _inverse_log_det_jacobian')
+
+    def _inverse_log_det_jacobian(self, y):
+        raise NotImplementedError(
+            f'{type(self).__name__} implements neither '
+            '_forward_log_det_jacobian nor _inverse_log_det_jacobian')
+
+    def _forward_shape(self, shape):
+        return shape
+
+    def _inverse_shape(self, shape):
+        return shape
+
+
+class AbsTransform(Transform):
+    r"""y = |x| — surjective onto [0, inf); ``inverse`` returns the set
+    inverse ``(-y, y)`` (ref ``transform.py:318``)."""
+
+    _type = Type.SURJECTION
+
+    def _forward(self, x):
+        return _op("abs_fwd", jnp.abs, x)
+
+    def _inverse(self, y):
+        return _op("abs_inv_neg", operator.neg, y), _t(y)
+
+    def _inverse_log_det_jacobian(self, y):
+        zero = _op("abs_ildj", lambda v: jnp.zeros((1,), v.dtype), y)
+        return zero, zero
+
+    @property
+    def _domain(self):
+        return variable.real
+
+    @property
+    def _codomain(self):
+        return variable.positive
+
+
+class AffineTransform(Transform):
+    r"""y = loc + scale * x (ref ``transform.py:390``)."""
+
+    _type = Type.BIJECTION
+
+    def __init__(self, loc, scale):
+        self._loc = _t(loc)
+        self._scale = _t(scale)
+        super().__init__()
+
+    @property
+    def loc(self):
+        return self._loc
+
+    @property
+    def scale(self):
+        return self._scale
+
+    def _forward(self, x):
+        return _op("affine_fwd", lambda v, l, s: l + s * v,
+                   x, self._loc, self._scale)
+
+    def _inverse(self, y):
+        return _op("affine_inv", lambda v, l, s: (v - l) / s,
+                   y, self._loc, self._scale)
+
+    def _forward_log_det_jacobian(self, x):
+        return _op("affine_fldj",
+                   lambda v, s: jnp.broadcast_to(jnp.log(jnp.abs(s)),
+                                                 jnp.broadcast_shapes(
+                                                     v.shape, s.shape)),
+                   x, self._scale)
+
+    def _broadcast(self, shape):
+        return tuple(jnp.broadcast_shapes(
+            tuple(shape), tuple(self._loc.shape), tuple(self._scale.shape)))
+
+    def _forward_shape(self, shape):
+        return self._broadcast(shape)
+
+    def _inverse_shape(self, shape):
+        return self._broadcast(shape)
+
+    @property
+    def _domain(self):
+        return variable.real
+
+    @property
+    def _codomain(self):
+        return variable.real
+
+
+class ChainTransform(Transform):
+    r"""Composition of transforms, applied left-to-right
+    (ref ``transform.py:467``)."""
+
+    def __init__(self, transforms):
+        if not isinstance(transforms, (list, tuple)) or not all(
+                isinstance(t, Transform) for t in transforms):
+            raise TypeError(
+                "transforms must be a list/tuple of Transform instances")
+        self.transforms = tuple(transforms)
+        super().__init__()
+
+    def _is_injective(self):
+        return all(t._is_injective() for t in self.transforms)
+
+    def _forward(self, x):
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def _inverse(self, y):
+        for t in reversed(self.transforms):
+            y = t.inverse(y)
+        return y
+
+    def _forward_log_det_jacobian(self, x):
+        value = 0.0
+        event_rank = self._domain.event_rank
+        for t in self.transforms:
+            value = value + _sum_rightmost(
+                t.forward_log_det_jacobian(x),
+                event_rank - t._domain.event_rank)
+            x = t.forward(x)
+            event_rank += t._codomain.event_rank - t._domain.event_rank
+        return value
+
+
+
+    def _forward_shape(self, shape):
+        for t in self.transforms:
+            shape = t.forward_shape(shape)
+        return shape
+
+    def _inverse_shape(self, shape):
+        for t in reversed(self.transforms):
+            shape = t.inverse_shape(shape)
+        return shape
+
+    @property
+    def _domain(self):
+        domain = self.transforms[0]._domain
+        # the chain's domain event rank is the max lift any suffix needs
+        event_rank = domain.event_rank
+        for t in reversed(self.transforms):
+            event_rank += t._domain.event_rank - t._codomain.event_rank
+            event_rank = max(event_rank, t._domain.event_rank)
+        return variable.Independent(
+            domain, event_rank - domain.event_rank) \
+            if event_rank > domain.event_rank else domain
+
+    @property
+    def _codomain(self):
+        return self.transforms[-1]._codomain
+
+
+class ExpTransform(Transform):
+    r"""y = exp(x) (ref ``transform.py:590``)."""
+
+    _type = Type.BIJECTION
+
+    def _forward(self, x):
+        return _op("exp_fwd", jnp.exp, x)
+
+    def _inverse(self, y):
+        return _op("exp_inv", jnp.log, y)
+
+    def _forward_log_det_jacobian(self, x):
+        return _t(x)
+
+    @property
+    def _domain(self):
+        return variable.real
+
+    @property
+    def _codomain(self):
+        return variable.positive
+
+
+class IndependentTransform(Transform):
+    r"""Wraps a base transform, reinterpreting the ``reinterpreted_batch_rank``
+    rightmost batch axes as event axes: the log-det-Jacobian sums over them
+    (ref ``transform.py:639``)."""
+
+    def __init__(self, base, reinterpreted_batch_rank):
+        if not isinstance(base, Transform):
+            raise TypeError(
+                f"Expected 'base' is Transform type, but got {type(base)}")
+        if reinterpreted_batch_rank <= 0:
+            raise ValueError(
+                "Expected 'reinterpreted_batch_rank' greater than zero, "
+                f"but got {reinterpreted_batch_rank}")
+        self._base = base
+        self._reinterpreted_batch_rank = reinterpreted_batch_rank
+        super().__init__()
+
+    def _is_injective(self):
+        return self._base._is_injective()
+
+    def _forward(self, x):
+        x = _t(x)
+        if x.ndim < self._domain.event_rank:
+            raise ValueError("input rank is less than the event rank")
+        return self._base.forward(x)
+
+    def _inverse(self, y):
+        y = _t(y)
+        if y.ndim < self._codomain.event_rank:
+            raise ValueError("input rank is less than the event rank")
+        return self._base.inverse(y)
+
+    def _forward_log_det_jacobian(self, x):
+        ldj = self._base.forward_log_det_jacobian(x)
+        n = self._reinterpreted_batch_rank
+        return _op("independent_fldj",
+                   lambda v: jnp.sum(v, axis=tuple(range(-n, 0))), ldj)
+
+    def _forward_shape(self, shape):
+        return self._base.forward_shape(shape)
+
+    def _inverse_shape(self, shape):
+        return self._base.inverse_shape(shape)
+
+    @property
+    def _domain(self):
+        return variable.Independent(self._base._domain,
+                                    self._reinterpreted_batch_rank)
+
+    @property
+    def _codomain(self):
+        return variable.Independent(self._base._codomain,
+                                    self._reinterpreted_batch_rank)
+
+
+class PowerTransform(Transform):
+    r"""y = x^power (ref ``transform.py:730``)."""
+
+    _type = Type.BIJECTION
+
+    def __init__(self, power):
+        self._power = _t(power)
+        super().__init__()
+
+    @property
+    def power(self):
+        return self._power
+
+    def _forward(self, x):
+        return _op("power_fwd", lambda v, p: jnp.power(v, p), x, self._power)
+
+    def _inverse(self, y):
+        return _op("power_inv", lambda v, p: jnp.power(v, 1.0 / p),
+                   y, self._power)
+
+    def _forward_log_det_jacobian(self, x):
+        return _op("power_fldj",
+                   lambda v, p: jnp.log(jnp.abs(p * jnp.power(v, p - 1))),
+                   x, self._power)
+
+    def _forward_shape(self, shape):
+        return tuple(jnp.broadcast_shapes(tuple(shape),
+                                          tuple(self._power.shape)))
+
+    def _inverse_shape(self, shape):
+        return tuple(jnp.broadcast_shapes(tuple(shape),
+                                          tuple(self._power.shape)))
+
+    @property
+    def _domain(self):
+        return variable.real
+
+    @property
+    def _codomain(self):
+        return variable.positive
+
+
+class ReshapeTransform(Transform):
+    r"""Reshapes the event shape (ref ``transform.py:793``)."""
+
+    _type = Type.BIJECTION
+
+    def __init__(self, in_event_shape, out_event_shape):
+        if not isinstance(in_event_shape, (list, tuple)) or \
+                not isinstance(out_event_shape, (list, tuple)):
+            raise TypeError("event shapes must be list or tuple")
+        if functools.reduce(operator.mul, in_event_shape, 1) != \
+                functools.reduce(operator.mul, out_event_shape, 1):
+            raise ValueError(
+                f"in_event_shape {in_event_shape} and out_event_shape "
+                f"{out_event_shape} have different numbers of elements")
+        self._in_event_shape = tuple(in_event_shape)
+        self._out_event_shape = tuple(out_event_shape)
+        super().__init__()
+
+    @property
+    def in_event_shape(self):
+        return self._in_event_shape
+
+    @property
+    def out_event_shape(self):
+        return self._out_event_shape
+
+    def _forward(self, x):
+        out_shape = tuple(_t(x).shape[:_t(x).ndim - len(
+            self._in_event_shape)]) + self._out_event_shape
+        return _op("reshape_fwd", lambda v: jnp.reshape(v, out_shape), x)
+
+    def _inverse(self, y):
+        in_shape = tuple(_t(y).shape[:_t(y).ndim - len(
+            self._out_event_shape)]) + self._in_event_shape
+        return _op("reshape_inv", lambda v: jnp.reshape(v, in_shape), y)
+
+    def _forward_log_det_jacobian(self, x):
+        batch = tuple(_t(x).shape[:_t(x).ndim - len(self._in_event_shape)])
+        return _op("reshape_fldj",
+                   lambda v: jnp.zeros(batch, dtype=v.dtype), x)
+
+    def _forward_shape(self, shape):
+        if len(shape) < len(self._in_event_shape):
+            raise ValueError("shape rank is smaller than in_event_shape rank")
+        if tuple(shape[len(shape) - len(self._in_event_shape):]) != \
+                self._in_event_shape:
+            raise ValueError(
+                f"shape suffix {shape} does not match in_event_shape "
+                f"{self._in_event_shape}")
+        return tuple(shape[:len(shape) - len(self._in_event_shape)]) + \
+            self._out_event_shape
+
+    def _inverse_shape(self, shape):
+        if len(shape) < len(self._out_event_shape):
+            raise ValueError("shape rank is smaller than out_event_shape rank")
+        if tuple(shape[len(shape) - len(self._out_event_shape):]) != \
+                self._out_event_shape:
+            raise ValueError(
+                f"shape suffix {shape} does not match out_event_shape "
+                f"{self._out_event_shape}")
+        return tuple(shape[:len(shape) - len(self._out_event_shape)]) + \
+            self._in_event_shape
+
+    @property
+    def _domain(self):
+        return variable.Independent(variable.real,
+                                    len(self._in_event_shape))
+
+    @property
+    def _codomain(self):
+        return variable.Independent(variable.real,
+                                    len(self._out_event_shape))
+
+
+class SigmoidTransform(Transform):
+    r"""y = sigmoid(x) (ref ``transform.py:900``)."""
+
+    _type = Type.BIJECTION
+
+    def _forward(self, x):
+        return _op("sigmoid_fwd", jax.nn.sigmoid, x)
+
+    def _inverse(self, y):
+        return _op("sigmoid_inv", lambda v: jnp.log(v) - jnp.log1p(-v), y)
+
+    def _forward_log_det_jacobian(self, x):
+        return _op("sigmoid_fldj",
+                   lambda v: -jax.nn.softplus(-v) - jax.nn.softplus(v), x)
+
+    @property
+    def _domain(self):
+        return variable.real
+
+    @property
+    def _codomain(self):
+        return variable.Variable(False, 0, constraint.Range(0.0, 1.0))
+
+
+class SoftmaxTransform(Transform):
+    r"""Softmax onto the simplex; not bijective, so log-det-Jacobian is
+    undefined (ref ``transform.py:943``)."""
+
+    _type = Type.OTHER
+
+    def _forward(self, x):
+        def fn(v):
+            z = jnp.exp(v - jnp.max(v, axis=-1, keepdims=True))
+            return z / jnp.sum(z, axis=-1, keepdims=True)
+        return _op("softmax_fwd", fn, x)
+
+    def _inverse(self, y):
+        return _op("softmax_inv", jnp.log, y)
+
+    def _forward_shape(self, shape):
+        if len(shape) < 1:
+            raise ValueError("input shape must have at least one dimension")
+        return shape
+
+    def _inverse_shape(self, shape):
+        if len(shape) < 1:
+            raise ValueError("input shape must have at least one dimension")
+        return shape
+
+    @property
+    def _domain(self):
+        return variable.Independent(variable.real, 1)
+
+    @property
+    def _codomain(self):
+        return variable.Variable(False, 1, constraint.simplex)
+
+
+class StackTransform(Transform):
+    r"""Applies a sequence of transforms to each slice along ``axis``
+    (ref ``transform.py:999``)."""
+
+    def __init__(self, transforms, axis=0):
+        if not isinstance(transforms, (list, tuple)) or not all(
+                isinstance(t, Transform) for t in transforms):
+            raise TypeError(
+                "transforms must be a list/tuple of Transform instances")
+        if not isinstance(axis, int):
+            raise TypeError("axis must be an int")
+        self._transforms = tuple(transforms)
+        self._axis = axis
+        super().__init__()
+
+    def _is_injective(self):
+        return all(t._is_injective() for t in self._transforms)
+
+    @property
+    def transforms(self):
+        return self._transforms
+
+    @property
+    def axis(self):
+        return self._axis
+
+    def _check_size(self, v):
+        if v.shape[self._axis] != len(self._transforms):
+            raise ValueError(
+                f"input size along axis {self._axis} "
+                f"({v.shape[self._axis]}) must equal the number of "
+                f"transforms ({len(self._transforms)})")
+
+    def _map(self, name, v, method):
+        v = _t(v)
+        self._check_size(v)
+
+        def fn(val):
+            cols = []
+            for i, t in enumerate(self._transforms):
+                out = method(t, Tensor(jnp.take(val, i, axis=self._axis)))
+                cols.append(out._value if isinstance(out, Tensor)
+                            else jnp.asarray(out))
+            return jnp.stack(cols, axis=self._axis)
+
+        return apply_op(name, fn, [v])
+
+    def _forward(self, x):
+        return self._map("stack_fwd", x, lambda t, s: t.forward(s))
+
+    def _inverse(self, y):
+        return self._map("stack_inv", y, lambda t, s: t.inverse(s))
+
+    def _forward_log_det_jacobian(self, x):
+        return self._map("stack_fldj", x,
+                         lambda t, s: t.forward_log_det_jacobian(s))
+
+    @property
+    def _domain(self):
+        return variable.Stack([t._domain for t in self._transforms],
+                              self._axis)
+
+    @property
+    def _codomain(self):
+        return variable.Stack([t._codomain for t in self._transforms],
+                              self._axis)
+
+
+class StickBreakingTransform(Transform):
+    r"""Maps an unconstrained (K-1)-vector to a K-simplex by stick-breaking
+    (ref ``transform.py:1104``)."""
+
+    _type = Type.BIJECTION
+
+    def _forward(self, x):
+        def fn(v):
+            offset = v.shape[-1] + 1 - jnp.arange(1, v.shape[-1] + 1)
+            z = jax.nn.sigmoid(v - jnp.log(offset.astype(v.dtype)))
+            zc = jnp.cumprod(1 - z, axis=-1)
+            pad = [(0, 0)] * (v.ndim - 1) + [(0, 1)]
+            return jnp.pad(z, pad, constant_values=1.0) * \
+                jnp.pad(zc, [(0, 0)] * (v.ndim - 1) + [(1, 0)],
+                        constant_values=1.0)
+        return _op("stickbreaking_fwd", fn, x)
+
+    def _inverse(self, y):
+        def fn(v):
+            y_crop = v[..., :-1]
+            offset = v.shape[-1] - jnp.arange(1, y_crop.shape[-1] + 1)
+            sf = 1.0 - jnp.cumsum(y_crop, axis=-1)
+            x = jnp.log(y_crop / sf) + jnp.log(offset.astype(v.dtype))
+            return x
+        return _op("stickbreaking_inv", fn, y)
+
+    def _forward_log_det_jacobian(self, x):
+        def fn(v):
+            y = self._forward(Tensor(v))._value
+            offset = v.shape[-1] + 1 - jnp.arange(1, v.shape[-1] + 1)
+            z = v - jnp.log(offset.astype(v.dtype))
+            return jnp.sum(-z + jax.nn.log_sigmoid(z) +
+                           jnp.log(y[..., :-1]), axis=-1)
+        return _op("stickbreaking_fldj", fn, x)
+
+    def _forward_shape(self, shape):
+        if not shape:
+            raise ValueError("input shape must have at least one dimension")
+        return tuple(shape[:-1]) + (shape[-1] + 1,)
+
+    def _inverse_shape(self, shape):
+        if not shape:
+            raise ValueError("input shape must have at least one dimension")
+        return tuple(shape[:-1]) + (shape[-1] - 1,)
+
+    @property
+    def _domain(self):
+        return variable.Independent(variable.real, 1)
+
+    @property
+    def _codomain(self):
+        return variable.Variable(False, 1, constraint.simplex)
+
+
+class TanhTransform(Transform):
+    r"""y = tanh(x) (ref ``transform.py:1169``)."""
+
+    _type = Type.BIJECTION
+
+    def _forward(self, x):
+        return _op("tanh_fwd", jnp.tanh, x)
+
+    def _inverse(self, y):
+        return _op("tanh_inv", jnp.arctanh, y)
+
+    def _forward_log_det_jacobian(self, x):
+        # 2 (log 2 - x - softplus(-2x)): higher precision than
+        # -log1p(-tanh(x)^2) (ref transform.py:1216-1222)
+        return _op("tanh_fldj",
+                   lambda v: 2.0 * (math.log(2.0) - v -
+                                    jax.nn.softplus(-2.0 * v)), x)
+
+    @property
+    def _domain(self):
+        return variable.real
+
+    @property
+    def _codomain(self):
+        return variable.Variable(False, 0, constraint.Range(-1.0, 1.0))

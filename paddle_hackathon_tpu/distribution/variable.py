@@ -1,0 +1,101 @@
+"""Random-variable descriptors: discreteness, event rank and constraint
+(ref ``python/paddle/distribution/variable.py:18-104``)."""
+
+from __future__ import annotations
+
+from . import constraint as _constraint
+
+
+class Variable:
+    """Random variable of a probability distribution
+    (ref ``variable.py:18``)."""
+
+    def __init__(self, is_discrete=False, event_rank=0, constraint=None):
+        self._is_discrete = is_discrete
+        self._event_rank = event_rank
+        self._constraint = constraint
+
+    @property
+    def is_discrete(self):
+        return self._is_discrete
+
+    @property
+    def event_rank(self):
+        return self._event_rank
+
+    def constraint(self, value):
+        """Check whether the 'value' meets the constraint conditions."""
+        return self._constraint(value)
+
+
+class Real(Variable):
+    def __init__(self, event_rank=0):
+        super().__init__(False, event_rank, _constraint.real)
+
+
+class Positive(Variable):
+    def __init__(self, event_rank=0):
+        super().__init__(False, event_rank, _constraint.positive)
+
+
+class Independent(Variable):
+    """Reinterprets some of the rightmost batch axes as event axes
+    (ref ``variable.py:57``)."""
+
+    def __init__(self, base, reinterpreted_batch_rank):
+        self._base = base
+        self._reinterpreted_batch_rank = reinterpreted_batch_rank
+        super().__init__(
+            base.is_discrete,
+            base.event_rank + reinterpreted_batch_rank)
+
+    def constraint(self, value):
+        ret = self._base.constraint(value)
+        if ret.ndim < self._reinterpreted_batch_rank:
+            raise ValueError(
+                "Input dimensions must be equal or greater than "
+                f"{self._reinterpreted_batch_rank}")
+        import jax.numpy as jnp
+        from ..core.autograd import apply_op
+        axes = tuple(range(-self._reinterpreted_batch_rank, 0))
+        return apply_op("independent_constraint",
+                        lambda v: jnp.all(v, axis=axes), [ret])
+
+
+class Stack(Variable):
+    def __init__(self, vars, axis=0):  # noqa: A002
+        self._vars = vars
+        self._axis = axis
+
+    @property
+    def is_discrete(self):
+        return any(var.is_discrete for var in self._vars)
+
+    @property
+    def event_rank(self):
+        # ref variable.py:95-99: the stacking axis only adds an event rank
+        # when it falls left of every component's event block
+        rank = max(var.event_rank for var in self._vars)
+        if self._axis + rank < 0:
+            rank += 1
+        return rank
+
+    def constraint(self, value):
+        import jax.numpy as jnp
+        from ..core.autograd import apply_op
+        from ..core.tensor import Tensor
+
+        def fn(v):
+            cols = []
+            for i, var in enumerate(self._vars):
+                out = var.constraint(Tensor(jnp.take(v, i, axis=self._axis)))
+                cols.append(out._value if isinstance(out, Tensor) else out)
+            return jnp.stack(cols, axis=self._axis)
+
+        value = value if isinstance(value, Tensor) else Tensor(
+            jnp.asarray(value))
+        return apply_op("stack_constraint", fn, [value])
+
+
+real = Real()
+positive = Positive()

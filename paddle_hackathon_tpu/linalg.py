@@ -1,0 +1,17 @@
+"""paddle.linalg namespace (ref ``python/paddle/linalg.py``) — a real
+importable submodule so ``import paddle_hackathon_tpu.linalg`` works the way
+``import paddle.linalg`` does, re-exporting the reference's export list."""
+
+from .ops.linalg import (  # noqa: F401
+    cholesky, norm, eig, cov, corrcoef, cond, matrix_power, solve,
+    cholesky_solve, eigvals, multi_dot, matrix_rank, svd, eigvalsh, qr,
+    lu, lu_unpack, eigh, det, slogdet, pinv, triangular_solve, lstsq,
+)
+from .ops.linalg import inverse as inv  # noqa: F401
+
+__all__ = [
+    'cholesky', 'norm', 'cond', 'cov', 'corrcoef', 'inv', 'eig', 'eigvals',
+    'multi_dot', 'matrix_rank', 'svd', 'qr', 'lu', 'lu_unpack',
+    'matrix_power', 'det', 'slogdet', 'eigh', 'eigvalsh', 'pinv', 'solve',
+    'cholesky_solve', 'triangular_solve', 'lstsq',
+]

@@ -1,0 +1,202 @@
+"""distribution.transform family (ref distribution/transform.py) — forward/
+inverse round-trips, log-det-Jacobian vs autodiff, shapes, domain/codomain,
+and TransformedDistribution integration."""
+
+import numpy as np
+import pytest
+
+import paddle_hackathon_tpu as paddle
+from paddle_hackathon_tpu import distribution as D
+
+
+def _ldj_autodiff(t, x):
+    """Reference fldj: log|df/dx| element-wise for scalar transforms."""
+    import jax
+    import jax.numpy as jnp
+
+    def f(v):
+        from paddle_hackathon_tpu.core.tensor import Tensor
+        out = t.forward(Tensor(v))
+        return out._value
+
+    flat = np.asarray(x, np.float32).ravel()
+    grads = [jax.grad(lambda s: f(s.reshape(1))[0])(jnp.float32(v))
+             for v in flat]
+    return np.log(np.abs(np.asarray(grads))).reshape(np.shape(x))
+
+
+SCALAR_TRANSFORMS = [
+    D.ExpTransform(),
+    D.SigmoidTransform(),
+    D.TanhTransform(),
+    D.AffineTransform(paddle.to_tensor(0.5), paddle.to_tensor(-2.0)),
+    D.PowerTransform(paddle.to_tensor(3.0)),
+]
+
+
+@pytest.mark.parametrize("t", SCALAR_TRANSFORMS,
+                         ids=lambda t: type(t).__name__)
+def test_scalar_roundtrip_and_ldj(t):
+    x = np.array([-0.9, -0.3, 0.2, 0.8], np.float32)
+    if isinstance(t, D.PowerTransform):
+        x = np.abs(x)  # x^3 bijective on R but 1/p-th root needs positives
+    y = t.forward(paddle.to_tensor(x))
+    x_rt = t.inverse(y)
+    np.testing.assert_allclose(x_rt.numpy(), x, rtol=1e-5, atol=1e-5)
+
+    fldj = t.forward_log_det_jacobian(paddle.to_tensor(x)).numpy()
+    np.testing.assert_allclose(fldj, _ldj_autodiff(t, x), rtol=1e-4,
+                               atol=1e-4)
+    # inverse ldj is the negative at the mapped point
+    ildj = t.inverse_log_det_jacobian(y).numpy()
+    np.testing.assert_allclose(ildj, -fldj, rtol=1e-4, atol=1e-4)
+
+
+def test_abs_transform():
+    t = D.AbsTransform()
+    x = paddle.to_tensor([-1.0, 0.0, 2.0])
+    np.testing.assert_allclose(t.forward(x).numpy(), [1.0, 0.0, 2.0])
+    neg, pos = t.inverse(paddle.to_tensor(1.0))
+    assert float(neg.numpy()) == -1.0 and float(pos.numpy()) == 1.0
+    z0, z1 = t.inverse_log_det_jacobian(paddle.to_tensor(1.0))
+    assert np.all(z0.numpy() == 0.0) and np.all(z1.numpy() == 0.0)
+    assert not type(t)._is_injective()
+    with pytest.raises(NotImplementedError):
+        t.forward_log_det_jacobian(x)
+
+
+def test_chain_transform():
+    t = D.ChainTransform([
+        D.AffineTransform(paddle.to_tensor(0.0), paddle.to_tensor(-1.0)),
+        D.ExpTransform()])
+    x = np.array([0.3, 1.5], np.float32)
+    y = t.forward(paddle.to_tensor(x))
+    np.testing.assert_allclose(y.numpy(), np.exp(-x), rtol=1e-6)
+    np.testing.assert_allclose(t.inverse(y).numpy(), x, rtol=1e-5)
+    # fldj(chain) = fldj(affine)(x) + fldj(exp)(-x) = 0 + (-x)
+    np.testing.assert_allclose(
+        t.forward_log_det_jacobian(paddle.to_tensor(x)).numpy(),
+        -x, rtol=1e-5)
+    assert t.forward_shape((2,)) == (2,)
+
+
+def test_independent_transform():
+    x = np.array([[1.0, 2.0, 3.0], [4.0, 5.0, 6.0]], np.float32)
+    t = D.IndependentTransform(D.ExpTransform(), 1)
+    out = t.forward(paddle.to_tensor(x))
+    np.testing.assert_allclose(out.numpy(), np.exp(x), rtol=1e-5)
+    ldj = t.forward_log_det_jacobian(paddle.to_tensor(x))
+    np.testing.assert_allclose(ldj.numpy(), x.sum(-1), rtol=1e-5)  # (2,)
+    with pytest.raises(ValueError):
+        D.IndependentTransform(D.ExpTransform(), 0)
+    with pytest.raises(TypeError):
+        D.IndependentTransform("nope", 1)
+
+
+def test_reshape_transform():
+    t = D.ReshapeTransform((2, 3), (3, 2))
+    x = np.arange(6, dtype=np.float32).reshape(1, 2, 3)
+    y = t.forward(paddle.to_tensor(x))
+    assert tuple(y.shape) == (1, 3, 2)
+    np.testing.assert_allclose(t.inverse(y).numpy(), x)
+    assert t.forward_shape((5, 2, 3)) == (5, 3, 2)
+    assert t.inverse_shape((5, 3, 2)) == (5, 2, 3)
+    ldj = t.forward_log_det_jacobian(paddle.to_tensor(x))
+    assert tuple(ldj.shape) == (1,)
+    with pytest.raises(ValueError):
+        D.ReshapeTransform((2, 3), (4, 2))
+
+
+def test_softmax_transform():
+    t = D.SoftmaxTransform()
+    x = np.array([[0.5, -1.0, 2.0]], np.float32)
+    y = t.forward(paddle.to_tensor(x)).numpy()
+    np.testing.assert_allclose(y.sum(-1), 1.0, rtol=1e-6)
+    # inverse recovers x up to an additive constant per row
+    x_rt = t.inverse(paddle.to_tensor(y)).numpy()
+    d = x - x_rt
+    np.testing.assert_allclose(d - d[..., :1], 0.0, atol=1e-5)
+    assert not type(t)._is_injective()
+
+
+def test_stack_transform():
+    t = D.StackTransform([D.ExpTransform(),
+                          D.AffineTransform(paddle.to_tensor(0.0),
+                                            paddle.to_tensor(2.0))], axis=1)
+    x = np.array([[0.5, 3.0], [1.0, 4.0]], np.float32)
+    y = t.forward(paddle.to_tensor(x)).numpy()
+    np.testing.assert_allclose(y[:, 0], np.exp(x[:, 0]), rtol=1e-5)
+    np.testing.assert_allclose(y[:, 1], 2.0 * x[:, 1], rtol=1e-5)
+    np.testing.assert_allclose(
+        t.inverse(paddle.to_tensor(y)).numpy(), x, rtol=1e-5)
+    ldj = t.forward_log_det_jacobian(paddle.to_tensor(x)).numpy()
+    np.testing.assert_allclose(ldj[:, 0], x[:, 0], rtol=1e-5)
+    np.testing.assert_allclose(ldj[:, 1], np.log(2.0), rtol=1e-5)
+
+
+def test_stickbreaking_transform():
+    t = D.StickBreakingTransform()
+    x = np.array([0.3, -0.5, 1.2], np.float32)
+    y = t.forward(paddle.to_tensor(x)).numpy()
+    assert y.shape == (4,)
+    assert np.all(y > 0) and abs(y.sum() - 1.0) < 1e-5
+    np.testing.assert_allclose(t.inverse(paddle.to_tensor(y)).numpy(), x,
+                               rtol=1e-4, atol=1e-5)
+    assert t.forward_shape((3,)) == (4,)
+    assert t.inverse_shape((4,)) == (3,)
+
+
+def test_transform_call_composition():
+    exp = D.ExpTransform()
+    chained = exp(D.AffineTransform(paddle.to_tensor(0.0),
+                                    paddle.to_tensor(2.0)))
+    assert isinstance(chained, D.ChainTransform)
+    base = D.Normal(paddle.to_tensor(0.0), paddle.to_tensor(1.0))
+    td = exp(base)
+    assert isinstance(td, D.TransformedDistribution)
+
+
+def test_transformed_distribution_lognormal_parity():
+    # Normal pushed through Exp == LogNormal densities
+    base = D.Normal(paddle.to_tensor(0.2), paddle.to_tensor(0.8))
+    td = D.TransformedDistribution(base, [D.ExpTransform()])
+    ln = D.LogNormal(paddle.to_tensor(0.2), paddle.to_tensor(0.8))
+    v = paddle.to_tensor([0.5, 1.0, 2.5])
+    np.testing.assert_allclose(td.log_prob(v).numpy(),
+                               ln.log_prob(v).numpy(), rtol=1e-5)
+    s = td.sample((7,))
+    assert np.all(s.numpy() > 0)
+
+
+def test_domain_codomain_constraints():
+    t = D.ExpTransform()
+    assert t._domain.event_rank == 0 and not t._domain.is_discrete
+    ok = t._codomain.constraint(paddle.to_tensor([1.0, 2.0]))
+    np.testing.assert_array_equal(ok.numpy(), [True, True])
+    sb = D.StickBreakingTransform()
+    assert sb._codomain.event_rank == 1
+    simplex_ok = sb._codomain.constraint(paddle.to_tensor([0.2, 0.3, 0.5]))
+    assert bool(simplex_ok.numpy())
+    rng = D.SigmoidTransform()._codomain.constraint(
+        paddle.to_tensor([0.5, 2.0]))
+    np.testing.assert_array_equal(rng.numpy(), [True, False])
+
+
+def test_variable_stack_and_independent():
+    from paddle_hackathon_tpu.distribution import variable
+    iv = variable.Independent(variable.positive, 1)
+    assert iv.event_rank == 1
+    res = iv.constraint(paddle.to_tensor([[1.0, -1.0], [2.0, 3.0]]))
+    np.testing.assert_array_equal(res.numpy(), [False, True])
+    sv = variable.Stack([variable.real, variable.positive], axis=0)
+    out = sv.constraint(paddle.to_tensor([[1.0, 2.0], [-1.0, 3.0]]))
+    np.testing.assert_array_equal(out.numpy(), [[True, True], [False, True]])
+
+
+def test_linalg_module_importable():
+    import paddle_hackathon_tpu.linalg as L
+    x = paddle.to_tensor(np.array([[4.0, 0.0], [0.0, 9.0]], np.float32))
+    np.testing.assert_allclose(L.det(x).numpy(), 36.0, rtol=1e-5)
+    np.testing.assert_allclose(
+        L.inv(x).numpy(), np.diag([0.25, 1 / 9.0]), rtol=1e-5)
+    assert set(L.__all__) >= {"svd", "qr", "lstsq", "pinv", "slogdet"}
