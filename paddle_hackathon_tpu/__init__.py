@@ -82,7 +82,8 @@ for _sub in ("nn", "optimizer", "io", "amp", "metric", "framework",
              "jit", "distributed", "vision", "incubate", "profiler", "hapi",
              "static", "text", "inference", "distribution", "sparse",
              "utils", "onnx", "fft", "signal", "device", "autograd", "linalg",
-             "regularizer", "sysconfig", "hub", "callbacks", "version"):
+             "regularizer", "sysconfig", "hub", "callbacks", "version",
+             "reader", "dataset", "cost_model", "tensor"):
     try:
         globals()[_sub] = _importlib.import_module(f"{__name__}.{_sub}")
     except ModuleNotFoundError as _e:

@@ -1,0 +1,5 @@
+"""paddle.cost_model (ref ``python/paddle/cost_model/__init__.py``)."""
+
+from .cost_model import CostModel  # noqa: F401
+
+__all__ = ["CostModel"]

@@ -1,0 +1,94 @@
+"""Op-level cost model (ref ``python/paddle/cost_model/cost_model.py:23-89``).
+
+``static_cost_data()`` loads the bundled per-op benchmark table; unlike the
+reference's V100 numbers (``static_op_benchmark.json``), this build ships
+times measured on the TPU chip this framework targets (see
+``tools/gen_op_benchmark.py`` — fields keep the reference schema, with
+``paddle_gpu_time`` holding the measured device time in ms).
+``profile_measure`` runs a program through the real executor under the
+profiler and reports measured cost.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+
+__all__ = ["CostModel"]
+
+
+class CostModel:
+
+    def __init__(self):
+        self._static_cost_data = None
+
+    def build_program(self):
+        """ref ``cost_model.py:28`` — a tiny fc program pair."""
+        import paddle_hackathon_tpu as paddle
+        from paddle_hackathon_tpu import static
+
+        paddle.enable_static()
+        main_program = static.Program()
+        startup_program = static.Program()
+        with static.program_guard(main_program=main_program,
+                                  startup_program=startup_program):
+            data = static.data(name='X', shape=[None, 1], dtype='float32')
+            hidden = static.nn.fc(data, 10)
+            loss = paddle.mean(hidden)
+            paddle.optimizer.SGD(learning_rate=0.01).minimize(loss)
+        paddle.disable_static()
+        return startup_program, main_program
+
+    def profile_measure(self, startup_program, main_program, device='tpu',
+                        fetch_cost_list=('time',)):
+        """ref ``cost_model.py:46`` — run the program once under the
+        profiler; returns {'time': total_ms, 'op_count': {op_name: n}}."""
+        import time
+
+        import paddle_hackathon_tpu as paddle
+        from paddle_hackathon_tpu import static
+
+        paddle.enable_static()
+        try:
+            exe = static.Executor()
+            exe.run(startup_program)
+            x = np.random.random(size=(10, 1)).astype('float32')
+            exe.run(main_program, feed={"X": x}, fetch_list=[])  # warm/compile
+            t0 = time.perf_counter()
+            exe.run(main_program, feed={"X": x}, fetch_list=[])
+            total_ms = (time.perf_counter() - t0) * 1e3
+        finally:
+            paddle.disable_static()
+        op_count = {}
+        for op in main_program.global_block().ops:
+            name = getattr(op, "type", None) or op.name
+            op_count[name] = op_count.get(name, 0) + 1
+        return {"time": total_ms, "op_count": op_count}
+
+    def static_cost_data(self):
+        """ref ``cost_model.py:62``."""
+        path = os.path.join(os.path.dirname(__file__),
+                            "static_op_benchmark.json")
+        with open(path) as f:
+            self._static_cost_data = json.load(f)
+        return self._static_cost_data
+
+    def get_static_op_time(self, op_name, forward=True, dtype="float32"):
+        """ref ``cost_model.py:71`` — measured time for one op."""
+        if op_name is None:
+            raise ValueError(
+                'op_name should not be empty when you want to get static op '
+                'time')
+        if self._static_cost_data is None:
+            self.static_cost_data()
+        op_cost = {}
+        for op_data in self._static_cost_data:
+            if (op_data["op"] == op_name) and (dtype in op_data["config"]):
+                if forward:
+                    op_cost["op_time"] = op_data["paddle_gpu_time"]
+                else:
+                    op_cost["op_time"] = op_data["paddle_gpu_time_backward"]
+                op_cost["config"] = op_data["config"]
+        return op_cost

@@ -1,0 +1,18 @@
+"""paddle.dataset — legacy generator-reader dataset package
+(ref ``python/paddle/dataset/__init__.py``)."""
+
+from . import common  # noqa: F401
+from . import mnist  # noqa: F401
+from . import imikolov  # noqa: F401
+from . import imdb  # noqa: F401
+from . import cifar  # noqa: F401
+from . import movielens  # noqa: F401
+from . import conll05  # noqa: F401
+from . import uci_housing  # noqa: F401
+from . import wmt14  # noqa: F401
+from . import wmt16  # noqa: F401
+from . import flowers  # noqa: F401
+from . import voc2012  # noqa: F401
+from . import image  # noqa: F401
+
+__all__ = []

@@ -1,0 +1,83 @@
+"""paddle.dataset.cifar (ref ``python/paddle/dataset/cifar.py:49-170``).
+
+Readers yield ``(image_f32[3072] in [0,1], int label)``. Real pickle
+archives under DATA_HOME are used when present, else a deterministic
+synthetic fallback.
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+
+import numpy as np
+
+from . import common
+
+__all__ = []
+
+_SYNTH = {"train": 1024, "test": 256}
+
+
+def reader_creator(filename, sub_name, cycle=False):
+    """ref ``cifar.py:49`` — stream one split from the pickle archive."""
+    from ..vision.datasets import Cifar10, Cifar100
+    cls = Cifar100 if "100" in sub_name or "train" == sub_name or \
+        "test" == sub_name else Cifar10
+    mode = "train" if "train" in sub_name or "data_batch" in sub_name \
+        else "test"
+
+    def reader():
+        ds = cls(data_file=filename, mode=mode)
+        it = itertools.cycle(range(len(ds))) if cycle else range(len(ds))
+        for i in it:
+            img, label = ds[i]
+            yield (np.transpose(img, (2, 0, 1)).reshape(-1).astype(
+                np.float32) / 255.0, int(label))
+
+    return reader
+
+
+def _synthetic(mode, n_classes, cycle=False):
+    def reader():
+        r = common.rng("cifar", mode, n_classes)
+        n = _SYNTH[mode]
+        imgs = r.rand(n, 3072).astype(np.float32)
+        labels = r.randint(0, n_classes, n)
+        idx = itertools.cycle(range(n)) if cycle else range(n)
+        for i in idx:
+            yield imgs[i], int(labels[i])
+
+    return reader
+
+
+def _make(archive, sub_name, mode, n_classes, cycle=False):
+    path = os.path.join(common.DATA_HOME, "cifar", archive)
+    if os.path.exists(path):
+        return reader_creator(path, sub_name, cycle)
+    return _synthetic(mode, n_classes, cycle)
+
+
+def train100():
+    """ref ``cifar.py:81``."""
+    return _make("cifar-100-python.tar.gz", "train", "train", 100)
+
+
+def test100():
+    """ref ``cifar.py:101``."""
+    return _make("cifar-100-python.tar.gz", "test", "test", 100)
+
+
+def train10(cycle=False):
+    """ref ``cifar.py:121``."""
+    return _make("cifar-10-python.tar.gz", "data_batch", "train", 10, cycle)
+
+
+def test10(cycle=False):
+    """ref ``cifar.py:144``."""
+    return _make("cifar-10-python.tar.gz", "test_batch", "test", 10, cycle)
+
+
+def fetch():
+    """ref ``cifar.py:167``."""
+    common.must_mkdirs(os.path.join(common.DATA_HOME, "cifar"))

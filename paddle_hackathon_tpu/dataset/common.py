@@ -1,0 +1,120 @@
+"""Dataset cache/download helpers (ref
+``python/paddle/dataset/common.py:41-231``).
+
+This build runs with zero network egress, so ``download`` validates/copies
+local files instead of fetching URLs; every built-in dataset falls back to
+deterministic synthetic samples with the reference's shapes and dtypes when
+the real archives are absent (same policy as ``paddle.text`` datasets).
+"""
+
+from __future__ import annotations
+
+import glob
+import hashlib
+import os
+import pickle
+
+import numpy as np
+
+__all__ = []
+
+DATA_HOME = os.path.expanduser(
+    os.environ.get("PADDLE_DATA_HOME", "~/.cache/paddle/dataset"))
+
+
+def must_mkdirs(path):
+    os.makedirs(path, exist_ok=True)
+
+
+must_mkdirs(DATA_HOME)
+
+
+def md5file(fname):
+    hash_md5 = hashlib.md5()
+    with open(fname, "rb") as f:
+        for chunk in iter(lambda: f.read(4096), b""):
+            hash_md5.update(chunk)
+    return hash_md5.hexdigest()
+
+
+def download(url, module_name, md5sum, save_name=None):
+    """Resolve the dataset file under DATA_HOME (ref ``common.py:62``).
+
+    Zero-egress: if the file already exists locally (placed by the user) it
+    is returned, with an md5 warning when it mismatches; otherwise a
+    FileNotFoundError explains how to provide it.
+    """
+    dirname = os.path.join(DATA_HOME, module_name)
+    must_mkdirs(dirname)
+    filename = os.path.join(
+        dirname, url.split('/')[-1] if save_name is None else save_name)
+    if os.path.exists(filename):
+        if md5sum and md5file(filename) != md5sum:
+            import warnings
+            warnings.warn(f"md5 of {filename} does not match the expected "
+                          f"{md5sum}; using the local file anyway")
+        return filename
+    raise FileNotFoundError(
+        f"{filename} not found and this build has no network access; "
+        f"download {url} manually to {dirname}, or use the dataset's "
+        "synthetic fallback readers")
+
+
+def fetch_all():
+    """ref ``common.py:119`` — eagerly fetch every dataset; with no network
+    this just ensures the cache directories exist."""
+    for name in ("mnist", "cifar", "uci_housing", "imdb", "imikolov",
+                 "movielens", "conll05", "wmt14", "wmt16", "flowers",
+                 "voc2012"):
+        must_mkdirs(os.path.join(DATA_HOME, name))
+
+
+def split(reader, line_count, suffix="%05d.pickle", dumper=pickle.dump):
+    """Split reader samples into pickled chunk files of ``line_count``
+    (ref ``common.py:129``)."""
+    indx_f = 0
+    lines = []
+    for i, d in enumerate(reader()):
+        lines.append(d)
+        if i >= line_count and i % line_count == 0:
+            with open(suffix % indx_f, "wb") as f:
+                dumper(lines, f)
+                lines = []
+                indx_f += 1
+    if lines:
+        with open(suffix % indx_f, "wb") as f:
+            dumper(lines, f)
+
+
+def cluster_files_reader(files_pattern, trainer_count, trainer_id,
+                         loader=pickle.load):
+    """Read this trainer's shard of chunk files (ref ``common.py:167``)."""
+
+    def reader():
+        flist = sorted(glob.glob(files_pattern))
+        my_file_list = []
+        for idx, fn in enumerate(flist):
+            if idx % trainer_count == trainer_id:
+                print("append file: %s" % fn)
+                my_file_list.append(fn)
+        for fn in my_file_list:
+            with open(fn, "rb") as f:
+                lines = loader(f)
+                for line in lines:
+                    yield line
+
+    return reader
+
+
+def _check_exists_and_download(path, url, md5, module_name, download_flag=True):
+    if path and os.path.exists(path):
+        return path
+    if download_flag:
+        return download(url, module_name, md5)
+    raise ValueError(f"{path} not exists and auto download disabled")
+
+
+def rng(*key_parts) -> np.random.RandomState:
+    """Deterministic per-(dataset, split) RNG for synthetic fallbacks."""
+    seed = int(hashlib.md5(repr(key_parts).encode()).hexdigest()[:8], 16)
+    return np.random.RandomState(seed)

@@ -1,0 +1,112 @@
+"""paddle.dataset.conll05 (ref ``python/paddle/dataset/conll05.py``).
+
+Semantic-role-labeling readers; items are the 9-slot tuple
+(word_ids, ctx_n2, ctx_n1, ctx_0, ctx_p1, ctx_p2, pred_id, mark, labels)
+the reference's ``reader_creator`` emits (``conll05.py:151-209``).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from . import common
+
+__all__ = []
+
+UNK_IDX = 0
+
+
+def load_label_dict(filename):
+    """ref ``conll05.py:49``."""
+    d = {}
+    tag_dict = set()
+    with open(filename) as f:
+        for line in f:
+            line = line.strip()
+            if line.startswith("B-"):
+                tag_dict.add(line[2:])
+            elif line.startswith("I-"):
+                tag_dict.add(line[2:])
+        index = 1
+        for tag in sorted(tag_dict):
+            d["B-" + tag] = index
+            index += 1
+            d["I-" + tag] = index
+            index += 1
+        d["O"] = 0
+    return d
+
+
+def load_dict(filename):
+    """ref ``conll05.py:69``."""
+    d = {}
+    with open(filename) as f:
+        for i, line in enumerate(f):
+            d[line.strip()] = i
+    return d
+
+
+def _dataset(mode="test"):
+    from ..text.datasets import Conll05st
+    return Conll05st(mode=mode)
+
+
+def corpus_reader(data_path=None, words_name=None, props_name=None):
+    """ref ``conll05.py:77`` — yields (sentences, predicate, labels)."""
+
+    def reader():
+        ds = _dataset()
+        id_to_word = {v: k for k, v in ds.word_dict.items()}
+        id_to_verb = {v: k for k, v in ds.predicate_dict.items()}
+        id_to_label = {v: k for k, v in ds.label_dict.items()}
+        for words, pred, mark, labels in ds.examples:
+            sentences = [id_to_word[int(w)] for w in words]
+            predicate = id_to_verb[int(pred)]
+            lbls = [id_to_label[int(l)] for l in labels]
+            yield sentences, predicate, lbls
+
+    return reader
+
+
+def reader_creator(corpus_reader, word_dict=None, predicate_dict=None,
+                   label_dict=None):
+    """ref ``conll05.py:151`` — to the 9-slot model input tuple."""
+
+    def reader():
+        ds = _dataset()
+        for words, pred, mark, labels in ds.examples:
+            w = [int(x) for x in words]
+            n = len(w)
+
+            def ctx(offset):
+                return [w[max(0, min(n - 1, i + offset))] for i in range(n)]
+
+            yield (w, ctx(-2), ctx(-1), ctx(0), ctx(1), ctx(2),
+                   [int(pred)] * n, [int(m) for m in mark],
+                   [int(l) for l in labels])
+
+    return reader
+
+
+def get_dict():
+    """ref ``conll05.py:212`` — (word_dict, verb_dict, label_dict)."""
+    ds = _dataset()
+    return ds.word_dict, ds.predicate_dict, ds.label_dict
+
+
+def get_embedding():
+    """ref ``conll05.py:230`` — pretrained word embeddings; deterministic
+    32-dim synthetic matrix here (the reference ships emb data)."""
+    ds = _dataset()
+    r = common.rng("conll05-emb")
+    return r.randn(len(ds.word_dict), 32).astype(np.float32)
+
+
+def test():
+    """ref ``conll05.py:242``."""
+    word_dict, verb_dict, label_dict = get_dict()
+    return reader_creator(corpus_reader(), word_dict, verb_dict, label_dict)
+
+
+def fetch():
+    """ref ``conll05.py:267``."""

@@ -1,0 +1,98 @@
+"""paddle.dataset.flowers (ref ``python/paddle/dataset/flowers.py``).
+
+102-category flower classification; readers yield
+``(chw_float32_image, int label)`` after the reference's mapper pipeline.
+Synthetic fallback images are used when the real archives are absent.
+"""
+
+from __future__ import annotations
+
+import functools
+import itertools
+
+import numpy as np
+
+from . import common
+from .image import simple_transform
+from ..reader import xmap_readers
+
+__all__ = []
+
+_N_CLASSES = 102
+_SYNTH = {"train": 256, "test": 64, "valid": 64}
+
+
+def default_mapper(is_train, sample):
+    """ref ``flowers.py:70`` — decode + simple_transform(256, 224)."""
+    img, label = sample
+    if isinstance(img, bytes):
+        from .image import load_image_bytes
+        img = load_image_bytes(img)
+    img = simple_transform(np.asarray(img), 256, 224, is_train)
+    return img.flatten().astype('float32'), label
+
+
+train_mapper = functools.partial(default_mapper, True)
+test_mapper = functools.partial(default_mapper, False)
+
+
+def _synthetic_raw(mode):
+    def reader():
+        r = common.rng("flowers", mode)
+        for i in range(_SYNTH[mode]):
+            img = (r.rand(256, 256, 3) * 255).astype(np.uint8)
+            yield img, int(r.randint(0, _N_CLASSES))
+
+    return reader
+
+
+def reader_creator(data_file, label_file, setid_file, dataset_name, mapper,
+                   buffered_size=1024, use_xmap=True, cycle=False):
+    """ref ``flowers.py:88``."""
+    mode = {"tstid": "train", "trnid": "test",
+            "valid": "valid"}.get(dataset_name, "train")
+    base = _synthetic_raw(mode)
+
+    def maybe_cycle(r):
+        if not cycle:
+            return r
+
+        def cycled():
+            while True:
+                for s in r():
+                    yield s
+        return cycled
+
+    raw = maybe_cycle(base)
+    if use_xmap:
+        return xmap_readers(mapper, raw, min(4, 8), buffered_size, order=False)
+
+    def mapped():
+        for s in raw():
+            yield mapper(s)
+
+    return mapped
+
+
+def train(mapper=train_mapper, buffered_size=1024, use_xmap=True,
+          cycle=False):
+    """ref ``flowers.py:152`` (the reference trains on the 'tstid' split)."""
+    return reader_creator(None, None, None, "tstid", mapper, buffered_size,
+                          use_xmap, cycle)
+
+
+def test(mapper=test_mapper, buffered_size=1024, use_xmap=True, cycle=False):
+    """ref ``flowers.py:185``."""
+    return reader_creator(None, None, None, "trnid", mapper, buffered_size,
+                          use_xmap, cycle)
+
+
+def valid(mapper=test_mapper, buffered_size=1024, use_xmap=True):
+    """ref ``flowers.py:218``."""
+    return reader_creator(None, None, None, "valid", mapper, buffered_size,
+                          use_xmap)
+
+
+def fetch():
+    """ref ``flowers.py:240``."""
+    common.must_mkdirs(common.DATA_HOME + "/flowers")

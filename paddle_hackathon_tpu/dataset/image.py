@@ -1,0 +1,187 @@
+"""paddle.dataset.image (ref ``python/paddle/dataset/image.py:72-428``).
+
+Image manipulation helpers. The reference shells out to cv2; here the
+array-path helpers (crop/flip/chw/resize) are pure numpy so they always
+work, and the file/bytes decoders use cv2 or PIL when available.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import tarfile
+
+import numpy as np
+
+__all__ = []
+
+
+def _decoder():
+    try:
+        import cv2
+        return "cv2", cv2
+    except ImportError:
+        pass
+    try:
+        import PIL.Image
+        return "pil", PIL.Image
+    except ImportError:
+        return None, None
+
+
+def batch_images_from_tar(data_file, dataset_name, img2label,
+                          num_per_batch=1024):
+    """ref ``image.py:84`` — pickle batches of (jpeg bytes, label)."""
+    batch_dir = data_file + "_batch"
+    out_path = "%s/%s_%s" % (batch_dir, dataset_name, os.getpid())
+    meta_file = "%s/%s_%s.txt" % (batch_dir, dataset_name, os.getpid())
+
+    if os.path.exists(out_path):
+        return meta_file
+    os.makedirs(out_path, exist_ok=True)
+    tf = tarfile.open(data_file)
+    mems = tf.getmembers()
+    data, labels = [], []
+    file_id = 0
+    for mem in mems:
+        if mem.name in img2label:
+            data.append(tf.extractfile(mem).read())
+            labels.append(img2label[mem.name])
+            if len(data) == num_per_batch:
+                output = {'label': labels, 'data': data}
+                with open(f"{out_path}/batch_{file_id}", 'wb') as f:
+                    pickle.dump(output, f, protocol=2)
+                file_id += 1
+                data, labels = [], []
+    if data:
+        output = {'label': labels, 'data': data}
+        with open(f"{out_path}/batch_{file_id}", 'wb') as f:
+            pickle.dump(output, f, protocol=2)
+    with open(meta_file, 'a') as meta:
+        for file in os.listdir(out_path):
+            meta.write(os.path.abspath(f"{out_path}/{file}") + "\n")
+    return meta_file
+
+
+def load_image_bytes(bytes, is_color=True):  # noqa: A002
+    """ref ``image.py:145``."""
+    kind, mod = _decoder()
+    if kind == "cv2":
+        import cv2
+        flag = 1 if is_color else 0
+        file_bytes = np.asarray(bytearray(bytes), dtype=np.uint8)
+        return cv2.imdecode(file_bytes, flag)
+    if kind == "pil":
+        import io
+        img = mod.open(io.BytesIO(bytes))
+        img = img.convert("RGB" if is_color else "L")
+        return np.asarray(img)
+    raise ImportError("decoding image bytes needs cv2 or PIL; neither is "
+                      "installed")
+
+
+def load_image(file, is_color=True):  # noqa: A002
+    """ref ``image.py:171``."""
+    with open(file, "rb") as f:
+        return load_image_bytes(f.read(), is_color)
+
+
+def _resize_bilinear(im, h_out, w_out):
+    """Pure-numpy bilinear resize (HWC or HW)."""
+    im = np.asarray(im)
+    h_in, w_in = im.shape[:2]
+    ys = np.linspace(0, h_in - 1, h_out)
+    xs = np.linspace(0, w_in - 1, w_out)
+    y0 = np.floor(ys).astype(int)
+    x0 = np.floor(xs).astype(int)
+    y1 = np.minimum(y0 + 1, h_in - 1)
+    x1 = np.minimum(x0 + 1, w_in - 1)
+    wy = (ys - y0)[:, None]
+    wx = (xs - x0)[None, :]
+    if im.ndim == 3:
+        wy = wy[..., None]
+        wx = wx[..., None]
+    p00 = im[y0][:, x0]
+    p01 = im[y0][:, x1]
+    p10 = im[y1][:, x0]
+    p11 = im[y1][:, x1]
+    out = (p00 * (1 - wy) * (1 - wx) + p01 * (1 - wy) * wx +
+           p10 * wy * (1 - wx) + p11 * wy * wx)
+    return out.astype(im.dtype)
+
+
+def resize_short(im, size):
+    """ref ``image.py:201`` — resize so the shorter edge equals ``size``."""
+    h, w = im.shape[:2]
+    h_new, w_new = size, size
+    if h > w:
+        h_new = size * h // w
+    else:
+        w_new = size * w // h
+    return _resize_bilinear(im, h_new, w_new)
+
+
+def to_chw(im, order=(2, 0, 1)):
+    """ref ``image.py:229``."""
+    assert len(im.shape) == len(order)
+    return im.transpose(order)
+
+
+def center_crop(im, size, is_color=True):
+    """ref ``image.py:253``."""
+    h, w = im.shape[:2]
+    h_start = (h - size) // 2
+    w_start = (w - size) // 2
+    h_end, w_end = h_start + size, w_start + size
+    if is_color:
+        return im[h_start:h_end, w_start:w_end, :]
+    return im[h_start:h_end, w_start:w_end]
+
+
+def random_crop(im, size, is_color=True):
+    """ref ``image.py:281``."""
+    h, w = im.shape[:2]
+    h_start = np.random.randint(0, h - size + 1)
+    w_start = np.random.randint(0, w - size + 1)
+    h_end, w_end = h_start + size, w_start + size
+    if is_color:
+        return im[h_start:h_end, w_start:w_end, :]
+    return im[h_start:h_end, w_start:w_end]
+
+
+def left_right_flip(im, is_color=True):
+    """ref ``image.py:309``."""
+    if len(im.shape) == 3 and is_color:
+        return im[:, ::-1, :]
+    return im[:, ::-1]
+
+
+def simple_transform(im, resize_size, crop_size, is_train, is_color=True,
+                     mean=None):
+    """ref ``image.py:331`` — resize_short, crop, maybe flip, CHW, -mean."""
+    im = resize_short(im, resize_size)
+    if is_train:
+        im = random_crop(im, crop_size, is_color=is_color)
+        if np.random.randint(2) == 0:
+            im = left_right_flip(im, is_color)
+    else:
+        im = center_crop(im, crop_size, is_color=is_color)
+    if len(im.shape) == 3:
+        im = to_chw(im)
+    im = im.astype('float32')
+    if mean is not None:
+        mean = np.array(mean, dtype=np.float32)
+        if mean.ndim == 1 and is_color:
+            mean = mean[:, np.newaxis, np.newaxis]
+        elif mean.ndim == 1:
+            mean = mean
+        im -= mean
+    return im
+
+
+def load_and_transform(filename, resize_size, crop_size, is_train,
+                       is_color=True, mean=None):
+    """ref ``image.py:387``."""
+    im = load_image(filename, is_color)
+    return simple_transform(im, resize_size, crop_size, is_train, is_color,
+                            mean)

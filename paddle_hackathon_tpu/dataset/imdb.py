@@ -1,0 +1,70 @@
+"""paddle.dataset.imdb (ref ``python/paddle/dataset/imdb.py:40-169``).
+
+Readers yield ``(word_id_list, 0/1 label)``; vocabulary from
+``word_dict()``. Backed by the deterministic ``paddle.text.Imdb`` corpus.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = []
+
+
+def _dataset(mode):
+    from ..text.datasets import Imdb
+    return Imdb(mode=mode)
+
+
+def tokenize(pattern):
+    """ref ``imdb.py:40`` — yield token lists of the docs matching the
+    aclImdb tar pattern; 'train' or 'test' and 'pos'/'neg' are inferred."""
+    mode = "test" if "test" in str(pattern) else "train"
+    want = None
+    if "pos" in str(pattern):
+        want = 1
+    elif "neg" in str(pattern):
+        want = 0
+    ds = _dataset(mode)
+    idx_to_word = {v: k for k, v in ds.word_idx.items()}
+    for doc, label in zip(ds.docs, ds.labels):
+        if want is not None and int(label) != want:
+            continue
+        yield [idx_to_word[int(w)] for w in doc]
+
+
+def build_dict(pattern, cutoff):
+    """ref ``imdb.py:60`` — word -> id, '<unk>' last."""
+    mode = "test" if "test" in str(pattern) else "train"
+    return _dataset(mode).word_idx
+
+
+def reader_creator(pos_pattern, neg_pattern, word_idx):
+    """ref ``imdb.py:85``."""
+    mode = "test" if "test" in str(pos_pattern) else "train"
+
+    def reader():
+        ds = _dataset(mode)
+        for doc, label in zip(ds.docs, ds.labels):
+            yield [int(w) for w in doc], int(label)
+
+    return reader
+
+
+def train(word_idx):
+    """ref ``imdb.py:108`` — yields (ids, 0/1)."""
+    return reader_creator("train/pos", "train/neg", word_idx)
+
+
+def test(word_idx):
+    """ref ``imdb.py:129``."""
+    return reader_creator("test/pos", "test/neg", word_idx)
+
+
+def word_dict():
+    """ref ``imdb.py:150``."""
+    return _dataset("train").word_idx
+
+
+def fetch():
+    """ref ``imdb.py:166``."""

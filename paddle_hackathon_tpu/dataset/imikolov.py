@@ -1,0 +1,77 @@
+"""paddle.dataset.imikolov (ref ``python/paddle/dataset/imikolov.py``).
+
+PTB-style n-gram / sequence readers over the deterministic
+``paddle.text.Imikolov`` corpus.
+"""
+
+from __future__ import annotations
+
+__all__ = []
+
+
+class DataType:
+    """ref ``imikolov.py:37``."""
+    NGRAM = 1
+    SEQ = 2
+
+
+def word_count(f, word_freq=None):
+    """ref ``imikolov.py:42`` — count words of an open token-line file."""
+    if word_freq is None:
+        word_freq = {}
+    for line in f:
+        for w in line.strip().split():
+            word_freq[w] = word_freq.get(w, 0) + 1
+        word_freq['<s>'] = word_freq.get('<s>', 0) + 1
+        word_freq['<e>'] = word_freq.get('<e>', 0) + 1
+    return word_freq
+
+
+def build_dict(min_word_freq=50):
+    """ref ``imikolov.py:55`` — word -> id with '<unk>' mapped last."""
+    from ..text.datasets import Imikolov
+    ds = Imikolov(mode="train", data_type="SEQ")
+    d = dict(ds.word_idx)
+    d.setdefault('<unk>', len(d))
+    return d
+
+
+def reader_creator(filename, word_idx, n, data_type):
+    """ref ``imikolov.py:85``."""
+    mode = "test" if "valid" in str(filename) or "test" in str(filename) \
+        else "train"
+    return _reader(mode, word_idx, n, data_type)
+
+
+def _reader(mode, word_idx, n, data_type):
+    from ..text.datasets import Imikolov
+
+    def reader():
+        if data_type == DataType.NGRAM or str(data_type).upper() == "NGRAM":
+            ds = Imikolov(mode=mode, data_type="NGRAM", window_size=n)
+            for gram in ds.data:
+                yield tuple(int(w) for w in gram)
+        else:
+            ds = Imikolov(mode=mode, data_type="SEQ")
+            for sent in ds.data:
+                ids = [int(w) for w in sent]
+                # <s> sentence <e> input/target split (ref imikolov.py:103)
+                src = [0] + ids
+                trg = ids + [1]
+                yield src, trg
+
+    return reader
+
+
+def train(word_idx, n, data_type=DataType.NGRAM):
+    """ref ``imikolov.py:121``."""
+    return _reader("train", word_idx, n, data_type)
+
+
+def test(word_idx, n, data_type=DataType.NGRAM):
+    """ref ``imikolov.py:146``."""
+    return _reader("test", word_idx, n, data_type)
+
+
+def fetch():
+    """ref ``imikolov.py:171``."""

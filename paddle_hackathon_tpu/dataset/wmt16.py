@@ -1,0 +1,92 @@
+"""paddle.dataset.wmt16 (ref ``python/paddle/dataset/wmt16.py``).
+
+ACL-WMT16 en-de readers: ``(src_ids, trg_ids, trg_ids_next)``; dicts keyed
+by language (``wmt16.py:104-338``).
+"""
+
+from __future__ import annotations
+
+__all__ = []
+
+TOTAL_EN_WORDS = 11250
+TOTAL_DE_WORDS = 19220
+
+START_MARK = "<s>"
+END_MARK = "<e>"
+UNK_MARK = "<unk>"
+
+
+def __get_dict_size(src_dict_size, trg_dict_size, src_lang):
+    """ref ``wmt16.py:96``."""
+    src_dict_size = min(src_dict_size, (TOTAL_EN_WORDS if src_lang == "en"
+                                        else TOTAL_DE_WORDS))
+    trg_dict_size = min(trg_dict_size, (TOTAL_DE_WORDS if src_lang == "en"
+                                        else TOTAL_EN_WORDS))
+    return src_dict_size, trg_dict_size
+
+
+def _dataset(mode, src_dict_size, trg_dict_size, src_lang):
+    from ..text.datasets import WMT16
+    return WMT16(mode=mode, src_dict_size=src_dict_size,
+                 trg_dict_size=trg_dict_size, lang=src_lang)
+
+
+def reader_creator(tar_file, file_name, src_dict_size, trg_dict_size,
+                   src_lang):
+    """ref ``wmt16.py:104``."""
+    mode = ("test" if "test" in str(file_name)
+            else "val" if "val" in str(file_name) else "train")
+
+    def reader():
+        ds = _dataset(mode, src_dict_size, trg_dict_size, src_lang)
+        for src, trg_in, trg_next in ds.pairs:
+            yield ([int(x) for x in src], [int(x) for x in trg_in],
+                   [int(x) for x in trg_next])
+
+    return reader
+
+
+def train(src_dict_size, trg_dict_size, src_lang="en"):
+    """ref ``wmt16.py:148``."""
+    if src_lang not in ["en", "de"]:
+        raise ValueError("An error language type. Only support: "
+                         "en (for English); de(for Germany).")
+    src_dict_size, trg_dict_size = __get_dict_size(src_dict_size,
+                                                   trg_dict_size, src_lang)
+    return reader_creator(None, "wmt16/train", src_dict_size, trg_dict_size,
+                          src_lang)
+
+
+def test(src_dict_size, trg_dict_size, src_lang="en"):
+    """ref ``wmt16.py:201``."""
+    if src_lang not in ["en", "de"]:
+        raise ValueError("An error language type. Only support: "
+                         "en (for English); de(for Germany).")
+    src_dict_size, trg_dict_size = __get_dict_size(src_dict_size,
+                                                   trg_dict_size, src_lang)
+    return reader_creator(None, "wmt16/test", src_dict_size, trg_dict_size,
+                          src_lang)
+
+
+def validation(src_dict_size, trg_dict_size, src_lang="en"):
+    """ref ``wmt16.py:254``."""
+    if src_lang not in ["en", "de"]:
+        raise ValueError("An error language type. Only support: "
+                         "en (for English); de(for Germany).")
+    src_dict_size, trg_dict_size = __get_dict_size(src_dict_size,
+                                                   trg_dict_size, src_lang)
+    return reader_creator(None, "wmt16/val", src_dict_size, trg_dict_size,
+                          src_lang)
+
+
+def get_dict(lang, dict_size, reverse=False):
+    """ref ``wmt16.py:305`` — the dict of one language."""
+    dict_size = min(dict_size, (TOTAL_EN_WORDS if lang == "en"
+                                else TOTAL_DE_WORDS))
+    ds = _dataset("train", dict_size, dict_size, "en")
+    src, trg = ds.get_dict(reverse=reverse)
+    return src if lang == "en" else trg
+
+
+def fetch():
+    """ref ``wmt16.py:340``."""

@@ -532,8 +532,14 @@ class TransformedDistribution(Distribution):
                 f"base distribution rank {len(base_shape)} is smaller than "
                 f"the chain's domain event rank {chain._domain.event_rank}")
         shape = chain.forward_shape(base_shape) if chain else base_shape
-        event_rank = max(len(base.event_shape),
-                         chain._codomain.event_rank if chain else 0)
+        # ref transformed_distribution.py:76-77: the transformed event rank
+        # is the chain codomain's plus whatever base event dims the chain's
+        # domain does not consume
+        if chain:
+            event_rank = chain._codomain.event_rank + max(
+                len(base.event_shape) - chain._domain.event_rank, 0)
+        else:
+            event_rank = len(base.event_shape)
         super().__init__(shape[:len(shape) - event_rank],
                          shape[len(shape) - event_rank:])
 

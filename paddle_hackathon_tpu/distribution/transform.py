@@ -280,19 +280,29 @@ class ChainTransform(Transform):
 
     @property
     def _domain(self):
+        # DP over the chain for the input-rank lower bound
+        # (ref transform.py:549-576): N(i) = max(N(i+1) - delta(ti), ti(in))
         domain = self.transforms[0]._domain
-        # the chain's domain event rank is the max lift any suffix needs
-        event_rank = domain.event_rank
+        event_rank = self.transforms[-1]._codomain.event_rank
         for t in reversed(self.transforms):
-            event_rank += t._domain.event_rank - t._codomain.event_rank
+            event_rank -= t._codomain.event_rank - t._domain.event_rank
             event_rank = max(event_rank, t._domain.event_rank)
-        return variable.Independent(
-            domain, event_rank - domain.event_rank) \
-            if event_rank > domain.event_rank else domain
+        if event_rank == domain.event_rank:
+            return domain
+        return variable.Independent(domain, event_rank - domain.event_rank)
 
     @property
     def _codomain(self):
-        return self.transforms[-1]._codomain
+        # ref transform.py:578-587
+        codomain = self.transforms[-1]._codomain
+        event_rank = self.transforms[0]._domain.event_rank
+        for t in self.transforms:
+            event_rank += t._codomain.event_rank - t._domain.event_rank
+            event_rank = max(event_rank, t._codomain.event_rank)
+        if event_rank == codomain.event_rank:
+            return codomain
+        return variable.Independent(codomain,
+                                    event_rank - codomain.event_rank)
 
 
 class ExpTransform(Transform):
@@ -351,10 +361,8 @@ class IndependentTransform(Transform):
         return self._base.inverse(y)
 
     def _forward_log_det_jacobian(self, x):
-        ldj = self._base.forward_log_det_jacobian(x)
-        n = self._reinterpreted_batch_rank
-        return _op("independent_fldj",
-                   lambda v: jnp.sum(v, axis=tuple(range(-n, 0))), ldj)
+        return _sum_rightmost(self._base.forward_log_det_jacobian(x),
+                              self._reinterpreted_batch_rank)
 
     def _forward_shape(self, shape):
         return self._base.forward_shape(shape)

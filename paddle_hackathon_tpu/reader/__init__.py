@@ -1,0 +1,9 @@
+"""paddle.reader — generator-reader decorators
+(ref ``python/paddle/reader/__init__.py``)."""
+
+from .decorator import (  # noqa: F401
+    cache, map_readers, buffered, compose, chain, shuffle, firstn,
+    xmap_readers, multiprocess_reader, ComposeNotAligned,
+)
+
+__all__ = []

@@ -1,0 +1,267 @@
+"""Reader decorators (ref ``python/paddle/reader/decorator.py:52-575``).
+
+A *reader* is a zero-arg callable returning an iterable of samples; these
+decorators compose readers: caching, mapping, buffering, shuffling,
+chaining, composing, truncation and threaded/multiprocess fan-in.
+"""
+
+from __future__ import annotations
+
+import itertools
+import multiprocessing
+import queue as _queue_mod
+import random
+from queue import Queue
+from threading import Thread
+
+__all__ = [
+    'cache', 'map_readers', 'buffered', 'compose', 'chain', 'shuffle',
+    'firstn', 'xmap_readers', 'multiprocess_reader', 'ComposeNotAligned',
+]
+
+
+def cache(reader):
+    """Cache the reader's data in memory; later iterations replay it
+    (ref ``decorator.py:52``)."""
+    all_data = tuple(reader())
+
+    def __impl__():
+        for item in all_data:
+            yield item
+
+    return __impl__
+
+
+def map_readers(func, *readers):
+    """Map ``func`` over the zipped output of ``readers``
+    (ref ``decorator.py:92``)."""
+
+    def reader():
+        rs = [r() for r in readers]
+        for e in map(func, *rs):
+            yield e
+
+    return reader
+
+
+def shuffle(reader, buf_size):
+    """Shuffle within a sliding buffer of ``buf_size`` samples
+    (ref ``decorator.py:134``)."""
+
+    def data_reader():
+        buf = []
+        for e in reader():
+            buf.append(e)
+            if len(buf) >= buf_size:
+                random.shuffle(buf)
+                for b in buf:
+                    yield b
+                buf = []
+        if len(buf) > 0:
+            random.shuffle(buf)
+            for b in buf:
+                yield b
+
+    return data_reader
+
+
+def chain(*readers):
+    """Concatenate readers; outputs of the i-th come before the (i+1)-th
+    (ref ``decorator.py:183``)."""
+
+    def reader():
+        rs = [r() for r in readers]
+        for e in itertools.chain(*rs):
+            yield e
+
+    return reader
+
+
+class ComposeNotAligned(ValueError):
+    pass
+
+
+def compose(*readers, **kwargs):
+    """Zip readers into flattened tuples: outputs ``(1, 2, 3)`` and
+    ``(4, 5)`` compose to ``(1, 2, 3, 4, 5)`` (ref ``decorator.py:248``).
+
+    check_alignment=True (default) raises ComposeNotAligned when the
+    readers have different lengths.
+    """
+    check_alignment = kwargs.pop('check_alignment', True)
+
+    def make_tuple(x):
+        if isinstance(x, tuple):
+            return x
+        return (x,)
+
+    def reader():
+        rs = [r() for r in readers]
+        if not check_alignment:
+            for outputs in zip(*rs):
+                yield sum(list(map(make_tuple, outputs)), ())
+        else:
+            for outputs in itertools.zip_longest(*rs):
+                for o in outputs:
+                    if o is None:
+                        raise ComposeNotAligned(
+                            "outputs of readers are not aligned")
+                yield sum(list(map(make_tuple, outputs)), ())
+
+    return reader
+
+
+def buffered(reader, size):
+    """Read ahead into a bounded buffer on a worker thread
+    (ref ``decorator.py:308``)."""
+
+    class EndSignal:
+        pass
+
+    end = EndSignal()
+
+    def read_worker(r, q):
+        for d in r:
+            q.put(d)
+        q.put(end)
+
+    def data_reader():
+        r = reader()
+        q = Queue(maxsize=size)
+        t = Thread(target=read_worker, args=(r, q))
+        t.daemon = True
+        t.start()
+        e = q.get()
+        while e is not end:
+            yield e
+            e = q.get()
+
+    return data_reader
+
+
+def firstn(reader, n):
+    """Truncate the reader to the first ``n`` samples
+    (ref ``decorator.py:367``)."""
+
+    def firstn_reader():
+        for i, item in enumerate(reader()):
+            if i == n:
+                break
+            yield item
+
+    return firstn_reader
+
+
+class XmapEndSignal:
+    pass
+
+
+def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
+    """Map samples with ``process_num`` worker threads, optionally keeping
+    input order (ref ``decorator.py:412``)."""
+    end = XmapEndSignal()
+
+    def read_worker(reader, in_queue):
+        for i in reader():
+            in_queue.put(i)
+        in_queue.put(end)
+
+    def order_read_worker(reader, in_queue):
+        for in_order, i in enumerate(reader()):
+            in_queue.put((in_order, i))
+        in_queue.put(end)
+
+    def handle_worker(in_queue, out_queue, mapper):
+        sample = in_queue.get()
+        while not isinstance(sample, XmapEndSignal):
+            out_queue.put(mapper(sample))
+            sample = in_queue.get()
+        in_queue.put(end)
+        out_queue.put(end)
+
+    def order_handle_worker(in_queue, out_queue, mapper, out_order):
+        ins = in_queue.get()
+        while not isinstance(ins, XmapEndSignal):
+            order, sample = ins
+            r = mapper(sample)
+            # emit strictly in input order (reference busy-waits the same
+            # way, decorator.py:459-464, but we sleep to avoid spinning)
+            import time
+            while order != out_order[0]:
+                time.sleep(0.0005)
+            out_queue.put(r)
+            out_order[0] += 1
+            ins = in_queue.get()
+        in_queue.put(end)
+        out_queue.put(end)
+
+    def xreader():
+        in_queue = Queue(buffer_size)
+        out_queue = Queue(buffer_size)
+        out_order = [0]
+        target = order_read_worker if order else read_worker
+        t = Thread(target=target, args=(reader, in_queue))
+        t.daemon = True
+        t.start()
+        target = order_handle_worker if order else handle_worker
+        args = (in_queue, out_queue, mapper, out_order) if order else \
+            (in_queue, out_queue, mapper)
+        workers = []
+        for _ in range(process_num):
+            w = Thread(target=target, args=args)
+            w.daemon = True
+            w.start()
+            workers.append(w)
+        finish = 0
+        while finish < process_num:
+            sample = out_queue.get()
+            if isinstance(sample, XmapEndSignal):
+                finish += 1
+            else:
+                yield sample
+
+    return xreader
+
+
+def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
+    """Fan-in multiple readers with one OS process each
+    (ref ``decorator.py:505``). Samples interleave in arrival order."""
+    if len(readers) < 1:
+        raise ValueError("readers must not be empty")
+
+    def _read_into_queue(reader, q):
+        try:
+            for sample in reader():
+                if sample is None:
+                    raise ValueError("sample has None")
+                q.put(sample)
+            q.put(None)
+        except Exception:
+            q.put("")
+            raise
+
+    def queue_reader():
+        q = multiprocessing.Queue(queue_size)
+        procs = []
+        for reader in readers:
+            p = multiprocessing.Process(target=_read_into_queue,
+                                        args=(reader, q))
+            p.start()
+            procs.append(p)
+        finish_num = 0
+        while finish_num < len(readers):
+            try:
+                sample = q.get(timeout=60)
+            except _queue_mod.Empty:
+                raise RuntimeError("multiprocess_reader queue timed out")
+            if sample is None:
+                finish_num += 1
+            elif sample == "":
+                raise RuntimeError("a reader subprocess raised an exception")
+            else:
+                yield sample
+        for p in procs:
+            p.join()
+
+    # pipe-based variant behaves the same at this API level
+    return queue_reader

@@ -1,0 +1,32 @@
+"""paddle.tensor — importable tensor-op package
+(ref ``python/paddle/tensor/__init__.py``).
+
+The op implementations live in ``..ops`` (the yaml-table analog); this
+package re-exports them under the reference's module layout so
+``import paddle.tensor`` and ``paddle.tensor.math``-style access work.
+"""
+
+import sys as _sys
+
+from .. import ops as _ops
+from ..ops import creation, linalg, manipulation, math, random, search  # noqa: F401
+from ..ops import *  # noqa: F401,F403
+
+_ops_all = [n for n in dir(_ops) if not n.startswith("_")]
+
+# reference submodule names -> our ops modules (stat/logic/attribute/einsum
+# functions live inside math/manipulation here; alias the module objects so
+# `from paddle.tensor import math` etc. resolve)
+stat = math
+logic = math
+attribute = math
+einsum = math
+
+for _name, _mod in (("creation", creation), ("linalg", linalg),
+                    ("manipulation", manipulation), ("math", math),
+                    ("random", random), ("search", search),
+                    ("stat", stat), ("logic", logic),
+                    ("attribute", attribute), ("einsum", einsum)):
+    _sys.modules.setdefault(f"{__name__}.{_name}", _mod)
+
+__all__ = list(_ops_all)

@@ -1,0 +1,190 @@
+"""paddle.dataset / paddle.reader / paddle.cost_model / paddle.tensor
+namespaces (ref python/paddle/{dataset,reader,cost_model,tensor})."""
+
+import numpy as np
+import pytest
+
+import paddle_hackathon_tpu as paddle
+from paddle_hackathon_tpu import dataset, reader
+from paddle_hackathon_tpu.cost_model import CostModel
+
+
+def test_mnist_readers():
+    sample = next(dataset.mnist.train()())
+    img, label = sample
+    assert img.shape == (784,) and img.dtype == np.float32
+    assert -1.0 <= img.min() and img.max() <= 1.0
+    assert 0 <= label <= 9
+    assert sum(1 for _ in dataset.mnist.test()()) > 0
+
+
+def test_uci_housing_readers():
+    feats, price = next(dataset.uci_housing.train()())
+    assert feats.shape == (13,) and price.shape == (1,)
+    assert len(dataset.uci_housing.feature_names) == 13
+    n_train = sum(1 for _ in dataset.uci_housing.train()())
+    n_test = sum(1 for _ in dataset.uci_housing.test()())
+    assert (n_train, n_test) == (404, 102)  # reference 80/20 split
+
+
+def test_cifar_readers():
+    img, label = next(dataset.cifar.train10()())
+    assert img.shape == (3072,) and 0.0 <= img.min() and img.max() <= 1.0
+    assert 0 <= label < 10
+    img100, label100 = next(dataset.cifar.train100()())
+    assert 0 <= label100 < 100
+    # cycle=True wraps around
+    it = dataset.cifar.test10(cycle=True)()
+    for _ in range(300):
+        next(it)
+
+
+def test_imdb_and_imikolov():
+    wd = dataset.imdb.word_dict()
+    assert "<unk>" in wd
+    doc, label = next(dataset.imdb.train(wd)())
+    assert isinstance(doc, list) and label in (0, 1)
+    toks = next(dataset.imdb.tokenize("train/pos"))
+    assert isinstance(toks, list) and isinstance(toks[0], str)
+
+    d = dataset.imikolov.build_dict()
+    gram = next(dataset.imikolov.train(d, 5)())
+    assert len(gram) == 5
+    src, trg = next(dataset.imikolov.train(
+        d, 5, dataset.imikolov.DataType.SEQ)())
+    assert src[0] == 0 and trg[-1] == 1
+
+
+def test_movielens():
+    row = next(dataset.movielens.train()())
+    assert len(row) == 8  # uid, gender, age, job, mid, cats, title, [rating]
+    assert isinstance(row[-1], list)
+    assert dataset.movielens.max_user_id() == 6040
+    assert dataset.movielens.max_movie_id() == 3952
+    assert dataset.movielens.max_job_id() <= 20
+    cats = dataset.movielens.movie_categories()
+    assert cats["Action"] == 0 and len(cats) == 18
+    assert len(dataset.movielens.user_info()) == 6040
+    mi = dataset.movielens.movie_info()[1]
+    assert len(mi.value()) == 3
+
+
+def test_conll05():
+    word_d, verb_d, label_d = dataset.conll05.get_dict()
+    assert len(label_d) == 106
+    sample = next(dataset.conll05.test()())
+    assert len(sample) == 9  # words, 5 ctx windows, predicate, mark, labels
+    lens = {len(s) for s in
+            (sample[0], sample[1], sample[5], sample[7], sample[8])}
+    assert len(lens) == 1
+    emb = dataset.conll05.get_embedding()
+    assert emb.shape[0] == len(word_d)
+
+
+def test_wmt_readers():
+    src, trg, trg_next = next(dataset.wmt14.train(3000)())
+    assert trg[0] == 0 and trg_next[-1] == 1  # <s> in, <e> next
+    sd, td = dataset.wmt14.get_dict(3000, reverse=False)
+    assert sd["<s>"] == 0 and td["<e>"] == 1
+    src16, trg16, _ = next(dataset.wmt16.train(3000, 3000)())
+    en = dataset.wmt16.get_dict("en", 3000)
+    assert en["<unk>"] == 2
+    with pytest.raises(ValueError):
+        dataset.wmt16.train(100, 100, src_lang="fr")
+
+
+def test_flowers_voc_image():
+    img, label = next(dataset.flowers.train(use_xmap=False)())
+    assert img.shape == (3 * 224 * 224,) and 0 <= label < 102
+    im, seg = next(dataset.voc2012.train()())
+    assert im.shape[0] == 3 and seg.shape == im.shape[1:]
+    # numpy image helpers
+    from paddle_hackathon_tpu.dataset import image as dimg
+    x = (np.random.rand(100, 80, 3) * 255).astype(np.uint8)
+    r = dimg.resize_short(x, 64)
+    assert min(r.shape[:2]) == 64
+    c = dimg.center_crop(r, 32)
+    assert c.shape[:2] == (32, 32)
+    assert dimg.to_chw(c).shape == (3, 32, 32)
+    f = dimg.left_right_flip(x)
+    np.testing.assert_array_equal(f, x[:, ::-1, :])
+    t = dimg.simple_transform(x, 64, 32, is_train=False,
+                              mean=[1.0, 2.0, 3.0])
+    assert t.shape == (3, 32, 32) and t.dtype == np.float32
+
+
+def test_reader_decorators():
+    def nums():
+        return iter(range(10))
+
+    assert list(reader.firstn(nums, 3)()) == [0, 1, 2]
+    assert list(reader.cache(nums)()) == list(range(10))
+    assert sorted(reader.shuffle(nums, 4)()) == list(range(10))
+    assert list(reader.chain(nums, nums)()) == list(range(10)) * 2
+    assert list(reader.buffered(nums, 2)()) == list(range(10))
+    assert list(reader.map_readers(lambda a, b: a + b, nums, nums)()) == \
+        [2 * i for i in range(10)]
+
+    def letters():
+        return iter("ab")
+
+    def pairs():
+        return iter([(1, 2), (3, 4)])
+
+    composed = list(reader.compose(letters, pairs)())
+    assert composed == [("a", 1, 2), ("b", 3, 4)]
+    with pytest.raises(reader.ComposeNotAligned):
+        list(reader.compose(nums, letters)())
+    # xmap keeps order when asked
+    out = list(reader.xmap_readers(lambda x: x * 2, nums, 3, 5, order=True)())
+    assert out == [2 * i for i in range(10)]
+
+
+def test_paddle_batch():
+    def nums():
+        return iter(range(7))
+
+    batches = list(paddle.batch(nums, 3)())
+    assert [len(b) for b in batches] == [3, 3, 1]
+    assert [len(b) for b in paddle.batch(nums, 3, drop_last=True)()] == [3, 3]
+
+
+def test_cost_model():
+    cm = CostModel()
+    data = cm.static_cost_data()
+    assert len(data) >= 10
+    t = cm.get_static_op_time("matmul")
+    assert t["op_time"] > 0
+    tb = cm.get_static_op_time("conv2d", forward=False)
+    assert tb["op_time"] > 0
+    with pytest.raises(ValueError):
+        cm.get_static_op_time(None)
+    sp, mp = cm.build_program()
+    res = cm.profile_measure(sp, mp)
+    assert res["time"] > 0
+
+
+def test_tensor_module():
+    import paddle_hackathon_tpu.tensor as T
+    from paddle_hackathon_tpu.tensor import math as tmath
+    x = paddle.to_tensor(np.eye(3, dtype=np.float32))
+    np.testing.assert_allclose(T.matmul(x, x).numpy(), np.eye(3))
+    assert tmath.add is not None
+    import paddle_hackathon_tpu.tensor.linalg as tlin
+    assert tlin.svd is not None
+
+
+def test_dataset_common_split_and_cluster(tmp_path):
+    import os
+    from paddle_hackathon_tpu.dataset import common
+
+    def r():
+        return iter(range(25))
+
+    suffix = str(tmp_path / "chunk-%05d.pickle")
+    common.split(r, 10, suffix=suffix)
+    files = sorted(os.listdir(tmp_path))
+    assert len(files) >= 2
+    cr = common.cluster_files_reader(str(tmp_path / "chunk-*.pickle"),
+                                     trainer_count=1, trainer_id=0)
+    assert sorted(cr()) == list(range(25))

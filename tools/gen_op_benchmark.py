@@ -1,0 +1,85 @@
+"""Generate cost_model/static_op_benchmark.json by timing ops on the local
+accelerator (run on the TPU chip; schema mirrors the reference's
+``static_op_benchmark.json`` with paddle_gpu_time holding device ms)."""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def timeit(fn, *args, reps=20):
+    jfn = jax.jit(fn)  # jit once; re-jitting per rep would time retracing
+    out = jfn(*args)
+    # hard sync through the axon tunnel
+    float(jnp.sum(jax.tree.leaves(out)[0]).astype(jnp.float32))
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = jfn(*args)
+    float(jnp.sum(jax.tree.leaves(out)[0]).astype(jnp.float32))
+    return (time.perf_counter() - t0) / reps * 1e3
+
+
+def main():
+    r = np.random.RandomState(0)
+    x4 = jnp.asarray(r.randn(16, 128, 257, 257), jnp.float32)
+    m1 = jnp.asarray(r.randn(1024, 1024), jnp.float32)
+    m2 = jnp.asarray(r.randn(1024, 1024), jnp.float32)
+    img = jnp.asarray(r.randn(32, 64, 56, 56), jnp.float32)
+    ker = jnp.asarray(r.randn(64, 64, 3, 3), jnp.float32)
+
+    def conv(x, k):
+        return jax.lax.conv_general_dilated(x, k, (1, 1), "SAME")
+
+    ops = {
+        "abs": (jnp.abs, (x4,), "x (Variable) - dtype: float32, shape: [16, 128, 257, 257]\n"),
+        "relu": (jax.nn.relu, (x4,), "x (Variable) - dtype: float32, shape: [16, 128, 257, 257]\n"),
+        "exp": (jnp.exp, (x4,), "x (Variable) - dtype: float32, shape: [16, 128, 257, 257]\n"),
+        "tanh": (jnp.tanh, (x4,), "x (Variable) - dtype: float32, shape: [16, 128, 257, 257]\n"),
+        "sigmoid": (jax.nn.sigmoid, (x4,), "x (Variable) - dtype: float32, shape: [16, 128, 257, 257]\n"),
+        "softmax": (lambda x: jax.nn.softmax(x, axis=-1), (x4,), "x (Variable) - dtype: float32, shape: [16, 128, 257, 257]\n"),
+        "matmul": (jnp.matmul, (m1, m2), "x (Variable) - dtype: float32, shape: [1024, 1024]; y - float32 [1024, 1024]\n"),
+        "conv2d": (conv, (img, ker), "x (Variable) - dtype: float32, shape: [32, 64, 56, 56]; w float32 [64, 64, 3, 3]\n"),
+        "mean": (jnp.mean, (x4,), "x (Variable) - dtype: float32, shape: [16, 128, 257, 257]\n"),
+        "sum": (jnp.sum, (x4,), "x (Variable) - dtype: float32, shape: [16, 128, 257, 257]\n"),
+        "layer_norm": (lambda x: jax.nn.standardize(x, axis=-1), (x4,), "x (Variable) - dtype: float32, shape: [16, 128, 257, 257]\n"),
+        "elementwise_add": (jnp.add, (x4, x4), "x, y (Variable) - dtype: float32, shape: [16, 128, 257, 257]\n"),
+        "elementwise_mul": (jnp.multiply, (x4, x4), "x, y (Variable) - dtype: float32, shape: [16, 128, 257, 257]\n"),
+        "log_softmax": (lambda x: jax.nn.log_softmax(x, axis=-1), (x4,), "x (Variable) - dtype: float32, shape: [16, 128, 257, 257]\n"),
+        "sqrt": (jnp.sqrt, (jnp.abs(x4),), "x (Variable) - dtype: float32, shape: [16, 128, 257, 257]\n"),
+    }
+    rows = []
+    stamp = time.strftime("%Y.%m%d.%H%M%S") + ".tpu-v5e"
+    for i, (name, (fn, args, cfg)) in enumerate(ops.items()):
+        fwd = timeit(fn, *args)
+
+        def loss(*a):
+            return jnp.sum(fn(*a))
+        bwd = timeit(jax.grad(loss, argnums=tuple(range(len(args)))), *args)
+        rows.append({
+            "name": f"{name}_0",
+            "op": name,
+            "op_count": 0,
+            "config": cfg,
+            "timestamp": stamp,
+            "paddle_gpu_time": round(fwd, 4),
+            "paddle_gpu_time_backward": round(bwd, 4),
+            "device": "tpu-v5e (this framework's measured device ms)",
+        })
+        print(name, round(fwd, 3), round(bwd, 3))
+    out = os.path.join(os.path.dirname(__file__), "..",
+                       "paddle_hackathon_tpu", "cost_model",
+                       "static_op_benchmark.json")
+    with open(out, "w") as f:
+        json.dump(rows, f, indent=1)
+    print("wrote", out)
+
+
+if __name__ == "__main__":
+    main()
