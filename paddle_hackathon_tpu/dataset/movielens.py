@@ -33,7 +33,7 @@ class MovieInfo:
         """[id, [category ids], [title word ids]]"""
         return [self.index,
                 [_CATEGORIES.index(c) for c in self.categories],
-                [hash(w) % 5000 for w in self.title.split()]]
+                [_word_id(w) for w in self.title.split()]]
 
     def __str__(self):
         return (f"<MovieInfo id({self.index}), title({self.title}), "
@@ -60,6 +60,13 @@ class UserInfo:
                 f"age({age_table[self.age]}), job({self.job_id})>")
 
     __repr__ = __str__
+
+
+def _word_id(w):
+    """Stable title-word id: Python's hash() is salted per process
+    (PYTHONHASHSEED), so use md5 — same id across runs and worker procs."""
+    import hashlib
+    return int(hashlib.md5(w.encode()).hexdigest()[:8], 16) % 5000
 
 
 _META = None

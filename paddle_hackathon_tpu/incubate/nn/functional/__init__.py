@@ -36,16 +36,25 @@ def _dropout(h, rate, key, mode="upscale_in_train"):
     return jnp.where(keep, h, 0.0).astype(h.dtype)
 
 
-def flash_attention_bshd(query, key, value, causal=False, sm_scale=None):
+def flash_attention_bshd(query, key, value, causal=False, sm_scale=None,
+                         dropout_p=0.0, seed=None):
     """Flash attention over paddle-layout (batch, seq, heads, head_dim).
 
-    Falls back to the caller's XLA path by raising if shapes don't qualify.
+    ``dropout_p`` drops attention probabilities inside the kernel (ref
+    ``fused_attention_op.cu`` attn_dropout); the mask is regenerated from
+    ``seed`` in the backward, never materialised. Falls back to the
+    caller's XLA path by raising if shapes don't qualify.
     """
     b, sq, h, d = query.shape
     skv = key.shape[1]
     if not _fa.supported(sq, skv):
         raise ValueError(f"flash kernel unsupported for seq ({sq},{skv})")
     scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
+    if dropout_p and seed is None:
+        from ....core import random as core_random
+        key_arr = core_random.split_key()
+        seed = jax.random.randint(key_arr, (1,), -2**31, 2**31 - 1,
+                                  dtype=jnp.int32)
 
     def fn(q, k, v):
         def to_bhd(x, s):
@@ -56,7 +65,8 @@ def flash_attention_bshd(query, key, value, causal=False, sm_scale=None):
             return x.reshape(b * h, s, d)
 
         out = _fa.flash_attention_bhd(
-            to_bhd(q, sq), to_bhd(k, skv), to_bhd(v, skv), causal, scale)
+            to_bhd(q, sq), to_bhd(k, skv), to_bhd(v, skv), causal, scale,
+            float(dropout_p), seed)
         out = out.reshape(b, h, sq, d)
         return jnp.swapaxes(out, 1, 2)          # b s h d
 
@@ -67,12 +77,8 @@ def flash_attention(query, key, value, dropout=0.0, causal=False,
                     return_softmax=False, name=None):
     """paddle.incubate flash_attention-style API: returns (out, softmax)."""
     assert not return_softmax, "flash kernel never materialises softmax"
-    if dropout:
-        raise NotImplementedError(
-            "attention-probability dropout inside the flash kernel is not "
-            "implemented; use nn.functional.scaled_dot_product_attention "
-            "(XLA path) when dropout_p > 0")
-    out = flash_attention_bshd(query, key, value, causal=causal)
+    out = flash_attention_bshd(query, key, value, causal=causal,
+                               dropout_p=dropout)
     return out, None
 
 

@@ -44,6 +44,37 @@ def _interpret() -> bool:
     return jax.default_backend() not in ("tpu", "axon")
 
 
+def _dropout_keep(seed, b, q_pos, k_pos, keep_prob):
+    """Layout-independent dropout mask: a murmur-style integer hash of
+    (seed, batch*head, q position, k position) so the forward kernel and
+    both backward kernels — which see the score matrix in different
+    layouts — regenerate the identical mask without storing it (the
+    reference's fused attention stores the O(s^2) mask; fmha_ref.h).
+    int32 ops wrap, which is fine for mixing."""
+    # avalanche the (seed, b) word BEFORE mixing positions, with distinct
+    # odd constants per coordinate — otherwise masks are shifted copies
+    # across batch*head (h would depend on b + q_pos only)
+    h = (seed ^ (b * jnp.int32(-2048144789))).astype(jnp.int32)  # 0x85EBCA6B
+    h = (h ^ (h >> 16)) * jnp.int32(-1640531527)    # 0x9E3779B9
+    h = h + q_pos * jnp.int32(-1028477387)          # 0xC2B2AE35
+    h = (h ^ (h >> 13)) * jnp.int32(668265261)      # 0x27D4EB2F
+    h = h + k_pos * jnp.int32(461845907)            # 0x1B873593
+    h = (h ^ (h >> 16)) * jnp.int32(-2048144789)
+    h = h ^ (h >> 13)
+    bits23 = h & jnp.int32(0x7FFFFF)
+    thresh = jnp.int32(int(keep_prob * float(0x800000)))
+    return bits23 < thresh
+
+
+def _smem_spec():
+    """(1,) int32 scalar input block (seed) — SMEM on TPU, plain block
+    under the CPU interpreter."""
+    from jax.experimental.pallas import tpu as pltpu
+    if _interpret():
+        return pl.BlockSpec((1,), lambda *_: (0,))
+    return pl.BlockSpec(memory_space=pltpu.SMEM)
+
+
 def _block_sizes(sq: int, skv: int, dtype=jnp.bfloat16):
     """Pick (block_q, block_kv). Swept on v5e (fwd+bwd, bf16, d=64,
     B*H=288): square 1024x1024 blocks win at every seq length that admits
@@ -71,9 +102,10 @@ def supported(sq: int, skv: int) -> bool:
 # Forward
 # ---------------------------------------------------------------------------
 
-def _fwd_kernel(q_ref, kt_ref, v_ref, o_ref, lse_ref,
+def _fwd_kernel(q_ref, kt_ref, v_ref, seed_ref, o_ref, lse_ref,
                 acc_ref, m_ref, l_ref, *, sm_scale, causal, block_q,
-                block_kv, n_kv):
+                block_kv, n_kv, dropout_p):
+    bi = pl.program_id(0)
     qi = pl.program_id(1)
     ki = pl.program_id(2)
 
@@ -108,6 +140,17 @@ def _fwd_kernel(q_ref, kt_ref, v_ref, o_ref, lse_ref,
         p = jnp.exp(s - m_next[:, :1])                   # (block_q, block_kv)
         l_ref[...] = l_prev * alpha + jnp.sum(
             p, axis=1, keepdims=True) * jnp.ones_like(l_prev)
+        if dropout_p > 0.0:
+            # drop the unnormalised p only in the PV accumulation: the
+            # final /l then equals dropout(softmax(s)) @ v, and lse stays
+            # the exact (undropped) statistic the backward needs
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_kv), 0)
+            k_pos = ki * block_kv + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_kv), 1)
+            keep = _dropout_keep(seed_ref[0], bi, q_pos, k_pos,
+                                 1.0 - dropout_p)
+            p = jnp.where(keep, p / (1.0 - dropout_p), 0.0)
         pv = jax.lax.dot_general(p.astype(v.dtype), v,
                                  (((1,), (0,)), ((), ())),
                                  preferred_element_type=jnp.float32,
@@ -135,15 +178,17 @@ def _fwd_kernel(q_ref, kt_ref, v_ref, o_ref, lse_ref,
         lse_ref[0] = jnp.swapaxes(lse2d[:, :_SUB], 0, 1)
 
 
-def _fwd(q, k, v, causal, sm_scale):
+def _fwd(q, k, v, causal, sm_scale, dropout_p=0.0, seed=None):
     bh, sq, d = q.shape
     skv = k.shape[1]
     bq, bkv = _block_sizes(sq, skv, q.dtype)
     n_q, n_kv = sq // bq, skv // bkv
 
+    if seed is None:
+        seed = jnp.zeros((1,), jnp.int32)
     kernel = functools.partial(
         _fwd_kernel, sm_scale=sm_scale, causal=causal, block_q=bq,
-        block_kv=bkv, n_kv=n_kv)
+        block_kv=bkv, n_kv=n_kv, dropout_p=dropout_p)
     kt = jnp.swapaxes(k, 1, 2)  # (bh, d, skv)
     out, lse = pl.pallas_call(
         kernel,
@@ -152,6 +197,7 @@ def _fwd(q, k, v, causal, sm_scale):
             pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, d, bkv), lambda b, i, j: (b, 0, j)),
             pl.BlockSpec((1, bkv, d), lambda b, i, j: (b, j, 0)),
+            _smem_spec(),
         ],
         out_specs=[
             pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
@@ -163,7 +209,7 @@ def _fwd(q, k, v, causal, sm_scale):
         ],
         scratch_shapes=_fwd_scratch(bq, d),
         interpret=_interpret(),
-    )(q, kt, v)
+    )(q, kt, v, seed)
     return out, lse
 
 
@@ -181,9 +227,9 @@ def _fwd_scratch(bq, d):
 # ---------------------------------------------------------------------------
 
 def _bwd_dkdv_kernel(q_ref, qt_ref, k_ref, v_ref, do_ref, dot_ref,
-                     lse_ref, delta_ref,
+                     lse_ref, delta_ref, seed_ref,
                      dk_ref, dv_ref, dk_acc, dv_acc, *, sm_scale, causal,
-                     block_q, block_kv, n_q):
+                     block_q, block_kv, n_q, dropout_p):
     """dk/dv in transposed (kv, q) layout.
 
     Every contraction is a standard (1),(0) dot — the only shape Mosaic's
@@ -194,6 +240,7 @@ def _bwd_dkdv_kernel(q_ref, qt_ref, k_ref, v_ref, do_ref, dot_ref,
     stay bf16 on the MXU (f32 accumulate); only softmax/elementwise math
     is f32.
     """
+    bi = pl.program_id(0)
     ki = pl.program_id(1)
     qi = pl.program_id(2)
 
@@ -223,14 +270,27 @@ def _bwd_dkdv_kernel(q_ref, qt_ref, k_ref, v_ref, do_ref, dot_ref,
                 jnp.int32, (block_kv, block_q), 1)
             st = jnp.where(q_pos >= k_pos, st, _NEG_INF)
         pt = jnp.exp(st - lse)                  # (block_kv, block_q)
-        # dv += p^T @ dO                          (block_kv, d)
+        pt_v = pt
+        if dropout_p > 0.0:
+            # same positional-hash mask as the forward (transposed layout:
+            # k along rows, q along columns)
+            k_pos_t = ki * block_kv + jax.lax.broadcasted_iota(
+                jnp.int32, (block_kv, block_q), 0)
+            q_pos_t = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_kv, block_q), 1)
+            keep = _dropout_keep(seed_ref[0], bi, q_pos_t, k_pos_t,
+                                 1.0 - dropout_p)
+            pt_v = jnp.where(keep, pt / (1.0 - dropout_p), 0.0)
+        # dv += dropout(p)^T @ dO                 (block_kv, d)
         dv_acc[...] += jax.lax.dot_general(
-            pt.astype(v.dtype), do, (((1,), (0,)), ((), ())),
+            pt_v.astype(v.dtype), do, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32, precision=_prec(v.dtype))
         # dp^T = v @ dO^T                         (block_kv, block_q)
         dpt = jax.lax.dot_general(v, dot_, (((1,), (0,)), ((), ())),
                                   preferred_element_type=jnp.float32,
                                   precision=_prec(v.dtype))
+        if dropout_p > 0.0:
+            dpt = jnp.where(keep, dpt / (1.0 - dropout_p), 0.0)
         dst = pt * (dpt - delta) * sm_scale
         # dk += ds^T @ q                          (block_kv, d)
         dk_acc[...] += jax.lax.dot_general(
@@ -251,12 +311,14 @@ def _bwd_dkdv_kernel(q_ref, qt_ref, k_ref, v_ref, do_ref, dot_ref,
 
 
 def _bwd_dq_kernel(q_ref, kt_ref, k_ref, vt_ref, do_ref, lse_ref, delta_ref,
+                   seed_ref,
                    dq_ref, dq_acc, *, sm_scale, causal, block_q, block_kv,
-                   n_kv):
+                   n_kv, dropout_p):
     """dq in natural (q, kv) layout; k/v arrive pre-transposed (d, block_kv)
     so every dot is a standard (1),(0) bf16 contraction (see dkdv kernel).
     lse/delta arrive in the (8, block_q) stats layout and are transposed to
     a (block_q, 1) column in-VMEM (a cheap sublane/lane swap)."""
+    bi = pl.program_id(0)
     qi = pl.program_id(1)
     ki = pl.program_id(2)
 
@@ -287,6 +349,14 @@ def _bwd_dq_kernel(q_ref, kt_ref, k_ref, vt_ref, do_ref, lse_ref, delta_ref,
         dp = jax.lax.dot_general(do, vt, (((1,), (0,)), ((), ())),
                                  preferred_element_type=jnp.float32,
                                  precision=_prec(do.dtype))
+        if dropout_p > 0.0:
+            q_pos2 = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_kv), 0)
+            k_pos2 = ki * block_kv + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_kv), 1)
+            keep = _dropout_keep(seed_ref[0], bi, q_pos2, k_pos2,
+                                 1.0 - dropout_p)
+            dp = jnp.where(keep, dp / (1.0 - dropout_p), 0.0)
         ds = p * (dp - delta) * sm_scale
         # dq += ds @ k                            (block_q, d)
         dq_acc[...] += jax.lax.dot_general(
@@ -305,8 +375,10 @@ def _bwd_dq_kernel(q_ref, kt_ref, k_ref, vt_ref, do_ref, lse_ref, delta_ref,
         dq_ref[0] = dq_acc[...].astype(dq_ref.dtype)
 
 
-def _bwd(causal, sm_scale, res, do):
-    q, k, v, out, lse = res
+def _bwd(causal, sm_scale, dropout_p, res, do):
+    q, k, v, out, lse, seed = res
+    if seed is None:
+        seed = jnp.zeros((1,), jnp.int32)
     bh, sq, d = q.shape
     skv = k.shape[1]
     bq, bkv = _block_sizes(sq, skv, q.dtype)
@@ -324,7 +396,7 @@ def _bwd(causal, sm_scale, res, do):
 
     dkdv = functools.partial(
         _bwd_dkdv_kernel, sm_scale=sm_scale, causal=causal, block_q=bq,
-        block_kv=bkv, n_q=n_q)
+        block_kv=bkv, n_q=n_q, dropout_p=dropout_p)
     dk, dv = pl.pallas_call(
         dkdv,
         grid=(bh, n_kv, n_q),
@@ -337,6 +409,7 @@ def _bwd(causal, sm_scale, res, do):
             pl.BlockSpec((1, d, bq), lambda b, j, i: (b, 0, i)),    # do^T
             pl.BlockSpec((1, _SUB, bq), lambda b, j, i: (b, 0, i)),  # lse^T
             pl.BlockSpec((1, _SUB, bq), lambda b, j, i: (b, 0, i)),  # delta^T
+            _smem_spec(),
         ],
         out_specs=[
             pl.BlockSpec((1, bkv, d), lambda b, j, i: (b, j, 0)),
@@ -351,11 +424,11 @@ def _bwd(causal, sm_scale, res, do):
             pltpu.VMEM((bkv, d), jnp.float32),
         ],
         interpret=_interpret(),
-    )(q, qt, k, v, do, dot_, lse_t, delta_t)
+    )(q, qt, k, v, do, dot_, lse_t, delta_t, seed)
 
     dqk = functools.partial(
         _bwd_dq_kernel, sm_scale=sm_scale, causal=causal, block_q=bq,
-        block_kv=bkv, n_kv=n_kv)
+        block_kv=bkv, n_kv=n_kv, dropout_p=dropout_p)
     dq = pl.pallas_call(
         dqk,
         grid=(bh, n_q, n_kv),
@@ -367,29 +440,37 @@ def _bwd(causal, sm_scale, res, do):
             pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),    # do
             pl.BlockSpec((1, _SUB, bq), lambda b, i, j: (b, 0, i)),  # lse
             pl.BlockSpec((1, _SUB, bq), lambda b, i, j: (b, 0, i)),  # delta
+            _smem_spec(),
         ],
         out_specs=pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
         interpret=_interpret(),
-    )(q, kt, k, vt, do, lse_t, delta_t)
-    return dq, dk, dv
+    )(q, kt, k, vt, do, lse_t, delta_t, seed)
+    return dq, dk, dv, None
 
 
 # ---------------------------------------------------------------------------
 # custom_vjp wrapper
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def flash_attention_bhd(q, k, v, causal, sm_scale):
-    """Flash attention over (batch*heads, seq, head_dim) arrays."""
-    out, _ = _fwd(q, k, v, causal, sm_scale)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def flash_attention_bhd(q, k, v, causal, sm_scale, dropout_p=0.0,
+                        seed=None):
+    """Flash attention over (batch*heads, seq, head_dim) arrays.
+
+    ``dropout_p`` drops attention probabilities inside the kernel (the
+    mask is a positional hash of ``seed``, regenerated — never stored —
+    in the backward kernels). ``seed`` is a (1,) int32 array; required
+    when ``dropout_p > 0``.
+    """
+    out, _ = _fwd(q, k, v, causal, sm_scale, dropout_p, seed)
     return out
 
 
-def _vjp_fwd(q, k, v, causal, sm_scale):
-    out, lse = _fwd(q, k, v, causal, sm_scale)
-    return out, (q, k, v, out, lse)
+def _vjp_fwd(q, k, v, causal, sm_scale, dropout_p=0.0, seed=None):
+    out, lse = _fwd(q, k, v, causal, sm_scale, dropout_p, seed)
+    return out, (q, k, v, out, lse, seed)
 
 
 flash_attention_bhd.defvjp(_vjp_fwd, _bwd)

@@ -40,13 +40,18 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
                     and query.shape[1] >= flags.flag("flash_attention_min_seqlen"))
     else:
         flash_ok = use_flash
-    if flash_ok and attn_mask is None and dropout_p == 0.0:
+    if flash_ok and attn_mask is None:
+        eff_drop = dropout_p if training else 0.0
         try:
             from ...incubate.nn.functional import flash_attention_bshd
             return flash_attention_bshd(_t(query), _t(key), _t(value),
-                                        causal=is_causal)
-        except Exception:
-            pass  # fall back to the XLA composition
+                                        causal=is_causal,
+                                        dropout_p=eff_drop)
+        except ValueError:
+            # the kernel's explicit unsupported-shape signal; anything else
+            # is a real bug and must surface (a blanket except once hid a
+            # 23x throughput regression via the O(S^2) fallback)
+            pass
 
     scale = 1.0 / math.sqrt(query.shape[-1])
     drop_key = None

@@ -47,3 +47,4 @@ from . import auto_parallel  # noqa: F401
 from .auto_parallel import (Engine, ProcessMesh, shard_op,  # noqa: F401
                             shard_tensor)
 from .store import TCPStore  # noqa: F401
+from .dist_checkpoint import load_sharded, reshard, save_sharded  # noqa: F401

@@ -253,7 +253,13 @@ def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
             try:
                 sample = q.get(timeout=60)
             except _queue_mod.Empty:
-                raise RuntimeError("multiprocess_reader queue timed out")
+                # slow readers are fine while their processes live; only a
+                # wedged pipeline (all workers dead, queue empty) is fatal
+                if any(p.is_alive() for p in procs):
+                    continue
+                raise RuntimeError(
+                    "multiprocess_reader: all reader processes exited "
+                    "without finishing")
             if sample is None:
                 finish_num += 1
             elif sample == "":
