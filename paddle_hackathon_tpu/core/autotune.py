@@ -1,0 +1,144 @@
+"""Runtime kernel autotuning with a result cache.
+
+Reference: ``paddle/phi/kernels/autotune/`` — ``auto_tune_base.h`` measures
+candidate algorithms for a kernel signature once and caches the winner
+(``cache.h``), gated by ``switch_autotune.cc`` and configured from python
+via ``paddle.incubate.autotune`` (``python/paddle/incubate/autotune.py``).
+
+TPU-native scope: XLA autotunes its own fusions inside the compiler, so
+the tunable surface here is the Pallas kernels' launch parameters (block
+shapes). Tuning runs in eager mode only — under a jit trace there is
+nothing to measure — which mirrors the reference's dygraph warmup-step
+tuning window; the cached winner is then used by traced/compiled calls.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, Hashable, List, Optional, Tuple
+
+__all__ = ["AutoTuneCache", "kernel_cache", "enabled", "in_tuning_window",
+           "set_config", "step", "status", "tune"]
+
+_config = {
+    "kernel": {"enable": False, "tuning_range": [1, 10]},
+    "layout": {"enable": False},
+    "dataloader": {"enable": False},
+}
+_step_count = 0
+
+
+class AutoTuneCache:
+    """Winner cache keyed by an arbitrary hashable kernel signature
+    (ref ``cache.h`` AlgorithmsCache)."""
+
+    def __init__(self):
+        self._cache: Dict[Hashable, object] = {}
+        self.hits = 0
+        self.misses = 0
+
+    def get(self, key):
+        if key in self._cache:
+            self.hits += 1
+            return self._cache[key]
+        self.misses += 1
+        return None
+
+    def put(self, key, value):
+        self._cache[key] = value
+
+    def size(self):
+        return len(self._cache)
+
+    def clear(self):
+        self._cache.clear()
+        self.hits = self.misses = 0
+
+
+kernel_cache = AutoTuneCache()
+
+
+def set_config(config: Optional[dict] = None):
+    """Apply a ``paddle.incubate.autotune``-style config
+    (ref ``incubate/autotune.py`` set_config): a dict — or a JSON file
+    path, as the reference accepts — with keys 'kernel'
+    ({enable, tuning_range}), 'layout', 'dataloader'. Enabling kernel
+    tuning resets the step counter so the tuning window is relative to
+    now (the reference counts from training start)."""
+    global _config, _step_count
+    if config is None:
+        _config["kernel"]["enable"] = True
+        _step_count = 0
+        return
+    if isinstance(config, str):
+        import json
+        with open(config) as f:
+            config = json.load(f)
+    if not isinstance(config, dict):
+        raise TypeError(
+            f"autotune config must be a dict or JSON file path, got "
+            f"{type(config)}")
+    for section in ("kernel", "layout", "dataloader"):
+        if section in config:
+            _config[section].update(config[section])
+    if config.get("kernel", {}).get("enable"):
+        _step_count = 0
+
+
+def enabled() -> bool:
+    return bool(_config["kernel"]["enable"])
+
+
+def in_tuning_window() -> bool:
+    lo, hi = _config["kernel"].get("tuning_range", [1, 10])
+    return lo <= _step_count <= hi
+
+
+def step():
+    """Advance the autotune step counter (called from optimizer.step);
+    tuning only happens inside the configured step range."""
+    global _step_count
+    _step_count += 1
+
+
+def status() -> dict:
+    return {"config": _config, "step": _step_count,
+            "cache_size": kernel_cache.size(),
+            "hits": kernel_cache.hits, "misses": kernel_cache.misses}
+
+
+def tune(key: Hashable, candidates: List, measure: Callable[[object], float],
+         default=None):
+    """Measure every candidate once, cache and return the fastest
+    (ref ``auto_tune_base.h`` AutoTuneBase::PickBestAlgorithm).
+
+    ``measure(candidate) -> seconds`` should include a device sync; a
+    candidate that raises is skipped. Returns ``default`` (or the first
+    candidate) when tuning is disabled or everything fails.
+    """
+    cached = kernel_cache.get(key)
+    if cached is not None:
+        return cached
+    best, best_t = None, float("inf")
+    for cand in candidates:
+        try:
+            t = measure(cand)
+        except Exception:
+            continue
+        if t < best_t:
+            best, best_t = cand, t
+    if best is None:
+        best = default if default is not None else candidates[0]
+    kernel_cache.put(key, best)
+    return best
+
+
+def measure_wall(fn: Callable[[], None], reps: int = 3) -> float:
+    """Median wall time of ``fn()`` over ``reps`` runs (fn must sync)."""
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        fn()
+        ts.append(time.perf_counter() - t0)
+    ts.sort()
+    return ts[len(ts) // 2]

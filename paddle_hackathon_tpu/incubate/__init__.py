@@ -18,8 +18,13 @@ from .. import sparse  # noqa: F401 — paddle.incubate.sparse surface
 
 
 def autotune(config=None):
-    """paddle.incubate.autotune stub — on TPU, kernel autotuning is XLA's
-    job (autotuner runs inside the compiler); layout autotune is subsumed by
-    XLA layout assignment. Accepts and ignores the reference's config dict
-    (ref incubate/autotune.py)."""
-    return None
+    """paddle.incubate.autotune (ref ``incubate/autotune.py`` set_config).
+
+    kernel: enables the runtime Pallas-kernel autotuner
+    (``core.autotune``) — flash-attention block shapes are measured per
+    signature during the configured eager tuning window and cached.
+    layout: subsumed by XLA layout assignment. dataloader: accepted for
+    parity. XLA additionally autotunes its own fusions in-compiler."""
+    from ..core import autotune as _at
+    _at.set_config(config)
+    return _at.status()

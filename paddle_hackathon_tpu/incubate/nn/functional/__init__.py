@@ -64,9 +64,10 @@ def flash_attention_bshd(query, key, value, causal=False, sm_scale=None,
             x = jnp.swapaxes(x, 1, 2)           # b h s d
             return x.reshape(b * h, s, d)
 
-        out = _fa.flash_attention_bhd(
-            to_bhd(q, sq), to_bhd(k, skv), to_bhd(v, skv), causal, scale,
-            float(dropout_p), seed)
+        qb, kb, vb = to_bhd(q, sq), to_bhd(k, skv), to_bhd(v, skv)
+        _fa.maybe_autotune(qb, kb, vb, causal, scale)
+        out = _fa.flash_attention_bhd(qb, kb, vb, causal, scale,
+                                      float(dropout_p), seed)
         out = out.reshape(b, h, sq, d)
         return jnp.swapaxes(out, 1, 2)          # b s h d
 

@@ -82,15 +82,79 @@ def _block_sizes(sq: int, skv: int, dtype=jnp.bfloat16):
     S=4096. Wider blocks blow the 16 MB scoped-VMEM budget (the s/p
     temporaries are f32 (bq, bkv): 4 MB at 1024^2); with f32 *operands*
     the backward's doubled input blocks push a 1024^2 grid cell past the
-    budget too, so f32 caps at 512."""
-    cap = 1024 if jnp.dtype(dtype).itemsize <= 2 else 512
-    bq = next((b for b in (1024, 512, 256, 128, 64, 32, 16, 8)
+    budget too, so f32 caps at 512.
+
+    ``paddle.incubate.autotune`` overrides this default per shape: a
+    measured winner in the autotune cache (keyed like _tune_key) wins."""
+    from ....core import autotune as _at
+    cached = _at.kernel_cache.get(_tune_key(sq, skv, dtype))         if _at.enabled() else None
+    if cached is not None:
+        return cached
+    cap = _vmem_cap(dtype)
+    bq = next((b for b in _BLOCK_CANDIDATES
                if b <= min(sq, cap) and sq % b == 0), None)
-    bkv = next((b for b in (1024, 512, 256, 128, 64, 32, 16, 8)
+    bkv = next((b for b in _BLOCK_CANDIDATES
                 if b <= min(skv, cap) and skv % b == 0), None)
     if bq is None or bkv is None:
         return None
     return bq, bkv
+
+
+_BLOCK_CANDIDATES = (1024, 512, 256, 128, 64, 32, 16, 8)
+
+
+def _vmem_cap(dtype):
+    """Largest admissible block edge under the 16 MB scoped-VMEM budget
+    (single source for the default chooser AND the autotuner's candidate
+    set — they must agree on what is safe)."""
+    return 1024 if jnp.dtype(dtype).itemsize <= 2 else 512
+
+
+def _tune_key(sq, skv, dtype):
+    return ("flash_attention_blocks", sq, skv, jnp.dtype(dtype).itemsize)
+
+
+def _candidate_blocks(sq, skv, dtype):
+    cap = 1024 if jnp.dtype(dtype).itemsize <= 2 else 512
+    cands = []
+    for bq in (1024, 512, 256, 128):
+        for bkv in (1024, 512, 256, 128):
+            if bq <= min(sq, cap) and bkv <= min(skv, cap)                     and sq % bq == 0 and skv % bkv == 0:
+                cands.append((bq, bkv))
+    return cands
+
+
+def maybe_autotune(q, k, v, causal, sm_scale):
+    """Eager-mode block-shape autotune (ref ``auto_tune_base.h``): when
+    ``incubate.autotune`` enabled kernel tuning and we are inside the
+    tuning step window, measure the fwd kernel across candidate block
+    shapes for this (sq, skv, dtype) signature and cache the winner.
+    No-op under a jit trace (nothing can be measured) — the cache filled
+    during eager warmup steps then serves compiled calls too. Measurement
+    covers the forward kernel only (the backward shares the cached block
+    choice); the static default remains the bwd-swept optimum when tuning
+    is off."""
+    from ....core import autotune as _at
+    if not (_at.enabled() and _at.in_tuning_window()):
+        return
+    if isinstance(q, jax.core.Tracer) or _interpret():
+        return
+    sq, skv = q.shape[1], k.shape[1]
+    key = _tune_key(sq, skv, q.dtype)
+    if _at.kernel_cache.get(key) is not None:
+        return
+    default = _block_sizes(sq, skv, q.dtype)
+    cands = _candidate_blocks(sq, skv, q.dtype)
+
+    def measure(blocks):
+        def run():
+            out, _ = _fwd(q, k, v, causal, sm_scale, _blocks=blocks)
+            jax.block_until_ready(out)
+            float(jnp.sum(out[..., :1].astype(jnp.float32)))  # hard sync
+        run()  # compile outside the timed reps
+        return _at.measure_wall(run)
+
+    _at.tune(key, cands, measure, default=default)
 
 
 def supported(sq: int, skv: int) -> bool:
@@ -178,10 +242,11 @@ def _fwd_kernel(q_ref, kt_ref, v_ref, seed_ref, o_ref, lse_ref,
         lse_ref[0] = jnp.swapaxes(lse2d[:, :_SUB], 0, 1)
 
 
-def _fwd(q, k, v, causal, sm_scale, dropout_p=0.0, seed=None):
+def _fwd(q, k, v, causal, sm_scale, dropout_p=0.0, seed=None,
+         _blocks=None):
     bh, sq, d = q.shape
     skv = k.shape[1]
-    bq, bkv = _block_sizes(sq, skv, q.dtype)
+    bq, bkv = _blocks or _block_sizes(sq, skv, q.dtype)
     n_q, n_kv = sq // bq, skv // bkv
 
     if seed is None:

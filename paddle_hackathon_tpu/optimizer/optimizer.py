@@ -85,6 +85,8 @@ class Optimizer:
 
     def step(self):
         """Apply one update (ref ``Optimizer.step`` ``optimizer.py:1232``)."""
+        from ..core import autotune as _autotune
+        _autotune.step()  # advances the incubate.autotune tuning window
         params = [p for p in self._parameter_list
                   if p.trainable and p._grad_value is not None]
         if not params:

@@ -429,3 +429,7 @@ class SortedKeys(Enum):
 
 
 __all__.append("SortedKeys")
+
+
+from . import cross_stack  # noqa: E402,F401
+from .cross_stack import merge_traces  # noqa: E402,F401

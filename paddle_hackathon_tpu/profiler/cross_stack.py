@@ -1,0 +1,105 @@
+"""Cluster-level (multi-rank) timeline merging.
+
+Reference: ``tools/CrossStackProfiler/`` — ``ProfileFileReader`` /
+``NetFileReader`` post-process per-rank profiler dumps into a single
+cluster timeline (CspReporter merges per-trainer chrome traces under
+distinct pids).
+
+Here each rank's ``profiler.export_chrome_tracing`` JSON becomes one
+process row in a merged chrome trace: pid = rank, thread rows preserved,
+optional time alignment on a named sync marker (e.g. the per-step
+``RecordEvent("step")``) so ranks with skewed host clocks line up.
+"""
+
+from __future__ import annotations
+
+import glob
+import gzip
+import json
+import os
+import re
+from typing import List, Optional
+
+__all__ = ["merge_traces", "main"]
+
+
+def _load(path: str) -> dict:
+    op = gzip.open if path.endswith(".gz") else open
+    with op(path, "rt") as f:
+        return json.load(f)
+
+
+def _rank_of(path: str, idx: int) -> int:
+    m = re.search(r"(?:rank|worker|trainer)[_-]?(\d+)", os.path.basename(path))
+    return int(m.group(1)) if m else idx
+
+
+def merge_traces(paths: List[str], align_marker: Optional[str] = None,
+                 out_path: Optional[str] = None) -> dict:
+    """Merge per-rank chrome traces into one cluster timeline.
+
+    ``align_marker``: event name whose first occurrence is treated as t=0
+    on every rank (clock-skew compensation — the reference aligns on its
+    profile step windows). Returns the merged trace dict; writes it to
+    ``out_path`` when given.
+    """
+    merged = {"traceEvents": [], "displayTimeUnit": "ms"}
+    ordered = sorted(paths)
+    ranks = [_rank_of(p_, i) for i, p_ in enumerate(ordered)]
+    if len(set(ranks)) != len(ranks):
+        # mixed named/unnamed files collided — fall back to positional pids
+        ranks = list(range(len(ordered)))
+    for idx, path in enumerate(ordered):
+        rank = ranks[idx]
+        trace = _load(path)
+        events = trace.get("traceEvents", trace if isinstance(trace, list)
+                           else [])
+        t0 = 0.0
+        if align_marker is not None:
+            starts = [e["ts"] for e in events
+                      if e.get("name") == align_marker and "ts" in e]
+            t0 = min(starts) if starts else 0.0
+        merged["traceEvents"].append({
+            "ph": "M", "name": "process_name", "pid": rank,
+            "args": {"name": f"rank {rank} "
+                             f"({os.path.basename(path).split('_step')[0]})"},
+        })
+        merged["traceEvents"].append({
+            "ph": "M", "name": "process_sort_index", "pid": rank,
+            "args": {"sort_index": rank},
+        })
+        for e in events:
+            if e.get("ph") == "M" and e.get("name") in (
+                    "process_name", "process_sort_index"):
+                continue  # replaced by the synthesized rank rows above
+            e = dict(e)
+            e["pid"] = rank
+            if e.get("ph") != "M" and "ts" in e:
+                e["ts"] = e["ts"] - t0
+            merged["traceEvents"].append(e)
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(merged, f)
+    return merged
+
+
+def main(argv=None):
+    import argparse
+    ap = argparse.ArgumentParser(
+        description="Merge per-rank profiler chrome traces into one "
+                    "cluster timeline (ref tools/CrossStackProfiler)")
+    ap.add_argument("trace_dir", help="directory of per-rank *.json traces")
+    ap.add_argument("-o", "--out", default="cluster_trace.json")
+    ap.add_argument("--align", default=None,
+                    help="event name used as per-rank t=0 (clock-skew fix)")
+    args = ap.parse_args(argv)
+    paths = sorted(glob.glob(os.path.join(args.trace_dir, "*.json")) +
+                   glob.glob(os.path.join(args.trace_dir, "*.json.gz")))
+    if not paths:
+        raise SystemExit(f"no traces found under {args.trace_dir}")
+    merge_traces(paths, align_marker=args.align, out_path=args.out)
+    print(f"merged {len(paths)} rank traces -> {args.out}")
+
+
+if __name__ == "__main__":
+    main()

@@ -259,3 +259,63 @@ def test_flash_dropout_mask_decorrelated_across_heads():
     m1 = np.asarray(_dropout_keep(seed, jnp2.int32(1), q, k, 0.5))
     assert (m0 != m1).mean() > 0.3          # independent-ish
     assert (m0[1:, :] != m1[:-1, :]).mean() > 0.3  # not a shifted copy
+
+
+def test_kernel_autotune_cache():
+    """incubate.autotune kernel tuning: candidates measured once, winner
+    cached and used by _block_sizes (ref phi/kernels/autotune)."""
+    from paddle_hackathon_tpu.core import autotune as at
+    from paddle_hackathon_tpu.incubate.nn.kernels import flash_attention as fa
+
+    at.kernel_cache.clear()
+    st = incubate.autotune({"kernel": {"enable": True,
+                                       "tuning_range": [0, 100]}})
+    assert st["config"]["kernel"]["enable"]
+
+    calls = []
+
+    def measure(cand):
+        calls.append(cand)
+        return 0.5 if cand == (256, 256) else 1.0
+
+    best = at.tune(("k", 1), [(512, 512), (256, 256), (128, 128)], measure)
+    assert best == (256, 256) and len(calls) == 3
+    # second lookup: cache hit, no re-measure
+    best2 = at.tune(("k", 1), [(512, 512)], measure)
+    assert best2 == (256, 256) and len(calls) == 3
+
+    # a cached winner overrides _block_sizes for that signature
+    at.kernel_cache.put(fa._tune_key(512, 512, jnp.float32), (128, 128))
+    assert fa._block_sizes(512, 512, jnp.float32) == (128, 128)
+    # other signatures keep the default
+    assert fa._block_sizes(1024, 1024, jnp.bfloat16) == (1024, 1024)
+
+    # failing candidates are skipped; default wins when all fail
+    def boom(c):
+        raise RuntimeError("no")
+    assert at.tune(("k", 2), [(1, 1)], boom, default=(9, 9)) == (9, 9)
+
+    incubate.autotune({"kernel": {"enable": False}})
+    at.kernel_cache.clear()
+
+
+def test_autotune_eager_window(monkeypatch):
+    """maybe_autotune gating: no-op under the interpreter / outside the
+    tuning window; enabling tuning resets the step counter (so enabling
+    mid-training still opens a window)."""
+    from paddle_hackathon_tpu.core import autotune as at
+    from paddle_hackathon_tpu.incubate.nn.kernels import flash_attention as fa
+
+    at.kernel_cache.clear()
+    monkeypatch.setattr(fa, "_interpret", lambda: True)  # any backend
+    incubate.autotune({"kernel": {"enable": True, "tuning_range": [0, 2]}})
+    q = jnp.ones((2, 128, 16), jnp.float32)
+    fa.maybe_autotune(q, q, q, True, 0.25)   # interpreter -> no measuring
+    assert at.kernel_cache.size() == 0
+    for _ in range(5):
+        at.step()
+    assert not at.in_tuning_window()
+    # re-enabling resets the counter: the window reopens
+    incubate.autotune({"kernel": {"enable": True, "tuning_range": [0, 2]}})
+    assert at.in_tuning_window()
+    incubate.autotune({"kernel": {"enable": False}})

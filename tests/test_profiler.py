@@ -80,3 +80,38 @@ def test_benchmark_timer():
     s = p.benchmark_summary()
     assert s["steps"] == 3
     assert s["ips"] > 0
+
+
+def test_cross_stack_trace_merge(tmp_path):
+    """Multi-rank chrome traces merge into one cluster timeline with
+    per-rank pids and optional sync-marker alignment (ref
+    tools/CrossStackProfiler CspReporter)."""
+    import json
+    from paddle_hackathon_tpu.profiler import merge_traces
+
+    for rank, skew in ((0, 0.0), (1, 500.0)):
+        events = [
+            {"name": "step", "ph": "X", "pid": 1234 + rank, "tid": 1,
+             "ts": 1000.0 + skew, "dur": 80.0},
+            {"name": "matmul", "ph": "X", "pid": 1234 + rank, "tid": 1,
+             "ts": 1010.0 + skew, "dur": 30.0},
+        ]
+        with open(tmp_path / f"worker{rank}_step5.json", "w") as f:
+            json.dump({"traceEvents": events}, f)
+
+    out = tmp_path / "cluster.json"
+    merged = merge_traces(
+        [str(tmp_path / "worker0_step5.json"),
+         str(tmp_path / "worker1_step5.json")],
+        align_marker="step", out_path=str(out))
+    assert out.exists()
+    evs = merged["traceEvents"]
+    pids = {e["pid"] for e in evs if e.get("ph") == "X"}
+    assert pids == {0, 1}
+    # alignment: both ranks' 'step' markers start at t=0 despite the skew
+    steps = [e for e in evs if e.get("name") == "step"]
+    assert all(abs(e["ts"]) < 1e-6 for e in steps)
+    names = {e["args"]["name"] for e in evs
+             if e.get("name") == "process_name"}
+    assert any("rank 0" in n for n in names)
+    assert any("rank 1" in n for n in names)
