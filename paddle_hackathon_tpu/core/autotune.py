@@ -119,6 +119,12 @@ def tune(key: Hashable, candidates: List, measure: Callable[[object], float],
     cached = kernel_cache.get(key)
     if cached is not None:
         return cached
+    if not candidates:
+        if default is None:
+            raise ValueError(f"autotune: no viable candidates for {key!r} "
+                             "and no default")
+        kernel_cache.put(key, default)
+        return default
     best, best_t = None, float("inf")
     for cand in candidates:
         try:

@@ -115,7 +115,7 @@ def _tune_key(sq, skv, dtype):
 
 
 def _candidate_blocks(sq, skv, dtype):
-    cap = 1024 if jnp.dtype(dtype).itemsize <= 2 else 512
+    cap = _vmem_cap(dtype)
     cands = []
     for bq in (1024, 512, 256, 128):
         for bkv in (1024, 512, 256, 128):

@@ -4,6 +4,7 @@
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -79,3 +80,65 @@ def vector_to_parameters(vec, parameters, name=None):
         n = int(np.prod(p.shape)) if p.shape else 1
         p._set_value(v[off:off + n].reshape(tuple(p.shape)))
         off += n
+
+
+def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12,
+                  dim=None):
+    """Spectral normalization reparametrization (ref
+    ``spectral_norm_hook.py:130``): W <- W / sigma(W), sigma estimated by
+    power iteration on persistent u/v buffers updated before each forward
+    while training."""
+    w = getattr(layer, name)
+    if dim is None:
+        cls = type(layer).__name__
+        dim = 1 if cls in ("Linear", "Conv1DTranspose", "Conv2DTranspose",
+                           "Conv3DTranspose") else 0
+    w0 = w._value
+    h = w0.shape[dim]
+    rest = int(np.prod(w0.shape)) // h
+    rng = np.random.RandomState(0)
+
+    def _l2n(x):
+        return x / (np.linalg.norm(x) + eps)
+
+    u0 = _l2n(rng.randn(h).astype(np.float32))
+    v0 = _l2n(rng.randn(rest).astype(np.float32))
+    del layer._parameters[name]
+    layer.add_parameter(name + "_orig", Parameter(w0, trainable=True))
+    layer.register_buffer(name + "_u", Tensor(jnp.asarray(u0)))
+    layer.register_buffer(name + "_v", Tensor(jnp.asarray(v0)))
+
+    def _mat(vv):
+        if dim != 0:
+            perm = (dim,) + tuple(i for i in range(vv.ndim) if i != dim)
+            vv = jnp.transpose(vv, perm)
+        return vv.reshape(h, rest)
+
+    def _recompute(lyr, inputs):
+        w_orig = getattr(lyr, name + "_orig")
+        u = getattr(lyr, name + "_u")._value
+        v = getattr(lyr, name + "_v")._value
+        wm_c = _mat(jax.lax.stop_gradient(w_orig._value))
+        if lyr.training:
+            for _ in range(n_power_iterations):
+                v = wm_c.T @ u
+                v = v / (jnp.linalg.norm(v) + eps)
+                u = wm_c @ v
+                u = u / (jnp.linalg.norm(u) + eps)
+            # persist the iteration only in eager mode: under a jit/
+            # to_static trace u/v are tracers and storing them would leak
+            if not isinstance(u, jax.core.Tracer):
+                getattr(lyr, name + "_u")._set_value(u)
+                getattr(lyr, name + "_v")._set_value(v)
+
+        def fn(wo):
+            sigma = u @ _mat(wo) @ v
+            return wo / sigma
+        object.__setattr__(lyr, name,
+                           apply_op("spectral_norm", fn, [w_orig]))
+        return None
+
+    handle = layer.register_forward_pre_hook(_recompute)
+    layer._spectral_norm_handle = (handle, name, dim)
+    _recompute(layer, None)
+    return layer

@@ -174,7 +174,28 @@ class _EmptyModel(Layer):
         return None
 
 
+class _FleetUtils:
+    """paddle.distributed.fleet.utils (ref ``fleet/utils/__init__.py``):
+    ``recompute`` + filesystem clients."""
+
+    @property
+    def recompute(self):
+        from .recompute import recompute
+        return recompute
+
+    @property
+    def LocalFS(self):
+        from ..utils.fs import LocalFS
+        return LocalFS
+
+    @property
+    def HDFSClient(self):
+        from ..utils.fs import HDFSClient
+        return HDFSClient
+
+
 fleet = _Fleet()
+fleet.utils = _FleetUtils()
 
 
 def init(role_maker=None, is_collective: bool = True, strategy=None):

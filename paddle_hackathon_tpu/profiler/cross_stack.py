@@ -52,13 +52,27 @@ def merge_traces(paths: List[str], align_marker: Optional[str] = None,
     for idx, path in enumerate(ordered):
         rank = ranks[idx]
         trace = _load(path)
-        events = trace.get("traceEvents", trace if isinstance(trace, list)
-                           else [])
+        if isinstance(trace, list):   # chrome "JSON Array Format"
+            events = trace
+        else:
+            events = trace.get("traceEvents", [])
         t0 = 0.0
         if align_marker is not None:
             starts = [e["ts"] for e in events
                       if e.get("name") == align_marker and "ts" in e]
-            t0 = min(starts) if starts else 0.0
+            if starts:
+                t0 = min(starts)
+            else:
+                # marker missing on this rank: rebase on its earliest event
+                # (keeping absolute time would skew it against the aligned
+                # ranks far worse than approximate alignment)
+                import warnings
+                all_ts = [e["ts"] for e in events
+                          if e.get("ph") != "M" and "ts" in e]
+                t0 = min(all_ts) if all_ts else 0.0
+                warnings.warn(
+                    f"align marker {align_marker!r} not found in {path}; "
+                    "falling back to the rank's earliest event")
         merged["traceEvents"].append({
             "ph": "M", "name": "process_name", "pid": rank,
             "args": {"name": f"rank {rank} "

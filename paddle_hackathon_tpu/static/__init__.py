@@ -110,3 +110,6 @@ __all__ = (["data", "Executor", "Program", "Variable", "program_guard",
             "default_main_program", "default_startup_program", "InputSpec",
             "save_inference_model", "load_inference_model", "gradients",
             "nn", "py_func"] + list(_compat_all))
+
+
+from . import amp  # noqa: E402,F401

@@ -1,0 +1,95 @@
+"""paddle.static.amp (ref ``python/paddle/static/amp/__init__.py`` →
+``fluid/contrib/mixed_precision``): AMP for the static-graph path.
+
+On TPU the dynamic and static paths share one AMP machinery (the op-level
+autocast in ``core.autograd`` works identically under tracing), so this
+namespace re-exports it with the static-era API names.
+"""
+
+from __future__ import annotations
+
+from ..amp import BLACK_LIST as _BLACK  # noqa: F401
+from ..amp import WHITE_LIST as _WHITE  # noqa: F401
+from ..amp import auto_cast, decorate  # noqa: F401
+
+
+def _white():
+    return _WHITE
+
+
+def _black():
+    return _BLACK
+
+__all__ = ["decorate", "AutoMixedPrecisionLists", "CustomOpLists",
+           "fp16_guard", "cast_model_to_fp16", "cast_parameters_to_fp16",
+           "bf16"]
+
+
+class AutoMixedPrecisionLists:
+    """ref ``fluid/contrib/mixed_precision/fp16_lists.py`` — op lists
+    controlling which ops run in low precision."""
+
+    def __init__(self, custom_white_list=None, custom_black_list=None,
+                 custom_black_varnames=None, dtype="float16"):
+        self.white_list = set(_white()) | set(custom_white_list or ())
+        self.black_list = set(_black()) | set(custom_black_list or ())
+        self.black_varnames = set(custom_black_varnames or ())
+        self.dtype = dtype
+        # ops in both lists: black wins (reference semantics)
+        self.white_list -= self.black_list
+
+
+CustomOpLists = AutoMixedPrecisionLists
+
+
+import contextlib as _contextlib
+
+
+@_contextlib.contextmanager
+def fp16_guard():
+    """ref ``fp16_utils.py`` fp16_guard — region marker inside which ops
+    are eligible for low precision; equals auto_cast here."""
+    with auto_cast(True):
+        yield
+
+
+def cast_model_to_fp16(program_or_layer, amp_lists=None, use_fp16_guard=True):
+    """ref ``fp16_utils.py`` — cast parameters to fp16 (TPU: bf16-first,
+    but fp16 honored when asked)."""
+    import jax.numpy as jnp
+    layer = program_or_layer
+    if hasattr(layer, "named_parameters"):
+        for _, p in layer.named_parameters():
+            if jnp.issubdtype(p._value.dtype, jnp.floating):
+                p._set_value(p._value.astype(jnp.float16))
+    return layer
+
+
+def cast_parameters_to_fp16(place=None, program=None, scope=None,
+                            to_fp16_var_names=None):
+    """ref ``fp16_utils.py`` — static-program variant; parameters live in
+    the jit-compiled state here, so this is satisfied by
+    ``cast_model_to_fp16`` on the source layer."""
+    return to_fp16_var_names
+
+
+class _BF16Namespace:
+    """ref ``mixed_precision/bf16`` submodule."""
+
+    @staticmethod
+    def decorate_bf16(optimizer, amp_lists=None, use_pure_bf16=False,
+                      use_bf16_guard=None):
+        """ref ``bf16/decorator.py`` decorate_bf16 — returns the (possibly
+        wrapped) optimizer. O1 relies on the op-level autocast lists; pure
+        bf16 casts the optimizer's parameters down."""
+        if use_pure_bf16:
+            import jax.numpy as jnp
+            for pr in getattr(optimizer, "_parameter_list", []) or []:
+                if jnp.issubdtype(pr._value.dtype, jnp.floating):
+                    pr._set_value(pr._value.astype(jnp.bfloat16))
+        return optimizer
+
+    AutoMixedPrecisionListsBF16 = AutoMixedPrecisionLists
+
+
+bf16 = _BF16Namespace()

@@ -7,6 +7,9 @@ import importlib as _importlib
 import warnings as _warnings
 
 from . import cpp_extension  # noqa: F401
+from . import dlpack  # noqa: F401
+from . import download  # noqa: F401
+from . import unique_name  # noqa: F401
 
 
 def deprecated(update_to="", since="", reason="", level=0):

@@ -1,0 +1,84 @@
+"""paddle.utils.download (ref ``python/paddle/utils/download.py:61-260``).
+
+Zero-egress build: URLs resolve to the local weights cache
+(``~/.cache/paddle/hapi/weights`` or ``PADDLE_WEIGHTS_HOME``); a missing
+file raises with instructions instead of fetching.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import os.path as osp
+import shutil
+import tarfile
+import zipfile
+
+__all__ = ["get_weights_path_from_url"]
+
+WEIGHTS_HOME = os.environ.get(
+    "PADDLE_WEIGHTS_HOME", osp.expanduser("~/.cache/paddle/hapi/weights"))
+
+
+def is_url(path):
+    """ref ``download.py:68``."""
+    return path.startswith("http://") or path.startswith("https://")
+
+
+def _md5check(fullname, md5sum=None):
+    if md5sum is None:
+        return True
+    md5 = hashlib.md5()
+    with open(fullname, 'rb') as f:
+        for chunk in iter(lambda: f.read(4096), b""):
+            md5.update(chunk)
+    return md5.hexdigest() == md5sum
+
+
+def _map_path(url, root_dir):
+    fname = osp.split(url)[-1]
+    return osp.join(root_dir, fname)
+
+
+def get_path_from_url(url, root_dir, md5sum=None, check_exist=True,
+                      decompress=True):
+    """ref ``download.py:123`` — resolve (and normally download) a URL
+    into ``root_dir``; here the file must already be present locally."""
+    fullpath = _map_path(url, root_dir)
+    if osp.exists(fullpath):
+        if check_exist and not _md5check(fullpath, md5sum):
+            raise ValueError(
+                f"{fullpath} exists but its md5 does not match {md5sum} — "
+                "the file is corrupt or outdated; re-download it")
+        if decompress and (tarfile.is_tarfile(fullpath) or
+                           zipfile.is_zipfile(fullpath)):
+            return _decompress(fullpath)
+        return fullpath
+    raise FileNotFoundError(
+        f"{fullpath} not found and this build has no network access — "
+        f"download {url} manually into {root_dir}")
+
+
+def _decompress(fname):
+    """ref ``download.py:202`` — unpack tar/zip next to the archive."""
+    out_dir = osp.splitext(fname)[0]
+    if out_dir.endswith(".tar"):
+        out_dir = osp.splitext(out_dir)[0]
+    if osp.isdir(out_dir):
+        return out_dir
+    if tarfile.is_tarfile(fname):
+        with tarfile.open(fname) as tf:
+            try:
+                tf.extractall(osp.dirname(fname), filter="data")
+            except TypeError:   # Python < 3.12: no filter arg
+                tf.extractall(osp.dirname(fname))
+    elif zipfile.is_zipfile(fname):
+        with zipfile.ZipFile(fname) as zf:
+            zf.extractall(osp.dirname(fname))
+    return out_dir if osp.exists(out_dir) else fname
+
+
+def get_weights_path_from_url(url, md5sum=None):
+    """ref ``download.py:77`` — path of the cached weights file for a
+    model-zoo URL."""
+    return get_path_from_url(url, WEIGHTS_HOME, md5sum)

@@ -1,0 +1,54 @@
+"""paddle.utils.unique_name (ref ``python/paddle/fluid/unique_name.py``):
+process-wide unique name generation with switch/guard scoping."""
+
+from __future__ import annotations
+
+import contextlib
+
+__all__ = ["generate", "switch", "guard"]
+
+
+class UniqueNameGenerator:
+    """ref ``unique_name.py:25`` — per-prefix counters."""
+
+    def __init__(self, prefix=None):
+        self.ids = {}
+        self.prefix = prefix or ""
+
+    def __call__(self, key):
+        tmp = self.ids.setdefault(key, 0)
+        self.ids[key] = tmp + 1
+        return self.prefix + "_".join([key, str(tmp)])
+
+
+generator = UniqueNameGenerator()
+
+
+def generate(key):
+    """ref ``unique_name.py:84`` — e.g. generate('fc') -> 'fc_0', 'fc_1'."""
+    return generator(key)
+
+
+def switch(new_generator=None):
+    """ref ``unique_name.py:134`` — swap the global generator, returning
+    the old one."""
+    global generator
+    old = generator
+    generator = new_generator if new_generator is not None \
+        else UniqueNameGenerator()
+    return old
+
+
+@contextlib.contextmanager
+def guard(new_generator=None):
+    """ref ``unique_name.py:187`` — scoped generator; names inside the
+    block restart (optionally under a string prefix)."""
+    if isinstance(new_generator, str):
+        new_generator = UniqueNameGenerator(new_generator)
+    elif isinstance(new_generator, bytes):
+        new_generator = UniqueNameGenerator(new_generator.decode())
+    old = switch(new_generator)
+    try:
+        yield
+    finally:
+        switch(old)

@@ -270,3 +270,71 @@ def test_static_namespace_surface_complete():
                     if getattr(t, "id", None) == "__all__":
                         names = [ast.literal_eval(e) for e in node.value.elts]
         assert [n for n in names if not hasattr(mod, n)] == []
+
+
+def test_utils_dlpack_roundtrip():
+    from paddle_hackathon_tpu.utils import dlpack
+    t = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+    cap = dlpack.to_dlpack(t)
+    back = dlpack.from_dlpack(t._value)  # jax arrays carry __dlpack__
+    np.testing.assert_array_equal(back.numpy(), t.numpy())
+    with pytest.raises(TypeError):
+        dlpack.to_dlpack(np.zeros(3))
+    assert cap is not None
+
+
+def test_utils_unique_name():
+    from paddle_hackathon_tpu.utils import unique_name
+    a, b = unique_name.generate("fc"), unique_name.generate("fc")
+    assert a != b and a.startswith("fc_")
+    with unique_name.guard():
+        inner = unique_name.generate("fc")
+    assert inner == "fc_0"
+    with unique_name.guard("pre_"):
+        assert unique_name.generate("fc").startswith("pre_fc")
+
+
+def test_utils_download_local(tmp_path, monkeypatch):
+    from paddle_hackathon_tpu.utils import download
+    monkeypatch.setattr(download, "WEIGHTS_HOME", str(tmp_path))
+    assert download.is_url("https://host/m.pdparams")
+    (tmp_path / "m.pdparams").write_bytes(b"weights")
+    p = download.get_weights_path_from_url("https://host/m.pdparams")
+    assert p.endswith("m.pdparams")
+    with pytest.raises(FileNotFoundError):
+        download.get_weights_path_from_url("https://host/missing.pdparams")
+
+
+def test_spectral_norm_power_iteration():
+    from paddle_hackathon_tpu import nn
+    lin = nn.Linear(8, 5)
+    nn.utils.spectral_norm(lin, dim=1)
+    x = paddle.to_tensor(np.random.randn(3, 8).astype("float32"))
+    for _ in range(25):
+        lin(x)
+    sigma = np.linalg.svd(lin.weight.numpy(), compute_uv=False)[0]
+    np.testing.assert_allclose(sigma, 1.0, atol=1e-3)
+    # still trainable through the reparam
+    xg = paddle.to_tensor(np.random.randn(3, 8).astype("float32"))
+    lin(xg).sum().backward()
+    assert lin.weight_orig.grad is not None
+
+
+def test_static_amp_namespace():
+    import paddle_hackathon_tpu.static.amp as samp
+    lists = samp.AutoMixedPrecisionLists(custom_white_list=["foo_op"],
+                                         custom_black_list=["bar_op"])
+    assert "foo_op" in lists.white_list and "bar_op" in lists.black_list
+    assert samp.CustomOpLists is samp.AutoMixedPrecisionLists
+    with samp.fp16_guard():
+        pass
+    lin = paddle.nn.Linear(4, 4)
+    samp.cast_model_to_fp16(lin)
+    assert str(lin.weight.dtype) == "float16"
+    assert samp.bf16.decorate_bf16 is not None
+
+
+def test_fleet_utils_namespace():
+    from paddle_hackathon_tpu.distributed import fleet
+    assert fleet.utils.recompute is not None
+    assert fleet.utils.LocalFS is not None and fleet.utils.HDFSClient is not None
