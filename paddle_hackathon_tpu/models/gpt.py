@@ -159,10 +159,28 @@ class GPTModel(Layer):
     def forward(self, input_ids, position_ids=None, caches=None):
         b, s = input_ids.shape
         past_len = caches[0][0].shape[1] if caches is not None else 0
-        if position_ids is None:
-            position_ids = ops.arange(past_len, past_len + s, dtype="int32")
-            position_ids = ops.expand(ops.reshape(position_ids, [1, s]), [b, s])
-        x = self.wte(input_ids) + self.wpe(position_ids)
+        max_pos = self.wpe.weight.shape[0]
+        if position_ids is None and past_len + s <= max_pos:
+            # Default positions are a contiguous arange, so the lookup is a
+            # row slice of the weight — not a gather.  The slice's transpose
+            # is a pad (identity when s == max_position_embeddings), which
+            # keeps the wpe gradient off the batch-scatter path that GSPMD
+            # can only reshard onto the ZeRO-3 param layout via involuntary
+            # full rematerialization (spmd_partitioner.cc warning).
+            pos_emb = ops.reshape(
+                ops.slice(self.wpe.weight, axes=[0], starts=[past_len],
+                          ends=[past_len + s]),
+                [1, s, -1])
+        else:
+            if position_ids is None:
+                # decode past max_position_embeddings: match gather's
+                # clamped out-of-bounds behavior instead of crashing
+                position_ids = ops.clip(
+                    ops.arange(past_len, past_len + s, dtype="int32"),
+                    0, max_pos - 1)
+                position_ids = ops.reshape(position_ids, [1, s])
+            pos_emb = self.wpe(position_ids)
+        x = self.wte(input_ids) + pos_emb
         x = self.drop(x)
         new_caches = []
         for i, block in enumerate(self.blocks):
@@ -255,5 +273,15 @@ def param_sharding_spec(name: str, shape) -> tuple:
     if "qkv_proj.bias" in name or "fc_in.bias" in name:
         return ("mp",)
     if "wte.weight" in name:
-        return ("mp", None)       # vocab-parallel embedding (c_embedding)
+        # vocab-parallel embedding (c_embedding); ZeRO-3 stacks 'sharding'
+        # onto the vocab rows too — row-sharded gather/scatter-add partition
+        # cleanly, while feature-dim sharding forces GSPMD to fully
+        # rematerialize the batch-sharded cotangent (involuntary-remat).
+        return (("mp", "sharding"), None)
+    if "wpe.weight" in name:
+        # ZeRO-3 would otherwise shard the *feature* dim; Shardy then
+        # propagates that layout onto the batch-sharded activation cotangent
+        # and GSPMD can only reach it via involuntary full rematerialization.
+        # Row (position) sharding partitions the slice/pad grad path cleanly.
+        return ("sharding", None)
     return tuple(None for _ in shape)

@@ -65,8 +65,18 @@ def get_mesh() -> Optional[Mesh]:
 
 
 def _filter_spec(spec, mesh: Mesh):
-    """Drop axis names the mesh doesn't have; keep dims aligned."""
-    return tuple(a if (a in mesh.axis_names) else None for a in spec)
+    """Drop axis names the mesh doesn't have; keep dims aligned.
+
+    Entries may be a single axis name or a tuple of axis names (a dim sharded
+    over several mesh axes, e.g. vocab over ('mp', 'sharding'))."""
+    out = []
+    for a in spec:
+        if isinstance(a, (tuple, list)):
+            kept = tuple(x for x in a if x in mesh.axis_names)
+            out.append(kept if len(kept) > 1 else (kept[0] if kept else None))
+        else:
+            out.append(a if (a in mesh.axis_names) else None)
+    return tuple(out)
 
 
 def shard_params(model: Layer, mesh: Mesh,
@@ -225,4 +235,5 @@ def make_sharded_train_step(model: Layer, mesh: Mesh,
         return ({"params": new_params, "opt_state": new_opt,
                  "step": new_step}, loss)
 
+    step._jitted = jitted  # exposed for AOT lowering / HLO inspection
     return step, state

@@ -33,16 +33,21 @@ _LEVELS = ("os", "os_g", "p_g_os")
 
 
 def _shard_spec_for(shape, mesh, axis="sharding", existing=None):
-    """Shard the first divisible, unsharded dim over ``axis``."""
+    """Shard the first divisible, unsharded dim over ``axis``.
+
+    Spec entries may be tuples (a dim sharded over several mesh axes)."""
     spec = list(existing) if existing else [None] * len(shape)
+
+    def _axes(entry):
+        return entry if isinstance(entry, (tuple, list)) else (entry,)
+
     n = mesh.shape.get(axis, 1)
-    if n > 1 and axis not in spec:
+    if n > 1 and all(axis not in _axes(s) for s in spec):
         for i, (dim, s) in enumerate(zip(shape, spec)):
             if s is None and dim % n == 0:
                 spec[i] = axis
                 break
-    return tuple(a if a in mesh.axis_names or a is None else None
-                 for a in spec)
+    return _mesh_api._filter_spec(spec, mesh)
 
 
 def group_sharded_parallel(model: Layer, optimizer, level: str = "os_g",

@@ -90,7 +90,10 @@ def test_tp_sharding_spec_rules():
                                (64, 192)) == (None, "mp")
     assert param_sharding_spec("gpt.blocks.0.attn.out_proj.weight",
                                (64, 64)) == ("mp", None)
-    assert param_sharding_spec("gpt.wte.weight", (128, 64)) == ("mp", None)
+    assert param_sharding_spec("gpt.wte.weight", (128, 64)) == (
+        ("mp", "sharding"), None)
+    assert param_sharding_spec("gpt.wpe.weight", (32, 64)) == (
+        "sharding", None)
     assert param_sharding_spec("gpt.ln_f.weight", (64,)) == (None,)
 
 
