@@ -110,7 +110,8 @@ class GradNode:
     """
 
     __slots__ = ("name", "vjp_fn", "parents", "n_outputs", "out_avals",
-                 "hooks", "_buffer", "_arrived", "_expected", "__weakref__")
+                 "hooks", "fwd_fn", "in_tensors", "_buffer", "_arrived",
+                 "_expected", "__weakref__")
 
     def __init__(self, name: str, vjp_fn: Callable, parents: list,
                  n_outputs: int, out_avals: list):
@@ -120,6 +121,12 @@ class GradNode:
         self.n_outputs = n_outputs
         self.out_avals = out_avals  # (shape, dtype) per output, for zero-fill
         self.hooks: Optional[dict] = None  # out_idx -> [hook fns]
+        # For create_graph=True (double grad): the op's closed forward fn and
+        # its differentiable input Tensors, so the backward can be re-derived
+        # as a *taped* computation (the reference keeps the same data as
+        # TensorWrappers on the grad node; eager/tensor_wrapper.h).
+        self.fwd_fn: Optional[Callable] = None
+        self.in_tensors: Optional[tuple] = None
         self._buffer: Optional[list] = None
         self._arrived = 0
         self._expected = 0
@@ -128,6 +135,8 @@ class GradNode:
         """Drop saved residuals (retain_graph=False semantics)."""
         self.vjp_fn = None
         self.parents = []
+        self.fwd_fn = None
+        self.in_tensors = None
 
 
 # ---------------------------------------------------------------------------
@@ -135,19 +144,10 @@ class GradNode:
 # eager/backward.cc:556: in-degree counting + queue).
 # ---------------------------------------------------------------------------
 
-def run_backward(tensors: Sequence, grad_tensors: Sequence, retain_graph: bool = False):
-    roots: List[Tuple[GradNode, int, Any]] = []
-    for t, g in zip(tensors, grad_tensors):
-        if t._grad_node is None:
-            # Backward on a leaf: its grad is just the incoming cotangent.
-            _accumulate_leaf(t, g)
-            continue
-        roots.append((t._grad_node, t._out_idx, g))
-    if not roots:
-        return
-
-    # Pass 1: count, for every reachable node, how many cotangent deliveries it
-    # will receive (edges from consumer nodes reachable from the roots).
+def _count_expected(roots):
+    """Pass 1: for every node reachable from the roots, count how many
+    cotangent deliveries it will receive (one per consumer edge, plus one
+    per root entry)."""
     expected = {}
     visited = set()
     stack = [n for n, _, _ in roots]
@@ -165,11 +165,21 @@ def run_backward(tensors: Sequence, grad_tensors: Sequence, retain_graph: bool =
             expected[pnode] = expected.get(pnode, 0) + 1
             if id(pnode) not in visited:
                 stack.append(pnode)
-
-    for n, _, g in roots:
+    for n, _, _ in roots:
         expected[n] = expected.get(n, 0) + 1
+    return expected
 
-    # Pass 2: ready queue.
+
+def _engine_walk(roots, *, zero_fill, run_hook, apply_node, on_leaf,
+                 after_node=None):
+    """Pass 2: the shared ready-queue walk (ref egr::RunBackward).
+
+    Cotangent values are opaque to the walk — raw jax arrays in the plain
+    engine, taped Tensors in the create_graph engine; both support
+    ``.dtype`` / ``.astype`` / ``+``. The four callbacks supply the
+    mode-specific behavior.
+    """
+    expected = _count_expected(roots)
     queue: deque = deque()
 
     def deliver(node: GradNode, out_idx: int, grad) -> None:
@@ -194,34 +204,61 @@ def run_backward(tensors: Sequence, grad_tensors: Sequence, retain_graph: bool =
 
     while queue:
         node = queue.popleft()
-        cotangents = tuple(
-            buf if buf is not None else jnp.zeros(shape, dtype)
+        cotangents = [
+            buf if buf is not None else zero_fill(shape, dtype)
             for buf, (shape, dtype) in zip(node._buffer, node.out_avals)
-        )
+        ]
         if node.hooks:
-            cotangents = list(cotangents)
             for out_idx, hook_fns in node.hooks.items():
                 for hook in hook_fns:
-                    res = hook(_wrap_hook_arg(cotangents[out_idx]))
+                    res = run_hook(hook, cotangents[out_idx])
                     if res is not None:
-                        cotangents[out_idx] = (
-                            res._value if isinstance(res, Tensor) else res)
-            cotangents = tuple(cotangents)
+                        cotangents[out_idx] = res
         node._buffer = None
+        in_grads = apply_node(node, tuple(cotangents))
+        parents = node.parents
+        if after_node is not None:
+            after_node(node)
+        for parent, grad in zip(parents, in_grads):
+            if isinstance(parent, _LeafSlot):
+                on_leaf(parent.tensor, grad)
+            else:
+                pnode, out_idx = parent
+                deliver(pnode, out_idx, grad)
+
+
+def run_backward(tensors: Sequence, grad_tensors: Sequence, retain_graph: bool = False):
+    roots: List[Tuple[GradNode, int, Any]] = []
+    for t, g in zip(tensors, grad_tensors):
+        if t._grad_node is None:
+            # Backward on a leaf: its grad is just the incoming cotangent.
+            _accumulate_leaf(t, g)
+            continue
+        roots.append((t._grad_node, t._out_idx, g))
+    if not roots:
+        return
+
+    def run_hook(hook, cot):
+        res = hook(_wrap_hook_arg(cot))
+        if res is None:
+            return None
+        return res._value if isinstance(res, Tensor) else res
+
+    def apply_node(node, cotangents):
         if node.vjp_fn is None:
             raise RuntimeError(
                 f"grad node {node.name} has been released; call backward with "
                 "retain_graph=True to backprop through the graph twice")
-        in_grads = node.vjp_fn(cotangents)
-        parents = node.parents
-        if not retain_graph:
-            node.release()
-        for parent, grad in zip(parents, in_grads):
-            if isinstance(parent, _LeafSlot):
-                _accumulate_leaf(parent.tensor, grad)
-            else:
-                pnode, out_idx = parent
-                deliver(pnode, out_idx, grad)
+        return node.vjp_fn(cotangents)
+
+    _engine_walk(
+        roots,
+        zero_fill=jnp.zeros,
+        run_hook=run_hook,
+        apply_node=apply_node,
+        on_leaf=_accumulate_leaf,
+        after_node=None if retain_graph else GradNode.release,
+    )
 
 
 def _accumulate_leaf(tensor, grad) -> None:
@@ -242,20 +279,120 @@ def _wrap_hook_arg(grad):
     return t
 
 
+def _run_backward_taped(roots, leaf_grads):
+    """create_graph=True engine: the same ready-queue walk as
+    :func:`run_backward`, but cotangents are *Tensors* and every node's
+    backward is re-applied through :func:`apply_op` — so the produced grads
+    carry their own GradNodes and are differentiable again (ref
+    ``egr::RunBackward`` with ``create_graph``; double-grad nodes from
+    eager_gen).  Second-order paths through saved inputs are correct because
+    each node's backward recomputes its forward inside ``jax.vjp`` from the
+    retained input Tensors.
+
+    ``roots`` is [(node, out_idx, cot_tensor)]; ``leaf_grads`` is a dict
+    {id(leaf_tensor): Tensor} filled with accumulated (taped) leaf grads.
+    """
+
+    def zero_fill(shape, dtype):
+        return Tensor(jnp.zeros(shape, dtype), stop_gradient=True)
+
+    def run_hook(hook, cot):
+        res = hook(cot)
+        if res is None:
+            return None
+        return res if isinstance(res, Tensor) else Tensor(res,
+                                                          stop_gradient=True)
+
+    def apply_node(node, cotangents):
+        if node.fwd_fn is None:
+            raise RuntimeError(
+                f"grad node {node.name} cannot be differentiated again "
+                "(released, produced by an op that does not retain its "
+                "forward — e.g. a PyLayer — or recorded with "
+                "FLAGS_eager_retain_double_grad off); create_graph=True "
+                "needs the taped forward")
+        n_in = len(node.in_tensors)
+        single_out = node.n_outputs == 1
+
+        def bwd(*vals, _fwd=node.fwd_fn, _n=n_in, _single=single_out):
+            xs, cts = vals[:_n], vals[_n:]
+            _, vjp_fn = jax.vjp(_fwd, *xs)
+            grads = vjp_fn(cts[0] if _single else tuple(cts))
+            return grads if len(grads) > 1 else grads[0]
+
+        in_grads = apply_op(node.name + "_grad", bwd,
+                            [*node.in_tensors, *cotangents],
+                            n_outputs=n_in)
+        return in_grads if isinstance(in_grads, tuple) else (in_grads,)
+
+    def on_leaf(tensor, grad_t):
+        for hook in tensor._grad_hooks:
+            out = hook(grad_t)
+            if out is not None:
+                grad_t = out
+        if tensor.stop_gradient:
+            return
+        key = id(tensor)
+        prev = leaf_grads.get(key)
+        leaf_grads[key] = grad_t if prev is None else prev + grad_t
+
+    _engine_walk(roots, zero_fill=zero_fill, run_hook=run_hook,
+                 apply_node=apply_node, on_leaf=on_leaf)
+
+
 def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
          create_graph=False, allow_unused=False):
     """paddle.grad equivalent (ref ``egr::GeneralGrad``, eager/backward.cc:38).
 
     Computes gradients of ``outputs`` w.r.t. ``inputs`` without touching
-    ``.grad`` of other leaves. ``create_graph`` (double grad) is not supported
-    by the eager tape; use the jit path (jax.grad composition) for higher-order
-    derivatives.
+    ``.grad`` of other leaves. With ``create_graph=True`` the returned grads
+    are themselves taped (double grad): each grad node's backward is re-run
+    through the tape, recomputing its forward inside ``jax.vjp`` so
+    second-order terms through saved inputs are included.
     """
     if create_graph:
-        raise NotImplementedError(
-            "create_graph=True is not supported on the eager tape; wrap the "
-            "computation in paddle_hackathon_tpu.jit.to_static and compose "
-            "jax.grad for higher-order derivatives")
+        outputs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        if grad_outputs is None:
+            grad_outputs = [None] * len(outputs)
+        root_cots = [
+            Tensor(jnp.ones(o.shape, o.dtype), stop_gradient=True)
+            if g is None else (g if isinstance(g, Tensor) else Tensor(g))
+            for o, g in zip(outputs, grad_outputs)]
+        leaf_grads: dict = {}
+        roots = []
+        # Intermediate (non-leaf) requested inputs: capture their accumulated
+        # cotangent Tensor via a temporary hook.
+        captures: dict = {}
+        temp_hooks = []
+        for inp in inputs:
+            if inp._grad_node is not None:
+                def _capture(g, _key=id(inp)):
+                    captures[_key] = g
+                temp_hooks.append(inp.register_hook(_capture))
+        try:
+            for t, g in zip(outputs, root_cots):
+                if t._grad_node is None:
+                    leaf_grads[id(t)] = g
+                else:
+                    roots.append((t._grad_node, t._out_idx, g))
+            if roots:
+                _run_backward_taped(roots, leaf_grads)
+            results = []
+            for inp in inputs:
+                if inp._grad_node is not None:
+                    g = captures.get(id(inp))
+                else:
+                    g = leaf_grads.get(id(inp))
+                if g is None and not allow_unused:
+                    raise ValueError(
+                        "one of the input tensors receives no gradient; pass "
+                        "allow_unused=True to return None for it")
+                results.append(g)
+            return results
+        finally:
+            for h in temp_hooks:
+                h.remove()
     outputs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
     inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
     if grad_outputs is None:
@@ -276,7 +413,16 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
 
     # Temporarily swap leaf accumulation: stash and restore .grad of leaves that
     # are not requested, capture grads of requested inputs.
-    saved = [(t, t._grad_value) for t in _all_leaves(outputs)]
+    # Stash .grad of every leaf the walk can touch — including *leaf outputs*
+    # (run_backward accumulates their cotangent straight into ._grad_value;
+    # without stashing, repeated grad() calls double-count and pollute .grad).
+    stash_leaves = _all_leaves(outputs)
+    seen_ids = {id(t) for t in stash_leaves}
+    for t in outputs:
+        if t._grad_node is None and id(t) not in seen_ids:
+            seen_ids.add(id(t))
+            stash_leaves.append(t)
+    saved = [(t, t._grad_value) for t in stash_leaves]
     for t, _ in saved:
         t._grad_value = None
     try:
@@ -411,6 +557,9 @@ def _apply_op_impl(name: str, fn: Callable, args: Sequence[Any], n_outputs: int 
             return _vjp(cotangents[0] if _single else cotangents)
 
     node = GradNode(name, node_vjp, parents, len(outs), out_avals)
+    if flags.flag("eager_retain_double_grad"):
+        node.fwd_fn = closed
+        node.in_tensors = tuple(args[pos] for pos in diff_positions)
     return _wrap_outputs(name, out, n_outputs, node=node)
 
 

@@ -88,6 +88,13 @@ define_flag("check_nan_inf", False,
             "Check outputs of every op for NaN/Inf (ref: FLAGS_check_nan_inf, "
             "eager/nan_inf_utils.cc).")
 define_flag("benchmark", False, "Sync after each op for timing (ref FLAGS_benchmark).")
+define_flag("eager_retain_double_grad", True,
+            "Retain each op's forward closure + input tensors on its grad "
+            "node so paddle.grad(create_graph=True) (double grad) works "
+            "out-of-the-box, like the reference's TensorWrapper retention "
+            "(eager/tensor_wrapper.h). Costs peak eager-mode memory (inputs "
+            "stay alive until backward releases the node); set False for "
+            "memory-tight eager runs that never need higher-order grads.")
 define_flag("flash_attention_min_seqlen", 1024,
             "Sequence length at which SDPA switches from the XLA softmax(QK)V "
             "composition to the Pallas flash kernel. Measured on v5e "

@@ -22,8 +22,11 @@ _SYNTH = {"train": 1024, "test": 256}
 def reader_creator(filename, sub_name, cycle=False):
     """ref ``cifar.py:49`` — stream one split from the pickle archive."""
     from ..vision.datasets import Cifar10, Cifar100
-    cls = Cifar100 if "100" in sub_name or "train" == sub_name or \
-        "test" == sub_name else Cifar10
+    # the dataset family is encoded in the archive filename (the reference
+    # passes cifar-100-python.tar.gz / cifar-10-python.tar.gz); sub_name
+    # only selects the split — cifar100 uses 'train'/'test', cifar10 uses
+    # 'data_batch_N'/'test_batch'
+    cls = Cifar100 if "100" in os.path.basename(str(filename)) else Cifar10
     mode = "train" if "train" in sub_name or "data_batch" in sub_name \
         else "test"
 

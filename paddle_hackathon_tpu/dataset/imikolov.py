@@ -47,17 +47,37 @@ def _reader(mode, word_idx, n, data_type):
     from ..text.datasets import Imikolov
 
     def reader():
+        # The reference maps every word — boundary markers included —
+        # through the *caller's* word_idx with '<unk>' as fallback
+        # (imikolov.py:98-107: ``[word_idx.get(w, UNK) for w in l]``).
+        # Corpus ids are translated corpus-id -> word -> caller-id so a
+        # custom dict (different min_word_freq, own boundary ids) works.
         if data_type == DataType.NGRAM or str(data_type).upper() == "NGRAM":
             ds = Imikolov(mode=mode, data_type="NGRAM", window_size=n)
-            for gram in ds.data:
-                yield tuple(int(w) for w in gram)
         else:
             ds = Imikolov(mode=mode, data_type="SEQ")
+        rev = {v: k for k, v in ds.word_idx.items()}
+        if word_idx and dict(word_idx) != dict(ds.word_idx):
+            unk = word_idx.get('<unk>', len(word_idx))
+
+            def xl(i):
+                return int(word_idx.get(rev[int(i)], unk))
+        else:  # caller dict is the corpus dict (the build_dict() case)
+            def xl(i):
+                return int(i)
+        if data_type == DataType.NGRAM or str(data_type).upper() == "NGRAM":
+            for gram in ds.data:
+                yield tuple(xl(w) for w in gram)
+        else:
+            lookup = word_idx if word_idx else ds.word_idx
+            unk = lookup.get('<unk>', len(lookup))
+            s_id = lookup.get('<s>', unk)
+            e_id = lookup.get('<e>', unk)
             for sent in ds.data:
-                ids = [int(w) for w in sent]
+                ids = [xl(w) for w in sent]
                 # <s> sentence <e> input/target split (ref imikolov.py:103)
-                src = [0] + ids
-                trg = ids + [1]
+                src = [s_id] + ids
+                trg = ids + [e_id]
                 yield src, trg
 
     return reader

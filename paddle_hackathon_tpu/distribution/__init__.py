@@ -579,7 +579,8 @@ from .transform import (  # noqa: E402
     IndependentTransform, PowerTransform, ReshapeTransform, SigmoidTransform,
     SoftmaxTransform, StackTransform, StickBreakingTransform, TanhTransform)
 
-Lognormal = LogNormal  # reference exports both spellings
+Lognormal = LogNormal  # alias matching newer upstream releases (the
+# reference snapshot only has LogNormal); kept for forward compatibility
 
 __all__ += ["ExponentialFamily", "Independent", "TransformedDistribution",
             "Transform", "AbsTransform", "AffineTransform", "ChainTransform",

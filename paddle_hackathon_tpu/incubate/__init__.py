@@ -27,4 +27,12 @@ def autotune(config=None):
     parity. XLA additionally autotunes its own fusions in-compiler."""
     from ..core import autotune as _at
     _at.set_config(config)
+    # return None for parity: the reference's set_config returns None; the
+    # status dict is available via paddle.incubate.autotune_status()
+
+
+def autotune_status():
+    """Autotuner status (config + cache hit/miss counters) — the dict the
+    pre-parity ``autotune()`` used to return."""
+    from ..core import autotune as _at
     return _at.status()

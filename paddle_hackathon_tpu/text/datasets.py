@@ -88,6 +88,11 @@ class Imikolov(Dataset):
         self.window_size = window_size
         vocab_size = 2074
         self.word_idx = {f"w{i}": i for i in range(vocab_size)}
+        # boundary markers are real dict entries (ref imikolov.py:98-107
+        # looks '<s>'/'<e>' up in the dict and pads NGRAM windows with them)
+        self.word_idx['<s>'] = vocab_size
+        self.word_idx['<e>'] = vocab_size + 1
+        s_id, e_id = vocab_size, vocab_size + 1
         n_sent = 2000 if mode == "train" else 500
         self.data = []
         for i in range(n_sent):
@@ -97,8 +102,11 @@ class Imikolov(Dataset):
             if self.data_type == "SEQ":
                 self.data.append(sent)
             else:
-                for j in range(len(sent) - window_size + 1):
-                    self.data.append(tuple(sent[j:j + window_size]))
+                padded = np.concatenate([
+                    np.full(window_size - 1, s_id, np.int64), sent,
+                    np.asarray([e_id], np.int64)])
+                for j in range(len(padded) - window_size + 1):
+                    self.data.append(tuple(padded[j:j + window_size]))
 
     def __getitem__(self, idx):
         return self.data[idx]

@@ -165,3 +165,81 @@ def test_grad_on_intermediate_tensor():
     z = (y * y).sum()
     (gy,) = paddle.grad(z, [y])
     np.testing.assert_allclose(gy.numpy(), [12.0])  # dz/dy = 2y = 12
+
+
+# ---------------------------------------------------------------------------
+# Double grad / create_graph=True (ref eager/backward.cc:38 GeneralGrad +
+# double-grad nodes; reference tests: test_imperative_double_grad.py)
+# ---------------------------------------------------------------------------
+
+def test_double_grad_scalar():
+    x = paddle.to_tensor([2.0, -1.5], stop_gradient=False)
+    y = (x * x * x).sum()
+    (g,) = paddle.grad(y, [x], create_graph=True)
+    np.testing.assert_allclose(g.numpy(), 3 * np.array([2.0, -1.5]) ** 2,
+                               rtol=1e-6)
+    (g2,) = paddle.grad(g.sum(), [x])
+    np.testing.assert_allclose(g2.numpy(), 6 * np.array([2.0, -1.5]),
+                               rtol=1e-6)
+
+
+def test_double_grad_matches_jax_composition():
+    """Gradient-penalty pattern: d/dW of ||d out/d x||^2 on a small MLP."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_hackathon_tpu import nn
+
+    paddle.seed(0)
+    lin1, lin2 = nn.Linear(4, 8), nn.Linear(8, 1)
+    xin = paddle.to_tensor(
+        np.random.RandomState(0).randn(3, 4).astype("float32"),
+        stop_gradient=False)
+    out = lin2(paddle.tanh(lin1(xin))).sum()
+    (gx,) = paddle.grad(out, [xin], create_graph=True)
+    gp = (gx * gx).sum()
+    gp.backward()
+
+    W1, b1 = np.asarray(lin1.weight._value), np.asarray(lin1.bias._value)
+    W2, b2 = np.asarray(lin2.weight._value), np.asarray(lin2.bias._value)
+
+    def f(params, xv):
+        W1, b1, W2, b2 = params
+        return (jnp.tanh(xv @ W1 + b1) @ W2 + b2).sum()
+
+    def gpen(params, xv):
+        g = jax.grad(f, argnums=1)(params, xv)
+        return (g * g).sum()
+
+    ref = jax.grad(gpen)((W1, b1, W2, b2), np.asarray(xin._value))
+    np.testing.assert_allclose(
+        np.asarray(lin1.weight._grad_value), np.asarray(ref[0]), atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(lin2.weight._grad_value), np.asarray(ref[2]), atol=1e-5)
+
+
+def test_double_grad_third_order():
+    x = paddle.to_tensor([1.5], stop_gradient=False)
+    y = (x ** 4).sum()
+    (g1,) = paddle.grad(y, [x], create_graph=True)
+    (g2,) = paddle.grad(g1.sum(), [x], create_graph=True)
+    (g3,) = paddle.grad(g2.sum(), [x])
+    np.testing.assert_allclose(g3.numpy(), [24 * 1.5], rtol=1e-6)
+
+
+def test_double_grad_allow_unused():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    z = paddle.to_tensor([1.0], stop_gradient=False)
+    y = (x * x).sum()
+    (g,) = paddle.grad(y, [x], create_graph=True)
+    gx, gz = paddle.grad(g.sum(), [x, z], allow_unused=True)
+    np.testing.assert_allclose(gx.numpy(), [2.0], rtol=1e-6)
+    assert gz is None
+
+
+def test_grad_on_leaf_output_does_not_pollute():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    (g1,) = paddle.grad(x, [x])
+    (g2,) = paddle.grad(x, [x])
+    np.testing.assert_allclose(g1.numpy(), [1.0, 1.0])
+    np.testing.assert_allclose(g2.numpy(), [1.0, 1.0])  # no double-count
+    assert x.grad is None  # .grad untouched by paddle.grad

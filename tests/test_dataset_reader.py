@@ -52,7 +52,8 @@ def test_imdb_and_imikolov():
     assert len(gram) == 5
     src, trg = next(dataset.imikolov.train(
         d, 5, dataset.imikolov.DataType.SEQ)())
-    assert src[0] == 0 and trg[-1] == 1
+    assert src[0] == d['<s>'] and trg[-1] == d['<e>']
+    assert d['<s>'] != 0 and d['<e>'] != 1  # not aliased onto real words
 
 
 def test_movielens():

@@ -268,8 +268,10 @@ def test_kernel_autotune_cache():
     from paddle_hackathon_tpu.incubate.nn.kernels import flash_attention as fa
 
     at.kernel_cache.clear()
-    st = incubate.autotune({"kernel": {"enable": True,
-                                       "tuning_range": [0, 100]}})
+    ret = incubate.autotune({"kernel": {"enable": True,
+                                        "tuning_range": [0, 100]}})
+    assert ret is None  # reference parity: set_config returns None
+    st = incubate.autotune_status()
     assert st["config"]["kernel"]["enable"]
 
     calls = []
@@ -319,3 +321,41 @@ def test_autotune_eager_window(monkeypatch):
     incubate.autotune({"kernel": {"enable": True, "tuning_range": [0, 2]}})
     assert at.in_tuning_window()
     incubate.autotune({"kernel": {"enable": False}})
+
+
+def test_flash_attention_causal_cross_lengths():
+    """skv != sq with causal=True: the diagonal-clamped index maps must stay
+    in range (regression: the q-block map could run past n_q for long kv)."""
+    from paddle_hackathon_tpu.incubate.nn.kernels import flash_attention as fa
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(1)
+    bh, sq, skv, d = 2, 256, 512, 32
+    q = jnp.asarray(rng.randn(bh, sq, d), jnp.float32) * 0.3
+    k = jnp.asarray(rng.randn(bh, skv, d), jnp.float32) * 0.3
+    v = jnp.asarray(rng.randn(bh, skv, d), jnp.float32)
+    scale = 1.0 / np.sqrt(d)
+
+    def ref(q, k, v):
+        s = jnp.einsum("bqd,bkd->bqk", q, k) * scale
+        mask = (jnp.arange(sq)[:, None] >= jnp.arange(skv)[None, :])
+        s = jnp.where(mask, s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        return jnp.einsum("bqk,bkd->bqd", p, v)
+
+    out = fa.flash_attention_bhd(q, k, v, True, scale)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref(q, k, v)),
+                               rtol=2e-4, atol=2e-4)
+
+    def loss(f):
+        return lambda q, k, v: jnp.sum(f(q, k, v) ** 2)
+
+    g1 = jax.grad(loss(lambda q, k, v: fa.flash_attention_bhd(
+        q, k, v, True, scale)), argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(loss(ref), argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-3, atol=2e-3)
+    # keys past the causal horizon get exactly zero grad
+    assert float(jnp.max(jnp.abs(g1[1][:, sq:, :]))) == 0.0
