@@ -74,6 +74,38 @@ def flash_attention_bshd(query, key, value, causal=False, sm_scale=None,
     return apply_op("flash_attention", fn, [_t(query), _t(key), _t(value)])
 
 
+def flash_attention_qkv_packed(qkv, num_heads, causal=True, sm_scale=None,
+                               dropout_p=0.0, seed=None):
+    """Flash attention directly on the fused qkv projection output
+    ``(b, s, 3*num_heads*head_dim)`` — no head split/merge ever touches
+    HBM (the (b,s,h,d) reorganization around the bhd kernel costs ~10% of
+    a gpt2-class train step in layout copies). Returns ``(b, s, h*d)``
+    ready for the output projection. Raises ValueError when shapes don't
+    qualify so callers can fall back.
+    """
+    from ..kernels import flash_attention_packed as _fap
+
+    qkv = _t(qkv)
+    b, s, hd3 = qkv.shape
+    head_dim = hd3 // 3 // num_heads
+    if not _fap.supported(s, s, num_heads, head_dim, qkv.dtype):
+        raise ValueError(
+            f"packed flash kernel unsupported for seq {s}, heads {num_heads}, "
+            f"head_dim {head_dim}, dtype {qkv.dtype}")
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(head_dim)
+    if dropout_p and seed is None:
+        from ....core import random as core_random
+        key_arr = core_random.split_key()
+        seed = jax.random.randint(key_arr, (1,), -2**31, 2**31 - 1,
+                                  dtype=jnp.int32)
+
+    def fn(qkv_val):
+        return _fap.flash_attention_packed(qkv_val, num_heads, causal,
+                                           scale, float(dropout_p), seed)
+
+    return apply_op("flash_attention_packed", fn, [qkv])
+
+
 def flash_attention(query, key, value, dropout=0.0, causal=False,
                     return_softmax=False, name=None):
     """paddle.incubate flash_attention-style API: returns (out, softmax)."""

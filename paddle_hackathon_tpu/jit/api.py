@@ -75,15 +75,26 @@ class StaticFunction:
     def __init__(self, function, input_spec: Optional[Sequence[InputSpec]] = None,
                  build_strategy=None, backend=None):
         self._raw_fn = function
+        self._conv_fn = None  # dy2static-converted, built lazily
         self._input_spec = input_spec
         self._cache = {}
         self._layer: Optional[Layer] = getattr(function, "__self__", None)
         functools.update_wrapper(self, function)
 
+    @property
+    def _fn(self):
+        """The function to trace: AST control-flow-converted (dy2static) so
+        Python if/while/for on tensor values become lax.cond/while_loop
+        (ref program_translator.py:340 + ifelse/loop transformers)."""
+        if self._conv_fn is None:
+            from . import dy2static
+            self._conv_fn = dy2static.convert_function(self._raw_fn)
+        return self._conv_fn
+
     # -- program construction ---------------------------------------------
     def _build(self, key, n_args, training):
         layer = self._layer
-        fn = self._raw_fn
+        fn = self._fn
 
         def pure(param_list, buffer_list, rng_key, *jax_args):
             param_keys, buffer_keys = key_meta
@@ -147,7 +158,7 @@ class StaticFunction:
         # container / closure tracers are covered too.
         if not _trace_state_clean():
             if layer is None:
-                return self._raw_fn(*args)
+                return self._fn(*args)
             # guard in-place buffer updates (BN stats): if the enclosing
             # caller did not swap state (functional_call does), a traced
             # update would corrupt the live layer — snapshot and drop any
@@ -155,7 +166,7 @@ class StaticFunction:
             bufs = list(_buffer_tensors(layer))
             saved = [b._value for b in bufs]
             try:
-                return self._raw_fn(*args)
+                return self._fn(*args)
             finally:
                 for b, old in zip(bufs, saved):
                     if isinstance(b._value, jax.core.Tracer):

@@ -1,0 +1,791 @@
+"""Dynamic-to-static AST conversion of Python control flow.
+
+The reference rewrites ``if``/``while``/``for`` on tensor values into graph
+ops via ~20 AST transformers
+(``dygraph_to_static/program_translator.py:340``, ``ifelse_transformer.py``,
+``loop_transformer.py``, ``logical_transformer.py``).  Trace-based
+``to_static`` alone silently bakes one branch into the program (or crashes
+on ``bool(tracer)``) whenever a branch condition depends on tensor *values*.
+
+TPU-native design: the same AST rewrite, but the target ops are XLA's
+structured control flow — ``lax.cond`` and ``lax.while_loop`` — dispatched
+at *runtime*: every rewritten site calls a ``convert_*`` helper that keeps
+plain Python semantics when the predicate is a concrete Python/NumPy value
+and lowers to the lax primitive only when it is a traced tensor.  One
+rewritten function therefore serves both eager and compiled execution, like
+the reference's ``convert_ifelse``/``convert_while_loop`` runtime layer
+(``dygraph_to_static/convert_operators.py``).
+
+Scope (documented, checked, and erroring loudly otherwise):
+
+- ``if``/``elif``/``else`` with tensor predicates: both branches must bind
+  the same set of traced locals with matching shapes/dtypes.
+- ``while`` with tensor conditions: loop-carried locals must keep stable
+  shapes/dtypes across iterations.
+- ``for i in range(...)``: desugared to ``while`` (generic-iterable ``for``
+  keeps Python semantics — iterating a traced tensor unrolls or errors,
+  matching trace behavior).
+- ``and`` / ``or`` / ``not`` on tensors: ``jnp.logical_*`` (short-circuit
+  preserved for plain Python values).
+- ``return`` / ``break`` / ``continue`` inside a *tensor-dependent* branch
+  or loop body are not convertible (same restriction class as the
+  reference's early-return transformer); such statements leave the
+  enclosing statement untransformed, which keeps Python-predicate code
+  working and raises jax's concretization error for tensor predicates.
+"""
+
+from __future__ import annotations
+
+import ast
+import functools
+import inspect
+import textwrap
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["convert_function", "convert_ifelse", "convert_while",
+           "convert_logical_and", "convert_logical_or", "convert_logical_not",
+           "Undefined"]
+
+
+class _UndefinedType:
+    """Placeholder for a local that is not yet bound at the rewrite site."""
+
+    _instance = None
+
+    def __new__(cls):
+        if cls._instance is None:
+            cls._instance = super().__new__(cls)
+        return cls._instance
+
+    def __repr__(self):
+        return "<undefined local>"
+
+    def __bool__(self):
+        raise NameError(
+            "local variable referenced before assignment inside converted "
+            "control flow")
+
+
+Undefined = _UndefinedType()
+
+
+def _tensor_cls():
+    from ..core.tensor import Tensor
+    return Tensor
+
+
+def _raw(x):
+    T = _tensor_cls()
+    return x._value if isinstance(x, T) else x
+
+
+def _is_traced(x) -> bool:
+    return isinstance(_raw(x), jax.core.Tracer)
+
+
+# ---------------------------------------------------------------------------
+# Runtime converters
+# ---------------------------------------------------------------------------
+
+def _to_carry(val, site):
+    """A control-flow-carried local -> jax value (or raise helpfully)."""
+    if val is Undefined:
+        raise ValueError(
+            f"{site}: a local is assigned on only one side of tensor-"
+            "dependent control flow; bind it before the branch so both "
+            "sides carry the same variables")
+    v = _raw(val)
+    if isinstance(v, (jax.Array, jax.core.Tracer)):
+        return v
+    try:
+        return jnp.asarray(v)
+    except (TypeError, ValueError) as e:
+        raise TypeError(
+            f"{site}: local of type {type(val).__name__} cannot be carried "
+            "through tensor-dependent control flow (only tensors and "
+            "numeric values can)") from e
+
+
+def _wrap_carry(vals):
+    T = _tensor_cls()
+    return tuple(T(v) for v in vals)
+
+
+def convert_ifelse(pred, true_fn: Callable, false_fn: Callable,
+                   vars: tuple) -> tuple:
+    """Rewritten ``if``: dispatches to ``lax.cond`` on traced predicates.
+
+    The branch callables receive the *current* values of every name either
+    branch assigns (``Undefined`` for names not yet bound — legal as long
+    as the branch writes before it reads); everything else they read
+    through their closures, which ``lax.cond`` traces inline.  Only the
+    branch *outputs* must be carryable and structurally identical."""
+    p = _raw(pred)
+    if not _is_traced(p):
+        # Python semantics (covers concrete device arrays via __bool__)
+        return true_fn(*vars) if p else false_fn(*vars)
+
+    site = ("if on a traced tensor (branches must assign the same locals "
+            "with matching shapes/dtypes)")
+
+    def _branch(fn):
+        def run(_):
+            out = fn(*vars)
+            return tuple(_to_carry(o, site) for o in out)
+        return run
+
+    out = jax.lax.cond(jnp.reshape(p, ()).astype(bool),
+                       _branch(true_fn), _branch(false_fn), ())
+    return _wrap_carry(out)
+
+
+def convert_ifelse_ret(pred, true_fn: Callable, false_fn: Callable,
+                       vars: tuple):
+    """Rewritten *returning* ``if`` (tail position): both branches end in
+    ``return``; the whole construct's value is the function's result."""
+    p = _raw(pred)
+    if not _is_traced(p):
+        return true_fn(*vars) if p else false_fn(*vars)
+
+    T = _tensor_cls()
+    site = ("returning if on a traced tensor (both return values must have "
+            "matching structure/shapes/dtypes)")
+
+    def _unwrap_tree(out):
+        return jax.tree.map(
+            lambda t: _to_carry(t, site) if isinstance(t, T) else t, out,
+            is_leaf=lambda t: isinstance(t, T))
+
+    def _branch(fn):
+        def run(_):
+            return _unwrap_tree(fn(*vars))
+        return run
+
+    out = jax.lax.cond(jnp.reshape(p, ()).astype(bool),
+                       _branch(true_fn), _branch(false_fn), ())
+    return jax.tree.map(
+        lambda v: T(v) if isinstance(v, (jax.Array, jax.core.Tracer)) else v,
+        out)
+
+
+def convert_while(cond_fn: Callable, body_fn: Callable,
+                  vars: tuple) -> tuple:
+    """Rewritten ``while``: dispatches to ``lax.while_loop`` on traced
+    conditions."""
+    test = cond_fn(*vars)
+    if not _is_traced(test):
+        while bool(_raw(test)):
+            vars = tuple(body_fn(*vars))
+            test = cond_fn(*vars)
+            if _is_traced(test):
+                # condition became traced mid-loop (e.g. first iteration
+                # produced a tracer) — hand off to the traced path
+                return convert_while(cond_fn, body_fn, vars)
+        return tuple(vars)
+
+    site = "while on a traced tensor"
+    carried = tuple(_to_carry(v, site) for v in vars)
+
+    def cond(vs):
+        t = cond_fn(*_wrap_carry(vs))
+        return jnp.reshape(_raw(t), ()).astype(bool)
+
+    def body(vs):
+        out = body_fn(*_wrap_carry(vs))
+        return tuple(_to_carry(o, site) for o in out)
+
+    out = jax.lax.while_loop(cond, body, carried)
+    return _wrap_carry(out)
+
+
+def convert_logical_and(lhs_fn: Callable, rhs_fn: Callable):
+    l = lhs_fn()
+    if _is_traced(l):
+        return _tensor_cls()(jnp.logical_and(
+            jnp.asarray(_raw(l)).astype(bool), _bool_val(rhs_fn())))
+    if not l:
+        return l
+    r = rhs_fn()
+    if _is_traced(r):
+        return _tensor_cls()(_bool_val(r))
+    return r
+
+
+def convert_logical_or(lhs_fn: Callable, rhs_fn: Callable):
+    l = lhs_fn()
+    if _is_traced(l):
+        return _tensor_cls()(jnp.logical_or(
+            jnp.asarray(_raw(l)).astype(bool), _bool_val(rhs_fn())))
+    if l:
+        return l
+    r = rhs_fn()
+    if _is_traced(r):
+        return _tensor_cls()(_bool_val(r))
+    return r
+
+
+def convert_logical_not(x):
+    if _is_traced(x):
+        return _tensor_cls()(jnp.logical_not(_bool_val(x)))
+    return not x
+
+
+def _bool_val(x):
+    return jnp.asarray(_raw(x)).astype(bool)
+
+
+# ---------------------------------------------------------------------------
+# AST analysis helpers
+# ---------------------------------------------------------------------------
+
+def _assigned_names(stmts) -> list:
+    """Names bound by a statement list (not descending into nested defs)."""
+    names = []
+
+    def add(n):
+        if n not in names:
+            names.append(n)
+
+    def add_target(t):
+        if isinstance(t, ast.Name):
+            add(t.id)
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                add_target(e)
+        elif isinstance(t, ast.Starred):
+            add_target(t.value)
+
+    def walrus_targets(node):
+        """NamedExpr bindings inside expressions of this statement, not
+        descending into nested function/lambda scopes (where := binds
+        locally... except lambda, where it binds in the enclosing scope —
+        close enough to flag it as bound here)."""
+        for sub in ast.iter_child_nodes(node):
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.ClassDef)):
+                continue
+            if isinstance(sub, ast.NamedExpr):
+                add_target(sub.target)
+            walrus_targets(sub)
+
+    def walk(body):
+        for node in body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                # nested defs (incl. our generated branch helpers) are
+                # re-created on every execution of the suite and cannot be
+                # carried through lax control flow — not state
+                continue  # do not descend
+            walrus_targets(node)
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    add_target(t)
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                add_target(node.target)
+            elif isinstance(node, ast.For):
+                add_target(node.target)
+            elif isinstance(node, ast.With):
+                for item in node.items:
+                    if item.optional_vars is not None:
+                        add_target(item.optional_vars)
+            elif isinstance(node, (ast.Import, ast.ImportFrom)):
+                for alias in node.names:
+                    add((alias.asname or alias.name).split(".")[0])
+            elif isinstance(node, ast.Try):
+                for handler in node.handlers:
+                    if handler.name:
+                        add(handler.name)
+                    walk(handler.body)
+            for attr in ("body", "orelse", "finalbody"):
+                walk(getattr(node, attr, []) or [])
+    walk(stmts)
+    return names
+
+
+def _read_names(node) -> set:
+    return {n.id for n in ast.walk(node)
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load)}
+
+
+def _has_return_at_level(stmts) -> bool:
+    """Return present at this control-flow level (descending through nested
+    ifs — a return there still exits the function — but not into nested
+    function definitions; returns inside nested *loops* also count, since
+    they exit the function too)."""
+    for node in stmts:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            continue
+        if isinstance(node, ast.Return):
+            return True
+        for attr in ("body", "orelse", "finalbody"):
+            if _has_return_at_level(getattr(node, attr, []) or []):
+                return True
+    return False
+
+
+def _has_loop_escape_at_level(stmts) -> bool:
+    """break/continue/yield at this level that would escape the fold."""
+    for node in stmts:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            continue
+        if isinstance(node, (ast.Break, ast.Continue, ast.Yield,
+                             ast.YieldFrom)):
+            return True
+        if isinstance(node, (ast.For, ast.While)):
+            continue  # break/continue inside bind to that loop
+        for attr in ("body", "orelse", "finalbody"):
+            if _has_loop_escape_at_level(getattr(node, attr, []) or []):
+                return True
+    return False
+
+
+def _terminates(stmts) -> bool:
+    """True when every execution path through the suite ends in ``return``
+    (conservative: only Return endings and exhaustive if/else are
+    recognized)."""
+    if not stmts:
+        return False
+    last = stmts[-1]
+    if isinstance(last, ast.Return):
+        return True
+    if isinstance(last, ast.If):
+        return (_terminates(last.body) and last.orelse
+                and _terminates(last.orelse))
+    return False
+
+
+def _has_flow_escape(stmts, *, loop: bool) -> bool:
+    """True when the statement list contains return/break/continue/yield at
+    this control-flow level (not inside nested functions or nested loops for
+    break/continue)."""
+    for node in stmts:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            continue
+        if isinstance(node, (ast.Return, ast.Yield, ast.YieldFrom)):
+            return True
+        if isinstance(node, (ast.Break, ast.Continue)):
+            return True
+        if isinstance(node, (ast.For, ast.While)):
+            # break/continue inside a nested loop bind to that loop — only
+            # return/yield still escape
+            for sub in ast.walk(node):
+                if isinstance(sub, (ast.Return, ast.Yield, ast.YieldFrom)):
+                    return True
+            continue
+        for attr in ("body", "orelse", "finalbody"):
+            if _has_flow_escape(getattr(node, attr, []) or [], loop=loop):
+                return True
+    return False
+
+
+# ---------------------------------------------------------------------------
+# The transformer
+# ---------------------------------------------------------------------------
+
+_JST = "__jst__"  # module alias injected into the compiled namespace
+
+
+class _ControlFlowTransformer(ast.NodeTransformer):
+    def __init__(self):
+        self.counter = 0
+        self.bound_names: set = set()  # approximation of names bound so far
+
+    def _uid(self, kind):
+        self.counter += 1
+        return f"__jst_{kind}_{self.counter}"
+
+    # -- boolean operators -------------------------------------------------
+    def visit_BoolOp(self, node):
+        self.generic_visit(node)
+        helper = ("convert_logical_and" if isinstance(node.op, ast.And)
+                  else "convert_logical_or")
+        expr = node.values[-1]
+        for v in reversed(node.values[:-1]):
+            expr = ast.Call(
+                func=ast.Attribute(
+                    value=ast.Name(id=_JST, ctx=ast.Load()),
+                    attr=helper, ctx=ast.Load()),
+                args=[ast.Lambda(args=_empty_args(), body=v),
+                      ast.Lambda(args=_empty_args(), body=expr)],
+                keywords=[])
+        return ast.copy_location(expr, node)
+
+    def visit_UnaryOp(self, node):
+        self.generic_visit(node)
+        if isinstance(node.op, ast.Not):
+            return ast.copy_location(ast.Call(
+                func=ast.Attribute(
+                    value=ast.Name(id=_JST, ctx=ast.Load()),
+                    attr="convert_logical_not", ctx=ast.Load()),
+                args=[node.operand], keywords=[]), node)
+        return node
+
+    # -- statements --------------------------------------------------------
+    def _track(self, stmts):
+        self.bound_names.update(_assigned_names(stmts))
+
+    def visit_FunctionDef(self, node):
+        # collect parameter names, then rewrite the body
+        args = node.args
+        for a in (args.posonlyargs + args.args + args.kwonlyargs):
+            self.bound_names.add(a.arg)
+        if args.vararg:
+            self.bound_names.add(args.vararg.arg)
+        if args.kwarg:
+            self.bound_names.add(args.kwarg.arg)
+        node.body = self._rewrite_block(node.body, tail=True)
+        return node
+
+    def _rewrite_block(self, stmts, tail=False):
+        """Rewrite a suite.  ``tail`` marks suites whose end is the end of
+        the function (so a returning ``if`` can fold the rest of the suite
+        into its else branch — the guard-clause pattern)."""
+        out = []
+        for idx, s in enumerate(stmts):
+            if (tail and isinstance(s, ast.If)
+                    and _has_return_at_level([s])
+                    and not _has_loop_escape_at_level([s])):
+                # Folding the rest of the suite into one branch is only
+                # sound when the *other* branch never falls through.  If
+                # the body terminates, the rest belongs to the else; if
+                # only the else terminates, swap branches (negating the
+                # predicate).  Neither terminating: leave Python semantics.
+                if _terminates(s.body):
+                    out.extend(self._fold_return_if(s, stmts[idx + 1:]))
+                    return out  # the rest of the suite was consumed
+                if _terminates(s.orelse):
+                    s.test = ast.copy_location(ast.UnaryOp(
+                        op=ast.Not(), operand=s.test), s.test)
+                    s.body, s.orelse = s.orelse, s.body
+                    out.extend(self._fold_return_if(s, stmts[idx + 1:]))
+                    return out
+            res = self.visit(s)
+            if isinstance(res, list):
+                out.extend(res)
+            elif res is not None:
+                out.append(res)
+            # names bound by this statement become visible to later ones
+            self.bound_names.update(_assigned_names([s]))
+        return out
+
+    def _fold_return_if(self, node, rest):
+        """Rewrite a tail-position ``if`` that returns into
+        ``return convert_ifelse_ret(...)``, folding the remainder of the
+        suite into the else branch (exact Python semantics: when the
+        condition is false, control falls through to the rest)."""
+        node.test = self.visit(node.test)
+        body_src = list(node.body)
+        orelse_src = list(node.orelse) + list(rest)
+        assigned = _assigned_names(body_src + orelse_src)
+        assigned = [n for n in assigned if not n.startswith("__jst_")]
+
+        outer_bound = set(self.bound_names)
+        body_r = self._rewrite_block(body_src, tail=True)
+        self.bound_names = set(outer_bound)
+        orelse_r = self._rewrite_block(orelse_src, tail=True)
+        self.bound_names = outer_bound
+
+        def ensure_ret(block):
+            if not block or not isinstance(block[-1], ast.Return):
+                block.append(ast.Return(value=ast.Constant(value=None)))
+            return block
+
+        true_name = self._uid("rtrue")
+        false_name = self._uid("rfalse")
+        t_fn = ast.FunctionDef(
+            name=true_name, args=_plain_args(assigned),
+            body=ensure_ret(body_r), decorator_list=[], returns=None,
+            type_comment=None, **_tp())
+        f_fn = ast.FunctionDef(
+            name=false_name, args=_plain_args(assigned),
+            body=ensure_ret(orelse_r), decorator_list=[], returns=None,
+            type_comment=None, **_tp())
+        ret = ast.Return(value=ast.Call(
+            func=ast.Attribute(value=ast.Name(id=_JST, ctx=ast.Load()),
+                               attr="convert_ifelse_ret", ctx=ast.Load()),
+            args=[node.test,
+                  ast.Name(id=true_name, ctx=ast.Load()),
+                  ast.Name(id=false_name, ctx=ast.Load()),
+                  _name_tuple_or_undefined(assigned, self.bound_names)],
+            keywords=[]))
+        nodes = [t_fn, f_fn, ret]
+        for n in nodes:
+            ast.copy_location(n, node)
+            ast.fix_missing_locations(n)
+        return nodes
+
+    def visit_If(self, node):
+        node.test = self.visit(node.test)
+        outer_bound = set(self.bound_names)  # names bound BEFORE the branch
+        node.body = self._rewrite_block(node.body)
+        self.bound_names = set(outer_bound)
+        node.orelse = self._rewrite_block(node.orelse)
+        self.bound_names = outer_bound
+        if (_has_flow_escape(node.body, loop=False)
+                or _has_flow_escape(node.orelse, loop=False)):
+            return node  # early return/break: leave Python semantics
+        assigned = [n for n in _assigned_names(node.body + node.orelse)
+                    if not n.startswith("__jst_")]
+        if not assigned:
+            # no state change: still needs the runtime dispatch for side
+            # effects? a tensor-pred if with no assignments is either dead
+            # or side-effecting — keep Python semantics (trace errors will
+            # name the site)
+            return node
+        true_name = self._uid("true")
+        false_name = self._uid("false")
+        tmp = self._uid("ifout")
+
+        def mk_branch(name, body):
+            fn = ast.FunctionDef(
+                name=name,
+                args=_plain_args(assigned),
+                body=(body or [ast.Pass()]) + [_return_tuple(assigned)],
+                decorator_list=[], returns=None, type_comment=None,
+                **_tp(),
+            )
+            return fn
+
+        call = ast.Assign(
+            targets=[ast.Name(id=tmp, ctx=ast.Store())],
+            value=ast.Call(
+                func=ast.Attribute(value=ast.Name(id=_JST, ctx=ast.Load()),
+                                   attr="convert_ifelse", ctx=ast.Load()),
+                args=[node.test,
+                      ast.Name(id=true_name, ctx=ast.Load()),
+                      ast.Name(id=false_name, ctx=ast.Load()),
+                      _name_tuple_or_undefined(assigned, self.bound_names)],
+                keywords=[]))
+        unpack = ast.Assign(
+            targets=[ast.Tuple(
+                elts=[ast.Name(id=n, ctx=ast.Store()) for n in assigned],
+                ctx=ast.Store())],
+            value=ast.Name(id=tmp, ctx=ast.Load()))
+        nodes = [mk_branch(true_name, node.body),
+                 mk_branch(false_name, node.orelse), call, unpack]
+        for n in nodes:
+            ast.copy_location(n, node)
+            ast.fix_missing_locations(n)
+        return nodes
+
+    def visit_While(self, node):
+        node.test = self.visit(node.test)
+        outer_bound = set(self.bound_names)
+        node.body = self._rewrite_block(node.body)
+        self.bound_names = outer_bound
+        if node.orelse or _has_flow_escape(node.body, loop=True):
+            return node
+        assigned = _assigned_names(node.body)
+        carried = sorted(
+            n for n in set(assigned) | (_read_names(node.test)
+                                        & (self.bound_names
+                                           | set(assigned)))
+            if not n.startswith("__jst_"))
+        if not carried:
+            return node
+        cond_name = self._uid("cond")
+        body_name = self._uid("body")
+        tmp = self._uid("whileout")
+        cond_fn = ast.FunctionDef(
+            name=cond_name, args=_plain_args(carried),
+            body=[ast.Return(value=node.test)],
+            decorator_list=[], returns=None, type_comment=None, **_tp())
+        body_fn = ast.FunctionDef(
+            name=body_name, args=_plain_args(carried),
+            body=node.body + [_return_tuple(carried)],
+            decorator_list=[], returns=None, type_comment=None, **_tp())
+        call = ast.Assign(
+            targets=[ast.Name(id=tmp, ctx=ast.Store())],
+            value=ast.Call(
+                func=ast.Attribute(value=ast.Name(id=_JST, ctx=ast.Load()),
+                                   attr="convert_while", ctx=ast.Load()),
+                args=[ast.Name(id=cond_name, ctx=ast.Load()),
+                      ast.Name(id=body_name, ctx=ast.Load()),
+                      _name_tuple_or_undefined(carried, self.bound_names)],
+                keywords=[]))
+        unpack = ast.Assign(
+            targets=[ast.Tuple(
+                elts=[ast.Name(id=n, ctx=ast.Store()) for n in carried],
+                ctx=ast.Store())],
+            value=ast.Name(id=tmp, ctx=ast.Load()))
+        nodes = [cond_fn, body_fn, call, unpack]
+        for n in nodes:
+            ast.copy_location(n, node)
+            ast.fix_missing_locations(n)
+        return nodes
+
+    def visit_For(self, node):
+        # Desugar `for x in range(...)` / `for x in <expr>` into a while
+        # (the while visitor then decides python-vs-lax at runtime).  Only
+        # range() iteration is desugared — generic iterables keep Python
+        # semantics (matching the reference's for_loop transformer scope).
+        if node.orelse or _has_flow_escape(node.body, loop=True):
+            self.generic_visit(node)
+            return node
+        it = node.iter
+        if not (isinstance(it, ast.Call) and isinstance(it.func, ast.Name)
+                and it.func.id == "range" and not it.keywords
+                and 1 <= len(it.args) <= 3):
+            self.generic_visit(node)
+            return node
+        if not isinstance(node.target, ast.Name):
+            self.generic_visit(node)
+            return node
+        args = it.args
+        if len(args) == 1:
+            start, stop, step = ast.Constant(0), args[0], ast.Constant(1)
+        elif len(args) == 2:
+            start, stop, step = args[0], args[1], ast.Constant(1)
+        else:
+            start, stop, step = args
+        ivar = node.target.id
+        stop_var = self._uid("stop")
+        step_var = self._uid("step")
+        init = [
+            ast.Assign(targets=[ast.Name(id=ivar, ctx=ast.Store())],
+                       value=start),
+            ast.Assign(targets=[ast.Name(id=stop_var, ctx=ast.Store())],
+                       value=stop),
+            ast.Assign(targets=[ast.Name(id=step_var, ctx=ast.Store())],
+                       value=step),
+        ]
+        # (i - stop) * sign(step) < 0  — handles negative steps
+        test = ast.Compare(
+            left=ast.BinOp(
+                left=ast.BinOp(left=ast.Name(id=ivar, ctx=ast.Load()),
+                               op=ast.Sub(),
+                               right=ast.Name(id=stop_var, ctx=ast.Load())),
+                op=ast.Mult(),
+                right=ast.Name(id=step_var, ctx=ast.Load())),
+            ops=[ast.Lt()], comparators=[ast.Constant(0)])
+        incr = ast.AugAssign(target=ast.Name(id=ivar, ctx=ast.Store()),
+                             op=ast.Add(),
+                             value=ast.Name(id=step_var, ctx=ast.Load()))
+        # note: test compares (i-stop)*step < 0, so step sign is honored;
+        # a zero step loops forever exactly like Python range() forbids —
+        # range() would have raised already in the original code
+        loop = ast.While(test=test, body=node.body + [incr], orelse=[])
+        for n in init + [loop]:
+            ast.copy_location(n, node)
+            ast.fix_missing_locations(n)
+        rewritten = []
+        for n in init:
+            rewritten.append(n)
+            self.bound_names.update(_assigned_names([n]))
+        res = self.visit(loop)
+        rewritten.extend(res if isinstance(res, list) else [res])
+        return rewritten
+
+
+def _tp():
+    """Python-version-dependent extra FunctionDef fields."""
+    import sys
+    return {"type_params": []} if sys.version_info >= (3, 12) else {}
+
+
+def _empty_args():
+    return ast.arguments(posonlyargs=[], args=[], vararg=None, kwonlyargs=[],
+                         kw_defaults=[], kwarg=None, defaults=[])
+
+
+def _plain_args(names):
+    return ast.arguments(
+        posonlyargs=[], args=[ast.arg(arg=n) for n in names], vararg=None,
+        kwonlyargs=[], kw_defaults=[], kwarg=None, defaults=[])
+
+
+def _return_tuple(names):
+    return ast.Return(value=ast.Tuple(
+        elts=[ast.Name(id=n, ctx=ast.Load()) for n in names],
+        ctx=ast.Load()))
+
+
+def _name_tuple_or_undefined(names, bound):
+    elts = []
+    for n in names:
+        if n in bound:
+            elts.append(ast.Name(id=n, ctx=ast.Load()))
+        else:
+            elts.append(ast.Attribute(
+                value=ast.Name(id=_JST, ctx=ast.Load()),
+                attr="Undefined", ctx=ast.Load()))
+    return ast.Tuple(elts=elts, ctx=ast.Load())
+
+
+# ---------------------------------------------------------------------------
+# Function conversion
+# ---------------------------------------------------------------------------
+
+_conversion_cache: dict = {}
+
+
+def convert_function(fn: Callable) -> Callable:
+    """AST-convert ``fn``'s control flow; returns ``fn`` unchanged when the
+    source is unavailable or conversion is disabled for it."""
+    if getattr(fn, "__not_to_static__", False):
+        return fn
+    inner = fn.__func__ if inspect.ismethod(fn) else fn
+    cached = _conversion_cache.get(inner)
+    if cached is not None:
+        converted = cached
+    else:
+        converted = _convert_inner(inner)
+        _conversion_cache[inner] = converted
+    if converted is inner:
+        return fn
+    if inspect.ismethod(fn):
+        return converted.__get__(fn.__self__, type(fn.__self__))
+    return converted
+
+
+def _convert_inner(fn):
+    try:
+        src = textwrap.dedent(inspect.getsource(fn))
+        tree = ast.parse(src)
+    except (OSError, TypeError, SyntaxError, IndentationError):
+        return fn
+    fdef = tree.body[0]
+    if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return fn
+    fdef.decorator_list = []  # decorators already applied to the original
+
+    needs = any(isinstance(n, (ast.If, ast.While, ast.For, ast.BoolOp))
+                or (isinstance(n, ast.UnaryOp) and isinstance(n.op, ast.Not))
+                for n in ast.walk(fdef))
+    if not needs:
+        return fn
+
+    _ControlFlowTransformer().visit(fdef)
+    ast.fix_missing_locations(tree)
+
+    # Rebuild closure access: wrap in a factory taking the free variables.
+    freevars = fn.__code__.co_freevars
+    factory_name = "__jst_factory__"
+    factory = ast.FunctionDef(
+        name=factory_name,
+        args=_plain_args(list(freevars)),
+        body=[fdef, ast.Return(value=ast.Name(id=fdef.name, ctx=ast.Load()))],
+        decorator_list=[], returns=None, type_comment=None, **_tp())
+    mod = ast.Module(body=[factory], type_ignores=[])
+    ast.fix_missing_locations(mod)
+
+    from . import dy2static as _self
+    namespace = dict(fn.__globals__)
+    namespace[_JST] = _self
+    try:
+        code = compile(mod, filename=f"<dy2static {fn.__qualname__}>",
+                       mode="exec")
+        exec(code, namespace)
+        cells = [c.cell_contents for c in (fn.__closure__ or ())]
+        new_fn = namespace[factory_name](*cells)
+    except Exception:
+        return fn  # any conversion failure falls back to the traced path
+    new_fn = functools.wraps(fn)(new_fn)
+    new_fn.__dy2static_converted__ = True
+    return new_fn

@@ -120,9 +120,27 @@ class BertSelfAttention(Layer):
         self.dropout_p = config.attention_dropout_prob
         self.use_flash = config.use_flash_attention
 
+    def _packed_flash_ok(self, qkv, s):
+        from ..core import flags
+        from ..core.tensor import Tensor
+        from ..incubate.nn.kernels import flash_attention_packed as _fap
+        if self.use_flash is False or not flags.flag("use_fused_kernels"):
+            return False
+        if s < flags.flag("flash_attention_min_seqlen"):
+            return False
+        dtype = qkv._value.dtype if isinstance(qkv, Tensor) else qkv.dtype
+        return _fap.supported(s, s, self.num_heads, self.head_dim, dtype)
+
     def forward(self, x, attn_mask=None):
         b, s, h = x.shape
         qkv = self.qkv_proj(x)
+        if attn_mask is None and self._packed_flash_ok(qkv, s):
+            # projection-native packed flash path (no head split copies)
+            from ..incubate.nn.functional import flash_attention_qkv_packed
+            out = flash_attention_qkv_packed(
+                qkv, self.num_heads, causal=False,
+                dropout_p=self.dropout_p if self.training else 0.0)
+            return self.out_proj(out)
         qkv = ops.reshape(qkv, [b, s, 3, self.num_heads, self.head_dim])
         q, k, v = ops.unstack(qkv, axis=2)
         out = F.scaled_dot_product_attention(

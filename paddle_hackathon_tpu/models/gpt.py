@@ -77,9 +77,29 @@ class GPTAttention(Layer):
         self.dropout_p = config.attention_dropout_prob
         self.use_flash = config.use_flash_attention
 
+    def _packed_flash_ok(self, qkv, s):
+        from ..core import flags
+        from ..incubate.nn.kernels import flash_attention_packed as _fap
+        # use_flash None = auto (same heuristic as scaled_dot_product_attention)
+        if self.use_flash is False or not flags.flag("use_fused_kernels"):
+            return False
+        if s < flags.flag("flash_attention_min_seqlen"):
+            return False
+        from ..core.tensor import Tensor
+        dtype = qkv._value.dtype if isinstance(qkv, Tensor) else qkv.dtype
+        return _fap.supported(s, s, self.num_heads, self.head_dim, dtype)
+
     def forward(self, x, cache=None):
         b, s, h = x.shape
         qkv = self.qkv_proj(x)
+        if cache is None and self._packed_flash_ok(qkv, s):
+            # fast path: flash attention on the projection-native packed
+            # layout — no head split/merge copies in HBM
+            from ..incubate.nn.functional import flash_attention_qkv_packed
+            out = flash_attention_qkv_packed(
+                qkv, self.num_heads, causal=True,
+                dropout_p=self.dropout_p if self.training else 0.0)
+            return self.out_proj(out)
         qkv = ops.reshape(qkv, [b, s, 3, self.num_heads, self.head_dim])
         q, k, v = ops.unstack(qkv, axis=2)
         attn_mask = None

@@ -1,0 +1,291 @@
+"""dy2static control-flow conversion (ref dygraph_to_static transformers:
+ifelse_transformer.py, loop_transformer.py, logical_transformer.py; test
+pattern: reference test_program_translator.py — dygraph vs static parity).
+
+The AST rewrite turns Python if/while/for on tensor values into runtime
+dispatchers that lower to lax.cond / lax.while_loop under trace, so the
+same function runs eagerly AND converts — trace-based to_static alone
+would bake one branch in (or crash on bool(tracer))."""
+
+import numpy as np
+import pytest
+
+import paddle_hackathon_tpu as paddle
+from paddle_hackathon_tpu import jit, nn
+from paddle_hackathon_tpu.core.tensor import Tensor
+from paddle_hackathon_tpu.jit import dy2static
+
+
+def _t(x, dtype="float32"):
+    return paddle.to_tensor(np.asarray(x, dtype))
+
+
+class TestRuntimeConverters:
+    def test_ifelse_python_pred(self):
+        out = dy2static.convert_ifelse(
+            True, lambda x: (x + 1,), lambda x: (x - 1,), (5,))
+        assert out == (6,)
+        out = dy2static.convert_ifelse(
+            0, lambda x: (x + 1,), lambda x: (x - 1,), (5,))
+        assert out == (4,)
+
+    def test_logical_python_semantics(self):
+        assert dy2static.convert_logical_and(lambda: 0, lambda: 5) == 0
+        assert dy2static.convert_logical_and(lambda: 2, lambda: 5) == 5
+        assert dy2static.convert_logical_or(lambda: 0, lambda: 5) == 5
+        assert dy2static.convert_logical_or(lambda: 3, lambda: 5) == 3
+        assert dy2static.convert_logical_not(0) is True
+        # short circuit preserved
+        dy2static.convert_logical_and(lambda: False,
+                                      lambda: 1 / 0)  # no ZeroDivisionError
+
+    def test_while_python(self):
+        out = dy2static.convert_while(
+            lambda i, s: i < 4, lambda i, s: (i + 1, s + i), (0, 0))
+        assert out == (4, 0 + 1 + 2 + 3)
+
+
+class TestConvertedFunctions:
+    def test_tensor_if_converts_and_matches_eager(self):
+        def f(x):
+            if (x.sum() > 0):
+                y = x * 2
+            else:
+                y = x - 1
+            return y
+
+        static_f = jit.to_static(f)
+        for sign in (1.0, -1.0):
+            x = _t([sign, sign * 2])
+            np.testing.assert_allclose(
+                static_f(x).numpy(), f(x).numpy(), rtol=1e-6)
+        # both signatures hit the same compiled program (shape-keyed): the
+        # branch decision must live INSIDE the program
+        assert len(static_f._cache) == 1
+
+    def test_tensor_while_converts_and_matches_eager(self):
+        def f(x):
+            s = x.sum() * 0
+            i = _t(0.0)
+            while (i < 5):
+                s = s + x.sum() + i
+                i = i + 1
+            return s
+
+        static_f = jit.to_static(f)
+        x = _t([1.0, 2.0])
+        np.testing.assert_allclose(static_f(x).numpy(), f(x).numpy(),
+                                   rtol=1e-6)
+
+    def test_tensor_bound_while(self):
+        """Loop bound depends on tensor *values* — the case tracing cannot
+        express at all."""
+        def f(x, n):
+            s = x * 0
+            i = n * 0
+            while (i < n):
+                s = s + x
+                i = i + 1
+            return s
+
+        static_f = jit.to_static(f)
+        x = _t([2.0, 3.0])
+        for n in (3, 7):
+            got = static_f(x, _t(n, "int32"))
+            np.testing.assert_allclose(got.numpy(), n * x.numpy(), rtol=1e-6)
+        assert len(static_f._cache) == 1  # same program, different n values
+
+    def test_for_range_tensor_bound(self):
+        def f(x, n):
+            s = x * 0
+            for i in range(n):
+                s = s + x
+            return s
+
+        # eager-style python range over a concrete int still works
+        static_f = jit.to_static(f)
+        x = _t([1.0, 1.5])
+        np.testing.assert_allclose(static_f(x, 4).numpy(), 4 * x.numpy(),
+                                   rtol=1e-6)
+
+    def test_logical_ops_on_tensors(self):
+        def f(x):
+            if (x.sum() > 0) and (x.max() < 10):
+                return x + 1
+            return x - 1
+
+        static_f = jit.to_static(f)
+        for arr in ([1.0, 2.0], [-1.0, -2.0], [20.0, 1.0]):
+            x = _t(arr)
+            np.testing.assert_allclose(static_f(x).numpy(), f(x).numpy(),
+                                       rtol=1e-6)
+
+    def test_nested_if_in_while(self):
+        def f(x):
+            i = _t(0.0)
+            s = x * 0
+            while (i < 4):
+                if (i > 1):
+                    s = s + x * 2
+                else:
+                    s = s + x
+                i = i + 1
+            return s
+
+        static_f = jit.to_static(f)
+        x = _t([1.0])
+        # i=0,1 -> +x each; i=2,3 -> +2x each => 6x
+        np.testing.assert_allclose(static_f(x).numpy(), 6 * x.numpy(),
+                                   rtol=1e-6)
+        np.testing.assert_allclose(f(x).numpy(), 6 * x.numpy(), rtol=1e-6)
+
+    def test_python_pred_control_flow_unchanged(self):
+        def f(x, mode):
+            if mode == "double":   # plain python predicate
+                return x * 2
+            out = x
+            for _ in range(3):     # plain python loop
+                out = out + 1
+            return out
+
+        static_f = jit.to_static(f)
+        x = _t([1.0])
+        np.testing.assert_allclose(static_f(x, "double").numpy(), [2.0])
+        np.testing.assert_allclose(static_f(x, "other").numpy(), [4.0])
+
+    def test_early_return_python_pred_still_works(self):
+        def f(x, flag):
+            if flag:          # python pred with early return: untransformed
+                return x * 10
+            return x
+
+        static_f = jit.to_static(f)
+        x = _t([3.0])
+        np.testing.assert_allclose(static_f(x, True).numpy(), [30.0])
+        np.testing.assert_allclose(static_f(x, False).numpy(), [3.0])
+
+    def test_gradients_flow_through_converted_control_flow(self):
+        class Net(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.lin = nn.Linear(2, 2)
+
+            def forward(self, x):
+                h = self.lin(x)
+                if (h.sum() > 0):
+                    out = (h * h).sum()
+                else:
+                    out = (h * 2).sum()
+                return out
+
+        paddle.seed(3)
+        m = Net()
+        x = Tensor(np.array([[1.0, 2.0]], np.float32), stop_gradient=False)
+        m(x).backward()  # eager reference
+        g_eager = np.asarray(m.lin.weight._grad_value).copy()
+        m.lin.weight.clear_grad()
+
+        m_static = jit.to_static(m)
+        out = m_static(x)
+        out.backward()
+        assert m.lin.weight.grad is not None
+        np.testing.assert_allclose(
+            np.asarray(m.lin.weight._grad_value), g_eager, rtol=1e-5)
+
+    def test_layer_forward_with_tensor_branch(self):
+        class Gate(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.lin = nn.Linear(2, 2)
+
+            def forward(self, x):
+                h = self.lin(x)
+                if (h.mean() > 0):
+                    return h * 2
+                return h * -1
+
+        paddle.seed(0)
+        m = Gate()
+        eager = [m(_t([[0.5, 0.5]])).numpy(), m(_t([[-5.0, -5.0]])).numpy()]
+        m2 = jit.to_static(m)
+        np.testing.assert_allclose(m2(_t([[0.5, 0.5]])).numpy(), eager[0],
+                                   rtol=1e-6)
+        np.testing.assert_allclose(m2(_t([[-5.0, -5.0]])).numpy(), eager[1],
+                                   rtol=1e-6)
+
+
+class TestConversionMechanics:
+    def test_not_to_static_respected(self):
+        @jit.not_to_static
+        def f(x):
+            if (x.sum() > 0):
+                return x
+            return -x
+
+        assert dy2static.convert_function(f) is f
+
+    def test_no_control_flow_untouched(self):
+        def f(x):
+            return x * 2
+
+        assert dy2static.convert_function(f) is f
+
+    def test_closure_variables_survive(self):
+        scale = 3.0
+
+        def f(x):
+            if (x.sum() > 0):
+                y = x * scale
+            else:
+                y = x / scale
+            return y
+
+        conv = dy2static.convert_function(f)
+        assert getattr(conv, "__dy2static_converted__", False)
+        x = _t([1.0])
+        np.testing.assert_allclose(conv(x).numpy(), [3.0], rtol=1e-6)
+
+
+class TestFoldCorrectness:
+    def test_non_exhaustive_tail_if_keeps_python_semantics(self):
+        """A tail if whose body can fall through must NOT be folded (it
+        would turn fall-through into `return None`)."""
+        def f(x, a, b):
+            if a:
+                if b:
+                    return x * 10
+                x = x + 1
+            return x - 5
+
+        static_f = jit.to_static(f)
+        x = _t([2.0])
+        np.testing.assert_allclose(static_f(x, True, False).numpy(), [-2.0])
+        np.testing.assert_allclose(static_f(x, True, True).numpy(), [20.0])
+        np.testing.assert_allclose(static_f(x, False, True).numpy(), [-3.0])
+
+    def test_else_terminates_swapped_fold(self):
+        """Body falls through but else returns: fold by negating."""
+        def f(x):
+            if (x.sum() > 0):
+                y = x * 2
+            else:
+                return x - 100
+            return y + 1
+
+        static_f = jit.to_static(f)
+        np.testing.assert_allclose(static_f(_t([3.0])).numpy(), [7.0])
+        np.testing.assert_allclose(static_f(_t([-3.0])).numpy(), [-103.0])
+        assert len(static_f._cache) == 1  # single traced program
+
+    def test_walrus_assignment_carried(self):
+        def h(x, c):
+            y = 0
+            if c:
+                z = (y := 2)
+            else:
+                z = 1
+            return y + z + x * 0
+
+        static_f = jit.to_static(h)
+        np.testing.assert_allclose(static_f(_t([0.0]), True).numpy(), [4.0])
+        np.testing.assert_allclose(static_f(_t([0.0]), False).numpy(), [1.0])

@@ -74,10 +74,6 @@ def main():
     t_fwd = timeit(fwd, params)
     t_fwdbwd = timeit(fwdbwd, params)
 
-    def run_step(s):
-        s2, loss = step(s, ids, labels, key)
-        return loss
-
     # step() mutates python-side state dict; time it directly
     for _ in range(3):
         state, loss = step(state, ids, labels, key)
