@@ -126,7 +126,8 @@ class BertSelfAttention(Layer):
         from ..incubate.nn.kernels import flash_attention_packed as _fap
         if self.use_flash is False or not flags.flag("use_fused_kernels"):
             return False
-        if s < flags.flag("flash_attention_min_seqlen"):
+        if self.use_flash is None and \
+                s < flags.flag("flash_attention_min_seqlen"):
             return False
         dtype = qkv._value.dtype if isinstance(qkv, Tensor) else qkv.dtype
         return _fap.supported(s, s, self.num_heads, self.head_dim, dtype)

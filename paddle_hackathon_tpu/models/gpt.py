@@ -80,10 +80,13 @@ class GPTAttention(Layer):
     def _packed_flash_ok(self, qkv, s):
         from ..core import flags
         from ..incubate.nn.kernels import flash_attention_packed as _fap
-        # use_flash None = auto (same heuristic as scaled_dot_product_attention)
+        # mirror scaled_dot_product_attention's dispatch: explicit
+        # use_flash=True forces flash at any supported length; None (auto)
+        # applies the measured min-seqlen crossover
         if self.use_flash is False or not flags.flag("use_fused_kernels"):
             return False
-        if s < flags.flag("flash_attention_min_seqlen"):
+        if self.use_flash is None and \
+                s < flags.flag("flash_attention_min_seqlen"):
             return False
         from ..core.tensor import Tensor
         dtype = qkv._value.dtype if isinstance(qkv, Tensor) else qkv.dtype

@@ -27,7 +27,8 @@ from ..nn import functional as F
 from ..nn import initializer as I
 from ..nn.container import LayerList
 from ..nn.layer import Layer
-from ..nn.layers.common import Conv2D, Linear
+from ..nn.layers.common import Linear
+from ..nn.layers.conv import Conv2D
 from ..nn.layers.norm import BatchNorm2D
 from ..nn.parameter import ParamAttr
 
@@ -95,8 +96,11 @@ class CSPResNet(Layer):
     def __init__(self, cfg: PPYOLOEConfig):
         super().__init__()
         w, d = cfg.width_mult, cfg.depth_mult
-        chs = [_c(64, w), _c(128, w), _c(256, w), _c(512, w), _c(1024, w)]
-        self.out_channels = chs[2:]
+        # stem downsamples 4x; each of the 3 stages downsamples 2x more,
+        # so the pyramid comes out at true strides 8 / 16 / 32 (matching
+        # PPYOLOEHead.strides — a 4th stage would shift them to 16/32/64)
+        chs = [_c(64, w), _c(256, w), _c(512, w), _c(1024, w)]
+        self.out_channels = chs[1:]
         self.stem = LayerList([
             ConvBNAct(3, chs[0] // 2, 3, stride=2),
             ConvBNAct(chs[0] // 2, chs[0], 3, stride=2),
@@ -113,10 +117,9 @@ class CSPResNet(Layer):
         for s in self.stem:
             x = s(x)
         feats = []
-        for i, (down, csp) in enumerate(self.stages):
+        for down, csp in self.stages:
             x = csp(down(x))
-            if i >= 1:            # keep strides 8, 16, 32
-                feats.append(x)
+            feats.append(x)       # strides 8, 16, 32
         return feats
 
 
@@ -229,55 +232,63 @@ class PPYOLOE(Layer):
 
     def loss(self, images, gt_boxes, gt_labels):
         """Simplified training objective: each gt is assigned to the cell
-        containing its center at every level; cls BCE everywhere +
-        L1 distance regression on assigned cells."""
+        containing its center at every level; cls BCE everywhere + L1
+        distance regression on assigned cells.
+
+        Targets are pure functions of the ground truth (no gradient), so
+        they are built with raw jnp and enter the graph as constants; the
+        prediction path stays in taped Tensor ops end-to-end so
+        ``loss(...).backward()`` works in eager mode and the same code
+        traces under jit (the driver's compiled-executor config)."""
         cls_logits, reg_dists = self(images)
         gb = gt_boxes._value if isinstance(gt_boxes, Tensor) else gt_boxes
         gl = gt_labels._value if isinstance(gt_labels, Tensor) else gt_labels
-        total = 0.0
+        total = None
         ncls = self.config.num_classes
         for lvl, (cl, rd) in enumerate(zip(cls_logits, reg_dists)):
             stride = self.head.strides[lvl]
-            clv = cl._value if isinstance(cl, Tensor) else cl
-            rdv = rd._value if isinstance(rd, Tensor) else rd
-            b, _, h, w = clv.shape
+            b, _, h, w = cl.shape
+            # ---- constant targets (raw jnp; stop-gradient by design) ----
             cx = (gb[..., 0] + gb[..., 2]) / 2.0 / stride    # (B, G)
             cy = (gb[..., 1] + gb[..., 3]) / 2.0 / stride
             gi = jnp.clip(cx.astype(jnp.int32), 0, w - 1)
             gj = jnp.clip(cy.astype(jnp.int32), 0, h - 1)
-            # one-hot cls target grid (B, C, H, W) via scatter-add
             flat = gj * w + gi                               # (B, G)
-            tgt = jnp.zeros((b, h * w, ncls))
             onehot = jnp.eye(ncls)[gl]                       # (B, G, C)
-            valid = (gb[..., 2] > gb[..., 0])[..., None]
+            valid = (gb[..., 2] > gb[..., 0])[..., None]     # (B, G, 1)
             tgt = jnp.clip(
                 jnp.zeros((b, h * w, ncls)).at[
                     jnp.arange(b)[:, None], flat].add(onehot * valid),
                 0.0, 1.0)
-            logits = clv.transpose(0, 2, 3, 1).reshape(b, h * w, ncls)
-            cls_loss = jnp.mean(
-                jnp.maximum(logits, 0) - logits * tgt +
-                jnp.log1p(jnp.exp(-jnp.abs(logits))))
-            # regression on assigned cells: expected distance vs gt box
-            dist = rdv.reshape(b, 4, self.config.reg_max, h * w)
-            sm = jnp.exp(dist - jnp.max(dist, axis=2, keepdims=True))
-            sm = sm / jnp.sum(sm, axis=2, keepdims=True)
-            exp_d = jnp.einsum("bksn,s->bkn", sm, self.head.proj._value)
-            cell_x = (jnp.take_along_axis(
-                exp_d[:, 0], flat, axis=1))                  # l at gt cells
             gd = jnp.stack([
                 cx - gi.astype(jnp.float32),                 # gt l in cells
                 cy - gj.astype(jnp.float32),
                 gi.astype(jnp.float32) + 1.0 - cx,
                 gj.astype(jnp.float32) + 1.0 - cy,
-            ], axis=1)
-            picked = jnp.stack([jnp.take_along_axis(exp_d[:, k], flat,
-                                                    axis=1)
-                                for k in range(4)], axis=1)
-            reg_loss = jnp.sum(jnp.abs(picked - gd) *
-                               valid.transpose(0, 2, 1)) / (
-                jnp.maximum(jnp.sum(valid), 1.0) * 4.0)
-            total = total + cls_loss + 0.5 * reg_loss
+            ], axis=1)                                       # (B, 4, G)
+            tgt_t = Tensor(tgt)
+            gd_t = Tensor(gd)
+            valid_t = Tensor(jnp.transpose(
+                jnp.broadcast_to(valid, valid.shape[:2] + (4,)),
+                (0, 2, 1)).astype(jnp.float32))              # (B, 4, G)
+            flat4 = Tensor(jnp.broadcast_to(flat[:, None, :],
+                                            (b, 4, flat.shape[1])))
+            denom = Tensor(jnp.maximum(
+                jnp.sum(valid.astype(jnp.float32)), 1.0) * 4.0)
+            # ---- taped prediction path ----
+            logits = ops.reshape(ops.transpose(cl, [0, 2, 3, 1]),
+                                 [b, h * w, ncls])
+            cls_loss = F.binary_cross_entropy_with_logits(
+                logits, tgt_t, reduction="mean")
+            dist = ops.reshape(rd, [b, 4, self.config.reg_max, h * w])
+            sm = F.softmax(dist, axis=2)
+            proj = Tensor(self.head.proj._value.reshape(1, 1, -1, 1))
+            exp_d = ops.sum(sm * proj, axis=2)               # (B, 4, HW)
+            picked = ops.take_along_axis(exp_d, flat4, axis=2)
+            reg_sum = ops.sum(ops.abs(picked - gd_t) * valid_t)
+            reg_loss = reg_sum / denom
+            lvl_loss = cls_loss + 0.5 * reg_loss
+            total = lvl_loss if total is None else total + lvl_loss
         return total
 
     def predict(self, images, score_threshold=0.4, iou_threshold=0.5,

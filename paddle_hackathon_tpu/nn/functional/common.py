@@ -425,3 +425,25 @@ def sparse_attention(query, key, value, sparse_csr_offset, sparse_csr_columns,
     return apply_op("sparse_attention", fn,
                     [_t(query), _t(key), _t(value),
                      _t(sparse_csr_offset), _t(sparse_csr_columns)])
+
+
+def gather_tree(ids, parents):
+    """Beam-search backtrace (ref ``phi/kernels/gather_tree_kernel.h``;
+    public ``paddle.nn.functional.gather_tree``): walk parent pointers from
+    the last step back to the first, emitting the full id sequence of every
+    final beam.  Inputs are (max_time, batch, beam_width) int tensors; the
+    walk is a reverse ``lax.scan`` carrying the selected beam indices."""
+    def fn(idv, parv):
+        t_len, b, w = idv.shape
+
+        def step(beams, t):
+            picked = jnp.take_along_axis(idv[t], beams, axis=1)
+            beams_next = jnp.take_along_axis(parv[t], beams, axis=1)
+            return beams_next, picked
+
+        init = jnp.broadcast_to(jnp.arange(w, dtype=parv.dtype), (b, w))
+        _, outs = jax.lax.scan(step, init,
+                               jnp.arange(t_len - 1, -1, -1))
+        return outs[::-1]
+
+    return apply_op("gather_tree", fn, [_t(ids), _t(parents)])

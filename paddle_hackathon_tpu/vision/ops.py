@@ -157,6 +157,75 @@ def box_iou(boxes1, boxes2):
     return apply_op("box_iou", fn, [b1, b2])
 
 
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True,
+              name=None, axis=0):
+    """Encode/decode target boxes against prior (anchor) boxes (ref
+    ``phi/kernels/box_coder_kernel.h``; python
+    ``fluid/layers/detection.py:827`` — the SSD-family box transform).
+
+    encode: target [N, 4] x prior [M, 4] -> [N, M, 4] offsets
+    decode: target [N, M, 4] x prior broadcast along ``axis`` -> boxes
+    ``prior_box_var`` may be a [M, 4] tensor, a 4-list, or None.
+    """
+    pb = _t(prior_box)
+    tb = _t(target_box)
+    var_is_tensor = not (prior_box_var is None
+                         or isinstance(prior_box_var, (list, tuple)))
+    var_list = (None if var_is_tensor or prior_box_var is None
+                else jnp.asarray(prior_box_var, jnp.float32))
+
+    def _center_size(b):
+        # [xmin, ymin, xmax, ymax] -> center x/y, w/h (+1 when unnormalized,
+        # matching the reference's pixel-box convention)
+        norm = 0.0 if box_normalized else 1.0
+        w = b[..., 2] - b[..., 0] + norm
+        h = b[..., 3] - b[..., 1] + norm
+        cx = b[..., 0] + w * 0.5
+        cy = b[..., 1] + h * 0.5
+        return cx, cy, w, h
+
+    def fn(pbv, tbv, *rest):
+        var = rest[0] if rest else var_list
+        pcx, pcy, pw, ph = _center_size(pbv)            # (M,)
+        if code_type == "encode_center_size":
+            tcx, tcy, tw, th = _center_size(tbv)        # (N,)
+            ox = (tcx[:, None] - pcx[None, :]) / pw[None, :]
+            oy = (tcy[:, None] - pcy[None, :]) / ph[None, :]
+            ow = jnp.log(jnp.abs(tw[:, None] / pw[None, :]))
+            oh = jnp.log(jnp.abs(th[:, None] / ph[None, :]))
+            out = jnp.stack([ox, oy, ow, oh], axis=-1)  # (N, M, 4)
+            if var is not None:
+                v = var if var.ndim == 1 else var[None, :, :]
+                out = out / v
+            return out
+        if code_type != "decode_center_size":
+            raise ValueError(f"unknown code_type {code_type!r}")
+        # decode: tbv (N, M, 4); `axis` is the target dim the prior
+        # broadcasts ACROSS (axis=0: prior [M,4] aligns with dim 1)
+        expand = (None, slice(None)) if axis == 0 else (slice(None), None)
+        pcx, pcy, pw, ph = (a[expand] for a in (pcx, pcy, pw, ph))
+        t = tbv
+        if var is not None:
+            if var.ndim == 1:
+                t = t * var
+            else:
+                t = t * (var[expand + (slice(None),)])
+        dcx = pw * t[..., 0] + pcx
+        dcy = ph * t[..., 1] + pcy
+        dw = jnp.exp(t[..., 2]) * pw
+        dh = jnp.exp(t[..., 3]) * ph
+        norm = 0.0 if box_normalized else 1.0
+        return jnp.stack([dcx - dw * 0.5, dcy - dh * 0.5,
+                          dcx + dw * 0.5 - norm,
+                          dcy + dh * 0.5 - norm], axis=-1)
+
+    args = [pb, tb]
+    if var_is_tensor:
+        args.append(_t(prior_box_var))
+    return apply_op("box_coder", fn, args)
+
+
 class RoIAlign(object):
     """Layer wrapper of roi_align (ref vision/ops.py RoIAlign)."""
 

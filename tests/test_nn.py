@@ -381,3 +381,17 @@ def test_grid_sample_nearest_shape():
     grid = paddle.zeros([2, 5, 6, 2])
     out = F.grid_sample(x, grid, mode="nearest")
     assert out.shape == [2, 3, 5, 6]
+
+
+def test_gather_tree_beam_backtrace():
+    """F.gather_tree (ref gather_tree_kernel.h; reference
+    test_gather_tree_op.py example)."""
+    import paddle_hackathon_tpu.nn.functional as F
+    ids = paddle.to_tensor(np.array(
+        [[[2, 2], [6, 1]], [[3, 9], [6, 1]], [[0, 1], [9, 0]]], "int64"))
+    parents = paddle.to_tensor(np.array(
+        [[[0, 0], [1, 1]], [[1, 0], [1, 0]], [[0, 0], [0, 1]]], "int64"))
+    out = F.gather_tree(ids, parents)
+    expect = np.array(
+        [[[2, 2], [1, 6]], [[3, 3], [6, 1]], [[0, 1], [9, 0]]], "int64")
+    np.testing.assert_array_equal(np.asarray(out._value), expect)

@@ -217,3 +217,47 @@ def test_take_along_put_along():
         paddle.take_along_axis(t, idx, axis=1).numpy(), [[1], [4]])
     out = paddle.put_along_axis(t, idx, 9.0, axis=1)
     assert out.numpy()[0, 0] == 9 and out.numpy()[1, 1] == 9
+
+
+def test_box_coder_encode_decode_roundtrip():
+    """vision.ops.box_coder (ref phi/kernels/box_coder_kernel.h;
+    test_box_coder_op.py pattern): decode inverts encode."""
+    from paddle_hackathon_tpu.vision.ops import box_coder
+    rng = np.random.RandomState(0)
+    prior = rng.rand(5, 4).astype("float32")
+    prior[:, 2:] = prior[:, :2] + rng.rand(5, 2).astype("float32") + 0.1
+    target = rng.rand(3, 4).astype("float32")
+    target[:, 2:] = target[:, :2] + rng.rand(3, 2).astype("float32") + 0.1
+
+    enc = box_coder(paddle.to_tensor(prior), None, paddle.to_tensor(target),
+                    code_type="encode_center_size")
+    assert list(enc.shape) == [3, 5, 4]
+    dec = box_coder(paddle.to_tensor(prior), None, enc,
+                    code_type="decode_center_size", axis=0)
+    # each row of dec[:, m] must reproduce the target box
+    np.testing.assert_allclose(
+        np.asarray(dec._value), np.broadcast_to(target[:, None, :], (3, 5, 4)),
+        rtol=1e-4, atol=1e-4)
+
+
+def test_box_coder_variance_forms():
+    from paddle_hackathon_tpu.vision.ops import box_coder
+    rng = np.random.RandomState(1)
+    prior = rng.rand(4, 4).astype("float32")
+    prior[:, 2:] = prior[:, :2] + 0.2
+    target = rng.rand(2, 4).astype("float32")
+    target[:, 2:] = target[:, :2] + 0.3
+    var_list = [0.1, 0.1, 0.2, 0.2]
+    var_t = np.broadcast_to(np.asarray(var_list, "float32"), (4, 4)).copy()
+
+    e_list = box_coder(paddle.to_tensor(prior), var_list,
+                       paddle.to_tensor(target))
+    e_tensor = box_coder(paddle.to_tensor(prior), paddle.to_tensor(var_t),
+                         paddle.to_tensor(target))
+    np.testing.assert_allclose(np.asarray(e_list._value),
+                               np.asarray(e_tensor._value), rtol=1e-5)
+    e_none = box_coder(paddle.to_tensor(prior), None,
+                       paddle.to_tensor(target))
+    np.testing.assert_allclose(np.asarray(e_list._value),
+                               np.asarray(e_none._value)
+                               / np.asarray(var_list, "float32"), rtol=1e-5)
