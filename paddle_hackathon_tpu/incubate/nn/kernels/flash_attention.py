@@ -471,6 +471,24 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _bwd(causal, sm_scale, dropout_p, res, do):
     q, k, v, out, lse, seed = res
+    delta_row = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                        axis=-1)                          # (bh, sq)
+    dq, dk, dv = _bwd_pair(q, k, v, do, lse, delta_row, causal, sm_scale,
+                           dropout_p, seed)
+    return dq, dk, dv, None
+
+
+def _bwd_pair(q, k, v, do, lse, delta_row, causal, sm_scale,
+              dropout_p=0.0, seed=None):
+    """(dq, dk, dv) for one q-chunk x kv-chunk pair, given the *global*
+    softmax statistics of the q rows: ``lse`` in the (bh, 8, sq) stats
+    layout and ``delta_row = rowsum(dO * O_final)`` as (bh, sq).
+
+    This is the whole-sequence backward when the pair covers the full
+    sequence — and the per-step building block of ring attention, where
+    the same q rows pair with a rotating kv chunk (Liu et al. 2023): with
+    global lse/delta the per-pair grads sum exactly to the full-attention
+    gradient."""
     if seed is None:
         seed = jnp.zeros((1,), jnp.int32)
     bh, sq, d = q.shape
@@ -479,10 +497,8 @@ def _bwd(causal, sm_scale, dropout_p, res, do):
     n_q, n_kv = sq // bq, skv // bkv
     from jax.experimental.pallas import tpu as pltpu
 
-    delta_row = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
-                        axis=-1)                          # (bh, sq)
     delta_t = jnp.broadcast_to(delta_row[:, None, :], (bh, _SUB, sq))
-    lse_t = lse                                           # (bh, 8, sq) from fwd
+    lse_t = lse                                           # (bh, 8, sq)
 
     # causal: q-block index map clamped to the diagonal from the other side
     # (the first q block that attends to kv block j) — skipped cells repeat
@@ -554,7 +570,7 @@ def _bwd(causal, sm_scale, dropout_p, res, do):
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
         interpret=_interpret(),
     )(q, k, v, do, lse_t, delta_t, seed)
-    return dq, dk, dv, None
+    return dq, dk, dv
 
 
 # ---------------------------------------------------------------------------

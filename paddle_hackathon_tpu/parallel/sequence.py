@@ -28,6 +28,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ..incubate.nn.kernels import flash_attention as _fa
+
 
 def _block_attn(q, k, v, scale, bias):
     """One (q-block x kv-block) attention partial: returns (out_unnorm,
@@ -43,18 +45,178 @@ def _block_attn(q, k, v, scale, bias):
     return o, m, l
 
 
+def _flash_ok(seq_local, dtype):
+    """Whether the Pallas kernel can run the per-chunk attention (else the
+    XLA composition below materializes O(s_local^2) scores).  Gates on the
+    backend and on the kernel's real constraints: block divisibility and
+    the dtype-dependent VMEM block cap."""
+    return (jax.default_backend() in ("tpu", "axon")
+            and _fa._block_sizes(seq_local, seq_local, dtype) is not None)
+
+
+# ---------------------------------------------------------------------------
+# Flash-in-ring: each ring step runs the Pallas flash kernel on the held kv
+# chunk and folds the chunk result into the running output with log-sum-exp
+# arithmetic — O(block^2) VMEM per step instead of the O(s_local^2) score
+# matrix of the einsum path, so 128k+ global sequences fit.  The whole ring
+# is one custom_vjp: the backward re-runs the ring with the *global* lse /
+# delta statistics, rotating (k, v, dk, dv) together so every chunk's grad
+# arrives back at its owner after n steps (Liu et al. 2023 ring attention).
+# ---------------------------------------------------------------------------
+
+def _to_bhd(x):
+    # (b, sl, h, d) -> (b*h, sl, d)
+    b, sl, h, d = x.shape
+    return jnp.swapaxes(x, 1, 2).reshape(b * h, sl, d)
+
+
+def _from_bhd(x, b, h):
+    bh, sl, d = x.shape
+    return jnp.swapaxes(x.reshape(b, h, sl, d), 1, 2)
+
+
+def _ring_flash_spmd(axis: str, n: int, causal: bool, scale: float):
+    """Build the per-device ring function (custom_vjp over local chunks)."""
+    neg = jnp.float32(-1e30)
+
+    def _fwd_impl(ql, kl, vl):
+        b, sl, h, d = ql.shape
+        my = jax.lax.axis_index(axis)
+        qb = _to_bhd(ql)
+        perm = [(i, (i + 1) % n) for i in range(n)]
+
+        # diagonal step: this device's own kv chunk
+        o0, lse0 = _fa._fwd(qb, _to_bhd(kl), _to_bhd(vl), causal, scale)
+        o = o0.astype(jnp.float32)
+        lse = lse0[:, 0, :]                       # (bh, sl)
+
+        def step(carry, i):
+            kc, vc, o, lse = carry
+            kc = jax.lax.ppermute(kc, axis, perm)
+            vc = jax.lax.ppermute(vc, axis, perm)
+            kv_rank = (my - i) % n                # owner of the held chunk
+
+            def run(_):
+                oi, lsei = _fa._fwd(qb, _to_bhd(kc), _to_bhd(vc), False,
+                                    scale)
+                return oi.astype(jnp.float32), lsei[:, 0, :]
+
+            def skip(_):
+                return (jnp.zeros_like(o),
+                        jnp.full_like(lse, neg))
+
+            if causal:
+                oi, lsei = jax.lax.cond(kv_rank < my, run, skip, None)
+            else:
+                oi, lsei = run(None)
+            new = jnp.logaddexp(lse, lsei)
+            o = (o * jnp.exp(lse - new)[..., None]
+                 + oi * jnp.exp(lsei - new)[..., None])
+            return (kc, vc, o, new), None
+
+        (kc, vc, o, lse), _ = jax.lax.scan(
+            step, (kl, vl, o, lse), jnp.arange(1, n))
+        out = _from_bhd(o, b, h).astype(ql.dtype)
+        return out, lse
+
+    @jax.custom_vjp
+    def ring(ql, kl, vl):
+        out, _ = _fwd_impl(ql, kl, vl)
+        return out
+
+    def ring_fwd(ql, kl, vl):
+        out, lse = _fwd_impl(ql, kl, vl)
+        return out, (ql, kl, vl, out, lse)
+
+    def ring_bwd(res, do):
+        ql, kl, vl, out, lse = res
+        b, sl, h, d = ql.shape
+        my = jax.lax.axis_index(axis)
+        qb = _to_bhd(ql)
+        dob = _to_bhd(do)
+        outb = _to_bhd(out)
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        # global per-row stats of MY q rows, in the kernels' layouts
+        delta_row = jnp.sum(dob.astype(jnp.float32)
+                            * outb.astype(jnp.float32), axis=-1)
+        lse_t = jnp.broadcast_to(lse[:, None, :],
+                                 (lse.shape[0], _fa._SUB, sl))
+
+        # diagonal pair
+        dq0, dk0, dv0 = _fa._bwd_pair(qb, _to_bhd(kl), _to_bhd(vl), dob,
+                                      lse_t, delta_row, causal, scale)
+
+        def step(carry, i):
+            kc, vc, dkc, dvc, dq = carry
+            kc = jax.lax.ppermute(kc, axis, perm)
+            vc = jax.lax.ppermute(vc, axis, perm)
+            dkc = jax.lax.ppermute(dkc, axis, perm)
+            dvc = jax.lax.ppermute(dvc, axis, perm)
+            kv_rank = (my - i) % n
+
+            def run(_):
+                return _fa._bwd_pair(qb, _to_bhd(kc), _to_bhd(vc), dob,
+                                     lse_t, delta_row, False, scale)
+
+            def skip(_):
+                z = jnp.zeros((qb.shape[0], sl, d), qb.dtype)
+                return z, z, z
+
+            if causal:
+                dqi, dki, dvi = jax.lax.cond(kv_rank < my, run, skip, None)
+            else:
+                dqi, dki, dvi = run(None)
+            dq = dq + dqi.astype(jnp.float32)
+            dkc = dkc + _from_bhd(dki, b, h).astype(jnp.float32)
+            dvc = dvc + _from_bhd(dvi, b, h).astype(jnp.float32)
+            return (kc, vc, dkc, dvc, dq), None
+
+        dkc0 = _from_bhd(dk0, b, h).astype(jnp.float32)
+        dvc0 = _from_bhd(dv0, b, h).astype(jnp.float32)
+        (kc, vc, dkc, dvc, dq), _ = jax.lax.scan(
+            step, (kl, vl, dkc0, dvc0, dq0.astype(jnp.float32)),
+            jnp.arange(1, n))
+        # after n-1 rotations the grad chunks sit one hop short of their
+        # owners — one more rotation completes the circle
+        dkc = jax.lax.ppermute(dkc, axis, perm)
+        dvc = jax.lax.ppermute(dvc, axis, perm)
+        return (_from_bhd(dq, b, h).astype(ql.dtype),
+                dkc.astype(kl.dtype), dvc.astype(vl.dtype))
+
+    ring.defvjp(ring_fwd, ring_bwd)
+    return ring
+
+
 def ring_attention(q, k, v, mesh: Mesh, axis: str = "sp",
-                   causal: bool = True, scale: Optional[float] = None):
+                   causal: bool = True, scale: Optional[float] = None,
+                   use_flash: Optional[bool] = None):
     """Exact attention over a sequence sharded on ``axis``.
 
     q, k, v: (b, s, h, d) global arrays with s sharded over ``axis``
     (P(None, axis, None, None)). Returns same-shaped, same-sharded output.
+    On TPU the per-chunk attention runs the Pallas flash kernel (O(block^2)
+    memory); elsewhere, or for unsupported shapes, the XLA online-softmax
+    composition below is used.  ``use_flash`` overrides the auto choice
+    (True forces the kernel — including the interpreter on CPU, which the
+    parity tests use).
     """
     n = mesh.shape.get(axis, 1)
     if n == 1:
         return _plain_attention(q, k, v, causal, scale)
     scale_ = scale if scale is not None else q.shape[-1] ** -0.5
     seq_local = q.shape[1] // n
+
+    flash = use_flash if use_flash is not None else _flash_ok(
+        seq_local, q.dtype)
+    if flash:
+        from ._smap import run_shard_map
+        spmd = _ring_flash_spmd(axis, n, causal, float(scale_))
+        return run_shard_map(
+            spmd, mesh,
+            in_specs=(P(None, axis), P(None, axis), P(None, axis)),
+            out_specs=P(None, axis),
+            manual_axes={axis},
+            args=(q, k, v))
 
     def spmd(ql, kl, vl):
         # ql/kl/vl: (b, s/n, h, d) — this device's sequence chunk
@@ -108,14 +270,20 @@ def ring_attention(q, k, v, mesh: Mesh, axis: str = "sp",
 
 
 def ulysses_attention(q, k, v, mesh: Mesh, axis: str = "sp",
-                      causal: bool = True, scale: Optional[float] = None):
+                      causal: bool = True, scale: Optional[float] = None,
+                      use_flash: Optional[bool] = None):
     """DeepSpeed-Ulysses style SP: a2a seq->head shards, full-sequence local
-    attention over h/n heads, a2a back. Requires num_heads % sp == 0."""
+    attention over h/n heads, a2a back. Requires num_heads % sp == 0.
+    The local full-sequence attention runs the Pallas flash kernel when
+    supported (its custom_vjp handles the backward)."""
     n = mesh.shape.get(axis, 1)
     if n == 1:
         return _plain_attention(q, k, v, causal, scale)
     scale_ = scale if scale is not None else q.shape[-1] ** -0.5
     assert q.shape[2] % n == 0, "ulysses needs num_heads divisible by sp"
+    s_full = q.shape[1]
+    flash = use_flash if use_flash is not None else _flash_ok(
+        s_full, q.dtype)
 
     def spmd(ql, kl, vl):
         def seq_to_heads(x):
@@ -128,14 +296,24 @@ def ulysses_attention(q, k, v, mesh: Mesh, axis: str = "sp",
                                       tiled=True)
 
         qh, kh, vh = seq_to_heads(ql), seq_to_heads(kl), seq_to_heads(vl)
-        bias = None
-        if causal:
-            s = qh.shape[1]
-            mask = jnp.tril(jnp.ones((s, s), bool))
-            bias = jnp.where(mask, 0.0, jnp.finfo(jnp.float32).min)[None, None]
-        o, m, l = _block_attn(qh.astype(jnp.float32), kh.astype(jnp.float32),
-                              vh.astype(jnp.float32), scale_, bias)
-        out = o / jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
+        if flash:
+            b, s, hl, d = qh.shape
+            ob = _fa.flash_attention_bhd(
+                _to_bhd(qh), _to_bhd(kh), _to_bhd(vh), causal,
+                float(scale_))
+            out = _from_bhd(ob, b, hl)
+        else:
+            bias = None
+            if causal:
+                s = qh.shape[1]
+                mask = jnp.tril(jnp.ones((s, s), bool))
+                bias = jnp.where(mask, 0.0,
+                                 jnp.finfo(jnp.float32).min)[None, None]
+            o, m, l = _block_attn(qh.astype(jnp.float32),
+                                  kh.astype(jnp.float32),
+                                  vh.astype(jnp.float32), scale_, bias)
+            out = (o / jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
+                   ).astype(ql.dtype)
         return heads_to_seq(out.astype(ql.dtype))
 
     from ._smap import run_shard_map
