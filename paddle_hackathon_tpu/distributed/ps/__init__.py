@@ -12,4 +12,5 @@ state; the TPU keeps the dense compute. ``fleet.init_server/init_worker``
 
 from .api import (PsServerHandle, PsClient, AsyncCommunicator,  # noqa: F401
                   SparseEmbedding, TableConfig, init_server, init_worker,
-                  run_server, stop_server, get_client, shutdown)
+                  ps_sparse_embedding, run_server, sparse_embedding_layer,
+                  stop_server, get_client, shutdown)

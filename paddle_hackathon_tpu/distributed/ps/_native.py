@@ -96,3 +96,12 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.pht_ps_barrier.argtypes = [c.c_void_p, c.c_char_p, c.c_uint32,
                                    c.c_int32]
     lib.pht_ps_barrier.restype = c.c_int32
+    lib.pht_ps_spill.argtypes = [c.c_void_p, c.c_uint32, c.c_uint32,
+                                 c.c_char_p]
+    lib.pht_ps_spill.restype = c.c_int64
+    lib.pht_ps_geo_push.argtypes = [c.c_void_p, c.c_uint32, u64p,
+                                    c.c_uint32, f32p, c.c_uint32]
+    lib.pht_ps_geo_push.restype = c.c_int32
+    lib.pht_ps_geo_pull_diff.argtypes = [c.c_void_p, c.c_uint32, c.c_uint32,
+                                         u64p, f32p, c.c_uint32, c.c_uint32]
+    lib.pht_ps_geo_pull_diff.restype = c.c_int64

@@ -21,6 +21,8 @@ import time
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence
 
+import jax
+import jax.numpy as jnp
 import numpy as np
 
 from . import _native
@@ -238,6 +240,70 @@ class PsClient:
             dropped += d
         return dropped
 
+    def spill(self, table_id: int, max_unseen: int, path: str) -> int:
+        """Evict rows unseen for more than ``max_unseen`` pull rounds to a
+        per-server spill file (the SSD tier; ref ``ssd_sparse_table.cc``
+        rocksdb cold storage).  Spilled rows leave server RAM; a later pull
+        restores them transparently.  Returns total rows spilled."""
+        total = 0
+        for s, c in enumerate(self._conns):
+            with c._lock:
+                rc = c._lib.pht_ps_spill(c._h, table_id, max_unseen,
+                                         f"{path}.srv{s}".encode())
+            if rc < 0:
+                raise RuntimeError(
+                    f"spill failed on server {s}: rc={rc} (I/O error — "
+                    "unspilled rows stay in RAM, nothing was lost)")
+            total += int(rc)
+        return total
+
+    def geo_push(self, table_id: int, ids, deltas) -> None:
+        """Geo-async push: merge raw weight deltas (the trainer ran its
+        optimizer locally; ref ``memory_sparse_geo_table.cc``)."""
+        ids = np.ascontiguousarray(np.asarray(ids, np.uint64).reshape(-1))
+        dim = self._dim(table_id)
+        deltas = np.ascontiguousarray(
+            np.asarray(deltas, np.float32).reshape(ids.size, dim))
+        for s, idx in enumerate(self._route(ids)):
+            if idx.size == 0:
+                continue
+            sub = np.ascontiguousarray(ids[idx])
+            d = np.ascontiguousarray(deltas[idx])
+            c = self._conns[s]
+            with c._lock:
+                rc = c._lib.pht_ps_geo_push(
+                    c._h, table_id, _u64p(sub), idx.size, _f32p(d), dim)
+            if rc != 0:
+                raise RuntimeError(f"geo_push failed on server {s}: {rc}")
+
+    def geo_pull_diff(self, table_id: int, trainer_id: int,
+                      cap_rows: int = 1 << 16):
+        """Rows changed since this trainer's previous ``geo_pull_diff``
+        (bounded staleness: each call delivers up to ``cap_rows`` oldest
+        pending updates per server and advances the watermark only over
+        what was delivered, so a burst larger than the buffer arrives over
+        the following rounds instead of being lost).  Returns (ids, rows).
+        """
+        dim = self._dim(table_id)
+        ids = np.empty(cap_rows, np.uint64)          # reused per server
+        rows = np.empty((cap_rows, dim), np.float32)
+        all_ids, all_rows = [], []
+        for s, c in enumerate(self._conns):
+            with c._lock:
+                rc = c._lib.pht_ps_geo_pull_diff(
+                    c._h, table_id, trainer_id, _u64p(ids), _f32p(rows),
+                    cap_rows, dim)
+            if rc < 0:
+                raise RuntimeError(f"geo_pull_diff failed on server {s}: "
+                                   f"{rc}")
+            n = int(rc)
+            if n:
+                all_ids.append(ids[:n].copy())
+                all_rows.append(rows[:n].copy())
+        if not all_ids:
+            return (np.empty(0, np.uint64), np.empty((0, dim), np.float32))
+        return np.concatenate(all_ids), np.concatenate(all_rows)
+
     def save(self, dirname: str) -> None:
         os.makedirs(dirname, exist_ok=True)
         for s, c in enumerate(self._conns):
@@ -381,6 +447,117 @@ class SparseEmbedding:
 
 _server: Optional[PsServerHandle] = None
 _client: Optional[PsClient] = None
+
+
+def ps_sparse_embedding(ids, table_token, table_id: int, dim: int,
+                        client: Optional[PsClient] = None,
+                        communicator: Optional[AsyncCommunicator] = None):
+    """Jit-compatible distributed embedding lookup (the reference's
+    ``fluid.layers.embedding(is_sparse=True, is_distributed=True)`` /
+    pscore ``distributed_lookup_table`` op).
+
+    Runs *inside* a compiled program: the pull/push cross the host boundary
+    as ordered ``io_callback``s around the jitted step (the dense compute
+    stays on the TPU), and the backward pushes row gradients to the PS,
+    where the server-side rule (sgd/adagrad) applies them.  This is what
+    lets ``Executor.train_from_dataset`` drive a CTR program whose sparse
+    tables live on the native PS.
+
+    ``ids``: int array (any shape); ``table_token``: differentiable f32
+    scalar standing in for the remote table (see ``lookup``'s docstring);
+    returns float32 of shape ids.shape+(dim,).
+    """
+    from jax.experimental import io_callback
+
+    def _client():
+        c = client if client is not None else _client_global()
+        if c is None:
+            raise RuntimeError("ps_sparse_embedding: no PS client; call "
+                               "init_worker() first")
+        return c
+
+    def _pull_host(ids_np):
+        flat = np.asarray(ids_np).astype(np.uint64).reshape(-1)
+        rows = _client().pull_sparse(table_id, flat)
+        return rows.reshape(np.asarray(ids_np).shape + (dim,)).astype(
+            np.float32)
+
+    def _push_host(ids_np, grads_np):
+        flat = np.asarray(ids_np).astype(np.uint64).reshape(-1)
+        g = np.asarray(grads_np, np.float32).reshape(flat.size, dim)
+        if communicator is not None:
+            communicator.push_sparse_async(table_id, flat, g)
+        else:
+            _client().push_sparse(table_id, flat, g)
+        return np.zeros((), np.float32)
+
+    @jax.custom_vjp
+    def lookup(ids_arr, table_token):
+        # table_token is a trainable scalar standing in for the remote
+        # table: reverse-mode only transposes ops on a path to a
+        # differentiable input, and the real table lives host-side — the
+        # token puts this op on the gradient path so the backward (the
+        # grad *push*) actually runs, like the reference's lookup-table
+        # var being a parameter of the block.
+        out_aval = jax.ShapeDtypeStruct(ids_arr.shape + (dim,), jnp.float32)
+        return io_callback(_pull_host, out_aval, ids_arr, ordered=True)
+
+    def lookup_fwd(ids_arr, table_token):
+        return lookup(ids_arr, table_token), ids_arr
+
+    def lookup_bwd(ids_arr, g):
+        # ordered io_callback is effectful — never dead-code-eliminated
+        io_callback(_push_host, jax.ShapeDtypeStruct((), jnp.float32),
+                    ids_arr, g, ordered=True)
+        # integer primal -> float0 cotangent; the token's grad is zero
+        return (np.zeros(ids_arr.shape, jax.dtypes.float0),
+                jnp.zeros((), jnp.float32))
+
+    lookup.defvjp(lookup_fwd, lookup_bwd)
+    return lookup(ids, table_token)
+
+
+def _client_global():
+    return _client
+
+
+def sparse_embedding_layer(ids, table_id: int, dim: int,
+                           client: Optional[PsClient] = None,
+                           communicator: Optional[AsyncCommunicator] = None,
+                           rule: str = "adagrad", lr: float = 0.05,
+                           init_range: float = 0.05):
+    """Framework-op wrapper over :func:`ps_sparse_embedding`: works in
+    eager mode (taped) AND inside static programs (recorded, then executed
+    under the compiled step with host-callback pull/push) — the analog of
+    ``fluid.layers.embedding(is_sparse=True, is_distributed=True)``.
+
+    Creates the table on first use when a client is reachable.  A
+    trainable zero scalar ("table token") joins the op's inputs so the
+    backward — the gradient push — is on the autodiff path (the
+    reference's lookup-table var is a block parameter for the same
+    reason); its own gradient is zero, so optimizers never move it."""
+    from ...core.autograd import apply_op
+
+    c = client if client is not None else _client_global()
+    if c is not None and table_id not in c._tables:
+        c.create_table(TableConfig(table_id, dim, rule=rule, lr=lr,
+                                   init_range=init_range))
+
+    token = _table_tokens.get(table_id)
+    if token is None:
+        from ...nn.parameter import Parameter
+        token = Parameter(jnp.zeros((), jnp.float32),
+                          name=f"ps_table_token_{table_id}")
+        _table_tokens[table_id] = token
+
+    def fn(ids_arr, token_arr):
+        return ps_sparse_embedding(ids_arr, token_arr, table_id, dim,
+                                   client=c, communicator=communicator)
+
+    return apply_op("ps_sparse_embedding", fn, [ids, token])
+
+
+_table_tokens: Dict[int, object] = {}
 
 
 def init_server(port: Optional[int] = None) -> PsServerHandle:

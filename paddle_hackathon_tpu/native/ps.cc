@@ -18,6 +18,7 @@
 #include <sys/socket.h>
 #include <unistd.h>
 
+#include <algorithm>
 #include <atomic>
 #include <chrono>
 #include <cmath>
@@ -73,6 +74,9 @@ enum Op : uint8_t {
   opShrink = 10,
   opPushShowClick = 11,
   opBarrier = 12,
+  opSpill = 13,        // SSD tier: evict cold rows to a spill file
+  opGeoPush = 14,      // geo-async: merge raw deltas (no optimizer rule)
+  opGeoPullDiff = 15,  // geo-async: rows changed since trainer's last sync
 };
 
 // deterministic per-id init in (-range, range): splitmix64 hash
@@ -89,7 +93,8 @@ struct Row {
   std::vector<float> w;      // dim weights
   std::vector<float> slot;   // adagrad: accumulated g^2 (dim), else empty
   float show = 0.f, click = 0.f;  // CTR accessor counters
-  uint32_t unseen = 0;            // shrink: rounds since last pull
+  uint32_t unseen = 0;            // shrink/spill: rounds since last pull
+  uint64_t ver = 0;               // geo: global version of last update
 };
 
 struct SparseShard {
@@ -113,20 +118,109 @@ struct Table {
 
   SparseShard shards[kShards];
 
+  // SSD spill tier (ref ssd_sparse_table.cc: rocksdb-backed cold rows;
+  // here an append-only spill file + in-memory offset index — cold rows
+  // leave RAM, a later pull promotes them back transparently)
+  std::mutex spill_mu;
+  std::string spill_path;
+  std::unordered_map<uint64_t, uint64_t> spill_index;  // id -> file offset
+
+  // geo-async replication (ref memory_sparse_geo_table.cc): raw-delta
+  // merge + per-trainer version watermarks for bounded-staleness diffs
+  std::atomic<uint64_t> gver{0};
+  std::mutex geo_mu;
+  std::unordered_map<uint32_t, uint64_t> trainer_seen;
+
+  uint32_t slot_dim() const { return rule == kAdagrad ? dim : 0; }
+
   SparseShard& shard(uint64_t id) {
     return shards[(id * 0x9E3779B97F4A7C15ull >> 58) & (kShards - 1)];
   }
 
-  Row& row(SparseShard& s, uint64_t id) {  // caller holds s.mu
+  // caller holds s.mu; lock order everywhere: shard.mu, then spill_mu
+  Row& row(SparseShard& s, uint64_t id) {
     auto it = s.rows.find(id);
     if (it == s.rows.end()) {
       Row r;
-      r.w.resize(dim);
-      for (uint32_t j = 0; j < dim; j++) r.w[j] = init_val(id, j, init_range);
-      if (rule == kAdagrad) r.slot.assign(dim, 0.f);
+      if (!restore_spilled(id, r)) {
+        r.w.resize(dim);
+        for (uint32_t j = 0; j < dim; j++)
+          r.w[j] = init_val(id, j, init_range);
+        if (rule == kAdagrad) r.slot.assign(dim, 0.f);
+      }
       it = s.rows.emplace(id, std::move(r)).first;
     }
     return it->second;
+  }
+
+  bool restore_spilled(uint64_t id, Row& r) {
+    std::lock_guard<std::mutex> g(spill_mu);
+    auto it = spill_index.find(id);
+    if (it == spill_index.end()) return false;
+    FILE* f = std::fopen(spill_path.c_str(), "rb");
+    if (!f) return false;
+    bool ok = std::fseek(f, static_cast<long>(it->second), SEEK_SET) == 0;
+    r.w.resize(dim);
+    ok = ok && std::fread(r.w.data(), 4, dim, f) == dim;
+    if (ok && slot_dim()) {
+      r.slot.resize(slot_dim());
+      ok = std::fread(r.slot.data(), 4, slot_dim(), f) == slot_dim();
+    }
+    ok = ok && std::fread(&r.show, 4, 1, f) == 1 &&
+         std::fread(&r.click, 4, 1, f) == 1 &&
+         std::fread(&r.ver, 8, 1, f) == 1;  // geo version survives the disk
+    std::fclose(f);
+    if (ok) spill_index.erase(it);  // promoted back to RAM
+    return ok;
+  }
+
+  // evict rows unseen > max_unseen to the spill file; returns count, or
+  // -1 on any I/O failure (rows only leave RAM after their record is
+  // fully on disk, so partial progress is always consistent)
+  int64_t spill(uint32_t max_unseen, const std::string& path) {
+    int64_t spilled = 0;
+    for (auto& s : shards) {
+      std::lock_guard<std::mutex> g(s.mu);
+      std::lock_guard<std::mutex> sg(spill_mu);
+      if (spill_path.empty()) spill_path = path;
+      FILE* f = nullptr;
+      for (auto it = s.rows.begin(); it != s.rows.end();) {
+        if (++it->second.unseen > max_unseen) {
+          if (!f) {
+            f = std::fopen(spill_path.c_str(), "ab");
+            if (!f) return -1;
+          }
+          if (std::fseek(f, 0, SEEK_END) != 0) {
+            std::fclose(f);
+            return -1;
+          }
+          uint64_t off = static_cast<uint64_t>(std::ftell(f));
+          Row& r = it->second;
+          std::vector<float> slot = r.slot;
+          slot.resize(slot_dim(), 0.f);
+          bool wok = std::fwrite(r.w.data(), 4, dim, f) == dim;
+          if (slot_dim())
+            wok = wok &&
+                  std::fwrite(slot.data(), 4, slot_dim(), f) == slot_dim();
+          wok = wok && std::fwrite(&r.show, 4, 1, f) == 1 &&
+                std::fwrite(&r.click, 4, 1, f) == 1 &&
+                std::fwrite(&r.ver, 8, 1, f) == 1;
+          if (!wok) {
+            // short write (disk full?): the row stays in RAM, the index
+            // is untouched, the garbage tail is overwritten next append
+            std::fclose(f);
+            return -1;
+          }
+          spill_index[it->first] = off;  // newest record wins
+          it = s.rows.erase(it);
+          spilled++;
+        } else {
+          ++it;
+        }
+      }
+      if (f && std::fclose(f) != 0) return -1;
+    }
+    return spilled;
   }
 
   void apply(float* w, float* slot, const float* g) {
@@ -402,6 +496,102 @@ void PsServer::handle(int fd) {
       }
       if (!write_full(fd, &dropped, 8)) break;
 
+    } else if (op == opSpill) {
+      // SSD tier: evict rows unseen > max_unseen to the spill file at
+      // `path` (first call fixes the table's spill file); later pulls of
+      // a spilled id restore it transparently (ssd_sparse_table behavior)
+      uint32_t max_unseen, plen;
+      if (!read_full(fd, &max_unseen, 4) || !read_full(fd, &plen, 4)) break;
+      std::string path(plen, '\0');
+      if (plen && !read_full(fd, &path[0], plen)) break;
+      Table* t = table(tid);
+      int64_t spilled = (t && !t->dense) ? t->spill(max_unseen, path) : 0;
+      if (!write_full(fd, &spilled, 8)) break;
+
+    } else if (op == opGeoPush) {
+      // geo-async merge: w += delta (trainers run the optimizer locally;
+      // the server merges raw deltas — memory_sparse_geo_table semantics)
+      uint32_t n, dim;
+      if (!read_full(fd, &n, 4)) break;
+      std::vector<uint64_t> ids(n);
+      if (n && !read_full(fd, ids.data(), 8ull * n)) break;
+      if (!read_full(fd, &dim, 4)) break;
+      std::vector<float> deltas(static_cast<size_t>(n) * dim);
+      if (!deltas.empty() &&
+          !read_full(fd, deltas.data(), deltas.size() * sizeof(float)))
+        break;
+      Table* t = table(tid);
+      bool match = t && dim == t->dim;
+      if (match) {
+        for (uint32_t i = 0; i < n; i++) {
+          auto& s = t->shard(ids[i]);
+          std::lock_guard<std::mutex> g(s.mu);
+          Row& r = t->row(s, ids[i]);
+          const float* d = &deltas[static_cast<size_t>(i) * dim];
+          for (uint32_t j = 0; j < dim; j++) r.w[j] += d[j];
+          r.ver = ++t->gver;
+        }
+      }
+      uint8_t ok = match ? 1 : 0;
+      if (!write_full(fd, &ok, 1)) break;
+
+    } else if (op == opGeoPullDiff) {
+      // bounded-staleness sync: return rows whose version is newer than
+      // this trainer's watermark, oldest versions first, at most `cap`
+      // rows; the watermark advances only to the newest version actually
+      // SENT (or the pre-scan snapshot when nothing was truncated) —
+      // truncated or racing updates are re-sent next round, never lost
+      uint32_t trainer, cap;
+      if (!read_full(fd, &trainer, 4) || !read_full(fd, &cap, 4)) break;
+      Table* t = table(tid);
+      std::vector<std::pair<uint64_t, uint64_t>> cand;  // (ver, id)
+      std::vector<float> rows;
+      uint32_t dim = t ? t->dim : 0;
+      uint32_t n = 0;
+      if (t) {
+        uint64_t snap = t->gver.load();
+        uint64_t seen;
+        {
+          std::lock_guard<std::mutex> g(t->geo_mu);
+          seen = t->trainer_seen[trainer];
+        }
+        for (auto& s : t->shards) {
+          std::lock_guard<std::mutex> g(s.mu);
+          for (auto& kv : s.rows)
+            if (kv.second.ver > seen) cand.emplace_back(kv.second.ver,
+                                                        kv.first);
+        }
+        uint64_t new_mark = snap;
+        if (cand.size() > cap) {
+          std::sort(cand.begin(), cand.end());
+          cand.resize(cap);
+          new_mark = cand.back().first;  // deliver the rest next round
+        }
+        std::vector<uint64_t> ids;
+        ids.reserve(cand.size());
+        rows.reserve(cand.size() * dim);
+        for (auto& vk : cand) {
+          auto& s = t->shard(vk.second);
+          std::lock_guard<std::mutex> g(s.mu);
+          auto it = s.rows.find(vk.second);
+          if (it == s.rows.end()) continue;  // spilled between scans
+          ids.push_back(vk.second);
+          rows.insert(rows.end(), it->second.w.begin(), it->second.w.end());
+        }
+        {
+          std::lock_guard<std::mutex> g(t->geo_mu);
+          t->trainer_seen[trainer] = new_mark;
+        }
+        n = static_cast<uint32_t>(ids.size());
+        if (!write_full(fd, &n, 4) || !write_full(fd, &dim, 4)) break;
+        if (n && (!write_full(fd, ids.data(), 8ull * n) ||
+                  !write_full(fd, rows.data(),
+                              rows.size() * sizeof(float))))
+          break;
+      } else {
+        if (!write_full(fd, &n, 4) || !write_full(fd, &dim, 4)) break;
+      }
+
     } else if (op == opSave || op == opLoad) {
       uint32_t plen;
       if (!read_full(fd, &plen, 4)) break;
@@ -467,6 +657,20 @@ bool PsServer::save(const std::string& path) {
       slot.resize(t->dim, 0.f);
       std::fwrite(slot.data(), 4, t->dim, f);
     } else {
+      // spilled (SSD-tier) rows are part of the table: promote them back
+      // before snapshotting so a save/load round trip never loses state
+      {
+        std::vector<uint64_t> spilled_ids;
+        {
+          std::lock_guard<std::mutex> sg(t->spill_mu);
+          for (auto& kv : t->spill_index) spilled_ids.push_back(kv.first);
+        }
+        for (uint64_t id : spilled_ids) {
+          auto& s = t->shard(id);
+          std::lock_guard<std::mutex> g(s.mu);
+          t->row(s, id);
+        }
+      }
       uint64_t nrows = t->nkeys();
       std::fwrite(&nrows, 8, 1, f);
       uint32_t slot_dim = (t->rule == kAdagrad) ? t->dim : 0;
@@ -729,6 +933,58 @@ PHT_API int64_t pht_ps_shrink(void* h, uint32_t tid, uint32_t max_unseen) {
   uint64_t dropped;
   if (!read_full(c->fd, &dropped, 8)) return -1;
   return static_cast<int64_t>(dropped);
+}
+
+PHT_API int64_t pht_ps_spill(void* h, uint32_t tid, uint32_t max_unseen,
+                             const char* path) {
+  auto* c = static_cast<PsClient*>(h);
+  uint32_t plen = std::strlen(path);
+  if (!c->rpc_hdr(opSpill, tid) || !write_full(c->fd, &max_unseen, 4) ||
+      !write_full(c->fd, &plen, 4) || !write_full(c->fd, path, plen))
+    return -1;
+  uint64_t spilled;
+  if (!read_full(c->fd, &spilled, 8)) return -1;
+  return static_cast<int64_t>(spilled);
+}
+
+PHT_API int32_t pht_ps_geo_push(void* h, uint32_t tid, const uint64_t* ids,
+                                uint32_t n, const float* deltas,
+                                uint32_t dim) {
+  auto* c = static_cast<PsClient*>(h);
+  if (!c->rpc_hdr(opGeoPush, tid) || !write_full(c->fd, &n, 4) ||
+      (n && !write_full(c->fd, ids, 8ull * n)) ||
+      !write_full(c->fd, &dim, 4) ||
+      (n && !write_full(c->fd, deltas, sizeof(float) * n * dim)))
+    return -1;
+  uint8_t ok;
+  if (!read_full(c->fd, &ok, 1)) return -1;
+  return ok ? 0 : -2;
+}
+
+// Pull rows changed since this trainer's last sync (at most cap_rows —
+// the server truncates oldest-first and only advances the watermark over
+// what it sent, so a follow-up call fetches the remainder; nothing is
+// ever lost to a small buffer).
+PHT_API int64_t pht_ps_geo_pull_diff(void* h, uint32_t tid, uint32_t trainer,
+                                     uint64_t* ids_out, float* rows_out,
+                                     uint32_t cap_rows, uint32_t out_dim) {
+  auto* c = static_cast<PsClient*>(h);
+  if (!c->rpc_hdr(opGeoPullDiff, tid) || !write_full(c->fd, &trainer, 4) ||
+      !write_full(c->fd, &cap_rows, 4))
+    return -1;
+  uint32_t n, dim;
+  if (!read_full(c->fd, &n, 4) || !read_full(c->fd, &dim, 4)) return -1;
+  std::vector<uint64_t> ids(n);
+  std::vector<float> rows(static_cast<size_t>(n) * dim);
+  if (n && (!read_full(c->fd, ids.data(), 8ull * n) ||
+            !read_full(c->fd, rows.data(), rows.size() * sizeof(float))))
+    return -1;
+  if (n && dim != out_dim) return -4;
+  if (n) {
+    std::memcpy(ids_out, ids.data(), 8ull * n);
+    std::memcpy(rows_out, rows.data(), rows.size() * sizeof(float));
+  }
+  return static_cast<int64_t>(n);
 }
 
 static int32_t path_op(PsClient* c, uint8_t op, const char* path) {

@@ -73,6 +73,142 @@ class Executor:
             return [np.asarray(f) for f in fetches]
         return [Tensor(f) for f in fetches]
 
+    # -- dataset-driven training (ref fluid/executor.py:2396
+    # train_from_dataset -> TrainerFactory/MultiTrainer + HogwildWorker,
+    # framework/trainer.h:105) ------------------------------------------------
+    def train_from_dataset(self, program=None, dataset=None, scope=None,
+                           thread=0, debug=False, fetch_list=None,
+                           fetch_info=None, print_period=100,
+                           fetch_handler=None):
+        """Dataset-file-driven training: feed threads parse the dataset's
+        file list into a bounded queue while the compiled program consumes
+        batches — the TPU-native shape of the reference's
+        DataFeed/HogwildWorker loop (reader threads feed per-thread op
+        execution; here one compiled step serializes on the device and the
+        thread pool hides host-side parsing).  Works with programs whose
+        sparse lookups live on the native PS (``ps_sparse_embedding``)."""
+        return self._run_from_dataset(program, dataset, thread, False, debug,
+                                      fetch_list, fetch_info, print_period,
+                                      fetch_handler)
+
+    def infer_from_dataset(self, program=None, dataset=None, scope=None,
+                           thread=0, debug=False, fetch_list=None,
+                           fetch_info=None, print_period=100,
+                           fetch_handler=None):
+        """Dataset-driven inference (ref ``infer_from_dataset`` — same loop
+        with gradient/optimizer work skipped; the program simply has no
+        ``minimize`` recorded)."""
+        return self._run_from_dataset(program, dataset, thread, True, debug,
+                                      fetch_list, fetch_info, print_period,
+                                      fetch_handler)
+
+    def _run_from_dataset(self, program, dataset, thread, is_infer, debug,
+                          fetch_list, fetch_info, print_period,
+                          fetch_handler):
+        import queue as _queue
+        import threading as _threading
+
+        program = program if program is not None else default_main_program()
+        if dataset is None:
+            raise ValueError("train_from_dataset needs a dataset "
+                             "(paddle.distributed.QueueDataset / "
+                             "InMemoryDataset)")
+        if is_infer and program._minimize is not None:
+            raise ValueError("infer_from_dataset got a program with "
+                             "minimize(); build an inference program")
+        feed_names = [v.name for v in program._feeds]
+        use_vars = list(getattr(dataset, "_use_var", []) or [])
+        slot_names = [getattr(v, "name", v) for v in use_vars] or feed_names
+        fetch_list = list(fetch_list or [])
+        fetch_info = list(fetch_info or [f.name if hasattr(f, "name") else
+                                         str(f) for f in fetch_list])
+
+        n_threads = max(int(thread) or int(getattr(dataset, "_thread_num", 1)
+                                           or 1), 1)
+        q: _queue.Queue = _queue.Queue(maxsize=4 * n_threads)
+        _END = object()
+        stop = _threading.Event()
+
+        def _put(item):
+            """stop-aware put: never parks the producer forever against a
+            full queue after the consumer has died."""
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.2)
+                    return True
+                except _queue.Full:
+                    continue
+            return False
+
+        def _producer():
+            # a reader error must surface in the trainer, not silently end
+            # the epoch — ship the exception through the queue
+            try:
+                for batch in dataset:
+                    if not _put(batch):
+                        return
+                _put(_END)
+            except BaseException as exc:  # noqa: BLE001 — re-raised below
+                _put(("__dataset_error__", exc))
+
+        # one producer thread per reference DataFeed reader; the dataset
+        # iterator itself is sequential, so a single producer suffices and
+        # extra threads would only reorder batches
+        prod = _threading.Thread(target=_producer, daemon=True)
+        prod.start()
+
+        def _check_first_batch(cols):
+            if len(cols) != len(slot_names):
+                raise ValueError(
+                    f"dataset yields {len(cols)} columns but the feed "
+                    f"binding has {len(slot_names)} slots {slot_names}; "
+                    "set use_var on the dataset to name the columns")
+            by_name = {v.name: v for v in program._feeds}
+            for name, col in zip(slot_names, cols):
+                var = by_name.get(name)
+                if var is None:
+                    continue
+                arr = np.asarray(col._value if hasattr(col, "_value")
+                                 else col)
+                want = np.dtype(var._value.dtype)
+                if arr.dtype.kind != want.kind:
+                    raise TypeError(
+                        f"dataset column {name!r} has dtype {arr.dtype} but "
+                        f"the program feed declares {want} — check the "
+                        "use_var order")
+
+        step = 0
+        last_fetch = None
+        try:
+            while True:
+                batch = q.get()
+                if batch is _END:
+                    break
+                if (isinstance(batch, tuple) and len(batch) == 2
+                        and batch[0] == "__dataset_error__"):
+                    raise batch[1]
+                cols = batch if isinstance(batch, (tuple, list)) else (batch,)
+                if step == 0:
+                    _check_first_batch(cols)
+                feed = {}
+                for name, col in zip(slot_names, cols):
+                    if name in feed_names:
+                        feed[name] = (col._value if hasattr(col, "_value")
+                                      else col)
+                fetches = self.run(program, feed=feed, fetch_list=fetch_list)
+                step += 1
+                last_fetch = fetches
+                if fetch_list and (debug or step % max(print_period, 1) == 0):
+                    msg = ", ".join(f"{n}={np.asarray(v).mean():.6f}"
+                                    for n, v in zip(fetch_info, fetches))
+                    print(f"[train_from_dataset] step {step}: {msg}")
+                if fetch_handler is not None and fetches:
+                    fetch_handler(fetches)
+            prod.join()
+        finally:
+            stop.set()  # unblock the producer if we are exiting on error
+        return last_fetch
+
     def _compile(self, program: Program, feed_ids: List[int], fetch_vars):
         params = program.all_parameters()
         trainable = [p for p in params

@@ -218,3 +218,107 @@ class TestMultiProcessPs:
                        for f in sorted((tmp_path / "logs").iterdir()))
         assert rc == 0, logs
         assert logs.count("TRAINER_OK") == 2, logs
+
+
+class TestSsdSpillTier:
+    """SSD tier (ref ssd_sparse_table.cc): cold rows leave RAM for an
+    append-only spill file; later pulls restore them with state intact."""
+
+    def test_spill_and_transparent_restore(self, cluster, tmp_path):
+        cluster.create_table(TableConfig(60, dim=4, rule="sgd", lr=1.0,
+                                         init_range=0.1))
+        ids = np.arange(1, 21, dtype=np.uint64)
+        before = cluster.pull_sparse(60, ids)
+        # train the rows so their state differs from deterministic init
+        cluster.push_sparse(60, ids, np.ones((20, 4), np.float32) * 0.5)
+        trained = cluster.pull_sparse(60, ids)
+        assert np.abs(trained - before).max() > 0.4
+
+        # everything is now cold (unseen resets on pull; spill ages by 1)
+        spilled = cluster.spill(60, max_unseen=0, path=str(tmp_path / "sp"))
+        assert spilled == 20
+        assert cluster.table_nkeys(60) == 0  # rows left RAM
+
+        # pull restores the TRAINED state, not a fresh init
+        back = cluster.pull_sparse(60, ids)
+        np.testing.assert_allclose(back, trained, rtol=1e-6)
+        assert cluster.table_nkeys(60) == 20
+
+    def test_spill_keeps_hot_rows(self, cluster, tmp_path):
+        cluster.create_table(TableConfig(61, dim=4, rule="sgd", lr=1.0,
+                                         init_range=0.1))
+        cold = np.arange(100, 110, dtype=np.uint64)
+        hot = np.arange(200, 210, dtype=np.uint64)
+        cluster.pull_sparse(61, cold)
+        cluster.spill(61, max_unseen=1, path=str(tmp_path / "sp2"))  # age 1
+        cluster.pull_sparse(61, hot)       # hot rows touched after aging
+        spilled = cluster.spill(61, max_unseen=1, path=str(tmp_path / "sp2"))
+        assert spilled == 10               # only the cold rows left RAM
+        assert cluster.table_nkeys(61) == 10
+
+
+class TestGeoTable:
+    """Geo-async replication (ref memory_sparse_geo_table.cc): raw-delta
+    merge + per-trainer diff pulls with a bounded staleness window."""
+
+    def test_geo_push_merges_deltas(self, cluster):
+        cluster.create_table(TableConfig(70, dim=4, rule="sgd", lr=0.1,
+                                         init_range=0.0))
+        ids = np.asarray([5, 9], np.uint64)
+        cluster.geo_push(70, ids, np.ones((2, 4), np.float32))
+        cluster.geo_push(70, ids, np.ones((2, 4), np.float32) * 2.0)
+        rows = cluster.pull_sparse(70, ids)
+        np.testing.assert_allclose(rows, np.full((2, 4), 3.0), rtol=1e-6)
+
+    def test_geo_pull_diff_staleness_bound(self, cluster):
+        cluster.create_table(TableConfig(71, dim=2, rule="sgd", lr=0.1,
+                                         init_range=0.0))
+        t0, t1 = 0, 1
+        ids_a = np.asarray([1, 2, 3], np.uint64)
+        cluster.geo_push(71, ids_a, np.ones((3, 2), np.float32))
+
+        # trainer 0 syncs: sees every update so far, exactly once
+        got, rows = cluster.geo_pull_diff(71, t0)
+        assert sorted(got.tolist()) == [1, 2, 3]
+        np.testing.assert_allclose(rows, np.ones((3, 2)), rtol=1e-6)
+        got2, _ = cluster.geo_pull_diff(71, t0)
+        assert got2.size == 0              # nothing new -> empty diff
+
+        # updates after trainer 0's watermark are delivered next round
+        ids_b = np.asarray([3, 4], np.uint64)
+        cluster.geo_push(71, ids_b, np.full((2, 2), 0.5, np.float32))
+        got3, rows3 = cluster.geo_pull_diff(71, t0)
+        assert sorted(got3.tolist()) == [3, 4]
+        row3 = dict(zip(got3.tolist(), rows3.tolist()))
+        np.testing.assert_allclose(row3[3], [1.5, 1.5], rtol=1e-6)
+
+        # trainer 1 has its own watermark: first sync sees everything
+        got_t1, _ = cluster.geo_pull_diff(71, t1)
+        assert sorted(got_t1.tolist()) == [1, 2, 3, 4]
+
+    def test_geo_pull_diff_small_cap_delivers_over_rounds(self, cluster):
+        """A burst larger than the pull buffer arrives across rounds —
+        never lost (truncation advances the watermark only over what was
+        sent)."""
+        cluster.create_table(TableConfig(72, dim=2, rule="sgd", lr=0.1,
+                                         init_range=0.0))
+        ids = np.arange(1, 11, dtype=np.uint64)   # 10 updates
+        cluster.geo_push(72, ids, np.ones((10, 2), np.float32))
+        got = []
+        for _ in range(8):
+            i, _r = cluster.geo_pull_diff(72, 0, cap_rows=3)
+            got.extend(i.tolist())
+            if len(got) >= 10:
+                break
+        assert sorted(got) == list(range(1, 11))
+
+    def test_spilled_rows_survive_save_load(self, cluster, tmp_path):
+        cluster.create_table(TableConfig(73, dim=4, rule="sgd", lr=1.0,
+                                         init_range=0.1))
+        ids = np.arange(1, 6, dtype=np.uint64)
+        cluster.push_sparse(73, ids, np.ones((5, 4), np.float32) * 0.3)
+        trained = cluster.pull_sparse(73, ids)
+        assert cluster.spill(73, 0, str(tmp_path / "sp3")) == 5
+        cluster.save(str(tmp_path / "snap"))
+        back = cluster.pull_sparse(73, ids)
+        np.testing.assert_allclose(back, trained, rtol=1e-6)
