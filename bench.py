@@ -20,6 +20,28 @@ import jax.numpy as jnp
 import numpy as np
 
 
+def load_bench_history(root=None):
+    """Parse the driver's BENCH_r*.json records (which wrap the metric
+    under "parsed") into [(round, value, metric)], sorted by round.
+    Shared by this script's vs_baseline and tools/perf_gate.py."""
+    import re
+    root = root or (os.path.dirname(os.path.abspath(__file__)) or ".")
+    rounds = []
+    for p in glob.glob(os.path.join(root, "BENCH_r*.json")):
+        m = re.search(r"BENCH_r(\d+)\.json$", p)
+        if not m:
+            continue
+        try:
+            with open(p) as fh:
+                data = json.load(fh)
+            rec = data.get("parsed", data)
+            rounds.append((int(m.group(1)), float(rec["value"]),
+                           rec.get("metric", "?")))
+        except (KeyError, TypeError, ValueError, OSError):
+            continue
+    return sorted(rounds)
+
+
 def main():
     import paddle_hackathon_tpu as paddle
     from paddle_hackathon_tpu import parallel
@@ -69,21 +91,8 @@ def main():
 
     tokens_per_sec = batch * seqlen * steps / dt
 
-    prev = None
-    import re
-    bench_files = glob.glob(os.path.join(os.path.dirname(__file__) or ".",
-                                         "BENCH_r*.json"))
-
-    def round_no(p):
-        m = re.search(r"BENCH_r(\d+)\.json$", p)
-        return int(m.group(1)) if m else -1
-
-    for f in sorted(bench_files, key=round_no):
-        try:
-            with open(f) as fh:
-                prev = json.load(fh).get("value")
-        except Exception:
-            pass
+    history = load_bench_history()
+    prev = history[-1][1] if history else None
     vs_baseline = (tokens_per_sec / prev) if prev else 1.0
 
     print(json.dumps({

@@ -174,17 +174,34 @@ struct Table {
     return ok;
   }
 
+  // smallest watermark across registered geo trainers: a row whose ver
+  // exceeds it has an undelivered geo update, and opGeoPullDiff only
+  // scans RAM — spilling it would silently drop the delivery
+  uint64_t geo_min_seen() {
+    std::lock_guard<std::mutex> g(geo_mu);
+    if (trainer_seen.empty()) return UINT64_MAX;
+    uint64_t m = UINT64_MAX;
+    for (auto& kv : trainer_seen) m = std::min(m, kv.second);
+    return m;
+  }
+
   // evict rows unseen > max_unseen to the spill file; returns count, or
   // -1 on any I/O failure (rows only leave RAM after their record is
-  // fully on disk, so partial progress is always consistent)
+  // fully on disk, so partial progress is always consistent). Rows with
+  // geo updates not yet delivered to every trainer stay in RAM.
   int64_t spill(uint32_t max_unseen, const std::string& path) {
     int64_t spilled = 0;
+    const uint64_t min_seen = geo_min_seen();
     for (auto& s : shards) {
       std::lock_guard<std::mutex> g(s.mu);
       std::lock_guard<std::mutex> sg(spill_mu);
       if (spill_path.empty()) spill_path = path;
       FILE* f = nullptr;
       for (auto it = s.rows.begin(); it != s.rows.end();) {
+        if (it->second.ver > min_seen) {  // pending geo delivery: keep hot
+          ++it;
+          continue;
+        }
         if (++it->second.unseen > max_unseen) {
           if (!f) {
             f = std::fopen(spill_path.c_str(), "ab");

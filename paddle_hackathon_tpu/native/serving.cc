@@ -1,0 +1,209 @@
+// Native inference serving shim.
+//
+// TPU-native counterpart of the reference's C++ predictor stack
+// (paddle/fluid/inference/api/analysis_predictor.h:95 and the C API in
+// paddle/fluid/inference/capi_exp) — a C ABI a C++ serving process links
+// against to load a saved model artifact and run inference with NO Python
+// in its own source. The runtime embeds CPython the same way the
+// reference's .so embeds the whole fluid framework: the interpreter,
+// the framework, and XLA live behind this ABI.
+//
+// Threading: all entry points serialize on one internal mutex and run
+// under the GIL; the embedded predictor itself executes on the
+// accelerator via XLA. One process = one interpreter; predictors are
+// independent handles (Predictor.clone() semantics apply server-side).
+//
+// API:
+//   pht_serving_init(repo_dir)                    -> 0/-1 (idempotent)
+//   pht_predictor_create(model_path)              -> handle | NULL
+//   pht_predictor_run_f32(h, in, shape, ndim,
+//                         out, out_cap, out_shape, out_ndim_cap)
+//                                                 -> out elem count | <0
+//   pht_predictor_last_error()                    -> static error string
+//   pht_predictor_destroy(h)
+
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <string>
+
+#define PHT_API extern "C" __attribute__((visibility("default")))
+
+namespace {
+
+std::mutex g_mu;
+bool g_inited = false;
+std::string g_err;
+
+void set_err_from_python() {
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  g_err = "python error";
+  if (value) {
+    PyObject* s = PyObject_Str(value);
+    if (s) {
+      const char* c = PyUnicode_AsUTF8(s);
+      if (c) g_err = c;
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+}
+
+struct NativePredictor {
+  PyObject* predictor = nullptr;  // paddle_hackathon_tpu Predictor
+};
+
+}  // namespace
+
+PHT_API const char* pht_predictor_last_error() { return g_err.c_str(); }
+
+PHT_API int32_t pht_serving_init(const char* repo_dir) {
+  std::lock_guard<std::mutex> g(g_mu);
+  if (g_inited) return 0;
+  if (!Py_IsInitialized()) Py_InitializeEx(0);
+  PyGILState_STATE gil = PyGILState_Ensure();
+  std::string code =
+      "import sys, os\n"
+      "sys.path.insert(0, r'''" + std::string(repo_dir) + "''')\n"
+      "_plat = os.environ.get('PHT_SERVING_PLATFORM')\n"
+      "if _plat:\n"
+      "    import jax\n"
+      "    jax.config.update('jax_platforms', _plat)\n"
+      "import paddle_hackathon_tpu.inference as _pht_inf\n";
+  int rc = PyRun_SimpleString(code.c_str());
+  if (rc == 0) g_inited = true;
+  else g_err = "failed to import paddle_hackathon_tpu.inference";
+  PyGILState_Release(gil);
+  return rc == 0 ? 0 : -1;
+}
+
+PHT_API void* pht_predictor_create(const char* model_path) {
+  std::lock_guard<std::mutex> g(g_mu);
+  if (!g_inited) {
+    g_err = "pht_serving_init not called";
+    return nullptr;
+  }
+  PyGILState_STATE gil = PyGILState_Ensure();
+  NativePredictor* np = nullptr;
+  PyObject* main = PyImport_AddModule("__main__");  // borrowed
+  PyObject* globals = PyModule_GetDict(main);       // borrowed
+  std::string code =
+      "_pht_cfg = _pht_inf.Config(r'''" + std::string(model_path) + "''')\n"
+      "_pht_pred = _pht_inf.create_predictor(_pht_cfg)\n";
+  PyObject* res = PyRun_String(code.c_str(), Py_file_input, globals, globals);
+  if (res) {
+    Py_DECREF(res);
+    PyObject* pred = PyDict_GetItemString(globals, "_pht_pred");  // borrowed
+    if (pred) {
+      np = new NativePredictor();
+      Py_INCREF(pred);
+      np->predictor = pred;
+      PyDict_DelItemString(globals, "_pht_pred");
+      PyDict_DelItemString(globals, "_pht_cfg");
+    } else {
+      g_err = "predictor object missing after create";
+    }
+  } else {
+    set_err_from_python();
+  }
+  PyGILState_Release(gil);
+  return np;
+}
+
+// Single-input / single-output f32 fast path (the CTR/vision serving
+// shape; multi-io callers hold one predictor per signature). Returns the
+// number of output elements written, or <0: -1 python error, -2 output
+// buffer too small, -3 bad handle.
+PHT_API int64_t pht_predictor_run_f32(void* h, const float* in,
+                                      const int64_t* shape, int32_t ndim,
+                                      float* out, int64_t out_cap,
+                                      int64_t* out_shape,
+                                      int32_t out_ndim_cap) {
+  std::lock_guard<std::mutex> g(g_mu);
+  auto* np = static_cast<NativePredictor*>(h);
+  if (!np || !np->predictor) {
+    g_err = "bad predictor handle";
+    return -3;
+  }
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int64_t ret = -1;
+
+  // build a numpy array from the caller's buffer without numpy's C API:
+  // go through python (np.frombuffer on a memoryview) — slow-path-free
+  // for the actual inference, which dominates
+  int64_t n_in = 1;
+  for (int32_t i = 0; i < ndim; i++) n_in *= shape[i];
+  PyObject* mem = PyMemoryView_FromMemory(
+      reinterpret_cast<char*>(const_cast<float*>(in)),
+      n_in * static_cast<int64_t>(sizeof(float)), PyBUF_READ);
+  PyObject* shape_t = PyTuple_New(ndim);
+  for (int32_t i = 0; i < ndim; i++)
+    PyTuple_SET_ITEM(shape_t, i, PyLong_FromLongLong(shape[i]));
+
+  PyObject* main = PyImport_AddModule("__main__");
+  PyObject* globals = PyModule_GetDict(main);
+  PyDict_SetItemString(globals, "_pht_mem", mem);
+  PyDict_SetItemString(globals, "_pht_shape", shape_t);
+  PyDict_SetItemString(globals, "_pht_p", np->predictor);
+  PyObject* res = PyRun_String(
+      "import numpy as _np\n"
+      "_x = _np.frombuffer(_pht_mem, dtype=_np.float32)"
+      ".reshape(tuple(_pht_shape))\n"
+      "_outs = _pht_p.run([_x])\n"
+      "_y = _np.ascontiguousarray(_np.asarray(_outs[0], _np.float32))\n",
+      Py_file_input, globals, globals);
+  if (res) {
+    Py_DECREF(res);
+    PyObject* y = PyDict_GetItemString(globals, "_y");  // borrowed
+    PyObject* buf_obj =
+        y ? PyObject_CallMethod(y, "tobytes", nullptr) : nullptr;
+    PyObject* yshape = y ? PyObject_GetAttrString(y, "shape") : nullptr;
+    if (buf_obj && yshape) {
+      Py_ssize_t nbytes = PyBytes_Size(buf_obj);
+      int64_t n_out = nbytes / static_cast<int64_t>(sizeof(float));
+      int32_t yndim = static_cast<int32_t>(PyTuple_Size(yshape));
+      if (n_out > out_cap || yndim > out_ndim_cap) {
+        g_err = "output buffer too small";
+        ret = -2;
+      } else {
+        std::memcpy(out, PyBytes_AsString(buf_obj), nbytes);
+        for (int32_t i = 0; i < yndim; i++)
+          out_shape[i] = PyLong_AsLongLong(PyTuple_GetItem(yshape, i));
+        for (int32_t i = yndim; i < out_ndim_cap; i++) out_shape[i] = 0;
+        ret = n_out;
+      }
+    } else {
+      set_err_from_python();
+    }
+    Py_XDECREF(buf_obj);
+    Py_XDECREF(yshape);
+  } else {
+    set_err_from_python();
+  }
+  for (const char* k : {"_pht_mem", "_pht_shape", "_pht_p", "_x", "_outs",
+                        "_y"})
+    if (PyDict_GetItemString(globals, k))  // missing after an error is fine
+      PyDict_DelItemString(globals, k);
+  PyErr_Clear();  // never leak a pending exception across the ABI
+  Py_DECREF(mem);
+  Py_DECREF(shape_t);
+  PyGILState_Release(gil);
+  return ret;
+}
+
+PHT_API void pht_predictor_destroy(void* h) {
+  std::lock_guard<std::mutex> g(g_mu);
+  auto* np = static_cast<NativePredictor*>(h);
+  if (!np) return;
+  if (Py_IsInitialized()) {
+    PyGILState_STATE gil = PyGILState_Ensure();
+    Py_XDECREF(np->predictor);
+    PyGILState_Release(gil);
+  }
+  delete np;
+}

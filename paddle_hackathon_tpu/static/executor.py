@@ -127,6 +127,11 @@ class Executor:
                                            or 1), 1)
         q: _queue.Queue = _queue.Queue(maxsize=4 * n_threads)
         _END = object()
+
+        class _DatasetError:
+            def __init__(self, exc):
+                self.exc = exc
+
         stop = _threading.Event()
 
         def _put(item):
@@ -149,7 +154,7 @@ class Executor:
                         return
                 _put(_END)
             except BaseException as exc:  # noqa: BLE001 — re-raised below
-                _put(("__dataset_error__", exc))
+                _put(_DatasetError(exc))
 
         # one producer thread per reference DataFeed reader; the dataset
         # iterator itself is sequential, so a single producer suffices and
@@ -184,9 +189,8 @@ class Executor:
                 batch = q.get()
                 if batch is _END:
                     break
-                if (isinstance(batch, tuple) and len(batch) == 2
-                        and batch[0] == "__dataset_error__"):
-                    raise batch[1]
+                if isinstance(batch, _DatasetError):
+                    raise batch.exc
                 cols = batch if isinstance(batch, (tuple, list)) else (batch,)
                 if step == 0:
                     _check_first_batch(cols)

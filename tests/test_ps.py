@@ -322,3 +322,19 @@ class TestGeoTable:
         cluster.save(str(tmp_path / "snap"))
         back = cluster.pull_sparse(73, ids)
         np.testing.assert_allclose(back, trained, rtol=1e-6)
+
+    def test_spill_keeps_rows_with_pending_geo_updates(self, cluster,
+                                                       tmp_path):
+        """A row whose geo update hasn't reached every trainer must stay in
+        RAM (diffs only scan RAM — spilling it would drop the delivery)."""
+        cluster.create_table(TableConfig(74, dim=2, rule="sgd", lr=0.1,
+                                         init_range=0.0))
+        cluster.geo_pull_diff(74, 0)  # register trainer 0 (watermark 0)
+        ids = np.asarray([1, 2], np.uint64)
+        cluster.geo_push(74, ids, np.ones((2, 2), np.float32))
+        # both rows have undelivered updates for trainer 0 -> unspillable
+        assert cluster.spill(74, 0, str(tmp_path / "sp4")) == 0
+        got, _ = cluster.geo_pull_diff(74, 0)
+        assert sorted(got.tolist()) == [1, 2]  # delivery intact
+        # delivered everywhere -> now spillable
+        assert cluster.spill(74, 0, str(tmp_path / "sp4")) == 2

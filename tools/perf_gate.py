@@ -1,0 +1,123 @@
+"""Cross-round performance gate (ref ``tools/ci_op_benchmark.sh:117`` /
+``ci_model_benchmark.sh`` — the reference's CI rejects changes that regress
+op or model benchmarks; it compares against an external benchmark repo, here
+the history lives in-tree).
+
+Two checks:
+
+1. **Model gate** — the headline `bench.py` metric against the best prior
+   `BENCH_r*.json`: fail when the current run is more than ``--tolerance``
+   (default 5%) below the best recorded round.
+2. **Op gate** — `cost_model/static_op_benchmark.json` regenerated (or a
+   fresh file passed via ``--ops``) against the committed snapshot: fail
+   when any op regresses more than ``--op-tolerance`` (default 25%; op
+   microbenchmarks are noisy through the axon tunnel).
+
+Usage::
+
+    python tools/perf_gate.py                 # model gate only (fast)
+    python tools/perf_gate.py --ops new.json  # + op gate vs snapshot
+
+Exit code 0 = pass, 1 = regression, 2 = cannot evaluate (no history).
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def best_recorded():
+    sys.path.insert(0, ROOT)
+    from bench import load_bench_history  # single owner of the file format
+    return load_bench_history(ROOT)
+
+
+def run_bench():
+    out = subprocess.run([sys.executable, os.path.join(ROOT, "bench.py")],
+                         capture_output=True, text=True, timeout=900)
+    if out.returncode != 0:
+        raise RuntimeError(f"bench.py failed:\n{out.stderr[-2000:]}")
+    line = out.stdout.strip().splitlines()[-1]
+    return json.loads(line)
+
+
+def model_gate(tolerance):
+    history = best_recorded()
+    if not history:
+        print("perf_gate: no BENCH_r*.json history — nothing to gate "
+              "against")
+        return 2
+    best_round, best_value, metric = max(history, key=lambda r: r[1])
+    cur = run_bench()
+    value = float(cur["value"])
+    floor = best_value * (1.0 - tolerance)
+    status = "PASS" if value >= floor else "FAIL"
+    print(f"perf_gate[model] {status}: {cur['metric']} = {value:,.0f} "
+          f"{cur.get('unit', '')} vs best {best_value:,.0f} "
+          f"(round {best_round}); floor at -{tolerance:.0%} = {floor:,.0f}")
+    return 0 if status == "PASS" else 1
+
+
+def op_gate(new_path, op_tolerance):
+    snap_path = os.path.join(ROOT, "cost_model", "static_op_benchmark.json")
+    if not os.path.exists(snap_path):
+        print("perf_gate[ops]: no committed op snapshot — skip")
+        return 0
+    with open(snap_path) as fh:
+        snap = json.load(fh)
+    with open(new_path) as fh:
+        new = json.load(fh)
+
+    def times(d):
+        out = {}
+        for entry in (d if isinstance(d, list) else d.get("ops", [])):
+            name = entry.get("op") or entry.get("name")
+            t = entry.get("paddle_gpu_time") or entry.get("time_ms")
+            if name is not None and t:
+                out[name] = float(t)
+        return out
+
+    old_t, new_t = times(snap), times(new)
+    regressed = []
+    for name, t_old in old_t.items():
+        t_new = new_t.get(name)
+        if t_new is None:
+            continue
+        if t_new > t_old * (1.0 + op_tolerance):
+            regressed.append((name, t_old, t_new))
+    if regressed:
+        print(f"perf_gate[ops] FAIL: {len(regressed)} ops regressed "
+              f">{op_tolerance:.0%}:")
+        for name, t_old, t_new in sorted(regressed,
+                                         key=lambda r: r[2] / r[1],
+                                         reverse=True)[:20]:
+            print(f"  {name}: {t_old:.4f} -> {t_new:.4f} ms "
+                  f"({t_new / t_old:.2f}x)")
+        return 1
+    print(f"perf_gate[ops] PASS: {len(old_t)} ops within "
+          f"{op_tolerance:.0%} of snapshot "
+          f"({len(new_t)} measured)")
+    return 0
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--tolerance", type=float, default=0.05,
+                    help="allowed model-bench drop vs best round (0.05=5%)")
+    ap.add_argument("--op-tolerance", type=float, default=0.25,
+                    help="allowed per-op slowdown vs snapshot")
+    ap.add_argument("--ops", help="fresh op-benchmark json to gate")
+    args = ap.parse_args()
+
+    rc = model_gate(args.tolerance)
+    if args.ops:
+        rc = max(rc, op_gate(args.ops, args.op_tolerance))
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
