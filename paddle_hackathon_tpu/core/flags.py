@@ -42,8 +42,14 @@ def define_flag(name: str, default: Any, doc: str = "") -> None:
         _registry[name] = _coerce(env, default) if env is not None else default
 
 
+epoch = 0  # bumped on every mutation; cache keys depend on it (a traced
+# op body may have read a flag value, so caches keyed pre-mutation must
+# not serve post-mutation calls)
+
+
 def set_flags(flags: Mapping[str, Any]) -> None:
     """paddle.set_flags equivalent (``fluid/framework.py:7125``)."""
+    global epoch
     with _lock:
         for name, value in flags.items():
             if name.startswith("FLAGS_"):
@@ -51,6 +57,7 @@ def set_flags(flags: Mapping[str, Any]) -> None:
             if name not in _defs:
                 raise ValueError(f"unknown flag: {name}")
             _registry[name] = _coerce(value, _defs[name]["default"])
+        epoch += 1
     # mirror into the native registry so C++ components observe updates
     # (ref global_value_getter_setter.cc)
     try:

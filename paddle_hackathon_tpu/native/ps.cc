@@ -499,10 +499,15 @@ void PsServer::handle(int fd) {
       Table* t = table(tid);
       uint64_t dropped = 0;
       if (t) {
+        // same invariant as spill(): rows with geo updates not yet
+        // delivered to every trainer must not be erased (diffs only scan
+        // RAM — shrink would drop the delivery permanently)
+        const uint64_t min_seen = t->geo_min_seen();
         for (auto& s : t->shards) {
           std::lock_guard<std::mutex> g(s.mu);
           for (auto it = s.rows.begin(); it != s.rows.end();) {
-            if (++it->second.unseen > max_unseen) {
+            if (it->second.ver <= min_seen &&
+                ++it->second.unseen > max_unseen) {
               it = s.rows.erase(it);
               dropped++;
             } else {

@@ -65,7 +65,11 @@ PHT_API const char* pht_predictor_last_error() { return g_err.c_str(); }
 PHT_API int32_t pht_serving_init(const char* repo_dir) {
   std::lock_guard<std::mutex> g(g_mu);
   if (g_inited) return 0;
-  if (!Py_IsInitialized()) Py_InitializeEx(0);
+  bool we_initialized = false;
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);
+    we_initialized = true;
+  }
   PyGILState_STATE gil = PyGILState_Ensure();
   std::string code =
       "import sys, os\n"
@@ -79,6 +83,12 @@ PHT_API int32_t pht_serving_init(const char* repo_dir) {
   if (rc == 0) g_inited = true;
   else g_err = "failed to import paddle_hackathon_tpu.inference";
   PyGILState_Release(gil);
+  if (we_initialized) {
+    // Py_InitializeEx left this thread holding the GIL via its thread
+    // state; release it or every OTHER thread's PyGILState_Ensure blocks
+    // forever (serving processes dispatch on worker threads)
+    PyEval_SaveThread();
+  }
   return rc == 0 ? 0 : -1;
 }
 

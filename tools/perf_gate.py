@@ -107,7 +107,7 @@ def op_gate(new_path, op_tolerance):
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--tolerance", type=float, default=0.05,
-                    help="allowed model-bench drop vs best round (0.05=5%)")
+                    help="allowed model-bench drop vs best round (0.05 = 5%%)")
     ap.add_argument("--op-tolerance", type=float, default=0.25,
                     help="allowed per-op slowdown vs snapshot")
     ap.add_argument("--ops", help="fresh op-benchmark json to gate")
