@@ -518,6 +518,12 @@ def apply_op(name: str, fn: Callable, args: Sequence[Any], n_outputs: int = 1):
 _dispatch_cache: dict = {}
 _DISPATCH_CACHE_MAX = 4096
 _dispatch_epoch = -1  # flags.epoch the cache was built under
+# churn defense: an op whose key keeps varying (e.g. a per-step python
+# float static) would compile on every call — after this many distinct
+# builds for one code object it is blacklisted back to the retrace path
+_dispatch_builds: dict = {}
+_dispatch_blacklist: set = set()
+_DISPATCH_CHURN_LIMIT = 32
 
 
 def _dispatch_cache_fresh():
@@ -528,6 +534,8 @@ def _dispatch_cache_fresh():
     global _dispatch_epoch
     if _dispatch_epoch != flags.epoch:
         _dispatch_cache.clear()
+        _dispatch_builds.clear()
+        _dispatch_blacklist.clear()
         _dispatch_epoch = flags.epoch
     return _dispatch_cache
 
@@ -550,13 +558,20 @@ def _freeze(x):
     call_form is what the cached jit receives (lists become tuples — jnp
     APIs accept either); key_form additionally carries the TYPE of every
     scalar so ==-equal values of different types (0 vs 0.0 vs False) never
-    share an entry (they trace to different dtypes)."""
+    share an entry (they trace to different dtypes).  Rejected outright:
+    NaN floats (never ==-equal: every call would insert a fresh
+    never-hittable key) and locally-defined callables (fresh object per
+    call, keyed by identity: every call would compile a new executable)."""
     if isinstance(x, (list, tuple)):
         kids = [_freeze(v) for v in x]
         if any(k is _Unfreezable for k in kids):
             return _Unfreezable
         return ((type(x).__name__,) + tuple(k for k, _ in kids),
                 tuple(c for _, c in kids))
+    if isinstance(x, float) and x != x:
+        return _Unfreezable
+    if callable(x) and "<locals>" in getattr(x, "__qualname__", ""):
+        return _Unfreezable
     if not _hashable(x):
         return _Unfreezable
     return ((type(x), x), x)
@@ -662,13 +677,21 @@ def _apply_op_impl(name: str, fn: Callable, args: Sequence[Any], n_outputs: int 
     if (not name.endswith("_grad")
             and not any(isinstance(a, jax.core.Tracer) for a in jax_args)):
         keyed = _dispatch_key(fn, jax_args, diff_positions)
-        if keyed is not None:
+        if keyed is not None and keyed[0][0] not in _dispatch_blacklist:
             key, jax_args = keyed  # statics now hashable (lists -> tuples)
             cache = _dispatch_cache_fresh()
             dispatch = cache.get(key)
-            if dispatch is None and len(cache) < _DISPATCH_CACHE_MAX:
-                dispatch = _build_dispatch(key, fn, jax_args, diff_positions)
-                cache[key] = dispatch
+            if dispatch is None:
+                builds = _dispatch_builds.get(key[0], 0) + 1
+                if builds > _DISPATCH_CHURN_LIMIT:
+                    _dispatch_blacklist.add(key[0])  # churny op: retrace
+                else:
+                    _dispatch_builds[key[0]] = builds
+                    if len(cache) >= _DISPATCH_CACHE_MAX:
+                        cache.pop(next(iter(cache)))  # FIFO eviction
+                    dispatch = _build_dispatch(key, fn, jax_args,
+                                               diff_positions)
+                    cache[key] = dispatch
 
     if not diff_positions:
         out = dispatch[0](*jax_args) if dispatch is not None else fn(*jax_args)

@@ -301,3 +301,22 @@ def test_dispatch_cache_distinguishes_static_types():
     b = paddle.clip(x, 0.0, 4.0)
     assert str(a.dtype).endswith("int32")
     assert "float" in str(b.dtype)
+
+
+def test_dispatch_cache_churn_defense():
+    """Per-call-varying statics must not compile forever: after the churn
+    limit the op falls back to the retrace path, and fresh local lambdas /
+    NaN statics never enter the cache at all."""
+    from paddle_hackathon_tpu.core import autograd as ag
+    x = paddle.to_tensor(np.ones((4,), "float32"))
+    before = len(ag._dispatch_cache)
+    for i in range(ag._DISPATCH_CHURN_LIMIT + 8):
+        paddle.scale(x, scale=float(i) * 1.0001)
+    added = len(ag._dispatch_cache) - before
+    assert added <= ag._DISPATCH_CHURN_LIMIT, added
+
+    # NaN static: never cached (hash-equal but never ==-equal keys)
+    n0 = len(ag._dispatch_cache)
+    for _ in range(4):
+        paddle.clip(x, float("nan"), 1.0)
+    assert len(ag._dispatch_cache) == n0
