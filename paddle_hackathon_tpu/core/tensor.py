@@ -224,6 +224,14 @@ class Tensor:
             raise TypeError("len() of a 0-d tensor")
         return self._value.shape[0]
 
+    def __iter__(self):
+        # leading-dim slices (paddle Tensor iteration).  Without this,
+        # Python's __getitem__ fallback loops forever: jnp indexing clamps
+        # out-of-range instead of raising IndexError.
+        if not self._value.shape:
+            raise TypeError("iteration over a 0-d tensor")
+        return (self[i] for i in range(self._value.shape[0]))
+
     def __bool__(self):
         return bool(self.numpy())
 

@@ -22,9 +22,9 @@ Scope (documented, checked, and erroring loudly otherwise):
   the same set of traced locals with matching shapes/dtypes.
 - ``while`` with tensor conditions: loop-carried locals must keep stable
   shapes/dtypes across iterations.
-- ``for i in range(...)``: desugared to ``while`` (generic-iterable ``for``
-  keeps Python semantics — iterating a traced tensor unrolls or errors,
-  matching trace behavior).
+- ``for i in range(...)``: desugared to ``while``; ``for x in <tensor>``
+  iterates leading-dim slices via ``Tensor.__iter__`` (exact unroll — the
+  dim is static under trace); other iterables keep Python semantics.
 - ``and`` / ``or`` / ``not`` on tensors: ``jnp.logical_*`` (short-circuit
   preserved for plain Python values).
 - ``return`` / ``break`` / ``continue`` inside a *tensor-dependent* branch
@@ -632,6 +632,10 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         if not (isinstance(it, ast.Call) and isinstance(it.func, ast.Name)
                 and it.func.id == "range" and not it.keywords
                 and 1 <= len(it.args) <= 3):
+            # generic iterables keep Python semantics — Tensor.__iter__
+            # yields leading-dim slices in eager AND traced modes, so
+            # tensor iteration needs no rewrite (exact unroll; the
+            # leading dim is static under trace)
             self.generic_visit(node)
             return node
         if not isinstance(node.target, ast.Name):

@@ -289,3 +289,16 @@ class TestFoldCorrectness:
         static_f = jit.to_static(h)
         np.testing.assert_allclose(static_f(_t([0.0]), True).numpy(), [4.0])
         np.testing.assert_allclose(static_f(_t([0.0]), False).numpy(), [1.0])
+
+
+def test_for_over_tensor_iterates_rows():
+    def f(t):
+        s = t[0] * 0
+        for row in t:              # tensor iteration: leading-dim slices
+            s = s + row
+        return s
+
+    static_f = jit.to_static(f)
+    x = _t([[1.0, 2.0], [3.0, 4.0], [5.0, 6.0]])
+    np.testing.assert_allclose(static_f(x).numpy(), [9.0, 12.0], rtol=1e-6)
+    np.testing.assert_allclose(f(x).numpy(), [9.0, 12.0], rtol=1e-6)

@@ -122,3 +122,14 @@ def test_device_api():
     p = paddle.set_device("cpu")
     assert p.is_cpu_place()
     assert paddle.get_device().startswith("cpu")
+
+
+def test_tensor_iteration_yields_rows_and_terminates():
+    """Tensor.__iter__ (paddle Tensor iteration). Regression: without it
+    the __getitem__ fallback looped forever (jnp clamps out-of-range)."""
+    t = paddle.to_tensor(np.arange(6, dtype="float32").reshape(3, 2))
+    rows = [np.asarray(r._value) for r in t]
+    assert len(rows) == 3
+    np.testing.assert_allclose(rows[2], [4.0, 5.0])
+    with pytest.raises(TypeError):
+        iter(paddle.to_tensor(np.float32(1.0)))
