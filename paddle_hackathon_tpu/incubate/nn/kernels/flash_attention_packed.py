@@ -143,9 +143,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, seed_ref, o_ref, lse_ref,
             causal_keep = q_pos >= k_pos         # bool; the i32 iotas die here
         for h in range(group):
             q = qb[:, h * D:(h + 1) * D]
-            kt = jnp.swapaxes(kb[:, h * D:(h + 1) * D], 0, 1)
+            k = kb[:, h * D:(h + 1) * D]
             v = vb[:, h * D:(h + 1) * D]
-            s = jax.lax.dot_general(q, kt, (((1,), (0,)), ((), ())),
+            # contract over d of BOTH operands directly — current Mosaic
+            # takes (1,1) bf16 contractions natively, no register transpose
+            s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                     preferred_element_type=jnp.float32,
                                     precision=_prec(q.dtype))
             s = s * sm_scale
@@ -286,14 +288,12 @@ def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref,
             causal_keep = q_pos_t >= k_pos_t
         for h in range(group):
             q = qb[:, h * D:(h + 1) * D]
-            qt = jnp.swapaxes(q, 0, 1)
             k = kb[:, h * D:(h + 1) * D]
             v = vb[:, h * D:(h + 1) * D]
             do = dob[:, h * D:(h + 1) * D]
-            dot_ = jnp.swapaxes(do, 0, 1)
             lse = lse_ref[0, h][:1, :]           # (1, block_q)
             delta = delta_ref[0, h][:1, :]
-            st = jax.lax.dot_general(k, qt, (((1,), (0,)), ((), ())),
+            st = jax.lax.dot_general(k, q, (((1,), (1,)), ((), ())),
                                      preferred_element_type=jnp.float32,
                                      precision=_prec(k.dtype))
             st = st * sm_scale
@@ -312,7 +312,7 @@ def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref,
                 pt_v.astype(v.dtype), do, (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32,
                 precision=_prec(v.dtype))
-            dpt = jax.lax.dot_general(v, dot_, (((1,), (0,)), ((), ())),
+            dpt = jax.lax.dot_general(v, do, (((1,), (1,)), ((), ())),
                                       preferred_element_type=jnp.float32,
                                       precision=_prec(v.dtype))
             if dropout_p > 0.0:
@@ -361,19 +361,18 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         for h in range(group):
             q = qb[:, h * D:(h + 1) * D]
             k = kb[:, h * D:(h + 1) * D]
-            kt = jnp.swapaxes(k, 0, 1)
-            vt = jnp.swapaxes(vb[:, h * D:(h + 1) * D], 0, 1)
+            v = vb[:, h * D:(h + 1) * D]
             do = dob[:, h * D:(h + 1) * D]
             lse = jnp.swapaxes(lse_ref[0, h], 0, 1)[:, :1]   # (block_q, 1)
             delta = jnp.swapaxes(delta_ref[0, h], 0, 1)[:, :1]
-            s = jax.lax.dot_general(q, kt, (((1,), (0,)), ((), ())),
+            s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                     preferred_element_type=jnp.float32,
                                     precision=_prec(q.dtype))
             s = s * sm_scale
             if causal:
                 s = jnp.where(causal_keep, s, _NEG_INF)
             p = jnp.exp(s - lse)
-            dp = jax.lax.dot_general(do, vt, (((1,), (0,)), ((), ())),
+            dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                      preferred_element_type=jnp.float32,
                                      precision=_prec(do.dtype))
             if dropout_p > 0.0:
