@@ -20,11 +20,13 @@ from __future__ import annotations
 import contextlib
 import functools
 import threading
+import types
 from collections import deque
 from typing import Any, Callable, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from . import flags
 
@@ -552,6 +554,16 @@ class _Unfreezable:
     pass
 
 
+# identity-hashed types whose identity IS stable across calls (module-level
+# functions, modules, classes, numpy ufuncs) — everything else that falls
+# back to object.__hash__ is a mutable per-instance object (Tensor, Layer,
+# client handles): keying on those churns the cache toward the blacklist,
+# and a cached jit that traced such an object's state would serve stale
+# results after in-place mutation (ADVICE r2)
+_STABLE_IDENTITY_TYPES = (types.FunctionType, types.BuiltinFunctionType,
+                          types.ModuleType, type, np.ufunc)
+
+
 def _freeze(x):
     """(key_form, call_form) for a static value, or _Unfreezable.
 
@@ -571,6 +583,9 @@ def _freeze(x):
     if isinstance(x, float) and x != x:
         return _Unfreezable
     if callable(x) and "<locals>" in getattr(x, "__qualname__", ""):
+        return _Unfreezable
+    if (type(x).__hash__ is object.__hash__
+            and not isinstance(x, _STABLE_IDENTITY_TYPES)):
         return _Unfreezable
     if not _hashable(x):
         return _Unfreezable
@@ -733,7 +748,10 @@ def _apply_op_impl(name: str, fn: Callable, args: Sequence[Any], n_outputs: int 
         if src._grad_node is not None:
             parents.append((src._grad_node, src._out_idx))
         else:
-            parents.append(_LeafSlot(src))
+            # a double-grad snapshot stands in for its original leaf so
+            # accumulation/hooks land on the user-visible tensor
+            alias = getattr(src, "_leaf_alias", None)
+            parents.append(_LeafSlot(alias if alias is not None else src))
 
     outs = out if isinstance(out, tuple) else (out,)
     out_avals = [(o.shape, o.dtype) for o in outs]
@@ -742,7 +760,27 @@ def _apply_op_impl(name: str, fn: Callable, args: Sequence[Any], n_outputs: int 
     node = GradNode(name, node_vjp, parents, len(outs), out_avals)
     if flags.flag("eager_retain_double_grad"):
         node.fwd_fn = closed
-        node.in_tensors = tuple(args[pos] for pos in diff_positions)
+        # Snapshot the recorded input VALUES (ref TensorWrapper,
+        # eager/tensor_wrapper.h): the re-taped backward recomputes the
+        # forward from in_tensors inside jax.vjp, so holding the live
+        # Tensor objects would silently diverge after any in-place update
+        # (optimizer _set_value, fill_) between forward and grad.  The
+        # snapshot keeps the original autograd metadata so second-order
+        # chains still connect to the graph (jax arrays are immutable —
+        # this aliases, never copies).
+        snaps = []
+        for pos in diff_positions:
+            src = args[pos]
+            snap = Tensor(jax_args[pos], stop_gradient=src.stop_gradient,
+                          _grad_node=src._grad_node, _out_idx=src._out_idx)
+            if src._grad_node is None:
+                # leaf grads/hooks land on the original user-visible tensor
+                # (resolve transitively: a snapshot of a snapshot — higher-
+                # order re-tapes — must still alias the true leaf)
+                base = getattr(src, "_leaf_alias", None)
+                snap._leaf_alias = src if base is None else base
+            snaps.append(snap)
+        node.in_tensors = tuple(snaps)
     return _wrap_outputs(name, out, n_outputs, node=node)
 
 

@@ -29,6 +29,7 @@ class Tensor:
                  "_grad_node", "_out_idx", "_grad_value", "_grad_hooks",
                  "_process_mesh", "_shard_spec",  # auto_parallel annotations
                  "_lod",  # legacy LoD offsets (static.nn sequence_* ops)
+                 "_leaf_alias",  # double-grad snapshot -> original leaf
                  "__weakref__")
 
     # auto_parallel annotations (set by parallel.auto_parallel.shard_tensor);

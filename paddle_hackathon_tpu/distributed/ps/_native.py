@@ -105,3 +105,5 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.pht_ps_geo_pull_diff.argtypes = [c.c_void_p, c.c_uint32, c.c_uint32,
                                          u64p, f32p, c.c_uint32, c.c_uint32]
     lib.pht_ps_geo_pull_diff.restype = c.c_int64
+    lib.pht_ps_geo_register.argtypes = [c.c_void_p, c.c_uint32, c.c_uint32]
+    lib.pht_ps_geo_register.restype = c.c_int32

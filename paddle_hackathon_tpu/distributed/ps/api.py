@@ -276,6 +276,19 @@ class PsClient:
             if rc != 0:
                 raise RuntimeError(f"geo_push failed on server {s}: {rc}")
 
+    def geo_register(self, table_id: int, trainer_id: int) -> None:
+        """Register a geo trainer's watermark up front (ADVICE r2: an
+        unregistered trainer is invisible to the spill/shrink pending-
+        delivery guard until its first ``geo_pull_diff``, so an early
+        spill could permanently drop updates it never received).  Call
+        once per expected trainer right after table creation; never
+        rewinds an existing watermark."""
+        for s, c in enumerate(self._conns):
+            with c._lock:
+                rc = c._lib.pht_ps_geo_register(c._h, table_id, trainer_id)
+            if rc != 0:
+                raise RuntimeError(f"geo_register failed on server {s}: {rc}")
+
     def geo_pull_diff(self, table_id: int, trainer_id: int,
                       cap_rows: int = 1 << 16):
         """Rows changed since this trainer's previous ``geo_pull_diff``

@@ -47,7 +47,7 @@ import jax.numpy as jnp
 
 __all__ = ["convert_function", "convert_ifelse", "convert_while",
            "convert_logical_and", "convert_logical_or", "convert_logical_not",
-           "Undefined"]
+           "Undefined", "undef_or"]
 
 
 class _UndefinedType:
@@ -72,6 +72,31 @@ class _UndefinedType:
 Undefined = _UndefinedType()
 
 
+class _UndefWithFallback:
+    """A local unbound before converted control flow, with a typed fallback
+    for the lax path.  Eager semantics: behaves like :data:`Undefined` (the
+    body writes before any read; an empty loop leaves it undefined).  The
+    ``lax.while_loop`` path must carry a concrete typed init, so it uses
+    the fallback value instead (for-range desugar: the range start)."""
+
+    __slots__ = ("fallback",)
+
+    def __init__(self, fallback):
+        self.fallback = fallback
+
+    def __repr__(self):
+        return "<undefined local (typed fallback)>"
+
+    def __bool__(self):
+        raise NameError(
+            "local variable referenced before assignment inside converted "
+            "control flow")
+
+
+def undef_or(fallback):
+    return _UndefWithFallback(fallback)
+
+
 def _tensor_cls():
     from ..core.tensor import Tensor
     return Tensor
@@ -92,6 +117,8 @@ def _is_traced(x) -> bool:
 
 def _to_carry(val, site):
     """A control-flow-carried local -> jax value (or raise helpfully)."""
+    if isinstance(val, _UndefWithFallback):
+        val = val.fallback
     if val is Undefined:
         raise ValueError(
             f"{site}: a local is assigned on only one side of tensor-"
@@ -395,6 +422,12 @@ class _ControlFlowTransformer(ast.NodeTransformer):
     def __init__(self):
         self.counter = 0
         self.bound_names: set = set()  # approximation of names bound so far
+        # loop vars unbound before their for-range loop: name -> induction
+        # var whose value types the lax carry (see _UndefWithFallback)
+        self._undef_fallbacks: dict = {}
+        # generated induction vars: mutated per-iteration, so they must be
+        # loop-carried despite the __jst_ temp prefix
+        self._carry_ok: set = set()
 
     def _uid(self, kind):
         self.counter += 1
@@ -586,7 +619,7 @@ class _ControlFlowTransformer(ast.NodeTransformer):
             n for n in set(assigned) | (_read_names(node.test)
                                         & (self.bound_names
                                            | set(assigned)))
-            if not n.startswith("__jst_"))
+            if n in self._carry_ok or not n.startswith("__jst_"))
         if not carried:
             return node
         cond_name = self._uid("cond")
@@ -607,7 +640,8 @@ class _ControlFlowTransformer(ast.NodeTransformer):
                                    attr="convert_while", ctx=ast.Load()),
                 args=[ast.Name(id=cond_name, ctx=ast.Load()),
                       ast.Name(id=body_name, ctx=ast.Load()),
-                      _name_tuple_or_undefined(carried, self.bound_names)],
+                      _name_tuple_or_undefined(carried, self.bound_names,
+                                               self._undef_fallbacks)],
                 keywords=[]))
         unpack = ast.Assign(
             targets=[ast.Tuple(
@@ -649,32 +683,47 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         else:
             start, stop, step = args
         ivar = node.target.id
+        ind_var = self._uid("i")   # internal induction variable
         stop_var = self._uid("stop")
         step_var = self._uid("step")
+        # Iterate the internal induction variable and bind the user's loop
+        # variable from it at the top of each iteration: the post-loop value
+        # of `i` is then the last in-range value (Python semantics), body
+        # mutations of `i` don't perturb iteration, and an empty range never
+        # rebinds a previously-bound `i`.  When `i` is unbound before the
+        # loop it gets an Undefined-with-fallback init: eager empty ranges
+        # leave it undefined, while the lax.while_loop path (which must
+        # carry a typed value) falls back to `start`.
         init = [
-            ast.Assign(targets=[ast.Name(id=ivar, ctx=ast.Store())],
+            ast.Assign(targets=[ast.Name(id=ind_var, ctx=ast.Store())],
                        value=start),
             ast.Assign(targets=[ast.Name(id=stop_var, ctx=ast.Store())],
                        value=stop),
             ast.Assign(targets=[ast.Name(id=step_var, ctx=ast.Store())],
                        value=step),
         ]
-        # (i - stop) * sign(step) < 0  — handles negative steps
+        # (__jst_i - stop) * sign(step) < 0  — handles negative steps
         test = ast.Compare(
             left=ast.BinOp(
-                left=ast.BinOp(left=ast.Name(id=ivar, ctx=ast.Load()),
+                left=ast.BinOp(left=ast.Name(id=ind_var, ctx=ast.Load()),
                                op=ast.Sub(),
                                right=ast.Name(id=stop_var, ctx=ast.Load())),
                 op=ast.Mult(),
                 right=ast.Name(id=step_var, ctx=ast.Load())),
             ops=[ast.Lt()], comparators=[ast.Constant(0)])
-        incr = ast.AugAssign(target=ast.Name(id=ivar, ctx=ast.Store()),
+        bind = ast.Assign(targets=[ast.Name(id=ivar, ctx=ast.Store())],
+                          value=ast.Name(id=ind_var, ctx=ast.Load()))
+        incr = ast.AugAssign(target=ast.Name(id=ind_var, ctx=ast.Store()),
                              op=ast.Add(),
                              value=ast.Name(id=step_var, ctx=ast.Load()))
         # note: test compares (i-stop)*step < 0, so step sign is honored;
         # a zero step loops forever exactly like Python range() forbids —
         # range() would have raised already in the original code
-        loop = ast.While(test=test, body=node.body + [incr], orelse=[])
+        loop = ast.While(test=test, body=[bind] + node.body + [incr],
+                         orelse=[])
+        self._carry_ok.add(ind_var)
+        if ivar not in self.bound_names:
+            self._undef_fallbacks[ivar] = ind_var
         for n in init + [loop]:
             ast.copy_location(n, node)
             ast.fix_missing_locations(n)
@@ -683,6 +732,7 @@ class _ControlFlowTransformer(ast.NodeTransformer):
             rewritten.append(n)
             self.bound_names.update(_assigned_names([n]))
         res = self.visit(loop)
+        self._undef_fallbacks.pop(ivar, None)
         rewritten.extend(res if isinstance(res, list) else [res])
         return rewritten
 
@@ -710,11 +760,18 @@ def _return_tuple(names):
         ctx=ast.Load()))
 
 
-def _name_tuple_or_undefined(names, bound):
+def _name_tuple_or_undefined(names, bound, fallbacks=None):
     elts = []
     for n in names:
         if n in bound:
             elts.append(ast.Name(id=n, ctx=ast.Load()))
+        elif fallbacks and n in fallbacks:
+            elts.append(ast.Call(
+                func=ast.Attribute(
+                    value=ast.Name(id=_JST, ctx=ast.Load()),
+                    attr="undef_or", ctx=ast.Load()),
+                args=[ast.Name(id=fallbacks[n], ctx=ast.Load())],
+                keywords=[]))
         else:
             elts.append(ast.Attribute(
                 value=ast.Name(id=_JST, ctx=ast.Load()),

@@ -77,6 +77,7 @@ enum Op : uint8_t {
   opSpill = 13,        // SSD tier: evict cold rows to a spill file
   opGeoPush = 14,      // geo-async: merge raw deltas (no optimizer rule)
   opGeoPullDiff = 15,  // geo-async: rows changed since trainer's last sync
+  opGeoRegister = 16,  // geo-async: register a trainer's watermark up front
 };
 
 // deterministic per-id init in (-range, range): splitmix64 hash
@@ -614,6 +615,24 @@ void PsServer::handle(int fd) {
         if (!write_full(fd, &n, 4) || !write_full(fd, &dim, 4)) break;
       }
 
+    } else if (op == opGeoRegister) {
+      // register a trainer BEFORE its first pull so the pending-delivery
+      // guard in spill/shrink covers it from the start: geo_min_seen()
+      // returns UINT64_MAX while trainer_seen is empty, and a spill that
+      // raced a trainer's implicit first-pull registration could evict
+      // rows whose geo updates that trainer never received (geo diffs
+      // only scan RAM — the delivery would be lost permanently)
+      uint32_t trainer;
+      if (!read_full(fd, &trainer, 4)) break;
+      Table* t = table(tid);
+      uint8_t ok = 0;
+      if (t) {
+        std::lock_guard<std::mutex> g(t->geo_mu);
+        t->trainer_seen.emplace(trainer, 0);  // never rewinds a watermark
+        ok = 1;
+      }
+      if (!write_full(fd, &ok, 1)) break;
+
     } else if (op == opSave || op == opLoad) {
       uint32_t plen;
       if (!read_full(fd, &plen, 4)) break;
@@ -977,6 +996,18 @@ PHT_API int32_t pht_ps_geo_push(void* h, uint32_t tid, const uint64_t* ids,
       (n && !write_full(c->fd, ids, 8ull * n)) ||
       !write_full(c->fd, &dim, 4) ||
       (n && !write_full(c->fd, deltas, sizeof(float) * n * dim)))
+    return -1;
+  uint8_t ok;
+  if (!read_full(c->fd, &ok, 1)) return -1;
+  return ok ? 0 : -2;
+}
+
+// Register a geo trainer's watermark before its first pull/push so
+// spill/shrink's pending-delivery guard covers it from table setup on
+// (an unregistered trainer is invisible to geo_min_seen).
+PHT_API int32_t pht_ps_geo_register(void* h, uint32_t tid, uint32_t trainer) {
+  auto* c = static_cast<PsClient*>(h);
+  if (!c->rpc_hdr(opGeoRegister, tid) || !write_full(c->fd, &trainer, 4))
     return -1;
   uint8_t ok;
   if (!read_full(c->fd, &ok, 1)) return -1;

@@ -173,13 +173,15 @@ def binary_cross_entropy(input, label, weight=None, reduction="mean",  # noqa: A
 def binary_cross_entropy_with_logits(logit, label, weight=None,
                                      reduction="mean", pos_weight=None,
                                      name=None):
+    has_w, has_pw = weight is not None, pos_weight is not None
+
     def fn(z, y, *rest):
         i = 0
         w = None
-        if weight is not None:
+        if has_w:
             w = rest[i]
             i += 1
-        pw = rest[i] if pos_weight is not None else None
+        pw = rest[i] if has_pw else None
         # stable: max(z,0) - z*y + log(1+exp(-|z|))
         base = jnp.maximum(z, 0) - z * y + jnp.log1p(jnp.exp(-jnp.abs(z)))
         if pw is not None:

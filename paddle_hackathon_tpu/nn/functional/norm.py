@@ -49,15 +49,20 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
     else:
         mean_t, var_t = _t(running_mean), _t(running_var)
 
+    # the closure must capture only the None-ness of weight/bias, not the
+    # Tensor objects (identity-keyed mutable cells defeat the dispatch
+    # cache — core/autograd._freeze); the values flow through args
+    has_w, has_b = weight is not None, bias is not None
+
     def fn(v, m, s, *rest):
         shape = [1] * v.ndim
         shape[channel_axis % v.ndim] = m.shape[0]
         out = (v - m.reshape(shape)) / jnp.sqrt(s.reshape(shape) + epsilon)
         i = 0
-        if weight is not None:
+        if has_w:
             out = out * rest[i].reshape(shape)
             i += 1
-        if bias is not None:
+        if has_b:
             out = out + rest[i].reshape(shape)
         return out
 
@@ -75,16 +80,18 @@ def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5,
         normalized_shape = (normalized_shape,)
     n_axes = len(tuple(normalized_shape))
 
+    has_w, has_b = weight is not None, bias is not None
+
     def fn(v, *rest):
         axes = tuple(range(v.ndim - n_axes, v.ndim))
         mean = jnp.mean(v, axis=axes, keepdims=True)
         var = jnp.var(v, axis=axes, keepdims=True)
         out = (v - mean) / jnp.sqrt(var + epsilon)
         i = 0
-        if weight is not None:
+        if has_w:
             out = out * rest[i]
             i += 1
-        if bias is not None:
+        if has_b:
             out = out + rest[i]
         return out
 
@@ -100,6 +107,8 @@ def group_norm(x, num_groups, epsilon=1e-5, weight=None, bias=None,
                data_format="NCHW", name=None):
     channel_last = not data_format.startswith("NC")
 
+    has_w, has_b = weight is not None, bias is not None
+
     def fn(v, *rest):
         if channel_last:
             v = jnp.moveaxis(v, -1, 1)
@@ -112,10 +121,10 @@ def group_norm(x, num_groups, epsilon=1e-5, weight=None, bias=None,
         out = ((grouped - mean) / jnp.sqrt(var + epsilon)).reshape(v.shape)
         shape = [1, c] + [1] * (v.ndim - 2)
         i = 0
-        if weight is not None:
+        if has_w:
             out = out * rest[i].reshape(shape)
             i += 1
-        if bias is not None:
+        if has_b:
             out = out + rest[i].reshape(shape)
         if channel_last:
             out = jnp.moveaxis(out, 1, -1)
@@ -132,6 +141,8 @@ def group_norm(x, num_groups, epsilon=1e-5, weight=None, bias=None,
 def instance_norm(x, running_mean=None, running_var=None, weight=None,
                   bias=None, use_input_stats=True, momentum=0.9, eps=1e-5,
                   data_format="NCHW", name=None):
+    has_w, has_b = weight is not None, bias is not None
+
     def fn(v, *rest):
         axes = tuple(range(2, v.ndim))
         mean = jnp.mean(v, axis=axes, keepdims=True)
@@ -139,10 +150,10 @@ def instance_norm(x, running_mean=None, running_var=None, weight=None,
         out = (v - mean) / jnp.sqrt(var + eps)
         shape = [1, v.shape[1]] + [1] * (v.ndim - 2)
         i = 0
-        if weight is not None:
+        if has_w:
             out = out * rest[i].reshape(shape)
             i += 1
-        if bias is not None:
+        if has_b:
             out = out + rest[i].reshape(shape)
         return out
 

@@ -320,3 +320,38 @@ def test_dispatch_cache_churn_defense():
     for _ in range(4):
         paddle.clip(x, float("nan"), 1.0)
     assert len(ag._dispatch_cache) == n0
+
+
+def test_double_grad_uses_recorded_values_after_inplace_update():
+    """ADVICE r2: create_graph must snapshot input values at record time
+    (ref TensorWrapper) — an in-place update between forward and grad must
+    not change the recomputed forward inside the re-taped backward."""
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = (x * x * x).sum()                      # y = x^3
+    (g1,) = paddle.grad(y, [x], create_graph=True)
+    x.set_value(np.asarray([100.0], np.float32))  # mutate AFTER recording
+    (g2,) = paddle.grad(g1.sum(), [x])
+    # d2/dx2 x^3 = 6x at the RECORDED x=2 -> 12, not 600
+    np.testing.assert_allclose(g2.numpy(), [12.0], rtol=1e-6)
+
+
+def test_dispatch_cache_shared_across_layer_instances():
+    """ADVICE r2: ops whose closures capture per-instance framework objects
+    (weight/bias Tensors) must not mint one dispatch-cache key per layer —
+    many same-shaped BN/LN layers should share a single cache entry and
+    never trip the churn blacklist."""
+    import paddle_hackathon_tpu.nn.functional as F
+    from paddle_hackathon_tpu.core import autograd as ag
+
+    x = paddle.to_tensor(np.random.randn(4, 8).astype("float32"))
+    before_blacklist = set(ag._dispatch_blacklist)
+    keys_before = {k[0] for k in ag._dispatch_cache_fresh()}
+    # 40 distinct weight/bias tensors > _DISPATCH_CHURN_LIMIT (32)
+    for _ in range(40):
+        w = paddle.to_tensor(np.random.rand(8).astype("float32") + 0.5)
+        b = paddle.to_tensor(np.random.randn(8).astype("float32"))
+        F.layer_norm(x, 8, weight=w, bias=b)
+    assert ag._dispatch_blacklist == before_blacklist  # nothing blacklisted
+    # at most ONE new code-object key appeared for the layer_norm op
+    new_keys = {k[0] for k in ag._dispatch_cache} - keys_before
+    assert len(new_keys) <= 1

@@ -302,3 +302,68 @@ def test_for_over_tensor_iterates_rows():
     x = _t([[1.0, 2.0], [3.0, 4.0], [5.0, 6.0]])
     np.testing.assert_allclose(static_f(x).numpy(), [9.0, 12.0], rtol=1e-6)
     np.testing.assert_allclose(f(x).numpy(), [9.0, 12.0], rtol=1e-6)
+
+
+def test_for_range_loop_var_python_semantics():
+    """ADVICE r2 (medium): the for-range desugar must leave the loop var at
+    the last in-range value (Python), not the first out-of-range one."""
+    def f(x):
+        for i in range(3):
+            x = x + i
+        return x + i * 10           # i == 2 after the loop, never 3
+
+    from paddle_hackathon_tpu import jit
+    static_f = jit.to_static(f)
+    x = _t([0.0])
+    np.testing.assert_allclose(static_f(x).numpy(), [23.0])  # 0+1+2 + 20
+
+
+def test_for_range_body_mutation_does_not_perturb_iteration():
+    def f(x):
+        total = x * 0
+        for i in range(4):
+            total = total + i
+            i = i * 100             # Python: next iteration resets i
+        return total
+
+    from paddle_hackathon_tpu import jit
+    static_f = jit.to_static(f)
+    np.testing.assert_allclose(static_f(_t([0.0])).numpy(), [6.0])
+
+
+def test_for_range_empty_does_not_rebind_loop_var():
+    def f(x):
+        i = 7
+        for i in range(0):
+            x = x + i
+        return x + i                # empty range: i keeps its old binding
+
+    from paddle_hackathon_tpu import jit
+    static_f = jit.to_static(f)
+    np.testing.assert_allclose(static_f(_t([1.0])).numpy(), [8.0])
+
+
+def test_for_range_negative_step_post_value():
+    def f(x):
+        for i in range(5, 0, -2):   # 5, 3, 1
+            x = x + i
+        return x + i * 10           # i == 1
+
+    from paddle_hackathon_tpu import jit
+    static_f = jit.to_static(f)
+    np.testing.assert_allclose(static_f(_t([0.0])).numpy(), [19.0])
+
+
+def test_for_range_tensor_bound_loop_var_after_loop():
+    """Traced path: post-loop loop-var value must match Python too."""
+    def f(x, n):
+        s = x * 0
+        for i in range(n):
+            s = s + x
+        return s + i                # last in-range value = n-1
+
+    from paddle_hackathon_tpu import jit
+    static_f = jit.to_static(f)
+    x = _t([1.0, 1.5])
+    got = static_f(x, _t(4, "int32"))
+    np.testing.assert_allclose(got.numpy(), 4 * x.numpy() + 3, rtol=1e-6)

@@ -338,3 +338,36 @@ class TestGeoTable:
         assert sorted(got.tolist()) == [1, 2]  # delivery intact
         # delivered everywhere -> now spillable
         assert cluster.spill(74, 0, str(tmp_path / "sp4")) == 2
+
+
+class TestGeoRegistration:
+    """ADVICE r2: explicit trainer registration closes the window where a
+    spill racing a trainer's very first geo_pull_diff (which implicitly
+    registers it) could evict rows whose updates that trainer never saw."""
+
+    def test_geo_register_guards_spill_before_first_pull(self, cluster,
+                                                         tmp_path):
+        cluster.create_table(TableConfig(75, dim=2, rule="sgd", lr=0.1,
+                                         init_range=0.0))
+        # register trainer 0 UP FRONT — no pull has happened yet
+        cluster.geo_register(75, 0)
+        ids = np.asarray([11, 12], np.uint64)
+        cluster.geo_push(75, ids, np.ones((2, 2), np.float32))
+        # both rows carry updates trainer 0 has not pulled -> unspillable
+        assert cluster.spill(75, 0, str(tmp_path / "sp5")) == 0
+        got, rows = cluster.geo_pull_diff(75, 0)
+        assert sorted(got.tolist()) == [11, 12]
+        np.testing.assert_allclose(rows, np.ones((2, 2)), rtol=1e-6)
+        # delivered -> spillable now
+        assert cluster.spill(75, 0, str(tmp_path / "sp5")) == 2
+
+    def test_geo_register_never_rewinds_watermark(self, cluster):
+        cluster.create_table(TableConfig(76, dim=2, rule="sgd", lr=0.1,
+                                         init_range=0.0))
+        ids = np.asarray([1], np.uint64)
+        cluster.geo_push(76, ids, np.ones((1, 2), np.float32))
+        got, _ = cluster.geo_pull_diff(76, 0)   # advances watermark
+        assert got.tolist() == [1]
+        cluster.geo_register(76, 0)             # re-register: no-op
+        got2, _ = cluster.geo_pull_diff(76, 0)  # nothing re-delivered
+        assert got2.size == 0
