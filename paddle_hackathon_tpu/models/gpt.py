@@ -278,6 +278,65 @@ class GPTForCausalLM(Layer):
     def num_params(self) -> int:
         return sum(p.size for p in self.parameters())
 
+    def pipeline_stage_spec(self) -> dict:
+        """Pipeline decomposition consumed by
+        ``parallel.make_sharded_train_step`` when the mesh has a 'pp' axis
+        (ref ``PipelineLayer`` segmentation ``parallel_layers/pp_layers.py:162``
+        and ``PipelineParallel.forward_backward_pipeline``
+        ``pipeline_parallel.py:82-152``).
+
+        The embedding head/tail run replicated over 'pp' — the tied ``wte``
+        is the reference's ``SharedLayerDesc`` (``pp_layers.py:77``); its
+        cross-stage grad allreduce (``pipeline_parallel.py:149``) falls out
+        of AD on the replicated placement.  The block stack is sharded over
+        'pp' with a stacked leading layer dim.
+
+        Returns dict with:
+          block_prefix: param-name prefix of the per-layer block params
+          num_layers:   total transformer layers
+          pre_fn(params, buffers, ids, key)  -> (b, s, h) hidden states
+          layer_fn(layer_params, x)          -> x  (one block, pure)
+          post_fn(params, x, labels)         -> scalar loss
+        Each mirrors the corresponding slice of ``GPTModel.forward`` /
+        ``GPTForCausalLM.loss`` exactly (parity-tested vs the non-pp path).
+        """
+        import jax
+        import jax.numpy as jnp
+        from ..core import random as core_random
+        from ..nn.layer import functional_call
+        from ..nn.functional.loss import fused_softmax_ce_rows
+
+        template = self.gpt.blocks[0]
+        drop = self.gpt.drop
+        ln_f = self.gpt.ln_f
+        vocab = self.config.vocab_size
+
+        def pre_fn(params, buffers, ids, key):
+            wte = params["gpt.wte.weight"]
+            wpe = params["gpt.wpe.weight"]
+            s = ids.shape[1]
+            # row slice of wpe == GPTModel.forward's slice+reshape path
+            pos = jax.lax.slice_in_dim(wpe, 0, s, axis=0)[None]
+            x = jnp.take(wte, ids, axis=0) + pos
+            with core_random.rng_scope(key):
+                x = functional_call(drop, {}, (Tensor(x),))
+            return x
+
+        def layer_fn(layer_params, x):
+            return functional_call(template, layer_params, (Tensor(x),))
+
+        def post_fn(params, x, labels):
+            xn = functional_call(
+                ln_f, {"weight": params["gpt.ln_f.weight"],
+                       "bias": params["gpt.ln_f.bias"]}, (Tensor(x),))
+            logits = xn @ params["gpt.wte.weight"].T
+            return jnp.mean(fused_softmax_ce_rows(
+                logits.reshape(-1, vocab), labels.reshape(-1)))
+
+        return {"block_prefix": "gpt.blocks.",
+                "num_layers": self.config.num_layers,
+                "pre_fn": pre_fn, "layer_fn": layer_fn, "post_fn": post_fn}
+
 
 def param_sharding_spec(name: str, shape) -> tuple:
     """Named-axis PartitionSpec entries for each GPT parameter.

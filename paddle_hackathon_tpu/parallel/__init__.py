@@ -30,8 +30,9 @@ from .topology import (CommunicateTopology, HybridCommunicateGroup,  # noqa: F40
 from .mp_layers import (ColumnParallelLinear, ParallelCrossEntropy,  # noqa: F401
                         RowParallelLinear, VocabParallelEmbedding,
                         mark_sharding, sharding_rule_from_model)
-from .pipeline import (LayerDesc, SharedLayerDesc, pipeline_apply,  # noqa: F401
-                       stack_layer_params, unstack_into_layers)
+from .pipeline import (LayerDesc, PipelineParallel, SharedLayerDesc,  # noqa: F401
+                       pipeline_apply, stack_layer_params,
+                       unstack_into_layers)
 from .sequence import ring_attention, ulysses_attention  # noqa: F401
 from .moe import GShardGate, MoELayer, NaiveGate, SwitchGate  # noqa: F401
 from .sharding import (group_sharded_parallel,  # noqa: F401

@@ -113,6 +113,96 @@ def batch_spec(mesh: Mesh) -> P:
     return P(data_axes)
 
 
+def _pp_stacked_spec(rel: str, arr, mesh: Mesh, rule, prefix: str,
+                     extra_sharding: bool):
+    """PartitionSpec for a stacked block parameter: leading layer dim on
+    'pp', remaining dims per the TP rule of the per-layer param (layer 0's
+    name is representative), optionally + a 'sharding' dim (ZeRO)."""
+    from .sharding import _shard_spec_for
+    per = list(rule(prefix + "0." + rel, arr.shape[1:])) if rule \
+        else [None] * (arr.ndim - 1)
+    spec = ["pp"] + list(_filter_spec(per, mesh))
+    if extra_sharding:
+        spec = list(_shard_spec_for(arr.shape, mesh, existing=spec))
+    return _filter_spec(spec, mesh)
+
+
+def _make_pipeline_loss(model, mesh: Mesh, pp_spec: dict, pp_degree: int,
+                        n_micro: int, stacked_rel_keys):
+    """Loss over the 1F1B pipelined forward (see make_sharded_train_step).
+
+    Microbatching uses a strided regroup — ``(B, ...) -> (mb, n_micro, ...)
+    -> swapaxes`` — so the dp/sharding-sharded batch dim splits without any
+    cross-device data motion (microbatch m = rows {j*n_micro + m}; the loss
+    is a mean over all rows, so the grouping is semantically free)."""
+    from .pipeline import pipeline_apply
+    from ..core import random as core_random
+
+    prefix = pp_spec["block_prefix"]
+    pre_fn, layer_fn, post_fn = (pp_spec["pre_fn"], pp_spec["layer_fn"],
+                                 pp_spec["post_fn"])
+    n_local = pp_spec["num_layers"] // pp_degree
+    data_axes = tuple(a for a in ("dp", "sharding") if a in mesh.axis_names)
+
+    def loss_fn(model, params, buffers, batch, rng):
+        ids, labels = batch
+        k_pre, k_blocks = jax.random.split(rng)
+        x = pre_fn(params, buffers, ids, k_pre)
+        B = x.shape[0]
+        if B % n_micro:
+            raise ValueError(
+                f"batch {B} must divide into pp_microbatches={n_micro}")
+        mb = B // n_micro
+        n_data = int(np.prod([mesh.shape[a] for a in data_axes])) \
+            if data_axes else 1
+        if mb % n_data:
+            raise ValueError(
+                f"microbatch size {mb} (= batch {B} / pp_microbatches "
+                f"{n_micro}) must divide over the {n_data} dp*sharding "
+                "devices — a smaller microbatch would idle data ranks and "
+                "force resharding; raise the batch or lower pp_microbatches")
+
+        def pin(a, spec_head):
+            # explicit motion-free sharding chain: without these pins GSPMD
+            # propagates the batch sharding onto the wrong regroup dim and
+            # falls back to involuntary full rematerialization
+            if not data_axes:
+                return a
+            spec = spec_head + tuple([None] * (a.ndim - len(spec_head)))
+            return jax.lax.with_sharding_constraint(
+                a, NamedSharding(mesh, P(*spec)))
+
+        xr = pin(x.reshape((mb, n_micro) + x.shape[1:]), (data_axes, None))
+        xm = pin(jnp.swapaxes(xr, 0, 1), (None, data_axes))
+        stacked = {rel: params[prefix + "$stacked." + rel]
+                   for rel in stacked_rel_keys}
+
+        def block_fn(stage_params, xb, mb_idx):
+            stage = jax.lax.axis_index("pp")
+
+            def body(h, inp):
+                lp, j = inp
+                # unique dropout stream per (layer, microbatch) — folding
+                # only the layer would reuse one mask across microbatches
+                lk = jax.random.fold_in(
+                    k_blocks, (stage * n_local + j) * n_micro + mb_idx)
+                with core_random.rng_scope(lk):
+                    return layer_fn(lp, h), None
+
+            h, _ = jax.lax.scan(body, xb,
+                                (stage_params, jnp.arange(n_local)))
+            return h
+
+        ym = pin(pipeline_apply(block_fn, stacked, xm, mesh,
+                                extra=jnp.arange(n_micro)),
+                 (None, data_axes))
+        ys = pin(jnp.swapaxes(ym, 0, 1), (data_axes, None))
+        y = pin(ys.reshape((B,) + ym.shape[2:]), (data_axes,))
+        return post_fn(params, y, labels)
+
+    return loss_fn
+
+
 def make_sharded_train_step(model: Layer, mesh: Mesh,
                             rule: Optional[Callable] = None,
                             learning_rate: float = 1e-4,
@@ -121,29 +211,92 @@ def make_sharded_train_step(model: Layer, mesh: Mesh,
                             param_dtype=None,
                             grad_clip_norm: Optional[float] = 1.0,
                             recompute: bool = False,
-                            recompute_policy: Optional[str] = None):
+                            recompute_policy: Optional[str] = None,
+                            pp_microbatches: Optional[int] = None):
     """Build (step_fn, state) — one compiled SPMD program per step covering
     forward, backward, grad psum over dp, Adam update on (optionally
     'sharding'-sharded) optimizer state.
 
     This one function subsumes: EagerReducer fused allreduce (DP), sharding
     stage-1/2 (optimizer state + grads live sharded — XLA keeps them
-    reduce-scattered), stage-3/FSDP (zero_stage=3 shards params too), and TP
-    (rule specs). Ref: SURVEY §2.4 table.
+    reduce-scattered), stage-3/FSDP (zero_stage=3 shards params too), TP
+    (rule specs), and — when the mesh has a 'pp' axis — 1F1B pipeline
+    parallelism composed INSIDE the same program (the reference's 4-D
+    hybrid: ``fleet_base.py:381-408`` topology + ``pipeline_parallel.py:
+    82-152`` schedule + ``hybrid_parallel_optimizer.py:172`` grad sync; the
+    dp/sharding grad psum and the TP collectives stay GSPMD-managed while
+    'pp' runs manual ppermute ticks via ``pipeline_apply``).
+    Ref: SURVEY §2.4 table.
+
+    The pp path requires the model to implement ``pipeline_stage_spec()``
+    (see ``models/gpt.py``); ``pp_microbatches`` sets the microbatch count
+    (default: the pp degree).
     """
     from ..nn.layer import functional_call
 
+    pp_degree = mesh.shape.get("pp", 1)
     if param_dtype is not None:
         for _, p in model.named_parameters():
             if jnp.issubdtype(p._value.dtype, jnp.floating):
                 p._set_value(p._value.astype(param_dtype))
-    shard_params(model, mesh, rule, zero_stage)
-    params = {k: p._value for k, p in model.named_parameters()}
-    _, buffers = model.functional_state()
 
     from .sharding import _shard_spec_for
 
+    pp_spec = None
+    stacked_rel_keys = ()
+    if pp_degree > 1:
+        if loss_fn is not None:
+            raise ValueError(
+                "a custom loss_fn cannot be combined with a 'pp' mesh axis; "
+                "the pipeline schedule owns the forward decomposition")
+        if not hasattr(model, "pipeline_stage_spec"):
+            raise ValueError(
+                f"{type(model).__name__} does not implement "
+                "pipeline_stage_spec(); required for a 'pp' mesh axis")
+        pp_spec = model.pipeline_stage_spec()
+        n_layers = pp_spec["num_layers"]
+        if n_layers % pp_degree:
+            raise ValueError(
+                f"num_layers={n_layers} must divide evenly over "
+                f"pp={pp_degree} stages")
+        prefix = pp_spec["block_prefix"]
+        import re
+        pat = re.compile(re.escape(prefix) + r"(\d+)\.(.+)")
+        raw = {k: p._value for k, p in model.named_parameters()}
+        per_layer: Dict[str, dict] = {}
+        params = {}
+        for k, v in raw.items():
+            m = pat.match(k)
+            if m:
+                per_layer.setdefault(m.group(2), {})[int(m.group(1))] = v
+            else:
+                spec = list(rule(k, v.shape)) if rule else [None] * v.ndim
+                spec = list(_filter_spec(spec, mesh))
+                if zero_stage >= 3:
+                    spec = list(_shard_spec_for(v.shape, mesh, existing=spec))
+                params[k] = jax.device_put(v, NamedSharding(mesh, P(*spec)))
+        for rel, d in sorted(per_layer.items()):
+            arr = jnp.stack([d[i] for i in range(n_layers)])
+            params[prefix + "$stacked." + rel] = jax.device_put(
+                arr, NamedSharding(mesh, P(*_pp_stacked_spec(
+                    rel, arr, mesh, rule, prefix, zero_stage >= 3))))
+        stacked_rel_keys = tuple(sorted(per_layer))
+        # rebind the live model's tensors to the placed (non-stacked) arrays
+        for k, p in model.named_parameters():
+            if k in params:
+                p._set_value(params[k])
+    else:
+        shard_params(model, mesh, rule, zero_stage)
+        params = {k: p._value for k, p in model.named_parameters()}
+    _, buffers = model.functional_state()
+
     def opt_state_spec(name, arr):
+        if pp_degree > 1 and name.startswith(
+                pp_spec["block_prefix"] + "$stacked."):
+            rel = name[len(pp_spec["block_prefix"]) + len("$stacked."):]
+            spec = _pp_stacked_spec(rel, arr, mesh, rule,
+                                    pp_spec["block_prefix"], zero_stage >= 1)
+            return NamedSharding(mesh, P(*spec))
         spec = list(rule(name, arr.shape)) if rule else [None] * arr.ndim
         spec = list(_filter_spec(spec, mesh))
         if zero_stage >= 1:
@@ -159,7 +312,11 @@ def make_sharded_train_step(model: Layer, mesh: Mesh,
         for k, v in params.items()}
     step_no = jnp.zeros((), jnp.int32)
 
-    if loss_fn is None:
+    if pp_degree > 1:
+        loss_fn = _make_pipeline_loss(
+            model, mesh, pp_spec, pp_degree,
+            pp_microbatches or pp_degree, stacked_rel_keys)
+    elif loss_fn is None:
         def loss_fn(model, params, buffers, batch, rng):
             ids, labels = batch
             from ..core import random as core_random
@@ -172,7 +329,7 @@ def make_sharded_train_step(model: Layer, mesh: Mesh,
 
     b1, b2, eps = 0.9, 0.95, 1e-8
 
-    def train_step(params, opt_state, step_no, batch, rng):
+    def train_step(params, opt_state, step_no, batch, rng, lr):
         def pure_loss(p):
             return loss_fn(model, p, buffers, batch, rng)
 
@@ -196,7 +353,7 @@ def make_sharded_train_step(model: Layer, mesh: Mesh,
             v = b2 * opt_state[k]["v"] + (1 - b2) * jnp.square(g)
             mhat = m / (1 - b1 ** t)
             vhat = v / (1 - b2 ** t)
-            upd = learning_rate * mhat / (jnp.sqrt(vhat) + eps)
+            upd = lr * mhat / (jnp.sqrt(vhat) + eps)
             new_params[k] = (params[k].astype(jnp.float32) - upd).astype(
                 params[k].dtype)
             new_opt[k] = {"m": m, "v": v}
@@ -212,7 +369,7 @@ def make_sharded_train_step(model: Layer, mesh: Mesh,
         in_shardings=(
             param_sh, opt_sh, scalar_sh,
             (NamedSharding(mesh, bspec), NamedSharding(mesh, bspec)),
-            None,
+            None, None,
         ),
         # pin output shardings to the input layout — without this XLA may pick
         # a different layout for the updated params, forcing a re-jit (and a
@@ -223,17 +380,41 @@ def make_sharded_train_step(model: Layer, mesh: Mesh,
     state = {"params": params, "opt_state": opt_state, "step": step_no}
     param_tensors = dict(model.named_parameters())
 
-    def step(state, ids, labels, rng):
-        new_params, new_opt, new_step, loss = jitted(
-            state["params"], state["opt_state"], state["step"],
-            (ids, labels), rng)
+    def step(state, ids, labels, rng, lr=None):
+        # lr is a dynamic scalar: schedules (PipelineParallel.train_batch
+        # passes the optimizer's current lr) never trigger a recompile
+        lr_now = jnp.float32(learning_rate if lr is None else lr)
+        # partial-manual shard_map (the pp pipeline) requires the ambient
+        # mesh at trace time (_smap.run_shard_map); harmless otherwise
+        with jax.set_mesh(mesh):
+            new_params, new_opt, new_step, loss = jitted(
+                state["params"], state["opt_state"], state["step"],
+                (ids, labels), rng, lr_now)
         # The old param buffers were donated; rebind the live model's tensors
         # to the updated arrays so the Layer stays usable (eval, jit.save,
-        # checkpointing) throughout training.
+        # checkpointing) throughout training.  Stacked pp block params are
+        # NOT unstacked per step (that would gather across the pp axis every
+        # iteration) — call step.sync_model(state) before eval/save.
         for k, v in new_params.items():
-            param_tensors[k]._set_value(v)
+            t = param_tensors.get(k)
+            if t is not None:
+                t._set_value(v)
         return ({"params": new_params, "opt_state": new_opt,
                  "step": new_step}, loss)
 
+    def sync_model(state):
+        """Write the (possibly pp-stacked) state back into the live model."""
+        for k, v in state["params"].items():
+            t = param_tensors.get(k)
+            if t is not None:
+                t._set_value(v)
+                continue
+            if pp_spec is not None:
+                prefix = pp_spec["block_prefix"]
+                rel = k[len(prefix) + len("$stacked."):]
+                for i in range(pp_spec["num_layers"]):
+                    param_tensors[f"{prefix}{i}.{rel}"]._set_value(v[i])
+
     step._jitted = jitted  # exposed for AOT lowering / HLO inspection
+    step.sync_model = sync_model
     return step, state

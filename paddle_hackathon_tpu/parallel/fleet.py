@@ -122,10 +122,17 @@ class _Fleet:
     def distributed_model(self, model: Layer) -> Layer:
         """Ref ``fleet_base.py:1073-``: wrap by parallel mode. Here: place
         every parameter onto the mesh per its pspec annotation (TP layers
-        set these) + replicate the rest; batch sharding happens at input."""
+        set these) + replicate the rest; batch sharding happens at input.
+        With a 'pp' axis in the mesh, return the :class:`PipelineParallel`
+        wrapper (ref ``fleet_base.py``'s PipelineParallel mode) whose
+        ``train_batch`` runs the 1F1B schedule composed with dp/sharding/mp
+        inside one program."""
         mesh = _mesh_api.get_mesh()
         if mesh is None:
             return model
+        if mesh.shape.get("pp", 1) > 1:
+            from .pipeline import PipelineParallel
+            return PipelineParallel(model, mesh, strategy=self._strategy)
         from .api import shard_params
         from .mp_layers import sharding_rule_from_model
         zero = 0

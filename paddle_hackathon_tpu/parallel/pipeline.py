@@ -134,6 +134,77 @@ class SharedLayerDesc(LayerDesc):
         self.forward_func = forward_func
 
 
+class PipelineParallel:
+    """Model wrapper returned by ``fleet.distributed_model`` when the mesh
+    has a 'pp' axis (ref ``meta_parallel/pipeline_parallel.py:31`` —
+    same role and ``train_batch`` surface as the reference's wrapper).
+
+    The 1F1B schedule, TP/DP/ZeRO composition and the optimizer update all
+    live in ONE compiled SPMD program (``make_sharded_train_step``), built
+    lazily on the first ``train_batch`` from the optimizer's lr and the
+    strategy's pipeline/sharding configs (microbatches =
+    ``pipeline_configs["accumulate_steps"]``, matching the reference)."""
+
+    def __init__(self, model, mesh: Mesh, strategy=None, rule=None):
+        self._model = model
+        self._mesh = mesh
+        self._strategy = strategy
+        self._rule = rule
+        self._step = None
+        self._state = None
+
+    def __getattr__(self, name):  # delegate everything else to the model
+        return getattr(self._model, name)
+
+    def __call__(self, *args, **kwargs):
+        return self._model(*args, **kwargs)
+
+    def train_batch(self, data, optimizer=None, lr_scheduler=None,
+                    scaler=None):
+        """Ref ``PipelineParallel.train_batch`` (``pipeline_parallel.py:154``):
+        one full pipelined forward+backward+update; returns the loss."""
+        import numpy as np
+        from ..core import random as core_random
+        from ..core.tensor import Tensor as _T
+        if scaler is not None:
+            raise NotImplementedError(
+                "GradScaler is not supported in the pipelined train step — "
+                "use bf16 params (no loss scaling needed on TPU) instead")
+        ids, labels = data
+        ids = ids._value if isinstance(ids, _T) else jnp.asarray(ids)
+        labels = (labels._value if isinstance(labels, _T)
+                  else jnp.asarray(labels))
+        if self._step is None:
+            from .api import make_sharded_train_step
+            from .mp_layers import sharding_rule_from_model
+            n_micro = None
+            zero = 0
+            if self._strategy is not None:
+                n_micro = int(self._strategy.pipeline_configs.get(
+                    "accumulate_steps", 0)) or None
+                if self._strategy.sharding:
+                    zero = int((self._strategy.sharding_configs or {}).get(
+                        "stage", 1))
+            rule = self._rule or sharding_rule_from_model(self._model)
+            self._step, self._state = make_sharded_train_step(
+                self._model, self._mesh, rule=rule,
+                zero_stage=zero, pp_microbatches=n_micro)
+        # lr read fresh every call: schedules stay live (the step takes lr
+        # as a dynamic scalar, so this never recompiles)
+        lr = float(optimizer.get_lr()) if optimizer is not None else 1e-3
+        self._state, loss = self._step(self._state, ids, labels,
+                                       core_random.split_key(), lr=lr)
+        if lr_scheduler is not None:
+            lr_scheduler.step()
+        from ..core.tensor import Tensor
+        return Tensor(loss)
+
+    def sync_model(self):
+        """Unstack the pipelined block params back into the live model."""
+        if self._step is not None:
+            self._step.sync_model(self._state)
+
+
 def stack_layer_params(layers) -> dict:
     """Stack the parameters of N structurally-identical layers into single
     arrays with a leading layer dim — the layout ``pipeline_apply`` (and

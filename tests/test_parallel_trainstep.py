@@ -167,3 +167,112 @@ def test_jit_save_dynamic_batch(tmp_path):
         x = paddle.randn([b, 4])
         np.testing.assert_allclose(loaded(x).numpy(), model(x).numpy(),
                                    atol=1e-5)
+
+
+class TestPipelineComposition:
+    """VERDICT r2 #1: pp composed with dp/sharding/mp in ONE program
+    (ref 4-axis hybrid: fleet_base.py:381-408 topology +
+    pipeline_parallel.py:82-152 1F1B + hybrid_parallel_optimizer.py:172)."""
+
+    def _run(self, mesh_dims, zero_stage, steps=3, **kw):
+        ids, labels = _data(batch=16)
+        paddle.seed(123)
+        model = GPTForCausalLM(_tiny(num_layers=4))
+        n = int(np.prod(list(mesh_dims.values())))
+        mesh = parallel.create_mesh(mesh_dims, devices=jax.devices()[:n])
+        step, state = parallel.make_sharded_train_step(
+            model, mesh, rule=param_sharding_spec, learning_rate=1e-3,
+            zero_stage=zero_stage, grad_clip_norm=None, **kw)
+        out = []
+        for i in range(steps):
+            state, loss = step(state, ids, labels, jax.random.key(0))
+            out.append(float(loss))
+        return out, step, state, model
+
+    def test_dp_pp_mp_matches_single_device(self):
+        single, *_ = self._run({"dp": 1}, 0)
+        hybrid, *_ = self._run({"dp": 2, "pp": 2, "mp": 2}, 0)
+        np.testing.assert_allclose(hybrid, single, rtol=2e-4)
+
+    def test_dp_pp_sharding_zero3_matches_single_device(self):
+        single, *_ = self._run({"dp": 1}, 0)
+        hybrid, *_ = self._run({"dp": 2, "pp": 2, "sharding": 2}, 3,
+                               pp_microbatches=2)
+        np.testing.assert_allclose(hybrid, single, rtol=2e-4)
+
+    def test_pp_stacked_params_actually_pipeline_sharded(self):
+        _, step, state, model = self._run({"pp": 2, "mp": 2}, 0, steps=1)
+        k = "gpt.blocks.$stacked.attn.qkv_proj.weight"
+        arr = state["params"][k]
+        assert arr.shape[0] == 4      # stacked layer dim
+        spec = arr.sharding.spec
+        assert spec[0] == "pp" and "mp" in spec
+        # per-device shard is 1/4 of the stacked tensor (pp2 x mp2)
+        assert arr.addressable_shards[0].data.size == arr.size // 4
+
+    def test_pp_sync_model_restores_per_layer_params(self):
+        _, step, state, model = self._run({"pp": 2, "dp": 2}, 0, steps=2)
+        step.sync_model(state)
+        k = "gpt.blocks.$stacked.attn.qkv_proj.weight"
+        stacked = np.asarray(state["params"][k])
+        live = dict(model.named_parameters())
+        for i in range(4):
+            np.testing.assert_allclose(
+                np.asarray(live[f"gpt.blocks.{i}.attn.qkv_proj.weight"]._value),
+                stacked[i])
+
+    def test_pp_with_dropout_trains(self):
+        """rng threading through the pipeline scan (fold_in per layer)."""
+        ids, labels = _data(batch=8)
+        paddle.seed(7)
+        model = GPTForCausalLM(_tiny(num_layers=4, hidden_dropout_prob=0.1,
+                                     attention_dropout_prob=0.0))
+        mesh = parallel.create_mesh({"pp": 2, "dp": 2},
+                                    devices=jax.devices()[:4])
+        step, state = parallel.make_sharded_train_step(
+            model, mesh, rule=param_sharding_spec, learning_rate=1e-3)
+        losses = []
+        for i in range(4):
+            state, loss = step(state, ids, labels, jax.random.key(i))
+            losses.append(float(loss))
+        assert all(np.isfinite(losses))
+        assert losses[-1] < losses[0]
+
+    def test_pp_microbatch_divisibility_error(self):
+        with pytest.raises(ValueError, match="divide"):
+            self._run({"dp": 4, "pp": 2}, 0, pp_microbatches=8)
+
+
+def test_fleet_pipeline_distributed_model_train_batch():
+    """fleet wiring (ref fleet_base.py:1073-): a pp-axis mesh makes
+    distributed_model return the PipelineParallel wrapper whose train_batch
+    runs the one-program 4-axis hybrid step."""
+    from paddle_hackathon_tpu.distributed import fleet
+    paddle.seed(0)
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 2, "mp_degree": 1,
+                               "pp_degree": 2, "sharding_degree": 2}
+    strategy.pipeline = True
+    strategy.pipeline_configs = {"accumulate_steps": 2}
+    strategy.sharding = True
+    strategy.sharding_configs = {"stage": 3}
+    fleet.init(is_collective=True, strategy=strategy)
+    try:
+        model = GPTForCausalLM(_tiny(num_layers=4))
+        model = fleet.distributed_model(model)
+        assert isinstance(model, parallel.PipelineParallel)
+        opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                    parameters=model.parameters())
+        opt = fleet.distributed_optimizer(opt)
+        r = np.random.RandomState(0)
+        losses = []
+        for i in range(4):
+            ids = paddle.to_tensor(r.randint(0, 128, (8, 16)).astype("int32"))
+            labels = paddle.to_tensor(
+                r.randint(0, 128, (8, 16)).astype("int32"))
+            loss = model.train_batch([ids, labels], opt)
+            losses.append(float(loss.numpy()))
+        assert all(np.isfinite(losses)) and losses[-1] < losses[0]
+        model.sync_model()  # stacked params restored into the live layers
+    finally:
+        parallel.set_mesh(None)
