@@ -134,15 +134,26 @@ def _fwd_kernel(q_ref, k_ref, v_ref, seed_ref, o_ref, lse_ref,
         m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
         l_ref[...] = jnp.zeros_like(l_ref)
 
-    def _body():
+    def _body(masked):
+        # VPU passes over the (block_q, block_kv) tile are the kernel's
+        # critical path (the d=64 dots leave the MXU mostly idle), so the
+        # softmax is arranged to touch the full tile as few times as
+        # possible: sm_scale is folded into the small (block, D) q slice
+        # (exact for power-of-two 1/sqrt(D)), the running max runs on the
+        # RAW block (a too-large max is only a shift — masked entries can
+        # never overflow exp), and causal masking is one select AFTER the
+        # exp — emitted only on diagonal-crossing cells (``masked``);
+        # strictly-lower cells skip mask and iotas entirely.
         qb = q_ref[0]                            # (block_q, G*D)
         kb = k_ref[0]                            # (block_kv, G*D)
         vb = v_ref[0]
-        if causal:
+        if masked or dropout_p > 0.0:
             q_pos, k_pos = _causal_positions(qi, ki, block_q, block_kv)
+        if masked:
             causal_keep = q_pos >= k_pos         # bool; the i32 iotas die here
         for h in range(group):
-            q = qb[:, h * D:(h + 1) * D]
+            q = (qb[:, h * D:(h + 1) * D] *
+                 jnp.asarray(sm_scale, qb.dtype))
             k = kb[:, h * D:(h + 1) * D]
             v = vb[:, h * D:(h + 1) * D]
             # contract over d of BOTH operands directly — current Mosaic
@@ -150,9 +161,6 @@ def _fwd_kernel(q_ref, k_ref, v_ref, seed_ref, o_ref, lse_ref,
             s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                     preferred_element_type=jnp.float32,
                                     precision=_prec(q.dtype))
-            s = s * sm_scale
-            if causal:
-                s = jnp.where(causal_keep, s, _NEG_INF)
             # stats live transposed (8, block_q); work in (block_q, 1)
             m_prev = jnp.swapaxes(m_ref[h], 0, 1)[:, :1]
             l_prev = jnp.swapaxes(l_ref[h], 0, 1)[:, :1]
@@ -160,13 +168,13 @@ def _fwd_kernel(q_ref, k_ref, v_ref, seed_ref, o_ref, lse_ref,
             m_next = jnp.maximum(m_prev, m_cur)          # (block_q, 1)
             alpha = jnp.exp(m_prev - m_next)
             p = jnp.exp(s - m_next)
+            if masked:
+                p = jnp.where(causal_keep, p, 0.0)
             l_next = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
             if dropout_p > 0.0:
-                dq_pos, dk_pos = _causal_positions(qi, ki, block_q,
-                                                   block_kv)
                 keep = _dropout_keep(seed_ref[0],
                                      bi * heads + gi * group + h,
-                                     dq_pos, dk_pos, 1.0 - dropout_p)
+                                     q_pos, k_pos, 1.0 - dropout_p)
                 p = jnp.where(keep, p / (1.0 - dropout_p), 0.0)
             pv = jax.lax.dot_general(p.astype(v.dtype), v,
                                      (((1,), (0,)), ((), ())),
@@ -179,11 +187,19 @@ def _fwd_kernel(q_ref, k_ref, v_ref, seed_ref, o_ref, lse_ref,
                 jnp.broadcast_to(l_next, (block_q, _SUB)), 0, 1)
 
     if causal:
-        @pl.when(ki * block_kv <= qi * block_q + block_q - 1)
-        def _run():
-            _body()
+        last_q = qi * block_q + block_q - 1
+        diag = (ki * block_kv <= last_q) & \
+            (ki * block_kv + block_kv - 1 > last_q - block_q)
+
+        @pl.when(diag)
+        def _run_diag():
+            _body(True)
+
+        @pl.when(ki * block_kv + block_kv - 1 <= last_q - block_q)
+        def _run_full():
+            _body(False)
     else:
-        _body()
+        _body(False)
 
     @pl.when(ki == n_kv - 1)
     def _finish():
@@ -258,10 +274,6 @@ def _fwd(qkv, heads, causal, sm_scale, dropout_p=0.0, seed=None,
     return out, lse
 
 
-# ---------------------------------------------------------------------------
-# Backward
-# ---------------------------------------------------------------------------
-
 def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref,
                      delta_ref, seed_ref, dk_ref, dv_ref, dk_acc, dv_acc,
                      *, sm_scale, causal, block_q, block_kv, n_q, group,
@@ -277,17 +289,23 @@ def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref,
         dk_acc[...] = jnp.zeros_like(dk_acc)
         dv_acc[...] = jnp.zeros_like(dv_acc)
 
-    def _body():
+    def _body(masked):
+        # VPU economy (see _fwd_kernel): sm_scale folded into the q slice
+        # (st lands in lse space; the same scaled q also serves the dk dot,
+        # since dk = pt*(dpt-delta) . q*scale), causal select after the
+        # exp, diagonal-crossing cells only
         qb = q_ref[0]                            # (block_q, G*D)
         kb = k_ref[0]                            # (block_kv, G*D)
         vb = v_ref[0]
         dob = do_ref[0]
-        if causal:
+        if masked or dropout_p > 0.0:
             q_pos_t, k_pos_t = _causal_positions(
                 qi, ki, block_q, block_kv, transposed=True)
+        if masked:
             causal_keep = q_pos_t >= k_pos_t
         for h in range(group):
-            q = qb[:, h * D:(h + 1) * D]
+            q = (qb[:, h * D:(h + 1) * D] *
+                 jnp.asarray(sm_scale, qb.dtype))
             k = kb[:, h * D:(h + 1) * D]
             v = vb[:, h * D:(h + 1) * D]
             do = dob[:, h * D:(h + 1) * D]
@@ -296,17 +314,14 @@ def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref,
             st = jax.lax.dot_general(k, q, (((1,), (1,)), ((), ())),
                                      preferred_element_type=jnp.float32,
                                      precision=_prec(k.dtype))
-            st = st * sm_scale
-            if causal:
-                st = jnp.where(causal_keep, st, _NEG_INF)
             pt = jnp.exp(st - lse)
+            if masked:
+                pt = jnp.where(causal_keep, pt, 0.0)
             pt_v = pt
             if dropout_p > 0.0:
-                dq_pos, dk_pos = _causal_positions(
-                    qi, ki, block_q, block_kv, transposed=True)
                 keep = _dropout_keep(seed_ref[0],
                                      bi * heads + gi * group + h,
-                                     dq_pos, dk_pos, 1.0 - dropout_p)
+                                     q_pos_t, k_pos_t, 1.0 - dropout_p)
                 pt_v = jnp.where(keep, pt / (1.0 - dropout_p), 0.0)
             dv_acc[h] += jax.lax.dot_general(
                 pt_v.astype(v.dtype), do, (((1,), (0,)), ((), ())),
@@ -317,18 +332,26 @@ def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref,
                                       precision=_prec(v.dtype))
             if dropout_p > 0.0:
                 dpt = jnp.where(keep, dpt / (1.0 - dropout_p), 0.0)
-            dst = pt * (dpt - delta) * sm_scale
+            dst = pt * (dpt - delta)
             dk_acc[h] += jax.lax.dot_general(
                 dst.astype(k.dtype), q, (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32,
                 precision=_prec(k.dtype))
 
     if causal:
-        @pl.when(qi * block_q + block_q - 1 >= ki * block_kv)
-        def _run():
-            _body()
+        first_k = ki * block_kv
+        diag = (qi * block_q + block_q - 1 >= first_k) & \
+            (qi * block_q < first_k + block_kv)
+
+        @pl.when(diag)
+        def _run_diag():
+            _body(True)
+
+        @pl.when(qi * block_q >= first_k + block_kv)
+        def _run_full():
+            _body(False)
     else:
-        _body()
+        _body(False)
 
     @pl.when(qi == n_q - 1)
     def _finish():
@@ -350,16 +373,22 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     def _init():
         dq_acc[...] = jnp.zeros_like(dq_acc)
 
-    def _body():
+    def _body(masked):
+        # same VPU economy as the forward: sm_scale folded into the small
+        # q slice (s lands in lse space directly) and into the k slice of
+        # the final dot (dq = p*(dp-delta) . k*scale); the causal select
+        # runs on p AFTER the exp and only on diagonal-crossing cells
         qb = q_ref[0]
         kb = k_ref[0]
         vb = v_ref[0]
         dob = do_ref[0]
-        if causal:
+        if masked or dropout_p > 0.0:
             q_pos, k_pos = _causal_positions(qi, ki, block_q, block_kv)
+        if masked:
             causal_keep = q_pos >= k_pos
         for h in range(group):
-            q = qb[:, h * D:(h + 1) * D]
+            scale = jnp.asarray(sm_scale, qb.dtype)
+            q = qb[:, h * D:(h + 1) * D] * scale
             k = kb[:, h * D:(h + 1) * D]
             v = vb[:, h * D:(h + 1) * D]
             do = dob[:, h * D:(h + 1) * D]
@@ -368,32 +397,37 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                     preferred_element_type=jnp.float32,
                                     precision=_prec(q.dtype))
-            s = s * sm_scale
-            if causal:
-                s = jnp.where(causal_keep, s, _NEG_INF)
             p = jnp.exp(s - lse)
+            if masked:
+                p = jnp.where(causal_keep, p, 0.0)
             dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                      preferred_element_type=jnp.float32,
                                      precision=_prec(do.dtype))
             if dropout_p > 0.0:
-                dq_pos, dk_pos = _causal_positions(qi, ki, block_q,
-                                                   block_kv)
                 keep = _dropout_keep(seed_ref[0],
                                      bi * heads + gi * group + h,
-                                     dq_pos, dk_pos, 1.0 - dropout_p)
+                                     q_pos, k_pos, 1.0 - dropout_p)
                 dp = jnp.where(keep, dp / (1.0 - dropout_p), 0.0)
-            ds = p * (dp - delta) * sm_scale
+            ds = p * (dp - delta)
             dq_acc[h] += jax.lax.dot_general(
-                ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+                ds.astype(k.dtype), k * scale, (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32,
                 precision=_prec(k.dtype))
 
     if causal:
-        @pl.when(ki * block_kv <= qi * block_q + block_q - 1)
-        def _run():
-            _body()
+        last_q = qi * block_q + block_q - 1
+        diag = (ki * block_kv <= last_q) & \
+            (ki * block_kv + block_kv - 1 > last_q - block_q)
+
+        @pl.when(diag)
+        def _run_diag():
+            _body(True)
+
+        @pl.when(ki * block_kv + block_kv - 1 <= last_q - block_q)
+        def _run_full():
+            _body(False)
     else:
-        _body()
+        _body(False)
 
     @pl.when(ki == n_kv - 1)
     def _finish():

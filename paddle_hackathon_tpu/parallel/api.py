@@ -127,7 +127,7 @@ def _pp_stacked_spec(rel: str, arr, mesh: Mesh, rule, prefix: str,
     return _filter_spec(spec, mesh)
 
 
-def _make_pipeline_loss(model, mesh: Mesh, pp_spec: dict, pp_degree: int,
+def _make_pipeline_loss(mesh: Mesh, pp_spec: dict, pp_degree: int,
                         n_micro: int, stacked_rel_keys):
     """Loss over the 1F1B pipelined forward (see make_sharded_train_step).
 
@@ -314,7 +314,7 @@ def make_sharded_train_step(model: Layer, mesh: Mesh,
 
     if pp_degree > 1:
         loss_fn = _make_pipeline_loss(
-            model, mesh, pp_spec, pp_degree,
+            mesh, pp_spec, pp_degree,
             pp_microbatches or pp_degree, stacked_rel_keys)
     elif loss_fn is None:
         def loss_fn(model, params, buffers, batch, rng):

@@ -163,16 +163,15 @@ class PipelineParallel:
                     scaler=None):
         """Ref ``PipelineParallel.train_batch`` (``pipeline_parallel.py:154``):
         one full pipelined forward+backward+update; returns the loss."""
-        import numpy as np
-        from ..core import random as core_random
-        from ..core.tensor import Tensor as _T
         if scaler is not None:
             raise NotImplementedError(
                 "GradScaler is not supported in the pipelined train step — "
                 "use bf16 params (no loss scaling needed on TPU) instead")
+        from ..core import random as core_random
+        from ..core.tensor import Tensor
         ids, labels = data
-        ids = ids._value if isinstance(ids, _T) else jnp.asarray(ids)
-        labels = (labels._value if isinstance(labels, _T)
+        ids = ids._value if isinstance(ids, Tensor) else jnp.asarray(ids)
+        labels = (labels._value if isinstance(labels, Tensor)
                   else jnp.asarray(labels))
         if self._step is None:
             from .api import make_sharded_train_step
@@ -190,13 +189,13 @@ class PipelineParallel:
                 self._model, self._mesh, rule=rule,
                 zero_stage=zero, pp_microbatches=n_micro)
         # lr read fresh every call: schedules stay live (the step takes lr
-        # as a dynamic scalar, so this never recompiles)
-        lr = float(optimizer.get_lr()) if optimizer is not None else 1e-3
+        # as a dynamic scalar, so this never recompiles); without an
+        # optimizer, None lets the step use its own configured default
+        lr = float(optimizer.get_lr()) if optimizer is not None else None
         self._state, loss = self._step(self._state, ids, labels,
                                        core_random.split_key(), lr=lr)
         if lr_scheduler is not None:
             lr_scheduler.step()
-        from ..core.tensor import Tensor
         return Tensor(loss)
 
     def sync_model(self):
