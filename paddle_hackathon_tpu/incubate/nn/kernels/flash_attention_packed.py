@@ -137,13 +137,13 @@ def _fwd_kernel(q_ref, k_ref, v_ref, seed_ref, o_ref, lse_ref,
     def _body(masked):
         # VPU passes over the (block_q, block_kv) tile are the kernel's
         # critical path (the d=64 dots leave the MXU mostly idle), so the
-        # softmax is arranged to touch the full tile as few times as
-        # possible: sm_scale is folded into the small (block, D) q slice
-        # (exact for power-of-two 1/sqrt(D)), the running max runs on the
-        # RAW block (a too-large max is only a shift — masked entries can
-        # never overflow exp), and causal masking is one select AFTER the
-        # exp — emitted only on diagonal-crossing cells (``masked``);
-        # strictly-lower cells skip mask and iotas entirely.
+        # softmax touches the full tile as few times as possible:
+        # sm_scale is folded into the small (block, D) q slice (exact for
+        # power-of-two 1/sqrt(D)), and the causal mask + iotas exist only
+        # on diagonal-crossing cells (``masked``) — strictly-lower cells
+        # skip them entirely.  Diag cells mask BEFORE the running max (a
+        # raw-block max could be inflated by a masked outlier logit,
+        # underflowing every valid probability in the row).
         qb = q_ref[0]                            # (block_q, G*D)
         kb = k_ref[0]                            # (block_kv, G*D)
         vb = v_ref[0]
@@ -161,6 +161,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, seed_ref, o_ref, lse_ref,
             s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                     preferred_element_type=jnp.float32,
                                     precision=_prec(q.dtype))
+            if masked:
+                s = jnp.where(causal_keep, s, _NEG_INF)
             # stats live transposed (8, block_q); work in (block_q, 1)
             m_prev = jnp.swapaxes(m_ref[h], 0, 1)[:, :1]
             l_prev = jnp.swapaxes(l_ref[h], 0, 1)[:, :1]
@@ -168,8 +170,6 @@ def _fwd_kernel(q_ref, k_ref, v_ref, seed_ref, o_ref, lse_ref,
             m_next = jnp.maximum(m_prev, m_cur)          # (block_q, 1)
             alpha = jnp.exp(m_prev - m_next)
             p = jnp.exp(s - m_next)
-            if masked:
-                p = jnp.where(causal_keep, p, 0.0)
             l_next = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
             if dropout_p > 0.0:
                 keep = _dropout_keep(seed_ref[0],

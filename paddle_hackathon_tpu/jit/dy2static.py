@@ -27,11 +27,18 @@ Scope (documented, checked, and erroring loudly otherwise):
   dim is static under trace); other iterables keep Python semantics.
 - ``and`` / ``or`` / ``not`` on tensors: ``jnp.logical_*`` (short-circuit
   preserved for plain Python values).
-- ``return`` / ``break`` / ``continue`` inside a *tensor-dependent* branch
-  or loop body are not convertible (same restriction class as the
-  reference's early-return transformer); such statements leave the
-  enclosing statement untransformed, which keeps Python-predicate code
-  working and raises jax's concretization error for tensor predicates.
+- ``break`` / ``continue`` / ``return`` inside loops ARE convertible (ref
+  ``break_continue_transformer.py`` / ``return_transformer.py``): escapes
+  desugar into boolean guard flags threaded through the loop carry —
+  ``break`` joins the loop test, ``continue`` guards the body tail, and a
+  ``return e`` site sets a flag whose post-loop handler re-evaluates ``e``
+  (legal because once any flag is set the guards freeze all loop state, so
+  ``e``'s constituents hold their escape-time values; ``e`` must therefore
+  be side-effect-free).  A tensor-pred mid-function return additionally
+  needs the loop in a tail-foldable position (the post-loop ``if flag:
+  return e`` goes through the guard-clause fold).  ``yield``, loop
+  ``else`` clauses, and escapes inside non-range ``for`` iterables keep
+  Python semantics.
 """
 
 from __future__ import annotations
@@ -498,6 +505,15 @@ class _ControlFlowTransformer(ast.NodeTransformer):
                     s.body, s.orelse = s.orelse, s.body
                     out.extend(self._fold_return_if(s, stmts[idx + 1:]))
                     return out
+            if isinstance(s, (ast.While, ast.For)):
+                des = self._try_desugar_escapes(s)
+                if des is not None:
+                    # re-process the flag-desugared replacement inline so
+                    # its post-loop `if flag: return e` guards reach the
+                    # tail-position return folding
+                    out.extend(self._rewrite_block(
+                        des + list(stmts[idx + 1:]), tail=tail))
+                    return out
             res = self.visit(s)
             if isinstance(res, list):
                 out.extend(res)
@@ -516,7 +532,8 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         body_src = list(node.body)
         orelse_src = list(node.orelse) + list(rest)
         assigned = _assigned_names(body_src + orelse_src)
-        assigned = [n for n in assigned if not n.startswith("__jst_")]
+        assigned = [n for n in assigned
+                    if n in self._carry_ok or not n.startswith("__jst_")]
 
         outer_bound = set(self.bound_names)
         body_r = self._rewrite_block(body_src, tail=True)
@@ -564,7 +581,7 @@ class _ControlFlowTransformer(ast.NodeTransformer):
                 or _has_flow_escape(node.orelse, loop=False)):
             return node  # early return/break: leave Python semantics
         assigned = [n for n in _assigned_names(node.body + node.orelse)
-                    if not n.startswith("__jst_")]
+                    if n in self._carry_ok or not n.startswith("__jst_")]
         if not assigned:
             # no state change: still needs the runtime dispatch for side
             # effects? a tensor-pred if with no assignments is either dead
@@ -654,6 +671,14 @@ class _ControlFlowTransformer(ast.NodeTransformer):
             ast.fix_missing_locations(n)
         return nodes
 
+    @staticmethod
+    def _is_range_for(node) -> bool:
+        it = node.iter
+        return (isinstance(it, ast.Call) and isinstance(it.func, ast.Name)
+                and it.func.id == "range" and not it.keywords
+                and 1 <= len(it.args) <= 3
+                and isinstance(node.target, ast.Name))
+
     def visit_For(self, node):
         # Desugar `for x in range(...)` / `for x in <expr>` into a while
         # (the while visitor then decides python-vs-lax at runtime).  Only
@@ -662,20 +687,221 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         if node.orelse or _has_flow_escape(node.body, loop=True):
             self.generic_visit(node)
             return node
-        it = node.iter
-        if not (isinstance(it, ast.Call) and isinstance(it.func, ast.Name)
-                and it.func.id == "range" and not it.keywords
-                and 1 <= len(it.args) <= 3):
+        res = self._for_range_to_while(node)
+        if res is None:
             # generic iterables keep Python semantics — Tensor.__iter__
             # yields leading-dim slices in eager AND traced modes, so
             # tensor iteration needs no rewrite (exact unroll; the
             # leading dim is static under trace)
             self.generic_visit(node)
             return node
-        if not isinstance(node.target, ast.Name):
-            self.generic_visit(node)
-            return node
-        args = it.args
+        init, loop = res
+        rewritten = []
+        for n in init:
+            rewritten.append(n)
+            self.bound_names.update(_assigned_names([n]))
+        out = self.visit(loop)
+        self._undef_fallbacks.pop(node.target.id, None)
+        rewritten.extend(out if isinstance(out, list) else [out])
+        return rewritten
+
+    # -- break/continue/return desugar (ref break_continue_transformer.py,
+    #    return_transformer.py: bool guard variables) ----------------------
+
+    def _can_desugar_escapes(self, stmts) -> bool:
+        """True when every flow escape in the suite can be converted to
+        guard flags: escapes directly at loop level or inside plain ifs;
+        nested loops only if their returns are themselves desugarable;
+        try/with/yield involvement bails to Python semantics."""
+        for s in stmts:
+            if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.ClassDef)):
+                continue
+            if isinstance(s, (ast.Try, ast.With)):
+                if any(isinstance(x, (ast.Break, ast.Continue, ast.Return,
+                                      ast.Yield, ast.YieldFrom))
+                       for x in ast.walk(s)):
+                    return False
+                continue
+            if isinstance(s, (ast.While, ast.For)):
+                if any(isinstance(x, ast.Return) for x in ast.walk(s)):
+                    if s.orelse:
+                        return False
+                    if isinstance(s, ast.For) and not self._is_range_for(s):
+                        return False
+                    if not self._can_desugar_escapes(s.body):
+                        return False
+                continue
+            if isinstance(s, ast.If):
+                if not self._can_desugar_escapes(s.body) \
+                        or not self._can_desugar_escapes(s.orelse):
+                    return False
+        return True
+
+    def _try_desugar_escapes(self, node):
+        """Loop containing break/continue/return -> flag-carried
+        replacement statement list, or None when not applicable."""
+        if not isinstance(node, (ast.While, ast.For)) or node.orelse:
+            return None
+        if not _has_flow_escape(node.body, loop=True):
+            return None
+        if any(isinstance(x, (ast.Yield, ast.YieldFrom))
+               for x in ast.walk(node)):
+            return None
+        if not self._can_desugar_escapes(node.body):
+            return None
+        if isinstance(node, ast.For):
+            res = self._for_range_to_while(node)
+            if res is None:
+                return None
+            init, loop = res
+            # the induction-variable increment appended by the range
+            # desugar must run on EVERY iteration — a continue guard that
+            # swallowed it would freeze the loop forever.  (Running it
+            # after break/return is harmless: the user-visible loop var is
+            # re-bound from the induction var at the top of each
+            # iteration, so it keeps its escape-time value.)
+            return init + self._desugar_while_escapes(loop, keep_tail=1)
+        return self._desugar_while_escapes(node)
+
+    def _desugar_while_escapes(self, node, keep_tail: int = 0):
+        """``while`` with break/continue/return -> bool guard flags (the
+        reference's transformer trick retargeted at the lax carry):
+
+        - ``break`` -> ``__jst_brk = True``; joins the loop test;
+        - ``continue`` -> ``__jst_cont = True``; reset at body top;
+        - ``return e`` -> per-site ``__jst_ret_k = True``; joins the loop
+          test; post-loop ``if __jst_ret_k: return e`` (state is frozen by
+          the guards after any flag sets, so ``e`` evaluates to its
+          escape-time value — ``e`` must be side-effect-free);
+        - after any statement that may set a flag, the rest of its suite
+          is wrapped in ``if not (<flags>):``.
+
+        All flags are pre-initialised to False (typed for the lax carry)
+        and registered carry-eligible.
+        """
+        flags: dict = {"brk": None, "cont": None}
+        ret_sites: list = []
+
+        def new_flag(kind):
+            name = self._uid(kind)
+            self._carry_ok.add(name)
+            # a nested loop's flag is (re)initialised inside the enclosing
+            # loop's body, so the enclosing carry needs a typed fallback
+            self._undef_fallbacks[name] = ast.Constant(False)
+            return name
+
+        def get(kind):
+            if flags[kind] is None:
+                flags[kind] = new_flag(kind)
+            return flags[kind]
+
+        def assign_true(name):
+            return ast.Assign(targets=[ast.Name(id=name, ctx=ast.Store())],
+                              value=ast.Constant(True))
+
+        def universe():
+            return {f for f in flags.values() if f} | \
+                {f for f, _ in ret_sites}
+
+        def assigned_flags(stmt):
+            uni = universe()
+            return {t.id for sub in ast.walk(stmt)
+                    if isinstance(sub, ast.Assign)
+                    for t in sub.targets
+                    if isinstance(t, ast.Name) and t.id in uni}
+
+        def rew(stmts):
+            out = []
+            for i, s in enumerate(stmts):
+                if isinstance(s, ast.Break):
+                    out.append(assign_true(get("brk")))
+                    return out          # rest of the suite is unreachable
+                if isinstance(s, ast.Continue):
+                    out.append(assign_true(get("cont")))
+                    return out
+                if isinstance(s, ast.Return):
+                    f = new_flag("ret")
+                    ret_sites.append((f, s.value or ast.Constant(None)))
+                    out.append(assign_true(f))
+                    return out
+                set_here: set = set()
+                if isinstance(s, ast.If) and _has_flow_escape([s],
+                                                              loop=True):
+                    s = ast.If(test=s.test, body=rew(s.body) or [ast.Pass()],
+                               orelse=rew(s.orelse))
+                    out.append(s)
+                    set_here = assigned_flags(s)
+                elif isinstance(s, (ast.While, ast.For)) and any(
+                        isinstance(x, ast.Return) for x in ast.walk(s)):
+                    # nested loop with returns: desugar it, then keep
+                    # rewriting its replacement (whose trailing
+                    # `if flag: return e` re-enters the Return path above,
+                    # migrating the return outward level by level)
+                    repl = self._try_desugar_escapes(s)
+                    if repl is None:       # checked by _can_desugar_escapes
+                        out.append(s)
+                        continue
+                    out.extend(rew(repl + list(stmts[i + 1:])))
+                    return out
+                else:
+                    out.append(s)
+                if set_here and i < len(stmts) - 1:
+                    names = sorted(set_here)
+                    pred = ast.Name(id=names[0], ctx=ast.Load()) \
+                        if len(names) == 1 else ast.BoolOp(
+                            op=ast.Or(),
+                            values=[ast.Name(id=n, ctx=ast.Load())
+                                    for n in names])
+                    guard = ast.If(
+                        test=ast.UnaryOp(op=ast.Not(), operand=pred),
+                        body=rew(list(stmts[i + 1:])) or [ast.Pass()],
+                        orelse=[])
+                    out.append(guard)
+                    return out
+            return out
+
+        body_src = list(node.body)
+        tail = body_src[len(body_src) - keep_tail:] if keep_tail else []
+        if keep_tail:
+            body_src = body_src[:len(body_src) - keep_tail]
+        new_body = (rew(body_src) or [ast.Pass()]) + tail
+        if flags["cont"] is not None:
+            new_body = [ast.Assign(
+                targets=[ast.Name(id=flags["cont"], ctx=ast.Store())],
+                value=ast.Constant(False))] + new_body
+        exit_flags = ([flags["brk"]] if flags["brk"] else []) + \
+            [f for f, _ in ret_sites]
+        test = node.test
+        if exit_flags:
+            test = ast.BoolOp(
+                op=ast.And(),
+                values=[test] + [
+                    ast.UnaryOp(op=ast.Not(),
+                                operand=ast.Name(id=f, ctx=ast.Load()))
+                    for f in exit_flags])
+        inits = [ast.Assign(targets=[ast.Name(id=f, ctx=ast.Store())],
+                            value=ast.Constant(False))
+                 for f in exit_flags + ([flags["cont"]]
+                                        if flags["cont"] else [])]
+        post = [ast.If(test=ast.Name(id=f, ctx=ast.Load()),
+                       body=[ast.Return(value=e)], orelse=[])
+                for f, e in ret_sites]
+        new_loop = ast.While(test=test, body=new_body, orelse=[])
+        result = inits + [new_loop] + post
+        for n in result:
+            ast.copy_location(n, node)
+            ast.fix_missing_locations(n)
+        return result
+
+    def _for_range_to_while(self, node):
+        """range-style ``for`` -> ([init stmts], While), or None.
+
+        Registers the internal induction var as carry-eligible and, when
+        the loop var is unbound before the loop, its typed lax fallback."""
+        if not self._is_range_for(node):
+            return None
+        args = node.iter.args
         if len(args) == 1:
             start, stop, step = ast.Constant(0), args[0], ast.Constant(1)
         elif len(args) == 2:
@@ -727,14 +953,7 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         for n in init + [loop]:
             ast.copy_location(n, node)
             ast.fix_missing_locations(n)
-        rewritten = []
-        for n in init:
-            rewritten.append(n)
-            self.bound_names.update(_assigned_names([n]))
-        res = self.visit(loop)
-        self._undef_fallbacks.pop(ivar, None)
-        rewritten.extend(res if isinstance(res, list) else [res])
-        return rewritten
+        return init, loop
 
 
 def _tp():
@@ -766,11 +985,14 @@ def _name_tuple_or_undefined(names, bound, fallbacks=None):
         if n in bound:
             elts.append(ast.Name(id=n, ctx=ast.Load()))
         elif fallbacks and n in fallbacks:
+            fb = fallbacks[n]
+            fb_node = ast.Name(id=fb, ctx=ast.Load()) \
+                if isinstance(fb, str) else fb
             elts.append(ast.Call(
                 func=ast.Attribute(
                     value=ast.Name(id=_JST, ctx=ast.Load()),
                     attr="undef_or", ctx=ast.Load()),
-                args=[ast.Name(id=fallbacks[n], ctx=ast.Load())],
+                args=[fb_node],
                 keywords=[]))
         else:
             elts.append(ast.Attribute(

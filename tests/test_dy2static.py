@@ -367,3 +367,167 @@ def test_for_range_tensor_bound_loop_var_after_loop():
     x = _t([1.0, 1.5])
     got = static_f(x, _t(4, "int32"))
     np.testing.assert_allclose(got.numpy(), 4 * x.numpy() + 3, rtol=1e-6)
+
+
+class TestFlowEscapeConversion:
+    """break/continue/return under tensor predicates (VERDICT r2 #4; ref
+    break_continue_transformer.py / return_transformer.py guard-flag
+    trick retargeted at the lax carry)."""
+
+    def _check(self, f, *args):
+        # value parity only: grads through lax.while_loop are not
+        # reverse-differentiable in jax (dynamic trip count) — same
+        # limitation as every converted tensor-pred while, escape or not
+        from paddle_hackathon_tpu import jit
+        static_f = jit.to_static(f)
+        want = f(*args)
+        got = static_f(*args)
+        np.testing.assert_allclose(got.numpy(), want.numpy(), rtol=1e-6)
+
+    def test_tensor_pred_break(self):
+        def f(x):
+            s = x * 0
+            i = _t(0.0)
+            while i < 10:
+                s = s + x
+                if (s.sum() > 5):      # tensor predicate
+                    break
+                i = i + 1
+            return s
+
+        self._check(f, _t([1.0, 2.0]))
+
+    def test_tensor_pred_continue(self):
+        def f(x):
+            s = x * 0
+            i = _t(0.0)
+            while i < 6:
+                i = i + 1
+                if (i.sum() % 2 < 1):   # tensor predicate: skip evens
+                    continue
+                s = s + x * i
+            return s                    # adds x*1 + x*3 + x*5 = 9x
+
+        self._check(f, _t([1.0, 0.5]))
+
+    def test_tensor_pred_early_return(self):
+        def f(x):
+            s = x * 0
+            i = _t(0.0)
+            while i < 10:
+                s = s + x
+                if (s.sum() > 5):
+                    return s * 100      # mid-function return, tensor pred
+                i = i + 1
+            return s
+
+        self._check(f, _t([1.0, 2.0]))
+        self._check(f, _t([0.1, 0.1]))  # never-taken branch
+
+    def test_for_range_tensor_break(self):
+        def f(x, n):
+            s = x * 0
+            for i in range(n):
+                if (s.sum() > 4):
+                    break
+                s = s + x
+            return s
+
+        from paddle_hackathon_tpu import jit
+        static_f = jit.to_static(f)
+        x = _t([1.0, 1.0])
+        got = static_f(x, _t(10, "int32"))
+        # breaks once s.sum() > 4: after 3 adds sum=6 -> 3 adds... check
+        # eager python-range equivalent
+        want = f(x, 10)
+        np.testing.assert_allclose(got.numpy(), want.numpy(), rtol=1e-6)
+
+    def test_break_and_continue_same_loop(self):
+        def f(x):
+            s = x * 0
+            i = _t(0.0)
+            while i < 20:
+                i = i + 1
+                if (i.sum() % 3 < 1):
+                    continue
+                if (i.sum() > 7):
+                    break
+                s = s + x * i
+            return s        # i=1,2,4,5,7: stops at 8>7 -> 1+2+4+5+7 = 19
+
+        self._check(f, _t([1.0]))
+
+    def test_return_in_nested_loop(self):
+        def f(x):
+            s = x * 0
+            i = _t(0.0)
+            j = _t(0.0)   # loop-carried locals bound before the loops
+            while i < 4:
+                j = j * 0
+                while j < 4:
+                    s = s + x
+                    if (s.sum() > 6):
+                        return s        # exits BOTH loops
+                    j = j + 1
+                i = i + 1
+            return s - 1
+
+        self._check(f, _t([1.0, 1.0]))
+        self._check(f, _t([0.1, 0.1]))
+
+    def test_python_pred_break_unchanged(self):
+        def f(x):
+            s = x * 0
+            for i in range(10):         # python range, python pred
+                if i >= 3:
+                    break
+                s = s + x
+            return s
+
+        from paddle_hackathon_tpu import jit
+        static_f = jit.to_static(f)
+        np.testing.assert_allclose(static_f(_t([1.0])).numpy(), [3.0])
+
+    def test_statements_after_flag_are_guarded(self):
+        def f(x):
+            s = x * 0
+            i = _t(0.0)
+            while i < 5:
+                if (i.sum() > 2):
+                    break
+                s = s + x               # must NOT run after break
+                i = i + 1
+            return s + i * 10
+
+        self._check(f, _t([1.0]))
+
+
+def test_for_range_continue_advances_induction_var():
+    """Review regression: the continue guard must not swallow the
+    for-range induction increment (would loop forever)."""
+    def f(x):
+        s = x * 0
+        for i in range(6):
+            if (_t(float(0)).sum() + i) % 2 < 1:   # python-ish but converted
+                continue
+            s = s + x
+        return s
+
+    from paddle_hackathon_tpu import jit
+    static_f = jit.to_static(f)
+    np.testing.assert_allclose(static_f(_t([1.0])).numpy(), [3.0])
+
+
+def test_for_range_tensor_pred_continue():
+    def f(x, n):
+        s = x * 0
+        for i in range(n):
+            if (x.sum() * 0 + i) % 2 < 1:   # tensor predicate: skip evens
+                continue
+            s = s + x
+        return s
+
+    from paddle_hackathon_tpu import jit
+    static_f = jit.to_static(f)
+    got = static_f(_t([1.0]), _t(6, "int32"))
+    np.testing.assert_allclose(got.numpy(), [3.0])
