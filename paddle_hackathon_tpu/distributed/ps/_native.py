@@ -107,3 +107,14 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.pht_ps_geo_pull_diff.restype = c.c_int64
     lib.pht_ps_geo_register.argtypes = [c.c_void_p, c.c_uint32, c.c_uint32]
     lib.pht_ps_geo_register.restype = c.c_int32
+    u32p = c.POINTER(c.c_uint32)
+    lib.pht_ps_graph_add_edges.argtypes = [c.c_void_p, c.c_uint32, u64p,
+                                           u64p, c.c_uint32]
+    lib.pht_ps_graph_add_edges.restype = c.c_int32
+    lib.pht_ps_graph_sample_neighbors.argtypes = [
+        c.c_void_p, c.c_uint32, u64p, c.c_uint32, c.c_uint32, c.c_uint64,
+        u64p, u32p]
+    lib.pht_ps_graph_sample_neighbors.restype = c.c_int64
+    lib.pht_ps_graph_random_nodes.argtypes = [c.c_void_p, c.c_uint32,
+                                              c.c_uint32, c.c_uint64, u64p]
+    lib.pht_ps_graph_random_nodes.restype = c.c_int64

@@ -317,6 +317,86 @@ class PsClient:
             return (np.empty(0, np.uint64), np.empty((0, dim), np.float32))
         return np.concatenate(all_ids), np.concatenate(all_rows)
 
+    # -- graph table (ref common_graph_table.cc: node/edge storage +
+    #    neighbor-sampling RPCs for graph learning) -----------------------
+
+    def graph_add_edges(self, table_id: int, src, dst) -> None:
+        """Add directed edges src[i] -> dst[i]; edges shard by SOURCE id
+        (the same hash routing as sparse rows).  Add the reverse edge
+        yourself for undirected graphs.  Node features live in the same
+        table's sparse rows (pull/push_sparse on node ids)."""
+        src = np.ascontiguousarray(np.asarray(src, np.uint64).reshape(-1))
+        dst = np.ascontiguousarray(np.asarray(dst, np.uint64).reshape(-1))
+        if src.size != dst.size:
+            raise ValueError("src and dst must have equal length")
+        for s, idx in enumerate(self._route(src)):
+            if idx.size == 0:
+                continue
+            a = np.ascontiguousarray(src[idx])
+            b = np.ascontiguousarray(dst[idx])
+            c = self._conns[s]
+            with c._lock:
+                rc = c._lib.pht_ps_graph_add_edges(
+                    c._h, table_id, _u64p(a), _u64p(b), idx.size)
+            if rc != 0:
+                raise RuntimeError(f"graph_add_edges failed on server {s}")
+
+    def graph_sample_neighbors(self, table_id: int, ids, k: int,
+                               seed: int = 0):
+        """Sample up to ``k`` neighbors per node WITHOUT replacement,
+        deterministic under (seed, node id) regardless of which client
+        asks.  Returns (neighbors [n, k] uint64, counts [n] int32); rows
+        are valid up to their count."""
+        ids = np.ascontiguousarray(np.asarray(ids, np.uint64).reshape(-1))
+        n = ids.size
+        neighbors = np.zeros((n, k), np.uint64)
+        counts = np.zeros(n, np.int32)
+        import ctypes as ct
+        for s, idx in enumerate(self._route(ids)):
+            if idx.size == 0:
+                continue
+            sub = np.ascontiguousarray(ids[idx])
+            nb = np.zeros((idx.size, k), np.uint64)  # tail beyond count = 0
+            cn = np.empty(idx.size, np.uint32)
+            c = self._conns[s]
+            with c._lock:
+                rc = c._lib.pht_ps_graph_sample_neighbors(
+                    c._h, table_id, _u64p(sub), idx.size, k, seed,
+                    _u64p(nb), cn.ctypes.data_as(ct.POINTER(ct.c_uint32)))
+            if rc == -3:
+                raise KeyError(f"graph table {table_id} does not exist on "
+                               f"server {s} (create_table first)")
+            if rc < 0:
+                raise RuntimeError(
+                    f"graph_sample_neighbors failed on server {s}")
+            neighbors[idx] = nb
+            counts[idx] = cn.astype(np.int32)
+        return neighbors, counts
+
+    def graph_random_nodes(self, table_id: int, k: int, seed: int = 0):
+        """Up to ``k`` distinct node ids sampled across all servers,
+        deterministic under seed."""
+        per = []
+        for s, c in enumerate(self._conns):
+            out = np.empty(k, np.uint64)
+            with c._lock:
+                rc = c._lib.pht_ps_graph_random_nodes(c._h, table_id, k,
+                                                      seed, _u64p(out))
+            if rc == -3:
+                raise KeyError(f"graph table {table_id} does not exist on "
+                               f"server {s} (create_table first)")
+            if rc < 0:
+                raise RuntimeError(f"graph_random_nodes failed on {s}")
+            per.append(out[:int(rc)])
+        allnodes = np.sort(np.concatenate(per)) if per else \
+            np.empty(0, np.uint64)
+        if allnodes.size <= k:
+            return allnodes
+        # deterministic client-side subsample of the per-server samples
+        r = np.random.RandomState(seed & 0x7FFFFFFF)
+        pick = r.choice(allnodes.size, size=k, replace=False)
+        return allnodes[np.sort(pick)]
+
     def save(self, dirname: str) -> None:
         os.makedirs(dirname, exist_ok=True)
         for s, c in enumerate(self._conns):

