@@ -79,27 +79,40 @@ def device_time(fn, *args, reps=20):
 
 
 def build_ops():
-    r = np.random.RandomState(0)
+    # ALL inputs are generated ON DEVICE (jax.random): materializing these
+    # ~3 GB of operands host-side and pushing them through the axon tunnel
+    # stalls for many minutes before the first op even compiles
+    _key_iter = iter(jax.random.split(jax.random.key(0), 40))
+
+    def _rnd(shape, dtype=jnp.float32):
+        return jax.jit(
+            lambda k: jax.random.normal(k, shape, jnp.float32).astype(dtype)
+        )(next(_key_iter))
+
+    def _rint(shape, hi):
+        return jax.jit(
+            lambda k: jax.random.randint(k, shape, 0, hi, jnp.int32)
+        )(next(_key_iter))
     # elementwise workhorse shape: big enough that per-call dispatch noise
     # vanishes under the op (~6 ms/pass f32)
-    x4 = jnp.asarray(r.randn(16, 128, 257, 257), jnp.float32)
-    x4b = jnp.asarray(r.randn(16, 128, 257, 257), jnp.bfloat16)
-    m1 = jnp.asarray(r.randn(1024, 1024), jnp.float32)
-    m2 = jnp.asarray(r.randn(1024, 1024), jnp.float32)
+    x4 = _rnd((16, 128, 257, 257), jnp.float32)
+    x4b = _rnd((16, 128, 257, 257), jnp.bfloat16)
+    m1 = _rnd((1024, 1024), jnp.float32)
+    m2 = _rnd((1024, 1024), jnp.float32)
     # model-shaped matmuls (gpt2 ffn / vocab head, bf16 MXU path)
-    a_tok = jnp.asarray(r.randn(8192, 768), jnp.bfloat16)
-    w_ffn = jnp.asarray(r.randn(768, 3072), jnp.bfloat16)
-    w_voc = jnp.asarray(r.randn(768, 50304), jnp.bfloat16)
-    img = jnp.asarray(r.randn(32, 64, 56, 56), jnp.float32)
-    ker = jnp.asarray(r.randn(64, 64, 3, 3), jnp.float32)
-    ker1 = jnp.asarray(r.randn(256, 64, 1, 1), jnp.float32)
-    imgb = jnp.asarray(r.randn(64, 256, 56, 56), jnp.bfloat16)
-    kerb = jnp.asarray(r.randn(64, 256, 1, 1), jnp.bfloat16)
-    seq = jnp.asarray(r.randn(32, 1024, 768), jnp.float32)
-    logits = jnp.asarray(r.randn(8192, 50304), jnp.float32)
-    lab = jnp.asarray(r.randint(0, 50304, (8192,)), jnp.int32)
-    emb = jnp.asarray(r.randn(50304, 768), jnp.float32)
-    ids = jnp.asarray(r.randint(0, 50304, (32, 1024)), jnp.int32)
+    a_tok = _rnd((8192, 768), jnp.bfloat16)
+    w_ffn = _rnd((768, 3072), jnp.bfloat16)
+    w_voc = _rnd((768, 50304), jnp.bfloat16)
+    img = _rnd((32, 64, 56, 56), jnp.float32)
+    ker = _rnd((64, 64, 3, 3), jnp.float32)
+    ker1 = _rnd((256, 64, 1, 1), jnp.float32)
+    imgb = _rnd((64, 256, 56, 56), jnp.bfloat16)
+    kerb = _rnd((64, 256, 1, 1), jnp.bfloat16)
+    seq = _rnd((32, 1024, 768), jnp.float32)
+    logits = _rnd((8192, 50304), jnp.float32)
+    lab = _rint((8192,), 50304)
+    emb = _rnd((50304, 768), jnp.float32)
+    ids = _rint((32, 1024), 50304)
     key = jax.random.key(0)
 
     def conv(x, k, stride=1):
@@ -213,14 +226,14 @@ def build_ops():
             lambda x: jnp.where(
                 jnp.arange(x.shape[-1])[None, :]
                 <= jnp.arange(x.shape[-2])[:, None], x, -1e30),
-            (jnp.asarray(r.randn(1024, 1024), jnp.float32),),
+            (_rnd((1024, 1024), jnp.float32),),
             "causal mask [1024, 1024]", False),
     })
 
     # the perf-critical Pallas kernel itself
     from paddle_hackathon_tpu.incubate.nn.kernels import (
         flash_attention_packed as fap)
-    qkv = jnp.asarray(r.randn(8, 1024, 3 * 768), jnp.bfloat16) * 0.1
+    qkv = _rnd((8, 1024, 3 * 768), jnp.bfloat16) * 0.1
     ops["flash_attention_packed"] = (
         lambda x: fap.flash_attention_packed(x, 12, True, 0.125), (qkv,),
         "packed qkv bf16 [8, 1024, 2304] causal", True)
@@ -232,13 +245,11 @@ def main():
     rows = []
     stamp = time.strftime("%Y.%m%d.%H%M%S") + ".tpu-v5e"
 
-    # Pre-compile with a couple of concurrent workers: through the axon
-    # tunnel the remote compile round-trip dominates the whole sweep
-    # (the compile helper degrades under heavier parallelism).
-    from concurrent.futures import ThreadPoolExecutor
-    jobs = {}
+    # compiles happen serially on first call inside device_time — threaded
+    # pre-compilation deadlocks the remote compile helper
     for name, (fn, args, cfg, diff) in ops.items():
-        jobs[name] = (jax.jit(fn), None, args, cfg, diff)
+        fwd = device_time(fn, *args)
+        bwd = 0.0
         if diff:
             def loss(*a, _fn=fn):
                 out = _fn(*a)
@@ -246,22 +257,7 @@ def main():
             darg = tuple(i for i, a in enumerate(args)
                          if jnp.issubdtype(a.dtype, jnp.floating))
             if darg:
-                jobs[name] = (jobs[name][0],
-                              jax.jit(jax.grad(loss, argnums=darg)),
-                              args, cfg, diff)
-
-    def warm(entry):
-        jfwd, jbwd, args, _, _ = entry
-        jfwd.lower(*args).compile()
-        if jbwd is not None:
-            jbwd.lower(*args).compile()
-
-    with ThreadPoolExecutor(max_workers=2) as ex:
-        list(ex.map(warm, jobs.values()))
-
-    for name, (jfwd, jbwd, args, cfg, diff) in jobs.items():
-        fwd = device_time(jfwd, *args)
-        bwd = device_time(jbwd, *args) if jbwd is not None else 0.0
+                bwd = device_time(jax.grad(loss, argnums=darg), *args)
         rows.append({
             "name": f"{name}_0",
             "op": name,
