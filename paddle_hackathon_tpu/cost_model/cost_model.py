@@ -84,8 +84,18 @@ class CostModel:
         if self._static_cost_data is None:
             self.static_cost_data()
         op_cost = {}
+        # configs carry either the reference's long dtype spelling
+        # ("dtype: float32") or this build's compact form ("x f32 [...]",
+        # tools/gen_op_benchmark.py) — match both.  Word-bounded so
+        # "f16" never matches inside "bf16".
+        import re
+        short = {"float32": "f32", "bfloat16": "bf16", "float16": "f16",
+                 "float64": "f64", "int32": "i32", "int64": "i64"}.get(dtype)
         for op_data in self._static_cost_data:
-            if (op_data["op"] == op_name) and (dtype in op_data["config"]):
+            cfg = op_data["config"]
+            if op_data["op"] == op_name and (
+                    f"dtype: {dtype}" in cfg
+                    or (short and re.search(rf"\b{short}\b", cfg))):
                 if forward:
                     op_cost["op_time"] = op_data["paddle_gpu_time"]
                 else:

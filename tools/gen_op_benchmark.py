@@ -23,6 +23,7 @@ import tempfile
 import time
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 import jax
 import jax.numpy as jnp
@@ -30,23 +31,13 @@ import numpy as np
 
 
 def _trace_device_ms(run, outdir):
+    from trace_util import xla_op_durations_ms
     shutil.rmtree(outdir, ignore_errors=True)
     jax.profiler.start_trace(outdir)
     run()
     jax.profiler.stop_trace()
-    paths = glob.glob(os.path.join(outdir, "**", "*.trace.json.gz"),
-                      recursive=True)
-    if not paths:
-        return None
-    with gzip.open(paths[0], "rt") as fh:
-        trace = json.load(fh)
-    events = trace["traceEvents"]
-    tids = {(e["pid"], e["tid"]): e["args"]["name"] for e in events
-            if e.get("ph") == "M" and e.get("name") == "thread_name"}
-    op_tids = {k for k, v in tids.items() if "XLA Ops" in v}
-    return sum(e.get("dur", 0) for e in events
-               if e.get("ph") == "X"
-               and (e.get("pid"), e.get("tid")) in op_tids) / 1e3
+    durs = xla_op_durations_ms(outdir)
+    return sum(durs.values()) if durs else None
 
 
 def device_time(fn, *args, reps=20):

@@ -9,6 +9,7 @@ import sys
 import collections
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 import jax
 import jax.numpy as jnp
@@ -43,38 +44,20 @@ def main(batch=32, seqlen=1024, outdir="/tmp/trace_step"):
     float(loss)
     jax.profiler.stop_trace()
 
-    path = glob.glob(os.path.join(outdir, "**", "*.trace.json.gz"),
-                     recursive=True)[0]
-    with gzip.open(path, "rt") as f:
-        trace = json.load(f)
-    events = trace["traceEvents"]
-    # find the "XLA Ops" thread id
-    tids = {}
-    for e in events:
-        if e.get("ph") == "M" and e.get("name") == "thread_name":
-            tids[(e["pid"], e["tid"])] = e["args"]["name"]
-    op_tids = {k for k, v in tids.items() if "XLA Ops" in v}
+    from trace_util import xla_op_durations_ms
+    ind = xla_op_durations_ms(outdir)
     agg = collections.Counter()
-    total = 0.0
-    for e in events:
-        if e.get("ph") == "X" and (e.get("pid"), e.get("tid")) in op_tids:
-            name = e["name"]
-            dur = e.get("dur", 0) / 1e3  # us -> ms
-            total += dur
-            # bucket by mnemonic
-            base = name.split(".")[0].rstrip("0123456789_")
-            if "fusion" in name:
-                base = "fusion"
-            agg[base] += dur
+    for name, dur in ind.items():
+        # bucket by mnemonic
+        base = name.split(".")[0].rstrip("0123456789_")
+        if "fusion" in name:
+            base = "fusion"
+        agg[base] += dur
+    total = sum(ind.values())
     print(f"total device op time: {total/3:.2f} ms/step  "
           f"({batch*seqlen*3/ (total/1e3):,.0f} tok/s-equivalent)")
     for name, dur in agg.most_common(30):
         print(f"  {name:40s} {dur/3:8.2f} ms")
-    # top individual ops
-    ind = collections.Counter()
-    for e in events:
-        if e.get("ph") == "X" and (e.get("pid"), e.get("tid")) in op_tids:
-            ind[e["name"]] += e.get("dur", 0) / 1e3
     print("top individual ops:")
     for name, dur in ind.most_common(25):
         print(f"  {name:60s} {dur/3:8.2f} ms")

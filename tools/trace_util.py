@@ -1,0 +1,30 @@
+"""Shared jax-profiler trace parsing: per-op device durations on the
+"XLA Ops" threads.  The trace-file format (thread_name metadata, X events)
+is owned here so the tools that depend on it (trace_step, trace_model,
+gen_op_benchmark) cannot drift apart when the schema changes.
+"""
+import collections
+import glob
+import gzip
+import json
+import os
+
+
+def xla_op_durations_ms(outdir):
+    """Counter of {op name: total device ms} summed over every event on an
+    "XLA Ops" thread in the newest trace under ``outdir``."""
+    paths = glob.glob(os.path.join(outdir, "**", "*.trace.json.gz"),
+                      recursive=True)
+    if not paths:
+        return collections.Counter()
+    with gzip.open(max(paths, key=os.path.getmtime), "rt") as fh:
+        trace = json.load(fh)
+    events = trace["traceEvents"]
+    tids = {(e["pid"], e["tid"]): e["args"]["name"] for e in events
+            if e.get("ph") == "M" and e.get("name") == "thread_name"}
+    op_tids = {k for k, v in tids.items() if "XLA Ops" in v}
+    durs = collections.Counter()
+    for e in events:
+        if e.get("ph") == "X" and (e.get("pid"), e.get("tid")) in op_tids:
+            durs[e["name"]] += e.get("dur", 0) / 1e3
+    return durs
