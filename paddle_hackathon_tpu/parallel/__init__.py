@@ -51,3 +51,4 @@ from .auto_parallel import (Engine, ProcessMesh, shard_op,  # noqa: F401
                             shard_tensor)
 from .store import TCPStore  # noqa: F401
 from .dist_checkpoint import load_sharded, reshard, save_sharded  # noqa: F401
+from .planner import plan_sharding, score_plan  # noqa: F401

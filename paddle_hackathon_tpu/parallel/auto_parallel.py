@@ -439,6 +439,26 @@ class Engine:
         return conv(batch), None
 
     # -- public API ----------------------------------------------------------
+    def plan(self, sample_inputs, axis: str = "mp", score: bool = False):
+        """Auto-derive TP shardings for un-annotated parameters (the
+        reference's Planner/Mapper step, ``auto_parallel/planner.py``):
+        trace the model on ``sample_inputs``, choose column/row/embedding
+        roles from dataflow, optionally score against replication with the
+        compiler, and apply the winning shardings to the model in place.
+        Call before ``prepare``/``fit``. Returns the rule (``rule.plan`` /
+        ``rule.why`` / ``rule.report`` describe the decision)."""
+        from .api import shard_params
+        from .planner import plan_sharding
+
+        sample = sample_inputs if isinstance(sample_inputs, (tuple, list)) \
+            else (sample_inputs,)
+        sample = tuple(a._value if isinstance(a, Tensor) else a
+                       for a in sample)
+        rule = plan_sharding(self.model, self.mesh, sample, axis=axis,
+                             score=score)
+        shard_params(self.model, self.mesh, rule=rule)
+        return rule
+
     def prepare(self, inputs_spec=None, labels_spec=None, mode: str = "train"):
         """Compile the program for ``mode`` (ref ``engine.py:prepare``)."""
         self._prepare_state()

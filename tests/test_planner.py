@@ -1,0 +1,143 @@
+"""Auto-parallel planner (parallel/planner.py): dataflow plan derivation
+(the reference's completion/planner/mapper, ``auto_parallel/planner.py``
+``cost_model.py``) + compiler-measured scoring.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_hackathon_tpu as paddle
+from paddle_hackathon_tpu import nn, parallel
+from paddle_hackathon_tpu.models import GPTConfig, GPTForCausalLM
+from paddle_hackathon_tpu.parallel.planner import plan_sharding, score_plan
+
+
+def _tiny_gpt():
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=256, hidden_size=64, num_layers=2,
+                    num_heads=4, max_position_embeddings=32,
+                    hidden_dropout_prob=0.0, attention_dropout_prob=0.0,
+                    use_flash_attention=False)
+    return GPTForCausalLM(cfg)
+
+
+class TestPlanGPT:
+    def test_reproduces_megatron_alternation(self):
+        """From pure dataflow — no name patterns — the planner must land on
+        the hand-written models/gpt.py::param_sharding_spec plan."""
+        m = _tiny_gpt()
+        mesh = parallel.create_mesh({"dp": 2, "mp": 4})
+        try:
+            rule = plan_sharding(m, mesh, (jnp.zeros((2, 32), jnp.int32),),
+                                 min_shard_elems=1)
+        finally:
+            parallel.set_mesh(None)
+        p = rule.plan
+        for i in range(2):
+            assert p[f"gpt.blocks.{i}.attn.qkv_proj.weight"] == (None, "mp")
+            assert p[f"gpt.blocks.{i}.attn.out_proj.weight"] == ("mp", None)
+            assert p[f"gpt.blocks.{i}.mlp.fc_in.weight"] == (None, "mp")
+            assert p[f"gpt.blocks.{i}.mlp.fc_out.weight"] == ("mp", None)
+            # column biases ride the shard; row biases replicate
+            assert p[f"gpt.blocks.{i}.attn.qkv_proj.bias"] == ("mp",)
+            assert f"gpt.blocks.{i}.attn.out_proj.bias" not in p
+            # LayerNorm params replicate
+            assert f"gpt.blocks.{i}.ln_1.weight" not in p
+        assert p["gpt.wte.weight"] == ("mp", None)
+        # the rule is total: unknown names fall back to replication
+        assert rule("no.such.param", (3, 5)) == (None, None)
+
+    def test_planned_step_matches_replicated(self):
+        mesh = parallel.create_mesh({"dp": 2, "mp": 4})
+        try:
+            paddle.seed(0)
+            m1 = _tiny_gpt()
+            rule = plan_sharding(m1, mesh,
+                                 (jnp.zeros((8, 32), jnp.int32),),
+                                 min_shard_elems=1)
+            step1, st1 = parallel.make_sharded_train_step(
+                m1, mesh, rule=rule, learning_rate=1e-3)
+            m2 = _tiny_gpt()
+            step2, st2 = parallel.make_sharded_train_step(
+                m2, mesh, rule=None, learning_rate=1e-3)
+            rng = np.random.RandomState(0)
+            ids = jnp.asarray(rng.randint(0, 256, (8, 32)), jnp.int32)
+            lab = jnp.asarray(rng.randint(0, 256, (8, 32)), jnp.int32)
+            for _ in range(3):
+                st1, l1 = step1(st1, ids, lab, jax.random.key(7))
+                st2, l2 = step2(st2, ids, lab, jax.random.key(7))
+            np.testing.assert_allclose(float(l1), float(l2), rtol=2e-3)
+        finally:
+            parallel.set_mesh(None)
+
+    def test_score_plan_measures_memory_win(self):
+        """The cost-model analog must report the TP plan's param-memory
+        saving from the actual compiled executable."""
+        mesh = parallel.create_mesh({"dp": 2, "mp": 4})
+        try:
+            m = _tiny_gpt()
+            rule = plan_sharding(m, mesh, (jnp.zeros((8, 32), jnp.int32),),
+                                 min_shard_elems=1)
+            planned = score_plan(m, mesh, rule,
+                                 (jnp.zeros((8, 32), jnp.int32),))
+            repl = score_plan(m, mesh, None,
+                              (jnp.zeros((8, 32), jnp.int32),))
+        finally:
+            parallel.set_mesh(None)
+        assert planned["arg_bytes_per_device"] < repl["arg_bytes_per_device"]
+        assert planned["collective_bytes"] > 0
+        assert "all-reduce" in repl["collectives"]
+
+
+class _PlainMLP(nn.Layer):
+    """Generic names (l0/l1/l2) the GPT hand-rule regexes would never
+    match — the planner must still alternate column/row from dataflow."""
+
+    def __init__(self):
+        super().__init__()
+        self.l0 = nn.Linear(64, 256)
+        self.l1 = nn.Linear(256, 256)
+        self.l2 = nn.Linear(256, 64)
+        self.act = nn.GELU()
+
+    def forward(self, x):
+        return self.l2(self.act(self.l1(self.act(self.l0(x)))))
+
+
+class TestPlanNameFree:
+    def test_mlp_alternates_from_dataflow(self):
+        paddle.seed(0)
+        m = _PlainMLP()
+        mesh = parallel.create_mesh({"dp": 2, "mp": 4})
+        try:
+            rule = plan_sharding(m, mesh,
+                                 (jnp.zeros((4, 64), jnp.float32),),
+                                 min_shard_elems=1)
+        finally:
+            parallel.set_mesh(None)
+        p = rule.plan
+        assert p["l0.weight"] == (None, "mp")   # column
+        assert p["l1.weight"] == ("mp", None)   # row: input sharded
+        assert p["l2.weight"] == (None, "mp")   # column again after psum
+        assert p["l0.bias"] == ("mp",)
+        assert "l1.bias" not in p
+
+    def test_engine_plan_applies_shardings(self):
+        from paddle_hackathon_tpu.parallel.auto_parallel import (Engine,
+                                                                 ProcessMesh)
+        paddle.seed(0)
+        m = _PlainMLP()
+        pm = ProcessMesh(np.arange(8).reshape(2, 4),
+                         dim_names=["dp", "mp"])
+        try:
+            eng = Engine(m, process_mesh=pm)
+            rule = eng.plan(jnp.zeros((4, 64), jnp.float32))
+            assert rule.plan["l0.weight"] == (None, "mp")
+            # params were placed: the column weight is device-sharded on mp
+            w = dict(m.named_parameters())["l0.weight"]._value
+            spec = w.sharding.spec
+            assert tuple(spec) == (None, "mp")
+        finally:
+            parallel.set_mesh(None)

@@ -13,8 +13,6 @@ pipelines fully under the op (BASELINE.md axon-tunnel notes).
 "XLA Ops" thread), which cost seconds per op through the tunnel.
 """
 
-import glob
-import gzip
 import json
 import os
 import shutil

@@ -5,7 +5,6 @@ round-3 notes; wall-clock microbenches through the axon tunnel lie).
 
 Usage: python tools/trace_model.py [resnet|resnet-infer] [batch]
 """
-import collections
 import os
 import shutil
 import sys
@@ -17,19 +16,14 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from trace_util import xla_op_durations_ms
+from trace_util import bucket_by_mnemonic, xla_op_durations_ms
 
 REPS = 3
 
 
 def _aggregate(outdir, reps, norm_label):
     ind = xla_op_durations_ms(outdir)
-    agg = collections.Counter()
-    for name, dur in ind.items():
-        base = name.split(".")[0].rstrip("0123456789_")
-        if "fusion" in name:
-            base = "fusion"
-        agg[base] += dur
+    agg = bucket_by_mnemonic(ind)
     total = sum(ind.values())
     print(f"total device op time: {total / reps:.2f} ms/step ({norm_label})")
     for name, dur in agg.most_common(25):

@@ -1,12 +1,8 @@
 """Trace the gpt2 train step and aggregate per-op durations from the
 profiler's trace (the only trustworthy per-op numbers through the axon
 tunnel — see BASELINE notes; wall-clock microbenches lie)."""
-import glob
-import gzip
-import json
 import os
 import sys
-import collections
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
@@ -44,15 +40,9 @@ def main(batch=32, seqlen=1024, outdir="/tmp/trace_step"):
     float(loss)
     jax.profiler.stop_trace()
 
-    from trace_util import xla_op_durations_ms
+    from trace_util import bucket_by_mnemonic, xla_op_durations_ms
     ind = xla_op_durations_ms(outdir)
-    agg = collections.Counter()
-    for name, dur in ind.items():
-        # bucket by mnemonic
-        base = name.split(".")[0].rstrip("0123456789_")
-        if "fusion" in name:
-            base = "fusion"
-        agg[base] += dur
+    agg = bucket_by_mnemonic(ind)
     total = sum(ind.values())
     print(f"total device op time: {total/3:.2f} ms/step  "
           f"({batch*seqlen*3/ (total/1e3):,.0f} tok/s-equivalent)")

@@ -10,6 +10,18 @@ import json
 import os
 
 
+def bucket_by_mnemonic(durs):
+    """Aggregate per-op durations into mnemonic buckets (fusion, copy,
+    dot, ...) — shared by trace_step and trace_model."""
+    agg = collections.Counter()
+    for name, dur in durs.items():
+        base = name.split(".")[0].rstrip("0123456789_")
+        if "fusion" in name:
+            base = "fusion"
+        agg[base] += dur
+    return agg
+
+
 def xla_op_durations_ms(outdir):
     """Counter of {op name: total device ms} summed over every event on an
     "XLA Ops" thread in the newest trace under ``outdir``."""
