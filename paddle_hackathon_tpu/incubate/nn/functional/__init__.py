@@ -302,20 +302,25 @@ def fused_multi_head_attention(
         pre_ln_epsilon=1e-5, qkv_bias=None, linear_bias=None,
         cache_kv=None, attn_mask=None, dropout_rate=0.5,
         attn_dropout_rate=0.5, ln_epsilon=1e-5, training=True,
-        mode="upscale_in_train", ring_id=-1, add_residual=True, name=None):
+        mode="upscale_in_train", ring_id=-1, add_residual=True,
+        time_step=None, name=None):
     """Functional form of the fused attention block (ref
     fused_attention_op.cu): optional pre-LN -> qkv -> MHA -> out proj ->
-    bias+dropout+residual(+post-LN)."""
+    bias+dropout+residual(+post-LN).
+
+    With ``cache_kv`` (shape (2, batch, heads, max_seq, head_dim), the
+    reference's CacheKV layout) the call runs incremental decoding: this
+    step's k/v are written at ``time_step`` (scalar, default 0 = prefill)
+    and queries attend over every cached position ≤ their global position.
+    Since arrays are immutable here, the updated cache is RETURNED:
+    ``(out, cache_kv_out)`` instead of the reference's in-place write.
+    """
     import math as _math
     h = _t(x)
     residual = h
     if pre_layer_norm:
         h, _ = fused_layer_norm(h, pre_ln_scale, pre_ln_bias,
                                 epsilon=pre_ln_epsilon)
-    if cache_kv is not None:
-        raise NotImplementedError(
-            "cache_kv incremental decoding is not wired in this build; run "
-            "full-sequence attention or use the models' own KV caching")
     qkvw = _t(qkv_weight)  # (3, num_heads, head_dim, embed)
     _, n_heads, head_dim, embed = qkvw.shape
     has_bias = qkv_bias is not None
@@ -344,18 +349,65 @@ def fused_multi_head_attention(
         ctx = jnp.einsum("bhst,bthe->bshe", probs, v)
         return ctx.reshape(ctx.shape[0], ctx.shape[1], -1)
 
+    def qkv_cached_fn(hv, wv, cachev, tstep, *rest):
+        """Incremental decoding against a static (2, B, H, Tmax, D) cache
+        (ref fused_multi_transformer_op.cu decode phase): write this call's
+        k/v at [time_step, time_step+s), attend each query i over key
+        positions j <= time_step + i.  Functional: returns the new cache."""
+        it = iter(rest)
+        b = next(it) if has_bias else None
+        mask = next(it) if has_mask else None
+        q, k, v = (jnp.einsum("bsd,hed->bshe", hv, wv[i])
+                   for i in range(3))
+        if b is not None:
+            q = q + b[0][None, None]
+            k = k + b[1][None, None]
+            v = v + b[2][None, None]
+        t0 = tstep.astype(jnp.int32)
+        kc, vc = cachev[0], cachev[1]                    # (B, H, Tmax, D)
+        k_bh = jnp.swapaxes(k, 1, 2).astype(kc.dtype)    # (B, H, s, D)
+        v_bh = jnp.swapaxes(v, 1, 2).astype(vc.dtype)
+        zero = jnp.zeros((), jnp.int32)
+        kc = jax.lax.dynamic_update_slice(kc, k_bh, (zero, zero, t0, zero))
+        vc = jax.lax.dynamic_update_slice(vc, v_bh, (zero, zero, t0, zero))
+        logits = jnp.einsum("bshe,bhte->bhst", q,
+                            kc.astype(q.dtype)) / _math.sqrt(head_dim)
+        s, t_max = q.shape[1], kc.shape[2]
+        qpos = t0 + jnp.arange(s)[:, None]               # (s, 1) global pos
+        kpos = jnp.arange(t_max)[None, :]
+        logits = jnp.where((kpos <= qpos)[None, None], logits,
+                           jnp.asarray(-1e30, logits.dtype))
+        if mask is not None:
+            logits = logits + mask
+        probs = jax.nn.softmax(logits, -1)
+        probs = _dropout(probs, attn_dropout_rate, drop_key)
+        ctx = jnp.einsum("bhst,bhte->bshe", probs, vc.astype(probs.dtype))
+        return (ctx.reshape(ctx.shape[0], ctx.shape[1], -1),
+                jnp.stack([kc, vc]))
+
+    new_cache = None
     args = [h, qkvw]
+    if cache_kv is not None:
+        ts = time_step if time_step is not None else jnp.asarray(
+            0, jnp.int32)
+        args += [_t(cache_kv), _t(ts)]
     if has_bias:
         args.append(_t(qkv_bias))
     if has_mask:
         args.append(_t(attn_mask))
-    ctx = apply_op("fused_mha_core", qkv_fn, args)
+    if cache_kv is not None:
+        ctx, new_cache = apply_op("fused_mha_core_cached", qkv_cached_fn,
+                                  args, n_outputs=2)
+    else:
+        ctx = apply_op("fused_mha_core", qkv_fn, args)
     out = fused_linear(ctx, linear_weight, linear_bias)
     if add_residual:
         out = fused_dropout_add(out, residual, p=dropout_rate,
                                 training=training, mode=mode)
     if not pre_layer_norm:
         out, _ = fused_layer_norm(out, ln_scale, ln_bias, epsilon=ln_epsilon)
+    if new_cache is not None:
+        return out, new_cache
     return out
 
 
@@ -376,20 +428,24 @@ def fused_multi_transformer(
         from ....ops import manipulation as _M
         qkv_weights = [_M.transpose(_t(w), [1, 2, 3, 0])
                        for w in qkv_weights]
-    if cache_kvs is not None or time_step is not None:
-        raise NotImplementedError(
-            "fused_multi_transformer cache_kvs/time_step decoding is not "
-            "wired in this build")
+    new_caches = [] if cache_kvs is not None else None
     for i in range(n_layers):
-        h = fused_multi_head_attention(
+        att = fused_multi_head_attention(
             h, qkv_weights[i], linear_weights[i],
             pre_layer_norm=pre_layer_norm,
             pre_ln_scale=ln_scales[i] if ln_scales else None,
             pre_ln_bias=ln_biases[i] if ln_biases else None,
             qkv_bias=qkv_biases[i] if qkv_biases else None,
             linear_bias=linear_biases[i] if linear_biases else None,
+            cache_kv=cache_kvs[i] if cache_kvs is not None else None,
+            time_step=time_step,
             attn_mask=attn_mask, dropout_rate=dropout_rate,
             attn_dropout_rate=dropout_rate, training=training, mode=mode)
+        if cache_kvs is not None:
+            h, cache_i = att
+            new_caches.append(cache_i)
+        else:
+            h = att
         h = fused_feedforward(
             h, ffn1_weights[i], ffn1_biases[i] if ffn1_biases else None,
             ffn2_weights[i], ffn2_biases[i] if ffn2_biases else None,
@@ -398,7 +454,7 @@ def fused_multi_transformer(
             dropout1_rate=dropout_rate, dropout2_rate=dropout_rate,
             activation=activation, pre_layer_norm=pre_layer_norm,
             training=training)
-    return h, cache_kvs
+    return h, (new_caches if new_caches is not None else cache_kvs)
 
 
 __all__ += ["fused_matmul_bias", "fused_bias_dropout_residual_layer_norm",

@@ -50,6 +50,16 @@ class FusedMultiHeadAttention(Layer):
         self.ln_bias = self.create_parameter(
             [embed_dim], attr=ln_bias_attr, is_bias=True)
 
+    def gen_cache(self, key, value=None):
+        """Empty growing Cache for incremental decoding (same protocol as
+        ``nn.MultiHeadAttention.gen_cache``; the fused qkv computes k/v
+        from the query, so only the growing-Cache type applies)."""
+        from ....nn.layers.transformer import MultiHeadAttention as _MHA
+        from ....ops import creation
+        b = key.shape[0]
+        z = creation.zeros([b, 0, self.num_heads, self.head_dim], key.dtype)
+        return _MHA.Cache(z, z)
+
     def forward(self, query, key=None, value=None, attn_mask=None, cache=None):
         x = query
         residual = x
@@ -63,6 +73,15 @@ class FusedMultiHeadAttention(Layer):
         q = M.squeeze(M.slice(qkv, [2], [0], [1]), axis=[2])
         k = M.squeeze(M.slice(qkv, [2], [1], [2]), axis=[2])
         v = M.squeeze(M.slice(qkv, [2], [2], [3]), axis=[2])
+        new_cache = None
+        if cache is not None:
+            from ....nn.layers.transformer import MultiHeadAttention as _MHA
+            if isinstance(cache, _MHA.StaticCache):
+                k, v = cache.k, cache.v
+            else:
+                k = M.concat([cache.k, k], axis=1)
+                v = M.concat([cache.v, v], axis=1)
+                new_cache = _MHA.Cache(k, v)
         from ....nn import functional as F
         out = F.scaled_dot_product_attention(
             q, k, v, attn_mask=attn_mask,
@@ -79,6 +98,8 @@ class FusedMultiHeadAttention(Layer):
                 out, self.ln_scale, self.ln_bias, epsilon=self.epsilon,
                 residual=residual, bias=self.linear_bias,
                 dropout_rate=self.dropout_rate, training=self.training)
+        if new_cache is not None:
+            return out, new_cache
         return out
 
     def extra_repr(self):
@@ -150,8 +171,15 @@ class FusedTransformerEncoderLayer(Layer):
             normalize_before=normalize_before)
 
     def forward(self, src, src_mask=None, cache=None):
+        if cache is not None:
+            out, new_cache = self.fused_attn(src, attn_mask=src_mask,
+                                             cache=cache)
+            return self.ffn(out), new_cache
         out = self.fused_attn(src, attn_mask=src_mask)
         return self.ffn(out)
+
+    def gen_cache(self, src):
+        return self.fused_attn.gen_cache(src)
 
 
 class FusedMultiTransformer(Layer):
@@ -172,9 +200,18 @@ class FusedMultiTransformer(Layer):
 
     def forward(self, src, attn_mask=None, caches=None):
         out = src
+        if caches is not None:
+            new_caches = []
+            for layer, c in zip(self.layers, caches):
+                out, nc = layer(out, src_mask=attn_mask, cache=c)
+                new_caches.append(nc)
+            return out, new_caches
         for layer in self.layers:
             out = layer(out, src_mask=attn_mask)
         return out
+
+    def gen_cache(self, src):
+        return [layer.gen_cache(src) for layer in self.layers]
 
 
 __all__ = ["FusedMultiHeadAttention", "FusedFeedForward",

@@ -141,8 +141,47 @@ class GRUCell(_RNNCellBase):
         return (self.hidden_size,)
 
 
+def _valid_window_reverse(x_tm, seq):
+    """Reverse each batch row of time-major ``x_tm`` WITHIN its valid
+    length: out[t, b] = x[L_b - 1 - t, b] for t < L_b (the reference's
+    reverse-RNN semantics with sequence_length — the padded tail is not
+    read into the recurrence)."""
+    def fn(xv, sv):
+        T = xv.shape[0]
+        t = jnp.arange(T)[:, None]
+        idx = jnp.clip(sv[None, :].astype(jnp.int32) - 1 - t, 0, T - 1)
+        idx = idx.reshape(idx.shape + (1,) * (xv.ndim - 2))
+        return jnp.take_along_axis(xv, idx, axis=0)
+    return apply_op("rnn_seq_reverse", fn, [_t(x_tm), _t(seq)])
+
+
+def _step_mask(seq, t, dtype):
+    """(batch, 1) float mask: 1 where step ``t`` is inside the sequence."""
+    def fn(sv, tv):
+        return (sv.astype(jnp.int32) > tv).astype(dtype)[:, None]
+    return apply_op("rnn_step_mask", fn,
+                    [_t(seq), _t(jnp.asarray(t, jnp.int32))])
+
+
+def _mask_states(new_states, old_states, m):
+    """new*m + old*(1-m) over a (possibly nested) state pytree — states
+    freeze once a row's sequence has ended (ref: the per-step mask the
+    cudnn path applies via sequence_length)."""
+    if isinstance(new_states, (tuple, list)):
+        return type(new_states)(
+            _mask_states(n, o, m) for n, o in zip(new_states, old_states))
+    return new_states * m + old_states * (1 - m)
+
+
 class RNN(Layer):
-    """Wrap a cell into a (scan-compiled) recurrence over the time axis."""
+    """Wrap a cell into a (scan-compiled) recurrence over the time axis.
+
+    ``sequence_length`` (shape [batch]) gives per-row valid lengths:
+    outputs beyond a row's length are zeroed, its states freeze at the
+    last valid step, and a reverse RNN consumes the row reversed within
+    the valid window — static shapes throughout (TPU-friendly masking in
+    place of the reference's cudnn variable-length path).
+    """
 
     def __init__(self, cell, is_reverse=False, time_major=False):
         super().__init__()
@@ -153,18 +192,43 @@ class RNN(Layer):
     def forward(self, inputs, initial_states=None, sequence_length=None):
         from ...ops import manipulation as M
         x = inputs if self.time_major else M.transpose(inputs, [1, 0, 2])
-        if self.is_reverse:
+        seq = None
+        if sequence_length is not None:
+            seq = _t(sequence_length)
+            if self.is_reverse:
+                x = _valid_window_reverse(x, seq)
+        elif self.is_reverse:
             x = M.flip(x, [0])
         steps = x.shape[0]
         outs = []
         states = initial_states
         for t in range(steps):
-            out, states = self.cell(x[t], states)
+            out, new_states = self.cell(x[t], states)
+            if seq is not None:
+                m = _step_mask(seq, t, out.dtype)
+                out = out * m
+                states = new_states if states is None \
+                    else _mask_states(new_states, states, m)
+            else:
+                states = new_states
             outs.append(out)
         from ...ops import manipulation
         out_seq = manipulation.stack(outs, axis=0)
         if self.is_reverse:
-            out_seq = M.flip(out_seq, [0])
+            if seq is not None:
+                # map each output back to its original position; re-mask —
+                # the clipped gather would otherwise copy step 0 into the
+                # padded tail
+                out_seq = _valid_window_reverse(out_seq, seq)
+                out_seq = apply_op(
+                    "rnn_tail_mask",
+                    lambda ov, sv: ov * (jnp.arange(ov.shape[0])[
+                        (...,) + (None,) * (ov.ndim - 1)]
+                        < sv[None, :, None].astype(jnp.int32)
+                    ).astype(ov.dtype),
+                    [out_seq, seq])
+            else:
+                out_seq = M.flip(out_seq, [0])
         if not self.time_major:
             out_seq = M.transpose(out_seq, [1, 0, 2])
         return out_seq, states
@@ -195,10 +259,6 @@ class _RNNBase(Layer):
     def forward(self, inputs, initial_states=None, sequence_length=None):
         from .. import functional as F
         from ...ops import manipulation as M
-        if sequence_length is not None:
-            raise NotImplementedError(
-                "sequence_length masking is not implemented; pad-and-mask at "
-                "the loss instead (static shapes on TPU)")
         x = inputs
         final_states = []
         for layer_i in range(self.num_layers):
@@ -208,11 +268,11 @@ class _RNNBase(Layer):
                 init_f, init_b = (layer_init if self.bidirect
                                   else (layer_init, None))
             fw = RNN(self.fw_cells[layer_i], time_major=self.time_major)
-            out_f, st_f = fw(x, init_f)
+            out_f, st_f = fw(x, init_f, sequence_length)
             if self.bidirect:
                 bw = RNN(self.bw_cells[layer_i], is_reverse=True,
                          time_major=self.time_major)
-                out_b, st_b = bw(x, init_b)
+                out_b, st_b = bw(x, init_b, sequence_length)
                 x = M.concat([out_f, out_b], axis=-1)
                 final_states.append((st_f, st_b))
             else:
@@ -261,10 +321,6 @@ class BiRNN(Layer):
 
     def forward(self, inputs, initial_states=None, sequence_length=None):
         from ...ops import manipulation as M
-        if sequence_length is not None:
-            raise NotImplementedError(
-                "BiRNN with sequence_length (variable-length flip) is not "
-                "supported; mask or bucket the batch instead")
         if initial_states is None:
             fw0 = bw0 = None
         else:

@@ -235,7 +235,8 @@ def make_sharded_train_step(model: Layer, mesh: Mesh,
                             grad_clip_norm: Optional[float] = 1.0,
                             recompute: bool = False,
                             recompute_policy: Optional[str] = None,
-                            pp_microbatches: Optional[int] = None):
+                            pp_microbatches: Optional[int] = None,
+                            moment_dtype=None):
     """Build (step_fn, state) — one compiled SPMD program per step covering
     forward, backward, grad psum over dp, Adam update on (optionally
     'sharding'-sharded) optimizer state.
@@ -326,10 +327,15 @@ def make_sharded_train_step(model: Layer, mesh: Mesh,
             spec = list(_shard_spec_for(arr.shape, mesh, existing=spec))
         return NamedSharding(mesh, P(*spec))
 
+    # moment_dtype=jnp.bfloat16 stores Adam m/v in bf16 (compute stays
+    # f32) — optax mu_dtype-style; on HBM-bound updates this cuts the
+    # optimizer's traffic by ~8 bytes/param and frees 8 bytes/param of
+    # capacity.  Default f32 matches the reference's fused adam exactly.
+    mdt = jnp.float32 if moment_dtype is None else jnp.dtype(moment_dtype)
     opt_state = {
-        k: {"m": jax.device_put(jnp.zeros(v.shape, jnp.float32),
+        k: {"m": jax.device_put(jnp.zeros(v.shape, mdt),
                                 opt_state_spec(k, v)),
-            "v": jax.device_put(jnp.zeros(v.shape, jnp.float32),
+            "v": jax.device_put(jnp.zeros(v.shape, mdt),
                                 opt_state_spec(k, v)),
             }
         for k, v in params.items()}
@@ -376,17 +382,14 @@ def make_sharded_train_step(model: Layer, mesh: Mesh,
                 for g in jax.tree.leaves(grads)))
             scale = grad_clip_norm / jnp.maximum(gnorm, grad_clip_norm)
             grads = jax.tree.map(lambda g: g * scale.astype(g.dtype), grads)
-        t = (step_no + 1).astype(jnp.float32)
+        t = step_no + 1
         new_params, new_opt = {}, {}
+        from ..optimizer.optimizers import adam_update
         for k in params:
-            g = grads[k].astype(jnp.float32)
-            m = b1 * opt_state[k]["m"] + (1 - b1) * g
-            v = b2 * opt_state[k]["v"] + (1 - b2) * jnp.square(g)
-            mhat = m / (1 - b1 ** t)
-            vhat = v / (1 - b2 ** t)
-            upd = lr * mhat / (jnp.sqrt(vhat) + eps)
-            new_params[k] = (params[k].astype(jnp.float32) - upd).astype(
-                params[k].dtype)
+            new_v, m, v = adam_update(params[k], grads[k],
+                                      opt_state[k]["m"], opt_state[k]["v"],
+                                      lr, t, b1, b2, eps, mdt)
+            new_params[k] = new_v.astype(params[k].dtype)
             new_opt[k] = {"m": m, "v": v}
         return new_params, new_opt, step_no + 1, loss
 

@@ -1,0 +1,133 @@
+"""Variable-length RNN via sequence_length (ref ``nn/layer/rnn.py`` cudnn
+sequence_length path; here TPU-static masking — outputs zeroed past each
+row's length, states frozen at the last valid step, reverse direction
+consumes the valid window reversed).
+
+Oracle: run the same cell on the truncated row alone and compare.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_hackathon_tpu as paddle
+from paddle_hackathon_tpu import nn
+from paddle_hackathon_tpu.core.tensor import Tensor
+from paddle_hackathon_tpu.nn.layers.rnn import RNN
+
+
+@pytest.fixture()
+def data():
+    rng = np.random.RandomState(0)
+    x = rng.randn(3, 6, 4).astype("float32")      # (B, T, F)
+    lens = np.asarray([6, 3, 1], "int64")
+    return x, lens
+
+
+def _run_rows(cell, x, lens, reverse=False):
+    """Oracle: per-row truncated run, no masking machinery."""
+    outs = np.zeros((x.shape[0], x.shape[1], cell.hidden_size), "float32")
+    finals = []
+    for b, L in enumerate(lens):
+        row = x[b:b + 1, :L]
+        if reverse:
+            row = row[:, ::-1].copy()
+        r = RNN(cell)
+        o, st = r(Tensor(row))
+        o = np.asarray(o.numpy())
+        if reverse:
+            o = o[:, ::-1]
+        outs[b, :L] = o[0]
+        finals.append(st)
+    return outs, finals
+
+
+def _state_leaf(st):
+    return st[0] if isinstance(st, tuple) else st
+
+
+class TestForwardSeqLen:
+    def test_outputs_and_final_states(self, data):
+        x, lens = data
+        paddle.seed(1)
+        cell = nn.GRUCell(4, 5)
+        oracle_out, oracle_fin = _run_rows(cell, x, lens)
+        r = RNN(cell)
+        out, st = r(Tensor(x), sequence_length=Tensor(lens))
+        out = np.asarray(out.numpy())
+        np.testing.assert_allclose(out, oracle_out, rtol=1e-5, atol=1e-5)
+        # padded tail is exactly zero
+        assert np.all(out[1, 3:] == 0) and np.all(out[2, 1:] == 0)
+        # final state = state at each row's last valid step
+        for b in range(3):
+            np.testing.assert_allclose(
+                np.asarray(_state_leaf(st).numpy())[b],
+                np.asarray(_state_leaf(oracle_fin[b]).numpy())[0],
+                rtol=1e-5, atol=1e-5)
+
+    def test_lstm_tuple_states_freeze(self, data):
+        x, lens = data
+        paddle.seed(2)
+        cell = nn.LSTMCell(4, 5)
+        oracle_out, oracle_fin = _run_rows(cell, x, lens)
+        r = RNN(cell)
+        out, (h, c) = r(Tensor(x), sequence_length=Tensor(lens))
+        np.testing.assert_allclose(np.asarray(out.numpy()), oracle_out,
+                                   rtol=1e-5, atol=1e-5)
+        for b in range(3):
+            _, (oh, oc) = (None, oracle_fin[b])
+            np.testing.assert_allclose(np.asarray(c.numpy())[b],
+                                       np.asarray(oc.numpy())[0],
+                                       rtol=1e-5, atol=1e-5)
+
+
+class TestReverseSeqLen:
+    def test_valid_window_reversal(self, data):
+        """Reverse RNN must consume x[L-1..0], not the padded tail."""
+        x, lens = data
+        paddle.seed(3)
+        cell = nn.GRUCell(4, 5)
+        oracle_out, oracle_fin = _run_rows(cell, x, lens, reverse=True)
+        r = RNN(cell, is_reverse=True)
+        out, st = r(Tensor(x), sequence_length=Tensor(lens))
+        out = np.asarray(out.numpy())
+        np.testing.assert_allclose(out, oracle_out, rtol=1e-5, atol=1e-5)
+        assert np.all(out[2, 1:] == 0)
+        for b in range(3):
+            np.testing.assert_allclose(
+                np.asarray(_state_leaf(st).numpy())[b],
+                np.asarray(_state_leaf(oracle_fin[b]).numpy())[0],
+                rtol=1e-5, atol=1e-5)
+
+
+class TestStacksAndWrappers:
+    def test_multilayer_bidirectional_gru(self, data):
+        x, lens = data
+        paddle.seed(4)
+        m = nn.GRU(4, 5, num_layers=2, direction="bidirect")
+        out, _ = m(Tensor(x), sequence_length=Tensor(lens))
+        out = np.asarray(out.numpy())
+        assert out.shape == (3, 6, 10)
+        assert np.all(out[2, 1:] == 0)          # tail masked in both dirs
+        assert np.any(out[0] != 0)
+
+    def test_birnn_accepts_sequence_length(self, data):
+        x, lens = data
+        paddle.seed(5)
+        b = nn.BiRNN(nn.GRUCell(4, 5), nn.GRUCell(4, 5))
+        out, _ = b(Tensor(x), sequence_length=Tensor(lens))
+        assert list(out.shape) == [3, 6, 10]
+        assert np.all(np.asarray(out.numpy())[2, 1:] == 0)
+
+    def test_gradients_flow_only_through_valid_steps(self, data):
+        x, lens = data
+        paddle.seed(6)
+        cell = nn.SimpleRNNCell(4, 5)
+        r = RNN(cell)
+        xt = Tensor(x, stop_gradient=False)
+        out, _ = r(xt, sequence_length=Tensor(lens))
+        loss = paddle.sum(out * out)
+        loss.backward()
+        g = np.asarray(xt.grad.numpy())
+        # padded inputs of row 2 (len 1) must get zero gradient
+        assert np.all(g[2, 1:] == 0)
+        assert np.any(g[2, 0] != 0)
