@@ -249,7 +249,7 @@ def run_suite():
             try:
                 proc = subprocess.run(
                     [sys.executable, me, "--one", name],
-                    capture_output=True, text=True, timeout=2400)
+                    capture_output=True, text=True, timeout=1500)
             except subprocess.TimeoutExpired:
                 sys.stderr.write(
                     f"suite row {name} attempt {attempt} timed out\n")

@@ -172,9 +172,11 @@ class FusedTransformerEncoderLayer(Layer):
 
     def forward(self, src, src_mask=None, cache=None):
         if cache is not None:
-            out, new_cache = self.fused_attn(src, attn_mask=src_mask,
-                                             cache=cache)
-            return self.ffn(out), new_cache
+            res = self.fused_attn(src, attn_mask=src_mask, cache=cache)
+            if isinstance(res, tuple):           # growing Cache: updated
+                out, new_cache = res
+                return self.ffn(out), new_cache
+            return self.ffn(res)                 # StaticCache: no update
         out = self.fused_attn(src, attn_mask=src_mask)
         return self.ffn(out)
 
@@ -203,7 +205,11 @@ class FusedMultiTransformer(Layer):
         if caches is not None:
             new_caches = []
             for layer, c in zip(self.layers, caches):
-                out, nc = layer(out, src_mask=attn_mask, cache=c)
+                res = layer(out, src_mask=attn_mask, cache=c)
+                if isinstance(res, tuple):
+                    out, nc = res
+                else:                            # StaticCache layer
+                    out, nc = res, c
                 new_caches.append(nc)
             return out, new_caches
         for layer in self.layers:

@@ -99,9 +99,46 @@ class GPTAttention(Layer):
         dtype = qkv._value.dtype if isinstance(qkv, Tensor) else qkv.dtype
         return _fap.supported(s, s, self.num_heads, self.head_dim, dtype)
 
-    def forward(self, x, cache=None):
+    def forward(self, x, cache=None, cache_pos=None):
         b, s, h = x.shape
         qkv = self.qkv_proj(x)
+        if cache_pos is not None:
+            # static-cache decode (jit-once generation): cache is a fixed
+            # (B, max_len, H, D) pair, this call's k/v land at
+            # [cache_pos, cache_pos+s), queries attend over cached
+            # positions <= their global position.  Same masking scheme as
+            # incubate's fused cache_kv path; compiled shapes never change
+            # across decode steps.
+            import math as _math
+
+            import jax
+            import jax.numpy as jnp
+            qkv = ops.reshape(qkv, [b, s, 3, self.num_heads, self.head_dim])
+            q, k, v = ops.unstack(qkv, axis=2)
+
+            def fn(qv, kv, vv, kb, vb, pos):
+                zero = jnp.zeros((), jnp.int32)
+                start = (zero, pos.astype(jnp.int32), zero, zero)
+                kb = jax.lax.dynamic_update_slice(kb, kv.astype(kb.dtype),
+                                                  start)
+                vb = jax.lax.dynamic_update_slice(vb, vv.astype(vb.dtype),
+                                                  start)
+                logits = jnp.einsum("bshe,bthe->bhst", qv,
+                                    kb.astype(qv.dtype))
+                logits = logits / _math.sqrt(qv.shape[-1])
+                qpos = pos.astype(jnp.int32) + jnp.arange(qv.shape[1])[:, None]
+                kpos = jnp.arange(kb.shape[1])[None, :]
+                logits = jnp.where((kpos <= qpos)[None, None], logits,
+                                   jnp.asarray(-1e30, logits.dtype))
+                probs = jax.nn.softmax(logits, -1)
+                ctx = jnp.einsum("bhst,bthe->bshe", probs,
+                                 vb.astype(probs.dtype))
+                return ctx.reshape(ctx.shape[0], ctx.shape[1], -1), kb, vb
+            from ..core.autograd import apply_op
+            out, new_k, new_v = apply_op(
+                "gpt_static_cache_attn", fn,
+                [q, k, v, cache[0], cache[1], cache_pos], n_outputs=3)
+            return self.out_proj(out), (new_k, new_v)
         if cache is None and self._packed_flash_ok(qkv, s):
             # fast path: flash attention on the projection-native packed
             # layout — no head split/merge copies in HBM
@@ -170,8 +207,8 @@ class GPTBlock(Layer):
             self.mlp = GPTMLP(config)
         self.dropout = Dropout(config.hidden_dropout_prob)
 
-    def forward(self, x, cache=None):
-        attn_out = self.attn(self.ln_1(x), cache=cache)
+    def forward(self, x, cache=None, cache_pos=None):
+        attn_out = self.attn(self.ln_1(x), cache=cache, cache_pos=cache_pos)
         if cache is not None:
             attn_out, cache = attn_out
         x = x + self.dropout(attn_out)
@@ -194,11 +231,22 @@ class GPTModel(Layer):
                                  for _ in range(config.num_layers)])
         self.ln_f = LayerNorm(config.hidden_size)
 
-    def forward(self, input_ids, position_ids=None, caches=None):
+    def forward(self, input_ids, position_ids=None, caches=None,
+                cache_pos=None):
         b, s = input_ids.shape
         past_len = caches[0][0].shape[1] if caches is not None else 0
         max_pos = self.wpe.weight.shape[0]
-        if position_ids is None and past_len + s <= max_pos:
+        if cache_pos is not None:
+            # static-cache decode: positions come from the dynamic write
+            # offset, not the (fixed, max_len) cache shape
+            import jax.numpy as jnp
+            from ..core.tensor import Tensor as _T
+            pv = cache_pos._value if isinstance(cache_pos, _T) else cache_pos
+            pos_idx = jnp.clip(
+                jnp.asarray(pv, jnp.int32) + jnp.arange(s, dtype=jnp.int32),
+                0, max_pos - 1)[None, :]
+            pos_emb = self.wpe(_T(jnp.broadcast_to(pos_idx, (1, s))))
+        elif position_ids is None and past_len + s <= max_pos:
             # Default positions are a contiguous arange, so the lookup is a
             # row slice of the weight — not a gather.  The slice's transpose
             # is a pad (identity when s == max_position_embeddings), which
@@ -225,7 +273,7 @@ class GPTModel(Layer):
             if caches is None:
                 x = block(x)
             else:
-                x, c = block(x, cache=caches[i])
+                x, c = block(x, cache=caches[i], cache_pos=cache_pos)
                 new_caches.append(c)
         x = self.ln_f(x)
         return x if caches is None else (x, new_caches)
@@ -247,42 +295,140 @@ class GPTForCausalLM(Layer):
         self.gpt = GPTModel(config)
         self.config = config
 
-    def forward(self, input_ids, position_ids=None, caches=None):
-        hidden = self.gpt(input_ids, position_ids, caches=caches)
+    def forward(self, input_ids, position_ids=None, caches=None,
+                cache_pos=None):
+        hidden = self.gpt(input_ids, position_ids, caches=caches,
+                          cache_pos=cache_pos)
         if caches is not None:
             hidden, caches = hidden
         logits = ops.matmul(hidden, self.gpt.wte.weight, transpose_y=True)
         return logits if caches is None else (logits, caches)
 
     def generate(self, input_ids, max_new_tokens=32, temperature=1.0,
-                 top_k: Optional[int] = None):
-        """Greedy / top-k sampling with a KV cache (incremental decode)."""
+                 top_k: Optional[int] = None, jit_decode: bool = True):
+        """Greedy / top-k sampling with a KV cache (incremental decode).
+
+        ``jit_decode=True`` (default) preallocates a static
+        (B, prompt+max_new, H, D) cache and compiles ONE fused program —
+        prefill plus a ``lax.fori_loop`` over decode steps with in-jit
+        sampling — cached per (batch, prompt, max_new, sampling) shape
+        and reused across calls (the TPU-idiomatic serving loop; the
+        growing-concat path recompiles every step because each step's
+        cache shape is new, and pays a host round trip per token).
+        """
         from .. import ops as O
         from ..core import random as core_random
         import jax
         import jax.numpy as jnp
 
         self.eval()
+        if jit_decode:
+            return self._generate_static(input_ids, max_new_tokens,
+                                         temperature, top_k)
         logits, caches = self(input_ids,
                               caches=self.gpt.gen_empty_caches(
                                   input_ids.shape[0]))
         out_ids = input_ids
         for _ in range(max_new_tokens):
-            last = Tensor(logits._value[:, -1, :] / max(temperature, 1e-6))
-            if top_k is not None:
-                vals, _ = O.topk(last, top_k, axis=-1)
-                cutoff = vals._value[:, -1:]
-                last = Tensor(jnp.where(last._value < cutoff, -1e30,
-                                        last._value))
-            if temperature == 0.0:
-                nxt = jnp.argmax(last._value, axis=-1, keepdims=True)
-            else:
-                key = core_random.split_key()
-                nxt = jax.random.categorical(key, last._value)[:, None]
+            nxt = self._sample(logits._value[:, -1, :], temperature, top_k)
             nxt_t = Tensor(nxt.astype(out_ids._value.dtype))
             out_ids = O.concat([out_ids, nxt_t], axis=1)
             logits, caches = self(nxt_t, caches=caches)
         return out_ids
+
+    @staticmethod
+    def _sample(last, temperature, top_k, key=None):
+        """Single owner of the sampling math (greedy / temperature /
+        top-k) for both decode paths.  ``key=None`` draws from the global
+        RNG (eager concat path); the jit path passes a traced key."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..core import random as core_random
+        last = last.astype(jnp.float32) / max(temperature, 1e-6)
+        if top_k is not None:
+            cutoff = jax.lax.top_k(last, top_k)[0][:, -1:]
+            last = jnp.where(last < cutoff, -1e30, last)
+        if temperature == 0.0:
+            return jnp.argmax(last, axis=-1, keepdims=True)
+        if key is None:
+            key = core_random.split_key()
+        return jax.random.categorical(key, last)[:, None]
+
+    def _generate_static(self, input_ids, max_new_tokens, temperature,
+                         top_k):
+        """One compiled program generates ALL tokens: prefill + a
+        ``lax.fori_loop`` decode loop with in-jit sampling over a static
+        KV cache.  No per-token host round trips — through the remote-chip
+        tunnel a host-side sampling loop measures ~45 tok/s while this
+        runs the whole generation on device."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..core import random as core_random
+        from ..nn.layer import functional_call
+
+        ids = input_ids._value if isinstance(input_ids, Tensor) \
+            else jnp.asarray(input_ids)
+        b, prompt = ids.shape
+        cfg = self.config
+        head_dim = cfg.hidden_size // cfg.num_heads
+        max_len = prompt + max_new_tokens
+        dtype = self.gpt.wte.weight._value.dtype
+        caches = [(jnp.zeros((b, max_len, cfg.num_heads, head_dim), dtype),
+                   jnp.zeros((b, max_len, cfg.num_heads, head_dim), dtype))
+                  for _ in range(cfg.num_layers)]
+        params, buffers = self.functional_state()
+        greedy = temperature == 0.0
+
+        # the jitted program is cached per decode configuration — rebuilding
+        # the closure every call would recompile every call (jax's jit cache
+        # keys on function identity)
+        gen_cache = self.__dict__.setdefault("_gen_program_cache", {})
+        cache_key = (b, prompt, max_new_tokens, greedy,
+                     float(temperature), top_k, str(dtype))
+        if cache_key in gen_cache:
+            key = core_random.split_key()
+            outbuf = gen_cache[cache_key](params, ids, caches, key)
+            return Tensor(jnp.concatenate([ids, outbuf], axis=1))
+
+        def fwd(params, ids_in, caches, pos):
+            return functional_call(
+                self, params, (Tensor(ids_in),),
+                kwargs={"caches": caches, "cache_pos": pos},
+                buffers=buffers, training=False)
+
+        def sample(last, key):
+            return self._sample(last, temperature, top_k, key=key)
+
+        @jax.jit
+        def run(params, ids, caches, key):
+            logits, caches = fwd(params, ids, caches,
+                                 jnp.asarray(0, jnp.int32))
+            nxt = sample(logits[:, -1, :], jax.random.fold_in(key, 0))
+            nxt = nxt.astype(ids.dtype)
+            outbuf = jnp.zeros((b, max_new_tokens), ids.dtype)
+            outbuf = jax.lax.dynamic_update_slice(outbuf, nxt, (0, 0))
+
+            def body(t, carry):
+                caches, cur, outbuf = carry
+                logits, caches = fwd(params, cur, caches,
+                                     (prompt + t).astype(jnp.int32))
+                nxt = sample(logits[:, -1, :],
+                             jax.random.fold_in(key, t + 1))
+                nxt = nxt.astype(ids.dtype)
+                outbuf = jax.lax.dynamic_update_slice(
+                    outbuf, nxt, (jnp.asarray(0, jnp.int32), t + 1))
+                return caches, nxt, outbuf
+
+            _, _, outbuf = jax.lax.fori_loop(
+                0, max_new_tokens - 1, body, (caches, nxt, outbuf))
+            return outbuf
+
+        gen_cache[cache_key] = run
+        key = core_random.split_key()
+        outbuf = run(params, ids, caches, key)
+        return Tensor(jnp.concatenate([ids, outbuf], axis=1))
 
     def loss(self, input_ids, labels, position_ids=None):
         logits = self(input_ids, position_ids)

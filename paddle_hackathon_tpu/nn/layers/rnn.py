@@ -155,12 +155,16 @@ def _valid_window_reverse(x_tm, seq):
     return apply_op("rnn_seq_reverse", fn, [_t(x_tm), _t(seq)])
 
 
-def _step_mask(seq, t, dtype):
-    """(batch, 1) float mask: 1 where step ``t`` is inside the sequence."""
-    def fn(sv, tv):
-        return (sv.astype(jnp.int32) > tv).astype(dtype)[:, None]
-    return apply_op("rnn_step_mask", fn,
-                    [_t(seq), _t(jnp.asarray(t, jnp.int32))])
+def _step_masks(seq, steps, dtype):
+    """List of (batch, 1) float masks, one per step — computed in ONE op
+    dispatch (a (T, batch, 1) comparison + unstack), not one per
+    timestep."""
+    def fn(sv):
+        t = jnp.arange(steps, dtype=jnp.int32)[:, None]
+        return (sv.astype(jnp.int32)[None, :] > t).astype(dtype)[..., None]
+    full = apply_op("rnn_seq_masks", fn, [_t(seq)])
+    from ...ops import manipulation as M
+    return M.unstack(full, axis=0)
 
 
 def _mask_states(new_states, old_states, m):
@@ -202,10 +206,13 @@ class RNN(Layer):
         steps = x.shape[0]
         outs = []
         states = initial_states
+        masks = None
         for t in range(steps):
             out, new_states = self.cell(x[t], states)
             if seq is not None:
-                m = _step_mask(seq, t, out.dtype)
+                if masks is None:
+                    masks = _step_masks(seq, steps, out.dtype)
+                m = masks[t]
                 out = out * m
                 states = new_states if states is None \
                     else _mask_states(new_states, states, m)

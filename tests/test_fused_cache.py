@@ -74,7 +74,7 @@ def test_step_decode_matches_full(weights):
             Tensor(w["x"][:, t:t + 1]), w["qkvw"], w["lw"],
             pre_layer_norm=False, ln_scale=w["ln_s"], ln_bias=w["ln_b"],
             qkv_bias=w["qkvb"], linear_bias=w["lb"],
-            cache_kv=cache if not isinstance(cache, Tensor) else cache,
+            cache_kv=cache,
             time_step=jnp.asarray(t, jnp.int32), dropout_rate=0.0,
             attn_dropout_rate=0.0, training=False)
         outs.append(np.asarray(out.numpy()))

@@ -146,6 +146,29 @@ def test_gpt_kv_cache_matches_full_forward():
                                    atol=2e-4)
 
 
+def test_gpt_generate_static_matches_concat():
+    """jit_decode=True (two compiled programs, static cache) must produce
+    token-for-token the same greedy output as the growing-concat path."""
+    import jax.numpy as jnp
+
+    from paddle_hackathon_tpu.core.tensor import Tensor
+
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=128, hidden_size=32, num_layers=2,
+                    num_heads=2, max_position_embeddings=64,
+                    hidden_dropout_prob=0.0, attention_dropout_prob=0.0,
+                    use_flash_attention=False)
+    m = GPTForCausalLM(cfg)
+    m.eval()
+    ids = jnp.asarray(np.random.RandomState(3).randint(0, 128, (2, 5)),
+                      jnp.int32)
+    new = m.generate(Tensor(ids), max_new_tokens=6, temperature=0.0)
+    old = m.generate(Tensor(ids), max_new_tokens=6, temperature=0.0,
+                     jit_decode=False)
+    np.testing.assert_array_equal(np.asarray(new.numpy()),
+                                  np.asarray(old.numpy()))
+
+
 def test_gpt_generate():
     paddle.seed(6)
     model = GPTForCausalLM(_tiny())
