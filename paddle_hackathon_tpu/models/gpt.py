@@ -317,9 +317,6 @@ class GPTForCausalLM(Layer):
         cache shape is new, and pays a host round trip per token).
         """
         from .. import ops as O
-        from ..core import random as core_random
-        import jax
-        import jax.numpy as jnp
 
         self.eval()
         if jit_decode:
@@ -387,10 +384,18 @@ class GPTForCausalLM(Layer):
         gen_cache = self.__dict__.setdefault("_gen_program_cache", {})
         cache_key = (b, prompt, max_new_tokens, greedy,
                      float(temperature), top_k, str(dtype))
-        if cache_key in gen_cache:
-            key = core_random.split_key()
-            outbuf = gen_cache[cache_key](params, ids, caches, key)
+
+        def _invoke(run):
+            # greedy decode must not consume the global RNG (the eager
+            # concat path doesn't) — seeded runs stay reproducible across
+            # both paths
+            key = (jax.random.key(0) if greedy
+                   else core_random.split_key())
+            outbuf = run(params, ids, caches, key)
             return Tensor(jnp.concatenate([ids, outbuf], axis=1))
+
+        if cache_key in gen_cache:
+            return _invoke(gen_cache[cache_key])
 
         def fwd(params, ids_in, caches, pos):
             return functional_call(
@@ -425,10 +430,10 @@ class GPTForCausalLM(Layer):
                 0, max_new_tokens - 1, body, (caches, nxt, outbuf))
             return outbuf
 
+        if len(gen_cache) >= 32:      # FIFO bound: variable-length serving
+            gen_cache.pop(next(iter(gen_cache)))  # must not grow unbounded
         gen_cache[cache_key] = run
-        key = core_random.split_key()
-        outbuf = run(params, ids, caches, key)
-        return Tensor(jnp.concatenate([ids, outbuf], axis=1))
+        return _invoke(run)
 
     def loss(self, input_ids, labels, position_ids=None):
         logits = self(input_ids, position_ids)

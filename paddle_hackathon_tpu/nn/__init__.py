@@ -6,6 +6,7 @@ Ref ``python/paddle/nn/__init__.py``; built on the TPU-native core
 
 from . import functional  # noqa: F401
 from . import initializer  # noqa: F401
+from . import quant  # noqa: F401
 from . import utils  # noqa: F401
 from .clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue  # noqa: F401
 from .container import Identity, LayerDict, LayerList, ParameterList, Sequential  # noqa: F401

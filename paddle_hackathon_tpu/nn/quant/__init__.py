@@ -1,0 +1,11 @@
+"""paddle.nn.quant — QAT fake-quantization layers
+(ref ``python/paddle/nn/quant/``)."""
+
+from . import functional_layers  # noqa: F401
+from .quant_layers import (FakeQuantAbsMax,  # noqa: F401
+                           FakeQuantChannelWiseAbsMax,
+                           FakeQuantMAOutputScaleLayer,
+                           FakeQuantMovingAverageAbsMax,
+                           MAOutputScaleLayer, MovingAverageAbsMaxScale,
+                           QuantizedConv2D, QuantizedConv2DTranspose,
+                           QuantizedLinear)

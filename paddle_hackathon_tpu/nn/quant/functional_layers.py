@@ -1,0 +1,52 @@
+"""Layer wrappers over functional ops so QAT passes can hook arithmetic
+(ref ``python/paddle/nn/quant/functional_layers.py``)."""
+
+from ...ops import manipulation as _M
+from ..layer import Layer
+
+__all__ = []
+
+
+class FloatFunctionalLayer(Layer):
+    def __init__(self):
+        super().__init__()
+
+
+class add(FloatFunctionalLayer):
+    def forward(self, x, y, name=None):
+        return x + y
+
+
+class subtract(FloatFunctionalLayer):
+    def forward(self, x, y, name=None):
+        return x - y
+
+
+class multiply(FloatFunctionalLayer):
+    def forward(self, x, y, name=None):
+        return x * y
+
+
+class divide(FloatFunctionalLayer):
+    def forward(self, x, y, name=None):
+        return x / y
+
+
+class reshape(FloatFunctionalLayer):
+    def forward(self, x, shape, name=None):
+        return _M.reshape(x, shape)
+
+
+class transpose(FloatFunctionalLayer):
+    def forward(self, x, perm, name=None):
+        return _M.transpose(x, perm)
+
+
+class concat(FloatFunctionalLayer):
+    def forward(self, x, axis=0, name=None):
+        return _M.concat(x, axis=axis)
+
+
+class flatten(FloatFunctionalLayer):
+    def forward(self, x, start_axis=0, stop_axis=-1, name=None):
+        return _M.flatten(x, start_axis, stop_axis)

@@ -1,0 +1,276 @@
+"""Quantization-aware-training layers (ref
+``python/paddle/nn/quant/quant_layers.py``: ``FakeQuantAbsMax:47``,
+``FakeQuantMovingAverageAbsMax:128``, ``FakeQuantChannelWiseAbsMax:226``,
+``MovingAverageAbsMaxScale:310``, ``QuantizedConv2D:398``,
+``QuantizedLinear:591``, ``_get_fake_quant_type:722``).
+
+TPU-native mechanism: the reference dispatches per-quantizer CUDA kernels
+(``fake_quantize_op.cu``); here each fake-quant is one jax op with the
+straight-through estimator expressed directly —
+``x + stop_gradient(dequant(quant(x)) - x)`` — so gradients are exact
+identity under ``jax.vjp`` with no custom-gradient registration, and XLA
+fuses the quant/dequant arithmetic into neighbouring ops.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.autograd import apply_op
+from ...core.tensor import Tensor
+from ..layer import Layer
+
+__all__ = [
+    "FakeQuantAbsMax", "FakeQuantMovingAverageAbsMax",
+    "FakeQuantChannelWiseAbsMax", "MovingAverageAbsMaxScale",
+    "QuantizedConv2D", "QuantizedConv2DTranspose", "QuantizedLinear",
+    "MAOutputScaleLayer", "FakeQuantMAOutputScaleLayer",
+]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+
+
+def _ste_quant_dequant(v, scale, qmax):
+    """Quantize-dequantize with straight-through gradients."""
+    scale = jnp.maximum(scale, 1e-9)
+    q = jnp.clip(jnp.round(v / scale * qmax), -qmax, qmax) / qmax * scale
+    return v + jax.lax.stop_gradient(q - v)
+
+
+class FakeQuantAbsMax(Layer):
+    """Per-tensor abs-max fake quantization (ref ``quant_layers.py:47``):
+    scale = max(|x|) of the current tensor; STE gradients."""
+
+    def __init__(self, name=None, quant_bits=8, dtype="float32",
+                 reduce_type=None):
+        super().__init__()
+        self._quant_bits = quant_bits
+        self.register_buffer("scale", Tensor(jnp.zeros([1], jnp.float32)),
+                             persistable=False)
+
+    def forward(self, x):
+        qmax = float(2 ** (self._quant_bits - 1) - 1)
+
+        def fn(v):
+            scale = jnp.max(jnp.abs(v)).astype(jnp.float32)
+            return (_ste_quant_dequant(v, scale.astype(v.dtype), qmax),
+                    scale[None])
+        out, scale = apply_op("fake_quant_abs_max", fn, [_t(x)], n_outputs=2)
+        self.scale._set_value(scale._value if isinstance(scale, Tensor)
+                              else scale)
+        return out
+
+
+class FakeQuantChannelWiseAbsMax(Layer):
+    """Per-channel abs-max fake quantization for weights (ref
+    ``quant_layers.py:226``)."""
+
+    def __init__(self, name=None, channel_num=None, quant_bits=8,
+                 quant_axis=0, dtype="float32", reduce_type=None):
+        super().__init__()
+        self._quant_bits = quant_bits
+        self._quant_axis = quant_axis
+        n = channel_num or 1
+        self.register_buffer("scale", Tensor(jnp.zeros([n], jnp.float32)),
+                             persistable=False)
+
+    def forward(self, x):
+        qmax = float(2 ** (self._quant_bits - 1) - 1)
+        axis = self._quant_axis
+
+        def fn(v):
+            other = tuple(i for i in range(v.ndim) if i != axis % v.ndim)
+            scale = jnp.max(jnp.abs(v), axis=other).astype(jnp.float32)
+            shape = [1] * v.ndim
+            shape[axis % v.ndim] = scale.shape[0]
+            return (_ste_quant_dequant(
+                v, scale.reshape(shape).astype(v.dtype), qmax), scale)
+        out, scale = apply_op("fake_quant_channel_abs_max", fn, [_t(x)],
+                              n_outputs=2)
+        self.scale._set_value(scale._value if isinstance(scale, Tensor)
+                              else scale)
+        return out
+
+
+class FakeQuantMovingAverageAbsMax(Layer):
+    """Moving-average abs-max fake quantization for activations (ref
+    ``quant_layers.py:128``): in train mode the scale tracks
+    ``accum = rate*accum + |x|_max; state = rate*state + 1;
+    scale = accum/state``; eval uses the frozen scale."""
+
+    def __init__(self, name=None, moving_rate=0.9, quant_bits=8,
+                 dtype="float32", reduce_type=None):
+        super().__init__()
+        self._moving_rate = moving_rate
+        self._quant_bits = quant_bits
+        # nonzero init (ref Constant(0.001), quant_layers.py:150): an
+        # untrained observer in eval must not collapse activations to zero
+        self.register_buffer("scale", Tensor(jnp.full([1], 1e-3,
+                                                      jnp.float32)))
+        self.register_buffer("state", Tensor(jnp.zeros([1], jnp.float32)))
+        self.register_buffer("accum", Tensor(jnp.zeros([1], jnp.float32)))
+
+    def _update_scale(self, v):
+        rate = self._moving_rate
+        abs_max = jnp.max(jnp.abs(v)).astype(jnp.float32)
+        accum = rate * self.accum._value + abs_max
+        state = rate * self.state._value + 1.0
+        self.accum._set_value(accum)
+        self.state._set_value(state)
+        self.scale._set_value(accum / state)
+
+    def forward(self, x):
+        x = _t(x)
+        qmax = float(2 ** (self._quant_bits - 1) - 1)
+        if self.training:
+            self._update_scale(x._value)
+        scale = self.scale._value
+
+        def fn(v, s):
+            return _ste_quant_dequant(v, s[0].astype(v.dtype), qmax)
+        return apply_op("fake_quant_ma_abs_max", fn, [x, Tensor(scale)])
+
+
+class MovingAverageAbsMaxScale(Layer):
+    """Scale observer only — passes the input through unchanged while
+    tracking the moving-average abs-max (ref ``quant_layers.py:310``)."""
+
+    def __init__(self, name=None, moving_rate=0.9, dtype="float32",
+                 reduce_type=None):
+        super().__init__()
+        self._moving_rate = moving_rate
+        self.register_buffer("scale", Tensor(jnp.full([1], 1e-3,
+                                                      jnp.float32)))
+        self.register_buffer("state", Tensor(jnp.zeros([1], jnp.float32)))
+        self.register_buffer("accum", Tensor(jnp.zeros([1], jnp.float32)))
+
+    def forward(self, x):
+        x = _t(x)
+        if self.training:
+            rate = self._moving_rate
+            abs_max = jnp.max(jnp.abs(x._value)).astype(jnp.float32)
+            accum = rate * self.accum._value + abs_max
+            state = rate * self.state._value + 1.0
+            self.accum._set_value(accum)
+            self.state._set_value(state)
+            self.scale._set_value(accum / state)
+        return x
+
+
+def _get_fake_quant_type(quant_type, **kwargs):
+    """ref ``quant_layers.py:722``."""
+    call = {
+        "abs_max": FakeQuantAbsMax,
+        "moving_average_abs_max": FakeQuantMovingAverageAbsMax,
+        "channel_wise_abs_max": FakeQuantChannelWiseAbsMax,
+    }.get(quant_type)
+    if call is None:
+        raise ValueError(f"unsupported quant type {quant_type!r}")
+    allowed = {"abs_max": ("name", "quant_bits", "dtype", "reduce_type"),
+               "moving_average_abs_max": ("name", "moving_rate",
+                                          "quant_bits", "dtype",
+                                          "reduce_type"),
+               "channel_wise_abs_max": ("name", "channel_num", "quant_bits",
+                                        "quant_axis", "dtype",
+                                        "reduce_type")}[quant_type]
+    return call(**{k: v for k, v in kwargs.items() if k in allowed})
+
+
+class _QuantizedWrapper(Layer):
+    """Shared QAT wrapper: fake-quant the activation and the wrapped
+    layer's weight, then run the float op (the reference's
+    Quantized{Conv2D,Linear} pattern)."""
+
+    def __init__(self, layer, weight_bits=8, activation_bits=8,
+                 moving_rate=0.9, weight_quantize_type="abs_max",
+                 activation_quantize_type="moving_average_abs_max",
+                 weight_quant_axis=0, **kwargs):
+        super().__init__()
+        self._inner = layer
+        self.weight = layer.weight
+        self.bias = getattr(layer, "bias", None)
+        ch = layer.weight.shape[weight_quant_axis]
+        self._fake_quant_weight = _get_fake_quant_type(
+            weight_quantize_type, quant_bits=weight_bits, channel_num=ch,
+            quant_axis=weight_quant_axis)
+        self._fake_quant_input = _get_fake_quant_type(
+            activation_quantize_type, quant_bits=activation_bits,
+            moving_rate=moving_rate)
+
+    def _quantized(self, x):
+        return (self._fake_quant_input(_t(x)),
+                self._fake_quant_weight(self.weight))
+
+
+class QuantizedLinear(_QuantizedWrapper):
+    """ref ``quant_layers.py:591``."""
+
+    def forward(self, x):
+        from .. import functional as F
+        qx, qw = self._quantized(x)
+        return F.linear(qx, qw, self.bias)
+
+
+class QuantizedConv2D(_QuantizedWrapper):
+    """ref ``quant_layers.py:398`` — wraps an existing ``nn.Conv2D``,
+    reusing its stride/padding/dilation/groups."""
+
+    def forward(self, x):
+        from .. import functional as F
+        qx, qw = self._quantized(x)
+        inner = self._inner
+        return F.conv2d(qx, qw, self.bias, inner._stride, inner._padding,
+                        inner._dilation, inner._groups, inner._data_format)
+
+
+class QuantizedConv2DTranspose(_QuantizedWrapper):
+    """ref ``quant_layers.py:486``."""
+
+    def forward(self, x):
+        from .. import functional as F
+        qx, qw = self._quantized(x)
+        inner = self._inner
+        return F.conv2d_transpose(
+            qx, qw, self.bias, stride=inner._stride, padding=inner._padding,
+            output_padding=getattr(inner, "_output_padding", 0),
+            groups=inner._groups, dilation=inner._dilation,
+            data_format=inner._data_format)
+
+
+class MAOutputScaleLayer(Layer):
+    """Wrap a layer and observe its output scale (ref
+    ``quant_layers.py:662``)."""
+
+    def __init__(self, layer=None, moving_rate=0.9, name=None,
+                 dtype="float32", reduce_type=None):
+        super().__init__()
+        self._layer = layer
+        self._ma_output_scale = MovingAverageAbsMaxScale(
+            name, moving_rate, dtype)
+
+    def forward(self, *inputs, **kwargs):
+        out = self._layer(*inputs, **kwargs)
+        if isinstance(out, (tuple, list)):
+            return out
+        return self._ma_output_scale(out)
+
+
+class FakeQuantMAOutputScaleLayer(Layer):
+    """Wrap a layer and fake-quant its output (ref
+    ``quant_layers.py:689``)."""
+
+    def __init__(self, layer, weight_bits=8, activation_bits=8,
+                 moving_rate=0.9, name=None, *args, **kwargs):
+        super().__init__()
+        self._layer = layer
+        self._fake_quant_output = FakeQuantMovingAverageAbsMax(
+            name, moving_rate, quant_bits=activation_bits)
+
+    def forward(self, *inputs, **kwargs):
+        out = self._layer(*inputs, **kwargs)
+        if isinstance(out, (tuple, list)):
+            return out
+        return self._fake_quant_output(out)

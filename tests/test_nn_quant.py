@@ -1,0 +1,121 @@
+"""paddle.nn.quant QAT fake-quantization layers (ref
+``python/paddle/nn/quant/quant_layers.py``): quant-dequant numerics,
+straight-through gradients, moving-average scale state, wrapped
+Quantized{Linear,Conv2D} layers.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_hackathon_tpu as paddle
+from paddle_hackathon_tpu import nn
+from paddle_hackathon_tpu.core.tensor import Tensor
+from paddle_hackathon_tpu.nn.quant import (FakeQuantAbsMax,
+                                           FakeQuantChannelWiseAbsMax,
+                                           FakeQuantMovingAverageAbsMax,
+                                           MovingAverageAbsMaxScale,
+                                           QuantizedConv2D, QuantizedLinear)
+
+
+@pytest.fixture()
+def x():
+    return jnp.asarray(np.random.RandomState(0).randn(4, 8) * 3, jnp.float32)
+
+
+class TestFakeQuantizers:
+    def test_abs_max_roundtrip_error_bounded(self, x):
+        q = FakeQuantAbsMax(quant_bits=8)
+        out = np.asarray(q(Tensor(x)).numpy())
+        scale = float(np.abs(np.asarray(x)).max())
+        # int8 quantization error is at most one step
+        assert np.abs(out - np.asarray(x)).max() <= scale / 127 + 1e-6
+        assert float(q.scale.numpy()[0]) == pytest.approx(scale, rel=1e-6)
+
+    def test_straight_through_gradients(self, x):
+        q = FakeQuantAbsMax(quant_bits=8)
+        xt = Tensor(x, stop_gradient=False)
+        loss = paddle.sum(q(xt) * 2.0)
+        loss.backward()
+        # STE: gradient is identity (x2 from the scale), not zero
+        np.testing.assert_allclose(np.asarray(xt.grad.numpy()),
+                                   np.full(x.shape, 2.0), rtol=1e-6)
+
+    def test_channel_wise_scales(self):
+        w = jnp.asarray(np.random.RandomState(1).randn(6, 3, 3, 3),
+                        jnp.float32)
+        q = FakeQuantChannelWiseAbsMax(channel_num=6, quant_bits=8,
+                                       quant_axis=0)
+        out = np.asarray(q(Tensor(w)).numpy())
+        scales = np.asarray(q.scale.numpy())
+        expect = np.abs(np.asarray(w)).reshape(6, -1).max(axis=1)
+        np.testing.assert_allclose(scales, expect, rtol=1e-6)
+        for c in range(6):
+            assert np.abs(out[c] - np.asarray(w)[c]).max() \
+                <= expect[c] / 127 + 1e-6
+
+    def test_moving_average_state(self, x):
+        q = FakeQuantMovingAverageAbsMax(moving_rate=0.9, quant_bits=8)
+        q.train()
+        q(Tensor(x))
+        s1 = float(q.scale.numpy()[0])
+        assert s1 == pytest.approx(float(np.abs(np.asarray(x)).max()),
+                                   rel=1e-5)
+        q(Tensor(x * 0.1))
+        s2 = float(q.scale.numpy()[0])
+        assert s2 < s1                      # scale tracks the new range
+        q.eval()
+        q(Tensor(x * 100))                  # eval: scale frozen
+        assert float(q.scale.numpy()[0]) == pytest.approx(s2, rel=1e-6)
+
+    def test_observer_passthrough(self, x):
+        obs = MovingAverageAbsMaxScale()
+        obs.train()
+        out = obs(Tensor(x))
+        np.testing.assert_array_equal(np.asarray(out.numpy()),
+                                      np.asarray(x))
+        assert float(obs.scale.numpy()[0]) > 0
+
+
+class TestQuantizedLayers:
+    def test_quantized_linear_close_to_float(self):
+        paddle.seed(0)
+        lin = nn.Linear(8, 4)
+        qlin = QuantizedLinear(lin, weight_quantize_type="channel_wise_abs_max",
+                               weight_quant_axis=1)
+        qlin.train()
+        x = Tensor(jnp.asarray(np.random.RandomState(2).randn(5, 8),
+                               jnp.float32))
+        ref = np.asarray(lin(x).numpy())
+        out = np.asarray(qlin(x).numpy())
+        assert np.abs(out - ref).max() < 0.15   # int8 QAT stays close
+        assert not np.allclose(out, ref)        # but quantization happened
+
+    def test_quantized_conv2d_trains(self):
+        paddle.seed(0)
+        conv = nn.Conv2D(3, 8, 3, stride=2, padding=1)
+        qconv = QuantizedConv2D(conv)
+        qconv.train()
+        from paddle_hackathon_tpu import optimizer
+        opt = optimizer.SGD(learning_rate=0.05,
+                            parameters=qconv.parameters())
+        x = Tensor(jnp.asarray(np.random.RandomState(3).randn(2, 3, 8, 8),
+                               jnp.float32))
+        losses = []
+        for _ in range(5):
+            loss = paddle.mean(qconv(x) ** 2)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < losses[0]           # STE lets QAT train
+
+    def test_functional_layers(self):
+        from paddle_hackathon_tpu.nn.quant import functional_layers as FL
+        a = Tensor(jnp.ones((2, 3)))
+        b = Tensor(jnp.full((2, 3), 2.0))
+        assert np.asarray(FL.add()(a, b).numpy()).sum() == 18
+        assert list(FL.reshape()(a, [3, 2]).shape) == [3, 2]
+        assert list(FL.concat()([a, b], axis=0).shape) == [4, 3]
+        assert list(FL.flatten()(a).shape) == [6]
