@@ -8,4 +8,4 @@ from .quant_layers import (FakeQuantAbsMax,  # noqa: F401
                            FakeQuantMovingAverageAbsMax,
                            MAOutputScaleLayer, MovingAverageAbsMaxScale,
                            QuantizedConv2D, QuantizedConv2DTranspose,
-                           QuantizedLinear)
+                           QuantizedLinear, QuantStub)

@@ -25,12 +25,24 @@ __all__ = [
     "FakeQuantAbsMax", "FakeQuantMovingAverageAbsMax",
     "FakeQuantChannelWiseAbsMax", "MovingAverageAbsMaxScale",
     "QuantizedConv2D", "QuantizedConv2DTranspose", "QuantizedLinear",
-    "MAOutputScaleLayer", "FakeQuantMAOutputScaleLayer",
+    "MAOutputScaleLayer", "FakeQuantMAOutputScaleLayer", "QuantStub",
 ]
 
 
 def _t(x):
     return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+
+
+def _ema_absmax_update(layer, v, rate):
+    """Shared moving-average abs-max recurrence over a layer's
+    accum/state/scale buffers (one owner for both the fake-quantizer and
+    the observer)."""
+    abs_max = jnp.max(jnp.abs(v)).astype(jnp.float32)
+    accum = rate * layer.accum._value + abs_max
+    state = rate * layer.state._value + 1.0
+    layer.accum._set_value(accum)
+    layer.state._set_value(state)
+    layer.scale._set_value(accum / state)
 
 
 def _ste_quant_dequant(v, scale, qmax):
@@ -71,10 +83,16 @@ class FakeQuantChannelWiseAbsMax(Layer):
     def __init__(self, name=None, channel_num=None, quant_bits=8,
                  quant_axis=0, dtype="float32", reduce_type=None):
         super().__init__()
+        if not channel_num:
+            raise ValueError(
+                "FakeQuantChannelWiseAbsMax requires channel_num (the size "
+                "of the quantized axis)")
         self._quant_bits = quant_bits
         self._quant_axis = quant_axis
-        n = channel_num or 1
-        self.register_buffer("scale", Tensor(jnp.zeros([n], jnp.float32)),
+        # recomputed every forward, like FakeQuantAbsMax.scale — not part
+        # of the persisted state
+        self.register_buffer("scale",
+                             Tensor(jnp.zeros([channel_num], jnp.float32)),
                              persistable=False)
 
     def forward(self, x):
@@ -113,20 +131,11 @@ class FakeQuantMovingAverageAbsMax(Layer):
         self.register_buffer("state", Tensor(jnp.zeros([1], jnp.float32)))
         self.register_buffer("accum", Tensor(jnp.zeros([1], jnp.float32)))
 
-    def _update_scale(self, v):
-        rate = self._moving_rate
-        abs_max = jnp.max(jnp.abs(v)).astype(jnp.float32)
-        accum = rate * self.accum._value + abs_max
-        state = rate * self.state._value + 1.0
-        self.accum._set_value(accum)
-        self.state._set_value(state)
-        self.scale._set_value(accum / state)
-
     def forward(self, x):
         x = _t(x)
         qmax = float(2 ** (self._quant_bits - 1) - 1)
         if self.training:
-            self._update_scale(x._value)
+            _ema_absmax_update(self, x._value, self._moving_rate)
         scale = self.scale._value
 
         def fn(v, s):
@@ -150,14 +159,13 @@ class MovingAverageAbsMaxScale(Layer):
     def forward(self, x):
         x = _t(x)
         if self.training:
-            rate = self._moving_rate
-            abs_max = jnp.max(jnp.abs(x._value)).astype(jnp.float32)
-            accum = rate * self.accum._value + abs_max
-            state = rate * self.state._value + 1.0
-            self.accum._set_value(accum)
-            self.state._set_value(state)
-            self.scale._set_value(accum / state)
+            _ema_absmax_update(self, x._value, self._moving_rate)
         return x
+
+
+# ref ``quant_layers.py:395`` — the quantization-entry-point marker is
+# the moving-average observer itself
+QuantStub = MovingAverageAbsMaxScale
 
 
 def _get_fake_quant_type(quant_type, **kwargs):
@@ -182,13 +190,22 @@ def _get_fake_quant_type(quant_type, **kwargs):
 class _QuantizedWrapper(Layer):
     """Shared QAT wrapper: fake-quant the activation and the wrapped
     layer's weight, then run the float op (the reference's
-    Quantized{Conv2D,Linear} pattern)."""
+    Quantized{Conv2D,Linear} pattern).
+
+    ``_default_weight_quant_axis`` mirrors the reference: 0 (the
+    output-channel axis) for Conv2D weights (O,I,kh,kw), 1 for Linear
+    (in,out) and Conv2DTranspose (I,O,kh,kw) weights.
+    """
+
+    _default_weight_quant_axis = 0
 
     def __init__(self, layer, weight_bits=8, activation_bits=8,
                  moving_rate=0.9, weight_quantize_type="abs_max",
                  activation_quantize_type="moving_average_abs_max",
-                 weight_quant_axis=0, **kwargs):
+                 weight_quant_axis=None, **kwargs):
         super().__init__()
+        if weight_quant_axis is None:
+            weight_quant_axis = self._default_weight_quant_axis
         self._inner = layer
         self.weight = layer.weight
         self.bias = getattr(layer, "bias", None)
@@ -207,6 +224,8 @@ class _QuantizedWrapper(Layer):
 
 class QuantizedLinear(_QuantizedWrapper):
     """ref ``quant_layers.py:591``."""
+
+    _default_weight_quant_axis = 1   # (in, out): out-features axis
 
     def forward(self, x):
         from .. import functional as F
@@ -228,6 +247,8 @@ class QuantizedConv2D(_QuantizedWrapper):
 
 class QuantizedConv2DTranspose(_QuantizedWrapper):
     """ref ``quant_layers.py:486``."""
+
+    _default_weight_quant_axis = 1   # (I, O, kh, kw): out-channels axis
 
     def forward(self, x):
         from .. import functional as F

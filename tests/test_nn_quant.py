@@ -82,8 +82,10 @@ class TestQuantizedLayers:
     def test_quantized_linear_close_to_float(self):
         paddle.seed(0)
         lin = nn.Linear(8, 4)
-        qlin = QuantizedLinear(lin, weight_quantize_type="channel_wise_abs_max",
-                               weight_quant_axis=1)
+        # default weight axis for Linear is 1 (out-features), per reference
+        qlin = QuantizedLinear(lin,
+                               weight_quantize_type="channel_wise_abs_max")
+        assert list(qlin._fake_quant_weight.scale.shape) == [4]
         qlin.train()
         x = Tensor(jnp.asarray(np.random.RandomState(2).randn(5, 8),
                                jnp.float32))
@@ -110,6 +112,17 @@ class TestQuantizedLayers:
             opt.clear_grad()
             losses.append(float(loss.numpy()))
         assert losses[-1] < losses[0]           # STE lets QAT train
+
+    def test_surface_matches_reference_exports(self):
+        """Every name the reference's nn.quant exports resolves here
+        (quant_layers.py __all__)."""
+        ref_all = ['FakeQuantAbsMax', 'FakeQuantMovingAverageAbsMax',
+                   'FakeQuantChannelWiseAbsMax', 'QuantizedConv2D',
+                   'QuantizedConv2DTranspose', 'QuantizedLinear',
+                   'MovingAverageAbsMaxScale', 'MAOutputScaleLayer',
+                   'FakeQuantMAOutputScaleLayer', 'QuantStub']
+        for name in ref_all:
+            assert hasattr(nn.quant, name), name
 
     def test_functional_layers(self):
         from paddle_hackathon_tpu.nn.quant import functional_layers as FL
