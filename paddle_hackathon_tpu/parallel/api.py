@@ -259,6 +259,26 @@ def make_sharded_train_step(model: Layer, mesh: Mesh,
     from ..nn.layer import functional_call
 
     pp_degree = mesh.shape.get("pp", 1)
+    sp_degree = mesh.shape.get("sp", 1)
+    if sp_degree > 1:
+        # sequence parallelism composed into the one-program step: the
+        # model's attention switches to the ring schedule
+        # (parallel/sequence.py) and the batch's seq dim shards on 'sp'
+        # (SURVEY §5.7 — capability beyond the reference)
+        if pp_degree > 1:
+            raise ValueError(
+                "'sp' does not compose with 'pp' yet — the pipeline loss "
+                "owns the sequence decomposition")
+        if not hasattr(model, "enable_sequence_parallel"):
+            raise ValueError(
+                f"{type(model).__name__} does not implement "
+                "enable_sequence_parallel(); required for an 'sp' mesh "
+                "axis")
+        model.enable_sequence_parallel("sp", mesh=mesh)
+    elif hasattr(model, "disable_sequence_parallel"):
+        # a previous sp step may have switched the model's attention to
+        # the ring schedule — a non-sp mesh must not inherit it
+        model.disable_sequence_parallel()
     if param_dtype is not None:
         for _, p in model.named_parameters():
             if jnp.issubdtype(p._value.dtype, jnp.floating):
@@ -394,6 +414,9 @@ def make_sharded_train_step(model: Layer, mesh: Mesh,
         return new_params, new_opt, step_no + 1, loss
 
     bspec = batch_spec(mesh)
+    if sp_degree > 1:
+        # (batch, seq): seq dim additionally sharded over 'sp'
+        bspec = P(bspec[0] if len(bspec) else None, "sp")
     param_sh = jax.tree.map(lambda a: a.sharding, params)
     opt_sh = jax.tree.map(lambda a: a.sharding, opt_state)
     scalar_sh = NamedSharding(mesh, P())
@@ -417,6 +440,10 @@ def make_sharded_train_step(model: Layer, mesh: Mesh,
     def step(state, ids, labels, rng, lr=None):
         # lr is a dynamic scalar: schedules (PipelineParallel.train_batch
         # passes the optimizer's current lr) never trigger a recompile
+        if sp_degree > 1 and ids.shape[1] % sp_degree:
+            raise ValueError(
+                f"sequence length {ids.shape[1]} must divide evenly over "
+                f"the 'sp' axis (degree {sp_degree})")
         lr_now = jnp.float32(learning_rate if lr is None else lr)
         # partial-manual shard_map (the pp pipeline) requires the ambient
         # mesh at trace time (_smap.run_shard_map); harmless otherwise

@@ -72,6 +72,31 @@ def test_hybrid_dp_sharding_mp_matches_single_device():
     np.testing.assert_allclose(hybrid, single, rtol=2e-4)
 
 
+def test_hybrid_dp_sp_mp_matches_single_device():
+    """Sequence parallelism composed INSIDE the one-program step (the seq
+    dim shards on 'sp', attention runs the ring schedule) must match the
+    single-device loss — SURVEY §5.7, beyond-reference capability."""
+    ids, labels = _data(batch=4)
+
+    def run(mesh_dims):
+        paddle.seed(123)
+        model = GPTForCausalLM(_tiny())
+        n = int(np.prod(list(mesh_dims.values())))
+        mesh = parallel.create_mesh(mesh_dims, devices=jax.devices()[:n])
+        step, state = parallel.make_sharded_train_step(
+            model, mesh, rule=param_sharding_spec, learning_rate=1e-3,
+            grad_clip_norm=None)
+        out = []
+        for i in range(3):
+            state, loss = step(state, ids, labels, jax.random.key(0))
+            out.append(float(loss))
+        return out
+
+    single = run({"dp": 1})
+    sp = run({"dp": 2, "sp": 2, "mp": 2})
+    np.testing.assert_allclose(sp, single, rtol=2e-3)
+
+
 def test_zero3_actually_shards_params():
     paddle.seed(0)
     model = GPTForCausalLM(_tiny())
