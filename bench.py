@@ -219,6 +219,37 @@ def bench_ppyoloe(batch=64, size=640, steps=100, warmup=5):
             "value": round(batch * steps / dt, 1), "unit": "imgs/s"}
 
 
+def bench_decode(batch=8, prompt=64, new_tokens=128, reps=20):
+    """One-program greedy decoding throughput (static KV cache + in-jit
+    sampling, BASELINE.md round-3 row)."""
+    import paddle_hackathon_tpu as paddle
+    from paddle_hackathon_tpu.core.tensor import Tensor
+    from paddle_hackathon_tpu.models import GPTForCausalLM, gpt_config
+
+    paddle.seed(0)
+    cfg = gpt_config("gpt2-small-en", hidden_dropout_prob=0.0,
+                     attention_dropout_prob=0.0)
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    for _, p in model.named_parameters():
+        if jnp.issubdtype(p._value.dtype, jnp.floating):
+            p._set_value(p._value.astype(jnp.bfloat16))
+    rng = np.random.RandomState(0)
+    ids = Tensor(jnp.asarray(rng.randint(0, cfg.vocab_size,
+                                         (batch, prompt)), jnp.int32))
+    np.asarray(model.generate(ids, max_new_tokens=new_tokens,
+                              temperature=0.0).numpy())  # compile+sync
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = np.asarray(model.generate(
+            ids, max_new_tokens=new_tokens, temperature=0.0).numpy())
+    dt = time.perf_counter() - t0
+    assert out.shape == (batch, prompt + new_tokens)
+    return {"metric": "gpt2_greedy_decode_tokens_per_sec_per_chip",
+            "value": round(reps * batch * new_tokens / dt, 1),
+            "unit": "tokens/s"}
+
+
 SUITE = {
     "gpt2": lambda: bench_gpt2(),
     "ernie": lambda: bench_ernie(),
@@ -233,6 +264,7 @@ SUITE = {
         metric="gpt2_long_context_s4096_tokens_per_sec_per_chip"),
     "resnet": lambda: bench_resnet(),
     "ppyoloe": lambda: bench_ppyoloe(),
+    "decode": lambda: bench_decode(),
 }
 
 

@@ -136,7 +136,7 @@ def suite_gate(tolerance, rows=None):
     if rows is None:
         out = subprocess.run(
             [sys.executable, os.path.join(ROOT, "bench.py"), "--suite"],
-            capture_output=True, text=True, timeout=20000)  # 6 rows x 2 attempts x 1500s + slack
+            capture_output=True, text=True, timeout=25000)  # 7 rows x 2 attempts x 1500s + slack
         if out.returncode != 0:
             raise RuntimeError(f"bench.py --suite failed:\n"
                                f"{out.stderr[-2000:]}")
