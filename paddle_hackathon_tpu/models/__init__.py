@@ -5,4 +5,5 @@ from .gpt import (GPTConfig, GPTForCausalLM, GPTModel, gpt_config,  # noqa: F401
 from .bert import (BertConfig, BertForPretraining,  # noqa: F401
                    BertForSequenceClassification, BertModel, ErnieModel,
                    ErnieForPretraining, ErnieForSequenceClassification,
-                   bert_config, bert_param_sharding_spec, ernie_config)
+                   bert_config, bert_mlm_pipeline, bert_param_sharding_spec,
+                   ernie_config, masked_mlm_loss)

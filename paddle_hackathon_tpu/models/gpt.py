@@ -72,7 +72,10 @@ def gpt_config(name: str, **overrides) -> GPTConfig:
     return cfg
 
 
-class GPTAttention(Layer):
+from ..nn.layers.transformer import SequenceParallelMixin
+
+
+class GPTAttention(SequenceParallelMixin, Layer):
     def __init__(self, config: GPTConfig):
         super().__init__()
         h = config.hidden_size
@@ -102,31 +105,14 @@ class GPTAttention(Layer):
     def forward(self, x, cache=None, cache_pos=None):
         b, s, h = x.shape
         qkv = self.qkv_proj(x)
-        if getattr(self, "seq_parallel_axis", None) is not None \
-                and cache is None and cache_pos is None:
+        if self._sp_enabled() and cache is None and cache_pos is None:
             # sequence-parallel training: the seq dim is sharded over the
-            # 'sp' mesh axis; attention runs the ring schedule
+            # 'sp' mesh axis; attention runs the ring/ulysses schedule
             # (parallel/sequence.py — flash-in-ring on TPU) against the
             # mesh enable_sequence_parallel() captured
-            from ..parallel import ring_attention
-            axis = self.seq_parallel_axis
-            mesh = self.seq_parallel_mesh
-            if mesh is None:
-                from ..parallel.api import get_mesh
-                mesh = get_mesh()
-            if mesh is None or axis not in mesh.shape:
-                raise RuntimeError(
-                    f"sequence-parallel attention needs a mesh with the "
-                    f"{axis!r} axis; pass it to enable_sequence_parallel "
-                    "(make_sharded_train_step does this automatically)")
             qkv = ops.reshape(qkv, [b, s, 3, self.num_heads, self.head_dim])
             q, k, v = ops.unstack(qkv, axis=2)
-
-            def fn(qv, kv, vv):
-                return ring_attention(qv, kv, vv, mesh, axis=axis,
-                                      causal=True)
-            from ..core.autograd import apply_op
-            out = apply_op("ring_attention_sp", fn, [q, k, v])
+            out = self._sp_attention(q, k, v, causal=True)
             out = ops.reshape(out, [b, s, h])
             return self.out_proj(out)
         if cache_pos is not None:
@@ -394,6 +380,11 @@ class GPTForCausalLM(Layer):
 
         ids = input_ids._value if isinstance(input_ids, Tensor) \
             else jnp.asarray(input_ids)
+        if max_new_tokens <= 0:
+            # prefill always samples one token, so the jitted program is
+            # only built for >=1 new tokens; the eager path returns the
+            # prompt unchanged for the same input
+            return Tensor(ids)
         b, prompt = ids.shape
         cfg = self.config
         head_dim = cfg.hidden_size // cfg.num_heads
@@ -462,29 +453,25 @@ class GPTForCausalLM(Layer):
         gen_cache[cache_key] = run
         return _invoke(run)
 
-    def enable_sequence_parallel(self, axis: str = "sp", mesh=None):
-        """Switch every attention layer to the ring schedule over mesh
-        axis ``axis`` (sequence/context parallelism inside the one-program
-        train step — SURVEY §5.7, a capability the reference lacks).
-        Requires attention dropout 0 (the ring kernels regenerate dropout
-        only on the single-chip path).
+    def enable_sequence_parallel(self, axis: str = "sp", mesh=None,
+                                 mode: str = "auto"):
+        """Switch every attention layer to the ring/ulysses schedule over
+        mesh axis ``axis`` (sequence/context parallelism inside the
+        one-program train step — SURVEY §5.7, a capability the reference
+        lacks). Delegates to the model-agnostic
+        ``parallel.enable_sequence_parallel`` walker (any model whose
+        attention carries ``supports_sequence_parallel`` works the same
+        way); kept as a method for API compatibility.
 
         Persists on the model (like ``shard_params`` placement) until
-        ``disable_sequence_parallel()``; eager forwards meanwhile run the
-        ring path too — correct, but uncached per call.
-        ``make_sharded_train_step`` enables/disables this automatically
-        from the mesh's 'sp' axis."""
-        if self.config.attention_dropout_prob > 0:
-            raise ValueError(
-                "sequence parallelism requires attention_dropout_prob=0")
-        for block in self.gpt.blocks:
-            block.attn.seq_parallel_axis = axis
-            block.attn.seq_parallel_mesh = mesh
+        ``disable_sequence_parallel()``; ``make_sharded_train_step``
+        enables/disables this automatically from the mesh's 'sp' axis."""
+        from ..parallel.sequence import enable_sequence_parallel
+        enable_sequence_parallel(self, axis, mesh, mode)
 
     def disable_sequence_parallel(self):
-        for block in self.gpt.blocks:
-            block.attn.seq_parallel_axis = None
-            block.attn.seq_parallel_mesh = None
+        from ..parallel.sequence import disable_sequence_parallel
+        disable_sequence_parallel(self)
 
     def loss(self, input_ids, labels, position_ids=None):
         logits = self(input_ids, position_ids)

@@ -10,6 +10,8 @@ from __future__ import annotations
 
 import collections
 
+import jax
+
 from ...ops import manipulation as M
 from .. import functional as F
 from ..container import LayerList
@@ -18,7 +20,61 @@ from .common import Dropout, Linear
 from .norm import LayerNorm
 
 
-class MultiHeadAttention(Layer):
+class SequenceParallelMixin:
+    """Sequence-parallel switch for attention modules — the generic hook
+    ``parallel.enable_sequence_parallel`` flips (SURVEY §5.7; a capability
+    the reference lacks). Any attention layer that (a) sets
+    ``supports_sequence_parallel = True``, (b) exposes ``num_heads``, and
+    (c) routes its core attention through :meth:`_sp_attention` when
+    :meth:`_sp_enabled` gets ring / Ulysses context parallelism for free
+    on meshes with an 'sp' axis — model-agnostic, unlike a per-model
+    ``enable_sequence_parallel`` method.
+
+    ``seq_parallel_mode``: 'ring' (K/V rotate via ppermute, O(block^2)
+    memory — the long-context default), 'ulysses' (one all-to-all pair,
+    cheapest when heads divide the sp degree), or 'auto' (ulysses when
+    ``num_heads % sp == 0`` else ring).
+    """
+
+    supports_sequence_parallel = True
+    seq_parallel_axis = None
+    seq_parallel_mesh = None
+    seq_parallel_mode = "auto"
+
+    def _sp_enabled(self) -> bool:
+        return getattr(self, "seq_parallel_axis", None) is not None
+
+    def _sp_attention(self, q, k, v, causal: bool):
+        """q/k/v: (b, s, h, d) Tensors with s sharded over the sp axis."""
+        from ...core.autograd import apply_op
+        from ...parallel.api import get_mesh
+        from ...parallel.sequence import ring_attention, ulysses_attention
+        axis = self.seq_parallel_axis
+        mesh = self.seq_parallel_mesh or get_mesh()
+        if mesh is None or axis not in mesh.shape:
+            raise RuntimeError(
+                f"sequence-parallel attention needs a mesh with the "
+                f"{axis!r} axis; pass it to enable_sequence_parallel "
+                "(make_sharded_train_step does this automatically)")
+        mode = getattr(self, "seq_parallel_mode", "auto") or "auto"
+        if mode == "auto":
+            n = mesh.shape[axis]
+            mode = "ulysses" if self.num_heads % n == 0 else "ring"
+        fn = ulysses_attention if mode == "ulysses" else ring_attention
+
+        def f(qv, kv, vv):
+            return fn(qv, kv, vv, mesh, axis=axis, causal=causal)
+
+        from ...jit.api import _trace_state_clean
+        if _trace_state_clean():
+            # eager call: the partial-manual shard_map inside needs the
+            # ambient mesh at trace time (jit inside apply_op)
+            with jax.set_mesh(mesh):
+                return apply_op(f"{mode}_attention_sp", f, [q, k, v])
+        return apply_op(f"{mode}_attention_sp", f, [q, k, v])
+
+
+class MultiHeadAttention(SequenceParallelMixin, Layer):
     Cache = collections.namedtuple("Cache", ["k", "v"])
     StaticCache = collections.namedtuple("StaticCache", ["k", "v"])
 
@@ -66,6 +122,14 @@ class MultiHeadAttention(Layer):
                 k = M.concat([cache.k, k], axis=1)
                 v = M.concat([cache.v, v], axis=1)
                 cache = self.Cache(k, v)
+        if self._sp_enabled() and cache is None:
+            if attn_mask is not None:
+                raise ValueError(
+                    "attention masks are not supported under sequence "
+                    "parallelism — pack sequences instead of padding")
+            out = self._sp_attention(q, k, v, causal=False)
+            b, s = out.shape[0], out.shape[1]
+            return self.out_proj(M.reshape(out, [b, s, self.embed_dim]))
         out = F.scaled_dot_product_attention(
             q, k, v, attn_mask=attn_mask,
             dropout_p=self.dropout if self.training else 0.0,

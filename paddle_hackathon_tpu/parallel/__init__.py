@@ -30,10 +30,13 @@ from .topology import (CommunicateTopology, HybridCommunicateGroup,  # noqa: F40
 from .mp_layers import (ColumnParallelLinear, ParallelCrossEntropy,  # noqa: F401
                         RowParallelLinear, VocabParallelEmbedding,
                         mark_sharding, sharding_rule_from_model)
-from .pipeline import (LayerDesc, PipelineParallel, SharedLayerDesc,  # noqa: F401
+from .pipeline import (LayerDesc, PipelineLayer,  # noqa: F401
+                       PipelineParallel, SharedLayerDesc,
                        pipeline_apply, stack_layer_params,
                        unstack_into_layers)
-from .sequence import ring_attention, ulysses_attention  # noqa: F401
+from .sequence import (disable_sequence_parallel,  # noqa: F401
+                       enable_sequence_parallel, ring_attention,
+                       ulysses_attention)
 from .moe import GShardGate, MoELayer, NaiveGate, SwitchGate  # noqa: F401
 from .multislice import (create_multislice_mesh,  # noqa: F401
                          dcn_traffic_axes)

@@ -12,8 +12,30 @@ run inside ``jit`` under an ambient ``jax.set_mesh`` context — but
 
 from __future__ import annotations
 
+import contextlib
+
 import jax
 from jax import shard_map
+
+# Axes already bound manual by an enclosing shard_map region (Shardy
+# forbids re-binding them in a nested shard_map). Collective programs
+# (ring/ulysses attention) consult this to run their per-device bodies
+# directly instead of opening a second region — see pipeline_apply.
+_ACTIVE_MANUAL_AXES: set = set()
+
+
+@contextlib.contextmanager
+def manual_axes_scope(axes):
+    added = set(axes) - _ACTIVE_MANUAL_AXES
+    _ACTIVE_MANUAL_AXES.update(added)
+    try:
+        yield
+    finally:
+        _ACTIVE_MANUAL_AXES.difference_update(added)
+
+
+def active_manual_axes() -> frozenset:
+    return frozenset(_ACTIVE_MANUAL_AXES)
 
 
 def run_shard_map(fn, mesh, in_specs, out_specs, manual_axes, args):

@@ -166,6 +166,10 @@ def _make_pipeline_loss(mesh: Mesh, pp_spec: dict, pp_degree: int,
     n_local = pp_spec["num_layers"] // pp_degree
     data_axes = tuple(a for a in ("dp", "sharding", "ep")
                       if a in mesh.axis_names)
+    # sp x pp: the seq dim (the one after the microbatch/batch dims) stays
+    # sharded on 'sp' through every regroup pin, so the ring attention
+    # inside each pipeline stage sees its sequence chunk without a gather
+    sp_axis = "sp" if mesh.shape.get("sp", 1) > 1 else None
 
     def loss_fn(model, params, buffers, batch, rng):
         ids, labels = batch
@@ -189,9 +193,10 @@ def _make_pipeline_loss(mesh: Mesh, pp_spec: dict, pp_degree: int,
             # explicit motion-free sharding chain: without these pins GSPMD
             # propagates the batch sharding onto the wrong regroup dim and
             # falls back to involuntary full rematerialization
-            if not data_axes:
+            if not data_axes and sp_axis is None:
                 return a
-            spec = spec_head + tuple([None] * (a.ndim - len(spec_head)))
+            spec = spec_head + (sp_axis,)
+            spec = spec + tuple([None] * (a.ndim - len(spec)))
             return jax.lax.with_sharding_constraint(
                 a, NamedSharding(mesh, P(*spec)))
 
@@ -209,6 +214,12 @@ def _make_pipeline_loss(mesh: Mesh, pp_spec: dict, pp_degree: int,
                 # only the layer would reuse one mask across microbatches
                 lk = jax.random.fold_in(
                     k_blocks, (stage * n_local + j) * n_micro + mb_idx)
+                if sp_axis is not None:
+                    # the sp axis is manual inside the pipeline region:
+                    # each device sees its LOCAL sequence chunk, so the
+                    # mask must differ per chunk (iid over positions)
+                    lk = jax.random.fold_in(
+                        lk, jax.lax.axis_index(sp_axis))
                 with core_random.rng_scope(lk):
                     return layer_fn(lp, h), None
 
@@ -217,7 +228,8 @@ def _make_pipeline_loss(mesh: Mesh, pp_spec: dict, pp_degree: int,
             return h
 
         ym = pin(pipeline_apply(block_fn, stacked, xm, mesh,
-                                extra=jnp.arange(n_micro)),
+                                extra=jnp.arange(n_micro),
+                                seq_axis=sp_axis),
                  (None, data_axes))
         ys = pin(jnp.swapaxes(ym, 0, 1), (data_axes, None))
         y = pin(ys.reshape((B,) + ym.shape[2:]), (data_axes,))
@@ -236,7 +248,8 @@ def make_sharded_train_step(model: Layer, mesh: Mesh,
                             recompute: bool = False,
                             recompute_policy: Optional[str] = None,
                             pp_microbatches: Optional[int] = None,
-                            moment_dtype=None):
+                            moment_dtype=None,
+                            sp_mode: str = "auto"):
     """Build (step_fn, state) — one compiled SPMD program per step covering
     forward, backward, grad psum over dp, Adam update on (optionally
     'sharding'-sharded) optimizer state.
@@ -261,24 +274,26 @@ def make_sharded_train_step(model: Layer, mesh: Mesh,
     pp_degree = mesh.shape.get("pp", 1)
     sp_degree = mesh.shape.get("sp", 1)
     if sp_degree > 1:
-        # sequence parallelism composed into the one-program step: the
-        # model's attention switches to the ring schedule
+        # sequence parallelism composed into the one-program step: every
+        # sp-capable attention switches to the ring/ulysses schedule
         # (parallel/sequence.py) and the batch's seq dim shards on 'sp'
-        # (SURVEY §5.7 — capability beyond the reference)
-        if pp_degree > 1:
-            raise ValueError(
-                "'sp' does not compose with 'pp' yet — the pipeline loss "
-                "owns the sequence decomposition")
-        if not hasattr(model, "enable_sequence_parallel"):
-            raise ValueError(
-                f"{type(model).__name__} does not implement "
-                "enable_sequence_parallel(); required for an 'sp' mesh "
-                "axis")
-        model.enable_sequence_parallel("sp", mesh=mesh)
-    elif hasattr(model, "disable_sequence_parallel"):
+        # (SURVEY §5.7 — capability beyond the reference).  Model-agnostic:
+        # the generic walker flips any attention carrying
+        # supports_sequence_parallel; a model-level method (GPT keeps one
+        # for API compatibility) takes precedence.
+        from .sequence import enable_sequence_parallel as _enable_sp
+        if hasattr(model, "enable_sequence_parallel"):
+            model.enable_sequence_parallel("sp", mesh=mesh, mode=sp_mode)
+        else:
+            _enable_sp(model, "sp", mesh=mesh, mode=sp_mode)
+    else:
         # a previous sp step may have switched the model's attention to
         # the ring schedule — a non-sp mesh must not inherit it
-        model.disable_sequence_parallel()
+        from .sequence import disable_sequence_parallel as _disable_sp
+        if hasattr(model, "disable_sequence_parallel"):
+            model.disable_sequence_parallel()
+        elif hasattr(model, "sublayers"):
+            _disable_sp(model)
     if param_dtype is not None:
         for _, p in model.named_parameters():
             if jnp.issubdtype(p._value.dtype, jnp.floating):

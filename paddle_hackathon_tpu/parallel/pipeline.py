@@ -29,13 +29,16 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ..nn.container import LayerList
+from ..nn.layer import Layer
+
 
 def num_stages(mesh: Mesh) -> int:
     return mesh.shape.get("pp", 1)
 
 
 def pipeline_apply(block_fn: Callable, stage_params: Any, x_mb: jax.Array,
-                   mesh: Mesh, extra: Any = None):
+                   mesh: Mesh, extra: Any = None, seq_axis: str = None):
     """Run microbatches through ``n_stages`` sequential stage applications.
 
     Args:
@@ -48,6 +51,11 @@ def pipeline_apply(block_fn: Callable, stage_params: Any, x_mb: jax.Array,
       x_mb: (n_micro, mb, ...) microbatched stage-0 input, replicated on pp.
       extra: per-microbatch side input pytree, leaves (n_micro, ...), passed
         to every stage (e.g. position ids); replicated on pp.
+      seq_axis: sp x pp composition — name of a mesh axis sharding x_mb's
+        dim 2 (the sequence). The region goes manual over BOTH axes (Shardy
+        forbids nesting a second shard_map on the same mesh), and the ring
+        attention inside block_fn detects the already-manual axis and runs
+        its per-device body directly (``_smap.active_manual_axes``).
 
     Returns (n_micro, mb, ...) last-stage outputs, replicated over 'pp'.
     """
@@ -60,8 +68,15 @@ def pipeline_apply(block_fn: Callable, stage_params: Any, x_mb: jax.Array,
                 lambda x, e: block_fn(stage_params, x, e))(x_mb, extra)
         return jax.vmap(lambda x: block_fn(stage_params, x, None))(x_mb)
 
+    manual = {"pp"}
+    x_spec = P()
+    if seq_axis is not None and mesh.shape.get(seq_axis, 1) > 1:
+        manual.add(seq_axis)
+        x_spec = P(None, None, seq_axis)
+
     def spmd(params, xs, ex):
         # params leaves: (layers_per_stage, ...) local slice
+        from ._smap import manual_axes_scope
         stage = jax.lax.axis_index("pp")
         is_first = stage == 0
         is_last = stage == n_stages_ - 1
@@ -93,8 +108,10 @@ def pipeline_apply(block_fn: Callable, stage_params: Any, x_mb: jax.Array,
             send = jax.lax.ppermute(y, "pp", perm)
             return (send, outputs), None
 
-        (_, outputs), _ = jax.lax.scan(
-            tick, (zero_state, outputs), jnp.arange(n_micro + n_stages_ - 1))
+        with manual_axes_scope(manual):
+            (_, outputs), _ = jax.lax.scan(
+                tick, (zero_state, outputs),
+                jnp.arange(n_micro + n_stages_ - 1))
         # only the last stage holds real outputs — replicate over pp
         mask = jnp.where(is_last, 1.0, 0.0).astype(outputs.dtype)
         return jax.lax.psum(outputs * mask, "pp")
@@ -103,10 +120,10 @@ def pipeline_apply(block_fn: Callable, stage_params: Any, x_mb: jax.Array,
     return run_shard_map(
         spmd, mesh,
         in_specs=(jax.tree.map(lambda _: P("pp"), stage_params),
-                  P(), jax.tree.map(lambda _: P(), extra)
+                  x_spec, jax.tree.map(lambda _: P(), extra)
                   if extra is not None else P()),
-        out_specs=P(),
-        manual_axes={"pp"},
+        out_specs=x_spec,
+        manual_axes=manual,
         args=(stage_params, x_mb, extra))
 
 
@@ -124,14 +141,221 @@ class LayerDesc:
 
 class SharedLayerDesc(LayerDesc):
     """Ref ``pp_layers.py:77`` — weight shared across stages (e.g. tied
-    embedding/head). In SPMD the tied weight simply lives replicated on
-    'pp'; the grad-allreduce the reference does by hand
+    embedding/head). Descs with the same ``key`` resolve to ONE module
+    instance; later occurrences apply ``forward_func(module, x)`` instead
+    of the module's own forward (the reference's shared-weight pattern).
+    In SPMD the tied weight simply lives replicated on 'pp'; the
+    grad-allreduce the reference does by hand
     (``pipeline_parallel.py:149``) falls out of AD."""
 
     def __init__(self, key, layer_cls, *args, forward_func=None, **kwargs):
         super().__init__(layer_cls, *args, **kwargs)
         self.key = key
         self.forward_func = forward_func
+
+
+def _structure_sig(mod) -> tuple:
+    """Structural identity of a layer: class + (name, shape, dtype) of every
+    parameter. Two layers with equal signatures can be stacked into one
+    leading-dim array (the pipeline_apply layout)."""
+    return (type(mod), tuple(
+        (k, tuple(p.shape), str(p._value.dtype))
+        for k, p in sorted(mod.named_parameters(), key=lambda kv: kv[0])))
+
+
+def _apply_positions(positions, params, buffers, x):
+    """Run ``x`` through [(prefix, module, fwd)] sequentially, with each
+    module's state substituted from the flat ``params``/``buffers`` dicts
+    at its owner prefix (tied/shared modules read their first-occurrence
+    prefix, so the traced value — and its gradient — flows to every use)."""
+    import jax as _jax
+
+    from ..core import autograd as _autograd
+    from ..core.tensor import Tensor as _T
+    from ..nn.layer import functional_call
+
+    for prefix, mod, fwd in positions:
+        sub = {k[len(prefix):]: v for k, v in params.items()
+               if k.startswith(prefix)}
+        subbuf = {k[len(prefix):]: v for k, v in (buffers or {}).items()
+                  if k.startswith(prefix)} or None
+        if fwd is None:
+            x = functional_call(mod, sub, (_T(x),), buffers=subbuf)
+        else:
+            # forward_func positions (shared-weight reuse) substitute the
+            # owner's state by hand — functional_call has no custom-forward
+            # hook
+            with mod._swap_state(sub, subbuf), _autograd.no_grad():
+                out = fwd(mod, _T(x))
+            x = _jax.tree.map(
+                lambda t: t._value if isinstance(t, _T) else t, out,
+                is_leaf=lambda t: isinstance(t, _T))
+    return x
+
+
+class PipelineLayer(Layer):
+    """Segment ANY layer list across pipeline stages — the framework-level
+    counterpart of the reference's ``PipelineLayer``
+    (``parallel_layers/pp_layers.py:162``), which turns a ``LayerDesc`` list
+    into per-stage submodels. Here the same desc list is partitioned into
+
+    - ``pre``:    layers before the homogeneous block run (replicated on 'pp')
+    - ``blocks``: the maximal contiguous run of structurally-identical layers
+                  (stacked on a leading layer dim, sharded over 'pp')
+    - ``post``:   layers after the run (replicated on 'pp')
+
+    and :meth:`pipeline_stage_spec` derives ``block_prefix``/``pre_fn``/
+    ``layer_fn``/``post_fn`` automatically, so ``make_sharded_train_step``
+    composes the model with dp/mp/sharding exactly like the hand-written
+    GPT spec (``models/gpt.py``). ``SharedLayerDesc`` entries with one key
+    build ONE module (tied weights, e.g. embedding + LM head); the tied
+    gradient contribution from every use site falls out of AD because all
+    sites read the same traced parameter.
+
+    ``loss_fn(outputs, labels) -> scalar`` (on jnp arrays) closes the
+    training objective; :meth:`make_loss_fn` exposes the equivalent
+    non-pipelined loss for single-device parity and pp=1 meshes.
+
+    Constraints (checked): at least 2 structurally-identical contiguous
+    layers; block layers must be plain ``LayerDesc`` (not shared); blocks
+    must map ``x -> same shape/dtype x`` (transformer invariant). Dropout
+    inside pre/blocks is RNG-keyed by the train step; dropout in ``post``
+    is not supported under pp (keep heads deterministic, as in GPT/BERT).
+    """
+
+    def __init__(self, layers, loss_fn=None):
+        super().__init__()
+        entries = []           # (module, fwd, is_new, shareable)
+        shared_mods = {}
+        for d in layers:
+            if isinstance(d, SharedLayerDesc):
+                is_new = d.key not in shared_mods
+                mod = shared_mods.setdefault(d.key, None) or d.build()
+                shared_mods[d.key] = mod
+                entries.append((mod, d.forward_func, is_new, True))
+            elif isinstance(d, LayerDesc):
+                entries.append((d.build(), None, True, False))
+            elif isinstance(d, Layer):
+                entries.append((d, None, True, False))
+            else:
+                raise TypeError(
+                    f"PipelineLayer entries must be LayerDesc/SharedLayerDesc"
+                    f"/Layer, got {type(d).__name__}")
+
+        # maximal contiguous run of stackable (plain, structurally equal)
+        # layers = the pipelined block stack
+        sigs = [None if (fwd is not None or shared or not new)
+                else _structure_sig(mod)
+                for mod, fwd, new, shared in entries]
+        best = (0, 0)          # (length, start)
+        i = 0
+        while i < len(entries):
+            if sigs[i] is None:
+                i += 1
+                continue
+            j = i
+            while j < len(entries) and sigs[j] == sigs[i]:
+                j += 1
+            if j - i > best[0]:
+                best = (j - i, i)
+            i = j
+        run_len, run_start = best
+        if run_len < 2:
+            raise ValueError(
+                "PipelineLayer found no contiguous run of >=2 structurally-"
+                "identical layers to segment across stages — pipeline "
+                "parallelism needs a homogeneous block stack")
+        run_end = run_start + run_len
+
+        pre_mods, block_mods, post_mods = [], [], []
+        owner_prefix = {}       # id(module) -> registered prefix
+        self._positions = []    # (prefix, module, fwd)
+        for idx, (mod, fwd, is_new, _) in enumerate(entries):
+            if run_start <= idx < run_end:
+                block_mods.append(mod)
+                prefix = f"blocks.{len(block_mods) - 1}."
+                owner_prefix[id(mod)] = prefix
+            elif is_new:
+                seg, lst = (("pre", pre_mods) if idx < run_end
+                            else ("post", post_mods))
+                lst.append(mod)
+                prefix = f"{seg}.{len(lst) - 1}."
+                owner_prefix[id(mod)] = prefix
+            else:
+                prefix = owner_prefix[id(mod)]   # shared reuse
+            self._positions.append((prefix, mod, fwd))
+        self._run_bounds = (run_start, run_end)
+        self.pre = LayerList(pre_mods)
+        self.blocks = LayerList(block_mods)
+        self.post = LayerList(post_mods)
+        self._loss_fn = loss_fn
+
+    def forward(self, x):
+        for _, mod, fwd in self._positions:
+            x = mod(x) if fwd is None else fwd(mod, x)
+        return x
+
+    def loss(self, x, labels):
+        if self._loss_fn is None:
+            raise ValueError("PipelineLayer was built without a loss_fn")
+        from ..core.tensor import Tensor
+        out = self.forward(x)
+        out = out._value if isinstance(out, Tensor) else out
+        labels = labels._value if isinstance(labels, Tensor) else labels
+        return Tensor(self._loss_fn(out, labels))
+
+    def make_loss_fn(self):
+        """Non-pipelined loss with ``make_sharded_train_step``'s
+        ``loss_fn(model, params, buffers, batch, rng)`` signature — the
+        single-device / pp=1 counterpart of the pipelined objective (used
+        by the parity tests; numerics match the pp path exactly when
+        dropout is off)."""
+        if self._loss_fn is None:
+            raise ValueError("PipelineLayer was built without a loss_fn")
+        positions, user_loss = self._positions, self._loss_fn
+        from ..core import random as core_random
+
+        def loss_fn(model, params, buffers, batch, rng):
+            ids, labels = batch
+            with core_random.rng_scope(rng):
+                y = _apply_positions(positions, params, buffers, ids)
+            return user_loss(y, labels)
+
+        return loss_fn
+
+    def pipeline_stage_spec(self) -> dict:
+        """The pp decomposition ``make_sharded_train_step`` consumes —
+        derived from the desc list instead of hand-written per model
+        (ref ``pp_layers.py:162`` segmentation)."""
+        if self._loss_fn is None:
+            raise ValueError(
+                "PipelineLayer needs a loss_fn to build the pipeline "
+                "objective (post_fn returns the scalar loss)")
+        run_start, run_end = self._run_bounds
+        pre_pos = self._positions[:run_start]
+        post_pos = self._positions[run_end:]
+        template = self.blocks[0]
+        user_loss = self._loss_fn
+        _, captured_buffers = self.functional_state()
+        from ..core import random as core_random
+        from ..core.tensor import Tensor
+        from ..nn.layer import functional_call
+
+        def pre_fn(params, buffers, ids, key):
+            with core_random.rng_scope(key):
+                return _apply_positions(pre_pos, params,
+                                        buffers or captured_buffers, ids)
+
+        def layer_fn(layer_params, x):
+            return functional_call(template, layer_params, (Tensor(x),))
+
+        def post_fn(params, x, labels):
+            y = _apply_positions(post_pos, params, captured_buffers, x)
+            return user_loss(y, labels)
+
+        return {"block_prefix": "blocks.",
+                "num_layers": len(self.blocks),
+                "pre_fn": pre_fn, "layer_fn": layer_fn, "post_fn": post_fn}
 
 
 class PipelineParallel:

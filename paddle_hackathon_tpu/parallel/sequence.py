@@ -203,14 +203,21 @@ def ring_attention(q, k, v, mesh: Mesh, axis: str = "sp",
     n = mesh.shape.get(axis, 1)
     if n == 1:
         return _plain_attention(q, k, v, causal, scale)
+    from ._smap import active_manual_axes, run_shard_map
+    # inside an enclosing shard_map already manual over `axis` (e.g. the
+    # pp pipeline region): inputs are LOCAL chunks; run the per-device
+    # body directly — a nested shard_map would re-bind the axis (Shardy
+    # rejects it)
+    in_manual = axis in active_manual_axes()
+    seq_local = q.shape[1] if in_manual else q.shape[1] // n
     scale_ = scale if scale is not None else q.shape[-1] ** -0.5
-    seq_local = q.shape[1] // n
 
     flash = use_flash if use_flash is not None else _flash_ok(
         seq_local, q.dtype)
     if flash:
-        from ._smap import run_shard_map
         spmd = _ring_flash_spmd(axis, n, causal, float(scale_))
+        if in_manual:
+            return spmd(q, k, v)
         return run_shard_map(
             spmd, mesh,
             in_specs=(P(None, axis), P(None, axis), P(None, axis)),
@@ -260,7 +267,8 @@ def ring_attention(q, k, v, mesh: Mesh, axis: str = "sp",
         out = o / jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
         return out.astype(ql.dtype)
 
-    from ._smap import run_shard_map
+    if in_manual:
+        return spmd(q, k, v)
     return run_shard_map(
         spmd, mesh,
         in_specs=(P(None, axis), P(None, axis), P(None, axis)),
@@ -279,9 +287,11 @@ def ulysses_attention(q, k, v, mesh: Mesh, axis: str = "sp",
     n = mesh.shape.get(axis, 1)
     if n == 1:
         return _plain_attention(q, k, v, causal, scale)
+    from ._smap import active_manual_axes, run_shard_map
+    in_manual = axis in active_manual_axes()
     scale_ = scale if scale is not None else q.shape[-1] ** -0.5
     assert q.shape[2] % n == 0, "ulysses needs num_heads divisible by sp"
-    s_full = q.shape[1]
+    s_full = q.shape[1] * n if in_manual else q.shape[1]
     flash = use_flash if use_flash is not None else _flash_ok(
         s_full, q.dtype)
 
@@ -316,13 +326,60 @@ def ulysses_attention(q, k, v, mesh: Mesh, axis: str = "sp",
                    ).astype(ql.dtype)
         return heads_to_seq(out.astype(ql.dtype))
 
-    from ._smap import run_shard_map
+    if in_manual:
+        return spmd(q, k, v)
     return run_shard_map(
         spmd, mesh,
         in_specs=(P(None, axis), P(None, axis), P(None, axis)),
         out_specs=P(None, axis),
         manual_axes={axis},
         args=(q, k, v))
+
+
+def enable_sequence_parallel(model, axis: str = "sp", mesh: Optional[Mesh]
+                             = None, mode: str = "auto") -> int:
+    """Switch every sp-capable attention layer in ``model`` to the
+    sequence-parallel schedule — the model-agnostic hook (any model built
+    on attention modules carrying ``supports_sequence_parallel`` gets
+    ring/Ulysses for free; ``nn.layers.transformer.SequenceParallelMixin``).
+
+    ``mode``: 'ring' | 'ulysses' | 'auto' (ulysses when heads divide the
+    sp degree). Returns the number of layers switched; raises if the model
+    has none, or if any switched layer has attention dropout (the ring
+    kernels regenerate dropout only on the single-chip path).
+    """
+    n = 0
+    for layer in model.sublayers(include_self=True):
+        if not getattr(layer, "supports_sequence_parallel", False):
+            continue
+        drop = getattr(layer, "dropout_p", None)
+        if drop is None:
+            drop = getattr(layer, "dropout", 0.0)
+        if isinstance(drop, (int, float)) and drop > 0:
+            raise ValueError(
+                "sequence parallelism requires attention dropout 0 "
+                f"(found {drop} on {type(layer).__name__})")
+        layer.seq_parallel_axis = axis
+        layer.seq_parallel_mesh = mesh
+        layer.seq_parallel_mode = mode
+        n += 1
+    if n == 0:
+        raise ValueError(
+            f"{type(model).__name__} has no sequence-parallel-capable "
+            "attention layers (supports_sequence_parallel)")
+    return n
+
+
+def disable_sequence_parallel(model) -> int:
+    """Clear the sp switch on every capable layer (a non-sp step must not
+    inherit the ring schedule from a previous sp step)."""
+    n = 0
+    for layer in model.sublayers(include_self=True):
+        if getattr(layer, "supports_sequence_parallel", False):
+            layer.seq_parallel_axis = None
+            layer.seq_parallel_mesh = None
+            n += 1
+    return n
 
 
 def _plain_attention(q, k, v, causal, scale):
