@@ -13,10 +13,23 @@ from __future__ import annotations
 
 import json
 import os
+import re
 
 import numpy as np
 
 __all__ = ["CostModel"]
+
+# configs carry either the reference's long dtype spelling
+# ("dtype: float32") or this build's compact form ("x f32 [...]",
+# tools/gen_op_benchmark.py) — match both.  Word-bounded so "f16" never
+# matches inside "bf16".  Precompiled once: get_static_op_time is called
+# per-op when pricing whole programs.
+_SHORT_DTYPE_RE = {
+    long: re.compile(rf"\b{short}\b")
+    for long, short in {"float32": "f32", "bfloat16": "bf16",
+                        "float16": "f16", "float64": "f64",
+                        "int32": "i32", "int64": "i64"}.items()
+}
 
 
 class CostModel:
@@ -84,18 +97,12 @@ class CostModel:
         if self._static_cost_data is None:
             self.static_cost_data()
         op_cost = {}
-        # configs carry either the reference's long dtype spelling
-        # ("dtype: float32") or this build's compact form ("x f32 [...]",
-        # tools/gen_op_benchmark.py) — match both.  Word-bounded so
-        # "f16" never matches inside "bf16".
-        import re
-        short = {"float32": "f32", "bfloat16": "bf16", "float16": "f16",
-                 "float64": "f64", "int32": "i32", "int64": "i64"}.get(dtype)
+        short_re = _SHORT_DTYPE_RE.get(dtype)
         for op_data in self._static_cost_data:
             cfg = op_data["config"]
             if op_data["op"] == op_name and (
                     f"dtype: {dtype}" in cfg
-                    or (short and re.search(rf"\b{short}\b", cfg))):
+                    or (short_re and short_re.search(cfg))):
                 if forward:
                     op_cost["op_time"] = op_data["paddle_gpu_time"]
                 else:

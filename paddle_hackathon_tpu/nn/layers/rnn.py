@@ -167,6 +167,14 @@ def _step_masks(seq, steps, dtype):
     return M.unstack(full, axis=0)
 
 
+def _zeros_like_states(s):
+    """The cells' default initial state is zeros (get_initial_states), so
+    a zeros pytree stands in for it when masking step 0."""
+    if isinstance(s, (tuple, list)):
+        return type(s)(_zeros_like_states(x) for x in s)
+    return s * 0
+
+
 def _mask_states(new_states, old_states, m):
     """new*m + old*(1-m) over a (possibly nested) state pytree — states
     freeze once a row's sequence has ended (ref: the per-step mask the
@@ -214,8 +222,12 @@ class RNN(Layer):
                     masks = _step_masks(seq, steps, out.dtype)
                 m = masks[t]
                 out = out * m
-                states = new_states if states is None \
-                    else _mask_states(new_states, states, m)
+                # step 0 with default states masks against the zeros the
+                # cell starts from — a length-0 row keeps its initial
+                # state instead of silently advancing
+                old = (_zeros_like_states(new_states) if states is None
+                       else states)
+                states = _mask_states(new_states, old, m)
             else:
                 states = new_states
             outs.append(out)

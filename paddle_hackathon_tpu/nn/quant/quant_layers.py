@@ -128,8 +128,11 @@ class FakeQuantMovingAverageAbsMax(Layer):
         # untrained observer in eval must not collapse activations to zero
         self.register_buffer("scale", Tensor(jnp.full([1], 1e-3,
                                                       jnp.float32)))
-        self.register_buffer("state", Tensor(jnp.zeros([1], jnp.float32)))
-        self.register_buffer("accum", Tensor(jnp.zeros([1], jnp.float32)))
+        # state/accum start at 1 (ref Constant(1), quant_layers.py:160-171)
+        # so the first update yields (rate + absmax) / (rate + 1) — the
+        # reference's early-step EMA trajectory, not raw absmax
+        self.register_buffer("state", Tensor(jnp.ones([1], jnp.float32)))
+        self.register_buffer("accum", Tensor(jnp.ones([1], jnp.float32)))
 
     def forward(self, x):
         x = _t(x)
@@ -153,8 +156,11 @@ class MovingAverageAbsMaxScale(Layer):
         self._moving_rate = moving_rate
         self.register_buffer("scale", Tensor(jnp.full([1], 1e-3,
                                                       jnp.float32)))
-        self.register_buffer("state", Tensor(jnp.zeros([1], jnp.float32)))
-        self.register_buffer("accum", Tensor(jnp.zeros([1], jnp.float32)))
+        # state/accum start at 1 (ref Constant(1), quant_layers.py:160-171)
+        # so the first update yields (rate + absmax) / (rate + 1) — the
+        # reference's early-step EMA trajectory, not raw absmax
+        self.register_buffer("state", Tensor(jnp.ones([1], jnp.float32)))
+        self.register_buffer("accum", Tensor(jnp.ones([1], jnp.float32)))
 
     def forward(self, x):
         x = _t(x)

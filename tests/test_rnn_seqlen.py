@@ -131,3 +131,17 @@ class TestStacksAndWrappers:
         # padded inputs of row 2 (len 1) must get zero gradient
         assert np.all(g[2, 1:] == 0)
         assert np.any(g[2, 0] != 0)
+
+    def test_length_zero_row_keeps_initial_state(self, data):
+        """A row with sequence length 0 must freeze at the cell's initial
+        (zeros) state even when initial_states=None — the step-0 state
+        used to be taken unmasked (advisor r3)."""
+        x, _ = data
+        lens = np.array([6, 3, 0], np.int32)
+        paddle.seed(7)
+        cell = nn.SimpleRNNCell(4, 5)
+        r = RNN(cell)
+        out, final = r(Tensor(x), sequence_length=Tensor(lens))
+        final = np.asarray(final.numpy())
+        assert np.all(final[2] == 0)            # frozen at initial zeros
+        assert np.any(final[0] != 0)

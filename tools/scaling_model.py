@@ -202,10 +202,25 @@ def main():
     for name, (t_comp, b) in configs.items():
         if b is None:
             continue
-        print(f"\n{name}: payload {b / 1e6:.1f} MB, "
-              f"compute {t_comp * 1e3:.1f} ms/step")
+        print(f"\n{name}:")
+        print(f"  MEASURED — payload {b / 1e6:.1f} MB (optimized-HLO "
+              "collective bytes, mesh-size-invariant), compute "
+              f"{t_comp * 1e3:.1f} ms/step (single-chip wall clock, "
+              "BASELINE.md)")
+        print("  MODELED  — bidirectional-ring cost on public constants "
+              "(v5e ICI 2x4.5e10 B/s/axis, DCN 2.5e9 B/s/chip, "
+              "jax-ml.github.io/scaling-book); NOT a hardware measurement")
         for row in efficiency_table(b, t_comp):
             print(f"  {row['chips']:4d} chips  comm {row['t_comm_ms']:7.2f} ms"
+                  f"  eff(overlap) {row['eff_overlap']:6.1%}"
+                  f"  eff(no-overlap) {row['eff_no_overlap']:6.1%}")
+        # DCN is the weakest modeled constant (no error bars on the public
+        # number): report the 256-chip row at 0.5x / 2x DCN bandwidth
+        for factor in (0.5, 2.0):
+            row = efficiency_table(b, t_comp, chips=(256,),
+                                   dcn_bw_chip=2.5e9 * factor)[0]
+            print(f"   256 chips @ {factor:g}x DCN  "
+                  f"comm {row['t_comm_ms']:7.2f} ms"
                   f"  eff(overlap) {row['eff_overlap']:6.1%}"
                   f"  eff(no-overlap) {row['eff_no_overlap']:6.1%}")
 
