@@ -117,11 +117,13 @@ class GPTAttention(SequenceParallelMixin, Layer):
             return self.out_proj(out)
         if cache_pos is not None:
             # static-cache decode (jit-once generation): cache is a fixed
-            # (B, max_len, H, D) pair, this call's k/v land at
-            # [cache_pos, cache_pos+s), queries attend over cached
-            # positions <= their global position.  Same masking scheme as
-            # incubate's fused cache_kv path; compiled shapes never change
-            # across decode steps.
+            # (B, max_len, H, D) pair — the train-time layout, so the
+            # per-step cache write is an in-place contiguous
+            # dynamic_update_slice (a head-major variant measured 68
+            # us/step of full-cache copies when XLA lost the aliasing).
+            # This call's k/v land at [cache_pos, cache_pos+s); queries
+            # attend over cached positions <= their global position.
+            # Compiled shapes never change across decode steps.
             import math as _math
 
             import jax
@@ -136,9 +138,15 @@ class GPTAttention(SequenceParallelMixin, Layer):
                                                   start)
                 vb = jax.lax.dynamic_update_slice(vb, vv.astype(vb.dtype),
                                                   start)
+                # NOTE round-4: three Pallas fused-decode-attention
+                # variants (3-D VPU, per-head MXU dots, head-batched
+                # dot_general) measured 23/37/49 us/layer vs ~21 us for
+                # this XLA composition at b8 T192 — kernel fixed costs
+                # dominate at decode shapes; the composition stays
+                # (BASELINE.md round-4 decode trace table)
+                scale = 1.0 / _math.sqrt(qv.shape[-1])
                 logits = jnp.einsum("bshe,bthe->bhst", qv,
-                                    kb.astype(qv.dtype))
-                logits = logits / _math.sqrt(qv.shape[-1])
+                                    kb.astype(qv.dtype)) * scale
                 qpos = pos.astype(jnp.int32) + jnp.arange(qv.shape[1])[:, None]
                 kpos = jnp.arange(kb.shape[1])[None, :]
                 logits = jnp.where((kpos <= qpos)[None, None], logits,
@@ -365,6 +373,24 @@ class GPTForCausalLM(Layer):
             key = core_random.split_key()
         return jax.random.categorical(key, last)[:, None]
 
+    def _param_mesh(self):
+        """The device mesh the model's parameters are placed on, or None.
+
+        When ``parallel.shard_params`` placed the weights (TP serving: a
+        model that needs 'mp' to fit), the decode program composes the
+        same mesh: KV caches shard their heads dim on 'mp', the batch on
+        the data axes, and GSPMD inserts the in-decode collectives — the
+        reference's ``fused_multi_transformer_op.cu`` runs its allreduce
+        inside the fused decode step the same way (ring id argument), and
+        ``DistModel`` serves multi-rank (``dist_model.cc``)."""
+        from jax.sharding import NamedSharding
+        sh = getattr(self.gpt.wte.weight._value, "sharding", None)
+        if isinstance(sh, NamedSharding) and any(
+                sh.mesh.shape.get(a, 1) > 1
+                for a in ("mp", "dp", "sharding")):
+            return sh.mesh
+        return None
+
     def _generate_static(self, input_ids, max_new_tokens, temperature,
                          top_k):
         """One compiled program generates ALL tokens: prefill + a
@@ -393,6 +419,23 @@ class GPTForCausalLM(Layer):
         caches = [(jnp.zeros((b, max_len, cfg.num_heads, head_dim), dtype),
                    jnp.zeros((b, max_len, cfg.num_heads, head_dim), dtype))
                   for _ in range(cfg.num_layers)]
+        mesh = self._param_mesh()
+        if mesh is not None:
+            # TP/DP-sharded decode: caches shard heads on 'mp' (the qkv
+            # projection's natural output sharding) and batch on the data
+            # axes; ids likewise.  GSPMD then inserts the out_proj psum
+            # and the vocab-parallel argmax/sample collectives inside the
+            # one decode program.
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from ..parallel.api import batch_spec
+            bspec = batch_spec(mesh)
+            bax = bspec[0] if len(bspec) else None
+            hax = "mp" if mesh.shape.get("mp", 1) > 1 else None
+            cache_sh = NamedSharding(mesh, P(bax, None, hax, None))
+            caches = [(jax.device_put(k, cache_sh),
+                       jax.device_put(v, cache_sh)) for k, v in caches]
+            ids = jax.device_put(ids, NamedSharding(mesh, P(bax, None)))
         params, buffers = self.functional_state()
         greedy = temperature == 0.0
 
@@ -403,14 +446,16 @@ class GPTForCausalLM(Layer):
         cache_key = (b, prompt, max_new_tokens, greedy,
                      float(temperature), top_k, str(dtype))
 
-        def _invoke(run):
+        def _invoke(entry):
             # greedy decode must not consume the global RNG (the eager
             # concat path doesn't) — seeded runs stay reproducible across
-            # both paths
-            key = (jax.random.key(0) if greedy
-                   else core_random.split_key())
-            outbuf = run(params, ids, caches, key)
-            return Tensor(jnp.concatenate([ids, outbuf], axis=1))
+            # both paths.  The greedy key is created ONCE per program (the
+            # sampler never reads it): an eager key per call costs a full
+            # host round trip on remote-dispatch setups (~100 ms through
+            # the axon tunnel — BASELINE round-4 decode notes).
+            run, greedy_key = entry
+            key = greedy_key if greedy else core_random.split_key()
+            return Tensor(run(params, ids, caches, key))
 
         if cache_key in gen_cache:
             return _invoke(gen_cache[cache_key])
@@ -446,12 +491,15 @@ class GPTForCausalLM(Layer):
 
             _, _, outbuf = jax.lax.fori_loop(
                 0, max_new_tokens - 1, body, (caches, nxt, outbuf))
-            return outbuf
+            # concat INSIDE the program: an eager concat after the call
+            # would be one more host round trip per generate()
+            return jnp.concatenate([ids, outbuf], axis=1)
 
         if len(gen_cache) >= 32:      # FIFO bound: variable-length serving
             gen_cache.pop(next(iter(gen_cache)))  # must not grow unbounded
-        gen_cache[cache_key] = run
-        return _invoke(run)
+        entry = (run, jax.random.key(0) if greedy else None)
+        gen_cache[cache_key] = entry
+        return _invoke(entry)
 
     def enable_sequence_parallel(self, axis: str = "sp", mesh=None,
                                  mode: str = "auto"):

@@ -44,28 +44,35 @@ _WORKER = """
     assert len(jax.local_devices()) == 4
     assert len(jax.devices()) == 8
 
-    paddle.seed(123)
-    cfg = GPTConfig(vocab_size=128, hidden_size=64, num_layers=2,
-                    num_heads=4, max_position_embeddings=32,
-                    hidden_dropout_prob=0.0, attention_dropout_prob=0.0,
-                    use_flash_attention=False)
-    model = GPTForCausalLM(cfg)
-    mesh = parallel.create_mesh({"dp": 4, "mp": 2})
-    step, state = parallel.make_sharded_train_step(
-        model, mesh, rule=param_sharding_spec, learning_rate=1e-3,
-        grad_clip_norm=None)
-    r = np.random.RandomState(0)
-    ids = jnp.asarray(r.randint(0, 128, (8, 16)), jnp.int32)
-    labels = jnp.asarray(r.randint(0, 128, (8, 16)), jnp.int32)
-    losses = []
-    for i in range(3):
-        state, loss = step(state, ids, labels, jax.random.key(0))
-        losses.append(float(loss))
-    print("LOSSES", jax.process_index(), json.dumps(losses))
+    def run(mesh_dims):
+        paddle.seed(123)
+        cfg = GPTConfig(vocab_size=128, hidden_size=64, num_layers=2,
+                        num_heads=4, max_position_embeddings=32,
+                        hidden_dropout_prob=0.0, attention_dropout_prob=0.0,
+                        use_flash_attention=False)
+        model = GPTForCausalLM(cfg)
+        mesh = parallel.create_mesh(mesh_dims)
+        step, state = parallel.make_sharded_train_step(
+            model, mesh, rule=param_sharding_spec, learning_rate=1e-3,
+            grad_clip_norm=None)
+        r = np.random.RandomState(0)
+        ids = jnp.asarray(r.randint(0, 128, (8, 16)), jnp.int32)
+        labels = jnp.asarray(r.randint(0, 128, (8, 16)), jnp.int32)
+        losses = []
+        for i in range(3):
+            state, loss = step(state, ids, labels, jax.random.key(0))
+            losses.append(float(loss))
+        return losses
+
+    out = {"dpmp": run({"dp": 4, "mp": 2}),
+           # the pp axis SPANS the two processes: the 1F1B ppermute ticks
+           # cross the controller boundary
+           "ppdpmp": run({"pp": 2, "dp": 2, "mp": 2})}
+    print("LOSSES", jax.process_index(), json.dumps(out))
 """ % _REPO
 
 
-def _single_process_reference():
+def _single_process_reference(mesh_dims):
     """The same mesh/model/data in THIS (8-virtual-device) process."""
     import jax
     import jax.numpy as jnp
@@ -81,7 +88,7 @@ def _single_process_reference():
                     hidden_dropout_prob=0.0, attention_dropout_prob=0.0,
                     use_flash_attention=False)
     model = GPTForCausalLM(cfg)
-    mesh = parallel.create_mesh({"dp": 4, "mp": 2})
+    mesh = parallel.create_mesh(mesh_dims)
     step, state = parallel.make_sharded_train_step(
         model, mesh, rule=param_sharding_spec, learning_rate=1e-3,
         grad_clip_norm=None)
@@ -110,8 +117,13 @@ def test_two_process_trainstep_matches_single_process(tmp_path):
             _, rank, payload = line.split(" ", 2)
             per_rank[int(rank)] = json.loads(payload)
     assert sorted(per_rank) == [0, 1], logs
-    # both controllers run the same SPMD program — identical losses
-    np.testing.assert_allclose(per_rank[0], per_rank[1], rtol=1e-6)
-
-    single = _single_process_reference()
-    np.testing.assert_allclose(per_rank[0], single, rtol=2e-4)
+    for config in ("dpmp", "ppdpmp"):
+        # both controllers run the same SPMD program — identical losses
+        np.testing.assert_allclose(per_rank[0][config], per_rank[1][config],
+                                   rtol=1e-6, err_msg=config)
+    np.testing.assert_allclose(per_rank[0]["dpmp"],
+                               _single_process_reference({"dp": 4, "mp": 2}),
+                               rtol=2e-4)
+    np.testing.assert_allclose(
+        per_rank[0]["ppdpmp"],
+        _single_process_reference({"pp": 2, "dp": 2, "mp": 2}), rtol=2e-4)

@@ -49,6 +49,24 @@ class TestBert:
         g = m.bert.embeddings.word_embeddings.weight.grad
         assert g is not None and np.isfinite(g.numpy()).all()
 
+    def test_masked_positions_head_matches_full_logits(self):
+        """masked_positions path (decode only masked rows — the
+        reference's pretraining-heads contract) must equal gathering
+        from the full-logits path."""
+        import jax.numpy as jnp
+        from paddle_hackathon_tpu.core.tensor import Tensor
+        paddle.seed(4)
+        m = BertForPretraining(_tiny())
+        m.eval()
+        r = np.random.RandomState(0)
+        ids = Tensor(jnp.asarray(r.randint(0, 128, (2, 16)), jnp.int32))
+        pos = jnp.asarray([1, 5, 17, 30], jnp.int32)   # flat b*s indices
+        full, _ = m(ids)
+        gathered, _ = m(ids, masked_positions=Tensor(pos))
+        full_rows = np.asarray(full.numpy()).reshape(-1, 128)[np.asarray(pos)]
+        np.testing.assert_allclose(np.asarray(gathered.numpy()), full_rows,
+                                   rtol=1e-5, atol=1e-5)
+
     def test_classifier_overfits_tiny_batch(self):
         from paddle_hackathon_tpu.optimizer import Adam
         paddle.seed(2)

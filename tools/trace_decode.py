@@ -1,0 +1,71 @@
+"""Trace one jitted greedy-decode program (GPTForCausalLM._generate_static)
+and aggregate per-op device durations — the decode counterpart of
+trace_step.py (VERDICT r4 directive #3: name where the 1.98 ms/token-step
+goes vs the ~0.3 ms param-read floor).
+
+Usage: python tools/trace_decode.py [batch] [prompt] [new_tokens]
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def main(batch=8, prompt=64, new_tokens=128, outdir="/tmp/trace_decode"):
+    import paddle_hackathon_tpu as paddle
+    from paddle_hackathon_tpu.core.tensor import Tensor
+    from paddle_hackathon_tpu.models import GPTForCausalLM, gpt_config
+
+    paddle.seed(0)
+    cfg = gpt_config("gpt2-small-en", hidden_dropout_prob=0.0,
+                     attention_dropout_prob=0.0)
+    model = GPTForCausalLM(cfg)
+    for _, p in model.named_parameters():
+        if jnp.issubdtype(p._value.dtype, jnp.floating):
+            p._set_value(p._value.astype(jnp.bfloat16))
+    model.eval()
+    rng = np.random.RandomState(0)
+    ids = Tensor(jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, prompt)),
+                             jnp.int32))
+
+    import time
+    out = model.generate(ids, max_new_tokens=new_tokens, temperature=0.0)
+    jax.block_until_ready(out._value)          # compile + warm
+    reps = 5
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = model.generate(ids, max_new_tokens=new_tokens, temperature=0.0)
+    jax.block_until_ready(out._value)
+    wall = (time.perf_counter() - t0) / reps
+    tok_s = batch * new_tokens / wall
+    print(f"wall: {wall*1e3:.1f} ms/call  {tok_s:,.0f} tok/s  "
+          f"{wall*1e3/new_tokens:.3f} ms/token-step")
+
+    import shutil
+    shutil.rmtree(outdir, ignore_errors=True)
+    jax.profiler.start_trace(outdir)
+    out = model.generate(ids, max_new_tokens=new_tokens, temperature=0.0)
+    jax.block_until_ready(out._value)
+    jax.profiler.stop_trace()
+
+    from trace_util import bucket_by_mnemonic, xla_op_durations_ms
+    ind = xla_op_durations_ms(outdir)
+    agg = bucket_by_mnemonic(ind)
+    total = sum(ind.values())
+    print(f"total device op time: {total:.2f} ms/call "
+          f"({total/new_tokens:.4f} ms/token-step op-time)")
+    for name, dur in agg.most_common(20):
+        print(f"  {name:40s} {dur:8.2f} ms")
+    print("top individual ops:")
+    for name, dur in ind.most_common(30):
+        print(f"  {name[:78]:78s} {dur:8.3f} ms")
+
+
+if __name__ == "__main__":
+    args = [int(a) for a in sys.argv[1:4]]
+    main(*args)

@@ -22,21 +22,52 @@ def bucket_by_mnemonic(durs):
     return agg
 
 
-def xla_op_durations_ms(outdir):
-    """Counter of {op name: total device ms} summed over every event on an
-    "XLA Ops" thread in the newest trace under ``outdir``."""
+def _xla_ops_events(outdir):
+    """X events on "XLA Ops" threads of the newest trace under ``outdir``,
+    as [(thread_key, name, ts, dur_us)] — the single owner of the
+    trace-file schema (thread_name metadata + X events)."""
     paths = glob.glob(os.path.join(outdir, "**", "*.trace.json.gz"),
                       recursive=True)
     if not paths:
-        return collections.Counter()
+        return []
     with gzip.open(max(paths, key=os.path.getmtime), "rt") as fh:
         trace = json.load(fh)
     events = trace["traceEvents"]
     tids = {(e["pid"], e["tid"]): e["args"]["name"] for e in events
             if e.get("ph") == "M" and e.get("name") == "thread_name"}
     op_tids = {k for k, v in tids.items() if "XLA Ops" in v}
+    return [((e["pid"], e["tid"]), e["name"], e["ts"], e.get("dur", 0))
+            for e in events
+            if e.get("ph") == "X" and (e.get("pid"), e.get("tid")) in op_tids]
+
+
+def xla_op_durations_ms(outdir):
+    """Counter of {op name: total device ms} summed over every event on an
+    "XLA Ops" thread in the newest trace under ``outdir``."""
     durs = collections.Counter()
-    for e in events:
-        if e.get("ph") == "X" and (e.get("pid"), e.get("tid")) in op_tids:
-            durs[e["name"]] += e.get("dur", 0) / 1e3
+    for _, name, _, dur in _xla_ops_events(outdir):
+        durs[name] += dur / 1e3
     return durs
+
+
+def toplevel_device_ms(outdir):
+    """Total device ms counting nested ops ONCE: a ``while`` op's X event
+    spans its whole loop execution and the body ops appear as separate
+    events inside that span — summing all durations double-counts. Sums
+    only events not contained in an earlier event's span on the same
+    XLA-Ops thread."""
+    per_thread = collections.defaultdict(list)
+    for key, _, ts, dur in _xla_ops_events(outdir):
+        per_thread[key].append((ts, dur))
+    total = 0.0
+    for evs in per_thread.values():
+        evs.sort()
+        cover_end = -1.0
+        for ts, dur in evs:
+            if ts >= cover_end:
+                total += dur
+                cover_end = ts + dur
+            elif ts + dur > cover_end:   # partial overlap: count the tail
+                total += ts + dur - cover_end
+                cover_end = ts + dur
+    return total / 1e3
