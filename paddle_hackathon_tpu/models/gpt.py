@@ -411,6 +411,14 @@ class GPTForCausalLM(Layer):
             # only built for >=1 new tokens; the eager path returns the
             # prompt unchanged for the same input
             return Tensor(ids)
+        pp_mesh = None
+        from ..parallel.api import get_mesh as _get_mesh
+        amb = _get_mesh()
+        if amb is not None and amb.shape.get("pp", 1) > 1:
+            pp_mesh = amb
+        if pp_mesh is not None:
+            return self._generate_static_pp(ids, max_new_tokens,
+                                            temperature, top_k, pp_mesh)
         b, prompt = ids.shape
         cfg = self.config
         head_dim = cfg.hidden_size // cfg.num_heads
@@ -437,28 +445,11 @@ class GPTForCausalLM(Layer):
                        jax.device_put(v, cache_sh)) for k, v in caches]
             ids = jax.device_put(ids, NamedSharding(mesh, P(bax, None)))
         params, buffers = self.functional_state()
-        greedy = temperature == 0.0
-
-        # the jitted program is cached per decode configuration — rebuilding
-        # the closure every call would recompile every call (jax's jit cache
+        # programs are cached per decode configuration — rebuilding the
+        # closure every call would recompile every call (jax's jit cache
         # keys on function identity)
-        gen_cache = self.__dict__.setdefault("_gen_program_cache", {})
-        cache_key = (b, prompt, max_new_tokens, greedy,
+        cache_key = (b, prompt, max_new_tokens, temperature == 0.0,
                      float(temperature), top_k, str(dtype))
-
-        def _invoke(entry):
-            # greedy decode must not consume the global RNG (the eager
-            # concat path doesn't) — seeded runs stay reproducible across
-            # both paths.  The greedy key is created ONCE per program (the
-            # sampler never reads it): an eager key per call costs a full
-            # host round trip on remote-dispatch setups (~100 ms through
-            # the axon tunnel — BASELINE round-4 decode notes).
-            run, greedy_key = entry
-            key = greedy_key if greedy else core_random.split_key()
-            return Tensor(run(params, ids, caches, key))
-
-        if cache_key in gen_cache:
-            return _invoke(gen_cache[cache_key])
 
         def fwd(params, ids_in, caches, pos):
             return functional_call(
@@ -466,40 +457,158 @@ class GPTForCausalLM(Layer):
                 kwargs={"caches": caches, "cache_pos": pos},
                 buffers=buffers, training=False)
 
-        def sample(last, key):
-            return self._sample(last, temperature, top_k, key=key)
+        return self._run_decode_program(
+            cache_key, fwd, params, ids, caches, temperature, top_k,
+            b, prompt, max_new_tokens)
 
-        @jax.jit
-        def run(params, ids, caches, key):
-            logits, caches = fwd(params, ids, caches,
-                                 jnp.asarray(0, jnp.int32))
-            nxt = sample(logits[:, -1, :], jax.random.fold_in(key, 0))
-            nxt = nxt.astype(ids.dtype)
-            outbuf = jnp.zeros((b, max_new_tokens), ids.dtype)
-            outbuf = jax.lax.dynamic_update_slice(outbuf, nxt, (0, 0))
+    def _run_decode_program(self, cache_key, fwd, params, ids, caches,
+                            temperature, top_k, b, prompt, max_new_tokens,
+                            mesh=None):
+        """Build-or-reuse the jitted decode program and invoke it —
+        scaffolding shared by the single/mp path and the pp path (only
+        ``fwd(params, ids_in, caches, pos) -> (logits, caches)``
+        differs).  Prefill + ``lax.fori_loop`` token loop + in-jit
+        sampling + in-program concat; the greedy key is created ONCE per
+        program (the sampler never reads it — an eager key per call costs
+        a full host round trip on remote-dispatch setups, ~100 ms through
+        the axon tunnel; BASELINE round-4 decode notes)."""
+        import contextlib
 
-            def body(t, carry):
-                caches, cur, outbuf = carry
-                logits, caches = fwd(params, cur, caches,
-                                     (prompt + t).astype(jnp.int32))
+        import jax
+        import jax.numpy as jnp
+
+        from ..core import random as core_random
+
+        greedy = temperature == 0.0
+        gen_cache = self.__dict__.setdefault("_gen_program_cache", {})
+        if cache_key not in gen_cache:
+            def sample(last, key):
+                return self._sample(last, temperature, top_k, key=key)
+
+            @jax.jit
+            def run(params, ids, caches, key):
+                logits, caches_ = fwd(params, ids, caches,
+                                      jnp.asarray(0, jnp.int32))
                 nxt = sample(logits[:, -1, :],
-                             jax.random.fold_in(key, t + 1))
-                nxt = nxt.astype(ids.dtype)
-                outbuf = jax.lax.dynamic_update_slice(
-                    outbuf, nxt, (jnp.asarray(0, jnp.int32), t + 1))
-                return caches, nxt, outbuf
+                             jax.random.fold_in(key, 0)).astype(ids.dtype)
+                outbuf = jnp.zeros((b, max_new_tokens), ids.dtype)
+                outbuf = jax.lax.dynamic_update_slice(outbuf, nxt, (0, 0))
 
-            _, _, outbuf = jax.lax.fori_loop(
-                0, max_new_tokens - 1, body, (caches, nxt, outbuf))
-            # concat INSIDE the program: an eager concat after the call
-            # would be one more host round trip per generate()
-            return jnp.concatenate([ids, outbuf], axis=1)
+                def body(t, carry):
+                    caches_, cur, outbuf = carry
+                    logits, caches2 = fwd(params, cur, caches_,
+                                          (prompt + t).astype(jnp.int32))
+                    nx = sample(logits[:, -1, :],
+                                jax.random.fold_in(key, t + 1)
+                                ).astype(ids.dtype)
+                    outbuf = jax.lax.dynamic_update_slice(
+                        outbuf, nx, (jnp.asarray(0, jnp.int32), t + 1))
+                    return caches2, nx, outbuf
 
-        if len(gen_cache) >= 32:      # FIFO bound: variable-length serving
-            gen_cache.pop(next(iter(gen_cache)))  # must not grow unbounded
-        entry = (run, jax.random.key(0) if greedy else None)
-        gen_cache[cache_key] = entry
-        return _invoke(entry)
+                _, _, outbuf = jax.lax.fori_loop(
+                    0, max_new_tokens - 1, body, (caches_, nxt, outbuf))
+                # concat INSIDE the program: an eager concat after the
+                # call would be one more host round trip per generate()
+                return jnp.concatenate([ids, outbuf], axis=1)
+
+            if len(gen_cache) >= 32:  # FIFO bound: variable-length serving
+                gen_cache.pop(next(iter(gen_cache)))  # must not grow
+            gen_cache[cache_key] = (run, jax.random.key(0) if greedy
+                                    else None)
+        run, greedy_key = gen_cache[cache_key]
+        key = greedy_key if greedy else core_random.split_key()
+        ctx = (jax.set_mesh(mesh) if mesh is not None
+               else contextlib.nullcontext())
+        with ctx:  # partial-manual shard_map (pp) needs the ambient mesh
+            return Tensor(run(params, ids, caches, key))
+
+    def _generate_static_pp(self, ids, max_new_tokens, temperature, top_k,
+                            mesh):
+        """Pipeline-sharded one-program decode: block params stacked over
+        layers and sharded on 'pp'; each token crosses the stages via
+        ``pipeline_decode_apply`` (masked sequential schedule), with the
+        embedding/head replicated and 'mp'/'dp' riding GSPMD — the
+        serving-side counterpart of the pp train step (the reference
+        serves pipelined models through ``DistModel``'s per-stage
+        processes, ``dist_model.cc``)."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ..nn.layer import functional_call
+        from ..parallel.api import batch_spec, stack_block_params
+        from ..parallel.pipeline import pipeline_decode_apply
+
+        b, prompt = ids.shape
+        cfg = self.config
+        L = cfg.num_layers
+        pp = mesh.shape.get("pp", 1)
+        if L % pp:
+            raise ValueError(
+                f"num_layers={L} must divide evenly over pp={pp} stages "
+                "for pipeline-sharded decode")
+        head_dim = cfg.hidden_size // cfg.num_heads
+        max_len = prompt + max_new_tokens
+        max_pos = cfg.max_position_embeddings
+        dtype = self.gpt.wte.weight._value.dtype
+        prefix = self.pipeline_stage_spec()["block_prefix"]
+
+        # stacking + placement reuse the train step's machinery and are
+        # cached per (mesh, live param identity): fixed-weight serving
+        # pays it once, a weight update (rebinding the tensors)
+        # invalidates it
+        pv_key = (tuple(sorted(mesh.shape.items())),
+                  tuple(id(p._value) for _, p in self.named_parameters()))
+        placed = self.__dict__.setdefault("_pp_decode_param_cache", {})
+        if placed.get("key") != pv_key:
+            placed["key"] = pv_key
+            placed["value"] = stack_block_params(
+                self, mesh, param_sharding_spec, prefix, L)
+        other, stacked = placed["value"]
+
+        bspec = batch_spec(mesh)
+        bax = bspec[0] if len(bspec) else None
+        hax = "mp" if mesh.shape.get("mp", 1) > 1 else None
+        cache_sh = NamedSharding(mesh, P("pp", bax, None, hax, None))
+        zeros = jnp.zeros((L, b, max_len, cfg.num_heads, head_dim), dtype)
+        caches = (jax.device_put(zeros, cache_sh),
+                  jax.device_put(zeros, cache_sh))
+        ids = jax.device_put(ids, NamedSharding(mesh, P(bax, None)))
+
+        template = self.gpt.blocks[0]
+        ln_f = self.gpt.ln_f
+
+        def layer_step(lp, cache, x, pos):
+            kc, vc = cache
+            y, (nk, nv) = functional_call(
+                template, lp, (Tensor(x),),
+                kwargs={"cache": (kc, vc), "cache_pos": pos},
+                training=False)
+            return y, (nk, nv)
+
+        def fwd(params, ids_in, caches, pos):
+            other_p, stacked_p = params
+            s = ids_in.shape[1]
+            pos_idx = jnp.clip(pos + jnp.arange(s, dtype=jnp.int32),
+                               0, max_pos - 1)
+            x = (jnp.take(other_p["gpt.wte.weight"], ids_in, axis=0)
+                 + jnp.take(other_p["gpt.wpe.weight"], pos_idx,
+                            axis=0)[None])
+            y, caches = pipeline_decode_apply(
+                layer_step, stacked_p, caches, x, pos, mesh)
+            xn = functional_call(
+                ln_f, {"weight": other_p["gpt.ln_f.weight"],
+                       "bias": other_p["gpt.ln_f.bias"]}, (Tensor(y),),
+                training=False)
+            logits = xn @ other_p["gpt.wte.weight"].T
+            return logits, caches
+
+        cache_key = ("pp", tuple(sorted(mesh.shape.items())), b, prompt,
+                     max_new_tokens, temperature == 0.0,
+                     float(temperature), top_k, str(dtype))
+        return self._run_decode_program(
+            cache_key, fwd, (other, stacked), ids, caches, temperature,
+            top_k, b, prompt, max_new_tokens, mesh=mesh)
 
     def enable_sequence_parallel(self, axis: str = "sp", mesh=None,
                                  mode: str = "auto"):

@@ -53,5 +53,6 @@ from . import auto_parallel  # noqa: F401
 from .auto_parallel import (Engine, ProcessMesh, shard_op,  # noqa: F401
                             shard_tensor)
 from .store import TCPStore  # noqa: F401
-from .dist_checkpoint import load_sharded, reshard, save_sharded  # noqa: F401
+from .dist_checkpoint import (load_sharded, load_train_state,  # noqa: F401
+                              reshard, save_sharded, save_train_state)
 from .planner import plan_sharding, score_plan  # noqa: F401

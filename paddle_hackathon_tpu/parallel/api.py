@@ -135,6 +135,43 @@ def _collect_moe_aux(model):
     return total
 
 
+def stack_block_params(model, mesh: Mesh, rule, block_prefix: str,
+                       n_layers: int, zero_stage: int = 0):
+    """Split a model's parameters into (other, stacked) and PLACE both:
+    per-layer block params (``{block_prefix}{i}.{rel}``) stack into
+    ``(n_layers, ...)`` arrays sharded over 'pp' (+ TP axes per ``rule``,
+    + 'sharding' when ``zero_stage>=3``); everything else places per the
+    rule. Shared by the pp train step and pp-sharded decode.
+
+    Returns ``(other, stacked)`` — ``other`` keyed by full param name,
+    ``stacked`` keyed by the per-layer relative name.
+    """
+    import re
+
+    from .sharding import _shard_spec_for
+    pat = re.compile(re.escape(block_prefix) + r"(\d+)\.(.+)")
+    per_layer: Dict[str, dict] = {}
+    other = {}
+    for k, p in model.named_parameters():
+        v = p._value
+        m = pat.match(k)
+        if m:
+            per_layer.setdefault(m.group(2), {})[int(m.group(1))] = v
+        else:
+            spec = list(rule(k, v.shape)) if rule else [None] * v.ndim
+            spec = list(_filter_spec(spec, mesh))
+            if zero_stage >= 3:
+                spec = list(_shard_spec_for(v.shape, mesh, existing=spec))
+            other[k] = jax.device_put(v, NamedSharding(mesh, P(*spec)))
+    stacked = {}
+    for rel, d in sorted(per_layer.items()):
+        arr = jnp.stack([d[i] for i in range(n_layers)])
+        stacked[rel] = jax.device_put(
+            arr, NamedSharding(mesh, P(*_pp_stacked_spec(
+                rel, arr, mesh, rule, block_prefix, zero_stage >= 3))))
+    return other, stacked
+
+
 def _pp_stacked_spec(rel: str, arr, mesh: Mesh, rule, prefix: str,
                      extra_sharding: bool):
     """PartitionSpec for a stacked block parameter: leading layer dim on
@@ -319,27 +356,12 @@ def make_sharded_train_step(model: Layer, mesh: Mesh,
                 f"num_layers={n_layers} must divide evenly over "
                 f"pp={pp_degree} stages")
         prefix = pp_spec["block_prefix"]
-        import re
-        pat = re.compile(re.escape(prefix) + r"(\d+)\.(.+)")
-        raw = {k: p._value for k, p in model.named_parameters()}
-        per_layer: Dict[str, dict] = {}
-        params = {}
-        for k, v in raw.items():
-            m = pat.match(k)
-            if m:
-                per_layer.setdefault(m.group(2), {})[int(m.group(1))] = v
-            else:
-                spec = list(rule(k, v.shape)) if rule else [None] * v.ndim
-                spec = list(_filter_spec(spec, mesh))
-                if zero_stage >= 3:
-                    spec = list(_shard_spec_for(v.shape, mesh, existing=spec))
-                params[k] = jax.device_put(v, NamedSharding(mesh, P(*spec)))
-        for rel, d in sorted(per_layer.items()):
-            arr = jnp.stack([d[i] for i in range(n_layers)])
-            params[prefix + "$stacked." + rel] = jax.device_put(
-                arr, NamedSharding(mesh, P(*_pp_stacked_spec(
-                    rel, arr, mesh, rule, prefix, zero_stage >= 3))))
-        stacked_rel_keys = tuple(sorted(per_layer))
+        other, stacked = stack_block_params(model, mesh, rule, prefix,
+                                            n_layers, zero_stage)
+        params = dict(other)
+        for rel, arr in stacked.items():
+            params[prefix + "$stacked." + rel] = arr
+        stacked_rel_keys = tuple(sorted(stacked))
         # rebind the live model's tensors to the placed (non-stacked) arrays
         for k, p in model.named_parameters():
             if k in params:

@@ -175,3 +175,98 @@ def reshard(state: Dict[str, jax.Array], mesh: Mesh,
         spec = tuple(_filter_axis(a, mesh) for a in spec)
         out[name] = jax.device_put(arr, NamedSharding(mesh, P(*spec)))
     return out
+
+
+# ---------------------------------------------------------------------------
+# Train-state checkpointing (resume across meshes / pp layouts)
+# ---------------------------------------------------------------------------
+
+_SEP = "::"
+
+
+def save_train_state(state: Dict, path: str) -> None:
+    """Checkpoint a ``make_sharded_train_step`` state (params + Adam
+    moments + step counter) as a sharded checkpoint — the fleet
+    save_persistables / auto_checkpoint analog for the one-program
+    trainer (SURVEY §5.4; ref ``dist_saver.py`` + ``auto_checkpoint.py``).
+    """
+    flat = {"step": state["step"]}
+    for k, v in state["params"].items():
+        flat[f"params{_SEP}{k}"] = v
+    for k, mv in state["opt_state"].items():
+        flat[f"opt{_SEP}{k}{_SEP}m"] = mv["m"]
+        flat[f"opt{_SEP}{k}{_SEP}v"] = mv["v"]
+    save_sharded(flat, path)
+
+
+def _translate_stacked(raw: Dict[str, np.ndarray], want: str):
+    """Bridge pp-stacked <-> per-layer parameter names.
+
+    ``want`` missing from ``raw`` resolves from the other layout:
+    ``P$stacked.R``  <- np.stack of ``P{i}.R``
+    ``P{i}.R``       <- row i of ``P$stacked.R``
+    (every numbered split position of ``want`` is tried, so prefixes that
+    themselves contain digits still resolve). Returns None when no
+    translation applies.
+    """
+    import re
+
+    if "$stacked." in want:
+        prefix, rel = want.split("$stacked.", 1)
+        rows = {}
+        pat = re.compile(re.escape(prefix) + r"(\d+)\." + re.escape(rel) + r"$")
+        for k, v in raw.items():
+            m = pat.match(k)
+            if m:
+                rows[int(m.group(1))] = np.asarray(v)
+        if rows and sorted(rows) == list(range(len(rows))):
+            return np.stack([rows[i] for i in range(len(rows))])
+        return None
+    for m in re.finditer(r"(\d+)\.", want):
+        prefix, idx = want[:m.start()], int(m.group(1))
+        rel = want[m.end():]
+        stacked_key = f"{prefix}$stacked.{rel}"
+        if stacked_key in raw:
+            return np.asarray(raw[stacked_key])[idx]
+    return None
+
+
+def load_train_state(path: str, like_state: Dict) -> Dict:
+    """Load a train-state checkpoint INTO the layout of ``like_state``
+    (the freshly-built state of the resuming ``make_sharded_train_step``).
+
+    Every array is placed with ``like_state``'s sharding — resuming on a
+    different mesh, zero stage, or pp degree is implicit resharding
+    (GSPMD moves the bytes; the reference needs Converter's slice/merge).
+    A checkpoint written with pp-STACKED block params resumes on a non-pp
+    mesh (and vice versa) via stacked<->per-layer name translation.
+    """
+    raw = load_sharded(path)   # host arrays, no placement yet
+
+    params_raw = {k[len(f"params{_SEP}"):]: v for k, v in raw.items()
+                  if k.startswith(f"params{_SEP}")}
+    opt_raw = {k[len(f"opt{_SEP}"):]: v for k, v in raw.items()
+               if k.startswith(f"opt{_SEP}")}
+
+    def pick_in(sub, name):
+        if name in sub:
+            return np.asarray(sub[name])
+        got = _translate_stacked(sub, name)
+        if got is None:
+            raise KeyError(f"checkpoint at {path} has no entry for {name}")
+        return got
+
+    params = {k: jax.device_put(pick_in(params_raw, k).astype(v.dtype),
+                                v.sharding)
+              for k, v in like_state["params"].items()}
+    opt = {k: {"m": jax.device_put(
+                   pick_in(opt_raw, f"{k}{_SEP}m").astype(mv["m"].dtype),
+                   mv["m"].sharding),
+               "v": jax.device_put(
+                   pick_in(opt_raw, f"{k}{_SEP}v").astype(mv["v"].dtype),
+                   mv["v"].sharding)}
+           for k, mv in like_state["opt_state"].items()}
+    step = jax.device_put(
+        np.asarray(raw["step"]).astype(like_state["step"].dtype),
+        like_state["step"].sharding)
+    return {"params": params, "opt_state": opt, "step": step}

@@ -127,6 +127,68 @@ def pipeline_apply(block_fn: Callable, stage_params: Any, x_mb: jax.Array,
         args=(stage_params, x_mb, extra))
 
 
+def pipeline_decode_apply(layer_step: Callable, stacked: Any, caches: Any,
+                          x: jax.Array, pos, mesh: Mesh):
+    """Pipelined layer application for autoregressive decode.
+
+    Decode is latency-bound and stateful (KV caches), so the 1F1B
+    microbatch schedule of :func:`pipeline_apply` does not apply; instead
+    each token (or prefill chunk) crosses the stages SEQUENTIALLY: every
+    tick all stages run their layer chunk on their current activation,
+    the activation ppermutes forward, and only the stage whose tick it is
+    commits its cache updates (masked select — the idle-stage compute is
+    the inherent single-stream pipeline bubble; multi-request interleaving
+    would fill it). Ref: the reference serves pipelined models through
+    per-stage processes in ``DistModel`` (``dist_model.cc``); here the
+    whole pipeline is ONE SPMD program.
+
+    Args:
+      layer_step: ``(layer_params, cache, x, pos) -> (y, new_cache)`` —
+        one layer with its KV cache (x/y same shape).
+      stacked: pytree, leaves (L, ...) stacked over layers, sharded P('pp').
+      caches: pytree, leaves (L, ...) per-layer cache state, sharded P('pp').
+      x: (b, s, h) stage-0 input, replicated over 'pp'.
+      pos: () int32 cache write position.
+    Returns (y, new_caches) with y replicated over 'pp'.
+    """
+    n = num_stages(mesh)
+
+    def chunk(st, cl, xc0, posv):
+        def body(xc, inp):
+            lp, c = inp
+            y, nc = layer_step(lp, c, xc, posv)
+            return y, nc
+        return jax.lax.scan(body, xc0, (st, cl))
+
+    if n == 1:
+        return chunk(stacked, caches, x, pos)
+
+    def spmd(st_local, c_local, xv, posv):
+        stage = jax.lax.axis_index("pp")
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        for t in range(n):
+            y, nc = chunk(st_local, c_local, xv, posv)
+            sel = stage == t
+            c_local = jax.tree.map(
+                lambda new, old: jnp.where(sel, new, old), nc, c_local)
+            # send my output forward; only stage t's is meaningful, and
+            # exactly stage t+1 consumes what it receives next tick
+            xv = jax.lax.ppermute(y, "pp", perm)
+        # after the last permute stage 0 holds stage n-1's output
+        out = jax.lax.psum(
+            jnp.where(stage == 0, xv, jnp.zeros_like(xv)), "pp")
+        return out, c_local
+
+    from ._smap import run_shard_map
+    return run_shard_map(
+        spmd, mesh,
+        in_specs=(jax.tree.map(lambda _: P("pp"), stacked),
+                  jax.tree.map(lambda _: P("pp"), caches), P(), P()),
+        out_specs=(P(), jax.tree.map(lambda _: P("pp"), caches)),
+        manual_axes={"pp"},
+        args=(stacked, caches, x, pos))
+
+
 class LayerDesc:
     """Deferred layer construction for stage segmentation
     (ref ``parallel_layers/pp_layers.py:120`` ``LayerDesc``)."""

@@ -1,0 +1,93 @@
+"""Train -> checkpoint -> resume workflows across meshes and pp layouts.
+
+The reference's story: ``fleet.save_persistables`` + auto_checkpoint
+resume (SURVEY §5.4), with ``converter.py`` re-sharding checkpoints
+across different meshes. Here ``parallel.save_train_state`` /
+``load_train_state`` checkpoint the full one-program trainer state
+(params + Adam moments + step) and resume on ANY mesh — including moving
+between pp-stacked and per-layer parameter layouts — with the loss
+trajectory of an uninterrupted run.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_hackathon_tpu as paddle
+from paddle_hackathon_tpu import parallel
+from paddle_hackathon_tpu.models import (GPTConfig, GPTForCausalLM,
+                                         param_sharding_spec)
+
+
+def _tiny():
+    return GPTConfig(vocab_size=128, hidden_size=64, num_layers=2,
+                     num_heads=4, max_position_embeddings=32,
+                     hidden_dropout_prob=0.0, attention_dropout_prob=0.0,
+                     use_flash_attention=False)
+
+
+def _data():
+    r = np.random.RandomState(0)
+    return (jnp.asarray(r.randint(0, 128, (8, 16)), jnp.int32),
+            jnp.asarray(r.randint(0, 128, (8, 16)), jnp.int32))
+
+
+def _build(mesh_dims, zero=0):
+    paddle.seed(123)
+    model = GPTForCausalLM(_tiny())
+    n = int(np.prod(list(mesh_dims.values())))
+    mesh = parallel.create_mesh(mesh_dims, devices=jax.devices()[:n])
+    step, state = parallel.make_sharded_train_step(
+        model, mesh, rule=param_sharding_spec, learning_rate=1e-3,
+        zero_stage=zero, grad_clip_norm=None)
+    return step, state
+
+
+def _run(step, state, ids, labels, n, start=0):
+    out = []
+    for i in range(start, start + n):
+        state, loss = step(state, ids, labels, jax.random.key(i))
+        out.append(float(loss))
+    return state, out
+
+
+@pytest.mark.parametrize("mesh_a,zero_a,mesh_b,zero_b", [
+    ({"dp": 4, "mp": 2}, 0, {"dp": 4, "mp": 2}, 0),         # same mesh
+    ({"dp": 4, "mp": 2}, 1, {"dp": 2, "sharding": 2, "mp": 2}, 3),  # reshard
+    ({"pp": 2, "dp": 2, "mp": 2}, 0, {"dp": 4, "mp": 2}, 0),  # pp -> flat
+    ({"dp": 4, "mp": 2}, 0, {"pp": 2, "dp": 2, "mp": 2}, 0),  # flat -> pp
+])
+def test_resume_matches_uninterrupted(tmp_path, mesh_a, zero_a, mesh_b,
+                                      zero_b, request):
+    ids, labels = _data()
+
+    # the reference trajectory: 4 steps uninterrupted on mesh B
+    step_b, state_b = _build(mesh_b, zero_b)
+    _, straight = _run(step_b, state_b, ids, labels, 4)
+
+    # 2 steps on mesh A, checkpoint, resume 2 more on mesh B
+    step_a, state_a = _build(mesh_a, zero_a)
+    state_a, first = _run(step_a, state_a, ids, labels, 2)
+    path = str(tmp_path / "ck")
+    parallel.save_train_state(state_a, path)
+
+    step_b2, fresh_b = _build(mesh_b, zero_b)
+    resumed = parallel.load_train_state(path, fresh_b)
+    assert int(np.asarray(resumed["step"])) == 2
+    _, rest = _run(step_b2, resumed, ids, labels, 2, start=2)
+
+    np.testing.assert_allclose(first + rest, straight, rtol=2e-3)
+    parallel.set_mesh(None)
+
+
+def test_missing_key_raises(tmp_path):
+    step, state = _build({"dp": 8})
+    parallel.save_train_state(state, str(tmp_path / "ck"))
+    bad = {"params": dict(state["params"]), "opt_state": state["opt_state"],
+           "step": state["step"]}
+    bad["params"]["nonexistent.weight"] = next(iter(
+        state["params"].values()))
+    with pytest.raises(KeyError, match="nonexistent"):
+        parallel.load_train_state(str(tmp_path / "ck"), bad)
+    parallel.set_mesh(None)
