@@ -401,7 +401,6 @@ class GPTForCausalLM(Layer):
         import jax
         import jax.numpy as jnp
 
-        from ..core import random as core_random
         from ..nn.layer import functional_call
 
         ids = input_ids._value if isinstance(input_ids, Tensor) \
@@ -556,12 +555,18 @@ class GPTForCausalLM(Layer):
         # stacking + placement reuse the train step's machinery and are
         # cached per (mesh, live param identity): fixed-weight serving
         # pays it once, a weight update (rebinding the tensors)
-        # invalidates it
-        pv_key = (tuple(sorted(mesh.shape.items())),
-                  tuple(id(p._value) for _, p in self.named_parameters()))
+        # invalidates it.  The cache HOLDS the keyed arrays (identity
+        # compare against live objects) — an id() tuple alone could
+        # false-hit after CPython recycles a freed array's address
+        live = tuple(p._value for _, p in self.named_parameters())
+        mesh_key = tuple(sorted(mesh.shape.items()))
         placed = self.__dict__.setdefault("_pp_decode_param_cache", {})
-        if placed.get("key") != pv_key:
-            placed["key"] = pv_key
+        hit = (placed.get("mesh") == mesh_key
+               and len(placed.get("refs", ())) == len(live)
+               and all(a is b for a, b in zip(placed["refs"], live)))
+        if not hit:
+            placed["mesh"] = mesh_key
+            placed["refs"] = live
             placed["value"] = stack_block_params(
                 self, mesh, param_sharding_spec, prefix, L)
         other, stacked = placed["value"]

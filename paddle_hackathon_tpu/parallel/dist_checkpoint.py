@@ -23,7 +23,8 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-__all__ = ["save_sharded", "load_sharded", "reshard"]
+__all__ = ["save_sharded", "load_sharded", "reshard",
+           "save_train_state", "load_train_state"]
 
 
 def _to_storable(blob: np.ndarray):
@@ -189,14 +190,58 @@ def save_train_state(state: Dict, path: str) -> None:
     moments + step counter) as a sharded checkpoint — the fleet
     save_persistables / auto_checkpoint analog for the one-program
     trainer (SURVEY §5.4; ref ``dist_saver.py`` + ``auto_checkpoint.py``).
+
+    ATOMIC: the save lands in ``{path}.saving`` (a fresh directory — no
+    stale shard/manifest parts from earlier topologies can linger), gets
+    a COMMITTED marker, and is renamed over ``path``; a crash mid-save
+    (the exact premise of crash-resume) can never corrupt the last good
+    checkpoint, and ``load_train_state`` recovers from whichever of
+    ``{path}.saving`` (committed) / ``path`` / ``{path}.old`` survived.
+    Multi-process saves barrier before the rank-0 swap.
     """
+    import shutil
+
     flat = {"step": state["step"]}
     for k, v in state["params"].items():
         flat[f"params{_SEP}{k}"] = v
     for k, mv in state["opt_state"].items():
         flat[f"opt{_SEP}{k}{_SEP}m"] = mv["m"]
         flat[f"opt{_SEP}{k}{_SEP}v"] = mv["v"]
-    save_sharded(flat, path)
+
+    tmp, old = path + ".saving", path + ".old"
+    multi = jax.process_count() > 1
+    if jax.process_index() == 0:
+        shutil.rmtree(tmp, ignore_errors=True)
+        os.makedirs(tmp, exist_ok=True)
+    if multi:
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices("pht_ckpt_begin")
+    save_sharded(flat, tmp)
+    if multi:
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices("pht_ckpt_written")
+    if jax.process_index() == 0:
+        with open(os.path.join(tmp, "COMMITTED"), "w") as f:
+            f.write("1")
+        shutil.rmtree(old, ignore_errors=True)
+        if os.path.isdir(path):
+            os.rename(path, old)
+        os.rename(tmp, path)
+        shutil.rmtree(old, ignore_errors=True)
+
+
+def _resolve_ck_dir(path: str) -> str:
+    """The newest complete checkpoint among the atomic-save trio:
+    ``{path}.saving`` with a COMMITTED marker (crash after commit, before
+    the swap) > ``path`` > ``{path}.old`` (crash mid-swap)."""
+    tmp = path + ".saving"
+    if os.path.isfile(os.path.join(tmp, "COMMITTED")):
+        return tmp
+    import glob as _glob
+    for cand in (path, path + ".old"):
+        if _glob.glob(os.path.join(cand, "manifest-p*.json")):
+            return cand
+    raise FileNotFoundError(f"no complete checkpoint at {path}")
 
 
 def _translate_stacked(raw: Dict[str, np.ndarray], want: str):
@@ -241,6 +286,7 @@ def load_train_state(path: str, like_state: Dict) -> Dict:
     A checkpoint written with pp-STACKED block params resumes on a non-pp
     mesh (and vice versa) via stacked<->per-layer name translation.
     """
+    path = _resolve_ck_dir(path)
     raw = load_sharded(path)   # host arrays, no placement yet
 
     params_raw = {k[len(f"params{_SEP}"):]: v for k, v in raw.items()

@@ -91,7 +91,6 @@ def run_one(impl, batch, seqlen, outdir):
     from paddle_hackathon_tpu import parallel
     from paddle_hackathon_tpu.models import (GPTForCausalLM, gpt_config,
                                              param_sharding_spec)
-    from paddle_hackathon_tpu.models import gpt as gpt_mod
 
     if impl != "packed":
         # the framework's global 'highest' default would make the jax
