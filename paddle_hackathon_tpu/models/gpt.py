@@ -555,18 +555,20 @@ class GPTForCausalLM(Layer):
         # stacking + placement reuse the train step's machinery and are
         # cached per (mesh, live param identity): fixed-weight serving
         # pays it once, a weight update (rebinding the tensors)
-        # invalidates it.  The cache HOLDS the keyed arrays (identity
-        # compare against live objects) — an id() tuple alone could
-        # false-hit after CPython recycles a freed array's address
+        # invalidates it.  Identity is tracked with WEAK refs — an id()
+        # tuple alone could false-hit after CPython recycles a freed
+        # array's address, while strong refs would pin the whole previous
+        # parameter set in device memory until the next call
+        import weakref
         live = tuple(p._value for _, p in self.named_parameters())
         mesh_key = tuple(sorted(mesh.shape.items()))
         placed = self.__dict__.setdefault("_pp_decode_param_cache", {})
-        hit = (placed.get("mesh") == mesh_key
-               and len(placed.get("refs", ())) == len(live)
-               and all(a is b for a, b in zip(placed["refs"], live)))
+        refs = placed.get("refs", ())
+        hit = (placed.get("mesh") == mesh_key and len(refs) == len(live)
+               and all(r() is v for r, v in zip(refs, live)))
         if not hit:
             placed["mesh"] = mesh_key
-            placed["refs"] = live
+            placed["refs"] = tuple(weakref.ref(v) for v in live)
             placed["value"] = stack_block_params(
                 self, mesh, param_sharding_spec, prefix, L)
         other, stacked = placed["value"]
@@ -672,11 +674,7 @@ class GPTForCausalLM(Layer):
         from ..nn.layer import functional_call
         from ..nn.functional.loss import fused_softmax_ce_rows
 
-        if self.config.moe_num_experts > 0:
-            raise NotImplementedError(
-                "pipeline parallelism over MoE blocks is not supported yet "
-                "(the per-layer aux loss does not survive the stage scan); "
-                "compose ep with dp/sharding/mp instead")
+        moe = self.config.moe_num_experts > 0
         template = self.gpt.blocks[0]
         drop = self.gpt.drop
         ln_f = self.gpt.ln_f
@@ -694,7 +692,18 @@ class GPTForCausalLM(Layer):
             return x
 
         def layer_fn(layer_params, x):
-            return functional_call(template, layer_params, (Tensor(x),))
+            h = functional_call(template, layer_params, (Tensor(x),))
+            if not moe:
+                return h
+            # MoE: the load-balance aux the forward just left on the
+            # layer is consumed INSIDE the stage scan (pipeline_apply
+            # accumulates it across layers/microbatches — the side
+            # channel _collect_moe_aux reads cannot escape a lax.scan)
+            aux = template.mlp.l_aux
+            aux = aux._value if isinstance(aux, Tensor) else aux
+            if aux is None:
+                aux = jnp.zeros((), jnp.float32)
+            return h, aux
 
         def post_fn(params, x, labels):
             xn = functional_call(
@@ -706,7 +715,9 @@ class GPTForCausalLM(Layer):
 
         return {"block_prefix": "gpt.blocks.",
                 "num_layers": self.config.num_layers,
-                "pre_fn": pre_fn, "layer_fn": layer_fn, "post_fn": post_fn}
+                "pre_fn": pre_fn, "layer_fn": layer_fn, "post_fn": post_fn,
+                "layer_aux": moe,
+                "aux_weight": self.config.moe_aux_weight}
 
 
 def param_sharding_spec(name: str, shape) -> tuple:

@@ -242,6 +242,8 @@ def _make_pipeline_loss(mesh: Mesh, pp_spec: dict, pp_degree: int,
         stacked = {rel: params[prefix + "$stacked." + rel]
                    for rel in stacked_rel_keys}
 
+        use_aux = bool(pp_spec.get("layer_aux"))
+
         def block_fn(stage_params, xb, mb_idx):
             stage = jax.lax.axis_index("pp")
 
@@ -258,19 +260,32 @@ def _make_pipeline_loss(mesh: Mesh, pp_spec: dict, pp_degree: int,
                     lk = jax.random.fold_in(
                         lk, jax.lax.axis_index(sp_axis))
                 with core_random.rng_scope(lk):
-                    return layer_fn(lp, h), None
+                    out = layer_fn(lp, h)
+                return (out, None) if not use_aux else out
 
-            h, _ = jax.lax.scan(body, xb,
-                                (stage_params, jnp.arange(n_local)))
+            h, auxes = jax.lax.scan(body, xb,
+                                    (stage_params, jnp.arange(n_local)))
+            if use_aux:
+                return h, jnp.sum(auxes)
             return h
 
-        ym = pin(pipeline_apply(block_fn, stacked, xm, mesh,
-                                extra=jnp.arange(n_micro),
-                                seq_axis=sp_axis),
-                 (None, data_axes))
+        ym = pipeline_apply(block_fn, stacked, xm, mesh,
+                            extra=jnp.arange(n_micro),
+                            seq_axis=sp_axis, with_aux=use_aux)
+        aux_total = None
+        if use_aux:
+            ym, aux_total = ym
+        ym = pin(ym, (None, data_axes))
         ys = pin(jnp.swapaxes(ym, 0, 1), (data_axes, None))
         y = pin(ys.reshape((B,) + ym.shape[2:]), (data_axes,))
-        return post_fn(params, y, labels)
+        loss = post_fn(params, y, labels)
+        if use_aux:
+            # aux is computed per microbatch (the reference's gradient-
+            # accumulation semantics); mean over microbatches matches the
+            # full-batch estimator in expectation
+            loss = loss + (float(pp_spec.get("aux_weight", 0.01))
+                           * aux_total / n_micro)
+        return loss
 
     return loss_fn
 

@@ -211,6 +211,10 @@ def save_train_state(state: Dict, path: str) -> None:
     tmp, old = path + ".saving", path + ".old"
     multi = jax.process_count() > 1
     if jax.process_index() == 0:
+        # a COMMITTED .saving from an interrupted swap is the NEWEST
+        # checkpoint — promote it before clearing the tmp dir, or this
+        # save would destroy it before its replacement is durable
+        _promote_committed(path)
         shutil.rmtree(tmp, ignore_errors=True)
         os.makedirs(tmp, exist_ok=True)
     if multi:
@@ -230,13 +234,33 @@ def save_train_state(state: Dict, path: str) -> None:
         shutil.rmtree(old, ignore_errors=True)
 
 
+def _promote_committed(path: str) -> None:
+    """Finish an interrupted atomic swap: a ``{path}.saving`` carrying the
+    COMMITTED marker is the newest complete checkpoint — rename it into
+    place (single-controller only; multi-process promotion is rank 0's
+    job inside save_train_state's barriered section)."""
+    import shutil
+
+    tmp, old = path + ".saving", path + ".old"
+    if not os.path.isfile(os.path.join(tmp, "COMMITTED")):
+        return
+    shutil.rmtree(old, ignore_errors=True)
+    if os.path.isdir(path):
+        os.rename(path, old)
+    os.rename(tmp, path)
+    shutil.rmtree(old, ignore_errors=True)
+
+
 def _resolve_ck_dir(path: str) -> str:
-    """The newest complete checkpoint among the atomic-save trio:
-    ``{path}.saving`` with a COMMITTED marker (crash after commit, before
-    the swap) > ``path`` > ``{path}.old`` (crash mid-swap)."""
-    tmp = path + ".saving"
-    if os.path.isfile(os.path.join(tmp, "COMMITTED")):
-        return tmp
+    """The newest complete checkpoint among the atomic-save trio —
+    finishing an interrupted swap first, so ``path`` itself is current
+    afterwards (an ``os.path.isdir(path)`` resume guard then sees it):
+    committed ``{path}.saving`` (promoted) > ``path`` > ``{path}.old``
+    (crash mid-swap, pre-commit)."""
+    if jax.process_count() == 1:
+        _promote_committed(path)
+    elif os.path.isfile(os.path.join(path + ".saving", "COMMITTED")):
+        return path + ".saving"   # multi-process: read in place, no race
     import glob as _glob
     for cand in (path, path + ".old"):
         if _glob.glob(os.path.join(cand, "manifest-p*.json")):

@@ -38,7 +38,8 @@ def num_stages(mesh: Mesh) -> int:
 
 
 def pipeline_apply(block_fn: Callable, stage_params: Any, x_mb: jax.Array,
-                   mesh: Mesh, extra: Any = None, seq_axis: str = None):
+                   mesh: Mesh, extra: Any = None, seq_axis: str = None,
+                   with_aux: bool = False):
     """Run microbatches through ``n_stages`` sequential stage applications.
 
     Args:
@@ -56,17 +57,27 @@ def pipeline_apply(block_fn: Callable, stage_params: Any, x_mb: jax.Array,
         forbids nesting a second shard_map on the same mesh), and the ring
         attention inside block_fn detects the already-manual axis and runs
         its per-device body directly (``_smap.active_manual_axes``).
+      with_aux: ``block_fn`` returns ``(y, aux_scalar)`` (e.g. the MoE
+        load-balance loss); aux sums over every VALID (stage, microbatch)
+        pair — warmup/cooldown ticks process clamped garbage microbatches
+        and are masked out — and psums over 'pp'.
 
-    Returns (n_micro, mb, ...) last-stage outputs, replicated over 'pp'.
+    Returns (n_micro, mb, ...) last-stage outputs, replicated over 'pp';
+    with ``with_aux``, a ``(outputs, aux_total)`` tuple.
     """
     n_stages_ = num_stages(mesh)
     n_micro = x_mb.shape[0]
 
     if n_stages_ == 1:
         if extra is not None:
-            return jax.vmap(
+            out = jax.vmap(
                 lambda x, e: block_fn(stage_params, x, e))(x_mb, extra)
-        return jax.vmap(lambda x: block_fn(stage_params, x, None))(x_mb)
+        else:
+            out = jax.vmap(lambda x: block_fn(stage_params, x, None))(x_mb)
+        if with_aux:
+            y, aux = out
+            return y, jnp.sum(aux)
+        return out
 
     manual = {"pp"}
     x_spec = P()
@@ -86,7 +97,7 @@ def pipeline_apply(block_fn: Callable, stage_params: Any, x_mb: jax.Array,
         outputs = jnp.zeros_like(xs)
 
         def tick(carry, t):
-            recv, outputs = carry
+            recv, outputs, aux_acc = carry
             mb_idx = jnp.clip(t, 0, n_micro - 1)
             x_in = jax.lax.dynamic_index_in_dim(xs, mb_idx, 0, keepdims=False)
             state = jnp.where(is_first, x_in, recv)
@@ -97,7 +108,13 @@ def pipeline_apply(block_fn: Callable, stage_params: Any, x_mb: jax.Array,
                 e_t = jax.tree.map(
                     lambda a: jax.lax.dynamic_index_in_dim(
                         a, my_mb, 0, keepdims=False), ex)
-            y = block_fn(params, state, e_t)
+            if with_aux:
+                y, aux = block_fn(params, state, e_t)
+                valid = (t >= stage) & (t - stage < n_micro)
+                aux_acc = aux_acc + jnp.where(
+                    valid, aux.astype(jnp.float32), 0.0)
+            else:
+                y = block_fn(params, state, e_t)
             out_idx = t - (n_stages_ - 1)
             idx = jnp.maximum(out_idx, 0)
             cur = jax.lax.dynamic_index_in_dim(outputs, idx, 0,
@@ -106,15 +123,22 @@ def pipeline_apply(block_fn: Callable, stage_params: Any, x_mb: jax.Array,
             outputs = jax.lax.dynamic_update_index_in_dim(
                 outputs, newval, idx, 0)
             send = jax.lax.ppermute(y, "pp", perm)
-            return (send, outputs), None
+            return (send, outputs, aux_acc), None
 
         with manual_axes_scope(manual):
-            (_, outputs), _ = jax.lax.scan(
-                tick, (zero_state, outputs),
+            (_, outputs, aux_acc), _ = jax.lax.scan(
+                tick, (zero_state, outputs, jnp.zeros((), jnp.float32)),
                 jnp.arange(n_micro + n_stages_ - 1))
         # only the last stage holds real outputs — replicate over pp
         mask = jnp.where(is_last, 1.0, 0.0).astype(outputs.dtype)
-        return jax.lax.psum(outputs * mask, "pp")
+        out = jax.lax.psum(outputs * mask, "pp")
+        if with_aux:
+            aux = jax.lax.psum(aux_acc, "pp")
+            if len(manual) > 1:       # sp also manual: aux is per-chunk
+                aux = jax.lax.pmean(aux, tuple(a for a in manual
+                                               if a != "pp"))
+            return out, aux
+        return out
 
     from ._smap import run_shard_map
     return run_shard_map(
@@ -122,7 +146,7 @@ def pipeline_apply(block_fn: Callable, stage_params: Any, x_mb: jax.Array,
         in_specs=(jax.tree.map(lambda _: P("pp"), stage_params),
                   x_spec, jax.tree.map(lambda _: P(), extra)
                   if extra is not None else P()),
-        out_specs=x_spec,
+        out_specs=(x_spec, P()) if with_aux else x_spec,
         manual_axes=manual,
         args=(stage_params, x_mb, extra))
 

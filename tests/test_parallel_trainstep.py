@@ -299,6 +299,35 @@ def test_gpt_generate():
                                       np.asarray(ids))
 
 
+def test_moe_pipeline_matches_ep_only():
+    """pp x ep: MoE blocks pipeline — the per-layer load-balance aux is
+    accumulated INSIDE the stage scan (pipeline_apply with_aux; the side
+    channel _collect_moe_aux reads cannot escape lax.scan) with
+    per-microbatch semantics (the reference's gradient-accumulation
+    behavior). Trajectory matches the ep-only composition."""
+    cfg = _tiny(moe_num_experts=4, moe_gate="naive")
+    ids, labels = _data()
+
+    def run(md):
+        paddle.seed(123)
+        model = GPTForCausalLM(cfg)
+        n = int(np.prod(list(md.values())))
+        mesh = parallel.create_mesh(md, devices=jax.devices()[:n])
+        step, state = parallel.make_sharded_train_step(
+            model, mesh, rule=param_sharding_spec, learning_rate=1e-3,
+            grad_clip_norm=None)
+        out = []
+        for i in range(3):
+            state, loss = step(state, ids, labels, jax.random.key(0))
+            out.append(float(loss))
+        return out
+
+    base = run({"ep": 2, "mp": 2, "dp": 2})
+    ppep = run({"pp": 2, "ep": 2, "mp": 2})
+    assert ppep[-1] < ppep[0]
+    np.testing.assert_allclose(ppep, base, rtol=2e-2)
+
+
 def test_gpt_generate_mp_sharded_matches_single_device():
     """TP-sharded one-program decode (VERDICT r3 missing #2): a model
     placed on a dp x mp mesh generates the SAME greedy tokens as the

@@ -140,8 +140,10 @@ def test_crash_relaunch_resumes_from_checkpoint(tmp_path):
         step, state = parallel.make_sharded_train_step(
             model, mesh, rule=param_sharding_spec, learning_rate=1e-3,
             grad_clip_norm=None)
-        if os.path.isdir(CK):                     # resume after relaunch
+        try:                                      # resume after relaunch
             state = parallel.load_train_state(CK, state)
+        except FileNotFoundError:                 # cold start
+            pass
         r = np.random.RandomState(0)
         ids = jnp.asarray(r.randint(0, 128, (8, 16)), jnp.int32)
         labels = jnp.asarray(r.randint(0, 128, (8, 16)), jnp.int32)
