@@ -32,8 +32,8 @@ class SequenceParallelMixin:
 
     ``seq_parallel_mode``: 'ring' (K/V rotate via ppermute, O(block^2)
     memory — the long-context default), 'ulysses' (one all-to-all pair,
-    cheapest when heads divide the sp degree), or 'auto' (ulysses when
-    ``num_heads % sp == 0`` else ring).
+    cheapest when the sp degree divides the head count), or 'auto'
+    (ulysses when ``num_heads % sp == 0`` else ring).
     """
 
     supports_sequence_parallel = True

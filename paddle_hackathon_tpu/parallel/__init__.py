@@ -32,8 +32,8 @@ from .mp_layers import (ColumnParallelLinear, ParallelCrossEntropy,  # noqa: F40
                         mark_sharding, sharding_rule_from_model)
 from .pipeline import (LayerDesc, PipelineLayer,  # noqa: F401
                        PipelineParallel, SharedLayerDesc,
-                       pipeline_apply, stack_layer_params,
-                       unstack_into_layers)
+                       pipeline_apply, pipeline_decode_apply,
+                       stack_layer_params, unstack_into_layers)
 from .sequence import (disable_sequence_parallel,  # noqa: F401
                        enable_sequence_parallel, ring_attention,
                        ulysses_attention)

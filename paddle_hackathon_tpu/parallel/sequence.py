@@ -343,8 +343,8 @@ def enable_sequence_parallel(model, axis: str = "sp", mesh: Optional[Mesh]
     on attention modules carrying ``supports_sequence_parallel`` gets
     ring/Ulysses for free; ``nn.layers.transformer.SequenceParallelMixin``).
 
-    ``mode``: 'ring' | 'ulysses' | 'auto' (ulysses when heads divide the
-    sp degree). Returns the number of layers switched; raises if the model
+    ``mode``: 'ring' | 'ulysses' | 'auto' (ulysses when the sp degree
+    divides the head count). Returns the number of layers switched; raises if the model
     has none, or if any switched layer has attention dropout (the ring
     kernels regenerate dropout only on the single-chip path).
     """

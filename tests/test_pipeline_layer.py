@@ -103,6 +103,10 @@ _PP_BASELINE = {}
 @pytest.mark.parametrize("mesh_dims,zero", [
     ({"pp": 2, "dp": 2, "mp": 2}, 0),     # the 4-D hybrid composition
     ({"pp": 2, "sharding": 2, "dp": 2}, 3),  # pp x ZeRO-3
+    # pp x sp: ring attention runs INSIDE each pipeline stage of the
+    # desc-built BERT (the region is manual over pp+sp; the attention
+    # mixin detects the already-manual axis)
+    ({"pp": 2, "sp": 2, "mp": 2}, 0),
 ])
 def test_bert_pipeline_matches_single_device(mesh_dims, zero):
     """BERT (never hand-wired for pp) pipelines via the generic desc path
@@ -145,3 +149,5 @@ def test_shared_desc_builds_one_module():
     names = [n for n, _ in pipe.named_parameters()]
     assert sum("word_embeddings" in n for n in names) == 1
     assert pipe._positions[0][1] is pipe._positions[-1][1]
+
+
