@@ -431,8 +431,12 @@ def make_sharded_train_step(model: Layer, mesh: Mesh,
             # MoELayer.forward left this trace's aux value on the layer
             aux = _collect_moe_aux(model)
             if aux is not None:
-                w = getattr(getattr(model, "config", None),
-                            "moe_aux_weight", 0.01)
+                # PipelineLayer carries its own weight; model configs
+                # (GPTConfig.moe_aux_weight) otherwise
+                w = getattr(model, "_aux_weight", None)
+                if w is None:
+                    w = getattr(getattr(model, "config", None),
+                                "moe_aux_weight", 0.01)
                 loss = loss + w * aux
             return loss
 

@@ -309,8 +309,9 @@ class PipelineLayer(Layer):
     is not supported under pp (keep heads deterministic, as in GPT/BERT).
     """
 
-    def __init__(self, layers, loss_fn=None):
+    def __init__(self, layers, loss_fn=None, aux_weight: float = 0.01):
         super().__init__()
+        self._aux_weight = aux_weight
         entries = []           # (module, fwd, is_new, shareable)
         shared_mods = {}
         for d in layers:
@@ -395,17 +396,24 @@ class PipelineLayer(Layer):
         ``loss_fn(model, params, buffers, batch, rng)`` signature — the
         single-device / pp=1 counterpart of the pipelined objective (used
         by the parity tests; numerics match the pp path exactly when
-        dropout is off)."""
+        dropout is off — the MoE aux term here is the full-batch
+        estimator vs the pp path's per-microbatch mean)."""
         if self._loss_fn is None:
             raise ValueError("PipelineLayer was built without a loss_fn")
         positions, user_loss = self._positions, self._loss_fn
+        aux_w = self._aux_weight
         from ..core import random as core_random
 
         def loss_fn(model, params, buffers, batch, rng):
+            from .api import _collect_moe_aux
             ids, labels = batch
             with core_random.rng_scope(rng):
                 y = _apply_positions(positions, params, buffers, ids)
-            return user_loss(y, labels)
+            loss = user_loss(y, labels)
+            aux = _collect_moe_aux(model)
+            if aux is not None:
+                loss = loss + aux_w * aux
+            return loss
 
         return loss_fn
 
@@ -432,8 +440,21 @@ class PipelineLayer(Layer):
                 return _apply_positions(pre_pos, params,
                                         buffers or captured_buffers, ids)
 
+        # blocks carrying an l_aux side channel (MoE layers) feed the
+        # pipeline's aux accumulator — the channel cannot escape the
+        # stage scan by itself (same mechanism as models/gpt.py)
+        from .api import _collect_moe_aux
+        has_aux = any(hasattr(m, "l_aux")
+                      for m in template.sublayers(include_self=True))
+
         def layer_fn(layer_params, x):
-            return functional_call(template, layer_params, (Tensor(x),))
+            h = functional_call(template, layer_params, (Tensor(x),))
+            if not has_aux:
+                return h
+            aux = _collect_moe_aux(template)
+            if aux is None:
+                aux = jnp.zeros((), jnp.float32)
+            return h, aux.astype(jnp.float32)
 
         def post_fn(params, x, labels):
             y = _apply_positions(post_pos, params, captured_buffers, x)
@@ -441,7 +462,9 @@ class PipelineLayer(Layer):
 
         return {"block_prefix": "blocks.",
                 "num_layers": len(self.blocks),
-                "pre_fn": pre_fn, "layer_fn": layer_fn, "post_fn": post_fn}
+                "pre_fn": pre_fn, "layer_fn": layer_fn, "post_fn": post_fn,
+                "layer_aux": has_aux,
+                "aux_weight": self._aux_weight}
 
 
 class PipelineParallel:

@@ -151,3 +151,54 @@ def test_shared_desc_builds_one_module():
     assert pipe._positions[0][1] is pipe._positions[-1][1]
 
 
+
+def test_pipeline_layer_moe_aux_flows():
+    """A desc-built pipeline whose blocks carry an l_aux side channel
+    (MoE) feeds the pipeline aux accumulator — the aux term must reach
+    the objective (aux_weight=0 gives a different loss)."""
+    from paddle_hackathon_tpu.models.gpt import GPTBlock, GPTConfig
+    from paddle_hackathon_tpu.nn.functional.loss import fused_softmax_ce_rows
+    from paddle_hackathon_tpu.nn.layers.common import Embedding, Linear
+
+    cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                    num_heads=2, max_position_embeddings=16,
+                    hidden_dropout_prob=0.0, attention_dropout_prob=0.0,
+                    use_flash_attention=False, moe_num_experts=4,
+                    moe_gate="gshard")
+
+    def ce(logits, labels):
+        return jnp.mean(fused_softmax_ce_rows(
+            logits.reshape(-1, logits.shape[-1]), labels.reshape(-1)))
+
+    def build(w):
+        paddle.seed(7)
+        return PipelineLayer([
+            LayerDesc(Embedding, 64, 32),
+            LayerDesc(GPTBlock, cfg), LayerDesc(GPTBlock, cfg),
+            LayerDesc(Linear, 32, 64),
+        ], loss_fn=ce, aux_weight=w)
+
+    pipe = build(0.05)
+    spec = pipe.pipeline_stage_spec()
+    assert spec["layer_aux"] is True and spec["aux_weight"] == 0.05
+
+    r = np.random.RandomState(0)
+    ids = jnp.asarray(r.randint(0, 64, (8, 8)), jnp.int32)
+    labels = jnp.asarray(r.randint(0, 64, (8, 8)), jnp.int32)
+
+    def first_loss(w):
+        pipe = build(w)
+        mesh = parallel.create_mesh({"pp": 2, "ep": 2, "mp": 2})
+        step, state = parallel.make_sharded_train_step(
+            pipe, mesh, rule=None, learning_rate=1e-3, grad_clip_norm=None)
+        losses = []
+        for i in range(2):
+            state, loss = step(state, ids, labels, jax.random.key(0))
+            losses.append(float(loss))
+        parallel.set_mesh(None)
+        return losses
+
+    with_aux = first_loss(0.05)
+    without = first_loss(0.0)
+    assert all(np.isfinite(with_aux)) and with_aux[-1] < with_aux[0]
+    assert abs(with_aux[0] - without[0]) > 1e-5   # aux reached the loss
