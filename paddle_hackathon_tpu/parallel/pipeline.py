@@ -456,9 +456,25 @@ class PipelineLayer(Layer):
                 aux = jnp.zeros((), jnp.float32)
             return h, aux.astype(jnp.float32)
 
+        # l_aux-bearing layers OUTSIDE the block run (pre/post segments)
+        # run at trace level — their side channels are readable when
+        # post_fn executes (same trace, no scan in between) and join the
+        # objective with the full-batch estimator
+        outer_mods = []
+        for prefix, mod, _ in pre_pos + post_pos:
+            if any(id(mod) == id(m) for m in outer_mods):
+                continue
+            outer_mods.append(mod)
+        aux_w = self._aux_weight
+
         def post_fn(params, x, labels):
             y = _apply_positions(post_pos, params, captured_buffers, x)
-            return user_loss(y, labels)
+            loss = user_loss(y, labels)
+            for mod in outer_mods:
+                aux = _collect_moe_aux(mod)
+                if aux is not None:
+                    loss = loss + aux_w * aux
+            return loss
 
         return {"block_prefix": "blocks.",
                 "num_layers": len(self.blocks),

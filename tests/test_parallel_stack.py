@@ -689,3 +689,40 @@ class TestRingAttentionMemoryProof:
         score_matrix = s_local * s_local * 4           # one f32 (b=h=1)
         assert t128 < score_matrix / 4, (t128, score_matrix)
         assert t128 / t64 < 2.6
+
+
+class TestPipelineDecodeApply:
+    def test_matches_sequential_with_state(self):
+        """The masked sequential decode schedule == plain layer-by-layer
+        application, INCLUDING the per-layer cache state each stage
+        commits (only at its own tick)."""
+        mesh = parallel.create_mesh({"pp": 4, "dp": 2})
+        try:
+            L, b, d, T = 4, 2, 8, 5
+            r = np.random.RandomState(0)
+            ws = jnp.asarray(r.randn(L, d, d).astype(np.float32) * 0.3)
+            caches = jnp.zeros((L, b, T, d), jnp.float32)
+            x = jnp.asarray(r.randn(b, 1, d).astype(np.float32))
+
+            def layer_step(w, cache, xc, pos):
+                y = jnp.tanh(xc @ w)
+                cache = jax.lax.dynamic_update_slice(
+                    cache, y, (0, pos.astype(jnp.int32), 0))
+                return y, cache
+
+            from paddle_hackathon_tpu.parallel import pipeline_decode_apply
+            y, new_caches = pipeline_decode_apply(
+                lambda lp, c, xc, pos: layer_step(lp["w"], c, xc, pos),
+                {"w": ws}, caches, x, jnp.asarray(2, jnp.int32), mesh)
+
+            expect = np.asarray(x)
+            exp_caches = np.zeros((L, b, T, d), np.float32)
+            for i in range(L):
+                expect = np.tanh(expect @ np.asarray(ws[i]))
+                exp_caches[i, :, 2:3] = expect
+            np.testing.assert_allclose(np.asarray(y), expect,
+                                       rtol=1e-5, atol=1e-6)
+            np.testing.assert_allclose(np.asarray(new_caches), exp_caches,
+                                       rtol=1e-5, atol=1e-6)
+        finally:
+            parallel.set_mesh(None)

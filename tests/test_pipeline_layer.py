@@ -189,13 +189,16 @@ def test_pipeline_layer_moe_aux_flows():
     def first_loss(w):
         pipe = build(w)
         mesh = parallel.create_mesh({"pp": 2, "ep": 2, "mp": 2})
-        step, state = parallel.make_sharded_train_step(
-            pipe, mesh, rule=None, learning_rate=1e-3, grad_clip_norm=None)
-        losses = []
-        for i in range(2):
-            state, loss = step(state, ids, labels, jax.random.key(0))
-            losses.append(float(loss))
-        parallel.set_mesh(None)
+        try:
+            step, state = parallel.make_sharded_train_step(
+                pipe, mesh, rule=None, learning_rate=1e-3,
+                grad_clip_norm=None)
+            losses = []
+            for i in range(2):
+                state, loss = step(state, ids, labels, jax.random.key(0))
+                losses.append(float(loss))
+        finally:
+            parallel.set_mesh(None)
         return losses
 
     with_aux = first_loss(0.05)
