@@ -3,4 +3,4 @@
 from . import lr  # noqa: F401
 from .optimizer import L1Decay, L2Decay, Optimizer  # noqa: F401
 from .optimizers import (Adadelta, Adagrad, Adam, Adamax, AdamW, Lamb,  # noqa: F401
-                         Momentum, RMSProp, SGD)
+                         Lars, LarsMomentum, Momentum, RMSProp, SGD)

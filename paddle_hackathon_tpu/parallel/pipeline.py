@@ -527,16 +527,50 @@ class PipelineParallel:
             from .mp_layers import sharding_rule_from_model
             n_micro = None
             zero = 0
+            opt_kind, opt_kwargs = "adam", None
             if self._strategy is not None:
                 n_micro = int(self._strategy.pipeline_configs.get(
                     "accumulate_steps", 0)) or None
                 if self._strategy.sharding:
                     zero = int((self._strategy.sharding_configs or {}).get(
                         "stage", 1))
+                # strategy.lamb/lars swap the in-step update rule here
+                # too (the eager-optimizer swap in fleet.
+                # distributed_optimizer cannot reach inside this one
+                # compiled program); their configs — and the swapped
+                # eager optimizer's hyperparameters — forward into the
+                # step, or the program would silently train with
+                # defaults the user never chose
+                if self._strategy.lamb:
+                    opt_kind = "lamb"
+                    c = self._strategy.lamb_configs or {}
+                    opt_kwargs = {"lamb_weight_decay":
+                                  float(c.get("lamb_weight_decay", 0.01))}
+                    if optimizer is not None and \
+                            hasattr(optimizer, "_beta1"):
+                        opt_kwargs.update(
+                            beta1=optimizer._beta1,
+                            beta2=optimizer._beta2)
+                        if hasattr(optimizer, "_eps"):
+                            opt_kwargs["epsilon"] = optimizer._eps
+                        if hasattr(optimizer, "_wd"):
+                            opt_kwargs["lamb_weight_decay"] = optimizer._wd
+                elif self._strategy.lars:
+                    opt_kind = "lars"
+                    c = self._strategy.lars_configs or {}
+                    opt_kwargs = {
+                        "lars_coeff": float(c.get("lars_coeff", 0.001)),
+                        "lars_weight_decay":
+                            float(c.get("lars_weight_decay", 0.0005)),
+                        "epsilon": float(c.get("epsilon", 0.0))}
+                    if optimizer is not None and \
+                            hasattr(optimizer, "_momentum"):
+                        opt_kwargs["momentum"] = optimizer._momentum
             rule = self._rule or sharding_rule_from_model(self._model)
             self._step, self._state = make_sharded_train_step(
                 self._model, self._mesh, rule=rule,
-                zero_stage=zero, pp_microbatches=n_micro)
+                zero_stage=zero, pp_microbatches=n_micro,
+                optimizer=opt_kind, optimizer_kwargs=opt_kwargs)
         # lr read fresh every call: schedules stay live (the step takes lr
         # as a dynamic scalar, so this never recompiles); without an
         # optimizer, None lets the step use its own configured default

@@ -246,3 +246,62 @@ class FP16AllReduceOptimizer(_OptimizerWrapper):
                 p._grad_value = self._comm_fn(
                     g.astype(self.wire_dtype)).astype(orig)
         self._inner.step()
+
+
+class AMPOptimizer(_OptimizerWrapper):
+    """Dynamic-loss-scaling wrapper behind ``strategy.amp`` (ref
+    ``fleet/meta_optimizers/amp_optimizer.py`` decorating the inner
+    optimizer with ``mixed_precision``).  This owns the loss-scaling half;
+    the cast half is ``paddle.amp.auto_cast`` around the forward, exactly
+    as the reference's dygraph flow pairs them.  ``minimize(loss)`` scales
+    before backward; ``step()`` unscales, skips the update on inf/nan, and
+    adapts the scale."""
+
+    def __init__(self, inner: Optimizer, configs=None):
+        super().__init__(inner)
+        cfg = configs or {}
+        from ..amp import GradScaler
+        self._scaler = GradScaler(
+            enable=True,
+            init_loss_scaling=float(cfg.get("init_loss_scaling", 2.0 ** 15)),
+            incr_ratio=float(cfg.get("incr_ratio", 2.0)),
+            decr_ratio=float(cfg.get("decr_ratio", 0.5)),
+            incr_every_n_steps=int(cfg.get("incr_every_n_steps", 1000)),
+            decr_every_n_nan_or_inf=int(
+                cfg.get("decr_every_n_nan_or_inf", 2)),
+            use_dynamic_loss_scaling=bool(
+                cfg.get("use_dynamic_loss_scaling", True)))
+        self._loss_scaled = False
+
+    @property
+    def scaler(self):
+        return self._scaler
+
+    def step(self):
+        # unscale_ divides every gradient by the loss scale — running it
+        # on gradients from an UNSCALED backward (the plain
+        # `loss.backward(); opt.step()` pattern) would shrink updates by
+        # 1/init_loss_scaling and silently stall training
+        if not self._loss_scaled:
+            raise RuntimeError(
+                "strategy.amp wraps the optimizer with loss scaling: call "
+                "minimize(loss) so the loss is scaled before backward, or "
+                "drive scaling yourself via optimizer.scaler "
+                "(scaler.scale(loss).backward(); scaler.step(inner)); a "
+                "bare step() after an unscaled backward would divide the "
+                "gradients by the loss scale")
+        self._loss_scaled = False
+        self._scaler.step(self._inner)
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        from ..core import autograd as _ag
+        sm = _ag._static_module
+        if sm is not None and isinstance(loss, sm.Variable):
+            return self._inner.minimize(loss, startup_program, parameters,
+                                        no_grad_set)
+        self._scaler.scale(loss).backward()
+        self._loss_scaled = True
+        self.step()
+        self.clear_grad()
+        return None, None

@@ -1,0 +1,181 @@
+"""The bench driver must produce *evidence* under every failure mode
+(VERDICT r4 directive #1): retry outages, classify code bugs as rc=1,
+fall back to trace measurement when the chip works but wall clock is
+tunnel-poisoned, and emit a structured outage record (rc=0) when the TPU
+is unreachable — the reference's perf CI philosophy
+(tools/ci_model_benchmark.sh:50-60) of gates that cannot die silently."""
+import importlib.util
+import json
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture()
+def bench(monkeypatch):
+    spec = importlib.util.spec_from_file_location(
+        "bench", os.path.join(_ROOT, "bench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    monkeypatch.setattr(mod.time, "sleep", lambda s: None)
+    return mod
+
+
+def test_outage_classifier(bench):
+    assert bench._looks_like_outage(
+        "RuntimeError: Unable to initialize backend 'axon': UNAVAILABLE")
+    assert bench._looks_like_outage("DEADLINE_EXCEEDED while fetching")
+    assert not bench._looks_like_outage(
+        "TypeError: unsupported operand type(s)")
+
+
+def test_headline_passthrough_on_success(bench, monkeypatch, capsys):
+    line = json.dumps({"metric": bench.HEADLINE_METRIC, "value": 142200.0})
+    monkeypatch.setattr(bench, "_run_sub",
+                        lambda args, timeout: (0, line, "", False))
+    assert bench.robust_headline() == 0
+    assert json.loads(capsys.readouterr().out)["value"] == 142200.0
+
+
+def test_headline_code_failure_is_rc1(bench, monkeypatch, capsys):
+    monkeypatch.setattr(
+        bench, "_run_sub",
+        lambda args, timeout: (1, None, "TypeError: bad call", False))
+    assert bench.robust_headline() == 1
+    assert capsys.readouterr().out == ""  # no fake metric emitted
+
+
+def test_headline_outage_emits_structured_record(bench, monkeypatch, capsys):
+    calls = []
+
+    def fake_run(args, timeout):
+        calls.append(args)
+        return 1, None, "Unable to initialize backend 'axon': UNAVAILABLE", \
+            False
+    monkeypatch.setattr(bench, "_run_sub", fake_run)
+    monkeypatch.setattr(bench, "_probe_chip",
+                        lambda timeout: (False, "probe timeout", True))
+    assert bench.robust_headline() == 0
+    rec = json.loads(capsys.readouterr().out)
+    assert rec["error"] == "tpu_unreachable"
+    assert rec["value"] is None
+    assert rec["attempts"] >= 2          # retried before giving up
+    assert rec["probe_ok"] is False
+    assert rec["metric"] == bench.HEADLINE_METRIC
+
+
+def test_headline_trace_fallback_when_chip_alive(bench, monkeypatch, capsys):
+    """Wall attempts time out (tunnel poisoned) but the chip answers a
+    probe -> the driver reaches for --headline-trace and passes its row
+    through."""
+    trace_line = json.dumps({"metric": bench.HEADLINE_METRIC,
+                             "value": 143800.0, "method": "trace"})
+
+    def fake_run(args, timeout):
+        if "--headline-trace" in args:
+            return 0, trace_line, "", False
+        return -1, None, "", True        # wall runs hang
+    monkeypatch.setattr(bench, "_run_sub", fake_run)
+    monkeypatch.setattr(bench, "_probe_chip",
+                        lambda timeout: (True, "", False))
+    assert bench.robust_headline() == 0
+    rec = json.loads(capsys.readouterr().out)
+    assert rec["method"] == "trace"
+    assert rec["value"] == 143800.0
+
+
+def test_cpu_fallback_row_is_not_a_chip_headline(bench, monkeypatch, capsys):
+    """jax's axon-init failure is a *warning* followed by CPU fallback, so
+    the child exits rc=0 with a cpu_smoke row — the driver must not accept
+    it as the chip headline.  With a live chip behind the probe it reaches
+    for the trace method; on a genuinely CPU-only box it prints the smoke
+    row under its own metric."""
+    smoke = json.dumps({"metric": "gpt2_small_pretrain_tokens_per_sec_"
+                        "cpu_smoke", "value": 9000.0})
+    trace_line = json.dumps({"metric": bench.HEADLINE_METRIC,
+                             "value": 143800.0, "method": "trace"})
+
+    def fake_run(args, timeout):
+        if "--headline-trace" in args:
+            return 0, trace_line, "", False
+        return 0, smoke, "", False
+    monkeypatch.setattr(bench, "_run_sub", fake_run)
+    monkeypatch.setattr(bench, "_probe_chip",
+                        lambda timeout: (True, "axon", False))
+    assert bench.robust_headline() == 0
+    assert json.loads(capsys.readouterr().out)["method"] == "trace"
+
+    monkeypatch.setattr(bench, "_probe_chip",
+                        lambda timeout: (True, "cpu", False))
+    assert bench.robust_headline() == 0
+    rec = json.loads(capsys.readouterr().out)
+    assert rec["metric"].endswith("cpu_smoke")
+
+    # "cpu+axon" = TPU box whose tunnel silently fell back to CPU: an
+    # outage record, never the smoke row and never a trace attempt
+    monkeypatch.setattr(bench, "_probe_chip",
+                        lambda timeout: (True, "cpu+axon", False))
+    assert bench.robust_headline() == 0
+    rec = json.loads(capsys.readouterr().out)
+    assert rec["error"] == "tpu_unreachable"
+    assert rec["probe_info"] == "cpu+axon"
+
+
+def test_timeouts_respect_global_deadline(bench, monkeypatch, capsys):
+    """With an exhausted budget the driver still emits the structured
+    record instead of sleeping past an outer driver timeout."""
+    monkeypatch.setenv("BENCH_MAX_SECONDS", "1")
+    monkeypatch.setattr(
+        bench, "_run_sub",
+        lambda args, timeout: (-1, None, "UNAVAILABLE", True))
+    assert bench.robust_headline() == 0
+    rec = json.loads(capsys.readouterr().out)
+    assert rec["error"] == "tpu_unreachable"
+
+
+def test_train_step_accepts_pytree_batch():
+    """Batch slots may be pytrees (ernie feeds (ids, masked_positions));
+    1-D leaves shard on the data axes truncated to their rank."""
+    import paddle_hackathon_tpu as paddle
+    from paddle_hackathon_tpu import parallel
+    from paddle_hackathon_tpu.core.tensor import Tensor
+    from paddle_hackathon_tpu.models import GPTForCausalLM, gpt_config
+    from paddle_hackathon_tpu.nn.layer import functional_call
+
+    paddle.seed(0)
+    cfg = gpt_config("gpt2-small-en", num_layers=2, hidden_size=64,
+                     num_heads=2, vocab_size=256,
+                     hidden_dropout_prob=0.0, attention_dropout_prob=0.0)
+    model = GPTForCausalLM(cfg)
+    mesh = parallel.create_mesh({"dp": 2}, devices=jax.devices()[:2])
+
+    def loss_fn(model, params, buffers, batch_, rng):
+        (ids, pos), labels = batch_
+        logits = functional_call(model, params, (Tensor(ids),),
+                                 buffers=dict(buffers))
+        lg = logits._value if isinstance(logits, Tensor) else logits
+        flat = lg.reshape(-1, lg.shape[-1])[pos]
+        onehot = jax.nn.one_hot(labels, lg.shape[-1])
+        return -jnp.mean(jnp.sum(jax.nn.log_softmax(flat) * onehot, -1))
+
+    step, state = parallel.make_sharded_train_step(
+        model, mesh, rule=None, learning_rate=1e-3, zero_stage=0,
+        loss_fn=loss_fn)
+    rng = np.random.RandomState(0)
+    ids = jnp.asarray(rng.randint(0, 256, (4, 16)), jnp.int32)
+    pos = jnp.asarray(rng.randint(0, 4 * 16, (8,)), jnp.int32)
+    labels = jnp.asarray(rng.randint(0, 256, (8,)), jnp.int32)
+    key = jax.random.key(0)
+    l0 = l1 = None
+    for i in range(3):
+        state, loss = step(state, (ids, pos), labels,
+                           jax.random.fold_in(key, i))
+        l0 = l0 if l0 is not None else float(loss)
+        l1 = float(loss)
+    assert np.isfinite(l1) and l1 < l0   # actually trains

