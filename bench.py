@@ -460,6 +460,12 @@ def robust_headline():
             return 1          # real code failure: fail loudly
         if smoke_line is not None:
             break             # deterministic CPU fallback — retries won't help
+        if timed_out:
+            # a HANG will not clear in a 30s backoff (round-4 stalls ran
+            # for hours) — and burning the budget on more 420s hangs
+            # would starve the probe+trace fallback, the one path that
+            # can still produce a number
+            break
         if attempt < 2:
             time.sleep(min(30 * (attempt + 1),
                            max(0, deadline - time.time() - 420)))

@@ -266,10 +266,17 @@ class Lamb(_PerParamDecayMixin, Optimizer):
     """LAMB (ref ``optimizer/lamb.py``; fused-sharded variant
     ``incubate/optimizer/distributed_fused_lamb.py:86``)."""
 
-    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
-                 beta2=0.999, epsilon=1e-6, parameters=None, grad_clip=None,
+    def __init__(self, learning_rate=0.001,
+                 lamb_weight_decay=None, beta1=None,
+                 beta2=None, epsilon=None, parameters=None, grad_clip=None,
                  exclude_from_weight_decay_fn=None, multi_precision=False,
                  name=None):
+        lamb_weight_decay = (LAMB_DEFAULTS["lamb_weight_decay"]
+                             if lamb_weight_decay is None
+                             else lamb_weight_decay)
+        beta1 = LAMB_DEFAULTS["beta1"] if beta1 is None else beta1
+        beta2 = LAMB_DEFAULTS["beta2"] if beta2 is None else beta2
+        epsilon = LAMB_DEFAULTS["epsilon"] if epsilon is None else epsilon
         super().__init__(learning_rate, parameters, None, grad_clip,
                          multi_precision, name)
         self._wd = lamb_weight_decay
@@ -318,8 +325,19 @@ def lamb_update(value, grad, m, v, lr, t, beta1, beta2, eps, wd,
             m32.astype(moment_dtype), u32.astype(moment_dtype))
 
 
+# THE single home of the LARS/LAMB hyperparameter defaults (ref
+# lars_momentum_op.cc attribute defaults; optimizer/lamb.py) — consulted
+# by the eager classes, fleet's strategy configs/_swap_update_rule, and
+# the sharded train step so the same nominal configuration means the
+# same numbers on every path.
+LARS_DEFAULTS = {"momentum": 0.9, "lars_coeff": 0.001,
+                 "lars_weight_decay": 0.0005, "epsilon": 0.0}
+LAMB_DEFAULTS = {"beta1": 0.9, "beta2": 0.999, "epsilon": 1e-6,
+                 "lamb_weight_decay": 0.01}
+
+
 def lars_update(value, grad, velocity, lr, momentum, lars_coeff, lars_wd,
-                epsilon=0.0):
+                epsilon=LARS_DEFAULTS["epsilon"]):
     """One LARS-momentum tensor update — single owner of the update math
     (ref ``fleet/meta_optimizers/lars_optimizer.py`` wrapping
     ``operators/optimizers/lars_momentum_op.cc``):
@@ -351,8 +369,11 @@ class Lars(_PerParamDecayMixin, Optimizer):
     ``fleet.distributed_optimizer`` swaps a Momentum optimizer to this
     class when ``strategy.lars`` is set."""
 
-    def __init__(self, learning_rate=0.001, momentum=0.9, lars_coeff=0.001,
-                 lars_weight_decay=0.0005, epsilon=0.0, parameters=None,
+    def __init__(self, learning_rate=0.001,
+                 momentum=LARS_DEFAULTS["momentum"],
+                 lars_coeff=LARS_DEFAULTS["lars_coeff"],
+                 lars_weight_decay=LARS_DEFAULTS["lars_weight_decay"],
+                 epsilon=LARS_DEFAULTS["epsilon"], parameters=None,
                  grad_clip=None, exclude_from_weight_decay=None,
                  multi_precision=False, name=None):
         super().__init__(learning_rate, parameters, None, grad_clip,

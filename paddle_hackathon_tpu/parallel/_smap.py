@@ -13,6 +13,7 @@ run inside ``jit`` under an ambient ``jax.set_mesh`` context — but
 from __future__ import annotations
 
 import contextlib
+import threading
 
 import jax
 from jax import shard_map
@@ -21,21 +22,32 @@ from jax import shard_map
 # forbids re-binding them in a nested shard_map). Collective programs
 # (ring/ulysses attention) consult this to run their per-device bodies
 # directly instead of opening a second region — see pipeline_apply.
-_ACTIVE_MANUAL_AXES: set = set()
+# Thread-local (mirroring the autograd tape's _tls pattern): the set is
+# mutated at TRACE time, and two traces on different threads (a pipeline
+# program compiling while an sp-only program compiles) must not leak
+# manual-axes state into each other.
+_tls = threading.local()
+
+
+def _axes() -> set:
+    if not hasattr(_tls, "manual_axes"):
+        _tls.manual_axes = set()
+    return _tls.manual_axes
 
 
 @contextlib.contextmanager
 def manual_axes_scope(axes):
-    added = set(axes) - _ACTIVE_MANUAL_AXES
-    _ACTIVE_MANUAL_AXES.update(added)
+    active = _axes()
+    added = set(axes) - active
+    active.update(added)
     try:
         yield
     finally:
-        _ACTIVE_MANUAL_AXES.difference_update(added)
+        active.difference_update(added)
 
 
 def active_manual_axes() -> frozenset:
-    return frozenset(_ACTIVE_MANUAL_AXES)
+    return frozenset(_axes())
 
 
 def run_shard_map(fn, mesh, in_specs, out_specs, manual_axes, args):

@@ -445,13 +445,24 @@ def make_sharded_train_step(model: Layer, mesh: Mesh,
                 loss = loss + w * aux
             return loss
 
-    b1 = float(okw.get("beta1", 0.9))
-    b2 = float(okw.get("beta2", 0.95 if opt_kind == "adam" else 0.999))
-    eps = float(okw.get("epsilon", 1e-8 if opt_kind != "lamb" else 1e-6))
-    lamb_wd = float(okw.get("lamb_weight_decay", 0.01))
-    lars_mu = float(okw.get("momentum", 0.9))
-    lars_coeff = float(okw.get("lars_coeff", 0.001))
-    lars_wd = float(okw.get("lars_weight_decay", 0.0005))
+    from ..optimizer.optimizers import LAMB_DEFAULTS, LARS_DEFAULTS
+    if opt_kind == "adam":
+        # the LM-pretraining adam defaults this step has always used
+        b1, b2, eps = (float(okw.get("beta1", 0.9)),
+                       float(okw.get("beta2", 0.95)),
+                       float(okw.get("epsilon", 1e-8)))
+    else:
+        b1 = float(okw.get("beta1", LAMB_DEFAULTS["beta1"]))
+        b2 = float(okw.get("beta2", LAMB_DEFAULTS["beta2"]))
+        eps = float(okw.get(
+            "epsilon", LAMB_DEFAULTS["epsilon"] if opt_kind == "lamb"
+            else LARS_DEFAULTS["epsilon"]))
+    lamb_wd = float(okw.get("lamb_weight_decay",
+                            LAMB_DEFAULTS["lamb_weight_decay"]))
+    lars_mu = float(okw.get("momentum", LARS_DEFAULTS["momentum"]))
+    lars_coeff = float(okw.get("lars_coeff", LARS_DEFAULTS["lars_coeff"]))
+    lars_wd = float(okw.get("lars_weight_decay",
+                            LARS_DEFAULTS["lars_weight_decay"]))
 
     def _is_stacked(k):
         return pp_degree > 1 and k.startswith(

@@ -205,8 +205,9 @@ def save_train_state(state: Dict, path: str) -> None:
     for k, v in state["params"].items():
         flat[f"params{_SEP}{k}"] = v
     for k, mv in state["opt_state"].items():
-        flat[f"opt{_SEP}{k}{_SEP}m"] = mv["m"]
-        flat[f"opt{_SEP}{k}{_SEP}v"] = mv["v"]
+        # slot names vary by update rule (adam/lamb: m+v; lars: m only)
+        for slot, arr in mv.items():
+            flat[f"opt{_SEP}{k}{_SEP}{slot}"] = arr
 
     tmp, old = path + ".saving", path + ".old"
     multi = jax.process_count() > 1
@@ -326,12 +327,10 @@ def load_train_state(path: str, like_state: Dict) -> Dict:
     params = {k: jax.device_put(pick_in(params_raw, k).astype(v.dtype),
                                 v.sharding)
               for k, v in like_state["params"].items()}
-    opt = {k: {"m": jax.device_put(
-                   pick_in(opt_raw, f"{k}{_SEP}m").astype(mv["m"].dtype),
-                   mv["m"].sharding),
-               "v": jax.device_put(
-                   pick_in(opt_raw, f"{k}{_SEP}v").astype(mv["v"].dtype),
-                   mv["v"].sharding)}
+    opt = {k: {slot: jax.device_put(
+                   pick_in(opt_raw, f"{k}{_SEP}{slot}").astype(arr.dtype),
+                   arr.sharding)
+               for slot, arr in mv.items()}
            for k, mv in like_state["opt_state"].items()}
     step = jax.device_put(
         np.asarray(raw["step"]).astype(like_state["step"].dtype),

@@ -34,6 +34,16 @@ from .topology import (CommunicateTopology, HybridCommunicateGroup,
                        set_hybrid_communicate_group)
 
 
+def _lars_defaults():
+    from ..optimizer.optimizers import LARS_DEFAULTS
+    return dict(LARS_DEFAULTS)
+
+
+def _lamb_defaults():
+    from ..optimizer.optimizers import LAMB_DEFAULTS
+    return dict(LAMB_DEFAULTS)
+
+
 @dataclasses.dataclass
 class DistributedStrategy:
     """Ref ``distributed_strategy.proto:278-319`` — the strategy switches the
@@ -64,14 +74,14 @@ class DistributedStrategy:
     fp16_allreduce: bool = False
     lars: bool = False
     lars_configs: Dict[str, Any] = dataclasses.field(
-        default_factory=lambda: {"lars_coeff": 0.001,
-                                 "lars_weight_decay": 0.0005,
-                                 "epsilon": 0.0,
-                                 "exclude_from_weight_decay": []})
+        default_factory=lambda: dict(
+            _lars_defaults(), exclude_from_weight_decay=[]))
     lamb: bool = False
     lamb_configs: Dict[str, Any] = dataclasses.field(
-        default_factory=lambda: {"lamb_weight_decay": 0.01,
-                                 "exclude_from_weight_decay_fn": None})
+        default_factory=lambda: {
+            "lamb_weight_decay":
+                _lamb_defaults()["lamb_weight_decay"],
+            "exclude_from_weight_decay_fn": None})
     hybrid_configs: Dict[str, int] = dataclasses.field(
         default_factory=lambda: {"dp_degree": 1, "mp_degree": 1,
                                  "pp_degree": 1, "sharding_degree": 1,
@@ -302,15 +312,17 @@ def _swap_update_rule(optimizer, strategy: DistributedStrategy):
                 "strategy.lars=True requires a Momentum optimizer (ref "
                 "lars_optimizer.py _can_apply); got "
                 f"{type(optimizer).__name__}")
+        d = _lars_defaults()
         cfg = strategy.lars_configs or {}
         return Lars(
             learning_rate=optimizer._learning_rate,
             momentum=optimizer._momentum,
             parameters=optimizer._parameter_list,
             grad_clip=optimizer._grad_clip,
-            lars_coeff=float(cfg.get("lars_coeff", 0.001)),
-            lars_weight_decay=float(cfg.get("lars_weight_decay", 0.0005)),
-            epsilon=float(cfg.get("epsilon", 0.0)),
+            lars_coeff=float(cfg.get("lars_coeff", d["lars_coeff"])),
+            lars_weight_decay=float(
+                cfg.get("lars_weight_decay", d["lars_weight_decay"])),
+            epsilon=float(cfg.get("epsilon", d["epsilon"])),
             exclude_from_weight_decay=cfg.get("exclude_from_weight_decay"))
     if isinstance(optimizer, Lamb):
         return optimizer
@@ -326,7 +338,8 @@ def _swap_update_rule(optimizer, strategy: DistributedStrategy):
         epsilon=optimizer._eps,
         parameters=optimizer._parameter_list,
         grad_clip=optimizer._grad_clip,
-        lamb_weight_decay=float(cfg.get("lamb_weight_decay", 0.01)),
+        lamb_weight_decay=float(cfg.get(
+            "lamb_weight_decay", _lamb_defaults()["lamb_weight_decay"])),
         exclude_from_weight_decay_fn=cfg.get(
             "exclude_from_weight_decay_fn"))
 

@@ -316,9 +316,13 @@ class PipelineLayer(Layer):
         shared_mods = {}
         for d in layers:
             if isinstance(d, SharedLayerDesc):
+                # explicit membership test — keying reuse on module
+                # TRUTHINESS would rebuild (and silently untie) any
+                # shared module whose class defines a zero __len__
                 is_new = d.key not in shared_mods
-                mod = shared_mods.setdefault(d.key, None) or d.build()
-                shared_mods[d.key] = mod
+                if is_new:
+                    shared_mods[d.key] = d.build()
+                mod = shared_mods[d.key]
                 entries.append((mod, d.forward_func, is_new, True))
             elif isinstance(d, LayerDesc):
                 entries.append((d.build(), None, True, False))
@@ -557,15 +561,23 @@ class PipelineParallel:
                             opt_kwargs["lamb_weight_decay"] = optimizer._wd
                 elif self._strategy.lars:
                     opt_kind = "lars"
+                    from ..optimizer.optimizers import LARS_DEFAULTS
                     c = self._strategy.lars_configs or {}
                     opt_kwargs = {
-                        "lars_coeff": float(c.get("lars_coeff", 0.001)),
-                        "lars_weight_decay":
-                            float(c.get("lars_weight_decay", 0.0005)),
-                        "epsilon": float(c.get("epsilon", 0.0))}
+                        k: float(c.get(k, LARS_DEFAULTS[k]))
+                        for k in ("lars_coeff", "lars_weight_decay",
+                                  "epsilon")}
                     if optimizer is not None and \
                             hasattr(optimizer, "_momentum"):
                         opt_kwargs["momentum"] = optimizer._momentum
+                    # a user-built Lars carries its own hyperparameters —
+                    # they beat the strategy-config defaults
+                    if optimizer is not None and \
+                            hasattr(optimizer, "_coeff"):
+                        opt_kwargs.update(
+                            lars_coeff=optimizer._coeff,
+                            lars_weight_decay=optimizer._lars_wd,
+                            epsilon=optimizer._eps)
             rule = self._rule or sharding_rule_from_model(self._model)
             self._step, self._state = make_sharded_train_step(
                 self._model, self._mesh, rule=rule,

@@ -336,6 +336,37 @@ def ulysses_attention(q, k, v, mesh: Mesh, axis: str = "sp",
         args=(q, k, v))
 
 
+def _sp_dropout_rate(layer) -> float:
+    """The attention-dropout rate of an sp-capable layer — part of the
+    ``supports_sequence_parallel`` contract: a ``_sp_dropout()`` hook, a
+    numeric ``dropout_p``/``dropout`` attribute, or a Dropout-like module
+    under ``.dropout`` (read via its ``p``/``rate``).  A layer whose rate
+    cannot be determined is REJECTED rather than assumed 0: nonzero
+    attention dropout under sp would silently generate divergent masks
+    per sequence chunk."""
+    hook = getattr(layer, "_sp_dropout", None)
+    if callable(hook):
+        return float(hook())
+    for attr in ("dropout_p", "dropout"):
+        v = getattr(layer, attr, None)
+        if isinstance(v, (int, float)):
+            return float(v)
+        if v is not None:
+            for sub in ("p", "rate", "dropout_p"):
+                r = getattr(v, sub, None)
+                if isinstance(r, (int, float)):
+                    return float(r)
+            raise ValueError(
+                f"{type(layer).__name__}.{attr} is a "
+                f"{type(v).__name__}; cannot determine its dropout rate "
+                "for sequence parallelism — expose a float dropout_p or "
+                "a _sp_dropout() hook on the layer")
+    raise ValueError(
+        f"{type(layer).__name__} advertises supports_sequence_parallel "
+        "but exposes no attention-dropout rate (float dropout_p/dropout "
+        "or a _sp_dropout() hook); refusing to assume 0")
+
+
 def enable_sequence_parallel(model, axis: str = "sp", mesh: Optional[Mesh]
                              = None, mode: str = "auto") -> int:
     """Switch every sp-capable attention layer in ``model`` to the
@@ -352,10 +383,8 @@ def enable_sequence_parallel(model, axis: str = "sp", mesh: Optional[Mesh]
     for layer in model.sublayers(include_self=True):
         if not getattr(layer, "supports_sequence_parallel", False):
             continue
-        drop = getattr(layer, "dropout_p", None)
-        if drop is None:
-            drop = getattr(layer, "dropout", 0.0)
-        if isinstance(drop, (int, float)) and drop > 0:
+        drop = _sp_dropout_rate(layer)
+        if drop > 0:
             raise ValueError(
                 "sequence parallelism requires attention dropout 0 "
                 f"(found {drop} on {type(layer).__name__})")

@@ -153,121 +153,123 @@ def firstn(reader, n):
 
 
 class XmapEndSignal:
-    pass
+    """Kept for API parity (some reference users type-check it); the
+    futures-based pipeline below no longer passes end signals around."""
 
 
 def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
-    """Map samples with ``process_num`` worker threads, optionally keeping
-    input order (ref ``decorator.py:412``)."""
-    end = XmapEndSignal()
+    """Map samples with ``process_num`` concurrent workers, optionally
+    keeping input order.
 
-    def read_worker(reader, in_queue):
-        for i in reader():
-            in_queue.put(i)
-        in_queue.put(end)
+    API/semantics of the reference ``decorator.py:412``; the machinery is
+    a bounded sliding window of futures over a thread pool rather than
+    the reference's reader-thread → in-queue → handler-threads →
+    out-queue pipeline.  Ordering costs nothing here: submission order IS
+    the window order, so ``order=True`` just drains the window FIFO
+    (where the reference's handler threads busy-wait on a shared output
+    counter), and ``order=False`` drains whatever finished first.
+    Mapper exceptions surface to the consumer on ``result()`` instead of
+    wedging a worker."""
+    from concurrent.futures import FIRST_COMPLETED, ThreadPoolExecutor, wait
 
-    def order_read_worker(reader, in_queue):
-        for in_order, i in enumerate(reader()):
-            in_queue.put((in_order, i))
-        in_queue.put(end)
-
-    def handle_worker(in_queue, out_queue, mapper):
-        sample = in_queue.get()
-        while not isinstance(sample, XmapEndSignal):
-            out_queue.put(mapper(sample))
-            sample = in_queue.get()
-        in_queue.put(end)
-        out_queue.put(end)
-
-    def order_handle_worker(in_queue, out_queue, mapper, out_order):
-        ins = in_queue.get()
-        while not isinstance(ins, XmapEndSignal):
-            order, sample = ins
-            r = mapper(sample)
-            # emit strictly in input order (reference busy-waits the same
-            # way, decorator.py:459-464, but we sleep to avoid spinning)
-            import time
-            while order != out_order[0]:
-                time.sleep(0.0005)
-            out_queue.put(r)
-            out_order[0] += 1
-            ins = in_queue.get()
-        in_queue.put(end)
-        out_queue.put(end)
+    window = max(int(buffer_size), int(process_num), 1)
 
     def xreader():
-        in_queue = Queue(buffer_size)
-        out_queue = Queue(buffer_size)
-        out_order = [0]
-        target = order_read_worker if order else read_worker
-        t = Thread(target=target, args=(reader, in_queue))
-        t.daemon = True
-        t.start()
-        target = order_handle_worker if order else handle_worker
-        args = (in_queue, out_queue, mapper, out_order) if order else \
-            (in_queue, out_queue, mapper)
-        workers = []
-        for _ in range(process_num):
-            w = Thread(target=target, args=args)
-            w.daemon = True
-            w.start()
-            workers.append(w)
-        finish = 0
-        while finish < process_num:
-            sample = out_queue.get()
-            if isinstance(sample, XmapEndSignal):
-                finish += 1
+        with ThreadPoolExecutor(max_workers=process_num) as pool:
+            if order:
+                from collections import deque
+                inflight = deque()
+                for sample in reader():
+                    inflight.append(pool.submit(mapper, sample))
+                    if len(inflight) >= window:
+                        yield inflight.popleft().result()
+                while inflight:
+                    yield inflight.popleft().result()
             else:
-                yield sample
+                inflight = set()
+                for sample in reader():
+                    inflight.add(pool.submit(mapper, sample))
+                    if len(inflight) >= window:
+                        done, inflight = wait(
+                            inflight, return_when=FIRST_COMPLETED)
+                        for f in done:
+                            yield f.result()
+                while inflight:
+                    done, inflight = wait(
+                        inflight, return_when=FIRST_COMPLETED)
+                    for f in done:
+                        yield f.result()
 
     return xreader
 
 
+# multiprocess_reader child→parent messages: tagged tuples, one writer per
+# child process.  (tag, payload) with tags "item" / "done" / "error" —
+# an exception's traceback text rides in "error" so the consumer can
+# re-raise with context.
+_MP_ITEM, _MP_DONE, _MP_ERROR = "item", "done", "error"
+
+
+def _mp_produce(reader, q):
+    """Child-process body: stream one reader into the shared queue."""
+    try:
+        for sample in reader():
+            if sample is None:
+                raise ValueError(
+                    "multiprocess_reader: readers must not yield None "
+                    "(None is unrepresentable through the queue protocol)")
+            q.put((_MP_ITEM, sample))
+        q.put((_MP_DONE, None))
+    except Exception as e:   # noqa: BLE001 — relayed to the parent
+        import traceback
+        q.put((_MP_ERROR, f"{type(e).__name__}: {e}\n"
+                          f"{traceback.format_exc(limit=5)}"))
+
+
 def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
-    """Fan-in multiple readers with one OS process each
-    (ref ``decorator.py:505``). Samples interleave in arrival order."""
+    """Fan-in multiple readers with one OS process each; samples
+    interleave in arrival order.
+
+    API of the reference ``decorator.py:505``; the wire protocol is
+    tagged messages (see ``_mp_produce``) instead of the reference's
+    None/empty-string sentinels, so a child exception carries its
+    traceback to the parent's RuntimeError."""
     if len(readers) < 1:
         raise ValueError("readers must not be empty")
 
-    def _read_into_queue(reader, q):
-        try:
-            for sample in reader():
-                if sample is None:
-                    raise ValueError("sample has None")
-                q.put(sample)
-            q.put(None)
-        except Exception:
-            q.put("")
-            raise
-
     def queue_reader():
         q = multiprocessing.Queue(queue_size)
-        procs = []
-        for reader in readers:
-            p = multiprocessing.Process(target=_read_into_queue,
-                                        args=(reader, q))
-            p.start()
-            procs.append(p)
-        finish_num = 0
-        while finish_num < len(readers):
-            try:
-                sample = q.get(timeout=60)
-            except _queue_mod.Empty:
-                # slow readers are fine while their processes live; only a
-                # wedged pipeline (all workers dead, queue empty) is fatal
-                if any(p.is_alive() for p in procs):
-                    continue
-                raise RuntimeError(
-                    "multiprocess_reader: all reader processes exited "
-                    "without finishing")
-            if sample is None:
-                finish_num += 1
-            elif sample == "":
-                raise RuntimeError("a reader subprocess raised an exception")
-            else:
-                yield sample
+        procs = [multiprocessing.Process(target=_mp_produce, args=(r, q),
+                                         daemon=True)
+                 for r in readers]
         for p in procs:
-            p.join()
+            p.start()
+        remaining = len(procs)
+        try:
+            while remaining:
+                try:
+                    tag, payload = q.get(timeout=60)
+                except _queue_mod.Empty:
+                    # slow readers are fine while their processes live;
+                    # only a wedged pipeline (all workers dead, queue
+                    # empty) is fatal
+                    if any(p.is_alive() for p in procs):
+                        continue
+                    raise RuntimeError(
+                        "multiprocess_reader: all reader processes exited "
+                        "without finishing") from None
+                if tag == _MP_DONE:
+                    remaining -= 1
+                elif tag == _MP_ERROR:
+                    raise RuntimeError(
+                        f"a reader subprocess raised:\n{payload}")
+                else:
+                    yield payload
+        finally:
+            for p in procs:
+                if p.is_alive():
+                    p.terminate()
+                p.join()
 
     # pipe-based variant behaves the same at this API level
     return queue_reader
