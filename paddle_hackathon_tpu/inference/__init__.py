@@ -23,8 +23,10 @@ Architecture (TPU-first, not a port):
 from .config import Config, AnalysisConfig, PassBuilder
 from .predictor import (Predictor, PredictorPool, Tensor as InferTensor,
                         create_predictor, get_version)
+from .serving import Request, ServingEngine
 
 __all__ = [
     "Config", "AnalysisConfig", "PassBuilder", "Predictor", "PredictorPool",
     "InferTensor", "create_predictor", "get_version",
+    "Request", "ServingEngine",
 ]

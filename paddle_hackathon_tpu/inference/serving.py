@@ -1,0 +1,565 @@
+"""Continuous-batching serving engine (multi-request decode).
+
+Ref: the reference serves multi-rank inference through
+``fleet_executor/dist_model.cc`` (DistModel — a persistent runtime that
+feeds requests through per-stage processes) and a thread-safe
+``AnalysisPredictor::ZeroCopyRun`` (``inference/api/analysis_predictor.h:182``)
+so many client threads can share one loaded model.
+
+TPU-native design: ONE jitted tick program over a slot-based static KV
+cache (``max_slots`` x ``max_len``).  Each tick advances every occupied
+slot by up to ``chunk`` tokens — prompt prefill is chunked into the SAME
+program that decodes (mixed prefill+decode batching), so a new request
+joins mid-flight without recompiling or stalling streams already
+decoding.  Per-slot cache depths ride a vector ``cache_pos`` through the
+model (``models/gpt.py`` static-cache attention); sampling happens
+in-program at each slot's last valid position.  The host side is a slot
+scheduler: admit from a FIFO into free slots, stage each slot's next
+token chunk, retire finished requests.
+
+Under pipeline parallelism the tick runs the interleaved-wave schedule:
+the slot batch splits into ``pp`` waves, each wave occupying a different
+stage every tick, so ALL stages do useful work each tick — the
+multi-request bubble-fill that the single-stream masked schedule
+(``parallel/pipeline.py pipeline_decode_apply``) documents as "would
+fill it".  A wave's sample surfaces ``pp - 1`` ticks after its tokens
+enter stage 0; the engine advances a wave's slot state only when its
+sample exits, so every stage mid-flight sees the wave's entry-time cache
+positions.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+from typing import List, Optional
+
+import numpy as np
+
+
+class Request:
+    """One in-flight generation request."""
+
+    __slots__ = ("prompt", "max_new_tokens", "tokens", "done", "error",
+                 "_event")
+
+    def __init__(self, prompt, max_new_tokens):
+        self.prompt = np.asarray(prompt, np.int32).reshape(-1)
+        self.max_new_tokens = int(max_new_tokens)
+        self.tokens: List[int] = []  # generated so far
+        self.done = False
+        self.error: Optional[BaseException] = None
+        self._event = threading.Event()
+
+    def wait(self, timeout=None):
+        self._event.wait(timeout)
+        return self.done
+
+    def result(self):
+        """Full sequence (prompt + generated), like ``model.generate``."""
+        if self.error is not None:
+            raise RuntimeError("request failed in the engine") from self.error
+        if not self.done:
+            raise RuntimeError("request not finished; wait() first")
+        return np.concatenate([self.prompt, np.asarray(self.tokens, np.int32)])
+
+
+class _Slot:
+    __slots__ = ("req", "off", "last")
+
+    def __init__(self):
+        self.req: Optional[Request] = None
+        self.off = 0      # prompt tokens consumed
+        self.last = 0     # last sampled token (decode feed)
+
+
+class ServingEngine:
+    """Slot-based continuous batching over one compiled decode tick.
+
+    Args:
+      model: a ``GPTForCausalLM``-shaped model (``.gpt`` backbone with
+        ``caches``/``cache_pos`` support, tied LM head).
+      max_slots: concurrent request capacity (the static batch B).
+      max_len: per-slot KV capacity; a request needs
+        ``len(prompt) + max_new_tokens <= max_len - chunk``.
+      chunk: prefill chunk width per tick (decode uses 1 of it).
+      temperature/top_k: sampling config (0.0 = greedy, matching
+        ``model.generate(temperature=0.0)`` token-for-token).
+      eos_token_id: optional early-stop token.
+    """
+
+    def __init__(self, model, max_slots=8, max_len=512, chunk=16,
+                 temperature=0.0, top_k=None, eos_token_id=None,
+                 auto_run=True):
+        import jax
+        import jax.numpy as jnp
+
+        model.eval()
+        self.model = model
+        self.max_slots = int(max_slots)
+        self.max_len = int(max_len)
+        self.chunk = int(chunk)
+        self.temperature = float(temperature)
+        self.top_k = top_k
+        self.eos_token_id = eos_token_id
+        self.auto_run = bool(auto_run)
+
+        cfg = model.config
+        self._head_dim = cfg.hidden_size // cfg.num_heads
+        self._dtype = model.gpt.wte.weight._value.dtype
+
+        params, bufs = model.functional_state()
+        # the head ties wte, so the backbone owns every parameter
+        self._params = {k[len("gpt."):]: v for k, v in params.items()
+                        if k.startswith("gpt.")}
+        self._bufs = {k[len("gpt."):]: v for k, v in bufs.items()
+                      if k.startswith("gpt.")}
+        self._mesh = model._param_mesh()
+        self._pp = 1
+        amb = self._ambient_pp_mesh()
+        if amb is not None:
+            self._mesh = amb
+            self._pp = amb.shape["pp"]
+
+        self._lock = threading.Lock()
+        self._pending = collections.deque()
+        self._slots = [_Slot() for _ in range(self.max_slots)]
+        self._lengths = np.zeros(self.max_slots, np.int32)
+        self._inflight = {}  # wave -> (consumed, finishing, reqs) at entry
+        self._running = False
+        self._tickno = 0
+        self.stats = {"ticks": 0, "tokens": 0, "requests": 0}
+        self._key = jax.random.key(0)
+
+        if self._pp > 1:
+            self._build_pp_tick()
+        else:
+            self._build_tick()
+        self._alloc_caches(jnp)
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _ambient_pp_mesh():
+        from ..parallel.api import get_mesh
+        m = get_mesh()
+        if m is not None and m.shape.get("pp", 1) > 1:
+            return m
+        return None
+
+    def _alloc_caches(self, jnp):
+        import jax
+        cfg = self.model.config
+        B, L = self.max_slots, self.max_len
+        shape = (B, L, cfg.num_heads, self._head_dim)
+        if self._pp > 1:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            zeros = jnp.zeros((cfg.num_layers,) + shape, self._dtype)
+            sh = NamedSharding(self._mesh, P("pp"))
+            self._caches = (jax.device_put(zeros, sh),
+                            jax.device_put(zeros, sh))
+            return
+        sh = None
+        if self._mesh is not None:
+            from ..parallel.api import decode_cache_sharding
+            sh = decode_cache_sharding(self._mesh)
+        mk = lambda: jnp.zeros(shape, self._dtype)  # noqa: E731
+        put = (lambda a: jax.device_put(a, sh)) if sh is not None else \
+            (lambda a: a)
+        self._caches = [(put(mk()), put(mk()))
+                        for _ in range(cfg.num_layers)]
+
+    # ------------------------------------------------------------------
+    def _build_tick(self):
+        """Single/mp-sharded tick: one fused program = embed + blocks
+        with per-slot cache writes + last-valid gather + head + sample."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..core.tensor import Tensor
+        from ..nn.layer import functional_call
+
+        model = self.model
+        temperature, top_k = self.temperature, self.top_k
+        bufs = self._bufs
+
+        def tick(params, caches, tokens, starts, nvalid, key, tickno):
+            hidden, caches = functional_call(
+                model.gpt, params, (Tensor(tokens),),
+                kwargs={"caches": caches, "cache_pos": starts},
+                buffers=bufs, training=False)
+            last = jnp.take_along_axis(
+                hidden, (nvalid - 1).astype(jnp.int32)[:, None, None],
+                axis=1)[:, 0]  # (B, h): each slot's last valid position
+            logits = last @ params["wte.weight"].T
+            nxt = model._sample(logits, temperature, top_k,
+                                key=jax.random.fold_in(key, tickno))
+            return caches, nxt[:, 0].astype(jnp.int32)
+
+        self._tick = jax.jit(tick, donate_argnums=(1,))
+
+    def _run_tick(self, tokens, starts, nvalid):
+        import jax.numpy as jnp
+        self._caches, nxt = self._tick(
+            self._params, self._caches, jnp.asarray(tokens),
+            jnp.asarray(starts), jnp.asarray(nvalid), self._key,
+            jnp.asarray(self._tickno, jnp.int32))
+        return np.asarray(nxt)
+
+    # ------------------------------------------------------------------
+    def _build_pp_tick(self):
+        """Interleaved-wave pipelined tick (see module docstring)."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ..core.tensor import Tensor
+        from ..models.gpt import param_sharding_spec
+        from ..nn.layer import functional_call
+        from ..parallel._smap import run_shard_map
+        from ..parallel.api import stack_block_params
+
+        model = self.model
+        cfg = model.config
+        mesh = self._mesh
+        pp = self._pp
+        if self.max_slots % pp:
+            raise ValueError(
+                f"max_slots={self.max_slots} must divide into pp={pp} waves")
+        if cfg.num_layers % pp:
+            raise ValueError(
+                f"num_layers={cfg.num_layers} must divide over pp={pp}")
+        self._wave = Bw = self.max_slots // pp
+        C = self.chunk
+        temperature, top_k = self.temperature, self.top_k
+        max_pos = cfg.max_position_embeddings
+
+        prefix = model.pipeline_stage_spec()["block_prefix"]
+        other, stacked = stack_block_params(
+            model, mesh, param_sharding_spec, prefix, cfg.num_layers)
+        self._pp_other, self._pp_stacked = other, stacked
+
+        template = model.gpt.blocks[0]
+        ln_f = model.gpt.ln_f
+
+        def stage_chunk(st, kc, vc, x, pos):
+            def body(xc, inp):
+                lp, k1, v1 = inp
+                y, (nk, nv) = functional_call(
+                    template, lp, (Tensor(xc),),
+                    kwargs={"cache": (k1, v1), "cache_pos": pos},
+                    training=False)
+                return y, (nk, nv)
+            y, (nk, nv) = jax.lax.scan(body, x, (st, kc, vc))
+            return y, nk, nv
+
+        def spmd(st_local, kcache, vcache, xbuf, tokens, starts, nvalid,
+                 wave_of_stage, other_p, key, tickno):
+            # kcache/vcache: (L_local, B, T, H, D) — this stage's layer
+            #   slab over the FULL slot batch (a stage touches only its
+            #   current wave's rows each tick).
+            # xbuf: (1, Bw, C, h) local — the activation ppermuted here
+            #   at the END of last tick (stage 0 replaces it with the
+            #   entering wave's embedding).
+            stage = jax.lax.axis_index("pp")
+            wave = wave_of_stage[stage]  # my wave this tick
+            sl0 = (wave * Bw).astype(jnp.int32)
+            tok_w = jax.lax.dynamic_slice(
+                tokens, (sl0, jnp.zeros((), jnp.int32)), (Bw, C))
+            st_w = jax.lax.dynamic_slice(starts, (sl0,), (Bw,))
+            nv_w = jax.lax.dynamic_slice(nvalid, (sl0,), (Bw,))
+
+            pos_idx = jnp.clip(
+                st_w[:, None] + jnp.arange(C, dtype=jnp.int32)[None, :],
+                0, max_pos - 1)
+            emb = (jnp.take(other_p["gpt.wte.weight"], tok_w, axis=0)
+                   + jnp.take(other_p["gpt.wpe.weight"], pos_idx, axis=0))
+            x = jnp.where(stage == 0, emb.astype(xbuf.dtype), xbuf[0])
+
+            kc_w = jax.lax.dynamic_slice_in_dim(kcache, sl0, Bw, axis=1)
+            vc_w = jax.lax.dynamic_slice_in_dim(vcache, sl0, Bw, axis=1)
+            y, nk, nv = stage_chunk(st_local, kc_w, vc_w, x, st_w)
+            kcache = jax.lax.dynamic_update_slice_in_dim(
+                kcache, nk.astype(kcache.dtype), sl0, axis=1)
+            vcache = jax.lax.dynamic_update_slice_in_dim(
+                vcache, nv.astype(vcache.dtype), sl0, axis=1)
+
+            # head + sample run on every stage (uniform SPMD; the
+            # (Bw,h)x(h,V) head is noise next to the layer slab) but only
+            # the LAST stage's — the exiting wave's — sample is real
+            xn = functional_call(
+                ln_f, {"weight": other_p["gpt.ln_f.weight"],
+                       "bias": other_p["gpt.ln_f.bias"]},
+                (Tensor(y),), training=False)
+            hid = jnp.take_along_axis(
+                xn, (nv_w - 1).astype(jnp.int32)[:, None, None],
+                axis=1)[:, 0]
+            logits = hid @ other_p["gpt.wte.weight"].T
+            nxt = model._sample(
+                logits, temperature, top_k,
+                key=jax.random.fold_in(key, tickno))[:, 0].astype(jnp.int32)
+            is_exit = stage == pp - 1
+            out = jnp.zeros((pp * Bw,), jnp.int32)
+            out = jax.lax.dynamic_update_slice(
+                out, jnp.where(is_exit, nxt, 0), (sl0,))
+            out = jax.lax.psum(
+                jnp.where(is_exit, out, jnp.zeros_like(out)), "pp")
+            y = jax.lax.ppermute(
+                y, "pp", [(i, (i + 1) % pp) for i in range(pp)])
+            return kcache, vcache, y[None], out
+
+        st_specs = jax.tree.map(lambda _: P("pp"), stacked)
+        other_specs = jax.tree.map(lambda _: P(), other)
+
+        def tick(stacked_p, kc, vc, xbuf, tokens, starts, nvalid,
+                 wave_of_stage, other_p, key, tickno):
+            return run_shard_map(
+                spmd, mesh,
+                in_specs=(st_specs, P("pp"), P("pp"), P("pp"),
+                          P(), P(), P(), P(), other_specs, P(), P()),
+                out_specs=(P("pp"), P("pp"), P("pp"), P()),
+                manual_axes={"pp"},
+                args=(stacked_p, kc, vc, xbuf, tokens, starts, nvalid,
+                      wave_of_stage, other_p, key, tickno))
+
+        self._pp_tick = jax.jit(tick, donate_argnums=(1, 2, 3))
+        self._xbuf = jax.device_put(
+            jnp.zeros((pp, Bw, C, cfg.hidden_size), self._dtype),
+            NamedSharding(mesh, P("pp")))
+
+    def _run_pp_tick(self, tokens, starts, nvalid):
+        import jax
+        import jax.numpy as jnp
+        pp = self._pp
+        # wave at stage s this tick entered stage 0 s ticks ago
+        wave_of_stage = np.array(
+            [(self._tickno - s) % pp for s in range(pp)], np.int32)
+        kc, vc = self._caches
+        # partial-manual shard_map (pp manual, dp/mp auto) needs the
+        # ambient mesh — same contract as _run_decode_program
+        with jax.set_mesh(self._mesh):
+            kc, vc, self._xbuf, nxt = self._pp_tick(
+                self._pp_stacked, kc, vc, self._xbuf, jnp.asarray(tokens),
+                jnp.asarray(starts), jnp.asarray(nvalid),
+                jnp.asarray(wave_of_stage), self._pp_other, self._key,
+                jnp.asarray(self._tickno, jnp.int32))
+        self._caches = (kc, vc)
+        return np.asarray(nxt)
+
+    # ------------------------------------------------------------------
+    # scheduling
+    def submit(self, prompt, max_new_tokens=32) -> Request:
+        req = Request(prompt, max_new_tokens)
+        need = len(req.prompt) + req.max_new_tokens
+        if need > self.max_len - self.chunk:
+            raise ValueError(
+                f"request needs {need} cache rows; capacity is "
+                f"max_len-chunk={self.max_len - self.chunk}")
+        max_pos = getattr(self.model.config, "max_position_embeddings", None)
+        if max_pos is not None and need > max_pos:
+            # past max_pos the position lookup clips to the last row —
+            # silently degraded generations; refuse up front
+            raise ValueError(
+                f"request needs {need} positions; the model's "
+                f"max_position_embeddings is {max_pos}")
+        with self._lock:
+            self._pending.append(req)
+            self.stats["requests"] += 1
+            if self.auto_run and not self._running:
+                self._running = True
+                threading.Thread(target=self._loop, daemon=True).start()
+        return req
+
+    def generate(self, prompt, max_new_tokens=32, timeout=None):
+        """Blocking, thread-safe: many caller threads share the engine
+        (the ``ZeroCopyRun``-under-lock contract, but requests BATCH
+        instead of serializing)."""
+        req = self.submit(prompt, max_new_tokens)
+        if not req.wait(timeout):
+            raise TimeoutError("generation did not finish in time")
+        return req.result()
+
+    def _admit(self):
+        """Move pending requests into free slots.  Under pp a request
+        admits into any free slot (its wave is slot // wave_size); its
+        staged prompt is consumed when that wave next enters stage 0."""
+        for i, slot in enumerate(self._slots):
+            if slot.req is not None or not self._pending:
+                continue
+            slot.req = self._pending.popleft()
+            slot.off = 0
+            slot.last = 0
+            self._lengths[i] = 0
+
+    def _stage(self):
+        """Build (tokens, starts, nvalid, consumed, finishing) for this
+        tick from current slot state. ``consumed[i]``: tokens written for
+        slot i (its length advance); ``finishing[i]``: the tick's sample
+        for slot i is a real next token (prompt fully consumed)."""
+        B, C = self.max_slots, self.chunk
+        tokens = np.zeros((B, C), np.int32)
+        starts = self._lengths.copy()
+        nvalid = np.ones(B, np.int32)
+        consumed = np.zeros(B, np.int32)
+        finishing = [False] * B
+        for i, slot in enumerate(self._slots):
+            req = slot.req
+            if req is None:
+                continue
+            if slot.off < len(req.prompt):
+                chunk = req.prompt[slot.off:slot.off + C]
+                tokens[i, :len(chunk)] = chunk
+                nvalid[i] = len(chunk)
+                consumed[i] = len(chunk)
+                finishing[i] = slot.off + len(chunk) >= len(req.prompt)
+            else:
+                tokens[i, 0] = slot.last
+                nvalid[i] = 1
+                consumed[i] = 1
+                finishing[i] = True
+        return tokens, starts, nvalid, consumed, finishing
+
+    def _finish(self, slot_idx, req):
+        req.done = True
+        self._slots[slot_idx].req = None
+        self._lengths[slot_idx] = 0
+        req._event.set()
+
+    def _commit_token(self, i, tok):
+        """Record slot i's sampled token; returns True if the request
+        completed."""
+        slot = self._slots[i]
+        req = slot.req
+        req.tokens.append(tok)
+        slot.last = tok
+        self.stats["tokens"] += 1
+        if (len(req.tokens) >= req.max_new_tokens
+                or (self.eos_token_id is not None
+                    and tok == self.eos_token_id)):
+            self._finish(i, req)
+            return True
+        return False
+
+    def step(self) -> bool:
+        """One engine tick. Returns False when there was nothing to do."""
+        self._lock.acquire()
+        try:
+            self._admit()
+            if self._pp > 1:
+                if (not any(s.req is not None for s in self._slots)
+                        and not self._inflight_live()):
+                    return False
+                return self._step_pp_locked()
+            if not any(s.req is not None for s in self._slots):
+                return False
+            tokens, starts, nvalid, consumed, finishing = self._stage()
+        finally:
+            # pp path released/reacquired internally; non-pp releases here
+            if self._lock.locked():
+                self._lock.release()
+        nxt = self._run_tick(tokens, starts, nvalid)
+        with self._lock:
+            self._tickno += 1
+            self.stats["ticks"] += 1
+            for i, slot in enumerate(self._slots):
+                if slot.req is None:
+                    continue
+                if slot.off < len(slot.req.prompt):
+                    slot.off += int(consumed[i])
+                self._lengths[i] += int(consumed[i])
+                if finishing[i]:
+                    self._commit_token(i, int(nxt[i]))
+        return True
+
+    def _inflight_live(self):
+        return any(any(r is not None for r in rec[2])
+                   for rec in self._inflight.values())
+
+    def _step_pp_locked(self):
+        """pp tick. Lock is held on entry (staging) and released around
+        the device call. The ENTERING wave's snapshot (consumed,
+        finishing, request identity) is recorded now; its slot state
+        advances and its token commits when the wave EXITS, pp-1 ticks
+        later — mid-flight, every stage must keep seeing the wave's
+        entry-time cache positions."""
+        pp = self._pp
+        enter_wave = self._tickno % pp
+        exit_wave = (self._tickno - (pp - 1)) % pp
+        tokens, starts, nvalid, consumed, finishing = self._stage()
+        self._inflight[enter_wave] = (
+            consumed.copy(), list(finishing), [s.req for s in self._slots])
+        self._lock.release()
+        try:
+            nxt = self._run_pp_tick(tokens, starts, nvalid)
+        finally:
+            self._lock.acquire()
+        self._tickno += 1
+        self.stats["ticks"] += 1
+        rec = self._inflight.pop(exit_wave, None)
+        if rec is not None:
+            consumed_e, finishing_e, reqs_e = rec
+            lo, hi = exit_wave * self._wave, (exit_wave + 1) * self._wave
+            for i in range(lo, hi):
+                slot = self._slots[i]
+                # commit only if the slot still holds the request the
+                # wave carried (not freed/re-admitted mid-flight)
+                if slot.req is None or slot.req is not reqs_e[i]:
+                    continue
+                if slot.off < len(slot.req.prompt):
+                    slot.off += int(consumed_e[i])
+                self._lengths[i] += int(consumed_e[i])
+                if finishing_e[i]:
+                    self._commit_token(i, int(nxt[i]))
+        return True
+
+    def _loop(self):
+        while True:
+            try:
+                busy = self.step()
+            except BaseException as e:  # noqa: BLE001 — a dead loop with
+                # _running stuck True would hang every current AND future
+                # request; fail them all with the cause instead (donated
+                # caches may be gone, so the engine is not reusable)
+                with self._lock:
+                    for req in list(self._pending):
+                        req.error = e
+                        req._event.set()
+                    self._pending.clear()
+                    for slot in self._slots:
+                        if slot.req is not None:
+                            slot.req.error = e
+                            slot.req._event.set()
+                            slot.req = None
+                    for rec in self._inflight.values():
+                        for req in rec[2]:
+                            if req is not None and not req._event.is_set():
+                                req.error = e
+                                req._event.set()
+                    self._inflight.clear()
+                    self._running = False
+                raise
+            if not busy:
+                with self._lock:
+                    if (not self._pending
+                            and all(s.req is None for s in self._slots)):
+                        self._running = False
+                        return
+
+    def run_until_idle(self, max_ticks=100000):
+        """Drive the engine synchronously (single-threaded use/tests)."""
+        for _ in range(max_ticks):
+            if not self.step():
+                return
+        raise RuntimeError("engine did not drain in max_ticks")
+
+    def shutdown(self, timeout=60.0):
+        """Wait for the background loop to drain and stop — call before
+        interpreter exit so a daemon thread isn't killed mid-device-call
+        (which aborts the process from PJRT's C++)."""
+        import time
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._lock:
+                if not self._running:
+                    return
+            time.sleep(0.005)
+        raise TimeoutError("engine loop did not drain before timeout")

@@ -132,12 +132,32 @@ class GPTAttention(SequenceParallelMixin, Layer):
             q, k, v = ops.unstack(qkv, axis=2)
 
             def fn(qv, kv, vv, kb, vb, pos):
-                zero = jnp.zeros((), jnp.int32)
-                start = (zero, pos.astype(jnp.int32), zero, zero)
-                kb = jax.lax.dynamic_update_slice(kb, kv.astype(kb.dtype),
-                                                  start)
-                vb = jax.lax.dynamic_update_slice(vb, vv.astype(vb.dtype),
-                                                  start)
+                pos = jnp.asarray(pos, jnp.int32)
+                if pos.ndim == 0:
+                    zero = jnp.zeros((), jnp.int32)
+                    start = (zero, pos, zero, zero)
+                    kb = jax.lax.dynamic_update_slice(
+                        kb, kv.astype(kb.dtype), start)
+                    vb = jax.lax.dynamic_update_slice(
+                        vb, vv.astype(vb.dtype), start)
+                    qpos = pos + jnp.arange(qv.shape[1])[:, None]
+                    kpos = jnp.arange(kb.shape[1])[None, :]
+                    mask = (kpos <= qpos)[None, None]  # (1,1,s,T)
+                else:
+                    # per-slot positions (continuous-batching serving:
+                    # each batch row is an independent request at its own
+                    # cache depth) — vmap the in-place write per row and
+                    # mask per-row causality
+                    def row_write(buf, upd, p):
+                        return jax.lax.dynamic_update_slice(
+                            buf, upd.astype(buf.dtype),
+                            (p, jnp.zeros((), jnp.int32),
+                             jnp.zeros((), jnp.int32)))
+                    kb = jax.vmap(row_write)(kb, kv, pos)
+                    vb = jax.vmap(row_write)(vb, vv, pos)
+                    qpos = pos[:, None] + jnp.arange(qv.shape[1])[None, :]
+                    kpos = jnp.arange(kb.shape[1])[None, None, :]
+                    mask = (kpos <= qpos[..., None])[:, None]  # (b,1,s,T)
                 # NOTE round-4: three Pallas fused-decode-attention
                 # variants (3-D VPU, per-head MXU dots, head-batched
                 # dot_general) measured 23/37/49 us/layer vs ~21 us for
@@ -147,9 +167,7 @@ class GPTAttention(SequenceParallelMixin, Layer):
                 scale = 1.0 / _math.sqrt(qv.shape[-1])
                 logits = jnp.einsum("bshe,bthe->bhst", qv,
                                     kb.astype(qv.dtype)) * scale
-                qpos = pos.astype(jnp.int32) + jnp.arange(qv.shape[1])[:, None]
-                kpos = jnp.arange(kb.shape[1])[None, :]
-                logits = jnp.where((kpos <= qpos)[None, None], logits,
+                logits = jnp.where(mask, logits,
                                    jnp.asarray(-1e30, logits.dtype))
                 probs = jax.nn.softmax(logits, -1)
                 ctx = jnp.einsum("bhst,bthe->bshe", probs,
@@ -263,10 +281,18 @@ class GPTModel(Layer):
             import jax.numpy as jnp
             from ..core.tensor import Tensor as _T
             pv = cache_pos._value if isinstance(cache_pos, _T) else cache_pos
-            pos_idx = jnp.clip(
-                jnp.asarray(pv, jnp.int32) + jnp.arange(s, dtype=jnp.int32),
-                0, max_pos - 1)[None, :]
-            pos_emb = self.wpe(_T(jnp.broadcast_to(pos_idx, (1, s))))
+            pv = jnp.asarray(pv, jnp.int32)
+            if pv.ndim == 0:
+                pos_idx = jnp.clip(
+                    pv + jnp.arange(s, dtype=jnp.int32),
+                    0, max_pos - 1)[None, :]
+                pos_emb = self.wpe(_T(jnp.broadcast_to(pos_idx, (1, s))))
+            else:
+                # per-slot positions (serving engine): (B,) starts -> (B, s)
+                pos_idx = jnp.clip(
+                    pv[:, None] + jnp.arange(s, dtype=jnp.int32)[None, :],
+                    0, max_pos - 1)
+                pos_emb = self.wpe(_T(pos_idx))
         elif position_ids is None and past_len + s <= max_pos:
             # Default positions are a contiguous arange, so the lookup is a
             # row slice of the weight — not a gather.  The slice's transpose
@@ -435,11 +461,10 @@ class GPTForCausalLM(Layer):
             # one decode program.
             from jax.sharding import NamedSharding, PartitionSpec as P
 
-            from ..parallel.api import batch_spec
+            from ..parallel.api import batch_spec, decode_cache_sharding
+            cache_sh = decode_cache_sharding(mesh)
             bspec = batch_spec(mesh)
             bax = bspec[0] if len(bspec) else None
-            hax = "mp" if mesh.shape.get("mp", 1) > 1 else None
-            cache_sh = NamedSharding(mesh, P(bax, None, hax, None))
             caches = [(jax.device_put(k, cache_sh),
                        jax.device_put(v, cache_sh)) for k, v in caches]
             ids = jax.device_put(ids, NamedSharding(mesh, P(bax, None)))

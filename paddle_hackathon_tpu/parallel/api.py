@@ -122,6 +122,19 @@ def batch_spec(mesh: Mesh) -> P:
     return P(data_axes)
 
 
+def decode_cache_sharding(mesh: Mesh):
+    """NamedSharding for a (B, T, heads, head_dim) KV-cache leaf: batch
+    over the data axes, heads on 'mp' (the qkv projection's natural
+    output sharding).  Single home for ``GPTForCausalLM._generate_static``
+    and the serving engine's slot cache — the layout must never diverge
+    between them."""
+    from jax.sharding import NamedSharding
+    bspec = batch_spec(mesh)
+    bax = bspec[0] if len(bspec) else None
+    hax = "mp" if mesh.shape.get("mp", 1) > 1 else None
+    return NamedSharding(mesh, P(bax, None, hax, None))
+
+
 def _collect_moe_aux(model):
     """Sum of the trace-fresh MoE load-balance aux values left on
     MoELayer instances by the forward just run (None when no MoE)."""

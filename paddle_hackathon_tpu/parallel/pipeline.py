@@ -547,9 +547,11 @@ class PipelineParallel:
                 # defaults the user never chose
                 if self._strategy.lamb:
                     opt_kind = "lamb"
+                    from ..optimizer.optimizers import LAMB_DEFAULTS
                     c = self._strategy.lamb_configs or {}
-                    opt_kwargs = {"lamb_weight_decay":
-                                  float(c.get("lamb_weight_decay", 0.01))}
+                    opt_kwargs = {"lamb_weight_decay": float(
+                        c.get("lamb_weight_decay",
+                              LAMB_DEFAULTS["lamb_weight_decay"]))}
                     if optimizer is not None and \
                             hasattr(optimizer, "_beta1"):
                         opt_kwargs.update(

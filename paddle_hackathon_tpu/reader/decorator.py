@@ -239,8 +239,9 @@ def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
 
     def queue_reader():
         q = multiprocessing.Queue(queue_size)
-        procs = [multiprocessing.Process(target=_mp_produce, args=(r, q),
-                                         daemon=True)
+        # non-daemonic: a reader may itself use multiprocessing (nested
+        # pools); the finally below terminates+joins on any exit path
+        procs = [multiprocessing.Process(target=_mp_produce, args=(r, q))
                  for r in readers]
         for p in procs:
             p.start()
