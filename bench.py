@@ -251,6 +251,33 @@ def bench_ppyoloe(batch=64, size=640, steps=100, warmup=5):
             "value": round(batch * steps / dt, 1), "unit": "imgs/s"}
 
 
+def _trace_device_ms(fn):
+    """Run ``fn`` under the jax profiler and return its summed top-level
+    XLA-op device time (ms) — the single owner of the trace-measurement
+    scaffold for the decode/serving rows (raise-safe stop, tools path,
+    temp-dir cleanup)."""
+    import shutil
+    import tempfile
+
+    outdir = tempfile.mkdtemp(prefix="bench_trace")
+    try:
+        jax.profiler.start_trace(outdir)
+        try:
+            fn()
+        finally:
+            # a raise mid-trace must not leave the profiler running for
+            # every subsequent suite row
+            jax.profiler.stop_trace()
+        sys.path.insert(0, os.path.join(os.path.dirname(
+            os.path.abspath(__file__)), "tools"))
+        from trace_util import toplevel_device_ms
+        dev_ms = toplevel_device_ms(outdir)
+    finally:
+        shutil.rmtree(outdir, ignore_errors=True)
+    assert dev_ms > 0, "empty profiler trace"
+    return dev_ms
+
+
 def bench_decode(batch=8, prompt=64, new_tokens=128):
     """One-program greedy decoding DEVICE throughput: one traced
     generate() call, summed top-level XLA-op device time (nested while
@@ -258,9 +285,6 @@ def bench_decode(batch=8, prompt=64, new_tokens=128):
     round-trip-bound (~100-160 ms per RTT, varying day to day) and
     measures the tunnel, not the chip — the round-3 "4,032 tok/s" row was
     ~2/3 tunnel latency (BASELINE.md round-4 decode notes)."""
-    import shutil
-    import tempfile
-
     import paddle_hackathon_tpu as paddle
     from paddle_hackathon_tpu.core.tensor import Tensor
     from paddle_hackathon_tpu.models import GPTForCausalLM, gpt_config
@@ -278,26 +302,50 @@ def bench_decode(batch=8, prompt=64, new_tokens=128):
                                          (batch, prompt)), jnp.int32))
     np.asarray(model.generate(ids, max_new_tokens=new_tokens,
                               temperature=0.0).numpy())  # compile+sync
-    outdir = tempfile.mkdtemp(prefix="bench_decode_trace")
-    try:
-        jax.profiler.start_trace(outdir)
-        try:
-            out = np.asarray(model.generate(
-                ids, max_new_tokens=new_tokens, temperature=0.0).numpy())
-        finally:
-            # a raise mid-trace must not leave the profiler running for
-            # every subsequent suite row
-            jax.profiler.stop_trace()
-        sys.path.insert(0, os.path.join(os.path.dirname(
-            os.path.abspath(__file__)), "tools"))
-        from trace_util import toplevel_device_ms
-        dev_ms = toplevel_device_ms(outdir)
-    finally:
-        shutil.rmtree(outdir, ignore_errors=True)
-    assert out.shape == (batch, prompt + new_tokens)
-    assert dev_ms > 0, "empty profiler trace"
+    outs = []
+    dev_ms = _trace_device_ms(lambda: outs.append(np.asarray(
+        model.generate(ids, max_new_tokens=new_tokens,
+                       temperature=0.0).numpy())))
+    assert outs[0].shape == (batch, prompt + new_tokens)
     return {"metric": "gpt2_greedy_decode_device_tokens_per_sec_per_chip",
             "value": round(batch * new_tokens / (dev_ms / 1e3), 1),
+            "unit": "tokens/s"}
+
+
+def bench_serving(streams=8, prompt=64, new_tokens=128, chunk=32):
+    """Continuous-batching serving (VERDICT r4 directive #2): aggregate
+    DEVICE tokens/s across `streams` concurrent requests through the
+    ServingEngine's slot-batched tick. Trace-measured like bench_decode —
+    per-tick wall through the axon tunnel is RTT-bound (one small D2H per
+    tick) and measures the tunnel, not the chip."""
+    import paddle_hackathon_tpu as paddle
+    from paddle_hackathon_tpu.inference.serving import ServingEngine
+    from paddle_hackathon_tpu.models import GPTForCausalLM, gpt_config
+
+    paddle.seed(0)
+    cfg = gpt_config("gpt2-small-en", hidden_dropout_prob=0.0,
+                     attention_dropout_prob=0.0)
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    for _, p in model.named_parameters():
+        if jnp.issubdtype(p._value.dtype, jnp.floating):
+            p._set_value(p._value.astype(jnp.bfloat16))
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(0, cfg.vocab_size, (prompt,)).astype(np.int32)
+               for _ in range(streams)]
+    eng = ServingEngine(model, max_slots=streams,
+                        max_len=prompt + new_tokens + chunk, chunk=chunk,
+                        auto_run=False, decode_window=16)
+    warm = eng.submit(prompts[0], 2)  # compile the tick
+    eng.run_until_idle()
+    assert warm.done
+    reqs = [eng.submit(p, new_tokens) for p in prompts]
+    dev_ms = _trace_device_ms(eng.run_until_idle)
+    assert all(r.done for r in reqs)
+    total = streams * new_tokens
+    return {"metric":
+            "gpt2_serving_8stream_device_tokens_per_sec_per_chip",
+            "value": round(total / (dev_ms / 1e3), 1),
             "unit": "tokens/s"}
 
 
@@ -316,6 +364,7 @@ SUITE = {
     "resnet": lambda: bench_resnet(),
     "ppyoloe": lambda: bench_ppyoloe(),
     "decode": lambda: bench_decode(),
+    "serving": lambda: bench_serving(),
 }
 
 

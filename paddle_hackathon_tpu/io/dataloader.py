@@ -60,12 +60,14 @@ class DataLoader:
                  shuffle=False, drop_last=False, collate_fn=None,
                  num_workers=0, use_buffer_reader=True, prefetch_factor=2,
                  use_shared_memory=True, timeout=0, worker_init_fn=None,
-                 persistent_workers=False):
+                 persistent_workers=False, use_process_workers=False):
         self.dataset = dataset
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = num_workers
         self.use_buffer_reader = use_buffer_reader
         self.prefetch_factor = max(prefetch_factor, 1)
+        self.use_shared_memory = use_shared_memory
+        self.use_process_workers = use_process_workers
         self.timeout = timeout
         self.worker_init_fn = worker_init_fn
         self._iterable_mode = isinstance(dataset, IterableDataset)
@@ -95,6 +97,8 @@ class DataLoader:
             return self._iter_iterable()
         if self.num_workers == 0:
             return self._iter_single()
+        if self.use_process_workers:
+            return iter(_ProcPrefetchIter(self))
         if self.use_buffer_reader:
             from ..core import native
             if native.available():

@@ -21,6 +21,17 @@
 //                                                 -> out elem count | <0
 //   pht_predictor_last_error()                    -> static error string
 //   pht_predictor_destroy(h)
+//
+// Generation serving (continuous batching — the DistModel-style
+// persistent runtime, fleet_executor/dist_model.cc):
+//   pht_engine_create(model_dir, max_slots, max_len, chunk) -> handle
+//   pht_engine_generate(h, prompt, prompt_len, max_new,
+//                       out, out_cap, timeout_s)  -> total tokens | <0
+//   pht_engine_destroy(h)
+// pht_engine_generate is CONCURRENT: it does not take the module mutex,
+// and the embedded engine batches requests from many caller threads into
+// the same device ticks. The GIL is released while a request waits
+// (threading.Event.wait), so callers block without serializing.
 
 #include <Python.h>
 
@@ -35,23 +46,35 @@ namespace {
 
 std::mutex g_mu;
 bool g_inited = false;
-std::string g_err;
+// error slot: written under its own mutex (pht_engine_generate runs
+// concurrently, outside g_mu); readers copy into a thread_local snapshot
+// so the returned pointer is stable for the calling thread while the
+// global keeps the cross-thread "last error anywhere" contract
+std::mutex g_err_mu;
+std::string g_err_store;
+thread_local std::string g_err_snapshot;
+
+void set_err(const std::string& msg) {
+  std::lock_guard<std::mutex> e(g_err_mu);
+  g_err_store = msg;
+}
 
 void set_err_from_python() {
   PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
   PyErr_Fetch(&type, &value, &tb);
-  g_err = "python error";
+  std::string msg = "python error";
   if (value) {
     PyObject* s = PyObject_Str(value);
     if (s) {
       const char* c = PyUnicode_AsUTF8(s);
-      if (c) g_err = c;
+      if (c) msg = c;
       Py_DECREF(s);
     }
   }
   Py_XDECREF(type);
   Py_XDECREF(value);
   Py_XDECREF(tb);
+  set_err(msg);
 }
 
 struct NativePredictor {
@@ -60,7 +83,11 @@ struct NativePredictor {
 
 }  // namespace
 
-PHT_API const char* pht_predictor_last_error() { return g_err.c_str(); }
+PHT_API const char* pht_predictor_last_error() {
+  std::lock_guard<std::mutex> e(g_err_mu);
+  g_err_snapshot = g_err_store;
+  return g_err_snapshot.c_str();
+}
 
 PHT_API int32_t pht_serving_init(const char* repo_dir) {
   std::lock_guard<std::mutex> g(g_mu);
@@ -81,7 +108,7 @@ PHT_API int32_t pht_serving_init(const char* repo_dir) {
       "import paddle_hackathon_tpu.inference as _pht_inf\n";
   int rc = PyRun_SimpleString(code.c_str());
   if (rc == 0) g_inited = true;
-  else g_err = "failed to import paddle_hackathon_tpu.inference";
+  else set_err("failed to import paddle_hackathon_tpu.inference");
   PyGILState_Release(gil);
   if (we_initialized) {
     // Py_InitializeEx left this thread holding the GIL via its thread
@@ -95,7 +122,7 @@ PHT_API int32_t pht_serving_init(const char* repo_dir) {
 PHT_API void* pht_predictor_create(const char* model_path) {
   std::lock_guard<std::mutex> g(g_mu);
   if (!g_inited) {
-    g_err = "pht_serving_init not called";
+    set_err("pht_serving_init not called");
     return nullptr;
   }
   PyGILState_STATE gil = PyGILState_Ensure();
@@ -116,7 +143,7 @@ PHT_API void* pht_predictor_create(const char* model_path) {
       PyDict_DelItemString(globals, "_pht_pred");
       PyDict_DelItemString(globals, "_pht_cfg");
     } else {
-      g_err = "predictor object missing after create";
+      set_err("predictor object missing after create");
     }
   } else {
     set_err_from_python();
@@ -137,7 +164,7 @@ PHT_API int64_t pht_predictor_run_f32(void* h, const float* in,
   std::lock_guard<std::mutex> g(g_mu);
   auto* np = static_cast<NativePredictor*>(h);
   if (!np || !np->predictor) {
-    g_err = "bad predictor handle";
+    set_err("bad predictor handle");
     return -3;
   }
   PyGILState_STATE gil = PyGILState_Ensure();
@@ -178,7 +205,7 @@ PHT_API int64_t pht_predictor_run_f32(void* h, const float* in,
       int64_t n_out = nbytes / static_cast<int64_t>(sizeof(float));
       int32_t yndim = static_cast<int32_t>(PyTuple_Size(yshape));
       if (n_out > out_cap || yndim > out_ndim_cap) {
-        g_err = "output buffer too small";
+        set_err("output buffer too small");
         ret = -2;
       } else {
         std::memcpy(out, PyBytes_AsString(buf_obj), nbytes);
@@ -216,4 +243,112 @@ PHT_API void pht_predictor_destroy(void* h) {
     PyGILState_Release(gil);
   }
   delete np;
+}
+
+namespace {
+struct NativeEngine {
+  PyObject* engine = nullptr;  // inference.serving.ServingEngine
+};
+}  // namespace
+
+PHT_API void* pht_engine_create(const char* model_dir, int32_t max_slots,
+                                int32_t max_len, int32_t chunk) {
+  std::lock_guard<std::mutex> g(g_mu);
+  if (!g_inited) {
+    set_err("pht_serving_init not called");
+    return nullptr;
+  }
+  PyGILState_STATE gil = PyGILState_Ensure();
+  NativeEngine* ne = nullptr;
+  PyObject* main = PyImport_AddModule("__main__");  // borrowed
+  PyObject* globals = PyModule_GetDict(main);       // borrowed
+  std::string code =
+      "_pht_eng = _pht_inf.serving.ServingEngine(\n"
+      "    _pht_inf.serving.load_for_serving(r'''" +
+      std::string(model_dir) + "'''),\n"
+      "    max_slots=" + std::to_string(max_slots) +
+      ", max_len=" + std::to_string(max_len) +
+      ", chunk=" + std::to_string(chunk) + ")\n";
+  PyObject* res = PyRun_String(code.c_str(), Py_file_input, globals, globals);
+  if (res) {
+    Py_DECREF(res);
+    PyObject* eng = PyDict_GetItemString(globals, "_pht_eng");  // borrowed
+    if (eng) {
+      ne = new NativeEngine();
+      Py_INCREF(eng);
+      ne->engine = eng;
+      PyDict_DelItemString(globals, "_pht_eng");
+    } else {
+      set_err("engine object missing after create");
+    }
+  } else {
+    set_err_from_python();
+  }
+  PyGILState_Release(gil);
+  return ne;
+}
+
+// Blocking generation: returns the FULL sequence (prompt + generated)
+// token count written to `out`, or <0: -1 python error/timeout, -2 output
+// buffer too small, -3 bad handle. Deliberately NOT under g_mu — requests
+// from concurrent caller threads batch into the same engine ticks.
+PHT_API int64_t pht_engine_generate(void* h, const int32_t* prompt,
+                                    int32_t prompt_len, int32_t max_new,
+                                    int32_t* out, int64_t out_cap,
+                                    double timeout_s) {
+  auto* ne = static_cast<NativeEngine*>(h);
+  if (!ne || !ne->engine) {
+    set_err("bad engine handle");
+    return -3;
+  }
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int64_t ret = -1;
+  PyObject* lst = PyList_New(prompt_len);
+  for (int32_t i = 0; i < prompt_len; i++)
+    PyList_SET_ITEM(lst, i, PyLong_FromLong(prompt[i]));
+  // generate(prompt, max_new_tokens, timeout): Event.wait inside releases
+  // the GIL, so the engine's tick thread and other callers keep running
+  PyObject* res = PyObject_CallMethod(ne->engine, "generate", "(Oid)", lst,
+                                      (int)max_new, timeout_s);
+  if (res) {
+    PyObject* as_list = PyObject_CallMethod(res, "tolist", nullptr);
+    if (as_list) {
+      Py_ssize_t n = PyList_Size(as_list);
+      if (n > out_cap) {
+        set_err("output buffer too small");
+        ret = -2;
+      } else {
+        for (Py_ssize_t i = 0; i < n; i++)
+          out[i] = (int32_t)PyLong_AsLong(PyList_GetItem(as_list, i));
+        ret = (int64_t)n;
+      }
+      Py_DECREF(as_list);
+    } else {
+      set_err_from_python();
+    }
+    Py_DECREF(res);
+  } else {
+    set_err_from_python();
+  }
+  Py_DECREF(lst);
+  PyErr_Clear();
+  PyGILState_Release(gil);
+  return ret;
+}
+
+PHT_API void pht_engine_destroy(void* h) {
+  std::lock_guard<std::mutex> g(g_mu);
+  auto* ne = static_cast<NativeEngine*>(h);
+  if (!ne) return;
+  if (Py_IsInitialized()) {
+    PyGILState_STATE gil = PyGILState_Ensure();
+    // drain the background loop before dropping the last reference so a
+    // daemon tick thread isn't left running against a freed engine
+    PyObject* r = PyObject_CallMethod(ne->engine, "shutdown", "(d)", 60.0);
+    if (!r) PyErr_Clear();
+    Py_XDECREF(r);
+    Py_XDECREF(ne->engine);
+    PyGILState_Release(gil);
+  }
+  delete ne;
 }

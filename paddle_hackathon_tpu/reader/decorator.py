@@ -209,6 +209,38 @@ def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
 # re-raise with context.
 _MP_ITEM, _MP_DONE, _MP_ERROR = "item", "done", "error"
 
+# Non-daemonic children (a reader may itself use multiprocessing) must
+# not hang interpreter exit when a generator is abandoned mid-iteration:
+# a child blocked on q.put() into a full queue would block
+# multiprocessing's own atexit join forever.  This handler registers
+# LATER than multiprocessing's (atexit is LIFO), so it terminates
+# leftover children FIRST.
+_mp_live_procs = []
+_mp_atexit_registered = False
+
+
+def _mp_terminate_leftovers():
+    for p in list(_mp_live_procs):
+        if p.is_alive():
+            p.terminate()
+
+
+def _mp_track(procs):
+    global _mp_atexit_registered
+    import atexit
+    if not _mp_atexit_registered:
+        atexit.register(_mp_terminate_leftovers)
+        _mp_atexit_registered = True
+    _mp_live_procs.extend(procs)
+
+
+def _mp_untrack(procs):
+    for p in procs:
+        try:
+            _mp_live_procs.remove(p)
+        except ValueError:
+            pass
+
 
 def _mp_produce(reader, q):
     """Child-process body: stream one reader into the shared queue."""
@@ -240,9 +272,11 @@ def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
     def queue_reader():
         q = multiprocessing.Queue(queue_size)
         # non-daemonic: a reader may itself use multiprocessing (nested
-        # pools); the finally below terminates+joins on any exit path
+        # pools); the finally below terminates+joins on any exit path,
+        # and the atexit guard covers abandoned generators
         procs = [multiprocessing.Process(target=_mp_produce, args=(r, q))
                  for r in readers]
+        _mp_track(procs)
         for p in procs:
             p.start()
         remaining = len(procs)
@@ -271,6 +305,7 @@ def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
                 if p.is_alive():
                     p.terminate()
                 p.join()
+            _mp_untrack(procs)
 
     # pipe-based variant behaves the same at this API level
     return queue_reader
