@@ -203,6 +203,59 @@ def bench_resnet(batch=256, steps=50, warmup=3):
             "value": round(batch * steps / dt, 1), "unit": "imgs/s"}
 
 
+def bench_resnet_input(batch=64, n_batches=24, workers=4):
+    """ResNet REAL-INPUT variant (VERDICT r4 directive #5): throughput of
+    the host input pipeline — per-sample Python decode+augment (a
+    GIL-bound transform, the class the thread pool serializes) through
+    process workers with shared-memory transfer. Host-only by design:
+    through the axon tunnel an end-to-end wall row measures H2D over the
+    tunnel, not the chip or the pipeline (BASELINE.md round-5 notes);
+    on co-located hosts this pipeline overlaps the synthetic-row compute.
+    """
+    import time as _time
+
+    from paddle_hackathon_tpu import io
+
+    class _AugmentedImages(io.Dataset):
+        """Synthetic 'decode + augment': numpy image plus a deliberately
+        Python-bound per-sample transform (~ms of pure bytecode, the
+        PIL/albumentations cost class)."""
+
+        def __len__(self):
+            return batch * n_batches
+
+        def __getitem__(self, i):
+            rng = np.random.RandomState(i)
+            img = rng.randint(0, 256, (3, 96, 96)).astype(np.float32)
+            acc = 0
+            for k in range(40000):  # GIL-bound python work
+                acc = (acc + k * i) % 1000003
+            img[0, 0, 0] += acc % 7
+            return img / 255.0, np.int64(i % 1000)
+
+    def run(nw, procs):
+        # use_buffer_reader=False for the thread comparison: same plain
+        # reorder pipeline both sides (the native staging ring is a
+        # separate path with its own cost profile)
+        loader = io.DataLoader(_AugmentedImages(), batch_size=batch,
+                               num_workers=nw, use_process_workers=procs,
+                               use_buffer_reader=False)
+        t0 = _time.perf_counter()
+        n = sum(x.shape[0] for x, _ in loader)
+        return n / (_time.perf_counter() - t0)
+
+    run(workers, True)  # warm fork/import costs
+    proc_rate = run(workers, True)
+    thread_rate = run(workers, False)
+    import os as _os
+    sys.stderr.write(
+        f"resnet_input: {workers}-process {proc_rate:.0f} imgs/s vs "
+        f"{workers}-thread {thread_rate:.0f} imgs/s "
+        f"({proc_rate / thread_rate:.2f}x on {_os.cpu_count()} cpu)\n")
+    return {"metric": "resnet50_input_pipeline_imgs_per_sec",
+            "value": round(proc_rate, 1), "unit": "imgs/s"}
+
+
 def bench_ppyoloe(batch=64, size=640, steps=100, warmup=5):
     # ~17 ms/step: anything under ~30 steps is dominated by the single
     # device->host sync latency through the axon tunnel (measured 2.4k
@@ -362,6 +415,7 @@ SUITE = {
         seqlen=4096, batch=4,
         metric="gpt2_long_context_s4096_tokens_per_sec_per_chip"),
     "resnet": lambda: bench_resnet(),
+    "resnet_input": lambda: bench_resnet_input(),
     "ppyoloe": lambda: bench_ppyoloe(),
     "decode": lambda: bench_decode(),
     "serving": lambda: bench_serving(),

@@ -98,9 +98,19 @@ PHT_API int32_t pht_serving_init(const char* repo_dir) {
     we_initialized = true;
   }
   PyGILState_STATE gil = PyGILState_Ensure();
+  // the path crosses as a Python OBJECT, never interpolated into source
+  // (a quote sequence in the path would break — or inject into — the
+  // generated code)
+  {
+    PyObject* main = PyImport_AddModule("__main__");
+    PyObject* globals = PyModule_GetDict(main);
+    PyObject* dir_obj = PyUnicode_FromString(repo_dir);
+    PyDict_SetItemString(globals, "_pht_repo_dir", dir_obj);
+    Py_XDECREF(dir_obj);
+  }
   std::string code =
       "import sys, os\n"
-      "sys.path.insert(0, r'''" + std::string(repo_dir) + "''')\n"
+      "sys.path.insert(0, _pht_repo_dir)\n"
       "_plat = os.environ.get('PHT_SERVING_PLATFORM')\n"
       "if _plat:\n"
       "    import jax\n"
@@ -129,10 +139,13 @@ PHT_API void* pht_predictor_create(const char* model_path) {
   NativePredictor* np = nullptr;
   PyObject* main = PyImport_AddModule("__main__");  // borrowed
   PyObject* globals = PyModule_GetDict(main);       // borrowed
-  std::string code =
-      "_pht_cfg = _pht_inf.Config(r'''" + std::string(model_path) + "''')\n"
+  PyObject* path_obj = PyUnicode_FromString(model_path);
+  PyDict_SetItemString(globals, "_pht_model_path", path_obj);
+  Py_XDECREF(path_obj);
+  const char* code =
+      "_pht_cfg = _pht_inf.Config(_pht_model_path)\n"
       "_pht_pred = _pht_inf.create_predictor(_pht_cfg)\n";
-  PyObject* res = PyRun_String(code.c_str(), Py_file_input, globals, globals);
+  PyObject* res = PyRun_String(code, Py_file_input, globals, globals);
   if (res) {
     Py_DECREF(res);
     PyObject* pred = PyDict_GetItemString(globals, "_pht_pred");  // borrowed
@@ -262,10 +275,12 @@ PHT_API void* pht_engine_create(const char* model_dir, int32_t max_slots,
   NativeEngine* ne = nullptr;
   PyObject* main = PyImport_AddModule("__main__");  // borrowed
   PyObject* globals = PyModule_GetDict(main);       // borrowed
+  PyObject* dir_obj = PyUnicode_FromString(model_dir);
+  PyDict_SetItemString(globals, "_pht_model_dir", dir_obj);
+  Py_XDECREF(dir_obj);
   std::string code =
       "_pht_eng = _pht_inf.serving.ServingEngine(\n"
-      "    _pht_inf.serving.load_for_serving(r'''" +
-      std::string(model_dir) + "'''),\n"
+      "    _pht_inf.serving.load_for_serving(_pht_model_dir),\n"
       "    max_slots=" + std::to_string(max_slots) +
       ", max_len=" + std::to_string(max_len) +
       ", chunk=" + std::to_string(chunk) + ")\n";
