@@ -11,6 +11,7 @@ state; the TPU keeps the dense compute. ``fleet.init_server/init_worker``
 """
 
 from .api import (PsServerHandle, PsClient, AsyncCommunicator,  # noqa: F401
-                  SparseEmbedding, TableConfig, init_server, init_worker,
+                  PsEmbeddingCache, SparseEmbedding, TableConfig,
+                  cached_sparse_embedding_layer, init_server, init_worker,
                   ps_sparse_embedding, run_server, sparse_embedding_layer,
                   stop_server, get_client, shutdown)

@@ -534,6 +534,236 @@ class SparseEmbedding:
         return Tensor(val, stop_gradient=False, _grad_node=node, _out_idx=0)
 
 
+class PsEmbeddingCache:
+    """Device-resident hot-row cache for a PS sparse table — the HeterPS
+    role (ref ``framework/fleet/ps_gpu_wrapper.cc``: hot sparse-table rows
+    cached in accelerator HBM so the training pass never leaves the
+    device for them), TPU-native mechanism:
+
+    - the cache is a ``(rows+1, dim)`` DEVICE array threaded through the
+      jitted step as program state (``Program.add_state``) or held by the
+      object in eager mode; row ``rows`` is scratch for padding;
+    - the in-step op gathers/scatters by SLOT; only the host<->device
+      traffic for MISSES (pull) and EVICTIONS (write-back) crosses the
+      boundary — hits are pure device gathers;
+    - LRU lives on the host; per-batch slot assignment is one tiny
+      host callback (ids -> slots), not a row transfer;
+    - write-back parity: the table rule must be plain ``sgd`` — local
+      row updates then COMMUTE with the server's rule, so pushing the
+      accumulated gradient ``(pulled - current)/lr`` at eviction leaves
+      the server exactly where uncached training would
+      (``tests/test_ps_cache.py`` pins parity).
+
+    ``stats``: hits / misses / evictions / writebacks counters.
+    """
+
+    def __init__(self, client: PsClient, table_id: int, dim: int,
+                 rows: int = 4096, lr: float = 0.05,
+                 init_range: float = 0.05):
+        import collections
+        self.client = client
+        self.table_id = table_id
+        self.dim = int(dim)
+        self.rows = int(rows)
+        self.lr = float(lr)
+        if table_id in client._tables:
+            rule = client._tables[table_id].rule
+            if rule != "sgd":
+                raise ValueError(
+                    f"PsEmbeddingCache needs table rule 'sgd' (got "
+                    f"{rule!r}): only linear updates commute with the "
+                    "deferred write-back")
+        else:
+            client.create_table(TableConfig(table_id, dim, rule="sgd",
+                                            lr=lr, init_range=init_range))
+        self.value = jnp.zeros((self.rows + 1, self.dim), jnp.float32)
+        self._slot_of = collections.OrderedDict()  # id -> slot (LRU order)
+        self._free = list(range(self.rows))
+        self._pulled = np.zeros((self.rows, self.dim), np.float32)
+        self._wb_queue = collections.deque()  # (ids, pulled_rows) pending
+        self._state_vars = {}  # id(program) -> state Variable
+        self.stats = {"hits": 0, "misses": 0, "evictions": 0,
+                      "writebacks": 0}
+
+    # -- program-state protocol (static/program.py add_state) -----------
+    def get(self):
+        return self.value
+
+    def set(self, arr):
+        self.value = arr
+
+    def updater(self, fwd_out, grad):
+        """Pure (traced into the step): forward-updated cache (fills
+        applied) minus the local sgd step on the batch's row gradients."""
+        return fwd_out - self.lr * grad
+
+    # -- host scheduling ------------------------------------------------
+    def _assign(self, ids_np):
+        """Map a batch of ids to slots; schedule fills (misses, pulled
+        from the PS) and write-backs (LRU evictions). Returns
+        (slots, fill_slots, fill_rows, wb_slots) with fixed width
+        K = ids.size (padded with the scratch row)."""
+        flat = np.asarray(ids_np).reshape(-1).astype(np.int64)
+        K = flat.size
+        uniq = list(dict.fromkeys(flat.tolist()))
+        needed = set(uniq)
+        miss_ids, fill_slot_list = [], []
+        wb_ids, wb_pulled, wb_slot_list = [], [], []
+        for uid in uniq:
+            if uid in self._slot_of:
+                self._slot_of.move_to_end(uid)
+                self.stats["hits"] += 1
+                continue
+            self.stats["misses"] += 1
+            if self._free:
+                s = self._free.pop()
+            else:
+                victim = next((i for i in self._slot_of
+                               if i not in needed), None)
+                if victim is None:
+                    raise RuntimeError(
+                        f"PsEmbeddingCache rows={self.rows} is smaller "
+                        f"than one batch's unique ids ({len(uniq)})")
+                s = self._slot_of.pop(victim)
+                self.stats["evictions"] += 1
+                wb_ids.append(victim)
+                wb_pulled.append(self._pulled[s].copy())
+                wb_slot_list.append(s)
+            self._slot_of[uid] = s
+            miss_ids.append(uid)
+            fill_slot_list.append(s)
+        fill_rows = np.zeros((K, self.dim), np.float32)
+        fill_slots = np.full(K, self.rows, np.int32)
+        if miss_ids:
+            rows = self.client.pull_sparse(
+                self.table_id, np.asarray(miss_ids, np.uint64))
+            fill_rows[:len(miss_ids)] = rows
+            fill_slots[:len(miss_ids)] = fill_slot_list
+            for s, r in zip(fill_slot_list, rows):
+                self._pulled[s] = r
+        wb_slots = np.full(K, self.rows, np.int32)
+        wb_slots[:len(wb_slot_list)] = wb_slot_list
+        self._wb_queue.append((np.asarray(wb_ids, np.uint64),
+                               np.asarray(wb_pulled, np.float32)
+                               if wb_ids else
+                               np.zeros((0, self.dim), np.float32)))
+        slots = np.asarray([self._slot_of[i] for i in flat.tolist()],
+                           np.int32)
+        return slots, fill_slots, fill_rows, wb_slots
+
+    def _push_wb(self, wb_rows):
+        """Write back the rows that left the cache: the server applies
+        -lr * grad, so grad = (pulled - current)/lr lands it exactly on
+        the locally-updated value."""
+        ids, pulled = self._wb_queue.popleft()
+        n = len(ids)
+        if n:
+            current = np.asarray(wb_rows[:n], np.float32)
+            grads = (pulled - current) / self.lr
+            self.client.push_sparse(self.table_id, ids, grads)
+            self.stats["writebacks"] += n
+        return np.zeros((), np.float32)
+
+    def flush(self):
+        """Write back every dirty cached row (end of training / before
+        saving the table). The cache stays populated."""
+        if not self._slot_of:
+            return
+        current = np.asarray(self.value)
+        ids = np.asarray(list(self._slot_of.keys()), np.uint64)
+        slots = np.asarray([self._slot_of[int(i)] for i in ids], np.int64)
+        grads = (self._pulled[slots] - current[slots]) / self.lr
+        self.client.push_sparse(self.table_id, ids, grads)
+        self.stats["writebacks"] += len(ids)
+        # rows are now in sync server-side: re-base the pull snapshot
+        for s in slots:
+            self._pulled[s] = current[s]
+
+    # -- the op ----------------------------------------------------------
+    def _fn(self, ids_arr, cache_arr):
+        """Traceable op body shared by static recording: one host
+        callback assigns slots (and pulls misses), the write-back rows
+        leave through a second ordered callback, fills apply with a
+        stop-gradient delta so dL/d(cache input) is the full scatter of
+        the embedding gradient (including freshly filled rows)."""
+        from jax.experimental import io_callback
+        K = int(np.prod(ids_arr.shape))
+        avals = (jax.ShapeDtypeStruct((K,), jnp.int32),
+                 jax.ShapeDtypeStruct((K,), jnp.int32),
+                 jax.ShapeDtypeStruct((K, self.dim), jnp.float32),
+                 jax.ShapeDtypeStruct((K,), jnp.int32))
+        slots, fill_slots, fill_rows, wb_slots = io_callback(
+            self._assign, avals, ids_arr, ordered=True)
+        wb_rows = jax.lax.stop_gradient(cache_arr)[wb_slots]
+        io_callback(self._push_wb, jax.ShapeDtypeStruct((), jnp.float32),
+                    wb_rows, ordered=True)
+        base = jax.lax.stop_gradient(cache_arr)
+        delta = jnp.zeros_like(base).at[fill_slots].set(
+            fill_rows - base[fill_slots])
+        cache_f = cache_arr + delta  # d cache_f / d cache_arr = identity
+        emb = cache_f[slots].reshape(tuple(ids_arr.shape) + (self.dim,))
+        return emb, cache_f
+
+
+def cached_sparse_embedding_layer(ids, cache: PsEmbeddingCache):
+    """Sparse-table lookup through a device-resident hot-row cache (the
+    ``sparse_embedding_layer`` fast tier — see :class:`PsEmbeddingCache`).
+    Works in static programs (the cache threads through the step as
+    program state) and eager mode."""
+    from ...core import autograd as _ag
+    from ...core.tensor import Tensor
+
+    sm = _ag._static_module
+    if (sm is not None and sm.in_static_mode()
+            and isinstance(ids, sm.Variable)):
+        prog = sm.default_main_program()
+        in_var = cache._state_vars.get(id(prog))
+        if in_var is None:
+            in_var = prog.add_state(
+                cache, name=f"ps_cache_{cache.table_id}")
+            cache._state_vars[id(prog)] = in_var
+        emb_var, out_var = prog.record_op(
+            "ps_cached_embedding", cache._fn, [ids, in_var], n_outputs=2)
+        prog.bind_state_out(in_var, out_var)
+        return emb_var
+
+    # eager: host scheduling directly, device gather/scatter, taped vjp
+    ids_np = np.asarray(ids.numpy() if hasattr(ids, "numpy") else ids)
+    slots, fill_slots, fill_rows, wb_slots = cache._assign(ids_np)
+    cache._push_wb(np.asarray(cache.value[wb_slots]))
+    cache.value = cache.value.at[fill_slots].set(jnp.asarray(fill_rows))
+    val = cache.value[slots].reshape(ids_np.shape + (cache.dim,))
+    from ...core.autograd import GradNode, is_grad_enabled
+    if not is_grad_enabled():
+        return Tensor(val)
+
+    flat_ids = ids_np.reshape(-1).astype(np.int64)
+
+    def vjp_fn(cotangents):
+        # resolve slots at BACKWARD time, keyed by id: a later forward
+        # on the same cache may have evicted/remapped slots since this
+        # forward, and a stale slot index would land the gradient on
+        # another id's row. Ids no longer cached push their gradient
+        # straight to the PS (by id — the always-safe route).
+        g = np.asarray(cotangents[0]).reshape(-1, cache.dim)
+        cur_slots = np.asarray(
+            [cache._slot_of.get(int(i), -1) for i in flat_ids], np.int64)
+        here = cur_slots >= 0
+        if here.any():
+            scat = jnp.zeros_like(cache.value).at[
+                jnp.asarray(cur_slots[here])].add(jnp.asarray(g[here]))
+            cache.value = cache.value - cache.lr * scat
+        if (~here).any():
+            cache.client.push_sparse(
+                cache.table_id, flat_ids[~here].astype(np.uint64),
+                g[~here])
+        return ()
+
+    node = GradNode("ps_cached_embedding", vjp_fn, [], 1,
+                    [(val.shape, val.dtype)])
+    return Tensor(val, stop_gradient=False, _grad_node=node, _out_idx=0)
+
+
 # ---------------------------------------------------------------------------
 # fleet-style lifecycle driven by the launcher's env protocol
 # ---------------------------------------------------------------------------

@@ -393,22 +393,40 @@ class _ProcPrefetchIter:
         if self._closed:
             return
         self._closed = True
+        # graceful first: sentinels let each worker finish its CURRENT
+        # task and flush its queue feeder — terminating straight away
+        # would strand in-flight shm segments that no process can name
+        # anymore (the worker already unregistered them)
+        for _ in self.workers:
+            self.task_q.put(None)
+        pending = list(self.results.values())
+        self.results.clear()
+        import queue as _q
+        import time as _time
+        deadline = _time.monotonic() + 5.0
+        while (any(w.is_alive() for w in self.workers)
+               and _time.monotonic() < deadline):
+            try:
+                item = self.data_q.get(timeout=0.1)
+            except _q.Empty:
+                continue
+            if item and not isinstance(item[0], str):
+                pending.append((item[1], item[2]))
         for w in self.workers:
             if w.is_alive():
                 w.terminate()
             w.join()
-        # unlink shared-memory blocks still parked in results AND those
-        # undrained in data_q (workers unregistered them — ownership is
-        # ours; an early-terminated epoch must not leak /dev/shm)
-        pending = list(self.results.values())
-        self.results.clear()
+        # final drain after join: everything the feeders flushed
         while True:
             try:
                 item = self.data_q.get_nowait()
             except Exception:
                 break
-            if item and item[0] != "error":
+            if item and not isinstance(item[0], str):
                 pending.append((item[1], item[2]))
+        # unlink segments parked in results or undrained in the queue —
+        # ownership transferred to the parent; an early-terminated epoch
+        # must not leak /dev/shm
         from multiprocessing import shared_memory
         for metas, _ in pending:
             for meta in metas:

@@ -105,8 +105,15 @@ PHT_API int32_t pht_serving_init(const char* repo_dir) {
     PyObject* main = PyImport_AddModule("__main__");
     PyObject* globals = PyModule_GetDict(main);
     PyObject* dir_obj = PyUnicode_FromString(repo_dir);
+    if (!dir_obj) {  // e.g. non-UTF-8 bytes in the path
+      PyErr_Clear();
+      set_err("repo_dir is not valid UTF-8");
+      PyGILState_Release(gil);
+      if (we_initialized) PyEval_SaveThread();
+      return -1;
+    }
     PyDict_SetItemString(globals, "_pht_repo_dir", dir_obj);
-    Py_XDECREF(dir_obj);
+    Py_DECREF(dir_obj);
   }
   std::string code =
       "import sys, os\n"
@@ -140,8 +147,14 @@ PHT_API void* pht_predictor_create(const char* model_path) {
   PyObject* main = PyImport_AddModule("__main__");  // borrowed
   PyObject* globals = PyModule_GetDict(main);       // borrowed
   PyObject* path_obj = PyUnicode_FromString(model_path);
+  if (!path_obj) {
+    PyErr_Clear();
+    set_err("model_path is not valid UTF-8");
+    PyGILState_Release(gil);
+    return nullptr;
+  }
   PyDict_SetItemString(globals, "_pht_model_path", path_obj);
-  Py_XDECREF(path_obj);
+  Py_DECREF(path_obj);
   const char* code =
       "_pht_cfg = _pht_inf.Config(_pht_model_path)\n"
       "_pht_pred = _pht_inf.create_predictor(_pht_cfg)\n";
@@ -276,8 +289,14 @@ PHT_API void* pht_engine_create(const char* model_dir, int32_t max_slots,
   PyObject* main = PyImport_AddModule("__main__");  // borrowed
   PyObject* globals = PyModule_GetDict(main);       // borrowed
   PyObject* dir_obj = PyUnicode_FromString(model_dir);
+  if (!dir_obj) {
+    PyErr_Clear();
+    set_err("model_dir is not valid UTF-8");
+    PyGILState_Release(gil);
+    return nullptr;
+  }
   PyDict_SetItemString(globals, "_pht_model_dir", dir_obj);
-  Py_XDECREF(dir_obj);
+  Py_DECREF(dir_obj);
   std::string code =
       "_pht_eng = _pht_inf.serving.ServingEngine(\n"
       "    _pht_inf.serving.load_for_serving(_pht_model_dir),\n"

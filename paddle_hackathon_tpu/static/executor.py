@@ -43,6 +43,11 @@ class Executor:
             if v.name not in feed:
                 raise ValueError(f"missing feed {v.name!r}")
             feed_map[v._var_id] = jnp.asarray(feed[v.name])
+        # program step state (e.g. the PS device embedding cache): fed
+        # from the owners, stored back after the run
+        owners = [ent[2] for ent in program._states]
+        for ent in program._states:
+            feed_map[ent[0]._var_id] = ent[2].get()
 
         key = (id(program), len(program._instructions),
                tuple(sorted((vid, arr.shape, str(arr.dtype))
@@ -57,18 +62,20 @@ class Executor:
         feed_arrays = [feed_map[vid] for vid in sorted(feed_map)]
         param_arrays = [p._value for p in params]
         if opt is None:
-            fetches = run_fn(feed_arrays, param_arrays)
+            fetches, new_state = run_fn(feed_arrays, param_arrays)
         else:
             optimizer, _ = program._minimize
             states = [optimizer._get_accumulators(p) for p in params]
             lr = jnp.asarray(optimizer.get_lr(), jnp.float32)
             step_t = jnp.asarray(optimizer._step_count + 1, jnp.int32)
-            fetches, new_vals, new_states = run_fn(
+            fetches, new_vals, new_states, new_state = run_fn(
                 feed_arrays, param_arrays, states, lr, step_t)
             for p, v, s in zip(params, new_vals, new_states):
                 p._set_value(v)
                 optimizer._accumulators[id(p)] = s
             optimizer._step_count += 1
+        for owner, arr in zip(owners, new_state):
+            owner.set(arr)
         if return_numpy:
             return [np.asarray(f) for f in fetches]
         return [Tensor(f) for f in fetches]
@@ -215,9 +222,15 @@ class Executor:
 
     def _compile(self, program: Program, feed_ids: List[int], fetch_vars):
         params = program.all_parameters()
-        trainable = [p for p in params
-                     if getattr(p, "trainable", False)]
         minimize = program._minimize
+        # program step state: position of each state input in feed_ids,
+        # its forward-out var, and the (pure) updater
+        st_pos = [feed_ids.index(ent[0]._var_id) for ent in program._states]
+        st_out = [ent[1] for ent in program._states]
+        st_upd = [ent[2].updater for ent in program._states]
+        if any(v is None for v in st_out):
+            raise RuntimeError("program state registered without a bound "
+                               "forward output (bind_state_out)")
 
         def replay_with(feed_arrays, param_arrays):
             feed_values = dict(zip(feed_ids, feed_arrays))
@@ -227,7 +240,10 @@ class Executor:
         if minimize is None:
             def run_fn(feed_arrays, param_arrays):
                 env = replay_with(feed_arrays, param_arrays)
-                return [env[v._var_id] for v in fetch_vars]
+                # forward-only (infer path): state keeps its forward
+                # update (cache fills persist), no gradient term
+                new_state = [env[v._var_id] for v in st_out]
+                return [env[v._var_id] for v in fetch_vars], new_state
 
             return jax.jit(run_fn), params, None
 
@@ -236,16 +252,21 @@ class Executor:
                  if getattr(p, "trainable", False)]
 
         def run_fn(feed_arrays, param_arrays, states, lr, step_t):
-            def loss_of(train_arrays):
+            def loss_of(train_arrays, state_arrays):
                 full = list(param_arrays)
                 for i, v in zip(t_idx, train_arrays):
                     full[i] = v
-                env = replay_with(feed_arrays, full)
+                feeds = list(feed_arrays)
+                for i, v in zip(st_pos, state_arrays):
+                    feeds[i] = v
+                env = replay_with(feeds, full)
                 return env[loss_var._var_id], env
 
             train_arrays = [param_arrays[i] for i in t_idx]
-            (loss, env), grads = jax.value_and_grad(loss_of, has_aux=True)(
-                train_arrays)
+            state_arrays = [feed_arrays[i] for i in st_pos]
+            (loss, env), (grads, st_grads) = jax.value_and_grad(
+                loss_of, argnums=(0, 1), has_aux=True)(
+                train_arrays, state_arrays)
             t_states = [states[i] for i in t_idx]
             plrs = tuple(params[i].optimize_attr.get("learning_rate", 1.0)
                          for i in t_idx)
@@ -256,8 +277,12 @@ class Executor:
             for i, v, s in zip(t_idx, new_train, new_t_states):
                 new_vals[i] = v
                 new_states[i] = s
+            # state update: forward-updated value (fills) + the owner's
+            # gradient rule (e.g. local sgd on cached embedding rows)
+            new_state = [upd(env[v._var_id], g)
+                         for upd, v, g in zip(st_upd, st_out, st_grads)]
             fetches = [env[v._var_id] for v in fetch_vars]
-            return fetches, new_vals, new_states
+            return fetches, new_vals, new_states, new_state
 
         return jax.jit(run_fn), params, optimizer
 

@@ -126,6 +126,11 @@ class Program:
         self._instructions: List[_Instruction] = []
         self._vars: Dict[int, Variable] = {}
         self._feeds: List[Variable] = []
+        # gradient-aware step state: [in_var, out_var, owner] — device
+        # arrays threaded through every run (auto-fed from owner.get(),
+        # updated via owner.updater(forward_out, dL/dstate), stored back
+        # with owner.set()). Carries e.g. the PS device embedding cache.
+        self._states: List[list] = []
         self._next_id = 0
         self._minimize: Optional[Tuple[Any, Variable]] = None  # (optimizer, loss)
         self.random_seed = None
@@ -176,6 +181,26 @@ class Program:
             name, fn, inputs, [v._var_id for v in out_vars],
             len(outs_avals)))
         return out_vars[0] if single else tuple(out_vars)
+
+    def add_state(self, owner, name=None):
+        """Register step state owned by ``owner`` (``get() -> array``,
+        ``set(array)``, ``updater(forward_out, grad) -> array`` — updater
+        must be pure/traceable: it runs inside the compiled step).
+        Returns the state's input Variable; the caller records an op
+        producing the forward-updated state and binds it with
+        :meth:`bind_state_out`."""
+        arr = owner.get()
+        aval = jax.ShapeDtypeStruct(tuple(arr.shape), arr.dtype)
+        v = self._new_var(aval, name=name or f"_state_{len(self._states)}")
+        self._states.append([v, None, owner])
+        return v
+
+    def bind_state_out(self, in_var, out_var):
+        for ent in self._states:
+            if ent[0] is in_var:
+                ent[1] = out_var
+                return
+        raise ValueError("bind_state_out: unknown state input variable")
 
     # -- introspection ------------------------------------------------------
     def global_block(self):

@@ -1,0 +1,211 @@
+"""Device-resident PS embedding cache (VERDICT r4 missing #3/directive #7
+— the HeterPS role, ref ``framework/fleet/ps_gpu_wrapper.cc``: hot
+sparse-table rows cached in accelerator memory; here a (rows+1, dim)
+device array threaded through the jitted step as program state, slot
+gather/scatter in-step, LRU + miss-pull + eviction write-back on the
+host boundary)."""
+
+import numpy as np
+import pytest
+
+import paddle_hackathon_tpu as paddle
+from paddle_hackathon_tpu import nn, optimizer, static
+from paddle_hackathon_tpu.distributed import QueueDataset
+
+from test_train_from_dataset import _make_dataset, _write_files
+
+
+def _cluster():
+    from paddle_hackathon_tpu.distributed.ps import PsClient, PsServerHandle
+    try:
+        server = PsServerHandle()
+    except RuntimeError:
+        pytest.skip("native PS unavailable")
+    client = PsClient([f"127.0.0.1:{server.port}"])
+    return server, client
+
+
+class TestEagerCache:
+    def test_parity_with_uncached_and_counters(self):
+        """Same seeds, same batches: the cached lookup trains EXACTLY
+        like the uncached PS path (local sgd commutes with the server's
+        sgd), and after flush() the server table matches too."""
+        from paddle_hackathon_tpu.distributed.ps import (
+            PsEmbeddingCache, SparseEmbedding, cached_sparse_embedding_layer)
+        server, client = _cluster()
+        server2, client2 = _cluster()
+        try:
+            dim, lr = 4, 0.1
+            emb_ref = SparseEmbedding(client, table_id=7, dim=dim,
+                                      rule="sgd", lr=lr)
+            cache = PsEmbeddingCache(client2, table_id=7, dim=dim,
+                                     rows=16, lr=lr)
+            rng = np.random.RandomState(0)
+            batches = [rng.randint(0, 12, (8,)) for _ in range(6)]
+            for ids in batches:
+                for use_cache in (False, True):
+                    out = (cached_sparse_embedding_layer(
+                               paddle.to_tensor(ids), cache) if use_cache
+                           else emb_ref(paddle.to_tensor(ids)))
+                    loss = (out * out).sum()
+                    loss.backward()
+            cache.flush()
+            probe = np.arange(12, dtype=np.uint64)
+            ref_rows = client.pull_sparse(7, probe)
+            got_rows = client2.pull_sparse(7, probe)
+            np.testing.assert_allclose(got_rows, ref_rows, atol=1e-5)
+            s = cache.stats
+            assert s["hits"] > 0 and s["misses"] > 0
+            assert s["misses"] <= 12  # at most one miss per distinct id
+            assert s["writebacks"] >= s["misses"]  # flush covered them
+        finally:
+            client.close(); server.stop()
+            client2.close(); server2.stop()
+
+    def test_lru_eviction_and_writeback(self):
+        from paddle_hackathon_tpu.distributed.ps import (
+            PsEmbeddingCache, cached_sparse_embedding_layer)
+        server, client = _cluster()
+        try:
+            cache = PsEmbeddingCache(client, table_id=3, dim=4, rows=4,
+                                     lr=0.1)
+            with paddle.no_grad():
+                cached_sparse_embedding_layer(
+                    paddle.to_tensor(np.asarray([0, 1, 2, 3])), cache)
+                # 4 new ids: all previous rows must evict + write back
+                cached_sparse_embedding_layer(
+                    paddle.to_tensor(np.asarray([4, 5, 6, 7])), cache)
+            assert cache.stats["evictions"] == 4
+            assert cache.stats["writebacks"] == 4
+            # over-capacity batch is a clear error, not silent corruption
+            with pytest.raises(RuntimeError, match="smaller"):
+                cached_sparse_embedding_layer(
+                    paddle.to_tensor(np.arange(6)), cache)
+        finally:
+            client.close(); server.stop()
+
+    def test_backward_after_eviction_routes_grads_by_id(self):
+        """Gradient accumulation across forwards that remap slots: the
+        vjp must key by ID, not by the forward-time slot — ids evicted
+        before backward push their gradient straight to the PS, and the
+        result matches the uncached path exactly."""
+        from paddle_hackathon_tpu.distributed.ps import (
+            PsEmbeddingCache, SparseEmbedding, cached_sparse_embedding_layer)
+        server, client = _cluster()
+        server2, client2 = _cluster()
+        try:
+            dim, lr = 4, 0.1
+            ref = SparseEmbedding(client, table_id=5, dim=dim, rule="sgd",
+                                  lr=lr)
+            cache = PsEmbeddingCache(client2, table_id=5, dim=dim, rows=2,
+                                     lr=lr)
+            ids1 = paddle.to_tensor(np.asarray([0, 1]))
+            ids2 = paddle.to_tensor(np.asarray([2, 3]))
+            # cached: second forward evicts ids 0/1 BEFORE backward runs
+            o1 = cached_sparse_embedding_layer(ids1, cache)
+            o2 = cached_sparse_embedding_layer(ids2, cache)
+            ((o1 * o1).sum() + (o2 * o2).sum()).backward()
+            cache.flush()
+            # uncached reference, same math
+            r1, r2 = ref(ids1), ref(ids2)
+            ((r1 * r1).sum() + (r2 * r2).sum()).backward()
+            probe = np.arange(4, dtype=np.uint64)
+            np.testing.assert_allclose(client2.pull_sparse(5, probe),
+                                       client.pull_sparse(5, probe),
+                                       atol=1e-5)
+        finally:
+            client.close(); server.stop()
+            client2.close(); server2.stop()
+
+    def test_rejects_non_sgd_table(self):
+        from paddle_hackathon_tpu.distributed.ps import (PsEmbeddingCache,
+                                                         TableConfig)
+        server, client = _cluster()
+        try:
+            client.create_table(TableConfig(9, 4, rule="adagrad", lr=0.1))
+            with pytest.raises(ValueError, match="sgd"):
+                PsEmbeddingCache(client, table_id=9, dim=4, rows=8)
+        finally:
+            client.close(); server.stop()
+
+
+class TestStaticCache:
+    """train_from_dataset CTR config with the cache threaded through the
+    compiled step as program state (the directive's 'done' criterion)."""
+
+    @pytest.fixture(autouse=True)
+    def _static_mode(self):
+        paddle.enable_static()
+        yield
+        paddle.disable_static()
+
+    def _build(self, use_cache, client, cache_rows=64):
+        from paddle_hackathon_tpu.distributed.ps import (
+            PsEmbeddingCache, cached_sparse_embedding_layer,
+            sparse_embedding_layer)
+        dim, lr = 8, 0.25
+        main, startup = static.Program(), static.Program()
+        cache = None
+        with static.program_guard(main, startup):
+            ids = static.data("ids", [None, 3], "int64")
+            dense = static.data("dense", [None, 4], "float32")
+            label = static.data("label", [None, 1], "float32")
+            if use_cache:
+                cache = PsEmbeddingCache(client, table_id=42, dim=dim,
+                                         rows=cache_rows, lr=lr)
+                emb = cached_sparse_embedding_layer(ids, cache)
+            else:
+                emb = sparse_embedding_layer(ids, table_id=42, dim=dim,
+                                             client=client, rule="sgd",
+                                             lr=lr)
+            emb_flat = emb.reshape([-1, 3 * dim])
+            feat = paddle.concat([emb_flat, dense], axis=1)
+            lin = nn.Linear(3 * dim + 4, 1)
+            logit = lin(feat)
+            loss = nn.functional.binary_cross_entropy_with_logits(logit,
+                                                                  label)
+            optimizer.SGD(learning_rate=0.5).minimize(loss)
+        return main, startup, loss, cache
+
+    def _train(self, tmp_path, use_cache, cache_rows=64, epochs=8):
+        server, client = _cluster()
+        try:
+            paddle.seed(0)
+            paths = _write_files(tmp_path, n_files=2, rows=64)
+            main, startup, loss, cache = self._build(use_cache, client,
+                                                     cache_rows)
+            exe = static.Executor()
+            exe.run(startup)
+            losses = []
+            for _ in range(epochs):
+                out = exe.train_from_dataset(main, _make_dataset(paths),
+                                             fetch_list=[loss])
+                losses.append(float(np.asarray(out[0])))
+            if cache is not None:
+                cache.flush()
+            rows = client.pull_sparse(42, np.arange(50, dtype=np.uint64))
+            return losses, rows, cache
+        finally:
+            client.close()
+            server.stop()
+
+    def test_ctr_cached_matches_uncached(self, tmp_path):
+        ref_losses, ref_rows, _ = self._train(tmp_path, use_cache=False)
+        losses, rows, cache = self._train(tmp_path, use_cache=True)
+        assert losses[-1] < losses[0] * 0.95, losses
+        np.testing.assert_allclose(losses, ref_losses, rtol=1e-4)
+        np.testing.assert_allclose(rows, ref_rows, atol=1e-4)
+        s = cache.stats
+        assert s["hits"] > 0
+        # hot ids (50-wide vocab over 8 epochs) overwhelmingly hit
+        assert s["hits"] / (s["hits"] + s["misses"]) > 0.9
+
+    def test_ctr_cached_with_evictions_matches(self, tmp_path):
+        """Cache smaller than the vocab: rows churn through eviction +
+        write-back every epoch and training still matches uncached."""
+        ref_losses, ref_rows, _ = self._train(tmp_path, use_cache=False)
+        losses, rows, cache = self._train(tmp_path, use_cache=True,
+                                          cache_rows=40)
+        np.testing.assert_allclose(losses, ref_losses, rtol=1e-4)
+        np.testing.assert_allclose(rows, ref_rows, atol=1e-4)
+        assert cache.stats["evictions"] > 0
