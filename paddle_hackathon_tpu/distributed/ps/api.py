@@ -567,12 +567,19 @@ class PsEmbeddingCache:
         self.rows = int(rows)
         self.lr = float(lr)
         if table_id in client._tables:
-            rule = client._tables[table_id].rule
-            if rule != "sgd":
+            cfg = client._tables[table_id]
+            if cfg.rule != "sgd":
                 raise ValueError(
                     f"PsEmbeddingCache needs table rule 'sgd' (got "
-                    f"{rule!r}): only linear updates commute with the "
+                    f"{cfg.rule!r}): only linear updates commute with the "
                     "deferred write-back")
+            if abs(cfg.lr - self.lr) > 1e-12 or cfg.dim != self.dim:
+                raise ValueError(
+                    f"PsEmbeddingCache(lr={self.lr}, dim={dim}) does not "
+                    f"match table {table_id}'s (lr={cfg.lr}, "
+                    f"dim={cfg.dim}): the write-back pushes "
+                    "(pulled-current)/lr, so a mismatched lr silently "
+                    "breaks parity")
         else:
             client.create_table(TableConfig(table_id, dim, rule="sgd",
                                             lr=lr, init_range=init_range))
@@ -717,14 +724,22 @@ def cached_sparse_embedding_layer(ids, cache: PsEmbeddingCache):
     if (sm is not None and sm.in_static_mode()
             and isinstance(ids, sm.Variable)):
         prog = sm.default_main_program()
-        in_var = cache._state_vars.get(id(prog))
-        if in_var is None:
+        ent = cache._state_vars.get(id(prog))
+        if ent is None:
             in_var = prog.add_state(
                 cache, name=f"ps_cache_{cache.table_id}")
-            cache._state_vars[id(prog)] = in_var
+            ent = cache._state_vars[id(prog)] = {"in": in_var,
+                                                 "cur": in_var}
+        # a SECOND lookup through the same cache chains off the previous
+        # op's output (its fills), not the original state input — the
+        # state binding always points at the LAST op's output so every
+        # fill persists; gradients flow through the chain's identity
+        # Jacobian and sum across lookups
         emb_var, out_var = prog.record_op(
-            "ps_cached_embedding", cache._fn, [ids, in_var], n_outputs=2)
-        prog.bind_state_out(in_var, out_var)
+            "ps_cached_embedding", cache._fn, [ids, ent["cur"]],
+            n_outputs=2)
+        ent["cur"] = out_var
+        prog.bind_state_out(ent["in"], out_var)
         return emb_var
 
     # eager: host scheduling directly, device gather/scatter, taped vjp

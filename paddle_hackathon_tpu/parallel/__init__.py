@@ -55,4 +55,5 @@ from .auto_parallel import (Engine, ProcessMesh, shard_op,  # noqa: F401
 from .store import TCPStore  # noqa: F401
 from .dist_checkpoint import (load_sharded, load_train_state,  # noqa: F401
                               reshard, save_sharded, save_train_state)
-from .planner import plan_sharding, score_plan  # noqa: F401
+from .planner import (MeshPlan, enumerate_meshes, plan_mesh,  # noqa: F401
+                      plan_sharding, score_plan)

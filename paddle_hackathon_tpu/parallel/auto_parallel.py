@@ -439,21 +439,45 @@ class Engine:
         return conv(batch), None
 
     # -- public API ----------------------------------------------------------
-    def plan(self, sample_inputs, axis: str = "mp", score: bool = False):
-        """Auto-derive TP shardings for un-annotated parameters (the
-        reference's Planner/Mapper step, ``auto_parallel/planner.py``):
-        trace the model on ``sample_inputs``, choose column/row/embedding
-        roles from dataflow, optionally score against replication with the
-        compiler, and apply the winning shardings to the model in place.
-        Call before ``prepare``/``fit``. Returns the rule (``rule.plan`` /
-        ``rule.why`` / ``rule.report`` describe the decision)."""
-        from .api import shard_params
-        from .planner import plan_sharding
+    def plan(self, sample_inputs, axis: str = "mp", score: bool = False,
+             n_devices: Optional[int] = None, **mesh_plan_kwargs):
+        """Auto-derive the distributed layout (the reference's
+        Planner/Mapper step, ``auto_parallel/planner.py``).
+
+        Default: trace the model on ``sample_inputs``, choose
+        column/row/embedding TP roles from dataflow, optionally score
+        against replication with the compiler, and apply the winning
+        shardings to the model in place (call before ``prepare``/``fit``;
+        returns the rule with ``rule.plan``/``rule.why``/``rule.report``).
+
+        With ``n_devices=``: planner v2 — recommend the whole MESH
+        (dp/mp/pp/sharding factorization + zero stage) by AOT-compiling
+        every candidate and choosing the fastest estimate that fits
+        memory (``planner.plan_mesh``). The engine's mesh is replaced by
+        the recommendation; returns the ``MeshPlan``."""
+        from .api import create_mesh, shard_params
+        from .planner import plan_mesh, plan_sharding
 
         sample = sample_inputs if isinstance(sample_inputs, (tuple, list)) \
             else (sample_inputs,)
         sample = tuple(a._value if isinstance(a, Tensor) else a
                        for a in sample)
+        if n_devices is not None:
+            choice = plan_mesh(self.model, n_devices, sample,
+                               **mesh_plan_kwargs)
+            dims = choice.mesh_dims
+            self._pm = ProcessMesh(
+                np.arange(n_devices).reshape(tuple(dims.values())),
+                dim_names=list(dims))
+            create_mesh(dims, devices=jax.devices()[:n_devices])
+            if choice.zero_stage:
+                # apply the recommendation, not just record it: the
+                # prepared train step gates sharding on the strategy
+                self.strategy.sharding = True
+                self.strategy.sharding_stage = choice.zero_stage
+            if choice.rule is not None:
+                shard_params(self.model, self.mesh, rule=choice.rule)
+            return choice
         rule = plan_sharding(self.model, self.mesh, sample, axis=axis,
                              score=score)
         shard_params(self.model, self.mesh, rule=rule)

@@ -141,3 +141,100 @@ class TestPlanNameFree:
             assert tuple(spec) == (None, "mp")
         finally:
             parallel.set_mesh(None)
+
+
+class TestPlanMesh:
+    """Planner v2 (VERDICT r4 missing #7): recommend the MESH — every
+    candidate factorization AOT-compiled and measured (memory gate +
+    compute/bubble/comm score)."""
+
+    def _model(self, layers=2):
+        paddle.seed(0)
+        from paddle_hackathon_tpu.models import GPTConfig, GPTForCausalLM
+        cfg = GPTConfig(vocab_size=256, hidden_size=64, num_layers=layers,
+                        num_heads=4, max_position_embeddings=32,
+                        hidden_dropout_prob=0.0, attention_dropout_prob=0.0,
+                        use_flash_attention=False)
+        return GPTForCausalLM(cfg)
+
+    def test_enumerate_meshes_filters(self):
+        from paddle_hackathon_tpu.parallel import enumerate_meshes
+        cands = enumerate_meshes(8, n_layers=2, batch=8)
+        keys = [tuple(sorted(d.items())) for d in cands]
+        assert len(set(keys)) == len(keys)  # deduped
+        for d in cands:
+            n = 1
+            for v in d.values():
+                n *= v
+            assert n == 8 or (n < 8 and list(d) == ["dp"])
+            assert d.get("pp", 1) in (1, 2)  # pp must divide 2 layers
+        assert {"dp": 8} in cands and {"mp": 8} in cands
+
+    def test_plan_mesh_picks_measured_best_and_pins_table(self):
+        """On the 8-device virtual mesh the recommendation must be the
+        feasible candidate with the minimal estimated step — and for
+        this comm-dominated tiny GPT that is a pp-bearing config (pp
+        halves the dp grad-allreduce payload), with pure-dp next."""
+        m = self._model()
+        ids = jnp.asarray(np.random.RandomState(0).randint(0, 256, (8, 32)),
+                          jnp.int32)
+        cands = [{"dp": 8}, {"dp": 4, "pp": 2}, {"dp": 4, "mp": 2},
+                 {"sharding": 4, "mp": 2}, {"dp": 2, "mp": 4}]
+        try:
+            choice = parallel.plan_mesh(m, 8, (ids,), candidates=cands,
+                                        zero_stages=(0,))
+        finally:
+            parallel.set_mesh(None)
+        feas = [r for r in choice.table if r.get("feasible")]
+        assert len(feas) >= 4
+        best = min(feas, key=lambda r: r["est_step_s"])
+        assert choice.mesh_dims == best["mesh"]
+        assert choice.mesh_dims == {"dp": 4, "pp": 2}
+        # every row carries the compiler's measurements
+        for r in feas:
+            assert r["bytes_per_device"] > 0
+            assert "collective_bytes" in r
+
+    def test_plan_mesh_memory_budget_forces_sharding(self):
+        """A budget below the replicated footprint must push the choice
+        to a config that shards parameters (zero-3 or mp)."""
+        m = self._model()
+        ids = jnp.asarray(np.random.RandomState(0).randint(0, 256, (8, 32)),
+                          jnp.int32)
+        cands = [{"dp": 8}, {"sharding": 8}, {"dp": 2, "sharding": 4}]
+        try:
+            full = parallel.plan_mesh(m, 8, (ids,), candidates=[{"dp": 8}],
+                                      zero_stages=(0,))
+            dp8 = full.table[0]["bytes_per_device"]
+            choice = parallel.plan_mesh(m, 8, (ids,), candidates=cands,
+                                        hbm_bytes=dp8 * 0.8)
+        finally:
+            parallel.set_mesh(None)
+        assert "sharding" in choice.mesh_dims
+        assert choice.zero_stage == 3
+        dp8_rows = [r for r in choice.table if r["mesh"] == {"dp": 8}]
+        assert all(not r["feasible"] for r in dp8_rows)
+
+    def test_plan_mesh_no_fit_raises(self):
+        m = self._model()
+        ids = jnp.asarray(np.zeros((8, 32)), jnp.int32)
+        with pytest.raises(RuntimeError, match="memory budget"):
+            try:
+                parallel.plan_mesh(m, 8, (ids,), candidates=[{"dp": 8}],
+                                   zero_stages=(0,), hbm_bytes=1.0)
+            finally:
+                parallel.set_mesh(None)
+
+    def test_engine_plan_n_devices(self):
+        from paddle_hackathon_tpu.parallel.auto_parallel import Engine
+        m = self._model()
+        ids = jnp.asarray(np.random.RandomState(0).randint(0, 256, (8, 32)),
+                          jnp.int32)
+        try:
+            eng = Engine(m)
+            choice = eng.plan((ids,), n_devices=8,
+                              candidates=[{"dp": 8}, {"dp": 4, "pp": 2}],
+                              zero_stages=(0,))
+            assert dict(eng.mesh.shape) == choice.mesh_dims
+        finally:
+            parallel.set_mesh(None)

@@ -128,6 +128,21 @@ class TestEagerCache:
         finally:
             client.close(); server.stop()
 
+    def test_rejects_mismatched_lr_or_dim(self):
+        from paddle_hackathon_tpu.distributed.ps import (PsEmbeddingCache,
+                                                         TableConfig)
+        server, client = _cluster()
+        try:
+            client.create_table(TableConfig(11, 4, rule="sgd", lr=0.01))
+            with pytest.raises(ValueError, match="lr"):
+                PsEmbeddingCache(client, table_id=11, dim=4, rows=8,
+                                 lr=0.05)
+            with pytest.raises(ValueError, match="dim"):
+                PsEmbeddingCache(client, table_id=11, dim=8, rows=8,
+                                 lr=0.01)
+        finally:
+            client.close(); server.stop()
+
 
 class TestStaticCache:
     """train_from_dataset CTR config with the cache threaded through the
@@ -199,6 +214,66 @@ class TestStaticCache:
         assert s["hits"] > 0
         # hot ids (50-wide vocab over 8 epochs) overwhelmingly hit
         assert s["hits"] / (s["hits"] + s["misses"]) > 0.9
+
+    def test_two_lookups_one_cache_chain(self):
+        """Two cached lookups through ONE cache in one program: the
+        second op must chain off the first's output so BOTH ops' fills
+        persist (a rebound state output would silently zero the first
+        lookup's rows)."""
+        from paddle_hackathon_tpu.distributed.ps import (
+            PsEmbeddingCache, cached_sparse_embedding_layer,
+            sparse_embedding_layer)
+        server, client = _cluster()
+        server2, client2 = _cluster()
+        try:
+            dim, lr = 4, 0.2
+
+            def build(use_cache, client_):
+                main, startup = static.Program(), static.Program()
+                with static.program_guard(main, startup):
+                    a = static.data("a", [None, 2], "int64")
+                    b = static.data("b", [None, 2], "int64")
+                    if use_cache:
+                        cache = PsEmbeddingCache(client_, table_id=6,
+                                                 dim=dim, rows=32, lr=lr)
+                        e1 = cached_sparse_embedding_layer(a, cache)
+                        e2 = cached_sparse_embedding_layer(b, cache)
+                    else:
+                        cache = None
+                        e1 = sparse_embedding_layer(
+                            a, table_id=6, dim=dim, client=client_,
+                            rule="sgd", lr=lr)
+                        e2 = sparse_embedding_layer(
+                            b, table_id=6, dim=dim, client=client_,
+                            rule="sgd", lr=lr)
+                    loss = (e1 * e1).sum() + (e2 * e2).sum()
+                    optimizer.SGD(learning_rate=0.5).minimize(loss)
+                return main, startup, loss, cache
+
+            feeds = [{"a": np.asarray([[0, 1], [2, 3]], np.int64),
+                      "b": np.asarray([[1, 4], [0, 5]], np.int64)}
+                     for _ in range(4)]
+            ref_main, ref_start, ref_loss, _ = build(False, client)
+            exe = static.Executor()
+            exe.run(ref_start)
+            ref_losses = [float(np.asarray(
+                exe.run(ref_main, feed=f, fetch_list=[ref_loss])[0]))
+                for f in feeds]
+            c_main, c_start, c_loss, cache = build(True, client2)
+            exe2 = static.Executor()
+            exe2.run(c_start)
+            losses = [float(np.asarray(
+                exe2.run(c_main, feed=f, fetch_list=[c_loss])[0]))
+                for f in feeds]
+            np.testing.assert_allclose(losses, ref_losses, rtol=1e-5)
+            cache.flush()
+            probe = np.arange(6, dtype=np.uint64)
+            np.testing.assert_allclose(client2.pull_sparse(6, probe),
+                                       client.pull_sparse(6, probe),
+                                       atol=1e-5)
+        finally:
+            client.close(); server.stop()
+            client2.close(); server2.stop()
 
     def test_ctr_cached_with_evictions_matches(self, tmp_path):
         """Cache smaller than the vocab: rows churn through eviction +
