@@ -39,6 +39,7 @@ from .core.dtype import (bfloat16, bool_, complex64, complex128, float16,
 from .core.flags import get_flags, set_flags
 from .core.random import get_rng_state, get_rng_state_tracker, set_rng_state
 from .core.random import seed as _seed_fn
+from .core.string_tensor import StringTensor
 from .core.tensor import Tensor, to_tensor
 
 from . import ops

@@ -1,0 +1,102 @@
+"""StringTensor + strings kernels (the last §2 inventory row).
+
+Ref ``paddle/phi/core/string_tensor.h`` (StringTensor over pstring
+payloads), ``phi/api/yaml/strings_api.yaml`` (empty / empty_like /
+lower / upper) and ``phi/kernels/strings/strings_lower_upper_kernel.h``
+(ASCII fast path vs ``use_utf8_encoding`` unicode path), with the
+eager constructor surface of ``core.eager.StringTensor``
+(``test_egr_string_tensor_api.py``).
+
+TPU-native design note: strings are HOST data here exactly as in the
+reference (its string kernels are CPU/GPU-host utilities, never MXU
+work) — the payload is a numpy unicode array; nothing is staged to the
+accelerator.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..utils import unique_name
+
+__all__ = ["StringTensor", "strings_empty", "strings_empty_like",
+           "strings_lower", "strings_upper"]
+
+_ASCII_LOWER = str.maketrans(
+    {c: chr(ord(c) + 32) for c in "ABCDEFGHIJKLMNOPQRSTUVWXYZ"})
+_ASCII_UPPER = str.maketrans(
+    {c: chr(ord(c) - 32) for c in "abcdefghijklmnopqrstuvwxyz"})
+
+
+class StringTensor:
+    """A tensor of strings (host-resident).
+
+    Constructors mirror ``core.eager.StringTensor``:
+    ``StringTensor()`` (empty scalar), ``StringTensor([2, 3])`` (empty
+    of shape), ``StringTensor(ndarray_or_nested_list)``,
+    ``StringTensor(other_string_tensor)`` (copy); all accept an
+    optional ``name``.
+    """
+
+    def __init__(self, value=None, name: str | None = None):
+        if value is None:
+            arr = np.asarray("", dtype=np.str_)
+        elif isinstance(value, StringTensor):
+            arr = value._value.copy()
+        elif (isinstance(value, (list, tuple)) and value
+              and all(isinstance(v, (int, np.integer)) for v in value)):
+            arr = np.empty(tuple(int(v) for v in value), dtype=np.str_)
+        else:
+            arr = np.asarray(value, dtype=np.str_)
+        self._value = arr
+        self.name = (name if name is not None
+                     else unique_name.generate("generated_string_tensor"))
+
+    @property
+    def shape(self):
+        return list(self._value.shape)
+
+    def numpy(self):
+        v = self._value
+        # scalar StringTensor mirrors the reference: numpy() is the str
+        return v.item() if v.ndim == 0 else v
+
+    def _map(self, fn):
+        flat = [fn(s) for s in self._value.reshape(-1)]
+        out = np.asarray(flat, dtype=np.str_).reshape(self._value.shape) \
+            if flat else np.empty(self._value.shape, np.str_)
+        return StringTensor(out)
+
+    def lower(self, use_utf8_encoding: bool = False) -> "StringTensor":
+        """ref strings_api.yaml ``lower``: ASCII-only case map by
+        default; ``use_utf8_encoding=True`` applies the full unicode
+        case conversion (the reference's unicode.h path)."""
+        if use_utf8_encoding:
+            return self._map(str.lower)
+        return self._map(lambda s: s.translate(_ASCII_LOWER))
+
+    def upper(self, use_utf8_encoding: bool = False) -> "StringTensor":
+        if use_utf8_encoding:
+            return self._map(str.upper)
+        return self._map(lambda s: s.translate(_ASCII_UPPER))
+
+    def __repr__(self):
+        return (f"StringTensor(name={self.name!r}, shape={self.shape}, "
+                f"{self._value!r})")
+
+
+def strings_empty(shape) -> StringTensor:
+    """ref strings_api.yaml ``empty``."""
+    return StringTensor(list(shape) if shape else None)
+
+
+def strings_empty_like(x: StringTensor) -> StringTensor:
+    return StringTensor(list(x.shape) if x.shape else None)
+
+
+def strings_lower(x: StringTensor, use_utf8_encoding: bool = False):
+    return x.lower(use_utf8_encoding)
+
+
+def strings_upper(x: StringTensor, use_utf8_encoding: bool = False):
+    return x.upper(use_utf8_encoding)

@@ -588,7 +588,9 @@ class PsEmbeddingCache:
         self._free = list(range(self.rows))
         self._pulled = np.zeros((self.rows, self.dim), np.float32)
         self._wb_queue = collections.deque()  # (ids, pulled_rows) pending
-        self._state_vars = {}  # id(program) -> state Variable
+        # id(program) -> {"in": state input Variable, "cur": the latest
+        # op's state output (chained lookups thread through it)}
+        self._state_vars = {}
         self.stats = {"hits": 0, "misses": 0, "evictions": 0,
                       "writebacks": 0}
 

@@ -465,7 +465,13 @@ class Engine:
         if n_devices is not None:
             choice = plan_mesh(self.model, n_devices, sample,
                                **mesh_plan_kwargs)
-            dims = choice.mesh_dims
+            # canonical axis order (create_mesh's AXES): the ProcessMesh
+            # must assign axes to the same physical devices as the global
+            # mesh, or Engine-placed params and get_mesh() users shard
+            # against different layouts
+            from .api import AXES
+            dims = {a: choice.mesh_dims[a] for a in AXES
+                    if a in choice.mesh_dims}
             self._pm = ProcessMesh(
                 np.arange(n_devices).reshape(tuple(dims.values())),
                 dim_names=list(dims))

@@ -592,9 +592,11 @@ def plan_mesh(model, n_devices, sample_args, labels=None, loss_fn=None,
                 mem = (score["arg_bytes_per_device"]
                        + score["temp_bytes_per_device"])
                 pp = dims.get("pp", 1)
-                micro = max(batch // (dims.get("dp", 1)
-                                      * dims.get("sharding", 1)
-                                      * dims.get("ep", 1)), 1)
+                # the scored step runs make_sharded_train_step's DEFAULT
+                # microbatching, pp_microbatches = pp — the bubble factor
+                # must describe the program that was compiled, not the
+                # batch's theoretical maximum microbatch count
+                micro = pp
                 bubble = (micro + pp - 1) / micro if pp > 1 else 1.0
                 compute_s = score.get("flops_per_device", 0.0) / peak_flops
                 comm_s = score["collective_bytes"] / bw_ring

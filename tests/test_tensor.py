@@ -133,3 +133,46 @@ def test_tensor_iteration_yields_rows_and_terminates():
     np.testing.assert_allclose(rows[2], [4.0, 5.0])
     with pytest.raises(TypeError):
         iter(paddle.to_tensor(np.float32(1.0)))
+
+
+class TestStringTensor:
+    """StringTensor + strings kernels (ref ``phi/core/string_tensor.h``,
+    ``strings_api.yaml``, eager surface ``test_egr_string_tensor_api.py``)."""
+
+    def test_constructors(self):
+        import paddle_hackathon_tpu as paddle
+        st1 = paddle.StringTensor()
+        assert st1.shape == [] and st1.numpy() == ""
+        st2 = paddle.StringTensor([2, 3], "ST2")
+        assert st2.name == "ST2" and st2.shape == [2, 3]
+        arr = np.array([["Hello World"], ["straße CAFÉ"]])
+        st3 = paddle.StringTensor(arr)
+        assert st3.shape == [2, 1]
+        assert np.array_equal(st3.numpy(), arr)
+        st4 = paddle.StringTensor(st3)          # copy constructor
+        assert np.array_equal(st4.numpy(), arr)
+        assert st3.name != st4.name             # generated names differ
+
+    def test_lower_upper_ascii_vs_utf8(self):
+        import paddle_hackathon_tpu as paddle
+        st = paddle.StringTensor(np.array(["Hello", "straße CAFÉ"]))
+        low = st.lower()                        # ASCII-only map
+        assert low.numpy().tolist() == ["hello", "straße cafÉ"]
+        low8 = st.lower(use_utf8_encoding=True)
+        assert low8.numpy().tolist() == ["hello", "straße café"]
+        up8 = st.upper(use_utf8_encoding=True)
+        assert up8.numpy().tolist() == ["HELLO", "STRASSE CAFÉ"]
+        up = st.upper()
+        assert up.numpy().tolist() == ["HELLO", "STRAßE CAFÉ"]
+
+    def test_strings_kernels(self):
+        import paddle_hackathon_tpu as paddle
+        from paddle_hackathon_tpu.core.string_tensor import (
+            strings_empty, strings_empty_like, strings_lower, strings_upper)
+        e = strings_empty([2, 2])
+        assert e.shape == [2, 2]
+        el = strings_empty_like(e)
+        assert el.shape == [2, 2]
+        st = paddle.StringTensor(np.array(["AbC"]))
+        assert strings_lower(st).numpy().tolist() == ["abc"]
+        assert strings_upper(st).numpy().tolist() == ["ABC"]
