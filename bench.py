@@ -434,7 +434,7 @@ def bench_serving(streams=8, prompt=64, new_tokens=128, chunk=32):
                for _ in range(streams)]
     eng = ServingEngine(model, max_slots=streams,
                         max_len=prompt + new_tokens + chunk, chunk=chunk,
-                        auto_run=False, decode_window=16)
+                        auto_run=False, decode_window=32)
     warm = eng.submit(prompts[0], 2)  # compile the tick
     eng.run_until_idle()
     assert warm.done
