@@ -266,6 +266,22 @@ class TestPipelineInterleaved:
         finally:
             parallel.set_mesh(None)
 
+    def test_pp2_mp2_composes(self):
+        """pp x mp: stage slabs TP-sharded by the rule; GSPMD inserts the
+        in-tick mp collectives inside the manual-pp region (the engine
+        analog of the pp x mp single-stream decode parity)."""
+        m, prompts, refs = self._setup()
+        parallel.create_mesh({"pp": 2, "mp": 2}, devices=jax.devices()[:4])
+        try:
+            eng = ServingEngine(m, max_slots=2, max_len=64, chunk=4)
+            reqs = [eng.submit(p, 8) for p in prompts]
+            for req, ref in zip(reqs, refs):
+                assert req.wait(300)
+                np.testing.assert_array_equal(req.result(), ref)
+            eng.shutdown()
+        finally:
+            parallel.set_mesh(None)
+
 
 def test_capacity_guard():
     m = _model()
