@@ -350,6 +350,143 @@ def bench_ppyoloe_train(batch=16, size=640, steps=50, warmup=3):
             "value": round(batch * steps / dt, 1), "unit": "imgs/s"}
 
 
+class _LMLoss:
+    """Callable loss for hapi fit: mean fused softmax-CE over all rows —
+    the same math the hand-rolled step's default loss_fn uses."""
+
+    def __call__(self, logits, labels):
+        from paddle_hackathon_tpu.core.tensor import Tensor
+        from paddle_hackathon_tpu.nn.functional.loss import \
+            fused_softmax_ce_rows
+        lg = logits._value if isinstance(logits, Tensor) else logits
+        lab = labels._value if isinstance(labels, Tensor) else labels
+        return Tensor(jnp.mean(fused_softmax_ce_rows(lg, lab)))
+
+
+def _hapi_fit_tps(seqlen, batch, steps, warmup, jit_compile, k=8,
+                  param_dtype=jnp.bfloat16, preset="gpt2-small-en",
+                  **cfg_kw):
+    """tokens/s through ``Model.fit`` (compiled or eager path).
+
+    Timing via a callback: t0 after the warmup window's loss is fetched
+    (drains the dispatch pipeline), t1 at on_train_end (fit has already
+    block_until_ready'd the last window) — compile time excluded, async
+    dispatch included, matching how the hand-rolled `_timed_steps` rows
+    measure."""
+    import paddle_hackathon_tpu as paddle
+    from paddle_hackathon_tpu import hapi, io, nn
+    from paddle_hackathon_tpu import optimizer as optim
+    from paddle_hackathon_tpu.models import GPTForCausalLM, gpt_config
+
+    if jit_compile:
+        assert warmup % k == 0 and steps % k == 0, (warmup, steps, k)
+    paddle.seed(0)
+    cfg = gpt_config(preset, hidden_dropout_prob=0.0,
+                     attention_dropout_prob=0.0, **cfg_kw)
+    cfg.max_position_embeddings = max(cfg.max_position_embeddings, seqlen)
+    net = GPTForCausalLM(cfg)
+    if param_dtype is not None:
+        for _, p in net.named_parameters():
+            if jnp.issubdtype(p._value.dtype, jnp.floating):
+                p._set_value(p._value.astype(param_dtype))
+
+    rng = np.random.RandomState(0)
+    n = batch * (warmup + steps)
+
+    class _IdsDS(io.Dataset):
+        def __len__(self):
+            return n
+
+        def __getitem__(self, i):
+            r = np.random.RandomState(i)
+            return (r.randint(0, cfg.vocab_size, (seqlen,)).astype(np.int32),
+                    r.randint(0, cfg.vocab_size, (seqlen,)).astype(np.int64))
+
+    model = hapi.Model(net)
+    # same rule the hand-rolled step compiles: adam(0.9, 0.95) + global
+    # norm clip 1.0 — the two programs must be comparable
+    model.prepare(
+        optimizer=optim.Adam(learning_rate=1e-4, beta1=0.9, beta2=0.95,
+                             parameters=net.parameters(),
+                             grad_clip=nn.ClipGradByGlobalNorm(1.0)),
+        loss=_LMLoss())
+
+    class _Timer(hapi.callbacks.Callback):
+        def __init__(self):
+            self.t0 = self.t1 = None
+            self.last = -1
+
+        def on_train_batch_end(self, step, logs=None):
+            if step == warmup - 1:
+                assert np.isfinite(float(logs["loss"]))  # drain pipeline
+                self.t0 = time.perf_counter()
+            self.last = step
+
+        def on_train_end(self, logs=None):
+            self.t1 = time.perf_counter()
+
+    timer = _Timer()
+    model.fit(_IdsDS(), epochs=1, batch_size=batch, shuffle=False,
+              verbose=0, log_freq=10 ** 9, num_iters=warmup + steps,
+              jit_compile=jit_compile if jit_compile else False,
+              steps_per_execution=k if jit_compile else 1,
+              callbacks=[timer])
+    assert timer.last == warmup + steps - 1
+    if jit_compile:
+        assert model._fit_used_compiled, "compiled fit path did not engage"
+    return batch * seqlen * steps / (timer.t1 - timer.t0)
+
+
+def bench_hapi_fit(seqlen=1024, batch=32, steps=48, warmup=8, k=8):
+    """GPT-2-small pretraining tokens/s THROUGH ``Model.fit``'s compiled
+    multi-step trainer (fused donated step + K-step scan + device
+    prefetch) — the five-line-trainer path, gated so it cannot silently
+    fall behind the hand-rolled `gpt2` row."""
+    value = _hapi_fit_tps(seqlen, batch, steps, warmup, jit_compile=True,
+                          k=k)
+    return {"metric": "hapi_fit_tokens_per_sec",
+            "value": round(value, 1), "unit": "tokens/s"}
+
+
+def bench_fit_compare():
+    """--fit mode: compiled Model.fit vs the hand-rolled jitted step vs
+    eager Model.fit, one JSON line with the two ratios the acceptance
+    gate reads (compiled within 10% of hand-rolled; >=2x eager).  On CPU
+    the config scales down like the cpu smoke path (same model family,
+    f32) — ratios remain meaningful, absolute tokens/s are not chip
+    numbers."""
+    on_tpu = any(d.platform in ("tpu", "axon") for d in jax.devices())
+    if on_tpu:
+        fit_kw = dict(seqlen=1024, batch=32, steps=48, warmup=8, k=8,
+                      param_dtype=jnp.bfloat16)
+        hand_kw = dict(seqlen=1024, batch=32, steps=48, warmup=8,
+                       param_dtype=jnp.bfloat16)
+        eager_steps = 8
+        metric = "hapi_fit_tokens_per_sec"
+    else:
+        small = dict(num_layers=2, hidden_size=128, num_heads=4,
+                     vocab_size=1024)
+        fit_kw = dict(seqlen=128, batch=4, steps=16, warmup=8, k=4,
+                      param_dtype=None, **small)
+        hand_kw = dict(seqlen=128, batch=4, steps=16, warmup=2,
+                       param_dtype=jnp.float32,
+                       metric="hapi_fit_tokens_per_sec_cpu_smoke", **small)
+        eager_steps = 8
+        metric = "hapi_fit_tokens_per_sec_cpu_smoke"
+    fit_tps = _hapi_fit_tps(jit_compile=True, **fit_kw)
+    hand_tps = bench_gpt2(**hand_kw)["value"]
+    eager_kw = dict(fit_kw, steps=eager_steps, warmup=2, k=1)
+    eager_tps = _hapi_fit_tps(jit_compile=False, **eager_kw)
+    row = {"metric": metric, "value": round(fit_tps, 1),
+           "unit": "tokens/s",
+           "handrolled_tokens_per_sec": round(hand_tps, 1),
+           "eager_fit_tokens_per_sec": round(eager_tps, 1),
+           "vs_handrolled": round(fit_tps / hand_tps, 4),
+           "vs_eager_fit": round(fit_tps / eager_tps, 4)}
+    print(json.dumps(row))
+    return row
+
+
 def _trace_device_ms(fn):
     """Run ``fn`` under the jax profiler and return its summed top-level
     XLA-op device time (ms) — the single owner of the trace-measurement
@@ -466,6 +603,9 @@ SUITE = {
     "ppyoloe_train": lambda: bench_ppyoloe_train(),
     "decode": lambda: bench_decode(),
     "serving": lambda: bench_serving(),
+    # the high-level trainer's compiled fast path (hapi/compiled.py):
+    # tokens/s through Model.fit must track the hand-rolled gpt2 row
+    "hapi_fit": lambda: bench_hapi_fit(),
 }
 
 
@@ -708,6 +848,9 @@ def headline_trace():
 def main():
     if "--suite" in sys.argv:
         run_suite()
+        return
+    if "--fit" in sys.argv:
+        bench_fit_compare()
         return
     if "--one" in sys.argv:
         name = sys.argv[sys.argv.index("--one") + 1]

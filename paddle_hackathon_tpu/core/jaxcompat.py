@@ -1,0 +1,72 @@
+"""Version tolerance for the two jax APIs whose spelling moved.
+
+The repo targets the current jax surface (``jax.shard_map`` with
+``axis_names``/``check_vma``, ambient-mesh ``jax.set_mesh``).  Older jax
+(0.4.x — the pinned toolchain on some build hosts) ships the same
+capabilities under the previous names: ``jax.experimental.shard_map``
+with ``(mesh, check_rep, auto)``, and no ambient-mesh context (the mesh
+rides explicitly on every shard_map / NamedSharding).  These two helpers
+present the new surface on both, so call sites stay written against the
+current API.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+import jax
+
+try:
+    from jax import shard_map as _shard_map_new
+    _NEW_SHARD_MAP = True
+except ImportError:
+    from jax.experimental.shard_map import shard_map as _shard_map_old
+    _NEW_SHARD_MAP = False
+
+try:
+    jax.export
+except AttributeError:
+    import jax.export  # registers the jax.export submodule on old jax
+
+
+def shard_map(fn, *, mesh=None, in_specs, out_specs, axis_names=None,
+              check_vma=False):
+    if _NEW_SHARD_MAP:
+        kw = dict(in_specs=in_specs, out_specs=out_specs,
+                  check_vma=check_vma)
+        if mesh is not None:
+            kw["mesh"] = mesh
+        if axis_names is not None:
+            kw["axis_names"] = frozenset(axis_names)
+        return _shard_map_new(fn, **kw)
+    if mesh is None:
+        from ..parallel.api import get_mesh
+        mesh = get_mesh()
+    if mesh is None:
+        raise ValueError(
+            "jax<0.6 shard_map needs an explicit mesh (no ambient-mesh "
+            "context exists to read one from)")
+    auto = frozenset()
+    if axis_names is not None:
+        auto = frozenset(mesh.axis_names) - frozenset(axis_names)
+    if auto:
+        # Partial-manual regions are beyond old jax: axis_index lowers to
+        # an unpartitionable PartitionId, and collectives (ppermute/psum)
+        # hit `Check failed: target.IsManualSubgroup()` — a C++ CHECK that
+        # ABORTS the process.  Refuse up front with a Python error
+        # instead of letting XLA kill the interpreter.
+        raise NotImplementedError(
+            "partial-manual shard_map (manual "
+            f"{sorted(frozenset(axis_names))} over mesh "
+            f"{sorted(mesh.axis_names)}) requires jax>=0.6; on this jax "
+            "it hard-aborts XLA's SPMD partitioner")
+    return _shard_map_old(fn, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_rep=False, auto=auto)
+
+
+def set_mesh(mesh):
+    if hasattr(jax, "set_mesh"):
+        return jax.set_mesh(mesh)
+    # old jax: the Mesh object itself is the context manager (physical
+    # ambient mesh); None callers get a no-op context
+    return mesh if mesh is not None else contextlib.nullcontext()

@@ -7,6 +7,21 @@ import os
 import time
 
 
+def _scalar(v):
+    """Printable float for a log value, or None to skip it.  Loss values
+    from the compiled fit path arrive as DEVICE scalars (the host sync is
+    deferred to print time — hapi/compiled.py's async-loss contract);
+    0-d arrays fetch here, non-scalars are skipped."""
+    if isinstance(v, numbers.Number):
+        return float(v)
+    if getattr(v, "ndim", None) == 0:
+        try:
+            return float(v)
+        except TypeError:
+            return None
+    return None
+
+
 class Callback:
     def set_params(self, params):
         self.params = params
@@ -72,15 +87,16 @@ class ProgBarLogger(Callback):
         if self.verbose and step % self.log_freq == 0:
             msgs = [f"step {step}/{self.steps or '?'}"]
             for k, v in (logs or {}).items():
-                if isinstance(v, numbers.Number):
-                    msgs.append(f"{k}: {v:.4f}")
+                s = _scalar(v)
+                if s is not None:
+                    msgs.append(f"{k}: {s:.4f}")
             print(f"Epoch {self.epoch + 1}/{self.epochs} - " + " - ".join(msgs))
 
     def on_epoch_end(self, epoch, logs=None):
         if self.verbose:
             dur = time.time() - self._start
-            msgs = [f"{k}: {v:.4f}" for k, v in (logs or {}).items()
-                    if isinstance(v, numbers.Number)]
+            msgs = [f"{k}: {s:.4f}" for k, v in (logs or {}).items()
+                    if (s := _scalar(v)) is not None]
             print(f"Epoch {epoch + 1}/{self.epochs} done ({dur:.1f}s) - "
                   + " - ".join(msgs))
 

@@ -1,0 +1,156 @@
+"""Compiled multi-step trainer behind ``Model.fit``.
+
+The eager ``Model.train_batch`` re-dispatches the network op-by-op every
+batch, runs the eager tape backward, and forces a device→host sync via
+``float(loss)`` — per-step dispatch overhead the hardware never sees in
+the hand-rolled jitted train step (``parallel/api.py
+make_sharded_train_step``).  This trainer lifts the same design into the
+high-level API:
+
+- ONE jitted program per step covering forward + backward + the
+  configured optimizer's functional update (``Optimizer.functional_update``),
+  with the whole train state (params + accumulators + step counter)
+  donated — in-place HBM update, zero copies;
+- optional K-step unroll: K prefetched batches stack into a superbatch
+  and a single ``lax.scan`` advances K steps per Python→device round trip
+  (the step body comes from the shared builder
+  ``parallel.api.make_functional_train_step``);
+- losses stay device scalars; the fit loop fetches them only at
+  ``log_freq`` boundaries and epoch end.
+
+``Model.fit`` falls back transparently to the eager path when the
+network/optimizer is not pure-functional-capable — see
+``CompiledTrainer.unsupported_reason`` and the trace-failure handling in
+``Model._run_compiled_epoch``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core import random as core_random
+from ..core.tensor import Tensor
+from ..nn.layer import functional_call
+from ..parallel.api import make_functional_train_step
+
+
+def _to_list(x):
+    if x is None:
+        return []
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+def _mutating_layer_types():
+    """Layer classes whose forward mutates registered buffers in training
+    mode (running BN stats, spectral-norm power iterates) — state the
+    functional trace cannot carry, so fit must stay eager for them."""
+    from ..nn.layers.norm import SpectralNorm, _BatchNormBase
+    return (_BatchNormBase, SpectralNorm)
+
+
+def unsupported_reason(model, accumulate_grad_batches=1):
+    """Why ``model`` cannot take the compiled fit path (None = it can).
+
+    Cheap structural checks only; data-dependent Python control flow in
+    ``forward`` is caught at first trace and falls back at runtime.
+    """
+    network, opt, loss = model.network, model._optimizer, model._loss
+    if opt is None or loss is None:
+        return "prepare() with an optimizer and a loss is required"
+    if model._metrics:
+        return ("metrics need per-step host outputs; the compiled path "
+                "keeps losses on device")
+    if accumulate_grad_batches != 1:
+        return ("accumulate_grad_batches relies on the eager tape's "
+                "update=False staging")
+    if not (hasattr(opt, "functional_update")
+            and hasattr(opt, "_parameter_list")):
+        return (f"{type(opt).__name__} exposes no functional update rule")
+    by_id = {id(p) for _, p in network.named_parameters()}
+    if any(id(p) not in by_id for p in opt._parameter_list):
+        return "optimizer holds parameters outside the fitted network"
+    mutating = _mutating_layer_types()
+    for layer in network.sublayers(include_self=True):
+        if isinstance(layer, mutating):
+            return (f"{type(layer).__name__} updates buffers in-place "
+                    "during training (running stats)")
+    return None
+
+
+class CompiledTrainer:
+    """Functional train state + donated jitted K-step program for one
+    ``Model.fit`` run.  Parameters are rebound into the live network
+    after every program call (the donated buffers are dead), so eval,
+    checkpointing and callbacks keep seeing current weights; optimizer
+    accumulators sync back at epoch boundaries via ``sync_optimizer``.
+    """
+
+    def __init__(self, model, seed=0):
+        network, opt, loss = model.network, model._optimizer, model._loss
+        self._opt = opt
+        self._network = network
+        plist = opt._parameter_list
+        by_id = {id(p): k for k, p in network.named_parameters()}
+        order = [by_id[id(p)] for p in plist]
+        self._plist, self._order = plist, order
+        self._param_tensors = dict(network.named_parameters())
+        params = {k: p._value for k, p in network.named_parameters()}
+        _, buffers = network.functional_state()
+        self.state = {
+            "params": params,
+            "opt": opt.functional_state(plist),
+            "step": jnp.asarray(opt._step_count, jnp.int32),
+        }
+        self.ever_ran = False
+
+        def forward_loss(p, xs, ys, step):
+            rng = jax.random.fold_in(jax.random.PRNGKey(seed), step)
+            with core_random.rng_scope(rng):
+                outs = functional_call(network, p,
+                                       tuple(Tensor(x) for x in xs),
+                                       buffers=buffers, training=True)
+            outs = [Tensor(o) if not isinstance(o, Tensor) else o
+                    for o in _to_list(outs)]
+            losses = _to_list(loss(*(outs + [Tensor(y) for y in ys])))
+            total = losses[0]
+            for l in losses[1:]:
+                total = total + l
+            total = total._value if isinstance(total, Tensor) else total
+            return total.astype(jnp.float32)
+
+        def grads_of(p, xs, ys, step):
+            return jax.value_and_grad(
+                lambda pp: forward_loss(pp, xs, ys, step))(p)
+
+        train_step = make_functional_train_step(opt, plist, order, grads_of,
+                                                scan_batch=True)
+        # donate the ENTIRE train state: params + accumulators + step all
+        # update in place on device; the live network's Tensors rebind to
+        # the fresh arrays after each call
+        self._jit = jax.jit(train_step, donate_argnums=(0, 1, 2))
+
+    def run(self, xs, ys):
+        """One compiled superstep over stacked batches (leaves (K, B, …));
+        returns the (K,) per-step loss vector as a DEVICE array."""
+        lr = jnp.asarray(self._opt.get_lr(), jnp.float32)
+        p, s, t, losses = self._jit(self.state["params"], self.state["opt"],
+                                    self.state["step"], lr, (xs, ys))
+        self.state.update(params=p, opt=s, step=t)
+        for k, v in p.items():
+            self._param_tensors[k]._set_value(v)
+        self.ever_ran = True
+        return losses
+
+    def sync_optimizer(self):
+        """Write accumulators + step count back into the live optimizer
+        (one small host sync for the step scalar — epoch-boundary cost)."""
+        self._opt.load_functional_state(
+            self._plist, self.state["opt"],
+            step_count=int(jax.block_until_ready(self.state["step"])))
+
+    def restore_eager(self):
+        """Abandon the functional state (trace failure fallback): the live
+        network already holds the last good params; accumulators return
+        to the optimizer so the eager path continues seamlessly."""
+        self.sync_optimizer()

@@ -108,7 +108,21 @@ class Model:
     def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
             drop_last=False, shuffle=True, num_workers=0, callbacks=None,
-            accumulate_grad_batches=1, num_iters=None):
+            accumulate_grad_batches=1, num_iters=None, jit_compile=None,
+            steps_per_execution=1, prefetch_buffer=2):
+        """Train loop.  ``jit_compile=None`` (default) tries the compiled
+        fast path — one donated jitted program per step (see
+        ``hapi/compiled.py``) — and falls back to the eager
+        ``train_batch`` loop when the network/optimizer isn't
+        pure-functional-capable (metrics, grad accumulation, in-place
+        buffer updates, Python-side control flow); ``True`` requires it,
+        ``False`` forces eager.  ``steps_per_execution=K`` unrolls K
+        steps into one ``lax.scan`` program (losses surface per step;
+        within a window the learning rate is read once, and a callback
+        setting ``stop_training`` mid-window stops AFTER the window's
+        remaining updates already ran — stop granularity is K steps).
+        ``prefetch_buffer`` batches are staged onto the device ahead of
+        compute (``io.device_prefetch``)."""
         train_loader = self._to_loader(train_data, batch_size, shuffle,
                                        drop_last, num_workers)
         eval_loader = (self._to_loader(eval_data, batch_size, False, False,
@@ -125,6 +139,21 @@ class Model:
             steps = None
         cbk.set_params({"epochs": epochs, "steps": steps, "verbose": verbose})
 
+        trainer = None
+        if jit_compile is not False:
+            from .compiled import CompiledTrainer, unsupported_reason
+            reason = unsupported_reason(self, accumulate_grad_batches)
+            if reason is None:
+                trainer = CompiledTrainer(self)
+            elif jit_compile:
+                raise ValueError(
+                    f"jit_compile=True, but the compiled fit path is "
+                    f"unavailable: {reason}")
+            else:
+                self._log_fallback_once(
+                    f"Model.fit: using the eager path ({reason})")
+        self._fit_used_compiled = trainer is not None
+
         self.stop_training = False
         cbk.on_train_begin()
         for epoch in range(epochs):
@@ -132,17 +161,23 @@ class Model:
             for m in self._metrics:
                 m.reset()
             logs = {}
-            for step, batch in enumerate(train_loader):
-                if num_iters is not None and step >= num_iters:
-                    break
-                cbk.on_train_batch_begin(step)
-                ins, lbs = self._split_batch(batch)
-                update = ((step + 1) % accumulate_grad_batches == 0)
-                res = self.train_batch(ins, lbs, update=update)
-                logs = self._pack_logs(res)
-                cbk.on_train_batch_end(step, logs)
-                if self.stop_training:
-                    break
+            if trainer is not None:
+                logs, trainer = self._run_compiled_epoch(
+                    trainer, train_loader, cbk, log_freq, num_iters,
+                    steps_per_execution, prefetch_buffer)
+                self._fit_used_compiled = trainer is not None
+            else:
+                for step, batch in enumerate(train_loader):
+                    if num_iters is not None and step >= num_iters:
+                        break
+                    cbk.on_train_batch_begin(step)
+                    ins, lbs = self._split_batch(batch)
+                    update = ((step + 1) % accumulate_grad_batches == 0)
+                    res = self.train_batch(ins, lbs, update=update)
+                    logs = self._pack_logs(res)
+                    cbk.on_train_batch_end(step, logs)
+                    if self.stop_training:
+                        break
             if eval_loader is not None and (epoch + 1) % eval_freq == 0:
                 eval_logs = self.evaluate(eval_loader, verbose=0,
                                           _callbacks=cbk)
@@ -152,6 +187,118 @@ class Model:
                 break
         cbk.on_train_end(logs)
         return logs
+
+    def _log_fallback_once(self, msg):
+        if not getattr(self, "_fallback_warned", False):
+            self._fallback_warned = True
+            import warnings
+            warnings.warn(msg, RuntimeWarning, stacklevel=3)
+
+    def _run_compiled_epoch(self, trainer, loader, cbk, log_freq, num_iters,
+                            k, prefetch_buffer):
+        """One epoch through the compiled trainer.  Returns
+        ``(logs, trainer_or_None)`` — None when the first program trace
+        failed (Python-side control flow in forward, unjittable op) and
+        the epoch finished on the eager path instead."""
+        import itertools
+
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from ..io.dataloader import device_prefetch
+
+        k = max(int(k), 1)
+        it = iter(loader)
+        pulled = 0
+
+        def _leaf(v):
+            return v._value if isinstance(v, Tensor) else np.asarray(v)
+
+        def _stack(vals):
+            if all(isinstance(v, np.ndarray) for v in vals):
+                return np.stack(vals)
+            return jnp.stack(vals)
+
+        def host_groups():
+            nonlocal pulled
+            while not self.stop_training:
+                group = []
+                while len(group) < k and (num_iters is None
+                                          or pulled < num_iters):
+                    try:
+                        batch = next(it)
+                    except StopIteration:
+                        break
+                    pulled += 1
+                    ins, lbs = self._split_batch(batch)
+                    group.append((tuple(_leaf(v) for v in ins),
+                                  tuple(_leaf(v) for v in lbs)))
+                if not group:
+                    return
+                xs = tuple(_stack([g[0][i] for g in group])
+                           for i in range(len(group[0][0])))
+                ys = tuple(_stack([g[1][i] for g in group])
+                           for i in range(len(group[0][1])))
+                yield (xs, ys)
+
+        step = 0
+        logs = {}
+        last = None
+        groups = device_prefetch(host_groups(), size=prefetch_buffer)
+        for xs, ys in groups:
+            try:
+                losses = trainer.run(xs, ys)
+            except Exception as e:  # noqa: BLE001 — unjittable network
+                # only TRACE-time failures fall back: an execution-time
+                # failure (XlaRuntimeError, e.g. device OOM) happens after
+                # the state buffers were donated, so neither the eager
+                # replay nor restore_eager could run — surface it
+                if trainer.ever_ran or "XlaRuntimeError" in type(e).__name__:
+                    raise
+                self._log_fallback_once(
+                    "Model.fit: compiled trainer failed to trace "
+                    f"({type(e).__name__}: {e}); falling back to eager")
+                trainer.restore_eager()
+                for exs, eys in itertools.chain([(xs, ys)], groups):
+                    n = int(jax.tree.leaves(exs)[0].shape[0])
+                    for j in range(n):
+                        cbk.on_train_batch_begin(step)
+                        res = self.train_batch([Tensor(x[j]) for x in exs],
+                                               [Tensor(y[j]) for y in eys])
+                        logs = self._pack_logs(res)
+                        cbk.on_train_batch_end(step, logs)
+                        step += 1
+                        if self.stop_training:
+                            break
+                    if self.stop_training:
+                        break
+                return logs, None
+            n = int(losses.shape[0])
+            for j in range(n):
+                cbk.on_train_batch_begin(step)
+                # async loss fetch: the scalar leaves the device only at
+                # log_freq boundaries — other steps hand callbacks the
+                # device scalar (float()-able on demand)
+                v = losses[j]
+                if log_freq and step % log_freq == 0:
+                    v = float(v)
+                logs = {"loss": v}
+                cbk.on_train_batch_end(step, logs)
+                step += 1
+                last = (losses, j)
+                if self.stop_training:
+                    break
+            if self.stop_training:
+                break
+        if last is not None:
+            # epoch-end sync; report the loss of the last step callbacks
+            # actually saw (a mid-window stop must not report past it)
+            losses, j = last
+            jax.block_until_ready(losses)
+            logs = {"loss": float(losses[j])}
+        trainer.sync_optimizer()
+        return logs, trainer
 
     def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
                  num_workers=0, callbacks=None, num_iters=None,

@@ -170,7 +170,9 @@ class Predictor:
                 return exported.call(feeds, params)
         else:
             def run_fn(args, params, bufs):
-                key = jax.random.key(0)
+                # raw key form — must match the aval jit.save exported
+                # (typed keys don't serialize on jax<0.6)
+                key = jax.random.PRNGKey(0)
                 outs, _ = exported.call(params, bufs, key, *args)
                 return outs
         # Two executables: the zero-copy path must NOT donate feeds (handles

@@ -37,19 +37,50 @@ from typing import List, Optional
 import numpy as np
 
 
+def _storage_dtype(dtype):
+    """npz-safe storage dtype for a param dtype: ml_dtypes extension
+    types (bfloat16, float8_*) round-trip through ``np.savez`` as raw
+    void blobs ('|V2') that numpy cannot interpret back — store them as
+    same-width unsigned ints and record the logical dtype name in
+    config.json instead."""
+    if dtype.kind == "V" or dtype.name not in np.sctypeDict:
+        return np.dtype(f"u{dtype.itemsize}")
+    return None
+
+
+def _named_dtype(name):
+    """np.dtype for a recorded dtype name, resolving ml_dtypes extension
+    names (e.g. 'bfloat16') that ``np.dtype(str)`` does not know."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+        return np.dtype(getattr(ml_dtypes, name))
+
+
 def save_for_serving(model, path):
     """Persist ``{config.json, params.npz}`` so a serving process — in
     particular the C++ shim (``native/serving.cc pht_engine_create``) —
     can rebuild the model without the training script (the role of the
-    reference's ``save_inference_model`` artifact for ``DistModel``)."""
+    reference's ``save_inference_model`` artifact for ``DistModel``).
+
+    Works for any param dtype: bf16 (the expected serving dtype — the
+    bench casts GPT-2 to bf16) and other ml_dtypes store as uint views
+    with the logical dtype recorded per param in ``config.json``."""
     import dataclasses
     import json
     import os
     os.makedirs(path, exist_ok=True)
+    arrs, dtypes = {}, {}
+    for k, v in model.named_parameters():
+        a = np.asarray(v._value)
+        dtypes[k] = a.dtype.name
+        store = _storage_dtype(a.dtype)
+        arrs[k] = a.view(store) if store is not None else a
     with open(os.path.join(path, "config.json"), "w") as f:
         json.dump({"model": type(model).__name__,
-                   "config": dataclasses.asdict(model.config)}, f)
-    arrs = {k: np.asarray(v._value) for k, v in model.named_parameters()}
+                   "config": dataclasses.asdict(model.config),
+                   "param_dtypes": dtypes}, f)
     np.savez(os.path.join(path, "params.npz"), **arrs)
 
 
@@ -66,7 +97,23 @@ def load_for_serving(path):
     model = cls(_gpt.GPTConfig(**meta["config"]))
     model.eval()
     z = np.load(os.path.join(path, "params.npz"))
-    model.set_state_dict({k: Tensor(np.asarray(z[k])) for k in z.files})
+    dtypes = meta.get("param_dtypes", {})
+    state = {}
+    for k in z.files:
+        a = np.asarray(z[k])
+        want = dtypes.get(k)
+        if want is not None and a.dtype.name != want:
+            a = a.view(_named_dtype(want))
+        state[k] = Tensor(a)
+    model.set_state_dict(state)
+    # set_state_dict casts into the fresh model's (f32) param dtypes;
+    # serving wants the SAVED dtypes back (bf16 halves HBM and is the
+    # dtype the engine was benched/validated in)
+    import jax.numpy as jnp
+    for k, p in model.named_parameters():
+        want = dtypes.get(k)
+        if want is not None and p._value.dtype.name != want:
+            p._set_value(p._value.astype(_named_dtype(want)))
     return model
 
 
@@ -161,6 +208,7 @@ class ServingEngine:
         self._lengths = np.zeros(self.max_slots, np.int32)
         self._inflight = {}  # wave -> (consumed, finishing, reqs) at entry
         self._running = False
+        self._loop_thread = None
         self._tickno = 0
         self.stats = {"ticks": 0, "tokens": 0, "requests": 0}
         self._key = jax.random.key(0)
@@ -418,7 +466,8 @@ class ServingEngine:
         kc, vc = self._caches
         # partial-manual shard_map (pp manual, dp/mp auto) needs the
         # ambient mesh — same contract as _run_decode_program
-        with jax.set_mesh(self._mesh):
+        from ..core.jaxcompat import set_mesh as _set_mesh
+        with _set_mesh(self._mesh):
             kc, vc, self._xbuf, nxt = self._pp_tick(
                 self._pp_stacked, kc, vc, self._xbuf, jnp.asarray(tokens),
                 jnp.asarray(starts), jnp.asarray(nvalid),
@@ -448,7 +497,9 @@ class ServingEngine:
             self.stats["requests"] += 1
             if self.auto_run and not self._running:
                 self._running = True
-                threading.Thread(target=self._loop, daemon=True).start()
+                t = threading.Thread(target=self._loop, daemon=True)
+                self._loop_thread = t
+                t.start()
         return req
 
     def generate(self, prompt, max_new_tokens=32, timeout=None):
@@ -528,8 +579,20 @@ class ServingEngine:
     def step(self) -> bool:
         """One engine tick: stage under the lock, run the device program
         unlocked (submit()/generate() stay responsive), commit under the
-        lock. Returns False when there was nothing to do."""
+        lock. Returns False when there was nothing to do.
+
+        Single-driver contract: while the auto_run loop is live, only the
+        loop thread may tick — a second driver would re-enter the jitted
+        tick with the DONATED cache buffers the in-flight call already
+        invalidated (crash/corruption), so it raises instead."""
         with self._lock:
+            if self._running and \
+                    threading.current_thread() is not self._loop_thread:
+                raise RuntimeError(
+                    "engine is being driven by its auto_run loop; "
+                    "step()/run_until_idle() from another thread would "
+                    "re-enter the tick with donated caches — wait for the "
+                    "loop to drain (shutdown()) instead")
             self._admit()
             if self._pp > 1:
                 if (not any(s.req is not None for s in self._slots)
@@ -664,7 +727,9 @@ class ServingEngine:
                         return
 
     def run_until_idle(self, max_ticks=100000):
-        """Drive the engine synchronously (single-threaded use/tests)."""
+        """Drive the engine synchronously (single-threaded use/tests).
+        Raises if the auto_run loop is concurrently driving (see
+        :meth:`step`'s single-driver contract)."""
         for _ in range(max_ticks):
             if not self.step():
                 return

@@ -14,4 +14,5 @@ from .dataset import (ChainDataset, ComposeDataset, ConcatDataset, Dataset,  # n
 from .sampler import (BatchSampler, DistributedBatchSampler, RandomSampler,  # noqa: F401
                       Sampler, SequenceSampler, SubsetRandomSampler,
                       WeightedRandomSampler)
-from .dataloader import DataLoader, default_collate_fn, get_worker_info  # noqa: F401
+from .dataloader import (DataLoader, default_collate_fn, device_prefetch,  # noqa: F401
+                         get_worker_info)

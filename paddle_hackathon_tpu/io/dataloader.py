@@ -127,6 +127,47 @@ class DataLoader:
             yield self.collate_fn(batch)
 
 
+def device_prefetch(iterator, size=2, device=None):
+    """Device-prefetch iterator (ref ``buffered_reader.cc``'s H2D staging
+    stage): pull up to ``size`` batches ahead of the consumer and start
+    their host→device transfers immediately.  ``jax.device_put`` is
+    asynchronous, so the copies overlap device compute — the consumer
+    (e.g. ``Model.fit``'s compiled trainer) finds its next batch already
+    resident instead of paying H2D on the critical path.
+
+    numpy leaves are ``device_put``; jax arrays and Tensors pass through
+    (already resident or in flight).  Works on any iterator of (nested)
+    batches — tuples/lists/dicts of arrays.
+    """
+    import collections
+
+    import jax
+
+    def _put_leaf(a):
+        if isinstance(a, Tensor):
+            return a
+        if isinstance(a, np.ndarray) and a.dtype.kind not in "OUSV":
+            return jax.device_put(a, device)
+        return a
+
+    def _put(batch):
+        return jax.tree.map(_put_leaf, batch,
+                            is_leaf=lambda t: isinstance(t, Tensor))
+
+    it = iter(iterator)
+    buf = collections.deque()
+    size = max(int(size), 1)
+    while True:
+        while len(buf) < size:
+            try:
+                buf.append(_put(next(it)))
+            except StopIteration:
+                while buf:
+                    yield buf.popleft()
+                return
+        yield buf.popleft()
+
+
 class _PrefetchIter:
     """Thread-pool prefetching iterator (ref
     ``_DataLoaderIterMultiProcess`` ``dataloader_iter.py:342``: outstanding
@@ -273,14 +314,56 @@ class _ProcPrefetchIter:
     (`_PrefetchIter`) serializes those on the GIL; processes run them in
     parallel (VERDICT r4 directive #5).
 
-    Workers are forked, so the dataset needn't pickle; numeric batch
-    leaves travel through POSIX shared memory (one memcpy in the worker,
-    one attach+copy in the parent), non-numeric leaves pickle."""
+    Start method: a FORKSERVER context is preferred when the worker
+    payload (dataset, collate, worker_init_fn) pickles — the server is
+    posix_spawn'ed single-threaded, so workers never fork() a
+    multi-threaded JAX parent (Python 3.12 deprecates that; forked
+    children can also deadlock on locks held by threads that don't
+    survive the fork).  When the payload doesn't pickle (closures,
+    open handles) the iterator falls back to plain fork(): the dataset
+    needn't pickle then, but child-side work MUST stay numpy-only —
+    no XLA/jax calls (the runtime threads don't survive the fork; see
+    ``_np_collate``).  Numeric batch leaves travel through POSIX shared
+    memory either way (one memcpy in the worker, one attach+copy in the
+    parent); non-numeric leaves pickle."""
+
+    @staticmethod
+    def _pick_context(loader, collate):
+        import multiprocessing
+        cached = getattr(loader, "_proc_mp_start_method", None)
+        if cached is not None:
+            return multiprocessing.get_context(cached)
+        method = "fork"
+        if "forkserver" in multiprocessing.get_all_start_methods():
+            # probe picklability through a null sink: no bytes are
+            # materialized, so a multi-GB in-memory dataset costs one
+            # serialization pass, not a 2x RAM spike
+            import io as _io
+            import pickle
+
+            class _Null(_io.RawIOBase):
+                def writable(self):
+                    return True
+
+                def write(self, b):
+                    return len(b)
+
+            try:
+                pickle.Pickler(_Null(),
+                               protocol=pickle.HIGHEST_PROTOCOL).dump(
+                    (loader.dataset, collate, loader.worker_init_fn))
+                method = "forkserver"
+            except Exception:  # unpicklable payload: fork keeps working
+                pass
+        loader._proc_mp_start_method = method  # probe once per loader
+        return multiprocessing.get_context(method)
 
     def __init__(self, loader: DataLoader):
-        import multiprocessing
         self.loader = loader
-        ctx = multiprocessing.get_context("fork")
+        collate = (loader.collate_fn
+                   if loader.collate_fn is not default_collate_fn
+                   else _np_collate)
+        ctx = self._pick_context(loader, collate)
         if loader.use_shared_memory:
             # spawn the resource tracker BEFORE forking: children must
             # inherit the parent's tracker, not spawn private ones whose
@@ -297,9 +380,6 @@ class _ProcPrefetchIter:
         self.next_emit = 0
         self.next_task = 0
         self._closed = False
-        collate = (loader.collate_fn
-                   if loader.collate_fn is not default_collate_fn
-                   else _np_collate)
         self.workers = [
             ctx.Process(target=_proc_worker,
                         args=(loader.dataset, collate,

@@ -75,7 +75,10 @@ def save(layer, path, input_spec=None, **configs):
             buffer_list = [buffers[k] for k in buffer_keys]
         else:
             param_list, buffer_list = [], []
-        key = jax.random.key(0)
+        # a RAW uint32 key, not jax.random.key(0): typed key avals
+        # (key<fry>) are not serializable by jax.export on jax<0.6, and
+        # every jax.random op accepts the raw form
+        key = jax.random.PRNGKey(0)
         exported = jax.export.export(jitted, platforms=("cpu", "tpu"))(
             param_list, buffer_list, key, *example_args)
     finally:
@@ -122,6 +125,10 @@ class TranslatedLayer(Layer):
         jax_args = [a._value if isinstance(a, Tensor) else jnp.asarray(a)
                     for a in args]
         key = core_random.split_key()
+        if jnp.issubdtype(key.dtype, jax.dtypes.prng_key):
+            # the artifact was exported against a RAW uint32 key (typed
+            # key avals don't serialize on jax<0.6)
+            key = jax.random.key_data(key)
         out_vals, _new_buffers = self._exported.call(
             self._param_arrays, self._buffer_arrays, key, *jax_args)
         return jax.tree.map(

@@ -547,7 +547,8 @@ class GPTForCausalLM(Layer):
                                     else None)
         run, greedy_key = gen_cache[cache_key]
         key = greedy_key if greedy else core_random.split_key()
-        ctx = (jax.set_mesh(mesh) if mesh is not None
+        from ..core.jaxcompat import set_mesh as _set_mesh
+        ctx = (_set_mesh(mesh) if mesh is not None
                else contextlib.nullcontext())
         with ctx:  # partial-manual shard_map (pp) needs the ambient mesh
             return Tensor(run(params, ids, caches, key))

@@ -32,6 +32,10 @@
 // and the embedded engine batches requests from many caller threads into
 // the same device ticks. The GIL is released while a request waits
 // (threading.Event.wait), so callers block without serializing.
+// timeout_s <= 0 means wait forever (Event.wait(None)). A timed-out
+// request is ABANDONED by the caller but not cancelled: it still runs to
+// completion in the engine, occupying its slot and burning ticks until
+// its token budget is spent — budget max_new accordingly.
 
 #include <Python.h>
 
@@ -341,9 +345,15 @@ PHT_API int64_t pht_engine_generate(void* h, const int32_t* prompt,
   for (int32_t i = 0; i < prompt_len; i++)
     PyList_SET_ITEM(lst, i, PyLong_FromLong(prompt[i]));
   // generate(prompt, max_new_tokens, timeout): Event.wait inside releases
-  // the GIL, so the engine's tick thread and other callers keep running
-  PyObject* res = PyObject_CallMethod(ne->engine, "generate", "(Oid)", lst,
-                                      (int)max_new, timeout_s);
+  // the GIL, so the engine's tick thread and other callers keep running.
+  // timeout_s <= 0 maps to timeout=None (wait forever) — a raw 0.0 would
+  // reach Event.wait(0) and time out immediately.
+  PyObject* res =
+      timeout_s <= 0.0
+          ? PyObject_CallMethod(ne->engine, "generate", "(OiO)", lst,
+                                (int)max_new, Py_None)
+          : PyObject_CallMethod(ne->engine, "generate", "(Oid)", lst,
+                                (int)max_new, timeout_s);
   if (res) {
     PyObject* as_list = PyObject_CallMethod(res, "tolist", nullptr);
     if (as_list) {

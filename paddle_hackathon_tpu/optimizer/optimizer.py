@@ -113,6 +113,54 @@ class Optimizer:
             self._accumulators[id(p)] = s
         self._step_count += 1
 
+    # -- functional (pure pytree) surface ----------------------------------
+    # The compiled trainers (parallel/auto_parallel.Engine and hapi's
+    # Model.fit fast path) inline the whole update into THEIR jitted train
+    # step — they hold the accumulators functionally and call this instead
+    # of step().  ``params`` carries the ordered Parameter objects the
+    # positional buffers correspond to, so per-parameter metadata (lr
+    # scale, weight-decay exclusions) resolves without the eager path's
+    # "has a grad" filtering (nothing has ``_grad_value`` under a trace).
+
+    def functional_state(self, params) -> List[Dict[str, jax.Array]]:
+        """Current accumulator dicts for ``params`` (created on demand),
+        in order — the optimizer half of a functional train state."""
+        return [self._get_accumulators(p) for p in params]
+
+    def load_functional_state(self, params, states, step_count=None):
+        """Write functionally-updated accumulators back into the live
+        optimizer (so ``state_dict``/checkpointing see them)."""
+        for p, s in zip(params, states):
+            self._accumulators[id(p)] = s
+        if step_count is not None:
+            self._step_count = int(step_count)
+
+    def functional_update(self, vals, grads, states, lr, step_t,
+                          param_lrs=None, params=None):
+        """Pure update rule over explicit buffers — safe under jit/grad.
+
+        ``(vals, grads, states)`` are positional lists of param values,
+        gradients and accumulator dicts; returns ``(new_vals,
+        new_states)``.  Pass ``params`` (the matching Parameter objects)
+        to let the rule derive per-parameter metadata; they are consumed
+        at trace time only and never cross the jit boundary.
+        """
+        if params is not None and param_lrs is None:
+            param_lrs = tuple(p.optimize_attr.get("learning_rate", 1.0)
+                              for p in params)
+        elif param_lrs is None:
+            param_lrs = (1.0,) * len(vals)
+        self._prepare_functional(params)
+        try:
+            return self._update_all(vals, grads, states, lr, step_t,
+                                    tuple(param_lrs))
+        finally:
+            self._prepare_functional(None)
+
+    def _prepare_functional(self, params):
+        """Hook: derive per-parameter trace-time metadata from an explicit
+        param list (``None`` restores the eager ``step()`` behavior)."""
+
     def _update_all(self, vals, grads, states, lr, step_t, param_lrs):
         grads = [g.astype(jnp.float32) if v.dtype == jnp.float32 else g
                  for g, v in zip(grads, vals)]

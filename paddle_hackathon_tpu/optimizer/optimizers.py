@@ -114,6 +114,16 @@ class AdamW(Adam):
                 for p in self._parameter_list}
         super().step()
 
+    def _prepare_functional(self, params):
+        # functional callers (compiled trainers) supply the param order
+        # explicitly — nothing carries ``_grad_value`` under a trace
+        self._functional_plist = params
+        if params is not None and self._apply_decay_param_fun is not None \
+                and self._decay_mask is None:
+            self._decay_mask = {
+                id(p): bool(self._apply_decay_param_fun(p.name))
+                for p in params}
+
     def _apply_one(self, v, g, s, lr, step_t):
         new_v, ns = super()._apply_one(v, g, s, lr, step_t)
         decay = self._wd_coeff
@@ -123,8 +133,9 @@ class AdamW(Adam):
     def _update_all(self, vals, grads, states, lr, step_t, param_lrs):
         if self._decay_mask is not None:
             # parameters excluded from decay (e.g. biases/LN) use plain Adam
-            params = [p for p in self._parameter_list
-                      if p.trainable and p._grad_value is not None]
+            params = getattr(self, "_functional_plist", None) or [
+                p for p in self._parameter_list
+                if p.trainable and p._grad_value is not None]
             new_vals, new_states = [], []
             if self._grad_clip is not None:
                 grads = self._grad_clip._clip(grads)
@@ -253,6 +264,10 @@ class _PerParamDecayMixin:
             not self._decay_excluded(p) for p in self._parameter_list
             if p.trainable and p._grad_value is not None)
         super().step()
+
+    def _prepare_functional(self, params):
+        self._wd_on = (() if params is None else
+                       tuple(not self._decay_excluded(p) for p in params))
 
     def _update_all(self, vals, grads, states, lr, step_t, param_lrs):
         flags = getattr(self, "_wd_on", ())

@@ -16,7 +16,7 @@ import contextlib
 import threading
 
 import jax
-from jax import shard_map
+from ..core.jaxcompat import set_mesh, shard_map
 
 # Axes already bound manual by an enclosing shard_map region (Shardy
 # forbids re-binding them in a nested shard_map). Collective programs
@@ -54,9 +54,13 @@ def run_shard_map(fn, mesh, in_specs, out_specs, manual_axes, args):
     manual = frozenset(manual_axes)
     from jax._src import core as _core
     if _core.trace_state_clean():
-        sm = shard_map(fn, in_specs=in_specs, out_specs=out_specs,
-                       axis_names=manual, check_vma=False)
-        with jax.set_mesh(mesh):
+        # mesh passed EXPLICITLY: the old-jax compat path must not fall
+        # back to the repo-global parallel.api.get_mesh(), which may be
+        # None or a different mesh than the caller's
+        sm = shard_map(fn, mesh=mesh, in_specs=in_specs,
+                       out_specs=out_specs, axis_names=manual,
+                       check_vma=False)
+        with set_mesh(mesh):
             return jax.jit(sm)(*args)
     if manual == frozenset(mesh.axis_names):
         sm = shard_map(fn, mesh=mesh, in_specs=in_specs,

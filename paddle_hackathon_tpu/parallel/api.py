@@ -20,6 +20,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..core.jaxcompat import set_mesh as _set_mesh
 from ..core.tensor import Tensor
 from ..nn.layer import Layer
 
@@ -301,6 +302,77 @@ def _make_pipeline_loss(mesh: Mesh, pp_spec: dict, pp_degree: int,
         return loss
 
     return loss_fn
+
+
+def make_functional_train_step(optimizer, plist, order, grads_of,
+                               merge_k: int = 1, scan_batch: bool = False):
+    """Compose a loss-gradient function with the optimizer's pure
+    ``Optimizer.functional_update`` into
+
+        train_step(params, opt_states, step, lr, batch)
+            -> (new_params, new_opt_states, new_step, loss)
+
+    — THE single owner of the forward+backward+update step body, shared
+    by ``auto_parallel.Engine`` (per-batch SPMD program, gradient merge)
+    and ``hapi.Model``'s compiled fit path (K-step ``lax.scan`` unroll).
+
+    - ``grads_of(params, xs, ys, step) -> (loss, grads)``, grads keyed
+      like ``params``; ``order`` maps ``plist`` (the optimizer's ordered
+      Parameter objects) to param-dict keys.
+    - ``merge_k > 1``: split the batch into k micro-batches, average
+      grads, single update (the reference's gradient_merge pass).
+    - ``scan_batch``: every batch leaf carries a leading stacked-step dim
+      ``(K, B, ...)``; one ``lax.scan`` runs K full optimizer steps
+      inside the same XLA program and ``loss`` returns as a (K,) vector
+      — Python touches the device once per K steps.
+    """
+
+    def one_step(params, opt_states, step, lr, xs, ys):
+        if merge_k > 1:
+            def split(a):
+                return a.reshape((merge_k, a.shape[0] // merge_k)
+                                 + a.shape[1:])
+
+            def body(carry, mb):
+                mx, my = mb
+                l, g = grads_of(params, mx, my, step)
+                acc_l, acc_g = carry
+                return (acc_l + l, jax.tree.map(jnp.add, acc_g, g)), None
+
+            zero_g = jax.tree.map(
+                lambda a: jnp.zeros(a.shape, jnp.float32), params)
+            (loss_sum, grad_sum), _ = jax.lax.scan(
+                body, (jnp.zeros((), jnp.float32), zero_g),
+                (jax.tree.map(split, xs), jax.tree.map(split, ys)))
+            loss = loss_sum / merge_k
+            grads = jax.tree.map(lambda g: g / merge_k, grad_sum)
+        else:
+            loss, grads = grads_of(params, xs, ys, step)
+        vals = [params[k] for k in order]
+        gs = [grads[k] for k in order]
+        new_vals, new_states = optimizer.functional_update(
+            vals, gs, opt_states, lr, step.astype(jnp.int32) + 1,
+            params=plist)
+        new_params = dict(params)
+        for k, v in zip(order, new_vals):
+            new_params[k] = v
+        return new_params, new_states, step + 1, loss
+
+    def train_step(params, opt_states, step, lr, batch):
+        xs, ys = batch
+        if not scan_batch:
+            return one_step(params, opt_states, step, lr, xs, ys)
+
+        def body(carry, xy):
+            p, s, t = carry
+            p, s, t, loss = one_step(p, s, t, lr, xy[0], xy[1])
+            return (p, s, t), loss
+
+        (params, opt_states, step), losses = jax.lax.scan(
+            body, (params, opt_states, step), (xs, ys))
+        return params, opt_states, step, losses
+
+    return train_step
 
 
 def make_sharded_train_step(model: Layer, mesh: Mesh,
@@ -592,7 +664,7 @@ def make_sharded_train_step(model: Layer, mesh: Mesh,
             else _get_jitted((ids, labels))
         # partial-manual shard_map (the pp pipeline) requires the ambient
         # mesh at trace time (_smap.run_shard_map); harmless otherwise
-        with jax.set_mesh(mesh):
+        with _set_mesh(mesh):
             new_params, new_opt, new_step, loss = fn(
                 state["params"], state["opt_state"], state["step"],
                 (ids, labels), rng, lr_now)

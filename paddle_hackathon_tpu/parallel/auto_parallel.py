@@ -288,7 +288,7 @@ class Engine:
         opt_states = None
         if opt is not None:
             plist = opt._parameter_list
-            opt_states = [opt._get_accumulators(p) for p in plist]
+            opt_states = opt.functional_state(plist)
             if self.strategy.sharding and self.strategy.sharding_stage >= 1:
                 from .sharding import _shard_spec_for
                 # ZeRO shards optimizer state across data-parallel replicas:
@@ -349,39 +349,12 @@ class Engine:
             return jax.value_and_grad(
                 lambda p: forward_loss(p, x, y, step))(params)
 
-        def train_step(params, opt_states, step, lr, batch):
-            x, y = batch
-            if merge_k > 1:
-                # gradient_merge (ref gradient_merge_optimizer.py): split the
-                # batch into k micro-batches, average grads, single update
-                xs = x.reshape((merge_k, x.shape[0] // merge_k) + x.shape[1:])
-                ys = y.reshape((merge_k, y.shape[0] // merge_k) + y.shape[1:])
-
-                def body(carry, mb):
-                    mx, my = mb
-                    l, g = grads_of(params, mx, my, step)
-                    acc_l, acc_g = carry
-                    return (acc_l + l,
-                            jax.tree.map(jnp.add, acc_g, g)), None
-
-                zero_g = jax.tree.map(
-                    lambda a: jnp.zeros(a.shape, jnp.float32), params)
-                (loss_sum, grad_sum), _ = jax.lax.scan(
-                    body, (jnp.zeros((), jnp.float32), zero_g), (xs, ys))
-                loss = loss_sum / merge_k
-                grads = jax.tree.map(lambda g: g / merge_k, grad_sum)
-            else:
-                loss, grads = grads_of(params, x, y, step)
-            vals = [params[k] for k in order]
-            gs = [grads[k] for k in order]
-            lrs = tuple(p.optimize_attr.get("learning_rate", 1.0)
-                        for p in plist)
-            new_vals, new_states = opt._update_all(
-                vals, gs, opt_states, lr, step.astype(jnp.int32) + 1, lrs)
-            new_params = dict(params)
-            for k, v in zip(order, new_vals):
-                new_params[k] = v
-            return new_params, new_states, step + 1, loss
+        # gradient_merge (ref gradient_merge_optimizer.py) is composed by
+        # the shared builder: split into k micro-batches, average grads,
+        # single functional optimizer update
+        from .api import make_functional_train_step
+        train_step = make_functional_train_step(opt, plist, order, grads_of,
+                                                merge_k=merge_k)
 
         state = self._state
         param_sh = jax.tree.map(lambda a: a.sharding, state["params"])
@@ -581,9 +554,9 @@ class Engine:
         for k, v in st["params"].items():
             lookup[k]._set_value(v)
         if self.optimizer is not None and st["opt_states"] is not None:
-            for p, s in zip(self.optimizer._parameter_list, st["opt_states"]):
-                self.optimizer._accumulators[id(p)] = s
-            self.optimizer._step_count = int(st["step"])
+            self.optimizer.load_functional_state(
+                self.optimizer._parameter_list, st["opt_states"],
+                step_count=int(st["step"]))
 
     def save(self, path: str):
         from ..framework import io as _io

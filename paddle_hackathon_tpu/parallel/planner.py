@@ -34,6 +34,8 @@ from typing import Dict, List, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from ..core.jaxcompat import set_mesh as _set_mesh
+
 __all__ = ["plan_sharding", "score_plan", "collective_bytes_from_hlo",
            "plan_mesh", "enumerate_meshes", "MeshPlan"]
 
@@ -439,7 +441,7 @@ def score_plan(model, mesh, rule, sample_args, zero_stage=0, labels=None,
                 "token ids; for this model pass labels= and loss_fn= "
                 "(same signature as make_sharded_train_step)")
         labels = jnp.zeros_like(ids)
-    with jax.set_mesh(mesh):
+    with _set_mesh(mesh):
         compiled = step._jitted.lower(
             state["params"], state["opt_state"], state["step"],
             (ids, labels), jax.random.key(0), jnp.float32(1e-3)).compile()

@@ -21,8 +21,26 @@ import jax  # noqa: E402
 # CPU mesh, not through the real-chip tunnel.
 jax.config.update("jax_platforms", "cpu")
 
+# DO NOT enable jax's persistent compilation cache here. On this box's
+# jax/jaxlib (0.4.37, CPU) cache-hit executables for the multi-device
+# donated train steps are UNSAFE: observed heap corruption ("corrupted
+# double-linked list", SIGSEGV/SIGABRT mid-suite) and silently WRONG
+# numerics on reload (test_train_resume trajectories diverge). A crash
+# kills the whole pytest process and every test after it.
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
+
+
+# Shared gate for the pp/sp test files (`from conftest import
+# requires_partial_manual`): partial-manual shard_map (pp/sp manual +
+# dp/mp/sharding auto) is unsupported on this container's jax<0.6 —
+# collectives hit an XLA C++ CHECK that would abort the whole pytest
+# process (core/jaxcompat.py raises NotImplementedError up front).
+# Keyed on the jax>=0.6 capability marker.
+requires_partial_manual = pytest.mark.skipif(
+    not hasattr(jax, "set_mesh"),
+    reason="partial-manual shard_map requires jax>=0.6")
 
 
 @pytest.fixture(autouse=True)
@@ -32,3 +50,41 @@ def _seeded():
     np.random.seed(0)
     paddle.seed(0)
     yield
+
+
+# Approximate per-FILE wall cost (seconds, measured once on this box with
+# cold jit — compile-dominated, so stable across runs). The tier-1 budget
+# (870s, ROADMAP.md) is shorter than the full suite without a persistent
+# compile cache (which is unsafe here — see the note above), so the
+# runner is killed mid-suite: ordering cheap files first maximizes how
+# many tests actually execute before the timeout. Intra-file order is
+# preserved (stable sort); unknown files default to mid-pack.
+_FILE_COST = {
+    "test_perf_gate.py": 2, "test_tensor.py": 3, "test_inference.py": 3,
+    "test_aux.py": 3, "test_profiler.py": 3, "test_cpp_extension.py": 4,
+    "test_bench_robust.py": 4, "test_static.py": 5, "test_nn_quant.py": 5,
+    "test_fleet_strategy.py": 5, "test_distribution_transform.py": 5,
+    "test_auto_parallel.py": 6, "test_autograd.py": 6,
+    "test_op_harness.py": 7, "test_ps_cache.py": 7, "test_dy2static.py": 7,
+    "test_train_from_dataset.py": 8, "test_io_amp.py": 8,
+    "test_scaling_model.py": 8, "test_jit.py": 9, "test_sparse.py": 9,
+    "test_rnn_seqlen.py": 9, "test_mnist_e2e.py": 10,
+    "test_api_roundout.py": 10, "test_ops.py": 11, "test_ps.py": 12,
+    "test_static_nn.py": 12, "test_dataset_reader.py": 12,
+    "test_strategies.py": 13, "test_fused_cache.py": 13,
+    "test_hapi_compiled_fit.py": 15, "test_moment_dtype.py": 16,
+    "test_optimizer.py": 17, "test_sharded_lamb.py": 18,
+    "test_native_serving.py": 20, "test_native.py": 20, "test_nn.py": 22,
+    "test_launch_elastic.py": 26, "test_pipeline_layer.py": 26,
+    "test_cross_process.py": 1,   # fully skip-gated on this jax
+    "test_planner.py": 32, "test_text_bert.py": 32,
+    "test_dataloader_procs.py": 45, "test_incubate.py": 45,
+    "test_serving.py": 60, "test_parallel_stack.py": 70,
+    "test_train_resume.py": 70, "test_models_ppyoloe.py": 83,
+    "test_surface2.py": 113, "test_vision_hapi.py": 118,
+    "test_parallel_trainstep.py": 125,
+}
+
+
+def pytest_collection_modifyitems(session, config, items):
+    items.sort(key=lambda it: _FILE_COST.get(it.fspath.basename, 40))

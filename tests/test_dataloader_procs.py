@@ -123,6 +123,49 @@ def test_proc_workers_worker_init_fn_and_info():
     assert set(rows[:, 1].tolist()) <= {1000, 1001}  # init_fn ran per worker
 
 
+def test_proc_workers_forkserver_no_fork_warnings():
+    """A picklable payload takes the FORKSERVER path (the server is
+    spawned, not forked) — no fork-of-a-threaded-process warnings: the
+    Python 3.12 DeprecationWarning and jax's os.fork RuntimeWarning both
+    fire only on fork().  Fork stays available for unpicklable payloads
+    (numpy-only-child constraint documented on _ProcPrefetchIter)."""
+    import warnings
+
+    from paddle_hackathon_tpu.io.dataloader import (_np_collate,
+                                                    _ProcPrefetchIter)
+
+    loader = io.DataLoader(_SquareDataset(12), batch_size=4, num_workers=2,
+                           use_process_workers=True)
+    ctx = _ProcPrefetchIter._pick_context(loader, _np_collate)
+    assert ctx.get_start_method() == "forkserver"
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        assert len(_run_epoch(loader)) == 3
+    bad = [w for w in rec
+           if issubclass(w.category, (DeprecationWarning, RuntimeWarning))
+           and "fork" in str(w.message)]
+    assert not bad, [str(w.message) for w in bad]
+
+
+def test_proc_workers_unpicklable_payload_falls_back_to_fork():
+    class Local(io.Dataset):  # locally-defined: not picklable
+        def __len__(self):
+            return 6
+
+        def __getitem__(self, i):
+            return np.full((2,), i, np.float32)
+
+    loader = io.DataLoader(Local(), batch_size=2, num_workers=2,
+                           use_process_workers=True)
+    from paddle_hackathon_tpu.io.dataloader import (_np_collate,
+                                                    _ProcPrefetchIter)
+    ctx = _ProcPrefetchIter._pick_context(loader, _np_collate)
+    assert ctx.get_start_method() == "fork"
+    vals = sorted(int(v) for b in loader
+                  for v in np.asarray(b.numpy())[:, 0].tolist())
+    assert vals == [0, 1, 2, 3, 4, 5]
+
+
 def test_proc_workers_timeout():
     class Slow(io.Dataset):
         def __len__(self):

@@ -1,0 +1,239 @@
+"""Compiled Model.fit fast path (hapi/compiled.py).
+
+The high-level trainer compiles forward+backward+update into ONE donated
+jitted program (optionally K steps per program via lax.scan) and must be
+numerically interchangeable with the eager train_batch loop — same
+optimizer rule (Optimizer.functional_update), same data order, same seed
+— while falling back to eager transparently whenever the network or
+configuration is not pure-functional-capable.
+"""
+
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_hackathon_tpu as paddle
+from paddle_hackathon_tpu import hapi, io, metric, nn, optimizer as optim
+from paddle_hackathon_tpu.core.tensor import Tensor
+
+
+class _ToyDS(io.Dataset):
+    def __init__(self, n=64, d=10, seed=0):
+        rng = np.random.RandomState(seed)
+        self.x = rng.randn(n, d).astype(np.float32)
+        self.y = (self.x.sum(1) > 0).astype(np.int64)
+
+    def __len__(self):
+        return len(self.x)
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]
+
+
+def _mlp_model(seed=7, lr=1e-2, opt_cls=optim.Adam, metrics=None):
+    paddle.seed(seed)
+    net = nn.Sequential(nn.Linear(10, 32), nn.ReLU(), nn.Linear(32, 2))
+    m = hapi.Model(net)
+    m.prepare(optimizer=opt_cls(learning_rate=lr,
+                                parameters=net.parameters()),
+              loss=nn.CrossEntropyLoss(), metrics=metrics)
+    return m
+
+
+def _weights(m):
+    return {k: np.asarray(v.numpy())
+            for k, v in m.network.state_dict().items()}
+
+
+def test_compiled_matches_eager_final_params_and_loss():
+    ds = _ToyDS()
+    m_e = _mlp_model()
+    logs_e = m_e.fit(ds, epochs=2, batch_size=8, verbose=0, shuffle=False,
+                     jit_compile=False)
+    m_c = _mlp_model()
+    logs_c = m_c.fit(ds, epochs=2, batch_size=8, verbose=0, shuffle=False,
+                     jit_compile=True)
+    assert m_c._fit_used_compiled
+    assert abs(logs_e["loss"] - logs_c["loss"]) < 1e-5
+    w_e, w_c = _weights(m_e), _weights(m_c)
+    for k in w_e:
+        np.testing.assert_allclose(w_e[k], w_c[k], rtol=2e-5, atol=1e-6)
+    # optimizer state synced back: checkpointing sees the real step count
+    assert m_c._optimizer._step_count == m_e._optimizer._step_count == 16
+
+
+@pytest.mark.parametrize("opt_cls", [optim.SGD, optim.Momentum, optim.AdamW])
+def test_compiled_matches_eager_other_rules(opt_cls):
+    ds = _ToyDS(n=32)
+    m_e = _mlp_model(opt_cls=opt_cls)
+    m_e.fit(ds, epochs=1, batch_size=8, verbose=0, shuffle=False,
+            jit_compile=False)
+    m_c = _mlp_model(opt_cls=opt_cls)
+    m_c.fit(ds, epochs=1, batch_size=8, verbose=0, shuffle=False,
+            jit_compile=True)
+    assert m_c._fit_used_compiled
+    w_e, w_c = _weights(m_e), _weights(m_c)
+    for k in w_e:
+        np.testing.assert_allclose(w_e[k], w_c[k], rtol=2e-5, atol=1e-6)
+
+
+def test_k_step_unroll_identical():
+    """K∈{1,4}: the scanned superstep must not change the numbers."""
+    ds = _ToyDS()
+    m1 = _mlp_model()
+    m1.fit(ds, epochs=2, batch_size=8, verbose=0, shuffle=False,
+           jit_compile=True, steps_per_execution=1)
+    m4 = _mlp_model()
+    m4.fit(ds, epochs=2, batch_size=8, verbose=0, shuffle=False,
+           jit_compile=True, steps_per_execution=4)
+    assert m1._fit_used_compiled and m4._fit_used_compiled
+    w1, w4 = _weights(m1), _weights(m4)
+    for k in w1:
+        np.testing.assert_allclose(w1[k], w4[k], rtol=1e-6, atol=1e-7)
+    assert m4._optimizer._step_count == 16
+
+
+def test_k_step_ragged_tail_group():
+    """Dataset size not divisible by K: the tail group scans shorter —
+    every batch still trains exactly once."""
+    ds = _ToyDS(n=56)  # 7 batches of 8 → groups of 3,3,1 at K=3
+    m = _mlp_model()
+    m.fit(ds, epochs=1, batch_size=8, verbose=0, shuffle=False,
+          jit_compile=True, steps_per_execution=3)
+    assert m._fit_used_compiled
+    assert m._optimizer._step_count == 7
+    m_ref = _mlp_model()
+    m_ref.fit(ds, epochs=1, batch_size=8, verbose=0, shuffle=False,
+              jit_compile=False)
+    w, w_ref = _weights(m), _weights(m_ref)
+    for k in w:
+        np.testing.assert_allclose(w[k], w_ref[k], rtol=2e-5, atol=1e-6)
+
+
+def test_python_control_flow_falls_back_and_warns_once():
+    class Branchy(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(10, 2)
+
+        def forward(self, x):
+            if float(x.numpy().mean()) > 100:  # data-dependent branch
+                return self.fc(x) * 2
+            return self.fc(x)
+
+    paddle.seed(0)
+    net = Branchy()
+    m = hapi.Model(net)
+    m.prepare(optimizer=optim.SGD(learning_rate=1e-2,
+                                  parameters=net.parameters()),
+              loss=nn.CrossEntropyLoss())
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        logs = m.fit(_ToyDS(n=32), epochs=2, batch_size=8, verbose=0)
+    msgs = [str(w.message) for w in rec
+            if issubclass(w.category, RuntimeWarning)
+            and "falling back to eager" in str(w.message)]
+    assert len(msgs) == 1  # logged once, then eager for the rest of fit
+    assert m._fit_used_compiled is False
+    assert np.isfinite(logs["loss"])
+
+
+def test_structural_fallbacks():
+    from paddle_hackathon_tpu.hapi.compiled import unsupported_reason
+
+    # metrics need per-step host outputs
+    m = _mlp_model(metrics=metric.Accuracy())
+    assert "metrics" in unsupported_reason(m)
+    # grad accumulation stays on the eager tape
+    m2 = _mlp_model()
+    assert "accumulate_grad_batches" in unsupported_reason(
+        m2, accumulate_grad_batches=4)
+    # BatchNorm mutates running stats in-place during training
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(10, 8), nn.BatchNorm1D(8), nn.Linear(8, 2))
+    mb = hapi.Model(net)
+    mb.prepare(optimizer=optim.Adam(learning_rate=1e-3,
+                                    parameters=net.parameters()),
+               loss=nn.CrossEntropyLoss())
+    assert "buffers" in unsupported_reason(mb)
+    # ...and fit still trains (eagerly, with running stats updating)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        logs = mb.fit(_ToyDS(n=16), epochs=1, batch_size=8, verbose=0)
+    assert mb._fit_used_compiled is False and np.isfinite(logs["loss"])
+    # jit_compile=True surfaces the reason instead of silently degrading
+    with pytest.raises(ValueError, match="metrics"):
+        m.fit(_ToyDS(n=16), epochs=1, batch_size=8, verbose=0,
+              jit_compile=True)
+
+
+def test_callbacks_see_every_step_and_early_stop():
+    seen = []
+
+    class Spy(hapi.callbacks.Callback):
+        def on_train_batch_end(self, step, logs=None):
+            seen.append((step, logs.get("loss")))
+            if step == 5:
+                self.model.stop_training = True
+
+    m = _mlp_model()
+    m.fit(_ToyDS(), epochs=1, batch_size=8, verbose=0, shuffle=False,
+          jit_compile=True, steps_per_execution=2, callbacks=[Spy()])
+    assert m._fit_used_compiled
+    assert [s for s, _ in seen] == [0, 1, 2, 3, 4, 5]  # stopped at 5
+    # losses arrive per step; log_freq boundaries as floats, the rest as
+    # 0-d device scalars that float() on demand
+    assert all(float(v) == float(v) for _, v in seen)
+    assert isinstance(seen[0][1], float)
+
+
+def test_dropout_network_compiles_with_per_step_rng():
+    paddle.seed(3)
+    net = nn.Sequential(nn.Linear(10, 32), nn.ReLU(), nn.Dropout(0.5),
+                        nn.Linear(32, 2))
+    m = hapi.Model(net)
+    m.prepare(optimizer=optim.Adam(learning_rate=1e-2,
+                                   parameters=net.parameters()),
+              loss=nn.CrossEntropyLoss())
+    logs = m.fit(_ToyDS(n=32), epochs=1, batch_size=8, verbose=0,
+                 jit_compile=True, steps_per_execution=2)
+    assert m._fit_used_compiled and np.isfinite(logs["loss"])
+
+
+def test_compiled_fit_then_evaluate_and_save(tmp_path):
+    """Params rebound into the live network after every superstep: eval,
+    predict and checkpointing see current weights."""
+    ds = _ToyDS()
+    m = _mlp_model()
+    m.fit(ds, eval_data=ds, epochs=1, batch_size=8, verbose=0,
+          jit_compile=True, steps_per_execution=4)
+    assert m._fit_used_compiled
+    ev = m.evaluate(ds, batch_size=8, verbose=0)
+    assert np.isfinite(ev["loss"])
+    path = str(tmp_path / "ck" / "model")
+    m.save(path)
+    m2 = _mlp_model(seed=99)
+    m2.load(path)
+    w, w2 = _weights(m), _weights(m2)
+    for k in w:
+        np.testing.assert_allclose(w[k], w2[k])
+    # optimizer checkpoint carries the functional step count
+    assert int(m2._optimizer._step_count) == 8
+
+
+def test_device_prefetch_passthrough_and_order():
+    from paddle_hackathon_tpu.io.dataloader import device_prefetch
+
+    batches = [(np.full((2, 2), i, np.float32), np.int64(i))
+               for i in range(7)]
+    out = list(device_prefetch(iter(batches), size=3))
+    assert len(out) == 7
+    for i, (x, y) in enumerate(out):
+        import jax
+        assert isinstance(x, jax.Array)  # numpy leaves were device_put
+        np.testing.assert_array_equal(np.asarray(x), batches[i][0])
+    # Tensors pass through unwrapped
+    t = Tensor(np.ones((2,), np.float32))
+    out2 = list(device_prefetch(iter([(t,)]), size=2))
+    assert out2[0][0] is t

@@ -202,6 +202,10 @@ class TestFleetExecutor:
 
 
 class TestMultiProcessBootstrap:
+    @pytest.mark.skipif(
+        not hasattr(__import__("jax"), "set_mesh"),
+        reason="requires_multiprocess_cpu: jax<0.6 CPU backend has no "
+               "multiprocess collectives")
     def test_two_process_collective_via_launcher(self, tmp_path):
         """End-to-end: launcher env protocol -> init_parallel_env ->
         jax.distributed two-process psum on CPU (ref test_dist_base.py

@@ -109,9 +109,12 @@ int main(int argc, char** argv) {
       std::vector<int32_t> prompt;
       for (int i = 0; i < 5 + k; i++) prompt.push_back(7 * k + 1 + i);
       outs[k].resize(64);
+      // client 0 exercises timeout_s <= 0 == wait-forever (a raw 0.0
+      // used to reach Event.wait(0) and time out immediately)
       ns[k] = pht_engine_generate(eng, prompt.data(),
                                   (int32_t)prompt.size(), 6,
-                                  outs[k].data(), 64, 300.0);
+                                  outs[k].data(), 64,
+                                  k == 0 ? 0.0 : 300.0);
     });
   }
   for (auto& t : threads) t.join();

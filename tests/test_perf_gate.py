@@ -73,3 +73,22 @@ def test_model_snapshot_exists_and_covers_driver_configs():
         base = json.load(fh)
     for want in ("gpt2_small", "ernie", "1p3b", "long_context", "resnet50"):
         assert any(want in k for k in base), (want, list(base))
+
+
+def test_ratio_gate_flags_slow_fit_path():
+    """The hapi_fit row is gated AGAINST the same run's hand-rolled gpt2
+    row (no committed baseline needed for a new metric)."""
+    rows = [{"metric": "gpt2_small_pretrain_tokens_per_sec_per_chip",
+             "value": 100000.0},
+            {"metric": "hapi_fit_tokens_per_sec", "value": 85000.0}]
+    bad = perf_gate.compare_ratios(rows)
+    assert len(bad) == 1 and bad[0][0] == "hapi_fit_tokens_per_sec"
+    rows[1]["value"] = 95000.0
+    assert perf_gate.compare_ratios(rows) == []
+    # either metric missing: skipped (baseline comparison flags missing)
+    assert perf_gate.compare_ratios(rows[:1]) == []
+
+
+def test_suite_has_hapi_fit_row():
+    import bench
+    assert "hapi_fit" in bench.SUITE

@@ -8,6 +8,8 @@ import sys
 
 import pytest
 
+from paddle_hackathon_tpu.core.jaxcompat import set_mesh as _set_mesh
+
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
 
 from scaling_model import (collective_bytes_from_hlo, efficiency_table,
@@ -65,7 +67,7 @@ def test_zero3_adds_param_gather_traffic():
             model, mesh, rule=param_sharding_spec, learning_rate=1e-3,
             zero_stage=3)
         ids = jnp.zeros((8, 32), jnp.int32)
-        with jax.set_mesh(mesh):
+        with _set_mesh(mesh):
             compiled = step._jitted.lower(
                 state["params"], state["opt_state"], state["step"],
                 (ids, ids), jax.random.key(0), jnp.float32(1e-3)).compile()

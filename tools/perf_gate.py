@@ -123,6 +123,31 @@ def compare_suite(baseline, rows, tolerance):
     return bad
 
 
+# Same-run ratio gates: (metric, reference_metric, min_ratio).  Unlike the
+# baseline comparison these need no committed number, so a NEW metric is
+# gated from its first suite run.  hapi_fit is the compiled Model.fit
+# path; it must stay within 10% of the hand-rolled jitted step it wraps
+# (the acceptance bar for the fit fast path).
+RATIO_GATES = [
+    ("hapi_fit_tokens_per_sec",
+     "gpt2_small_pretrain_tokens_per_sec_per_chip", 0.90),
+]
+
+
+def compare_ratios(rows):
+    """[(metric, ref, ratio, floor)] for ratio gates that fail; gates
+    whose metrics the run didn't produce are skipped (the baseline
+    comparison already flags missing rows)."""
+    cur = {r["metric"]: float(r["value"]) for r in rows}
+    bad = []
+    for metric, ref, floor in RATIO_GATES:
+        if metric in cur and ref in cur and cur[ref] > 0:
+            ratio = cur[metric] / cur[ref]
+            if ratio < floor:
+                bad.append((metric, ref, ratio, floor))
+    return bad
+
+
 def suite_gate(tolerance, rows=None):
     """Gate EVERY BASELINE.md model config (ERNIE/1.3B/long-context/
     ResNet + gpt2) against the committed best values — the round-2 gate
@@ -143,15 +168,21 @@ def suite_gate(tolerance, rows=None):
         rows = [json.loads(line) for line in out.stdout.splitlines()
                 if line.startswith("{")]
     bad = compare_suite(baseline, rows, tolerance)
-    if bad:
-        print(f"perf_gate[suite] FAIL: {len(bad)} configs regressed "
-              f">{tolerance:.0%}:")
-        for metric, base, v in bad:
-            print(f"  {metric}: {base:,.0f} -> "
-                  f"{'missing' if v is None else format(v, ',.0f')}")
+    bad_ratio = compare_ratios(rows)
+    if bad or bad_ratio:
+        if bad:
+            print(f"perf_gate[suite] FAIL: {len(bad)} configs regressed "
+                  f">{tolerance:.0%}:")
+            for metric, base, v in bad:
+                print(f"  {metric}: {base:,.0f} -> "
+                      f"{'missing' if v is None else format(v, ',.0f')}")
+        for metric, ref, ratio, floor in bad_ratio:
+            print(f"perf_gate[suite] FAIL: {metric} at {ratio:.2f}x of "
+                  f"{ref} (floor {floor:.2f}x)")
         return 1
     print(f"perf_gate[suite] PASS: {len(baseline)} configs within "
-          f"{tolerance:.0%} of the committed baseline")
+          f"{tolerance:.0%} of the committed baseline; "
+          f"{len(RATIO_GATES)} ratio gates hold")
     return 0
 
 

@@ -44,6 +44,8 @@ import os
 import subprocess
 import sys
 
+from paddle_hackathon_tpu.core.jaxcompat import set_mesh as _set_mesh
+
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 def collective_bytes_from_hlo(hlo_text):
@@ -83,7 +85,7 @@ def measure_dp_step(n, hidden=64, layers=2, vocab=256, seq=32,
             model, mesh, rule=param_sharding_spec, learning_rate=1e-3,
             zero_stage=zero_stage)
         ids = jnp.asarray(np.zeros((n, seq)), jnp.int32)
-        with jax.set_mesh(mesh):
+        with _set_mesh(mesh):
             compiled = step._jitted.lower(
                 state["params"], state["opt_state"], state["step"],
                 (ids, ids), jax.random.key(0), jnp.float32(1e-3)).compile()
