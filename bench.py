@@ -514,13 +514,19 @@ def _trace_device_ms(fn):
     return dev_ms
 
 
-def bench_decode(batch=8, prompt=64, new_tokens=128):
+def bench_decode(batch=8, prompt=64, new_tokens=128, spec_k=0,
+                 metric="gpt2_greedy_decode_device_tokens_per_sec_per_chip"):
     """One-program greedy decoding DEVICE throughput: one traced
     generate() call, summed top-level XLA-op device time (nested while
     bodies counted once). Wall clock through the axon tunnel is
     round-trip-bound (~100-160 ms per RTT, varying day to day) and
     measures the tunnel, not the chip — the round-3 "4,032 tok/s" row was
-    ~2/3 tunnel latency (BASELINE.md round-4 decode notes)."""
+    ~2/3 tunnel latency (BASELINE.md round-4 decode notes).
+
+    ``spec_k>0`` = the `decode_spec` row: the draft-and-verify loop
+    (n-gram self-drafting) over the same workload, with the acceptance
+    rate recorded — exact greedy equivalence means any rate > 0 is free
+    throughput."""
     import paddle_hackathon_tpu as paddle
     from paddle_hackathon_tpu.core.tensor import Tensor
     from paddle_hackathon_tpu.models import GPTForCausalLM, gpt_config
@@ -536,24 +542,37 @@ def bench_decode(batch=8, prompt=64, new_tokens=128):
     rng = np.random.RandomState(0)
     ids = Tensor(jnp.asarray(rng.randint(0, cfg.vocab_size,
                                          (batch, prompt)), jnp.int32))
-    np.asarray(model.generate(ids, max_new_tokens=new_tokens,
-                              temperature=0.0).numpy())  # compile+sync
+    gen = lambda: np.asarray(model.generate(  # noqa: E731
+        ids, max_new_tokens=new_tokens, temperature=0.0,
+        spec_k=spec_k).numpy())
+    gen()  # compile+sync
     outs = []
-    dev_ms = _trace_device_ms(lambda: outs.append(np.asarray(
-        model.generate(ids, max_new_tokens=new_tokens,
-                       temperature=0.0).numpy())))
+    dev_ms = _trace_device_ms(lambda: outs.append(gen()))
     assert outs[0].shape == (batch, prompt + new_tokens)
-    return {"metric": "gpt2_greedy_decode_device_tokens_per_sec_per_chip",
-            "value": round(batch * new_tokens / (dev_ms / 1e3), 1),
-            "unit": "tokens/s"}
+    row = {"metric": metric,
+           "value": round(batch * new_tokens / (dev_ms / 1e3), 1),
+           "unit": "tokens/s"}
+    if spec_k:
+        st = model._last_spec_stats
+        row["acceptance_rate"] = round(
+            st["accepted"] / max(st["proposed"], 1), 4)
+        row["spec_ticks"] = st["ticks"]
+    return row
 
 
-def bench_serving(streams=8, prompt=64, new_tokens=128, chunk=32):
+def bench_serving(streams=8, prompt=64, new_tokens=128, chunk=32, spec_k=0,
+                  metric="gpt2_serving_8stream_device_tokens_per_sec_per_chip"):
     """Continuous-batching serving (VERDICT r4 directive #2): aggregate
     DEVICE tokens/s across `streams` concurrent requests through the
     ServingEngine's slot-batched tick. Trace-measured like bench_decode —
     per-tick wall through the axon tunnel is RTT-bound (one small D2H per
-    tick) and measures the tunnel, not the chip."""
+    tick) and measures the tunnel, not the chip.
+
+    ``spec_k>0`` = the `serving_spec` row: identical workload through the
+    fused verify tick with the n-gram drafter; acceptance rate recorded,
+    and tools/perf_gate.py holds it to >= 1.0x the same-run `serving`
+    row (exact greedy equivalence makes speculation strictly free unless
+    the verify width itself costs more than it recovers)."""
     import paddle_hackathon_tpu as paddle
     from paddle_hackathon_tpu.inference.serving import ServingEngine
     from paddle_hackathon_tpu.models import GPTForCausalLM, gpt_config
@@ -571,7 +590,7 @@ def bench_serving(streams=8, prompt=64, new_tokens=128, chunk=32):
                for _ in range(streams)]
     eng = ServingEngine(model, max_slots=streams,
                         max_len=prompt + new_tokens + chunk, chunk=chunk,
-                        auto_run=False, decode_window=32)
+                        auto_run=False, decode_window=32, spec_k=spec_k)
     warm = eng.submit(prompts[0], 2)  # compile the tick
     eng.run_until_idle()
     assert warm.done
@@ -579,10 +598,15 @@ def bench_serving(streams=8, prompt=64, new_tokens=128, chunk=32):
     dev_ms = _trace_device_ms(eng.run_until_idle)
     assert all(r.done for r in reqs)
     total = streams * new_tokens
-    return {"metric":
-            "gpt2_serving_8stream_device_tokens_per_sec_per_chip",
-            "value": round(total / (dev_ms / 1e3), 1),
-            "unit": "tokens/s"}
+    row = {"metric": metric,
+           "value": round(total / (dev_ms / 1e3), 1),
+           "unit": "tokens/s"}
+    if spec_k:
+        row["acceptance_rate"] = round(
+            eng.stats["spec_accepted"] / max(eng.stats["spec_drafted"], 1),
+            4)
+        row["spec_ticks"] = eng.stats["spec_ticks"]
+    return row
 
 
 SUITE = {
@@ -603,6 +627,15 @@ SUITE = {
     "ppyoloe_train": lambda: bench_ppyoloe_train(),
     "decode": lambda: bench_decode(),
     "serving": lambda: bench_serving(),
+    # speculative draft-and-verify rows (PR 3): same workloads, spec_k=8
+    # n-gram self-drafting; the serving_spec/serving same-run ratio is
+    # gated >= 1.0x by tools/perf_gate.py
+    "decode_spec": lambda: bench_decode(
+        spec_k=8,
+        metric="gpt2_greedy_decode_spec_device_tokens_per_sec_per_chip"),
+    "serving_spec": lambda: bench_serving(
+        spec_k=8,
+        metric="gpt2_serving_spec_8stream_device_tokens_per_sec_per_chip"),
     # the high-level trainer's compiled fast path (hapi/compiled.py):
     # tokens/s through Model.fit must track the hand-rolled gpt2 row
     "hapi_fit": lambda: bench_hapi_fit(),

@@ -24,9 +24,13 @@ from .config import Config, AnalysisConfig, PassBuilder
 from .predictor import (Predictor, PredictorPool, Tensor as InferTensor,
                         create_predictor, get_version)
 from .serving import Request, ServingEngine
+# speculative-decoding drafters (ServingEngine(spec_k=..., drafter=...) /
+# GPTForCausalLM.generate(spec_k=...)) — re-exported here because serving
+# is where users reach for them
+from ..nn.decode import ModelDrafter, NGramDrafter
 
 __all__ = [
     "Config", "AnalysisConfig", "PassBuilder", "Predictor", "PredictorPool",
     "InferTensor", "create_predictor", "get_version",
-    "Request", "ServingEngine",
+    "Request", "ServingEngine", "NGramDrafter", "ModelDrafter",
 ]

@@ -118,14 +118,21 @@ def load_for_serving(path):
 
 
 class Request:
-    """One in-flight generation request."""
+    """One in-flight generation request.
+
+    ``temperature``/``top_k``/``top_p`` override the engine-global
+    sampling defaults for this request only (None = inherit)."""
 
     __slots__ = ("prompt", "max_new_tokens", "tokens", "done", "error",
-                 "_event")
+                 "temperature", "top_k", "top_p", "_event")
 
-    def __init__(self, prompt, max_new_tokens):
+    def __init__(self, prompt, max_new_tokens, temperature=None,
+                 top_k=None, top_p=None):
         self.prompt = np.asarray(prompt, np.int32).reshape(-1)
         self.max_new_tokens = int(max_new_tokens)
+        self.temperature = None if temperature is None else float(temperature)
+        self.top_k = None if top_k is None else int(top_k)
+        self.top_p = None if top_p is None else float(top_p)
         self.tokens: List[int] = []  # generated so far
         self.done = False
         self.error: Optional[BaseException] = None
@@ -161,16 +168,30 @@ class ServingEngine:
         ``caches``/``cache_pos`` support, tied LM head).
       max_slots: concurrent request capacity (the static batch B).
       max_len: per-slot KV capacity; a request needs
-        ``len(prompt) + max_new_tokens <= max_len - chunk``.
+        ``len(prompt) + max_new_tokens <= max_len - max(chunk, spec_k+1)``
+        (headroom for the widest in-flight cache write).
       chunk: prefill chunk width per tick (decode uses 1 of it).
-      temperature/top_k: sampling config (0.0 = greedy, matching
-        ``model.generate(temperature=0.0)`` token-for-token).
+      temperature/top_k/top_p: engine-default sampling config (0.0 =
+        greedy, matching ``model.generate(temperature=0.0)``
+        token-for-token); :meth:`submit` may override per request.
       eos_token_id: optional early-stop token.
+      spec_k: >0 enables speculative decoding — on all-decode ticks a
+        drafter proposes up to ``spec_k`` tokens per slot and ONE fused
+        verify program scores all ``spec_k+1`` positions, committing the
+        longest prefix matching the target's greedy argmax (exact greedy
+        equivalence; slots sampling at temperature>0 simply draft 0 and
+        advance 1 token/tick).  Prefilling slots keep the chunk-wide
+        program unchanged.  Acceptance counters land in ``stats``
+        (``spec_ticks``/``spec_drafted``/``spec_accepted``).
+      drafter: 'ngram' (model-free prompt-lookup, default), a small
+        ``GPTForCausalLM`` draft model, or any object speaking the
+        ``nn.decode`` drafter interface.
     """
 
     def __init__(self, model, max_slots=8, max_len=512, chunk=16,
                  temperature=0.0, top_k=None, eos_token_id=None,
-                 auto_run=True, decode_window=8):
+                 auto_run=True, decode_window=8, top_p=None, spec_k=0,
+                 drafter="ngram"):
         import jax
         import jax.numpy as jnp
 
@@ -181,9 +202,11 @@ class ServingEngine:
         self.chunk = int(chunk)
         self.temperature = float(temperature)
         self.top_k = top_k
+        self.top_p = top_p
         self.eos_token_id = eos_token_id
         self.auto_run = bool(auto_run)
         self._decode_window = max(1, min(int(decode_window), self.chunk))
+        self.spec_k = int(spec_k)
 
         cfg = model.config
         self._head_dim = cfg.hidden_size // cfg.num_heads
@@ -210,8 +233,22 @@ class ServingEngine:
         self._running = False
         self._loop_thread = None
         self._tickno = 0
-        self.stats = {"ticks": 0, "tokens": 0, "requests": 0}
+        self.stats = {"ticks": 0, "tokens": 0, "requests": 0,
+                      "spec_ticks": 0, "spec_drafted": 0,
+                      "spec_accepted": 0}
         self._key = jax.random.key(0)
+
+        self._spec = None
+        if self.spec_k > 0 and self._pp > 1:
+            import warnings
+            warnings.warn("spec_k is not supported on the pipeline-"
+                          "parallel tick yet; serving without "
+                          "speculative decoding", stacklevel=2)
+            self.spec_k = 0
+        if self.spec_k > 0:
+            from ..nn.decode import get_drafter
+            self._spec = get_drafter(drafter, self.spec_k)
+            self._spec.begin(self.max_slots, self.max_len)
 
         if self._pp > 1:
             self._build_pp_tick()
@@ -267,27 +304,29 @@ class ServingEngine:
         from ..nn.layer import functional_call
 
         model = self.model
-        temperature, top_k = self.temperature, self.top_k
         bufs = self._bufs
 
-        def tick(params, caches, tokens, starts, nvalid, key, tickno):
-            hidden, caches = functional_call(
-                model.gpt, params, (Tensor(tokens),),
-                kwargs={"caches": caches, "cache_pos": starts},
-                buffers=bufs, training=False)
-            last = jnp.take_along_axis(
-                hidden, (nvalid - 1).astype(jnp.int32)[:, None, None],
-                axis=1)[:, 0]  # (B, h): each slot's last valid position
-            logits = last @ params["wte.weight"].T
-            # path tag 0: the single-step and multi-step programs must
-            # draw from disjoint PRNG domains (tickno vs tickno*M+t
-            # counters would otherwise collide for temperature>0)
-            nxt = model._sample(
-                logits, temperature, top_k,
-                key=jax.random.fold_in(jax.random.fold_in(key, 0), tickno))
-            return caches, nxt[:, 0].astype(jnp.int32)
+        def mk_tick(sample):
+            def tick(params, caches, tokens, starts, nvalid, temps, topks,
+                     topps, key, tickno):
+                hidden, caches = functional_call(
+                    model.gpt, params, (Tensor(tokens),),
+                    kwargs={"caches": caches, "cache_pos": starts},
+                    buffers=bufs, training=False)
+                last = jnp.take_along_axis(
+                    hidden, (nvalid - 1).astype(jnp.int32)[:, None, None],
+                    axis=1)[:, 0]  # (B, h): each slot's last valid position
+                logits = last @ params["wte.weight"].T
+                # path tag 0: the single-step and multi-step programs must
+                # draw from disjoint PRNG domains (tickno vs tickno*M+t
+                # counters would otherwise collide for temperature>0)
+                nxt = sample(
+                    logits, temps, topks, topps,
+                    jax.random.fold_in(jax.random.fold_in(key, 0), tickno))
+                return caches, nxt[:, 0].astype(jnp.int32)
+            return jax.jit(tick, donate_argnums=(1,))
 
-        self._tick = jax.jit(tick, donate_argnums=(1,))
+        self._tick, self._tick_mk = {}, mk_tick
 
         # multi-step decode window: when NO slot is prefilling, one tick
         # runs M in-program decode steps (lax.fori_loop with in-jit
@@ -297,46 +336,191 @@ class ServingEngine:
         # tok/s at b8; window=8: 9.1k; the fused loop: 12.2k)
         M = self._decode_window
 
-        def tick_multi(params, caches, last_tok, starts, key, tickno):
-            B = last_tok.shape[0]
-            outbuf = jnp.zeros((B, M), jnp.int32)
+        def mk_tick_multi(sample):
+            def tick_multi(params, caches, last_tok, starts, temps, topks,
+                           topps, key, tickno):
+                B = last_tok.shape[0]
+                outbuf = jnp.zeros((B, M), jnp.int32)
 
-            def body(t, carry):
-                caches, cur, outbuf = carry
-                hidden, caches = functional_call(
-                    model.gpt, params, (Tensor(cur[:, None]),),
-                    kwargs={"caches": caches,
-                            "cache_pos": starts + t.astype(jnp.int32)},
-                    buffers=bufs, training=False)
-                logits = hidden[:, 0] @ params["wte.weight"].T
-                nxt = model._sample(
-                    logits, temperature, top_k,
-                    key=jax.random.fold_in(jax.random.fold_in(key, 1),
+                def body(t, carry):
+                    caches, cur, outbuf = carry
+                    hidden, caches = functional_call(
+                        model.gpt, params, (Tensor(cur[:, None]),),
+                        kwargs={"caches": caches,
+                                "cache_pos": starts + t.astype(jnp.int32)},
+                        buffers=bufs, training=False)
+                    logits = hidden[:, 0] @ params["wte.weight"].T
+                    nxt = sample(
+                        logits, temps, topks, topps,
+                        jax.random.fold_in(jax.random.fold_in(key, 1),
                                            tickno * M + t)
-                )[:, 0].astype(jnp.int32)
-                outbuf = jax.lax.dynamic_update_slice(
-                    outbuf, nxt[:, None],
-                    (jnp.zeros((), jnp.int32), t.astype(jnp.int32)))
-                return caches, nxt, outbuf
+                    )[:, 0].astype(jnp.int32)
+                    outbuf = jax.lax.dynamic_update_slice(
+                        outbuf, nxt[:, None],
+                        (jnp.zeros((), jnp.int32), t.astype(jnp.int32)))
+                    return caches, nxt, outbuf
 
-            caches, _, outbuf = jax.lax.fori_loop(
-                0, M, body, (caches, last_tok, outbuf))
-            return caches, outbuf
+                caches, _, outbuf = jax.lax.fori_loop(
+                    0, M, body, (caches, last_tok, outbuf))
+                return caches, outbuf
+            return jax.jit(tick_multi, donate_argnums=(1,))
 
-        self._tick_multi = jax.jit(tick_multi, donate_argnums=(1,))
+        self._tick_multi, self._tick_multi_mk = {}, mk_tick_multi
 
-    def _run_tick(self, tokens, starts, nvalid):
+        if self.spec_k > 0:
+            self._build_spec_tick()
+
+    def _mk_sampler(self, skey):
+        """The per-tick sampling closure, in static flavors compiled as
+        separate programs.  ``skey=False`` bakes the engine-global scalar
+        config (the historical single-argmax/top-k trace — no per-row
+        sort/nucleus work on the hot path).  ``skey=(tk_on, tp_on)``
+        routes the per-slot override vectors through ``_sample``'s vector
+        mode, with the top-k sort and the nucleus softmax/cumsum each
+        compiled in only when some row actually enables that filter.
+        ``_sampling_vectors`` picks the flavor per tick, so engines whose
+        requests never override sampling never even compile a vector
+        variant."""
+        model = self.model
+        if skey is False:
+            t, k, p = self.temperature, self.top_k, self.top_p
+
+            def sample(logits, temps, topks, topps, key):
+                return model._sample(logits, t, k, top_p=p, key=key)
+            return sample
+        tk_on, tp_on = skey
+
+        def sample(logits, temps, topks, topps, key):
+            return model._sample(logits, temps,
+                                 topks if tk_on else None,
+                                 top_p=topps if tp_on else None, key=key)
+        return sample
+
+    def _prog(self, name, skey):
+        """Build-or-reuse the jitted ``name`` program for sampler flavor
+        ``skey`` (flavors compile lazily on first use)."""
+        cache = getattr(self, name)
+        fn = cache.get(skey)
+        if fn is None:
+            fn = cache[skey] = getattr(self, name + "_mk")(
+                self._mk_sampler(skey))
+        return fn
+
+    def _build_spec_tick(self):
+        """Fused speculative VERIFY tick: score all ``spec_k+1`` positions
+        of every decoding slot in one program over the same static-cache
+        ``cache_pos`` write path the chunk program uses.  Position 0
+        samples per-slot (greedy slots: argmax — the committed bonus
+        token); positions >=1 are the greedy references the host-side
+        acceptance compares drafts against.  Rejected tails need no cache
+        rollback: the engine simply advances ``_lengths`` by accepted+1,
+        and the next program rewrites ``[length, length+K]`` before any
+        query can attend the stale rows (kpos <= qpos masking)."""
+        import jax
         import jax.numpy as jnp
+
+        from ..core.tensor import Tensor
+        from ..nn.layer import functional_call
+
+        model = self.model
+        bufs = self._bufs
+        K = self.spec_k
+
+        def mk_tick_spec(sample):
+            def tick_spec(params, caches, tokens, starts, temps, topks,
+                          topps, key, tickno):
+                B = tokens.shape[0]
+                hidden, caches = functional_call(
+                    model.gpt, params, (Tensor(tokens),),
+                    kwargs={"caches": caches, "cache_pos": starts},
+                    buffers=bufs, training=False)
+                logits = hidden @ params["wte.weight"].T  # (B, K+1, V)
+                # position 0 is the committed bonus/sampled token — it
+                # samples per slot config (path tag 3: disjoint PRNG
+                # domain from the other programs); positions >= 1 exist
+                # only as greedy references for acceptance (and as the
+                # committed tokens of greedy slots) — one batched argmax,
+                # the same scalar-greedy math generate()'s verify uses
+                first = sample(
+                    logits[:, 0], temps, topks, topps,
+                    jax.random.fold_in(jax.random.fold_in(key, 3), tickno))
+                ref = model._sample(
+                    logits[:, 1:].reshape(B * K, -1), 0.0, None)
+                out = jnp.concatenate([first, ref.reshape(B, K)], axis=1)
+                return caches, out.astype(jnp.int32)
+            return jax.jit(tick_spec, donate_argnums=(1,))
+
+        self._tick_spec, self._tick_spec_mk = {}, mk_tick_spec
+
+    def _sampling_vectors(self):
+        """Per-slot (skey, temperature, top_k, top_p) for the tick
+        programs: the engine defaults, overridden by each slot's request
+        (the per-request sampling API).  ``skey`` is False when no
+        active request overrides anything — the tick then runs the
+        scalar-config program (the cheap argmax/top-k trace); otherwise
+        it is a ``(top_k_live, top_p_live)`` pair selecting a vector-mode
+        program that compiles only the filters some row enables.
+        Encodings match ``_sample``'s vector mode: top_k=0 / top_p=1.0 =
+        filter off."""
+        B = self.max_slots
+        temps = np.full(B, self.temperature, np.float32)
+        topks = np.full(B, 0 if self.top_k is None else int(self.top_k),
+                        np.int32)
+        topps = np.full(B, 1.0 if self.top_p is None else float(self.top_p),
+                        np.float32)
+        vec = False
+        for i, slot in enumerate(self._slots):
+            req = slot.req
+            if req is None:
+                continue
+            if req.temperature is not None:
+                temps[i] = req.temperature
+            if req.top_k is not None:
+                topks[i] = req.top_k
+            if req.top_p is not None:
+                topps[i] = req.top_p
+            vec = vec or (req.temperature is not None
+                          or req.top_k is not None
+                          or req.top_p is not None)
+        skey = (bool((topks != 0).any()),
+                bool((topps != 1.0).any())) if vec else False
+        return skey, temps, topks, topps
+
+    def _run_tick(self, tokens, starts, nvalid, sampling):
+        import jax.numpy as jnp
+        vec, temps, topks, topps = sampling
         width = 1 if int(np.max(nvalid)) <= 1 else self.chunk
-        self._caches, nxt = self._tick(
+        self._caches, nxt = self._prog("_tick", vec)(
             self._params, self._caches, jnp.asarray(tokens[:, :width]),
-            jnp.asarray(starts), jnp.asarray(nvalid), self._key,
+            jnp.asarray(starts), jnp.asarray(nvalid), jnp.asarray(temps),
+            jnp.asarray(topks), jnp.asarray(topps), self._key,
             jnp.asarray(self._tickno, jnp.int32))
         return np.asarray(nxt)
+
+    def _run_tick_spec(self, tokens, starts, sampling):
+        import jax
+        import jax.numpy as jnp
+        vec, temps, topks, topps = sampling
+        toks_j, starts_j = jnp.asarray(tokens), jnp.asarray(starts)
+        if self._mesh is not None:
+            # place the widened (B, K+1) verify block on the KV cache's
+            # batch layout up front — GSPMD then needs no reshard before
+            # the in-program per-slot cache writes
+            from ..parallel.api import token_batch_sharding
+            sh = token_batch_sharding(self._mesh)
+            toks_j = jax.device_put(toks_j, sh)
+            starts_j = jax.device_put(starts_j, sh)
+        self._caches, out = self._prog("_tick_spec", vec)(
+            self._params, self._caches, toks_j, starts_j,
+            jnp.asarray(temps), jnp.asarray(topks), jnp.asarray(topps),
+            self._key, jnp.asarray(self._tickno, jnp.int32))
+        return np.asarray(out)
 
     # ------------------------------------------------------------------
     def _build_pp_tick(self):
         """Interleaved-wave pipelined tick (see module docstring)."""
+        import functools
+
         import jax
         import jax.numpy as jnp
         from jax.sharding import NamedSharding, PartitionSpec as P
@@ -359,7 +543,6 @@ class ServingEngine:
                 f"num_layers={cfg.num_layers} must divide over pp={pp}")
         self._wave = Bw = self.max_slots // pp
         C = self.chunk
-        temperature, top_k = self.temperature, self.top_k
         max_pos = cfg.max_position_embeddings
 
         prefix = model.pipeline_stage_spec()["block_prefix"]
@@ -381,8 +564,9 @@ class ServingEngine:
             y, (nk, nv) = jax.lax.scan(body, x, (st, kc, vc))
             return y, nk, nv
 
-        def spmd(st_local, kcache, vcache, xbuf, tokens, starts, nvalid,
-                 wave_of_stage, other_p, key, tickno):
+        def spmd(sample, st_local, kcache, vcache, xbuf, tokens, starts,
+                 nvalid, temps, topks, topps, wave_of_stage, other_p,
+                 key, tickno):
             # kcache/vcache: (L_local, B, T, H, D) — this stage's layer
             #   slab over the FULL slot batch (a stage touches only its
             #   current wave's rows each tick).
@@ -423,9 +607,12 @@ class ServingEngine:
                 xn, (nv_w - 1).astype(jnp.int32)[:, None, None],
                 axis=1)[:, 0]
             logits = hid @ other_p["gpt.wte.weight"].T
-            nxt = model._sample(
-                logits, temperature, top_k,
-                key=jax.random.fold_in(jax.random.fold_in(key, 2), tickno)
+            nxt = sample(
+                logits,
+                jax.lax.dynamic_slice(temps, (sl0,), (Bw,)),
+                jax.lax.dynamic_slice(topks, (sl0,), (Bw,)),
+                jax.lax.dynamic_slice(topps, (sl0,), (Bw,)),
+                jax.random.fold_in(jax.random.fold_in(key, 2), tickno)
             )[:, 0].astype(jnp.int32)
             is_exit = stage == pp - 1
             out = jnp.zeros((pp * Bw,), jnp.int32)
@@ -440,26 +627,34 @@ class ServingEngine:
         st_specs = jax.tree.map(lambda _: P("pp"), stacked)
         other_specs = jax.tree.map(lambda _: P(), other)
 
-        def tick(stacked_p, kc, vc, xbuf, tokens, starts, nvalid,
-                 wave_of_stage, other_p, key, tickno):
-            return run_shard_map(
-                spmd, mesh,
-                in_specs=(st_specs, P("pp"), P("pp"), P("pp"),
-                          P(), P(), P(), P(), other_specs, P(), P()),
-                out_specs=(P("pp"), P("pp"), P("pp"), P()),
-                manual_axes={"pp"},
-                args=(stacked_p, kc, vc, xbuf, tokens, starts, nvalid,
-                      wave_of_stage, other_p, key, tickno))
+        def mk_tick(sample):
+            spmd_s = functools.partial(spmd, sample)
 
-        self._pp_tick = jax.jit(tick, donate_argnums=(1, 2, 3))
+            def tick(stacked_p, kc, vc, xbuf, tokens, starts, nvalid,
+                     temps, topks, topps, wave_of_stage, other_p, key,
+                     tickno):
+                return run_shard_map(
+                    spmd_s, mesh,
+                    in_specs=(st_specs, P("pp"), P("pp"), P("pp"),
+                              P(), P(), P(), P(), P(), P(), P(),
+                              other_specs, P(), P()),
+                    out_specs=(P("pp"), P("pp"), P("pp"), P()),
+                    manual_axes={"pp"},
+                    args=(stacked_p, kc, vc, xbuf, tokens, starts, nvalid,
+                          temps, topks, topps, wave_of_stage, other_p, key,
+                          tickno))
+            return jax.jit(tick, donate_argnums=(1, 2, 3))
+
+        self._pp_tick, self._pp_tick_mk = {}, mk_tick
         self._xbuf = jax.device_put(
             jnp.zeros((pp, Bw, C, cfg.hidden_size), self._dtype),
             NamedSharding(mesh, P("pp")))
 
-    def _run_pp_tick(self, tokens, starts, nvalid):
+    def _run_pp_tick(self, tokens, starts, nvalid, sampling):
         import jax
         import jax.numpy as jnp
         pp = self._pp
+        vec, temps, topks, topps = sampling
         # wave at stage s this tick entered stage 0 s ticks ago
         wave_of_stage = np.array(
             [(self._tickno - s) % pp for s in range(pp)], np.int32)
@@ -468,9 +663,10 @@ class ServingEngine:
         # ambient mesh — same contract as _run_decode_program
         from ..core.jaxcompat import set_mesh as _set_mesh
         with _set_mesh(self._mesh):
-            kc, vc, self._xbuf, nxt = self._pp_tick(
+            kc, vc, self._xbuf, nxt = self._prog("_pp_tick", vec)(
                 self._pp_stacked, kc, vc, self._xbuf, jnp.asarray(tokens),
                 jnp.asarray(starts), jnp.asarray(nvalid),
+                jnp.asarray(temps), jnp.asarray(topks), jnp.asarray(topps),
                 jnp.asarray(wave_of_stage), self._pp_other, self._key,
                 jnp.asarray(self._tickno, jnp.int32))
         self._caches = (kc, vc)
@@ -478,13 +674,20 @@ class ServingEngine:
 
     # ------------------------------------------------------------------
     # scheduling
-    def submit(self, prompt, max_new_tokens=32) -> Request:
-        req = Request(prompt, max_new_tokens)
+    def submit(self, prompt, max_new_tokens=32, temperature=None,
+               top_k=None, top_p=None) -> Request:
+        req = Request(prompt, max_new_tokens, temperature=temperature,
+                      top_k=top_k, top_p=top_p)
         need = len(req.prompt) + req.max_new_tokens
-        if need > self.max_len - self.chunk:
+        # reserve headroom past the last committed row for the widest
+        # in-flight write: a prefill chunk, or the (spec_k+1)-wide verify
+        # block — without it a tail write would clamp back onto (and
+        # corrupt) committed cache rows
+        reserve = max(self.chunk, self.spec_k + 1)
+        if need > self.max_len - reserve:
             raise ValueError(
                 f"request needs {need} cache rows; capacity is "
-                f"max_len-chunk={self.max_len - self.chunk}")
+                f"max_len-max(chunk,spec_k+1)={self.max_len - reserve}")
         max_pos = getattr(self.model.config, "max_position_embeddings", None)
         if max_pos is not None and need > max_pos:
             # past max_pos the position lookup clips to the last row —
@@ -594,6 +797,7 @@ class ServingEngine:
                     "re-enter the tick with donated caches — wait for the "
                     "loop to drain (shutdown()) instead")
             self._admit()
+            sampling = self._sampling_vectors()
             if self._pp > 1:
                 if (not any(s.req is not None for s in self._slots)
                         and not self._inflight_live()):
@@ -603,26 +807,77 @@ class ServingEngine:
             elif not any(s.req is not None for s in self._slots):
                 return False
             # after _admit, a pending request implies no free slot — so
-            # "every active slot is decoding" is the multi-window gate
+            # "every active slot is decoding" is the spec/multi-window gate
             elif all(s.req is None or s.off >= len(s.req.prompt)
                      for s in self._slots):
-                mode = "multi"
                 last_toks = np.asarray([s.last for s in self._slots],
                                        np.int32)
                 starts = self._lengths.copy()
+                active = np.asarray(
+                    [s.req is not None for s in self._slots])
+                # speculate only when some active slot is greedy — an
+                # all-sampling tick would pay the K+1-wide verify for 1
+                # token/slot where the fused M-step window commits M
+                mode = ("spec" if self._spec is not None
+                        and bool((active & (sampling[1] == 0.0)).any())
+                        else "multi")
             else:
                 mode = "chunk"
                 tokens, starts, nvalid, consumed, finishing = self._stage()
 
         if mode == "pp":
-            nxt = self._run_pp_tick(tokens, starts, nvalid)
+            nxt = self._run_pp_tick(tokens, starts, nvalid, sampling)
             with self._lock:
                 self._tickno += 1
                 self.stats["ticks"] += 1
                 self._commit_pp_exit_locked(exit_wave, nxt)
             return True
+        if mode == "spec":
+            # draft-and-verify: slot state is stable outside the lock
+            # (only this driver thread mutates it), so drafting and the
+            # device tick run unlocked like the other modes
+            drafts, ndraft = self._spec.propose(last_toks, starts)
+            # only active greedy slots draft; sampled slots (per-request
+            # temperature>0) advance 1 token/tick with exact sampling
+            ndraft = np.where(active & (sampling[1] == 0.0), ndraft, 0)
+            ndraft = ndraft.astype(np.int32)
+            if not ndraft.any():
+                # nothing proposed this tick (e.g. no n-gram repeats yet):
+                # the K+1-wide verify would commit 1 token/slot — the
+                # fused M-step window is strictly better, demote
+                mode = "multi"
+        if mode == "spec":
+            toks = np.concatenate([last_toks[:, None], drafts], axis=1)
+            out = self._run_tick_spec(toks, starts, sampling)
+            from ..nn.decode import accept_lengths
+            acc = accept_lengths(drafts, ndraft, out)
+            with self._lock:
+                self._tickno += 1
+                self.stats["ticks"] += 1
+                self.stats["spec_ticks"] += 1
+                nvalid = np.zeros(self.max_slots, np.int32)
+                for i, slot in enumerate(self._slots):
+                    if slot.req is None:
+                        continue
+                    # cap at the request's remaining budget: drafts past
+                    # it are discarded and would overstate the reported
+                    # acceptance rate
+                    rem = slot.req.max_new_tokens - len(slot.req.tokens)
+                    self.stats["spec_drafted"] += min(int(ndraft[i]), rem)
+                    self.stats["spec_accepted"] += min(int(acc[i]), rem)
+                    adv = int(acc[i]) + 1
+                    nvalid[i] = adv
+                    self._lengths[i] += adv
+                    for t in range(adv):
+                        if self._commit_token(i, int(out[i, t])):
+                            break  # freed; later accepted tokens discarded
+            if getattr(self._spec, "ingest_after_verify", True):
+                # self-ingesting drafters (ModelDrafter) already wrote
+                # these rows into their own cache during propose()
+                self._spec.ingest(toks, starts, nvalid)
+            return True
         if mode == "multi":
-            out = self._run_tick_multi(last_toks, starts)
+            out = self._run_tick_multi(last_toks, starts, sampling)
             with self._lock:
                 self._tickno += 1
                 self.stats["ticks"] += 1
@@ -634,8 +889,17 @@ class ServingEngine:
                     for t in range(M):
                         if self._commit_token(i, int(out[i, t])):
                             break  # freed; later window tokens discarded
+            if self._spec is not None:
+                # an all-sampling window can still precede a greedy
+                # request: mirror the M cache rows the window wrote so
+                # the drafter stays in sync for later spec ticks
+                M = self._decode_window
+                chunk = np.concatenate([last_toks[:, None], out[:, :M - 1]],
+                                       axis=1)
+                self._spec.ingest(chunk, starts,
+                                  np.where(active, M, 0).astype(np.int32))
             return True
-        nxt = self._run_tick(tokens, starts, nvalid)
+        nxt = self._run_tick(tokens, starts, nvalid, sampling)
         with self._lock:
             self._tickno += 1
             self.stats["ticks"] += 1
@@ -647,13 +911,19 @@ class ServingEngine:
                 self._lengths[i] += int(consumed[i])
                 if finishing[i]:
                     self._commit_token(i, int(nxt[i]))
+        if self._spec is not None:
+            # keep the drafter's mirror in sync with what the chunk tick
+            # wrote (prefill chunks and the 1-wide decode feeds alike)
+            self._spec.ingest(tokens, starts, consumed)
         return True
 
-    def _run_tick_multi(self, last_toks, starts):
+    def _run_tick_multi(self, last_toks, starts, sampling):
         import jax.numpy as jnp
-        self._caches, out = self._tick_multi(
+        vec, temps, topks, topps = sampling
+        self._caches, out = self._prog("_tick_multi", vec)(
             self._params, self._caches, jnp.asarray(last_toks),
-            jnp.asarray(starts), self._key,
+            jnp.asarray(starts), jnp.asarray(temps), jnp.asarray(topks),
+            jnp.asarray(topps), self._key,
             jnp.asarray(self._tickno, jnp.int32))
         return np.asarray(out)
 

@@ -358,8 +358,10 @@ class GPTForCausalLM(Layer):
         return logits if caches is None else (logits, caches)
 
     def generate(self, input_ids, max_new_tokens=32, temperature=1.0,
-                 top_k: Optional[int] = None, jit_decode: bool = True):
-        """Greedy / top-k sampling with a KV cache (incremental decode).
+                 top_k: Optional[int] = None, jit_decode: bool = True,
+                 top_p: Optional[float] = None, spec_k: int = 0,
+                 drafter=None):
+        """Greedy / top-k / nucleus sampling with a KV cache.
 
         ``jit_decode=True`` (default) preallocates a static
         (B, prompt+max_new, H, D) cache and compiles ONE fused program —
@@ -368,42 +370,117 @@ class GPTForCausalLM(Layer):
         and reused across calls (the TPU-idiomatic serving loop; the
         growing-concat path recompiles every step because each step's
         cache shape is new, and pays a host round trip per token).
+
+        ``spec_k > 0`` switches to speculative draft-and-verify decoding:
+        a drafter (``drafter='ngram'`` prompt-lookup by default, or a
+        small ``GPTForCausalLM``) proposes up to ``spec_k`` tokens per
+        step and ONE widened forward verifies all of them, committing the
+        longest prefix matching the target's greedy argmax — output is
+        token-for-token identical to the non-speculative greedy path.
+        Greedy only (``temperature`` must be 0.0).
         """
         from .. import ops as O
 
         self.eval()
+        if spec_k:
+            if temperature != 0.0:
+                raise ValueError(
+                    "spec_k requires temperature=0.0: speculative "
+                    "acceptance matches the target's greedy argmax, so "
+                    "only greedy decoding is exactly preserved")
+            if not jit_decode:
+                raise ValueError(
+                    "spec_k requires jit_decode=True: the draft-and-"
+                    "verify loop runs over the jitted static-cache "
+                    "programs (the eager concat path has no verify step)")
+            out = self._generate_spec(input_ids, max_new_tokens,
+                                      int(spec_k), drafter)
+            if out is not None:
+                return out
+            # pp mesh: no spec verify program — fall through to the
+            # pipelined decode (same greedy tokens, just unsped)
         if jit_decode:
             return self._generate_static(input_ids, max_new_tokens,
-                                         temperature, top_k)
+                                         temperature, top_k, top_p)
         logits, caches = self(input_ids,
                               caches=self.gpt.gen_empty_caches(
                                   input_ids.shape[0]))
         out_ids = input_ids
         for _ in range(max_new_tokens):
-            nxt = self._sample(logits._value[:, -1, :], temperature, top_k)
+            nxt = self._sample(logits._value[:, -1, :], temperature, top_k,
+                               top_p=top_p)
             nxt_t = Tensor(nxt.astype(out_ids._value.dtype))
             out_ids = O.concat([out_ids, nxt_t], axis=1)
             logits, caches = self(nxt_t, caches=caches)
         return out_ids
 
     @staticmethod
-    def _sample(last, temperature, top_k, key=None):
+    def _nucleus_mask(scaled, top_p):
+        """Mask logits outside the nucleus: keep the smallest set of
+        tokens whose probability mass reaches ``top_p`` (the top-1 token
+        is always kept).  ``top_p`` is a scalar or a broadcastable (B, 1)
+        per-row array."""
+        import jax
+        import jax.numpy as jnp
+        probs = jax.nn.softmax(scaled, axis=-1)
+        desc = -jnp.sort(-probs, axis=-1)
+        csum = jnp.cumsum(desc, axis=-1)
+        # token kept while the mass BEFORE it is still under p
+        keep = (csum - desc) < jnp.maximum(top_p, 1e-9)
+        kth = jnp.sum(keep, axis=-1, keepdims=True)  # >= 1 per row
+        minp = jnp.take_along_axis(desc, kth - 1, axis=-1)
+        return jnp.where(probs < minp, -1e30, scaled)
+
+    @staticmethod
+    def _sample(last, temperature, top_k, key=None, top_p=None):
         """Single owner of the sampling math (greedy / temperature /
-        top-k) for both decode paths.  ``key=None`` draws from the global
-        RNG (eager concat path); the jit path passes a traced key."""
+        top-k / nucleus top-p) for every decode path.  ``key=None`` draws
+        from the global RNG (eager concat path); the jit paths pass a
+        traced key.
+
+        Scalar mode (python-number ``temperature``): one config for the
+        whole batch — the historical behavior, bit-for-bit.  Vector mode
+        (array ``temperature``/``top_k``/``top_p`` of shape (B,)): each
+        row samples under its own config — the serving engine's
+        per-request sampling params; ``top_k=0`` / ``top_p=1.0`` disable
+        the respective filter for that row, ``temperature=0`` makes the
+        row greedy (identical argmax to the scalar greedy path: both
+        argmax the same f32 ``logits / 1e-6``)."""
         import jax
         import jax.numpy as jnp
 
         from ..core import random as core_random
-        last = last.astype(jnp.float32) / max(temperature, 1e-6)
+        last = last.astype(jnp.float32)
+        if isinstance(temperature, (int, float)):
+            last = last / max(temperature, 1e-6)
+            if top_k is not None:
+                cutoff = jax.lax.top_k(last, top_k)[0][:, -1:]
+                last = jnp.where(last < cutoff, -1e30, last)
+            if top_p is not None:
+                last = GPTForCausalLM._nucleus_mask(last, float(top_p))
+            if temperature == 0.0:
+                return jnp.argmax(last, axis=-1, keepdims=True)
+            if key is None:
+                key = core_random.split_key()
+            return jax.random.categorical(key, last)[:, None]
+        temperature = jnp.asarray(temperature, jnp.float32)
+        scaled = last / jnp.maximum(temperature, 1e-6)[:, None]
+        greedy = jnp.argmax(scaled, axis=-1, keepdims=True)
         if top_k is not None:
-            cutoff = jax.lax.top_k(last, top_k)[0][:, -1:]
-            last = jnp.where(last < cutoff, -1e30, last)
-        if temperature == 0.0:
-            return jnp.argmax(last, axis=-1, keepdims=True)
+            kk = jnp.asarray(top_k, jnp.int32)
+            vocab = scaled.shape[-1]
+            desc = -jnp.sort(-scaled, axis=-1)
+            cut = jnp.take_along_axis(
+                desc, jnp.clip(kk - 1, 0, vocab - 1)[:, None], axis=-1)
+            scaled = jnp.where((kk > 0)[:, None] & (scaled < cut),
+                               -1e30, scaled)
+        if top_p is not None:
+            scaled = GPTForCausalLM._nucleus_mask(
+                scaled, jnp.asarray(top_p, jnp.float32)[:, None])
         if key is None:
             key = core_random.split_key()
-        return jax.random.categorical(key, last)[:, None]
+        sampled = jax.random.categorical(key, scaled)[:, None]
+        return jnp.where((temperature == 0.0)[:, None], greedy, sampled)
 
     def _param_mesh(self):
         """The device mesh the model's parameters are placed on, or None.
@@ -424,7 +501,7 @@ class GPTForCausalLM(Layer):
         return None
 
     def _generate_static(self, input_ids, max_new_tokens, temperature,
-                         top_k):
+                         top_k, top_p=None):
         """One compiled program generates ALL tokens: prefill + a
         ``lax.fori_loop`` decode loop with in-jit sampling over a static
         KV cache.  No per-token host round trips — through the remote-chip
@@ -449,7 +526,8 @@ class GPTForCausalLM(Layer):
             pp_mesh = amb
         if pp_mesh is not None:
             return self._generate_static_pp(ids, max_new_tokens,
-                                            temperature, top_k, pp_mesh)
+                                            temperature, top_k, pp_mesh,
+                                            top_p)
         b, prompt = ids.shape
         cfg = self.config
         head_dim = cfg.hidden_size // cfg.num_heads
@@ -479,7 +557,7 @@ class GPTForCausalLM(Layer):
         # closure every call would recompile every call (jax's jit cache
         # keys on function identity)
         cache_key = (b, prompt, max_new_tokens, temperature == 0.0,
-                     float(temperature), top_k, str(dtype))
+                     float(temperature), top_k, top_p, str(dtype))
 
         def fwd(params, ids_in, caches, pos):
             return functional_call(
@@ -489,11 +567,11 @@ class GPTForCausalLM(Layer):
 
         return self._run_decode_program(
             cache_key, fwd, params, ids, caches, temperature, top_k,
-            b, prompt, max_new_tokens)
+            b, prompt, max_new_tokens, top_p=top_p)
 
     def _run_decode_program(self, cache_key, fwd, params, ids, caches,
                             temperature, top_k, b, prompt, max_new_tokens,
-                            mesh=None):
+                            mesh=None, top_p=None):
         """Build-or-reuse the jitted decode program and invoke it —
         scaffolding shared by the single/mp path and the pp path (only
         ``fwd(params, ids_in, caches, pos) -> (logits, caches)``
@@ -513,7 +591,8 @@ class GPTForCausalLM(Layer):
         gen_cache = self.__dict__.setdefault("_gen_program_cache", {})
         if cache_key not in gen_cache:
             def sample(last, key):
-                return self._sample(last, temperature, top_k, key=key)
+                return self._sample(last, temperature, top_k, key=key,
+                                    top_p=top_p)
 
             @jax.jit
             def run(params, ids, caches, key):
@@ -553,8 +632,159 @@ class GPTForCausalLM(Layer):
         with ctx:  # partial-manual shard_map (pp) needs the ambient mesh
             return Tensor(run(params, ids, caches, key))
 
+    def _generate_spec(self, input_ids, max_new_tokens, spec_k, drafter):
+        """Speculative draft-and-verify greedy decoding (single-request
+        path).  Two jitted programs — a prompt prefill and a (B, K+1)-wide
+        VERIFY step that scores every proposal position in one forward
+        over the static cache — plus a host loop that proposes drafts,
+        accepts the longest argmax-matching prefix, and commits
+        ``accepted+1`` tokens per round trip.  Rejected tails need no
+        cache rollback: attention reads only ``kpos <= qpos`` and the
+        next verify rewrites ``[length, length+K]``, so stale rows are
+        never attended (the serving engine's tick shares this invariant).
+
+        Output is bit-identical to ``_generate_static(temperature=0.0)``:
+        both commit ``argmax(logits/1e-6)`` given the same committed
+        prefix.  Returns None under a pp mesh (the caller falls back to
+        the pipelined non-spec program — same tokens, no speedup).
+
+        Acceptance counters land on ``self._last_spec_stats`` for the
+        bench rows ({"proposed", "accepted", "ticks"})."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from ..nn.decode import accept_lengths, get_drafter
+        from ..nn.layer import functional_call
+
+        ids = input_ids._value if isinstance(input_ids, Tensor) \
+            else jnp.asarray(input_ids)
+        if max_new_tokens <= 0:
+            return Tensor(ids)
+        from ..parallel.api import get_mesh as _get_mesh
+        amb = _get_mesh()
+        if amb is not None and amb.shape.get("pp", 1) > 1:
+            return None
+        b, prompt = ids.shape
+        cfg = self.config
+        K = int(spec_k)
+        head_dim = cfg.hidden_size // cfg.num_heads
+        # K extra rows: the last verify before a row finishes starts at
+        # length prompt+max_new-1 and writes K+1 wide
+        cache_len = prompt + max_new_tokens + K + 1
+        dtype = self.gpt.wte.weight._value.dtype
+        caches = [(jnp.zeros((b, cache_len, cfg.num_heads, head_dim), dtype),
+                   jnp.zeros((b, cache_len, cfg.num_heads, head_dim), dtype))
+                  for _ in range(cfg.num_layers)]
+        mesh = self._param_mesh()
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from ..parallel.api import (batch_spec, decode_cache_sharding,
+                                        token_batch_sharding)
+            cache_sh = decode_cache_sharding(mesh)
+            bspec = batch_spec(mesh)
+            bax = bspec[0] if len(bspec) else None
+            caches = [(jax.device_put(k, cache_sh),
+                       jax.device_put(v, cache_sh)) for k, v in caches]
+            ids = jax.device_put(ids, NamedSharding(mesh, P(bax, None)))
+            tok_sh = token_batch_sharding(mesh)
+        else:
+            tok_sh = None
+        params, buffers = self.functional_state()
+        cache_key = ("spec", b, prompt, max_new_tokens, K, str(dtype))
+        gen_cache = self.__dict__.setdefault("_gen_program_cache", {})
+        if cache_key not in gen_cache:
+            def prefill(params, ids_in, caches):
+                logits, caches = functional_call(
+                    self, params, (Tensor(ids_in),),
+                    kwargs={"caches": caches,
+                            "cache_pos": jnp.asarray(0, jnp.int32)},
+                    buffers=buffers, training=False)
+                nxt = self._sample(logits[:, -1, :], 0.0, None)
+                return caches, nxt[:, 0].astype(jnp.int32)
+
+            def verify(params, caches, toks, pos):
+                logits, caches = functional_call(
+                    self, params, (Tensor(toks),),
+                    kwargs={"caches": caches, "cache_pos": pos},
+                    buffers=buffers, training=False)
+                out = self._sample(
+                    logits.reshape(b * (K + 1), -1), 0.0, None)
+                return caches, out[:, 0].reshape(b, K + 1).astype(jnp.int32)
+
+            if len(gen_cache) >= 32:  # same FIFO bound as the fused loop
+                gen_cache.pop(next(iter(gen_cache)))
+            gen_cache[cache_key] = (jax.jit(prefill, donate_argnums=(2,)),
+                                    jax.jit(verify, donate_argnums=(1,)))
+        run_prefill, run_verify = gen_cache[cache_key]
+
+        # resolve-once per (drafter, K): a ModelDrafter's jitted
+        # ingest/propose programs live on the instance, so rebuilding it
+        # every generate() would re-trace the draft model per call.  The
+        # entry keeps a strong ref to the user's argument, so the id()
+        # key cannot alias a recycled object.
+        dcache = self.__dict__.setdefault("_spec_drafter_cache", {})
+        entry = dcache.get((id(drafter), K))
+        if entry is None or entry[0] is not drafter:
+            if len(dcache) >= 8:
+                dcache.pop(next(iter(dcache)))
+            entry = (drafter, get_drafter(drafter, K))
+            dcache[(id(drafter), K)] = entry
+        dr = entry[1]
+        dr.begin(b, cache_len)
+        np_ids = np.asarray(ids, np.int32)
+        dr.ingest(np_ids, np.zeros(b, np.int32),
+                  np.full(b, prompt, np.int32))
+        caches, tok0 = run_prefill(params, ids, caches)
+        tok0 = np.asarray(tok0)
+        out = np.zeros((b, max_new_tokens), np.int32)
+        out[:, 0] = tok0
+        ngen = np.ones(b, np.int64)
+        lengths = np.full(b, prompt, np.int32)  # committed cache rows
+        last = tok0.copy()
+        stats = {"proposed": 0, "accepted": 0, "ticks": 0}
+        while (ngen < max_new_tokens).any():
+            drafts, ndraft = dr.propose(last, lengths)
+            ndraft = np.where(ngen >= max_new_tokens, 0, ndraft)
+            toks = np.concatenate([last[:, None], drafts], axis=1)
+            toks_j = jnp.asarray(toks)
+            pos_j = jnp.asarray(lengths)
+            if tok_sh is not None:
+                toks_j = jax.device_put(toks_j, tok_sh)
+                pos_j = jax.device_put(pos_j, tok_sh)
+            caches, ver = run_verify(params, caches, toks_j, pos_j)
+            ver = np.asarray(ver)
+            acc = accept_lengths(drafts, ndraft, ver)
+            stats["ticks"] += 1
+            ingest_nvalid = np.zeros(b, np.int32)
+            old_lengths = lengths.copy()
+            for i in range(b):
+                if ngen[i] >= max_new_tokens:
+                    continue  # frozen: re-verifies in place, commits nothing
+                rem = max_new_tokens - int(ngen[i])
+                # cap at the row's remaining budget: drafts past it are
+                # discarded, and counting them would overstate the
+                # acceptance rate the bench rows report
+                stats["proposed"] += min(int(ndraft[i]), rem)
+                stats["accepted"] += min(int(acc[i]), rem)
+                take = min(int(acc[i]) + 1, rem)
+                out[i, ngen[i]:ngen[i] + take] = ver[i, :take]
+                ngen[i] += take
+                if ngen[i] < max_new_tokens:
+                    ingest_nvalid[i] = int(acc[i]) + 1
+                    lengths[i] += int(acc[i]) + 1
+                    last[i] = ver[i, int(acc[i])]
+            if getattr(dr, "ingest_after_verify", True):
+                # self-ingesting drafters already wrote these rows in
+                # propose(); replaying them would recompute identical KV
+                dr.ingest(toks, old_lengths, ingest_nvalid)
+        self._last_spec_stats = stats
+        return Tensor(jnp.concatenate(
+            [ids, jnp.asarray(out).astype(ids.dtype)], axis=1))
+
     def _generate_static_pp(self, ids, max_new_tokens, temperature, top_k,
-                            mesh):
+                            mesh, top_p=None):
         """Pipeline-sharded one-program decode: block params stacked over
         layers and sharded on 'pp'; each token crosses the stages via
         ``pipeline_decode_apply`` (masked sequential schedule), with the
@@ -644,10 +874,10 @@ class GPTForCausalLM(Layer):
 
         cache_key = ("pp", tuple(sorted(mesh.shape.items())), b, prompt,
                      max_new_tokens, temperature == 0.0,
-                     float(temperature), top_k, str(dtype))
+                     float(temperature), top_k, top_p, str(dtype))
         return self._run_decode_program(
             cache_key, fwd, (other, stacked), ids, caches, temperature,
-            top_k, b, prompt, max_new_tokens, mesh=mesh)
+            top_k, b, prompt, max_new_tokens, mesh=mesh, top_p=top_p)
 
     def enable_sequence_parallel(self, axis: str = "sp", mesh=None,
                                  mode: str = "auto"):

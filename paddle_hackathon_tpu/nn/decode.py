@@ -123,6 +123,239 @@ def dynamic_decode(decoder, inits=None, max_step_num=64, output_time_major=False
     return out, log_probs
 
 
+# ---------------------------------------------------------------------------
+# Speculative-decoding drafters (Leviathan et al. 2023; prompt-lookup /
+# n-gram self-drafting per Saxena 2023).
+#
+# A drafter proposes up to ``k`` continuation tokens per stream; the target
+# model scores all proposals plus one bonus position in ONE widened forward
+# (the serving engine's verify tick / ``GPTForCausalLM.generate(spec_k=...)``)
+# and commits the longest prefix matching its own greedy argmax — so under
+# greedy sampling the output is token-for-token identical to non-speculative
+# decoding, whatever the drafter proposes.  Drafter quality only moves the
+# acceptance rate (speed), never correctness.
+#
+# Both drafters speak one slot-batched interface so the engine and the
+# single-request generate() drive them identically:
+#
+#   begin(batch, cache_len)          allocate per-stream state
+#   ingest(tokens, starts, nvalid)   committed token chunk per stream —
+#                                    exactly what the target tick wrote to
+#                                    its KV cache (prefill chunks and
+#                                    accepted verify chunks alike)
+#   propose(last, starts)            -> (drafts (B, k) int32, ndraft (B,))
+#
+# ``starts`` is each stream's committed length (the cache write offset);
+# ``last`` is the pending sampled token not yet written.  Stale draft-cache
+# rows past a stream's committed length are never read (attention masks
+# kpos <= qpos and every program rewrites [starts, starts+width)), so
+# rejected proposals need no rollback on either side.
+# ---------------------------------------------------------------------------
+
+
+def accept_lengths(drafts, ndraft, verified):
+    """Per-stream count of leading draft tokens the verify pass accepted.
+
+    ``drafts`` (B, K) proposals, ``ndraft`` (B,) valid proposal counts,
+    ``verified`` (B, >=K) the target's greedy tokens at each position.
+    Row i accepts ``a`` = the longest prefix with
+    ``drafts[i, t] == verified[i, t]`` for all ``t < a <= ndraft[i]``;
+    the caller then commits ``verified[i, :a+1]`` (accepted + bonus)."""
+    drafts = np.asarray(drafts)
+    B, K = drafts.shape
+    if K == 0:
+        return np.zeros(B, np.int32)
+    ok = (np.arange(K)[None, :] < np.asarray(ndraft)[:, None]) \
+        & (drafts == np.asarray(verified)[:, :K])
+    return np.cumprod(ok, axis=1).sum(axis=1).astype(np.int32)
+
+
+class NGramDrafter:
+    """Model-free prompt-lookup drafter: propose the continuation of the
+    most recent earlier occurrence of the stream's current suffix n-gram
+    (falling from ``max_ngram`` down to ``min_ngram``).  Zero device work;
+    pays off whenever generation revisits its own history (code, prose,
+    the repetition attractors of greedy decoding)."""
+
+    # propose() writes nothing: the engine must replay committed verify
+    # chunks into ingest() (see ingest_after_verify contract below)
+    ingest_after_verify = True
+
+    def __init__(self, k=4, max_ngram=3, min_ngram=1):
+        self.k = int(k)
+        self.max_ngram = int(max_ngram)
+        self.min_ngram = max(1, int(min_ngram))
+        self._hist = None
+
+    def begin(self, batch, cache_len):
+        self._hist = np.zeros((int(batch), int(cache_len)), np.int32)
+
+    def ingest(self, tokens, starts, nvalid):
+        # the committed length itself is not tracked here: propose()'s
+        # ``starts`` is the source of truth (slot reuse resets it to 0)
+        tokens = np.asarray(tokens, np.int32)
+        for i in range(tokens.shape[0]):
+            s, n = int(starts[i]), int(nvalid[i])
+            if n > 0:
+                self._hist[i, s:s + n] = tokens[i, :n]
+
+    def _lookup(self, seq):
+        L = len(seq)
+        for n in range(min(self.max_ngram, L - 1), self.min_ngram - 1, -1):
+            pat = seq[L - n:]
+            win = np.lib.stride_tricks.sliding_window_view(seq, n)
+            hits = np.nonzero((win[:L - n] == pat).all(axis=1))[0]
+            if hits.size:
+                j = int(hits[-1])  # most recent occurrence wins
+                cont = seq[j + n:j + n + self.k]
+                if cont.size:
+                    return cont
+        return np.zeros(0, np.int32)
+
+    def propose(self, last, starts):
+        B = len(last)
+        drafts = np.zeros((B, self.k), np.int32)
+        ndraft = np.zeros(B, np.int32)
+        for i in range(B):
+            seq = np.append(self._hist[i, :int(starts[i])],
+                            np.int32(last[i]))
+            cont = self._lookup(seq)
+            ndraft[i] = len(cont)
+            drafts[i, :len(cont)] = cont
+        return drafts, ndraft
+
+
+class ModelDrafter:
+    """Draft proposals from a small ``GPTForCausalLM``: the classic
+    two-model speculative setup.  Keeps its own slot-batched static KV
+    cache mirroring the target's length accounting; ``ingest`` replays
+    committed chunks through the draft backbone (prefill chunks and
+    decode-window tokens the drafter never saw), ``propose`` runs ``k``
+    greedy steps in one jitted ``fori_loop`` program — ``k+1`` feeds, so
+    its own cache writes at ``[starts, starts+k]`` already hold every
+    token any acceptance outcome can commit (``[last, p_0..p_{a-1}]`` for
+    a <= k).  ``ingest_after_verify = False`` therefore lets callers skip
+    the post-verify replay: re-running it would recompute identical KV.
+    Rejected-tail rows are scratch — the next program rewrites them
+    before any query can attend (kpos <= qpos masking)."""
+
+    ingest_after_verify = False
+
+    def __init__(self, model, k=4):
+        model.eval()
+        self.model = model
+        self.k = int(k)
+        self._caches = None
+        self._fns = None
+
+    def _programs(self):
+        if self._fns is not None:
+            return self._fns
+        import jax
+        import jax.numpy as jnp
+
+        from ..core.tensor import Tensor
+        from .layer import functional_call
+
+        model = self.model
+        _, bufs = model.functional_state()
+        gpt_bufs = {k[len("gpt."):]: v for k, v in bufs.items()
+                    if k.startswith("gpt.")}
+        K = self.k
+
+        def ingest(params, caches, tokens, starts):
+            _, caches = functional_call(
+                model.gpt, params, (Tensor(tokens),),
+                kwargs={"caches": caches, "cache_pos": starts},
+                buffers=gpt_bufs, training=False)
+            return caches
+
+        def propose(params, caches, last, starts):
+            outbuf = jnp.zeros((last.shape[0], K + 1), jnp.int32)
+
+            def body(t, carry):
+                caches, cur, outbuf = carry
+                hidden, caches = functional_call(
+                    model.gpt, params, (Tensor(cur[:, None]),),
+                    kwargs={"caches": caches,
+                            "cache_pos": starts + t.astype(jnp.int32)},
+                    buffers=gpt_bufs, training=False)
+                logits = hidden[:, 0] @ params["wte.weight"].T
+                nxt = jnp.argmax(logits.astype(jnp.float32),
+                                 axis=-1).astype(jnp.int32)
+                outbuf = jax.lax.dynamic_update_slice(
+                    outbuf, nxt[:, None],
+                    (jnp.zeros((), jnp.int32), t.astype(jnp.int32)))
+                return caches, nxt, outbuf
+
+            # K+1 feeds: the last one writes p_{K-1}'s KV row so a
+            # fully-accepted verify needs no replay (its proposal output
+            # is discarded)
+            caches, _, outbuf = jax.lax.fori_loop(
+                0, K + 1, body, (caches, last, outbuf))
+            return caches, outbuf[:, :K]
+
+        self._fns = {
+            "ingest": jax.jit(ingest, donate_argnums=(1,)),
+            "propose": jax.jit(propose, donate_argnums=(1,)),
+        }
+        return self._fns
+
+    def _gpt_params(self):
+        """Read the draft model's CURRENT param payloads each call (a
+        handful of dict entries) — baking them into the programs would
+        silently pin the weights the drafter was first used with."""
+        params, _ = self.model.functional_state()
+        return {k[len("gpt."):]: v for k, v in params.items()
+                if k.startswith("gpt.")}
+
+    def begin(self, batch, cache_len):
+        import jax.numpy as jnp
+        cfg = self.model.config
+        head_dim = cfg.hidden_size // cfg.num_heads
+        dtype = self.model.gpt.wte.weight._value.dtype
+        shape = (int(batch), int(cache_len), cfg.num_heads, head_dim)
+        self._caches = [(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
+                        for _ in range(cfg.num_layers)]
+
+    def ingest(self, tokens, starts, nvalid=None):
+        # nvalid is unused on-device: rows past it are garbage the draft
+        # attention can never read (see class docstring)
+        import jax.numpy as jnp
+        fns = self._programs()
+        self._caches = fns["ingest"](
+            self._gpt_params(), self._caches,
+            jnp.asarray(np.asarray(tokens, np.int32)),
+            jnp.asarray(np.asarray(starts, np.int32)))
+
+    def propose(self, last, starts):
+        import jax.numpy as jnp
+        fns = self._programs()
+        self._caches, drafts = fns["propose"](
+            self._gpt_params(), self._caches,
+            jnp.asarray(np.asarray(last, np.int32)),
+            jnp.asarray(np.asarray(starts, np.int32)))
+        drafts = np.asarray(drafts)
+        return drafts, np.full(drafts.shape[0], self.k, np.int32)
+
+
+def get_drafter(spec, k):
+    """Resolve a drafter argument: ``None``/'ngram' -> :class:`NGramDrafter`,
+    a ``GPTForCausalLM``-shaped model -> :class:`ModelDrafter`, an object
+    already speaking the drafter interface -> itself."""
+    if spec is None or spec == "ngram":
+        return NGramDrafter(k=k)
+    if hasattr(spec, "propose") and hasattr(spec, "begin"):
+        if getattr(spec, "k", k) != k:
+            raise ValueError(
+                f"drafter proposes k={spec.k} tokens but spec_k={k}")
+        return spec
+    if hasattr(spec, "gpt") and hasattr(spec, "config"):
+        return ModelDrafter(spec, k=k)
+    raise TypeError(f"cannot build a drafter from {type(spec).__name__}; "
+                    "pass 'ngram', a GPTForCausalLM, or a drafter object")
+
+
 def _tree_map(fn, tree):
     if isinstance(tree, (list, tuple)):
         return type(tree)(_tree_map(fn, t) for t in tree)

@@ -136,6 +136,18 @@ def decode_cache_sharding(mesh: Mesh):
     return NamedSharding(mesh, P(bax, None, hax, None))
 
 
+def token_batch_sharding(mesh: Mesh):
+    """NamedSharding for host-staged per-slot serving inputs — the
+    (B, K+1) speculative verify token block and the (B,) start/length
+    vectors: batch over the data axes, trailing dims replicated.  Shares
+    :func:`decode_cache_sharding`'s batch layout so the widened verify
+    program's per-slot cache writes need no GSPMD reshard between the
+    token gather and the KV dynamic_update_slice."""
+    bspec = batch_spec(mesh)
+    bax = bspec[0] if len(bspec) else None
+    return NamedSharding(mesh, P(bax))
+
+
 def _collect_moe_aux(model):
     """Sum of the trace-fresh MoE load-balance aux values left on
     MoELayer instances by the forward just run (None when no MoE)."""

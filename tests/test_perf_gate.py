@@ -92,3 +92,25 @@ def test_ratio_gate_flags_slow_fit_path():
 def test_suite_has_hapi_fit_row():
     import bench
     assert "hapi_fit" in bench.SUITE
+
+
+def test_suite_has_spec_rows():
+    import bench
+    assert "serving_spec" in bench.SUITE
+    assert "decode_spec" in bench.SUITE
+
+
+def test_ratio_gate_holds_spec_serving_to_nonspec():
+    """serving_spec is gated >= 1.0x the SAME-RUN serving row: exact
+    greedy equivalence means speculation may never lose throughput."""
+    rows = [{"metric": "gpt2_serving_8stream_device_tokens_per_sec_per_chip",
+             "value": 10000.0},
+            {"metric":
+             "gpt2_serving_spec_8stream_device_tokens_per_sec_per_chip",
+             "value": 9500.0}]
+    bad = perf_gate.compare_ratios(rows)
+    assert len(bad) == 1 and bad[0][0].startswith("gpt2_serving_spec")
+    rows[1]["value"] = 10000.0  # exactly 1.0x passes
+    assert perf_gate.compare_ratios(rows) == []
+    rows[1]["value"] = 14000.0
+    assert perf_gate.compare_ratios(rows) == []

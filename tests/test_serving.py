@@ -141,6 +141,52 @@ def test_eos_early_stop():
     eng.shutdown()
 
 
+def _truncate_at_eos(gen, eos):
+    """Expected engine stream: generated tokens up to and INCLUDING the
+    first eos occurrence."""
+    gen = list(int(t) for t in gen)
+    return gen[:gen.index(eos) + 1] if eos in gen else gen
+
+
+def test_eos_mid_stream_truncates_and_frees_slot_for_pending():
+    """A slot hitting EOS mid-stream frees immediately: its tokens
+    truncate AT the eos, and with max_slots=1 the queued second request
+    can only complete by reusing the freed slot."""
+    m = _model()
+    p0, p1 = _prompts(2)
+    ref0, ref1 = _ref(m, p0, n=10), _ref(m, p1, n=10)
+    eos = int(ref0[len(p0) + 2])  # third generated token of stream 0
+    want0 = _truncate_at_eos(ref0[len(p0):], eos)
+    want1 = _truncate_at_eos(ref1[len(p1):], eos)
+    eng = ServingEngine(m, max_slots=1, max_len=64, chunk=4,
+                        eos_token_id=eos, auto_run=False)
+    r0 = eng.submit(p0, 10)
+    r1 = eng.submit(p1, 10)  # pending until r0's slot frees
+    eng.run_until_idle()
+    assert r0.done and r1.done
+    assert r0.tokens == want0 and len(r0.tokens) < 10  # truncated early
+    assert r1.tokens == want1
+    assert eng.stats["requests"] == 2
+
+
+def test_eos_mid_stream_spec_tick_truncates():
+    """Same contract through the speculative verify tick: an EOS inside
+    an accepted run of tokens truncates the commit there."""
+    m = _model()
+    p0, p1 = _prompts(2)
+    ref0, ref1 = _ref(m, p0, n=10), _ref(m, p1, n=10)
+    eos = int(ref0[len(p0) + 2])
+    want0 = _truncate_at_eos(ref0[len(p0):], eos)
+    want1 = _truncate_at_eos(ref1[len(p1):], eos)
+    eng = ServingEngine(m, max_slots=1, max_len=64, chunk=4,
+                        eos_token_id=eos, auto_run=False, spec_k=4)
+    r0 = eng.submit(p0, 10)
+    r1 = eng.submit(p1, 10)
+    eng.run_until_idle()
+    assert r0.done and r1.done
+    assert r0.tokens == want0 and r1.tokens == want1
+
+
 def test_aggregate_throughput_scales_with_streams():
     """K concurrent streams finish in ~the tick count of ONE stream
     (slots advance in the same tick), i.e. aggregate tokens/tick ~ K x
@@ -252,6 +298,31 @@ class TestPipelineInterleaved:
             assert all(r.done for r in reqs)
             rate2 = eng2.stats["tokens"] / eng2.stats["ticks"]
             assert rate2 > 1.5 * rate1, (rate2, rate1)
+        finally:
+            parallel.set_mesh(None)
+
+    def test_pp2_eos_mid_stream_frees_and_reuses_slot(self):
+        """EOS on the pp path: the wave's exit commit truncates at eos,
+        frees the slot, and a pending request admits into it."""
+        m = _model(num_layers=4)
+        prompts = _prompts(3)
+        refs = [_ref(m, p) for p in prompts]
+        eos = int(refs[0][len(prompts[0]) + 2])
+
+        def want(i):
+            return _truncate_at_eos(refs[i][len(prompts[i]):], eos)
+
+        parallel.create_mesh({"pp": 2}, devices=jax.devices()[:2])
+        try:
+            eng = ServingEngine(m, max_slots=2, max_len=64, chunk=4,
+                                eos_token_id=eos, auto_run=False)
+            reqs = [eng.submit(p, 8) for p in prompts]  # 3rd queues
+            eng.run_until_idle()
+            assert all(r.done for r in reqs)
+            assert reqs[0].tokens == want(0) and len(reqs[0].tokens) < 8
+            for i in (1, 2):
+                assert reqs[i].tokens == want(i)
+            assert eng.stats["requests"] == 3
         finally:
             parallel.set_mesh(None)
 

@@ -1,0 +1,280 @@
+"""Speculative decoding (PR 3 tentpole): draft-and-verify multi-token
+ticks with EXACT greedy equivalence — for both drafters (model-free
+n-gram prompt-lookup and a small draft model), on both the serving
+engine's fused verify tick and ``GPT.generate(spec_k=...)``'s host loop.
+
+The acceptance rule commits only prefixes matching the target's own
+greedy argmax, so speculative output must be token-for-token identical
+to the non-speculative baseline; drafter quality moves throughput, never
+correctness.  Also covers the per-request sampling params satellite
+(temperature/top_k/top_p overrides per submit()) and the widened-write
+capacity guard."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_hackathon_tpu as paddle
+from paddle_hackathon_tpu.core.tensor import Tensor
+from paddle_hackathon_tpu.inference import ServingEngine
+from paddle_hackathon_tpu.models.gpt import GPTConfig, GPTForCausalLM
+from paddle_hackathon_tpu.nn.decode import (ModelDrafter, NGramDrafter,
+                                            accept_lengths, get_drafter)
+
+
+def _cfg(num_layers=2):
+    return GPTConfig(vocab_size=128, hidden_size=64, num_layers=num_layers,
+                     num_heads=4, max_position_embeddings=128,
+                     hidden_dropout_prob=0.0, attention_dropout_prob=0.0,
+                     use_flash_attention=False)
+
+
+def _model(seed=3, num_layers=2):
+    paddle.seed(seed)
+    m = GPTForCausalLM(_cfg(num_layers))
+    m.eval()
+    return m
+
+
+def _ref(model, prompt, n=8):
+    ids = jnp.asarray(np.asarray(prompt, np.int32)[None, :])
+    return np.asarray(model.generate(
+        Tensor(ids), max_new_tokens=n, temperature=0.0).numpy())[0]
+
+
+def _prompts(k, lens=(6, 11, 5, 9)):
+    rs = np.random.RandomState(5)
+    return [rs.randint(0, 128, (lens[i % len(lens)],)).astype(np.int32)
+            for i in range(k)]
+
+
+# ---------------------------------------------------------------- units
+
+def test_accept_lengths():
+    drafts = np.array([[7, 8, 9], [7, 8, 9], [7, 8, 9], [1, 2, 3]])
+    ndraft = np.array([3, 3, 2, 0])
+    verified = np.array([[7, 8, 9, 4],   # all accepted
+                         [7, 5, 9, 4],   # mismatch at 1
+                         [7, 8, 9, 4],   # capped by ndraft
+                         [7, 8, 9, 4]])  # no drafts
+    np.testing.assert_array_equal(
+        accept_lengths(drafts, ndraft, verified), [3, 1, 2, 0])
+    # k=0 drafts degenerate cleanly
+    np.testing.assert_array_equal(
+        accept_lengths(np.zeros((2, 0), np.int32), np.zeros(2, np.int32),
+                       verified[:2]), [0, 0])
+
+
+def test_ngram_drafter_lookup():
+    dr = NGramDrafter(k=3, max_ngram=3)
+    dr.begin(2, 32)
+    # row 0: repeating pattern — suffix (5, 6) last seen at 1 with
+    # continuation (7, 8, 5); row 1: no repetition at all
+    hist = np.array([[4, 5, 6, 7, 8, 5, 0, 0],
+                     [1, 2, 3, 4, 5, 6, 7, 8]], np.int32)
+    dr.ingest(hist, np.zeros(2, np.int32), np.array([6, 8], np.int32))
+    drafts, ndraft = dr.propose(np.array([6, 9], np.int32),
+                                np.array([6, 8], np.int32))
+    assert ndraft[0] == 3
+    np.testing.assert_array_equal(drafts[0], [7, 8, 5])
+    assert ndraft[1] == 0
+    # slot reuse: propose()'s starts is the committed-length truth — a
+    # re-admitted slot proposing at starts=2 sees only the new prefix
+    dr.ingest(np.array([[9, 9]] * 2, np.int32), np.zeros(2, np.int32),
+              np.array([2, 2], np.int32))
+    drafts, ndraft = dr.propose(np.array([9, 9], np.int32),
+                                np.array([2, 2], np.int32))
+    np.testing.assert_array_equal(ndraft, [1, 1])  # suffix [9] seen at 0/1
+    assert drafts[0, 0] == 9 and drafts[1, 0] == 9
+
+
+def test_get_drafter_resolution():
+    assert isinstance(get_drafter(None, 4), NGramDrafter)
+    assert isinstance(get_drafter("ngram", 4), NGramDrafter)
+    m = _model(seed=11, num_layers=1)
+    assert isinstance(get_drafter(m, 4), ModelDrafter)
+    dr = NGramDrafter(k=4)
+    assert get_drafter(dr, 4) is dr
+    with pytest.raises(ValueError, match="spec_k"):
+        get_drafter(NGramDrafter(k=2), 4)
+    with pytest.raises(TypeError):
+        get_drafter(123, 4)
+
+
+def test_sample_top_p_and_vector_mode():
+    """Nucleus top-p lives in the single _sample owner: a tiny top_p
+    keeps only the argmax token, so sampling at any temperature becomes
+    deterministic — asserted for both the scalar and the per-row vector
+    mode (and greedy rows of the vector mode match the scalar argmax)."""
+    import jax
+    rs = np.random.RandomState(0)
+    logits = jnp.asarray(rs.randn(4, 32).astype(np.float32))
+    argmax = np.asarray(jnp.argmax(logits, -1))
+    key = jax.random.key(0)
+    scal = GPTForCausalLM._sample(logits, 0.7, None, key=key, top_p=1e-9)
+    np.testing.assert_array_equal(np.asarray(scal)[:, 0], argmax)
+    vec = GPTForCausalLM._sample(
+        logits, jnp.asarray([0.0, 0.9, 0.0, 1.3]),
+        jnp.asarray([0, 5, 0, 0]), key=key,
+        top_p=jnp.asarray([1.0, 1e-9, 1e-9, 1e-9]))
+    np.testing.assert_array_equal(np.asarray(vec)[:, 0], argmax)
+
+
+# ------------------------------------------------- generate(spec_k=...)
+
+def test_generate_spec_ngram_matches_greedy():
+    m = _model()
+    for p in _prompts(2):  # mixed prompt lengths
+        ref = _ref(m, p, n=10)
+        out = np.asarray(m.generate(
+            Tensor(jnp.asarray(p[None])), max_new_tokens=10,
+            temperature=0.0, spec_k=4).numpy())[0]
+        np.testing.assert_array_equal(out, ref)
+    st = m._last_spec_stats
+    assert st["ticks"] >= 1 and 0 <= st["accepted"] <= st["proposed"]
+
+
+def test_generate_spec_model_drafter_matches_greedy():
+    m = _model()
+    draft = _model(seed=11, num_layers=1)
+    (p,) = _prompts(1)
+    ref = _ref(m, p, n=10)
+    out = np.asarray(m.generate(
+        Tensor(jnp.asarray(p[None])), max_new_tokens=10,
+        temperature=0.0, spec_k=3, drafter=draft).numpy())[0]
+    np.testing.assert_array_equal(out, ref)
+
+
+def test_generate_spec_batched():
+    m = _model()
+    (p,) = _prompts(1)
+    ids = Tensor(jnp.asarray(np.stack([p, p[::-1].copy()])))
+    ref = np.asarray(m.generate(ids, max_new_tokens=10,
+                                temperature=0.0).numpy())
+    out = np.asarray(m.generate(ids, max_new_tokens=10, temperature=0.0,
+                                spec_k=4).numpy())
+    np.testing.assert_array_equal(out, ref)
+
+
+def test_generate_spec_requires_greedy():
+    m = _model()
+    (p,) = _prompts(1)
+    with pytest.raises(ValueError, match="temperature=0.0"):
+        m.generate(Tensor(jnp.asarray(p[None])), max_new_tokens=4,
+                   temperature=0.8, spec_k=2)
+    with pytest.raises(ValueError, match="jit_decode"):
+        m.generate(Tensor(jnp.asarray(p[None])), max_new_tokens=4,
+                   temperature=0.0, spec_k=2, jit_decode=False)
+
+
+# ----------------------------------------------------- engine verify tick
+
+@pytest.mark.parametrize("drafter", ["ngram", "model"])
+def test_engine_spec_matches_nonspec(drafter):
+    m = _model()
+    prompts = _prompts(3)
+    refs = [_ref(m, p, n=10) for p in prompts]
+    dr = "ngram" if drafter == "ngram" else _model(seed=11, num_layers=1)
+    eng = ServingEngine(m, max_slots=4, max_len=64, chunk=4,
+                        auto_run=False, spec_k=4, drafter=dr)
+    reqs = [eng.submit(p, 10) for p in prompts]
+    eng.run_until_idle()
+    for req, ref in zip(reqs, refs):
+        assert req.done
+        np.testing.assert_array_equal(req.result(), ref)
+    assert eng.stats["spec_ticks"] >= 1
+    assert 0 <= eng.stats["spec_accepted"] <= eng.stats["spec_drafted"]
+
+
+def test_engine_spec_acceptance_on_repetitive_stream():
+    """A repetitive prompt is the n-gram drafter's home turf: acceptance
+    must actually engage (the exactness tests alone would pass with a
+    drafter that never proposes)."""
+    m = _model()
+    p = np.tile(np.array([9, 7, 5], np.int32), 6)  # strongly periodic
+    ref = _ref(m, p, n=12)
+    eng = ServingEngine(m, max_slots=2, max_len=96, chunk=4,
+                        auto_run=False, spec_k=4)
+    req = eng.submit(p, 12)
+    eng.run_until_idle()
+    np.testing.assert_array_equal(req.result(), ref)
+    assert eng.stats["spec_accepted"] > 0
+    # the decode phase averaged > 1 token/tick: the prefill's finishing
+    # tick commits 1 of the 12 tokens, the spec ticks the other 11
+    assert eng.stats["spec_ticks"] < eng.stats["tokens"] - 1
+
+
+def test_engine_spec_with_mixed_sampling_slots():
+    """A temperature>0 request (per-request override) shares the engine
+    with greedy streams: it drafts 0 and samples exactly, while the
+    greedy neighbors keep byte-identical speculative output."""
+    m = _model()
+    p_greedy, p_sampled = _prompts(2)
+    ref = _ref(m, p_greedy, n=10)
+    eng = ServingEngine(m, max_slots=2, max_len=64, chunk=4,
+                        auto_run=False, spec_k=4)
+    r0 = eng.submit(p_greedy, 10)
+    r1 = eng.submit(p_sampled, 10, temperature=0.9, top_k=20)
+    eng.run_until_idle()
+    np.testing.assert_array_equal(r0.result(), ref)
+    out1 = r1.result()
+    assert out1.shape == (len(p_sampled) + 10,)
+    assert ((out1 >= 0) & (out1 < 128)).all()
+
+
+def test_engine_spec_all_sampling_falls_back_to_multi_window():
+    """When no active slot is greedy, speculating would commit 1
+    token/slot per K+1-wide tick where the fused window commits M — the
+    engine must take the multi path; and when a greedy request later
+    joins, spec engages with the drafter still in sync (the window's
+    cache writes are mirrored into it) and stays byte-exact."""
+    m = _model()
+    p_greedy, p_sampled = _prompts(2)
+    ref = _ref(m, p_greedy, n=10)
+    eng = ServingEngine(m, max_slots=2, max_len=64, chunk=4,
+                        temperature=0.8, spec_k=4, decode_window=4,
+                        auto_run=False)
+    r_s = eng.submit(p_sampled, 6)          # all-sampling phase
+    for _ in range(4):
+        eng.step()
+    assert eng.stats["spec_ticks"] == 0     # multi window, not spec
+    r_g = eng.submit(p_greedy, 10, temperature=0.0)
+    eng.run_until_idle()
+    assert r_s.done and r_g.done
+    np.testing.assert_array_equal(r_g.result(), ref)
+    assert eng.stats["spec_ticks"] > 0      # spec engaged once greedy joined
+
+
+# ------------------------------------------- per-request sampling params
+
+def test_per_request_overrides():
+    """submit()-level temperature/top_k/top_p beat the engine defaults:
+    a greedy override inside a sampling engine reproduces the greedy
+    baseline token-for-token, and vice versa a sampled override inside a
+    greedy engine stays in-vocab and completes."""
+    m = _model()
+    p0, p1 = _prompts(2)
+    ref = _ref(m, p0, n=8)
+    eng = ServingEngine(m, max_slots=2, max_len=64, chunk=4,
+                        temperature=0.9, top_k=20, auto_run=False)
+    r0 = eng.submit(p0, 8, temperature=0.0)
+    r1 = eng.submit(p1, 8, top_p=0.8)
+    eng.run_until_idle()
+    np.testing.assert_array_equal(r0.result(), ref)
+    out1 = r1.result()
+    assert ((out1 >= 0) & (out1 < 128)).all()
+
+
+def test_submit_capacity_guard_covers_spec_headroom():
+    """The widened verify write needs spec_k+1 rows of headroom — the
+    capacity check must use max(chunk, spec_k+1), not chunk alone."""
+    m = _model()
+    eng = ServingEngine(m, max_slots=2, max_len=32, chunk=4,
+                        auto_run=False, spec_k=7)
+    with pytest.raises(ValueError, match="cache rows"):
+        # fits max_len-chunk=28 but NOT max_len-(spec_k+1)=24
+        eng.submit(np.arange(10, dtype=np.int32), max_new_tokens=16)
+    # within the spec-aware bound: accepted and completes
+    req = eng.submit(np.arange(10, dtype=np.int32), max_new_tokens=14)
+    eng.run_until_idle()
+    assert req.done and len(req.tokens) == 14

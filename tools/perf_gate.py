@@ -127,10 +127,15 @@ def compare_suite(baseline, rows, tolerance):
 # baseline comparison these need no committed number, so a NEW metric is
 # gated from its first suite run.  hapi_fit is the compiled Model.fit
 # path; it must stay within 10% of the hand-rolled jitted step it wraps
-# (the acceptance bar for the fit fast path).
+# (the acceptance bar for the fit fast path).  serving_spec is the
+# speculative draft-and-verify tick over the identical serving workload:
+# exact greedy equivalence means speculation must never LOSE throughput,
+# so the bar is >= 1.0x the same-run non-speculative row.
 RATIO_GATES = [
     ("hapi_fit_tokens_per_sec",
      "gpt2_small_pretrain_tokens_per_sec_per_chip", 0.90),
+    ("gpt2_serving_spec_8stream_device_tokens_per_sec_per_chip",
+     "gpt2_serving_8stream_device_tokens_per_sec_per_chip", 1.00),
 ]
 
 
@@ -161,7 +166,8 @@ def suite_gate(tolerance, rows=None):
     if rows is None:
         out = subprocess.run(
             [sys.executable, os.path.join(ROOT, "bench.py"), "--suite"],
-            capture_output=True, text=True, timeout=25000)  # 7 rows x 2 attempts x 1500s + slack
+            capture_output=True, text=True,
+            timeout=42000)  # 13 rows x 2 attempts x 1500s + slack
         if out.returncode != 0:
             raise RuntimeError(f"bench.py --suite failed:\n"
                                f"{out.stderr[-2000:]}")
