@@ -444,8 +444,19 @@ def bench_hapi_fit(seqlen=1024, batch=32, steps=48, warmup=8, k=8):
     fall behind the hand-rolled `gpt2` row."""
     value = _hapi_fit_tps(seqlen, batch, steps, warmup, jit_compile=True,
                           k=k)
-    return {"metric": "hapi_fit_tokens_per_sec",
-            "value": round(value, 1), "unit": "tokens/s"}
+    from paddle_hackathon_tpu.observability import get_registry
+    reg = get_registry()
+    row = {"metric": "hapi_fit_tokens_per_sec",
+           "value": round(value, 1), "unit": "tokens/s"}
+    fam = reg.get("train_step_seconds")
+    series = [c for c in fam.children() if c.count] if fam else []
+    row["metrics"] = {
+        "jit_builds_total": int(reg.total("jit_builds_total",
+                                          site="hapi.compiled_trainer")),
+        "step_p50_ms": round(series[0].quantile(0.5) * 1e3, 3)
+        if series else None,
+    }
+    return row
 
 
 def bench_fit_compare():
@@ -588,12 +599,27 @@ def bench_serving(streams=8, prompt=64, new_tokens=128, chunk=32, spec_k=0,
     rng = np.random.RandomState(0)
     prompts = [rng.randint(0, cfg.vocab_size, (prompt,)).astype(np.int32)
                for _ in range(streams)]
+    from paddle_hackathon_tpu.observability import get_registry
     eng = ServingEngine(model, max_slots=streams,
-                        max_len=prompt + new_tokens + chunk, chunk=chunk,
-                        auto_run=False, decode_window=32, spec_k=spec_k)
-    warm = eng.submit(prompts[0], 2)  # compile the tick
+                        max_len=prompt + new_tokens + chunk, spec_k=spec_k,
+                        auto_run=False, decode_window=32, chunk=chunk)
+    reg = get_registry()
+    builds = lambda: int(  # noqa: E731 — this engine's program builds
+        reg.total("jit_builds_total", engine=eng._engine_id))
+    # warm phase compiles every tick flavor this run can hit: a random
+    # prompt covers the chunk-prefill and multi-step decode programs
+    # (ticks where the drafter proposes nothing demote to the fused
+    # window), then — under spec_k — a REPEATED prompt makes the n-gram
+    # drafter actually propose, compiling the fused verify program now
+    # rather than mid-measurement
+    warm = eng.submit(prompts[0], 2)
     eng.run_until_idle()
     assert warm.done
+    if spec_k:
+        warm2 = eng.submit(np.tile(prompts[0][:8], 4), 8)
+        eng.run_until_idle()
+        assert warm2.done
+    builds_warm = builds()
     reqs = [eng.submit(p, new_tokens) for p in prompts]
     dev_ms = _trace_device_ms(eng.run_until_idle)
     assert all(r.done for r in reqs)
@@ -606,6 +632,17 @@ def bench_serving(streams=8, prompt=64, new_tokens=128, chunk=32, spec_k=0,
             eng.stats["spec_accepted"] / max(eng.stats["spec_drafted"], 1),
             4)
         row["spec_ticks"] = eng.stats["spec_ticks"]
+    # telemetry snapshot for tools/perf_gate.py: builds growing past the
+    # warm phase = the tick recompiled mid-run (the regression tripwire);
+    # the latency percentiles ride along for the record
+    row["metrics"] = {
+        "jit_builds_warm": builds_warm,
+        "jit_builds_total": builds(),
+        "ttft_p50_ms": round(eng._h_ttft.quantile(0.5) * 1e3, 3),
+        "tpot_p50_ms": round(eng._h_tpot.quantile(0.5) * 1e3, 3),
+        "e2e_p50_ms": round(eng._h_e2e.quantile(0.5) * 1e3, 3),
+        "ticks": eng.stats["ticks"],
+    }
     return row
 
 

@@ -81,6 +81,7 @@ import importlib as _importlib
 
 for _sub in ("nn", "optimizer", "io", "amp", "metric", "framework",
              "jit", "distributed", "vision", "incubate", "profiler", "hapi",
+             "observability",
              "static", "text", "inference", "distribution", "sparse",
              "utils", "onnx", "fft", "signal", "device", "autograd", "linalg",
              "regularizer", "sysconfig", "hub", "callbacks", "version",

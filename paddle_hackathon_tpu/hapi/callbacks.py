@@ -118,6 +118,77 @@ class ModelCheckpoint(Callback):
             self.model.save(os.path.join(self.save_dir, "final"))
 
 
+class MetricsCallback(Callback):
+    """Log/telemetry bridge for the metrics registry
+    (``observability.MetricRegistry``) inside ``Model.fit``.
+
+    Every ``log_freq`` train steps it samples the guarded device-health
+    gauges and prints a compact line of the registry's key training
+    series (step time p50, tokens/sec, compile events, input wait);
+    ``on_train_end`` optionally writes the full ``registry.snapshot()``
+    JSON to ``snapshot_path`` — the file ``tools/metrics_dump.py``
+    pretty-prints and diffs."""
+
+    def __init__(self, log_freq=100, snapshot_path=None, registry=None,
+                 verbose=1):
+        from ..observability import metrics as _obs
+        self.registry = registry or _obs.get_registry()
+        self.log_freq = max(int(log_freq), 1)
+        self.snapshot_path = snapshot_path
+        self.verbose = verbose
+        self._begin = None
+
+    def on_train_begin(self, logs=None):
+        self._begin = self.registry.snapshot()
+
+    def _line(self):
+        reg = self.registry
+        parts = []
+        fam = reg.get("train_step_seconds")
+        if fam is not None:
+            for c in fam.children():
+                if c.count:
+                    parts.append(f"step_p50 {c.quantile(0.5) * 1e3:.1f}ms")
+                    break
+        tps = reg.total("train_tokens_per_sec")
+        if tps:
+            parts.append(f"tokens/s {tps:,.0f}")
+        builds = reg.total("jit_builds_total")
+        if builds:
+            parts.append(f"jit_builds {builds:.0f}")
+        fam = reg.get("input_wait_seconds")
+        if fam is not None:
+            for c in fam.children():
+                if c.count:
+                    parts.append(
+                        f"input_wait_p90 {c.quantile(0.9) * 1e3:.1f}ms")
+                    break
+        return " - ".join(parts)
+
+    def on_train_batch_end(self, step, logs=None):
+        if step % self.log_freq:
+            return
+        from ..observability import metrics as _obs
+        _obs.record_device_memory(self.registry)
+        if self.verbose:
+            line = self._line()
+            if line:
+                print(f"[metrics] step {step} - {line}")
+
+    def on_train_end(self, logs=None):
+        from ..observability import metrics as _obs
+        _obs.record_device_memory(self.registry)
+        if self.snapshot_path:
+            import json
+            snap = self.registry.snapshot()
+            if self._begin is not None:
+                from ..observability.metrics import snapshot_delta
+                snap["delta_from_train_begin"] = snapshot_delta(
+                    self._begin, snap)["metrics"]
+            with open(self.snapshot_path, "w") as f:
+                json.dump(snap, f, indent=1)
+
+
 class EarlyStopping(Callback):
     """Stop when a monitored metric stops improving (ref EarlyStopping)."""
 

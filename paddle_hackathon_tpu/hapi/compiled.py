@@ -32,6 +32,7 @@ import jax.numpy as jnp
 from ..core import random as core_random
 from ..core.tensor import Tensor
 from ..nn.layer import functional_call
+from ..observability import metrics as _obs
 from ..parallel.api import make_functional_train_step
 
 
@@ -127,8 +128,12 @@ class CompiledTrainer:
                                                 scan_batch=True)
         # donate the ENTIRE train state: params + accumulators + step all
         # update in place on device; the live network's Tensors rebind to
-        # the fresh arrays after each call
-        self._jit = jax.jit(train_step, donate_argnums=(0, 1, 2))
+        # the fresh arrays after each call.  instrument_jit records every
+        # trace+compile (a new batch shape = a new program) into
+        # jit_builds_total{site=hapi.compiled_trainer}.
+        self._jit = _obs.instrument_jit(
+            jax.jit(train_step, donate_argnums=(0, 1, 2)),
+            site="hapi.compiled_trainer")
 
     def run(self, xs, ys):
         """One compiled superstep over stacked batches (leaves (K, B, …));

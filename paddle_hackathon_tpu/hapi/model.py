@@ -201,12 +201,41 @@ class Model:
         failed (Python-side control flow in forward, unjittable op) and
         the epoch finished on the eager path instead."""
         import itertools
+        import time
 
         import jax
         import jax.numpy as jnp
         import numpy as np
 
         from ..io.dataloader import device_prefetch
+        from ..observability import metrics as _obs
+
+        # step-time/throughput telemetry rides the sync points the loop
+        # ALREADY pays (the log_freq loss fetch and the epoch-end
+        # block_until_ready) — between them dispatch is async and a wall
+        # clock around trainer.run() would measure only Python dispatch.
+        _reg = _obs.get_registry()
+        _h_step = _reg.histogram(
+            "train_step_seconds",
+            "mean per-step wall time between loss fetches",
+            unit="s").labels(path="hapi_compiled")
+        _g_tps = _reg.gauge(
+            "train_tokens_per_sec",
+            "training throughput between loss fetches "
+            "(tokens = batch x seqlen; batch for 1-D samples)").labels(
+                path="hapi_compiled")
+        _t_mark = None
+        _steps_since = _tokens_since = 0
+
+        def _telemetry_tick():
+            nonlocal _t_mark, _steps_since, _tokens_since
+            now = time.perf_counter()
+            if _t_mark is not None and _steps_since:
+                dt = now - _t_mark
+                if dt > 0:
+                    _h_step.observe(dt / _steps_since)
+                    _g_tps.set(_tokens_since / dt)
+            _t_mark, _steps_since, _tokens_since = now, 0, 0
 
         k = max(int(k), 1)
         it = iter(loader)
@@ -274,15 +303,24 @@ class Model:
                     if self.stop_training:
                         break
                 return logs, None
+            lead = jax.tree.leaves(xs)[0]   # (K, B, ...) stacked batches
+            # tokens = B*S only for token batches (K, B, S); any other
+            # rank (vision NCHW etc.) counts samples — shape[2] would be
+            # a channel count, not a sequence length
+            toks_per_step = int(lead.shape[1]) * (
+                int(lead.shape[2]) if lead.ndim == 3 else 1)
             n = int(losses.shape[0])
             for j in range(n):
                 cbk.on_train_batch_begin(step)
+                _steps_since += 1
+                _tokens_since += toks_per_step
                 # async loss fetch: the scalar leaves the device only at
                 # log_freq boundaries — other steps hand callbacks the
                 # device scalar (float()-able on demand)
                 v = losses[j]
                 if log_freq and step % log_freq == 0:
                     v = float(v)
+                    _telemetry_tick()
                 logs = {"loss": v}
                 cbk.on_train_batch_end(step, logs)
                 step += 1
@@ -296,6 +334,7 @@ class Model:
             # actually saw (a mid-window stop must not report past it)
             losses, j = last
             jax.block_until_ready(losses)
+            _telemetry_tick()
             logs = {"loss": float(losses[j])}
         trainer.sync_optimizer()
         return logs, trainer

@@ -31,10 +31,42 @@ positions.
 from __future__ import annotations
 
 import collections
+import collections.abc
+import itertools
 import threading
+import time
 from typing import List, Optional
 
 import numpy as np
+
+from ..observability import metrics as _obs
+
+_ENGINE_IDS = itertools.count()
+
+
+class _EngineStats(collections.abc.Mapping):
+    """Back-compat dict view over the engine's registry counters: the
+    historical ``engine.stats`` keys read straight from the labelled
+    ``serving_*_total`` series, so existing callers (tests, bench rows)
+    keep working while scrapers get the full labelled families."""
+
+    _KEYS = ("ticks", "tokens", "requests",
+             "spec_ticks", "spec_drafted", "spec_accepted")
+
+    def __init__(self, counters):
+        self._counters = counters   # key -> Counter child
+
+    def __getitem__(self, k):
+        return int(self._counters[k].value)
+
+    def __iter__(self):
+        return iter(self._KEYS)
+
+    def __len__(self):
+        return len(self._KEYS)
+
+    def __repr__(self):
+        return repr(dict(self))
 
 
 def _storage_dtype(dtype):
@@ -124,7 +156,8 @@ class Request:
     sampling defaults for this request only (None = inherit)."""
 
     __slots__ = ("prompt", "max_new_tokens", "tokens", "done", "error",
-                 "temperature", "top_k", "top_p", "_event")
+                 "temperature", "top_k", "top_p", "_event",
+                 "_t_submit", "_t_first")
 
     def __init__(self, prompt, max_new_tokens, temperature=None,
                  top_k=None, top_p=None):
@@ -137,6 +170,8 @@ class Request:
         self.done = False
         self.error: Optional[BaseException] = None
         self._event = threading.Event()
+        self._t_submit = time.perf_counter()   # TTFT/e2e reference point
+        self._t_first: Optional[float] = None  # first generated token
 
     def wait(self, timeout=None):
         self._event.wait(timeout)
@@ -233,9 +268,7 @@ class ServingEngine:
         self._running = False
         self._loop_thread = None
         self._tickno = 0
-        self.stats = {"ticks": 0, "tokens": 0, "requests": 0,
-                      "spec_ticks": 0, "spec_drafted": 0,
-                      "spec_accepted": 0}
+        self._init_metrics()
         self._key = jax.random.key(0)
 
         self._spec = None
@@ -255,6 +288,58 @@ class ServingEngine:
         else:
             self._build_tick()
         self._alloc_caches(jnp)
+
+    # ------------------------------------------------------------------
+    def _init_metrics(self):
+        """Register this engine's telemetry series (metric catalog:
+        docs/OBSERVABILITY.md).  One ``engine`` label per instance keeps
+        concurrently-live engines (tests, A/B deploys) from mixing
+        series; ``self.stats`` stays the historical dict-shaped view."""
+        reg = self._registry = _obs.get_registry()
+        self._engine_id = f"e{next(_ENGINE_IDS)}"
+        lbl = {"engine": self._engine_id}
+        counters = {
+            "ticks": reg.counter(
+                "serving_ticks_total", "engine ticks run"),
+            "tokens": reg.counter(
+                "serving_tokens_total", "generated tokens committed"),
+            "requests": reg.counter(
+                "serving_requests_total", "requests submitted"),
+            "spec_ticks": reg.counter(
+                "serving_spec_ticks_total", "speculative verify ticks"),
+            "spec_drafted": reg.counter(
+                "serving_spec_drafted_total",
+                "draft tokens proposed (capped at request budget)"),
+            "spec_accepted": reg.counter(
+                "serving_spec_accepted_total",
+                "draft tokens accepted AND committed"),
+        }
+        self._c = {k: fam.labels(**lbl) for k, fam in counters.items()}
+        self.stats = _EngineStats(self._c)
+        self._h_ttft = reg.histogram(
+            "serving_ttft_seconds",
+            "submit to first generated token", unit="s").labels(**lbl)
+        self._h_tpot = reg.histogram(
+            "serving_tpot_seconds",
+            "mean inter-token latency past the first token",
+            unit="s").labels(**lbl)
+        self._h_e2e = reg.histogram(
+            "serving_e2e_seconds",
+            "submit to request completion", unit="s").labels(**lbl)
+        tick_fam = reg.histogram(
+            "serving_tick_seconds",
+            "device tick wall time by program flavor", unit="s")
+        self._h_tick = {f: tick_fam.labels(flavor=f, **lbl)
+                        for f in ("prefill", "decode", "spec", "pp")}
+        self._h_accept = reg.histogram(
+            "serving_spec_accept_ratio",
+            "per-spec-tick accepted/drafted ratio",
+            buckets=_obs.RATIO_BUCKETS).labels(**lbl)
+        self._g_occupancy = reg.gauge(
+            "serving_batch_occupancy",
+            "slots holding an active request this tick").labels(**lbl)
+        self._g_queue = reg.gauge(
+            "serving_queue_depth", "requests waiting for a slot").labels(**lbl)
 
     # ------------------------------------------------------------------
     @staticmethod
@@ -398,12 +483,17 @@ class ServingEngine:
 
     def _prog(self, name, skey):
         """Build-or-reuse the jitted ``name`` program for sampler flavor
-        ``skey`` (flavors compile lazily on first use)."""
+        ``skey`` (flavors compile lazily on first use).  Every program is
+        wrapped by ``observability.instrument_jit`` so builds — including
+        shape-keyed retraces inside one flavor, e.g. the width-1 vs
+        chunk-wide tick — land in ``jit_builds_total{site=serving.*}``:
+        the recompilation-regression tripwire tools/perf_gate.py gates."""
         cache = getattr(self, name)
         fn = cache.get(skey)
         if fn is None:
-            fn = cache[skey] = getattr(self, name + "_mk")(
-                self._mk_sampler(skey))
+            fn = cache[skey] = _obs.instrument_jit(
+                getattr(self, name + "_mk")(self._mk_sampler(skey)),
+                site=f"serving.{name.lstrip('_')}", engine=self._engine_id)
         return fn
 
     def _build_spec_tick(self):
@@ -697,7 +787,8 @@ class ServingEngine:
                 f"max_position_embeddings is {max_pos}")
         with self._lock:
             self._pending.append(req)
-            self.stats["requests"] += 1
+            self._c["requests"].inc()
+            self._g_queue.set(len(self._pending))
             if self.auto_run and not self._running:
                 self._running = True
                 t = threading.Thread(target=self._loop, daemon=True)
@@ -762,6 +853,11 @@ class ServingEngine:
         req.done = True
         self._slots[slot_idx].req = None
         self._lengths[slot_idx] = 0
+        now = time.perf_counter()
+        self._h_e2e.observe(now - req._t_submit)
+        if req._t_first is not None and len(req.tokens) > 1:
+            self._h_tpot.observe(
+                (now - req._t_first) / (len(req.tokens) - 1))
         req._event.set()
 
     def _commit_token(self, i, tok):
@@ -769,9 +865,12 @@ class ServingEngine:
         completed."""
         slot = self._slots[i]
         req = slot.req
+        if not req.tokens:
+            req._t_first = time.perf_counter()
+            self._h_ttft.observe(req._t_first - req._t_submit)
         req.tokens.append(tok)
         slot.last = tok
-        self.stats["tokens"] += 1
+        self._c["tokens"].inc()
         if (len(req.tokens) >= req.max_new_tokens
                 or (self.eos_token_id is not None
                     and tok == self.eos_token_id)):
@@ -797,6 +896,9 @@ class ServingEngine:
                     "re-enter the tick with donated caches — wait for the "
                     "loop to drain (shutdown()) instead")
             self._admit()
+            self._g_queue.set(len(self._pending))
+            self._g_occupancy.set(
+                sum(s.req is not None for s in self._slots))
             sampling = self._sampling_vectors()
             if self._pp > 1:
                 if (not any(s.req is not None for s in self._slots)
@@ -826,10 +928,12 @@ class ServingEngine:
                 tokens, starts, nvalid, consumed, finishing = self._stage()
 
         if mode == "pp":
+            t0 = time.perf_counter()
             nxt = self._run_pp_tick(tokens, starts, nvalid, sampling)
+            self._h_tick["pp"].observe(time.perf_counter() - t0)
             with self._lock:
                 self._tickno += 1
-                self.stats["ticks"] += 1
+                self._c["ticks"].inc()
                 self._commit_pp_exit_locked(exit_wave, nxt)
             return True
         if mode == "spec":
@@ -848,39 +952,54 @@ class ServingEngine:
                 mode = "multi"
         if mode == "spec":
             toks = np.concatenate([last_toks[:, None], drafts], axis=1)
+            t0 = time.perf_counter()
             out = self._run_tick_spec(toks, starts, sampling)
+            self._h_tick["spec"].observe(time.perf_counter() - t0)
             from ..nn.decode import accept_lengths
             acc = accept_lengths(drafts, ndraft, out)
             with self._lock:
                 self._tickno += 1
-                self.stats["ticks"] += 1
-                self.stats["spec_ticks"] += 1
+                self._c["ticks"].inc()
+                self._c["spec_ticks"].inc()
+                tick_drafted = tick_accepted = 0
                 nvalid = np.zeros(self.max_slots, np.int32)
                 for i, slot in enumerate(self._slots):
                     if slot.req is None:
                         continue
-                    # cap at the request's remaining budget: drafts past
-                    # it are discarded and would overstate the reported
-                    # acceptance rate
                     rem = slot.req.max_new_tokens - len(slot.req.tokens)
-                    self.stats["spec_drafted"] += min(int(ndraft[i]), rem)
-                    self.stats["spec_accepted"] += min(int(acc[i]), rem)
                     adv = int(acc[i]) + 1
                     nvalid[i] = adv
                     self._lengths[i] += adv
+                    committed = 0
                     for t in range(adv):
+                        committed += 1
                         if self._commit_token(i, int(out[i, t])):
                             break  # freed; later accepted tokens discarded
+                    # count only what the commit loop could use: the
+                    # request budget (rem) bounds drafts, and the commit
+                    # count additionally bounds accepted (EOS truncation)
+                    # — otherwise the acceptance counters claim tokens
+                    # the tokens counter never saw
+                    d = min(int(ndraft[i]), rem)
+                    a = min(int(acc[i]), committed)
+                    self._c["spec_drafted"].inc(d)
+                    self._c["spec_accepted"].inc(a)
+                    tick_drafted += d
+                    tick_accepted += a
+                if tick_drafted:
+                    self._h_accept.observe(tick_accepted / tick_drafted)
             if getattr(self._spec, "ingest_after_verify", True):
                 # self-ingesting drafters (ModelDrafter) already wrote
                 # these rows into their own cache during propose()
                 self._spec.ingest(toks, starts, nvalid)
             return True
         if mode == "multi":
+            t0 = time.perf_counter()
             out = self._run_tick_multi(last_toks, starts, sampling)
+            self._h_tick["decode"].observe(time.perf_counter() - t0)
             with self._lock:
                 self._tickno += 1
-                self.stats["ticks"] += 1
+                self._c["ticks"].inc()
                 M = self._decode_window
                 for i, slot in enumerate(self._slots):
                     if slot.req is None:
@@ -899,10 +1018,12 @@ class ServingEngine:
                 self._spec.ingest(chunk, starts,
                                   np.where(active, M, 0).astype(np.int32))
             return True
+        t0 = time.perf_counter()
         nxt = self._run_tick(tokens, starts, nvalid, sampling)
+        self._h_tick["prefill"].observe(time.perf_counter() - t0)
         with self._lock:
             self._tickno += 1
-            self.stats["ticks"] += 1
+            self._c["ticks"].inc()
             for i, slot in enumerate(self._slots):
                 if slot.req is None:
                     continue
@@ -1008,12 +1129,15 @@ class ServingEngine:
     def shutdown(self, timeout=60.0):
         """Wait for the background loop to drain and stop — call before
         interpreter exit so a daemon thread isn't killed mid-device-call
-        (which aborts the process from PJRT's C++)."""
-        import time
+        (which aborts the process from PJRT's C++).  Also drops this
+        engine's labelled series from the process-wide registry (engine
+        churn must not grow it forever); ``self.stats`` holds its own
+        counter handles, so it stays readable after shutdown."""
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
             with self._lock:
                 if not self._running:
+                    self._registry.drop_labels(engine=self._engine_id)
                     return
             time.sleep(0.005)
         raise TimeoutError("engine loop did not drain before timeout")

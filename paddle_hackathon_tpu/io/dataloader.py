@@ -138,10 +138,22 @@ def device_prefetch(iterator, size=2, device=None):
     numpy leaves are ``device_put``; jax arrays and Tensors pass through
     (already resident or in flight).  Works on any iterator of (nested)
     batches — tuples/lists/dicts of arrays.
+
+    Each host-side pull is timed into the
+    ``input_wait_seconds{site=device_prefetch}`` histogram: when the
+    consumer outruns the producer, this distribution fattening is the
+    input-starvation signal (docs/OBSERVABILITY.md).
     """
     import collections
+    import time as _time
 
     import jax
+
+    from ..observability import metrics as _obs
+    wait_hist = _obs.get_registry().histogram(
+        "input_wait_seconds",
+        "host wait per batch pulled from the input pipeline",
+        unit="s").labels(site="device_prefetch")
 
     def _put_leaf(a):
         if isinstance(a, Tensor):
@@ -160,7 +172,10 @@ def device_prefetch(iterator, size=2, device=None):
     while True:
         while len(buf) < size:
             try:
-                buf.append(_put(next(it)))
+                t0 = _time.perf_counter()
+                nxt = next(it)
+                wait_hist.observe(_time.perf_counter() - t0)
+                buf.append(_put(nxt))
             except StopIteration:
                 while buf:
                     yield buf.popleft()

@@ -1,0 +1,36 @@
+"""Runtime telemetry (metrics) — the half of the observability surface
+the reference framework does NOT have.
+
+``paddle.profiler`` (ported in ``profiler/``) answers "where did this
+step's time go" — spans on a timeline.  Production serving/training is
+flown on the OTHER signal class: counters, gauges and latency
+distributions scraped continuously (TTFT/TPOT/queue-depth on the serving
+side — the Orca/vLLM-style continuous-batching observability contract —
+and step-time/tokens-per-sec/compile-stall telemetry on the training
+side).  This package is that metrics half:
+
+- :class:`MetricRegistry` — process-wide, thread-safe registry of
+  :class:`Counter` / :class:`Gauge` / :class:`Histogram` families with
+  Prometheus-style labels and fixed log-spaced histogram buckets;
+  near-zero cost when disabled.
+- Exporters: ``registry.expose_text()`` (Prometheus text exposition) and
+  ``registry.snapshot()`` / :func:`snapshot_delta` (JSON).
+- Chrome-trace integration: while a ``profiler.Profiler`` records,
+  counter/gauge updates are mirrored as chrome-trace counter events
+  (``"ph": "C"``) so metrics and spans land on one timeline (see
+  ``profiler.export_chrome_tracing``).
+- :func:`instrument_jit` — wraps a jitted callable so program builds and
+  compile wall-time are counted at every jit-build site.
+- :func:`record_device_memory` — guarded live-buffer / device-memory
+  gauges (degrades silently where jaxlib lacks the stats).
+
+Metric catalog: ``docs/OBSERVABILITY.md``.
+"""
+
+from .metrics import (Counter, Gauge, Histogram, MetricRegistry,
+                      get_registry, instrument_jit, log_buckets,
+                      record_device_memory, set_trace_sink, snapshot_delta)
+
+__all__ = ["MetricRegistry", "Counter", "Gauge", "Histogram",
+           "get_registry", "instrument_jit", "log_buckets",
+           "record_device_memory", "set_trace_sink", "snapshot_delta"]

@@ -1,0 +1,556 @@
+"""Process-wide metrics registry: Counter/Gauge/Histogram families.
+
+Design notes
+------------
+- A *family* is one metric name + type + help/unit; a *child* is one
+  labelled time series inside it (``family.labels(mode="decode")``).
+  Families with no labels still have exactly one child (the empty label
+  set) and proxy ``inc``/``set``/``observe`` straight to it.
+- Thread-safety: every child guards its scalars with one small lock
+  (CPython `+=` is not atomic across bytecodes); the registry guards
+  family/child creation.  Locks are leaves — nothing is called while one
+  is held — so instrumented code may update metrics under its own locks.
+- Near-zero cost when disabled: every hot-path method checks one plain
+  attribute (``registry.enabled``) before touching a lock.
+- Histograms use FIXED buckets chosen at family creation (default
+  log-spaced, :func:`log_buckets`) — observation is a binary search +
+  two adds, and two snapshots subtract bucket-by-bucket
+  (:func:`snapshot_delta`), which per-request reservoirs cannot do.
+- Chrome-trace integration: a module-level sink (armed by
+  ``profiler.Profiler`` while recording) receives every counter/gauge
+  update as ``(name, labels, value, t_ns)`` and lands them as
+  ``"ph": "C"`` counter events on the span timeline.
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+import time
+from typing import Dict, Iterable, Optional, Tuple
+
+__all__ = ["MetricRegistry", "Counter", "Gauge", "Histogram",
+           "get_registry", "instrument_jit", "log_buckets",
+           "record_device_memory", "set_trace_sink", "snapshot_delta"]
+
+
+def log_buckets(lo: float = 1e-6, hi: float = 64.0, per_decade: int = 3):
+    """Fixed log-spaced bucket upper bounds covering [lo, hi] — the
+    latency scale from microseconds (a cache-hit tick dispatch) to the
+    minute class (a cold XLA compile).  ``per_decade`` steps per 10x."""
+    out = []
+    e = 0
+    while True:
+        b = lo * 10.0 ** (e / per_decade)
+        out.append(float(f"{b:.6g}"))  # stable, JSON-friendly bounds
+        if b >= hi:
+            return tuple(out)
+        e += 1
+
+
+DEFAULT_BUCKETS = log_buckets()
+# acceptance-rate style histograms: a ratio in [0, 1]
+RATIO_BUCKETS = tuple(round(0.1 * i, 1) for i in range(1, 11))
+
+# Armed by profiler.Profiler while recording (see profiler._start_record):
+# fn(name, labels_tuple, value, t_ns).  Module-level so the check on the
+# metric hot path is one global load.
+_trace_sink = None
+
+
+def set_trace_sink(fn) -> None:
+    """Install (or clear, with None) the chrome-trace counter sink."""
+    global _trace_sink
+    _trace_sink = fn
+
+
+class _Child:
+    __slots__ = ("name", "labels", "_reg", "_lock")
+
+    def __init__(self, name, labels, reg):
+        self.name = name
+        self.labels = labels            # sorted tuple of (key, value)
+        self._reg = reg
+        self._lock = threading.Lock()
+
+
+class Counter(_Child):
+    """Monotonically increasing count (Prometheus counter)."""
+
+    __slots__ = ("_value",)
+
+    def __init__(self, name, labels, reg):
+        super().__init__(name, labels, reg)
+        self._value = 0.0
+
+    def inc(self, v: float = 1.0) -> None:
+        if not self._reg.enabled:
+            return
+        if v < 0:
+            raise ValueError(f"counter {self.name} cannot decrease")
+        with self._lock:
+            self._value += v
+            val = self._value
+        sink = _trace_sink
+        if sink is not None:
+            sink(self.name, self.labels, val, time.perf_counter_ns())
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+
+class Gauge(_Child):
+    """Point-in-time value (queue depth, occupancy, bytes in use)."""
+
+    __slots__ = ("_value",)
+
+    def __init__(self, name, labels, reg):
+        super().__init__(name, labels, reg)
+        self._value = 0.0
+
+    def set(self, v: float) -> None:
+        if not self._reg.enabled:
+            return
+        with self._lock:
+            self._value = float(v)
+        sink = _trace_sink
+        if sink is not None:
+            sink(self.name, self.labels, float(v), time.perf_counter_ns())
+
+    def inc(self, v: float = 1.0) -> None:
+        if not self._reg.enabled:
+            return
+        with self._lock:
+            self._value += v
+            val = self._value
+        sink = _trace_sink
+        if sink is not None:
+            sink(self.name, self.labels, val, time.perf_counter_ns())
+
+    def dec(self, v: float = 1.0) -> None:
+        self.inc(-v)
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+
+class Histogram(_Child):
+    """Fixed-bucket distribution (latencies, ratios).
+
+    ``buckets`` are upper bounds; an implicit +Inf bucket catches the
+    tail.  ``quantile(q)`` interpolates within the bucket that crosses
+    the requested rank — the standard Prometheus ``histogram_quantile``
+    estimate, good to bucket resolution."""
+
+    __slots__ = ("buckets", "_counts", "_sum", "_count")
+
+    def __init__(self, name, labels, reg, buckets=DEFAULT_BUCKETS):
+        super().__init__(name, labels, reg)
+        self.buckets = tuple(sorted(float(b) for b in buckets))
+        self._counts = [0] * (len(self.buckets) + 1)  # +Inf tail
+        self._sum = 0.0
+        self._count = 0
+
+    def observe(self, v: float) -> None:
+        if not self._reg.enabled:
+            return
+        i = bisect.bisect_left(self.buckets, v)
+        with self._lock:
+            self._counts[i] += 1
+            self._sum += v
+            self._count += 1
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    @property
+    def sum(self) -> float:
+        return self._sum
+
+    def quantile(self, q: float) -> float:
+        """Approximate q-quantile (0 <= q <= 1) from bucket counts."""
+        with self._lock:
+            counts, total = list(self._counts), self._count
+        if not total:
+            return float("nan")
+        rank = q * total
+        acc = 0.0
+        for i, c in enumerate(counts):
+            if acc + c >= rank and c:
+                lo = self.buckets[i - 1] if i > 0 else 0.0
+                hi = (self.buckets[i] if i < len(self.buckets)
+                      else self.buckets[-1])  # +Inf bucket: clamp at top
+                return lo + (hi - lo) * ((rank - acc) / c)
+            acc += c
+        return self.buckets[-1]
+
+
+class _Family:
+    """One metric name: type + help + the labelled children."""
+
+    def __init__(self, name, kind, help, unit, reg, buckets=None):
+        self.name = name
+        self.kind = kind                # 'counter' | 'gauge' | 'histogram'
+        self.help = help
+        self.unit = unit
+        self.buckets = buckets
+        self._reg = reg
+        self._children: Dict[Tuple, _Child] = {}
+        self._lock = threading.Lock()
+
+    def labels(self, **kv) -> _Child:
+        key = tuple(sorted((k, str(v)) for k, v in kv.items()))
+        child = self._children.get(key)
+        if child is not None:
+            return child
+        with self._lock:
+            child = self._children.get(key)
+            if child is None:
+                if self.kind == "counter":
+                    child = Counter(self.name, key, self._reg)
+                elif self.kind == "gauge":
+                    child = Gauge(self.name, key, self._reg)
+                else:
+                    child = Histogram(self.name, key, self._reg,
+                                      self.buckets or DEFAULT_BUCKETS)
+                self._children[key] = child
+        return child
+
+    def children(self) -> Iterable[_Child]:
+        return list(self._children.values())
+
+    # unlabeled convenience: family.inc() == family.labels().inc()
+    def inc(self, v=1.0):
+        self.labels().inc(v)
+
+    def set(self, v):
+        self.labels().set(v)
+
+    def dec(self, v=1.0):
+        self.labels().dec(v)
+
+    def observe(self, v):
+        self.labels().observe(v)
+
+    @property
+    def value(self):
+        return self.labels().value
+
+
+class MetricRegistry:
+    """Thread-safe registry of metric families.
+
+    ``enabled=False`` (or :meth:`disable`) turns every update into one
+    attribute check + return — instrumented hot paths keep their cost
+    even when nobody is scraping."""
+
+    def __init__(self, enabled: bool = True):
+        self.enabled = bool(enabled)
+        self._families: Dict[str, _Family] = {}
+        self._lock = threading.Lock()
+
+    # -- lifecycle ---------------------------------------------------------
+    def enable(self):
+        self.enabled = True
+
+    def disable(self):
+        self.enabled = False
+
+    def reset(self):
+        """Drop every family (test isolation)."""
+        with self._lock:
+            self._families.clear()
+
+    # -- family constructors ----------------------------------------------
+    def _family(self, name, kind, help, unit, buckets=None) -> _Family:
+        fam = self._families.get(name)
+        if fam is None:
+            with self._lock:
+                fam = self._families.get(name)
+                if fam is None:
+                    fam = _Family(name, kind, help, unit, self, buckets)
+                    self._families[name] = fam
+        # validate OUTSIDE the creation branch: the loser of a concurrent
+        # first registration must get the same checks as a late caller
+        if fam.kind != kind:
+            raise ValueError(
+                f"metric {name!r} already registered as {fam.kind}")
+        if kind == "histogram" and buckets is not None:
+            want = tuple(sorted(float(b) for b in buckets))
+            have = tuple(sorted(float(b)
+                                for b in (fam.buckets or DEFAULT_BUCKETS)))
+            if want != have:
+                # silently keeping the first-registered layout would land
+                # later observations in the wrong buckets (a 0..1 ratio
+                # collapses into ~3 log-spaced latency buckets)
+                raise ValueError(
+                    f"metric {name!r} already registered with different "
+                    f"buckets")
+        return fam
+
+    def counter(self, name, help: str = "", unit: str = "") -> _Family:
+        return self._family(name, "counter", help, unit)
+
+    def gauge(self, name, help: str = "", unit: str = "") -> _Family:
+        return self._family(name, "gauge", help, unit)
+
+    def histogram(self, name, help: str = "", unit: str = "",
+                  buckets=None) -> _Family:
+        return self._family(name, "histogram", help, unit, buckets)
+
+    def get(self, name) -> Optional[_Family]:
+        return self._families.get(name)
+
+    def drop_labels(self, **labels) -> int:
+        """Remove every series whose labels include the given key/values
+        (e.g. ``drop_labels(engine="e3")`` when an engine is torn down),
+        returning how many were dropped.  Without this, per-instance
+        labels would grow the process-wide registry forever under
+        instance churn.  Handles already held keep working — the series
+        just stops being exported/snapshotted."""
+        want = {(k, str(v)) for k, v in labels.items()}
+        dropped = 0
+        with self._lock:
+            fams = list(self._families.values())
+        for fam in fams:
+            with fam._lock:
+                dead = [key for key, c in fam._children.items()
+                        if want <= set(c.labels)]
+                for key in dead:
+                    del fam._children[key]
+                dropped += len(dead)
+        return dropped
+
+    def total(self, name, **label_filter) -> float:
+        """Sum of all children of ``name`` whose labels match the filter
+        (counters/gauges: values; histograms: observation counts)."""
+        fam = self._families.get(name)
+        if fam is None:
+            return 0.0
+        want = {(k, str(v)) for k, v in label_filter.items()}
+        out = 0.0
+        for c in fam.children():
+            if want <= set(c.labels):
+                out += c.count if isinstance(c, Histogram) else c.value
+        return out
+
+    # -- exporters ---------------------------------------------------------
+    @staticmethod
+    def _fmt_labels(labels, extra=None) -> str:
+        items = list(labels) + (extra or [])
+        if not items:
+            return ""
+        def esc(v):
+            return str(v).replace("\\", r"\\").replace('"', r'\"') \
+                         .replace("\n", r"\n")
+        return "{" + ",".join(f'{k}="{esc(v)}"' for k, v in items) + "}"
+
+    def expose_text(self) -> str:
+        """Prometheus text exposition format (version 0.0.4)."""
+        lines = []
+        with self._lock:
+            fams = list(self._families.values())
+        for fam in fams:
+            help = fam.help + (f" [{fam.unit}]" if fam.unit else "")
+            if help:
+                lines.append(f"# HELP {fam.name} {help}")
+            lines.append(f"# TYPE {fam.name} {fam.kind}")
+            for c in fam.children():
+                if isinstance(c, Histogram):
+                    with c._lock:
+                        counts = list(c._counts)
+                        s, n = c._sum, c._count
+                    acc = 0
+                    for b, cnt in zip(c.buckets, counts):
+                        acc += cnt
+                        lines.append(
+                            f"{fam.name}_bucket"
+                            f"{self._fmt_labels(c.labels, [('le', f'{b:g}')])}"
+                            f" {acc}")
+                    lines.append(
+                        f"{fam.name}_bucket"
+                        f"{self._fmt_labels(c.labels, [('le', '+Inf')])} {n}")
+                    lines.append(
+                        f"{fam.name}_sum{self._fmt_labels(c.labels)} {s}")
+                    lines.append(
+                        f"{fam.name}_count{self._fmt_labels(c.labels)} {n}")
+                else:
+                    lines.append(
+                        f"{fam.name}{self._fmt_labels(c.labels)} {c.value}")
+        return "\n".join(lines) + "\n"
+
+    def snapshot(self) -> dict:
+        """JSON-able point-in-time dump of every series.
+
+        Counters/gauges: ``value``.  Histograms: ``count``/``sum``,
+        per-bucket cumulative counts and approximate p50/p90/p99."""
+        with self._lock:
+            fams = list(self._families.values())
+        out = {"ts": time.time(), "metrics": {}}
+        for fam in fams:
+            series = []
+            for c in fam.children():
+                entry = {"labels": dict(c.labels)}
+                if isinstance(c, Histogram):
+                    with c._lock:
+                        counts = list(c._counts)
+                        entry["sum"] = c._sum
+                        entry["count"] = c._count
+                    cum, acc = {}, 0
+                    for b, cnt in zip(c.buckets, counts):
+                        acc += cnt
+                        cum[f"{b:g}"] = acc
+                    cum["+Inf"] = entry["count"]
+                    entry["buckets"] = cum
+                    for q in (0.5, 0.9, 0.99):
+                        entry[f"p{int(q * 100)}"] = c.quantile(q)
+                else:
+                    entry["value"] = c.value
+                series.append(entry)
+            out["metrics"][fam.name] = {"type": fam.kind, "help": fam.help,
+                                        "unit": fam.unit, "series": series}
+        return out
+
+
+def snapshot_delta(prev: dict, cur: dict) -> dict:
+    """What happened BETWEEN two :meth:`MetricRegistry.snapshot` calls.
+
+    Counters and histogram counts/sums/buckets subtract; gauges keep the
+    current value (a gauge delta is rarely meaningful).  Series absent
+    from ``prev`` are treated as zero."""
+    def key(entry):
+        return tuple(sorted(entry["labels"].items()))
+
+    out = {"ts": cur.get("ts"), "ts_prev": prev.get("ts"), "metrics": {}}
+    pm = prev.get("metrics", {})
+    for name, fam in cur.get("metrics", {}).items():
+        old = {key(e): e for e in pm.get(name, {}).get("series", [])}
+        series = []
+        for e in fam["series"]:
+            o = old.get(key(e), {})
+            d = {"labels": e["labels"]}
+            if fam["type"] == "histogram":
+                d["count"] = e["count"] - o.get("count", 0)
+                d["sum"] = e["sum"] - o.get("sum", 0.0)
+                ob = o.get("buckets", {})
+                d["buckets"] = {b: v - ob.get(b, 0)
+                                for b, v in e["buckets"].items()}
+            elif fam["type"] == "counter":
+                d["value"] = e["value"] - o.get("value", 0.0)
+            else:
+                d["value"] = e["value"]
+            series.append(d)
+        out["metrics"][name] = {"type": fam["type"], "help": fam["help"],
+                                "unit": fam["unit"], "series": series}
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Default (process-wide) registry
+# ---------------------------------------------------------------------------
+
+_default_registry = MetricRegistry(enabled=True)
+
+
+def get_registry() -> MetricRegistry:
+    """The process-wide default registry every built-in instrumentation
+    site records into."""
+    return _default_registry
+
+
+# ---------------------------------------------------------------------------
+# jit-build instrumentation
+# ---------------------------------------------------------------------------
+
+def instrument_jit(fn, site: str, registry: Optional[MetricRegistry] = None,
+                   **labels):
+    """Wrap a ``jax.jit`` callable so every call that triggers a fresh
+    trace+compile is counted (``jit_builds_total{site=...}``) and its
+    wall time recorded (``jit_build_seconds{site=...}``).
+
+    Detection rides the jit function's internal trace cache
+    (``_cache_size`` growing across a call — jax compiles eagerly at
+    call time even though execution is async, so the call's wall clock
+    IS trace+compile+dispatch).  Where ``_cache_size`` is unavailable
+    only the first call is recorded.  The raw jitted function stays on
+    ``wrapped._jit_fn`` (AOT lowering / HLO inspection)."""
+    reg = registry or get_registry()
+    builds = reg.counter(
+        "jit_builds_total",
+        "program trace+compile events per jit-build site").labels(
+            site=site, **labels)
+    seconds = reg.histogram(
+        "jit_build_seconds",
+        "wall time of calls that trace+compile a new program",
+        unit="s").labels(site=site, **labels)
+    state = {"calls": 0}
+
+    def cache_size():
+        try:
+            return fn._cache_size()
+        except Exception:
+            return None
+
+    def wrapped(*a, **k):
+        if not reg.enabled:
+            return fn(*a, **k)
+        n0 = cache_size()
+        t0 = time.perf_counter()
+        out = fn(*a, **k)
+        state["calls"] += 1
+        n1 = cache_size()
+        grew = (n1 > n0) if (n0 is not None and n1 is not None) \
+            else state["calls"] == 1
+        if grew:
+            builds.inc()
+            seconds.observe(time.perf_counter() - t0)
+        return out
+
+    wrapped._jit_fn = fn
+    return wrapped
+
+
+# ---------------------------------------------------------------------------
+# Device health
+# ---------------------------------------------------------------------------
+
+def record_device_memory(registry: Optional[MetricRegistry] = None) -> None:
+    """Sample device-health gauges; every probe is guarded — on a jaxlib
+    without the stats (or with no live backend) this silently records
+    nothing rather than failing the training/serving loop."""
+    reg = registry or get_registry()
+    if not reg.enabled:
+        return
+    try:
+        import jax
+    except Exception:
+        return
+    try:
+        live = jax.live_arrays()
+        reg.gauge("device_live_buffer_count",
+                  "live jax arrays in the process").set(len(live))
+        reg.gauge("device_live_buffer_bytes",
+                  "bytes held by live jax arrays", unit="B").set(
+            sum(getattr(a, "nbytes", 0) for a in live))
+    except Exception:
+        pass
+    try:
+        for d in jax.local_devices():
+            stats = d.memory_stats()
+            if not stats:
+                continue
+            in_use = stats.get("bytes_in_use")
+            if in_use is not None:
+                reg.gauge("device_memory_bytes_in_use",
+                          "PJRT allocator bytes in use", unit="B").labels(
+                    device=str(d.id)).set(in_use)
+            limit = stats.get("bytes_limit")
+            if limit is not None:
+                reg.gauge("device_memory_bytes_limit",
+                          "PJRT allocator byte limit", unit="B").labels(
+                    device=str(d.id)).set(limit)
+    except Exception:
+        pass

@@ -23,6 +23,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..core.jaxcompat import set_mesh as _set_mesh
 from ..core.tensor import Tensor
 from ..nn.layer import Layer
+from ..observability import metrics as _obs
 
 _current_mesh: Optional[Mesh] = None
 
@@ -627,7 +628,11 @@ def make_sharded_train_step(model: Layer, mesh: Mesh,
     scalar_sh = NamedSharding(mesh, P())
 
     def _make_jitted(batch_sh):
-        return jax.jit(
+        # instrument_jit: trace+compile events (count + wall time) land in
+        # jit_builds_total{site=parallel.sharded_train_step} — a step that
+        # silently recompiles mid-run shows up in telemetry, not just as a
+        # mystery stall
+        return _obs.instrument_jit(jax.jit(
             train_step,
             donate_argnums=(0, 1, 2),
             in_shardings=(param_sh, opt_sh, scalar_sh, batch_sh, None, None),
@@ -635,7 +640,7 @@ def make_sharded_train_step(model: Layer, mesh: Mesh,
             # pick a different layout for the updated params, forcing a
             # re-jit (and a second full compile) on the next step.
             out_shardings=(param_sh, opt_sh, scalar_sh, scalar_sh),
-        )
+        ), site="parallel.sharded_train_step")
 
     jitted = _make_jitted((NamedSharding(mesh, bspec),
                            NamedSharding(mesh, bspec)))
@@ -705,6 +710,8 @@ def make_sharded_train_step(model: Layer, mesh: Mesh,
                 for i in range(pp_spec["num_layers"]):
                     param_tensors[f"{prefix}{i}.{rel}"]._set_value(v[i])
 
-    step._jitted = jitted  # exposed for AOT lowering / HLO inspection
+    # exposed for AOT lowering / HLO inspection (the RAW jit function —
+    # the instrumentation wrapper has no .lower)
+    step._jitted = jitted._jit_fn
     step.sync_model = sync_model
     return step, state

@@ -363,10 +363,12 @@ class Engine:
         # Donate only optimizer state: the param buffers are still referenced
         # by the live model's Parameters (same invariant as Optimizer.step,
         # optimizer.py — donating them would invalidate the model mid-fit).
-        return jax.jit(
+        from ..observability import metrics as _obs
+        return _obs.instrument_jit(jax.jit(
             train_step, donate_argnums=(1,),
             in_shardings=(param_sh, opt_sh, None, None, (bsh, bsh)),
-            out_shardings=(param_sh, opt_sh, None, None))
+            out_shardings=(param_sh, opt_sh, None, None)),
+            site="parallel.engine_train_step")
 
     def _build_eval_step(self):
         model, buffers = self.model, self._buffers

@@ -104,6 +104,7 @@ class _Recorder:
 
     def __init__(self):
         self.events = []
+        self.counters = []   # (name, labels_tuple, value, t_ns) samples
         self._lock = threading.Lock()
         self.active = False
 
@@ -113,10 +114,24 @@ class _Recorder:
         with self._lock:
             self.events.append(ev)
 
+    def add_counter(self, name, labels, value, t_ns):
+        """Metric-update sample (armed into observability.metrics as the
+        trace sink while recording) — lands as a chrome "ph":"C" counter
+        event next to the spans."""
+        if not self.active:
+            return
+        with self._lock:
+            self.counters.append((name, labels, value, t_ns))
+
     def drain(self):
         with self._lock:
             evs, self.events = self.events, []
         return evs
+
+    def drain_counters(self):
+        with self._lock:
+            cs, self.counters = self.counters, []
+        return cs
 
 
 _recorder = _Recorder()
@@ -175,6 +190,20 @@ def export_chrome_tracing(dir_name: str, worker_name: Optional[str] = None):
                 "pid": os.getpid(), "tid": ev.tid,
                 "ts": ev.start / 1000.0,       # ns -> us
                 "dur": (ev.end - ev.start) / 1000.0,
+            })
+        # registry counters/gauges sampled while recording: chrome counter
+        # rows ("ph":"C") on the same timeline as the spans.  Label sets
+        # render into the event name so each series gets its own row;
+        # the value rides args (chrome plots every args key as a series).
+        for cname, labels, value, t_ns in getattr(prof, "_counter_events",
+                                                  ()):
+            if labels:
+                cname = cname + "{" + ",".join(
+                    f"{k}={v}" for k, v in labels) + "}"
+            events.append({
+                "name": cname, "ph": "C", "cat": "Metric",
+                "pid": os.getpid(), "ts": t_ns / 1000.0,
+                "args": {"value": value},
             })
         with open(path, "w") as f:
             json.dump({"traceEvents": events,
@@ -239,6 +268,7 @@ class Profiler:
         self.step_num = 0
         self.current_state = ProfilerState.CLOSED
         self._events = []
+        self._counter_events = []
         self._last_export = None
         self._device_dir = None
         self._device_active = False
@@ -290,6 +320,12 @@ class Profiler:
     def _start_record(self):
         _recorder.active = True
         _autograd._profiler_hook = _op_hook
+        # mirror registry counter/gauge updates onto the trace timeline
+        try:
+            from ..observability import metrics as _metrics
+            _metrics.set_trace_sink(_recorder.add_counter)
+        except Exception:
+            pass
         # also arm the native host tracer (C++ workqueue/dataloader spans)
         try:
             from ..core import native as _native
@@ -311,7 +347,13 @@ class Profiler:
     def _stop_record(self):
         _autograd._profiler_hook = None
         _recorder.active = False
+        try:
+            from ..observability import metrics as _metrics
+            _metrics.set_trace_sink(None)
+        except Exception:
+            pass
         self._events = _recorder.drain()
+        self._counter_events = _recorder.drain_counters()
         # drain native host-tracer events into the same stream
         try:
             from ..core import native as _native

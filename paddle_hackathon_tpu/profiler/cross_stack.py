@@ -29,9 +29,32 @@ def _load(path: str) -> dict:
         return json.load(f)
 
 
-def _rank_of(path: str, idx: int) -> int:
+def _rank_of(path: str) -> Optional[int]:
     m = re.search(r"(?:rank|worker|trainer)[_-]?(\d+)", os.path.basename(path))
-    return int(m.group(1)) if m else idx
+    return int(m.group(1)) if m else None
+
+
+def _assign_ranks(ordered: List[str]) -> List[int]:
+    """Deterministic pid per trace file.  Named files (rank0/worker1/...)
+    keep their encoded rank; unnamed files take the smallest free pids in
+    sorted-path order — a mixed named/unnamed merge must NOT silently
+    renumber the named ranks (the old behavior: ANY collision between a
+    named rank and an unnamed file's positional index threw away every
+    name).  Only when the named files themselves collide (two files both
+    claiming rank 1) is positional numbering the honest fallback."""
+    ranks = [_rank_of(p) for p in ordered]
+    named = [r for r in ranks if r is not None]
+    if len(set(named)) != len(named):
+        return list(range(len(ordered)))
+    used = set(named)
+    nxt = 0
+    for i, r in enumerate(ranks):
+        if r is None:
+            while nxt in used:
+                nxt += 1
+            ranks[i] = nxt
+            used.add(nxt)
+    return ranks
 
 
 def merge_traces(paths: List[str], align_marker: Optional[str] = None,
@@ -45,10 +68,7 @@ def merge_traces(paths: List[str], align_marker: Optional[str] = None,
     """
     merged = {"traceEvents": [], "displayTimeUnit": "ms"}
     ordered = sorted(paths)
-    ranks = [_rank_of(p_, i) for i, p_ in enumerate(ordered)]
-    if len(set(ranks)) != len(ranks):
-        # mixed named/unnamed files collided — fall back to positional pids
-        ranks = list(range(len(ordered)))
+    ranks = _assign_ranks(ordered)
     for idx, path in enumerate(ordered):
         rank = ranks[idx]
         trace = _load(path)
@@ -58,8 +78,11 @@ def merge_traces(paths: List[str], align_marker: Optional[str] = None,
             events = trace.get("traceEvents", [])
         t0 = 0.0
         if align_marker is not None:
+            # span events only: a counter series ("ph":"C") that happens
+            # to share the marker's name must not skew the alignment
             starts = [e["ts"] for e in events
-                      if e.get("name") == align_marker and "ts" in e]
+                      if e.get("name") == align_marker and "ts" in e
+                      and e.get("ph") not in ("C", "M")]
             if starts:
                 t0 = min(starts)
             else:

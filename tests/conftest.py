@@ -72,7 +72,8 @@ _FILE_COST = {
     "test_api_roundout.py": 10, "test_ops.py": 11, "test_ps.py": 12,
     "test_static_nn.py": 12, "test_dataset_reader.py": 12,
     "test_strategies.py": 13, "test_fused_cache.py": 13,
-    "test_hapi_compiled_fit.py": 15, "test_moment_dtype.py": 16,
+    "test_hapi_compiled_fit.py": 15, "test_observability.py": 15,
+    "test_moment_dtype.py": 16,
     "test_optimizer.py": 17, "test_sharded_lamb.py": 18,
     "test_native_serving.py": 20, "test_native.py": 20, "test_nn.py": 22,
     "test_launch_elastic.py": 26, "test_pipeline_layer.py": 26,

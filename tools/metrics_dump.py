@@ -1,0 +1,121 @@
+"""Pretty-print or diff metrics-registry snapshots.
+
+A snapshot is the JSON written by ``MetricRegistry.snapshot()`` — e.g.
+the file ``hapi.callbacks.MetricsCallback(snapshot_path=...)`` drops at
+``on_train_end``, or one saved by hand::
+
+    import json
+    from paddle_hackathon_tpu.observability import get_registry
+    json.dump(get_registry().snapshot(), open("snap.json", "w"))
+
+Usage::
+
+    python tools/metrics_dump.py snap.json            # pretty-print
+    python tools/metrics_dump.py before.json after.json   # diff
+
+The diff subtracts counters and histogram counts/sums (what HAPPENED
+between the snapshots) and shows gauges as old -> new; bench rows'
+embedded ``"metrics"`` dicts are a separate compact format gated by
+``tools/perf_gate.py``, not this tool's input.
+"""
+
+import argparse
+import json
+import sys
+
+
+def _labels(d):
+    if not d:
+        return ""
+    return "{" + ",".join(f"{k}={v}" for k, v in sorted(d.items())) + "}"
+
+
+def _fmt(v):
+    if v is None:
+        return "-"
+    if isinstance(v, float) and v != int(v):
+        return f"{v:.6g}"
+    return f"{int(v):,}"
+
+
+def render(snap, out=sys.stdout):
+    """One aligned line per series: NAME{labels} TYPE VALUE [detail]."""
+    rows = []
+    for name, fam in sorted(snap.get("metrics", {}).items()):
+        for s in fam["series"]:
+            key = name + _labels(s.get("labels"))
+            if fam["type"] == "histogram":
+                detail = (f"count={_fmt(s.get('count'))} "
+                          f"sum={_fmt(s.get('sum'))}")
+                for q in ("p50", "p90", "p99"):
+                    if s.get(q) is not None:
+                        detail += f" {q}={s[q]:.6g}"
+                rows.append((key, fam["type"], detail))
+            else:
+                rows.append((key, fam["type"], _fmt(s.get("value"))))
+    width = max((len(r[0]) for r in rows), default=0)
+    for key, kind, val in rows:
+        out.write(f"{key:<{width}}  {kind:<9}  {val}\n")
+    return len(rows)
+
+
+def render_diff(prev, cur, out=sys.stdout):
+    """Changed series only, prev -> cur (via observability.snapshot_delta
+    for the counter/histogram subtraction semantics)."""
+    sys.path.insert(0, __file__.rsplit("/", 2)[0])
+    from paddle_hackathon_tpu.observability import snapshot_delta
+    delta = snapshot_delta(prev, cur)
+    pm = prev.get("metrics", {})
+
+    def prev_series(name, labels):
+        for s in pm.get(name, {}).get("series", []):
+            if s.get("labels", {}) == labels:
+                return s
+        return {}
+
+    rows = []
+    for name, fam in sorted(delta["metrics"].items()):
+        for s in fam["series"]:
+            key = name + _labels(s.get("labels"))
+            if fam["type"] == "histogram":
+                if not s["count"]:
+                    continue
+                rows.append((key, f"+{_fmt(s['count'])} obs",
+                             f"sum +{s['sum']:.6g}"))
+            elif fam["type"] == "counter":
+                if not s["value"]:
+                    continue
+                rows.append((key, f"+{_fmt(s['value'])}", ""))
+            else:
+                old = prev_series(name, s.get("labels", {})).get("value")
+                if old == s["value"]:
+                    continue
+                rows.append((key, f"{_fmt(old)} -> {_fmt(s['value'])}", ""))
+    width = max((len(r[0]) for r in rows), default=0)
+    for key, change, extra in rows:
+        out.write(f"{key:<{width}}  {change}{'  ' + extra if extra else ''}\n")
+    if not rows:
+        out.write("(no changes)\n")
+    return len(rows)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="pretty-print one metrics snapshot, or diff two")
+    ap.add_argument("snapshot", help="registry snapshot JSON")
+    ap.add_argument("snapshot2", nargs="?",
+                    help="later snapshot: show what changed in between")
+    args = ap.parse_args(argv)
+    with open(args.snapshot) as f:
+        snap = json.load(f)
+    if args.snapshot2 is None:
+        render(snap)
+        return 0
+    with open(args.snapshot2) as f:
+        snap2 = json.load(f)
+    render_diff(snap, snap2)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -13,6 +13,12 @@ Two checks:
    when any op regresses more than ``--op-tolerance`` (default 25%; op
    microbenchmarks are noisy through the axon tunnel).
 
+The ``--suite`` run additionally checks the telemetry each bench row
+embeds (``"metrics"``, from the observability registry): a serving row
+whose jit-build count grew between the warm phase and the measured
+steady-state phase recompiled mid-run and fails the gate
+(``compare_metrics``).
+
 Usage::
 
     python tools/perf_gate.py                 # model gate only (fast)
@@ -153,6 +159,21 @@ def compare_ratios(rows):
     return bad
 
 
+def compare_metrics(rows):
+    """[(metric, warm, total)] for rows whose embedded telemetry shows
+    jit builds GROWING between the warm (prefill + compile) phase and the
+    measured steady-state phase — a program recompiled mid-run.  The
+    serving bench rows embed ``metrics.jit_builds_warm/total`` (bench.py)
+    exactly for this tripwire; rows without the keys are skipped."""
+    bad = []
+    for r in rows:
+        m = r.get("metrics") or {}
+        warm, total = m.get("jit_builds_warm"), m.get("jit_builds_total")
+        if warm is not None and total is not None and total > warm:
+            bad.append((r["metric"], int(warm), int(total)))
+    return bad
+
+
 def suite_gate(tolerance, rows=None):
     """Gate EVERY BASELINE.md model config (ERNIE/1.3B/long-context/
     ResNet + gpt2) against the committed best values — the round-2 gate
@@ -175,7 +196,8 @@ def suite_gate(tolerance, rows=None):
                 if line.startswith("{")]
     bad = compare_suite(baseline, rows, tolerance)
     bad_ratio = compare_ratios(rows)
-    if bad or bad_ratio:
+    bad_metrics = compare_metrics(rows)
+    if bad or bad_ratio or bad_metrics:
         if bad:
             print(f"perf_gate[suite] FAIL: {len(bad)} configs regressed "
                   f">{tolerance:.0%}:")
@@ -185,10 +207,15 @@ def suite_gate(tolerance, rows=None):
         for metric, ref, ratio, floor in bad_ratio:
             print(f"perf_gate[suite] FAIL: {metric} at {ratio:.2f}x of "
                   f"{ref} (floor {floor:.2f}x)")
+        for metric, warm, total in bad_metrics:
+            print(f"perf_gate[suite] FAIL: {metric} recompiled in steady "
+                  f"state ({warm} jit builds after warm-up, {total} after "
+                  f"the measured run)")
         return 1
     print(f"perf_gate[suite] PASS: {len(baseline)} configs within "
           f"{tolerance:.0%} of the committed baseline; "
-          f"{len(RATIO_GATES)} ratio gates hold")
+          f"{len(RATIO_GATES)} ratio gates hold; no steady-state "
+          f"recompilation")
     return 0
 
 
