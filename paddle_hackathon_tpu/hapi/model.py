@@ -109,7 +109,7 @@ class Model:
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
             drop_last=False, shuffle=True, num_workers=0, callbacks=None,
             accumulate_grad_batches=1, num_iters=None, jit_compile=None,
-            steps_per_execution=1, prefetch_buffer=2):
+            steps_per_execution=1, prefetch_buffer=2, nan_policy="record"):
         """Train loop.  ``jit_compile=None`` (default) tries the compiled
         fast path — one donated jitted program per step (see
         ``hapi/compiled.py``) — and falls back to the eager
@@ -122,7 +122,15 @@ class Model:
         setting ``stop_training`` mid-window stops AFTER the window's
         remaining updates already ran — stop granularity is K steps).
         ``prefetch_buffer`` batches are staged onto the device ahead of
-        compute (``io.device_prefetch``)."""
+        compute (``io.device_prefetch``).
+
+        ``nan_policy``: the non-finite-loss watchdog, checked at the
+        sync points the loop already pays (``log_freq`` loss fetches,
+        epoch end) so it costs no extra device round trip.  A NaN/Inf
+        loss always increments ``train_nonfinite_total`` and records a
+        flight-recorder event; ``"raise"`` additionally aborts with a
+        clear error instead of silently training on garbage (default
+        ``"record"``: keep going — some recipes ride through spikes)."""
         train_loader = self._to_loader(train_data, batch_size, shuffle,
                                        drop_last, num_workers)
         eval_loader = (self._to_loader(eval_data, batch_size, False, False,
@@ -139,6 +147,9 @@ class Model:
             steps = None
         cbk.set_params({"epochs": epochs, "steps": steps, "verbose": verbose})
 
+        if nan_policy not in ("record", "raise"):
+            raise ValueError(
+                f"nan_policy must be 'record' or 'raise', got {nan_policy!r}")
         trainer = None
         if jit_compile is not False:
             from .compiled import CompiledTrainer, unsupported_reason
@@ -155,37 +166,63 @@ class Model:
         self._fit_used_compiled = trainer is not None
 
         self.stop_training = False
-        cbk.on_train_begin()
-        for epoch in range(epochs):
-            cbk.on_epoch_begin(epoch)
-            for m in self._metrics:
-                m.reset()
-            logs = {}
-            if trainer is not None:
-                logs, trainer = self._run_compiled_epoch(
-                    trainer, train_loader, cbk, log_freq, num_iters,
-                    steps_per_execution, prefetch_buffer)
-                self._fit_used_compiled = trainer is not None
-            else:
-                for step, batch in enumerate(train_loader):
-                    if num_iters is not None and step >= num_iters:
-                        break
-                    cbk.on_train_batch_begin(step)
-                    ins, lbs = self._split_batch(batch)
-                    update = ((step + 1) % accumulate_grad_batches == 0)
-                    res = self.train_batch(ins, lbs, update=update)
-                    logs = self._pack_logs(res)
-                    cbk.on_train_batch_end(step, logs)
-                    if self.stop_training:
-                        break
-            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
-                eval_logs = self.evaluate(eval_loader, verbose=0,
-                                          _callbacks=cbk)
-                logs.update({f"eval_{k}": v for k, v in eval_logs.items()})
-            cbk.on_epoch_end(epoch, logs)
-            if self.stop_training:
-                break
-        cbk.on_train_end(logs)
+        logs = {}   # epochs=0: on_train_end still needs a value
+        try:
+            cbk.on_train_begin()
+            for epoch in range(epochs):
+                cbk.on_epoch_begin(epoch)
+                for m in self._metrics:
+                    m.reset()
+                logs = {}
+                if trainer is not None:
+                    logs, trainer = self._run_compiled_epoch(
+                        trainer, train_loader, cbk, log_freq, num_iters,
+                        steps_per_execution, prefetch_buffer, nan_policy)
+                    self._fit_used_compiled = trainer is not None
+                else:
+                    from ..observability import tracing as _tr
+                    for step, batch in enumerate(train_loader):
+                        if num_iters is not None and step >= num_iters:
+                            break
+                        cbk.on_train_batch_begin(step)
+                        ins, lbs = self._split_batch(batch)
+                        update = ((step + 1) % accumulate_grad_batches == 0)
+                        res = self.train_batch(ins, lbs, update=update)
+                        logs = self._pack_logs(res)
+                        # eager losses are already host floats
+                        # (train_batch float()s them): watch EVERY step —
+                        # no log_freq=0 hole, no missed epoch tail
+                        self._watch_nonfinite(logs.get("loss"), step,
+                                              "hapi_eager", nan_policy)
+                        # eager steps are host-synced, so each is a real
+                        # liveness signal — without one a wedged eager
+                        # fit never trips /healthz?max_age (an absent
+                        # beacon passes; only a stale one alerts)
+                        _tr.heartbeat("train.hapi_fit")
+                        cbk.on_train_batch_end(step, logs)
+                        if self.stop_training:
+                            break
+                if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                    eval_logs = self.evaluate(eval_loader, verbose=0,
+                                              _callbacks=cbk)
+                    logs.update({f"eval_{k}": v
+                                 for k, v in eval_logs.items()})
+                cbk.on_epoch_end(epoch, logs)
+                if self.stop_training:
+                    break
+            cbk.on_train_end(logs)
+            # clean completion: a finished fit must not leave a
+            # forever-stale beacon 503ing /healthz?max_age (a crashed
+            # fit keeps its beacon — going stale IS the alert)
+            from ..observability import tracing as _tr_
+            _tr_.remove_beacon("train.hapi_fit")
+        except BaseException as e:
+            # every crashed fit leaves a post-mortem: the flight ring
+            # holds the recent step/telemetry events (and the watchdog's
+            # nonfinite marks) that led up to the failure
+            from ..observability import flight as _flight
+            _flight.crash_dump("hapi.Model.fit", e)
+            raise
         return logs
 
     def _log_fallback_once(self, msg):
@@ -194,8 +231,35 @@ class Model:
             import warnings
             warnings.warn(msg, RuntimeWarning, stacklevel=3)
 
+    def _watch_nonfinite(self, value, step, path, nan_policy):
+        """Non-finite training watchdog (``fit(nan_policy=...)``): runs
+        only at sync points where the loss is already on the host, so it
+        never adds a device round trip.  Counts + flight-records every
+        NaN/Inf; ``nan_policy='raise'`` aborts with a clear error."""
+        import math
+        try:
+            v = float(value)
+        except (TypeError, ValueError):
+            return
+        if math.isfinite(v):
+            return
+        from ..observability import flight as _flight
+        from ..observability import metrics as _obs
+        _obs.get_registry().counter(
+            "train_nonfinite_total",
+            "non-finite (NaN/Inf) losses seen at fit sync points").labels(
+                path=path).inc()
+        _flight.get_flight_recorder().record(
+            "train.nonfinite", path=path, step=int(step), loss=repr(v))
+        if nan_policy == "raise":
+            raise FloatingPointError(
+                f"Model.fit: loss is non-finite ({v}) at step {step} — "
+                "aborting instead of training on garbage (check the "
+                "learning rate / data; nan_policy='record' continues "
+                "and only counts)")
+
     def _run_compiled_epoch(self, trainer, loader, cbk, log_freq, num_iters,
-                            k, prefetch_buffer):
+                            k, prefetch_buffer, nan_policy="record"):
         """One epoch through the compiled trainer.  Returns
         ``(logs, trainer_or_None)`` — None when the first program trace
         failed (Python-side control flow in forward, unjittable op) and
@@ -209,6 +273,7 @@ class Model:
 
         from ..io.dataloader import device_prefetch
         from ..observability import metrics as _obs
+        from ..observability import tracing as _tr
 
         # step-time/throughput telemetry rides the sync points the loop
         # ALREADY pays (the log_freq loss fetch and the epoch-end
@@ -229,6 +294,7 @@ class Model:
 
         def _telemetry_tick():
             nonlocal _t_mark, _steps_since, _tokens_since
+            _tr.heartbeat("train.hapi_fit")   # /healthz last-step recency
             now = time.perf_counter()
             if _t_mark is not None and _steps_since:
                 dt = now - _t_mark
@@ -272,10 +338,12 @@ class Model:
                 yield (xs, ys)
 
         step = 0
+        last_watched = -1   # last step index the watchdog already saw
         logs = {}
         last = None
         groups = device_prefetch(host_groups(), size=prefetch_buffer)
         for xs, ys in groups:
+            t0n = time.perf_counter_ns()
             try:
                 losses = trainer.run(xs, ys)
             except Exception as e:  # noqa: BLE001 — unjittable network
@@ -296,6 +364,10 @@ class Model:
                         res = self.train_batch([Tensor(x[j]) for x in exs],
                                                [Tensor(y[j]) for y in eys])
                         logs = self._pack_logs(res)
+                        # host floats already — watch every replayed step
+                        self._watch_nonfinite(logs.get("loss"), step,
+                                              "hapi_eager", nan_policy)
+                        _tr.heartbeat("train.hapi_fit")
                         cbk.on_train_batch_end(step, logs)
                         step += 1
                         if self.stop_training:
@@ -303,6 +375,12 @@ class Model:
                     if self.stop_training:
                         break
                 return logs, None
+            if _tr.tracing_enabled():
+                # dispatch wall of the K-step donated program (first call
+                # includes trace+compile; the async device time shows up
+                # in the loss_fetch spans instead)
+                _tr.add_span("hapi.fit.superstep", t0n,
+                             time.perf_counter_ns(), step=step, k=k)
             lead = jax.tree.leaves(xs)[0]   # (K, B, ...) stacked batches
             # tokens = B*S only for token batches (K, B, S); any other
             # rank (vision NCHW etc.) counts samples — shape[2] would be
@@ -319,7 +397,16 @@ class Model:
                 # device scalar (float()-able on demand)
                 v = losses[j]
                 if log_freq and step % log_freq == 0:
+                    tf0 = time.perf_counter_ns()
                     v = float(v)
+                    if _tr.tracing_enabled():
+                        # host wait for the async device pipeline to
+                        # deliver this step's loss scalar
+                        _tr.add_span("hapi.fit.loss_fetch", tf0,
+                                     time.perf_counter_ns(), step=step)
+                    self._watch_nonfinite(v, step, "hapi_compiled",
+                                          nan_policy)
+                    last_watched = step
                     _telemetry_tick()
                 logs = {"loss": v}
                 cbk.on_train_batch_end(step, logs)
@@ -333,9 +420,19 @@ class Model:
             # epoch-end sync; report the loss of the last step callbacks
             # actually saw (a mid-window stop must not report past it)
             losses, j = last
+            tf0 = time.perf_counter_ns()
             jax.block_until_ready(losses)
+            if _tr.tracing_enabled():
+                _tr.add_span("hapi.fit.loss_fetch", tf0,
+                             time.perf_counter_ns(), step=step - 1,
+                             epoch_end=True)
             _telemetry_tick()
             logs = {"loss": float(losses[j])}
+            if step - 1 != last_watched:
+                # skip when the final step already hit a log_freq fetch:
+                # one bad step must count once, not twice
+                self._watch_nonfinite(logs["loss"], step - 1,
+                                      "hapi_compiled", nan_policy)
         trainer.sync_optimizer()
         return logs, trainer
 

@@ -39,9 +39,12 @@ from typing import List, Optional
 
 import numpy as np
 
+from ..observability import flight as _flight
 from ..observability import metrics as _obs
+from ..observability import tracing as _tr
 
 _ENGINE_IDS = itertools.count()
+_REQ_IDS = itertools.count()
 
 
 class _EngineStats(collections.abc.Mapping):
@@ -157,10 +160,12 @@ class Request:
 
     __slots__ = ("prompt", "max_new_tokens", "tokens", "done", "error",
                  "temperature", "top_k", "top_p", "_event",
-                 "_t_submit", "_t_first")
+                 "_t_submit", "_t_first", "rid", "_span_queue",
+                 "_span_life")
 
     def __init__(self, prompt, max_new_tokens, temperature=None,
                  top_k=None, top_p=None):
+        self.rid = next(_REQ_IDS)   # process-wide request id (spans/flight)
         self.prompt = np.asarray(prompt, np.int32).reshape(-1)
         self.max_new_tokens = int(max_new_tokens)
         self.temperature = None if temperature is None else float(temperature)
@@ -172,6 +177,9 @@ class Request:
         self._event = threading.Event()
         self._t_submit = time.perf_counter()   # TTFT/e2e reference point
         self._t_first: Optional[float] = None  # first generated token
+        # lifecycle spans (no-ops while tracing is disabled): queued =
+        # submit->admit, life = submit->finish/EOS
+        self._span_queue = self._span_life = _tr._NOOP
 
     def wait(self, timeout=None):
         self._event.wait(timeout)
@@ -340,6 +348,12 @@ class ServingEngine:
             "slots holding an active request this tick").labels(**lbl)
         self._g_queue = reg.gauge(
             "serving_queue_depth", "requests waiting for a slot").labels(**lbl)
+        # event-level observability: always-on flight ring (request
+        # lifecycle marks + tick summaries feed the crash post-mortem)
+        # and the /debug/requests slot table (weakly registered — a
+        # dropped engine vanishes from the endpoint)
+        self._flight = _flight.get_flight_recorder()
+        _tr.register_introspection_source(self._engine_id, self)
 
     # ------------------------------------------------------------------
     @staticmethod
@@ -785,6 +799,14 @@ class ServingEngine:
             raise ValueError(
                 f"request needs {need} positions; the model's "
                 f"max_position_embeddings is {max_pos}")
+        req._span_life = _tr.start_span(
+            "serving.request", rid=req.rid, engine=self._engine_id,
+            prompt_len=len(req.prompt), max_new=req.max_new_tokens)
+        req._span_queue = _tr.start_span(
+            "serving.request.queued", rid=req.rid, engine=self._engine_id)
+        self._flight.record(
+            "req", phase="submit", rid=req.rid, engine=self._engine_id,
+            prompt_len=len(req.prompt), max_new=req.max_new_tokens)
         with self._lock:
             self._pending.append(req)
             self._c["requests"].inc()
@@ -816,10 +838,15 @@ class ServingEngine:
         for i, slot in enumerate(self._slots):
             if slot.req is not None or not self._pending:
                 continue
-            slot.req = self._pending.popleft()
+            slot.req = req = self._pending.popleft()
             slot.off = 0
             slot.last = 0
             self._lengths[i] = 0
+            req._span_queue.end(slot=i)
+            self._flight.record(
+                "req", phase="admit", rid=req.rid, engine=self._engine_id,
+                slot=i,
+                queue_s=round(time.perf_counter() - req._t_submit, 6))
 
     def _stage(self):
         """Build (tokens, starts, nvalid, consumed, finishing) for this
@@ -858,6 +885,11 @@ class ServingEngine:
         if req._t_first is not None and len(req.tokens) > 1:
             self._h_tpot.observe(
                 (now - req._t_first) / (len(req.tokens) - 1))
+        req._span_life.end(slot=slot_idx, tokens=len(req.tokens))
+        self._flight.record(
+            "req", phase="finish", rid=req.rid, engine=self._engine_id,
+            slot=slot_idx, tokens=len(req.tokens),
+            e2e_s=round(now - req._t_submit, 6))
         req._event.set()
 
     def _commit_token(self, i, tok):
@@ -886,15 +918,48 @@ class ServingEngine:
         Single-driver contract: while the auto_run loop is live, only the
         loop thread may tick — a second driver would re-enter the jitted
         tick with the DONATED cache buffers the in-flight call already
-        invalidated (crash/corruption), so it raises instead."""
+        invalidated (crash/corruption), so it raises instead.
+
+        An escaping exception writes the flight-recorder ring to disk
+        first (``observability/flight.py``): the dump carries the recent
+        tick summaries and the failing requests' lifecycle events —
+        the post-mortem an aggregate counter cannot give."""
+        try:
+            return self._step_impl()
+        except BaseException as e:
+            # the single-driver guard raise is a usage error, not an
+            # engine crash: a caller retrying step() against a live
+            # auto_run loop must not flood $PHT_FLIGHT_DIR with dumps
+            # (or evict the ring's real history with 'crash' events)
+            if not getattr(e, "_pht_usage_error", False):
+                _flight.crash_dump(f"serving.step[{self._engine_id}]", e)
+            raise
+
+    def _after_tick(self, flavor, t0n, t1n, committed, **extra):
+        """Per-tick event-level bookkeeping (all modes): the liveness
+        beacon /healthz reads, the always-on flight tick summary, and —
+        only while tracing is armed — the tick-level span."""
+        _tr.heartbeat(f"serving.{self._engine_id}")
+        self._flight.record(
+            "tick", engine=self._engine_id, flavor=flavor,
+            tickno=self._tickno, dur_us=(t1n - t0n) // 1000,
+            committed=committed, **extra)
+        if _tr.tracing_enabled():
+            _tr.add_span(f"serving.tick.{flavor}", t0n, t1n,
+                         engine=self._engine_id, tickno=self._tickno,
+                         committed=committed, **extra)
+
+    def _step_impl(self) -> bool:
         with self._lock:
             if self._running and \
                     threading.current_thread() is not self._loop_thread:
-                raise RuntimeError(
+                err = RuntimeError(
                     "engine is being driven by its auto_run loop; "
                     "step()/run_until_idle() from another thread would "
                     "re-enter the tick with donated caches — wait for the "
                     "loop to drain (shutdown()) instead")
+                err._pht_usage_error = True   # step(): no crash dump
+                raise err
             self._admit()
             self._g_queue.set(len(self._pending))
             self._g_occupancy.set(
@@ -928,13 +993,16 @@ class ServingEngine:
                 tokens, starts, nvalid, consumed, finishing = self._stage()
 
         if mode == "pp":
-            t0 = time.perf_counter()
+            t0n = time.perf_counter_ns()
             nxt = self._run_pp_tick(tokens, starts, nvalid, sampling)
-            self._h_tick["pp"].observe(time.perf_counter() - t0)
+            t1n = time.perf_counter_ns()
+            self._h_tick["pp"].observe((t1n - t0n) / 1e9)
             with self._lock:
                 self._tickno += 1
                 self._c["ticks"].inc()
-                self._commit_pp_exit_locked(exit_wave, nxt)
+                committed = self._commit_pp_exit_locked(exit_wave, nxt)
+                self._after_tick("pp", t0n, t1n, committed,
+                                 exit_wave=int(exit_wave))
             return True
         if mode == "spec":
             # draft-and-verify: slot state is stable outside the lock
@@ -952,20 +1020,23 @@ class ServingEngine:
                 mode = "multi"
         if mode == "spec":
             toks = np.concatenate([last_toks[:, None], drafts], axis=1)
-            t0 = time.perf_counter()
+            t0n = time.perf_counter_ns()
             out = self._run_tick_spec(toks, starts, sampling)
-            self._h_tick["spec"].observe(time.perf_counter() - t0)
+            t1n = time.perf_counter_ns()
+            self._h_tick["spec"].observe((t1n - t0n) / 1e9)
             from ..nn.decode import accept_lengths
             acc = accept_lengths(drafts, ndraft, out)
             with self._lock:
                 self._tickno += 1
                 self._c["ticks"].inc()
                 self._c["spec_ticks"].inc()
-                tick_drafted = tick_accepted = 0
+                tron = _tr.tracing_enabled()
+                tick_drafted = tick_accepted = tick_committed = 0
                 nvalid = np.zeros(self.max_slots, np.int32)
                 for i, slot in enumerate(self._slots):
                     if slot.req is None:
                         continue
+                    rid = slot.req.rid
                     rem = slot.req.max_new_tokens - len(slot.req.tokens)
                     adv = int(acc[i]) + 1
                     nvalid[i] = adv
@@ -986,28 +1057,51 @@ class ServingEngine:
                     self._c["spec_accepted"].inc(a)
                     tick_drafted += d
                     tick_accepted += a
+                    tick_committed += committed
+                    if tron:
+                        # each slot's share of the fused verify tick on
+                        # its own lane: request id + acceptance outcome
+                        _tr.add_span("serving.spec_verify", t0n, t1n,
+                                     _tid=i, rid=rid, slot=i, drafted=d,
+                                     accepted=a, committed=committed)
                 if tick_drafted:
                     self._h_accept.observe(tick_accepted / tick_drafted)
+                self._after_tick("spec", t0n, t1n, tick_committed,
+                                 drafted=tick_drafted,
+                                 accepted=tick_accepted)
             if getattr(self._spec, "ingest_after_verify", True):
                 # self-ingesting drafters (ModelDrafter) already wrote
                 # these rows into their own cache during propose()
                 self._spec.ingest(toks, starts, nvalid)
             return True
         if mode == "multi":
-            t0 = time.perf_counter()
+            t0n = time.perf_counter_ns()
             out = self._run_tick_multi(last_toks, starts, sampling)
-            self._h_tick["decode"].observe(time.perf_counter() - t0)
+            t1n = time.perf_counter_ns()
+            self._h_tick["decode"].observe((t1n - t0n) / 1e9)
             with self._lock:
                 self._tickno += 1
                 self._c["ticks"].inc()
+                tron = _tr.tracing_enabled()
+                tick_committed = 0
                 M = self._decode_window
                 for i, slot in enumerate(self._slots):
                     if slot.req is None:
                         continue
+                    rid = slot.req.rid
+                    committed = 0
                     self._lengths[i] += M
                     for t in range(M):
+                        committed += 1
                         if self._commit_token(i, int(out[i, t])):
                             break  # freed; later window tokens discarded
+                    tick_committed += committed
+                    if tron:
+                        _tr.add_span("serving.decode", t0n, t1n, _tid=i,
+                                     rid=rid, slot=i, window=M,
+                                     committed=committed)
+                self._after_tick("decode", t0n, t1n, tick_committed,
+                                 window=M)
             if self._spec is not None:
                 # an all-sampling window can still precede a greedy
                 # request: mirror the M cache rows the window wrote so
@@ -1018,20 +1112,33 @@ class ServingEngine:
                 self._spec.ingest(chunk, starts,
                                   np.where(active, M, 0).astype(np.int32))
             return True
-        t0 = time.perf_counter()
+        t0n = time.perf_counter_ns()
         nxt = self._run_tick(tokens, starts, nvalid, sampling)
-        self._h_tick["prefill"].observe(time.perf_counter() - t0)
+        t1n = time.perf_counter_ns()
+        self._h_tick["prefill"].observe((t1n - t0n) / 1e9)
         with self._lock:
             self._tickno += 1
             self._c["ticks"].inc()
+            tron = _tr.tracing_enabled()
+            tick_committed = 0
             for i, slot in enumerate(self._slots):
                 if slot.req is None:
                     continue
-                if slot.off < len(slot.req.prompt):
+                rid = slot.req.rid
+                was_prefill = slot.off < len(slot.req.prompt)
+                if was_prefill:
                     slot.off += int(consumed[i])
                 self._lengths[i] += int(consumed[i])
                 if finishing[i]:
                     self._commit_token(i, int(nxt[i]))
+                    tick_committed += 1
+                if tron:
+                    _tr.add_span(
+                        "serving.prefill_chunk" if was_prefill
+                        else "serving.decode",
+                        t0n, t1n, _tid=i, rid=rid, slot=i,
+                        tokens=int(consumed[i]))
+            self._after_tick("prefill", t0n, t1n, tick_committed)
         if self._spec is not None:
             # keep the drafter's mirror in sync with what the chunk tick
             # wrote (prefill chunks and the 1-wide decode feeds alike)
@@ -1067,9 +1174,11 @@ class ServingEngine:
         return tokens, starts, nvalid, exit_wave
 
     def _commit_pp_exit_locked(self, exit_wave, nxt):
+        """Advance the exiting wave's slots; returns tokens committed."""
         rec = self._inflight.pop(exit_wave, None)
         if rec is None:
-            return
+            return 0
+        committed = 0
         consumed_e, finishing_e, reqs_e = rec
         lo, hi = exit_wave * self._wave, (exit_wave + 1) * self._wave
         for i in range(lo, hi):
@@ -1083,39 +1192,89 @@ class ServingEngine:
             self._lengths[i] += int(consumed_e[i])
             if finishing_e[i]:
                 self._commit_token(i, int(nxt[i]))
+                committed += 1
+        return committed
 
     def _loop(self):
         while True:
             try:
-                busy = self.step()
+                # _step_impl, not step(): the loop writes its own crash
+                # dump below AFTER the fail-all marks, so the on-disk
+                # post-mortem carries the failing requests' terminal
+                # events (step()'s dump would fire before them)
+                busy = self._step_impl()
             except BaseException as e:  # noqa: BLE001 — a dead loop with
                 # _running stuck True would hang every current AND future
                 # request; fail them all with the cause instead (donated
                 # caches may be gone, so the engine is not reusable)
                 with self._lock:
-                    for req in list(self._pending):
+                    def _fail(req, where):
                         req.error = e
+                        # close the lifecycle spans (no-ops when tracing
+                        # is off) and leave a terminal flight mark — the
+                        # failing requests are the ones a post-mortem
+                        # most needs to see
+                        req._span_queue.end(error=type(e).__name__)
+                        req._span_life.end(error=type(e).__name__)
+                        self._flight.record(
+                            "req", phase="fail", rid=req.rid,
+                            engine=self._engine_id, where=where,
+                            error=type(e).__name__)
                         req._event.set()
+                    for req in list(self._pending):
+                        _fail(req, "pending")
                     self._pending.clear()
                     for slot in self._slots:
                         if slot.req is not None:
-                            slot.req.error = e
-                            slot.req._event.set()
+                            _fail(slot.req, "slot")
                             slot.req = None
                     for rec in self._inflight.values():
                         for req in rec[2]:
                             if req is not None and not req._event.is_set():
-                                req.error = e
-                                req._event.set()
+                                _fail(req, "inflight")
                     self._inflight.clear()
                     self._running = False
+                if not getattr(e, "_pht_usage_error", False):
+                    _flight.crash_dump(
+                        f"serving.step[{self._engine_id}]", e)
                 raise
             if not busy:
                 with self._lock:
                     if (not self._pending
                             and all(s.req is None for s in self._slots)):
                         self._running = False
+                        # clean drain between bursts: drop the beacon so
+                        # an IDLE engine doesn't 503 /healthz?max_age —
+                        # the next burst's first tick re-adds it (the
+                        # crash path above raises instead, keeping the
+                        # beacon: going stale is the alert)
+                        _tr.remove_beacon(f"serving.{self._engine_id}")
                         return
+
+    def introspect_requests(self) -> dict:
+        """In-flight slot table for ``/debug/requests`` (and debugging):
+        one row per slot — request id, prompt progress, tokens generated,
+        committed cache depth — plus the pending-queue depth.  Snapshot
+        under the engine lock; called from the introspection server's
+        thread, so it must stay cheap (it is: B small dicts)."""
+        with self._lock:
+            slots = []
+            for i, slot in enumerate(self._slots):
+                req = slot.req
+                if req is None:
+                    slots.append(None)
+                    continue
+                slots.append({
+                    "rid": req.rid, "slot": i,
+                    "prompt_len": int(len(req.prompt)),
+                    "prompt_consumed": int(slot.off),
+                    "generated": len(req.tokens),
+                    "max_new_tokens": req.max_new_tokens,
+                    "cache_len": int(self._lengths[i]),
+                })
+            return {"engine": self._engine_id, "tickno": self._tickno,
+                    "running": self._running,
+                    "pending": len(self._pending), "slots": slots}
 
     def run_until_idle(self, max_ticks=100000):
         """Drive the engine synchronously (single-threaded use/tests).
@@ -1123,6 +1282,13 @@ class ServingEngine:
         :meth:`step`'s single-driver contract)."""
         for _ in range(max_ticks):
             if not self.step():
+                with self._lock:
+                    if (not self._pending
+                            and all(s.req is None for s in self._slots)):
+                        # mirror the auto_run loop's idle-drain: a
+                        # synchronously driven engine must not leave a
+                        # forever-stale beacon 503ing /healthz?max_age
+                        _tr.remove_beacon(f"serving.{self._engine_id}")
                 return
         raise RuntimeError("engine did not drain in max_ticks")
 
@@ -1138,6 +1304,11 @@ class ServingEngine:
             with self._lock:
                 if not self._running:
                     self._registry.drop_labels(engine=self._engine_id)
+                    _tr.unregister_introspection_source(self._engine_id)
+                    # clean shutdown: a gone engine must not leave a
+                    # forever-stale beacon 503ing /healthz?max_age (a
+                    # CRASHED loop keeps its beacon — stale IS the alert)
+                    _tr.remove_beacon(f"serving.{self._engine_id}")
                     return
             time.sleep(0.005)
         raise TimeoutError("engine loop did not drain before timeout")

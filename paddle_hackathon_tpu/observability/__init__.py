@@ -24,13 +24,40 @@ side).  This package is that metrics half:
 - :func:`record_device_memory` — guarded live-buffer / device-memory
   gauges (degrades silently where jaxlib lacks the stats).
 
-Metric catalog: ``docs/OBSERVABILITY.md``.
+The event-level half lives next door and completes the triad:
+
+- ``tracing`` — request/step spans (default-off; armed by
+  ``profiler.Profiler`` onto the chrome-trace timeline).
+- ``flight`` — always-on bounded ring of recent structured events,
+  dumped automatically when ``ServingEngine.step`` / ``Model.fit``
+  escape with an exception.
+- ``server`` — opt-in stdlib HTTP introspection
+  (:func:`start_introspection_server`: ``/metrics``, ``/healthz``,
+  ``/debug/flight``, ``/debug/requests``).
+
+Metric catalog and endpoint reference: ``docs/OBSERVABILITY.md``.
 """
 
+from . import flight, tracing
+from .flight import FlightRecorder, get_flight_recorder
 from .metrics import (Counter, Gauge, Histogram, MetricRegistry,
                       get_registry, instrument_jit, log_buckets,
                       record_device_memory, set_trace_sink, snapshot_delta)
+from .tracing import (add_span, disable_tracing, enable_tracing, end_span,
+                      span, start_span, tracing_enabled)
 
 __all__ = ["MetricRegistry", "Counter", "Gauge", "Histogram",
            "get_registry", "instrument_jit", "log_buckets",
-           "record_device_memory", "set_trace_sink", "snapshot_delta"]
+           "record_device_memory", "set_trace_sink", "snapshot_delta",
+           "span", "start_span", "end_span", "add_span", "enable_tracing",
+           "disable_tracing", "tracing_enabled", "FlightRecorder",
+           "get_flight_recorder", "start_introspection_server",
+           "flight", "tracing"]
+
+
+def start_introspection_server(*args, **kwargs):
+    """Lazy re-export of :func:`server.start_introspection_server` —
+    the ``http.server`` import stays off the serving/training import
+    path until someone actually starts the server."""
+    from .server import start_introspection_server as _start
+    return _start(*args, **kwargs)

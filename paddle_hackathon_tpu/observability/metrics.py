@@ -142,9 +142,13 @@ class Histogram(_Child):
     ``buckets`` are upper bounds; an implicit +Inf bucket catches the
     tail.  ``quantile(q)`` interpolates within the bucket that crosses
     the requested rank — the standard Prometheus ``histogram_quantile``
-    estimate, good to bucket resolution."""
+    estimate, good to bucket resolution.  The observed maximum is
+    tracked exactly: the +Inf overflow bucket interpolates up to it
+    instead of clamping to ``buckets[-1]`` (which silently under-reports
+    any tail beyond the top bound — a 300 s compile stall must not
+    quantile as 64 s)."""
 
-    __slots__ = ("buckets", "_counts", "_sum", "_count")
+    __slots__ = ("buckets", "_counts", "_sum", "_count", "_max")
 
     def __init__(self, name, labels, reg, buckets=DEFAULT_BUCKETS):
         super().__init__(name, labels, reg)
@@ -152,6 +156,7 @@ class Histogram(_Child):
         self._counts = [0] * (len(self.buckets) + 1)  # +Inf tail
         self._sum = 0.0
         self._count = 0
+        self._max = float("-inf")
 
     def observe(self, v: float) -> None:
         if not self._reg.enabled:
@@ -161,6 +166,8 @@ class Histogram(_Child):
             self._counts[i] += 1
             self._sum += v
             self._count += 1
+            if v > self._max:
+                self._max = v
 
     @property
     def count(self) -> int:
@@ -170,22 +177,29 @@ class Histogram(_Child):
     def sum(self) -> float:
         return self._sum
 
+    @property
+    def max(self) -> float:
+        """Largest value observed (NaN before any observation)."""
+        return self._max if self._count else float("nan")
+
     def quantile(self, q: float) -> float:
         """Approximate q-quantile (0 <= q <= 1) from bucket counts."""
         with self._lock:
-            counts, total = list(self._counts), self._count
+            counts, total, vmax = list(self._counts), self._count, self._max
         if not total:
             return float("nan")
+        top = max(vmax, self.buckets[-1])
         rank = q * total
         acc = 0.0
         for i, c in enumerate(counts):
             if acc + c >= rank and c:
                 lo = self.buckets[i - 1] if i > 0 else 0.0
-                hi = (self.buckets[i] if i < len(self.buckets)
-                      else self.buckets[-1])  # +Inf bucket: clamp at top
+                # +Inf bucket: interpolate up to the OBSERVED max — a
+                # tail past buckets[-1] must not report as buckets[-1]
+                hi = self.buckets[i] if i < len(self.buckets) else top
                 return lo + (hi - lo) * ((rank - acc) / c)
             acc += c
-        return self.buckets[-1]
+        return top
 
 
 class _Family:
@@ -356,6 +370,10 @@ class MetricRegistry:
         for fam in fams:
             help = fam.help + (f" [{fam.unit}]" if fam.unit else "")
             if help:
+                # HELP escaping per the text format: backslash and
+                # line feed (label VALUES additionally escape the quote
+                # — see _fmt_labels)
+                help = help.replace("\\", r"\\").replace("\n", r"\n")
                 lines.append(f"# HELP {fam.name} {help}")
             lines.append(f"# TYPE {fam.name} {fam.kind}")
             for c in fam.children():
@@ -399,6 +417,7 @@ class MetricRegistry:
                         counts = list(c._counts)
                         entry["sum"] = c._sum
                         entry["count"] = c._count
+                        entry["max"] = c._max if c._count else None
                     cum, acc = {}, 0
                     for b, cnt in zip(c.buckets, counts):
                         acc += cnt
@@ -435,7 +454,8 @@ def snapshot_delta(prev: dict, cur: dict) -> dict:
             if fam["type"] == "histogram":
                 d["count"] = e["count"] - o.get("count", 0)
                 d["sum"] = e["sum"] - o.get("sum", 0.0)
-                ob = o.get("buckets", {})
+                d["max"] = e.get("max")   # all-time max (delta-max needs
+                ob = o.get("buckets", {})  # per-window tracking it lacks)
                 d["buckets"] = {b: v - ob.get(b, 0)
                                 for b, v in e["buckets"].items()}
             elif fam["type"] == "counter":
@@ -443,8 +463,10 @@ def snapshot_delta(prev: dict, cur: dict) -> dict:
             else:
                 d["value"] = e["value"]
             series.append(d)
-        out["metrics"][name] = {"type": fam["type"], "help": fam["help"],
-                                "unit": fam["unit"], "series": series}
+        out["metrics"][name] = {"type": fam["type"],
+                                "help": fam.get("help", ""),
+                                "unit": fam.get("unit", ""),
+                                "series": series}
     return out
 
 

@@ -1,0 +1,249 @@
+"""Event-level tracing: request/step spans on the chrome-trace timeline.
+
+The metrics registry (``observability/metrics.py``) answers aggregate
+questions — p99 TTFT, tokens/s.  When ONE request blows past p99 or one
+training step stalls, aggregates cannot answer "what happened to *this*
+request/step"; spans can.  This module is the span half of the triad
+(metrics → spans → introspection):
+
+- :func:`span` — ``with span("serving.tick", tickno=3):`` context
+  manager for straight-line scopes.
+- :func:`start_span` / :func:`end_span` — explicit pairs for lifecycles
+  that interleave across many requests (a serving tick advances eight
+  requests at once; no single ``with`` block brackets one request).
+- :func:`add_span` — retroactive emission for work whose bounds were
+  measured anyway (a device tick's wall clock times N slots at once:
+  one call per slot lands each request's share on its own lane).
+
+Cost model: tracing is DEFAULT-OFF.  Every entry point checks one
+module-level flag and returns a shared no-op when disabled, so the
+serving decode tick and the compiled fit loop keep their timings when
+nobody is tracing.  ``profiler.Profiler`` arms tracing while recording
+(the span sink feeds ``export_chrome_tracing``'s ``"ph": "X"`` events,
+merged by ``profiler/cross_stack.py`` alongside the counter events), and
+finished spans also land in the always-on flight recorder
+(``observability/flight.py``) so a crash dump carries recent spans.
+
+The module additionally keeps two tiny always-on registries the
+introspection server (``observability/server.py``) reads:
+
+- :func:`heartbeat` — named liveness beacons (the serving engine marks
+  one per tick, the fit loop one per telemetry sync) for ``/healthz``.
+- :func:`register_introspection_source` — live objects exposing
+  ``introspect_requests()`` (the serving slot table) for
+  ``/debug/requests``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import weakref
+from typing import Dict, Optional
+
+__all__ = ["span", "start_span", "end_span", "add_span", "Span",
+           "enable_tracing", "disable_tracing", "tracing_enabled",
+           "set_span_sink", "heartbeat", "beacon_ages", "remove_beacon",
+           "register_introspection_source",
+           "unregister_introspection_source", "introspection_tables"]
+
+_enabled = False
+# Armed by profiler.Profiler while recording:
+# fn(name, start_ns, end_ns, tid, attrs_dict_or_None).
+_span_sink = None
+
+
+def tracing_enabled() -> bool:
+    return _enabled
+
+
+def enable_tracing() -> None:
+    global _enabled
+    _enabled = True
+
+
+def disable_tracing() -> None:
+    global _enabled
+    _enabled = False
+
+
+def set_span_sink(fn) -> None:
+    """Install (or clear, with None) the chrome-trace span sink."""
+    global _span_sink
+    _span_sink = fn
+
+
+class Span:
+    """One open span.  ``end()`` (or ``end_span``) closes it; attrs
+    passed at end merge over the start attrs (e.g. the committed token
+    count is only known when the request finishes)."""
+
+    __slots__ = ("name", "attrs", "t0", "tid", "_open")
+
+    def __init__(self, name: str, attrs: Optional[dict], tid=None):
+        self.name = name
+        self.attrs = attrs
+        self.t0 = time.perf_counter_ns()
+        self.tid = tid if tid is not None else threading.get_ident()
+        self._open = True
+
+    def set_attrs(self, /, **attrs):
+        if self.attrs is None:
+            self.attrs = attrs
+        else:
+            self.attrs.update(attrs)
+
+    def end(self, /, **attrs):
+        if not self._open:
+            return
+        self._open = False
+        if attrs:
+            self.set_attrs(**attrs)
+        _emit(self.name, self.t0, time.perf_counter_ns(), self.tid,
+              self.attrs)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
+
+
+class _NoopSpan:
+    """Shared do-nothing span handed out while tracing is disabled — the
+    disabled hot path is one flag check plus an attribute load."""
+
+    __slots__ = ()
+
+    def set_attrs(self, /, **attrs):
+        pass
+
+    def end(self, /, **attrs):
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        pass
+
+
+_NOOP = _NoopSpan()
+
+
+def _emit(name, t0_ns, t1_ns, tid, attrs):
+    sink = _span_sink
+    if sink is not None:
+        sink(name, t0_ns, t1_ns, tid, attrs)
+    from . import flight as _flight
+    # merge so the envelope keys win: a user attr named "name"/"dur_us"
+    # must shadow, not TypeError, the traced hot path
+    _flight.get_flight_recorder().record(
+        "span", **{**(attrs or {}), "name": name,
+                   "dur_us": (t1_ns - t0_ns) // 1000})
+
+
+def start_span(name: str, /, _tid=None, **attrs):
+    """Open a span; close it with :func:`end_span` (or ``.end()``).
+    Returns a shared no-op when tracing is disabled — callers may hold
+    and end it unconditionally.  ``name`` (like every span-API
+    positional) is positional-only so an attr may share its name."""
+    if not _enabled:
+        return _NOOP
+    return Span(name, attrs or None, tid=_tid)
+
+
+def end_span(sp, /, **attrs) -> None:
+    sp.end(**attrs)
+
+
+def span(name: str, /, **attrs):
+    """``with span("hapi.fit.superstep", step=i):`` — context-managed
+    span for scopes that open and close on one frame."""
+    if not _enabled:
+        return _NOOP
+    return Span(name, attrs or None)
+
+
+def add_span(name: str, t0_ns: int, t1_ns: int, /, _tid=None,
+             **attrs) -> None:
+    """Emit an already-measured span (e.g. each slot's share of a device
+    tick whose wall clock was timed for the tick histogram anyway).
+    ``_tid`` overrides the chrome-trace lane — per-slot lanes keep one
+    request's prefill/decode/verify spans on one row."""
+    if not _enabled:
+        return
+    _emit(name, int(t0_ns), int(t1_ns),
+          _tid if _tid is not None else threading.get_ident(), attrs or None)
+
+
+# ---------------------------------------------------------------------------
+# Liveness beacons (for /healthz)
+# ---------------------------------------------------------------------------
+
+_beacons: Dict[str, float] = {}
+
+
+def heartbeat(name: str) -> None:
+    """Mark ``name`` alive now.  One dict store — cheap enough for the
+    serving engine to call every tick, always on."""
+    _beacons[name] = time.time()
+
+
+def remove_beacon(name: str) -> None:
+    """Forget a beacon.  A cleanly-stopped activity (engine shutdown,
+    completed fit) must not 503 ``/healthz?max_age`` forever — and with
+    engine churn the dict must not grow without bound.  A CRASHED
+    activity keeps its beacon on purpose: going stale is the alert."""
+    _beacons.pop(name, None)
+
+
+def beacon_ages() -> Dict[str, float]:
+    """Seconds since each beacon last beat."""
+    now = time.time()
+    # dict(_beacons) snapshots atomically (single C-level op under the
+    # GIL) — iterating the live dict would race an engine's first-tick
+    # insert and 500 the /healthz probe
+    return {k: now - v for k, v in sorted(dict(_beacons).items())}
+
+
+# ---------------------------------------------------------------------------
+# Introspection sources (for /debug/requests)
+# ---------------------------------------------------------------------------
+
+_sources: "weakref.WeakValueDictionary[str, object]" = \
+    weakref.WeakValueDictionary()
+# WeakValueDictionary iteration tolerates GC-driven removals (iteration
+# guard) but a concurrent INSERT raises — serialize mutation vs snapshot
+_sources_lock = threading.Lock()
+
+
+def register_introspection_source(name: str, obj) -> None:
+    """Register a live object exposing ``introspect_requests() -> dict``
+    (held weakly: a dropped engine vanishes from ``/debug/requests``
+    without an unregister call)."""
+    with _sources_lock:
+        _sources[name] = obj
+
+
+def unregister_introspection_source(name: str) -> None:
+    with _sources_lock:
+        _sources.pop(name, None)
+
+
+def introspection_tables() -> dict:
+    """``{name: source.introspect_requests()}`` over live sources; a
+    source that fails mid-snapshot reports the error rather than taking
+    the endpoint down."""
+    with _sources_lock:
+        items = sorted(_sources.items())
+    out = {}
+    # call outside the lock: a source's snapshot may take its own lock
+    # (the engine does), and engines unregister while holding it —
+    # calling under _sources_lock would be a lock-order inversion
+    for name, obj in items:
+        try:
+            out[name] = obj.introspect_requests()
+        except Exception as e:  # noqa: BLE001 — introspection must not throw
+            out[name] = {"error": f"{type(e).__name__}: {e}"}
+    return out

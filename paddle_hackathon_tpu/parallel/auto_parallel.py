@@ -17,6 +17,7 @@ The Engine compiles one SPMD train/eval/predict step per mode.
 from __future__ import annotations
 
 import dataclasses
+import time
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import jax
@@ -26,6 +27,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..core.tensor import Tensor
 from ..nn.layer import Layer, functional_call
+from ..observability import tracing as _tr
 from .api import batch_spec as _batch_spec
 
 __all__ = ["ProcessMesh", "shard_tensor", "shard_op", "Strategy", "Engine",
@@ -493,9 +495,17 @@ class Engine:
                     break
                 x, y = self._to_arrays(batch)
                 lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
+                t0n = time.perf_counter_ns()
                 p, o, s, loss = step_fn(st["params"], st["opt_states"],
                                         st["step"], lr, (x, y))
                 st.update(params=p, opt_states=o, step=s)
+                _tr.heartbeat("train.engine_fit")  # /healthz step recency
+                if _tr.tracing_enabled():
+                    # dispatch wall per SPMD step (async device time
+                    # surfaces only at the verbose log_freq float())
+                    _tr.add_span("parallel.engine_step", t0n,
+                                 time.perf_counter_ns(), epoch=epoch,
+                                 step=i)
                 # keep the raw device array: float() would force a host sync
                 # every step and stall async dispatch
                 history.append(loss)
@@ -506,6 +516,9 @@ class Engine:
                 self.evaluate(valid_data, batch_size=batch_size,
                               verbose=verbose)
         self._sync_back()
+        # clean completion: drop the beacon (a crashed fit keeps it —
+        # going stale on /healthz?max_age IS the alert)
+        _tr.remove_beacon("train.engine_fit")
         history = [float(l) for l in history]
         self._history["loss"].extend(history)
         return {"loss": history}

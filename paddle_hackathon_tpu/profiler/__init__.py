@@ -89,14 +89,16 @@ def _default_state_scheduler(step: int) -> ProfilerState:
 # ---------------------------------------------------------------------------
 
 class _HostEvent:
-    __slots__ = ("name", "start", "end", "tid", "event_type")
+    __slots__ = ("name", "start", "end", "tid", "event_type", "args")
 
-    def __init__(self, name, start, end, tid, event_type="UserDefined"):
+    def __init__(self, name, start, end, tid, event_type="UserDefined",
+                 args=None):
         self.name = name
         self.start = start
         self.end = end
         self.tid = tid
         self.event_type = event_type
+        self.args = args  # chrome-trace args payload (span attrs)
 
 
 class _Recorder:
@@ -122,6 +124,16 @@ class _Recorder:
             return
         with self._lock:
             self.counters.append((name, labels, value, t_ns))
+
+    def add_span(self, name, t0_ns, t1_ns, tid, attrs):
+        """Finished observability.tracing span (armed as the span sink
+        while recording) — a "ph":"X" duration event carrying its attrs
+        (request id, slot, step …) as chrome-trace args."""
+        if not self.active:
+            return
+        with self._lock:
+            self.events.append(_HostEvent(name, t0_ns, t1_ns, tid, "Span",
+                                          args=attrs))
 
     def drain(self):
         with self._lock:
@@ -185,12 +197,15 @@ def export_chrome_tracing(dir_name: str, worker_name: Optional[str] = None):
         path = os.path.join(dir_name, f"{name}_step{prof.step_num}.json")
         events = []
         for ev in prof._events:
-            events.append({
+            row = {
                 "name": ev.name, "ph": "X", "cat": ev.event_type,
                 "pid": os.getpid(), "tid": ev.tid,
                 "ts": ev.start / 1000.0,       # ns -> us
                 "dur": (ev.end - ev.start) / 1000.0,
-            })
+            }
+            if getattr(ev, "args", None):
+                row["args"] = ev.args   # span attrs (request id, step, …)
+            events.append(row)
         # registry counters/gauges sampled while recording: chrome counter
         # rows ("ph":"C") on the same timeline as the spans.  Label sets
         # render into the event name so each series gets its own row;
@@ -326,6 +341,17 @@ class Profiler:
             _metrics.set_trace_sink(_recorder.add_counter)
         except Exception:
             pass
+        # arm event-level spans (observability.tracing): request/step
+        # spans land as "ph":"X" events next to the counter rows.  A
+        # user may have enabled tracing independently (flight-recorder
+        # spans without a profiler) — remember and restore that.
+        try:
+            from ..observability import tracing as _tracing
+            self._tracing_was_enabled = _tracing.tracing_enabled()
+            _tracing.set_span_sink(_recorder.add_span)
+            _tracing.enable_tracing()
+        except Exception:
+            pass
         # also arm the native host tracer (C++ workqueue/dataloader spans)
         try:
             from ..core import native as _native
@@ -350,6 +376,13 @@ class Profiler:
         try:
             from ..observability import metrics as _metrics
             _metrics.set_trace_sink(None)
+        except Exception:
+            pass
+        try:
+            from ..observability import tracing as _tracing
+            _tracing.set_span_sink(None)
+            if not getattr(self, "_tracing_was_enabled", False):
+                _tracing.disable_tracing()
         except Exception:
             pass
         self._events = _recorder.drain()

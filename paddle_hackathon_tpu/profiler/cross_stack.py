@@ -57,15 +57,57 @@ def _assign_ranks(ordered: List[str]) -> List[int]:
     return ranks
 
 
+def _flight_rows(path: str, pid: int) -> List[dict]:
+    """Flight-recorder dump (``observability/flight.py``) as chrome
+    instant events.  The dump's paired ``ts``/``perf_ns`` sample anchors
+    its wall-clocked events onto the perf_counter timeline the span/
+    counter events live on (valid for dumps from the traced host — the
+    perf_counter epoch is per-boot)."""
+    dump = _load(path)
+    anchor_ns = dump.get("perf_ns")
+    if anchor_ns is None:   # pre-anchor dump: cannot place honestly
+        import warnings
+        warnings.warn(f"flight dump {path} carries no perf_ns anchor; "
+                      "skipping (cannot align wall clock to the trace)")
+        return []
+    wall_off_s = dump.get("ts", 0.0) - anchor_ns / 1e9  # wall = perf + off
+    rows = [
+        {"ph": "M", "name": "process_name", "pid": pid,
+         "args": {"name": f"flight ({os.path.basename(path)})"}},
+        {"ph": "M", "name": "process_sort_index", "pid": pid,
+         "args": {"sort_index": pid}},
+    ]
+    for ev in dump.get("events", []):
+        args = {k: v for k, v in ev.items() if k not in ("ts", "kind")}
+        rows.append({"name": f"flight:{ev.get('kind', '?')}", "ph": "i",
+                     "s": "p", "cat": "Flight", "pid": pid, "tid": 0,
+                     "ts": (ev.get("ts", 0.0) - wall_off_s) * 1e6,
+                     "args": args})
+    return rows
+
+
 def merge_traces(paths: List[str], align_marker: Optional[str] = None,
-                 out_path: Optional[str] = None) -> dict:
+                 out_path: Optional[str] = None,
+                 flight_paths: Optional[List[str]] = None) -> dict:
     """Merge per-rank chrome traces into one cluster timeline.
 
     ``align_marker``: event name whose first occurrence is treated as t=0
     on every rank (clock-skew compensation — the reference aligns on its
     profile step windows). Returns the merged trace dict; writes it to
     ``out_path`` when given.
+
+    ``flight_paths``: flight-recorder dumps to overlay as instant-event
+    rows (their own pids above the ranks) — a crash post-mortem lands on
+    the same timeline as the spans leading up to it.  Incompatible with
+    ``align_marker`` rebasing (the dumps carry no marker), so flight
+    rows keep absolute perf-clock time.
     """
+    if align_marker and flight_paths:
+        raise ValueError(
+            "align_marker rebases every rank to its marker's t=0, but "
+            "flight rows keep absolute perf-clock time (the dumps carry "
+            "no marker) — the overlay would land far off the timeline; "
+            "pass one or the other")
     merged = {"traceEvents": [], "displayTimeUnit": "ms"}
     ordered = sorted(paths)
     ranks = _assign_ranks(ordered)
@@ -114,6 +156,10 @@ def merge_traces(paths: List[str], align_marker: Optional[str] = None,
             if e.get("ph") != "M" and "ts" in e:
                 e["ts"] = e["ts"] - t0
             merged["traceEvents"].append(e)
+    if flight_paths:
+        next_pid = (max(ranks) + 1) if ranks else 0
+        for j, fp in enumerate(sorted(flight_paths)):
+            merged["traceEvents"].extend(_flight_rows(fp, next_pid + j))
     if out_path:
         with open(out_path, "w") as f:
             json.dump(merged, f)
@@ -129,12 +175,19 @@ def main(argv=None):
     ap.add_argument("-o", "--out", default="cluster_trace.json")
     ap.add_argument("--align", default=None,
                     help="event name used as per-rank t=0 (clock-skew fix)")
+    ap.add_argument("--flight", nargs="*", default=None,
+                    help="flight-recorder dump(s) to overlay as instant "
+                         "events (incompatible with --align)")
     args = ap.parse_args(argv)
+    if args.align and args.flight:
+        raise SystemExit("--flight rows keep absolute perf-clock time and "
+                         "cannot be rebased by --align; pick one")
     paths = sorted(glob.glob(os.path.join(args.trace_dir, "*.json")) +
                    glob.glob(os.path.join(args.trace_dir, "*.json.gz")))
     if not paths:
         raise SystemExit(f"no traces found under {args.trace_dir}")
-    merge_traces(paths, align_marker=args.align, out_path=args.out)
+    merge_traces(paths, align_marker=args.align, out_path=args.out,
+                 flight_paths=args.flight)
     print(f"merged {len(paths)} rank traces -> {args.out}")
 
 

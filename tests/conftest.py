@@ -73,6 +73,7 @@ _FILE_COST = {
     "test_static_nn.py": 12, "test_dataset_reader.py": 12,
     "test_strategies.py": 13, "test_fused_cache.py": 13,
     "test_hapi_compiled_fit.py": 15, "test_observability.py": 15,
+    "test_tracing.py": 8,   # span/flight/server units; engine runs are slow-marked
     "test_moment_dtype.py": 16,
     "test_optimizer.py": 17, "test_sharded_lamb.py": 18,
     "test_native_serving.py": 20, "test_native.py": 20, "test_nn.py": 22,
@@ -89,3 +90,11 @@ _FILE_COST = {
 
 def pytest_collection_modifyitems(session, config, items):
     items.sort(key=lambda it: _FILE_COST.get(it.fspath.basename, 40))
+
+
+def pytest_configure(config):
+    # tier-1 runs `-m 'not slow'` against the 870 s budget: mark tests
+    # that compile engines/trainers or poll the HTTP server as slow so
+    # they run only in full (untimed) suites
+    config.addinivalue_line(
+        "markers", "slow: excluded from the timed tier-1 run")

@@ -99,6 +99,47 @@ def test_expose_text_parses_as_prometheus():
     assert "h_seconds_count 1" in text
 
 
+def test_histogram_tracks_max_for_overflow_bucket():
+    r = MetricRegistry()
+    h = r.histogram("lat2_seconds", buckets=(0.1, 1.0)).labels()
+    assert np.isnan(h.max)
+    for v in (0.05, 0.5, 300.0):   # 300 s lands in the +Inf bucket
+        h.observe(v)
+    assert h.max == 300.0
+    # the tail quantile interpolates up to the OBSERVED max instead of
+    # clamping to buckets[-1]=1.0 (which silently under-reported any
+    # latency past the top bound)
+    assert h.quantile(1.0) == 300.0
+    assert h.quantile(0.9) > 1.0
+    assert h.quantile(0.3) <= 1.0             # low ranks unaffected
+    s = r.snapshot()["metrics"]["lat2_seconds"]["series"][0]
+    assert s["max"] == 300.0                  # surfaced in snapshot()
+    h2 = r.histogram("empty_seconds").labels()
+    assert r.snapshot()["metrics"]["empty_seconds"]["series"][0]["max"] \
+        is None
+    # in-range observations keep the old interpolation: inside the
+    # covering bucket, never pushed up toward the observed max
+    assert h2.observe(0.5) is None
+    assert 0.46 < h2.quantile(0.5) <= 1.0
+
+
+def test_expose_text_hostile_label_values():
+    r = MetricRegistry()
+    hostile = 'back\\slash "quote"\nnewline'
+    r.counter("hostile_total", 'help with \\ and\nnewline').labels(
+        k=hostile).inc()
+    text = r.expose_text()
+    # label value escaping per the text exposition format: \ " and LF
+    assert (r'k="back\\slash \"quote\"\nnewline"') in text
+    # one metric line must stay ONE line (a raw newline would split it)
+    metric_lines = [ln for ln in text.splitlines()
+                    if ln.startswith("hostile_total")]
+    assert len(metric_lines) == 1 and metric_lines[0].endswith(" 1.0")
+    # HELP text escapes backslash + newline too
+    help_lines = [ln for ln in text.splitlines() if ln.startswith("# HELP")]
+    assert help_lines == [r"# HELP hostile_total help with \\ and\nnewline"]
+
+
 def test_snapshot_delta():
     r = MetricRegistry()
     c = r.counter("ticks_total")
@@ -360,3 +401,30 @@ def test_metrics_dump_render_and_diff(capsys):
     assert n == 2   # counter delta + gauge change; histogram unchanged
     out = capsys.readouterr().out
     assert "+4" in out and "2 -> 9" in out
+
+
+def test_metrics_dump_diff_added_and_removed_series(capsys):
+    """Families/children present in only one snapshot (engine churn
+    drops labelled series; new sites appear mid-run) render as
+    added/removed instead of raising or silently vanishing."""
+    import metrics_dump
+    r = MetricRegistry()
+    r.counter("churn_total").labels(engine="old").inc(2)
+    r.gauge("old_depth").set(1)
+    s1 = r.snapshot()
+    r.drop_labels(engine="old")          # series gone from s2
+    del r._families["old_depth"]         # whole family gone from s2
+    r.counter("churn_total").labels(engine="new").inc(5)
+    r.histogram("fresh_seconds").observe(0.25)   # family only in s2
+    s2 = r.snapshot()
+    n = metrics_dump.render_diff(s1, s2)
+    out = capsys.readouterr().out
+    assert n == 4
+    rows = {ln.split()[0]: " ".join(ln.split()[1:])
+            for ln in out.splitlines()}
+    assert rows["churn_total{engine=new}"] == "+5 (added)"
+    assert rows["fresh_seconds"] == "+1 obs (added) sum +0.25"
+    assert rows["churn_total{engine=old}"] == "(removed)"
+    assert rows["old_depth"] == "(removed)"
+    # symmetric direction still renders (nothing raises)
+    assert metrics_dump.render_diff(s2, s1) == 4

@@ -38,9 +38,10 @@ def _fmt(v):
     return f"{int(v):,}"
 
 
-def render(snap, out=sys.stdout):
+def render(snap, out=None):
     """One aligned line per series: NAME{labels} TYPE VALUE [detail]."""
-    rows = []
+    out = out or sys.stdout   # resolved at call time: a captured/replaced
+    rows = []                 # stdout must not be baked in at import
     for name, fam in sorted(snap.get("metrics", {}).items()):
         for s in fam["series"]:
             key = name + _labels(s.get("labels"))
@@ -50,6 +51,8 @@ def render(snap, out=sys.stdout):
                 for q in ("p50", "p90", "p99"):
                     if s.get(q) is not None:
                         detail += f" {q}={s[q]:.6g}"
+                if s.get("max") is not None:
+                    detail += f" max={s['max']:.6g}"
                 rows.append((key, fam["type"], detail))
             else:
                 rows.append((key, fam["type"], _fmt(s.get("value"))))
@@ -59,38 +62,53 @@ def render(snap, out=sys.stdout):
     return len(rows)
 
 
-def render_diff(prev, cur, out=sys.stdout):
+def render_diff(prev, cur, out=None):
     """Changed series only, prev -> cur (via observability.snapshot_delta
-    for the counter/histogram subtraction semantics)."""
+    for the counter/histogram subtraction semantics).  Series present in
+    only one snapshot — engine churn drops labelled series, new sites
+    register fresh families mid-run — render as added/removed instead of
+    raising or silently vanishing."""
+    out = out or sys.stdout
     sys.path.insert(0, __file__.rsplit("/", 2)[0])
     from paddle_hackathon_tpu.observability import snapshot_delta
     delta = snapshot_delta(prev, cur)
     pm = prev.get("metrics", {})
+    cm = cur.get("metrics", {})
 
     def prev_series(name, labels):
         for s in pm.get(name, {}).get("series", []):
             if s.get("labels", {}) == labels:
                 return s
-        return {}
+        return None
 
     rows = []
     for name, fam in sorted(delta["metrics"].items()):
         for s in fam["series"]:
             key = name + _labels(s.get("labels"))
+            old = prev_series(name, s.get("labels", {}))
+            tag = " (added)" if old is None else ""
             if fam["type"] == "histogram":
-                if not s["count"]:
+                if not s.get("count") and not tag:
                     continue
-                rows.append((key, f"+{_fmt(s['count'])} obs",
-                             f"sum +{s['sum']:.6g}"))
+                rows.append((key, f"+{_fmt(s.get('count'))} obs{tag}",
+                             f"sum +{s.get('sum', 0.0):.6g}"))
             elif fam["type"] == "counter":
-                if not s["value"]:
+                if not s.get("value") and not tag:
                     continue
-                rows.append((key, f"+{_fmt(s['value'])}", ""))
+                rows.append((key, f"+{_fmt(s.get('value'))}{tag}", ""))
             else:
-                old = prev_series(name, s.get("labels", {})).get("value")
-                if old == s["value"]:
+                oldv = old.get("value") if old else None
+                if old is not None and oldv == s.get("value"):
                     continue
-                rows.append((key, f"{_fmt(old)} -> {_fmt(s['value'])}", ""))
+                rows.append((key, f"{_fmt(oldv)} -> {_fmt(s.get('value'))}"
+                                  f"{tag}", ""))
+
+    def series_keys(m):
+        return {(name, tuple(sorted(s.get("labels", {}).items())))
+                for name, fam in m.items() for s in fam.get("series", [])}
+
+    for name, lk in sorted(series_keys(pm) - series_keys(cm)):
+        rows.append((name + _labels(dict(lk)), "(removed)", ""))
     width = max((len(r[0]) for r in rows), default=0)
     for key, change, extra in rows:
         out.write(f"{key:<{width}}  {change}{'  ' + extra if extra else ''}\n")
