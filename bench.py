@@ -499,30 +499,42 @@ def bench_fit_compare():
 
 
 def _trace_device_ms(fn):
-    """Run ``fn`` under the jax profiler and return its summed top-level
-    XLA-op device time (ms) — the single owner of the trace-measurement
-    scaffold for the decode/serving rows (raise-safe stop, tools path,
-    temp-dir cleanup)."""
+    """Run ``fn`` under the jax profiler and return ``(ms, timing)`` —
+    the single owner of the trace-measurement scaffold for the
+    decode/serving rows (raise-safe stop, tools path, temp-dir cleanup).
+
+    ``timing`` is ``"device"`` (summed top-level XLA-op device time) on
+    accelerators, or ``"host"`` on CPU-only containers: jax.profiler
+    emits no XLA device events on CPU, so the old hard ``assert`` made
+    every serving/decode row crash there — fall back to wall clock
+    around ``fn`` instead, marked so a host number can never be read as
+    (or gated against) a chip number."""
     import shutil
     import tempfile
 
     outdir = tempfile.mkdtemp(prefix="bench_trace")
     try:
         jax.profiler.start_trace(outdir)
+        t0 = time.perf_counter()
         try:
             fn()
         finally:
             # a raise mid-trace must not leave the profiler running for
             # every subsequent suite row
+            host_ms = (time.perf_counter() - t0) * 1e3
             jax.profiler.stop_trace()
         sys.path.insert(0, os.path.join(os.path.dirname(
             os.path.abspath(__file__)), "tools"))
         from trace_util import toplevel_device_ms
-        dev_ms = toplevel_device_ms(outdir)
+        try:
+            dev_ms = toplevel_device_ms(outdir)
+        except Exception:
+            dev_ms = 0.0
     finally:
         shutil.rmtree(outdir, ignore_errors=True)
-    assert dev_ms > 0, "empty profiler trace"
-    return dev_ms
+    if dev_ms > 0:
+        return dev_ms, "device"
+    return host_ms, "host"
 
 
 def bench_decode(batch=8, prompt=64, new_tokens=128, spec_k=0,
@@ -558,11 +570,11 @@ def bench_decode(batch=8, prompt=64, new_tokens=128, spec_k=0,
         spec_k=spec_k).numpy())
     gen()  # compile+sync
     outs = []
-    dev_ms = _trace_device_ms(lambda: outs.append(gen()))
+    dev_ms, timing = _trace_device_ms(lambda: outs.append(gen()))
     assert outs[0].shape == (batch, prompt + new_tokens)
     row = {"metric": metric,
            "value": round(batch * new_tokens / (dev_ms / 1e3), 1),
-           "unit": "tokens/s"}
+           "unit": "tokens/s", "timing": timing}
     if spec_k:
         st = model._last_spec_stats
         row["acceptance_rate"] = round(
@@ -572,7 +584,9 @@ def bench_decode(batch=8, prompt=64, new_tokens=128, spec_k=0,
 
 
 def bench_serving(streams=8, prompt=64, new_tokens=128, chunk=32, spec_k=0,
-                  metric="gpt2_serving_8stream_device_tokens_per_sec_per_chip"):
+                  metric="gpt2_serving_8stream_device_tokens_per_sec_per_chip",
+                  cache_mode="dense", page_size=16, num_pages=None,
+                  max_len=None):
     """Continuous-batching serving (VERDICT r4 directive #2): aggregate
     DEVICE tokens/s across `streams` concurrent requests through the
     ServingEngine's slot-batched tick. Trace-measured like bench_decode —
@@ -601,8 +615,11 @@ def bench_serving(streams=8, prompt=64, new_tokens=128, chunk=32, spec_k=0,
                for _ in range(streams)]
     from paddle_hackathon_tpu.observability import get_registry
     eng = ServingEngine(model, max_slots=streams,
-                        max_len=prompt + new_tokens + chunk, spec_k=spec_k,
-                        auto_run=False, decode_window=32, chunk=chunk)
+                        max_len=max_len or (prompt + new_tokens + chunk),
+                        spec_k=spec_k,
+                        auto_run=False, decode_window=32, chunk=chunk,
+                        cache_mode=cache_mode, page_size=page_size,
+                        num_pages=num_pages)
     reg = get_registry()
     builds = lambda: int(  # noqa: E731 — this engine's program builds
         reg.total("jit_builds_total", engine=eng._engine_id))
@@ -621,12 +638,12 @@ def bench_serving(streams=8, prompt=64, new_tokens=128, chunk=32, spec_k=0,
         assert warm2.done
     builds_warm = builds()
     reqs = [eng.submit(p, new_tokens) for p in prompts]
-    dev_ms = _trace_device_ms(eng.run_until_idle)
+    dev_ms, timing = _trace_device_ms(eng.run_until_idle)
     assert all(r.done for r in reqs)
     total = streams * new_tokens
     row = {"metric": metric,
            "value": round(total / (dev_ms / 1e3), 1),
-           "unit": "tokens/s"}
+           "unit": "tokens/s", "timing": timing}
     if spec_k:
         row["acceptance_rate"] = round(
             eng.stats["spec_accepted"] / max(eng.stats["spec_drafted"], 1),
@@ -643,6 +660,20 @@ def bench_serving(streams=8, prompt=64, new_tokens=128, chunk=32, spec_k=0,
         "e2e_p50_ms": round(eng._h_e2e.quantile(0.5) * 1e3, 3),
         "ticks": eng.stats["ticks"],
     }
+    if cache_mode == "paged":
+        # pool-leak tripwire for tools/perf_gate.py: after the drain the
+        # only live pages are the prefix cache's; dropping it must
+        # return the pool to 0 allocated — anything left is a refcount
+        # leak and compare_metrics fails the suite on it.  streams rides
+        # along as the paged-vs-dense admitted-concurrency evidence.
+        cached = eng.drop_prefix_cache()
+        row["metrics"].update({
+            "kv_pages_leaked": eng.kv_pages_in_use,
+            "prefix_cached_pages_dropped": cached,
+            "peak_concurrent_streams": eng._peak_occupancy,
+            "prefix_hit_rate": round(eng.stats["prefix_hit_rate"], 4),
+        })
+        row["streams"] = streams
     return row
 
 
@@ -673,6 +704,17 @@ SUITE = {
     "serving_spec": lambda: bench_serving(
         spec_k=8,
         metric="gpt2_serving_spec_8stream_device_tokens_per_sec_per_chip"),
+    # paged-KV serving (PR 6): 16 streams through a page pool sized to
+    # the HBM an 8-slot dense engine provisioned for a max_len=512 worst
+    # case reserves (8*512 rows = 256 usable pages + the null page) —
+    # each 64+128-token request footprints 14 pages, so 2x the streams
+    # fit where dense strands the max_len slack; tools/perf_gate.py
+    # holds the row to >= 1.0x the same-run dense `serving` row and
+    # fails on any leaked page
+    "serving_paged": lambda: bench_serving(
+        streams=16, max_len=512, cache_mode="paged", page_size=16,
+        num_pages=8 * 512 // 16 + 1,
+        metric="gpt2_serving_paged_16stream_device_tokens_per_sec_per_chip"),
     # the high-level trainer's compiled fast path (hapi/compiled.py):
     # tokens/s through Model.fit must track the hand-rolled gpt2 row
     "hapi_fit": lambda: bench_hapi_fit(),

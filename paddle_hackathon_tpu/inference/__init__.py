@@ -24,6 +24,9 @@ from .config import Config, AnalysisConfig, PassBuilder
 from .predictor import (Predictor, PredictorPool, Tensor as InferTensor,
                         create_predictor, get_version)
 from .serving import Request, ServingEngine
+# paged-KV host bookkeeping (ServingEngine(cache_mode="paged")): the
+# page-pool allocator and the radix prefix cache
+from .paged import PagePool, PrefixCache, pages_for
 # speculative-decoding drafters (ServingEngine(spec_k=..., drafter=...) /
 # GPTForCausalLM.generate(spec_k=...)) — re-exported here because serving
 # is where users reach for them
@@ -33,4 +36,5 @@ __all__ = [
     "Config", "AnalysisConfig", "PassBuilder", "Predictor", "PredictorPool",
     "InferTensor", "create_predictor", "get_version",
     "Request", "ServingEngine", "NGramDrafter", "ModelDrafter",
+    "PagePool", "PrefixCache", "pages_for",
 ]

@@ -17,6 +17,16 @@ in-program at each slot's last valid position.  The host side is a slot
 scheduler: admit from a FIFO into free slots, stage each slot's next
 token chunk, retire finished requests.
 
+``cache_mode="paged"`` swaps the per-slot dense regions for a global
+page pool with per-slot page tables (PagedAttention/RadixAttention
+lineage): admission reserves each request's actual page footprint
+instead of a ``max_len`` slot, a radix prefix cache lets requests
+sharing a page-aligned prompt prefix map the same physical pages and
+prefill only their suffix, and attention gathers K/V through the table
+(``incubate/nn/kernels/paged_attention.py``).  Host-side bookkeeping
+lives in ``inference/paged.py``; docs/SERVING.md has the layout diagram
+and sizing guidance.
+
 Under pipeline parallelism the tick runs the interleaved-wave schedule:
 the slot batch splits into ``pp`` waves, each wave occupying a different
 stage every tick, so ALL stages do useful work each tick — the
@@ -54,12 +64,19 @@ class _EngineStats(collections.abc.Mapping):
     keep working while scrapers get the full labelled families."""
 
     _KEYS = ("ticks", "tokens", "requests",
-             "spec_ticks", "spec_drafted", "spec_accepted")
+             "spec_ticks", "spec_drafted", "spec_accepted",
+             "prefix_hit_tokens", "prompt_tokens", "prefix_hit_rate")
 
     def __init__(self, counters):
         self._counters = counters   # key -> Counter child
 
     def __getitem__(self, k):
+        if k == "prefix_hit_rate":
+            # derived: prompt tokens the prefix cache saved re-prefilling
+            # over all prompt tokens admitted (0.0 until any admit)
+            pt = int(self._counters["prompt_tokens"].value)
+            hit = int(self._counters["prefix_hit_tokens"].value)
+            return hit / pt if pt else 0.0
         return int(self._counters[k].value)
 
     def __iter__(self):
@@ -229,12 +246,36 @@ class ServingEngine:
       drafter: 'ngram' (model-free prompt-lookup, default), a small
         ``GPTForCausalLM`` draft model, or any object speaking the
         ``nn.decode`` drafter interface.
+      cache_mode: "dense" (the historical per-slot ``max_slots x
+        max_len`` regions) or "paged" — a global page pool
+        (``num_pages x page_size`` KV rows per layer) with per-slot page
+        tables.  Paged admission reserves each request's ACTUAL page
+        footprint (``prompt + max_new`` plus the write-window reserve,
+        in pages) instead of a whole ``max_len`` slot, so short requests
+        stop stranding HBM and more streams fit the same pool
+        (``inference/paged.py``; attention gathers through the table via
+        ``incubate/nn/kernels/paged_attention.py`` — the Pallas decode
+        kernel on TPU, a token-exact jnp reference elsewhere).
+      page_size: KV rows per page (paged mode).  16 balances internal
+        fragmentation (~page_size/2 rows wasted per request) against
+        page-table width; keep it a multiple of 8 so the decode kernel
+        engages (sublane alignment).
+      num_pages: pool size INCLUDING the reserved null page 0.  Default
+        ``max_slots * ceil(max_len/page_size) + 1`` (the dense worst
+        case); size it down to your HBM budget — admission simply queues
+        requests whose footprint doesn't fit yet.
+      prefix_cache: keep finished prompts' full pages in a radix cache
+        so a later request sharing a page-aligned prompt prefix (e.g. a
+        system prompt) maps the same physical pages and prefills only
+        its suffix (copy-on-write by recompute: the shared tail page is
+        re-prefilled privately, so shared pages are never written).
     """
 
     def __init__(self, model, max_slots=8, max_len=512, chunk=16,
                  temperature=0.0, top_k=None, eos_token_id=None,
                  auto_run=True, decode_window=8, top_p=None, spec_k=0,
-                 drafter="ngram"):
+                 drafter="ngram", cache_mode="dense", page_size=16,
+                 num_pages=None, prefix_cache=True):
         import jax
         import jax.numpy as jnp
 
@@ -291,6 +332,35 @@ class ServingEngine:
             self._spec = get_drafter(drafter, self.spec_k)
             self._spec.begin(self.max_slots, self.max_len)
 
+        if cache_mode not in ("dense", "paged"):
+            raise ValueError(f"cache_mode must be 'dense' or 'paged', "
+                             f"got {cache_mode!r}")
+        if cache_mode == "paged" and self._pp > 1:
+            import warnings
+            warnings.warn("cache_mode='paged' is not supported on the "
+                          "pipeline-parallel tick yet; serving dense",
+                          stacklevel=2)
+            cache_mode = "dense"
+        self.cache_mode = cache_mode
+        self._paged = cache_mode == "paged"
+        self._pool = self._prefix = None
+        self._peak_occupancy = 0
+        if self._paged:
+            from .paged import PagePool, PrefixCache
+            self._page_size = int(page_size)
+            if self._page_size < 1:
+                raise ValueError("page_size must be >= 1")
+            self._pages_per_slot = -(-self.max_len // self._page_size)
+            if num_pages is None:
+                num_pages = self.max_slots * self._pages_per_slot + 1
+            self._pool = PagePool(int(num_pages), self._page_size)
+            if prefix_cache:
+                self._prefix = PrefixCache(self._pool)
+            self._page_tables = np.zeros(
+                (self.max_slots, self._pages_per_slot), np.int32)
+            self._slot_pages = [[] for _ in range(self.max_slots)]
+            self._g_pages_free.set(self._pool.free)
+
         if self._pp > 1:
             self._build_pp_tick()
         else:
@@ -321,6 +391,13 @@ class ServingEngine:
             "spec_accepted": reg.counter(
                 "serving_spec_accepted_total",
                 "draft tokens accepted AND committed"),
+            "prefix_hit_tokens": reg.counter(
+                "serving_prefix_hit_tokens_total",
+                "prompt tokens served from cached prefix pages "
+                "(re-prefill skipped; paged cache mode only)"),
+            "prompt_tokens": reg.counter(
+                "serving_prompt_tokens_total",
+                "prompt tokens of admitted requests (all cache modes)"),
         }
         self._c = {k: fam.labels(**lbl) for k, fam in counters.items()}
         self.stats = _EngineStats(self._c)
@@ -348,6 +425,14 @@ class ServingEngine:
             "slots holding an active request this tick").labels(**lbl)
         self._g_queue = reg.gauge(
             "serving_queue_depth", "requests waiting for a slot").labels(**lbl)
+        # paged-KV pool gauges (stay 0 in dense mode): admission headroom
+        # and the leak tripwire tools/perf_gate.py reads off the bench row
+        self._g_pages_used = reg.gauge(
+            "serving_kv_pages_in_use",
+            "KV pool pages currently allocated").labels(**lbl)
+        self._g_pages_free = reg.gauge(
+            "serving_kv_pages_free",
+            "KV pool pages on the free list").labels(**lbl)
         # event-level observability: always-on flight ring (request
         # lifecycle marks + tick summaries feed the crash post-mortem)
         # and the /debug/requests slot table (weakly registered — a
@@ -367,6 +452,23 @@ class ServingEngine:
     def _alloc_caches(self, jnp):
         import jax
         cfg = self.model.config
+        if self._paged:
+            # one global page pool per layer: pages are slot-agnostic, so
+            # there is no batch dim to shard — heads ride 'mp' (the qkv
+            # projection's natural output sharding), pages replicate over
+            # the data axes (parallel/api.py page_pool_sharding)
+            shape = (self._pool.num_pages, self._page_size,
+                     cfg.num_heads, self._head_dim)
+            sh = None
+            if self._mesh is not None:
+                from ..parallel.api import page_pool_sharding
+                sh = page_pool_sharding(self._mesh)
+            put = (lambda a: jax.device_put(a, sh)) if sh is not None \
+                else (lambda a: a)
+            self._caches = [(put(jnp.zeros(shape, self._dtype)),
+                             put(jnp.zeros(shape, self._dtype)))
+                            for _ in range(cfg.num_layers)]
+            return
         B, L = self.max_slots, self.max_len
         shape = (B, L, cfg.num_heads, self._head_dim)
         if self._pp > 1:
@@ -406,11 +508,15 @@ class ServingEngine:
         bufs = self._bufs
 
         def mk_tick(sample):
+            # pt=None compiles the dense trace; the paged engine passes
+            # its (B, pages_per_slot) page table every tick (host numpy —
+            # tiny — so admission/free only ever touch host state)
             def tick(params, caches, tokens, starts, nvalid, temps, topks,
-                     topps, key, tickno):
+                     topps, key, tickno, pt=None):
                 hidden, caches = functional_call(
                     model.gpt, params, (Tensor(tokens),),
-                    kwargs={"caches": caches, "cache_pos": starts},
+                    kwargs={"caches": caches, "cache_pos": starts,
+                            "page_table": pt},
                     buffers=bufs, training=False)
                 last = jnp.take_along_axis(
                     hidden, (nvalid - 1).astype(jnp.int32)[:, None, None],
@@ -437,7 +543,7 @@ class ServingEngine:
 
         def mk_tick_multi(sample):
             def tick_multi(params, caches, last_tok, starts, temps, topks,
-                           topps, key, tickno):
+                           topps, key, tickno, pt=None):
                 B = last_tok.shape[0]
                 outbuf = jnp.zeros((B, M), jnp.int32)
 
@@ -446,7 +552,8 @@ class ServingEngine:
                     hidden, caches = functional_call(
                         model.gpt, params, (Tensor(cur[:, None]),),
                         kwargs={"caches": caches,
-                                "cache_pos": starts + t.astype(jnp.int32)},
+                                "cache_pos": starts + t.astype(jnp.int32),
+                                "page_table": pt},
                         buffers=bufs, training=False)
                     logits = hidden[:, 0] @ params["wte.weight"].T
                     nxt = sample(
@@ -532,11 +639,12 @@ class ServingEngine:
 
         def mk_tick_spec(sample):
             def tick_spec(params, caches, tokens, starts, temps, topks,
-                          topps, key, tickno):
+                          topps, key, tickno, pt=None):
                 B = tokens.shape[0]
                 hidden, caches = functional_call(
                     model.gpt, params, (Tensor(tokens),),
-                    kwargs={"caches": caches, "cache_pos": starts},
+                    kwargs={"caches": caches, "cache_pos": starts,
+                            "page_table": pt},
                     buffers=bufs, training=False)
                 logits = hidden @ params["wte.weight"].T  # (B, K+1, V)
                 # position 0 is the committed bonus/sampled token — it
@@ -590,6 +698,13 @@ class ServingEngine:
                 bool((topps != 1.0).any())) if vec else False
         return skey, temps, topks, topps
 
+    def _pt_kw(self):
+        """Extra program kwargs: the current page table (paged mode)."""
+        if not self._paged:
+            return {}
+        import jax.numpy as jnp
+        return {"pt": jnp.asarray(self._page_tables)}
+
     def _run_tick(self, tokens, starts, nvalid, sampling):
         import jax.numpy as jnp
         vec, temps, topks, topps = sampling
@@ -598,7 +713,7 @@ class ServingEngine:
             self._params, self._caches, jnp.asarray(tokens[:, :width]),
             jnp.asarray(starts), jnp.asarray(nvalid), jnp.asarray(temps),
             jnp.asarray(topks), jnp.asarray(topps), self._key,
-            jnp.asarray(self._tickno, jnp.int32))
+            jnp.asarray(self._tickno, jnp.int32), **self._pt_kw())
         return np.asarray(nxt)
 
     def _run_tick_spec(self, tokens, starts, sampling):
@@ -617,7 +732,8 @@ class ServingEngine:
         self._caches, out = self._prog("_tick_spec", vec)(
             self._params, self._caches, toks_j, starts_j,
             jnp.asarray(temps), jnp.asarray(topks), jnp.asarray(topps),
-            self._key, jnp.asarray(self._tickno, jnp.int32))
+            self._key, jnp.asarray(self._tickno, jnp.int32),
+            **self._pt_kw())
         return np.asarray(out)
 
     # ------------------------------------------------------------------
@@ -792,6 +908,21 @@ class ServingEngine:
             raise ValueError(
                 f"request needs {need} cache rows; capacity is "
                 f"max_len-max(chunk,spec_k+1)={self.max_len - reserve}")
+        if self._paged:
+            # page-granular footprint, computed on the final row index
+            # (pages_for): a reserve window narrower than a page can
+            # still STRADDLE a page boundary, so counting reserved
+            # TOKENS (max(chunk, spec_k+1)) undercounts by one page
+            # exactly when the window straddles — the allocator would
+            # then hand the tail write a page the table doesn't have
+            from .paged import pages_for
+            npages = pages_for(need, reserve, self._page_size)
+            if npages > self._pool.usable:
+                raise ValueError(
+                    f"request needs {npages} KV pages; the pool has "
+                    f"{self._pool.usable} usable pages "
+                    f"(num_pages={self._pool.num_pages}, "
+                    f"page_size={self._page_size})")
         max_pos = getattr(self.model.config, "max_position_embeddings", None)
         if max_pos is not None and need > max_pos:
             # past max_pos the position lookup clips to the last row —
@@ -834,19 +965,134 @@ class ServingEngine:
     def _admit(self):
         """Move pending requests into free slots.  Under pp a request
         admits into any free slot (its wave is slot // wave_size); its
-        staged prompt is consumed when that wave next enters stage 0."""
+        staged prompt is consumed when that wave next enters stage 0.
+
+        Paged mode additionally requires the request's PAGE footprint to
+        fit the pool — a free slot alone is not capacity.  Admission
+        stays FIFO: when the queue head's pages don't fit, later (maybe
+        smaller) requests wait behind it rather than starving it."""
         for i, slot in enumerate(self._slots):
             if slot.req is not None or not self._pending:
                 continue
+            skip = 0
+            if self._paged:
+                skip = self._paged_admit_locked(i, self._pending[0])
+                if skip is None:
+                    break  # pool exhausted for the FIFO head
             slot.req = req = self._pending.popleft()
-            slot.off = 0
+            slot.off = skip   # prefix-cache hit: those rows are already KV
             slot.last = 0
-            self._lengths[i] = 0
+            self._lengths[i] = skip
+            self._c["prompt_tokens"].inc(len(req.prompt))
+            if skip and self._spec is not None:
+                self._replay_skipped_to_drafter(i, req, skip)
             req._span_queue.end(slot=i)
             self._flight.record(
                 "req", phase="admit", rid=req.rid, engine=self._engine_id,
-                slot=i,
+                slot=i, prefix_hit=skip,
                 queue_s=round(time.perf_counter() - req._t_submit, 6))
+
+    def _paged_admit_locked(self, i, req):
+        """Reserve slot ``i``'s whole page footprint up front (worst-case
+        rows = prompt + max_new + the write-window reserve, in pages):
+        no mid-flight exhaustion, no preemption machinery, and the
+        concurrency win is intact because the footprint tracks the
+        REQUEST's need, not ``max_len``.  Cached prefix pages are mapped
+        shared (refcount++) and their tokens skipped from prefill.
+        Returns the skipped token count, or None when the pool cannot
+        fit the request yet (caller leaves it queued)."""
+        from .paged import NULL_PAGE, pages_for
+        P = self._page_size
+        reserve = max(self.chunk, self.spec_k + 1)
+        total = pages_for(len(req.prompt) + req.max_new_tokens, reserve, P)
+        hit = (self._prefix.match(req.prompt)
+               if self._prefix is not None else [])
+        fresh_n = total - len(hit)
+        short = fresh_n - self._pool.free
+        if short > 0:
+            # evict ONLY when eviction can actually cover the shortfall
+            # (cached_only counts exactly what evict can free leaf-up
+            # right now, excluding cache-only nodes pinned under a live
+            # slot's tail) — otherwise an unadmittable head would flush
+            # a hot prefix cache for nothing and still not admit
+            if (self._prefix is None
+                    or self._prefix.cached_only() < short):
+                if hit:
+                    self._pool.decref(hit)  # hand the matched refs back
+                return None
+            self._prefix.evict(short)
+        fresh = self._pool.alloc(fresh_n)
+        if fresh is None:
+            if hit:
+                self._pool.decref(hit)
+            return None
+        pages = hit + fresh
+        self._slot_pages[i] = pages
+        self._page_tables[i] = NULL_PAGE
+        self._page_tables[i, :len(pages)] = pages
+        self._c["prefix_hit_tokens"].inc(len(hit) * P)
+        self._g_pages_used.set(self._pool.allocated)
+        self._g_pages_free.set(self._pool.free)
+        return len(hit) * P
+
+    def _replay_skipped_to_drafter(self, i, req, skip):
+        """A prefix-cache hit skips re-prefilling rows [0, skip) — but
+        the drafter's mirror only ever sees what the target tick feeds
+        it, so without this replay it would propose from a hole in its
+        history (never *wrong* tokens — verify rejects — just a silently
+        degraded acceptance rate).  Replay in chunk-wide pieces: the
+        width the drafter's ingest program is already compiled for, so
+        no new trace per distinct hit length.  Other slots' rows follow
+        the normal ingest convention (zero tokens written past their
+        committed length — scratch the draft attention never reads)."""
+        C = self.chunk
+        for ofs in range(0, skip, C):
+            n = min(C, skip - ofs)
+            buf = np.zeros((self.max_slots, C), np.int32)
+            buf[i, :n] = req.prompt[ofs:ofs + n]
+            starts = self._lengths.copy()
+            starts[i] = ofs
+            nvalid = np.zeros(self.max_slots, np.int32)
+            nvalid[i] = n
+            self._spec.ingest(buf, starts, nvalid)
+
+    def _release_pages_locked(self, i):
+        """Drop slot ``i``'s page references (request finished/failed).
+        Pages the prefix cache also references stay allocated for future
+        prefix hits; everything else returns to the free list."""
+        from .paged import NULL_PAGE
+        pages = self._slot_pages[i]
+        if pages:
+            self._pool.decref(pages)
+            self._slot_pages[i] = []
+        self._page_tables[i] = NULL_PAGE
+        self._g_pages_used.set(self._pool.allocated)
+        self._g_pages_free.set(self._pool.free)
+
+    def _check_write_windows_locked(self, starts):
+        """Tripwire for the paged no-shared-writes invariant: no active
+        slot's write window ``[start, start+reserve)`` may map a page
+        with refcount > 1 — the prefix cache's round-down-to-a-page-
+        boundary match (copy-on-write by recompute) guarantees it, so a
+        violation is a refcount bug; fail the tick loudly rather than
+        serve KV another request (or the cache) can see corrupted."""
+        from .paged import NULL_PAGE
+        P = self._page_size
+        reserve = max(self.chunk, self.spec_k + 1)
+        for i, slot in enumerate(self._slots):
+            if slot.req is None:
+                continue
+            lo = int(starts[i]) // P
+            hi = min((int(starts[i]) + reserve - 1) // P,
+                     self._pages_per_slot - 1)
+            for k in range(lo, hi + 1):
+                pg = int(self._page_tables[i, k])
+                if pg != NULL_PAGE and self._pool.refcount(pg) > 1:
+                    raise RuntimeError(
+                        f"paged KV invariant violated: slot {i} write "
+                        f"window [{int(starts[i])}, "
+                        f"{int(starts[i]) + reserve}) maps shared page "
+                        f"{pg} (refcount {self._pool.refcount(pg)})")
 
     def _stage(self):
         """Build (tokens, starts, nvalid, consumed, finishing) for this
@@ -880,6 +1126,8 @@ class ServingEngine:
         req.done = True
         self._slots[slot_idx].req = None
         self._lengths[slot_idx] = 0
+        if self._paged:
+            self._release_pages_locked(slot_idx)
         now = time.perf_counter()
         self._h_e2e.observe(now - req._t_submit)
         if req._t_first is not None and len(req.tokens) > 1:
@@ -962,8 +1210,11 @@ class ServingEngine:
                 raise err
             self._admit()
             self._g_queue.set(len(self._pending))
-            self._g_occupancy.set(
-                sum(s.req is not None for s in self._slots))
+            occ = sum(s.req is not None for s in self._slots)
+            self._g_occupancy.set(occ)
+            if occ > self._peak_occupancy:
+                # paged-vs-dense admitted-concurrency evidence (bench)
+                self._peak_occupancy = occ
             sampling = self._sampling_vectors()
             if self._pp > 1:
                 if (not any(s.req is not None for s in self._slots)
@@ -991,6 +1242,8 @@ class ServingEngine:
             else:
                 mode = "chunk"
                 tokens, starts, nvalid, consumed, finishing = self._stage()
+            if self._paged:
+                self._check_write_windows_locked(starts)
 
         if mode == "pp":
             t0n = time.perf_counter_ns()
@@ -1128,6 +1381,16 @@ class ServingEngine:
                 was_prefill = slot.off < len(slot.req.prompt)
                 if was_prefill:
                     slot.off += int(consumed[i])
+                    if (self._prefix is not None
+                            and slot.off >= len(slot.req.prompt)):
+                        # prompt fully prefilled: register its FULL pages
+                        # so later requests sharing the prefix skip them.
+                        # Before _commit_token — a request that finishes
+                        # this very tick must donate its pages to the
+                        # cache before _finish releases the slot's refs.
+                        self._prefix.insert(
+                            slot.req.prompt, self._page_tables[i],
+                            len(slot.req.prompt) // self._page_size)
                 self._lengths[i] += int(consumed[i])
                 if finishing[i]:
                     self._commit_token(i, int(nxt[i]))
@@ -1152,7 +1415,7 @@ class ServingEngine:
             self._params, self._caches, jnp.asarray(last_toks),
             jnp.asarray(starts), jnp.asarray(temps), jnp.asarray(topks),
             jnp.asarray(topps), self._key,
-            jnp.asarray(self._tickno, jnp.int32))
+            jnp.asarray(self._tickno, jnp.int32), **self._pt_kw())
         return np.asarray(out)
 
     def _inflight_live(self):
@@ -1224,10 +1487,12 @@ class ServingEngine:
                     for req in list(self._pending):
                         _fail(req, "pending")
                     self._pending.clear()
-                    for slot in self._slots:
+                    for i, slot in enumerate(self._slots):
                         if slot.req is not None:
                             _fail(slot.req, "slot")
                             slot.req = None
+                            if self._paged:
+                                self._release_pages_locked(i)
                     for rec in self._inflight.values():
                         for req in rec[2]:
                             if req is not None and not req._event.is_set():
@@ -1264,17 +1529,50 @@ class ServingEngine:
                 if req is None:
                     slots.append(None)
                     continue
-                slots.append({
+                row = {
                     "rid": req.rid, "slot": i,
                     "prompt_len": int(len(req.prompt)),
                     "prompt_consumed": int(slot.off),
                     "generated": len(req.tokens),
                     "max_new_tokens": req.max_new_tokens,
                     "cache_len": int(self._lengths[i]),
-                })
-            return {"engine": self._engine_id, "tickno": self._tickno,
-                    "running": self._running,
-                    "pending": len(self._pending), "slots": slots}
+                }
+                if self._paged:
+                    row["pages"] = len(self._slot_pages[i])
+                slots.append(row)
+            out = {"engine": self._engine_id, "tickno": self._tickno,
+                   "running": self._running,
+                   "pending": len(self._pending), "slots": slots}
+            if self._paged:
+                out["kv_pages_in_use"] = self._pool.allocated
+                out["kv_pages_free"] = self._pool.free
+                out["prefix_cached_pages"] = (
+                    len(self._prefix) if self._prefix is not None else 0)
+            return out
+
+    @property
+    def kv_pages_in_use(self) -> int:
+        """Allocated pool pages (0 in dense mode) — includes pages held
+        only by the prefix cache; :meth:`drop_prefix_cache` reclaims
+        those, after which a drained engine must read 0 (the pool-leak
+        assert tools/perf_gate.py gates via the bench row)."""
+        return self._pool.allocated if self._paged else 0
+
+    @property
+    def kv_pages_free(self) -> int:
+        return self._pool.free if self._paged else 0
+
+    def drop_prefix_cache(self) -> int:
+        """Release every cached prefix page (HBM reclaim / leak checks);
+        returns how many the cache held.  Pages a live slot still maps
+        stay allocated until that slot frees."""
+        with self._lock:
+            if self._prefix is None:
+                return 0
+            n = self._prefix.drop()
+            self._g_pages_used.set(self._pool.allocated)
+            self._g_pages_free.set(self._pool.free)
+            return n
 
     def run_until_idle(self, max_ticks=100000):
         """Drive the engine synchronously (single-threaded use/tests).

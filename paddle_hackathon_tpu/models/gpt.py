@@ -102,9 +102,38 @@ class GPTAttention(SequenceParallelMixin, Layer):
         dtype = qkv._value.dtype if isinstance(qkv, Tensor) else qkv.dtype
         return _fap.supported(s, s, self.num_heads, self.head_dim, dtype)
 
-    def forward(self, x, cache=None, cache_pos=None):
+    def forward(self, x, cache=None, cache_pos=None, page_table=None):
         b, s, h = x.shape
         qkv = self.qkv_proj(x)
+        if page_table is not None:
+            # paged KV (serving engine cache_mode="paged"): ``cache`` is a
+            # global page-pool pair ((num_pages, page_size, H, D)) shared
+            # by every slot; ``page_table`` (B, pages_per_slot) maps each
+            # slot's logical rows to physical pages and ``cache_pos`` is
+            # the per-slot write offset.  Write-through-the-table, then
+            # gather-attention (the Pallas decode kernel on TPU at width
+            # 1, the exact-jnp reference otherwise) — same math, masking
+            # and dtypes as the dense static-cache branch below, so paged
+            # greedy decode is token-exact against it.
+            if cache_pos is None:
+                raise ValueError("page_table requires cache_pos")
+            from ..incubate.nn.kernels import paged_attention as _pa
+            qkv = ops.reshape(qkv, [b, s, 3, self.num_heads, self.head_dim])
+            q, k, v = ops.unstack(qkv, axis=2)
+
+            def fn(qv, kv, vv, kp, vp, pt, pos):
+                import jax.numpy as jnp
+                pos = jnp.asarray(pos, jnp.int32)
+                kp = _pa.paged_write(kp, kv, pt, pos)
+                vp = _pa.paged_write(vp, vv, pt, pos)
+                ctx = _pa.paged_attention(qv, kp, vp, pt, pos)
+                return ctx.reshape(ctx.shape[0], ctx.shape[1], -1), kp, vp
+            from ..core.autograd import apply_op
+            out, new_k, new_v = apply_op(
+                "gpt_paged_cache_attn", fn,
+                [q, k, v, cache[0], cache[1], page_table, cache_pos],
+                n_outputs=3)
+            return self.out_proj(out), (new_k, new_v)
         if self._sp_enabled() and cache is None and cache_pos is None:
             # sequence-parallel training: the seq dim is sharded over the
             # 'sp' mesh axis; attention runs the ring/ulysses schedule
@@ -252,8 +281,9 @@ class GPTBlock(Layer):
             self.mlp = GPTMLP(config)
         self.dropout = Dropout(config.hidden_dropout_prob)
 
-    def forward(self, x, cache=None, cache_pos=None):
-        attn_out = self.attn(self.ln_1(x), cache=cache, cache_pos=cache_pos)
+    def forward(self, x, cache=None, cache_pos=None, page_table=None):
+        attn_out = self.attn(self.ln_1(x), cache=cache, cache_pos=cache_pos,
+                             page_table=page_table)
         if cache is not None:
             attn_out, cache = attn_out
         x = x + self.dropout(attn_out)
@@ -277,9 +307,12 @@ class GPTModel(Layer):
         self.ln_f = LayerNorm(config.hidden_size)
 
     def forward(self, input_ids, position_ids=None, caches=None,
-                cache_pos=None):
+                cache_pos=None, page_table=None):
         b, s = input_ids.shape
-        past_len = caches[0][0].shape[1] if caches is not None else 0
+        # paged caches are (num_pages, page_size, H, D) pools — their
+        # leading dims say nothing about past length; cache_pos does
+        past_len = (caches[0][0].shape[1]
+                    if caches is not None and page_table is None else 0)
         max_pos = self.wpe.weight.shape[0]
         if cache_pos is not None:
             # static-cache decode: positions come from the dynamic write
@@ -326,7 +359,8 @@ class GPTModel(Layer):
             if caches is None:
                 x = block(x)
             else:
-                x, c = block(x, cache=caches[i], cache_pos=cache_pos)
+                x, c = block(x, cache=caches[i], cache_pos=cache_pos,
+                             page_table=page_table)
                 new_caches.append(c)
         x = self.ln_f(x)
         return x if caches is None else (x, new_caches)
@@ -349,9 +383,9 @@ class GPTForCausalLM(Layer):
         self.config = config
 
     def forward(self, input_ids, position_ids=None, caches=None,
-                cache_pos=None):
+                cache_pos=None, page_table=None):
         hidden = self.gpt(input_ids, position_ids, caches=caches,
-                          cache_pos=cache_pos)
+                          cache_pos=cache_pos, page_table=page_table)
         if caches is not None:
             hidden, caches = hidden
         logits = ops.matmul(hidden, self.gpt.wte.weight, transpose_y=True)

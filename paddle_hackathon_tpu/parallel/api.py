@@ -149,6 +149,18 @@ def token_batch_sharding(mesh: Mesh):
     return NamedSharding(mesh, P(bax))
 
 
+def page_pool_sharding(mesh: Mesh):
+    """NamedSharding for a paged-KV pool leaf (num_pages, page_size,
+    heads, head_dim): heads on 'mp' like :func:`decode_cache_sharding`
+    (the qkv projection's natural output sharding), pages REPLICATED
+    over the data axes — pages are slot-agnostic, so there is no batch
+    dim to shard, and any page must be gatherable by any slot's table
+    row without a cross-rank collective per page."""
+    from jax.sharding import NamedSharding
+    hax = "mp" if mesh.shape.get("mp", 1) > 1 else None
+    return NamedSharding(mesh, P(None, None, hax, None))
+
+
 def _collect_moe_aux(model):
     """Sum of the trace-fresh MoE load-balance aux values left on
     MoELayer instances by the forward just run (None when no MoE)."""

@@ -114,3 +114,47 @@ def test_ratio_gate_holds_spec_serving_to_nonspec():
     assert perf_gate.compare_ratios(rows) == []
     rows[1]["value"] = 14000.0
     assert perf_gate.compare_ratios(rows) == []
+
+
+def test_suite_has_paged_row():
+    import bench
+    assert "serving_paged" in bench.SUITE
+
+
+def test_ratio_gate_holds_paged_serving_to_dense():
+    """serving_paged (16 streams through the page pool) is gated >= 1.0x
+    the SAME-RUN dense serving row: the page-table indirection must pay
+    for itself at 2x the admitted concurrency."""
+    rows = [{"metric": "gpt2_serving_8stream_device_tokens_per_sec_per_chip",
+             "value": 10000.0},
+            {"metric":
+             "gpt2_serving_paged_16stream_device_tokens_per_sec_per_chip",
+             "value": 9000.0}]
+    bad = perf_gate.compare_ratios(rows)
+    assert len(bad) == 1 and bad[0][0].startswith("gpt2_serving_paged")
+    rows[1]["value"] = 11000.0
+    assert perf_gate.compare_ratios(rows) == []
+
+
+def test_pool_leak_gate_fires_on_leaked_pages():
+    """A paged row whose pool did not drain to 0 (refcount bug) fails
+    the suite gate; 0 leaked (or a row without the key) passes."""
+    rows = [{"metric": "paged", "metrics": {"kv_pages_leaked": 3}},
+            {"metric": "dense", "metrics": {}}]
+    assert perf_gate.compare_pool_leaks(rows) == [("paged", 3)]
+    rows[0]["metrics"]["kv_pages_leaked"] = 0
+    assert perf_gate.compare_pool_leaks(rows) == []
+
+
+def test_host_timed_device_metric_fails_suite():
+    """A *device* throughput row that fell back to host wall timing
+    (broken profiler trace on a TPU run) must fail with a named cause,
+    never gate wall clock against device baselines."""
+    rows = [{"metric": "gpt2_serving_8stream_device_tokens_per_sec_per_chip",
+             "value": 9000.0, "timing": "host"},
+            {"metric": "resnet50_input_pipeline_imgs_per_sec",
+             "value": 100.0, "timing": "host"},   # host metric: fine
+            {"metric": "gpt2_greedy_decode_device_tokens_per_sec_per_chip",
+             "value": 9000.0, "timing": "device"}]
+    assert perf_gate.compare_timing_fallbacks(rows) == [
+        "gpt2_serving_8stream_device_tokens_per_sec_per_chip"]

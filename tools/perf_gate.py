@@ -142,6 +142,12 @@ RATIO_GATES = [
      "gpt2_small_pretrain_tokens_per_sec_per_chip", 0.90),
     ("gpt2_serving_spec_8stream_device_tokens_per_sec_per_chip",
      "gpt2_serving_8stream_device_tokens_per_sec_per_chip", 1.00),
+    # paged KV at 2x the admitted streams must not lose aggregate
+    # throughput to the dense layout: attention reads each slot's actual
+    # length through the page table where dense reads max_len rows, so
+    # the indirection has to pay for itself on the same-run workload
+    ("gpt2_serving_paged_16stream_device_tokens_per_sec_per_chip",
+     "gpt2_serving_8stream_device_tokens_per_sec_per_chip", 1.00),
 ]
 
 
@@ -174,6 +180,30 @@ def compare_metrics(rows):
     return bad
 
 
+def compare_timing_fallbacks(rows):
+    """[metric] for rows measuring a *device* metric that fell back to
+    HOST wall-clock timing (bench.py tags ``"timing": "host"`` when the
+    profiler trace has no XLA device events).  On a TPU run that means
+    the profiler broke: host wall through the axon tunnel is RTT-bound
+    and must never be gated against committed device baselines — fail
+    with a named cause instead of an unexplained throughput shift."""
+    return [r["metric"] for r in rows
+            if r.get("timing") == "host" and "device" in r.get("metric", "")]
+
+
+def compare_pool_leaks(rows):
+    """[(metric, leaked)] for paged serving rows whose KV page pool did
+    not return to 0 allocated after the drain + prefix-cache drop
+    (bench.py embeds ``metrics.kv_pages_leaked``): a refcount bug leaks
+    HBM a page at a time in production — fail the gate instead."""
+    bad = []
+    for r in rows:
+        leaked = (r.get("metrics") or {}).get("kv_pages_leaked")
+        if leaked is not None and int(leaked) > 0:
+            bad.append((r["metric"], int(leaked)))
+    return bad
+
+
 def suite_gate(tolerance, rows=None):
     """Gate EVERY BASELINE.md model config (ERNIE/1.3B/long-context/
     ResNet + gpt2) against the committed best values — the round-2 gate
@@ -197,7 +227,9 @@ def suite_gate(tolerance, rows=None):
     bad = compare_suite(baseline, rows, tolerance)
     bad_ratio = compare_ratios(rows)
     bad_metrics = compare_metrics(rows)
-    if bad or bad_ratio or bad_metrics:
+    bad_leaks = compare_pool_leaks(rows)
+    bad_timing = compare_timing_fallbacks(rows)
+    if bad or bad_ratio or bad_metrics or bad_leaks or bad_timing:
         if bad:
             print(f"perf_gate[suite] FAIL: {len(bad)} configs regressed "
                   f">{tolerance:.0%}:")
@@ -211,11 +243,19 @@ def suite_gate(tolerance, rows=None):
             print(f"perf_gate[suite] FAIL: {metric} recompiled in steady "
                   f"state ({warm} jit builds after warm-up, {total} after "
                   f"the measured run)")
+        for metric, leaked in bad_leaks:
+            print(f"perf_gate[suite] FAIL: {metric} leaked {leaked} KV "
+                  f"pool pages (pages_in_use != 0 after drain + "
+                  f"prefix-cache drop — a refcount bug)")
+        for metric in bad_timing:
+            print(f"perf_gate[suite] FAIL: {metric} was host-timed "
+                  f"(profiler trace had no device events) — a device "
+                  f"metric cannot be gated from wall clock")
         return 1
     print(f"perf_gate[suite] PASS: {len(baseline)} configs within "
           f"{tolerance:.0%} of the committed baseline; "
           f"{len(RATIO_GATES)} ratio gates hold; no steady-state "
-          f"recompilation")
+          f"recompilation; no KV pool leaks")
     return 0
 
 
