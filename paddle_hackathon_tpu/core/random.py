@@ -27,17 +27,30 @@ _lock = threading.Lock()
 def seed(s: int) -> None:
     """paddle.seed equivalent: reset the global generator."""
     global _GLOBAL_SEED, _global_key
+    # build the key OUTSIDE the lock: jax.random.key dispatches device
+    # work, and holding _lock across it stalls every concurrent
+    # split_key() behind the device (pht-lint PHT003)
+    key = jax.random.key(int(s))
     with _lock:
         _GLOBAL_SEED = int(s)
-        _global_key = jax.random.key(int(s))
+        _global_key = key
 
 
 def get_rng_state():
     global _global_key
-    with _lock:
-        if _global_key is None:
-            _global_key = jax.random.key(_GLOBAL_SEED)
-        return _global_key
+    k = _global_key
+    if k is None:
+        # stage/commit: dispatch outside the lock, double-check inside
+        # (a racing seed()/get_rng_state() wins; this fresh key is
+        # dropped) — see seed() for why.  The return value is re-read
+        # INSIDE the lock: a concurrent set_rng_state(None) must not
+        # make this return None
+        fresh = jax.random.key(_GLOBAL_SEED)
+        with _lock:
+            if _global_key is None:
+                _global_key = fresh
+            k = _global_key
+    return k
 
 
 def set_rng_state(key) -> None:
@@ -54,10 +67,20 @@ def split_key() -> jax.Array:
         new_key, sub = jax.random.split(scope_key)
         _state.key = new_key
         return sub
+    get_rng_state()   # init staged outside the lock (see seed())
     global _global_key
     with _lock:
         if _global_key is None:
+            # a set_rng_state(None) reset landed between the staged
+            # init above and this critical section: re-init here (the
+            # rare-race path; the dispatch-under-lock is covered by
+            # this function's PHT003 baseline entry)
             _global_key = jax.random.key(_GLOBAL_SEED)
+        # the split itself MUST stay under the lock: two threads
+        # splitting the same key would both return the same "fresh"
+        # key.  Baselined (pht-lint PHT003) — this is the eager
+        # Paddle-compat path, not a hot path; traced hot paths thread
+        # explicit keys via rng_scope instead.
         _global_key, sub = jax.random.split(_global_key)
         return sub
 

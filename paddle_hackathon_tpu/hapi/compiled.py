@@ -135,7 +135,7 @@ class CompiledTrainer:
             jax.jit(train_step, donate_argnums=(0, 1, 2)),
             site="hapi.compiled_trainer")
 
-    def run(self, xs, ys):
+    def run(self, xs, ys):  # pht-lint: hot-root (compiled-trainer step)
         """One compiled superstep over stacked batches (leaves (K, B, …));
         returns the (K,) per-step loss vector as a DEVICE array."""
         lr = jnp.asarray(self._opt.get_lr(), jnp.float32)

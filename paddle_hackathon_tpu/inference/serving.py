@@ -51,6 +51,7 @@ import numpy as np
 
 from ..observability import flight as _flight
 from ..observability import metrics as _obs
+from ..observability.sanitizers import make_lock
 from ..observability import tracing as _tr
 
 _ENGINE_IDS = itertools.count()
@@ -309,7 +310,7 @@ class ServingEngine:
             self._mesh = amb
             self._pp = amb.shape["pp"]
 
-        self._lock = threading.Lock()
+        self._lock = make_lock("serving.engine")
         self._pending = collections.deque()
         self._slots = [_Slot() for _ in range(self.max_slots)]
         self._lengths = np.zeros(self.max_slots, np.int32)
@@ -706,6 +707,7 @@ class ServingEngine:
         return {"pt": jnp.asarray(self._page_tables)}
 
     def _run_tick(self, tokens, starts, nvalid, sampling):
+        import jax
         import jax.numpy as jnp
         vec, temps, topks, topps = sampling
         width = 1 if int(np.max(nvalid)) <= 1 else self.chunk
@@ -714,7 +716,10 @@ class ServingEngine:
             jnp.asarray(starts), jnp.asarray(nvalid), jnp.asarray(temps),
             jnp.asarray(topks), jnp.asarray(topps), self._key,
             jnp.asarray(self._tickno, jnp.int32), **self._pt_kw())
-        return np.asarray(nxt)
+        # the tick's ONE designed device->host fetch: explicit, so the
+        # transfer-guard sanitizer (observability/sanitizers.py) can
+        # tell it from an accidental implicit sync
+        return jax.device_get(nxt)
 
     def _run_tick_spec(self, tokens, starts, sampling):
         import jax
@@ -734,7 +739,8 @@ class ServingEngine:
             jnp.asarray(temps), jnp.asarray(topks), jnp.asarray(topps),
             self._key, jnp.asarray(self._tickno, jnp.int32),
             **self._pt_kw())
-        return np.asarray(out)
+        # designed once-per-tick fetch (see _run_tick)
+        return jax.device_get(out)
 
     # ------------------------------------------------------------------
     def _build_pp_tick(self):
@@ -890,7 +896,8 @@ class ServingEngine:
                 jnp.asarray(wave_of_stage), self._pp_other, self._key,
                 jnp.asarray(self._tickno, jnp.int32))
         self._caches = (kc, vc)
-        return np.asarray(nxt)
+        # designed once-per-tick fetch (see _run_tick)
+        return jax.device_get(nxt)
 
     # ------------------------------------------------------------------
     # scheduling
@@ -970,7 +977,17 @@ class ServingEngine:
         Paged mode additionally requires the request's PAGE footprint to
         fit the pool — a free slot alone is not capacity.  Admission
         stays FIFO: when the queue head's pages don't fit, later (maybe
-        smaller) requests wait behind it rather than starving it."""
+        smaller) requests wait behind it rather than starving it.
+
+        Returns the prefix-hit drafter replays ``[(slot, req, skip)]``
+        for the CALLER to run after releasing the engine lock: the
+        replay dispatches the drafter's jitted ingest program, and
+        dispatching device work under ``_lock`` stalls every concurrent
+        submit()/introspection call behind the device (pht-lint PHT003
+        caught this).  Deferral is safe — only the driver thread touches
+        slot state, and the replay only needs to land before this tick's
+        post-verify ingest, which runs later on this same thread."""
+        replays = []
         for i, slot in enumerate(self._slots):
             if slot.req is not None or not self._pending:
                 continue
@@ -985,12 +1002,13 @@ class ServingEngine:
             self._lengths[i] = skip
             self._c["prompt_tokens"].inc(len(req.prompt))
             if skip and self._spec is not None:
-                self._replay_skipped_to_drafter(i, req, skip)
+                replays.append((i, req, skip))
             req._span_queue.end(slot=i)
             self._flight.record(
                 "req", phase="admit", rid=req.rid, engine=self._engine_id,
                 slot=i, prefix_hit=skip,
                 queue_s=round(time.perf_counter() - req._t_submit, 6))
+        return replays
 
     def _paged_admit_locked(self, i, req):
         """Reserve slot ``i``'s whole page footprint up front (worst-case
@@ -1197,7 +1215,7 @@ class ServingEngine:
                          engine=self._engine_id, tickno=self._tickno,
                          committed=committed, **extra)
 
-    def _step_impl(self) -> bool:
+    def _step_impl(self) -> bool:  # pht-lint: hot-root (tick body)
         with self._lock:
             if self._running and \
                     threading.current_thread() is not self._loop_thread:
@@ -1208,7 +1226,7 @@ class ServingEngine:
                     "loop to drain (shutdown()) instead")
                 err._pht_usage_error = True   # step(): no crash dump
                 raise err
-            self._admit()
+            replays = self._admit()
             self._g_queue.set(len(self._pending))
             occ = sum(s.req is not None for s in self._slots)
             self._g_occupancy.set(occ)
@@ -1244,6 +1262,14 @@ class ServingEngine:
                 tokens, starts, nvalid, consumed, finishing = self._stage()
             if self._paged:
                 self._check_write_windows_locked(starts)
+
+        for i, req, skip in replays:
+            # deferred from _admit: the drafter's jitted ingest must not
+            # dispatch under the engine lock (only this driver thread
+            # mutates slot state, so running it here — before this
+            # tick's device program and its post-verify ingest — is
+            # order-equivalent to replaying inside _admit)
+            self._replay_skipped_to_drafter(i, req, skip)
 
         if mode == "pp":
             t0n = time.perf_counter_ns()
@@ -1409,6 +1435,7 @@ class ServingEngine:
         return True
 
     def _run_tick_multi(self, last_toks, starts, sampling):
+        import jax
         import jax.numpy as jnp
         vec, temps, topks, topps = sampling
         self._caches, out = self._prog("_tick_multi", vec)(
@@ -1416,7 +1443,8 @@ class ServingEngine:
             jnp.asarray(starts), jnp.asarray(temps), jnp.asarray(topks),
             jnp.asarray(topps), self._key,
             jnp.asarray(self._tickno, jnp.int32), **self._pt_kw())
-        return np.asarray(out)
+        # designed once-per-tick fetch (see _run_tick)
+        return jax.device_get(out)
 
     def _inflight_live(self):
         return any(any(r is not None for r in rec[2])

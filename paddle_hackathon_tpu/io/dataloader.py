@@ -21,6 +21,7 @@ from typing import Callable, Optional
 import numpy as np
 
 from ..core.tensor import Tensor
+from ..observability.sanitizers import make_lock
 from .dataset import IterableDataset
 from .sampler import BatchSampler
 
@@ -197,7 +198,7 @@ class _PrefetchIter:
         self.task_q: "queue.Queue" = queue.Queue()
         self.results = {}
         self.next_emit = 0
-        self.lock = threading.Lock()
+        self.lock = make_lock("dataloader.prefetch")
         self.cv = threading.Condition(self.lock)
         self.error = None
         for i, b in enumerate(self.batches):

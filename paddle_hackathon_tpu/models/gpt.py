@@ -666,6 +666,7 @@ class GPTForCausalLM(Layer):
         with ctx:  # partial-manual shard_map (pp) needs the ambient mesh
             return Tensor(run(params, ids, caches, key))
 
+    # pht-lint: hot-root (host draft-and-verify loop)
     def _generate_spec(self, input_ids, max_new_tokens, spec_k, drafter):
         """Speculative draft-and-verify greedy decoding (single-request
         path).  Two jitted programs — a prompt prefill and a (B, K+1)-wide
@@ -767,11 +768,15 @@ class GPTForCausalLM(Layer):
             dcache[(id(drafter), K)] = entry
         dr = entry[1]
         dr.begin(b, cache_len)
-        np_ids = np.asarray(ids, np.int32)
+        # explicit fetches (jax.device_get, not np.asarray-on-Array):
+        # these are the loop's designed device->host syncs — one for the
+        # prompt mirror, one per verify round trip — and the explicit
+        # form is what the transfer-guard sanitizer whitelists
+        np_ids = np.asarray(jax.device_get(ids), np.int32)
         dr.ingest(np_ids, np.zeros(b, np.int32),
                   np.full(b, prompt, np.int32))
         caches, tok0 = run_prefill(params, ids, caches)
-        tok0 = np.asarray(tok0)
+        tok0 = jax.device_get(tok0)
         out = np.zeros((b, max_new_tokens), np.int32)
         out[:, 0] = tok0
         ngen = np.ones(b, np.int64)
@@ -788,7 +793,7 @@ class GPTForCausalLM(Layer):
                 toks_j = jax.device_put(toks_j, tok_sh)
                 pos_j = jax.device_put(pos_j, tok_sh)
             caches, ver = run_verify(params, caches, toks_j, pos_j)
-            ver = np.asarray(ver)
+            ver = jax.device_get(ver)   # the round trip's designed fetch
             acc = accept_lengths(drafts, ndraft, ver)
             stats["ticks"] += 1
             ingest_nvalid = np.zeros(b, np.int32)

@@ -34,15 +34,23 @@ The event-level half lives next door and completes the triad:
 - ``server`` — opt-in stdlib HTTP introspection
   (:func:`start_introspection_server`: ``/metrics``, ``/healthz``,
   ``/debug/flight``, ``/debug/requests``).
+- ``sanitizers`` — opt-in runtime lock-order checker
+  (``PHT_LOCK_SANITIZER=1``; fail-fast cycle detection over the engine/
+  registry/tracing/flight/dataloader locks) and
+  :func:`forbid_host_transfers`, the transfer guard hot-path tests wrap
+  around steady-state ticks.  Static counterpart: ``tools/pht_lint``
+  (``docs/STATIC_ANALYSIS.md``).
 
 Metric catalog and endpoint reference: ``docs/OBSERVABILITY.md``.
 """
 
-from . import flight, tracing
+from . import flight, sanitizers, tracing
 from .flight import FlightRecorder, get_flight_recorder
 from .metrics import (Counter, Gauge, Histogram, MetricRegistry,
                       get_registry, instrument_jit, log_buckets,
                       record_device_memory, set_trace_sink, snapshot_delta)
+from .sanitizers import (HostTransferError, LockOrderError,
+                         forbid_host_transfers, make_lock, make_rlock)
 from .tracing import (add_span, disable_tracing, enable_tracing, end_span,
                       span, start_span, tracing_enabled)
 
@@ -52,7 +60,9 @@ __all__ = ["MetricRegistry", "Counter", "Gauge", "Histogram",
            "span", "start_span", "end_span", "add_span", "enable_tracing",
            "disable_tracing", "tracing_enabled", "FlightRecorder",
            "get_flight_recorder", "start_introspection_server",
-           "flight", "tracing"]
+           "forbid_host_transfers", "make_lock", "make_rlock",
+           "HostTransferError", "LockOrderError",
+           "flight", "sanitizers", "tracing"]
 
 
 def start_introspection_server(*args, **kwargs):

@@ -25,6 +25,8 @@ import threading
 import time
 from typing import Optional
 
+from .sanitizers import make_lock
+
 __all__ = ["FlightRecorder", "get_flight_recorder", "crash_dump"]
 
 DEFAULT_CAPACITY = 2048
@@ -41,7 +43,7 @@ class FlightRecorder:
     def __init__(self, capacity: int = DEFAULT_CAPACITY):
         self.enabled = True
         self._buf = collections.deque(maxlen=int(capacity))
-        self._lock = threading.Lock()
+        self._lock = make_lock("flight.recorder")
         self._dropped = 0
 
     @property

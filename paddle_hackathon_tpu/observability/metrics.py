@@ -29,6 +29,8 @@ import threading
 import time
 from typing import Dict, Iterable, Optional, Tuple
 
+from .sanitizers import make_lock
+
 __all__ = ["MetricRegistry", "Counter", "Gauge", "Histogram",
            "get_registry", "instrument_jit", "log_buckets",
            "record_device_memory", "set_trace_sink", "snapshot_delta"]
@@ -71,7 +73,7 @@ class _Child:
         self.name = name
         self.labels = labels            # sorted tuple of (key, value)
         self._reg = reg
-        self._lock = threading.Lock()
+        self._lock = make_lock("metrics.child")
 
 
 class Counter(_Child):
@@ -213,7 +215,7 @@ class _Family:
         self.buckets = buckets
         self._reg = reg
         self._children: Dict[Tuple, _Child] = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock("metrics.family")
 
     def labels(self, **kv) -> _Child:
         key = tuple(sorted((k, str(v)) for k, v in kv.items()))
@@ -264,7 +266,7 @@ class MetricRegistry:
     def __init__(self, enabled: bool = True):
         self.enabled = bool(enabled)
         self._families: Dict[str, _Family] = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock("metrics.registry")
 
     # -- lifecycle ---------------------------------------------------------
     def enable(self):

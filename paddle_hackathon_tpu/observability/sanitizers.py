@@ -1,0 +1,429 @@
+"""Runtime sanitizers: lock-order checking and host-transfer guarding.
+
+The static half of this defence lives in ``tools/pht_lint`` (PHT001
+host-sync-in-hot-path, PHT003 lock-discipline).  Static analysis is
+conservative — it can only see acquisition orders the AST spells out.
+These sanitizers are the dynamic half: they watch what the process
+*actually does* and fail fast, with stacks, at the first violation.
+
+Two tools (catalog and env flags: ``docs/STATIC_ANALYSIS.md``):
+
+- :func:`make_lock` / :func:`make_rlock` — drop-in lock constructors the
+  concurrent subsystems (serving engine, metric registry, tracing,
+  flight recorder, dataloader) use instead of ``threading.Lock()``.
+  Disabled (the default), they return the plain stdlib lock — zero
+  added cost, not even a wrapper frame.  Enabled (``PHT_LOCK_SANITIZER=1``
+  in the environment at lock creation, or under
+  :func:`lock_sanitizer`), they return a :class:`_SanitizedLock` that
+  records per-thread acquisition stacks, maintains a process-global
+  lock-order graph, and raises :class:`LockOrderError` the moment any
+  thread acquires two locks in an order that cycles against an order
+  some thread (this one or another) has already used — i.e. it turns a
+  once-in-a-blue-moon deadlock into a deterministic test failure with
+  both acquisition stacks attached.
+
+- :func:`forbid_host_transfers` — context manager hot-path tests wrap
+  around steady-state decode/train ticks.  Inside it, an *implicit*
+  device→host transfer (``np.asarray`` on a jax Array, ``float()`` /
+  ``int()`` / ``bool()`` / ``.item()`` on one) is a named
+  :class:`HostTransferError` instead of a silent 10x stall; the
+  *explicit* fetch (``jax.device_get``) every hot loop is designed
+  around stays allowed.  On TPU/GPU the XLA transfer guard
+  (``jax.transfer_guard_device_to_host``) is authoritative.  On the CPU
+  backend that guard never fires (device buffers ARE host memory, the
+  fetch is zero-copy), so we additionally interpose the scalar-
+  conversion dunders on ``ArrayImpl`` — which catches the PHT001 bug
+  classes (``float``/``int``/``bool``/``.item``/``tolist``) but not
+  ``np.asarray``, which NumPy routes through the C buffer protocol.
+  That one CPU blind spot is closed statically by pht-lint's
+  np.asarray-on-Array taint rule.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import sys
+import threading
+import traceback
+from typing import Dict, List, Optional, Tuple
+
+
+def _capture_stack(skip: int = 3):
+    """Cheap stack capture for evidence: frame walk WITHOUT source-line
+    reads (lookup_lines=False defers linecache to format time) — the
+    stack is only ever rendered on an error path, so the steady-state
+    sanitized acquire pays a tuple walk, not a traceback render.
+    ``skip`` drops this helper + the sanitizer wrapper frames."""
+    try:
+        f = sys._getframe(skip)
+    except ValueError:
+        f = sys._getframe(1)
+    s = traceback.StackSummary.extract(
+        traceback.walk_stack(f), limit=16, lookup_lines=False)
+    s.reverse()             # oldest-first, like format_stack
+    return s
+
+
+def _fmt_stack(summary) -> str:
+    return "".join(summary.format())
+
+__all__ = ["LockOrderError", "HostTransferError", "make_lock",
+           "make_rlock", "lock_sanitizer", "lock_sanitizer_enabled",
+           "reset_lock_graph", "forbid_host_transfers"]
+
+_ENV_FLAG = "PHT_LOCK_SANITIZER"
+
+
+class LockOrderError(RuntimeError):
+    """Two locks were acquired in an order that cycles against an order
+    already observed — a latent deadlock, reported deterministically."""
+
+
+class HostTransferError(RuntimeError):
+    """An implicit device→host transfer happened under
+    :func:`forbid_host_transfers`."""
+
+
+# ---------------------------------------------------------------------------
+# lock-order sanitizer
+# ---------------------------------------------------------------------------
+
+_forced = 0                      # lock_sanitizer() nesting count
+_graph_lock = threading.Lock()   # guards _edges (plain lock, never sanitized)
+# (held_name, acquired_name) -> captured StackSummary of the first time
+# this edge was taken (the evidence attached to a later cycle report;
+# formatted only when a report actually fires)
+_edges: Dict[Tuple[str, str], object] = {}
+# thread ident -> [(lock, name, stack)].  A plain dict, NOT
+# threading.local: stdlib Lock legally supports acquire-in-A /
+# release-in-B (handoff pattern), and the releasing thread must be able
+# to clear the OWNER's entry — per-key access is GIL-atomic.
+_held_map: Dict[int, List] = {}
+
+
+def lock_sanitizer_enabled() -> bool:
+    """True when :func:`make_lock` should hand out instrumented locks.
+
+    Checked at lock *creation* time: a lock built while the sanitizer is
+    off stays a plain ``threading.Lock`` forever (that is the zero-cost
+    contract), so enable the sanitizer *before* constructing the engine
+    / registry / loader under test."""
+    return _forced > 0 or os.environ.get(_ENV_FLAG, "") not in ("", "0")
+
+
+@contextlib.contextmanager
+def lock_sanitizer():
+    """Force-enable :func:`make_lock` instrumentation for this block
+    (test fixture path — no environment mutation, nests fine)."""
+    global _forced
+    _forced += 1
+    try:
+        yield
+    finally:
+        _forced -= 1
+
+
+def reset_lock_graph() -> None:
+    """Drop every recorded edge AND held-stack entry (test isolation:
+    one test's legitimate order must not veto another's opposite-but-
+    unrelated order, and a lock leaked held by a failed test or dead
+    thread must not phantom-poison a later thread that reuses the
+    ident)."""
+    with _graph_lock:
+        _edges.clear()
+        _held_map.clear()
+
+
+def make_lock(name: str):
+    """A ``threading.Lock`` — instrumented iff the sanitizer is enabled
+    at creation.  ``name`` identifies the lock in the order graph; locks
+    sharing a name are one node (every ``ServingEngine._lock`` is
+    ``"serving.engine"``), so cross-instance inversions count too."""
+    if not lock_sanitizer_enabled():
+        return threading.Lock()
+    return _SanitizedLock(name, threading.Lock(), reentrant=False)
+
+
+def make_rlock(name: str):
+    """RLock variant of :func:`make_lock` (reentrant re-acquisition of
+    the SAME instance records no edge and never errors)."""
+    if not lock_sanitizer_enabled():
+        return threading.RLock()
+    return _SanitizedLock(name, threading.RLock(), reentrant=True)
+
+
+def _held(ident: Optional[int] = None) -> List[Tuple[object, str, str]]:
+    tid = threading.get_ident() if ident is None else ident
+    h = _held_map.get(tid)
+    if h is None:
+        h = _held_map[tid] = []
+    return h
+
+
+def _find_path(src: str, dst: str) -> Optional[List[str]]:
+    """Path src -> ... -> dst in the edge graph (caller holds _graph_lock)."""
+    stack = [(src, [src])]
+    seen = set()
+    while stack:
+        cur, path = stack.pop()
+        if cur == dst:
+            return path
+        if cur in seen:
+            continue
+        seen.add(cur)
+        for (a, b) in _edges:
+            if a == cur:
+                stack.append((b, path + [b]))
+    return None
+
+
+class _SanitizedLock:
+    """Lock wrapper recording per-thread acquisition stacks and checking
+    the global order graph on every nested acquisition.
+
+    Works as the lock of a ``threading.Condition`` too — for the Lock
+    AND the RLock variant: ``_release_save``/``_acquire_restore``/
+    ``_is_owned`` delegate to the inner lock's own protocol (so a
+    recursively-held RLock fully releases across ``wait()`` and its
+    whole held-stack depth is restored on wake), and the ``_is_owned``
+    probe goes straight to the inner lock, recording no order edges."""
+
+    __slots__ = ("name", "_inner", "_reentrant", "_owners")
+
+    def __init__(self, name: str, inner, reentrant: bool):
+        self.name = name
+        self._inner = inner
+        self._reentrant = reentrant
+        self._owners: List[int] = []   # thread idents, acquisition order
+
+    # -- bookkeeping --------------------------------------------------------
+    def _check_order(self, blocking: bool) -> None:
+        held = _held()
+        for lk, _, first_stk in held:
+            if lk is self:
+                if self._reentrant:
+                    return        # same-instance RLock re-entry: no edge
+                if blocking:
+                    # any blocking acquire — timed or not — of a lock
+                    # this thread already holds can only fail; raise
+                    # instead of hanging (or burning the timeout)
+                    raise LockOrderError(
+                        f"lock `{self.name}` re-acquired by the thread "
+                        f"already holding it (non-reentrant Lock) — "
+                        f"this deadlocks\nfirst acquisition:\n"
+                        f"{_fmt_stack(first_stk)}")
+                return            # non-blocking try-acquire probe
+        if not blocking:
+            # try-acquire is the standard deadlock-AVOIDANCE pattern (it
+            # backs off on failure, so reverse-order try-lock cannot
+            # deadlock): neither cycle-checked nor recorded as order
+            # evidence.  A later BLOCKING acquire while try-held locks
+            # are in the held list still records its edges normally.
+            return
+        if not held:
+            return
+        # the stack is only captured when actually needed (a NEW edge
+        # or an error): on the steady-state path — every edge already
+        # known — a sanitized nested acquire costs one dict probe per
+        # held lock, not a frame walk
+        stack = None
+
+        def _stk():
+            nonlocal stack
+            if stack is None:
+                # _capture_stack <- _stk <- _check_order <- acquire
+                stack = _capture_stack(skip=4)
+            return stack
+
+        with _graph_lock:
+            for _, h_name, h_stk in held:
+                if h_name == self.name:
+                    # cite the MATCHED entry's stack — held[-1] may be
+                    # a different, innocent lock acquired in between
+                    raise LockOrderError(
+                        f"lock `{self.name}` acquired while another "
+                        f"instance of `{h_name}` is held — two threads "
+                        f"nesting opposite instances deadlock\n"
+                        f"holding:\n{_fmt_stack(h_stk)}\n"
+                        f"acquiring:\n{_fmt_stack(_stk())}")
+                edge = (h_name, self.name)
+                if edge not in _edges:
+                    back = _find_path(self.name, h_name)
+                    if back is not None:
+                        chain = " -> ".join(back)
+                        raise LockOrderError(
+                            f"lock-order cycle: this thread holds "
+                            f"`{h_name}` and is acquiring `{self.name}`, "
+                            f"but the order {chain} was already used"
+                            f"\nreverse-order evidence (first "
+                            f"{back[0]} -> {back[1]} site):\n"
+                            f"{_fmt_stack(_edges[(back[0], back[1])])}"
+                            f"\nthis acquisition:\n{_fmt_stack(_stk())}")
+                    _edges[edge] = _stk()
+
+    def _record(self) -> None:
+        # _capture_stack <- _record <- acquire: evidence stays unformatted
+        # until an error actually needs it
+        stack = _capture_stack(skip=3)
+        tid = threading.get_ident()
+        _held(tid).append((self, self.name, stack))
+        self._owners.append(tid)
+
+    def _unrecord(self) -> None:
+        """Clear the most recent OWNER's entry — which, for the stdlib
+        handoff pattern, may live on a different thread's held list than
+        the one calling release()."""
+        if not self._owners:
+            return
+        tid = self._owners.pop()
+        held = _held_map.get(tid)
+        if held is None:
+            return
+        for i in range(len(held) - 1, -1, -1):
+            if held[i][0] is self:
+                del held[i]
+                break
+        # the emptied list is deliberately NOT popped from _held_map: a
+        # cross-thread release racing the owner's concurrent _record
+        # would orphan the list the owner is appending to, silently
+        # hiding that hold.  An empty list per dead thread is the
+        # (tiny, bounded-by-thread-count) price of correctness.
+
+    # -- lock protocol ------------------------------------------------------
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        self._check_order(bool(blocking))
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            self._record()
+        return got
+
+    def release(self):
+        self._unrecord()
+        self._inner.release()
+
+    def locked(self):
+        return self._inner.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    # -- Condition protocol -------------------------------------------------
+    # Condition prefers these over its acquire/release fallbacks; they
+    # must fully release a (possibly recursive) hold across wait() and
+    # restore the SAME held-stack depth on wake.
+    def _release_save(self):
+        held = _held()
+        depth = sum(1 for lk, _, _ in held if lk is self)
+        for _ in range(depth):
+            self._unrecord()
+        inner = self._inner
+        if hasattr(inner, "_release_save"):
+            state = inner._release_save()   # RLock: drops every level
+        else:
+            inner.release()
+            state = None
+        return (state, depth)
+
+    def _acquire_restore(self, saved):
+        state, depth = saved
+        inner = self._inner
+        if hasattr(inner, "_acquire_restore"):
+            inner._acquire_restore(state)
+        else:
+            inner.acquire()
+        for _ in range(max(depth, 1)):
+            self._record()
+
+    def _is_owned(self):
+        inner = self._inner
+        if hasattr(inner, "_is_owned"):
+            return inner._is_owned()
+        # plain Lock: Condition's own probe semantics, against the
+        # INNER lock directly — an ownership probe is not an
+        # acquisition order event
+        if inner.acquire(False):
+            inner.release()
+            return False
+        return True
+
+
+# ---------------------------------------------------------------------------
+# transfer guard
+# ---------------------------------------------------------------------------
+
+_patch_lock = threading.Lock()
+_patch_depth = 0
+_saved_dunders: Dict[str, object] = {}
+
+# scalar-conversion surface of jaxlib's ArrayImpl: every one of these is
+# an implicit device→host sync in disguise (the PHT001 call set)
+_PATCHED = ("__float__", "__int__", "__bool__", "__index__", "__complex__",
+            "item", "tolist")
+
+
+def _trip(name):
+    def tripped(self, *a, **k):
+        raise HostTransferError(
+            f"implicit device→host transfer: `{name}` called on a jax "
+            f"Array under forbid_host_transfers() — fetch once, "
+            f"explicitly, with jax.device_get(...) at the tick's "
+            f"designed sync point (pht-lint PHT001)")
+    return tripped
+
+
+def _arrayimpl():
+    import jax  # noqa: F401  (ensures jaxlib is importable first)
+    from jax._src.array import ArrayImpl
+    return ArrayImpl
+
+
+def _patch_cpu_dunders():
+    global _patch_depth
+    with _patch_lock:
+        if _patch_depth == 0:
+            cls = _arrayimpl()
+            for n in _PATCHED:
+                orig = getattr(cls, n, None)
+                if orig is not None:
+                    _saved_dunders[n] = orig
+                    setattr(cls, n, _trip(n))
+        _patch_depth += 1
+
+
+def _unpatch_cpu_dunders():
+    global _patch_depth
+    with _patch_lock:
+        _patch_depth -= 1
+        if _patch_depth == 0:
+            cls = _arrayimpl()
+            for n, orig in _saved_dunders.items():
+                setattr(cls, n, orig)
+            _saved_dunders.clear()
+
+
+@contextlib.contextmanager
+def forbid_host_transfers():
+    """Fail loudly on any *implicit* device→host transfer in the block.
+
+    ``jax.device_get`` (the explicit designed fetch) stays allowed — the
+    point is to prove a steady-state tick performs its ONE designed sync
+    and nothing else.  Host→device transfers are not restricted (tick
+    inputs legitimately stream up).  See the module docstring for the
+    TPU (XLA guard) vs CPU (dunder interposition) mechanics."""
+    import jax
+    cpu_only = all(d.platform == "cpu" for d in jax.devices())
+    with jax.transfer_guard_device_to_host("disallow"):
+        if cpu_only:
+            _patch_cpu_dunders()
+            try:
+                yield
+            finally:
+                _unpatch_cpu_dunders()
+        else:
+            yield

@@ -41,6 +41,8 @@ import time
 import weakref
 from typing import Dict, Optional
 
+from .sanitizers import make_lock
+
 __all__ = ["span", "start_span", "end_span", "add_span", "Span",
            "enable_tracing", "disable_tracing", "tracing_enabled",
            "set_span_sink", "heartbeat", "beacon_ages", "remove_beacon",
@@ -215,7 +217,7 @@ _sources: "weakref.WeakValueDictionary[str, object]" = \
     weakref.WeakValueDictionary()
 # WeakValueDictionary iteration tolerates GC-driven removals (iteration
 # guard) but a concurrent INSERT raises — serialize mutation vs snapshot
-_sources_lock = threading.Lock()
+_sources_lock = make_lock("tracing.sources")
 
 
 def register_introspection_source(name: str, obj) -> None:

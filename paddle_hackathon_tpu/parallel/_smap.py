@@ -50,18 +50,59 @@ def active_manual_axes() -> frozenset:
     return frozenset(_axes())
 
 
-def run_shard_map(fn, mesh, in_specs, out_specs, manual_axes, args):
+# Eager-path program cache.  ``jax.jit``'s own cache keys on the
+# function's identity, and every eager run_shard_map call used to build
+# a FRESH shard_map closure — so each call was a full retrace+compile
+# (pht-lint PHT002).  Key on everything the closure semantics depend on:
+# the wrapped fn (or the caller's ``cache_key``, for callers whose fn is
+# itself a fresh closure over values the key captures), the mesh, the
+# manual axes, and the in/out spec trees.  Bounded LRU (hits refresh
+# recency; the least-recently-USED entry is evicted): keys hold strong
+# refs to callables, and an unbounded map would pin every mesh a test
+# suite ever built.
+import collections
+
+_prog_cache = collections.OrderedDict()
+_PROG_CACHE_MAX = 64
+
+
+def run_shard_map(fn, mesh, in_specs, out_specs, manual_axes, args,
+                  cache_key=None):
+    """``cache_key`` contract: when given, it REPLACES ``fn`` in the
+    program-cache key, so it must capture everything ``fn``'s closure
+    does (two calls with equal keys must want the same program)."""
     manual = frozenset(manual_axes)
     from jax._src import core as _core
     if _core.trace_state_clean():
-        # mesh passed EXPLICITLY: the old-jax compat path must not fall
-        # back to the repo-global parallel.api.get_mesh(), which may be
-        # None or a different mesh than the caller's
-        sm = shard_map(fn, mesh=mesh, in_specs=in_specs,
-                       out_specs=out_specs, axis_names=manual,
-                       check_vma=False)
+        spec_leaves, spec_def = jax.tree.flatten((in_specs, out_specs))
+        key = (cache_key if cache_key is not None else fn,
+               mesh, manual, tuple(spec_leaves), spec_def)
+        jitted = _prog_cache.get(key)
+        if jitted is not None:
+            # LRU, not FIFO: refresh recency on hit so a per-token-hot
+            # program (pipeline decode) is never the eviction victim
+            # just because it was built first.  move_to_end is one
+            # GIL-atomic call — a pop/reinsert pair would open a window
+            # where a concurrent reader misses and pays a full retrace
+            try:
+                _prog_cache.move_to_end(key)
+            except KeyError:
+                pass   # concurrently evicted; we still hold the program
+        if jitted is None:
+            # mesh passed EXPLICITLY: the old-jax compat path must not
+            # fall back to the repo-global parallel.api.get_mesh(),
+            # which may be None or a different mesh than the caller's
+            sm = shard_map(fn, mesh=mesh, in_specs=in_specs,
+                           out_specs=out_specs, axis_names=manual,
+                           check_vma=False)
+            if len(_prog_cache) >= _PROG_CACHE_MAX:
+                try:   # concurrent eager callers may race the eviction
+                    _prog_cache.pop(next(iter(_prog_cache)), None)
+                except (StopIteration, RuntimeError):
+                    pass
+            jitted = _prog_cache[key] = jax.jit(sm)
         with set_mesh(mesh):
-            return jax.jit(sm)(*args)
+            return jitted(*args)
     if manual == frozenset(mesh.axis_names):
         sm = shard_map(fn, mesh=mesh, in_specs=in_specs,
                        out_specs=out_specs, check_vma=False)

@@ -148,7 +148,11 @@ def pipeline_apply(block_fn: Callable, stage_params: Any, x_mb: jax.Array,
                   if extra is not None else P()),
         out_specs=(x_spec, P()) if with_aux else x_spec,
         manual_axes=manual,
-        args=(stage_params, x_mb, extra))
+        args=(stage_params, x_mb, extra),
+        # spmd is rebuilt per call; everything it closes over is here
+        # (shapes are jit's problem, specs are in run_shard_map's key)
+        cache_key=("pipeline_apply", block_fn, n_stages_, n_micro,
+                   with_aux))
 
 
 def pipeline_decode_apply(layer_step: Callable, stacked: Any, caches: Any,
@@ -210,7 +214,10 @@ def pipeline_decode_apply(layer_step: Callable, stacked: Any, caches: Any,
                   jax.tree.map(lambda _: P("pp"), caches), P(), P()),
         out_specs=(P(), jax.tree.map(lambda _: P("pp"), caches)),
         manual_axes={"pp"},
-        args=(stacked, caches, x, pos))
+        args=(stacked, caches, x, pos),
+        # per-decode-step call site: without the key every token paid a
+        # fresh trace+compile of the whole pipelined program
+        cache_key=("pipeline_decode", layer_step, n))
 
 
 class LayerDesc:

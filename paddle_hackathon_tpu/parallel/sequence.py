@@ -223,7 +223,10 @@ def ring_attention(q, k, v, mesh: Mesh, axis: str = "sp",
             in_specs=(P(None, axis), P(None, axis), P(None, axis)),
             out_specs=P(None, axis),
             manual_axes={axis},
-            args=(q, k, v))
+            args=(q, k, v),
+            # spmd is a fresh closure per call over exactly these values
+            # — the key keeps the eager-path jit cache hitting
+            cache_key=("ring_flash", axis, n, causal, float(scale_)))
 
     def spmd(ql, kl, vl):
         # ql/kl/vl: (b, s/n, h, d) — this device's sequence chunk
@@ -274,7 +277,11 @@ def ring_attention(q, k, v, mesh: Mesh, axis: str = "sp",
         in_specs=(P(None, axis), P(None, axis), P(None, axis)),
         out_specs=P(None, axis),
         manual_axes={axis},
-        args=(q, k, v))
+        args=(q, k, v),
+        # seq_local is baked into the closure's causal bias — it MUST
+        # key the cache, or a retrace at a new shape would reuse a
+        # stale-bias closure
+        cache_key=("ring_xla", axis, n, causal, float(scale_), seq_local))
 
 
 def ulysses_attention(q, k, v, mesh: Mesh, axis: str = "sp",
@@ -333,7 +340,8 @@ def ulysses_attention(q, k, v, mesh: Mesh, axis: str = "sp",
         in_specs=(P(None, axis), P(None, axis), P(None, axis)),
         out_specs=P(None, axis),
         manual_axes={axis},
-        args=(q, k, v))
+        args=(q, k, v),
+        cache_key=("ulysses", axis, n, causal, float(scale_), flash))
 
 
 def _sp_dropout_rate(layer) -> float:

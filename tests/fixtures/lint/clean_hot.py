@@ -1,0 +1,27 @@
+"""A well-behaved hot path: tests assert ZERO findings here.
+
+The shapes the rules must NOT fire on: module-level jit with stable
+identity, host-side numpy work, branching on host values only, the
+device array staying on device.  Never executed.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_step = jax.jit(lambda x: x * 2)   # module level: stable jit identity
+
+
+def tick():  # pht-lint: hot-root
+    x = jnp.ones((4,))
+    y = _step(x)
+    host = np.asarray([1, 2, 3])   # numpy on host data: no device taint
+    if host.sum() > 0:             # host predicate: fine
+        y = _step(y)
+    return y                       # stays on device: no sync
+
+
+@jax.jit
+def shadowed_name_ok(x, time):
+    """This module never imports `time`: a parameter that happens to
+    carry the name is not the stdlib module (PHT004 must stay quiet)."""
+    return x + time.total_seconds()

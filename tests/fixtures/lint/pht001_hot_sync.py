@@ -1,0 +1,49 @@
+"""Seeded PHT001 violations (host sync in a hot path).
+
+tests/test_lint.py parses the ``# expect: RULE`` comments and asserts
+the linter reports EXACTLY those (rule, line) pairs — the comments ARE
+the assertion, so keep them on the violating line.
+
+This file is never imported or executed (and ``fixtures`` is excluded
+from the repo-wide lint scope); it exists purely as AST input.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def tick_body():  # pht-lint: hot-root
+    x = jnp.zeros((8,))
+    v = x.item()                       # expect: PHT001
+    x.block_until_ready()              # expect: PHT001
+    got = jax.device_get(x)            # expect: PHT001
+    arr = np.asarray(x)                # expect: PHT001
+    f = float(x)                       # expect: PHT001
+    if x:                              # expect: PHT001
+        pass
+    n = got.item()                     # laundered fetch: host, no finding
+    m = np.asarray([4, 5]).item()      # numpy-from-host: no finding
+    _reached_helper()
+    return v, got, arr, f, n, m
+
+
+def _reached_helper():
+    """Reachable from the hot root via the same-module call graph —
+    its sync is a hot-path sync too."""
+    y = jnp.ones((2,))
+    return y.item()                    # expect: PHT001
+
+
+def cold_path():
+    """NOT reachable from any hot root: the same calls are fine here."""
+    z = jnp.ones((3,))
+    return z.item(), float(z), np.asarray(z)
+
+
+class Engine:
+    def step(self):  # pht-lint: hot-root
+        """A device assignment to an ATTRIBUTE must not taint the
+        receiver: np.asarray on host-data attributes stays clean."""
+        self._key = jnp.zeros((4,))
+        self._host = [1, 2, 3]
+        return np.asarray(self._host)   # host data: no finding

@@ -1,0 +1,57 @@
+"""Seeded PHT002 violations (retrace hazards).
+
+See pht001_hot_sync.py for the ``# expect:`` contract.  Never executed.
+"""
+import jax
+import jax.numpy as jnp
+
+
+def _impl(x, n):
+    return x * n
+
+
+_prog = jax.jit(_impl, static_argnums=(1,))   # module level: fine
+
+
+def jit_in_loop(fns, x):
+    out = []
+    for f in fns:
+        out.append(jax.jit(f)(x))             # expect: PHT002
+    return out
+
+
+def hot_builder():  # pht-lint: hot-root
+    prog = jax.jit(_impl, static_argnums=(1,))   # expect: PHT002
+    return prog
+
+
+def unstable_identity(x):
+    return jax.jit(lambda v: v * 2)(x)        # expect: PHT002
+
+
+def unhashable_static(x):
+    return _prog(x, [1, 2, 3])                # expect: PHT002
+
+
+@jax.jit
+def traced_branch(x):
+    if x > 0:                                 # expect: PHT002
+        return x
+    return -x
+
+
+@jax.jit
+def shielded_branch_ok(x):
+    if x.shape[0] > 2:    # shape is static under trace: no finding
+        return x * 2
+    return x
+
+
+class Host:
+    def _impl(self, n):
+        """Same NAME as the module-level jitted function, but this
+        method is never jitted: plain-Python branching is fine (the
+        old suffix-match resolution false-fired PHT002 here)."""
+        if n:
+            return 1
+        return 0

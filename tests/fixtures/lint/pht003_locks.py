@@ -1,0 +1,51 @@
+"""Seeded PHT003 violations (lock discipline).
+
+See pht001_hot_sync.py for the ``# expect:`` contract.  Never executed.
+
+Note on the cycle finding's anchor line: the linter reports a cycle ONCE,
+at the first-recorded edge of the pair — functions are indexed in
+definition order, so the report lands on ``forward_order``'s inner
+``with`` (the ``_lock_a -> _lock_b`` edge), with ``backward_order``'s
+reverse path cited in the message.
+"""
+import threading
+
+import jax.numpy as jnp
+
+_lock_a = threading.Lock()
+_lock_b = threading.Lock()
+
+
+def forward_order():
+    with _lock_a:
+        with _lock_b:                  # expect: PHT003
+            pass
+
+
+def backward_order():
+    with _lock_b:
+        with _lock_a:
+            pass
+
+
+def dispatch_under_lock(x):
+    with _lock_a:
+        return jnp.sum(x)              # expect: PHT003
+
+
+_lock_c = threading.Lock()
+_lock_d = threading.Lock()
+
+
+def multi_item_order():
+    """`with C, D:` acquires left-to-right — it must record the C->D
+    edge (the report for the cycle against reversed_nesting lands here,
+    the first-recorded edge of the pair)."""
+    with _lock_c, _lock_d:             # expect: PHT003
+        pass
+
+
+def reversed_nesting():
+    with _lock_d:
+        with _lock_c:
+            pass

@@ -1,0 +1,48 @@
+"""Seeded PHT004 violations (nondeterminism frozen into a jitted body).
+
+See pht001_hot_sync.py for the ``# expect:`` contract.  Never executed.
+"""
+import random
+import time
+import time as walltime
+from random import random as rnd
+
+import jax
+import numpy as np
+
+
+def _noise_helper():
+    """Reachable from the jitted body: its entropy freezes too."""
+    return time.time()                 # expect: PHT004
+
+
+@jax.jit
+def frozen_entropy(x):
+    t = time.time()                    # expect: PHT004
+    r = random.random()                # expect: PHT004
+    n = np.random.rand()               # expect: PHT004
+    extra = _noise_helper()
+    return x + t + r + n + extra
+
+
+@jax.jit
+def aliased_entropy(x):
+    """Aliased and from-imported entropy is the same frozen value."""
+    a = walltime.time()                # expect: PHT004
+    b = rnd()                          # expect: PHT004
+    return x + a + b
+
+
+@jax.jit
+def nested_scope(x):
+    """A nested def reports ONCE, under its own func name; a staged
+    lambda reports under the enclosing jitted body."""
+    def inner():
+        return random.random()         # expect: PHT004
+    g = lambda: time.time()            # expect: PHT004  # noqa: E731
+    return x + inner() + g()
+
+
+def host_side_ok():
+    """Not jitted: wall clocks and host RNG are fine here."""
+    return time.time(), random.random()

@@ -1,0 +1,352 @@
+"""pht-lint: seeded-violation fixtures, the baseline workflow, CLI exit
+codes, and the tier-1 gate — the repo-wide run must be CLEAN (zero
+unsuppressed findings), so any new hot-path sync / retrace hazard /
+lock inversion breaks the suite here instead of landing.
+
+Rule catalog and workflow: docs/STATIC_ANALYSIS.md.  Pure AST work —
+no engine compiles, the whole module stays in the lean tier-1 budget
+(~7s, dominated by the one repo-wide walk).
+"""
+
+import collections
+import os
+import re
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if ROOT not in sys.path:
+    sys.path.insert(0, ROOT)
+
+from tools.pht_lint import (BaselineError, DEFAULT_BASELINE,  # noqa: E402
+                            changed_paths, default_paths, load_baseline,
+                            run_lint)
+from tools.pht_lint.__main__ import main as lint_main  # noqa: E402
+
+FIXTURES = os.path.join(ROOT, "tests", "fixtures", "lint")
+
+_EXPECT_RE = re.compile(r"#\s*expect:\s*((?:PHT\d{3}[\s,]*)+)")
+
+
+def _expected(path):
+    """(line, rule) -> count, parsed from the fixture's own comments."""
+    out = collections.Counter()
+    with open(path, encoding="utf-8") as f:
+        for i, line in enumerate(f, 1):
+            m = _EXPECT_RE.search(line)
+            if m:
+                for rule in m.group(1).replace(",", " ").split():
+                    out[(i, rule)] += 1
+    return out
+
+
+def _actual(path):
+    findings, suppressed, unused = run_lint(paths=[path],
+                                            baseline_path=None)
+    assert not suppressed and not unused
+    return collections.Counter((f.line, f.rule) for f in findings)
+
+
+# ------------------------------------------------------------ fixtures
+@pytest.mark.parametrize("name", ["pht001_hot_sync.py",
+                                  "pht002_retrace.py",
+                                  "pht003_locks.py",
+                                  "pht004_nondet.py"])
+def test_seeded_violations_detected_at_exact_lines(name):
+    """Every seeded violation fires at the exact file:line — and ONLY
+    there (the Counter equality also rejects extra findings, so the
+    fixtures' negative shapes — cold_path, shielded_branch_ok,
+    host_side_ok — are asserted clean by the same comparison)."""
+    path = os.path.join(FIXTURES, name)
+    expected = _expected(path)
+    assert expected, f"{name} has no # expect: comments"
+    assert _actual(path) == expected
+
+
+def test_clean_fixture_has_zero_findings():
+    assert _actual(os.path.join(FIXTURES, "clean_hot.py")) == {}
+
+
+def test_fixture_findings_carry_func_and_hint():
+    findings, _, _ = run_lint(
+        paths=[os.path.join(FIXTURES, "pht001_hot_sync.py")],
+        baseline_path=None)
+    for f in findings:
+        assert f.func and f.hint and f.message
+        assert f.file.startswith("tests/fixtures/lint/")
+        assert re.search(r":\d+: PHT\d{3}", f.render())
+
+
+# ------------------------------------------------------ repo-wide gate
+def test_repo_wide_lint_is_clean():
+    """THE gate: zero unsuppressed findings across the package, tools
+    and bench driver, and zero unused baseline entries (a fixed finding
+    must take its suppression with it)."""
+    findings, suppressed, unused = run_lint()
+    assert findings == [], "unsuppressed pht-lint findings:\n" + "\n".join(
+        f.render() for f in findings)
+    assert unused == [], f"stale baseline entries (fixed? delete them): " \
+                         f"{unused}"
+    # the declared hot roots must actually exist in the walked scope —
+    # a rename that silently drops a root would turn PHT001 off there
+    assert any(f.rule == "PHT001" for f in suppressed), \
+        "no PHT001 suppressions: did the hot-root annotations vanish?"
+
+
+def test_default_scope_covers_the_hot_modules():
+    paths = {os.path.relpath(p, ROOT) for p in default_paths()}
+    for rel in ("paddle_hackathon_tpu/inference/serving.py",
+                "paddle_hackathon_tpu/hapi/compiled.py",
+                "paddle_hackathon_tpu/nn/decode.py",
+                "tools/metrics_dump.py", "tools/perf_gate.py",
+                "bench.py"):
+        assert rel in paths, rel
+    assert not any("fixtures" in p for p in paths)
+
+
+# ------------------------------------------------------------ baseline
+def test_baseline_entries_all_have_reasons():
+    entries = load_baseline(DEFAULT_BASELINE)
+    assert entries, "baseline exists and is non-empty"
+    for e in entries:
+        assert e["reason"].strip(), e
+
+
+def test_baseline_missing_reason_is_an_error(tmp_path):
+    p = tmp_path / "b.toml"
+    p.write_text('[[suppress]]\nrule = "PHT001"\n'
+                 'file = "x.py"\nfunc = "f"\n')
+    with pytest.raises(BaselineError, match="no reason"):
+        load_baseline(str(p))
+
+
+def test_baseline_unknown_key_and_bad_syntax_are_errors(tmp_path):
+    p = tmp_path / "b.toml"
+    p.write_text('[[suppress]]\nrule = "PHT001"\nfile = "x.py"\n'
+                 'func = "f"\nreason = "r"\nseverity = "low"\n')
+    with pytest.raises(BaselineError, match="unknown key"):
+        load_baseline(str(p))
+    p.write_text('[[suppress]]\nrule = PHT001\n')
+    with pytest.raises(BaselineError, match="double-quoted"):
+        load_baseline(str(p))
+
+
+def test_baseline_suppresses_matching_findings(tmp_path):
+    fixture = os.path.join(FIXTURES, "pht004_nondet.py")
+    p = tmp_path / "b.toml"
+    p.write_text('[[suppress]]\nrule = "PHT004"\n'
+                 'file = "tests/fixtures/lint/pht004_nondet.py"\n'
+                 'func = "frozen_entropy"\n'
+                 'reason = "seeded on purpose"\n')
+    findings, suppressed, unused = run_lint(paths=[fixture],
+                                            baseline_path=str(p))
+    assert {f.func for f in suppressed} == {"frozen_entropy"}
+    assert len(suppressed) == 3
+    # findings in OTHER functions are not covered by the entry
+    assert {f.func for f in findings} == {"_noise_helper",
+                                          "aliased_entropy",
+                                          "nested_scope",
+                                          "nested_scope.inner"}
+    assert unused == []
+
+
+def test_unused_baseline_entry_is_reported(tmp_path):
+    p = tmp_path / "b.toml"
+    p.write_text('[[suppress]]\nrule = "PHT001"\n'
+                 'file = "never/was.py"\nfunc = "g"\n'
+                 'reason = "obsolete"\n')
+    _, _, unused = run_lint(
+        paths=[os.path.join(FIXTURES, "clean_hot.py")],
+        baseline_path=str(p))
+    assert len(unused) == 1 and unused[0]["file"] == "never/was.py"
+
+
+# ------------------------------------------------------------ CLI
+def test_cli_exit_codes(tmp_path, capsys):
+    # findings -> 1
+    assert lint_main([os.path.join(FIXTURES, "pht001_hot_sync.py"),
+                      "--no-baseline"]) == 1
+    # clean -> 0
+    assert lint_main([os.path.join(FIXTURES, "clean_hot.py")]) == 0
+    # malformed baseline -> 2 (perf_gate convention: broken != regression)
+    bad = tmp_path / "bad.toml"
+    bad.write_text('[[suppress]]\nrule = "PHT001"\n')
+    assert lint_main([os.path.join(FIXTURES, "clean_hot.py"),
+                      "--baseline", str(bad)]) == 2
+    # --changed and explicit paths are exclusive -> 2
+    assert lint_main(["--changed", "somefile.py"]) == 2
+    # an explicit path that is missing or unparseable must NOT report a
+    # 'clean' lint that never ran -> 2
+    assert lint_main([os.path.join(FIXTURES, "does_not_exist.py")]) == 2
+    broken = tmp_path / "broken.py"
+    broken.write_text("def f(:\n")
+    assert lint_main([str(broken)]) == 2
+    capsys.readouterr()
+
+
+def test_cli_json_format(capsys):
+    import json
+    rc = lint_main([os.path.join(FIXTURES, "pht003_locks.py"),
+                    "--no-baseline", "--format", "json"])
+    assert rc == 1
+    out = json.loads(capsys.readouterr().out)
+    assert {f["rule"] for f in out["findings"]} == {"PHT003"}
+    assert all(f["line"] and f["hint"] for f in out["findings"])
+
+
+def test_changed_paths_stay_in_scope():
+    """--changed (the pre-PR check) only ever lints scope files that
+    exist — whatever the current worktree diff happens to be."""
+    for p in changed_paths():
+        rel = os.path.relpath(p, ROOT)
+        assert rel.endswith(".py") and os.path.exists(p)
+        assert rel.startswith(("paddle_hackathon_tpu/", "tools/")) \
+            or rel == "bench.py"
+
+
+def test_full_lock_graph_catches_straddling_cycle(tmp_path):
+    """A lock-order cycle whose two halves live in a changed and an
+    UNCHANGED module is invisible to a diff-only graph — the --changed
+    mode must build PHT003 over the whole scope."""
+    d = tmp_path / "tools"
+    d.mkdir()
+    (d / "mod_a.py").write_text(
+        "import threading\n"
+        "from tools import mod_b\n"
+        "_lock_a = threading.Lock()\n\n\n"
+        "def take_a():\n"
+        "    with _lock_a:\n"
+        "        pass\n\n\n"
+        "def take_a_then_b():\n"
+        "    with _lock_a:\n"
+        "        mod_b.take_b()\n")
+    changed = d / "mod_b.py"
+    changed.write_text(
+        "import threading\n"
+        "from tools import mod_a\n"
+        "_lock_b = threading.Lock()\n\n\n"
+        "def take_b():\n"
+        "    with _lock_b:\n"
+        "        pass\n\n\n"
+        "def take_b_then_a():\n"
+        "    with _lock_b:\n"
+        "        mod_a.take_a()\n")
+    partial, _, _ = run_lint(paths=[str(changed)], baseline_path=None,
+                             repo_root=str(tmp_path))
+    assert not any("cycle" in f.message for f in partial)
+    full, _, _ = run_lint(paths=[str(changed)], baseline_path=None,
+                          repo_root=str(tmp_path), full_lock_graph=True)
+    assert any(f.rule == "PHT003" and "cycle" in f.message
+               for f in full), [f.render() for f in full]
+
+
+def test_changed_paths_include_branch_commits(tmp_path):
+    """On a feature branch, committing the diff must not turn the
+    pre-PR check vacuously green: files in commits since the merge-base
+    with main stay in scope."""
+    import subprocess
+
+    def git(*a):
+        subprocess.run(["git", *a], cwd=tmp_path, check=True,
+                       capture_output=True)
+    git("init", "-b", "main")
+    git("config", "user.email", "t@t")
+    git("config", "user.name", "t")
+    (tmp_path / "tools").mkdir()
+    (tmp_path / "tools" / "seed.py").write_text("x = 1\n")
+    git("add", "."); git("commit", "-m", "seed")
+    git("checkout", "-b", "feat")
+    (tmp_path / "tools" / "newmod.py").write_text("y = 2\n")
+    git("add", "."); git("commit", "-m", "feat work")
+    got = {os.path.relpath(p, tmp_path)
+           for p in changed_paths(repo_root=str(tmp_path))}
+    assert got == {"tools/newmod.py"}
+
+
+def test_changed_paths_include_untracked_files(tmp_path):
+    """A brand-new (never git-added) module is exactly the file the
+    pre-PR check must not skip.  Scratch repo, not the live one — a
+    tier-1 timeout kill mid-test must not leave a stray probe file."""
+    import subprocess
+
+    def git(*a):
+        subprocess.run(["git", *a], cwd=tmp_path, check=True,
+                       capture_output=True)
+    git("init", "-b", "main")
+    git("config", "user.email", "t@t")
+    git("config", "user.name", "t")
+    (tmp_path / "tools").mkdir()
+    (tmp_path / "tools" / "seed.py").write_text("x = 1\n")
+    git("add", "."); git("commit", "-m", "seed")
+    (tmp_path / "tools" / "untracked.py").write_text("y = 2\n")
+    got = {os.path.relpath(p, tmp_path)
+           for p in changed_paths(repo_root=str(tmp_path))}
+    assert got == {"tools/untracked.py"}
+
+
+def test_deep_call_chain_does_not_blind_lock_analysis(tmp_path):
+    """Regression: acquires() used to memoize DEPTH-TRUNCATED results,
+    so an unrelated deep chain reaching a function first permanently
+    hid its lock from later shallow queries — a real cycle went
+    unreported depending on definition order."""
+    chain = "\n\n".join(
+        f"def g{i}():\n    g{i + 1}()" for i in range(8))
+    src = f"""import threading
+
+_lock_b = threading.Lock()
+_lock_c = threading.Lock()
+
+
+def deep_entry():
+    g0()
+
+
+{chain}
+
+
+def g8():
+    with _lock_b:
+        pass
+
+
+def shallow_entry():
+    with _lock_c:
+        g8()
+
+
+def reverse():
+    with _lock_b:
+        with _lock_c:
+            pass
+"""
+    p = tmp_path / "deepchain.py"
+    p.write_text(src)
+    findings, _, _ = run_lint(paths=[str(p)], baseline_path=None,
+                              repo_root=str(tmp_path))
+    assert any(f.rule == "PHT003" and "cycle" in f.message
+               for f in findings), [f.render() for f in findings]
+
+
+def test_relative_imports_resolve_from_package_init():
+    """module_dotted() strips '__init__', so a package __init__'s
+    level-1 import is relative to base_dotted ITSELF — resolving one
+    level higher silently blinded PHT003 to package-__init__ modules."""
+    from tools.pht_lint.callgraph import index_module
+    mi = index_module(os.path.join(
+        ROOT, "paddle_hackathon_tpu", "observability", "__init__.py"), ROOT)
+    assert mi.imports["make_lock"] == \
+        "paddle_hackathon_tpu.observability.sanitizers.make_lock"
+    # and from a plain module, the existing behavior is unchanged
+    mi2 = index_module(os.path.join(
+        ROOT, "paddle_hackathon_tpu", "observability", "metrics.py"), ROOT)
+    assert mi2.imports["make_lock"] == \
+        "paddle_hackathon_tpu.observability.sanitizers.make_lock"
+
+
+def test_cli_partial_scope_does_not_flag_unused_baseline(capsys):
+    """Linting one file must not advise deleting live suppressions that
+    simply live elsewhere (they are only provably stale repo-wide)."""
+    rc = lint_main([os.path.join(FIXTURES, "clean_hot.py")])
+    assert rc == 0
+    assert "unused baseline entry" not in capsys.readouterr().err

@@ -735,3 +735,42 @@ class TestPipelineDecodeApply:
                                        rtol=1e-5, atol=1e-6)
         finally:
             parallel.set_mesh(None)
+
+
+def test_eager_shard_map_program_cache_hits_and_is_lru():
+    """The eager run_shard_map program cache (PR 7 retrace fix): a
+    repeat call is a cache HIT (same jitted callable), and a hit
+    refreshes recency so FIFO insertion order cannot evict the hottest
+    program first."""
+    from jax.sharding import Mesh, PartitionSpec as P
+    from paddle_hackathon_tpu.parallel import _smap
+
+    mesh = Mesh(np.array(jax.devices("cpu")[:1]), ("x",))
+    x = jnp.arange(4, dtype=jnp.float32)
+
+    def f1(v):
+        return v + 1
+
+    def f2(v):
+        return v * 2
+
+    _smap._prog_cache.clear()
+    args = dict(mesh=mesh, in_specs=P(), out_specs=P(),
+                manual_axes={"x"})
+    np.testing.assert_allclose(
+        np.asarray(_smap.run_shard_map(f1, args=(x,), **args)),
+        np.arange(4) + 1)
+    np.testing.assert_allclose(
+        np.asarray(_smap.run_shard_map(f2, args=(x,), **args)),
+        np.arange(4) * 2)
+    assert len(_smap._prog_cache) == 2
+    k1, k2 = list(_smap._prog_cache)
+    prog1 = _smap._prog_cache[k1]
+    # re-call f1: a HIT (no new entry, same program) that moves k1 to
+    # the most-recently-used end — so k2, not k1, is next in line for
+    # FIFO-from-the-front eviction
+    _smap.run_shard_map(f1, args=(x,), **args)
+    assert len(_smap._prog_cache) == 2
+    assert _smap._prog_cache[k1] is prog1
+    assert list(_smap._prog_cache) == [k2, k1]
+    _smap._prog_cache.clear()

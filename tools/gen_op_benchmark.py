@@ -67,6 +67,21 @@ def device_time(fn, *args, reps=20):
     return (time.perf_counter() - t0) / reps * 1e3
 
 
+# Module level with static shape/dtype args: a stable jit identity, so
+# repeated shapes hit the cache instead of retracing a fresh lambda per
+# operand (pht-lint PHT002).
+def _rnd_impl(k, shape, dtype):
+    return jax.random.normal(k, shape, jnp.float32).astype(dtype)
+
+
+def _rint_impl(k, shape, hi):
+    return jax.random.randint(k, shape, 0, hi, jnp.int32)
+
+
+_rnd_impl = jax.jit(_rnd_impl, static_argnums=(1, 2))
+_rint_impl = jax.jit(_rint_impl, static_argnums=(1, 2))
+
+
 def build_ops():
     # ALL inputs are generated ON DEVICE (jax.random): materializing these
     # ~3 GB of operands host-side and pushing them through the axon tunnel
@@ -74,14 +89,10 @@ def build_ops():
     _key_iter = iter(jax.random.split(jax.random.key(0), 40))
 
     def _rnd(shape, dtype=jnp.float32):
-        return jax.jit(
-            lambda k: jax.random.normal(k, shape, jnp.float32).astype(dtype)
-        )(next(_key_iter))
+        return _rnd_impl(next(_key_iter), tuple(shape), dtype)
 
     def _rint(shape, hi):
-        return jax.jit(
-            lambda k: jax.random.randint(k, shape, 0, hi, jnp.int32)
-        )(next(_key_iter))
+        return _rint_impl(next(_key_iter), tuple(shape), int(hi))
     # elementwise workhorse shape: big enough that per-call dispatch noise
     # vanishes under the op (~6 ms/pass f32)
     x4 = _rnd((16, 128, 257, 257), jnp.float32)

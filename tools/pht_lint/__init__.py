@@ -1,0 +1,243 @@
+"""pht-lint: project-specific static analysis for JAX hot paths.
+
+Usage (scripted; perf_gate-style exit codes):
+
+    python -m tools.pht_lint                 # default scope, baseline on
+    python -m tools.pht_lint --changed       # only files in the git diff
+    python -m tools.pht_lint path/to/file.py --format json
+
+Exit codes: 0 = clean, 1 = unsuppressed findings, 2 = usage/config
+error (bad baseline entry, unreadable path).
+
+Rule catalog, the baseline workflow, and how to declare a new hot root:
+docs/STATIC_ANALYSIS.md.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+from typing import Dict, List, Optional, Tuple
+
+from .callgraph import HOT_ROOT_MARK, ModuleInfo, index_module
+from .rules import Finding, lint_locks, lint_module
+
+__all__ = ["Finding", "run_lint", "load_baseline", "default_paths",
+           "changed_paths", "BaselineError", "REPO_ROOT",
+           "DEFAULT_BASELINE", "HOT_ROOT_MARK"]
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+DEFAULT_BASELINE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "baseline.toml")
+
+# Default lint scope: the package, the tools, and the bench driver.
+# Tests are excluded — they float()/block on purpose, and none of them
+# is a hot path.
+_DEFAULT_SCOPE = ("paddle_hackathon_tpu", "tools", "bench.py")
+_SKIP_DIRS = {"__pycache__", ".git", "fixtures"}
+
+
+class BaselineError(Exception):
+    """Malformed baseline (missing reason, unknown key, bad syntax)."""
+
+
+# ---------------------------------------------------------------------------
+# baseline: a restricted TOML subset (this container is py3.10 — no
+# tomllib), parsed strictly: only ``[[suppress]]`` tables with
+# ``key = "string"`` pairs
+# ---------------------------------------------------------------------------
+
+def load_baseline(path: Optional[str]) -> List[Dict[str, str]]:
+    if path is None or not os.path.exists(path):
+        return []
+    entries: List[Dict[str, str]] = []
+    cur: Optional[Dict[str, str]] = None
+    with open(path, encoding="utf-8") as f:
+        for i, raw in enumerate(f, 1):
+            line = raw.strip()
+            if not line or line.startswith("#"):
+                continue
+            if line == "[[suppress]]":
+                cur = {}
+                entries.append(cur)
+                continue
+            if "=" in line and cur is not None:
+                key, _, val = line.partition("=")
+                key = key.strip()
+                val = val.strip()
+                if len(val) >= 2 and val[0] == val[-1] == '"':
+                    val = val[1:-1]
+                else:
+                    raise BaselineError(
+                        f"{path}:{i}: values must be double-quoted "
+                        f"strings (got {val!r})")
+                if key not in ("rule", "file", "func", "reason"):
+                    raise BaselineError(
+                        f"{path}:{i}: unknown key {key!r} (allowed: "
+                        "rule, file, func, reason)")
+                cur[key] = val
+                continue
+            raise BaselineError(f"{path}:{i}: cannot parse {line!r}")
+    for n, e in enumerate(entries, 1):
+        for req in ("rule", "file", "func"):
+            if not e.get(req):
+                raise BaselineError(
+                    f"{path}: suppress entry #{n} is missing {req!r}")
+        if not e.get("reason", "").strip():
+            raise BaselineError(
+                f"{path}: suppress entry #{n} ({e['rule']} {e['file']} "
+                f"{e['func']}) has no reason — every suppression must "
+                "say WHY the finding is justified")
+    return entries
+
+
+def _matches(entry: Dict[str, str], f: Finding) -> bool:
+    return (entry["rule"] == f.rule and entry["file"] == f.file
+            and entry["func"] == f.func)
+
+
+# ---------------------------------------------------------------------------
+# file discovery
+# ---------------------------------------------------------------------------
+
+def default_paths(repo_root: str = REPO_ROOT) -> List[str]:
+    out = []
+    for rel in _DEFAULT_SCOPE:
+        p = os.path.join(repo_root, rel)
+        if os.path.isfile(p):
+            out.append(p)
+        elif os.path.isdir(p):
+            for dirpath, dirnames, filenames in os.walk(p):
+                # sorted: walk order anchors PHT003 cycle reports (first-
+                # recorded edge wins) — inode order would make the
+                # anchoring (file, func), and thus baseline matching,
+                # machine-dependent
+                dirnames[:] = sorted(d for d in dirnames
+                                     if d not in _SKIP_DIRS)
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        out.append(os.path.join(dirpath, fn))
+    return out
+
+
+def _git(repo_root: str, *args: str) -> Optional[str]:
+    try:
+        out = subprocess.run(["git", *args], cwd=repo_root,
+                             capture_output=True, text=True, timeout=30)
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    return out.stdout if out.returncode == 0 else None
+
+
+def changed_paths(repo_root: str = REPO_ROOT) -> List[str]:
+    """Python files touched in the working tree + index + untracked,
+    PLUS — on a feature branch — everything committed since the
+    merge-base with main/master (the pre-PR check must not go vacuously
+    green the moment the developer commits their diff)."""
+    files = set()
+    for args in (["diff", "--name-only", "HEAD"],
+                 ["diff", "--name-only", "--cached"],
+                 ["ls-files", "--others", "--exclude-standard"]):
+        out = _git(repo_root, *args)
+        if out is not None:
+            files.update(ln.strip() for ln in out.splitlines()
+                         if ln.strip())
+    branch = (_git(repo_root, "rev-parse", "--abbrev-ref", "HEAD")
+              or "").strip()
+    if branch and branch not in ("main", "master"):
+        # remote-tracking fallbacks: a fresh CI checkout often has no
+        # LOCAL main/master, and a silent no-op here re-opens the
+        # committed-diff hole this augmentation exists to close
+        for base in ("main", "master", "origin/main", "origin/master"):
+            mb = _git(repo_root, "merge-base", "HEAD", base)
+            if mb is None:
+                continue
+            out = _git(repo_root, "diff", "--name-only",
+                       mb.strip(), "HEAD")
+            if out is not None:
+                files.update(ln.strip() for ln in out.splitlines()
+                             if ln.strip())
+            break
+    scope_dirs = tuple(s for s in _DEFAULT_SCOPE if not s.endswith(".py"))
+    keep = []
+    for rel in sorted(files):
+        if not rel.endswith(".py"):
+            continue
+        if rel in _DEFAULT_SCOPE or rel.startswith(
+                tuple(d + "/" for d in scope_dirs)):
+            p = os.path.join(repo_root, rel)
+            if os.path.exists(p):
+                keep.append(p)
+    return keep
+
+
+# ---------------------------------------------------------------------------
+# driver
+# ---------------------------------------------------------------------------
+
+def run_lint(paths: Optional[List[str]] = None,
+             baseline_path: Optional[str] = DEFAULT_BASELINE,
+             repo_root: str = REPO_ROOT,
+             strict: bool = False,
+             full_lock_graph: bool = False,
+             ) -> Tuple[List[Finding], List[Finding], List[Dict[str, str]]]:
+    """Lint ``paths`` (default scope when None).
+
+    ``full_lock_graph=True`` (the ``--changed`` mode) runs PHT003 over
+    the WHOLE default scope even when ``paths`` is partial: a lock-order
+    cycle's two halves may straddle a changed and an unchanged module,
+    and a graph built from the diff alone cannot see it.
+
+    Returns ``(findings, suppressed, unused_baseline_entries)`` —
+    findings sorted by (file, line, rule).  Raises BaselineError on a
+    malformed baseline and, with ``strict=True`` (the CLI's explicit-
+    paths mode), OSError for a path that is missing or unparseable —
+    callers map both to exit code 2.  A silent skip would report a
+    'clean' lint that never ran on the file the caller named."""
+    if paths is None:
+        paths = default_paths(repo_root)
+    baseline = load_baseline(baseline_path)
+
+    modules: List[ModuleInfo] = []
+    for p in paths:
+        mi = index_module(os.path.abspath(p), repo_root)
+        if mi is not None:
+            modules.append(mi)
+        elif strict:
+            raise OSError(f"cannot lint {p}: missing, unreadable, or "
+                          "not parseable as Python")
+
+    findings: List[Finding] = []
+    for mi in modules:
+        findings.extend(lint_module(mi))
+    lock_modules = modules
+    if full_lock_graph:
+        by_path = {m.path for m in modules}
+        lock_modules = list(modules)
+        for p in default_paths(repo_root):
+            ap = os.path.abspath(p)
+            if ap not in by_path:
+                mi = index_module(ap, repo_root)
+                if mi is not None:
+                    lock_modules.append(mi)
+    # full mode reports ALL lock findings, even ones anchored in
+    # unchanged modules: the cycle report lands at the first-recorded
+    # edge, which may be the unchanged half — filtering to the diff
+    # would drop exactly the finding the mode exists to surface
+    findings.extend(lint_locks(lock_modules))
+    findings.sort(key=lambda f: (f.file, f.line, f.rule))
+
+    kept, suppressed = [], []
+    used = [False] * len(baseline)
+    for f in findings:
+        hit = False
+        for i, e in enumerate(baseline):
+            if _matches(e, f):
+                used[i] = True
+                hit = True
+                break
+        (suppressed if hit else kept).append(f)
+    unused = [e for i, e in enumerate(baseline) if not used[i]]
+    return kept, suppressed, unused

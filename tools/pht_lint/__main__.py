@@ -1,0 +1,85 @@
+"""CLI for pht-lint — see the package docstring and
+docs/STATIC_ANALYSIS.md.  Exit codes: 0 clean, 1 findings, 2 usage/
+config error (the perf_gate convention, so CI scripts can tell "lint
+regression" from "lint broken")."""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from . import (DEFAULT_BASELINE, BaselineError, changed_paths, run_lint)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.pht_lint",
+        description="JAX hot-path static analysis (PHT001-PHT004)")
+    ap.add_argument("paths", nargs="*",
+                    help="files to lint (default: package + tools + "
+                         "bench.py)")
+    ap.add_argument("--changed", action="store_true",
+                    help="lint the .py files your change touches "
+                         "(worktree + index + untracked + commits since "
+                         "the merge-base with main); PHT003's lock graph "
+                         "still spans the whole scope — the pre-PR check")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="suppression file (default: "
+                         "tools/pht_lint/baseline.toml)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline (show everything)")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    args = ap.parse_args(argv)
+
+    paths = args.paths or None
+    if args.changed:
+        if args.paths:
+            print("pht-lint: --changed and explicit paths are exclusive",
+                  file=sys.stderr)
+            return 2
+        paths = changed_paths()
+        if not paths:
+            print("pht-lint: no changed files in scope; nothing to lint")
+            return 0
+
+    try:
+        findings, suppressed, unused = run_lint(
+            paths=paths,
+            baseline_path=None if args.no_baseline else args.baseline,
+            strict=bool(args.paths),
+            # a cycle's two halves may straddle the diff and an
+            # unchanged module: the pre-PR check runs PHT003 repo-wide
+            full_lock_graph=args.changed)
+    except BaselineError as e:
+        print(f"pht-lint: baseline error: {e}", file=sys.stderr)
+        return 2
+    except OSError as e:
+        print(f"pht-lint: {e}", file=sys.stderr)
+        return 2
+
+    # an entry can only be proven stale by the FULL default scope — a
+    # partial run (explicit paths, --changed) simply didn't look where
+    # the entry points, and "fixed? delete it" advice would be wrong
+    full_scope = paths is None
+    if args.format == "json":
+        print(json.dumps({
+            "findings": [vars(f) for f in findings],
+            "suppressed": [vars(f) for f in suppressed],
+            "unused_baseline": unused if full_scope else [],
+        }, indent=2))
+    else:
+        for f in findings:
+            print(f.render())
+        if full_scope:
+            for e in unused:
+                print(f"pht-lint: warning: unused baseline entry "
+                      f"{e['rule']} {e['file']} {e['func']} "
+                      f"(fixed? delete it)", file=sys.stderr)
+        print(f"pht-lint: {len(findings)} finding(s), "
+              f"{len(suppressed)} suppressed by baseline")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

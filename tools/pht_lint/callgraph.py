@@ -1,0 +1,353 @@
+"""Per-module AST indexing for pht-lint: functions, imports, calls,
+hot roots, locks — and the conservative same-module call-graph walk.
+
+Design constraints (docs/STATIC_ANALYSIS.md):
+
+- Pure stdlib ``ast``; no imports of the analyzed code (linting must not
+  execute jax, and must work on files that would not even import here).
+- Conservative resolution: a call we cannot resolve is simply not an
+  edge.  Hot-path reachability (PHT001/PHT002) walks SAME-MODULE edges
+  only — cross-module reachability would need whole-program type
+  inference to stay sound.  The lock graph (PHT003) additionally
+  resolves ``alias.func(...)`` calls into other project modules (module
+  aliases are statically known from the import table) and falls back to
+  a project-wide METHOD-NAME index for ``obj.meth(...)`` receivers whose
+  class is unknowable (``self._spec.ingest`` — any project method of
+  that name is conservatively assumed reachable).
+- Hot roots are DECLARED, not inferred: a ``# pht-lint: hot-root``
+  comment on (or directly above) the ``def`` line marks a function as
+  the entry of a latency-critical loop body.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+HOT_ROOT_MARK = "pht-lint: hot-root"
+
+# stdlib / third-party roots whose attribute calls we classify rather
+# than resolve (everything else non-project is ignored)
+_JAX_ROOTS = ("jax",)
+
+
+@dataclass
+class CallRef:
+    """One call site, pre-chewed for resolution.
+
+    kind: 'self'   — self.NAME(...)          (name = method name)
+          'bare'   — NAME(...)               (name = local/module func)
+          'dotted' — alias.attr...(...)      (name = fully-resolved
+                      dotted path, import aliases already substituted,
+                      e.g. 'numpy.asarray', 'jax.device_get',
+                      'paddle_hackathon_tpu.observability.tracing.add_span')
+          'method' — <expr>.NAME(...)        (receiver class unknown)
+    """
+    kind: str
+    name: str
+    node: ast.Call
+
+
+@dataclass
+class FuncInfo:
+    qualname: str                 # "Class.method" / "outer.inner" / "f"
+    node: ast.AST                 # FunctionDef | AsyncFunctionDef | Lambda
+    class_name: Optional[str]
+    lineno: int
+    hot_root: bool = False
+    calls: List[CallRef] = field(default_factory=list)
+    # names of functions defined lexically inside this one
+    local_defs: Set[str] = field(default_factory=set)
+
+
+@dataclass
+class LockDef:
+    lock_id: str                  # "mod.Class.attr" or "mod.attr"
+    lineno: int
+
+
+@dataclass
+class ModuleInfo:
+    path: str                     # absolute
+    relpath: str                  # repo-relative, posix
+    dotted: str                   # "paddle_hackathon_tpu.inference.serving"
+    tree: ast.Module
+    lines: List[str]
+    imports: Dict[str, str] = field(default_factory=dict)   # alias -> dotted
+    funcs: Dict[str, FuncInfo] = field(default_factory=dict)
+    classes: Dict[str, Set[str]] = field(default_factory=dict)  # cls -> methods
+    locks: Dict[str, LockDef] = field(default_factory=dict)  # local key -> def
+    # local key is "Class.attr" (self.attr = Lock()) or "name" (module level)
+
+    def source_line(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1]
+        return ""
+
+    def resolve_dotted(self, expr: ast.expr) -> Optional[str]:
+        """Dotted path of an expression with import aliases substituted
+        (the ONE alias-resolution implementation — rules.py and the
+        visitor both delegate here)."""
+        d = dotted_of(expr)
+        if d is None:
+            return None
+        head, _, rest = d.partition(".")
+        mapped = self.imports.get(head)
+        if mapped is None:
+            return d
+        return f"{mapped}.{rest}" if rest else mapped
+
+    def import_resolves(self, root: str) -> bool:
+        """True when some import in this module actually supplies
+        ``root`` (directly or via alias) — distinguishes a resolved
+        ``time.time`` from a local variable that happens to be named
+        ``time``."""
+        return any(v == root or v.startswith(root + ".")
+                   for v in self.imports.values())
+
+
+def dotted_of(node: ast.expr) -> Optional[str]:
+    """'a.b.c' for an Attribute/Name chain, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def module_dotted(relpath: str) -> str:
+    p = relpath[:-3] if relpath.endswith(".py") else relpath
+    p = p.replace(os.sep, "/")
+    parts = [x for x in p.split("/") if x]
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts)
+
+
+def _resolve_relative(base_dotted: str, level: int, module: str,
+                      is_pkg: bool) -> str:
+    """Resolve ``from ..x import y`` against the importing module."""
+    parts = base_dotted.split(".")
+    # For a plain module, base_dotted names the MODULE: a level-1 import
+    # is relative to its package, so strip the module segment plus
+    # (level - 1) packages.  For a package __init__, module_dotted()
+    # already stripped the '__init__' segment — base_dotted IS the
+    # package a level-1 import is relative to, so strip one less.
+    keep = len(parts) - level + (1 if is_pkg else 0)
+    if keep < 0:
+        keep = 0
+    prefix = parts[:keep]
+    if module:
+        prefix += module.split(".")
+    return ".".join(prefix)
+
+
+class _ModuleVisitor(ast.NodeVisitor):
+    """Single pass building ModuleInfo: imports, funcs, calls, locks."""
+
+    _LOCK_CTORS = ("threading.Lock", "threading.RLock",
+                   "threading.Condition")
+    _MAKE_LOCK = ("make_lock", "make_rlock")
+
+    def __init__(self, mi: ModuleInfo):
+        self.mi = mi
+        self.class_stack: List[str] = []
+        self.func_stack: List[FuncInfo] = []
+
+    # -- imports ------------------------------------------------------------
+    def visit_Import(self, node: ast.Import):
+        for a in node.names:
+            self.mi.imports[a.asname or a.name.split(".")[0]] = (
+                a.name if a.asname else a.name.split(".")[0])
+        self.generic_visit(node)
+
+    def visit_ImportFrom(self, node: ast.ImportFrom):
+        base = node.module or ""
+        if node.level:
+            base = _resolve_relative(
+                self.mi.dotted, node.level, base,
+                self.mi.relpath.endswith("__init__.py"))
+        for a in node.names:
+            if a.name == "*":
+                continue
+            self.mi.imports[a.asname or a.name] = (
+                f"{base}.{a.name}" if base else a.name)
+        self.generic_visit(node)
+
+    # -- defs ---------------------------------------------------------------
+    def _is_hot_root(self, node) -> bool:
+        # marker on the def line, a trailing comment, or the line above
+        # (which may be a decorator or a standalone comment)
+        for ln in (node.lineno, node.lineno - 1):
+            if HOT_ROOT_MARK in self.mi.source_line(ln):
+                return True
+        for dec in getattr(node, "decorator_list", []):
+            if HOT_ROOT_MARK in self.mi.source_line(dec.lineno):
+                return True
+        return False
+
+    def _enter_func(self, node):
+        parts = []
+        if self.class_stack:
+            parts.append(".".join(self.class_stack))
+        parts += [f.node.name for f in self.func_stack
+                  if isinstance(f.node, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef))]
+        parts.append(node.name)
+        qual = ".".join(parts)
+        fi = FuncInfo(qualname=qual, node=node,
+                      class_name=(self.class_stack[-1]
+                                  if self.class_stack else None),
+                      lineno=node.lineno, hot_root=self._is_hot_root(node))
+        self.mi.funcs[qual] = fi
+        if self.class_stack and len(parts) == 2:
+            self.mi.classes.setdefault(self.class_stack[-1],
+                                       set()).add(node.name)
+        if self.func_stack:
+            self.func_stack[-1].local_defs.add(node.name)
+        return fi
+
+    def visit_FunctionDef(self, node: ast.FunctionDef):
+        fi = self._enter_func(node)
+        self.func_stack.append(fi)
+        self.generic_visit(node)
+        self.func_stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_ClassDef(self, node: ast.ClassDef):
+        self.class_stack.append(node.name)
+        self.mi.classes.setdefault(node.name, set())
+        self.generic_visit(node)
+        self.class_stack.pop()
+
+    # -- calls --------------------------------------------------------------
+    def resolve_dotted(self, expr: ast.expr) -> Optional[str]:
+        return self.mi.resolve_dotted(expr)
+
+    def visit_Call(self, node: ast.Call):
+        if self.func_stack:
+            ref = self._classify_call(node)
+            if ref is not None:
+                self.func_stack[-1].calls.append(ref)
+        self.generic_visit(node)
+
+    def _classify_call(self, node: ast.Call) -> Optional[CallRef]:
+        f = node.func
+        if isinstance(f, ast.Name):
+            mapped = self.mi.imports.get(f.id)
+            if mapped is not None:
+                return CallRef("dotted", mapped, node)
+            return CallRef("bare", f.id, node)
+        if isinstance(f, ast.Attribute):
+            if isinstance(f.value, ast.Name) and f.value.id == "self":
+                return CallRef("self", f.attr, node)
+            d = self.resolve_dotted(f)
+            if d is not None:
+                head = d.split(".")[0]
+                # a resolved import alias (module or symbol) — or a
+                # plain local variable, which has no import mapping and
+                # therefore stays a 'method' ref
+                if head in self.mi.imports.values() or \
+                        any(v.split(".")[0] == head
+                            for v in self.mi.imports.values()):
+                    return CallRef("dotted", d, node)
+            return CallRef("method", f.attr, node)
+        return None
+
+    # -- locks --------------------------------------------------------------
+    def visit_Assign(self, node: ast.Assign):
+        self._maybe_lock(node.targets, node.value)
+        self.generic_visit(node)
+
+    def _maybe_lock(self, targets, value):
+        if not isinstance(value, ast.Call):
+            return
+        d = self.resolve_dotted(value.func) or ""
+        is_lock = (d in self._LOCK_CTORS
+                   or d.split(".")[-1] in self._MAKE_LOCK)
+        if not is_lock:
+            return
+        for t in targets:
+            key = None
+            if isinstance(t, ast.Name) and not self.func_stack:
+                key = t.id
+            elif (isinstance(t, ast.Attribute)
+                  and isinstance(t.value, ast.Name)
+                  and t.value.id == "self" and self.class_stack):
+                key = f"{self.class_stack[-1]}.{t.attr}"
+            if key is not None:
+                self.mi.locks[key] = LockDef(
+                    lock_id=f"{self.mi.dotted}.{key}", lineno=t.lineno)
+
+
+def index_module(path: str, repo_root: str) -> Optional[ModuleInfo]:
+    try:
+        with open(path, encoding="utf-8") as f:
+            src = f.read()
+        tree = ast.parse(src, filename=path)
+    except (SyntaxError, UnicodeDecodeError, OSError):
+        return None
+    rel = os.path.relpath(path, repo_root).replace(os.sep, "/")
+    mi = ModuleInfo(path=path, relpath=rel, dotted=module_dotted(rel),
+                    tree=tree, lines=src.splitlines())
+    _ModuleVisitor(mi).visit(tree)
+    return mi
+
+
+# ---------------------------------------------------------------------------
+# same-module reachability (PHT001 / PHT002 hot sets)
+# ---------------------------------------------------------------------------
+
+def resolve_same_module(mi: ModuleInfo, caller: FuncInfo,
+                        ref: CallRef) -> Set[str]:
+    """Qualnames in ``mi`` a call may reach (conservative, same module)."""
+    out: Set[str] = set()
+    if ref.kind == "self":
+        cls = caller.class_name
+        if cls and f"{cls}.{ref.name}" in mi.funcs:
+            out.add(f"{cls}.{ref.name}")
+        elif not cls or f"{cls}.{ref.name}" not in mi.funcs:
+            for c, methods in mi.classes.items():
+                if ref.name in methods:
+                    out.add(f"{c}.{ref.name}")
+    elif ref.kind == "bare":
+        # nearest enclosing scope first: a nested def shadows module level
+        prefix = caller.qualname
+        while prefix:
+            cand = f"{prefix}.{ref.name}"
+            if cand in mi.funcs:
+                out.add(cand)
+                return out
+            prefix = prefix.rpartition(".")[0]
+        if ref.name in mi.funcs:
+            out.add(ref.name)
+    return out
+
+
+def hot_set(mi: ModuleInfo) -> Set[str]:
+    """Functions reachable from this module's declared hot roots."""
+    roots = [q for q, f in mi.funcs.items() if f.hot_root]
+    seen: Set[str] = set()
+    work = list(roots)
+    while work:
+        q = work.pop()
+        if q in seen:
+            continue
+        seen.add(q)
+        fi = mi.funcs[q]
+        for ref in fi.calls:
+            for tgt in resolve_same_module(mi, fi, ref):
+                if tgt not in seen:
+                    work.append(tgt)
+        # nested defs execute in the parent's dynamic extent (closures
+        # staged under the root): treat them as reachable
+        for q2 in mi.funcs:
+            if q2.startswith(q + ".") and q2 not in seen:
+                work.append(q2)
+    return seen

@@ -1,0 +1,871 @@
+"""pht-lint rules PHT001–PHT004 (catalog: docs/STATIC_ANALYSIS.md).
+
+PHT001  host-sync-in-hot-path   — .item() / block_until_ready /
+        jax.device_get / np.asarray-on-device-value / float()/int()/
+        bool()-on-device-value / implicit bool, inside functions
+        reachable from a declared ``# pht-lint: hot-root``.
+PHT002  retrace-hazard          — jit constructed in a loop body or a
+        hot function; ``jax.jit(f)(...)`` where ``f`` has per-call
+        identity (local def / lambda / local name); a list/dict/set
+        literal passed at a ``static_argnums`` position; Python
+        branching on a traced parameter inside a jitted body.
+PHT003  lock-discipline         — cycles in the cross-module static
+        lock-acquisition order graph; locks held across device dispatch
+        or host syncs.
+PHT004  nondeterminism-in-jit   — time.* / random.* / np.random.*
+        inside jitted bodies (traced once, frozen forever).
+
+"Device value" tracking is a per-function forward taint pass: names
+assigned from jax/jnp calls are device; jax.device_get launders back to
+host.  No interprocedural taint — a miss is conservative, never a false
+positive.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from .callgraph import (CallRef, FuncInfo, ModuleInfo, dotted_of, hot_set,
+                        resolve_same_module)
+
+
+@dataclass
+class Finding:
+    rule: str
+    file: str
+    line: int
+    func: str
+    message: str
+    hint: str
+
+    def key(self):
+        return (self.rule, self.file, self.line)
+
+    def render(self) -> str:
+        return (f"{self.file}:{self.line}: {self.rule} [{self.func}] "
+                f"{self.message}\n    hint: {self.hint}")
+
+
+# --------------------------------------------------------------------------
+# shared classifiers
+# --------------------------------------------------------------------------
+
+_DEVICE_PREFIXES = ("jax.numpy.", "jax.lax.", "jax.random.", "jax.nn.",
+                    "jax.scipy.")
+_DEVICE_EXACT = {"jax.device_put"}
+_SYNC_EXACT = {"jax.device_get", "jax.block_until_ready"}
+_NP_HOSTIFY = {"numpy.asarray", "numpy.array", "numpy.copy"}
+_JIT_NAMES = {"jax.jit", "jax.pjit", "jax.experimental.pjit.pjit",
+              "pjit.pjit"}
+_NONDET_ROOTS = ("time", "random")
+_NONDET_PREFIXES = ("numpy.random.",)
+
+
+def _is_device_call(dotted: Optional[str]) -> bool:
+    if dotted is None:
+        return False
+    return (dotted.startswith(_DEVICE_PREFIXES) or dotted in _DEVICE_EXACT)
+
+
+def _call_dotted(mi: ModuleInfo, node: ast.Call) -> Optional[str]:
+    return mi.resolve_dotted(node.func)
+
+
+def _is_jit_ctor(mi: ModuleInfo, node: ast.Call) -> bool:
+    d = _call_dotted(mi, node)
+    return d in _JIT_NAMES
+
+
+def _static_positions(node: ast.Call) -> Optional[Set[int]]:
+    """Literal static_argnums of a jit call, or None if non-literal."""
+    for kw in node.keywords:
+        if kw.arg == "static_argnums":
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                return {v.value}
+            if isinstance(v, (ast.Tuple, ast.List)):
+                out = set()
+                for e in v.elts:
+                    if not (isinstance(e, ast.Constant)
+                            and isinstance(e.value, int)):
+                        return None
+                    out.add(e.value)
+                return out
+            return None
+    return set()
+
+
+# --------------------------------------------------------------------------
+# PHT001 + PHT002(jit-in-loop/hot, immediate-call) + taint walker
+# --------------------------------------------------------------------------
+
+class _FuncWalker(ast.NodeVisitor):
+    """Order-preserving walk of ONE function body: taint + rule checks.
+
+    Nested defs are skipped (they are separate FuncInfo entries, linted
+    on their own if reachable)."""
+
+    def __init__(self, mi: ModuleInfo, fi: FuncInfo, hot: bool,
+                 jit_bindings: Dict[str, Set[int]],
+                 findings: List[Finding]):
+        self.mi = mi
+        self.fi = fi
+        self.hot = hot
+        self.jit_bindings = jit_bindings
+        self.findings = findings
+        self.tainted: Set[str] = set()
+        # names PROVABLY holding host values (numpy-from-host results,
+        # laundered fetches): three-state lattice — tainted / host /
+        # unknown — so receiver-always rules (.item) can skip the
+        # provably-host case without losing the unknown-receiver catch
+        self.host_names: Set[str] = set()
+        self.loop_depth = 0
+        self.local_names: Set[str] = set(fi.local_defs)
+        for n in ast.walk(fi.node):
+            if isinstance(n, ast.Assign):
+                for t in n.targets:
+                    for nn in ast.walk(t):
+                        if isinstance(nn, ast.Name):
+                            self.local_names.add(nn.id)
+
+    # -- entry -------------------------------------------------------------
+    def run(self):
+        body = getattr(self.fi.node, "body", [])
+        for stmt in body:
+            self.visit(stmt)
+
+    def visit_FunctionDef(self, node):   # don't descend into nested defs
+        pass
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node):
+        pass
+
+    # -- taint -------------------------------------------------------------
+    def _expr_tainted(self, e: ast.expr) -> bool:
+        if isinstance(e, ast.Name):
+            return e.id in self.tainted
+        if isinstance(e, ast.Call):
+            d = _call_dotted(self.mi, e)
+            if d in _SYNC_EXACT or d in _NP_HOSTIFY:
+                return False      # result is back on host
+            if _is_device_call(d):
+                return True
+            return False
+        if isinstance(e, (ast.Subscript, ast.Attribute)):
+            return self._expr_tainted(e.value)
+        if isinstance(e, ast.BinOp):
+            return (self._expr_tainted(e.left)
+                    or self._expr_tainted(e.right))
+        if isinstance(e, ast.UnaryOp):
+            return self._expr_tainted(e.operand)
+        if isinstance(e, (ast.Tuple, ast.List)):
+            return any(self._expr_tainted(x) for x in e.elts)
+        return False
+
+    def _provably_host(self, e: ast.expr) -> bool:
+        """True when ``e`` is definitely a host value: a constant, a
+        name assigned from one, or a laundering/numpy-from-host call."""
+        if isinstance(e, ast.Constant):
+            return True
+        if isinstance(e, ast.Name):
+            return e.id in self.host_names
+        if isinstance(e, ast.Call):
+            d = _call_dotted(self.mi, e)
+            if d in _SYNC_EXACT:
+                return True
+            if d is not None and d.split(".")[0] == "numpy" \
+                    and not any(self._expr_tainted(a) for a in e.args):
+                return True
+        if isinstance(e, ast.Subscript):
+            return self._provably_host(e.value)
+        return False
+
+    def _bind_target(self, target: ast.expr, t: bool, host: bool) -> None:
+        """(Un)taint exactly the names this target BINDS.  Attribute and
+        Subscript targets bind nothing we track — and crucially their
+        RECEIVER's taint must not change: ``self.k = jnp.zeros(4)`` says
+        nothing about ``self`` itself (tainting it false-fired PHT001 on
+        host-data attribute reads; untainting it masked real ones)."""
+        if isinstance(target, ast.Name):
+            (self.tainted.add if t else self.tainted.discard)(target.id)
+            (self.host_names.add if host
+             else self.host_names.discard)(target.id)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for e in target.elts:
+                self._bind_target(e, t, host)
+        elif isinstance(target, ast.Starred):
+            self._bind_target(target.value, t, host)
+
+    def visit_Assign(self, node: ast.Assign):
+        self.visit(node.value)
+        t = self._expr_tainted(node.value)
+        host = not t and self._provably_host(node.value)
+        for tgt in node.targets:
+            self._bind_target(tgt, t, host)
+
+    def visit_AugAssign(self, node: ast.AugAssign):
+        self.visit(node.value)
+        if isinstance(node.target, ast.Name):
+            if self._expr_tainted(node.value):
+                self.tainted.add(node.target.id)
+
+    # -- control flow ------------------------------------------------------
+    def _check_implicit_bool(self, test: ast.expr):
+        if not self.hot:
+            return
+        if isinstance(test, ast.Name) and test.id in self.tainted:
+            self._emit("PHT001", test,
+                       f"implicit bool() on device value `{test.id}` "
+                       "blocks on the device",
+                       "fetch once with jax.device_get(...) outside the "
+                       "hot loop, or keep the predicate on device "
+                       "(jnp.where/lax.cond)")
+
+    def visit_If(self, node: ast.If):
+        self._check_implicit_bool(node.test)
+        self.visit(node.test)
+        for s in node.body:
+            self.visit(s)
+        for s in node.orelse:
+            self.visit(s)
+
+    def visit_While(self, node: ast.While):
+        self._check_implicit_bool(node.test)
+        self.visit(node.test)
+        self.loop_depth += 1
+        for s in node.body:
+            self.visit(s)
+        self.loop_depth -= 1
+        for s in node.orelse:
+            self.visit(s)
+
+    def visit_For(self, node: ast.For):
+        self.visit(node.iter)
+        self.loop_depth += 1
+        for s in node.body:
+            self.visit(s)
+        self.loop_depth -= 1
+        for s in node.orelse:
+            self.visit(s)
+
+    # -- calls -------------------------------------------------------------
+    def visit_Call(self, node: ast.Call):
+        d = _call_dotted(self.mi, node)
+        f = node.func
+
+        # PHT002: jit constructed here
+        if _is_jit_ctor(self.mi, node):
+            if self.loop_depth > 0:
+                self._emit("PHT002", node,
+                           "jax.jit/pjit constructed inside a loop body "
+                           "— a fresh program identity every iteration "
+                           "defeats the jit cache (retrace per pass)",
+                           "hoist the jit construction out of the loop "
+                           "(build once, call many)")
+            elif self.hot:
+                self._emit("PHT002", node,
+                           "jax.jit/pjit constructed inside a hot-path "
+                           "function — per-call program construction "
+                           "retraces on every invocation",
+                           "build the program once at init and cache it "
+                           "(see ServingEngine._prog)")
+            self._check_static_literals(node, node)
+
+        # PHT002: jax.jit(f)(...) with per-call identity of f
+        if isinstance(f, ast.Call) and _is_jit_ctor(self.mi, f):
+            inner = f.args[0] if f.args else None
+            unstable = (isinstance(inner, ast.Lambda)
+                        or (isinstance(inner, ast.Name)
+                            and inner.id in self.local_names))
+            if unstable:
+                self._emit("PHT002", node,
+                           "jax.jit(f)(...) where f is a local "
+                           "function/lambda: the jit cache keys on f's "
+                           "identity, which is fresh every call — this "
+                           "retraces and recompiles per invocation",
+                           "cache the jitted callable keyed by what the "
+                           "closure actually captures (see "
+                           "parallel/_smap.py run_shard_map)")
+            self._check_static_literals(f, node)
+
+        # PHT002: non-hashable literal at a static position of a bound
+        # jitted callable
+        if isinstance(f, ast.Name) and f.id in self.jit_bindings:
+            self._check_static_args(self.jit_bindings[f.id], node)
+
+        # PHT001 (hot functions only).  .item()/.block_until_ready fire
+        # on UNKNOWN receivers too (attributes, parameters — the taint
+        # pass can't see them, and a device array there is the common
+        # case) but not on provably-host ones (numpy .item() is not a
+        # sync).
+        if self.hot:
+            if isinstance(f, ast.Attribute) and f.attr == "item" \
+                    and not node.args and not node.keywords \
+                    and not self._provably_host(f.value):
+                self._emit("PHT001", node,
+                           ".item() forces a device→host sync",
+                           "batch the fetch: jax.device_get once per "
+                           "tick, not per element")
+            elif isinstance(f, ast.Attribute) \
+                    and f.attr == "block_until_ready":
+                self._emit("PHT001", node,
+                           ".block_until_ready() blocks the host on "
+                           "device completion",
+                           "only sync at designed boundaries (log_freq, "
+                           "epoch end); baseline with a reason if this "
+                           "IS one")
+            elif d in _SYNC_EXACT:
+                self._emit("PHT001", node,
+                           f"{d} is a host sync",
+                           "keep it to one designed fetch per tick; "
+                           "baseline with a reason if this is it")
+            elif d in _NP_HOSTIFY and node.args \
+                    and self._expr_tainted(node.args[0]):
+                self._emit("PHT001", node,
+                           f"{d} on a device value is an implicit "
+                           "device→host transfer",
+                           "use jax.device_get(...) to make the sync "
+                           "explicit (and transfer-guard-clean), or "
+                           "keep the value on device")
+            elif isinstance(f, ast.Name) \
+                    and f.id in ("float", "int", "bool") \
+                    and f.id not in self.mi.imports \
+                    and node.args and self._expr_tainted(node.args[0]):
+                self._emit("PHT001", node,
+                           f"{f.id}() on a device value forces a "
+                           "device→host sync",
+                           "fetch via jax.device_get at a designed sync "
+                           "point instead")
+
+        self.generic_visit(node)
+
+    def _check_static_literals(self, jit_call: ast.Call,
+                               outer: ast.Call):
+        """jit(f, static_argnums=...)(args...) direct-call form."""
+        if outer is jit_call:
+            return
+        statics = _static_positions(jit_call)
+        if statics:
+            self._check_static_args(statics, outer)
+
+    def _check_static_args(self, statics: Set[int], call: ast.Call):
+        for pos in statics:
+            if pos < len(call.args) and isinstance(
+                    call.args[pos], (ast.List, ast.Dict, ast.Set,
+                                     ast.ListComp, ast.DictComp,
+                                     ast.SetComp)):
+                self._emit("PHT002", call.args[pos],
+                           f"non-hashable literal at static_argnums "
+                           f"position {pos} — jit static args must hash "
+                           "(this raises, or retraces if converted "
+                           "per call)",
+                           "pass a tuple / frozen value, or make the "
+                           "argument traced")
+
+    def _emit(self, rule, node, message, hint):
+        self.findings.append(Finding(
+            rule=rule, file=self.mi.relpath, line=node.lineno,
+            func=self.fi.qualname, message=message, hint=hint))
+
+
+def _collect_jit_bindings(mi: ModuleInfo) -> Dict[str, Set[int]]:
+    """``g = jax.jit(f, static_argnums=<literal>)`` name bindings."""
+    out: Dict[str, Set[int]] = {}
+    for node in ast.walk(mi.tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call) \
+                and _is_jit_ctor(mi, node.value):
+            statics = _static_positions(node.value)
+            if statics:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        out[t.id] = statics
+    return out
+
+
+# --------------------------------------------------------------------------
+# jit-target discovery (PHT002 traced-branch, PHT004)
+# --------------------------------------------------------------------------
+
+def _jit_targets(mi: ModuleInfo) -> Dict[str, Set[int]]:
+    """qualname -> static positions, for functions that get jitted:
+    decorated with @jax.jit / @functools.partial(jax.jit, ...), or
+    passed by name to jax.jit anywhere in the module."""
+    out: Dict[str, Set[int]] = {}
+
+    def _deco_statics(dec) -> Optional[Set[int]]:
+        if isinstance(dec, ast.Call):
+            d = _call_dotted(mi, dec)
+            if d in _JIT_NAMES:
+                return _static_positions(dec) or set()
+            if d in ("functools.partial",) and dec.args \
+                    and mi.resolve_dotted(dec.args[0]) in _JIT_NAMES:
+                return _static_positions(dec) or set()
+        elif mi.resolve_dotted(dec) in _JIT_NAMES:
+            return set()
+        return None
+
+    for qual, fi in mi.funcs.items():
+        for dec in getattr(fi.node, "decorator_list", []):
+            s = _deco_statics(dec)
+            if s is not None:
+                out[qual] = s
+
+    # jax.jit(f, ...) with f a plain name: resolve through the SAME
+    # scope rules as any other bare call — nearest enclosing scope for
+    # calls inside a function, module level otherwise.  (A suffix match
+    # over all qualnames marked every same-named method as jitted,
+    # false-firing PHT002/PHT004 on never-jitted host code.)
+    def _attribute(targets: Set[str], statics: Set[int]):
+        for tq in targets:
+            out.setdefault(tq, set()).update(statics)
+
+    for fi in mi.funcs.values():
+        for ref in fi.calls:
+            node = ref.node
+            if _is_jit_ctor(mi, node) and node.args \
+                    and isinstance(node.args[0], ast.Name):
+                _attribute(
+                    resolve_same_module(
+                        mi, fi, CallRef("bare", node.args[0].id, node)),
+                    _static_positions(node) or set())
+
+    class _TopLevelCalls(ast.NodeVisitor):
+        """Module-level jit calls only (function bodies are handled
+        above, with their enclosing scope)."""
+
+        def visit_FunctionDef(self, node):
+            pass
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+
+        def visit_Lambda(self, node):
+            pass
+
+        def visit_Call(self, node):
+            if _is_jit_ctor(mi, node) and node.args \
+                    and isinstance(node.args[0], ast.Name):
+                name = node.args[0].id
+                if name in mi.funcs:
+                    _attribute({name}, _static_positions(node) or set())
+            self.generic_visit(node)
+
+    _TopLevelCalls().visit(mi.tree)
+    return out
+
+
+def _traced_params(fi: FuncInfo, statics: Set[int]) -> Set[str]:
+    args = getattr(fi.node, "args", None)
+    if args is None:
+        return set()
+    names = [a.arg for a in args.posonlyargs + args.args]
+    return {n for i, n in enumerate(names)
+            if i not in statics and n not in ("self", "cls")}
+
+
+class _TracedBranchWalker(ast.NodeVisitor):
+    def __init__(self, mi, fi, params, findings):
+        self.mi, self.fi = mi, fi
+        self.params = params
+        self.findings = findings
+
+    def visit_FunctionDef(self, node):
+        pass
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def _shielded(self, e: ast.expr) -> Set[int]:
+        """ids of Name nodes under shape/ndim/dtype/size/len shields."""
+        out: Set[int] = set()
+        for n in ast.walk(e):
+            shield = None
+            if isinstance(n, ast.Attribute) and n.attr in (
+                    "shape", "ndim", "dtype", "size"):
+                shield = n
+            elif isinstance(n, ast.Call) and isinstance(n.func, ast.Name) \
+                    and n.func.id in ("len", "isinstance", "getattr",
+                                      "hasattr", "type"):
+                shield = n
+            if shield is not None:
+                for sub in ast.walk(shield):
+                    out.add(id(sub))
+        return out
+
+    def _check(self, test: ast.expr):
+        shielded = self._shielded(test)
+        for n in ast.walk(test):
+            if isinstance(n, ast.Name) and n.id in self.params \
+                    and id(n) not in shielded:
+                self.findings.append(Finding(
+                    rule="PHT002", file=self.mi.relpath, line=test.lineno,
+                    func=self.fi.qualname,
+                    message=f"Python branch on traced parameter "
+                            f"`{n.id}` inside a jitted body — "
+                            "concretizes the tracer (error) or bakes "
+                            "one trace-time outcome in forever",
+                    hint="use jnp.where / jax.lax.cond, or mark the "
+                         "argument static if it is host config"))
+                return
+
+    def visit_If(self, node):
+        self._check(node.test)
+        self.generic_visit(node)
+
+    def visit_While(self, node):
+        self._check(node.test)
+        self.generic_visit(node)
+
+    def run(self):
+        for stmt in getattr(self.fi.node, "body", []):
+            self.visit(stmt)
+
+
+def _nondet_calls(mi: ModuleInfo, fi: FuncInfo,
+                  findings: List[Finding]):
+    # own body only: a nested def is its own FuncInfo (reported under
+    # its own func name if reachable — walking into it here duplicated
+    # every finding under two func names, and a nested def that is
+    # never called never executes at trace time anyway).  Lambdas DO
+    # stay in scope: they are not FuncInfo entries, and a staged
+    # `lambda: time.time()` freezes exactly like inline code.
+    calls: List[ast.Call] = []
+
+    def collect(node):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if isinstance(child, ast.Call):
+                calls.append(child)
+            collect(child)
+
+    collect(fi.node)
+    for node in calls:
+        d = _call_dotted(mi, node)
+        if d is None:
+            continue
+        root = d.split(".")[0]
+        # import_resolves distinguishes the resolved time.time /
+        # random.random (direct, aliased, or from-imported) from a
+        # LOCAL variable that merely shadows the name
+        if (root in _NONDET_ROOTS and mi.import_resolves(root)) \
+                or d.startswith(_NONDET_PREFIXES):
+            findings.append(Finding(
+                rule="PHT004", file=mi.relpath, line=node.lineno,
+                func=fi.qualname,
+                message=f"{d}() inside a jitted body is evaluated ONCE "
+                        "at trace time — every later call replays the "
+                        "frozen value (nondeterminism you can't see)",
+                hint="pass timestamps/seeds in as arguments; use "
+                     "jax.random with an explicit key for randomness"))
+
+
+# --------------------------------------------------------------------------
+# per-module driver (PHT001/002/004)
+# --------------------------------------------------------------------------
+
+def lint_module(mi: ModuleInfo) -> List[Finding]:
+    findings: List[Finding] = []
+    hot = hot_set(mi)
+    jit_bindings = _collect_jit_bindings(mi)
+    for qual, fi in mi.funcs.items():
+        if isinstance(fi.node, ast.Lambda):
+            continue
+        _FuncWalker(mi, fi, qual in hot, jit_bindings, findings).run()
+
+    targets = _jit_targets(mi)
+    # PHT004 scope: jitted bodies plus same-module functions they reach
+    nondet_scope: Set[str] = set()
+    work = list(targets)
+    while work:
+        q = work.pop()
+        if q in nondet_scope or q not in mi.funcs:
+            continue
+        nondet_scope.add(q)
+        fi = mi.funcs[q]
+        for ref in fi.calls:
+            for tgt in resolve_same_module(mi, fi, ref):
+                work.append(tgt)
+    for qual, statics in targets.items():
+        fi = mi.funcs.get(qual)
+        if fi is None:
+            continue
+        _TracedBranchWalker(mi, fi, _traced_params(fi, statics),
+                            findings).run()
+    for qual in nondet_scope:
+        _nondet_calls(mi, mi.funcs[qual], findings)
+    return findings
+
+
+# --------------------------------------------------------------------------
+# PHT003: cross-module lock discipline
+# --------------------------------------------------------------------------
+
+# Method names so ubiquitous on stdlib objects (dict/list/set/queue/
+# threading/futures) that receiver-unknown resolution through the
+# project method-name index is noise, not signal: `self.cv.wait()`
+# must not resolve to some project class's `wait`.  Distinctive project
+# names (ingest, propose, tick, …) stay resolvable.  Conservative in
+# the lint direction: a skipped name can only MISS a finding.
+_COMMON_METHOD_NAMES = frozenset((
+    "add", "append", "clear", "close", "copy", "count", "dec", "discard",
+    "done", "extend", "flush", "get", "inc", "index", "insert", "items",
+    "join", "keys", "next", "notify", "notify_all", "pop", "popleft",
+    "put", "read", "recv", "release", "remove", "reset", "result", "run",
+    "send", "set", "sort", "start", "submit", "update", "values", "wait",
+    "write",
+))
+
+
+class _LockAnalysis:
+    def __init__(self, modules: List[ModuleInfo]):
+        self.modules = modules
+        self.by_dotted = {m.dotted: m for m in modules}
+        # method-name index over project classes (receiver-unknown calls)
+        self.methods: Dict[str, List[Tuple[ModuleInfo, FuncInfo]]] = {}
+        for m in modules:
+            for qual, fi in m.funcs.items():
+                if fi.class_name and qual.count(".") == 1:
+                    self.methods.setdefault(
+                        qual.split(".")[1], []).append((m, fi))
+        self._acquires_memo: Dict[Tuple[str, str], Set[str]] = {}
+        self._dispatch_memo: Dict[Tuple[str, str], bool] = {}
+
+    # -- resolution --------------------------------------------------------
+    def resolve(self, mi: ModuleInfo, fi: FuncInfo,
+                ref: CallRef) -> List[Tuple[ModuleInfo, FuncInfo]]:
+        out = [(mi, mi.funcs[q])
+               for q in resolve_same_module(mi, fi, ref)]
+        if out:
+            return out
+        if ref.kind == "dotted":
+            # project module function: longest module prefix match
+            parts = ref.name.split(".")
+            for cut in range(len(parts) - 1, 0, -1):
+                mod = ".".join(parts[:cut])
+                m2 = self.by_dotted.get(mod)
+                if m2 is not None:
+                    qual = ".".join(parts[cut:])
+                    fi2 = m2.funcs.get(qual)
+                    if fi2 is not None:
+                        return [(m2, fi2)]
+                    return []
+            return []
+        if ref.kind in ("method", "self"):
+            if ref.name in _COMMON_METHOD_NAMES:
+                return []
+            return self.methods.get(ref.name, [])
+        return []
+
+    # -- lock refs ---------------------------------------------------------
+    def lock_of(self, mi: ModuleInfo, fi: FuncInfo,
+                expr: ast.expr) -> Optional[str]:
+        if isinstance(expr, ast.Attribute) \
+                and isinstance(expr.value, ast.Name) \
+                and expr.value.id == "self" and fi.class_name:
+            key = f"{fi.class_name}.{expr.attr}"
+            ld = mi.locks.get(key)
+            return ld.lock_id if ld else None
+        if isinstance(expr, ast.Name):
+            ld = mi.locks.get(expr.id)
+            return ld.lock_id if ld else None
+        return None
+
+    # -- transitive lock acquisition ---------------------------------------
+    # No depth cap on either walk: memoization already bounds the work
+    # to one computation per function (a cap would force truncated
+    # results into the memo, and an unrelated deep call chain reaching a
+    # function FIRST would permanently blind later shallow queries —
+    # hiding real cycles depending on definition order).  The empty-set
+    # placeholder is the recursion cycle guard; mutually recursive
+    # functions under-approximate across the back edge, which can only
+    # MISS, never false-positive.
+    def acquires(self, mi: ModuleInfo, fi: FuncInfo) -> Set[str]:
+        key = (mi.dotted, fi.qualname)
+        if key in self._acquires_memo:
+            return self._acquires_memo[key]
+        self._acquires_memo[key] = set()   # cycle guard
+        out: Set[str] = set()
+        for node in ast.walk(fi.node):
+            if isinstance(node, ast.With):
+                for item in node.items:
+                    lk = self.lock_of(mi, fi, item.context_expr)
+                    if lk:
+                        out.add(lk)
+        for ref in fi.calls:
+            for m2, f2 in self.resolve(mi, fi, ref):
+                out |= self.acquires(m2, f2)
+        self._acquires_memo[key] = out
+        return out
+
+    # -- device dispatch reachability --------------------------------------
+    def dispatches(self, mi: ModuleInfo, fi: FuncInfo) -> bool:
+        key = (mi.dotted, fi.qualname)
+        if key in self._dispatch_memo:
+            return self._dispatch_memo[key]
+        self._dispatch_memo[key] = False   # cycle guard
+        out = False
+        for node in ast.walk(fi.node):
+            if isinstance(node, ast.Call):
+                d = _call_dotted(mi, node)
+                if _is_device_call(d) or d in _SYNC_EXACT:
+                    out = True
+                    break
+        if not out:
+            for ref in fi.calls:
+                for m2, f2 in self.resolve(mi, fi, ref):
+                    if self.dispatches(m2, f2):
+                        out = True
+                        break
+                if out:
+                    break
+        self._dispatch_memo[key] = out
+        return out
+
+    # -- the walk ----------------------------------------------------------
+    def run(self) -> List[Finding]:
+        findings: List[Finding] = []
+        # edge -> first site (file, line, holder func)
+        edges: Dict[Tuple[str, str], Tuple[str, int, str]] = {}
+        dispatch_sites: List[Finding] = []
+
+        for mi in self.modules:
+            for fi in mi.funcs.values():
+                self._walk_func(mi, fi, edges, dispatch_sites)
+
+        findings.extend(dispatch_sites)
+
+        # cycle detection on the order graph
+        graph: Dict[str, Set[str]] = {}
+        for a, b in edges:
+            graph.setdefault(a, set()).add(b)
+        reported: Set[frozenset] = set()
+        for a, b in sorted(edges):
+            if a == b:
+                cyc = frozenset((a,))
+                if cyc not in reported:
+                    reported.add(cyc)
+                    f, ln, fn = edges[(a, b)]
+                    findings.append(Finding(
+                        rule="PHT003", file=f, line=ln, func=fn,
+                        message=f"lock `{a}` acquired while an instance "
+                                "of the same lock class is already held "
+                                "— two threads nesting opposite "
+                                "instances deadlock",
+                        hint="impose a total order on instances, or "
+                             "restructure so one is released first"))
+                continue
+            path = self._find_path(graph, b, a)
+            if path is not None:
+                cyc = frozenset(path)
+                if cyc in reported:
+                    continue
+                reported.add(cyc)
+                f, ln, fn = edges[(a, b)]
+                chain = " -> ".join(path + [path[0]])
+                findings.append(Finding(
+                    rule="PHT003", file=f, line=ln, func=fn,
+                    message=f"lock-order cycle: `{a}` -> `{b}` here, but "
+                            f"the reverse path exists ({chain}) — "
+                            "opposing acquisition orders deadlock under "
+                            "contention",
+                    hint="acquire in one global order everywhere, or "
+                         "drop to snapshot-then-call (copy under one "
+                         "lock, call outside it)"))
+        return findings
+
+    def _find_path(self, graph, src, dst) -> Optional[List[str]]:
+        seen = set()
+        stack = [(src, [src])]
+        while stack:
+            cur, path = stack.pop()
+            if cur == dst:
+                return path
+            if cur in seen:
+                continue
+            seen.add(cur)
+            for nxt in graph.get(cur, ()):
+                stack.append((nxt, path + [nxt]))
+        return None
+
+    def _walk_func(self, mi, fi, edges, dispatch_sites):
+        calls_by_id = {id(ref.node): ref for ref in fi.calls}
+        flagged: Set[Tuple[str, str]] = set()
+
+        def walk(node, held: List[str]):
+            if isinstance(node, ast.With):
+                lks = [self.lock_of(mi, fi, it.context_expr)
+                       for it in node.items]
+                lks = [lk for lk in lks if lk]
+                # `with A, B:` acquires left-to-right: earlier items are
+                # HELD when later ones are taken, so they order-edge
+                # exactly like the enclosing held list
+                for idx, lk in enumerate(lks):
+                    for h in held + lks[:idx]:
+                        edges.setdefault(
+                            (h, lk), (mi.relpath, node.lineno,
+                                      fi.qualname))
+                inner = held + lks
+                for s in node.body:
+                    walk(s, inner)
+                return
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                return
+            if isinstance(node, ast.Call) and held:
+                self._check_call_under_lock(
+                    mi, fi, node, held, calls_by_id, edges, flagged,
+                    dispatch_sites)
+            for child in ast.iter_child_nodes(node):
+                walk(child, held)
+
+        # start from the body statements — walk()'s nested-def guard
+        # must not short-circuit on the root FunctionDef itself
+        for stmt in getattr(fi.node, "body", []):
+            walk(stmt, [])
+
+    def _check_call_under_lock(self, mi, fi, node, held, calls_by_id,
+                               edges, flagged, dispatch_sites):
+        d = _call_dotted(mi, node)
+        direct_sync = (d in _SYNC_EXACT
+                       or (isinstance(node.func, ast.Attribute)
+                           and node.func.attr in ("item",
+                                                  "block_until_ready")))
+        direct_dispatch = _is_device_call(d)
+        reason = None
+        if direct_sync:
+            reason = f"host sync `{d or node.func.attr}`"
+        elif direct_dispatch:
+            reason = f"device dispatch `{d}`"
+        else:
+            ref = calls_by_id.get(id(node))
+            if ref is not None:
+                for m2, f2 in self.resolve(mi, fi, ref):
+                    for lk in self.acquires(m2, f2):
+                        for h in held:
+                            edges.setdefault(
+                                (h, lk),
+                                (mi.relpath, node.lineno, fi.qualname))
+                    if self.dispatches(m2, f2):
+                        reason = (f"call into {m2.dotted}."
+                                  f"{f2.qualname} which dispatches "
+                                  "device work")
+        if reason:
+            key = (held[-1], reason)
+            if key not in flagged:
+                flagged.add(key)
+                dispatch_sites.append(Finding(
+                    rule="PHT003", file=mi.relpath, line=node.lineno,
+                    func=fi.qualname,
+                    message=f"lock `{held[-1]}` held across {reason} — "
+                            "every thread contending this lock stalls "
+                            "behind the device",
+                    hint="stage under the lock, dispatch outside it "
+                         "(the ServingEngine.step stage/commit "
+                         "pattern)"))
+
+
+def lint_locks(modules: List[ModuleInfo]) -> List[Finding]:
+    return _LockAnalysis(modules).run()
