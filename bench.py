@@ -586,7 +586,7 @@ def bench_decode(batch=8, prompt=64, new_tokens=128, spec_k=0,
 def bench_serving(streams=8, prompt=64, new_tokens=128, chunk=32, spec_k=0,
                   metric="gpt2_serving_8stream_device_tokens_per_sec_per_chip",
                   cache_mode="dense", page_size=16, num_pages=None,
-                  max_len=None):
+                  max_len=None, quant=None):
     """Continuous-batching serving (VERDICT r4 directive #2): aggregate
     DEVICE tokens/s across `streams` concurrent requests through the
     ServingEngine's slot-batched tick. Trace-measured like bench_decode —
@@ -597,9 +597,23 @@ def bench_serving(streams=8, prompt=64, new_tokens=128, chunk=32, spec_k=0,
     fused verify tick with the n-gram drafter; acceptance rate recorded,
     and tools/perf_gate.py holds it to >= 1.0x the same-run `serving`
     row (exact greedy equivalence makes speculation strictly free unless
-    the verify width itself costs more than it recovers)."""
+    the verify width itself costs more than it recovers).
+
+    ``quant="int8"`` = the `serving_int8` row: the SAME workload served
+    from a weight-only quantized artifact (save_for_serving(quant=) ->
+    load_for_serving round trip, so the row measures what a production
+    deploy measures: the fused dequant GEMM ticks plus quantize-at-load).
+    Embeds the achieved weight-HBM bytes and the bf16 ratio as evidence;
+    tools/perf_gate.py holds the row to >= 1.3x the same-run bf16
+    `serving` row on device timing (decode is weight-bandwidth-bound, so
+    halved weight bytes must buy real throughput)."""
+    import shutil
+    import tempfile
+
     import paddle_hackathon_tpu as paddle
-    from paddle_hackathon_tpu.inference.serving import ServingEngine
+    from paddle_hackathon_tpu.inference.serving import (ServingEngine,
+                                                        load_for_serving,
+                                                        save_for_serving)
     from paddle_hackathon_tpu.models import GPTForCausalLM, gpt_config
 
     paddle.seed(0)
@@ -610,6 +624,16 @@ def bench_serving(streams=8, prompt=64, new_tokens=128, chunk=32, spec_k=0,
     for _, p in model.named_parameters():
         if jnp.issubdtype(p._value.dtype, jnp.floating):
             p._set_value(p._value.astype(jnp.bfloat16))
+    bf16_bytes = sum(int(p._value.nbytes)
+                     for _, p in model.named_parameters())
+    quant_dir = None
+    if quant is not None:
+        quant_dir = tempfile.mkdtemp(prefix="bench_quant_artifact")
+        try:
+            save_for_serving(model, quant_dir, quant=quant)
+            model = load_for_serving(quant_dir)
+        finally:
+            shutil.rmtree(quant_dir, ignore_errors=True)
     rng = np.random.RandomState(0)
     prompts = [rng.randint(0, cfg.vocab_size, (prompt,)).astype(np.int32)
                for _ in range(streams)]
@@ -660,6 +684,15 @@ def bench_serving(streams=8, prompt=64, new_tokens=128, chunk=32, spec_k=0,
         "e2e_p50_ms": round(eng._h_e2e.quantile(0.5) * 1e3, 3),
         "ticks": eng.stats["ticks"],
     }
+    if quant is not None:
+        # achieved weight HBM (the serving_weight_bytes gauge) and the
+        # bf16 ratio — evidence the artifact/HBM halving actually landed
+        wb = int(eng._g_weight_bytes.value)
+        row["metrics"].update({
+            "serving_weight_bytes": wb,
+            "weight_bytes_vs_bf16": round(wb / bf16_bytes, 4),
+        })
+        row["quant"] = quant
     if cache_mode == "paged":
         # pool-leak tripwire for tools/perf_gate.py: after the drain the
         # only live pages are the prefix cache's; dropping it must
@@ -715,6 +748,14 @@ SUITE = {
         streams=16, max_len=512, cache_mode="paged", page_size=16,
         num_pages=8 * 512 // 16 + 1,
         metric="gpt2_serving_paged_16stream_device_tokens_per_sec_per_chip"),
+    # weight-only int8 serving (PR 8): identical workload to `serving`
+    # through the quantized artifact (save -> quantize-at-load ->
+    # fused dequant GEMM ticks); decode streams half the weight bytes
+    # per token, so tools/perf_gate.py holds the row to >= 1.3x the
+    # same-run bf16 `serving` row wherever device timing is available
+    "serving_int8": lambda: bench_serving(
+        quant="int8",
+        metric="gpt2_serving_int8_8stream_device_tokens_per_sec_per_chip"),
     # the high-level trainer's compiled fast path (hapi/compiled.py):
     # tokens/s through Model.fit must track the hand-rolled gpt2 row
     "hapi_fit": lambda: bench_hapi_fit(),

@@ -87,11 +87,22 @@ class _BuildCtx:
 
 
 def _load_artifact(config: Config):
-    """Load either a static artifact (prefix.pdmodel raw StableHLO +
-    prefix.pdiparams pickle) or a jit zip artifact (MAGIC member)."""
+    """Load a static artifact (prefix.pdmodel raw StableHLO +
+    prefix.pdiparams pickle), a jit zip artifact (MAGIC member), or a
+    ``save_for_serving`` directory ({config.json, params.npz} — bf16 or
+    weight-only-quantized; the quantized artifact rebuilds with fused
+    dequant-GEMM Linears, so Predictor serves int8/fp8 weights through
+    the same ZeroCopy interface)."""
+    import os
     prog = config.prog_file()
     if prog is None:
         raise ValueError("Config has no model file; call set_model()")
+    if os.path.isdir(prog) and os.path.exists(
+            os.path.join(prog, "config.json")):
+        from .serving import load_for_serving
+        model = load_for_serving(prog)
+        params, bufs = model.functional_state()
+        return ("serving", model, params, bufs, ["input_ids"], 1)
     path = prog if prog.endswith(".pdmodel") else prog + ".pdmodel"
     if zipfile.is_zipfile(path):
         with zipfile.ZipFile(path, "r") as zf:
@@ -153,8 +164,19 @@ class Predictor:
                 # pass is disabled (or ir_optim off): weights stay on host
                 # and transfer on each run
                 put = np.asarray
-            self._params = [put(p) for p in params]
-            self._bufs = [put(b) for b in bufs] if bufs is not None else None
+            if self._kind == "serving":
+                # the live model already holds these arrays (run_fn
+                # closes over it) — tree-mapping a put here would keep a
+                # SECOND full weight copy alive for the Predictor's
+                # lifetime, doubling the footprint the quantized
+                # artifact exists to halve
+                self._params, self._bufs = params, bufs
+            else:
+                # list-shaped pdmodel/jit artifacts: resident-params
+                # pins to the target device, else host copies per run
+                self._params = jax.tree.map(put, params)
+                self._bufs = (jax.tree.map(put, bufs)
+                              if bufs is not None else None)
             self._compiled = self._build_runner()
 
         self._inputs: Dict[str, Tensor] = {
@@ -165,7 +187,20 @@ class Predictor:
 
     def _build_runner(self):
         exported = self._exported
-        if self._kind == "static":
+        if self._kind == "serving":
+            # the artifact is a live model (save_for_serving dir): the
+            # runner is one jitted functional forward — quantized
+            # Linears route to the fused dequant GEMM inside this
+            # program exactly as they do in ServingEngine's tick
+            from ..core.tensor import Tensor
+            from ..nn.layer import functional_call
+            model = exported
+
+            def run_fn(args, params, bufs):
+                logits = functional_call(model, params, (Tensor(args[0]),),
+                                         buffers=bufs, training=False)
+                return [logits]
+        elif self._kind == "static":
             def run_fn(feeds, params):
                 return exported.call(feeds, params)
         else:

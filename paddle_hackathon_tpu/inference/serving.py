@@ -111,7 +111,7 @@ def _named_dtype(name):
         return np.dtype(getattr(ml_dtypes, name))
 
 
-def save_for_serving(model, path):
+def save_for_serving(model, path, quant=None):
     """Persist ``{config.json, params.npz}`` so a serving process — in
     particular the C++ shim (``native/serving.cc pht_engine_create``) —
     can rebuild the model without the training script (the role of the
@@ -119,21 +119,52 @@ def save_for_serving(model, path):
 
     Works for any param dtype: bf16 (the expected serving dtype — the
     bench casts GPT-2 to bf16) and other ml_dtypes store as uint views
-    with the logical dtype recorded per param in ``config.json``."""
+    with the logical dtype recorded per param in ``config.json``.
+
+    ``quant="int8"`` (or ``"fp8"``, falling back to int8 where the dtype
+    is missing) post-training-quantizes the attention/MLP projection
+    weights at save time: the artifact stores int8 values plus f32
+    per-output-channel ``<name>_scale`` entries (~halving weight bytes),
+    and ``config.json`` records ``{"quant": {"scheme", "params"}}`` so
+    :func:`load_for_serving` installs the fused-GEMM serving layers
+    before loading state — no wide copy of the SAVED weights is ever
+    built (model construction still transiently allocates the default
+    f32 initializers, the same load peak as the bf16 path).  A model
+    ALREADY holding
+    quantized Linears (``nn.quant.convert_to_weight_only`` — the QAT
+    export) records the same manifest without ``quant=``; embeddings,
+    layernorms and the tied logits head stay in the float dtype either
+    way (docs/SERVING.md, "Weight-only quantized serving")."""
     import dataclasses
     import json
     import os
     os.makedirs(path, exist_ok=True)
+    params = {k: v._value for k, v in model.named_parameters()}
+    scheme = None
+    if quant is not None:
+        from ..nn.quant import weight_only as _wo
+        scheme = _wo.resolve_scheme(quant)
+        params, _ = _wo.quantize_weights(params, scheme)
+    # manifest by inspection (covers both quant= and pre-quantized
+    # trees): a weight with a `_scale` sibling is a serving-quantized
+    # Linear the loader must swap before loading state
+    manifest = sorted(k for k in params if k + "_scale" in params)
     arrs, dtypes = {}, {}
-    for k, v in model.named_parameters():
-        a = np.asarray(v._value)
+    for k, v in params.items():
+        a = np.asarray(v)
         dtypes[k] = a.dtype.name
         store = _storage_dtype(a.dtype)
         arrs[k] = a.view(store) if store is not None else a
+    meta = {"model": type(model).__name__,
+            "config": dataclasses.asdict(model.config),
+            "param_dtypes": dtypes}
+    if manifest:
+        if scheme is None:
+            scheme = ("int8" if dtypes[manifest[0]] == "int8"
+                      else "fp8-e4m3")
+        meta["quant"] = {"scheme": scheme, "params": manifest}
     with open(os.path.join(path, "config.json"), "w") as f:
-        json.dump({"model": type(model).__name__,
-                   "config": dataclasses.asdict(model.config),
-                   "param_dtypes": dtypes}, f)
+        json.dump(meta, f)
     np.savez(os.path.join(path, "params.npz"), **arrs)
 
 
@@ -149,6 +180,17 @@ def load_for_serving(path):
     cls = getattr(_gpt, meta["model"])
     model = cls(_gpt.GPTConfig(**meta["config"]))
     model.eval()
+    q = meta.get("quant")
+    if q:
+        # quantize-at-load: install empty WeightOnlyLinear shells at the
+        # manifest paths BEFORE loading state, so the int8/fp8 weights
+        # land directly in the fused-GEMM layers — no wide copy of the
+        # SAVED weights is ever built.  (Construction above still paid
+        # the default f32 initializers transiently — the same load peak
+        # as any load_for_serving; the swap frees those right here,
+        # before params.npz streams in.)
+        from ..nn.quant.weight_only import apply_weight_only
+        apply_weight_only(model, q["scheme"], names=q["params"])
     z = np.load(os.path.join(path, "params.npz"))
     dtypes = meta.get("param_dtypes", {})
     state = {}
@@ -226,7 +268,12 @@ class ServingEngine:
 
     Args:
       model: a ``GPTForCausalLM``-shaped model (``.gpt`` backbone with
-        ``caches``/``cache_pos`` support, tied LM head).
+        ``caches``/``cache_pos`` support, tied LM head).  A weight-only
+        quantized model (``load_for_serving`` of a ``quant=`` artifact)
+        serves through the same tick programs — its projections route to
+        the fused dequant GEMM inside the jitted tick, halving the
+        weight bytes every decode step streams (docs/SERVING.md,
+        "Weight-only quantized serving").
       max_slots: concurrent request capacity (the static batch B).
       max_len: per-slot KV capacity; a request needs
         ``len(prompt) + max_new_tokens <= max_len - max(chunk, spec_k+1)``
@@ -318,6 +365,14 @@ class ServingEngine:
         self._running = False
         self._loop_thread = None
         self._tickno = 0
+        # device-resident per-tick constants, rebuilt only when slot
+        # membership / page tables change (tick-dispatch trim): a
+        # steady-state decode tick then issues ONE program dispatch plus
+        # the designed token fetch — no per-tick host->device staging of
+        # unchanged sampling vectors or page tables
+        self._sampling_cache = None
+        self._sampling_dev = None
+        self._pt_dev = None
         self._init_metrics()
         self._key = jax.random.key(0)
 
@@ -426,6 +481,17 @@ class ServingEngine:
             "slots holding an active request this tick").labels(**lbl)
         self._g_queue = reg.gauge(
             "serving_queue_depth", "requests waiting for a slot").labels(**lbl)
+        # achieved weight HBM: every param/buffer array the tick programs
+        # stream per token (int8 quantization should read ~half the bf16
+        # bytes — the serving_int8 bench row embeds this as evidence).
+        # .nbytes is shape math on the jax Array, not a transfer.
+        self._g_weight_bytes = reg.gauge(
+            "serving_weight_bytes",
+            "model weight bytes resident for the decode tick "
+            "(params + quant scales + buffers)").labels(**lbl)
+        self._g_weight_bytes.set(
+            sum(int(v.nbytes) for v in self._params.values())
+            + sum(int(v.nbytes) for v in self._bufs.values()))
         # paged-KV pool gauges (stay 0 in dense mode): admission headroom
         # and the leak tripwire tools/perf_gate.py reads off the bench row
         self._g_pages_used = reg.gauge(
@@ -674,7 +740,17 @@ class ServingEngine:
         it is a ``(top_k_live, top_p_live)`` pair selecting a vector-mode
         program that compiles only the filters some row enables.
         Encodings match ``_sample``'s vector mode: top_k=0 / top_p=1.0 =
-        filter off."""
+        filter off.
+
+        Cached until admission/finish changes slot membership; the
+        device-side copies (:meth:`_sampling_dev3`) share the cache's
+        lifetime, so steady-state ticks reuse resident arrays instead of
+        paying three H2D stagings per tick (tick-dispatch trim).  This
+        runs under the engine lock and is host-only — the device staging
+        happens in the unlocked tick runners (PHT003: no device dispatch
+        under ``_lock``)."""
+        if self._sampling_cache is not None:
+            return self._sampling_cache
         B = self.max_slots
         temps = np.full(B, self.temperature, np.float32)
         topks = np.full(B, 0 if self.top_k is None else int(self.top_k),
@@ -697,25 +773,43 @@ class ServingEngine:
                           or req.top_p is not None)
         skey = (bool((topks != 0).any()),
                 bool((topps != 1.0).any())) if vec else False
-        return skey, temps, topks, topps
+        self._sampling_cache = (skey, temps, topks, topps)
+        self._sampling_dev = None
+        return self._sampling_cache
+
+    def _sampling_dev3(self, sampling):
+        """Device-resident (temps, topks, topps) for the tick programs,
+        staged once per membership change (called OUTSIDE the engine
+        lock, from the tick runners only — single-driver contract)."""
+        if self._sampling_dev is None:
+            import jax
+            self._sampling_dev = tuple(
+                jax.device_put(v) for v in sampling[1:4])
+        return self._sampling_dev
 
     def _pt_kw(self):
-        """Extra program kwargs: the current page table (paged mode)."""
+        """Extra program kwargs: the current page table (paged mode),
+        staged to device only when admission/release changed it — the
+        decode steady state reuses the resident copy."""
         if not self._paged:
             return {}
-        import jax.numpy as jnp
-        return {"pt": jnp.asarray(self._page_tables)}
+        if self._pt_dev is None:
+            import jax.numpy as jnp
+            self._pt_dev = jnp.asarray(self._page_tables)
+        return {"pt": self._pt_dev}
 
     def _run_tick(self, tokens, starts, nvalid, sampling):
         import jax
-        import jax.numpy as jnp
-        vec, temps, topks, topps = sampling
+        vec = sampling[0]
+        temps_d, topks_d, topps_d = self._sampling_dev3(sampling)
         width = 1 if int(np.max(nvalid)) <= 1 else self.chunk
+        # host numpy args (tokens/starts/nvalid/tickno) ride the ONE
+        # jitted dispatch's H2D; the sampling vectors are already
+        # resident (tick-dispatch trim)
         self._caches, nxt = self._prog("_tick", vec)(
-            self._params, self._caches, jnp.asarray(tokens[:, :width]),
-            jnp.asarray(starts), jnp.asarray(nvalid), jnp.asarray(temps),
-            jnp.asarray(topks), jnp.asarray(topps), self._key,
-            jnp.asarray(self._tickno, jnp.int32), **self._pt_kw())
+            self._params, self._caches, tokens[:, :width],
+            starts, nvalid, temps_d, topks_d, topps_d, self._key,
+            np.int32(self._tickno), **self._pt_kw())
         # the tick's ONE designed device->host fetch: explicit, so the
         # transfer-guard sanitizer (observability/sanitizers.py) can
         # tell it from an accidental implicit sync
@@ -724,7 +818,8 @@ class ServingEngine:
     def _run_tick_spec(self, tokens, starts, sampling):
         import jax
         import jax.numpy as jnp
-        vec, temps, topks, topps = sampling
+        vec = sampling[0]
+        temps_d, topks_d, topps_d = self._sampling_dev3(sampling)
         toks_j, starts_j = jnp.asarray(tokens), jnp.asarray(starts)
         if self._mesh is not None:
             # place the widened (B, K+1) verify block on the KV cache's
@@ -736,8 +831,8 @@ class ServingEngine:
             starts_j = jax.device_put(starts_j, sh)
         self._caches, out = self._prog("_tick_spec", vec)(
             self._params, self._caches, toks_j, starts_j,
-            jnp.asarray(temps), jnp.asarray(topks), jnp.asarray(topps),
-            self._key, jnp.asarray(self._tickno, jnp.int32),
+            temps_d, topks_d, topps_d,
+            self._key, np.int32(self._tickno),
             **self._pt_kw())
         # designed once-per-tick fetch (see _run_tick)
         return jax.device_get(out)
@@ -880,7 +975,8 @@ class ServingEngine:
         import jax
         import jax.numpy as jnp
         pp = self._pp
-        vec, temps, topks, topps = sampling
+        vec = sampling[0]
+        temps_d, topks_d, topps_d = self._sampling_dev3(sampling)
         # wave at stage s this tick entered stage 0 s ticks ago
         wave_of_stage = np.array(
             [(self._tickno - s) % pp for s in range(pp)], np.int32)
@@ -892,9 +988,9 @@ class ServingEngine:
             kc, vc, self._xbuf, nxt = self._prog("_pp_tick", vec)(
                 self._pp_stacked, kc, vc, self._xbuf, jnp.asarray(tokens),
                 jnp.asarray(starts), jnp.asarray(nvalid),
-                jnp.asarray(temps), jnp.asarray(topks), jnp.asarray(topps),
+                temps_d, topks_d, topps_d,
                 jnp.asarray(wave_of_stage), self._pp_other, self._key,
-                jnp.asarray(self._tickno, jnp.int32))
+                np.int32(self._tickno))
         self._caches = (kc, vc)
         # designed once-per-tick fetch (see _run_tick)
         return jax.device_get(nxt)
@@ -997,6 +1093,7 @@ class ServingEngine:
                 if skip is None:
                     break  # pool exhausted for the FIFO head
             slot.req = req = self._pending.popleft()
+            self._sampling_cache = None  # membership changed: restage
             slot.off = skip   # prefix-cache hit: those rows are already KV
             slot.last = 0
             self._lengths[i] = skip
@@ -1048,6 +1145,7 @@ class ServingEngine:
         self._slot_pages[i] = pages
         self._page_tables[i] = NULL_PAGE
         self._page_tables[i, :len(pages)] = pages
+        self._pt_dev = None   # table changed: restage on next tick
         self._c["prefix_hit_tokens"].inc(len(hit) * P)
         self._g_pages_used.set(self._pool.allocated)
         self._g_pages_free.set(self._pool.free)
@@ -1084,6 +1182,7 @@ class ServingEngine:
             self._pool.decref(pages)
             self._slot_pages[i] = []
         self._page_tables[i] = NULL_PAGE
+        self._pt_dev = None   # table changed: restage on next tick
         self._g_pages_used.set(self._pool.allocated)
         self._g_pages_free.set(self._pool.free)
 
@@ -1143,6 +1242,7 @@ class ServingEngine:
     def _finish(self, slot_idx, req):
         req.done = True
         self._slots[slot_idx].req = None
+        self._sampling_cache = None  # membership changed: restage
         self._lengths[slot_idx] = 0
         if self._paged:
             self._release_pages_locked(slot_idx)
@@ -1436,13 +1536,14 @@ class ServingEngine:
 
     def _run_tick_multi(self, last_toks, starts, sampling):
         import jax
-        import jax.numpy as jnp
-        vec, temps, topks, topps = sampling
+        vec = sampling[0]
+        temps_d, topks_d, topps_d = self._sampling_dev3(sampling)
+        # the steady-state hot path: one jitted dispatch (sampling
+        # vectors + page table already device-resident) + one fetch
         self._caches, out = self._prog("_tick_multi", vec)(
-            self._params, self._caches, jnp.asarray(last_toks),
-            jnp.asarray(starts), jnp.asarray(temps), jnp.asarray(topks),
-            jnp.asarray(topps), self._key,
-            jnp.asarray(self._tickno, jnp.int32), **self._pt_kw())
+            self._params, self._caches, last_toks,
+            starts, temps_d, topks_d, topps_d, self._key,
+            np.int32(self._tickno), **self._pt_kw())
         # designed once-per-tick fetch (see _run_tick)
         return jax.device_get(out)
 

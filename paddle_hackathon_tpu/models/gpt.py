@@ -1031,6 +1031,16 @@ def param_sharding_spec(name: str, shape) -> tuple:
     ZeRO-3 ('sharding' axis) additionally shards the first remaining dim.
     Returns a tuple usable as jax.sharding.PartitionSpec(*spec).
     """
+    if name.endswith(".weight_scale"):
+        # weight-only quantization scales (nn/quant/weight_only.py): one
+        # f32 per OUTPUT channel, so they follow the weight's out-feature
+        # placement — sharded on 'mp' where the projection is column-
+        # parallel, replicated where it is row-parallel.  Checked before
+        # the weight rules: "qkv_proj.weight" substring-matches the
+        # scale name too.
+        if "qkv_proj." in name or "fc_in." in name:
+            return ("mp",)
+        return (None,)
     if "qkv_proj.weight" in name or "fc_in.weight" in name:
         return (None, "mp")       # (in, out): split output columns
     if "out_proj.weight" in name or "fc_out.weight" in name:

@@ -1,5 +1,7 @@
 """paddle.nn.quant — QAT fake-quantization layers
-(ref ``python/paddle/nn/quant/``)."""
+(ref ``python/paddle/nn/quant/``) plus the weight-only serving
+quantizer (``weight_only.py`` — post-training int8/fp8 with fused
+dequant GEMM, beyond the reference's surface)."""
 
 from . import functional_layers  # noqa: F401
 from .quant_layers import (FakeQuantAbsMax,  # noqa: F401
@@ -9,3 +11,6 @@ from .quant_layers import (FakeQuantAbsMax,  # noqa: F401
                            MAOutputScaleLayer, MovingAverageAbsMaxScale,
                            QuantizedConv2D, QuantizedConv2DTranspose,
                            QuantizedLinear, QuantStub)
+from .weight_only import (WeightOnlyLinear,  # noqa: F401
+                          apply_weight_only, convert_to_weight_only,
+                          quantize_weights)

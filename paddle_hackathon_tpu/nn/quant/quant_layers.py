@@ -45,6 +45,15 @@ def _ema_absmax_update(layer, v, rate):
     layer.scale._set_value(accum / state)
 
 
+def channel_absmax(v, axis):
+    """Per-channel absolute maximum over every other axis — the scale
+    statistic shared by the QAT channel-wise fake-quantizer below and the
+    post-training weight-only quantizer (``nn/quant/weight_only.py``)."""
+    axis = axis % v.ndim
+    other = tuple(i for i in range(v.ndim) if i != axis)
+    return jnp.max(jnp.abs(v), axis=other).astype(jnp.float32)
+
+
 def _ste_quant_dequant(v, scale, qmax):
     """Quantize-dequantize with straight-through gradients."""
     scale = jnp.maximum(scale, 1e-9)
@@ -100,8 +109,7 @@ class FakeQuantChannelWiseAbsMax(Layer):
         axis = self._quant_axis
 
         def fn(v):
-            other = tuple(i for i in range(v.ndim) if i != axis % v.ndim)
-            scale = jnp.max(jnp.abs(v), axis=other).astype(jnp.float32)
+            scale = channel_absmax(v, axis)
             shape = [1] * v.ndim
             shape[axis % v.ndim] = scale.shape[0]
             return (_ste_quant_dequant(

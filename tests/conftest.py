@@ -77,6 +77,8 @@ _FILE_COST = {
     "test_lint.py": 7,      # pure AST; one repo-wide walk dominates
     "test_sanitizers.py": 3,  # lock/guard units; engine runs are slow-marked
     "test_paged.py": 16,    # allocator units + 2 tiny-GPT engine runs
+    "test_quant_serving.py": 12,  # kernel/quantizer units + 2 tiny fwd
+                                  # compiles; engine runs are slow-marked
     "test_moment_dtype.py": 16,
     "test_optimizer.py": 17, "test_sharded_lamb.py": 18,
     "test_native_serving.py": 20, "test_native.py": 20, "test_nn.py": 22,
