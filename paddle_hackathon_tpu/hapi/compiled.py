@@ -33,7 +33,14 @@ from ..core import random as core_random
 from ..core.tensor import Tensor
 from ..nn.layer import functional_call
 from ..observability import metrics as _obs
-from ..parallel.api import make_functional_train_step
+from ..parallel.api import _collect_moe_aux, make_functional_train_step
+from ..parallel.moe import moe_aux_weight
+
+
+def has_moe_layers(network) -> bool:
+    """Whether any sublayer carries the MoE aux side channel."""
+    return any(hasattr(l, "l_aux")
+               for l in network.sublayers(include_self=True))
 
 
 def _to_list(x):
@@ -104,6 +111,14 @@ class CompiledTrainer:
             "step": jnp.asarray(opt._step_count, jnp.int32),
         }
         self.ever_ran = False
+        # MoE: thread the load-balance aux INTO the donated program's
+        # loss (the PR 2 contract — no extra dispatches) and return it
+        # as a ride-along (K,) vector so Model.fit can observe the
+        # train_moe_aux_loss metric at the log_freq sync points it
+        # already pays for the loss fetch
+        self._has_moe = has_moe_layers(network)
+        self.last_aux = None
+        aux_w = moe_aux_weight(network) if self._has_moe else 0.0
 
         def forward_loss(p, xs, ys, step):
             rng = jax.random.fold_in(jax.random.PRNGKey(seed), step)
@@ -118,11 +133,31 @@ class CompiledTrainer:
             for l in losses[1:]:
                 total = total + l
             total = total._value if isinstance(total, Tensor) else total
-            return total.astype(jnp.float32)
+            total = total.astype(jnp.float32)
+            if not self._has_moe:
+                return total
+            # the forward just traced left each MoELayer's aux on the
+            # layer (the _collect_moe_aux side-channel contract the
+            # sharded train step already uses)
+            aux = _collect_moe_aux(network)
+            if aux is None:
+                aux = jnp.zeros((), jnp.float32)
+            aux = aux.astype(jnp.float32)
+            return total + aux_w * aux, aux
 
-        def grads_of(p, xs, ys, step):
-            return jax.value_and_grad(
-                lambda pp: forward_loss(pp, xs, ys, step))(p)
+        if self._has_moe:
+            def grads_of(p, xs, ys, step):
+                # has_aux: the aux scalar rides the loss slot as a
+                # (total, aux) pair — lax.scan stacks both into (K,)
+                # vectors, so the program's outputs grow by K floats,
+                # not by a dispatch
+                return jax.value_and_grad(
+                    lambda pp: forward_loss(pp, xs, ys, step),
+                    has_aux=True)(p)
+        else:
+            def grads_of(p, xs, ys, step):
+                return jax.value_and_grad(
+                    lambda pp: forward_loss(pp, xs, ys, step))(p)
 
         train_step = make_functional_train_step(opt, plist, order, grads_of,
                                                 scan_batch=True)
@@ -141,6 +176,10 @@ class CompiledTrainer:
         lr = jnp.asarray(self._opt.get_lr(), jnp.float32)
         p, s, t, losses = self._jit(self.state["params"], self.state["opt"],
                                     self.state["step"], lr, (xs, ys))
+        if self._has_moe:
+            # (totals, auxes) — aux stays a device vector until a
+            # log_freq fetch reads it alongside the loss
+            losses, self.last_aux = losses
         self.state.update(params=p, opt=s, step=t)
         for k, v in p.items():
             self._param_tensors[k]._set_value(v)

@@ -62,13 +62,52 @@ class Model:
         total = losses[0]
         for l in losses[1:]:
             total = total + l
+        aux = self._moe_aux_tensor()
+        if aux is not None:
+            # MoE load-balance aux (same term the compiled path threads
+            # into its donated program — the eager tape must train the
+            # router too, not just the experts)
+            from ..parallel.moe import moe_aux_weight
+            total = total + moe_aux_weight(self.network) * aux
         total.backward()
+        if aux is not None:
+            # report the OPTIMIZED objective as the headline loss so the
+            # eager and compiled fit paths log the same quantity — a
+            # trace-failure fallback mid-fit must not discontinuously
+            # drop the loss series by the aux term
+            losses = [total] + losses[1:]
         if update:
             self._optimizer.step()
             self._optimizer.clear_grad()
         metrics = self._update_metrics(outs, labels)
         out_loss = [float(l.numpy()) for l in losses]
+        if aux is not None:
+            # observe AFTER the out_loss fetch above already synced the
+            # device pipeline — a pre-backward fetch would stall the
+            # step on the forward's completion just to feed telemetry
+            self._observe_moe_aux(float(aux.numpy()), "hapi_eager")
         return (out_loss, metrics) if metrics else out_loss
+
+    def _moe_aux_tensor(self):
+        """Sum of the MoE load-balance aux Tensors the eager forward just
+        left on the network's MoELayers, still ON the tape so
+        ``backward`` trains the router; None when the network has no
+        (traced-this-forward) aux.  Delegates to the single owner of the
+        ``l_aux`` walk (``parallel.moe.collect_moe_aux``)."""
+        from ..parallel.moe import collect_moe_aux
+        return collect_moe_aux(self.network, tensors=True)
+
+    @staticmethod
+    def _observe_moe_aux(value, path):
+        """train_moe_aux_loss histogram (docs/OBSERVABILITY.md): the
+        UNWEIGHTED aux value at the sync points each fit path already
+        pays — a rising series means routing is collapsing onto few
+        experts faster than the weighted term can rebalance it."""
+        from ..observability import metrics as _obs
+        _obs.get_registry().histogram(
+            "train_moe_aux_loss",
+            "MoE load-balance aux loss (unweighted) at loss-fetch sync "
+            "points").labels(path=path).observe(float(value))
 
     def eval_batch(self, inputs, labels=None):
         self.network.eval()
@@ -406,6 +445,13 @@ class Model:
                                      time.perf_counter_ns(), step=step)
                     self._watch_nonfinite(v, step, "hapi_compiled",
                                           nan_policy)
+                    if trainer.last_aux is not None:
+                        # MoE aux ride-along: the loss fetch above
+                        # already drained the pipeline, so this is one
+                        # more tiny d2h of an already-computed scalar,
+                        # not a dispatch
+                        self._observe_moe_aux(
+                            float(trainer.last_aux[j]), "hapi_compiled")
                     last_watched = step
                     _telemetry_tick()
                 logs = {"loss": v}

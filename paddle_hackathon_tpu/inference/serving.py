@@ -373,6 +373,32 @@ class ServingEngine:
         self._sampling_cache = None
         self._sampling_dev = None
         self._pt_dev = None
+        # MoE serving: per-token routing runs INSIDE the jitted tick (the
+        # MoELayer MLP is cache-independent, so the dense/paged programs
+        # need no structural change); arming collect_router_stats makes
+        # each tick additionally return (mean router entropy, per-expert
+        # load) which ride the tick's single designed fetch into the
+        # moe_router_entropy / moe_expert_load histograms.  Eval routing
+        # is DROPLESS (parallel/moe.py), so a token's output never
+        # depends on which other slots share its tick batch — the
+        # engine's token-exactness contract vs generate() holds for MoE.
+        from ..parallel.moe import MoELayer as _MoELayer
+        moe_layers = [l for l in model.sublayers(include_self=True)
+                      if isinstance(l, _MoELayer)]
+        self._moe = bool(moe_layers) and self._pp == 1
+        self._moe_num_experts = (moe_layers[0].num_experts
+                                 if moe_layers else 0)
+        if self._moe:
+            # armed for the MODEL's lifetime, deliberately: the flag is
+            # read at trace time, so disarming on shutdown would break a
+            # second live engine's next lazily-built tick flavor (it
+            # expects the 3-output trace).  Cost to non-engine users of
+            # the same model is nil where it matters — a jitted
+            # generate() never consumes the stats, so XLA dead-code
+            # eliminates them from the compiled program; only fully
+            # eager forwards pay the per-layer entropy/load arithmetic.
+            for l in moe_layers:
+                l.collect_router_stats = True
         self._init_metrics()
         self._key = jax.random.key(0)
 
@@ -500,6 +526,24 @@ class ServingEngine:
         self._g_pages_free = reg.gauge(
             "serving_kv_pages_free",
             "KV pool pages on the free list").labels(**lbl)
+        # MoE router telemetry (registered only for MoE engines so dense
+        # engines don't grow empty series): entropy distribution + one
+        # per-expert load-share histogram — a hot expert shows up as its
+        # series' mass moving right while the others move left
+        self._h_moe_ent = None
+        self._h_moe_load = ()
+        if self._moe:
+            self._h_moe_ent = reg.histogram(
+                "moe_router_entropy",
+                "mean per-token router entropy per MoE decode tick "
+                "(nats; ln(num_experts) = uniform routing)").labels(**lbl)
+            load_fam = reg.histogram(
+                "moe_expert_load",
+                "per-tick fraction of kept (dispatched) token slots "
+                "routed to each expert", buckets=_obs.RATIO_BUCKETS)
+            self._h_moe_load = tuple(
+                load_fam.labels(expert=str(e), **lbl)
+                for e in range(self._moe_num_experts))
         # event-level observability: always-on flight ring (request
         # lifecycle marks + tick summaries feed the crash post-mortem)
         # and the /debug/requests slot table (weakly registered — a
@@ -570,9 +614,11 @@ class ServingEngine:
 
         from ..core.tensor import Tensor
         from ..nn.layer import functional_call
+        from ..parallel.moe import collect_router_stats as _moe_stats
 
         model = self.model
         bufs = self._bufs
+        moe = self._moe
 
         def mk_tick(sample):
             # pt=None compiles the dense trace; the paged engine passes
@@ -595,7 +641,13 @@ class ServingEngine:
                 nxt = sample(
                     logits, temps, topks, topps,
                     jax.random.fold_in(jax.random.fold_in(key, 0), tickno))
-                return caches, nxt[:, 0].astype(jnp.int32)
+                toks = nxt[:, 0].astype(jnp.int32)
+                if moe:
+                    # router stats left on the layers by the forward just
+                    # traced — returned as program outputs so they ride
+                    # the tick's single designed fetch
+                    return caches, toks, _moe_stats(model.gpt)
+                return caches, toks
             return jax.jit(tick, donate_argnums=(1,))
 
         self._tick, self._tick_mk = {}, mk_tick
@@ -608,6 +660,8 @@ class ServingEngine:
         # tok/s at b8; window=8: 9.1k; the fused loop: 12.2k)
         M = self._decode_window
 
+        E = self._moe_num_experts
+
         def mk_tick_multi(sample):
             def tick_multi(params, caches, last_tok, starts, temps, topks,
                            topps, key, tickno, pt=None):
@@ -615,7 +669,10 @@ class ServingEngine:
                 outbuf = jnp.zeros((B, M), jnp.int32)
 
                 def body(t, carry):
-                    caches, cur, outbuf = carry
+                    if moe:
+                        caches, cur, outbuf, acc = carry
+                    else:
+                        caches, cur, outbuf = carry
                     hidden, caches = functional_call(
                         model.gpt, params, (Tensor(cur[:, None]),),
                         kwargs={"caches": caches,
@@ -631,8 +688,22 @@ class ServingEngine:
                     outbuf = jax.lax.dynamic_update_slice(
                         outbuf, nxt[:, None],
                         (jnp.zeros((), jnp.int32), t.astype(jnp.int32)))
+                    if moe:
+                        # accumulate the in-loop steps' router stats in
+                        # the carry (the side-channel values are local to
+                        # each body trace; only the carry survives)
+                        e, l = _moe_stats(model.gpt)
+                        return caches, nxt, outbuf, (acc[0] + e, acc[1] + l)
                     return caches, nxt, outbuf
 
+                if moe:
+                    # per-token accumulators (B rows, width 1 per step):
+                    # the engine masks inactive slots after the fetch
+                    zero = (jnp.zeros((B,), jnp.float32),
+                            jnp.zeros((B, E), jnp.float32))
+                    caches, _, outbuf, acc = jax.lax.fori_loop(
+                        0, M, body, (caches, last_tok, outbuf, zero))
+                    return caches, outbuf, (acc[0] / M, acc[1] / M)
                 caches, _, outbuf = jax.lax.fori_loop(
                     0, M, body, (caches, last_tok, outbuf))
                 return caches, outbuf
@@ -699,10 +770,12 @@ class ServingEngine:
 
         from ..core.tensor import Tensor
         from ..nn.layer import functional_call
+        from ..parallel.moe import collect_router_stats as _moe_stats
 
         model = self.model
         bufs = self._bufs
         K = self.spec_k
+        moe = self._moe
 
         def mk_tick_spec(sample):
             def tick_spec(params, caches, tokens, starts, temps, topks,
@@ -726,7 +799,10 @@ class ServingEngine:
                 ref = model._sample(
                     logits[:, 1:].reshape(B * K, -1), 0.0, None)
                 out = jnp.concatenate([first, ref.reshape(B, K)], axis=1)
-                return caches, out.astype(jnp.int32)
+                out = out.astype(jnp.int32)
+                if moe:
+                    return caches, out, _moe_stats(model.gpt)
+                return caches, out
             return jax.jit(tick_spec, donate_argnums=(1,))
 
         self._tick_spec, self._tick_spec_mk = {}, mk_tick_spec
@@ -798,7 +874,32 @@ class ServingEngine:
             self._pt_dev = jnp.asarray(self._page_tables)
         return {"pt": self._pt_dev}
 
-    def _run_tick(self, tokens, starts, nvalid, sampling):
+    # pht-lint: hot-root (MoE decode tick path — per-tick stats observe)
+    def _observe_moe(self, st, mask):
+        """Record a tick's router stats (host values — they rode the
+        tick's designed fetch).  ``st`` is the layer-averaged PER-TOKEN
+        (entropy (n,), kept-slot counts (n, E)) pair; ``mask`` (same
+        row order as the tick's token batch, flattened) selects the
+        rows that belong to an ACTIVE slot's real positions — inactive
+        slots' scratch rows and prefill padding route garbage every
+        tick, and letting them into the histograms at partial occupancy
+        would fake the expert-collapse signals operators alarm on.
+        No-op for dense engines/None stats."""
+        if st is None or self._h_moe_ent is None:
+            return
+        mask = np.asarray(mask).reshape(-1)
+        if not mask.any():
+            return
+        ent, load = st
+        ent = np.asarray(ent).reshape(-1)[mask]
+        load = np.asarray(load).reshape(mask.shape[0], -1)[mask]
+        self._h_moe_ent.observe(float(ent.mean()))
+        counts = load.sum(0)
+        tot = max(float(counts.sum()), 1.0)
+        for child, cnt in zip(self._h_moe_load, counts):
+            child.observe(float(cnt) / tot)
+
+    def _run_tick(self, tokens, starts, nvalid, sampling, active):
         import jax
         vec = sampling[0]
         temps_d, topks_d, topps_d = self._sampling_dev3(sampling)
@@ -806,16 +907,29 @@ class ServingEngine:
         # host numpy args (tokens/starts/nvalid/tickno) ride the ONE
         # jitted dispatch's H2D; the sampling vectors are already
         # resident (tick-dispatch trim)
-        self._caches, nxt = self._prog("_tick", vec)(
+        out = self._prog("_tick", vec)(
             self._params, self._caches, tokens[:, :width],
             starts, nvalid, temps_d, topks_d, topps_d, self._key,
             np.int32(self._tickno), **self._pt_kw())
         # the tick's ONE designed device->host fetch: explicit, so the
         # transfer-guard sanitizer (observability/sanitizers.py) can
-        # tell it from an accidental implicit sync
+        # tell it from an accidental implicit sync (MoE router stats
+        # ride the same single fetch)
+        if self._moe:
+            self._caches, nxt, st = out
+            nxt, st = jax.device_get((nxt, st))
+            # valid rows: active slots' first nvalid positions (decode
+            # rows are width 1; prefill rows beyond the chunk's valid
+            # span are padding)
+            self._observe_moe(st, active[:, None]
+                              & (np.arange(width)[None, :]
+                                 < nvalid[:, None]))
+            return nxt
+        self._caches, nxt = out
         return jax.device_get(nxt)
 
-    def _run_tick_spec(self, tokens, starts, sampling):
+    def _run_tick_spec(self, tokens, starts, sampling, active=None,
+                       ndraft=None):
         import jax
         import jax.numpy as jnp
         vec = sampling[0]
@@ -829,12 +943,27 @@ class ServingEngine:
             sh = token_batch_sharding(self._mesh)
             toks_j = jax.device_put(toks_j, sh)
             starts_j = jax.device_put(starts_j, sh)
-        self._caches, out = self._prog("_tick_spec", vec)(
+        res = self._prog("_tick_spec", vec)(
             self._params, self._caches, toks_j, starts_j,
             temps_d, topks_d, topps_d,
             self._key, np.int32(self._tickno),
             **self._pt_kw())
         # designed once-per-tick fetch (see _run_tick)
+        if self._moe:
+            self._caches, out, st = res
+            out, st = jax.device_get((out, st))
+            # valid rows: active slots' bonus token + their real drafts
+            # (positions past ndraft are stale draft padding)
+            B, W = np.asarray(tokens).shape
+            act = (np.ones(B, bool) if active is None
+                   else np.asarray(active, bool))
+            nd = (np.full(B, W - 1) if ndraft is None
+                  else np.asarray(ndraft))
+            self._observe_moe(
+                st, act[:, None] & (np.arange(W)[None, :]
+                                    <= nd[:, None]))
+            return out
+        self._caches, out = res
         return jax.device_get(out)
 
     # ------------------------------------------------------------------
@@ -1334,6 +1463,10 @@ class ServingEngine:
                 # paged-vs-dense admitted-concurrency evidence (bench)
                 self._peak_occupancy = occ
             sampling = self._sampling_vectors()
+            # live-slot mask, shared by every mode: the tick programs
+            # run ALL slots (inactive rows carry scratch), and the MoE
+            # stats observer must see only the real ones
+            active = np.asarray([s.req is not None for s in self._slots])
             if self._pp > 1:
                 if (not any(s.req is not None for s in self._slots)
                         and not self._inflight_live()):
@@ -1349,8 +1482,6 @@ class ServingEngine:
                 last_toks = np.asarray([s.last for s in self._slots],
                                        np.int32)
                 starts = self._lengths.copy()
-                active = np.asarray(
-                    [s.req is not None for s in self._slots])
                 # speculate only when some active slot is greedy — an
                 # all-sampling tick would pay the K+1-wide verify for 1
                 # token/slot where the fused M-step window commits M
@@ -1400,7 +1531,8 @@ class ServingEngine:
         if mode == "spec":
             toks = np.concatenate([last_toks[:, None], drafts], axis=1)
             t0n = time.perf_counter_ns()
-            out = self._run_tick_spec(toks, starts, sampling)
+            out = self._run_tick_spec(toks, starts, sampling,
+                                      active=active, ndraft=ndraft)
             t1n = time.perf_counter_ns()
             self._h_tick["spec"].observe((t1n - t0n) / 1e9)
             from ..nn.decode import accept_lengths
@@ -1455,7 +1587,8 @@ class ServingEngine:
             return True
         if mode == "multi":
             t0n = time.perf_counter_ns()
-            out = self._run_tick_multi(last_toks, starts, sampling)
+            out = self._run_tick_multi(last_toks, starts, sampling,
+                                       active=active)
             t1n = time.perf_counter_ns()
             self._h_tick["decode"].observe((t1n - t0n) / 1e9)
             with self._lock:
@@ -1492,7 +1625,7 @@ class ServingEngine:
                                   np.where(active, M, 0).astype(np.int32))
             return True
         t0n = time.perf_counter_ns()
-        nxt = self._run_tick(tokens, starts, nvalid, sampling)
+        nxt = self._run_tick(tokens, starts, nvalid, sampling, active)
         t1n = time.perf_counter_ns()
         self._h_tick["prefill"].observe((t1n - t0n) / 1e9)
         with self._lock:
@@ -1534,17 +1667,25 @@ class ServingEngine:
             self._spec.ingest(tokens, starts, consumed)
         return True
 
-    def _run_tick_multi(self, last_toks, starts, sampling):
+    def _run_tick_multi(self, last_toks, starts, sampling, active=None):
         import jax
         vec = sampling[0]
         temps_d, topks_d, topps_d = self._sampling_dev3(sampling)
         # the steady-state hot path: one jitted dispatch (sampling
         # vectors + page table already device-resident) + one fetch
-        self._caches, out = self._prog("_tick_multi", vec)(
+        res = self._prog("_tick_multi", vec)(
             self._params, self._caches, last_toks,
             starts, temps_d, topks_d, topps_d, self._key,
             np.int32(self._tickno), **self._pt_kw())
-        # designed once-per-tick fetch (see _run_tick)
+        # designed once-per-tick fetch (see _run_tick); MoE stats are
+        # the window's M-step means and ride the same fetch
+        if self._moe:
+            self._caches, out, st = res
+            out, st = jax.device_get((out, st))
+            self._observe_moe(st, np.ones(len(out), bool)
+                              if active is None else active)
+            return out
+        self._caches, out = res
         return jax.device_get(out)
 
     def _inflight_live(self):

@@ -40,17 +40,30 @@ class GPTConfig:
     attention_dropout_prob: float = 0.1
     initializer_range: float = 0.02
     use_flash_attention: bool = None  # None = auto (seq-length heuristic)
-    # MoE (GPT-MoE family): >0 replaces every block's MLP with a MoELayer
-    # whose expert dim shards over the 'ep' mesh axis
+    # MoE (GPT-MoE family): >0 replaces selected blocks' MLP with a
+    # MoELayer whose expert dim shards over the 'ep' mesh axis.
+    # moe_every_n selects WHICH blocks route: every n-th block (counting
+    # from 1, so every_n=2 makes blocks 1, 3, 5, ... MoE and the rest
+    # dense — the interleaved GPT-MoE layout); 1 = every block.
     moe_num_experts: int = 0
     moe_topk: int = 2
     moe_gate: str = "naive"
     moe_capacity_factor: float = 2.0
     moe_aux_weight: float = 0.01
+    moe_every_n: int = 1
+    # dispatch token-group size (None = auto; parallel/moe.py docstring)
+    moe_group_size: Optional[int] = None
 
     @property
     def ffn_size(self):
         return self.intermediate_size or 4 * self.hidden_size
+
+    def block_uses_moe(self, layer_idx: int) -> bool:
+        """Whether block ``layer_idx`` (0-based) routes through experts."""
+        if self.moe_num_experts <= 0:
+            return False
+        n = max(1, int(self.moe_every_n))
+        return (layer_idx + 1) % n == 0
 
 
 _GPT_PRESETS = {
@@ -265,18 +278,22 @@ class GPTMLP(Layer):
 class GPTBlock(Layer):
     """Pre-LN transformer block (the fused_multi_transformer layout)."""
 
-    def __init__(self, config: GPTConfig):
+    def __init__(self, config: GPTConfig, use_moe: Optional[bool] = None):
         super().__init__()
         self.ln_1 = LayerNorm(config.hidden_size)
         self.attn = GPTAttention(config)
         self.ln_2 = LayerNorm(config.hidden_size)
-        if config.moe_num_experts > 0:
+        # use_moe=None keeps the historical contract (any block of an MoE
+        # config routes); GPTModel passes config.block_uses_moe(i) so
+        # moe_every_n can interleave dense and routed blocks
+        if (config.moe_num_experts > 0 if use_moe is None else use_moe):
             from ..parallel.moe import MoELayer
             self.mlp = MoELayer(
                 config.hidden_size, config.ffn_size,
                 config.moe_num_experts, gate=config.moe_gate,
                 topk=config.moe_topk,
-                capacity_factor=config.moe_capacity_factor)
+                capacity_factor=config.moe_capacity_factor,
+                group_size=config.moe_group_size)
         else:
             self.mlp = GPTMLP(config)
         self.dropout = Dropout(config.hidden_dropout_prob)
@@ -302,8 +319,9 @@ class GPTModel(Layer):
                              config.hidden_size,
                              weight_attr=ParamAttr(initializer=init))
         self.drop = Dropout(config.hidden_dropout_prob)
-        self.blocks = LayerList([GPTBlock(config)
-                                 for _ in range(config.num_layers)])
+        self.blocks = LayerList([GPTBlock(config,
+                                          use_moe=config.block_uses_moe(i))
+                                 for i in range(config.num_layers)])
         self.ln_f = LayerNorm(config.hidden_size)
 
     def forward(self, input_ids, position_ids=None, caches=None,
@@ -530,7 +548,11 @@ class GPTForCausalLM(Layer):
         sh = getattr(self.gpt.wte.weight._value, "sharding", None)
         if isinstance(sh, NamedSharding) and any(
                 sh.mesh.shape.get(a, 1) > 1
-                for a in ("mp", "dp", "sharding")):
+                for a in ("mp", "dp", "sharding", "ep")):
+            # 'ep' counts: the embedding itself is replicated over it,
+            # but expert stacks shard on it, and decode must compose the
+            # same mesh (batch over the data axes incl. 'ep') or GSPMD
+            # gathers every expert to every rank per tick
             return sh.mesh
         return None
 
@@ -976,6 +998,15 @@ class GPTForCausalLM(Layer):
         from ..nn.functional.loss import fused_softmax_ce_rows
 
         moe = self.config.moe_num_experts > 0
+        if moe and max(1, int(self.config.moe_every_n)) != 1:
+            # the pipeline schedule stacks ONE block template's params
+            # over the layer dim (stack_block_params) — interleaved
+            # dense/MoE blocks have different param sets and cannot
+            # stack; ep/mp/dp compositions serve moe_every_n fine
+            raise ValueError(
+                "pipeline parallelism requires homogeneous blocks: "
+                f"moe_every_n={self.config.moe_every_n} interleaves dense "
+                "and MoE blocks — use moe_every_n=1 under a 'pp' mesh")
         template = self.gpt.blocks[0]
         drop = self.gpt.drop
         ln_f = self.gpt.ln_f

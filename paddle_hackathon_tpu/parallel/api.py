@@ -163,15 +163,12 @@ def page_pool_sharding(mesh: Mesh):
 
 def _collect_moe_aux(model):
     """Sum of the trace-fresh MoE load-balance aux values left on
-    MoELayer instances by the forward just run (None when no MoE)."""
-    total = None
-    for layer in model.sublayers(include_self=True):
-        aux = getattr(layer, "l_aux", None)
-        if aux is None:
-            continue
-        v = aux._value if isinstance(aux, Tensor) else aux
-        total = v if total is None else total + v
-    return total
+    MoELayer instances by the forward just run (None when no MoE).
+    Kept under its historical name; the walk itself lives in
+    ``parallel.moe.collect_moe_aux`` (single owner — the eager
+    ``train_batch`` shares it with ``tensors=True``)."""
+    from .moe import collect_moe_aux
+    return collect_moe_aux(model)
 
 
 def stack_block_params(model, mesh: Mesh, rule, block_prefix: str,
@@ -546,13 +543,8 @@ def make_sharded_train_step(model: Layer, mesh: Mesh,
             # MoELayer.forward left this trace's aux value on the layer
             aux = _collect_moe_aux(model)
             if aux is not None:
-                # PipelineLayer carries its own weight; model configs
-                # (GPTConfig.moe_aux_weight) otherwise
-                w = getattr(model, "_aux_weight", None)
-                if w is None:
-                    w = getattr(getattr(model, "config", None),
-                                "moe_aux_weight", 0.01)
-                loss = loss + w * aux
+                from .moe import moe_aux_weight
+                loss = loss + moe_aux_weight(model) * aux
             return loss
 
     from ..optimizer.optimizers import LAMB_DEFAULTS, LARS_DEFAULTS

@@ -79,6 +79,9 @@ _FILE_COST = {
     "test_paged.py": 16,    # allocator units + 2 tiny-GPT engine runs
     "test_quant_serving.py": 12,  # kernel/quantizer units + 2 tiny fwd
                                   # compiles; engine runs are slow-marked
+    "test_moe.py": 30,      # gate/dispatch units, eager-only (no engine)
+    "test_moe_serving.py": 16,  # 2 tiny jitted fwds; engine/trainer
+                                # runs are slow-marked
     "test_moment_dtype.py": 16,
     "test_optimizer.py": 17, "test_sharded_lamb.py": 18,
     "test_native_serving.py": 20, "test_native.py": 20, "test_nn.py": 22,

@@ -179,3 +179,38 @@ def test_train_step_accepts_pytree_batch():
         l0 = l0 if l0 is not None else float(loss)
         l1 = float(loss)
     assert np.isfinite(l1) and l1 < l0   # actually trains
+
+
+def test_run_suite_records_error_rows_and_continues(bench, monkeypatch,
+                                                    capsys):
+    """A suite row that fails both attempts becomes an {"error": ...}
+    row and the sweep CONTINUES (the r04 rc=1 dtype crash aborted the
+    whole bench record under the old raise); tools/perf_gate.py fails
+    loudly on the recorded row instead."""
+    import subprocess as sp
+    import types
+
+    monkeypatch.setattr(bench, "SUITE",
+                        {"good": None, "boom": None, "tail": None})
+
+    def fake_run(args, capture_output=True, text=True, timeout=None):
+        name = args[args.index("--one") + 1]
+        if name == "boom":
+            return types.SimpleNamespace(
+                returncode=1, stdout="",
+                stderr="ValueError: dtype crash (cf. r04 rc=1)")
+        return types.SimpleNamespace(
+            returncode=0,
+            stdout=json.dumps({"metric": name, "value": 1.0}) + "\n",
+            stderr="")
+
+    monkeypatch.setattr(sp, "run", fake_run)
+    rows = bench.run_suite()
+    assert [r["metric"] for r in rows] == ["good", "boom", "tail"]
+    err = rows[1]
+    assert err["suite_row"] == "boom" and "dtype crash" in err["error"]
+    assert "value" not in err
+    # every row — including the error row — was printed as a JSON line
+    printed = [json.loads(ln) for ln in capsys.readouterr().out.splitlines()
+               if ln.startswith("{")]
+    assert len(printed) == 3 and printed[1]["error"]

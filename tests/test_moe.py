@@ -1,0 +1,277 @@
+"""MoE routing-stack unit tests (PR 9 satellite: ``parallel/moe.py`` had
+zero gate/capacity/balance coverage while the flagship started depending
+on it).  Everything here is CPU-fast — engine/trainer compiles live in
+``test_moe_serving.py`` (slow-marked)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_hackathon_tpu as paddle
+from paddle_hackathon_tpu import parallel
+from paddle_hackathon_tpu.core.tensor import Tensor
+from paddle_hackathon_tpu.parallel.moe import (GShardGate, MoELayer,
+                                               NaiveGate, SwitchGate,
+                                               _balance_loss,
+                                               moe_active_params,
+                                               moe_all_to_all)
+
+
+# ---------------------------------------------------------------- gates
+class TestGates:
+    def test_naive_route_topk_normalized(self):
+        g = NaiveGate(8, 4, topk=2)
+        logits = jnp.asarray(np.random.RandomState(0).randn(6, 4),
+                             jnp.float32)
+        vals, idx, aux = g.route(logits)
+        assert vals.shape == (6, 2) and idx.shape == (6, 2)
+        # top-2 gates renormalize to sum 1 (GShard combine weights)
+        np.testing.assert_allclose(np.asarray(vals.sum(-1)),
+                                   np.ones(6), rtol=1e-5)
+        # indices really are the top-k of the softmax
+        probs = np.asarray(jax.nn.softmax(logits, -1))
+        np.testing.assert_array_equal(np.asarray(idx[:, 0]),
+                                      probs.argmax(-1))
+        assert float(aux) == 0.0  # naive gate: no aux
+
+    def test_top1_keeps_raw_probability(self):
+        """Top-1 keeps the raw softmax prob (Switch): renormalizing a
+        single gate would pin it at 1.0."""
+        g = NaiveGate(8, 4, topk=1)
+        logits = jnp.asarray(np.random.RandomState(1).randn(5, 4),
+                             jnp.float32)
+        vals, idx, _ = g.route(logits)
+        probs = np.asarray(jax.nn.softmax(logits, -1))
+        np.testing.assert_allclose(np.asarray(vals[:, 0]),
+                                   probs.max(-1), rtol=1e-5)
+        assert (np.asarray(vals[:, 0]) < 1.0).all()
+
+    def test_top1_router_gradient_flows(self):
+        """The PR 9 regression fix: with top-1 renormalization the router
+        weight got gradient ONLY through the aux loss — the combine
+        weight was the constant 1.0.  The raw-prob combine must carry
+        output gradient back into the gate weight."""
+        paddle.seed(0)
+        layer = MoELayer(8, 16, num_experts=4, gate="switch",
+                         capacity_factor=4.0)
+        layer.eval()  # no jitter, no aux in the loss below
+        x = Tensor(np.random.RandomState(0).randn(6, 8).astype(np.float32),
+                   stop_gradient=False)
+        y = layer(x)
+        (y * y).sum().backward()
+        g = layer.gate.weight.grad
+        assert g is not None
+        assert float(np.abs(np.asarray(g._value)).max()) > 0.0
+
+    def test_gshard_noise_drops_second_expert(self):
+        g = GShardGate(8, 4, topk=2)
+        logits = jnp.asarray(np.random.RandomState(2).randn(6, 4),
+                             jnp.float32)
+        base, idx, aux = g.route(logits, noise=None)
+        assert float(aux) > 0.0  # load-balance aux armed
+        # noise >= 2*gate2 everywhere -> every second expert dropped
+        ones = jnp.ones((6,), jnp.float32) * 10.0
+        dropped, idx2, _ = g.route(logits, noise=ones)
+        np.testing.assert_array_equal(np.asarray(idx), np.asarray(idx2))
+        assert np.allclose(np.asarray(dropped[:, 1]), 0.0)
+        np.testing.assert_allclose(np.asarray(dropped[:, 0]),
+                                   np.asarray(base[:, 0]), rtol=1e-6)
+        # noise < 2*gate2 everywhere -> all kept
+        kept, _, _ = g.route(logits, noise=jnp.zeros((6,)) - 1.0)
+        np.testing.assert_allclose(np.asarray(kept), np.asarray(base),
+                                   rtol=1e-6)
+
+    def test_switch_gate_is_top1_with_jitter_knob(self):
+        g = SwitchGate(8, 4, jitter=0.02)
+        assert g.topk == 1 and g.jitter == 0.02 and g.aux
+
+    def test_route_runs_under_jit(self):
+        """Routing must trace cleanly inside the compiled step (the
+        PHT004 concern: no host randomness/branching in ``route``)."""
+        g = GShardGate(8, 4, topk=2)
+        logits = jnp.asarray(np.random.RandomState(3).randn(5, 4),
+                             jnp.float32)
+        noise = jnp.asarray(np.random.RandomState(4).rand(5), jnp.float32)
+        jitted = jax.jit(lambda lg, nz: g.route(lg, nz))
+        v1, i1, a1 = jitted(logits, noise)
+        v2, i2, a2 = g.route(logits, noise)
+        np.testing.assert_allclose(np.asarray(v1), np.asarray(v2),
+                                   rtol=1e-6)
+        np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+        np.testing.assert_allclose(float(a1), float(a2), rtol=1e-6)
+
+
+# ---------------------------------------------- capacity / balance loss
+class TestCapacityAndBalance:
+    def test_capacity_formula_and_floor(self):
+        layer = MoELayer(8, 16, num_experts=4, topk=2,
+                         capacity_factor=1.25)
+        # ceil(k * S * cf / E) with a floor of 4
+        assert layer.capacity(64) == int(np.ceil(2 * 64 * 1.25 / 4))
+        assert layer.capacity(1) == 4
+        layer2 = MoELayer(8, 16, num_experts=64, topk=1,
+                          capacity_factor=1.0)
+        assert layer2.capacity(8) == 4  # floor
+
+    def test_balance_loss_hand_value(self):
+        """E * sum_e mean(prob_e) * frac_e against a hand computation:
+        uniform probs with all top-1 on expert 0 -> E * (1/E * 1) = 1."""
+        E = 4
+        probs = jnp.full((8, E), 1.0 / E)
+        idx = jnp.zeros((8, 1), jnp.int32)
+        assert float(_balance_loss(probs, idx, E)) == pytest.approx(1.0)
+        # perfectly balanced top-1 assignment -> E * E*(1/E * 1/E) = 1
+        idx_b = jnp.arange(8, dtype=jnp.int32).reshape(8, 1) % E
+        assert float(_balance_loss(probs, idx_b, E)) == pytest.approx(1.0)
+        # skewed probs + skewed assignment exceed the balanced value
+        sk = jnp.asarray(np.eye(E)[np.zeros(8, np.int32)] * 0.97
+                         + 0.01, jnp.float32)
+        assert float(_balance_loss(sk, idx, E)) > 1.0
+
+    def test_training_drops_over_capacity_eval_is_dropless(self):
+        """Training: over-capacity tokens are DROPPED (zero MoE output
+        -> the block's residual passes them through unchanged).  The
+        SAME layer in eval: capacity = group size, nothing dropped."""
+        paddle.seed(0)
+        layer = MoELayer(4, 8, num_experts=2, gate="naive", topk=1,
+                         capacity_factor=0.0)  # floor C=4
+        layer.train()
+        # 16 identical tokens all route to one expert; capacity 4 keeps
+        # the first 4 slots and drops the rest
+        x = np.tile(np.random.RandomState(0).randn(1, 4), (16, 1)) \
+            .astype(np.float32)
+        y = np.asarray(layer(Tensor(x))._value)
+        nonzero = np.abs(y).sum(-1) > 1e-7
+        assert nonzero.sum() == 4 and nonzero[:4].all()
+        layer.eval()
+        y = np.asarray(layer(Tensor(x))._value)
+        assert (np.abs(y).sum(-1) > 1e-7).all()
+        # every row identical input -> identical output
+        np.testing.assert_allclose(y, np.tile(y[:1], (16, 1)), rtol=1e-5)
+
+
+# ----------------------------------------------------- grouped dispatch
+class TestGroupedDispatch:
+    def test_group_size_auto(self):
+        layer = MoELayer(4, 8, num_experts=2)
+        assert layer._group_size(8) == 8        # small: one group
+        assert layer._group_size(512) == 512
+        assert layer._group_size(4096) == 512   # bounded groups
+        assert layer._group_size(1536) == 512
+        assert layer._group_size(513) == 171    # largest divisor <= cap
+        assert layer._group_size(32769) == 331  # odd n stays bounded
+        assert layer._group_size(521) == 1      # prime: degrades, no err
+
+    def test_group_size_is_an_upper_bound_not_a_divisor(self):
+        """A training-tuned group_size must still serve: decode ticks
+        route n = batch tokens, far below (and not dividing) the
+        training group — clamp, never raise (code-review finding)."""
+        layer = MoELayer(4, 8, num_experts=2, group_size=512)
+        assert layer._group_size(8) == 8
+        assert layer._group_size(520) == 260    # divisor <= 512
+        layer.eval()
+        y = layer(Tensor(np.random.randn(8, 4).astype(np.float32)))
+        assert tuple(y.shape) == (8, 4)
+        with pytest.raises(ValueError, match=">= 1"):
+            MoELayer(4, 8, num_experts=2, group_size=0)._group_size(8)
+
+    def test_eval_grouping_and_batch_composition_invariance(self):
+        """Dropless eval: the SAME tokens produce the same outputs
+        whatever the grouping, and a token's output must not depend on
+        which OTHER rows share its batch — the slot-composition
+        invariance the serving engine's token-exactness rests on
+        (continuous batching: slots come and go)."""
+        paddle.seed(0)
+        layer = MoELayer(8, 16, num_experts=4, gate="naive", topk=2)
+        layer.eval()
+        x = np.random.RandomState(0).randn(16, 8).astype(np.float32)
+        y1 = np.asarray(layer(Tensor(x))._value)
+        layer.group_size = 4
+        y4 = np.asarray(layer(Tensor(x))._value)
+        layer.group_size = None
+        np.testing.assert_allclose(y1, y4, rtol=2e-5, atol=2e-6)
+        # batch-composition: the first 4 rows alone vs riding with the
+        # rest of the batch
+        ya = np.asarray(layer(Tensor(x[:4]))._value)
+        np.testing.assert_allclose(ya, y1[:4], rtol=2e-5, atol=2e-6)
+        # and in TRAINING, with capacity ample enough that nothing
+        # drops, grouping is a pure reshape — same outputs either way
+        layer.train()
+        layer.capacity_factor = 8.0
+        t1 = np.asarray(layer(Tensor(x))._value)
+        layer.group_size = 4
+        t4 = np.asarray(layer(Tensor(x))._value)
+        np.testing.assert_allclose(t1, t4, rtol=2e-5, atol=2e-6)
+
+
+# --------------------------------------------------- helpers / plumbing
+class TestHelpers:
+    def test_moe_all_to_all_is_the_dispatch_reshard(self):
+        """The explicit 'ep' all_to_all (the global_scatter analog) must
+        carry the global values unchanged while moving the sharded dim
+        from concat_axis to split_axis — the exchange GSPMD inserts
+        around the capacity einsums."""
+        mesh = parallel.create_mesh({"ep": 2}, devices=jax.devices()[:2])
+        try:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            x = np.arange(4 * 6 * 3, dtype=np.float32).reshape(4, 6, 3)
+            xd = jax.device_put(
+                jnp.asarray(x), NamedSharding(mesh, P(None, "ep", None)))
+            out = moe_all_to_all(xd, mesh, axis="ep", split_axis=0,
+                                 concat_axis=1)
+            np.testing.assert_array_equal(np.asarray(out), x)
+            assert out.sharding.spec[0] == "ep"
+            # and the gather direction (combine) reshards back
+            back = moe_all_to_all(out, mesh, axis="ep", split_axis=1,
+                                  concat_axis=0)
+            np.testing.assert_array_equal(np.asarray(back), x)
+            assert back.sharding.spec[1] == "ep"
+        finally:
+            parallel.set_mesh(None)
+
+    def test_moe_active_params_counts(self):
+        from paddle_hackathon_tpu.models import GPTForCausalLM
+        from paddle_hackathon_tpu.models.gpt import GPTConfig
+        paddle.seed(0)
+        kw = dict(vocab_size=64, hidden_size=32, num_layers=2,
+                  num_heads=2, max_position_embeddings=32,
+                  use_flash_attention=False)
+        dense = GPTForCausalLM(GPTConfig(**kw))
+        a0, t0 = moe_active_params(dense)
+        assert a0 == t0 == dense.num_params()
+        # 4 experts of ffn 2h at top-2 activate the params of the dense
+        # 4h MLP: active ~= dense total within the router weights and
+        # per-expert bias slack
+        moe = GPTForCausalLM(GPTConfig(
+            moe_num_experts=4, moe_topk=2, moe_gate="naive",
+            intermediate_size=64, **kw))
+        a1, t1 = moe_active_params(moe)
+        assert t1 == moe.num_params() and a1 < t1
+        assert abs(a1 - t0) / t0 < 0.02
+
+    def test_moe_every_n_interleaves_blocks(self):
+        from paddle_hackathon_tpu.models import GPTForCausalLM
+        from paddle_hackathon_tpu.models.gpt import GPTConfig
+        from paddle_hackathon_tpu.models.gpt import GPTMLP
+        paddle.seed(0)
+        cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=4,
+                        num_heads=2, max_position_embeddings=32,
+                        use_flash_attention=False,
+                        moe_num_experts=2, moe_every_n=2)
+        m = GPTForCausalLM(cfg)
+        kinds = [type(b.mlp) for b in m.gpt.blocks]
+        assert kinds == [GPTMLP, MoELayer, GPTMLP, MoELayer]
+        # pipeline stacking needs homogeneous blocks — named error
+        with pytest.raises(ValueError, match="moe_every_n"):
+            m.pipeline_stage_spec()
+
+    def test_param_sharding_spec_moe_names(self):
+        from paddle_hackathon_tpu.models import param_sharding_spec
+        assert param_sharding_spec("gpt.blocks.0.mlp.w1",
+                                   (4, 8, 16)) == ("ep", None, "mp")
+        assert param_sharding_spec("gpt.blocks.0.mlp.w2",
+                                   (4, 16, 8)) == ("ep", "mp", None)
+        assert param_sharding_spec("gpt.blocks.0.mlp.gate.weight",
+                                   (8, 4)) == (None, None)

@@ -158,3 +158,63 @@ def test_host_timed_device_metric_fails_suite():
              "value": 9000.0, "timing": "device"}]
     assert perf_gate.compare_timing_fallbacks(rows) == [
         "gpt2_serving_8stream_device_tokens_per_sec_per_chip"]
+
+
+def test_suite_has_moe_rows():
+    import bench
+    assert "gpt2_moe" in bench.SUITE
+    assert "serving_moe" in bench.SUITE
+
+
+def test_error_rows_fail_suite_loudly(monkeypatch, tmp_path):
+    """A crashed suite row (bench.py run_suite records {"error": ...}
+    instead of aborting the sweep) must be a NAMED gate failure — and
+    must not crash the other comparators that expect "value"."""
+    rows = [{"metric": "m1", "value": 100.0},
+            {"metric": "gpt2_moe", "suite_row": "gpt2_moe",
+             "error": "ValueError: dtype crash (rc=1)"}]
+    bad = perf_gate.compare_error_rows(rows)
+    assert len(bad) == 1 and bad[0][0] == "gpt2_moe"
+    assert "dtype crash" in bad[0][1]
+    # the valueless row must not break the other comparators
+    assert perf_gate.compare_ratios(rows) == []
+    assert perf_gate.compare_suite({"m1": 100.0}, rows, 0.07) == []
+    snap = tmp_path / "model_bench_baseline.json"
+    snap.write_text(json.dumps({"m1": 100.0}))
+    monkeypatch.setattr(perf_gate, "MODEL_SNAPSHOT", str(snap))
+    assert perf_gate.suite_gate(0.07, rows=rows) == 1
+    assert perf_gate.suite_gate(0.07, rows=rows[:1]) == 0
+
+
+def test_moe_active_ratio_gate():
+    """The MoE flagship row embeds its SAME-RUN dense-reference ratio at
+    matched active params (vs_dense_active_params); the gate holds it
+    >= 0.6x on device AND host-timed (CPU smoke) runs alike."""
+    row = {"metric": "gpt2_moe_pretrain_tokens_per_sec_cpu_smoke",
+           "value": 4000.0, "vs_dense_active_params": 0.55}
+    bad = perf_gate.compare_moe_active_ratio([row])
+    assert bad == [(row["metric"], 0.55)]
+    row["vs_dense_active_params"] = 0.72
+    assert perf_gate.compare_moe_active_ratio([row]) == []
+    # rows without the key (every non-MoE row) are skipped
+    assert perf_gate.compare_moe_active_ratio([{"metric": "x",
+                                                "value": 1.0}]) == []
+
+
+def test_ratio_gate_holds_moe_serving_to_dense():
+    """serving_moe runs the IDENTICAL workload as the dense serving row
+    (same streams/prompt/new_tokens), so a cross-row floor is sound
+    there; gpt2_moe deliberately has NO cross-row gate (different batch
+    size vs the headline row) — its matched-config gate is the embedded
+    vs_dense_active_params ratio."""
+    assert not any(m.startswith("gpt2_moe_pretrain")
+                   for m, _, _ in perf_gate.RATIO_GATES)
+    rows = [{"metric": "gpt2_serving_8stream_device_tokens_per_sec_per_chip",
+             "value": 10000.0},
+            {"metric":
+             "gpt2_moe_serving_8stream_device_tokens_per_sec_per_chip",
+             "value": 2000.0}]
+    bad = perf_gate.compare_ratios(rows)
+    assert len(bad) == 1 and bad[0][0].startswith("gpt2_moe_serving")
+    rows[1]["value"] = 2600.0    # >= 0.25x
+    assert perf_gate.compare_ratios(rows) == []
