@@ -508,11 +508,18 @@ def bench_hapi_fit(seqlen=1024, batch=32, steps=48, warmup=8, k=8):
            "value": round(value, 1), "unit": "tokens/s"}
     fam = reg.get("train_step_seconds")
     series = [c for c in fam.children() if c.count] if fam else []
+    mfu_fam = reg.get("train_mfu")
+    mfu = [c.value for c in mfu_fam.children()
+           if dict(c.labels).get("path") == "hapi_compiled"] \
+        if mfu_fam else []
     row["metrics"] = {
         "jit_builds_total": int(reg.total("jit_builds_total",
                                           site="hapi.compiled_trainer")),
         "step_p50_ms": round(series[0].quantile(0.5) * 1e3, 3)
         if series else None,
+        # set only where cost_model.device_peak_flops knows the chip
+        # (or PHT_PEAK_FLOPS pins it); None on this CPU container
+        "mfu": round(mfu[0], 4) if mfu else None,
     }
     return row
 
@@ -743,12 +750,28 @@ def bench_serving(streams=8, prompt=64, new_tokens=128, chunk=32, spec_k=0,
     # telemetry snapshot for tools/perf_gate.py: builds growing past the
     # warm phase = the tick recompiled mid-run (the regression tripwire);
     # the latency percentiles ride along for the record
+    def _slo_ms(name, q):
+        # rolling-window percentile from the request-level SLO telemetry
+        # (the /load report's source); None (not NaN — invalid JSON)
+        # when the window saw nothing
+        h = eng._slo[name]
+        return round(h.quantile(q) * 1e3, 3) if h.count else None
+
+    gp = eng.load_report()["goodput"]
     row["metrics"] = {
         "jit_builds_warm": builds_warm,
         "jit_builds_total": builds(),
         "ttft_p50_ms": round(eng._h_ttft.quantile(0.5) * 1e3, 3),
         "tpot_p50_ms": round(eng._h_tpot.quantile(0.5) * 1e3, 3),
         "e2e_p50_ms": round(eng._h_e2e.quantile(0.5) * 1e3, 3),
+        # SLO-trajectory fields (extra JSON only — no gate reads them):
+        # p50/p99 from the rolling windows + goodput, so the bench
+        # history grows an SLO record alongside tokens/s
+        "slo_ttft_p50_ms": _slo_ms("ttft", 0.5),
+        "slo_ttft_p99_ms": _slo_ms("ttft", 0.99),
+        "slo_tpot_p50_ms": _slo_ms("tpot", 0.5),
+        "slo_tpot_p99_ms": _slo_ms("tpot", 0.99),
+        "goodput": gp["ratio"],
         "ticks": eng.stats["ticks"],
     }
     if quant is not None:

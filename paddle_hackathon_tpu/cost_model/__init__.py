@@ -1,5 +1,8 @@
-"""paddle.cost_model (ref ``python/paddle/cost_model/__init__.py``)."""
+"""paddle.cost_model (ref ``python/paddle/cost_model/__init__.py``) —
+plus the analytic FLOPs/peak helpers behind the trainers' MFU gauges
+(docs/OBSERVABILITY.md, "Trainer MFU and step-phase attribution")."""
 
-from .cost_model import CostModel  # noqa: F401
+from .cost_model import (CostModel, device_peak_flops,  # noqa: F401
+                         train_flops_per_token)
 
-__all__ = ["CostModel"]
+__all__ = ["CostModel", "train_flops_per_token", "device_peak_flops"]

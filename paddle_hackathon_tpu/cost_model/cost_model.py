@@ -7,6 +7,14 @@ times measured on the TPU chip this framework targets (see
 ``paddle_gpu_time`` holding the measured device time in ms).
 ``profile_measure`` runs a program through the real executor under the
 profiler and reports measured cost.
+
+This module additionally owns the ANALYTIC accounting the trainer's MFU
+telemetry reads (``Model.fit`` / ``auto_parallel.Engine`` —
+docs/OBSERVABILITY.md): :func:`train_flops_per_token` (PaLM-appendix
+``6N (+ 12·L·h·s)``, MoE-aware — ACTIVE params only) and
+:func:`device_peak_flops` (per-chip peak from the device kind, env-
+overridable), so every loop divides by the same denominator instead of
+growing private FLOPs formulas.
 """
 
 from __future__ import annotations
@@ -17,7 +25,72 @@ import re
 
 import numpy as np
 
-__all__ = ["CostModel"]
+__all__ = ["CostModel", "train_flops_per_token", "device_peak_flops"]
+
+
+def train_flops_per_token(network, seqlen=None) -> float:
+    """Analytic training FLOPs per token: ``6 * N_active`` (fwd + bwd,
+    the PaLM MFU accounting) plus the attention score/value term
+    ``12 * L * h * s`` when ``seqlen`` and a GPT-shaped config are
+    known.  ``N_active`` is MoE-aware — each MoE layer's expert stacks
+    count at ``topk / num_experts`` of their size
+    (``parallel.moe.moe_active_params``): a top-2-of-8 MoE step does
+    NOT execute 8 experts' FLOPs per token, and counting total params
+    would overstate MFU by the inverse sparsity.  Pure host shape math
+    (no device sync); works for any ``Layer`` (non-GPT nets simply get
+    the 6N term)."""
+    from ..parallel.moe import moe_active_params
+    active, _ = moe_active_params(network)
+    flops = 6.0 * float(active)
+    cfg = getattr(network, "config", None)
+    layers = getattr(cfg, "num_layers", None)
+    hidden = getattr(cfg, "hidden_size", None)
+    if seqlen and layers and hidden:
+        # QK^T + AV are 4*L*h*s MACs/token fwd -> x3 for fwd+bwd
+        flops += 12.0 * float(layers) * float(hidden) * float(seqlen)
+    return flops
+
+
+# Per-chip peak dense-matmul FLOPs/s by (lowercased) device kind — bf16
+# numbers, the training dtype this framework targets.  Substring match:
+# jax reports kinds like "TPU v4", "TPU v5 lite", "TPU v5p chip".
+_PEAK_FLOPS_BY_KIND = (
+    ("v6e", 918e12), ("trillium", 918e12),
+    ("v5p", 459e12),
+    ("v5 lite", 197e12), ("v5e", 197e12),
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 45e12),
+)
+
+
+def device_peak_flops():
+    """Per-chip peak FLOPs/s for MFU accounting, or None when unknown
+    (the MFU gauge then simply isn't set — a made-up denominator is
+    worse than no number).  Resolution order: the ``PHT_PEAK_FLOPS``
+    env override (authoritative — lets operators account for a clocked-
+    down pod, and tests pin a denominator on CPU), then the device-kind
+    table above."""
+    env = os.environ.get("PHT_PEAK_FLOPS")
+    if env:
+        try:
+            return float(env)
+        except ValueError:
+            # a typo'd override must not SILENTLY disable MFU on a chip
+            # the table knows: warn once and fall through to the table
+            import warnings
+            warnings.warn(
+                f"PHT_PEAK_FLOPS={env!r} is not a number; falling back "
+                "to the device-kind table", stacklevel=2)
+    try:
+        import jax
+        kind = jax.devices()[0].device_kind.lower()
+    except Exception:
+        return None
+    for key, peak in _PEAK_FLOPS_BY_KIND:
+        if key in kind:
+            return peak
+    return None
 
 # configs carry either the reference's long dtype spelling
 # ("dtype: float32") or this build's compact form ("x f32 [...]",

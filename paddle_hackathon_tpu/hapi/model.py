@@ -328,19 +328,80 @@ class Model:
             "training throughput between loss fetches "
             "(tokens = batch x seqlen; batch for 1-D samples)").labels(
                 path="hapi_compiled")
+        # MFU + step-phase attribution (docs/OBSERVABILITY.md, "Trainer
+        # MFU and step-phase attribution"): both derive ONLY from
+        # timestamps the loop already takes — the program-call wall
+        # (dispatch), the log_freq fetch wall (host wait), and the
+        # window wall between fetches — so arming them adds no host
+        # sync to the step loop.
+        _phase_fam = _reg.gauge(
+            "train_phase_seconds_per_step",
+            "mean wall seconds per step attributed to each step phase "
+            "over the last telemetry window (dispatch = Python program "
+            "calls, host_wait = loss-fetch stalls, device = the "
+            "remainder the async pipeline overlapped)", unit="s")
+        _g_phase = {ph: _phase_fam.labels(path="hapi_compiled", phase=ph)
+                    for ph in ("dispatch", "host_wait", "device")}
+        from ..cost_model import device_peak_flops, train_flops_per_token
+        # ONE chip's peak: the hapi compiled trainer is an unsharded
+        # jax.jit — it executes on the default device only, so a
+        # device_count multiplier would understate MFU by the host's
+        # chip count (the sharded auto_parallel.Engine scales by its
+        # OWN mesh size instead).  The gauge child is created only when
+        # the peak is known — an eager child would export
+        # train_mfu=0.0 (alarm-worthy) where the honest answer is
+        # "unknown" (docs: unset).
+        _peak = device_peak_flops()
+        _g_mfu = _reg.gauge(
+            "train_mfu",
+            "model FLOPs utilization between loss fetches "
+            "(analytic cost_model.train_flops_per_token x tokens/s over "
+            "device_peak_flops; MoE-active-params-aware; unset when the "
+            "chip peak is unknown)").labels(path="hapi_compiled") \
+            if _peak else None
+        _flops_tok = None      # resolved lazily (needs the seqlen)
+        _seqlen = None
         _t_mark = None
         _steps_since = _tokens_since = 0
+        _disp_ns = _fetch_ns = 0
 
         def _telemetry_tick():
-            nonlocal _t_mark, _steps_since, _tokens_since
+            """Close the current telemetry window; returns the phase/
+            MFU attribution dict (for the loss_fetch span) or None on
+            the first window (compile time must pollute neither the
+            step histogram nor the phase split)."""
+            nonlocal _t_mark, _steps_since, _tokens_since, _disp_ns, \
+                _fetch_ns, _flops_tok
             _tr.heartbeat("train.hapi_fit")   # /healthz last-step recency
             now = time.perf_counter()
+            out = None
             if _t_mark is not None and _steps_since:
                 dt = now - _t_mark
                 if dt > 0:
-                    _h_step.observe(dt / _steps_since)
-                    _g_tps.set(_tokens_since / dt)
+                    per_step = dt / _steps_since
+                    _h_step.observe(per_step)
+                    tps = _tokens_since / dt
+                    _g_tps.set(tps)
+                    disp = _disp_ns / 1e9 / _steps_since
+                    wait = _fetch_ns / 1e9 / _steps_since
+                    dev = max(per_step - disp - wait, 0.0)
+                    _g_phase["dispatch"].set(disp)
+                    _g_phase["host_wait"].set(wait)
+                    _g_phase["device"].set(dev)
+                    out = {"steps": _steps_since,
+                           "dispatch_ms_per_step": round(disp * 1e3, 3),
+                           "host_wait_ms_per_step": round(wait * 1e3, 3),
+                           "device_ms_per_step": round(dev * 1e3, 3)}
+                    if _peak:
+                        if _flops_tok is None:
+                            _flops_tok = train_flops_per_token(
+                                self.network, seqlen=_seqlen)
+                        mfu = tps * _flops_tok / _peak
+                        _g_mfu.set(mfu)
+                        out["mfu"] = round(mfu, 4)
             _t_mark, _steps_since, _tokens_since = now, 0, 0
+            _disp_ns = _fetch_ns = 0
+            return out
 
         k = max(int(k), 1)
         it = iter(loader)
@@ -414,23 +475,30 @@ class Model:
                     if self.stop_training:
                         break
                 return logs, None
+            t1n = time.perf_counter_ns()
             if _tr.tracing_enabled():
                 # dispatch wall of the K-step donated program (first call
                 # includes trace+compile; the async device time shows up
                 # in the loss_fetch spans instead)
-                _tr.add_span("hapi.fit.superstep", t0n,
-                             time.perf_counter_ns(), step=step, k=k)
+                _tr.add_span("hapi.fit.superstep", t0n, t1n, step=step, k=k)
             lead = jax.tree.leaves(xs)[0]   # (K, B, ...) stacked batches
             # tokens = B*S only for token batches (K, B, S); any other
             # rank (vision NCHW etc.) counts samples — shape[2] would be
             # a channel count, not a sequence length
-            toks_per_step = int(lead.shape[1]) * (
-                int(lead.shape[2]) if lead.ndim == 3 else 1)
+            _seqlen = int(lead.shape[2]) if lead.ndim == 3 else None
+            toks_per_step = int(lead.shape[1]) * (_seqlen or 1)
             n = int(losses.shape[0])
+            # phase attribution: amortize the K-step program-call wall
+            # over its K inner steps — a telemetry window closing MID-
+            # superstep (log_freq % k != 0, the default shapes) must
+            # get dispatch time proportional to the steps it contains,
+            # not a whole superstep's wall dumped into one window
+            disp_step_ns = (t1n - t0n) / n
             for j in range(n):
                 cbk.on_train_batch_begin(step)
                 _steps_since += 1
                 _tokens_since += toks_per_step
+                _disp_ns += disp_step_ns
                 # async loss fetch: the scalar leaves the device only at
                 # log_freq boundaries — other steps hand callbacks the
                 # device scalar (float()-able on demand)
@@ -438,11 +506,16 @@ class Model:
                 if log_freq and step % log_freq == 0:
                     tf0 = time.perf_counter_ns()
                     v = float(v)
+                    tf1 = time.perf_counter_ns()
+                    _fetch_ns += tf1 - tf0   # phase: host wait on fetch
+                    phases = _telemetry_tick()
                     if _tr.tracing_enabled():
                         # host wait for the async device pipeline to
-                        # deliver this step's loss scalar
-                        _tr.add_span("hapi.fit.loss_fetch", tf0,
-                                     time.perf_counter_ns(), step=step)
+                        # deliver this step's loss scalar — carrying the
+                        # closed window's phase/MFU attribution so the
+                        # trace answers "where did this window go"
+                        _tr.add_span("hapi.fit.loss_fetch", tf0, tf1,
+                                     step=step, **(phases or {}))
                     self._watch_nonfinite(v, step, "hapi_compiled",
                                           nan_policy)
                     if trainer.last_aux is not None:
@@ -453,7 +526,6 @@ class Model:
                         self._observe_moe_aux(
                             float(trainer.last_aux[j]), "hapi_compiled")
                     last_watched = step
-                    _telemetry_tick()
                 logs = {"loss": v}
                 cbk.on_train_batch_end(step, logs)
                 step += 1
@@ -468,11 +540,13 @@ class Model:
             losses, j = last
             tf0 = time.perf_counter_ns()
             jax.block_until_ready(losses)
+            tf1 = time.perf_counter_ns()
+            _fetch_ns += tf1 - tf0
+            phases = _telemetry_tick()
             if _tr.tracing_enabled():
-                _tr.add_span("hapi.fit.loss_fetch", tf0,
-                             time.perf_counter_ns(), step=step - 1,
-                             epoch_end=True)
-            _telemetry_tick()
+                _tr.add_span("hapi.fit.loss_fetch", tf0, tf1,
+                             step=step - 1, epoch_end=True,
+                             **(phases or {}))
             logs = {"loss": float(losses[j])}
             if step - 1 != last_watched:
                 # skip when the final step already hit a log_freq fetch:

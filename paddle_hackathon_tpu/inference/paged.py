@@ -52,6 +52,17 @@ def pages_for(need: int, reserve: int, page_size: int) -> int:
     return last_row // page_size + 1
 
 
+def tokens_admittable(free_pages: int, reserve: int, page_size: int) -> int:
+    """Largest committed-row need (``prompt + max_new``) a SINGLE fresh
+    request could reserve from ``free_pages`` — the exact inverse of
+    :func:`pages_for`, published as the ``/load`` report's paged
+    admission headroom so a router can answer "would THIS request fit
+    here right now" without replaying the allocator.  0 when even a
+    1-token request would not fit (the write window alone exceeds the
+    free pool)."""
+    return max(0, int(free_pages) * int(page_size) - int(reserve) + 1)
+
+
 class PagePool:
     """Free-list page allocator with refcounts.
 

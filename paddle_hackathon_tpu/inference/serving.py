@@ -216,12 +216,23 @@ class Request:
     """One in-flight generation request.
 
     ``temperature``/``top_k``/``top_p`` override the engine-global
-    sampling defaults for this request only (None = inherit)."""
+    sampling defaults for this request only (None = inherit).
+
+    ``lifecycle`` is the request's SLO record — one flat dict stamped at
+    each stage (submit → admit → first token → per-tick decode → finish
+    or abort), the per-request ground truth behind the rolling window
+    percentiles in :meth:`ServingEngine.load_report`.  Times are
+    ``time.perf_counter()`` values (the engine's monotonic clock);
+    derived durations (``queue_s``/``ttft_s``/``tpot_s``/``e2e_s``)
+    land next to them so callers never re-derive.  Plain data on the
+    request object, NOT metric labels: per-request ids as labels would
+    mint one time series per request and grow the registry without
+    bound (pht-lint PHT005)."""
 
     __slots__ = ("prompt", "max_new_tokens", "tokens", "done", "error",
                  "temperature", "top_k", "top_p", "_event",
                  "_t_submit", "_t_first", "rid", "_span_queue",
-                 "_span_life")
+                 "_span_life", "lifecycle", "_tick_mark")
 
     def __init__(self, prompt, max_new_tokens, temperature=None,
                  top_k=None, top_p=None):
@@ -237,6 +248,12 @@ class Request:
         self._event = threading.Event()
         self._t_submit = time.perf_counter()   # TTFT/e2e reference point
         self._t_first: Optional[float] = None  # first generated token
+        # (last commit time, tokens then) — the per-tick TPOT sample base
+        self._tick_mark: Optional[tuple] = None
+        self.lifecycle = {"rid": self.rid,
+                          "prompt_len": int(self.prompt.shape[0]),
+                          "max_new_tokens": self.max_new_tokens,
+                          "t_submit": self._t_submit}
         # lifecycle spans (no-ops while tracing is disabled): queued =
         # submit->admit, life = submit->finish/EOS
         self._span_queue = self._span_life = _tr._NOOP
@@ -252,6 +269,22 @@ class Request:
         if not self.done:
             raise RuntimeError("request not finished; wait() first")
         return np.concatenate([self.prompt, np.asarray(self.tokens, np.int32)])
+
+
+class _LoadDebugSource:
+    """Adapter publishing an engine's :meth:`ServingEngine.load_report`
+    through the ``/debug/requests`` introspection registry (as
+    ``"<engine>.load"``) so the capacity document is inspectable from
+    the debug surface too, not only the router-facing ``/load``.  The
+    engine holds the strong reference; the registry holds it weakly."""
+
+    __slots__ = ("_engine", "__weakref__")
+
+    def __init__(self, engine):
+        self._engine = engine
+
+    def introspect_requests(self) -> dict:
+        return self._engine.load_report()
 
 
 class _Slot:
@@ -317,13 +350,18 @@ class ServingEngine:
         system prompt) maps the same physical pages and prefills only
         its suffix (copy-on-write by recompute: the shared tail page is
         re-prefilled privately, so shared pages are never written).
+      slo_window_s: span of the rolling TTFT/TPOT/e2e/queue-wait
+        percentile windows :meth:`load_report` (and the ``/load``
+        endpoint) publishes — "p99 over the last N seconds", the signal
+        a least-loaded router dispatches on (docs/OBSERVABILITY.md,
+        "SLO telemetry and the /load report").
     """
 
     def __init__(self, model, max_slots=8, max_len=512, chunk=16,
                  temperature=0.0, top_k=None, eos_token_id=None,
                  auto_run=True, decode_window=8, top_p=None, spec_k=0,
                  drafter="ngram", cache_mode="dense", page_size=16,
-                 num_pages=None, prefix_cache=True):
+                 num_pages=None, prefix_cache=True, slo_window_s=60.0):
         import jax
         import jax.numpy as jnp
 
@@ -399,6 +437,13 @@ class ServingEngine:
             # eager forwards pay the per-layer entropy/load arithmetic.
             for l in moe_layers:
                 l.collect_router_stats = True
+        self._slo_window_s = float(slo_window_s)
+        # weight-only quantized serving flag for the /load mode block —
+        # by class NAME so the (Pallas-importing) quant module stays off
+        # the unquantized engine's import path
+        self._quantized = any(
+            type(l).__name__ == "WeightOnlyLinear"
+            for l in model.sublayers(include_self=True))
         self._init_metrics()
         self._key = jax.random.key(0)
 
@@ -480,6 +525,17 @@ class ServingEngine:
             "prompt_tokens": reg.counter(
                 "serving_prompt_tokens_total",
                 "prompt tokens of admitted requests (all cache modes)"),
+            # goodput pair: generated tokens that reached a COMPLETED
+            # request vs tokens burned on requests the engine failed
+            # (loop crash fail-all) — completed/(completed+aborted) is
+            # the /load report's goodput ratio
+            "completed_tokens": reg.counter(
+                "serving_completed_tokens_total",
+                "generated tokens of requests that finished"),
+            "aborted_tokens": reg.counter(
+                "serving_aborted_tokens_total",
+                "generated tokens of requests that failed/aborted "
+                "(work the caller never got)"),
         }
         self._c = {k: fam.labels(**lbl) for k, fam in counters.items()}
         self.stats = _EngineStats(self._c)
@@ -550,6 +606,22 @@ class ServingEngine:
         # dropped engine vanishes from the endpoint)
         self._flight = _flight.get_flight_recorder()
         _tr.register_introspection_source(self._engine_id, self)
+        # rolling SLO windows (NOT registry families: per-engine working
+        # state, no labels, exact "last N seconds" semantics the
+        # lifetime histograms cannot give) — the percentile source for
+        # load_report()/the /load endpoint.  queue_wait feeds at admit,
+        # ttft at first token, tpot per decode tick, e2e at finish.
+        self._slo = {k: _obs.SlidingWindowHistogram(
+            window_s=self._slo_window_s)
+            for k in ("ttft", "tpot", "e2e", "queue_wait")}
+        # /load registration: the engine IS its own load source, and the
+        # same report rides /debug/requests under "<eid>.load" via a
+        # strongly-held adapter (both registries are weak — a dropped
+        # engine vanishes from the endpoints without unregister)
+        _tr.register_load_source(self._engine_id, self)
+        self._load_debug = _LoadDebugSource(self)
+        _tr.register_introspection_source(f"{self._engine_id}.load",
+                                          self._load_debug)
 
     # ------------------------------------------------------------------
     @staticmethod
@@ -1162,11 +1234,18 @@ class ServingEngine:
             raise ValueError(
                 f"request needs {need} positions; the model's "
                 f"max_position_embeddings is {max_pos}")
+        # _tid=rid puts every span of one request — lifecycle, queued,
+        # and the per-tick prefill/decode/verify shares below — on ONE
+        # chrome-trace lane, so a request reads as a single swimlane
+        # from submit to finish (slots are reused across requests, so a
+        # slot-keyed lane would interleave strangers)
         req._span_life = _tr.start_span(
-            "serving.request", rid=req.rid, engine=self._engine_id,
+            "serving.request", _tid=req.rid, rid=req.rid,
+            engine=self._engine_id,
             prompt_len=len(req.prompt), max_new=req.max_new_tokens)
         req._span_queue = _tr.start_span(
-            "serving.request.queued", rid=req.rid, engine=self._engine_id)
+            "serving.request.queued", _tid=req.rid, rid=req.rid,
+            engine=self._engine_id)
         self._flight.record(
             "req", phase="submit", rid=req.rid, engine=self._engine_id,
             prompt_len=len(req.prompt), max_new=req.max_new_tokens)
@@ -1229,11 +1308,14 @@ class ServingEngine:
             self._c["prompt_tokens"].inc(len(req.prompt))
             if skip and self._spec is not None:
                 replays.append((i, req, skip))
+            now = time.perf_counter()
+            queue_s = now - req._t_submit
+            req.lifecycle.update(t_admit=now, queue_s=queue_s, slot=i)
+            self._slo["queue_wait"].observe(queue_s)
             req._span_queue.end(slot=i)
             self._flight.record(
                 "req", phase="admit", rid=req.rid, engine=self._engine_id,
-                slot=i, prefix_hit=skip,
-                queue_s=round(time.perf_counter() - req._t_submit, 6))
+                slot=i, prefix_hit=skip, queue_s=round(queue_s, 6))
         return replays
 
     def _paged_admit_locked(self, i, req):
@@ -1376,16 +1458,42 @@ class ServingEngine:
         if self._paged:
             self._release_pages_locked(slot_idx)
         now = time.perf_counter()
-        self._h_e2e.observe(now - req._t_submit)
+        e2e = now - req._t_submit
+        self._h_e2e.observe(e2e)
+        self._slo["e2e"].observe(e2e)
+        self._c["completed_tokens"].inc(len(req.tokens))
+        req.lifecycle.update(t_finish=now, e2e_s=e2e,
+                             tokens=len(req.tokens), aborted=False)
         if req._t_first is not None and len(req.tokens) > 1:
-            self._h_tpot.observe(
-                (now - req._t_first) / (len(req.tokens) - 1))
+            tpot = (now - req._t_first) / (len(req.tokens) - 1)
+            self._h_tpot.observe(tpot)
+            req.lifecycle["tpot_s"] = tpot
         req._span_life.end(slot=slot_idx, tokens=len(req.tokens))
         self._flight.record(
             "req", phase="finish", rid=req.rid, engine=self._engine_id,
             slot=slot_idx, tokens=len(req.tokens),
             e2e_s=round(now - req._t_submit, 6))
         req._event.set()
+
+    def _tick_progress(self, req, t_ns):
+        """Per-tick TPOT sample for one request: this tick committed
+        ``len(req.tokens) - n_prev`` tokens since the mark at ``t_prev``,
+        so the rolling window sees ``(t - t_prev) / committed`` — the
+        per-token decode latency of THIS tick, not the request-lifetime
+        mean (a mid-run slowdown shifts the /load p99 within one window,
+        where the lifetime mean would launder it).  The tick that
+        produced the FIRST token only plants the mark (that latency is
+        TTFT's); called once per slot per tick, host floats only."""
+        if req._t_first is None:
+            return
+        t = t_ns / 1e9   # perf_counter_ns and perf_counter share a clock
+        n = len(req.tokens)
+        mark = req._tick_mark
+        if mark is not None:
+            t_prev, n_prev = mark
+            if n > n_prev and t > t_prev:
+                self._slo["tpot"].observe((t - t_prev) / (n - n_prev))
+        req._tick_mark = (t, n)
 
     def _commit_token(self, i, tok):
         """Record slot i's sampled token; returns True if the request
@@ -1394,7 +1502,10 @@ class ServingEngine:
         req = slot.req
         if not req.tokens:
             req._t_first = time.perf_counter()
-            self._h_ttft.observe(req._t_first - req._t_submit)
+            ttft = req._t_first - req._t_submit
+            req.lifecycle.update(t_first_token=req._t_first, ttft_s=ttft)
+            self._h_ttft.observe(ttft)
+            self._slo["ttft"].observe(ttft)
         req.tokens.append(tok)
         slot.last = tok
         self._c["tokens"].inc()
@@ -1510,7 +1621,7 @@ class ServingEngine:
             with self._lock:
                 self._tickno += 1
                 self._c["ticks"].inc()
-                committed = self._commit_pp_exit_locked(exit_wave, nxt)
+                committed = self._commit_pp_exit_locked(exit_wave, nxt, t1n)
                 self._after_tick("pp", t0n, t1n, committed,
                                  exit_wave=int(exit_wave))
             return True
@@ -1547,8 +1658,9 @@ class ServingEngine:
                 for i, slot in enumerate(self._slots):
                     if slot.req is None:
                         continue
-                    rid = slot.req.rid
-                    rem = slot.req.max_new_tokens - len(slot.req.tokens)
+                    req = slot.req   # _commit_token may free the slot
+                    rid = req.rid
+                    rem = req.max_new_tokens - len(req.tokens)
                     adv = int(acc[i]) + 1
                     nvalid[i] = adv
                     self._lengths[i] += adv
@@ -1557,6 +1669,7 @@ class ServingEngine:
                         committed += 1
                         if self._commit_token(i, int(out[i, t])):
                             break  # freed; later accepted tokens discarded
+                    self._tick_progress(req, t1n)
                     # count only what the commit loop could use: the
                     # request budget (rem) bounds drafts, and the commit
                     # count additionally bounds accepted (EOS truncation)
@@ -1571,9 +1684,10 @@ class ServingEngine:
                     tick_committed += committed
                     if tron:
                         # each slot's share of the fused verify tick on
-                        # its own lane: request id + acceptance outcome
+                        # the REQUEST's lane (_tid=rid: one request, one
+                        # swimlane): request id + acceptance outcome
                         _tr.add_span("serving.spec_verify", t0n, t1n,
-                                     _tid=i, rid=rid, slot=i, drafted=d,
+                                     _tid=rid, rid=rid, slot=i, drafted=d,
                                      accepted=a, committed=committed)
                 if tick_drafted:
                     self._h_accept.observe(tick_accepted / tick_drafted)
@@ -1600,16 +1714,18 @@ class ServingEngine:
                 for i, slot in enumerate(self._slots):
                     if slot.req is None:
                         continue
-                    rid = slot.req.rid
+                    req = slot.req   # _commit_token may free the slot
+                    rid = req.rid
                     committed = 0
                     self._lengths[i] += M
                     for t in range(M):
                         committed += 1
                         if self._commit_token(i, int(out[i, t])):
                             break  # freed; later window tokens discarded
+                    self._tick_progress(req, t1n)
                     tick_committed += committed
                     if tron:
-                        _tr.add_span("serving.decode", t0n, t1n, _tid=i,
+                        _tr.add_span("serving.decode", t0n, t1n, _tid=rid,
                                      rid=rid, slot=i, window=M,
                                      committed=committed)
                 self._after_tick("decode", t0n, t1n, tick_committed,
@@ -1636,8 +1752,9 @@ class ServingEngine:
             for i, slot in enumerate(self._slots):
                 if slot.req is None:
                     continue
-                rid = slot.req.rid
-                was_prefill = slot.off < len(slot.req.prompt)
+                req = slot.req   # _commit_token may free the slot
+                rid = req.rid
+                was_prefill = slot.off < len(req.prompt)
                 if was_prefill:
                     slot.off += int(consumed[i])
                     if (self._prefix is not None
@@ -1654,11 +1771,12 @@ class ServingEngine:
                 if finishing[i]:
                     self._commit_token(i, int(nxt[i]))
                     tick_committed += 1
+                    self._tick_progress(req, t1n)
                 if tron:
                     _tr.add_span(
                         "serving.prefill_chunk" if was_prefill
                         else "serving.decode",
-                        t0n, t1n, _tid=i, rid=rid, slot=i,
+                        t0n, t1n, _tid=rid, rid=rid, slot=i,
                         tokens=int(consumed[i]))
             self._after_tick("prefill", t0n, t1n, tick_committed)
         if self._spec is not None:
@@ -1706,7 +1824,7 @@ class ServingEngine:
             consumed.copy(), list(finishing), [s.req for s in self._slots])
         return tokens, starts, nvalid, exit_wave
 
-    def _commit_pp_exit_locked(self, exit_wave, nxt):
+    def _commit_pp_exit_locked(self, exit_wave, nxt, t_ns):
         """Advance the exiting wave's slots; returns tokens committed."""
         rec = self._inflight.pop(exit_wave, None)
         if rec is None:
@@ -1720,12 +1838,14 @@ class ServingEngine:
             # carried (not freed/re-admitted mid-flight)
             if slot.req is None or slot.req is not reqs_e[i]:
                 continue
-            if slot.off < len(slot.req.prompt):
+            req = slot.req   # _commit_token may free the slot
+            if slot.off < len(req.prompt):
                 slot.off += int(consumed_e[i])
             self._lengths[i] += int(consumed_e[i])
             if finishing_e[i]:
                 self._commit_token(i, int(nxt[i]))
                 committed += 1
+                self._tick_progress(req, t_ns)
         return committed
 
     def _loop(self):
@@ -1743,6 +1863,15 @@ class ServingEngine:
                 with self._lock:
                     def _fail(req, where):
                         req.error = e
+                        # goodput accounting: every token the failed
+                        # request generated is aborted work the caller
+                        # never got — the /load report's goodput ratio
+                        # reads completed/(completed+aborted)
+                        self._c["aborted_tokens"].inc(len(req.tokens))
+                        req.lifecycle.update(
+                            t_abort=time.perf_counter(), aborted=True,
+                            tokens=len(req.tokens), where=where,
+                            error=type(e).__name__)
                         # close the lifecycle spans (no-ops when tracing
                         # is off) and leave a terminal flight mark — the
                         # failing requests are the ones a post-mortem
@@ -1769,6 +1898,11 @@ class ServingEngine:
                                 _fail(req, "inflight")
                     self._inflight.clear()
                     self._running = False
+                # the loop thread dies on this raise: PIN the beacon so
+                # it survives the thread's exit and goes stale — the
+                # /healthz?max_age alert a crashed engine must leave
+                # (beacon_ages GCs dead-thread beacons otherwise)
+                _tr.pin_beacon(f"serving.{self._engine_id}")
                 if not getattr(e, "_pht_usage_error", False):
                     _flight.crash_dump(
                         f"serving.step[{self._engine_id}]", e)
@@ -1819,6 +1953,101 @@ class ServingEngine:
                 out["prefix_cached_pages"] = (
                     len(self._prefix) if self._prefix is not None else 0)
             return out
+
+    def load_report(self) -> dict:
+        """The machine-readable load/capacity report — the versioned
+        JSON document the ``/load`` endpoint serves and a least-loaded
+        router polls (ROADMAP item 2; schema contract:
+        docs/OBSERVABILITY.md, "SLO telemetry and the /load report").
+
+        One snapshot under the engine lock (host dicts and counters
+        only — no device touch), so polling never stalls a tick:
+
+        - ``slots``/``queue``: free capacity and how long the queue
+          head has been waiting (admission is FIFO, so ``oldest_wait_s``
+          bounds every queued request's wait).
+        - ``admission``: the headroom a router sizes a request against —
+          largest admissible ``prompt + max_new`` right now (page-exact
+          in paged mode via ``paged.tokens_admittable``, ``max_len``
+          minus the write-window reserve in dense), plus the paged
+          pool's free/used pages.
+        - ``modes``: what this replica is (spec/quant/MoE/paged/pp) —
+          a router must not mix replicas with different latency shapes
+          in one SLO pool blindly.
+        - ``slo``: rolling TTFT/TPOT/e2e/queue-wait percentiles over the
+          last ``slo_window_s`` seconds (None when no traffic — never
+          NaN, which is not JSON).
+        - ``goodput``: completed vs aborted generated tokens and their
+          ratio (None before any token).
+        """
+        reserve = max(self.chunk, self.spec_k + 1)
+        with self._lock:
+            now = time.perf_counter()
+            active = sum(s.req is not None for s in self._slots)
+            free_slots = self.max_slots - active
+            oldest = max((now - r._t_submit for r in self._pending),
+                         default=0.0)
+            completed = int(self._c["completed_tokens"].value)
+            aborted = int(self._c["aborted_tokens"].value)
+            report = {
+                "version": 1,
+                "engine": self._engine_id,
+                "ts": time.time(),
+                "running": self._running,
+                "tickno": self._tickno,
+                "slots": {"max": self.max_slots, "active": active,
+                          "free": free_slots},
+                "queue": {"depth": len(self._pending),
+                          "oldest_wait_s": round(oldest, 6)},
+                "modes": {"cache": self.cache_mode,
+                          "spec_k": self.spec_k,
+                          "quant": self._quantized,
+                          "moe": self._moe,
+                          "pp": self._pp},
+                "slo": {"window_s": self._slo_window_s,
+                        **{k: h.percentiles()
+                           for k, h in self._slo.items()}},
+                "goodput": {
+                    "completed_tokens": completed,
+                    "aborted_tokens": aborted,
+                    "ratio": (completed / (completed + aborted)
+                              if completed + aborted else None)},
+            }
+            admission = {"reserve_tokens": reserve}
+            # the per-slot caps every request faces regardless of pool
+            # state: max_len minus the write-window reserve, and the
+            # model's position table (submit() refuses past either)
+            slot_cap = self.max_len - reserve
+            max_pos = getattr(self.model.config,
+                              "max_position_embeddings", None)
+            if max_pos is not None:
+                slot_cap = min(slot_cap, int(max_pos))
+            if self._paged:
+                from .paged import tokens_admittable
+                # admission evicts cache-only prefix pages to cover a
+                # shortfall (_paged_admit_locked), so the free list
+                # alone UNDERSTATES what would actually admit — the
+                # router contract is "would this request fit RIGHT
+                # NOW", eviction included
+                evictable = (self._prefix.cached_only()
+                             if self._prefix is not None else 0)
+                headroom = min(
+                    tokens_admittable(self._pool.free + evictable,
+                                      reserve, self._page_size),
+                    slot_cap)
+                admission.update(
+                    kv_pages_free=self._pool.free,
+                    kv_pages_evictable=evictable,
+                    kv_pages_in_use=self._pool.allocated,
+                    page_size=self._page_size,
+                    # a free slot is still required: pages alone don't
+                    # admit when every slot is occupied
+                    headroom_tokens=headroom if free_slots else 0)
+            else:
+                admission["headroom_tokens"] = (slot_cap if free_slots
+                                                else 0)
+            report["admission"] = admission
+            return report
 
     @property
     def kv_pages_in_use(self) -> int:
@@ -1873,6 +2102,12 @@ class ServingEngine:
                 if not self._running:
                     self._registry.drop_labels(engine=self._engine_id)
                     _tr.unregister_introspection_source(self._engine_id)
+                    # a shut-down engine must vanish from the router's
+                    # /load poll (and the /debug mirror) immediately,
+                    # not only when the weak refs die
+                    _tr.unregister_load_source(self._engine_id)
+                    _tr.unregister_introspection_source(
+                        f"{self._engine_id}.load")
                     # clean shutdown: a gone engine must not leave a
                     # forever-stale beacon 503ing /healthz?max_age (a
                     # CRASHED loop keeps its beacon — stale IS the alert)

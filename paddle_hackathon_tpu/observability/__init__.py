@@ -47,14 +47,16 @@ Metric catalog and endpoint reference: ``docs/OBSERVABILITY.md``.
 from . import flight, sanitizers, tracing
 from .flight import FlightRecorder, get_flight_recorder
 from .metrics import (Counter, Gauge, Histogram, MetricRegistry,
-                      get_registry, instrument_jit, log_buckets,
-                      record_device_memory, set_trace_sink, snapshot_delta)
+                      SlidingWindowHistogram, get_registry, instrument_jit,
+                      log_buckets, record_device_memory, set_trace_sink,
+                      snapshot_delta)
 from .sanitizers import (HostTransferError, LockOrderError,
                          forbid_host_transfers, make_lock, make_rlock)
 from .tracing import (add_span, disable_tracing, enable_tracing, end_span,
                       span, start_span, tracing_enabled)
 
 __all__ = ["MetricRegistry", "Counter", "Gauge", "Histogram",
+           "SlidingWindowHistogram",
            "get_registry", "instrument_jit", "log_buckets",
            "record_device_memory", "set_trace_sink", "snapshot_delta",
            "span", "start_span", "end_span", "add_span", "enable_tracing",

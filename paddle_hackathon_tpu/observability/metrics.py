@@ -32,8 +32,9 @@ from typing import Dict, Iterable, Optional, Tuple
 from .sanitizers import make_lock
 
 __all__ = ["MetricRegistry", "Counter", "Gauge", "Histogram",
-           "get_registry", "instrument_jit", "log_buckets",
-           "record_device_memory", "set_trace_sink", "snapshot_delta"]
+           "SlidingWindowHistogram", "get_registry", "instrument_jit",
+           "log_buckets", "record_device_memory", "set_trace_sink",
+           "snapshot_delta"]
 
 
 def log_buckets(lo: float = 1e-6, hi: float = 64.0, per_decade: int = 3):
@@ -64,6 +65,30 @@ def set_trace_sink(fn) -> None:
     """Install (or clear, with None) the chrome-trace counter sink."""
     global _trace_sink
     _trace_sink = fn
+
+
+def _quantile_from_counts(buckets, counts, total, vmax, q):
+    """Approximate q-quantile from per-bucket counts — the standard
+    Prometheus ``histogram_quantile`` interpolation, shared by
+    :class:`Histogram` and :class:`SlidingWindowHistogram`.  The +Inf
+    overflow bucket interpolates up to the OBSERVED max instead of
+    clamping to ``buckets[-1]`` (a 300 s stall must not quantile as the
+    top bound)."""
+    if not total:
+        return float("nan")
+    top = max(vmax, buckets[-1])
+    rank = q * total
+    acc = 0.0
+    for i, c in enumerate(counts):
+        if acc + c >= rank and c:
+            lo = buckets[i - 1] if i > 0 else 0.0
+            hi = buckets[i] if i < len(buckets) else top
+            # clamp to the observed max: an empirical quantile can
+            # never exceed it, but in-bucket interpolation toward the
+            # bucket's upper bound can (all samples below the bound)
+            return min(lo + (hi - lo) * ((rank - acc) / c), vmax)
+        acc += c
+    return min(top, vmax)
 
 
 class _Child:
@@ -188,20 +213,136 @@ class Histogram(_Child):
         """Approximate q-quantile (0 <= q <= 1) from bucket counts."""
         with self._lock:
             counts, total, vmax = list(self._counts), self._count, self._max
+        return _quantile_from_counts(self.buckets, counts, total, vmax, q)
+
+
+class SlidingWindowHistogram:
+    """Fixed-bucket histogram over (approximately) the last
+    ``window_s`` seconds — the rolling-percentile primitive behind the
+    serving SLO report (``ServingEngine.load_report`` / the ``/load``
+    endpoint): a router wants "p99 TTFT over the last minute", and a
+    lifetime :class:`Histogram` can never forget a cold start.
+
+    Design: a ring of ``slices`` sub-windows, each a plain bucket-count
+    array stamped with its epoch (``now // slice_width``).  ``observe``
+    is LOCK-FREE on the hot path — one clock read, one bisect, three
+    list/scalar bumps (GIL-atomic enough for telemetry); the only lock
+    is taken on the rare slice rotation (once per ``window_s/slices``
+    seconds), where the stale sub-window is reset before reuse.  A
+    concurrent observe racing a rotation can at worst misplace ONE
+    sample — acceptable for latency percentiles, never used for
+    billing-grade counts.
+
+    Reads (:meth:`quantile` / :meth:`snapshot`) merge the non-expired
+    sub-windows — O(slices x buckets), no per-observation state — and
+    interpolate quantiles exactly like :class:`Histogram` (bucket
+    resolution, +Inf tail up to the observed max).  The covered span is
+    slice-granular: between ``window_s - slice_width`` and ``window_s``
+    seconds of history, the standard rolling-window trade.
+
+    NOT a registry family on purpose: windows are per-instance working
+    state (one per engine-side series), carry no labels, and never grow
+    the process-wide registry — the tentpole's "no per-request metric
+    labels" rule.  ``clock`` is injectable for tests."""
+
+    __slots__ = ("buckets", "window_s", "slices", "_slice_s", "_wins",
+                 "_rot_lock", "_clock")
+
+    def __init__(self, window_s: float = 60.0, slices: int = 6,
+                 buckets=DEFAULT_BUCKETS, clock=time.monotonic):
+        if window_s <= 0:
+            raise ValueError("window_s must be > 0")
+        if slices < 1:
+            raise ValueError("slices must be >= 1")
+        self.buckets = tuple(sorted(float(b) for b in buckets))
+        self.window_s = float(window_s)
+        self.slices = int(slices)
+        self._slice_s = self.window_s / self.slices
+        # [epoch, counts, count, sum, max] per sub-window; epoch -1 =
+        # never used (matches no real epoch, so it reads as expired)
+        self._wins = [[-1, [0] * (len(self.buckets) + 1), 0, 0.0,
+                       float("-inf")] for _ in range(self.slices)]
+        self._rot_lock = make_lock("metrics.swh")
+        self._clock = clock
+
+    def observe(self, v: float) -> None:
+        epoch = int(self._clock() // self._slice_s)
+        w = self._wins[epoch % self.slices]
+        if w[0] != epoch:
+            # rotation: reset the expired sub-window before claiming it
+            # (the one lock, taken once per slice width)
+            with self._rot_lock:
+                if w[0] != epoch:
+                    w[1] = [0] * (len(self.buckets) + 1)
+                    w[2], w[3], w[4] = 0, 0.0, float("-inf")
+                    w[0] = epoch
+        i = bisect.bisect_left(self.buckets, v)
+        w[1][i] += 1
+        w[2] += 1
+        w[3] += v
+        if v > w[4]:
+            w[4] = v
+
+    def _merged(self):
+        """(counts, total, sum, max) over the live sub-windows."""
+        cur = int(self._clock() // self._slice_s)
+        lo = cur - self.slices + 1
+        counts = [0] * (len(self.buckets) + 1)
+        s, vmax = 0.0, float("-inf")
+        for w in self._wins:
+            if lo <= w[0] <= cur:
+                for j, c in enumerate(w[1]):
+                    counts[j] += c
+                s += w[3]
+                vmax = max(vmax, w[4])
+        # total from the merged counts, not the per-window counters, so
+        # quantile ranks stay internally consistent under racy observes
+        total = sum(counts)
+        if total and vmax == float("-inf"):
+            # a reader racing the FIRST observe of an otherwise-empty
+            # window can see the count bump before the max update:
+            # report empty for this read rather than leak -inf into
+            # strict-JSON consumers (/load) — the next read sees both
+            return [0] * len(counts), 0, 0.0, float("-inf")
+        return counts, total, s, vmax
+
+    @property
+    def count(self) -> int:
+        return self._merged()[1]
+
+    @property
+    def sum(self) -> float:
+        return self._merged()[2]
+
+    @property
+    def max(self) -> float:
+        counts, total, _, vmax = self._merged()
+        return vmax if total else float("nan")
+
+    def quantile(self, q: float) -> float:
+        """q-quantile over the window (NaN when empty)."""
+        counts, total, _, vmax = self._merged()
+        return _quantile_from_counts(self.buckets, counts, total, vmax, q)
+
+    def percentiles(self, qs=(0.5, 0.95, 0.99)):
+        """JSON-safe rolling summary: ``{"count", "mean", "max",
+        "p50", "p95", "p99"}`` — or None when the window is empty
+        (None, not NaN: NaN is not valid JSON and a router must be able
+        to tell "no traffic" from a number)."""
+        counts, total, s, vmax = self._merged()
         if not total:
-            return float("nan")
-        top = max(vmax, self.buckets[-1])
-        rank = q * total
-        acc = 0.0
-        for i, c in enumerate(counts):
-            if acc + c >= rank and c:
-                lo = self.buckets[i - 1] if i > 0 else 0.0
-                # +Inf bucket: interpolate up to the OBSERVED max — a
-                # tail past buckets[-1] must not report as buckets[-1]
-                hi = self.buckets[i] if i < len(self.buckets) else top
-                return lo + (hi - lo) * ((rank - acc) / c)
-            acc += c
-        return top
+            return None
+        out = {"count": total, "mean": s / total, "max": vmax}
+        for q in qs:
+            out[f"p{int(q * 100)}"] = _quantile_from_counts(
+                self.buckets, counts, total, vmax, q)
+        return out
+
+    def snapshot(self) -> dict:
+        """Window metadata + :meth:`percentiles` (``values`` None when
+        empty)."""
+        return {"window_s": self.window_s, "slices": self.slices,
+                "values": self.percentiles()}
 
 
 class _Family:

@@ -8,9 +8,16 @@ process is live:
     srv = start_introspection_server(9200)
     curl localhost:9200/metrics          # Prometheus exposition
     curl localhost:9200/healthz          # liveness beacons (tick/step age)
+    curl localhost:9200/load             # machine-readable load/capacity
     curl localhost:9200/debug/flight     # flight-recorder ring as JSON
     curl localhost:9200/debug/requests   # in-flight serving slot tables
     srv.stop()
+
+``/load`` is the router contract (ROADMAP item 2): a VERSIONED JSON
+capacity report per registered engine — slot/queue/page-pool headroom,
+rolling TTFT/TPOT/e2e percentiles, goodput — the document a
+least-loaded dispatcher polls (schema: docs/OBSERVABILITY.md, "SLO
+telemetry and the /load report").
 
 Opt-in by construction (nothing starts it implicitly), bound to
 localhost by default, and pure stdlib ``http.server`` — no dependency
@@ -63,6 +70,12 @@ class _Handler(BaseHTTPRequestHandler):
                            "text/plain; version=0.0.4; charset=utf-8")
             elif url.path == "/healthz":
                 self._healthz(url)
+            elif url.path == "/load":
+                # the router poll: one versioned envelope, one report
+                # per live engine (tracing.load_reports snapshots then
+                # calls, so a scrape never blocks the serving tick)
+                self._send_json({"version": 1, "ts": time.time(),
+                                 "engines": _tracing.load_reports()})
             elif url.path == "/debug/flight":
                 self._send_json(_flight.get_flight_recorder().dump())
             elif url.path == "/debug/requests":
@@ -71,6 +84,7 @@ class _Handler(BaseHTTPRequestHandler):
             else:
                 self._send_json({"error": "not found",
                                  "endpoints": ["/metrics", "/healthz",
+                                               "/load",
                                                "/debug/flight",
                                                "/debug/requests"]}, 404)
         except Exception as e:  # noqa: BLE001 — introspection must not die
@@ -91,20 +105,31 @@ class _Handler(BaseHTTPRequestHandler):
         # staleness alert the probe exists for
         q = parse_qs(url.query, keep_blank_values=True)
         if "max_age" in q:
+            raw = q["max_age"][0]
             try:
-                limit = float(q["max_age"][0])
-            except ValueError:
+                limit = float(raw)
+            except (TypeError, ValueError):
+                # a parse failure is the CALLER's malformed query — 400,
+                # never the 500 an uncaught ValueError here produced
                 limit = float("nan")
-            if not math.isfinite(limit):
+            if not math.isfinite(limit) or limit < 0:
                 # NaN compares False against every age — a templated
                 # probe expanding to 'nan' must not silently disable
-                # the staleness alert it exists for
+                # the staleness alert it exists for; a negative limit
+                # trips on EVERY beacon, which is a probe bug, not a
+                # health signal
                 self._send_json({"error": "max_age must be a finite "
-                                          "number"}, 400)
+                                          "number >= 0",
+                                 "got": raw}, 400)
                 return
             stale = {k: v for k, v in ages.items() if v > limit}
             if stale:
-                payload.update(ok=False, stale=stale)
+                # name the failing beacons explicitly (sorted, stalest
+                # first) so an alert line can say WHICH worker wedged
+                # without parsing the ages dict
+                payload.update(ok=False, stale=stale,
+                               stale_beacons=sorted(
+                                   stale, key=stale.get, reverse=True))
                 self._send_json(payload, 503)
                 return
         self._send_json(payload)

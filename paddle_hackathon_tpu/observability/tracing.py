@@ -46,8 +46,11 @@ from .sanitizers import make_lock
 __all__ = ["span", "start_span", "end_span", "add_span", "Span",
            "enable_tracing", "disable_tracing", "tracing_enabled",
            "set_span_sink", "heartbeat", "beacon_ages", "remove_beacon",
+           "pin_beacon",
            "register_introspection_source",
-           "unregister_introspection_source", "introspection_tables"]
+           "unregister_introspection_source", "introspection_tables",
+           "register_load_source", "unregister_load_source",
+           "load_reports"]
 
 _enabled = False
 # Armed by profiler.Profiler while recording:
@@ -183,30 +186,61 @@ def add_span(name: str, t0_ns: int, t1_ns: int, /, _tid=None,
 # Liveness beacons (for /healthz)
 # ---------------------------------------------------------------------------
 
-_beacons: Dict[str, float] = {}
+_beacons: Dict[str, tuple] = {}   # name -> (last_beat_ts, owner_thread|None)
 
 
 def heartbeat(name: str) -> None:
     """Mark ``name`` alive now.  One dict store — cheap enough for the
-    serving engine to call every tick, always on."""
-    _beacons[name] = time.time()
+    serving engine to call every tick, always on.  The beating thread is
+    recorded as the beacon's OWNER: :func:`beacon_ages` garbage-collects
+    beacons whose owner thread has exited, so a worker that died without
+    cleaning up does not sit in ``/healthz`` with an ever-growing age and
+    false-trip a router health probe.  An activity that must alert by
+    going stale after its thread dies (a crashed engine loop) pins
+    itself first via :func:`pin_beacon`."""
+    _beacons[name] = (time.time(), threading.current_thread())
+
+
+def pin_beacon(name: str) -> None:
+    """Detach ``name`` from its owner thread: the beacon survives the
+    thread's exit and its age grows forever — exactly the ``?max_age``
+    alert a CRASHED loop wants to leave behind (the serving engine's
+    fail-all path pins before re-raising).  Keeps the last beat time;
+    creates the beacon if it never beat."""
+    rec = _beacons.get(name)
+    _beacons[name] = (rec[0] if rec else time.time(), None)
 
 
 def remove_beacon(name: str) -> None:
     """Forget a beacon.  A cleanly-stopped activity (engine shutdown,
     completed fit) must not 503 ``/healthz?max_age`` forever — and with
     engine churn the dict must not grow without bound.  A CRASHED
-    activity keeps its beacon on purpose: going stale is the alert."""
+    activity keeps its beacon on purpose (see :func:`pin_beacon`):
+    going stale is the alert."""
     _beacons.pop(name, None)
 
 
 def beacon_ages() -> Dict[str, float]:
-    """Seconds since each beacon last beat."""
+    """Seconds since each live beacon last beat.  Beacons whose owner
+    thread has exited are dropped (and removed) here: a dead worker's
+    frozen beat time would otherwise read as an ever-growing age and
+    false-trip any ``?max_age`` probe — GC at the read keeps the write
+    path one dict store.  Pinned beacons (owner None) never GC."""
     now = time.time()
     # dict(_beacons) snapshots atomically (single C-level op under the
     # GIL) — iterating the live dict would race an engine's first-tick
     # insert and 500 the /healthz probe
-    return {k: now - v for k, v in sorted(dict(_beacons).items())}
+    out = {}
+    for k, rec in sorted(dict(_beacons).items()):
+        ts, owner = rec
+        if owner is not None and not owner.is_alive():
+            # drop only the record we judged: a concurrent re-beat (the
+            # name re-used by a fresh thread) must not be evicted
+            if _beacons.get(k) is rec:
+                _beacons.pop(k, None)
+            continue
+        out[k] = now - ts
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -247,5 +281,44 @@ def introspection_tables() -> dict:
         try:
             out[name] = obj.introspect_requests()
         except Exception as e:  # noqa: BLE001 — introspection must not throw
+            out[name] = {"error": f"{type(e).__name__}: {e}"}
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Load/capacity report sources (for /load — the router contract)
+# ---------------------------------------------------------------------------
+
+_load_sources: "weakref.WeakValueDictionary[str, object]" = \
+    weakref.WeakValueDictionary()
+_load_sources_lock = make_lock("tracing.load_sources")
+
+
+def register_load_source(name: str, obj) -> None:
+    """Register a live object exposing ``load_report() -> dict`` (the
+    serving engine's capacity/SLO document — docs/OBSERVABILITY.md,
+    "SLO telemetry and the /load report").  Held weakly, like the
+    introspection sources: a dropped engine vanishes from ``/load``."""
+    with _load_sources_lock:
+        _load_sources[name] = obj
+
+
+def unregister_load_source(name: str) -> None:
+    with _load_sources_lock:
+        _load_sources.pop(name, None)
+
+
+def load_reports() -> dict:
+    """``{name: source.load_report()}`` over live sources — the body of
+    the ``/load`` endpoint.  Snapshot-then-call, same lock discipline as
+    :func:`introspection_tables`; a failing source reports its error
+    instead of taking the router's poll down."""
+    with _load_sources_lock:
+        items = sorted(_load_sources.items())
+    out = {}
+    for name, obj in items:
+        try:
+            out[name] = obj.load_report()
+        except Exception as e:  # noqa: BLE001 — the router poll must not die
             out[name] = {"error": f"{type(e).__name__}: {e}"}
     return out

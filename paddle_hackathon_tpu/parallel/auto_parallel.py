@@ -489,6 +489,15 @@ class Engine:
                               drop_last=True)
         st = self._state
         history = []
+        # MFU/tokens-per-sec accounting (same contract as Model.fit's
+        # compiled path, path="engine"): measured from the moment the
+        # FIRST step_fn call returns — jit compiles eagerly at call
+        # time, so that wall excludes the compile while every step's
+        # async execution lands inside the window — to the end-of-fit
+        # float() sync the loop already pays.  No added host syncs.
+        t_mark = None
+        tokens_done = 0
+        seqlen = None
         for epoch in range(epochs):
             for i, batch in enumerate(loader):
                 if steps_per_epoch is not None and i >= steps_per_epoch:
@@ -506,6 +515,11 @@ class Engine:
                     _tr.add_span("parallel.engine_step", t0n,
                                  time.perf_counter_ns(), epoch=epoch,
                                  step=i)
+                if t_mark is None:
+                    t_mark = time.perf_counter()   # compile excluded
+                else:
+                    seqlen = int(x.shape[1]) if np.ndim(x) == 2 else None
+                    tokens_done += int(x.shape[0]) * (seqlen or 1)
                 # keep the raw device array: float() would force a host sync
                 # every step and stall async dispatch
                 history.append(loss)
@@ -520,8 +534,45 @@ class Engine:
         # going stale on /healthz?max_age IS the alert)
         _tr.remove_beacon("train.engine_fit")
         history = [float(l) for l in history]
+        self._record_throughput(t_mark, tokens_done, seqlen)
         self._history["loss"].extend(history)
         return {"loss": history}
+
+    def _record_throughput(self, t_mark, tokens_done, seqlen):
+        """tokens/s + MFU gauges (``path="engine"``) at the end-of-fit
+        sync: the ``history`` float() loop above already drained the
+        device pipeline, so the elapsed wall from the first program
+        return to now covers every post-compile step's execution.
+        MFU divides by ``cost_model.device_peak_flops`` across the
+        participating chips; skipped when the peak is unknown or the
+        run was too short to exclude compile (< 2 steps)."""
+        if t_mark is None or not tokens_done:
+            return
+        dt = time.perf_counter() - t_mark
+        if dt <= 0:
+            return
+        from ..cost_model import device_peak_flops, train_flops_per_token
+        from ..observability import metrics as _obs
+        reg = _obs.get_registry()
+        tps = tokens_done / dt
+        reg.gauge(
+            "train_tokens_per_sec",
+            "training throughput between loss fetches "
+            "(tokens = batch x seqlen; batch for 1-D samples)").labels(
+                path="engine").set(tps)
+        peak = device_peak_flops()
+        if peak:
+            # the SPMD program spans exactly this Engine's mesh — not
+            # jax.device_count(), which may include chips outside it
+            peak *= int(self.mesh.devices.size)
+            mfu = tps * train_flops_per_token(self.model, seqlen) / peak
+            reg.gauge(
+                "train_mfu",
+                "model FLOPs utilization between loss fetches "
+                "(analytic cost_model.train_flops_per_token x tokens/s "
+                "over device_peak_flops; MoE-active-params-aware; unset "
+                "when the chip peak is unknown)").labels(
+                    path="engine").set(mfu)
 
     def evaluate(self, valid_data, batch_size: int = 1, steps=None,
                  verbose: int = 0):

@@ -74,6 +74,8 @@ _FILE_COST = {
     "test_strategies.py": 13, "test_fused_cache.py": 13,
     "test_hapi_compiled_fit.py": 15, "test_observability.py": 15,
     "test_tracing.py": 8,   # span/flight/server units; engine runs are slow-marked
+    "test_slo.py": 12,      # window/beacon/healthz units + ONE tiny engine
+                            # run (lifecycle + /load golden) + one tiny fit
     "test_lint.py": 7,      # pure AST; one repo-wide walk dominates
     "test_sanitizers.py": 3,  # lock/guard units; engine runs are slow-marked
     "test_paged.py": 16,    # allocator units + 2 tiny-GPT engine runs

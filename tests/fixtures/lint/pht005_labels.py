@@ -1,0 +1,64 @@
+"""Seeded PHT005 (metric-label-cardinality) violations — each tagged
+with the rule expected AT THAT LINE, asserted by tests/test_lint.py.
+Negative shapes (bounded loops, **splat, plain params) must stay
+silent: the Counter equality in the test rejects extra findings."""
+
+import itertools
+
+from paddle_hackathon_tpu.observability import get_registry
+
+_IDS = itertools.count()
+
+
+def label_from_request_id(req):
+    reg = get_registry()
+    c = reg.counter("reqs_total")
+    c.labels(rid=req.rid).inc()                       # expect: PHT005
+    c.labels(request=str(req.request_id)).inc()       # expect: PHT005
+
+
+def label_from_bare_id_name(rid):
+    c = get_registry().counter("reqs_total")
+    c.labels(req=f"r{rid}").inc()                     # expect: PHT005
+
+
+def label_from_unbounded_loop(items):
+    fam = get_registry().gauge("depth")
+    for i, item in enumerate(items):
+        fam.labels(index=str(i)).set(1)               # expect: PHT005
+
+
+def label_from_counter_in_while(q):
+    fam = get_registry().counter("polls_total")
+    n = 0
+    while q:
+        n += 1
+        fam.labels(poll=n).inc()                      # expect: PHT005
+
+
+def label_from_next():
+    fam = get_registry().counter("spawn_total")
+    wid = next(_IDS)
+    fam.labels(worker=wid).inc()                      # expect: PHT005
+
+
+def label_from_comprehension(rows):
+    fam = get_registry().gauge("rows")
+    return [fam.labels(row=str(r)) for r in rows]     # expect: PHT005
+
+
+def bounded_labels_ok(mode):
+    """Negative shapes: none of these may fire."""
+    reg = get_registry()
+    fam = reg.histogram("tick_seconds")
+    # literal-tuple loop target: provably bounded
+    children = {f: fam.labels(flavor=f) for f in ("prefill", "decode")}
+    # constant range: provably bounded
+    for k in range(4):
+        reg.gauge("lanes").labels(lane=str(k)).set(0)
+    # a plain parameter is config, not a counter
+    reg.counter("mode_total").labels(mode=mode).inc()
+    # **splat is conservatively skipped (shared per-instance label dict)
+    lbl = {"engine": "e0"}
+    reg.counter("ticks_total").labels(**lbl).inc()
+    return children

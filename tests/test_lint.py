@@ -52,7 +52,8 @@ def _actual(path):
 @pytest.mark.parametrize("name", ["pht001_hot_sync.py",
                                   "pht002_retrace.py",
                                   "pht003_locks.py",
-                                  "pht004_nondet.py"])
+                                  "pht004_nondet.py",
+                                  "pht005_labels.py"])
 def test_seeded_violations_detected_at_exact_lines(name):
     """Every seeded violation fires at the exact file:line — and ONLY
     there (the Counter equality also rejects extra findings, so the
@@ -103,6 +104,27 @@ def test_default_scope_covers_the_hot_modules():
                 "bench.py"):
         assert rel in paths, rel
     assert not any("fixtures" in p for p in paths)
+
+
+def test_new_telemetry_code_is_label_cardinality_clean():
+    """The SLO telemetry this round added (lifecycle records, the /load
+    report, the MFU gauges) must not smuggle per-request values into
+    metric labels: PHT005 over exactly those modules, baseline on (the
+    two justified bounded-loop suppressions stay suppressed)."""
+    telem = [os.path.join(ROOT, rel) for rel in (
+        "paddle_hackathon_tpu/inference/serving.py",
+        "paddle_hackathon_tpu/observability/metrics.py",
+        "paddle_hackathon_tpu/observability/server.py",
+        "paddle_hackathon_tpu/observability/tracing.py",
+        "paddle_hackathon_tpu/hapi/model.py",
+        "paddle_hackathon_tpu/parallel/auto_parallel.py",
+    )]
+    findings, suppressed, _ = run_lint(paths=telem,
+                                       baseline_path=DEFAULT_BASELINE)
+    assert [f.render() for f in findings if f.rule == "PHT005"] == []
+    # the rule actually ran here: the two justified per-topology loops
+    # (expert label, device label) are suppressed, not invisible
+    assert sum(f.rule == "PHT005" for f in suppressed) >= 2
 
 
 # ------------------------------------------------------------ baseline

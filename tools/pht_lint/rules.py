@@ -1,4 +1,4 @@
-"""pht-lint rules PHT001–PHT004 (catalog: docs/STATIC_ANALYSIS.md).
+"""pht-lint rules PHT001–PHT005 (catalog: docs/STATIC_ANALYSIS.md).
 
 PHT001  host-sync-in-hot-path   — .item() / block_until_ready /
         jax.device_get / np.asarray-on-device-value / float()/int()/
@@ -14,6 +14,12 @@ PHT003  lock-discipline         — cycles in the cross-module static
         or host syncs.
 PHT004  nondeterminism-in-jit   — time.* / random.* / np.random.*
         inside jitted bodies (traced once, frozen forever).
+PHT005  metric-label-cardinality — ``.labels(...)`` keyword values
+        derived from request/session ids or unbounded loop indices:
+        every new value mints a fresh time series, so the registry
+        (and every scrape) grows without bound.  Per-request data
+        belongs in spans / the flight recorder / lifecycle records,
+        never in labels.
 
 "Device value" tracking is a per-function forward taint pass: names
 assigned from jax/jnp calls are device; jax.device_get launders back to
@@ -563,7 +569,149 @@ def _nondet_calls(mi: ModuleInfo, fi: FuncInfo,
 
 
 # --------------------------------------------------------------------------
-# per-module driver (PHT001/002/004)
+# PHT005: unbounded metric-label cardinality
+# --------------------------------------------------------------------------
+
+# identifier/attribute names that are per-request/per-occurrence by
+# convention — a label fed from one of these mints a series per request
+_ID_NAMES = frozenset((
+    "rid", "request_id", "req_id", "uid", "uuid", "session_id",
+    "trace_id", "span_id",
+))
+
+
+def _bounded_iterable(mi: ModuleInfo, it: ast.expr) -> bool:
+    """Provably-bounded iterables: literal containers, constants, and
+    ``range``/``enumerate`` over them with constant arguments.  A
+    ``range(self.num_x)`` is NOT provably bounded — flag it and let the
+    baseline carry the justification when the bound is real (the
+    workflow for every conservative rule here)."""
+    if isinstance(it, (ast.Tuple, ast.List, ast.Set, ast.Dict,
+                       ast.Constant)):
+        return True
+    if isinstance(it, ast.Call) and isinstance(it.func, ast.Name) \
+            and it.func.id not in mi.imports:
+        if it.func.id == "range":
+            return all(isinstance(a, ast.Constant) for a in it.args)
+        if it.func.id in ("enumerate", "sorted", "reversed", "zip"):
+            return all(_bounded_iterable(mi, a) for a in it.args)
+    return False
+
+
+def _target_names(target: ast.expr) -> Set[str]:
+    out: Set[str] = set()
+    for n in ast.walk(target):
+        if isinstance(n, ast.Name):
+            out.add(n.id)
+    return out
+
+
+class _LabelCardinalityWalker:
+    """PHT005 over ONE function body (nested defs are their own
+    FuncInfo entries): collect names that count without bound —
+    for/comprehension targets over non-provably-bounded iterables,
+    loop-carried ``i += 1`` counters, names assigned from ``next(...)``
+    — then flag ``.labels(...)`` keyword values mentioning one of them
+    or a request-id-ish name.  ``**splat`` kwargs are skipped
+    (conservative: can only MISS, never false-positive on the shared
+    per-instance label dict idiom)."""
+
+    def __init__(self, mi: ModuleInfo, fi: FuncInfo,
+                 findings: List[Finding]):
+        self.mi = mi
+        self.fi = fi
+        self.findings = findings
+        self.unbounded: Set[str] = set()
+
+    def _own_nodes(self):
+        """Child-first walk of the function body, skipping nested
+        defs (linted under their own FuncInfo)."""
+        out = []
+
+        def collect(node):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    continue
+                out.append(child)
+                collect(child)
+
+        collect(self.fi.node)
+        return out
+
+    def _collect_unbounded(self, nodes):
+        for node in nodes:
+            if isinstance(node, (ast.For, ast.AsyncFor)):
+                if not _bounded_iterable(self.mi, node.iter):
+                    self.unbounded |= _target_names(node.target)
+            elif isinstance(node, (ast.ListComp, ast.SetComp,
+                                   ast.DictComp, ast.GeneratorExp)):
+                for comp in node.generators:
+                    if not _bounded_iterable(self.mi, comp.iter):
+                        self.unbounded |= _target_names(comp.target)
+            elif isinstance(node, ast.Assign) \
+                    and isinstance(node.value, ast.Call) \
+                    and isinstance(node.value.func, ast.Name) \
+                    and node.value.func.id == "next" \
+                    and node.value.func.id not in self.mi.imports:
+                # x = next(counter): a fresh value per call
+                for t in node.targets:
+                    self.unbounded |= _target_names(t)
+        # loop-carried counters: `i += <const>` anywhere under a loop
+        for node in nodes:
+            if isinstance(node, (ast.For, ast.AsyncFor, ast.While)):
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.AugAssign) \
+                            and isinstance(sub.target, ast.Name) \
+                            and isinstance(sub.value, ast.Constant):
+                        self.unbounded.add(sub.target.id)
+
+    def _suspect(self, value: ast.expr):
+        """(kind, name) when the label-value expression mentions an
+        unbounded name or a request-id-ish identifier, else None."""
+        for n in ast.walk(value):
+            if isinstance(n, ast.Name):
+                if n.id in _ID_NAMES:
+                    return ("a request-id-shaped name", n.id)
+                if n.id in self.unbounded:
+                    return ("an unbounded loop index/counter", n.id)
+            elif isinstance(n, ast.Attribute) and n.attr in _ID_NAMES:
+                return ("a request-id-shaped attribute", n.attr)
+        return None
+
+    def run(self):
+        nodes = self._own_nodes()
+        self._collect_unbounded(nodes)
+        for node in nodes:
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "labels"):
+                continue
+            for kw in node.keywords:
+                if kw.arg is None:
+                    continue   # **splat: skipped (see class docstring)
+                hit = self._suspect(kw.value)
+                if hit is None:
+                    continue
+                what, name = hit
+                self.findings.append(Finding(
+                    rule="PHT005", file=self.mi.relpath,
+                    line=node.lineno, func=self.fi.qualname,
+                    message=f"metric label `{kw.arg}` takes a value "
+                            f"derived from {what} (`{name}`) — every "
+                            "new value mints a fresh time series, "
+                            "growing the registry and every scrape "
+                            "without bound",
+                    hint="keep labels a bounded enum (mode/flavor/"
+                         "phase/engine); per-request ids belong in "
+                         "spans, the flight recorder, or the request "
+                         "lifecycle record — and per-instance labels "
+                         "must drop on teardown "
+                         "(registry.drop_labels)"))
+
+
+# --------------------------------------------------------------------------
+# per-module driver (PHT001/002/004/005)
 # --------------------------------------------------------------------------
 
 def lint_module(mi: ModuleInfo) -> List[Finding]:
@@ -574,6 +722,9 @@ def lint_module(mi: ModuleInfo) -> List[Finding]:
         if isinstance(fi.node, ast.Lambda):
             continue
         _FuncWalker(mi, fi, qual in hot, jit_bindings, findings).run()
+        # PHT005 applies everywhere, not just hot paths: registry growth
+        # from an init-time loop is just as unbounded as from a tick
+        _LabelCardinalityWalker(mi, fi, findings).run()
 
     targets = _jit_targets(mi)
     # PHT004 scope: jitted bodies plus same-module functions they reach
