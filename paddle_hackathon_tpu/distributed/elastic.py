@@ -11,12 +11,20 @@ reference's elastic tests mock etcd (``test_fleet_elastic_manager.py``).
 
 from __future__ import annotations
 
+import random
 import threading
 import time
 from typing import Callable, Dict, List, Optional
 
-__all__ = ["ElasticStatus", "LeaseStore", "MemLeaseStore", "TCPLeaseStore",
-           "ElasticManager"]
+__all__ = ["ElasticStatus", "LeaseLostError", "LeaseStore", "MemLeaseStore",
+           "TCPLeaseStore", "ElasticManager"]
+
+
+class LeaseLostError(RuntimeError):
+    """A lease refresh could not reach the store after bounded retries —
+    the node must assume its membership lapsed (peers see its TTL
+    expire) and re-register / re-rendezvous rather than train on as if
+    still a member."""
 
 
 class ElasticStatus:
@@ -78,29 +86,93 @@ class MemLeaseStore(LeaseStore):
 class TCPLeaseStore(LeaseStore):
     """Lease store over the native TCPStore: value is ``payload|expiry``;
     expiry is refreshed by heartbeats and filtered on read (TTL semantics
-    without server-side timers)."""
+    without server-side timers).
 
-    def __init__(self, store):
+    Store I/O is TRANSIENTLY fallible (the master restarting, a dropped
+    connection): ``put_with_lease``/``refresh`` retry with bounded,
+    seeded-jittered exponential backoff — each retry counted into
+    ``elastic_store_retries_total{op=...}`` — instead of crashing the
+    heartbeat thread on the first hiccup.  A ``refresh`` that exhausts
+    its retries raises :class:`LeaseLostError` (a NAMED verdict the
+    caller can act on: re-register, re-rendezvous) rather than leaking
+    whatever socket exception the attempt died of.  Fault points
+    ``elastic.put``/``elastic.refresh`` fire inside each attempt, so the
+    drill harness exercises exactly this recovery path."""
+
+    def __init__(self, store, retries: int = 4, backoff_base: float = 0.05,
+                 backoff_max: float = 1.0, jitter_seed: int = 0):
         self._s = store
         self._registered = set()
+        self.retries = max(int(retries), 0)
+        self.backoff_base = float(backoff_base)
+        self.backoff_max = float(backoff_max)
+        self._jitter = random.Random(int(jitter_seed))
+        from ..observability import metrics as _obs
+        self._c_retries = _obs.get_registry().counter(
+            "elastic_store_retries_total",
+            "lease-store operations retried after a transient error")
+
+    def _with_retries(self, op: str, fn):
+        """Run ``fn`` with up to ``retries`` retried attempts.  Returns
+        ``fn()``'s value; re-raises the LAST error when exhausted."""
+        attempt = 0
+        while True:
+            try:
+                return fn()
+            except Exception:  # noqa: BLE001 — any transport error is
+                # retryable; non-transient errors surface after retries
+                if attempt >= self.retries:
+                    raise
+                self._c_retries.labels(op=op).inc()
+                # seeded jitter: deterministic under test, decorrelated
+                # across members in production (each store instance
+                # seeds differently)
+                sleep = min(self.backoff_base * (2 ** attempt),
+                            self.backoff_max)
+                time.sleep(sleep * (0.5 + self._jitter.random() / 2))
+                attempt += 1
 
     def put_with_lease(self, key, value, ttl):
-        self._s.set(key, f"{value}|{time.time() + ttl}")
-        if key not in self._registered:
-            # enumeration index: the store has no prefix scan, so members
-            # claim an atomic slot (add) and publish their key under it;
-            # deleted members leave tombstone slots filtered by check()
-            slot = self._s.add("__elastic_index/n", 1) - 1
-            self._s.set(f"__elastic_index/{slot}", key)
-            self._registered.add(key)
+        from ..observability import faults as _faults
+        claimed = [None]   # slot survives across retried attempts
+
+        def _put():
+            _faults.point("elastic.put")
+            self._s.set(key, f"{value}|{time.time() + ttl}")
+            if key not in self._registered:
+                # enumeration index: the store has no prefix scan, so
+                # members claim an atomic slot (add) and publish their
+                # key under it; deleted members leave tombstone slots
+                # filtered by check().  The claim is hoisted out of the
+                # retry body: a retried attempt must REUSE the slot the
+                # failed attempt already claimed, or every transient
+                # error grows the index every reader scans forever.
+                if claimed[0] is None:
+                    claimed[0] = self._s.add("__elastic_index/n", 1) - 1
+                self._s.set(f"__elastic_index/{claimed[0]}", key)
+                self._registered.add(key)
+
+        self._with_retries("put_with_lease", _put)
 
     def refresh(self, key, ttl):
-        if not self._s.check(key):
-            return False
-        raw = self._s.get(key).decode()
-        payload = raw.rsplit("|", 1)[0]
-        self._s.set(key, f"{payload}|{time.time() + ttl}")
-        return True
+        from ..observability import faults as _faults
+
+        def _refresh():
+            _faults.point("elastic.refresh")
+            if not self._s.check(key):
+                return False
+            raw = self._s.get(key).decode()
+            payload = raw.rsplit("|", 1)[0]
+            self._s.set(key, f"{payload}|{time.time() + ttl}")
+            return True
+
+        try:
+            return self._with_retries("refresh", _refresh)
+        except Exception as e:  # noqa: BLE001 — named verdict for callers
+            raise LeaseLostError(
+                f"lease refresh for {key!r} failed after "
+                f"{self.retries + 1} attempts ({type(e).__name__}: {e}) — "
+                f"assume the lease expired and re-register") from e
 
     def delete(self, key):
         self._s.delete_key(key)
@@ -171,9 +243,23 @@ class ElasticManager:
 
     def _heartbeat(self) -> None:
         while not self._stop.wait(self.interval):
-            if not self.store.refresh(self._key, self.ttl):
+            try:
+                ok = self.store.refresh(self._key, self.ttl)
+            except LeaseLostError:
+                # retries exhausted: treat as an expired lease and fall
+                # through to re-registration — a heartbeat thread that
+                # dies on a store hiccup silently drops this node from
+                # the job at the NEXT TTL expiry
+                ok = False
+            if not ok:
                 # lease lost (e.g. store restarted): re-register
-                self.store.put_with_lease(self._key, self.host, self.ttl)
+                try:
+                    self.store.put_with_lease(self._key, self.host,
+                                              self.ttl)
+                except Exception:  # noqa: BLE001 — keep beating; the
+                    # next interval retries (put_with_lease already did
+                    # its own bounded retries)
+                    pass
 
     def exit(self, completed: bool = True) -> None:
         self._stop.set()

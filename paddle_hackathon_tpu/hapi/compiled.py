@@ -186,6 +186,29 @@ class CompiledTrainer:
         self.ever_ran = True
         return losses
 
+    def checkpoint_flat(self):
+        """Flat checkpoint namespace over the CURRENT train state
+        (``params::*`` / ``opt::i::slot`` / ``step`` — the layout
+        ``parallel.checkpointing`` persists).  Values are the live
+        device refs; callers snapshot (``device_snapshot``) before the
+        next ``run()`` donates them."""
+        from ..parallel.checkpointing import flatten_train_state
+        return flatten_train_state(self.state["params"], self.state["opt"],
+                                   self.state["step"])
+
+    def load_checkpoint_flat(self, placed):
+        """Install a restored flat state (arrays already placed with
+        :meth:`checkpoint_flat`'s shardings): train state, the live
+        network's Parameters, and the optimizer's accumulators + step
+        count all see the resumed values (LR schedules included — one
+        tiny host sync of the step scalar, resume-time only)."""
+        from ..parallel.checkpointing import unflatten_train_state
+        params, opt_states, step = unflatten_train_state(placed)
+        self.state = {"params": params, "opt": opt_states, "step": step}
+        for k, v in params.items():
+            self._param_tensors[k]._set_value(v)
+        self.sync_optimizer()
+
     def sync_optimizer(self):
         """Write accumulators + step count back into the live optimizer
         (one small host sync for the step scalar — epoch-boundary cost)."""

@@ -148,7 +148,8 @@ class Model:
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
             drop_last=False, shuffle=True, num_workers=0, callbacks=None,
             accumulate_grad_batches=1, num_iters=None, jit_compile=None,
-            steps_per_execution=1, prefetch_buffer=2, nan_policy="record"):
+            steps_per_execution=1, prefetch_buffer=2, nan_policy="record",
+            checkpoint=None):
         """Train loop.  ``jit_compile=None`` (default) tries the compiled
         fast path — one donated jitted program per step (see
         ``hapi/compiled.py``) — and falls back to the eager
@@ -169,7 +170,18 @@ class Model:
         loss always increments ``train_nonfinite_total`` and records a
         flight-recorder event; ``"raise"`` additionally aborts with a
         clear error instead of silently training on garbage (default
-        ``"record"``: keep going — some recipes ride through spikes)."""
+        ``"record"``: keep going — some recipes ride through spikes).
+
+        ``checkpoint``: a directory (or
+        ``parallel.checkpointing.CheckpointConfig``) enabling async
+        crash-safe checkpoints on the compiled path: at the ``log_freq``
+        sync points the loop already pays, the train state (params +
+        optimizer accumulators + step + data cursor) is snapshot with
+        ONE on-device copy dispatch (no added host sync) and committed
+        atomically by a background writer; a crashed fit resumes from
+        the latest VALID checkpoint — torn shards/manifests are detected
+        and fall back — restoring step/epoch/RNG/cursor so the loss
+        series continues where it stopped (docs/CHECKPOINTING.md)."""
         train_loader = self._to_loader(train_data, batch_size, shuffle,
                                        drop_last, num_workers)
         eval_loader = (self._to_loader(eval_data, batch_size, False, False,
@@ -204,20 +216,59 @@ class Model:
                     f"Model.fit: using the eager path ({reason})")
         self._fit_used_compiled = trainer is not None
 
+        # crash-safe checkpointing (compiled path only — the eager tape
+        # has no functional state to snapshot donation-safely)
+        ckpt_driver = None
+        start_epoch = 0
+        skip_batches = 0
+        if checkpoint is not None:
+            if trainer is None:
+                # direct warn, NOT _log_fallback_once: the once-only
+                # flag may already be spent on the eager-fallback log,
+                # and losing crash safety must never be silent
+                import warnings
+                warnings.warn(
+                    "Model.fit: checkpoint= requires the compiled fit "
+                    "path; training continues WITHOUT crash-safe "
+                    "checkpoints", RuntimeWarning, stacklevel=2)
+            else:
+                from ..parallel.checkpointing import FitCheckpointer
+                ckpt_driver = FitCheckpointer(checkpoint)
+                ckpt_driver.global_step = int(
+                    getattr(self._optimizer, "_step_count", 0) or 0)
+                resumed = ckpt_driver.resume(trainer.checkpoint_flat())
+                if resumed is not None:
+                    placed, start_epoch, skip_batches = resumed
+                    trainer.load_checkpoint_flat(placed)
+
         self.stop_training = False
         logs = {}   # epochs=0: on_train_end still needs a value
         try:
             cbk.on_train_begin()
-            for epoch in range(epochs):
+            for epoch in range(start_epoch, epochs):
                 cbk.on_epoch_begin(epoch)
+                if ckpt_driver is not None:
+                    # capture the shuffle RNG before the epoch's
+                    # permutation draws from it (exact-data-order resume)
+                    ckpt_driver.mark_epoch()
                 for m in self._metrics:
                     m.reset()
                 logs = {}
                 if trainer is not None:
                     logs, trainer = self._run_compiled_epoch(
                         trainer, train_loader, cbk, log_freq, num_iters,
-                        steps_per_execution, prefetch_buffer, nan_policy)
+                        steps_per_execution, prefetch_buffer, nan_policy,
+                        epoch=epoch, ckpt=ckpt_driver,
+                        skip_batches=(skip_batches
+                                      if epoch == start_epoch else 0))
                     self._fit_used_compiled = trainer is not None
+                    if ckpt_driver is not None and trainer is not None:
+                        # epoch-boundary save: the epoch-end fetch just
+                        # drained the pipeline; the snapshot is still
+                        # one device-copy dispatch, no extra sync
+                        ckpt_driver.maybe_save(
+                            trainer.checkpoint_flat(), epoch=epoch + 1,
+                            cursor=0, force=True)
                 else:
                     from ..observability import tracing as _tr
                     for step, batch in enumerate(train_loader):
@@ -250,12 +301,25 @@ class Model:
                 if self.stop_training:
                     break
             cbk.on_train_end(logs)
+            if ckpt_driver is not None:
+                # drain the writer before returning: a fit that exits
+                # with its last checkpoint still queued isn't durable
+                ckpt_driver.finish()
             # clean completion: a finished fit must not leave a
             # forever-stale beacon 503ing /healthz?max_age (a crashed
             # fit keeps its beacon — going stale IS the alert)
             from ..observability import tracing as _tr_
             _tr_.remove_beacon("train.hapi_fit")
         except BaseException as e:
+            if ckpt_driver is not None:
+                # an IN-PROCESS failure can still flush the last parked
+                # snapshot — the resume point should be as fresh as the
+                # crash allows (a hard kill can't flush; that is what
+                # the atomic commit protocol covers)
+                try:
+                    ckpt_driver.finish()
+                except Exception:  # noqa: BLE001 — never mask the crash
+                    pass
             # every crashed fit leaves a post-mortem: the flight ring
             # holds the recent step/telemetry events (and the watchdog's
             # nonfinite marks) that led up to the failure
@@ -298,11 +362,17 @@ class Model:
                 "and only counts)")
 
     def _run_compiled_epoch(self, trainer, loader, cbk, log_freq, num_iters,
-                            k, prefetch_buffer, nan_policy="record"):
+                            k, prefetch_buffer, nan_policy="record",
+                            epoch=0, ckpt=None, skip_batches=0):
         """One epoch through the compiled trainer.  Returns
         ``(logs, trainer_or_None)`` — None when the first program trace
         failed (Python-side control flow in forward, unjittable op) and
-        the epoch finished on the eager path instead."""
+        the epoch finished on the eager path instead.
+
+        ``ckpt`` (a ``parallel.checkpointing.FitCheckpointer``) saves at
+        the ``log_freq`` fetches below; ``skip_batches`` fast-forwards
+        the loader past batches a resumed checkpoint already trained
+        (host-side pulls only — no device work for skipped batches)."""
         import itertools
         import time
 
@@ -406,6 +476,16 @@ class Model:
         k = max(int(k), 1)
         it = iter(loader)
         pulled = 0
+        # resume fast-forward: the checkpoint's cursor counts batches its
+        # state already trained this epoch — consume them host-side so
+        # the resumed run sees the SAME data order a crash-free run saw
+        skip_batches = int(skip_batches)
+        for _ in range(skip_batches):
+            if next(it, None) is None:
+                break
+        if num_iters is not None:
+            num_iters = max(int(num_iters) - skip_batches, 0)
+        consumed = skip_batches   # batches the train STATE has absorbed
 
         def _leaf(v):
             return v._value if isinstance(v, Tensor) else np.asarray(v)
@@ -456,6 +536,17 @@ class Model:
                 self._log_fallback_once(
                     "Model.fit: compiled trainer failed to trace "
                     f"({type(e).__name__}: {e}); falling back to eager")
+                if ckpt is not None:
+                    # the once-only fallback log above may already be
+                    # spent — losing crash safety mid-run deserves its
+                    # own explicit warning, not silence
+                    import warnings
+                    warnings.warn(
+                        "Model.fit: the compiled trainer fell back to "
+                        "eager MID-RUN; crash-safe checkpointing is "
+                        "DISABLED for the rest of this fit (the eager "
+                        "tape has no functional state to snapshot)",
+                        RuntimeWarning, stacklevel=2)
                 trainer.restore_eager()
                 for exs, eys in itertools.chain([(xs, ys)], groups):
                     n = int(jax.tree.leaves(exs)[0].shape[0])
@@ -488,6 +579,9 @@ class Model:
             _seqlen = int(lead.shape[2]) if lead.ndim == 3 else None
             toks_per_step = int(lead.shape[1]) * (_seqlen or 1)
             n = int(losses.shape[0])
+            consumed += n
+            if ckpt is not None:
+                ckpt.advance(n)
             # phase attribution: amortize the K-step program-call wall
             # over its K inner steps — a telemetry window closing MID-
             # superstep (log_freq % k != 0, the default shapes) must
@@ -525,6 +619,13 @@ class Model:
                         # not a dispatch
                         self._observe_moe_aux(
                             float(trainer.last_aux[j]), "hapi_compiled")
+                    if ckpt is not None:
+                        # async checkpoint at the sync point just paid:
+                        # one on-device copy dispatch + a queue handoff —
+                        # the d2h fetch and disk I/O happen on the
+                        # writer thread (parallel/checkpointing.py)
+                        ckpt.maybe_save(trainer.checkpoint_flat(),
+                                        epoch=epoch, cursor=consumed)
                     last_watched = step
                 logs = {"loss": v}
                 cbk.on_train_batch_end(step, logs)

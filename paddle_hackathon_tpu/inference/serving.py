@@ -49,6 +49,7 @@ from typing import List, Optional
 
 import numpy as np
 
+from ..observability import faults as _faults
 from ..observability import flight as _flight
 from ..observability import metrics as _obs
 from ..observability.sanitizers import make_lock
@@ -111,11 +112,25 @@ def _named_dtype(name):
         return np.dtype(getattr(ml_dtypes, name))
 
 
+class TornArtifactError(RuntimeError):
+    """A serving artifact directory is incomplete — a crash mid-save by
+    a pre-atomic writer, or a partial copy.  :func:`save_for_serving`
+    commits atomically (tmp dir + rename), so a torn directory is
+    always externally produced; :func:`load_for_serving` refuses to
+    half-load it."""
+
+
 def save_for_serving(model, path, quant=None):
     """Persist ``{config.json, params.npz}`` so a serving process — in
     particular the C++ shim (``native/serving.cc pht_engine_create``) —
     can rebuild the model without the training script (the role of the
     reference's ``save_inference_model`` artifact for ``DistModel``).
+
+    ATOMIC: both files land in a tmp directory (``params.npz`` first,
+    ``config.json`` — the manifest — last, both fsync'd) which is then
+    renamed over ``path``; a crash mid-save leaves the previous artifact
+    (or nothing) — never a torn directory a later
+    :func:`load_for_serving` would half-load.
 
     Works for any param dtype: bf16 (the expected serving dtype — the
     bench casts GPT-2 to bf16) and other ml_dtypes store as uint views
@@ -138,7 +153,7 @@ def save_for_serving(model, path, quant=None):
     import dataclasses
     import json
     import os
-    os.makedirs(path, exist_ok=True)
+    import shutil
     params = {k: v._value for k, v in model.named_parameters()}
     scheme = None
     if quant is not None:
@@ -163,20 +178,110 @@ def save_for_serving(model, path, quant=None):
             scheme = ("int8" if dtypes[manifest[0]] == "int8"
                       else "fp8-e4m3")
         meta["quant"] = {"scheme": scheme, "params": manifest}
-    with open(os.path.join(path, "config.json"), "w") as f:
-        json.dump(meta, f)
-    np.savez(os.path.join(path, "params.npz"), **arrs)
+    # atomic commit: params first, the config manifest last, rename the
+    # whole directory into place (same trio as the training checkpoints,
+    # parallel/checkpointing.py — docs/CHECKPOINTING.md)
+    import uuid
+    path = os.fspath(path)
+    # pid identifies the owner for the liveness sweep; the uuid keeps
+    # concurrent saves from different THREADS of one process (same pid)
+    # off each other's tmp dirs
+    tmp = f"{path}.saving-{os.getpid()}-{uuid.uuid4().hex[:8]}"
+    old = f"{path}.old"
+    # sweep tmp dirs orphaned by a DEAD process's hard kill: each holds
+    # a full-model-size params.npz nothing else would ever delete.  A
+    # dir whose owner pid is still alive (this process included — a
+    # concurrent thread's save) is left alone
+    import glob as _glob
+    for stale in _glob.glob(f"{path}.saving-*"):
+        try:
+            pid = int(stale.split(".saving-", 1)[1].split("-", 1)[0])
+            os.kill(pid, 0)       # raises if the owner is gone
+            continue              # owner alive: not ours to sweep
+        except (ValueError, ProcessLookupError):
+            pass                  # malformed name or dead owner: sweep
+        except PermissionError:
+            continue              # alive under another uid
+        shutil.rmtree(stale, ignore_errors=True)
+    os.makedirs(tmp)
+    try:
+        with open(os.path.join(tmp, "params.npz"), "wb") as f:
+            np.savez(f, **arrs)
+            f.flush()
+            os.fsync(f.fileno())
+        with open(os.path.join(tmp, "config.json"), "w") as f:
+            json.dump(meta, f)
+            f.flush()
+            os.fsync(f.fileno())
+        # carry sidecar files (tokenizer.json etc.) the user keeps next
+        # to the framework's two into the replacement — a re-export must
+        # not silently destroy them.  After a swap-window crash the live
+        # artifact is .old, so sidecars come from there.
+        side_src = path if os.path.isdir(path) else (
+            old if os.path.isdir(old) else None)
+        if side_src is not None:
+            for n in os.listdir(side_src):
+                if n in ("config.json", "params.npz"):
+                    continue
+                src, dst = os.path.join(side_src, n), os.path.join(tmp, n)
+                if os.path.isdir(src):
+                    shutil.copytree(src, dst)
+                else:
+                    shutil.copy2(src, dst)
+        if os.path.isdir(path):
+            # `path` is a complete artifact, so a stale .old (leftover
+            # of a crash AFTER a previous commit) is disposable.  Never
+            # delete .old while it may be the only valid copy — when
+            # `path` is missing (crash inside a previous swap window),
+            # .old survives until the rename below commits.
+            shutil.rmtree(old, ignore_errors=True)
+            os.rename(path, old)
+        os.rename(tmp, path)
+        shutil.rmtree(old, ignore_errors=True)
+        # durability of the rename itself (same protocol step as
+        # checkpointing._write_checkpoint_dir's root fsync)
+        from ..parallel.checkpointing import _fsync_dir
+        _fsync_dir(os.path.dirname(os.path.abspath(path)))
+    except BaseException:
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise
 
 
 def load_for_serving(path):
-    """Rebuild the model saved by :func:`save_for_serving`."""
+    """Rebuild the model saved by :func:`save_for_serving`.
+
+    A torn artifact (missing/truncated ``config.json`` or missing
+    ``params.npz``) raises :class:`TornArtifactError` instead of
+    half-loading; a directory caught between the two renames of an
+    atomic re-save falls back to the surviving ``.old`` artifact."""
     import json
     import os
 
     from ..core.tensor import Tensor
     from ..models import gpt as _gpt
-    with open(os.path.join(path, "config.json")) as f:
-        meta = json.load(f)
+    path = os.fspath(path)
+    if not os.path.isdir(path) and os.path.isdir(path + ".old"):
+        # crash inside save_for_serving's swap window: the previous
+        # artifact is complete at .old — serve that
+        path = path + ".old"
+    if not os.path.isdir(path):
+        raise FileNotFoundError(path)
+    cfg_p = os.path.join(path, "config.json")
+    npz_p = os.path.join(path, "params.npz")
+    for p in (cfg_p, npz_p):
+        if not os.path.exists(p):
+            raise TornArtifactError(
+                f"serving artifact at {path} is torn: {os.path.basename(p)} "
+                f"is missing — the save crashed mid-write (pre-atomic "
+                f"writer) or the copy was partial; re-export with "
+                f"save_for_serving")
+    try:
+        with open(cfg_p) as f:
+            meta = json.load(f)
+    except ValueError as e:
+        raise TornArtifactError(
+            f"serving artifact at {path} is torn: config.json does not "
+            f"parse ({e}) — re-export with save_for_serving") from e
     cls = getattr(_gpt, meta["model"])
     model = cls(_gpt.GPTConfig(**meta["config"]))
     model.eval()
@@ -212,6 +317,14 @@ def load_for_serving(path):
     return model
 
 
+class DeadlineExceededError(RuntimeError):
+    """A request sat in the admission queue past its
+    ``submit(deadline_s=)`` budget and was aborted un-served: waiting
+    longer can only return an answer the caller has already given up
+    on.  Counted into ``serving_aborted_tokens_total`` and stamped
+    ``t_abort``/``where="queued"`` on the request's lifecycle record."""
+
+
 class Request:
     """One in-flight generation request.
 
@@ -232,16 +345,17 @@ class Request:
     __slots__ = ("prompt", "max_new_tokens", "tokens", "done", "error",
                  "temperature", "top_k", "top_p", "_event",
                  "_t_submit", "_t_first", "rid", "_span_queue",
-                 "_span_life", "lifecycle", "_tick_mark")
+                 "_span_life", "lifecycle", "_tick_mark", "deadline_s")
 
     def __init__(self, prompt, max_new_tokens, temperature=None,
-                 top_k=None, top_p=None):
+                 top_k=None, top_p=None, deadline_s=None):
         self.rid = next(_REQ_IDS)   # process-wide request id (spans/flight)
         self.prompt = np.asarray(prompt, np.int32).reshape(-1)
         self.max_new_tokens = int(max_new_tokens)
         self.temperature = None if temperature is None else float(temperature)
         self.top_k = None if top_k is None else int(top_k)
         self.top_p = None if top_p is None else float(top_p)
+        self.deadline_s = None if deadline_s is None else float(deadline_s)
         self.tokens: List[int] = []  # generated so far
         self.done = False
         self.error: Optional[BaseException] = None
@@ -254,6 +368,8 @@ class Request:
                           "prompt_len": int(self.prompt.shape[0]),
                           "max_new_tokens": self.max_new_tokens,
                           "t_submit": self._t_submit}
+        if self.deadline_s is not None:
+            self.lifecycle["deadline_s"] = self.deadline_s
         # lifecycle spans (no-ops while tracing is disabled): queued =
         # submit->admit, life = submit->finish/EOS
         self._span_queue = self._span_life = _tr._NOOP
@@ -397,6 +513,10 @@ class ServingEngine:
 
         self._lock = make_lock("serving.engine")
         self._pending = collections.deque()
+        # count of queued requests carrying a submit(deadline_s=): the
+        # per-tick expiry sweep is gated on this, so the common
+        # no-deadline case pays one int check, not an O(queue) scan
+        self._deadline_queued = 0
         self._slots = [_Slot() for _ in range(self.max_slots)]
         self._lengths = np.zeros(self.max_slots, np.int32)
         self._inflight = {}  # wave -> (consumed, finishing, reqs) at entry
@@ -1199,9 +1319,16 @@ class ServingEngine:
     # ------------------------------------------------------------------
     # scheduling
     def submit(self, prompt, max_new_tokens=32, temperature=None,
-               top_k=None, top_p=None) -> Request:
+               top_k=None, top_p=None, deadline_s=None) -> Request:
+        """Queue a request.  ``deadline_s`` bounds the ADMISSION wait: a
+        request still queued ``deadline_s`` after submit is aborted with
+        :class:`DeadlineExceededError` (``req.error``; ``req.wait()``
+        returns, ``result()`` raises) instead of waiting forever behind
+        a saturated engine — the caller has already timed out, serving
+        it would be wasted work the goodput accounting counts against
+        ``serving_aborted_tokens_total``."""
         req = Request(prompt, max_new_tokens, temperature=temperature,
-                      top_k=top_k, top_p=top_p)
+                      top_k=top_k, top_p=top_p, deadline_s=deadline_s)
         need = len(req.prompt) + req.max_new_tokens
         # reserve headroom past the last committed row for the widest
         # in-flight write: a prefill chunk, or the (spec_k+1)-wide verify
@@ -1251,6 +1378,8 @@ class ServingEngine:
             prompt_len=len(req.prompt), max_new=req.max_new_tokens)
         with self._lock:
             self._pending.append(req)
+            if req.deadline_s is not None:
+                self._deadline_queued += 1
             self._c["requests"].inc()
             self._g_queue.set(len(self._pending))
             if self.auto_run and not self._running:
@@ -1291,6 +1420,7 @@ class ServingEngine:
         caught this).  Deferral is safe — only the driver thread touches
         slot state, and the replay only needs to land before this tick's
         post-verify ingest, which runs later on this same thread."""
+        self._expire_queued_locked()
         replays = []
         for i, slot in enumerate(self._slots):
             if slot.req is not None or not self._pending:
@@ -1301,6 +1431,8 @@ class ServingEngine:
                 if skip is None:
                     break  # pool exhausted for the FIFO head
             slot.req = req = self._pending.popleft()
+            if req.deadline_s is not None:
+                self._deadline_queued -= 1
             self._sampling_cache = None  # membership changed: restage
             slot.off = skip   # prefix-cache hit: those rows are already KV
             slot.last = 0
@@ -1317,6 +1449,40 @@ class ServingEngine:
                 "req", phase="admit", rid=req.rid, engine=self._engine_id,
                 slot=i, prefix_hit=skip, queue_s=round(queue_s, 6))
         return replays
+
+    def _expire_queued_locked(self):
+        """Abort queued requests past their ``submit(deadline_s=)``
+        budget (runs at every ``_admit``, i.e. every tick).  The common
+        case — nobody set a deadline — is one int check, no queue scan,
+        no clock read (``_deadline_queued`` is maintained by submit/
+        admit/expiry/fail-all)."""
+        if not self._deadline_queued:
+            return
+        now = time.perf_counter()
+        keep = collections.deque()
+        for req in self._pending:
+            wait_s = now - req._t_submit
+            if req.deadline_s is None or wait_s <= req.deadline_s:
+                keep.append(req)
+                continue
+            self._deadline_queued -= 1
+            req.error = DeadlineExceededError(
+                f"request {req.rid} queued {wait_s:.3f}s, past its "
+                f"deadline_s={req.deadline_s}; aborted un-admitted")
+            # goodput accounting: same books as the loop fail-all —
+            # a queued abort contributes its (zero) generated tokens
+            self._c["aborted_tokens"].inc(len(req.tokens))
+            req.lifecycle.update(
+                t_abort=now, aborted=True, tokens=len(req.tokens),
+                where="queued", error="DeadlineExceededError")
+            req._span_queue.end(error="DeadlineExceededError")
+            req._span_life.end(error="DeadlineExceededError")
+            self._flight.record(
+                "req", phase="abort", rid=req.rid,
+                engine=self._engine_id, where="queued",
+                wait_s=round(wait_s, 6), error="DeadlineExceededError")
+            req._event.set()
+        self._pending = keep
 
     def _paged_admit_locked(self, i, req):
         """Reserve slot ``i``'s whole page footprint up front (worst-case
@@ -1556,6 +1722,11 @@ class ServingEngine:
                          committed=committed, **extra)
 
     def _step_impl(self) -> bool:  # pht-lint: hot-root (tick body)
+        # fault-injection drill point (observability/faults.py): armed,
+        # it kills/fails/delays a tick deterministically — how the
+        # fail-all path below and the crash-dump post-mortem are
+        # drilled; disarmed it is one empty-dict probe per tick
+        _faults.point("serving.step")
         with self._lock:
             if self._running and \
                     threading.current_thread() is not self._loop_thread:
@@ -1886,6 +2057,7 @@ class ServingEngine:
                     for req in list(self._pending):
                         _fail(req, "pending")
                     self._pending.clear()
+                    self._deadline_queued = 0
                     for i, slot in enumerate(self._slots):
                         if slot.req is not None:
                             _fail(slot.req, "slot")

@@ -167,12 +167,17 @@ def device_prefetch(iterator, size=2, device=None):
         return jax.tree.map(_put_leaf, batch,
                             is_leaf=lambda t: isinstance(t, Tensor))
 
+    from ..observability import faults as _faults
+
     it = iter(iterator)
     buf = collections.deque()
     size = max(int(size), 1)
     while True:
         while len(buf) < size:
             try:
+                # drill point for the crash harness: a dataloader dying
+                # (or stalling) mid-fit is a canonical training failure
+                _faults.point("io.prefetch")
                 t0 = _time.perf_counter()
                 nxt = next(it)
                 wait_hist.observe(_time.perf_counter() - t0)

@@ -34,6 +34,9 @@ The event-level half lives next door and completes the triad:
 - ``server`` — opt-in stdlib HTTP introspection
   (:func:`start_introspection_server`: ``/metrics``, ``/healthz``,
   ``/debug/flight``, ``/debug/requests``).
+- ``faults`` — deterministic fault-injection harness (named points,
+  ``PHT_FAULTS`` seeded schedules; zero-cost while disarmed) drilling
+  the crash-safety layer (``docs/CHECKPOINTING.md``).
 - ``sanitizers`` — opt-in runtime lock-order checker
   (``PHT_LOCK_SANITIZER=1``; fail-fast cycle detection over the engine/
   registry/tracing/flight/dataloader locks) and
@@ -44,7 +47,8 @@ The event-level half lives next door and completes the triad:
 Metric catalog and endpoint reference: ``docs/OBSERVABILITY.md``.
 """
 
-from . import flight, sanitizers, tracing
+from . import faults, flight, sanitizers, tracing
+from .faults import InjectedFault
 from .flight import FlightRecorder, get_flight_recorder
 from .metrics import (Counter, Gauge, Histogram, MetricRegistry,
                       SlidingWindowHistogram, get_registry, instrument_jit,
@@ -63,8 +67,8 @@ __all__ = ["MetricRegistry", "Counter", "Gauge", "Histogram",
            "disable_tracing", "tracing_enabled", "FlightRecorder",
            "get_flight_recorder", "start_introspection_server",
            "forbid_host_transfers", "make_lock", "make_rlock",
-           "HostTransferError", "LockOrderError",
-           "flight", "sanitizers", "tracing"]
+           "HostTransferError", "LockOrderError", "InjectedFault",
+           "faults", "flight", "sanitizers", "tracing"]
 
 
 def start_introspection_server(*args, **kwargs):

@@ -54,6 +54,9 @@ from . import auto_parallel  # noqa: F401
 from .auto_parallel import (Engine, ProcessMesh, shard_op,  # noqa: F401
                             shard_tensor)
 from .store import TCPStore  # noqa: F401
+from . import checkpointing  # noqa: F401
+from .checkpointing import (CheckpointConfig, CheckpointManager,  # noqa: F401
+                            CorruptCheckpointError, elastic_rendezvous)
 from .dist_checkpoint import (load_sharded, load_train_state,  # noqa: F401
                               reshard, save_sharded, save_train_state)
 from .planner import (MeshPlan, enumerate_meshes, plan_mesh,  # noqa: F401

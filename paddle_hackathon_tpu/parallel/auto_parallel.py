@@ -311,8 +311,13 @@ class Engine:
                 opt_states = [{k: jax.device_put(v, repl)
                                for k, v in st.items()} for st in opt_states]
         self._buffers = buffers
+        # step replicated ONTO the mesh (not default-device): checkpoint
+        # resume places arrays with these shardings, and a single-device
+        # committed step next to mesh-wide params would split the jitted
+        # step across incompatible device sets
         self._state = {"params": params, "opt_states": opt_states,
-                       "step": jnp.zeros((), jnp.int32)}
+                       "step": jax.device_put(jnp.zeros((), jnp.int32),
+                                              repl)}
 
     def _build_train_step(self):
         opt = self.optimizer
@@ -481,13 +486,39 @@ class Engine:
 
     def fit(self, train_data, epochs: int = 1, batch_size: int = 1,
             steps_per_epoch: Optional[int] = None, valid_data=None,
-            log_freq: int = 10, verbose: int = 0):
-        """Ref ``Engine.fit`` ``engine.py``: compiled SPMD train loop."""
+            log_freq: int = 10, verbose: int = 0, checkpoint=None):
+        """Ref ``Engine.fit`` ``engine.py``: compiled SPMD train loop.
+
+        ``checkpoint``: directory or ``checkpointing.CheckpointConfig``
+        — async atomic checkpoints every ``log_freq`` steps (one
+        on-device copy dispatch; d2h + disk on the writer thread) and
+        elastic resume: a checkpoint written on one dp size resumes on
+        another, the restore placing every shard with THIS engine's
+        ``NamedSharding``s (docs/CHECKPOINTING.md)."""
         self.prepare(mode="train")
         step_fn = self._steps["train"]
         loader = self._loader(train_data, batch_size, shuffle=True,
                               drop_last=True)
         st = self._state
+        ckpt_driver = None
+        start_epoch = skip = 0
+        if checkpoint is not None:
+            from .checkpointing import (FitCheckpointer, flatten_train_state,
+                                        unflatten_train_state)
+            ckpt_driver = FitCheckpointer(checkpoint)
+            resumed = ckpt_driver.resume(flatten_train_state(
+                st["params"], st["opt_states"], st["step"]))
+            if resumed is not None:
+                placed, start_epoch, skip = resumed
+                params, opt_states, step = unflatten_train_state(placed)
+                # resume across a CHANGED dp size is implicit here: the
+                # restore placed every array with this engine's (new)
+                # mesh shardings — GSPMD's answer to the reference
+                # Converter's slice/merge machinery
+                st.update(params=params, opt_states=opt_states, step=step)
+        mesh_meta = {"mesh": {str(n): int(s) for n, s in
+                              zip(self.mesh.axis_names,
+                                  self.mesh.devices.shape)}}
         history = []
         # MFU/tokens-per-sec accounting (same contract as Model.fit's
         # compiled path, path="engine"): measured from the moment the
@@ -498,38 +529,81 @@ class Engine:
         t_mark = None
         tokens_done = 0
         seqlen = None
-        for epoch in range(epochs):
-            for i, batch in enumerate(loader):
-                if steps_per_epoch is not None and i >= steps_per_epoch:
-                    break
-                x, y = self._to_arrays(batch)
-                lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
-                t0n = time.perf_counter_ns()
-                p, o, s, loss = step_fn(st["params"], st["opt_states"],
-                                        st["step"], lr, (x, y))
-                st.update(params=p, opt_states=o, step=s)
-                _tr.heartbeat("train.engine_fit")  # /healthz step recency
-                if _tr.tracing_enabled():
-                    # dispatch wall per SPMD step (async device time
-                    # surfaces only at the verbose log_freq float())
-                    _tr.add_span("parallel.engine_step", t0n,
-                                 time.perf_counter_ns(), epoch=epoch,
-                                 step=i)
-                if t_mark is None:
-                    t_mark = time.perf_counter()   # compile excluded
-                else:
-                    seqlen = int(x.shape[1]) if np.ndim(x) == 2 else None
-                    tokens_done += int(x.shape[0]) * (seqlen or 1)
-                # keep the raw device array: float() would force a host sync
-                # every step and stall async dispatch
-                history.append(loss)
-                if verbose and i % log_freq == 0:
-                    print(f"[auto_parallel] epoch {epoch} step {i} "
-                          f"loss {float(loss):.5f}")
-            if valid_data is not None:
-                self.evaluate(valid_data, batch_size=batch_size,
-                              verbose=verbose)
+        try:
+            for epoch in range(start_epoch, epochs):
+                if ckpt_driver is not None:
+                    # capture the shuffle RNG before the epoch's
+                    # permutation draws from it (exact-data-order resume)
+                    ckpt_driver.mark_epoch()
+                for i, batch in enumerate(loader):
+                    if steps_per_epoch is not None and i >= steps_per_epoch:
+                        break
+                    if epoch == start_epoch and i < skip:
+                        # resume fast-forward: batches the checkpointed
+                        # state already trained — consumed (data order
+                        # preserved), never dispatched
+                        continue
+                    x, y = self._to_arrays(batch)
+                    lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
+                    t0n = time.perf_counter_ns()
+                    p, o, s, loss = step_fn(st["params"], st["opt_states"],
+                                            st["step"], lr, (x, y))
+                    st.update(params=p, opt_states=o, step=s)
+                    _tr.heartbeat("train.engine_fit")  # /healthz recency
+                    if _tr.tracing_enabled():
+                        # dispatch wall per SPMD step (async device time
+                        # surfaces only at the verbose log_freq float())
+                        _tr.add_span("parallel.engine_step", t0n,
+                                     time.perf_counter_ns(), epoch=epoch,
+                                     step=i)
+                    if t_mark is None:
+                        t_mark = time.perf_counter()   # compile excluded
+                    else:
+                        seqlen = int(x.shape[1]) if np.ndim(x) == 2 else None
+                        tokens_done += int(x.shape[0]) * (seqlen or 1)
+                    # keep the raw device array: float() would force a host
+                    # sync every step and stall async dispatch
+                    history.append(loss)
+                    if ckpt_driver is not None:
+                        ckpt_driver.advance(1)
+                        if log_freq and (i + 1) % log_freq == 0:
+                            # one on-device copy dispatch + queue handoff;
+                            # the writer thread owns the d2h fetch — the
+                            # step loop stays sync-free
+                            ckpt_driver.maybe_save(
+                                flatten_train_state(st["params"],
+                                                    st["opt_states"],
+                                                    st["step"]),
+                                epoch=epoch, cursor=i + 1, meta=mesh_meta)
+                    if verbose and i % log_freq == 0:
+                        print(f"[auto_parallel] epoch {epoch} step {i} "
+                              f"loss {float(loss):.5f}")
+                if ckpt_driver is not None:
+                    ckpt_driver.maybe_save(
+                        flatten_train_state(st["params"], st["opt_states"],
+                                            st["step"]),
+                        epoch=epoch + 1, cursor=0, meta=mesh_meta,
+                        force=True)
+                if valid_data is not None:
+                    self.evaluate(valid_data, batch_size=batch_size,
+                                  verbose=verbose)
+        except BaseException as e:
+            if ckpt_driver is not None:
+                # an in-process failure can still flush the last parked
+                # snapshot (a hard kill can't — the atomic commit
+                # protocol covers that case)
+                try:
+                    ckpt_driver.finish()
+                except Exception:  # noqa: BLE001 — never mask the crash
+                    pass
+            from ..observability import flight as _flight
+            _flight.crash_dump("parallel.Engine.fit", e)
+            raise
         self._sync_back()
+        if ckpt_driver is not None:
+            # drain the writer: a fit that returns with its final
+            # checkpoint still queued isn't durable
+            ckpt_driver.finish()
         # clean completion: drop the beacon (a crashed fit keeps it —
         # going stale on /healthz?max_age IS the alert)
         _tr.remove_beacon("train.engine_fit")

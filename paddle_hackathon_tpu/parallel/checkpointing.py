@@ -1,0 +1,720 @@
+"""Async crash-safe training checkpoints: atomic commit, elastic resume.
+
+The reference framework's fleet stack treats failure as routine
+(``distributed/elastic`` relaunches, ``incubate/checkpoint``
+auto-snapshots); this module is that contract for the one-program
+trainers behind ``Model.fit`` and ``auto_parallel.Engine.fit``:
+
+- **Zero added host syncs.** At a sync point the fit loop already pays
+  (the ``log_freq`` loss fetch), the training thread runs ONE jitted
+  copy program (:func:`device_snapshot`) — a device-side dispatch, not
+  a fetch — and enqueues the copy.  The copy is what makes the snapshot
+  donation-safe: the trainer's next superstep donates its state buffers
+  in place, so the writer thread must own buffers nothing else will
+  invalidate.  The ``device_get`` (the designed d2h fetch), the
+  serialization and the disk I/O all happen on the background writer
+  thread.
+
+- **Atomic commit.** A checkpoint is a directory: one shard file per
+  array (+ crc32 checksum recorded per shard), each fsync'd, then
+  ``manifest.json`` written and fsync'd LAST, then the whole tmp
+  directory renamed into place.  A crash at ANY point leaves either the
+  previous checkpoints untouched (tmp dirs are ignored and swept) or a
+  complete new one.  A torn shard or torn manifest — e.g. bit-rot, a
+  crash inside a non-atomic filesystem — is *detected at load* (json
+  parse, per-shard size+crc32) and falls back to the previous valid
+  checkpoint; corruption is never loaded silently.
+
+- **Elastic resume across a changed dp size.** :func:`restore_like`
+  places every array with the RESUMING trainer's sharding
+  (``jax.device_put`` onto the new mesh — GSPMD moves the bytes, the
+  whole of what the reference's Converter does by hand), so a
+  checkpoint written on dp=4 resumes on dp=2, dp=8, or a single chip.
+  :func:`elastic_rendezvous` sizes the new world from the TTL-lease
+  membership (``distributed/elastic``).
+
+Fault points (``observability/faults.py`` — the drill harness):
+``ckpt.shard_write``, ``ckpt.manifest_write``, ``ckpt.commit``.
+
+Manifest format, retention and the fault-injection howto:
+``docs/CHECKPOINTING.md``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import shutil
+import threading
+import time
+import zlib
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..observability import faults as _faults
+from ..observability import flight as _flight
+from ..observability import metrics as _obs
+from ..observability.sanitizers import make_lock
+from .dist_checkpoint import _from_storable, _np_dtype, _to_storable
+
+__all__ = ["CheckpointConfig", "CheckpointManager", "CorruptCheckpointError",
+           "FitCheckpointer", "device_snapshot", "flatten_train_state",
+           "unflatten_train_state", "load_checkpoint", "load_latest",
+           "restore_like", "list_checkpoints", "elastic_rendezvous"]
+
+MANIFEST = "manifest.json"
+_VERSION = 1
+_PREFIX = "ckpt-"
+_TMP_PREFIX = ".tmp-"
+_SEP = "::"
+
+
+class CorruptCheckpointError(RuntimeError):
+    """A checkpoint directory failed validation (torn manifest, torn or
+    missing shard, checksum mismatch).  Raised by :func:`load_checkpoint`
+    on a specific directory; the latest-valid search catches it and
+    falls back instead."""
+
+
+# ---------------------------------------------------------------------------
+# snapshot (training-thread side)
+# ---------------------------------------------------------------------------
+
+# ONE program per state structure (jax caches by pytree/avals): copies
+# every leaf into fresh buffers the trainer's donation cannot touch.
+# Module-level so no jit is constructed inside the fit loop (PHT002).
+_copy_tree = jax.jit(lambda t: jax.tree.map(jnp.copy, t))
+
+
+def device_snapshot(flat):  # pht-lint: hot-root (fit sync-point snapshot)
+    """Donation-safe on-device copy of a flat state dict.
+
+    One jitted program dispatch, NO device→host transfer: the training
+    thread stays async while the writer thread fetches the copy at its
+    own pace.  Non-array leaves (step/epoch ints) pass through."""
+    arrs = {k: v for k, v in flat.items() if isinstance(v, jax.Array)}
+    out = {k: v for k, v in flat.items() if not isinstance(v, jax.Array)}
+    if arrs:
+        out.update(_copy_tree(arrs))
+    return out
+
+
+def flatten_train_state(params: Dict[str, Any], opt_states, step,
+                        order=None) -> Dict[str, Any]:
+    """Flatten a functional train state into the checkpoint namespace:
+    ``params::<name>``, ``opt::<i>::<slot>`` (``i`` = position in the
+    optimizer's parameter list — stable across dp resharding because the
+    model structure, not the mesh, fixes the order), ``step``.
+
+    ``opt_states`` may be a list of slot dicts (the functional-state
+    layout both trainers use) or None (no optimizer state).  The list
+    LENGTH is recorded explicitly (``opt_n``): slot-less entries (plain
+    SGD's ``{}``) produce no ``opt::`` keys of their own, and without
+    the count the inverse would compress them away and misalign the
+    surviving slots onto the wrong params."""
+    flat: Dict[str, Any] = {"step": step}
+    for k, v in params.items():
+        flat[f"params{_SEP}{k}"] = v
+    if opt_states is not None:
+        flat["opt_n"] = len(opt_states)
+        for i, slots in enumerate(opt_states):
+            for slot, arr in slots.items():
+                flat[f"opt{_SEP}{i}{_SEP}{slot}"] = arr
+    return flat
+
+
+def unflatten_train_state(flat: Dict[str, Any]):
+    """Inverse of :func:`flatten_train_state` →
+    ``(params, opt_states, step)``."""
+    params, opt = {}, {}
+    for k, v in flat.items():
+        if k.startswith(f"params{_SEP}"):
+            params[k[len(f"params{_SEP}"):]] = v
+        elif k.startswith(f"opt{_SEP}") and k != "opt_n":
+            i, slot = k[len(f"opt{_SEP}"):].split(_SEP, 1)
+            opt.setdefault(int(i), {})[slot] = v
+    n = flat.get("opt_n")
+    if n is not None:
+        opt_states = [opt.get(i, {}) for i in range(int(np.asarray(n)))]
+    else:
+        opt_states = [opt[i] for i in sorted(opt)] if opt else None
+    return params, opt_states, flat.get("step")
+
+
+# ---------------------------------------------------------------------------
+# on-disk protocol
+# ---------------------------------------------------------------------------
+
+
+def _spec_of(arr) -> Optional[list]:
+    sh = getattr(arr, "sharding", None)
+    if isinstance(sh, jax.sharding.NamedSharding):
+        return [list(a) if isinstance(a, (list, tuple)) else a
+                for a in sh.spec]
+    return None
+
+
+def _fsync_dir(path: str) -> None:
+    flags = os.O_RDONLY | getattr(os, "O_DIRECTORY", 0)
+    try:
+        fd = os.open(path, flags)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _ckpt_dirname(step: int) -> str:
+    return f"{_PREFIX}{int(step):012d}"
+
+
+def list_checkpoints(root: str):
+    """``[(step, path)]`` of committed checkpoint dirs, oldest first.
+    Tmp dirs (interrupted writes) are never listed."""
+    out = []
+    try:
+        names = os.listdir(root)
+    except FileNotFoundError:
+        return out
+    for n in names:
+        if n.startswith(_PREFIX):
+            try:
+                step = int(n[len(_PREFIX):])
+            except ValueError:
+                continue
+            out.append((step, os.path.join(root, n)))
+    out.sort()
+    return out
+
+
+def _write_checkpoint_dir(root: str, flat_host: Dict[str, Any],
+                          manifest_meta: Dict[str, Any], step: int,
+                          specs: Optional[Dict[str, Any]] = None) -> int:
+    """The atomic commit protocol.  Returns the total shard bytes.
+    ``flat_host`` values are host arrays / scalars (already fetched);
+    ``specs`` carries the source shardings captured before the fetch
+    (recorded in the manifest for post-mortems — the resume side places
+    with the NEW state's shardings, not these)."""
+    final = os.path.join(root, _ckpt_dirname(step))
+    tmp = os.path.join(root, f"{_TMP_PREFIX}{_ckpt_dirname(step)}-{os.getpid()}")
+    shutil.rmtree(tmp, ignore_errors=True)
+    os.makedirs(tmp)
+    arrays: Dict[str, dict] = {}
+    scalars: Dict[str, Any] = {}
+    total = 0
+    try:
+        for idx, (name, val) in enumerate(sorted(flat_host.items())):
+            a = np.asarray(val)
+            if a.ndim == 0 and a.dtype.kind in "iu" and not isinstance(
+                    val, (np.ndarray, jax.Array)):
+                scalars[name] = int(val)
+                continue
+            fname = f"shard-{idx:05d}.bin"
+            spec = (specs or {}).get(name)
+            blob = _to_storable(a)
+            data = blob.tobytes()
+            _faults.point("ckpt.shard_write")
+            with open(os.path.join(tmp, fname), "wb") as f:
+                f.write(data)
+                f.flush()
+                os.fsync(f.fileno())
+            arrays[name] = {"shape": list(a.shape), "dtype": a.dtype.name,
+                            "file": fname, "crc32": zlib.crc32(data),
+                            "bytes": len(data), "spec": spec}
+            total += len(data)
+        manifest = dict(manifest_meta)
+        manifest.update(version=_VERSION, step=int(step),
+                        save_time=time.time(), arrays=arrays,
+                        scalars=scalars)
+        _faults.point("ckpt.manifest_write")
+        with open(os.path.join(tmp, MANIFEST), "w") as f:
+            json.dump(manifest, f)
+            f.flush()
+            os.fsync(f.fileno())
+        _fsync_dir(tmp)
+        _faults.point("ckpt.commit")
+        replaced = final + ".replaced"
+        if os.path.isdir(final):
+            # step collision (a previous run wrote this step into the
+            # same root, e.g. resume=False restarts): the CURRENT run's
+            # state must win — silently keeping the stale dir would let
+            # a later resume load another run's weights as this one's.
+            # Never delete before commit: the old dir moves aside and
+            # is removed only after the rename lands.
+            shutil.rmtree(replaced, ignore_errors=True)
+            os.rename(final, replaced)
+        os.rename(tmp, final)
+        shutil.rmtree(replaced, ignore_errors=True)
+        _fsync_dir(root)
+    except BaseException:
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise
+    return total
+
+
+def load_checkpoint(path: str) -> Tuple[Dict[str, np.ndarray], dict]:
+    """Read and VALIDATE one checkpoint dir → ``(flat_host, manifest)``.
+
+    Raises :class:`CorruptCheckpointError` on a torn manifest (fails to
+    parse / wrong version) or a torn shard (missing file, short read,
+    crc32 mismatch) — the caller decides whether to fall back."""
+    mf = os.path.join(path, MANIFEST)
+    try:
+        with open(mf) as f:
+            manifest = json.load(f)
+    except (OSError, ValueError) as e:
+        raise CorruptCheckpointError(
+            f"torn or missing manifest at {mf}: {e}") from e
+    if manifest.get("version") != _VERSION:
+        raise CorruptCheckpointError(
+            f"manifest version {manifest.get('version')!r} at {mf} "
+            f"(expected {_VERSION})")
+    flat: Dict[str, Any] = {}
+    for name, meta in manifest.get("arrays", {}).items():
+        fpath = os.path.join(path, meta["file"])
+        try:
+            with open(fpath, "rb") as f:
+                data = f.read()
+        except OSError as e:
+            raise CorruptCheckpointError(
+                f"missing shard {fpath} for {name!r}: {e}") from e
+        if len(data) != meta["bytes"] or zlib.crc32(data) != meta["crc32"]:
+            raise CorruptCheckpointError(
+                f"torn shard {fpath} for {name!r}: "
+                f"{len(data)} bytes / crc {zlib.crc32(data)}, manifest "
+                f"says {meta['bytes']} / {meta['crc32']}")
+        dtype = _np_dtype(meta["dtype"])
+        store = np.dtype(f"u{dtype.itemsize}") \
+            if dtype.kind == "V" or dtype.name not in np.sctypeDict else dtype
+        arr = np.frombuffer(data, dtype=store).copy()
+        arr = _from_storable(arr, meta["dtype"]).reshape(meta["shape"])
+        flat[name] = arr
+    for name, v in manifest.get("scalars", {}).items():
+        flat[name] = v
+    return flat, manifest
+
+
+def load_latest(root: str):
+    """Newest VALID checkpoint under ``root`` → ``(flat_host, manifest)``
+    or ``(None, None)``.  A corrupt newest checkpoint is skipped (with a
+    ``checkpoint_failures_total{stage="load"}`` count, a flight event
+    and a warning) and the previous one is tried — torn state degrades
+    the resume point, it never poisons it."""
+    for step, path in reversed(list_checkpoints(root)):
+        try:
+            return load_checkpoint(path)
+        except CorruptCheckpointError as e:
+            _obs.get_registry().counter(
+                "checkpoint_failures_total",
+                "checkpoint operations that failed (stage=write|load)"
+            ).labels(stage="load").inc()
+            _flight.get_flight_recorder().record(
+                "ckpt", phase="corrupt", step=int(step), path=path,
+                error=str(e)[:300])
+            import warnings
+            warnings.warn(
+                f"checkpoint at {path} is corrupt ({e}); falling back to "
+                f"the previous checkpoint", stacklevel=2)
+    return None, None
+
+
+def restore_like(root: str, like_flat: Dict[str, Any]):
+    """Load the newest valid checkpoint and place every array with the
+    RESUMING state's sharding + dtype (``like_flat`` — the freshly built
+    trainer state).  Resuming on a different dp size / mesh is implicit:
+    ``device_put`` reshards onto the new layout.  Returns
+    ``(placed_flat, manifest)`` or ``(None, None)``."""
+    flat, manifest = load_latest(root)
+    if flat is None:
+        return None, None
+    missing = [k for k in like_flat if k not in flat]
+    if missing:
+        raise KeyError(
+            f"checkpoint at {root} lacks {len(missing)} state entries "
+            f"(e.g. {missing[:3]}) — it was written by a different "
+            f"model/optimizer configuration")
+    placed = {}
+    for k, like in like_flat.items():
+        v = flat[k]
+        if isinstance(like, jax.Array):
+            arr = np.asarray(v).astype(like.dtype)
+            placed[k] = jax.device_put(arr, like.sharding)
+        elif isinstance(like, np.ndarray):
+            placed[k] = np.asarray(v, dtype=like.dtype).reshape(like.shape)
+        elif isinstance(like, (int, np.integer)):
+            placed[k] = int(np.asarray(v))
+        else:
+            placed[k] = v
+    return placed, manifest
+
+
+# ---------------------------------------------------------------------------
+# manager (background writer, retention)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class CheckpointConfig:
+    """``Model.fit(checkpoint=...)`` / ``Engine.fit(checkpoint=...)``
+    configuration.  A plain directory string is promoted to
+    ``CheckpointConfig(dir=...)``.
+
+    ``every_steps=None`` saves at every sync point the fit loop already
+    pays (each ``log_freq`` loss fetch and epoch end); an explicit value
+    saves only when at least that many steps passed since the last save.
+    ``resume=False`` starts fresh even when valid checkpoints exist."""
+    dir: str = "checkpoints"
+    every_steps: Optional[int] = None
+    keep_last_k: int = 3
+    async_save: bool = True
+    resume: bool = True
+
+    @staticmethod
+    def wrap(value) -> "CheckpointConfig":
+        if isinstance(value, CheckpointConfig):
+            return value
+        return CheckpointConfig(dir=os.fspath(value))
+
+
+class CheckpointManager:
+    """Owns one checkpoint directory: background writer thread, atomic
+    commits, keep-last-K retention, write metrics.
+
+    ``save()`` never blocks on I/O (``async_save``): it parks the
+    snapshot for the writer and returns.  If a write is already in
+    flight the parked snapshot is REPLACED (coalesced) — under
+    checkpoint pressure the trainer always persists its newest state
+    rather than queueing history."""
+
+    def __init__(self, root: str, keep_last_k: int = 3,
+                 async_save: bool = True):
+        self.root = os.fspath(root)
+        os.makedirs(self.root, exist_ok=True)
+        self.keep_last_k = max(int(keep_last_k), 1)
+        self.async_save = bool(async_save)
+        self.last_error: Optional[BaseException] = None
+        self._cv = threading.Condition(make_lock("ckpt.manager"))
+        self._pending = None          # (flat_snapshot, meta, step)
+        self._busy = False
+        self._closed = False
+        self._thread: Optional[threading.Thread] = None
+        reg = _obs.get_registry()
+        self._h_write = reg.histogram(
+            "checkpoint_write_seconds",
+            "wall seconds per committed checkpoint on the writer thread "
+            "(device_get + shard writes + fsync + manifest + rename)",
+            unit="s").labels(root=self.root)
+        self._g_bytes = reg.gauge(
+            "checkpoint_bytes",
+            "total shard bytes of the last committed checkpoint").labels(
+                root=self.root)
+        self._c_saves = reg.counter(
+            "checkpoint_saves_total",
+            "checkpoints committed").labels(root=self.root)
+        self._c_coalesced = reg.counter(
+            "checkpoint_coalesced_total",
+            "snapshots replaced by a newer one before the writer got to "
+            "them (checkpoint pressure)").labels(root=self.root)
+        self._c_fail = reg.counter(
+            "checkpoint_failures_total",
+            "checkpoint operations that failed (stage=write|load)").labels(
+                stage="write")
+        self._flight = _flight.get_flight_recorder()
+        self._sweep_tmp()
+
+    # -- write side ---------------------------------------------------------
+    def save(self, flat_snapshot: Dict[str, Any], *, step: int,
+             epoch: int = 0, cursor: int = 0,
+             meta: Optional[Dict[str, Any]] = None,
+             block: bool = False) -> None:
+        """Persist a :func:`device_snapshot` (or any flat host/device
+        state).  Returns immediately (async); ``block=True`` additionally
+        waits for THIS snapshot (and any before it) to commit — tests
+        and end-of-fit use it."""
+        world = {"n_devices": jax.device_count(),
+                 "process_count": jax.process_count()}
+        m = {"epoch": int(epoch), "cursor": int(cursor), "world": world,
+             "meta": meta or {}}
+        if not self.async_save:
+            self._write(flat_snapshot, m, int(step))
+            return
+        with self._cv:
+            if self._closed:
+                raise RuntimeError("CheckpointManager is closed")
+            if self._pending is not None:
+                self._c_coalesced.inc()
+            self._pending = (flat_snapshot, m, int(step))
+            if self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._worker, name="ckpt-writer", daemon=True)
+                self._thread.start()
+            self._cv.notify_all()
+        if block:
+            self.wait()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        """Block until no snapshot is pending or being written."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cv:
+            while self._pending is not None or self._busy:
+                left = None if deadline is None \
+                    else max(deadline - time.monotonic(), 0.0)
+                if left == 0.0:
+                    return False
+                self._cv.wait(left if left is not None else 1.0)
+        return True
+
+    def close(self) -> None:
+        """Drain outstanding writes, stop the writer thread, and stop
+        accepting new saves.  Fit loops close their manager at the end
+        of every run — a manager per fit must not leak an immortal
+        writer thread per fit."""
+        self.wait()
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=5.0)
+            self._thread = None
+
+    def _worker(self) -> None:
+        while True:
+            with self._cv:
+                while self._pending is None and not self._closed:
+                    self._cv.wait(1.0)
+                if self._pending is None and self._closed:
+                    return
+                flat, m, step = self._pending
+                self._pending = None
+                self._busy = True
+            try:
+                self._write(flat, m, step)
+            except BaseException as e:  # noqa: BLE001 — a failed save
+                # must not kill the writer: the run continues and the
+                # NEXT save may succeed; the failure is counted, flight-
+                # recorded and surfaced on .last_error
+                self.last_error = e
+                self._c_fail.inc()
+                self._flight.record("ckpt", phase="fail", step=int(step),
+                                    error=f"{type(e).__name__}: {e}"[:300])
+            finally:
+                with self._cv:
+                    self._busy = False
+                    self._cv.notify_all()
+
+    def _write(self, flat, manifest_meta, step):  # pht-lint: hot-root
+        # (background checkpoint writer — the d2h fetch below is this
+        # thread's DESIGNED sync; baseline.toml carries the reasoning)
+        t0 = time.perf_counter()
+        self._flight.record("ckpt", phase="begin", step=step)
+        specs = {k: _spec_of(v) for k, v in flat.items()}
+        flat = jax.device_get(flat)   # designed fetch, writer thread only
+        total = _write_checkpoint_dir(self.root, flat, manifest_meta, step,
+                                      specs=specs)
+        dt = time.perf_counter() - t0
+        self._h_write.observe(dt)
+        if total:
+            self._g_bytes.set(total)
+        self._c_saves.inc()
+        self._flight.record("ckpt", phase="commit", step=step,
+                            bytes=total, secs=round(dt, 4))
+        self._gc()
+
+    # -- retention ----------------------------------------------------------
+    def _gc(self) -> None:
+        ckpts = list_checkpoints(self.root)
+        for step, path in ckpts[:-self.keep_last_k]:
+            shutil.rmtree(path, ignore_errors=True)
+        self._sweep_tmp()
+
+    def _sweep_tmp(self) -> None:
+        """Remove interrupted-write leftovers from a previous process
+        (tmp dirs and half-finished ``.replaced`` collision backups).
+        Committed checkpoints are never touched."""
+        try:
+            names = os.listdir(self.root)
+        except FileNotFoundError:
+            return
+        for n in names:
+            if n.startswith(_TMP_PREFIX) or n.endswith(".replaced"):
+                shutil.rmtree(os.path.join(self.root, n),
+                              ignore_errors=True)
+
+    # -- read side ----------------------------------------------------------
+    def restore_like(self, like_flat: Dict[str, Any]):
+        """Instance convenience for :func:`restore_like` on this root."""
+        return restore_like(self.root, like_flat)
+
+
+def _encode_np_rng() -> dict:
+    """JSON-able snapshot of the global numpy RNG (MT19937 state) — the
+    stream the data pipeline's per-epoch shuffle permutations draw from
+    (``io.sampler.RandomSampler``)."""
+    alg, keys, pos, has_gauss, cached = np.random.get_state()
+    return {"alg": str(alg), "keys": [int(x) for x in keys],
+            "pos": int(pos), "has_gauss": int(has_gauss),
+            "cached": float(cached)}
+
+
+def _decode_np_rng(d: dict) -> None:
+    np.random.set_state((d["alg"], np.asarray(d["keys"], np.uint32),
+                         int(d["pos"]), int(d["has_gauss"]),
+                         float(d["cached"])))
+
+
+class FitCheckpointer:
+    """Bridges a fit loop to a :class:`CheckpointManager`: resume once
+    at fit start, then snapshot-and-save at the sync points the loop
+    already pays.
+
+    The fit loop owns three calls (all host-cheap):
+
+    - :meth:`resume` with the freshly built state's flat refs →
+      ``(placed_flat, start_epoch, cursor)`` or ``None`` (fresh run);
+    - :meth:`advance` after every completed train step batch;
+    - :meth:`maybe_save` at each sync point with the CURRENT state's
+      flat refs — it runs the :func:`device_snapshot` copy (one
+      dispatch, no host sync) and parks it for the writer thread.
+
+    ``global_step`` is tracked on the HOST (seeded from the resume
+    manifest) precisely so saving never needs to ``int()`` the device
+    step scalar — that would be an added host sync the PHT001 gate
+    forbids."""
+
+    def __init__(self, config, manager: Optional[CheckpointManager] = None):
+        self.cfg = CheckpointConfig.wrap(config)
+        self.mgr = manager or CheckpointManager(
+            self.cfg.dir, keep_last_k=self.cfg.keep_last_k,
+            async_save=self.cfg.async_save)
+        self.global_step = 0
+        self._last_saved: Optional[int] = None
+        self._epoch_rng: Optional[dict] = None
+
+    def resume(self, like_flat: Dict[str, Any]):
+        """Restore the newest valid checkpoint into ``like_flat``'s
+        layout (dp resharding implicit).  Returns ``(placed_flat,
+        start_epoch, cursor)`` or ``None`` when there is nothing to
+        resume (or resume is disabled)."""
+        if not self.cfg.resume:
+            if list_checkpoints(self.mgr.root):
+                import warnings
+                warnings.warn(
+                    f"checkpoint resume is disabled but {self.mgr.root} "
+                    f"already holds checkpoints from a previous run: "
+                    f"colliding steps will be REPLACED by this run's "
+                    f"saves, and leftover higher-step checkpoints can "
+                    f"shadow them at a later resume — prefer a fresh "
+                    f"directory per run", stacklevel=3)
+            return None
+        placed, manifest = self.mgr.restore_like(like_flat)
+        if placed is None:
+            return None
+        self.global_step = int(manifest["step"])
+        self._last_saved = self.global_step
+        rng = manifest.get("meta", {}).get("numpy_rng")
+        if rng:
+            # restore the SHUFFLE stream as of the checkpointed epoch's
+            # start: the resumed epoch re-draws the same permutation, so
+            # cursor fast-forward skips exactly the batches the saved
+            # state already trained — the loss series continues where
+            # it stopped instead of replaying reshuffled data
+            _decode_np_rng(rng)
+        _flight.get_flight_recorder().record(
+            "ckpt", phase="resume", step=self.global_step,
+            epoch=manifest.get("epoch", 0),
+            cursor=manifest.get("cursor", 0))
+        return placed, int(manifest.get("epoch", 0)), \
+            int(manifest.get("cursor", 0))
+
+    def advance(self, n_steps: int) -> None:
+        self.global_step += int(n_steps)
+
+    def mark_epoch(self) -> None:
+        """Call at EPOCH START, before the loader iterator is created:
+        captures the numpy RNG state the epoch's shuffle permutation is
+        about to be drawn from.  Mid-epoch saves record THIS state (the
+        resumed epoch must re-draw the same permutation); epoch-boundary
+        saves (``cursor=0``) record the then-current state instead."""
+        self._epoch_rng = _encode_np_rng()
+
+    def maybe_save(self, flat_refs: Dict[str, Any], *, epoch: int,
+                   cursor: int, meta: Optional[Dict[str, Any]] = None,
+                   force: bool = False) -> bool:
+        """Snapshot + enqueue if due (``every_steps`` respected unless
+        ``force``); never saves the same step twice."""
+        if self._last_saved == self.global_step:
+            return False
+        every = self.cfg.every_steps
+        if not force and every is not None and self._last_saved is not None \
+                and self.global_step - self._last_saved < every:
+            return False
+        snap = device_snapshot(flat_refs)
+        meta = dict(meta or {})
+        meta["numpy_rng"] = (_encode_np_rng()
+                             if cursor == 0 or self._epoch_rng is None
+                             else self._epoch_rng)
+        self.mgr.save(snap, step=self.global_step, epoch=epoch,
+                      cursor=cursor, meta=meta)
+        self._last_saved = self.global_step
+        return True
+
+    def finish(self) -> None:
+        """Drain outstanding writes and release the writer thread (end
+        of fit — clean OR crashed; a new fit builds a new manager)."""
+        self.mgr.close()
+
+
+# ---------------------------------------------------------------------------
+# elastic rendezvous (resume-side world sizing)
+# ---------------------------------------------------------------------------
+
+
+def elastic_rendezvous(job_id: str, host: str, store=None, np_range="1:64",
+                       timeout: float = 10.0, settle: float = 0.3,
+                       ttl: float = 5.0):
+    """TTL-lease rendezvous for elastic resume: register this host under
+    the job, wait for membership to stop changing (``settle`` seconds of
+    stability, bounded by ``timeout``), and return
+    ``(rank, world_size, manager)``.
+
+    The resuming trainer sizes its dp mesh by ``world_size`` and lets
+    :func:`restore_like` reshard the checkpoint onto it — together these
+    are the elastic-restart path: crash → members re-register → new
+    world agreed through the lease store → resume from the last valid
+    checkpoint on the new dp size.  The returned
+    :class:`~paddle_hackathon_tpu.distributed.elastic.ElasticManager`
+    keeps heartbeating; call ``manager.exit()`` when training ends."""
+    from ..distributed.elastic import ElasticManager
+    em = ElasticManager(job_id, np_range, host, store=store,
+                        heartbeat_interval=min(settle, 1.0), ttl=ttl)
+    em.register()
+    deadline = time.monotonic() + timeout
+    stable_since = time.monotonic()
+    members = em.hosts()
+    while time.monotonic() < deadline:
+        cur = em.hosts()
+        if cur != members:
+            members, stable_since = cur, time.monotonic()
+        elif (time.monotonic() - stable_since >= settle
+              and em.np_min <= len(cur) <= em.np_max):
+            break
+        time.sleep(min(settle / 3, 0.1))
+    members = em.hosts()
+    if not (em.np_min <= len(members) <= em.np_max):
+        # a timed-out rendezvous outside the declared range must be an
+        # ERROR, not a silently undersized (or still-churning) world the
+        # trainer resumes on anyway
+        em.exit()
+        raise TimeoutError(
+            f"elastic rendezvous for job {job_id!r} timed out after "
+            f"{timeout}s with {len(members)} member(s) — outside the "
+            f"declared np range {np_range!r}")
+    rank = em.rank_map().get(host, 0)
+    return rank, len(members), em

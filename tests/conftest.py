@@ -77,6 +77,8 @@ _FILE_COST = {
     "test_slo.py": 12,      # window/beacon/healthz units + ONE tiny engine
                             # run (lifecycle + /load golden) + one tiny fit
     "test_lint.py": 7,      # pure AST; one repo-wide walk dominates
+    "test_checkpointing.py": 8,   # host-only protocol/fault units
+    "test_crash_drill.py": 1,     # fully slow-marked (subprocess drills)
     "test_sanitizers.py": 3,  # lock/guard units; engine runs are slow-marked
     "test_paged.py": 16,    # allocator units + 2 tiny-GPT engine runs
     "test_quant_serving.py": 12,  # kernel/quantizer units + 2 tiny fwd

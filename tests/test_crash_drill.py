@@ -1,0 +1,287 @@
+"""The crash drill: kill a fit mid-run, corrupt its checkpoints, resume
+on a different dp size — and require the loss series to continue.
+
+Everything here is ``slow``-marked (engine/trainer compiles, real
+subprocesses): the lean protocol units live in ``test_checkpointing.py``.
+
+The flagship test is a three-process drill:
+
+1. a REFERENCE child trains an Engine on a dp=4 mesh, uninterrupted,
+   and records its loss series;
+2. a CRASH child runs the identical recipe with checkpointing enabled
+   and ``PHT_FAULTS=ckpt.commit=crash@4`` in its environment — the
+   fault harness ``os._exit``s the process (the kill -9 simulation: no
+   cleanup, no flushed buffers) during the FOURTH checkpoint commit,
+   mid-fit;
+3. the parent then corrupts the newest surviving checkpoint's shard AND
+   the next one's manifest — both must be *detected*, never loaded —
+   and a RESUME child sizes a NEW dp=2 world through the elastic
+   TTL-lease rendezvous, restores from the last VALID checkpoint
+   (re-sharded onto the smaller mesh by ``restore_like``), and finishes
+   the run.
+
+The resumed loss series must equal the reference's tail bit-for-bit:
+same steps, same shuffle permutations (numpy RNG restored from the
+manifest), same update math — the crash becomes invisible.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.slow
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# every child runs on the same virtual 8-device CPU mesh the suite uses
+_CHILD_ENV = {
+    "JAX_PLATFORMS": "cpu",
+    "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+}
+
+_COMMON = r"""
+import json, os, sys
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+import paddle_hackathon_tpu as paddle
+from paddle_hackathon_tpu import nn
+from paddle_hackathon_tpu.parallel.auto_parallel import Engine, ProcessMesh
+from paddle_hackathon_tpu.parallel import checkpointing as ck
+
+
+class _MLP(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(16, 32)
+        self.fc2 = nn.Linear(32, 4)
+
+    def forward(self, x):
+        return self.fc2(paddle.nn.functional.relu(self.fc1(x)))
+
+
+def dataset(n=64):
+    rng = np.random.RandomState(0)
+    xs = rng.randn(n, 16).astype("float32")
+    w = rng.randn(16, 4).astype("float32")
+    ys = np.argmax(xs @ w, axis=1).astype("int64")
+    return [(xs[i], ys[i]) for i in range(n)]
+
+
+def mk_engine(dp):
+    paddle.seed(7)
+    np.random.seed(123)   # the shuffle stream every run starts from
+    model = _MLP()
+    opt = paddle.optimizer.Adam(learning_rate=0.01,
+                                parameters=model.parameters())
+    pm = ProcessMesh(list(range(dp)), ["dp"])
+    return Engine(model, loss=nn.CrossEntropyLoss(), optimizer=opt,
+                  process_mesh=pm)
+"""
+
+
+def _run_child(body, env_extra=None, timeout=300):
+    env = dict(os.environ)
+    env.update(_CHILD_ENV)
+    env.update(env_extra or {})
+    return subprocess.run(
+        [sys.executable, "-c", _COMMON + body], cwd=_REPO, env=env,
+        capture_output=True, text=True, timeout=timeout)
+
+
+def test_crash_drill_kill_corrupt_reshard_resume(tmp_path):
+    ckdir = str(tmp_path / "ckpts")
+    ref_json = str(tmp_path / "ref.json")
+    res_json = str(tmp_path / "res.json")
+
+    # 1) reference: uninterrupted dp=4 run
+    ref = _run_child(f"""
+eng = mk_engine(4)
+hist = eng.fit(dataset(), epochs=3, batch_size=16, log_freq=2)
+json.dump(hist["loss"], open({ref_json!r}, "w"))
+""")
+    assert ref.returncode == 0, ref.stderr[-2000:]
+    ref_losses = json.load(open(ref_json))
+    assert len(ref_losses) == 12
+
+    # 2) crash child: the fault harness (armed through the environment,
+    # the way a chaos drill arms a real fleet) os._exit()s the process
+    # during the 4th checkpoint commit — mid-fit, no cleanup
+    # async_save=False inside the drill: commits happen deterministically
+    # at each maybe_save (no coalescing), so "the 4th commit" is exactly
+    # the step-8 save — the async writer's own crash behavior is covered
+    # by test_model_fit_injected_crash_resume and the tier-1 units
+    crash = _run_child(f"""
+eng = mk_engine(4)
+eng.fit(dataset(), epochs=3, batch_size=16, log_freq=2,
+        checkpoint=ck.CheckpointConfig(dir={ckdir!r}, keep_last_k=3,
+                                       async_save=False))
+raise SystemExit("fit survived a drill that should have killed it")
+""", env_extra={"PHT_FAULTS": "ckpt.commit=crash@4"})
+    assert crash.returncode == 42, (crash.returncode, crash.stderr[-2000:])
+
+    from paddle_hackathon_tpu.parallel import checkpointing as ck
+    ckpts = dict(ck.list_checkpoints(ckdir))
+    assert sorted(ckpts) == [2, 4, 6], sorted(ckpts)
+
+    # 3) corrupt a shard of the newest AND the manifest of the next —
+    # resume must detect both and fall back to step 2, never loading
+    # torn state silently
+    shard = sorted(f for f in os.listdir(ckpts[6])
+                   if f.startswith("shard"))[0]
+    with open(os.path.join(ckpts[6], shard), "r+b") as f:
+        f.write(b"\xde\xad\xbe\xef")
+    mf = os.path.join(ckpts[4], "manifest.json")
+    open(mf, "w").write(open(mf).read()[:23])
+
+    # 4) resume child: the new world size comes from the elastic
+    # TTL-lease rendezvous (a second member is already registered), and
+    # the restore re-shards the dp=4 checkpoint onto the dp=2 mesh
+    res = _run_child(f"""
+import warnings
+from paddle_hackathon_tpu.distributed.elastic import MemLeaseStore
+store = MemLeaseStore()
+store.put_with_lease("/drill/nodes/peer", "peer", 30.0)
+rank, world, mgr = ck.elastic_rendezvous(
+    "drill", "me", store=store, np_range="1:4", timeout=5.0, settle=0.1)
+assert world == 2, world
+with warnings.catch_warnings(record=True) as caught:
+    warnings.simplefilter("always")
+    flat, man = ck.load_latest({ckdir!r})
+assert man["step"] == 2, man["step"]          # fell back past BOTH torn dirs
+assert sum("corrupt" in str(w.message) for w in caught) >= 2
+eng = mk_engine(world)                         # dp sized by the rendezvous
+hist = eng.fit(dataset(), epochs=3, batch_size=16, log_freq=2,
+               checkpoint={ckdir!r})
+mgr.exit()
+json.dump(hist["loss"], open({res_json!r}, "w"))
+""")
+    assert res.returncode == 0, res.stderr[-2000:]
+    res_losses = json.load(open(res_json))
+
+    # the resumed series continues the reference's: 2 steps were already
+    # trained before the last valid checkpoint, the remaining 10 match
+    assert len(res_losses) == 10
+    np.testing.assert_allclose(res_losses, ref_losses[2:],
+                               rtol=2e-4, atol=1e-5)
+
+
+def test_model_fit_injected_crash_resume_is_exact(tmp_path):
+    """In-process half of the drill, on the hapi path: an injected
+    dataloader fault kills `Model.fit` mid-run; the resumed fit (same
+    shuffle stream, restored from the manifest) finishes with weights
+    identical to a never-crashed run."""
+    import paddle_hackathon_tpu as paddle
+    from paddle_hackathon_tpu import hapi, io, nn, optimizer as optim
+    from paddle_hackathon_tpu.observability import faults
+    from paddle_hackathon_tpu.parallel import checkpointing as ck
+
+    class _DS(io.Dataset):
+        def __init__(self, n=64, d=10):
+            rng = np.random.RandomState(5)
+            self.x = rng.randn(n, d).astype(np.float32)
+            self.y = (self.x.sum(1) > 0).astype(np.int64)
+
+        def __len__(self):
+            return len(self.x)
+
+        def __getitem__(self, i):
+            return self.x[i], self.y[i]
+
+    def mk():
+        paddle.seed(7)
+        np.random.seed(123)
+        net = nn.Sequential(nn.Linear(10, 32), nn.ReLU(), nn.Linear(32, 2))
+        m = hapi.Model(net)
+        m.prepare(optimizer=optim.Adam(learning_rate=1e-2,
+                                       parameters=net.parameters()),
+                  loss=nn.CrossEntropyLoss())
+        return m
+
+    ds = _DS()
+    fit_kw = dict(epochs=2, batch_size=8, verbose=0, shuffle=True,
+                  jit_compile=True, log_freq=2)
+    d = str(tmp_path / "ck")
+
+    m_ref = mk()
+    m_ref.fit(ds, **fit_kw)
+
+    m1 = mk()
+    faults.arm("io.prefetch=fail@10")   # dies pulling a mid-run batch
+    try:
+        with pytest.raises(faults.InjectedFault):
+            m1.fit(ds, checkpoint=ck.CheckpointConfig(dir=d), **fit_kw)
+    finally:
+        faults.disarm()
+    assert ck.list_checkpoints(d), "no checkpoint survived the crash"
+
+    m2 = mk()
+    logs2 = m2.fit(ds, checkpoint=d, **fit_kw)
+    assert np.isfinite(logs2["loss"])
+    w_ref = {k: np.asarray(v.numpy())
+             for k, v in m_ref.network.state_dict().items()}
+    w_res = {k: np.asarray(v.numpy())
+             for k, v in m2.network.state_dict().items()}
+    for k in w_ref:
+        np.testing.assert_allclose(w_ref[k], w_res[k], rtol=2e-4,
+                                   atol=1e-5)
+    assert m2._optimizer._step_count == m_ref._optimizer._step_count
+
+
+def test_fit_checkpoint_overhead_holds_builds_warm(tmp_path):
+    """Zero-sync evidence at the fit level: enabling checkpointing must
+    not add program builds to the compiled trainer (the snapshot is its
+    own tiny program, counted under no trainer site) and the fit must
+    still engage the compiled path."""
+    import paddle_hackathon_tpu as paddle
+    from paddle_hackathon_tpu import hapi, io, nn, optimizer as optim
+    from paddle_hackathon_tpu.observability import get_registry
+
+    class _DS(io.Dataset):
+        def __init__(self, n=64, d=10):
+            rng = np.random.RandomState(5)
+            self.x = rng.randn(n, d).astype(np.float32)
+            self.y = (self.x.sum(1) > 0).astype(np.int64)
+
+        def __len__(self):
+            return len(self.x)
+
+        def __getitem__(self, i):
+            return self.x[i], self.y[i]
+
+    def mk():
+        paddle.seed(7)
+        net = nn.Sequential(nn.Linear(10, 16), nn.ReLU(), nn.Linear(16, 2))
+        m = hapi.Model(net)
+        m.prepare(optimizer=optim.Adam(learning_rate=1e-2,
+                                       parameters=net.parameters()),
+                  loss=nn.CrossEntropyLoss())
+        return m
+
+    reg = get_registry()
+
+    def builds():
+        return int(reg.total("jit_builds_total",
+                             site="hapi.compiled_trainer"))
+
+    b0 = builds()
+    m_plain = mk()
+    m_plain.fit(_DS(), epochs=1, batch_size=8, verbose=0, shuffle=False,
+                jit_compile=True, log_freq=2)
+    b1 = builds()
+
+    m_ck = mk()
+    m_ck.fit(_DS(), epochs=1, batch_size=8, verbose=0, shuffle=False,
+             jit_compile=True, log_freq=2,
+             checkpoint=str(tmp_path / "ck"))
+    b2 = builds()
+    assert m_ck._fit_used_compiled
+    assert b2 - b1 == b1 - b0, \
+        "checkpointing changed the trainer's program-build count"
+    # and the checkpoints actually landed
+    h = reg.get("checkpoint_write_seconds")
+    assert h is not None and any(c.count for c in h.children())
