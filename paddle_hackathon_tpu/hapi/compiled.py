@@ -33,6 +33,7 @@ from ..core import random as core_random
 from ..core.tensor import Tensor
 from ..nn.layer import functional_call
 from ..observability import metrics as _obs
+from ..observability.sanitizers import sanitize_donation
 from ..parallel.api import _collect_moe_aux, make_functional_train_step
 from ..parallel.moe import moe_aux_weight
 
@@ -166,9 +167,10 @@ class CompiledTrainer:
         # the fresh arrays after each call.  instrument_jit records every
         # trace+compile (a new batch shape = a new program) into
         # jit_builds_total{site=hapi.compiled_trainer}.
-        self._jit = _obs.instrument_jit(
+        self._jit = sanitize_donation(_obs.instrument_jit(
             jax.jit(train_step, donate_argnums=(0, 1, 2)),
-            site="hapi.compiled_trainer")
+            site="hapi.compiled_trainer"),
+            donate_argnums=(0, 1, 2), site="hapi.compiled_trainer")
 
     def run(self, xs, ys):  # pht-lint: hot-root (compiled-trainer step)
         """One compiled superstep over stacked batches (leaves (K, B, …));

@@ -216,8 +216,13 @@ class Predictor:
         # the convenience run(inputs) path re-binds feeds every call, so
         # donating them there is safe and is what enable_memory_optim buys.
         keep = jax.jit(run_fn)
-        donating = (jax.jit(run_fn, donate_argnums=(0,))
-                    if self._ctx.donate_feeds else keep)
+        if self._ctx.donate_feeds:
+            from ..observability.sanitizers import sanitize_donation
+            donating = sanitize_donation(
+                jax.jit(run_fn, donate_argnums=(0,)),
+                donate_argnums=(0,), site="predictor.run")
+        else:
+            donating = keep
         return (keep, donating)
 
     # -- handles -----------------------------------------------------------

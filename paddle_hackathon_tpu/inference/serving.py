@@ -52,7 +52,7 @@ import numpy as np
 from ..observability import faults as _faults
 from ..observability import flight as _flight
 from ..observability import metrics as _obs
-from ..observability.sanitizers import make_lock
+from ..observability.sanitizers import make_lock, sanitize_donation
 from ..observability import tracing as _tr
 
 _ENGINE_IDS = itertools.count()
@@ -840,7 +840,9 @@ class ServingEngine:
                     # the tick's single designed fetch
                     return caches, toks, _moe_stats(model.gpt)
                 return caches, toks
-            return jax.jit(tick, donate_argnums=(1,))
+            return sanitize_donation(jax.jit(tick, donate_argnums=(1,)),
+                                     donate_argnums=(1,),
+                                     site="serving.tick")
 
         self._tick, self._tick_mk = {}, mk_tick
 
@@ -899,7 +901,9 @@ class ServingEngine:
                 caches, _, outbuf = jax.lax.fori_loop(
                     0, M, body, (caches, last_tok, outbuf))
                 return caches, outbuf
-            return jax.jit(tick_multi, donate_argnums=(1,))
+            return sanitize_donation(
+                jax.jit(tick_multi, donate_argnums=(1,)),
+                donate_argnums=(1,), site="serving.tick_multi")
 
         self._tick_multi, self._tick_multi_mk = {}, mk_tick_multi
 
@@ -995,7 +999,9 @@ class ServingEngine:
                 if moe:
                     return caches, out, _moe_stats(model.gpt)
                 return caches, out
-            return jax.jit(tick_spec, donate_argnums=(1,))
+            return sanitize_donation(
+                jax.jit(tick_spec, donate_argnums=(1,)),
+                donate_argnums=(1,), site="serving.tick_spec")
 
         self._tick_spec, self._tick_spec_mk = {}, mk_tick_spec
 
@@ -1285,7 +1291,9 @@ class ServingEngine:
                     args=(stacked_p, kc, vc, xbuf, tokens, starts, nvalid,
                           temps, topks, topps, wave_of_stage, other_p, key,
                           tickno))
-            return jax.jit(tick, donate_argnums=(1, 2, 3))
+            return sanitize_donation(
+                jax.jit(tick, donate_argnums=(1, 2, 3)),
+                donate_argnums=(1, 2, 3), site="serving.pp_tick")
 
         self._pp_tick, self._pp_tick_mk = {}, mk_tick
         self._xbuf = jax.device_put(

@@ -772,8 +772,14 @@ class GPTForCausalLM(Layer):
 
             if len(gen_cache) >= 32:  # same FIFO bound as the fused loop
                 gen_cache.pop(next(iter(gen_cache)))
-            gen_cache[cache_key] = (jax.jit(prefill, donate_argnums=(2,)),
-                                    jax.jit(verify, donate_argnums=(1,)))
+            from ..observability.sanitizers import sanitize_donation
+            gen_cache[cache_key] = (
+                sanitize_donation(jax.jit(prefill, donate_argnums=(2,)),
+                                  donate_argnums=(2,),
+                                  site="gpt.spec_prefill"),
+                sanitize_donation(jax.jit(verify, donate_argnums=(1,)),
+                                  donate_argnums=(1,),
+                                  site="gpt.spec_verify"))
         run_prefill, run_verify = gen_cache[cache_key]
 
         # resolve-once per (drafter, K): a ModelDrafter's jitted

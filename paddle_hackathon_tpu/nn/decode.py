@@ -295,9 +295,14 @@ class ModelDrafter:
                 0, K + 1, body, (caches, last, outbuf))
             return caches, outbuf[:, :K]
 
+        from ..observability.sanitizers import sanitize_donation
         self._fns = {
-            "ingest": jax.jit(ingest, donate_argnums=(1,)),
-            "propose": jax.jit(propose, donate_argnums=(1,)),
+            "ingest": sanitize_donation(
+                jax.jit(ingest, donate_argnums=(1,)),
+                donate_argnums=(1,), site="drafter.ingest"),
+            "propose": sanitize_donation(
+                jax.jit(propose, donate_argnums=(1,)),
+                donate_argnums=(1,), site="drafter.propose"),
         }
         return self._fns
 

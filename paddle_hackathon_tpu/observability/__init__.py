@@ -55,7 +55,9 @@ from .metrics import (Counter, Gauge, Histogram, MetricRegistry,
                       log_buckets, record_device_memory, set_trace_sink,
                       snapshot_delta)
 from .sanitizers import (HostTransferError, LockOrderError,
-                         forbid_host_transfers, make_lock, make_rlock)
+                         UseAfterDonateError, donation_sanitizer,
+                         forbid_host_transfers, make_lock, make_rlock,
+                         sanitize_donation)
 from .tracing import (add_span, disable_tracing, enable_tracing, end_span,
                       span, start_span, tracing_enabled)
 
@@ -67,8 +69,9 @@ __all__ = ["MetricRegistry", "Counter", "Gauge", "Histogram",
            "disable_tracing", "tracing_enabled", "FlightRecorder",
            "get_flight_recorder", "start_introspection_server",
            "forbid_host_transfers", "make_lock", "make_rlock",
-           "HostTransferError", "LockOrderError", "InjectedFault",
-           "faults", "flight", "sanitizers", "tracing"]
+           "sanitize_donation", "donation_sanitizer",
+           "HostTransferError", "LockOrderError", "UseAfterDonateError",
+           "InjectedFault", "faults", "flight", "sanitizers", "tracing"]
 
 
 def start_introspection_server(*args, **kwargs):

@@ -6,7 +6,7 @@ conservative — it can only see acquisition orders the AST spells out.
 These sanitizers are the dynamic half: they watch what the process
 *actually does* and fail fast, with stacks, at the first violation.
 
-Two tools (catalog and env flags: ``docs/STATIC_ANALYSIS.md``):
+Three tools (catalog and env flags: ``docs/STATIC_ANALYSIS.md``):
 
 - :func:`make_lock` / :func:`make_rlock` — drop-in lock constructors the
   concurrent subsystems (serving engine, metric registry, tracing,
@@ -21,6 +21,14 @@ Two tools (catalog and env flags: ``docs/STATIC_ANALYSIS.md``):
   some thread (this one or another) has already used — i.e. it turns a
   once-in-a-blue-moon deadlock into a deterministic test failure with
   both acquisition stacks attached.
+
+- :func:`sanitize_donation` / ``PHT_DONATION_SANITIZER=1`` — wraps the
+  donating jitted programs (serving ticks, the compiled trainer, the
+  sharded train steps, the drafter/spec programs) so any access to a
+  buffer AFTER it was donated raises a named :class:`UseAfterDonateError`
+  carrying the donating call's stack — instead of a context-free
+  deleted-buffer error on TPU or, worse, a silent stale-bytes read on
+  CPU where donation is a no-op.  Static counterpart: pht-lint PHT006.
 
 - :func:`forbid_host_transfers` — context manager hot-path tests wrap
   around steady-state decode/train ticks.  Inside it, an *implicit*
@@ -41,6 +49,7 @@ Two tools (catalog and env flags: ``docs/STATIC_ANALYSIS.md``):
 
 from __future__ import annotations
 
+import collections
 import contextlib
 import os
 import sys
@@ -68,9 +77,12 @@ def _capture_stack(skip: int = 3):
 def _fmt_stack(summary) -> str:
     return "".join(summary.format())
 
-__all__ = ["LockOrderError", "HostTransferError", "make_lock",
-           "make_rlock", "lock_sanitizer", "lock_sanitizer_enabled",
-           "reset_lock_graph", "forbid_host_transfers"]
+__all__ = ["LockOrderError", "HostTransferError", "UseAfterDonateError",
+           "make_lock", "make_rlock", "lock_sanitizer",
+           "lock_sanitizer_enabled", "reset_lock_graph",
+           "forbid_host_transfers", "sanitize_donation",
+           "donation_sanitizer", "donation_sanitizer_enabled",
+           "reset_donation_registry"]
 
 _ENV_FLAG = "PHT_LOCK_SANITIZER"
 
@@ -83,6 +95,13 @@ class LockOrderError(RuntimeError):
 class HostTransferError(RuntimeError):
     """An implicit device→host transfer happened under
     :func:`forbid_host_transfers`."""
+
+
+class UseAfterDonateError(RuntimeError):
+    """A buffer donated to a jitted program (``donate_argnums``) was
+    accessed after the donating call.  The message carries BOTH sides:
+    the donating call's stack (recorded when the wrapper returned) and
+    the offending read (the raise site's traceback)."""
 
 
 # ---------------------------------------------------------------------------
@@ -354,27 +373,31 @@ class _SanitizedLock:
 
 
 # ---------------------------------------------------------------------------
-# transfer guard
+# shared ArrayImpl interposition (transfer guard + donation sanitizer)
 # ---------------------------------------------------------------------------
+#
+# Both runtime guards interpose the same Python access surface of
+# jaxlib's ArrayImpl, and they MUST share one dispatcher: independent
+# save/patch/restore pairs corrupt each other under non-LIFO
+# interleaving — a forbid_host_transfers() block exiting while the
+# donation sanitizer was armed wiped the donation read-guard, and the
+# later donation disarm reinstalled the transfer TRIP as the
+# "original", poisoning float()/item() on every array process-wide.
+# One dispatcher per method, installed while EITHER guard is armed,
+# consulting each guard's live depth at call time.
 
 _patch_lock = threading.Lock()
-_patch_depth = 0
-_saved_dunders: Dict[str, object] = {}
+_transfer_depth = 0          # forbid_host_transfers nesting
+_donation_depth = 0          # donation sanitizer arms (context + env)
+_installed_originals: Dict[str, object] = {}
 
 # scalar-conversion surface of jaxlib's ArrayImpl: every one of these is
 # an implicit device→host sync in disguise (the PHT001 call set)
-_PATCHED = ("__float__", "__int__", "__bool__", "__index__", "__complex__",
-            "item", "tolist")
-
-
-def _trip(name):
-    def tripped(self, *a, **k):
-        raise HostTransferError(
-            f"implicit device→host transfer: `{name}` called on a jax "
-            f"Array under forbid_host_transfers() — fetch once, "
-            f"explicitly, with jax.device_get(...) at the tick's "
-            f"designed sync point (pht-lint PHT001)")
-    return tripped
+_TRANSFER_NAMES = ("__float__", "__int__", "__bool__", "__index__",
+                   "__complex__", "item", "tolist")
+# donation additionally guards the container-read surface: a dead
+# buffer read through indexing / np-conversion is use-after-donate too
+_DONATION_NAMES = _TRANSFER_NAMES + ("__array__", "__getitem__")
 
 
 def _arrayimpl():
@@ -383,28 +406,264 @@ def _arrayimpl():
     return ArrayImpl
 
 
-def _patch_cpu_dunders():
-    global _patch_depth
+def _dispatcher(name, orig):
+    in_transfer_set = name in _TRANSFER_NAMES
+
+    def dispatched(self, *a, **k):
+        if _donation_depth > 0:
+            ent = _don_entry(self)
+            if ent is not None:
+                _raise_use_after_donate(f"`{name}`", ent)
+        if _transfer_depth > 0 and in_transfer_set:
+            raise HostTransferError(
+                f"implicit device→host transfer: `{name}` called on a "
+                f"jax Array under forbid_host_transfers() — fetch once, "
+                f"explicitly, with jax.device_get(...) at the tick's "
+                f"designed sync point (pht-lint PHT001)")
+        return orig(self, *a, **k)
+
+    dispatched.__name__ = getattr(orig, "__name__", name)
+    return dispatched
+
+
+def _guard_arm(kind: str) -> None:
+    global _transfer_depth, _donation_depth
     with _patch_lock:
-        if _patch_depth == 0:
+        if _transfer_depth + _donation_depth == 0:
             cls = _arrayimpl()
-            for n in _PATCHED:
+            for n in _DONATION_NAMES:      # the union surface
                 orig = getattr(cls, n, None)
                 if orig is not None:
-                    _saved_dunders[n] = orig
-                    setattr(cls, n, _trip(n))
-        _patch_depth += 1
+                    _installed_originals[n] = orig
+                    setattr(cls, n, _dispatcher(n, orig))
+        if kind == "transfer":
+            _transfer_depth += 1
+        else:
+            _donation_depth += 1
+
+
+def _guard_disarm(kind: str) -> None:
+    global _transfer_depth, _donation_depth
+    with _patch_lock:
+        if kind == "transfer":
+            _transfer_depth -= 1
+        else:
+            _donation_depth -= 1
+        if _transfer_depth + _donation_depth == 0:
+            cls = _arrayimpl()
+            for n, orig in _installed_originals.items():
+                setattr(cls, n, orig)
+            _installed_originals.clear()
+
+
+def _patch_cpu_dunders():
+    _guard_arm("transfer")
 
 
 def _unpatch_cpu_dunders():
-    global _patch_depth
-    with _patch_lock:
-        _patch_depth -= 1
-        if _patch_depth == 0:
-            cls = _arrayimpl()
-            for n, orig in _saved_dunders.items():
-                setattr(cls, n, orig)
-            _saved_dunders.clear()
+    _guard_disarm("transfer")
+
+
+# ---------------------------------------------------------------------------
+# donation sanitizer (the dynamic half of pht-lint PHT006)
+# ---------------------------------------------------------------------------
+#
+# XLA buffer donation invalidates the INPUT buffer in place: on TPU a
+# later access raises a deleted-buffer error deep inside jax with no
+# pointer to the donating call; on the CPU backend donation is not
+# implemented at all, so a use-after-donate silently reads STALE
+# pre-update bytes — the worst bug class, because tests on CPU pass
+# while TPU crashes (or vice versa: CPU trains on stale state).
+#
+# sanitize_donation() wraps a donating jitted callable.  Disabled (the
+# default), it returns the callable UNCHANGED — the zero-cost contract,
+# decided at creation like make_lock.  Enabled (PHT_DONATION_SANITIZER=1
+# at wrap time, or under donation_sanitizer()), every call registers the
+# donated argument leaves in a bounded strong-ref registry stamped with
+# the donating call's stack, and:
+#
+# - passing a registered (dead) array back INTO any sanitized program
+#   raises UseAfterDonateError naming both sites (the serving stale-
+#   cache class — on CPU this would otherwise silently compute on
+#   stale state);
+# - the Python access surface of ArrayImpl (scalar dunders, item/
+#   tolist, __array__, __getitem__) is interposed while the sanitizer
+#   is armed, so a host-side read of a dead buffer raises the same
+#   named error (CPU fallback — the same mechanics as
+#   forbid_host_transfers);
+# - on TPU, where jax itself raises on deleted buffers, a RuntimeError
+#   escaping the sanitized call while a registered-dead input is in
+#   scope is re-raised as UseAfterDonateError FROM the original, so the
+#   recorded donation site rides the exception chain.
+#
+# np.asarray via the C buffer protocol stays the documented CPU blind
+# spot (closed statically by PHT006/PHT001).
+
+_DONATION_ENV = "PHT_DONATION_SANITIZER"
+_don_forced = 0                   # donation_sanitizer() nesting count
+_don_lock = threading.Lock()
+# id(arr) -> (arr, site_label, captured donation stack).  STRONG refs:
+# they pin the id (no reuse while the entry lives) and, on CPU, the
+# stale bytes a buggy read would have seen.  Bounded FIFO — ~a few
+# supersteps of dead train state, plenty to catch the read-back window.
+_donated = collections.OrderedDict()
+_DONATED_MAX = 8192
+_don_env_armed = False
+
+
+def donation_sanitizer_enabled() -> bool:
+    """True when :func:`sanitize_donation` should hand out guarded
+    wrappers.  Checked at wrap *creation* time (the zero-cost-off
+    contract): enable before constructing the engine/trainer under
+    test."""
+    return _don_forced > 0 or \
+        os.environ.get(_DONATION_ENV, "") not in ("", "0")
+
+
+def reset_donation_registry() -> None:
+    """Drop every registered donated buffer (test isolation)."""
+    with _don_lock:
+        _donated.clear()
+
+
+def _reset_donation_sanitizer_for_tests() -> None:
+    """Disarm an env-flag-armed interposition and clear the registry.
+    Env-mode arming is process-lifetime by design (the process opted
+    in); only tests exercising the env path need to undo it."""
+    global _don_env_armed
+    if _don_env_armed:
+        _don_env_armed = False
+        _disarm_donation_patches()
+    reset_donation_registry()
+
+
+def _don_entry(arr):
+    ent = _donated.get(id(arr))
+    # identity check makes id-reuse impossible even in theory (we hold a
+    # strong ref, but belt and braces)
+    if ent is not None and ent[0] is arr:
+        return ent
+    return None
+
+
+def _raise_use_after_donate(access: str, ent, cause=None):
+    _, label, stack = ent
+    err = UseAfterDonateError(
+        f"use-after-donate: {access} on a buffer donated to `{label}` — "
+        f"the buffer is dead (deleted in place where donation is "
+        f"effective; silently STALE bytes where the backend ignores "
+        f"donation)\n"
+        f"donating call:\n{_fmt_stack(stack)}"
+        f"offending access: see this exception's traceback\n"
+        f"fix: rebind the name to the program's returned value before "
+        f"any further use (pht-lint PHT006)")
+    if cause is not None:
+        raise err from cause
+    raise err
+
+
+def _arm_donation_patches():
+    _guard_arm("donation")
+
+
+def _disarm_donation_patches():
+    _guard_disarm("donation")
+
+
+@contextlib.contextmanager
+def donation_sanitizer():
+    """Force-enable :func:`sanitize_donation` for this block (test
+    fixture path — construct the engine/trainer INSIDE the block; no
+    environment mutation, nests fine).  Exiting disarms the ArrayImpl
+    interposition and clears the registry."""
+    global _don_forced
+    _don_forced += 1
+    _arm_donation_patches()
+    try:
+        yield
+    finally:
+        _don_forced -= 1
+        _disarm_donation_patches()
+        if _don_forced == 0:
+            reset_donation_registry()
+
+
+def _register_donated(leaf, label, stack) -> None:
+    with _don_lock:
+        while len(_donated) >= _DONATED_MAX:
+            _donated.popitem(last=False)
+        _donated[id(leaf)] = (leaf, label, stack)
+
+
+def sanitize_donation(fn, donate_argnums=(), donate_argnames=(),
+                      site=None):
+    """Wrap a donating jitted callable so use-after-donate fails loudly.
+
+    ``donate_argnums``/``donate_argnames`` must RESTATE what the wrapped
+    ``jax.jit`` donates (the wrapper cannot introspect it); pht-lint's
+    PHT006 reads them off this call the same way it reads the inner
+    ``jax.jit``, so the restatement is lint-checked against real use.
+
+    Disabled (the default): returns ``fn`` unchanged — a plain call,
+    zero added cost.  Decided at creation; see
+    :func:`donation_sanitizer_enabled`."""
+    if not donation_sanitizer_enabled():
+        return fn
+    import jax
+    global _don_env_armed
+    if _don_forced == 0 and not _don_env_armed:
+        # env-flag mode (enabled but no context active): arm the
+        # interposition once, process lifetime — the process opted into
+        # sanitizer mode.  Context-manager mode arms/disarms around the
+        # block instead.
+        _don_env_armed = True
+        _arm_donation_patches()
+    nums = tuple(donate_argnums)
+    names = tuple(donate_argnames)
+    label = site or getattr(fn, "__name__", "donating jitted call")
+
+    def wrapped(*args, **kwargs):
+        if not donation_sanitizer_enabled():
+            # the donation_sanitizer() context that created this wrapper
+            # has exited: behave as the plain call again — no registry
+            # growth (strong refs would pin dead device buffers), no
+            # re-input raises while the read-side guard is disarmed
+            return fn(*args, **kwargs)
+        for leaf in jax.tree.leaves((args, kwargs)):
+            ent = _don_entry(leaf) if isinstance(leaf, jax.Array) else None
+            if ent is not None:
+                _raise_use_after_donate(
+                    f"passing it back into `{label}`", ent)
+        try:
+            out = fn(*args, **kwargs)
+        except RuntimeError as e:
+            # TPU path: jax's own deleted-buffer check fired on an array
+            # some UNsanitized call donated — attach any site we know
+            for leaf in jax.tree.leaves((args, kwargs)):
+                ent = _don_entry(leaf) if isinstance(leaf, jax.Array) \
+                    else None
+                if ent is not None and "delet" in str(e).lower():
+                    _raise_use_after_donate(
+                        f"passing it into `{label}`", ent, cause=e)
+            raise
+        stack = _capture_stack(skip=2)
+        out_ids = {id(l) for l in jax.tree.leaves(out)}
+        trees = [args[p] for p in nums if p < len(args)]
+        trees += [kwargs[n] for n in names if n in kwargs]
+        for tree in trees:
+            for leaf in jax.tree.leaves(tree):
+                if isinstance(leaf, jax.Array) and id(leaf) not in out_ids:
+                    _register_donated(leaf, label, stack)
+        return out
+
+    wrapped._pht_donation_guard = True
+    # instrument_jit (and AOT tooling) reach through to the raw jit
+    wrapped._jit_fn = getattr(fn, "_jit_fn", fn)
+    if hasattr(fn, "_cache_size"):
+        wrapped._cache_size = fn._cache_size
+    if hasattr(fn, "lower"):
+        wrapped.lower = fn.lower
+    return wrapped
 
 
 @contextlib.contextmanager

@@ -20,6 +20,7 @@ import numpy as np
 
 from ..core.tensor import Tensor
 from ..nn.clip import ClipGradBase
+from ..observability.sanitizers import sanitize_donation
 from .lr import LRScheduler
 
 
@@ -101,7 +102,9 @@ class Optimizer:
             # Donate only the accumulator buffers (arg 2): parameter buffers
             # may still be aliased by vjp residuals of a retained graph or by
             # user-held references, so they must not be invalidated.
-            self._jit_update = jax.jit(self._update_all, donate_argnums=(2,))
+            self._jit_update = sanitize_donation(
+                jax.jit(self._update_all, donate_argnums=(2,)),
+                donate_argnums=(2,), site="optimizer.update")
             self._jit_key = key
 
         vals = [p._value for p in params]

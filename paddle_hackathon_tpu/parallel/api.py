@@ -636,7 +636,8 @@ def make_sharded_train_step(model: Layer, mesh: Mesh,
         # jit_builds_total{site=parallel.sharded_train_step} — a step that
         # silently recompiles mid-run shows up in telemetry, not just as a
         # mystery stall
-        return _obs.instrument_jit(jax.jit(
+        from ..observability.sanitizers import sanitize_donation
+        return sanitize_donation(_obs.instrument_jit(jax.jit(
             train_step,
             donate_argnums=(0, 1, 2),
             in_shardings=(param_sh, opt_sh, scalar_sh, batch_sh, None, None),
@@ -644,7 +645,8 @@ def make_sharded_train_step(model: Layer, mesh: Mesh,
             # pick a different layout for the updated params, forcing a
             # re-jit (and a second full compile) on the next step.
             out_shardings=(param_sh, opt_sh, scalar_sh, scalar_sh),
-        ), site="parallel.sharded_train_step")
+        ), site="parallel.sharded_train_step"),
+            donate_argnums=(0, 1, 2), site="parallel.sharded_train_step")
 
     jitted = _make_jitted((NamedSharding(mesh, bspec),
                            NamedSharding(mesh, bspec)))

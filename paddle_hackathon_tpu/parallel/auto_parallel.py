@@ -371,11 +371,13 @@ class Engine:
         # by the live model's Parameters (same invariant as Optimizer.step,
         # optimizer.py — donating them would invalidate the model mid-fit).
         from ..observability import metrics as _obs
-        return _obs.instrument_jit(jax.jit(
+        from ..observability.sanitizers import sanitize_donation
+        return sanitize_donation(_obs.instrument_jit(jax.jit(
             train_step, donate_argnums=(1,),
             in_shardings=(param_sh, opt_sh, None, None, (bsh, bsh)),
             out_shardings=(param_sh, opt_sh, None, None)),
-            site="parallel.engine_train_step")
+            site="parallel.engine_train_step"),
+            donate_argnums=(1,), site="parallel.engine_train_step")
 
     def _build_eval_step(self):
         model, buffers = self.model, self._buffers

@@ -76,7 +76,10 @@ _FILE_COST = {
     "test_tracing.py": 8,   # span/flight/server units; engine runs are slow-marked
     "test_slo.py": 12,      # window/beacon/healthz units + ONE tiny engine
                             # run (lifecycle + /load golden) + one tiny fit
-    "test_lint.py": 7,      # pure AST; one repo-wide walk dominates
+    "test_lint.py": 12,     # pure AST; repo-wide walks (PHT001-008)
+                            # dominate — re-measured after the flow rules
+                            # landed (tools/test_budget.py caught the 7s
+                            # entry going stale)
     "test_checkpointing.py": 8,   # host-only protocol/fault units
     "test_crash_drill.py": 1,     # fully slow-marked (subprocess drills)
     "test_sanitizers.py": 3,  # lock/guard units; engine runs are slow-marked

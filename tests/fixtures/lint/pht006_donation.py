@@ -1,0 +1,119 @@
+"""Seeded PHT006 donation-safety violations — the `# expect:` comments
+ARE the exact-line assertions tests/test_lint.py checks.
+
+Negative shapes asserted clean by the same Counter comparison:
+donate-then-rebind, self.state rebound through .update(), a donation
+only one branch performs.  Never executed.
+"""
+import jax
+import jax.numpy as jnp
+
+from paddle_hackathon_tpu.observability.metrics import instrument_jit
+from paddle_hackathon_tpu.observability.sanitizers import sanitize_donation
+
+
+def _step(state, batch):
+    return state + batch
+
+
+g = jax.jit(_step, donate_argnums=(0,))
+g_named = jax.jit(_step, donate_argnames=("state",))
+g_pair = jax.jit(lambda ab, x: (ab[0] + x, ab[1] - x),
+                 donate_argnums=(0,))
+g_wrapped = sanitize_donation(
+    instrument_jit(jax.jit(_step, donate_argnums=(0,)), site="fixture"),
+    donate_argnums=(0,), site="fixture")
+
+
+def use_after_donate():
+    state = jnp.zeros((4,))
+    out = g(state, jnp.ones((4,)))
+    return state + out             # expect: PHT006
+
+
+def donate_then_rebind_ok():
+    state = jnp.zeros((4,))
+    state = g(state, jnp.ones((4,)))
+    return state                   # clean: rebound before the read
+
+
+def keyword_donation():
+    s = jnp.zeros((4,))
+    out = g_named(batch=jnp.ones((4,)), state=s)
+    return s.sum() + out           # expect: PHT006
+
+
+def argnames_positional():
+    s = jnp.zeros((4,))
+    out = g_named(s, jnp.ones((4,)))   # argnames map to position 0
+    return s.sum() + out           # expect: PHT006
+
+
+def partial_tree_return():
+    a = jnp.zeros((4,))
+    b = jnp.zeros((4,))
+    a, _ = g_pair((a, b), jnp.ones((4,)))
+    return b * 2                   # expect: PHT006
+
+
+def alias_is_dead_too():
+    state = jnp.zeros((4,))
+    view = state                   # one buffer, two names
+    out = g(state, jnp.ones((4,)))
+    return view + out              # expect: PHT006
+
+
+def through_wrappers():
+    s = jnp.zeros((4,))
+    out = g_wrapped(s, jnp.ones((4,)))
+    return s * out                 # expect: PHT006
+
+
+def local_binding_use_after():
+    step = jax.jit(_step, donate_argnums=(0,))
+    s = jnp.zeros((3,))
+    out = step(s, jnp.ones((3,)))
+    return s                       # expect: PHT006
+
+
+def direct_call_use_after():
+    s = jnp.zeros((3,))
+    out = jax.jit(_step, donate_argnums=(0,))(s, jnp.ones((3,)))
+    return s.mean() + out          # expect: PHT006
+
+
+def branch_only_one_path_ok(flag):
+    state = jnp.zeros((4,))
+    if flag:
+        return g(state, jnp.ones((4,)))
+    return state                   # clean: donation not on this path
+
+
+class Prebound:
+    def leak(self, batch):
+        buf = jnp.zeros((4,))
+        self._buf = buf            # the attribute aliases the local...
+        out = g(buf, batch)        # ...which is then donated
+        return self._buf.sum()     # expect: PHT006
+
+    def rebound_ok(self, batch):
+        buf = jnp.zeros((4,))
+        self._buf = buf
+        self._buf = g(buf, batch)  # attribute rebound to the output
+        return self._buf.sum()
+
+
+class Trainer:
+    def __init__(self):
+        self._jit = instrument_jit(
+            jax.jit(_step, donate_argnums=(0,)), site="fixture.trainer")
+        self.state = {"p": jnp.zeros((2,))}
+
+    def run_bad(self, batch):
+        out = self._jit(self.state["p"], batch)
+        return self.state["p"].sum() + out    # expect: PHT006
+
+    def run_ok(self, batch):
+        out = self._jit(self.state["p"], batch)
+        self.state.update(p=out)   # rebinds everything under .state
+        return self.state["p"].sum()

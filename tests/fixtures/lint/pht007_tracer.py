@@ -1,0 +1,94 @@
+"""Seeded PHT007 tracer-escape / stale-closure-capture violations —
+`# expect:` comments are the exact-line assertions.
+
+Negative shapes asserted clean by the same comparison: local-container
+mutation inside a jit, a cache_key that covers every capture, host-side
+self writes outside any trace.  Never executed.
+"""
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from paddle_hackathon_tpu.parallel._smap import run_shard_map
+
+_trace_log = []
+_last_norm = None
+
+
+@jax.jit
+def leaky_step(params, x):
+    global _last_norm
+    y = x @ params
+    _last_norm = jnp.sum(y * y)        # expect: PHT007
+    _trace_log.append(y)               # expect: PHT007
+    return y
+
+
+@jax.jit
+def local_mutation_ok(x):
+    acc = []
+    acc.append(x * 2)      # local container: dies with the trace, fine
+    return jnp.stack(acc)
+
+
+class Stats:
+    def collect(self, x, mesh):
+        def body(xl):
+            s = jnp.sum(xl)
+            self.last = s              # expect: PHT007
+            return xl * 2
+        return run_shard_map(body, mesh, in_specs=(P("dp"),),
+                             out_specs=P("dp"), manual_axes={"dp"},
+                             args=(x,), cache_key=("stats",))
+
+
+class HostSide:
+    def configure(self, n):
+        self.n = n         # not a traced body: plain host state, clean
+
+
+def fresh_closure_no_key(x, mesh):
+    def body(xl):
+        return xl * 2
+    return run_shard_map(body, mesh, in_specs=(P("dp"),),  # expect: PHT007
+                         out_specs=P("dp"), manual_axes={"dp"},
+                         args=(x,))
+
+
+def stale_capture(x, mesh, shift):
+    def body(xl):
+        return xl + shift
+    return run_shard_map(body, mesh, in_specs=(P("dp"),),  # expect: PHT007
+                         out_specs=P("dp"), manual_axes={"dp"},
+                         args=(x,), cache_key=("stale",))
+
+
+def covered_key_ok(x, mesh, width):
+    def body(xl):
+        return xl * width
+    return run_shard_map(body, mesh, in_specs=(P("dp"),),
+                         out_specs=P("dp"), manual_axes={"dp"},
+                         args=(x,), cache_key=("covered", width))
+
+
+def capture_rides_manual_axes_ok(x, mesh, axis):
+    # `axis` never appears in cache_key, but manual_axes carries it and
+    # run_shard_map folds manual_axes into its program key itself
+    def body(xl):
+        return jax.lax.psum(xl, axis)
+    return run_shard_map(body, mesh, in_specs=(P("dp"),),
+                         out_specs=P("dp"), manual_axes={axis},
+                         args=(x,), cache_key=("rides_manual",))
+
+
+def helper_closure_covered_ok(x, mesh, scale):
+    # body captures `helper`, a local def; helper's own capture `scale`
+    # is in the key — covered transitively
+    def helper(v):
+        return v * scale
+
+    def body(xl):
+        return helper(xl)
+    return run_shard_map(body, mesh, in_specs=(P("dp"),),
+                         out_specs=P("dp"), manual_axes={"dp"},
+                         args=(x,), cache_key=("helper", scale))

@@ -53,7 +53,10 @@ def _actual(path):
                                   "pht002_retrace.py",
                                   "pht003_locks.py",
                                   "pht004_nondet.py",
-                                  "pht005_labels.py"])
+                                  "pht005_labels.py",
+                                  "pht006_donation.py",
+                                  "pht007_tracer.py",
+                                  "pht008_specs.py"])
 def test_seeded_violations_detected_at_exact_lines(name):
     """Every seeded violation fires at the exact file:line — and ONLY
     there (the Counter equality also rejects extra findings, so the
@@ -125,6 +128,71 @@ def test_new_telemetry_code_is_label_cardinality_clean():
     # the rule actually ran here: the two justified per-topology loops
     # (expert label, device label) are suppressed, not invisible
     assert sum(f.rule == "PHT005" for f in suppressed) >= 2
+
+
+# ------------------------------------------- PHT006-008 (flow) units
+def test_underkeyed_cache_key_is_caught(tmp_path):
+    """The generalized ring_attention seq_local hazard: dropping a
+    captured local from the cache_key must lint (PR 7 caught this class
+    by hand; the pre-ZeRO check must catch it mechanically)."""
+    src = open(os.path.join(ROOT, "paddle_hackathon_tpu", "parallel",
+                            "sequence.py"), encoding="utf-8").read()
+    broken = src.replace(
+        'cache_key=("ring_xla", axis, n, causal, float(scale_), seq_local)',
+        'cache_key=("ring_xla", axis, n, causal, float(scale_))')
+    assert broken != src, "ring_xla cache_key moved — update this test"
+    p = tmp_path / "sequence.py"
+    p.write_text(broken)
+    findings, _, _ = run_lint(paths=[str(p)], baseline_path=None,
+                              repo_root=str(tmp_path))
+    assert any(f.rule == "PHT007" and "seq_local" in f.message
+               for f in findings), [f.render() for f in findings]
+    # and the shipped file keys the capture: clean
+    ok, _, _ = run_lint(paths=[os.path.join(
+        ROOT, "paddle_hackathon_tpu", "parallel", "sequence.py")],
+        baseline_path=None)
+    assert not any(f.rule == "PHT007" for f in ok)
+
+
+def test_donation_flow_sees_through_wrappers(tmp_path):
+    """instrument_jit/sanitize_donation wrapping must not hide the
+    donate_argnums from PHT006 — the repo's donation sites are all
+    wrapped (hapi/compiled.py is the template)."""
+    p = tmp_path / "m.py"
+    p.write_text(
+        "import jax\n"
+        "from paddle_hackathon_tpu.observability.metrics import "
+        "instrument_jit\n\n\n"
+        "def _step(s, b):\n"
+        "    return s + b\n\n\n"
+        "class T:\n"
+        "    def __init__(self):\n"
+        "        self._jit = instrument_jit(\n"
+        "            jax.jit(_step, donate_argnums=(0,)), site='x')\n\n"
+        "    def run(self, b):\n"
+        "        out = self._jit(self.state, b)\n"
+        "        return self.state\n")
+    findings, _, _ = run_lint(paths=[str(p)], baseline_path=None,
+                              repo_root=str(tmp_path))
+    assert [f.rule for f in findings] == ["PHT006"]
+    assert "self.state" in findings[0].message
+
+
+def test_spec_drift_resolves_create_mesh_axes(tmp_path):
+    """PHT008 reads axis names out of parallel/api.py's create_mesh
+    dict literal, not just jax.sharding.Mesh ctors."""
+    p = tmp_path / "m.py"
+    p.write_text(
+        "import jax\n"
+        "from jax.sharding import NamedSharding, PartitionSpec as P\n"
+        "from paddle_hackathon_tpu.parallel.api import create_mesh\n\n"
+        "m = create_mesh({'dp': 2, 'mp': 4})\n\n\n"
+        "def place(arr):\n"
+        "    return jax.device_put(arr, NamedSharding(m, P('tp')))\n")
+    findings, _, _ = run_lint(paths=[str(p)], baseline_path=None,
+                              repo_root=str(tmp_path))
+    assert [f.rule for f in findings] == ["PHT008"]
+    assert "tp" in findings[0].message
 
 
 # ------------------------------------------------------------ baseline

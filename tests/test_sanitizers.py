@@ -369,6 +369,263 @@ def test_forbid_host_transfers_nests_and_restores_on_error():
     assert float(x) == 1.0                # restored despite the escape
 
 
+# ------------------------------------------------- donation sanitizer
+def _donstep(s, b):
+    return s + b
+
+
+def test_donation_sanitizer_disabled_is_zero_cost_plain_call():
+    """The make_lock contract: off (default) returns the callable
+    UNCHANGED — not even a wrapper frame."""
+    import jax
+    assert not S.donation_sanitizer_enabled()
+    f = jax.jit(_donstep, donate_argnums=(0,))
+    assert S.sanitize_donation(f, donate_argnums=(0,)) is f
+
+
+def test_use_after_donate_read_raises_with_both_stacks():
+    import jax.numpy as jnp
+    import jax
+    with S.donation_sanitizer():
+        g = S.sanitize_donation(jax.jit(_donstep, donate_argnums=(0,)),
+                                donate_argnums=(0,), site="unit.step")
+        s = jnp.zeros((4,))
+        out = g(s, jnp.ones((4,)))
+        with pytest.raises(S.UseAfterDonateError) as ei:
+            float(s[0])
+        msg = str(ei.value)
+        assert "unit.step" in msg           # the donating site, named
+        assert "donating call" in msg
+        assert "test_sanitizers" in msg     # ...with its recorded stack
+        assert "PHT006" in msg              # points at the static rule
+        # the OUTPUT is alive and readable
+        assert float(out.sum()) == 4.0
+    # context exit disarms the interposition: fresh arrays unaffected,
+    # and the dead handle now raises jax's OWN context-free error (on
+    # this jaxlib CPU donation really deletes) — which is exactly the
+    # un-annotated failure mode the sanitizer exists to improve on
+    import jax.numpy as jnp2
+    assert float(jnp2.ones(())[()]) == 1.0
+    with pytest.raises(RuntimeError) as ei2:
+        float(s[0])
+    assert not isinstance(ei2.value, S.UseAfterDonateError)
+
+
+def test_donated_buffer_as_program_input_raises():
+    """The serving stale-cache class: on CPU (donation a no-op) feeding
+    a dead buffer back in would silently compute on stale bytes."""
+    import jax
+    import jax.numpy as jnp
+    with S.donation_sanitizer():
+        g = S.sanitize_donation(jax.jit(_donstep, donate_argnums=(0,)),
+                                donate_argnums=(0,), site="unit.reinput")
+        s = jnp.zeros((4,))
+        g(s, jnp.ones((4,)))
+        with pytest.raises(S.UseAfterDonateError,
+                           match="passing it back into"):
+            g(s, jnp.ones((4,)))
+
+
+def test_donate_then_rebind_is_clean():
+    import jax
+    import jax.numpy as jnp
+    with S.donation_sanitizer():
+        g = S.sanitize_donation(jax.jit(_donstep, donate_argnums=(0,)),
+                                donate_argnums=(0,), site="unit.rebind")
+        s = jnp.zeros((4,))
+        for _ in range(3):
+            s = g(s, jnp.ones((4,)))      # the clean shape
+        assert float(s.sum()) == 12.0
+
+
+def test_broken_consumer_raises_naming_the_donation_site():
+    """The deliberately-broken shape: a trainer-alike that forgets to
+    rebind its state after the donating call — the SECOND run must be a
+    named error, not a silent stale-state step."""
+    import jax
+    import jax.numpy as jnp
+
+    class BrokenTrainer:
+        def __init__(self):
+            self._jit = S.sanitize_donation(
+                jax.jit(_donstep, donate_argnums=(0,)),
+                donate_argnums=(0,), site="broken.trainer")
+            self.state = jnp.zeros((4,))
+
+        def run(self, b):
+            return self._jit(self.state, b)   # BUG: state never rebound
+
+    with S.donation_sanitizer():
+        t = BrokenTrainer()
+        t.run(jnp.ones((4,)))
+        with pytest.raises(S.UseAfterDonateError,
+                           match="broken.trainer"):
+            t.run(jnp.ones((4,)))
+
+
+def test_donation_env_flag_arms_at_creation(monkeypatch):
+    """PHT_DONATION_SANITIZER=1 in the environment enables wrapping at
+    CREATION time, same contract as PHT_LOCK_SANITIZER."""
+    import jax
+    import jax.numpy as jnp
+    monkeypatch.setenv("PHT_DONATION_SANITIZER", "1")
+    try:
+        assert S.donation_sanitizer_enabled()
+        g = S.sanitize_donation(jax.jit(_donstep, donate_argnums=(0,)),
+                                donate_argnums=(0,), site="env.step")
+        assert getattr(g, "_pht_donation_guard", False)
+        s = jnp.zeros((2,))
+        g(s, jnp.ones((2,)))
+        with pytest.raises(S.UseAfterDonateError, match="env.step"):
+            s.tolist()
+    finally:
+        S._reset_donation_sanitizer_for_tests()
+    # a wrapper built AFTER the flag is gone is a plain call again
+    monkeypatch.delenv("PHT_DONATION_SANITIZER")
+    f = jax.jit(_donstep, donate_argnums=(0,))
+    assert S.sanitize_donation(f, donate_argnums=(0,)) is f
+
+
+def test_donation_registry_is_bounded():
+    import jax
+    import jax.numpy as jnp
+    with S.donation_sanitizer():
+        g = S.sanitize_donation(jax.jit(_donstep, donate_argnums=(0,)),
+                                donate_argnums=(0,), site="unit.bound")
+        s = jnp.zeros((2,))
+        for _ in range(16):
+            s = g(s, jnp.ones((2,)))
+        from paddle_hackathon_tpu.observability.sanitizers import (
+            _DONATED_MAX, _donated)
+        assert 0 < len(_donated) <= _DONATED_MAX
+
+
+def test_interleaved_guards_restore_cleanly():
+    """Regression: the transfer guard and the donation sanitizer patch
+    the SAME ArrayImpl surface — with independent save/restore pairs, a
+    forbid_host_transfers() block exiting while the donation sanitizer
+    was armed wiped the donation read-guard, and the later donation
+    disarm reinstalled the transfer TRIP as the 'original', poisoning
+    float()/item() on every array process-wide."""
+    import jax
+    import jax.numpy as jnp
+    with S.donation_sanitizer():
+        g = S.sanitize_donation(jax.jit(_donstep, donate_argnums=(0,)),
+                                donate_argnums=(0,), site="mix.step")
+        with S.forbid_host_transfers():
+            # non-LIFO interleaving: the transfer block closes while
+            # the donation guard must stay armed
+            pass
+        s = jnp.zeros((4,))
+        g(s, jnp.ones((4,)))
+        with pytest.raises(S.UseAfterDonateError, match="mix.step"):
+            float(s[0])       # donation guard survived the inner exit
+    # ...and after the donation context exits too, NO trip is left
+    # behind: scalar reads on fresh arrays are plain reads again
+    assert float(jnp.ones(())) == 1.0
+    assert jnp.arange(3).tolist() == [0, 1, 2]
+
+
+def test_wrapper_outliving_its_context_is_a_plain_call():
+    """Regression: a wrapper created inside donation_sanitizer() used to
+    stay half-armed after the context exited — still pinning every
+    donated leaf in the strong-ref registry and still raising on
+    re-input while the read-side guard was disarmed."""
+    import jax
+    import jax.numpy as jnp
+    with S.donation_sanitizer():
+        g = S.sanitize_donation(jax.jit(_donstep, donate_argnums=(0,)),
+                                donate_argnums=(0,), site="outlive.step")
+    from paddle_hackathon_tpu.observability.sanitizers import _donated
+    s = jnp.zeros((4,))
+    out = g(s, jnp.ones((4,)))
+    assert len(_donated) == 0          # no registry growth when disabled
+    assert float(out.sum()) == 4.0
+    # re-arming a NEW context resumes guarding through the same wrapper
+    with S.donation_sanitizer():
+        s2 = jnp.zeros((4,))
+        g(s2, jnp.ones((4,)))
+        with pytest.raises(S.UseAfterDonateError, match="outlive.step"):
+            g(s2, jnp.ones((4,)))
+
+
+# ----------------------------------------------- jaxcompat bridge canary
+def test_jaxcompat_bridges_survive_reseed():
+    """core/jaxcompat.py has been WIPED by a re-seed before (PR 2 had to
+    rebuild it; MEMORY/ROADMAP both warn).  Import the bridge symbols
+    tier-1 so a wipe fails HERE, loudly, instead of as a downstream XLA
+    abort in the pp/sp stacks."""
+    import contextlib
+    import jax
+
+    from paddle_hackathon_tpu.core import jaxcompat
+
+    assert callable(jaxcompat.shard_map)
+    assert callable(jaxcompat.set_mesh)
+    # jax.export registered on old jax (jit.save depends on it)
+    assert hasattr(jax, "export")
+    if not hasattr(jax, "set_mesh"):
+        # old-jax half of the bridge: set_mesh(None) is a no-op context,
+        # and partial-manual shard_map REFUSES with a Python error
+        # instead of letting XLA's C++ CHECK abort the interpreter
+        ctx = jaxcompat.set_mesh(None)
+        assert isinstance(ctx, contextlib.nullcontext) or hasattr(
+            ctx, "__enter__")
+        import numpy as _np
+        from jax.sharding import PartitionSpec as P
+        devs = jax.devices()
+        if len(devs) >= 4:
+            mesh = jax.sharding.Mesh(
+                _np.asarray(devs[:4]).reshape(2, 2), ("a", "b"))
+            with pytest.raises(NotImplementedError,
+                               match="partial-manual"):
+                jaxcompat.shard_map(lambda x: x, mesh=mesh,
+                                    in_specs=P(), out_specs=P(),
+                                    axis_names={"a"})
+
+
+@pytest.mark.slow
+def test_trainer_and_dense_tick_run_clean_under_donation_sanitizer(
+        monkeypatch):
+    """The acceptance drive: one CompiledTrainer superstep and a dense
+    serving decode run complete with ZERO use-after-donate under
+    PHT_DONATION_SANITIZER=1 — every donating program rebinds before
+    any re-read, engine and trainer both."""
+    import jax
+
+    monkeypatch.setenv("PHT_DONATION_SANITIZER", "1")
+    try:
+        from paddle_hackathon_tpu.inference import ServingEngine
+        m = _tiny_gpt()
+        eng = ServingEngine(m, max_slots=2, max_len=64, chunk=4,
+                            auto_run=False)
+        prompts = _prompts()
+        reqs = [eng.submit(p, 10) for p in prompts]
+        eng.run_until_idle()
+        outs = [r.result() for r in reqs]
+        for p, o in zip(prompts, outs):
+            assert len(o) == len(p) + 10
+        eng.shutdown()
+
+        from paddle_hackathon_tpu.hapi.compiled import CompiledTrainer
+        paddle.seed(7)
+        net = nn.Sequential(nn.Linear(10, 32), nn.ReLU(),
+                            nn.Linear(32, 2))
+        mdl = hapi.Model(net)
+        mdl.prepare(optimizer=optim.Adam(learning_rate=1e-2,
+                                         parameters=net.parameters()),
+                    loss=nn.CrossEntropyLoss())
+        trainer = CompiledTrainer(mdl)
+        rs = np.random.RandomState(0)
+        x = rs.randn(8, 10).astype(np.float32)
+        y = (x.sum(1) > 0).astype(np.int64)
+        for _ in range(2):
+            losses = trainer.run((x[None],), (y[None],))
+        assert np.isfinite(jax.device_get(losses)).all()
+    finally:
+        S._reset_donation_sanitizer_for_tests()
+
+
 # ---------------------------------------------------- engines (slow)
 def _tiny_gpt(num_layers=2):
     from paddle_hackathon_tpu.models.gpt import GPTConfig, GPTForCausalLM

@@ -21,6 +21,7 @@ import sys
 from typing import Dict, List, Optional, Tuple
 
 from .callgraph import HOT_ROOT_MARK, ModuleInfo, index_module
+from .flow import lint_module_flow
 from .rules import Finding, lint_locks, lint_module
 
 __all__ = ["Finding", "run_lint", "load_baseline", "default_paths",
@@ -212,6 +213,7 @@ def run_lint(paths: Optional[List[str]] = None,
     findings: List[Finding] = []
     for mi in modules:
         findings.extend(lint_module(mi))
+        findings.extend(lint_module_flow(mi))
     lock_modules = modules
     if full_lock_graph:
         by_path = {m.path for m in modules}

@@ -15,7 +15,7 @@ from . import (DEFAULT_BASELINE, BaselineError, changed_paths, run_lint)
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m tools.pht_lint",
-        description="JAX hot-path static analysis (PHT001-PHT005)")
+        description="JAX hot-path static analysis (PHT001-PHT008)")
     ap.add_argument("paths", nargs="*",
                     help="files to lint (default: package + tools + "
                          "bench.py)")

@@ -1,0 +1,1041 @@
+"""pht-lint flow rules PHT006–PHT008 (catalog: docs/STATIC_ANALYSIS.md).
+
+PHT006  donation-safety         — a value donated to a jitted program
+        (``donate_argnums``/``donate_argnames``) is READ again after the
+        donating call: on TPU the buffer was invalidated in place, so
+        the read raises a deleted-buffer error at best and — via a
+        cached alias — reuses garbage at worst; on CPU (donation
+        unsupported) the read silently sees STALE pre-update bytes,
+        which is the harder bug to find.  Rebinding the name/attribute
+        is the clean shape; the flow pass clears the mark on rebind.
+PHT007  tracer-escape           — (a) inside jitted and shard_map
+        bodies: traced values written to ``self``/globals/outer-scope
+        containers leak tracers (error at best, a frozen trace-time
+        value at worst); (b) at ``run_shard_map``-style cached-program
+        call sites: a per-call closure with no ``cache_key``, or a
+        ``cache_key`` that does not fold in some mutable outer variable
+        the closure captures — the cache then serves a STALE program
+        compiled against the old captured value (the ``ring_attention``
+        ``seq_local`` hazard, generalized).
+PHT008  sharding-spec drift     — at ``shard_map``/``run_shard_map``/
+        ``NamedSharding`` sites where the mesh's axis names are
+        statically known (literal ``Mesh(...)``, ``create_mesh({...})``,
+        module constants): a spec/axis name missing from the mesh, or an
+        ``in_specs`` tuple whose arity disagrees with the body's
+        parameters / the ``args`` tuple.  These otherwise surface as
+        trace-time XLA aborts long after the edit that caused them.
+
+Same design rules as rules.py: pure stdlib ``ast``, conservative
+resolution (a shape we cannot prove is NOT flagged — misses are
+acceptable, false positives are not), per-function flow sensitivity
+with branch intersection so an ``if``-guarded donation never flags the
+other branch.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .callgraph import FuncInfo, ModuleInfo
+from .rules import Finding, _call_dotted, _is_jit_ctor, _jit_targets
+
+# wrappers that return the (possibly jitted) callable they were given:
+# fn = wrapper(jax.jit(f, donate_argnums=...), ...) must still read as a
+# donating binding.  sanitize_donation additionally RESTATES the donated
+# positions as its own kwarg (the runtime half needs them), so the info
+# is read from whichever call carries it.
+_TRANSPARENT_TAILS = ("instrument_jit", "sanitize_donation")
+
+_DONATE_KWARGS = ("donate_argnums", "donate_argnames")
+
+
+def _tail(dotted: Optional[str]) -> str:
+    return dotted.rsplit(".", 1)[-1] if dotted else ""
+
+
+def _literal_ints(v) -> Optional[Set[int]]:
+    if isinstance(v, ast.Constant) and isinstance(v.value, int):
+        return {v.value}
+    if isinstance(v, (ast.Tuple, ast.List)):
+        out = set()
+        for e in v.elts:
+            if not (isinstance(e, ast.Constant) and isinstance(e.value, int)):
+                return None
+            out.add(e.value)
+        return out
+    return None
+
+
+def _literal_strs(v) -> Optional[Set[str]]:
+    if isinstance(v, ast.Constant) and isinstance(v.value, str):
+        return {v.value}
+    if isinstance(v, (ast.Tuple, ast.List)):
+        out = set()
+        for e in v.elts:
+            if not (isinstance(e, ast.Constant) and isinstance(e.value, str)):
+                return None
+            out.add(e.value)
+        return out
+    return None
+
+
+class _DonateInfo:
+    __slots__ = ("argnums", "argnames", "line", "fn_params")
+
+    def __init__(self, argnums, argnames, line, fn_params=None):
+        self.argnums = argnums            # Set[int]
+        self.argnames = argnames          # Set[str]
+        self.line = line
+        self.fn_params = fn_params        # positional arg names of the
+        #                                   wrapped fn, when resolvable —
+        #                                   maps argnames to positions
+
+
+def _donate_info_of_call(mi: ModuleInfo, call: ast.Call,
+                         funcs: Dict[str, FuncInfo]) -> Optional[_DonateInfo]:
+    """Donation info of ``call`` if it constructs a donating jitted
+    callable — looking through transparent wrappers."""
+    seen_nums: Set[int] = set()
+    seen_names: Set[str] = set()
+    inner = call
+    for _ in range(4):          # wrapper nesting is shallow in practice
+        for kw in inner.keywords:
+            if kw.arg == "donate_argnums":
+                nums = _literal_ints(kw.value)
+                if nums:
+                    seen_nums |= nums
+            elif kw.arg == "donate_argnames":
+                names = _literal_strs(kw.value)
+                if names:
+                    seen_names |= names
+        if _is_jit_ctor(mi, inner):
+            break
+        if _tail(_call_dotted(mi, inner)) in _TRANSPARENT_TAILS \
+                and inner.args and isinstance(inner.args[0], ast.Call):
+            inner = inner.args[0]
+            continue
+        return None
+    else:
+        return None
+    if not seen_nums and not seen_names:
+        return None
+    fn_params = None
+    if inner.args and isinstance(inner.args[0], ast.Name):
+        fi = funcs.get(inner.args[0].id)
+        if fi is not None:
+            a = getattr(fi.node, "args", None)
+            if a is not None:
+                fn_params = [x.arg for x in a.posonlyargs + a.args]
+    return _DonateInfo(seen_nums, seen_names, call.lineno, fn_params)
+
+
+class _DonatingBindings(ast.NodeVisitor):
+    """Module scan for donating-callable bindings:
+    ``g = jax.jit(f, donate_argnums=...)`` at module level, and
+    ``self.attr = jax.jit(...)`` (possibly wrapped) anywhere in a class
+    body or method."""
+
+    def __init__(self, mi: ModuleInfo):
+        self.mi = mi
+        self.names: Dict[str, _DonateInfo] = {}
+        self.attrs: Dict[Tuple[str, str], _DonateInfo] = {}
+        self._class_stack: List[str] = []
+        self._func_depth = 0
+
+    def visit_ClassDef(self, node):
+        self._class_stack.append(node.name)
+        self.generic_visit(node)
+        self._class_stack.pop()
+
+    def visit_FunctionDef(self, node):
+        self._func_depth += 1
+        self.generic_visit(node)
+        self._func_depth -= 1
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Assign(self, node: ast.Assign):
+        if isinstance(node.value, ast.Call):
+            info = _donate_info_of_call(self.mi, node.value, self.mi.funcs)
+            if info is not None:
+                for t in node.targets:
+                    if isinstance(t, ast.Name) and self._func_depth == 0:
+                        self.names[t.id] = info
+                    elif (isinstance(t, ast.Attribute)
+                          and isinstance(t.value, ast.Name)
+                          and t.value.id == "self" and self._class_stack):
+                        self.attrs[(self._class_stack[-1], t.attr)] = info
+        self.generic_visit(node)
+
+
+# --------------------------------------------------------------------------
+# PHT006: per-function donation flow
+# --------------------------------------------------------------------------
+
+Path = Tuple[str, ...]
+
+
+def _path_of(e: ast.expr) -> Optional[Path]:
+    """("self", ".state", "[params]") style access path, or None for
+    anything dynamic (a call in the chain, a non-constant subscript)."""
+    parts: List[str] = []
+    while True:
+        if isinstance(e, ast.Attribute):
+            parts.append("." + e.attr)
+            e = e.value
+        elif isinstance(e, ast.Subscript):
+            s = e.slice
+            if isinstance(s, ast.Constant) and isinstance(
+                    s.value, (str, int)):
+                parts.append(f"[{s.value!r}]")
+                e = e.value
+            else:
+                return None
+        elif isinstance(e, ast.Name):
+            parts.append(e.id)
+            return tuple(reversed(parts))
+        else:
+            return None
+
+
+def _render_path(p: Path) -> str:
+    return "".join(p)
+
+
+class _DonationWalker(ast.NodeVisitor):
+    """Order-preserving walk of one function body tracking which access
+    paths currently refer to DONATED (dead) buffers.
+
+    - a donating call marks the donated argument expressions' paths (and
+      their recorded aliases) dead, stamped with the call line;
+    - any later Load of a dead path (or an extension of one) is a
+      PHT006 finding;
+    - a Store to a path clears every mark at or under it (rebinding is
+      the clean shape); a method call on a path conservatively clears
+      everything strictly under it (``self.state.update(...)``);
+    - ``if``/``try`` branches are walked independently and the marks
+      INTERSECTED after (a donation only one branch performs must not
+      flag the other branch's reads).
+    """
+
+    def __init__(self, mi: ModuleInfo, fi: FuncInfo,
+                 names: Dict[str, _DonateInfo],
+                 attrs: Dict[Tuple[str, str], _DonateInfo],
+                 findings: List[Finding]):
+        self.mi = mi
+        self.fi = fi
+        self.names = names
+        self.attrs = attrs
+        self.findings = findings
+        self.donated: Dict[Path, Tuple[int, str]] = {}
+        self.aliases: Dict[Path, Set[Path]] = {}
+        self.local_names: Dict[str, _DonateInfo] = {}
+        self._reported: Set[Tuple[int, Path]] = set()
+
+    def run(self):
+        for stmt in getattr(self.fi.node, "body", []):
+            self.visit(stmt)
+
+    # -- helpers ------------------------------------------------------------
+    def _mark(self, path: Path, line: int, desc: str):
+        for p in {path} | self.aliases.get(path, set()):
+            self.donated[p] = (line, desc)
+
+    def _clear_under(self, path: Path, strict: bool = False):
+        for p in list(self.donated):
+            if p[:len(path)] == path and (not strict or p != path):
+                del self.donated[p]
+
+    def _check_load(self, node: ast.expr):
+        path = _path_of(node)
+        if path is None:
+            return
+        for d, (line, desc) in self.donated.items():
+            if path[:len(d)] == d:
+                key = (node.lineno, d)
+                if key in self._reported:
+                    return
+                self._reported.add(key)
+                self.findings.append(Finding(
+                    rule="PHT006", file=self.mi.relpath, line=node.lineno,
+                    func=self.fi.qualname,
+                    message=f"`{_render_path(path)}` was donated to "
+                            f"{desc} (line {line}) and is read again "
+                            "here — the buffer is dead: deleted-buffer "
+                            "error on TPU, silently STALE bytes on "
+                            "backends without donation",
+                    hint="rebind the name to the program's returned "
+                         "value before any further use (p, s = "
+                         "step(p, s)), or drop donation for a buffer "
+                         "that must stay live"))
+                return
+
+    # -- donation detection -------------------------------------------------
+    def _donating_info(self, node: ast.Call) -> Optional[_DonateInfo]:
+        f = node.func
+        if isinstance(f, ast.Name):
+            return self.local_names.get(f.id) or self.names.get(f.id)
+        if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name) \
+                and f.value.id == "self" and self.fi.class_name:
+            return self.attrs.get((self.fi.class_name, f.attr))
+        if isinstance(f, ast.Call):
+            # jax.jit(fn, donate_argnums=...)(args): donates right here
+            return _donate_info_of_call(self.mi, f, self.mi.funcs)
+        return None
+
+    def _apply_donation(self, node: ast.Call, info: _DonateInfo):
+        if any(isinstance(a, ast.Starred) for a in node.args):
+            return            # positional mapping unknowable
+        desc = "a donating jitted call"
+        f = node.func
+        fp = _path_of(f) if isinstance(f, (ast.Name, ast.Attribute)) else None
+        if fp is not None:
+            desc = f"donating call `{_render_path(fp)}(...)`"
+        positions = set(info.argnums)
+        names = set(info.argnames)
+        if names and info.fn_params:
+            for n in names:
+                if n in info.fn_params:
+                    positions.add(info.fn_params.index(n))
+        exprs: List[ast.expr] = []
+        for pos in positions:
+            if pos < len(node.args):
+                exprs.append(node.args[pos])
+        for kw in node.keywords:
+            if kw.arg in names:
+                exprs.append(kw.value)
+        for e in exprs:
+            parts = e.elts if isinstance(e, (ast.Tuple, ast.List)) else (
+                list(e.values) if isinstance(e, ast.Dict) else [e])
+            for sub in parts:
+                p = _path_of(sub)
+                if p is not None:
+                    self._mark(p, node.lineno, desc)
+
+    # -- statements ---------------------------------------------------------
+    def _bind_target(self, t: ast.expr):
+        p = _path_of(t)
+        if p is not None:
+            self._clear_under(p)
+            # the name now refers elsewhere: stop treating it as an
+            # alias of whatever it used to share a buffer with
+            self.aliases.pop(p, None)
+            for group in self.aliases.values():
+                group.discard(p)
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                self._bind_target(e)
+        elif isinstance(t, ast.Starred):
+            self._bind_target(t.value)
+        elif isinstance(t, (ast.Attribute, ast.Subscript)):
+            # dynamic path: visiting the receiver checks its reads
+            self.visit(t.value)
+
+    def visit_Assign(self, node: ast.Assign):
+        self.visit(node.value)
+        if isinstance(node.value, ast.Call):
+            info = _donate_info_of_call(self.mi, node.value, self.mi.funcs)
+            if info is not None:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        self.local_names[t.id] = info
+        for t in node.targets:
+            self._bind_target(t)
+        # alias record: `x = self.buf` makes x and self.buf one buffer —
+        # donating either later kills both
+        vp = _path_of(node.value)
+        if vp is not None:
+            for t in node.targets:
+                tp = _path_of(t)
+                if tp is not None and tp != vp:
+                    self.aliases.setdefault(vp, set()).add(tp)
+                    self.aliases.setdefault(tp, set()).add(vp)
+
+    def visit_AnnAssign(self, node):
+        if node.value is not None:
+            self.visit(node.value)
+        self._bind_target(node.target)
+
+    def visit_AugAssign(self, node):
+        self.visit(node.value)
+        self._check_load(node.target)     # x += 1 reads x first
+        self._bind_target(node.target)
+
+    def visit_Delete(self, node):
+        for t in node.targets:
+            p = _path_of(t)
+            if p is not None:
+                self._clear_under(p)
+
+    def visit_Return(self, node):
+        if node.value is not None:
+            self.visit(node.value)
+
+    # -- expressions --------------------------------------------------------
+    def visit_Name(self, node: ast.Name):
+        if isinstance(node.ctx, ast.Load):
+            self._check_load(node)
+
+    def visit_Attribute(self, node: ast.Attribute):
+        if isinstance(node.ctx, ast.Load):
+            self._check_load(node)
+        self.generic_visit(node)
+
+    def visit_Subscript(self, node: ast.Subscript):
+        if isinstance(node.ctx, ast.Load):
+            self._check_load(node)
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call):
+        # receiver of a method call: a READ of the receiver path (a dead
+        # receiver fires), then args, then donation marks, then the
+        # conservative mutation-clears-children rule
+        recv_path = None
+        if isinstance(node.func, ast.Attribute):
+            recv_path = _path_of(node.func.value)
+            self._check_load(node.func.value)
+            if recv_path is None:
+                self.visit(node.func.value)
+        elif isinstance(node.func, ast.Call):
+            self.visit(node.func)
+        for a in node.args:
+            self.visit(a.value if isinstance(a, ast.Starred) else a)
+        for kw in node.keywords:
+            self.visit(kw.value)
+        if recv_path is not None:
+            # self.state.update(params=p): whatever lived UNDER the
+            # receiver may have been rebound by the mutation.  BEFORE
+            # donation marking: the call's own marks are its post-state
+            # (self._jit(self.state[...]) must not clear itself)
+            self._clear_under(recv_path, strict=True)
+        info = self._donating_info(node)
+        if info is not None:
+            self._apply_donation(node, info)
+
+    # -- control flow: branch intersection ----------------------------------
+    def _branch(self, stmts) -> Dict[Path, Tuple[int, str]]:
+        saved = dict(self.donated)
+        for s in stmts:
+            self.visit(s)
+        out = self.donated
+        self.donated = saved
+        return out
+
+    def visit_If(self, node: ast.If):
+        self.visit(node.test)
+        aliases_before = {k: set(v) for k, v in self.aliases.items()}
+        a = self._branch(node.body)
+        b = self._branch(node.orelse)
+        self.donated = {k: v for k, v in a.items() if k in b}
+        # aliases recorded inside a branch may not hold on the other
+        # path — keeping them could mark a buffer donated through an
+        # alias that never existed (a false positive); drop them
+        self.aliases = aliases_before
+
+    def visit_Try(self, node):
+        body = self._branch(node.body)    # walked against current state
+        for h in node.handlers:
+            # handlers run against the PRE-try marks: the donation the
+            # body performs may not have happened when the handler does
+            saved = dict(self.donated)
+            for s in h.body:
+                self.visit(s)
+            self.donated = saved
+        # fall-through continues on the no-exception path's state
+        self.donated = body
+        for s in node.finalbody:
+            self.visit(s)
+
+    def visit_While(self, node):
+        self.visit(node.test)
+        for s in node.body:
+            self.visit(s)
+        for s in node.orelse:
+            self.visit(s)
+
+    def visit_For(self, node):
+        self.visit(node.iter)
+        self._bind_target(node.target)
+        for s in node.body:
+            self.visit(s)
+        for s in node.orelse:
+            self.visit(s)
+
+    # nested defs/lambdas: separate scopes (their own FuncInfo)
+    def visit_FunctionDef(self, node):
+        pass
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node):
+        pass
+
+
+# --------------------------------------------------------------------------
+# PHT007: tracer escape + stale closure capture
+# --------------------------------------------------------------------------
+
+_SMAP_TAILS = ("shard_map", "run_shard_map")
+_MUTATORS = frozenset(("append", "add", "extend", "insert", "update",
+                       "setdefault", "put", "appendleft"))
+
+
+def _bound_names(node: ast.AST) -> Set[str]:
+    """Every name BOUND anywhere under ``node`` (params of nested defs
+    included — over-approximating bound names shrinks the free set,
+    which can only MISS)."""
+    out: Set[str] = set()
+    for n in ast.walk(node):
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out.add(n.name)
+            a = n.args
+            for x in (a.posonlyargs + a.args + a.kwonlyargs):
+                out.add(x.arg)
+            if a.vararg:
+                out.add(a.vararg.arg)
+            if a.kwarg:
+                out.add(a.kwarg.arg)
+        elif isinstance(n, ast.Lambda):
+            a = n.args
+            for x in (a.posonlyargs + a.args + a.kwonlyargs):
+                out.add(x.arg)
+        elif isinstance(n, ast.Name) and isinstance(n.ctx, (ast.Store,
+                                                            ast.Del)):
+            out.add(n.id)
+        elif isinstance(n, (ast.comprehension,)):
+            for t in ast.walk(n.target):
+                if isinstance(t, ast.Name):
+                    out.add(t.id)
+        elif isinstance(n, ast.ExceptHandler) and n.name:
+            out.add(n.name)
+    return out
+
+
+def _free_names(fn_node: ast.AST) -> Set[str]:
+    bound = _bound_names(fn_node)
+    free: Set[str] = set()
+    for n in ast.walk(fn_node):
+        if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load) \
+                and n.id not in bound:
+            free.add(n.id)
+    return free
+
+
+def _traced_body_set(mi: ModuleInfo) -> Dict[str, str]:
+    """qualname -> description, for functions whose bodies run under a
+    trace: jitted functions (rules._jit_targets) and functions passed as
+    the body of ``shard_map``/``run_shard_map``."""
+    out: Dict[str, str] = {}
+    for q in _jit_targets(mi):
+        out[q] = "jitted"
+    for fi in mi.funcs.values():
+        for ref in fi.calls:
+            node = ref.node
+            if _tail(_call_dotted(mi, node)) not in _SMAP_TAILS:
+                continue
+            if node.args and isinstance(node.args[0], ast.Name):
+                name = node.args[0].id
+                # nearest enclosing scope, same rules as bare calls
+                prefix = fi.qualname
+                while prefix:
+                    cand = f"{prefix}.{name}"
+                    if cand in mi.funcs:
+                        out[cand] = "shard_map body"
+                        break
+                    prefix = prefix.rpartition(".")[0]
+                else:
+                    if name in mi.funcs:
+                        out[name] = "shard_map body"
+    return out
+
+
+class _TracerEscapeWalker(ast.NodeVisitor):
+    """One traced body: flag writes of (potentially) traced values to
+    ``self``, declared globals/nonlocals, and outer-scope containers.
+    Inside a traced body, any value derived from a parameter or a
+    jnp/lax call is traced; host constants are not.  Conservative: only
+    values the taint pass can SEE as traced are flagged."""
+
+    def __init__(self, mi: ModuleInfo, fi: FuncInfo, kind: str,
+                 findings: List[Finding]):
+        self.mi = mi
+        self.fi = fi
+        self.kind = kind
+        self.findings = findings
+        a = getattr(fi.node, "args", None)
+        params = [x.arg for x in (a.posonlyargs + a.args + a.kwonlyargs)] \
+            if a else []
+        self.tainted: Set[str] = {p for p in params
+                                  if p not in ("self", "cls")}
+        self.locals: Set[str] = set(params) | set(fi.local_defs)
+        self.outer_decl: Set[str] = set()
+        for n in ast.walk(fi.node):
+            if isinstance(n, (ast.Global, ast.Nonlocal)):
+                self.outer_decl.update(n.names)
+            elif isinstance(n, ast.Name) and isinstance(n.ctx, ast.Store):
+                self.locals.add(n.id)
+
+    def run(self):
+        for stmt in getattr(self.fi.node, "body", []):
+            self.visit(stmt)
+
+    def visit_FunctionDef(self, node):
+        pass
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node):
+        pass
+
+    def _is_traced(self, e: ast.expr) -> bool:
+        from .rules import _DEVICE_PREFIXES, _DEVICE_EXACT
+        if isinstance(e, ast.Name):
+            return e.id in self.tainted
+        if isinstance(e, ast.Call):
+            d = _call_dotted(self.mi, e)
+            if d is not None and (d.startswith(_DEVICE_PREFIXES)
+                                  or d in _DEVICE_EXACT
+                                  or d.startswith("jax.lax.")):
+                return True
+            # a call over traced inputs yields a traced output
+            return any(self._is_traced(a) for a in e.args) \
+                or any(self._is_traced(k.value) for k in e.keywords)
+        if isinstance(e, (ast.Subscript, ast.Attribute, ast.Starred)):
+            return self._is_traced(e.value)
+        if isinstance(e, ast.BinOp):
+            return self._is_traced(e.left) or self._is_traced(e.right)
+        if isinstance(e, (ast.UnaryOp,)):
+            return self._is_traced(e.operand)
+        if isinstance(e, (ast.Tuple, ast.List)):
+            return any(self._is_traced(x) for x in e.elts)
+        if isinstance(e, ast.IfExp):
+            return self._is_traced(e.body) or self._is_traced(e.orelse)
+        return False
+
+    def _emit(self, node, target_desc: str):
+        self.findings.append(Finding(
+            rule="PHT007", file=self.mi.relpath, line=node.lineno,
+            func=self.fi.qualname,
+            message=f"traced value written to {target_desc} inside a "
+                    f"{self.kind} body — the tracer escapes the trace: "
+                    "an error under strict checks, or a value frozen at "
+                    "trace time that silently never updates",
+            hint="return the value from the traced function (ride the "
+                 "program's outputs) instead of writing through the "
+                 "closure; host-side state belongs outside the trace"))
+
+    def visit_Assign(self, node: ast.Assign):
+        self.visit(node.value)
+        traced = self._is_traced(node.value)
+        for t in node.targets:
+            # taint propagation: a local assigned from a traced value is
+            # itself traced for everything downstream
+            if isinstance(t, ast.Name):
+                (self.tainted.add if traced
+                 else self.tainted.discard)(t.id)
+            elif isinstance(t, (ast.Tuple, ast.List)) and traced:
+                for e in t.elts:
+                    if isinstance(e, ast.Name):
+                        self.tainted.add(e.id)
+            if not traced:
+                continue
+            if isinstance(t, ast.Attribute) and isinstance(
+                    t.value, ast.Name) and t.value.id in ("self", "cls"):
+                self._emit(node, f"`{t.value.id}.{t.attr}`")
+            elif isinstance(t, ast.Name) and t.id in self.outer_decl:
+                self._emit(node, f"global/nonlocal `{t.id}`")
+            elif isinstance(t, ast.Subscript):
+                p = _path_of(t.value)
+                if p is not None and p[0] not in self.locals \
+                        and p[0] not in self.mi.imports \
+                        and p[0] not in ("self", "cls"):
+                    self._emit(node, f"outer container "
+                                     f"`{_render_path(p)}[...]`")
+
+    def visit_Call(self, node: ast.Call):
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr in _MUTATORS:
+            p = _path_of(f.value)
+            if p is not None and p[0] not in self.locals \
+                    and p[0] not in self.mi.imports:
+                if any(self._is_traced(a) for a in node.args) or any(
+                        self._is_traced(k.value) for k in node.keywords):
+                    self._emit(node, f"outer container `{_render_path(p)}` "
+                                     f"(.{f.attr})")
+        self.generic_visit(node)
+
+
+def _lint_cached_program_keys(mi: ModuleInfo, findings: List[Finding]):
+    """PHT007(b): ``run_shard_map(local_closure, ..., cache_key=K)``
+    sites — a fresh-per-call closure must carry a cache_key, and the key
+    must mention every mutable outer variable the closure captures."""
+    for fi in mi.funcs.values():
+        # names bound in THIS function's own scope (params + stores,
+        # nested subtrees excluded so a nested def's locals don't count)
+        a = getattr(fi.node, "args", None)
+        own: Set[str] = set(fi.local_defs)
+        if a is not None:
+            own |= {x.arg for x in a.posonlyargs + a.args + a.kwonlyargs}
+
+        class _OwnStores(ast.NodeVisitor):
+            def visit_FunctionDef(self, n):
+                pass
+
+            visit_AsyncFunctionDef = visit_FunctionDef
+
+            def visit_Lambda(self, n):
+                pass
+
+            def visit_Name(self, n):
+                if isinstance(n.ctx, ast.Store):
+                    own.add(n.id)
+
+        w = _OwnStores()
+        for stmt in getattr(fi.node, "body", []):
+            w.visit(stmt)
+
+        # names assigned from calls in this scope (per-call identity even
+        # though the closure body is elsewhere, e.g. spmd = _builder(...));
+        # linenos kept so a name bound BOTH ways (ring_attention's two
+        # `spmd` bindings) resolves to whichever binding precedes the
+        # call site, like the interpreter would
+        call_made: Dict[str, List[int]] = {}
+        for n in ast.walk(fi.node):
+            if isinstance(n, ast.Assign) and isinstance(n.value, ast.Call):
+                for t in n.targets:
+                    if isinstance(t, ast.Name):
+                        call_made.setdefault(t.id, []).append(n.lineno)
+
+        for ref in fi.calls:
+            node = ref.node
+            if _tail(_call_dotted(mi, node)) != "run_shard_map" \
+                    or not node.args:
+                continue
+            fn_arg = node.args[0]
+            key_kw = next((kw for kw in node.keywords
+                           if kw.arg == "cache_key"), None)
+            local_def = None
+            per_call = isinstance(fn_arg, ast.Lambda)
+            if isinstance(fn_arg, ast.Name):
+                cand = f"{fi.qualname}.{fn_arg.id}"
+                def_line = mi.funcs[cand].lineno if cand in mi.funcs \
+                    else None
+                assign_lines = [ln for ln in call_made.get(fn_arg.id, ())
+                                if ln < node.lineno]
+                if def_line is not None or assign_lines:
+                    per_call = True
+                # nearest binding preceding the call wins; a call-result
+                # binding has an unknowable body, so only a def binding
+                # gets the capture-coverage check
+                best_assign = max(assign_lines, default=-1)
+                if def_line is not None and (
+                        def_line < node.lineno and def_line > best_assign
+                        or best_assign < 0):
+                    local_def = mi.funcs[cand]
+            if not per_call:
+                continue
+            if key_kw is None:
+                findings.append(Finding(
+                    rule="PHT007", file=mi.relpath, line=node.lineno,
+                    func=fi.qualname,
+                    message="run_shard_map called with a per-call "
+                            "closure and NO cache_key — the program "
+                            "cache keys on the closure's identity, which "
+                            "is fresh every call: full retrace+compile "
+                            "per invocation",
+                    hint="pass cache_key=(<stable tag>, <every value the "
+                         "closure captures>) — see ring_attention in "
+                         "parallel/sequence.py"))
+                continue
+            if local_def is None:
+                continue
+            # run_shard_map folds mesh, manual_axes and the spec trees
+            # into its program key itself — a capture that rides one of
+            # those arguments is covered without appearing in cache_key
+            key_names = {n.id for n in ast.walk(key_kw.value)
+                         if isinstance(n, ast.Name)}
+            for kw in node.keywords:
+                if kw.arg in ("in_specs", "out_specs", "manual_axes"):
+                    key_names |= {n.id for n in ast.walk(kw.value)
+                                  if isinstance(n, ast.Name)}
+            if len(node.args) > 1:
+                key_names |= {n.id for n in ast.walk(node.args[1])
+                              if isinstance(n, ast.Name)}
+
+            def _covered(fn_node, seen: Set[str]) -> List[str]:
+                """Captured own-scope names not covered by the key —
+                recursing through captured LOCAL DEFS (a fresh helper
+                closure is covered iff everything IT captures is)."""
+                out: List[str] = []
+                for name in sorted(_free_names(fn_node) & own):
+                    # `self`/`cls` captures are method-closure routine;
+                    # traced writes through them are PHT007(a)'s job
+                    if name in key_names or name in seen \
+                            or name in ("self", "cls"):
+                        continue
+                    seen.add(name)
+                    inner = mi.funcs.get(f"{fi.qualname}.{name}")
+                    if inner is not None:
+                        out.extend(_covered(inner.node, seen))
+                    else:
+                        out.append(name)
+                return out
+
+            uncovered = _covered(local_def.node, set())
+            if uncovered:
+                findings.append(Finding(
+                    rule="PHT007", file=mi.relpath, line=node.lineno,
+                    func=fi.qualname,
+                    message=f"cache_key does not cover outer "
+                            f"variable(s) {', '.join(uncovered)} captured "
+                            "by the closure — two calls with equal keys "
+                            "but different captured values reuse ONE "
+                            "cached program, silently replaying the "
+                            "stale capture (the ring_attention "
+                            "seq_local hazard)",
+                    hint="fold every captured local into the cache_key "
+                         "tuple (the run_shard_map contract: equal keys "
+                         "must want the same program)"))
+
+
+# --------------------------------------------------------------------------
+# PHT008: sharding-spec drift
+# --------------------------------------------------------------------------
+
+def _module_constants(mi: ModuleInfo) -> Dict[str, Set[str]]:
+    """Module-level NAME = ("dp", "mp") string-tuple constants."""
+    out: Dict[str, Set[str]] = {}
+    for node in mi.tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            strs = _literal_strs(node.value)
+            if strs is not None:
+                out[node.targets[0].id] = strs
+    return out
+
+
+def _mesh_axes_of_value(mi: ModuleInfo, value: ast.expr,
+                        consts: Dict[str, Set[str]]) -> Optional[Set[str]]:
+    """Statically known axis-name set of a mesh-constructing expression."""
+    if not isinstance(value, ast.Call):
+        return None
+    tail = _tail(_call_dotted(mi, value))
+    if tail == "Mesh":
+        ax = None
+        if len(value.args) >= 2:
+            ax = value.args[1]
+        for kw in value.keywords:
+            if kw.arg == "axis_names":
+                ax = kw.value
+        if ax is None:
+            return None
+        if isinstance(ax, ast.Name):
+            return consts.get(ax.id)
+        return _literal_strs(ax)
+    if tail == "create_mesh":
+        if value.args and isinstance(value.args[0], ast.Dict):
+            keys = set()
+            for k in value.args[0].keys:
+                if not (isinstance(k, ast.Constant)
+                        and isinstance(k.value, str)):
+                    return None
+                keys.add(k.value)
+            return keys or {"dp"}
+    return None
+
+
+def _collect_known_meshes(mi: ModuleInfo,
+                          consts: Dict[str, Set[str]]) -> Dict[str, Set[str]]:
+    out: Dict[str, Set[str]] = {}
+    ambiguous: Set[str] = set()
+    for node in ast.walk(mi.tree):
+        if isinstance(node, ast.Assign):
+            axes = _mesh_axes_of_value(mi, node.value, consts)
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    if axes is None:
+                        if isinstance(node.value, ast.Call) and _tail(
+                                _call_dotted(mi, node.value)) in (
+                                    "Mesh", "create_mesh"):
+                            ambiguous.add(t.id)
+                    elif t.id in out and out[t.id] != axes:
+                        ambiguous.add(t.id)
+                    else:
+                        out[t.id] = axes
+    for name in ambiguous:
+        out.pop(name, None)
+    return out
+
+
+def _spec_axis_names(mi: ModuleInfo, e: ast.expr) -> List[Tuple[str, int]]:
+    """(axis_name, lineno) for every string inside P(...)/PartitionSpec
+    calls under ``e``."""
+    out: List[Tuple[str, int]] = []
+    for n in ast.walk(e):
+        if isinstance(n, ast.Call) and _tail(_call_dotted(mi, n)) \
+                == "PartitionSpec":
+            for sub in n.args:
+                for c in ast.walk(sub):
+                    if isinstance(c, ast.Constant) and isinstance(
+                            c.value, str):
+                        out.append((c.value, n.lineno))
+    return out
+
+
+def _fn_positional_arity(mi: ModuleInfo, fi: FuncInfo,
+                         fn_arg: ast.expr) -> Optional[int]:
+    if isinstance(fn_arg, ast.Lambda):
+        a = fn_arg.args
+        if a.vararg or a.defaults:
+            return None
+        return len(a.posonlyargs + a.args)
+    if not isinstance(fn_arg, ast.Name):
+        return None
+    prefix = fi.qualname
+    target = None
+    while prefix:
+        cand = f"{prefix}.{fn_arg.id}"
+        if cand in mi.funcs:
+            target = mi.funcs[cand]
+            break
+        prefix = prefix.rpartition(".")[0]
+    if target is None:
+        target = mi.funcs.get(fn_arg.id)
+    if target is None:
+        return None
+    a = getattr(target.node, "args", None)
+    if a is None or a.vararg or a.defaults or a.kwonlyargs:
+        return None           # defaults/varargs make arity a range
+    return len(a.posonlyargs + a.args)
+
+
+def _lint_spec_drift(mi: ModuleInfo, findings: List[Finding]):
+    consts = _module_constants(mi)
+    known = _collect_known_meshes(mi, consts)
+
+    def _emit(node, fi, message, hint):
+        findings.append(Finding(
+            rule="PHT008", file=mi.relpath, line=node.lineno,
+            func=fi.qualname, message=message, hint=hint))
+
+    for fi in mi.funcs.values():
+        for ref in fi.calls:
+            node = ref.node
+            tail = _tail(_call_dotted(mi, node))
+            kw = {k.arg: k.value for k in node.keywords if k.arg}
+
+            def pos_or_kw(i, name):
+                if name in kw:
+                    return kw[name]
+                if len(node.args) > i and not any(
+                        isinstance(a, ast.Starred) for a in node.args[:i + 1]):
+                    return node.args[i]
+                return None
+
+            if tail == "NamedSharding" and node.args:
+                mesh_e = node.args[0]
+                axes = known.get(mesh_e.id) if isinstance(
+                    mesh_e, ast.Name) else _mesh_axes_of_value(
+                        mi, mesh_e, consts)
+                spec_e = pos_or_kw(1, "spec")
+                if axes is not None and spec_e is not None:
+                    for name, ln in _spec_axis_names(mi, spec_e):
+                        if name not in axes:
+                            _emit(node, fi,
+                                  f"spec axis `{name}` is not an axis of "
+                                  f"the mesh ({sorted(axes)}) — this "
+                                  "NamedSharding aborts at trace time",
+                                  "rename the spec axis to match the "
+                                  "mesh (or add the axis to the mesh "
+                                  "builder)")
+            elif tail in _SMAP_TAILS:
+                is_run = tail == "run_shard_map"
+                mesh_e = pos_or_kw(1, "mesh")
+                axes = None
+                if isinstance(mesh_e, ast.Name):
+                    axes = known.get(mesh_e.id)
+                elif mesh_e is not None:
+                    axes = _mesh_axes_of_value(mi, mesh_e, consts)
+                in_specs = pos_or_kw(2 if is_run else 10 ** 6, "in_specs")
+                out_specs = pos_or_kw(3 if is_run else 10 ** 6, "out_specs")
+                manual = kw.get("manual_axes") if is_run \
+                    else kw.get("axis_names")
+                if is_run and manual is None:
+                    manual = pos_or_kw(4, "manual_axes")
+                if axes is not None:
+                    for e in (in_specs, out_specs):
+                        if e is None:
+                            continue
+                        for name, ln in _spec_axis_names(mi, e):
+                            if name not in axes:
+                                _emit(node, fi,
+                                      f"spec axis `{name}` is not an "
+                                      f"axis of the mesh "
+                                      f"({sorted(axes)}) — XLA aborts "
+                                      "at trace time, long after the "
+                                      "rename that caused it",
+                                      "keep spec axis names in lockstep "
+                                      "with the mesh builder's axes")
+                    if manual is not None:
+                        names = _literal_strs(manual) or (
+                            {e.value for e in manual.elts
+                             if isinstance(e, ast.Constant)
+                             and isinstance(e.value, str)}
+                            if isinstance(manual, ast.Set) else set())
+                        for name in sorted(names or ()):
+                            if name not in axes:
+                                _emit(node, fi,
+                                      f"manual axis `{name}` is not an "
+                                      f"axis of the mesh "
+                                      f"({sorted(axes)})",
+                                      "manual_axes must name mesh axes")
+                # arity: in_specs tuple vs body params vs args tuple
+                if isinstance(in_specs, (ast.Tuple, ast.List)):
+                    n_specs = len(in_specs.elts)
+                    if node.args:
+                        arity = _fn_positional_arity(mi, fi, node.args[0])
+                        if arity is not None and arity != n_specs:
+                            _emit(node, fi,
+                                  f"in_specs has {n_specs} entries but "
+                                  f"the body takes {arity} argument(s) "
+                                  "— the spec tree no longer matches "
+                                  "the program (added an argument "
+                                  "without its spec?)",
+                                  "give every body argument exactly one "
+                                  "in_specs entry")
+                    if is_run:
+                        args_e = pos_or_kw(5, "args")
+                        if isinstance(args_e, (ast.Tuple, ast.List)) \
+                                and len(args_e.elts) != n_specs:
+                            _emit(node, fi,
+                                  f"in_specs has {n_specs} entries but "
+                                  f"args passes {len(args_e.elts)} "
+                                  "value(s)",
+                                  "one spec per argument — arity drift "
+                                  "aborts in XLA at trace time")
+
+
+# --------------------------------------------------------------------------
+# driver
+# --------------------------------------------------------------------------
+
+def lint_module_flow(mi: ModuleInfo) -> List[Finding]:
+    findings: List[Finding] = []
+
+    bindings = _DonatingBindings(mi)
+    bindings.visit(mi.tree)
+    for fi in mi.funcs.values():
+        if isinstance(fi.node, ast.Lambda):
+            continue
+        _DonationWalker(mi, fi, bindings.names, bindings.attrs,
+                        findings).run()
+
+    traced = _traced_body_set(mi)
+    for qual, kind in traced.items():
+        fi = mi.funcs.get(qual)
+        if fi is not None and not isinstance(fi.node, ast.Lambda):
+            _TracerEscapeWalker(mi, fi, kind, findings).run()
+    _lint_cached_program_keys(mi, findings)
+    _lint_spec_drift(mi, findings)
+    return findings

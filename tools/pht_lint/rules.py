@@ -1,4 +1,5 @@
-"""pht-lint rules PHT001–PHT005 (catalog: docs/STATIC_ANALYSIS.md).
+"""pht-lint rules PHT001–PHT005 (catalog: docs/STATIC_ANALYSIS.md; the
+flow-sensitive PHT006–PHT008 live in flow.py).
 
 PHT001  host-sync-in-hot-path   — .item() / block_until_ready /
         jax.device_get / np.asarray-on-device-value / float()/int()/

@@ -1,0 +1,163 @@
+"""test_budget: compare measured pytest durations against the per-file
+wall-cost budgets in tests/conftest.py ``_FILE_COST``.
+
+The tier-1 suite runs against a hard 870s timeout (ROADMAP.md) and is
+KILLED mid-suite when it overruns — the failure mode is RC=137 with a
+spurious trailing "F", discovered long after the test that actually blew
+its budget landed.  This tool moves that discovery to the PR:
+
+    python -m pytest tests/ -q -m 'not slow' --durations=0 \
+        -p no:cacheprovider | tee /tmp/durations.log
+    python tools/test_budget.py /tmp/durations.log
+
+Exit codes (perf_gate convention): 0 = every file within budget,
+1 = at least one file over budget (each listed with measured vs budget),
+2 = usage error (missing/unparseable log or conftest).
+
+Reading a TIMED tier-1 run: the timeout RC is useless (137 = killed at
+the budget, even when every test that RAN passed) — compare DOTS_PASSED
+instead, per the ROADMAP verify recipe:
+
+    DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\\[ *[0-9]+%\\])?$' t1.log \\
+        | tr -cd . | wc -c)
+
+against the seed's count, with no concurrent load on the box.  This
+tool complements that: DOTS_PASSED tells you WHETHER the suite got
+worse; the per-file budget diff tells you WHICH file to make leaner
+(or slow-mark) before the timeout truncation eats someone else's tests.
+
+Budgets are approximate single-measurement wall costs (compile-
+dominated, so stable); ``--slack`` (default 1.5x) absorbs box noise.
+Files absent from ``_FILE_COST`` sort mid-pack in the suite order and
+are reported with ``--strict`` so new test files get an entry.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import collections
+import os
+import re
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_CONFTEST = os.path.join(REPO_ROOT, "tests", "conftest.py")
+
+# pytest --durations lines: "12.34s call     tests/test_x.py::test_y[p]"
+_DURATION_RE = re.compile(
+    r"^\s*(\d+(?:\.\d+)?)s\s+(call|setup|teardown)\s+"
+    r"(?:.*/)?(test_[\w.]+\.py)::")
+
+
+def load_budgets(conftest_path: str):
+    """``_FILE_COST`` parsed out of the conftest SOURCE (never imported:
+    the conftest imports jax and mutates the platform config)."""
+    with open(conftest_path, encoding="utf-8") as f:
+        tree = ast.parse(f.read(), filename=conftest_path)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == "_FILE_COST":
+                    return ast.literal_eval(node.value)
+    raise ValueError(f"no _FILE_COST dict found in {conftest_path}")
+
+
+def measured_per_file(lines):
+    """Sum call+setup+teardown seconds per test FILE from a pytest run
+    captured with ``--durations=0`` (0 = report every test; a truncated
+    ``--durations=N`` under-measures and is reported as suspicious)."""
+    totals = collections.Counter()
+    saw_durations_header = False
+    for line in lines:
+        if "slowest" in line and "durations" in line:
+            saw_durations_header = True
+        m = _DURATION_RE.match(line)
+        if m:
+            secs, _, fname = m.groups()
+            totals[fname] += float(secs)
+    return totals, saw_durations_header
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python tools/test_budget.py",
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+        description=__doc__)
+    ap.add_argument("logfile",
+                    help="pytest output captured with --durations=0 "
+                         "('-' = stdin)")
+    ap.add_argument("--conftest", default=DEFAULT_CONFTEST,
+                    help="conftest.py holding _FILE_COST "
+                         "(default: tests/conftest.py)")
+    ap.add_argument("--slack", type=float, default=1.5,
+                    help="over-budget threshold multiplier (default 1.5: "
+                         "budgets are single-measurement costs, boxes "
+                         "are noisy)")
+    ap.add_argument("--min-seconds", type=float, default=3.0,
+                    help="ignore files measuring under this many seconds "
+                         "(default 3.0 — nobody blows the 870s budget "
+                         "with a 2s file)")
+    ap.add_argument("--strict", action="store_true",
+                    help="also fail on measured files with NO _FILE_COST "
+                         "entry (they sort mid-pack blind)")
+    args = ap.parse_args(argv)
+
+    try:
+        budgets = load_budgets(args.conftest)
+    except (OSError, ValueError, SyntaxError) as e:
+        print(f"test_budget: cannot load budgets: {e}", file=sys.stderr)
+        return 2
+    try:
+        if args.logfile == "-":
+            lines = sys.stdin.read().splitlines()
+        else:
+            with open(args.logfile, encoding="utf-8") as f:
+                lines = f.read().splitlines()
+    except OSError as e:
+        print(f"test_budget: cannot read log: {e}", file=sys.stderr)
+        return 2
+
+    totals, saw_header = measured_per_file(lines)
+    if not totals:
+        print("test_budget: no duration lines found — run pytest with "
+              "--durations=0 and feed me that output", file=sys.stderr)
+        return 2
+    if not saw_header:
+        print("test_budget: warning: no 'slowest durations' header seen "
+              "— is this really pytest --durations output?",
+              file=sys.stderr)
+
+    over = []
+    unbudgeted = []
+    for fname, secs in sorted(totals.items(), key=lambda kv: -kv[1]):
+        if secs < args.min_seconds:
+            continue
+        budget = budgets.get(fname)
+        if budget is None:
+            unbudgeted.append((fname, secs))
+            continue
+        if secs > budget * args.slack:
+            over.append((fname, secs, budget))
+
+    for fname, secs, budget in over:
+        print(f"OVER BUDGET: {fname}: measured {secs:.1f}s vs budget "
+              f"{budget}s (x{args.slack:.2f} slack = "
+              f"{budget * args.slack:.1f}s) — make it leaner, slow-mark "
+              f"the heavy tests, or re-measure and raise the entry")
+    for fname, secs in unbudgeted:
+        print(f"{'UNBUDGETED' if args.strict else 'note: unbudgeted'}: "
+              f"{fname}: measured {secs:.1f}s but has no _FILE_COST "
+              f"entry (sorts mid-pack blind — add one)")
+    ok_n = len([f for f, s in totals.items()
+                if s >= args.min_seconds]) - len(over) - len(unbudgeted)
+    print(f"test_budget: {len(over)} over, "
+          f"{len(unbudgeted)} unbudgeted, {ok_n} within budget "
+          f"({len(totals)} files measured)")
+    if over or (args.strict and unbudgeted):
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
