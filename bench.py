@@ -423,7 +423,8 @@ class _LMLoss:
 
 def _hapi_fit_tps(seqlen, batch, steps, warmup, jit_compile, k=8,
                   param_dtype=jnp.bfloat16, preset="gpt2-small-en",
-                  log_freq=10 ** 9, checkpoint_dir=None, **cfg_kw):
+                  log_freq=10 ** 9, checkpoint_dir=None, zero_stage=0,
+                  master_weights=False, **cfg_kw):
     """tokens/s through ``Model.fit`` (compiled or eager path).
 
     Timing via a callback: t0 after the warmup window's loss is fetched
@@ -488,7 +489,8 @@ def _hapi_fit_tps(seqlen, batch, steps, warmup, jit_compile, k=8,
               verbose=0, log_freq=log_freq, num_iters=warmup + steps,
               jit_compile=jit_compile if jit_compile else False,
               steps_per_execution=k if jit_compile else 1,
-              callbacks=[timer], checkpoint=checkpoint_dir)
+              callbacks=[timer], checkpoint=checkpoint_dir,
+              zero_stage=zero_stage, master_weights=master_weights)
     assert timer.last == warmup + steps - 1
     if jit_compile:
         assert model._fit_used_compiled, "compiled fit path did not engage"
@@ -521,9 +523,60 @@ def bench_hapi_fit(seqlen=1024, batch=32, steps=48, warmup=8, k=8):
         # (or PHT_PEAK_FLOPS pins it); None on this CPU container
         "mfu": round(mfu[0], 4) if mfu else None,
     }
+    # ZeRO comparison anchors for the hapi_fit_zero1 ratio gate: the
+    # dense row is by construction replicated (stage 0, ratio 1.0)
+    row["zero_stage"] = 0
+    row["opt_state_bytes_vs_replicated"] = 1.0
     row["metrics"]["checkpoint"] = _hapi_fit_checkpoint_evidence(
         seqlen, batch, steps, warmup, k)
     return row
+
+
+def _opt_state_bytes_ratio(path="hapi_compiled"):
+    """sharded/replicated per-device optimizer-state bytes from the
+    ``train_opt_state_bytes`` gauge the trainer build just set; 1.0 when
+    the build did not shard (no mesh data axis)."""
+    from paddle_hackathon_tpu.observability import get_registry
+    fam = get_registry().get("train_opt_state_bytes")
+    vals = {dict(c.labels).get("sharded"): c.value
+            for c in (fam.children() if fam else [])
+            if dict(c.labels).get("path") == path}
+    if vals.get("false") and vals.get("true") is not None:
+        return round(vals["true"] / vals["false"], 4)
+    return 1.0
+
+
+def bench_hapi_fit_zero1(seqlen=1024, batch=32, steps=48, warmup=8, k=8):
+    """The SAME ``Model.fit`` recipe as the hapi_fit row with a ZeRO-1
+    sharded optimizer over a dp=<all devices> mesh: moments owned 1/dp
+    per chip, grads reduce-scattered, params all-gathered per tensor
+    with the gathers overlapping the update tail inside the donated
+    K-step scan.  tools/perf_gate.py holds the row to >= 0.9x the
+    same-run hapi_fit row (the gather/overlap design must not tax the
+    step), and the embedded ``opt_state_bytes_vs_replicated`` evidences
+    the ~1/dp HBM shrink.  ``builds_warm_delta`` must be 0: exactly one
+    program build (steps and warmup are multiples of k, so there is no
+    ragged-tail second program and no mid-run recompile)."""
+    import paddle_hackathon_tpu.parallel as parallel
+    from paddle_hackathon_tpu.observability import get_registry
+    reg = get_registry()
+    ndev = len(jax.devices())
+    parallel.create_mesh({"dp": ndev})
+
+    def builds():
+        return int(reg.total("jit_builds_total",
+                             site="hapi.compiled_trainer"))
+
+    b0 = builds()
+    value = _hapi_fit_tps(seqlen, batch, steps, warmup, jit_compile=True,
+                          k=k, zero_stage=1)
+    built = builds() - b0
+    return {"metric": "hapi_fit_zero1_tokens_per_sec",
+            "value": round(value, 1), "unit": "tokens/s",
+            "zero_stage": 1, "dp": ndev,
+            "opt_state_bytes_vs_replicated": _opt_state_bytes_ratio(),
+            "metrics": {"jit_builds_total": built,
+                        "builds_warm_delta": built - 1}}
 
 
 def _hapi_fit_checkpoint_evidence(seqlen, batch, steps, warmup, k,
@@ -907,6 +960,11 @@ SUITE = {
     # the high-level trainer's compiled fast path (hapi/compiled.py):
     # tokens/s through Model.fit must track the hand-rolled gpt2 row
     "hapi_fit": lambda: bench_hapi_fit(),
+    # ZeRO-1 sharded optimizer through the same Model.fit recipe on a
+    # dp=<all chips> mesh (moments 1/dp per chip, reduce-scattered
+    # grads, per-tensor overlapped param all-gathers); gated >= 0.9x
+    # the same-run hapi_fit row by tools/perf_gate.py
+    "hapi_fit_zero1": lambda: bench_hapi_fit_zero1(),
     # MoE-GPT flagship (PR 9, ROADMAP item 5): expert-parallel training
     # at matched ACTIVE params — the row embeds its own same-run dense
     # reference and tools/perf_gate.py holds vs_dense_active_params

@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..core import random as core_random
 from ..core.tensor import Tensor
@@ -93,9 +94,24 @@ class CompiledTrainer:
     after every program call (the donated buffers are dead), so eval,
     checkpointing and callbacks keep seeing current weights; optimizer
     accumulators sync back at epoch boundaries via ``sync_optimizer``.
+
+    ``zero_stage>=1`` (``Model.fit(zero_stage=)``) runs the donated
+    K-step program ZeRO-sharded over the ambient mesh
+    (``parallel.create_mesh``): params replicated, batch sharded over
+    the data axes, and every optimizer moment (plus the optional f32
+    ``master_weights`` copy) owned 1/dp per rank — the scan body
+    reduce-scatters grads, updates the shard, and all-gathers the
+    updated params per tensor, so step k+1's gathers overlap the tail
+    of step k's update inside the scanned program instead of
+    serializing on one fused gather.  The flat checkpoint layout is
+    unchanged (the sharded slots ride ``opt::i::slot``), so
+    ``parallel.checkpointing.restore_like`` resumes ZeRO state across a
+    changed dp size for free.
     """
 
-    def __init__(self, model, seed=0):
+    def __init__(self, model, seed=0, zero_stage=0, master_weights=False):
+        import warnings
+
         network, opt, loss = model.network, model._optimizer, model._loss
         self._opt = opt
         self._network = network
@@ -104,13 +120,61 @@ class CompiledTrainer:
         order = [by_id[id(p)] for p in plist]
         self._plist, self._order = plist, order
         self._param_tensors = dict(network.named_parameters())
+
+        self._zero = None
+        self._zero_jits = {}
+        self._armed_prog = None
+        self._n_data = 1
+        step0 = jnp.asarray(opt._step_count, jnp.int32)
+        opt_states = opt.functional_state(plist)
+        if int(zero_stage or 0) >= 1:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from ..parallel.api import get_mesh
+            from ..parallel.sharding import ZeroShardInfo, zero_data_axis
+            mesh = get_mesh()
+            zaxis = zero_data_axis(mesh)
+            if zaxis is None:
+                warnings.warn(
+                    "Model.fit(zero_stage>=1) needs an ambient mesh with "
+                    "a >1 'sharding' or 'dp' axis (parallel.create_mesh); "
+                    "optimizer state stays replicated for this fit",
+                    RuntimeWarning, stacklevel=3)
+            else:
+                si = ZeroShardInfo(
+                    mesh=mesh, axis=zaxis, stage=int(zero_stage),
+                    master_weights=bool(master_weights)).with_param_specs(
+                        [(None,) * p._value.ndim for p in plist])
+                self._zero = si
+                self._n_data = int(np.prod([
+                    mesh.shape[a] for a in ("dp", "sharding", "ep")
+                    if a in mesh.axis_names], dtype=np.int64))
+                repl = NamedSharding(mesh, P())
+                # params replicated onto the mesh (ZeRO 1/2 keeps the
+                # forward's params whole; only the optimizer state
+                # shards) — the live network rebinds to the placed
+                # arrays so eval/save/checkpoint see mesh arrays
+                for t in self._param_tensors.values():
+                    t._set_value(jax.device_put(t._value, repl))
+                step0 = jax.device_put(step0, repl)
+                from ..parallel.sharding import place_zero_state
+                opt_states = place_zero_state(
+                    si, [p._value for p in plist], opt_states)
+        if self._zero is None and master_weights:
+            warnings.warn(
+                "Model.fit(master_weights=True) only takes effect with "
+                "zero_stage>=1 on a mesh; ignored", RuntimeWarning,
+                stacklevel=3)
+
         params = {k: p._value for k, p in network.named_parameters()}
         _, buffers = network.functional_state()
         self.state = {
             "params": params,
-            "opt": opt.functional_state(plist),
-            "step": jnp.asarray(opt._step_count, jnp.int32),
+            "opt": opt_states,
+            "step": step0,
         }
+        from ..parallel.sharding import observe_opt_state_bytes
+        observe_opt_state_bytes("hapi_compiled", opt_states)
         self.ever_ran = False
         # MoE: thread the load-balance aux INTO the donated program's
         # loss (the PR 2 contract — no extra dispatches) and return it
@@ -161,7 +225,9 @@ class CompiledTrainer:
                     lambda pp: forward_loss(pp, xs, ys, step))(p)
 
         train_step = make_functional_train_step(opt, plist, order, grads_of,
-                                                scan_batch=True)
+                                                scan_batch=True,
+                                                shard_info=self._zero)
+        self._train_step = train_step
         # donate the ENTIRE train state: params + accumulators + step all
         # update in place on device; the live network's Tensors rebind to
         # the fresh arrays after each call.  instrument_jit records every
@@ -172,12 +238,111 @@ class CompiledTrainer:
             site="hapi.compiled_trainer"),
             donate_argnums=(0, 1, 2), site="hapi.compiled_trainer")
 
+    def _zero_struct_key(self, xs, ys):
+        """(treedef, ranks, ragged?, batch) — the first three select the
+        cached program wrapper (``ragged`` = the batch does not divide
+        over the data axes, so the replicated-batch flavor applies);
+        the batch size rides along for the warning only."""
+        leaves, treedef = jax.tree.flatten((xs, ys))
+        b = int(np.shape(leaves[0])[1]) if np.ndim(leaves[0]) >= 2 else 0
+        return (treedef, tuple(np.ndim(l) for l in leaves),
+                bool(b % self._n_data), b)
+
+    def ensure_program(self, xs, ys):
+        """Build-or-reuse the ZeRO program for this batch structure.
+        ZeRO runs need explicit in/out shardings (batch over the data
+        axes, state pinned to its placement so XLA cannot pick a
+        re-replicated layout for the donated moments), and the batch
+        pytree structure is only known at the first batch — cached per
+        (treedef, ranks), mirroring ``make_sharded_train_step``'s
+        structure-keyed cache.  The fit loop calls this BEFORE ``run``
+        so the hot step path itself never constructs a program
+        (PHT002); a structure hit is one dict probe.
+
+        A batch that does not divide over the data axes — typically the
+        ragged FINAL batch of an epoch under the default
+        ``drop_last=False`` — selects a replicated-batch flavor of the
+        program instead of crashing the fit: every rank computes the
+        whole (small) batch, which is mathematically the same update
+        (the moments stay sharded), it just forgoes dp compute scaling
+        for that one superstep.  A once-per-fit warning points at
+        ``drop_last=True`` / a divisible batch for runs where EVERY
+        batch is indivisible."""
+        if self._zero is None:
+            return self._jit
+        key = self._zero_struct_key(xs, ys)
+        if key[2] and not getattr(self, "_warned_ragged", False):
+            self._warned_ragged = True
+            import warnings
+            warnings.warn(
+                f"Model.fit(zero_stage>=1): batch size {key[3]} does not "
+                f"divide over the mesh's {self._n_data} data-axis "
+                "devices; this superstep runs with a REPLICATED batch "
+                "(correct, but no dp compute scaling) — pass "
+                "drop_last=True or a divisible batch size if this is "
+                "not just an epoch's ragged tail", RuntimeWarning,
+                stacklevel=3)
+        fn = self._zero_jits.get(key[:3])
+        if fn is None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from ..parallel.api import batch_spec
+            leaves, treedef = jax.tree.flatten((xs, ys))
+            mesh = self._zero.mesh
+            bspec = batch_spec(mesh)
+            # ragged (indivisible) batch flavor: batch dim replicated
+            bax = (bspec[0] if len(bspec) else None) \
+                if not key[2] else None
+            repl = NamedSharding(mesh, P())
+
+            def leaf_sh(l):
+                nd = np.ndim(l)
+                # stacked (K, B, ...) superbatch leaves: K replicated,
+                # batch dim over the data axes, trailing dims whole
+                spec = ((None, bax) + (None,) * (nd - 2))[:nd]
+                return NamedSharding(mesh, P(*spec))
+
+            bsh = jax.tree.unflatten(treedef, [leaf_sh(l) for l in leaves])
+            param_sh = jax.tree.map(lambda a: a.sharding,
+                                    self.state["params"])
+            opt_sh = jax.tree.map(lambda a: a.sharding, self.state["opt"])
+            fn = sanitize_donation(_obs.instrument_jit(
+                jax.jit(self._train_step, donate_argnums=(0, 1, 2),
+                        in_shardings=(param_sh, opt_sh, repl, None, bsh),
+                        # repl is a PREFIX spec for the loss slot: it
+                        # covers both the (K,) loss vector and the MoE
+                        # (losses, aux) pair
+                        out_shardings=(param_sh, opt_sh, repl, repl)),
+                site="hapi.compiled_trainer"),
+                donate_argnums=(0, 1, 2), site="hapi.compiled_trainer")
+            self._zero_jits[key[:3]] = fn
+        # arm for the next run(): the fit loop calls ensure_program
+        # immediately before run with the same batch, so the hot path
+        # reads this slot instead of re-deriving the structure key
+        self._armed_prog = fn
+        return fn
+
     def run(self, xs, ys):  # pht-lint: hot-root (compiled-trainer step)
         """One compiled superstep over stacked batches (leaves (K, B, …));
         returns the (K,) per-step loss vector as a DEVICE array."""
+        if self._zero is None:
+            fn = self._jit
+        else:
+            # armed by the ensure_program the fit loop just called (no
+            # re-derivation of the structure key on the hot path); the
+            # dict lookup only serves direct callers out of sequence
+            fn = self._armed_prog
+            if fn is None:
+                fn = self._zero_jits.get(self._zero_struct_key(xs, ys)[:3])
+            if fn is None:
+                # program construction lives OUTSIDE the hot step path —
+                # the fit loop (Model._run_compiled_epoch) prepares it
+                raise RuntimeError(
+                    "CompiledTrainer.run: no program for this batch "
+                    "structure — call ensure_program(xs, ys) first")
         lr = jnp.asarray(self._opt.get_lr(), jnp.float32)
-        p, s, t, losses = self._jit(self.state["params"], self.state["opt"],
-                                    self.state["step"], lr, (xs, ys))
+        p, s, t, losses = fn(self.state["params"], self.state["opt"],
+                             self.state["step"], lr, (xs, ys))
         if self._has_moe:
             # (totals, auxes) — aux stays a device vector until a
             # log_freq fetch reads it alongside the loss

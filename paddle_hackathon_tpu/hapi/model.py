@@ -149,7 +149,7 @@ class Model:
             drop_last=False, shuffle=True, num_workers=0, callbacks=None,
             accumulate_grad_batches=1, num_iters=None, jit_compile=None,
             steps_per_execution=1, prefetch_buffer=2, nan_policy="record",
-            checkpoint=None):
+            checkpoint=None, zero_stage=0, master_weights=False):
         """Train loop.  ``jit_compile=None`` (default) tries the compiled
         fast path — one donated jitted program per step (see
         ``hapi/compiled.py``) — and falls back to the eager
@@ -181,7 +181,23 @@ class Model:
         atomically by a background writer; a crashed fit resumes from
         the latest VALID checkpoint — torn shards/manifests are detected
         and fall back — restoring step/epoch/RNG/cursor so the loss
-        series continues where it stopped (docs/CHECKPOINTING.md)."""
+        series continues where it stopped (docs/CHECKPOINTING.md).
+
+        ``zero_stage>=1`` (ZeRO-sharded optimizer, compiled path only):
+        the donated K-step program shards every optimizer moment 1/dp
+        over the ambient mesh's 'sharding'/'dp' axis
+        (``parallel.create_mesh`` first; the batch shards over the same
+        axes) — grads reduce-scatter, the update runs on the shard, and
+        the updated params all-gather per tensor with the gathers
+        overlapping the update tail inside the scanned program.  Cuts
+        per-chip optimizer HBM to ~1/dp; the loss series matches the
+        replicated update to f32 reassociation (the reduce-scatter
+        changes the grad-psum summation order by design).
+        ``master_weights=True`` additionally keeps f32 master copies
+        sharded alongside the moments (params may then be bf16).
+        Checkpoints flow through ``parallel/checkpointing.py``
+        unchanged, so resume across a changed dp size re-shards the
+        ZeRO state automatically (docs/PARALLELISM.md)."""
         train_loader = self._to_loader(train_data, batch_size, shuffle,
                                        drop_last, num_workers)
         eval_loader = (self._to_loader(eval_data, batch_size, False, False,
@@ -206,7 +222,8 @@ class Model:
             from .compiled import CompiledTrainer, unsupported_reason
             reason = unsupported_reason(self, accumulate_grad_batches)
             if reason is None:
-                trainer = CompiledTrainer(self)
+                trainer = CompiledTrainer(self, zero_stage=zero_stage,
+                                          master_weights=master_weights)
             elif jit_compile:
                 raise ValueError(
                     f"jit_compile=True, but the compiled fit path is "
@@ -214,6 +231,14 @@ class Model:
             else:
                 self._log_fallback_once(
                     f"Model.fit: using the eager path ({reason})")
+        if zero_stage and trainer is None:
+            # losing the ZeRO sharding must never be silent — the run
+            # would quietly hold dp full copies of the optimizer state
+            import warnings
+            warnings.warn(
+                "Model.fit: zero_stage>=1 requires the compiled fit "
+                "path; training continues with REPLICATED optimizer "
+                "state", RuntimeWarning, stacklevel=2)
         self._fit_used_compiled = trainer is not None
 
         # crash-safe checkpointing (compiled path only — the eager tape
@@ -523,6 +548,10 @@ class Model:
         last = None
         groups = device_prefetch(host_groups(), size=prefetch_buffer)
         for xs, ys in groups:
+            # ZeRO program build-or-reuse happens HERE, outside the
+            # trainer's hot step path (a structure hit is a dict probe;
+            # non-ZeRO trainers return their one program unconditionally)
+            trainer.ensure_program(xs, ys)
             t0n = time.perf_counter_ns()
             try:
                 losses = trainer.run(xs, ys)
@@ -536,6 +565,17 @@ class Model:
                 self._log_fallback_once(
                     "Model.fit: compiled trainer failed to trace "
                     f"({type(e).__name__}: {e}); falling back to eager")
+                if getattr(trainer, "_zero", None) is not None:
+                    # the once-only fallback log above may already be
+                    # spent, and losing the ZeRO sharding mid-run must
+                    # never be silent: the eager tape trains with dp
+                    # FULL replicated copies of the optimizer state
+                    import warnings
+                    warnings.warn(
+                        "Model.fit: the ZeRO-sharded compiled trainer "
+                        "fell back to eager MID-RUN; optimizer state is "
+                        "REPLICATED for the rest of this fit",
+                        RuntimeWarning, stacklevel=2)
                 if ckpt is not None:
                     # the once-only fallback log above may already be
                     # spent — losing crash safety mid-run deserves its

@@ -36,6 +36,17 @@ class L1Decay:
         self.coeff = float(coeff)
 
 
+def _make_zero_update(opt, shard_info):
+    """Shard-aware update target for the eager ``step()`` jit (module
+    level so the jit binding has a stable shape; per-call identity is
+    guarded by ``Optimizer._jit_key``, same as the ``_update_all``
+    binding it replaces)."""
+    def zero_update(vals, grads, states, lr, step_t, param_lrs):
+        return opt._sharded_update(vals, grads, states, lr, step_t,
+                                   param_lrs, shard_info)
+    return zero_update
+
+
 class Optimizer:
     _accum_names: List[str] = []
 
@@ -97,13 +108,28 @@ class Optimizer:
         lr = jnp.asarray(self.get_lr(), jnp.float32)
         step_t = jnp.asarray(self._step_count + 1, jnp.int32)
 
-        key = tuple((id(p), g.shape, str(g.dtype)) for p, g in zip(params, grads))
+        zi = getattr(self, "_zero_info", None)
+        # zi rides the key BY REFERENCE (held strongly in _jit_key, so a
+        # replaced shard-info can never alias a freed one's id) — a
+        # re-wrap after an elastic resize rebuilds the jitted update
+        key = (tuple((id(p), g.shape, str(g.dtype))
+                     for p, g in zip(params, grads)), zi)
         if self._jit_key != key:
             # Donate only the accumulator buffers (arg 2): parameter buffers
             # may still be aliased by vjp residuals of a retained graph or by
             # user-held references, so they must not be invalidated.
+            if zi is not None:
+                # eager ZeRO (parallel.sharding.group_sharded_parallel):
+                # the jitted update is the shard-aware path, so the eager
+                # workflow runs the SAME reduce-scatter/shard-update/
+                # all-gather program the compiled trainers compile
+                update_fn = _make_zero_update(self, zi.with_param_specs([
+                    tuple(getattr(p, "pspec", None)
+                          or (None,) * p._value.ndim) for p in params]))
+            else:
+                update_fn = self._update_all
             self._jit_update = sanitize_donation(
-                jax.jit(self._update_all, donate_argnums=(2,)),
+                jax.jit(update_fn, donate_argnums=(2,)),
                 donate_argnums=(2,), site="optimizer.update")
             self._jit_key = key
 
@@ -139,7 +165,7 @@ class Optimizer:
             self._step_count = int(step_count)
 
     def functional_update(self, vals, grads, states, lr, step_t,
-                          param_lrs=None, params=None):
+                          param_lrs=None, params=None, shard_info=None):
         """Pure update rule over explicit buffers — safe under jit/grad.
 
         ``(vals, grads, states)`` are positional lists of param values,
@@ -147,6 +173,14 @@ class Optimizer:
         new_states)``.  Pass ``params`` (the matching Parameter objects)
         to let the rule derive per-parameter metadata; they are consumed
         at trace time only and never cross the jit boundary.
+
+        ``shard_info`` (a ``parallel.sharding.ZeroShardInfo``) selects
+        the ZeRO shard-aware path: each rank owns a 1/dp slice of every
+        moment — gradients are constraint-pinned to the moment sharding
+        (GSPMD lowers the pending grad psum + slice to a reduce-scatter),
+        the rule runs on the shard, and the updated params are pinned
+        back to their own sharding (per-tensor all-gathers the scheduler
+        can overlap with the remaining update compute).
         """
         if params is not None and param_lrs is None:
             param_lrs = tuple(p.optimize_attr.get("learning_rate", 1.0)
@@ -155,6 +189,10 @@ class Optimizer:
             param_lrs = (1.0,) * len(vals)
         self._prepare_functional(params)
         try:
+            if shard_info is not None:
+                return self._sharded_update(vals, grads, states, lr,
+                                            step_t, tuple(param_lrs),
+                                            shard_info)
             return self._update_all(vals, grads, states, lr, step_t,
                                     tuple(param_lrs))
         finally:
@@ -164,7 +202,12 @@ class Optimizer:
         """Hook: derive per-parameter trace-time metadata from an explicit
         param list (``None`` restores the eager ``step()`` behavior)."""
 
-    def _update_all(self, vals, grads, states, lr, step_t, param_lrs):
+    def _preprocess_grads(self, vals, grads):
+        """The grad preamble shared by every update path: f32 cast,
+        coupled weight decay, grad clip.  Runs on the UNPINNED (fully
+        replicated) gradients in the ZeRO path too, so the global clip
+        norm is computed in exactly the reduction order the replicated
+        update uses — sharded-vs-replicated stays bit-exact."""
         grads = [g.astype(jnp.float32) if v.dtype == jnp.float32 else g
                  for g, v in zip(grads, vals)]
         if isinstance(self._weight_decay, L2Decay) and self._weight_decay.coeff:
@@ -179,12 +222,87 @@ class Optimizer:
                          for g, v in zip(grads, vals)]
         if self._grad_clip is not None:
             grads = self._grad_clip._clip(grads)
+        return grads
+
+    def _update_all(self, vals, grads, states, lr, step_t, param_lrs):
+        grads = self._preprocess_grads(vals, grads)
         new_vals, new_states = [], []
         for v, g, s, plr in zip(vals, grads, states, param_lrs):
             nv, ns = self._apply_one(v, g, s, lr * plr, step_t)
             new_vals.append(nv.astype(v.dtype))
             new_states.append(ns)
         return new_vals, new_states
+
+    def _sharded_update(self, vals, grads, states, lr, step_t, param_lrs,
+                        shard_info):
+        """ZeRO shard-aware update (``parallel.sharding.ZeroShardInfo``).
+
+        Per tensor: grad pinned to the moment sharding → the pending dp
+        grad psum fuses with the slice into a reduce-scatter; moments
+        (and the optional f32 ``"master"`` slot) pinned in AND out so
+        GSPMD cannot re-replicate them anywhere in the program; the
+        update rule itself is the unmodified ``_update_all`` core run on
+        the 1/dp slice; the new param value is cast to the param dtype
+        FIRST and then pinned to the param's own spec — a per-tensor
+        all-gather (bf16-sized under master weights) that depends only
+        on its own update, so the scheduler overlaps it with the other
+        params' update compute and the next step's forward entry.
+
+        Weight decay + global-norm clip run BEFORE the pins (on the
+        replicated grads) — see ``_preprocess_grads`` — keeping the
+        sharded loss series bit-exact vs the replicated update for
+        elementwise rules.  Per-param-norm rules (LAMB/LARS) compute
+        their norms on the sharded slices with GSPMD-inserted
+        cross-shard reductions — globally correct, reassociated."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        mesh = shard_info.mesh
+        pspecs = shard_info.param_specs or (None,) * len(vals)
+
+        def pin(a, spec):
+            return jax.lax.with_sharding_constraint(
+                a, NamedSharding(mesh, P(*spec)))
+
+        grads = self._preprocess_grads(
+            vals if not shard_info.master_weights
+            else [s.get("master", v) for v, s in zip(vals, states)], grads)
+        mspecs = [shard_info.moment_spec(v.shape, existing=ps)
+                  for v, ps in zip(vals, pspecs)]
+        g_sh = [pin(g, ms) for g, ms in zip(grads, mspecs)]
+        if shard_info.master_weights:
+            compute_vals = [pin(s["master"], ms) if "master" in s
+                            else pin(v, ms)
+                            for v, s, ms in zip(vals, states, mspecs)]
+            inner_states = [{k: v for k, v in s.items() if k != "master"}
+                            for s in states]
+        else:
+            compute_vals = [pin(v, ms) for v, ms in zip(vals, mspecs)]
+            inner_states = states
+        inner_states = [{k: pin(v, ms) for k, v in s.items()}
+                        for s, ms in zip(inner_states, mspecs)]
+        # decay/clip already applied above — run the core rule only (the
+        # attribute save/restore is trace-time Python, never traced state)
+        saved_clip, saved_wd = self._grad_clip, self._weight_decay
+        self._grad_clip = None
+        self._weight_decay = None
+        try:
+            new_vals, new_states = self._update_all(
+                compute_vals, g_sh, inner_states, lr, step_t, param_lrs)
+        finally:
+            self._grad_clip, self._weight_decay = saved_clip, saved_wd
+        out_states = [{k: pin(v, ms) for k, v in s.items()}
+                      for s, ms in zip(new_states, mspecs)]
+        if shard_info.master_weights:
+            for st, nv, s_in, ms in zip(out_states, new_vals, states,
+                                        mspecs):
+                if "master" in s_in:
+                    st["master"] = pin(nv, ms)   # f32, stays sharded
+        out_vals = [
+            pin(nv.astype(v.dtype),
+                ps if ps is not None and len(ps) == v.ndim
+                else (None,) * v.ndim)
+            for nv, v, ps in zip(new_vals, vals, pspecs)]
+        return out_vals, out_states
 
     def _decoupled_weight_decay(self) -> bool:
         return False

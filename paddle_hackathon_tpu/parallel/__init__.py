@@ -41,8 +41,9 @@ from .moe import (GShardGate, MoELayer, NaiveGate, SwitchGate,  # noqa: F401
                   moe_active_params, moe_all_to_all)
 from .multislice import (create_multislice_mesh,  # noqa: F401
                          dcn_traffic_axes)
-from .sharding import (group_sharded_parallel,  # noqa: F401
-                       save_group_sharded_model)
+from .sharding import (ZeroShardInfo,  # noqa: F401
+                       group_sharded_parallel, save_group_sharded_model,
+                       state_bytes, zero_data_axis)
 from .fleet import (DistributedStrategy, distributed_model,  # noqa: F401
                     distributed_optimizer, fleet)
 from .recompute import (jit_recompute, recompute,  # noqa: F401

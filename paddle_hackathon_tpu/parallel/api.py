@@ -209,16 +209,19 @@ def stack_block_params(model, mesh: Mesh, rule, block_prefix: str,
 
 
 def _pp_stacked_spec(rel: str, arr, mesh: Mesh, rule, prefix: str,
-                     extra_sharding: bool):
+                     extra_sharding: bool, axis: str = "sharding"):
     """PartitionSpec for a stacked block parameter: leading layer dim on
     'pp', remaining dims per the TP rule of the per-layer param (layer 0's
-    name is representative), optionally + a 'sharding' dim (ZeRO)."""
+    name is representative), optionally + a ZeRO dim over ``axis``
+    ('sharding' for param placement; optimizer-state specs pass the
+    dp-fallback axis from ``sharding.zero_data_axis``)."""
     from .sharding import _shard_spec_for
     per = list(rule(prefix + "0." + rel, arr.shape[1:])) if rule \
         else [None] * (arr.ndim - 1)
     spec = ["pp"] + list(_filter_spec(per, mesh))
     if extra_sharding:
-        spec = list(_shard_spec_for(arr.shape, mesh, existing=spec))
+        spec = list(_shard_spec_for(arr.shape, mesh, axis=axis,
+                                    existing=spec))
     return _filter_spec(spec, mesh)
 
 
@@ -327,7 +330,8 @@ def _make_pipeline_loss(mesh: Mesh, pp_spec: dict, pp_degree: int,
 
 
 def make_functional_train_step(optimizer, plist, order, grads_of,
-                               merge_k: int = 1, scan_batch: bool = False):
+                               merge_k: int = 1, scan_batch: bool = False,
+                               shard_info=None):
     """Compose a loss-gradient function with the optimizer's pure
     ``Optimizer.functional_update`` into
 
@@ -347,6 +351,12 @@ def make_functional_train_step(optimizer, plist, order, grads_of,
       ``(K, B, ...)``; one ``lax.scan`` runs K full optimizer steps
       inside the same XLA program and ``loss`` returns as a (K,) vector
       — Python touches the device once per K steps.
+    - ``shard_info`` (``sharding.ZeroShardInfo``): the optimizer update
+      runs ZeRO-sharded — reduce-scattered grads, shard-local moments
+      (+ optional f32 master slot), per-tensor param all-gathers pinned
+      so the scanned program's scheduler overlaps step k+1's gathers
+      with the tail of step k's update instead of serializing on one
+      fused gather (``Optimizer.functional_update`` shard-aware path).
     """
 
     def one_step(params, opt_states, step, lr, xs, ys):
@@ -374,7 +384,7 @@ def make_functional_train_step(optimizer, plist, order, grads_of,
         gs = [grads[k] for k in order]
         new_vals, new_states = optimizer.functional_update(
             vals, gs, opt_states, lr, step.astype(jnp.int32) + 1,
-            params=plist)
+            params=plist, shard_info=shard_info)
         new_params = dict(params)
         for k, v in zip(order, new_vals):
             new_params[k] = v
@@ -400,7 +410,7 @@ def make_functional_train_step(optimizer, plist, order, grads_of,
 def make_sharded_train_step(model: Layer, mesh: Mesh,
                             rule: Optional[Callable] = None,
                             learning_rate: float = 1e-4,
-                            zero_stage: int = 1,
+                            zero_stage: Optional[int] = None,
                             loss_fn: Optional[Callable] = None,
                             param_dtype=None,
                             grad_clip_norm: Optional[float] = 1.0,
@@ -410,10 +420,26 @@ def make_sharded_train_step(model: Layer, mesh: Mesh,
                             moment_dtype=None,
                             sp_mode: str = "auto",
                             optimizer: str = "adam",
-                            optimizer_kwargs: Optional[dict] = None):
+                            optimizer_kwargs: Optional[dict] = None,
+                            master_weights: bool = False):
     """Build (step_fn, state) — one compiled SPMD program per step covering
     forward, backward, grad psum over dp, Adam update on (optionally
-    'sharding'-sharded) optimizer state.
+    'sharding'/'dp'-sharded) optimizer state.
+
+    ``zero_stage=None`` (default) means stage 1 wherever the mesh has a
+    data axis; ``zero_stage>=1`` shards the OPTIMIZER STATE over the ZeRO
+    data axis
+    (the 'sharding' axis when present, else 'dp' —
+    ``sharding.zero_data_axis``): each rank owns a 1/dp slice of every
+    moment; the step's update is constraint-pinned end to end — grads
+    reduce-scattered onto the slice, shard-local rule, per-tensor param
+    all-gathers the scheduler overlaps with the remaining update compute
+    (stage 2 = the same program; the grads only ever materialize
+    scattered).  ``zero_stage>=3`` additionally shards the params
+    themselves ('sharding' axis, FSDP).  ``master_weights=True`` keeps
+    an f32 master copy of every floating param sharded alongside the
+    moments (classic multi-precision; params may then be bf16) — the
+    all-gather ships the CAST param, so master mode gathers bf16 bytes.
 
     This one function subsumes: EagerReducer fused allreduce (DP), sharding
     stage-1/2 (optimizer state + grads live sharded — XLA keeps them
@@ -431,6 +457,11 @@ def make_sharded_train_step(model: Layer, mesh: Mesh,
     (default: the pp degree).
     """
     from ..nn.layer import functional_call
+
+    # zero_stage=None (the default) means "stage 1 where the mesh allows
+    # it"; an explicit value is remembered so an inert ask can warn below
+    zero_explicit = zero_stage is not None
+    zero_stage = 1 if zero_stage is None else int(zero_stage)
 
     pp_degree = mesh.shape.get("pp", 1)
     sp_degree = mesh.shape.get("sp", 1)
@@ -495,17 +526,36 @@ def make_sharded_train_step(model: Layer, mesh: Mesh,
         params = {k: p._value for k, p in model.named_parameters()}
     _, buffers = model.functional_state()
 
+    # the ZeRO data axis: the dedicated 'sharding' axis when present,
+    # else 'dp' (the reference's sharding_optimizer partitions over the
+    # dp ring when no separate sharding ring exists) — a dp-only mesh no
+    # longer replicates the moments.  An EXPLICIT zero_stage>=1 on a mesh
+    # with no data axis warns — keeping dp full copies after an explicit
+    # ask must never be silent (same rule as Engine/Model.fit)
+    from .sharding import observe_opt_state_bytes, zero_data_axis
+    zaxis = zero_data_axis(mesh)
+    zero_on = zero_stage >= 1 and zaxis is not None
+    if zero_explicit and zero_stage >= 1 and zaxis is None:
+        import warnings
+        warnings.warn(
+            f"make_sharded_train_step(zero_stage={zero_stage}) on a mesh "
+            f"with no >1 'sharding'/'dp' axis ({dict(mesh.shape)}); "
+            "optimizer state stays REPLICATED", RuntimeWarning,
+            stacklevel=2)
+
     def opt_state_spec(name, arr):
         if pp_degree > 1 and name.startswith(
                 pp_spec["block_prefix"] + "$stacked."):
             rel = name[len(pp_spec["block_prefix"]) + len("$stacked."):]
             spec = _pp_stacked_spec(rel, arr, mesh, rule,
-                                    pp_spec["block_prefix"], zero_stage >= 1)
+                                    pp_spec["block_prefix"], zero_on,
+                                    axis=zaxis or "sharding")
             return NamedSharding(mesh, P(*spec))
         spec = list(rule(name, arr.shape)) if rule else [None] * arr.ndim
         spec = list(_filter_spec(spec, mesh))
-        if zero_stage >= 1:
-            spec = list(_shard_spec_for(arr.shape, mesh, existing=spec))
+        if zero_on:
+            spec = list(_shard_spec_for(arr.shape, mesh, axis=zaxis,
+                                        existing=spec))
         return NamedSharding(mesh, P(*spec))
 
     # moment_dtype=jnp.bfloat16 stores Adam m/v in bf16 (compute stays
@@ -519,10 +569,20 @@ def make_sharded_train_step(model: Layer, mesh: Mesh,
     mdt = jnp.float32 if moment_dtype is None else jnp.dtype(moment_dtype)
     # lars keeps a single velocity slot; adam/lamb keep two moments
     slots = ("m",) if opt_kind == "lars" else ("m", "v")
-    opt_state = {
-        k: {s: jax.device_put(jnp.zeros(v.shape, mdt),
-                              opt_state_spec(k, v)) for s in slots}
-        for k, v in params.items()}
+    m_sh = {k: opt_state_spec(k, v) for k, v in params.items()}
+
+    def _init_slots(k, v):
+        st = {s: jax.device_put(jnp.zeros(v.shape, mdt), m_sh[k])
+              for s in slots}
+        if master_weights and jnp.issubdtype(v.dtype, jnp.floating):
+            # f32 master copy sharded like the moments; the bf16 compute
+            # param is re-derived from it every step by cast + gather
+            from .sharding import master_copy
+            st["master"] = jax.device_put(master_copy(v), m_sh[k])
+        return st
+
+    opt_state = {k: _init_slots(k, v) for k, v in params.items()}
+    observe_opt_state_bytes("sharded_step", opt_state)
     step_no = jnp.zeros((), jnp.int32)
 
     if pp_degree > 1:
@@ -599,6 +659,8 @@ def make_sharded_train_step(model: Layer, mesh: Mesh,
         nv, vel = fn(p, g, st["m"].astype(jnp.float32))
         return nv, {"m": vel.astype(mdt)}
 
+    param_shardings = {k: a.sharding for k, a in params.items()}
+
     def train_step(params, opt_state, step_no, batch, rng, lr):
         def pure_loss(p):
             return loss_fn(model, p, buffers, batch, rng)
@@ -610,6 +672,9 @@ def make_sharded_train_step(model: Layer, mesh: Mesh,
             pure_loss = jit_recompute(pure_loss, policy=recompute_policy)
         loss, grads = jax.value_and_grad(pure_loss)(params)
         if grad_clip_norm is not None:
+            # the global clip norm is computed BEFORE the ZeRO grad pins
+            # (on the replicated grads) so sharded-vs-replicated runs
+            # clip by the bit-identical scale
             gnorm = jnp.sqrt(sum(
                 jnp.sum(jnp.square(g.astype(jnp.float32)))
                 for g in jax.tree.leaves(grads)))
@@ -618,9 +683,40 @@ def make_sharded_train_step(model: Layer, mesh: Mesh,
         t = step_no + 1
         new_params, new_opt = {}, {}
         for k in params:
-            new_v, new_opt[k] = _apply_update(k, params[k], grads[k],
-                                              opt_state[k], lr, t)
-            new_params[k] = new_v.astype(params[k].dtype)
+            g, st = grads[k], opt_state[k]
+            st = dict(st)
+            master = st.pop("master", None)
+            if zero_on:
+                # ZeRO pins, per tensor: the pending dp grad psum fuses
+                # with the slice into a reduce-scatter; moments stay on
+                # their 1/dp slice in AND out (GSPMD cannot re-replicate
+                # them); the updated param casts to the compute dtype
+                # FIRST and then gathers back to its own sharding — an
+                # independent per-tensor all-gather the scheduler
+                # overlaps with the other params' update compute
+                msh = m_sh[k]
+
+                def wsc(a, _m=msh):
+                    return jax.lax.with_sharding_constraint(a, _m)
+
+                g = wsc(g)
+                st = {s: wsc(v) for s, v in st.items()}
+                p_upd = wsc(master) if master is not None \
+                    else wsc(params[k])
+            else:
+                p_upd = master if master is not None else params[k]
+            new_v, new_st = _apply_update(k, p_upd, g, st, lr, t)
+            if zero_on:
+                new_st = {s: wsc(v) for s, v in new_st.items()}
+            if master is not None:
+                # the f32 master never leaves its shard
+                new_st["master"] = wsc(new_v) if zero_on else new_v
+            nv = new_v.astype(params[k].dtype)
+            if zero_on:
+                nv = jax.lax.with_sharding_constraint(nv,
+                                                      param_shardings[k])
+            new_params[k] = nv
+            new_opt[k] = new_st
         return new_params, new_opt, step_no + 1, loss
 
     bspec = batch_spec(mesh)

@@ -206,7 +206,16 @@ def shard_op(op_fn: Callable, process_mesh: Optional[ProcessMesh] = None,
 @dataclasses.dataclass
 class Strategy:
     """Engine config (ref ``auto_parallel/strategy.py`` — the pass-toggle
-    blocks: amp / sharding / recompute / gradient_merge)."""
+    blocks: amp / sharding / recompute / gradient_merge).
+
+    ``sharding=True`` + ``sharding_stage>=1`` runs the ZeRO-sharded
+    optimizer: every moment is owned 1/dp per rank over the mesh's
+    'sharding'/'dp' axis, the train program reduce-scatters grads into
+    the shard-local update and all-gathers the updated params per
+    tensor (stage 2 is the same one-program lowering — grads only ever
+    materialize scattered; stage 3 additionally shards params).
+    ``master_weights=True`` keeps f32 master copies sharded alongside
+    the moments (useful with amp/bf16 params)."""
     amp: bool = False
     amp_dtype: str = "bfloat16"
     sharding: bool = False
@@ -214,6 +223,7 @@ class Strategy:
     recompute: bool = False
     gradient_merge_k: int = 1
     seed: int = 0
+    master_weights: bool = False
 
 
 class Engine:
@@ -288,28 +298,53 @@ class Engine:
         _, buffers = self.model.functional_state()
         opt = self.optimizer
         opt_states = None
+        self._zero_info = None
         if opt is not None:
             plist = opt._parameter_list
             opt_states = opt.functional_state(plist)
+            zaxis = None
             if self.strategy.sharding and self.strategy.sharding_stage >= 1:
-                from .sharding import _shard_spec_for
                 # ZeRO shards optimizer state across data-parallel replicas:
-                # use the dedicated 'sharding' axis when the mesh has one,
-                # else fall back to the dp axis (ref sharding_optimizer.py
-                # partitions over the dp ring when no mp/sharding ring exists)
-                zaxis = ("sharding" if mesh.shape.get("sharding", 1) > 1
-                         else "dp")
-                placed = []
-                for p, st in zip(plist, opt_states):
-                    spec = _shard_spec_for(p.shape, mesh, axis=zaxis,
-                                           existing=None)
-                    sh = NamedSharding(mesh, P(*spec))
-                    placed.append({k: jax.device_put(v, sh)
-                                   for k, v in st.items()})
-                opt_states = placed
+                # the dedicated 'sharding' axis when the mesh has one, else
+                # the dp axis (ref sharding_optimizer.py partitions over the
+                # dp ring when no mp/sharding ring exists)
+                from .sharding import ZeroShardInfo, zero_data_axis
+                zaxis = zero_data_axis(mesh)
+                if zaxis is None:
+                    # the user explicitly asked for sharding — keeping dp
+                    # full copies of the optimizer state must never be
+                    # silent (same rule as Model.fit's zero_stage warn)
+                    import warnings
+                    warnings.warn(
+                        "Strategy.sharding_stage>=1 needs a mesh with a "
+                        ">1 'sharding' or 'dp' axis; optimizer state "
+                        "stays REPLICATED on this mesh", RuntimeWarning,
+                        stacklevel=3)
+            if zaxis is not None:
+                def _pspec(p):
+                    sh = getattr(p._value, "sharding", None)
+                    if isinstance(sh, NamedSharding):
+                        spec = list(sh.spec) + [None] * (
+                            p._value.ndim - len(sh.spec))
+                        return tuple(spec)
+                    return (None,) * p._value.ndim
+                si = ZeroShardInfo(
+                    mesh=mesh, axis=zaxis,
+                    stage=int(self.strategy.sharding_stage),
+                    master_weights=bool(self.strategy.master_weights)
+                ).with_param_specs([_pspec(p) for p in plist])
+                self._zero_info = si
+                # moments extend the param's OWN spec (TP dims kept) so
+                # the placement agrees with the in-program pins — a
+                # mismatch would force a reshard at program entry
+                from .sharding import place_zero_state
+                opt_states = place_zero_state(
+                    si, [p._value for p in plist], opt_states)
             else:
                 opt_states = [{k: jax.device_put(v, repl)
                                for k, v in st.items()} for st in opt_states]
+            from .sharding import observe_opt_state_bytes
+            observe_opt_state_bytes("engine", opt_states)
         self._buffers = buffers
         # step replicated ONTO the mesh (not default-device): checkpoint
         # resume places arrays with these shardings, and a single-device
@@ -358,10 +393,15 @@ class Engine:
 
         # gradient_merge (ref gradient_merge_optimizer.py) is composed by
         # the shared builder: split into k micro-batches, average grads,
-        # single functional optimizer update
+        # single functional optimizer update; Strategy.sharding_stage>=1
+        # threads the ZeRO shard_info through it so the update runs on
+        # the 1/dp moment slices (reduce-scattered grads, per-tensor
+        # param all-gathers) instead of letting GSPMD re-replicate the
+        # placed state inside the program
         from .api import make_functional_train_step
-        train_step = make_functional_train_step(opt, plist, order, grads_of,
-                                                merge_k=merge_k)
+        train_step = make_functional_train_step(
+            opt, plist, order, grads_of, merge_k=merge_k,
+            shard_info=getattr(self, "_zero_info", None))
 
         state = self._state
         param_sh = jax.tree.map(lambda a: a.sharding, state["params"])

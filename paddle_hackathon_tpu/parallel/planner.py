@@ -420,7 +420,9 @@ def score_plan(model, mesh, rule, sample_args, zero_stage=0, labels=None,
                loss_fn=None, want_flops=False):
     """Compile the real train step under ``rule`` and measure it: exact
     collective payload bytes from the optimized HLO plus per-device
-    argument bytes from the compiled executable.
+    argument bytes from the compiled executable, and the placed
+    optimizer state's per-device vs replicated bytes (the ZeRO saving a
+    ``zero_stage`` candidate buys — ``plan_mesh`` tables carry both).
 
     The default train-step loss is the LM path (int token ``ids`` +
     ``labels``); for other model families pass ``labels`` and a
@@ -448,12 +450,20 @@ def score_plan(model, mesh, rule, sample_args, zero_stage=0, labels=None,
     text = compiled.as_text()
     coll = collective_bytes_from_hlo(text)
     mem = compiled.memory_analysis()
+    # sharded-state accounting: per-device bytes of the PLACED optimizer
+    # state (ZeRO shrinks this ~1/dp while arg_bytes already reflect it
+    # in aggregate) — reported explicitly so a plan_mesh table shows
+    # where a zero_stage candidate's memory win comes from
+    from .sharding import state_bytes as _state_bytes
+    opt_logical, opt_per_dev = _state_bytes(state["opt_state"])
     out = {
         "collective_bytes": sum(coll.values()),
         "collectives": coll,
         "arg_bytes_per_device": int(getattr(mem, "argument_size_in_bytes",
                                             0)),
         "temp_bytes_per_device": int(getattr(mem, "temp_size_in_bytes", 0)),
+        "opt_state_bytes_per_device": int(opt_per_dev),
+        "opt_state_bytes_replicated": int(opt_logical),
     }
     if want_flops:
         try:

@@ -19,12 +19,13 @@ the *eager* model+optimizer workflow.
 
 from __future__ import annotations
 
-from typing import Optional
+import dataclasses
+from typing import Optional, Sequence
 
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..nn.layer import Layer
 from . import api as _mesh_api
@@ -48,6 +49,137 @@ def _shard_spec_for(shape, mesh, axis="sharding", existing=None):
                 spec[i] = axis
                 break
     return _mesh_api._filter_spec(spec, mesh)
+
+
+def zero_data_axis(mesh: Optional[Mesh]) -> Optional[str]:
+    """The mesh axis ZeRO shards optimizer state over: the dedicated
+    'sharding' axis when present, else the 'dp' axis (ref
+    ``sharding_optimizer.py`` partitions over the dp ring when no
+    separate sharding ring exists).  None when neither axis is >1 —
+    ZeRO is then inert and callers keep state replicated."""
+    if mesh is None:
+        return None
+    for axis in ("sharding", "dp"):
+        if mesh.shape.get(axis, 1) > 1:
+            return axis
+    return None
+
+
+@dataclasses.dataclass(frozen=True)
+class ZeroShardInfo:
+    """Static description of a ZeRO-sharded optimizer update — the
+    argument ``Optimizer.functional_update(shard_info=...)`` (and the
+    trainers that inline it) consume at trace time.
+
+    ``stage`` follows the reference's ``group_sharded_parallel`` levels:
+    1 ('os') shards the optimizer state, 2 ('os_g') additionally keeps
+    gradients reduce-scattered — in the one-program GSPMD formulation
+    both lower identically (the grad pin below makes the gradient
+    materialize already scattered; there is no eager window where a
+    full gradient could persist), so the field is recorded for API
+    parity and telemetry, not branched on.  Stage 3 (params sharded) is
+    the trainers' ``zero_stage=3`` placement; the update path here is
+    the same — the param pin is then a no-op because the param spec
+    already carries the axis.
+
+    ``master_weights=True`` expects every state dict to carry a
+    ``"master"`` slot (f32, placed like the moments): the update reads
+    and writes the master copy and the gathered param is its cast.
+    """
+    mesh: Mesh
+    axis: str
+    stage: int = 1
+    master_weights: bool = False
+    # per-param base specs (TP/placement), aligned with the positional
+    # buffers; None = all-replicated
+    param_specs: Optional[tuple] = None
+
+    def moment_spec(self, shape, existing=None):
+        """Spec for a moment/master leaf of ``shape``: the param's own
+        spec (TP dims preserved) with the first divisible unsharded dim
+        additionally split over the ZeRO axis."""
+        ex = list(existing) if existing is not None else None
+        if ex is not None and len(ex) != len(tuple(shape)):
+            ex = None
+        return _shard_spec_for(shape, self.mesh, axis=self.axis,
+                               existing=ex)
+
+    def with_param_specs(self, specs: Sequence) -> "ZeroShardInfo":
+        return dataclasses.replace(self, param_specs=tuple(
+            tuple(s) if s is not None else None for s in specs))
+
+
+def place_zero_state(shard_info: "ZeroShardInfo", param_values, states):
+    """Place per-param optimizer slot dicts at their ZeRO moment
+    sharding, adding the f32 ``"master"`` slot for floating params when
+    ``shard_info.master_weights`` — THE single owner of the placement
+    the hapi trainer and the Engine share (``make_sharded_train_step``
+    keeps its own pp-stacked-aware variant).  Returns the placed list."""
+    pspecs = shard_info.param_specs or (None,) * len(param_values)
+    placed = []
+    for v, st, ps in zip(param_values, states, pspecs):
+        sh = NamedSharding(shard_info.mesh,
+                           P(*shard_info.moment_spec(v.shape, existing=ps)))
+        d = {k: jax.device_put(s, sh) for k, s in st.items()}
+        if shard_info.master_weights and jnp.issubdtype(v.dtype,
+                                                        jnp.floating):
+            d["master"] = jax.device_put(master_copy(v), sh)
+        placed.append(d)
+    return placed
+
+
+def master_copy(value):
+    """The f32 master-weight INITIAL value for ``value`` — a fresh
+    buffer, always.  An f32 param's ``astype`` is a no-op returning the
+    same array, and an aliased master would be the same buffer donated
+    twice (through the params arg AND the opt-state arg) — Execute()
+    rejects that.  Single owner of the invariant; every trainer's
+    master init must go through here."""
+    return jnp.copy(value.astype(jnp.float32))
+
+
+def state_bytes(tree):
+    """``(logical_bytes, per_device_bytes)`` over a placed state pytree —
+    pure sharding metadata (``NamedSharding.shard_shape``), no transfer.
+    ``logical`` is what a replicated placement would hold per device, so
+    ``per_device / logical`` is the measured ZeRO shrink (~1/dp)."""
+    logical = per_dev = 0
+    for a in jax.tree.leaves(tree):
+        if not isinstance(a, jax.Array):
+            continue
+        logical += a.nbytes
+        sh = getattr(a, "sharding", None)
+        if hasattr(sh, "shard_shape"):
+            per_dev += int(np.prod(sh.shard_shape(a.shape),
+                                   dtype=np.int64)) * a.dtype.itemsize
+        else:
+            per_dev += a.nbytes
+    return logical, per_dev
+
+
+def observe_opt_state_bytes(path: str, tree) -> int:
+    """Set ``train_opt_state_bytes{path,sharded}`` at trainer build
+    (docs/OBSERVABILITY.md) — sharding metadata only, no transfer.
+
+    ``sharded="false"`` carries what a REPLICATED placement holds per
+    device (the state's logical bytes); ``sharded="true"`` carries the
+    ACTUAL placed per-device bytes — equal to the replicated value when
+    ZeRO is off, so the true/false ratio IS the measured shrink (~1/dp
+    under ZeRO, 1.0 otherwise).  BOTH children are written on every
+    build: a non-sharded rebuild on the same path must overwrite a
+    previous ZeRO build's value, never leave a stale shrink exported.
+    Returns the per-device bytes."""
+    from ..observability import metrics as _obs
+    logical, per_dev = state_bytes(tree)
+    fam = _obs.get_registry().gauge(
+        "train_opt_state_bytes",
+        "optimizer-state bytes per device at trainer build (placement "
+        "metadata, no transfer): sharded=false = the replicated "
+        "footprint, sharded=true = the actual placed footprint; their "
+        "ratio is the ZeRO shrink (~1/dp; 1.0 when not sharded)")
+    fam.labels(path=path, sharded="false").set(logical)
+    fam.labels(path=path, sharded="true").set(per_dev)
+    return per_dev
 
 
 def group_sharded_parallel(model: Layer, optimizer, level: str = "os_g",
@@ -91,6 +223,15 @@ def group_sharded_parallel(model: Layer, optimizer, level: str = "os_g",
         return out
 
     optimizer._init_accumulators = sharded_init
+    # ...and the UPDATE runs through the same functional sharded path the
+    # compiled trainers use (``Optimizer._sharded_update``): grads pinned
+    # to the moment sharding (reduce-scatter), shard-local rule, params
+    # all-gathered back — eager and compiled ZeRO agree on the program,
+    # instead of the old placement-only wrapping that let GSPMD
+    # re-replicate the moments inside ``Optimizer.step``'s jitted update.
+    optimizer._zero_info = ZeroShardInfo(
+        mesh=mesh, axis="sharding",
+        stage={"os": 1, "os_g": 2, "p_g_os": 3}[level])
     return model, optimizer, scaler
 
 

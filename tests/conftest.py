@@ -81,6 +81,8 @@ _FILE_COST = {
                             # landed (tools/test_budget.py caught the 7s
                             # entry going stale)
     "test_checkpointing.py": 8,   # host-only protocol/fault units
+    "test_zero_sharded.py": 6,    # spec/update units + 2 tiny jits;
+                                  # fit/Engine drills are slow-marked
     "test_crash_drill.py": 1,     # fully slow-marked (subprocess drills)
     "test_sanitizers.py": 3,  # lock/guard units; engine runs are slow-marked
     "test_paged.py": 16,    # allocator units + 2 tiny-GPT engine runs

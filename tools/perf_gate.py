@@ -177,6 +177,13 @@ def compare_moe_active_ratio(rows):
 RATIO_GATES = [
     ("hapi_fit_tokens_per_sec",
      "gpt2_small_pretrain_tokens_per_sec_per_chip", 0.90),
+    # ZeRO-1 sharded optimizer through the identical Model.fit recipe:
+    # the reduce-scatter/shard-update/all-gather path must hold tokens/s
+    # within 10% of the replicated-update hapi_fit row (the per-tensor
+    # gathers are designed to overlap the update tail inside the scanned
+    # program — a serialized gather shows up here); the row additionally
+    # embeds opt_state_bytes_vs_replicated ~ 1/dp as the HBM evidence
+    ("hapi_fit_zero1_tokens_per_sec", "hapi_fit_tokens_per_sec", 0.90),
     ("gpt2_serving_spec_8stream_device_tokens_per_sec_per_chip",
      "gpt2_serving_8stream_device_tokens_per_sec_per_chip", 1.00),
     # paged KV at 2x the admitted streams must not lose aggregate
@@ -239,6 +246,31 @@ def compare_metrics(rows):
     return bad
 
 
+def compare_zero_sharding(rows):
+    """[(metric, reason)] for ZeRO bench rows whose sharding evidence is
+    vacuous or absent: a row claiming ``zero_stage>=1`` must have run on
+    >1 data-axis devices (``dp``) AND show
+    ``opt_state_bytes_vs_replicated`` strictly below 1.0 (the ~1/dp
+    shrink).  A single-device bench environment — or a mesh the trainer
+    silently degraded on — would otherwise green-light the
+    ``hapi_fit_zero1`` ratio gate while both rows ran the identical
+    replicated program, measuring nothing."""
+    bad = []
+    for r in rows:
+        if not r.get("zero_stage"):
+            continue
+        dp = int(r.get("dp") or 0)
+        ratio = r.get("opt_state_bytes_vs_replicated")
+        if dp <= 1:
+            bad.append((r["metric"],
+                        f"ran on dp={dp} — ZeRO measured nothing"))
+        elif ratio is None or float(ratio) >= 1.0:
+            bad.append((r["metric"],
+                        f"opt_state_bytes_vs_replicated={ratio!r} on "
+                        f"dp={dp} — the optimizer state did not shard"))
+    return bad
+
+
 def compare_timing_fallbacks(rows):
     """[metric] for rows measuring a *device* metric that fell back to
     HOST wall-clock timing (bench.py tags ``"timing": "host"`` when the
@@ -290,8 +322,9 @@ def suite_gate(tolerance, rows=None):
     bad_timing = compare_timing_fallbacks(rows)
     bad_errors = compare_error_rows(rows)
     bad_moe = compare_moe_active_ratio(rows)
+    bad_zero = compare_zero_sharding(rows)
     if (bad or bad_ratio or bad_metrics or bad_leaks or bad_timing
-            or bad_errors or bad_moe):
+            or bad_errors or bad_moe or bad_zero):
         if bad:
             print(f"perf_gate[suite] FAIL: {len(bad)} configs regressed "
                   f">{tolerance:.0%}:")
@@ -312,6 +345,9 @@ def suite_gate(tolerance, rows=None):
             print(f"perf_gate[suite] FAIL: {metric} recompiled in steady "
                   f"state ({warm} jit builds after warm-up, {total} after "
                   f"the measured run)")
+        for metric, reason in bad_zero:
+            print(f"perf_gate[suite] FAIL: {metric} ZeRO evidence is "
+                  f"vacuous ({reason})")
         for metric, leaked in bad_leaks:
             print(f"perf_gate[suite] FAIL: {metric} leaked {leaked} KV "
                   f"pool pages (pages_in_use != 0 after drain + "
