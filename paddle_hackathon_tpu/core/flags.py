@@ -12,10 +12,11 @@ variables at import time, mirroring the reference's env-var override behaviour.
 from __future__ import annotations
 
 import os
-import threading
 from typing import Any, Dict, Iterable, Mapping, Union
 
-_lock = threading.RLock()
+from ..observability.sanitizers import make_rlock
+
+_lock = make_rlock("core.flags")
 _registry: Dict[str, Any] = {}
 _defs: Dict[str, dict] = {}
 

@@ -20,16 +20,17 @@ import ctypes
 import hashlib
 import os
 import subprocess
-import threading
 from pathlib import Path
 from typing import Optional, Sequence
+
+from ..observability.sanitizers import make_lock
 
 _SRC = Path(__file__).resolve().parent.parent / "native" / "runtime.cc"
 _BUILD_DIR = _SRC.parent / "_build"
 
 _lib = None
 _lib_failed = False
-_lib_lock = threading.Lock()
+_lib_lock = make_lock("core.native_build")
 _TASK_FN = ctypes.CFUNCTYPE(None, ctypes.c_void_p, ctypes.c_int32)
 
 

@@ -18,10 +18,13 @@ from typing import Optional
 
 import jax
 
+from ..observability.sanitizers import make_lock
+
 _state = threading.local()
 _GLOBAL_SEED = 0
 _global_key = None
-_lock = threading.Lock()
+# make_lock: visible to the lock-order/race sanitizers (PHT009 sweep)
+_lock = make_lock("core.random")
 
 
 def seed(s: int) -> None:

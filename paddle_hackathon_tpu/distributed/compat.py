@@ -23,6 +23,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.tensor import Tensor
+from ..observability.sanitizers import make_lock
 from ..parallel import env as _env
 from ..parallel import store as _store_mod
 
@@ -80,7 +81,8 @@ class ParallelEnv:
 # -- p2p over the rendezvous store ------------------------------------------
 
 _local_chan: dict = {}
-_chan_lock = threading.Lock()
+# make_lock: visible to the lock-order/race sanitizers (PHT009 sweep)
+_chan_lock = make_lock("dist.chan")
 _p2p_seq: dict = {}
 _store = None
 

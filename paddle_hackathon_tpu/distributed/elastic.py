@@ -16,6 +16,8 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional
 
+from ..observability.sanitizers import make_lock
+
 __all__ = ["ElasticStatus", "LeaseLostError", "LeaseStore", "MemLeaseStore",
            "TCPLeaseStore", "ElasticManager"]
 
@@ -56,7 +58,8 @@ class MemLeaseStore(LeaseStore):
 
     def __init__(self):
         self._data: Dict[str, tuple] = {}  # key -> (value, expiry)
-        self._lock = threading.Lock()
+        # make_lock: the heartbeat thread and watchers share this store
+        self._lock = make_lock("elastic.lease")
 
     def put_with_lease(self, key, value, ttl):
         with self._lock:

@@ -26,6 +26,8 @@ import threading
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional
 
+from ..observability.sanitizers import make_lock
+
 __all__ = ["TaskNode", "Carrier", "FleetExecutor", "Interceptor",
            "ComputeInterceptor", "AmplifierInterceptor", "MessageBus"]
 
@@ -221,7 +223,9 @@ class Carrier:
         self._done = threading.Event()
         self._finished: set = set()
         self._sinks = {n.task_id for n in nodes if not n.downstream}
-        self._lock = threading.Lock()
+        # make_lock: visible to the lock-order/race sanitizers (the
+        # interceptor actor threads all report through this carrier)
+        self._lock = make_lock("fleet.carrier")
 
     def collect(self, task_id: int, mb: int, value: Any) -> None:
         self.results.setdefault(task_id, {})[mb] = value

@@ -6,16 +6,17 @@ import ctypes
 import hashlib
 import os
 import subprocess
-import threading
 from pathlib import Path
 from typing import Optional
+
+from ...observability.sanitizers import make_lock
 
 _SRC = Path(__file__).resolve().parent.parent.parent / "native" / "ps.cc"
 _BUILD_DIR = _SRC.parent / "_build"
 
 _lib = None
 _lib_failed = False
-_lock = threading.Lock()
+_lock = make_lock("ps.native_build")
 
 
 def _build() -> Path:

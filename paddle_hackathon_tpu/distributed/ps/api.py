@@ -26,6 +26,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import _native
+from ...observability.sanitizers import make_lock
 
 RULES = {"sgd": 0, "adagrad": 1}
 
@@ -77,12 +78,16 @@ class _Conn:
         self._h = lib.pht_ps_connect(host.encode(), port, timeout_ms)
         if not self._h:
             raise TimeoutError(f"cannot reach PS server {host}:{port}")
-        self._lock = threading.Lock()
+        self._lock = make_lock("ps.client")
 
     def close(self):
-        if self._h:
-            self._lib.pht_ps_disconnect(self._h)
-            self._h = None
+        # under the client lock: close() racing an in-flight pull/push on
+        # another thread (the AsyncCommunicator flush loop) would null
+        # _h between that caller's check and its native call
+        with self._lock:
+            if self._h:
+                self._lib.pht_ps_disconnect(self._h)
+                self._h = None
 
 
 def _f32p(a):
@@ -438,7 +443,9 @@ class AsyncCommunicator:
         self.client = client
         self.interval = flush_interval
         self._pending: List[tuple] = []
-        self._cv = threading.Condition()
+        # Condition over a make_lock: the send thread's lock shows up in
+        # the sanitizers' graph like every other lock in the process
+        self._cv = threading.Condition(make_lock("ps.communicator"))
         self._stop = False
         self._max = max_pending
         self._thread = threading.Thread(target=self._loop, daemon=True)

@@ -52,7 +52,8 @@ import numpy as np
 from ..observability import faults as _faults
 from ..observability import flight as _flight
 from ..observability import metrics as _obs
-from ..observability.sanitizers import make_lock, sanitize_donation
+from ..observability.sanitizers import (make_lock, sanitize_donation,
+                                        share_object)
 from ..observability import tracing as _tr
 
 _ENGINE_IDS = itertools.count()
@@ -613,6 +614,13 @@ class ServingEngine:
         else:
             self._build_tick()
         self._alloc_caches(jnp)
+        # declare this engine shared for the race sanitizer (zero cost
+        # when off — returns self untouched).  atomic: _tickno is read
+        # lock-free by its only writer, the driver thread (the same
+        # single-aligned-read contract the `# pht-lint: gil-atomic`
+        # annotations on the _run_tick* read sites claim statically).
+        share_object(self, f"serving.engine[{self._engine_id}]",
+                     atomic=("_tickno",))
 
     # ------------------------------------------------------------------
     def _init_metrics(self):
@@ -1108,7 +1116,8 @@ class ServingEngine:
         out = self._prog("_tick", vec)(
             self._params, self._caches, tokens[:, :width],
             starts, nvalid, temps_d, topks_d, topps_d, self._key,
-            np.int32(self._tickno), **self._pt_kw())
+            # single aligned int read by its only writer (driver thread)
+            np.int32(self._tickno), **self._pt_kw())  # pht-lint: gil-atomic
         # the tick's ONE designed device->host fetch: explicit, so the
         # transfer-guard sanitizer (observability/sanitizers.py) can
         # tell it from an accidental implicit sync (MoE router stats
@@ -1144,7 +1153,8 @@ class ServingEngine:
         res = self._prog("_tick_spec", vec)(
             self._params, self._caches, toks_j, starts_j,
             temps_d, topks_d, topps_d,
-            self._key, np.int32(self._tickno),
+            # single aligned int read by its only writer (driver thread)
+            self._key, np.int32(self._tickno),  # pht-lint: gil-atomic
             **self._pt_kw())
         # designed once-per-tick fetch (see _run_tick)
         if self._moe:
@@ -1306,9 +1316,11 @@ class ServingEngine:
         pp = self._pp
         vec = sampling[0]
         temps_d, topks_d, topps_d = self._sampling_dev3(sampling)
-        # wave at stage s this tick entered stage 0 s ticks ago
+        # wave at stage s this tick entered stage 0 s ticks ago (tickno:
+        # single aligned int read by its only writer, the driver thread)
         wave_of_stage = np.array(
-            [(self._tickno - s) % pp for s in range(pp)], np.int32)
+            [(self._tickno - s) % pp for s in range(pp)],  # pht-lint: gil-atomic
+            np.int32)
         kc, vc = self._caches
         # partial-manual shard_map (pp manual, dp/mp auto) needs the
         # ambient mesh — same contract as _run_decode_program
@@ -1319,7 +1331,7 @@ class ServingEngine:
                 jnp.asarray(starts), jnp.asarray(nvalid),
                 temps_d, topks_d, topps_d,
                 jnp.asarray(wave_of_stage), self._pp_other, self._key,
-                np.int32(self._tickno))
+                np.int32(self._tickno))  # pht-lint: gil-atomic
         self._caches = (kc, vc)
         # designed once-per-tick fetch (see _run_tick)
         return jax.device_get(nxt)
@@ -1420,8 +1432,9 @@ class ServingEngine:
         stays FIFO: when the queue head's pages don't fit, later (maybe
         smaller) requests wait behind it rather than starving it.
 
-        Returns the prefix-hit drafter replays ``[(slot, req, skip)]``
-        for the CALLER to run after releasing the engine lock: the
+        Returns the prefix-hit drafter replays ``[(slot, req, skip,
+        lengths_snapshot)]`` for the CALLER to run after releasing the
+        engine lock: the
         replay dispatches the drafter's jitted ingest program, and
         dispatching device work under ``_lock`` stalls every concurrent
         submit()/introspection call behind the device (pht-lint PHT003
@@ -1447,7 +1460,13 @@ class ServingEngine:
             self._lengths[i] = skip
             self._c["prompt_tokens"].inc(len(req.prompt))
             if skip and self._spec is not None:
-                replays.append((i, req, skip))
+                # snapshot the committed lengths UNDER the lock: the
+                # replay itself runs after release (device dispatch must
+                # not hold the engine lock — PHT003), and reading
+                # self._lengths there would be an unguarded read of
+                # lock-guarded state (PHT009); only slot i's row is
+                # consumed (other slots replay zero tokens)
+                replays.append((i, req, skip, self._lengths.copy()))
             now = time.perf_counter()
             queue_s = now - req._t_submit
             req.lifecycle.update(t_admit=now, queue_s=queue_s, slot=i)
@@ -1536,22 +1555,24 @@ class ServingEngine:
         self._g_pages_free.set(self._pool.free)
         return len(hit) * P
 
-    def _replay_skipped_to_drafter(self, i, req, skip):
+    def _replay_skipped_to_drafter(self, i, req, skip, lengths):
         """A prefix-cache hit skips re-prefilling rows [0, skip) — but
         the drafter's mirror only ever sees what the target tick feeds
         it, so without this replay it would propose from a hole in its
         history (never *wrong* tokens — verify rejects — just a silently
         degraded acceptance rate).  Replay in chunk-wide pieces: the
         width the drafter's ingest program is already compiled for, so
-        no new trace per distinct hit length.  Other slots' rows follow
-        the normal ingest convention (zero tokens written past their
+        no new trace per distinct hit length.  ``lengths`` is the
+        committed-lengths snapshot ``_admit`` took under the engine
+        lock (this runs after release); other slots' rows follow the
+        normal ingest convention (zero tokens written past their
         committed length — scratch the draft attention never reads)."""
         C = self.chunk
         for ofs in range(0, skip, C):
             n = min(C, skip - ofs)
             buf = np.zeros((self.max_slots, C), np.int32)
             buf[i, :n] = req.prompt[ofs:ofs + n]
-            starts = self._lengths.copy()
+            starts = lengths.copy()
             starts[i] = ofs
             nvalid = np.zeros(self.max_slots, np.int32)
             nvalid[i] = n
@@ -1784,13 +1805,13 @@ class ServingEngine:
             if self._paged:
                 self._check_write_windows_locked(starts)
 
-        for i, req, skip in replays:
+        for i, req, skip, lengths in replays:
             # deferred from _admit: the drafter's jitted ingest must not
             # dispatch under the engine lock (only this driver thread
             # mutates slot state, so running it here — before this
             # tick's device program and its post-verify ingest — is
             # order-equivalent to replaying inside _admit)
-            self._replay_skipped_to_drafter(i, req, skip)
+            self._replay_skipped_to_drafter(i, req, skip, lengths)
 
         if mode == "pp":
             t0n = time.perf_counter_ns()
@@ -1973,7 +1994,8 @@ class ServingEngine:
         res = self._prog("_tick_multi", vec)(
             self._params, self._caches, last_toks,
             starts, temps_d, topks_d, topps_d, self._key,
-            np.int32(self._tickno), **self._pt_kw())
+            # single aligned int read by its only writer (driver thread)
+            np.int32(self._tickno), **self._pt_kw())  # pht-lint: gil-atomic
         # designed once-per-tick fetch (see _run_tick); MoE stats are
         # the window's M-step means and ride the same fetch
         if self._moe:

@@ -21,7 +21,7 @@ from typing import Callable, Optional
 import numpy as np
 
 from ..core.tensor import Tensor
-from ..observability.sanitizers import make_lock
+from ..observability.sanitizers import make_lock, share_object
 from .dataset import IterableDataset
 from .sampler import BatchSampler
 
@@ -210,6 +210,10 @@ class _PrefetchIter:
             self.task_q.put((i, b))
         self.n_tasks = len(self.batches)
         self.workers = []
+        # declare shared BEFORE the workers start: every worker access
+        # from here on is lockset-checked when the race sanitizer is
+        # armed (zero cost otherwise — share_object returns self as-is)
+        share_object(self, "dataloader.prefetch")
         for wid in range(loader.num_workers):
             t = threading.Thread(target=self._worker, args=(wid,), daemon=True)
             t.start()
@@ -243,9 +247,14 @@ class _PrefetchIter:
         return self
 
     def __next__(self):
-        if self.next_emit >= self.n_tasks:
-            raise StopIteration
         with self.cv:
+            # the drained check must read next_emit UNDER the cv: it is
+            # written under the cv below, and two consumer threads (or
+            # the buffered stager racing a direct consumer) checking it
+            # lock-free could both pass and one would wait forever on a
+            # batch the other already emitted (PHT009 check-then-act)
+            if self.next_emit >= self.n_tasks:
+                raise StopIteration
             while self.next_emit not in self.results and self.error is None:
                 self.cv.wait(timeout=1.0)
             if self.error is not None:
@@ -400,6 +409,11 @@ class _ProcPrefetchIter:
         self.results = {}
         self.next_emit = 0
         self.next_task = 0
+        # close() runs from the consumer AND from __del__ (which the GC
+        # may fire on any thread): the closed check-then-set must be
+        # atomic or both callers race past it (PHT010's shape) and
+        # double-drain the queues
+        self._close_lock = make_lock("dataloader.close")
         self._closed = False
         self.workers = [
             ctx.Process(target=_proc_worker,
@@ -491,9 +505,10 @@ class _ProcPrefetchIter:
         return self._reconstruct(metas, structure)
 
     def close(self):
-        if self._closed:
-            return
-        self._closed = True
+        with self._close_lock:
+            if self._closed:
+                return
+            self._closed = True
         # graceful first: sentinels let each worker finish its CURRENT
         # task and flush its queue feeder — terminating straight away
         # would strand in-flight shm segments that no process can name
@@ -568,6 +583,9 @@ class _BufferedPrefetchIter:
         n_slots = max(4, loader.num_workers * loader.prefetch_factor * 2)
         self.ring = native.StagingRing(n_slots=n_slots, slot_bytes=slot_bytes)
         self.meta_q: "queue.Queue" = queue.Queue()
+        # same contract as _ProcPrefetchIter: close() is reachable from
+        # the consumer and from GC-driven __del__ concurrently
+        self._close_lock = make_lock("dataloader.close")
         self._closed = False
         # the thread target closes over (inner, ring, meta_q) directly — NOT
         # self — so an abandoned iterator can be garbage-collected, firing
@@ -579,9 +597,10 @@ class _BufferedPrefetchIter:
 
     def close(self):
         """Unblock and tear down (also called on abandonment via __del__)."""
-        if self._closed:
-            return
-        self._closed = True
+        with self._close_lock:
+            if self._closed:
+                return
+            self._closed = True
         self.ring.close()  # unblocks a stager stuck waiting for a free slot
         with self.inner.cv:
             if self.inner.error is None:

@@ -54,10 +54,10 @@ from .metrics import (Counter, Gauge, Histogram, MetricRegistry,
                       SlidingWindowHistogram, get_registry, instrument_jit,
                       log_buckets, record_device_memory, set_trace_sink,
                       snapshot_delta)
-from .sanitizers import (HostTransferError, LockOrderError,
+from .sanitizers import (DataRaceError, HostTransferError, LockOrderError,
                          UseAfterDonateError, donation_sanitizer,
                          forbid_host_transfers, make_lock, make_rlock,
-                         sanitize_donation)
+                         race_sanitizer, sanitize_donation, share_object)
 from .tracing import (add_span, disable_tracing, enable_tracing, end_span,
                       span, start_span, tracing_enabled)
 
@@ -70,7 +70,9 @@ __all__ = ["MetricRegistry", "Counter", "Gauge", "Histogram",
            "get_flight_recorder", "start_introspection_server",
            "forbid_host_transfers", "make_lock", "make_rlock",
            "sanitize_donation", "donation_sanitizer",
+           "race_sanitizer", "share_object",
            "HostTransferError", "LockOrderError", "UseAfterDonateError",
+           "DataRaceError",
            "InjectedFault", "faults", "flight", "sanitizers", "tracing"]
 
 

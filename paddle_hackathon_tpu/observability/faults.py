@@ -60,6 +60,8 @@ import threading
 import time
 from typing import Dict, Optional
 
+from .sanitizers import make_lock
+
 __all__ = ["InjectedFault", "FaultSpecError", "point", "arm", "arm_point",
            "disarm", "injected", "hits", "armed"]
 
@@ -105,7 +107,9 @@ class _Fault:
         self.hits = 0
         self.fired = 0
         self._rng = random.Random(int(seed))
-        self._lock = threading.Lock()
+        # make_lock: every lock in the process must be visible to the
+        # lock-order and race sanitizers (PHT009 sweep)
+        self._lock = make_lock("faults.spec")
 
     def fire(self) -> None:
         with self._lock:

@@ -25,7 +25,7 @@ import threading
 import time
 from typing import Optional
 
-from .sanitizers import make_lock
+from .sanitizers import make_lock, share_object
 
 __all__ = ["FlightRecorder", "get_flight_recorder", "crash_dump"]
 
@@ -45,6 +45,9 @@ class FlightRecorder:
         self._buf = collections.deque(maxlen=int(capacity))
         self._lock = make_lock("flight.recorder")
         self._dropped = 0
+        # every subsystem records into this ring from its own thread:
+        # lockset-checked under the race sanitizer, untouched otherwise
+        share_object(self, "flight.recorder")
 
     @property
     def capacity(self) -> int:

@@ -29,7 +29,7 @@ import threading
 import time
 from typing import Dict, Iterable, Optional, Tuple
 
-from .sanitizers import make_lock
+from .sanitizers import make_lock, share_object
 
 __all__ = ["MetricRegistry", "Counter", "Gauge", "Histogram",
            "SlidingWindowHistogram", "get_registry", "instrument_jit",
@@ -408,6 +408,12 @@ class MetricRegistry:
         self.enabled = bool(enabled)
         self._families: Dict[str, _Family] = {}
         self._lock = make_lock("metrics.registry")
+        # scraped/updated from every subsystem's threads: declared
+        # shared for the race sanitizer (zero cost when off).  atomic:
+        # `enabled` is a single GIL-atomic flag read on every metric
+        # update — the designed lock-free hot path (its writers,
+        # enable()/disable(), are test/setup-time operations).
+        share_object(self, "metrics.registry", atomic=("enabled",))
 
     # -- lifecycle ---------------------------------------------------------
     def enable(self):

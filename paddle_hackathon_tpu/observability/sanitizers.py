@@ -6,7 +6,7 @@ conservative — it can only see acquisition orders the AST spells out.
 These sanitizers are the dynamic half: they watch what the process
 *actually does* and fail fast, with stacks, at the first violation.
 
-Three tools (catalog and env flags: ``docs/STATIC_ANALYSIS.md``):
+Four tools (catalog and env flags: ``docs/STATIC_ANALYSIS.md``):
 
 - :func:`make_lock` / :func:`make_rlock` — drop-in lock constructors the
   concurrent subsystems (serving engine, metric registry, tracing,
@@ -45,6 +45,16 @@ Three tools (catalog and env flags: ``docs/STATIC_ANALYSIS.md``):
   ``np.asarray``, which NumPy routes through the C buffer protocol.
   That one CPU blind spot is closed statically by pht-lint's
   np.asarray-on-Array taint rule.
+
+- :func:`share_object` / :func:`race_sanitizer` /
+  ``PHT_RACE_SANITIZER=1`` — Eraser-style lockset checking over
+  declared-shared objects (serving engine, metric registry, flight
+  ring, dataloader prefetch state, TCPStore client): per attribute,
+  the (thread, held-lockset) of every access is recorded — riding the
+  lock sanitizer's per-thread bookkeeping — and a write/write or
+  read/write pair with an EMPTY lockset intersection raises
+  :class:`DataRaceError` carrying both access stacks and both
+  locksets.  Static counterpart: pht-lint PHT009/PHT010.
 """
 
 from __future__ import annotations
@@ -55,6 +65,7 @@ import os
 import sys
 import threading
 import traceback
+import weakref
 from typing import Dict, List, Optional, Tuple
 
 
@@ -78,11 +89,14 @@ def _fmt_stack(summary) -> str:
     return "".join(summary.format())
 
 __all__ = ["LockOrderError", "HostTransferError", "UseAfterDonateError",
+           "DataRaceError",
            "make_lock", "make_rlock", "lock_sanitizer",
            "lock_sanitizer_enabled", "reset_lock_graph",
            "forbid_host_transfers", "sanitize_donation",
            "donation_sanitizer", "donation_sanitizer_enabled",
-           "reset_donation_registry"]
+           "reset_donation_registry",
+           "race_sanitizer", "race_sanitizer_enabled", "share_object",
+           "reset_race_registry"]
 
 _ENV_FLAG = "PHT_LOCK_SANITIZER"
 
@@ -90,6 +104,13 @@ _ENV_FLAG = "PHT_LOCK_SANITIZER"
 class LockOrderError(RuntimeError):
     """Two locks were acquired in an order that cycles against an order
     already observed — a latent deadlock, reported deterministically."""
+
+
+class DataRaceError(RuntimeError):
+    """Two threads accessed the same declared-shared attribute (at least
+    one a write) with NO common lock held — the Eraser lockset
+    discipline, violated.  The message carries BOTH access stacks and
+    the lockset each held."""
 
 
 class HostTransferError(RuntimeError):
@@ -127,8 +148,15 @@ def lock_sanitizer_enabled() -> bool:
     Checked at lock *creation* time: a lock built while the sanitizer is
     off stays a plain ``threading.Lock`` forever (that is the zero-cost
     contract), so enable the sanitizer *before* constructing the engine
-    / registry / loader under test."""
-    return _forced > 0 or os.environ.get(_ENV_FLAG, "") not in ("", "0")
+    / registry / loader under test.
+
+    The RACE sanitizer implies lock instrumentation: its per-access
+    locksets ride the held-lock bookkeeping only instrumented locks
+    maintain, so ``PHT_RACE_SANITIZER=1`` (or ``race_sanitizer()``)
+    turns ``make_lock`` instrumentation on too."""
+    return _forced > 0 or _race_forced > 0 \
+        or os.environ.get(_ENV_FLAG, "") not in ("", "0") \
+        or os.environ.get(_RACE_ENV, "") not in ("", "0")
 
 
 @contextlib.contextmanager
@@ -686,3 +714,280 @@ def forbid_host_transfers():
                 _unpatch_cpu_dunders()
         else:
             yield
+
+
+# ---------------------------------------------------------------------------
+# data-race sanitizer (the dynamic half of pht-lint PHT009/PHT010)
+# ---------------------------------------------------------------------------
+#
+# Eraser-style lockset checking over DECLARED-SHARED objects.  The
+# concurrent subsystems (serving engine, metric registry, flight ring,
+# dataloader prefetch state, TCPStore client) call
+# ``share_object(self, label, atomic=(...))`` at the end of __init__:
+#
+# - Off (the default): ``share_object`` returns the object UNCHANGED —
+#   not a wrapper, not a class swap, zero cost (the make_lock contract,
+#   decided at declaration).
+# - On (``PHT_RACE_SANITIZER=1`` at declaration, or under the
+#   ``race_sanitizer()`` context in tests): the object's class is
+#   swapped to a cached shim subclass whose ``__getattribute__``/
+#   ``__setattr__`` record, per (object, attribute), the accessing
+#   thread and the LOCKSET it held — riding the per-thread held-lock
+#   bookkeeping the lock sanitizer already maintains (which is why the
+#   race flag implies make_lock instrumentation).
+#
+# Per attribute the classic Eraser state machine runs: exclusive to the
+# first thread (init writes are free), ONE silent ownership transfer
+# (the engine's publish-then-hand-to-driver pattern), then shared —
+# where the candidate lockset is intersected at every access and a
+# write/write or read/write pair whose intersection is EMPTY raises
+# :class:`DataRaceError` naming both access stacks and both locksets.
+# ``atomic=`` names attributes exempted per the gil-atomic contract
+# (single aligned read / single ``+=`` bump — the runtime mirror of the
+# static ``# pht-lint: gil-atomic`` annotation).
+#
+# Granularity is the ATTRIBUTE BINDING: in-place container mutation
+# (``self.d[k] = v``) reads the attribute, so the checker sees a read —
+# rebinding races and scalar/flag races are caught, element races
+# inside a shared dict are not (the static rules and the lock-order
+# sanitizer carry those).
+
+_RACE_ENV = "PHT_RACE_SANITIZER"
+_race_forced = 0                 # race_sanitizer() nesting count
+# RLock, deliberately: registrations hold weakrefs whose GC callback
+# (_race_drop) re-acquires this lock to prune — an allocation inside a
+# _race_access critical section can trigger that GC on the SAME
+# thread, which would deadlock a plain Lock
+_race_lock = threading.RLock()   # guards _race_table/_race_objects
+# id(obj) -> (weakref-to-obj, label, frozenset(atomic), original class).
+# WEAK refs: in env-flag mode the sanitizer is armed for the process
+# lifetime, and per-epoch objects (a fresh dataloader _PrefetchIter
+# every epoch) must not accumulate — the ref's GC callback prunes the
+# object's registry and per-attribute entries.
+_race_objects: Dict[int, Tuple[object, str, frozenset, type]] = {}
+# (id(obj), attr) -> _RaceEntry
+_race_table: Dict[Tuple[int, str], "_RaceEntry"] = {}
+_race_env_armed = False
+_shim_cache: Dict[type, type] = {}
+
+# threading primitives living in instance dicts are synchronization
+# OBJECTS, not shared data: accessing them lock-free is the discipline
+_LOCKISH_TYPES = (type(threading.Lock()), type(threading.RLock()),
+                  threading.Condition, threading.Event,
+                  threading.Semaphore, threading.BoundedSemaphore)
+
+
+def race_sanitizer_enabled() -> bool:
+    """True when :func:`share_object` should instrument.  Checked at
+    declaration time (the zero-cost-off contract): enable before
+    constructing the objects under test."""
+    return _race_forced > 0 or \
+        os.environ.get(_RACE_ENV, "") not in ("", "0")
+
+
+class _RaceEntry:
+    __slots__ = ("owner", "state", "lockset", "last", "handoffs")
+    # state: 0 exclusive / 1 shared (reads) / 2 shared-modified
+
+    def __init__(self, owner):
+        # owner is the THREAD OBJECT, compared by identity — raw
+        # thread idents are recycled the moment a thread exits, so an
+        # ident-keyed owner mistakes a brand-new thread for the
+        # exclusive owner and silently skips the shared transition
+        # (observed: the seeded-race tests passed standalone and went
+        # quiet mid-suite, where ident reuse is routine).  The strong
+        # ref pins the Thread object, making identity unambiguous.
+        self.owner = owner
+        self.state = 0
+        self.lockset = None      # set of lock ids once shared
+        self.last = None         # (thread, name, kind, lock_names,
+        #                           lock_ids, stack)
+        self.handoffs = 0
+
+
+def _held_lockset():
+    held = _held_map.get(threading.get_ident(), ())
+    return (frozenset(id(lk) for lk, _, _ in held),
+            tuple(nm for _, nm, _ in held))
+
+
+def _race_drop(oid: int) -> None:
+    """Weakref GC callback: a shared object died — prune its registry
+    row and every per-attribute entry (env-flag mode runs for the
+    process lifetime; per-epoch objects must not accumulate)."""
+    with _race_lock:
+        _race_objects.pop(oid, None)
+        for key in [k for k in _race_table if k[0] == oid]:
+            del _race_table[key]
+
+
+def _race_access(obj, name, kind):
+    rec = _race_objects.get(id(obj))
+    if rec is None or rec[0]() is not obj or name in rec[2]:
+        return
+    lock_ids, lock_names = _held_lockset()
+    me = threading.current_thread()
+    # stack captured per access: it is the evidence a later conflicting
+    # access reports — sanitizer-mode-only cost, lookup_lines deferred
+    stack = _capture_stack(skip=3)
+    acc = (me, me.name, kind, lock_names, lock_ids, stack)
+    with _race_lock:
+        ent = _race_table.get((id(obj), name))
+        if ent is None:
+            _race_table[(id(obj), name)] = ent = _RaceEntry(me)
+            ent.last = acc
+            return
+        prev = ent.last
+        ent.last = acc
+        if ent.state == 0:
+            if me is ent.owner:
+                return
+            if ent.handoffs == 0:
+                # publish-then-hand-off (the init thread constructs,
+                # ONE worker takes over): a single silent ownership
+                # transfer, still exclusive — the single-driver engine
+                # pattern would otherwise false-alarm on every attr
+                ent.handoffs = 1
+                ent.owner = me
+                return
+            # a third party (or the first thread returning): genuinely
+            # shared — the candidate lockset starts as the intersection
+            # of the two accesses that made it shared
+            ent.lockset = set(prev[4] & lock_ids)
+            ent.state = 2 if (kind == "write" or prev[2] == "write") else 1
+        else:
+            ent.lockset &= lock_ids
+            if kind == "write":
+                ent.state = 2
+        if ent.state == 2 and not ent.lockset \
+                and (kind == "write" or prev[2] == "write"):
+            raise DataRaceError(_race_report(rec[1], name, prev, ent.last))
+
+
+def _fmt_lockset(names) -> str:
+    return "{" + ", ".join(sorted(names)) + "}" if names else "{} (none)"
+
+
+def _race_report(label, name, a, b) -> str:
+    def side(tag, acc):
+        tid, tname, kind, lock_names, _ids, stack = acc
+        return (f"{tag}: {kind} by thread {tname!r} holding "
+                f"{_fmt_lockset(lock_names)}\n{_fmt_stack(stack)}")
+    return (f"data race on `{label}.{name}`: two threads accessed it "
+            f"(at least one write) with NO common lock held — the "
+            f"lockset intersection is empty (Eraser discipline, "
+            f"pht-lint PHT009)\n"
+            f"{side('earlier access', a)}\n{side('this access', b)}\n"
+            f"fix: guard every access with one lock (make_lock), or — "
+            f"for a single GIL-atomic counter read/bump — declare the "
+            f"attribute in share_object(atomic=...) and annotate the "
+            f"static access `# pht-lint: gil-atomic`")
+
+
+def _make_shim(cls: type) -> type:
+    shim = _shim_cache.get(cls)
+    if shim is not None:
+        return shim
+
+    def __getattribute__(self, name):
+        if name[:2] != "__":
+            try:
+                d = object.__getattribute__(self, "__dict__")
+            except AttributeError:      # __slots__-only object
+                d = ()
+            if name in d:
+                _race_access(self, name, "read")
+        return object.__getattribute__(self, name)
+
+    def __setattr__(self, name, value):
+        if name[:2] != "__" and not isinstance(value, _LOCKISH_TYPES) \
+                and not isinstance(value, _SanitizedLock):
+            _race_access(self, name, "write")
+        object.__setattr__(self, name, value)
+
+    shim = type(f"_RaceShim_{cls.__name__}", (cls,), {
+        "__getattribute__": __getattribute__,
+        "__setattr__": __setattr__,
+        "__module__": cls.__module__,
+    })
+    _shim_cache[cls] = shim
+    return shim
+
+
+def share_object(obj, label: str, atomic=()):
+    """Declare ``obj`` shared-between-threads for the race sanitizer.
+
+    Disabled (the default): returns ``obj`` unchanged — zero cost, not
+    even a class swap.  Enabled: swaps in a shim subclass recording
+    (thread, held-lockset) per attribute access and raising
+    :class:`DataRaceError` on an empty-intersection write/write or
+    read/write pair.  ``atomic`` names attributes exempt per the
+    GIL-atomic contract (mirror of ``# pht-lint: gil-atomic``)."""
+    if not race_sanitizer_enabled():
+        return obj
+    global _race_env_armed
+    if _race_forced == 0:
+        _race_env_armed = True    # env-flag mode: process-lifetime
+    cls = type(obj)
+    orig = cls
+    if cls.__name__.startswith("_RaceShim_"):   # already shimmed
+        return obj
+    try:
+        obj.__class__ = _make_shim(cls)
+    except TypeError:
+        # __slots__/extension classes can't swap: skip, stay plain
+        return obj
+    # skip attrs already holding locks at declaration (scan once)
+    skip = set(atomic)
+    for k, v in list(getattr(obj, "__dict__", {}).items()):
+        if isinstance(v, _LOCKISH_TYPES) or isinstance(v, _SanitizedLock):
+            skip.add(k)
+    oid = id(obj)
+    try:
+        ref = weakref.ref(obj, lambda _r, oid=oid: _race_drop(oid))
+    except TypeError:
+        # un-weakref-able (slots without __weakref__): pin it — rare,
+        # and none of the in-repo shared classes hit this
+        ref = (lambda o=obj: o)
+    with _race_lock:
+        _race_objects[oid] = (ref, label, frozenset(skip), orig)
+    return obj
+
+
+def reset_race_registry() -> None:
+    """Restore every (live) shared object's original class and drop all
+    per-attribute state (test isolation; env-mode disarm for tests)."""
+    with _race_lock:
+        for ref, _, _, orig in list(_race_objects.values()):
+            obj = ref()
+            if obj is None:
+                continue
+            try:
+                obj.__class__ = orig
+            except TypeError:
+                pass
+        _race_objects.clear()
+        _race_table.clear()
+
+
+def _reset_race_sanitizer_for_tests() -> None:
+    global _race_env_armed
+    _race_env_armed = False
+    reset_race_registry()
+
+
+@contextlib.contextmanager
+def race_sanitizer():
+    """Force-enable :func:`share_object` (and, implicitly, make_lock
+    instrumentation — the locksets ride the lock sanitizer's held-lock
+    bookkeeping) for this block.  Construct the engine/loader/registry
+    under test INSIDE the block; exiting restores every shared object's
+    original class and clears the race state."""
+    global _race_forced
+    _race_forced += 1
+    try:
+        yield
+    finally:
+        _race_forced -= 1
+        if _race_forced == 0 and not _race_env_armed:
+            reset_race_registry()

@@ -13,13 +13,14 @@ launcher/elastic heartbeats, parameter-server discovery, and tests.
 
 from __future__ import annotations
 
+import contextlib
 import ctypes
 import os
-import threading
 import time
 from typing import Optional
 
 from ..core import native as _native
+from ..observability.sanitizers import make_lock, share_object
 
 __all__ = ["TCPStore", "MasterStore"]
 
@@ -49,13 +50,16 @@ class TCPStore:
         # protocol has no framing for interleaved requests, so concurrent
         # callers (e.g. an elastic heartbeat thread + a membership watcher)
         # must serialize on the client.
-        self._lock = threading.Lock()
+        self._lock = make_lock("store.client")
         self._client = lib.pht_store_connect(
             host.encode(), port, int(timeout * 1000))
         if not self._client:
             self.close()
             raise TimeoutError(f"could not connect to store {host}:{port}")
         self.timeout = timeout
+        # heartbeat/watcher threads share one client: declared for the
+        # race sanitizer (zero cost when off)
+        share_object(self, "parallel.store")
 
     # -- KV ops -------------------------------------------------------------
     def set(self, key: str, value) -> None:
@@ -135,12 +139,21 @@ class TCPStore:
         self.wait(f"__barrier/{name}/done", timeout=timeout)
 
     def close(self) -> None:
-        if getattr(self, "_client", None):
-            self._lib.pht_store_disconnect(self._client)
-            self._client = None
-        if getattr(self, "_server", None):
-            self._lib.pht_store_server_stop(self._server)
-            self._server = None
+        # under the client lock: close() racing an in-flight get()/add()
+        # on another thread (an elastic heartbeat mid-poll while the
+        # watcher tears down) would otherwise null _client between the
+        # caller's check and its native call — a use-after-free in the
+        # C client.  The early-__init__ failure path closes before the
+        # lock exists, hence the getattr.
+        lk = getattr(self, "_lock", None)
+        ctx = lk if lk is not None else contextlib.nullcontext()
+        with ctx:
+            if getattr(self, "_client", None):
+                self._lib.pht_store_disconnect(self._client)
+                self._client = None
+            if getattr(self, "_server", None):
+                self._lib.pht_store_server_stop(self._server)
+                self._server = None
 
     def __del__(self):
         try:

@@ -25,6 +25,7 @@ from enum import Enum
 from typing import Callable, Iterable, Optional
 
 from ..core import autograd as _autograd
+from ..observability.sanitizers import make_lock
 
 __all__ = ["Profiler", "ProfilerState", "ProfilerTarget", "make_scheduler",
            "export_chrome_tracing", "export_protobuf", "RecordEvent",
@@ -107,7 +108,9 @@ class _Recorder:
     def __init__(self):
         self.events = []
         self.counters = []   # (name, labels_tuple, value, t_ns) samples
-        self._lock = threading.Lock()
+        # make_lock, not threading.Lock: the lock-order and race
+        # sanitizers must see every lock in the process (PHT009 sweep)
+        self._lock = make_lock("profiler.recorder")
         self.active = False
 
     def add(self, ev: _HostEvent):

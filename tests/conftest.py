@@ -76,15 +76,17 @@ _FILE_COST = {
     "test_tracing.py": 8,   # span/flight/server units; engine runs are slow-marked
     "test_slo.py": 12,      # window/beacon/healthz units + ONE tiny engine
                             # run (lifecycle + /load golden) + one tiny fit
-    "test_lint.py": 12,     # pure AST; repo-wide walks (PHT001-008)
-                            # dominate — re-measured after the flow rules
-                            # landed (tools/test_budget.py caught the 7s
-                            # entry going stale)
+    "test_lint.py": 14,     # pure AST; repo-wide walks dominate —
+                            # re-measured after PHT009/PHT010 landed
+                            # (the early-exit pass optimizations paid
+                            # for the two new rules, but the extra
+                            # fixture/stats tests add ~2s)
     "test_checkpointing.py": 8,   # host-only protocol/fault units
     "test_zero_sharded.py": 6,    # spec/update units + 2 tiny jits;
                                   # fit/Engine drills are slow-marked
     "test_crash_drill.py": 1,     # fully slow-marked (subprocess drills)
-    "test_sanitizers.py": 3,  # lock/guard units; engine runs are slow-marked
+    "test_sanitizers.py": 5,  # lock/guard/race units + one thread-only
+                              # dataloader epoch; engine runs slow-marked
     "test_paged.py": 16,    # allocator units + 2 tiny-GPT engine runs
     "test_quant_serving.py": 12,  # kernel/quantizer units + 2 tiny fwd
                                   # compiles; engine runs are slow-marked

@@ -1,0 +1,107 @@
+"""Seeded PHT009 violations: unguarded access to lock-guarded shared
+state from thread-entry-reachable code — plus the negative shapes that
+must stay clean (gil-atomic annotated counters, attributes only ever
+touched pre-thread-start, access under a different-but-held lock, and
+functions only ever reached with the lock held)."""
+
+import threading
+
+from paddle_hackathon_tpu.observability.sanitizers import make_lock
+
+
+class Dispatcher:
+    """The router shape: a dispatch loop thread + caller-facing API."""
+
+    def __init__(self):
+        self._lock = make_lock("fixture.dispatcher")
+        self.replicas = {}
+        self.inflight = 0
+        self.ticks = 0
+        self.config_mode = "dense"   # written here only: pre-start, clean
+
+    def start(self):
+        t = threading.Thread(target=self._loop, daemon=True)
+        t.start()
+
+    def admit(self, rid, replica):
+        with self._lock:
+            self.replicas[rid] = replica
+            self.inflight += 1
+            self.ticks += 1
+
+    def _loop(self):
+        while True:
+            n = len(self.replicas)           # expect: PHT009
+            self.inflight -= n               # expect: PHT009
+            self.ticks += 1  # pht-lint: gil-atomic (claimed single bump)
+            mode = self.config_mode          # never lock-guarded: clean
+            if mode == "dense":
+                self._scan()
+            with self._lock:
+                self.replicas.clear()        # under the lock: clean
+                self._locked_scan()
+
+    def _scan(self):
+        # reached lock-free from the _loop entry: flagged here too
+        return sorted(self.replicas)         # expect: PHT009
+
+    def _locked_scan(self):
+        # only ever called WITH the lock held: clean
+        return len(self.replicas)
+
+
+class PoolUser:
+    """executor.submit(fn) is a thread entry too."""
+
+    def __init__(self, pool):
+        self.pool = pool
+        self._lock = make_lock("fixture.pool")
+        self.results = {}
+
+    def kick(self):
+        self.pool.submit(self._work)
+
+    def record(self, k, v):
+        with self._lock:
+            self.results[k] = v
+
+    def _work(self):
+        return list(self.results)            # expect: PHT009
+
+
+class DebugHandler:
+    """do_GET runs on the HTTP server's handler thread."""
+
+    def __init__(self):
+        self._lock = make_lock("fixture.handler")
+        self.snapshot = {}
+
+    def refresh(self):
+        with self._lock:
+            self.snapshot = {"ts": 1}
+
+    def do_GET(self):
+        return dict(self.snapshot)           # expect: PHT009
+
+
+class HandoffPair:
+    """Access under a DIFFERENT (but held) recognized lock is NOT
+    flagged: the static model is coarse ('some lock held') — the
+    runtime race sanitizer's lockset intersection is the precise
+    check that would catch a genuinely wrong lock."""
+
+    def __init__(self):
+        self._a = make_lock("fixture.a")
+        self._b = make_lock("fixture.b")
+        self.shared = []
+
+    def start(self):
+        threading.Thread(target=self._drain, daemon=True).start()
+
+    def fill(self):
+        with self._a:
+            self.shared.append(1)
+
+    def _drain(self):
+        with self._b:
+            self.shared.pop()                # held lock (coarse): clean

@@ -1,0 +1,92 @@
+"""Seeded PHT010 check-then-act violations: a decision derived from
+lock-guarded state under the lock, acted on after release — plus the
+clean shapes (act under the same lock, snapshot-and-report with no act,
+decision rebound before the test)."""
+
+from paddle_hackathon_tpu.observability.sanitizers import make_lock
+
+
+class Router:
+    def __init__(self, max_slots):
+        self._lock = make_lock("fixture.router")
+        self._stats_lock = make_lock("fixture.stats")
+        self.max_slots = max_slots
+        self.active = {}
+        self.queue = []
+        self.hist = None
+
+    def enqueue(self, rid):
+        with self._lock:
+            self.queue.append(rid)
+
+    def admit_bad(self, rid):
+        with self._lock:
+            free = self.max_slots - len(self.active)
+        if free > 0:                         # expect: PHT010
+            with self._lock:
+                self.active[rid] = True
+
+    def dispatch_bad(self):
+        with self._lock:
+            empty = not self.queue
+        if not empty:                        # expect: PHT010
+            return self.queue.pop(0)
+        return None
+
+    def admit_good(self, rid):
+        with self._lock:
+            if self.max_slots - len(self.active) > 0:
+                self.active[rid] = True      # act under the SAME lock
+
+    def report_good(self):
+        with self._lock:
+            depth = len(self.queue)
+        if depth > 10:                       # snapshot-and-report: no act
+            return "overloaded"
+        return "ok"
+
+    def rebound_good(self, rid):
+        with self._lock:
+            free = self.max_slots - len(self.active)
+        free = 0                             # rebound: stale value gone
+        if free > 0:
+            with self._lock:
+                self.active[rid] = True
+
+    def loop_target_good(self, snapshot):
+        with self._lock:
+            free = self.max_slots - len(self.active)
+        for free in snapshot:                # for-target rebind kills it
+            if free:
+                with self._lock:
+                    self.active[free] = True
+
+    def unpack_rebound_good(self, pair):
+        with self._lock:
+            empty = not self.queue
+        empty, _other = pair                 # tuple rebind kills it
+        if not empty:
+            return self.queue.pop(0)
+        return None
+
+    def report_unrelated_lock_good(self):
+        with self._lock:
+            depth = len(self.queue)
+        if depth > 10:
+            # the helper takes an UNRELATED lock and touches no guarded
+            # state — reporting is not an act on the checked decision
+            self._note_overload()
+        return depth
+
+    def _note_overload(self):
+        with self._stats_lock:
+            self.hist.observe(1)
+
+    def relocked_rebind_good(self, rid):
+        with self._lock:
+            free = self.max_slots - len(self.active)
+        with self._lock:
+            free, _n = 0, 1                  # tuple rebind under a later lock
+        if free > 0:
+            with self._lock:
+                self.active[rid] = True

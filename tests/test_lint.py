@@ -56,7 +56,9 @@ def _actual(path):
                                   "pht005_labels.py",
                                   "pht006_donation.py",
                                   "pht007_tracer.py",
-                                  "pht008_specs.py"])
+                                  "pht008_specs.py",
+                                  "pht009_races.py",
+                                  "pht010_checkact.py"])
 def test_seeded_violations_detected_at_exact_lines(name):
     """Every seeded violation fires at the exact file:line — and ONLY
     there (the Counter equality also rejects extra findings, so the
@@ -86,8 +88,12 @@ def test_fixture_findings_carry_func_and_hint():
 def test_repo_wide_lint_is_clean():
     """THE gate: zero unsuppressed findings across the package, tools
     and bench driver, and zero unused baseline entries (a fixed finding
-    must take its suppression with it)."""
-    findings, suppressed, unused = run_lint()
+    must take its suppression with it).  The same walk feeds the
+    --stats plumbing and the wall-time budget: the linter itself rides
+    the tier-1 suite, so rule growth must not silently blow the budget
+    (tier-1 already overruns 870s — tools/test_budget.py workflow)."""
+    stats = {}
+    findings, suppressed, unused = run_lint(stats=stats)
     assert findings == [], "unsuppressed pht-lint findings:\n" + "\n".join(
         f.render() for f in findings)
     assert unused == [], f"stale baseline entries (fixed? delete them): " \
@@ -96,6 +102,20 @@ def test_repo_wide_lint_is_clean():
     # a rename that silently drops a root would turn PHT001 off there
     assert any(f.rule == "PHT001" for f in suppressed), \
         "no PHT001 suppressions: did the hot-root annotations vanish?"
+    # stats shape: every pass timed, every rule counted (incl. the new
+    # PHT009/PHT010), and the whole-scope walk within its ~10s budget
+    assert set(stats["passes"]) == {"rules", "flow", "races", "locks"}
+    for rule in ("PHT001", "PHT003", "PHT006", "PHT009", "PHT010"):
+        assert rule in stats["rule_counts"], stats["rule_counts"]
+    assert stats["files"] > 100   # whole scope, not a partial walk
+    # budget on process-CPU seconds, not wall: the walk is
+    # single-threaded pure CPU, so cpu_s == wall on an idle box but —
+    # unlike wall — does not flake when the (already over-budget)
+    # tier-1 suite shares the box with other load
+    assert stats["cpu_s"] < 10.0, (
+        f"repo-wide pht-lint burned {stats['cpu_s']:.1f} CPU-s — over "
+        "the ~10s budget; profile the passes (python -m tools.pht_lint "
+        f"--stats) and make the slow rule leaner: {stats['passes']}")
 
 
 def test_default_scope_covers_the_hot_modules():
@@ -195,6 +215,55 @@ def test_spec_drift_resolves_create_mesh_axes(tmp_path):
     assert "tp" in findings[0].message
 
 
+# --------------------------------------------- PHT009/PHT010 (races)
+def test_serving_tickno_annotation_is_load_bearing(tmp_path):
+    """The `# pht-lint: gil-atomic` claims on serving.py's driver-only
+    _tickno reads are WHY the repo-wide lint is clean: strip one and
+    PHT009 must fire on that exact read (the annotation is a reviewed
+    contract, not a comment)."""
+    src = open(os.path.join(ROOT, "paddle_hackathon_tpu", "inference",
+                            "serving.py"), encoding="utf-8").read()
+    marker = "np.int32(self._tickno), **self._pt_kw())  # pht-lint: gil-atomic"
+    broken = src.replace(
+        marker, "np.int32(self._tickno), **self._pt_kw())", 1)
+    assert broken != src, "tickno annotation moved — update this test"
+    p = tmp_path / "serving.py"
+    p.write_text(broken)
+    findings, _, _ = run_lint(paths=[str(p)], baseline_path=None,
+                              repo_root=str(tmp_path))
+    assert any(f.rule == "PHT009" and "_tickno" in f.message
+               for f in findings), [f.render() for f in findings]
+    # and the shipped file is PHT009-clean (the repo-wide gate pins the
+    # rest of the scope; this pins the specific file the rule targets)
+    ok, _, _ = run_lint(paths=[os.path.join(
+        ROOT, "paddle_hackathon_tpu", "inference", "serving.py")],
+        baseline_path=None)
+    assert not any(f.rule in ("PHT009", "PHT010") for f in ok), \
+        [f.render() for f in ok if f.rule in ("PHT009", "PHT010")]
+
+
+def test_cli_stats_text(capsys):
+    rc = lint_main([os.path.join(FIXTURES, "pht009_races.py"),
+                    "--no-baseline", "--stats"])
+    assert rc == 1
+    out = capsys.readouterr().out
+    assert "pht-lint stats:" in out
+    assert "PHT009=5" in out
+    assert "pass races" in out
+
+
+def test_cli_stats_json(capsys):
+    import json
+    rc = lint_main([os.path.join(FIXTURES, "pht010_checkact.py"),
+                    "--no-baseline", "--format", "json", "--stats"])
+    assert rc == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["stats"]["rule_counts"]["PHT010"] == 2
+    assert set(doc["stats"]["passes"]) == {"rules", "flow", "races",
+                                           "locks"}
+    assert doc["stats"]["files"] == 1
+
+
 # ------------------------------------------------------------ baseline
 def test_baseline_entries_all_have_reasons():
     entries = load_baseline(DEFAULT_BASELINE)
@@ -239,6 +308,33 @@ def test_baseline_suppresses_matching_findings(tmp_path):
                                           "nested_scope",
                                           "nested_scope.inner"}
     assert unused == []
+
+
+def test_baseline_matching_and_unused_detection_cover_race_rules(tmp_path):
+    """PHT009/PHT010 suppressions ride the same (rule, file, func)
+    matching and unused-entry detection as PHT001-008 — and the same
+    reason-required strictness (the loader is rule-agnostic, this pins
+    that the NEW rules' findings actually match entries)."""
+    fixture = os.path.join(FIXTURES, "pht009_races.py")
+    p = tmp_path / "b.toml"
+    p.write_text(
+        '[[suppress]]\nrule = "PHT009"\n'
+        'file = "tests/fixtures/lint/pht009_races.py"\n'
+        'func = "Dispatcher._loop"\n'
+        'reason = "seeded fixture; invariant: the loop thread is the '
+        'only mutator of replicas/inflight"\n'
+        '[[suppress]]\nrule = "PHT010"\n'
+        'file = "never/was.py"\nfunc = "g"\nreason = "obsolete"\n')
+    findings, suppressed, unused = run_lint(paths=[fixture],
+                                            baseline_path=str(p))
+    assert {f.func for f in suppressed} == {"Dispatcher._loop"}
+    assert all(f.rule == "PHT009" for f in suppressed)
+    # findings in other functions stay unsuppressed...
+    assert {f.func for f in findings} == {"Dispatcher._scan",
+                                          "PoolUser._work",
+                                          "DebugHandler.do_GET"}
+    # ...and the stale PHT010 entry is detected as unused
+    assert [e["rule"] for e in unused] == ["PHT010"]
 
 
 def test_unused_baseline_entry_is_reported(tmp_path):

@@ -549,6 +549,210 @@ def test_wrapper_outliving_its_context_is_a_plain_call():
             g(s2, jnp.ones((4,)))
 
 
+# ------------------------------------------------- race sanitizer
+class _SharedBox:
+    def __init__(self):
+        self.val = 0
+        self.flag = False
+
+
+def test_share_object_disabled_is_zero_cost_plain_object():
+    """The make_lock contract: off (default) returns the object
+    UNCHANGED — same identity, same class, no shim."""
+    assert not S.race_sanitizer_enabled()
+    b = _SharedBox()
+    out = S.share_object(b, "unit.box", atomic=("val",))
+    assert out is b
+    assert type(out) is _SharedBox
+
+
+def test_seeded_write_write_race_cites_both_stacks_and_locksets():
+    """THE report-quality pin (acceptance criterion): a seeded
+    write/write race raises DataRaceError naming the shared attribute,
+    BOTH access stacks, and the lockset held at each access."""
+    with S.race_sanitizer():
+        box = S.share_object(_SharedBox(), "unit.box")
+        guard = S.make_lock("race.guard")
+
+        def locked_writer():
+            with guard:
+                box.val = 1
+
+        def unlocked_writer():
+            box.val = 2
+
+        for name in ("locked-1", "locked-2"):
+            th = threading.Thread(target=locked_writer, name=name)
+            th.start()
+            th.join(5)
+        errs = []
+
+        def racing():
+            try:
+                unlocked_writer()
+            except S.DataRaceError as e:
+                errs.append(e)
+        th = threading.Thread(target=racing, name="unlocked")
+        th.start()
+        th.join(5)
+        assert errs, "write/write with empty lockset intersection " \
+                     "must raise DataRaceError"
+        msg = str(errs[0])
+        assert "unit.box.val" in msg
+        assert "earlier access" in msg and "this access" in msg
+        assert "locked_writer" in msg      # the earlier side's stack...
+        assert "unlocked_writer" in msg    # ...and the racing side's
+        assert "race.guard" in msg         # the lockset held earlier
+        assert "(none)" in msg             # the empty lockset here
+        assert "PHT009" in msg             # points at the static rule
+
+
+def test_read_write_race_detected():
+    with S.race_sanitizer():
+        box = S.share_object(_SharedBox(), "unit.rw")
+        guard = S.make_lock("rw.guard")
+
+        def locked_reader():
+            with guard:
+                _ = box.val
+        for _ in range(2):
+            th = threading.Thread(target=locked_reader)
+            th.start()
+            th.join(5)
+        # the attribute is shared with lockset {rw.guard}; an unlocked
+        # write from a third thread empties the intersection
+        with pytest.raises(S.DataRaceError, match="unit.rw"):
+            box.val = 9
+
+
+def test_common_lock_discipline_is_clean():
+    with S.race_sanitizer():
+        box = S.share_object(_SharedBox(), "unit.clean")
+        guard = S.make_lock("clean.guard")
+        errs = []
+
+        def worker():
+            try:
+                for _ in range(20):
+                    with guard:
+                        box.val += 1
+            except BaseException as e:  # noqa: BLE001
+                errs.append(e)
+        threads = [threading.Thread(target=worker) for _ in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(5)
+        assert not errs, errs
+        with guard:
+            assert box.val == 60
+
+
+def test_publish_then_single_driver_is_clean():
+    """The engine pattern: the constructing thread publishes, ONE
+    driver thread then owns the attribute exclusively — the single
+    ownership handoff must not false-alarm."""
+    with S.race_sanitizer():
+        box = S.share_object(_SharedBox(), "unit.owner")
+        box.val = 1              # init-thread write
+        errs = []
+
+        def driver():
+            try:
+                for i in range(10):
+                    box.val = i      # handoff, then exclusive
+                    _ = box.val
+            except BaseException as e:  # noqa: BLE001
+                errs.append(e)
+        th = threading.Thread(target=driver)
+        th.start()
+        th.join(5)
+        assert not errs, errs
+
+
+def test_atomic_exemption_mirrors_gil_atomic():
+    """share_object(atomic=...) is the runtime half of the static
+    `# pht-lint: gil-atomic` annotation: exempted attrs never race,
+    everything else stays checked."""
+    with S.race_sanitizer():
+        box = S.share_object(_SharedBox(), "unit.at", atomic=("val",))
+
+        def bump():
+            box.val += 1
+        for _ in range(3):
+            th = threading.Thread(target=bump)
+            th.start()
+            th.join(5)
+        assert box.val == 3      # no raise: exempt
+        # the un-exempted attr still races
+
+        def flip():
+            box.flag = True
+        for _ in range(2):
+            th = threading.Thread(target=flip)
+            th.start()
+            th.join(5)
+        with pytest.raises(S.DataRaceError, match="unit.at.flag"):
+            box.flag = False
+
+
+def test_race_context_exit_restores_plain_objects():
+    with S.race_sanitizer():
+        box = S.share_object(_SharedBox(), "unit.restore")
+        assert type(box) is not _SharedBox     # shimmed while armed
+    assert type(box) is _SharedBox             # restored on exit
+    box.val = 5                                # plain write, no recording
+    assert not S.race_sanitizer_enabled()
+
+
+def test_race_env_flag_arms_at_declaration(monkeypatch):
+    """PHT_RACE_SANITIZER=1 enables share_object at declaration AND
+    implies lock instrumentation (the locksets ride make_lock's
+    held-lock bookkeeping)."""
+    monkeypatch.setenv("PHT_RACE_SANITIZER", "1")
+    try:
+        assert S.race_sanitizer_enabled()
+        assert S.lock_sanitizer_enabled()
+        lk = S.make_lock("env.race.lk")
+        assert type(lk) is not type(threading.Lock())
+        box = S.share_object(_SharedBox(), "env.box")
+        assert type(box) is not _SharedBox
+    finally:
+        S._reset_race_sanitizer_for_tests()
+    assert type(box) is _SharedBox
+
+
+def test_race_registry_does_not_pin_dead_objects():
+    """Env-flag mode runs for the process lifetime, and per-epoch
+    objects (a fresh prefetch iterator every epoch) must not accumulate:
+    the registry holds WEAK refs whose GC callback prunes the object's
+    row and per-attribute entries."""
+    import gc
+
+    from paddle_hackathon_tpu.observability.sanitizers import (
+        _race_objects, _race_table)
+    with S.race_sanitizer():
+        box = S.share_object(_SharedBox(), "unit.gc")
+        box.val = 1
+        oid = id(box)
+        assert oid in _race_objects
+        assert any(k[0] == oid for k in _race_table)
+        del box
+        gc.collect()
+        assert oid not in _race_objects
+        assert not any(k[0] == oid for k in _race_table)
+
+
+def test_dataloader_prefetch_epoch_under_race_sanitizer():
+    """Acceptance drive: a full thread-worker prefetch epoch (workers +
+    consumer + the cv handshake) with the prefetch iterator declared
+    shared — every cross-thread access lockset-checked, zero races."""
+    with S.race_sanitizer():
+        loader = io.DataLoader(_TinyDS(), batch_size=4, num_workers=2)
+        assert sum(1 for _ in loader) == 6
+        assert sum(1 for _ in loader) == 6   # second epoch, fresh iter
+
+
 # ----------------------------------------------- jaxcompat bridge canary
 def test_jaxcompat_bridges_survive_reseed():
     """core/jaxcompat.py has been WIPED by a re-seed before (PR 2 had to
@@ -750,3 +954,112 @@ def test_engine_loop_under_instrumented_locks():
                 assert len(o) == len(p) + 8
         finally:
             tracing._sources_lock = old
+
+
+@pytest.mark.slow
+def test_serving_runs_clean_under_race_sanitizer(monkeypatch):
+    """Acceptance drive: one dense steady-state run and one live
+    auto_run SPEC engine with concurrent submit / introspection /
+    load_report / expose_text, all under the race sanitizer — the
+    engine, a fresh process-wide registry and a fresh flight ring are
+    declared shared, so every cross-thread attribute access is
+    Eraser-lockset-checked.  A single unguarded access anywhere in the
+    engine/observability stack fails this test with both stacks."""
+    import paddle_hackathon_tpu.observability.flight as flight_mod
+    import paddle_hackathon_tpu.observability.metrics as metrics_mod
+    from paddle_hackathon_tpu.inference import ServingEngine
+    with S.race_sanitizer():
+        # fresh registry/flight constructed INSIDE the sanitizer so
+        # they are instrumented (the import-time singletons stay plain
+        # by the declaration-time zero-cost contract)
+        monkeypatch.setattr(metrics_mod, "_default_registry",
+                            metrics.MetricRegistry())
+        monkeypatch.setattr(flight_mod, "_default_recorder",
+                            flight.FlightRecorder(capacity=512))
+        m = _tiny_gpt()
+        # dense, synchronously driven
+        eng = ServingEngine(m, max_slots=2, max_len=64, chunk=4,
+                            auto_run=False)
+        prompts = _prompts()
+        reqs = [eng.submit(p, 8) for p in prompts]
+        eng.run_until_idle()
+        for p, r in zip(prompts, reqs):
+            assert len(r.result()) == len(p) + 8
+        eng.shutdown()
+        # spec, auto_run loop + concurrent readers
+        eng2 = ServingEngine(m, max_slots=2, max_len=64, chunk=4,
+                             auto_run=True, spec_k=2)
+        reg = metrics.get_registry()
+        stop = threading.Event()
+        errs = []
+
+        def reader():
+            try:
+                while not stop.is_set():
+                    eng2.introspect_requests()
+                    eng2.load_report()
+                    reg.expose_text()
+            except BaseException as e:  # noqa: BLE001
+                errs.append(e)
+        th = threading.Thread(target=reader, name="introspector")
+        th.start()
+        prompts = _prompts(4, (6, 9, 5, 11))
+        reqs = [eng2.submit(p, 8) for p in prompts]
+        for r in reqs:
+            assert r.wait(300), "request did not finish"
+        outs = [r.result() for r in reqs]
+        stop.set()
+        th.join(10)
+        eng2.shutdown()
+        assert not errs, errs
+        for p, o in zip(prompts, outs):
+            assert len(o) == len(p) + 8
+
+
+@pytest.mark.slow
+def test_compiled_trainer_superstep_under_race_sanitizer(monkeypatch):
+    """Acceptance drive: CompiledTrainer supersteps with the shared
+    registry/flight instrumented and a concurrent scraper hammering
+    expose_text — the trainer's telemetry writes are lockset-checked
+    against the scrape reads."""
+    import jax
+
+    import paddle_hackathon_tpu.observability.flight as flight_mod
+    import paddle_hackathon_tpu.observability.metrics as metrics_mod
+    from paddle_hackathon_tpu.hapi.compiled import CompiledTrainer
+    with S.race_sanitizer():
+        monkeypatch.setattr(metrics_mod, "_default_registry",
+                            metrics.MetricRegistry())
+        monkeypatch.setattr(flight_mod, "_default_recorder",
+                            flight.FlightRecorder(capacity=512))
+        paddle.seed(7)
+        net = nn.Sequential(nn.Linear(10, 32), nn.ReLU(),
+                            nn.Linear(32, 2))
+        mdl = hapi.Model(net)
+        mdl.prepare(optimizer=optim.Adam(learning_rate=1e-2,
+                                         parameters=net.parameters()),
+                    loss=nn.CrossEntropyLoss())
+        trainer = CompiledTrainer(mdl)
+        reg = metrics.get_registry()
+        fr = flight_mod.get_flight_recorder()
+        stop = threading.Event()
+        errs = []
+
+        def scraper():
+            try:
+                while not stop.is_set():
+                    reg.expose_text()
+                    fr.events()
+            except BaseException as e:  # noqa: BLE001
+                errs.append(e)
+        th = threading.Thread(target=scraper, name="scraper")
+        th.start()
+        rs = np.random.RandomState(0)
+        x = rs.randn(8, 10).astype(np.float32)
+        y = (x.sum(1) > 0).astype(np.int64)
+        for _ in range(2):
+            losses = trainer.run((x[None],), (y[None],))
+        stop.set()
+        th.join(10)
+        assert not errs, errs
+        assert np.isfinite(jax.device_get(losses)).all()

@@ -18,15 +18,27 @@ from __future__ import annotations
 import os
 import subprocess
 import sys
+import time
 from typing import Dict, List, Optional, Tuple
 
 from .callgraph import HOT_ROOT_MARK, ModuleInfo, index_module
 from .flow import lint_module_flow
+from .races import lint_module_races
 from .rules import Finding, lint_locks, lint_module
 
 __all__ = ["Finding", "run_lint", "load_baseline", "default_paths",
            "changed_paths", "BaselineError", "REPO_ROOT",
-           "DEFAULT_BASELINE", "HOT_ROOT_MARK"]
+           "DEFAULT_BASELINE", "HOT_ROOT_MARK", "PASS_RULES"]
+
+# pass name -> the rules it produces (stats attribution: rules sharing
+# one AST walk share one honest wall-time bucket instead of a made-up
+# per-rule split)
+PASS_RULES = {
+    "rules": ("PHT001", "PHT002", "PHT004", "PHT005"),
+    "flow": ("PHT006", "PHT007", "PHT008"),
+    "races": ("PHT009", "PHT010"),
+    "locks": ("PHT003",),
+}
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
@@ -183,6 +195,7 @@ def run_lint(paths: Optional[List[str]] = None,
              repo_root: str = REPO_ROOT,
              strict: bool = False,
              full_lock_graph: bool = False,
+             stats: Optional[dict] = None,
              ) -> Tuple[List[Finding], List[Finding], List[Dict[str, str]]]:
     """Lint ``paths`` (default scope when None).
 
@@ -191,12 +204,20 @@ def run_lint(paths: Optional[List[str]] = None,
     cycle's two halves may straddle a changed and an unchanged module,
     and a graph built from the diff alone cannot see it.
 
+    ``stats``, when a dict is passed, is filled in place (the ``--stats``
+    CLI flag): per-pass wall seconds (``passes``), per-rule finding
+    counts including suppressed (``rule_counts``), file count and total
+    wall (``files``/``total_s``) — the linter's own cost is tier-1
+    budgeted, so rule growth must stay measurable.
+
     Returns ``(findings, suppressed, unused_baseline_entries)`` —
     findings sorted by (file, line, rule).  Raises BaselineError on a
     malformed baseline and, with ``strict=True`` (the CLI's explicit-
     paths mode), OSError for a path that is missing or unparseable —
     callers map both to exit code 2.  A silent skip would report a
     'clean' lint that never ran on the file the caller named."""
+    t_start = time.perf_counter()
+    c_start = time.process_time()
     if paths is None:
         paths = default_paths(repo_root)
     baseline = load_baseline(baseline_path)
@@ -210,10 +231,19 @@ def run_lint(paths: Optional[List[str]] = None,
             raise OSError(f"cannot lint {p}: missing, unreadable, or "
                           "not parseable as Python")
 
+    passes = {name: 0.0 for name in PASS_RULES}
     findings: List[Finding] = []
     for mi in modules:
+        t0 = time.perf_counter()
         findings.extend(lint_module(mi))
+        t1 = time.perf_counter()
         findings.extend(lint_module_flow(mi))
+        t2 = time.perf_counter()
+        findings.extend(lint_module_races(mi))
+        t3 = time.perf_counter()
+        passes["rules"] += t1 - t0
+        passes["flow"] += t2 - t1
+        passes["races"] += t3 - t2
     lock_modules = modules
     if full_lock_graph:
         by_path = {m.path for m in modules}
@@ -228,8 +258,24 @@ def run_lint(paths: Optional[List[str]] = None,
     # unchanged modules: the cycle report lands at the first-recorded
     # edge, which may be the unchanged half — filtering to the diff
     # would drop exactly the finding the mode exists to surface
+    t0 = time.perf_counter()
     findings.extend(lint_locks(lock_modules))
+    passes["locks"] += time.perf_counter() - t0
     findings.sort(key=lambda f: (f.file, f.line, f.rule))
+    if stats is not None:
+        counts = {r: 0 for rules in PASS_RULES.values() for r in rules}
+        for f in findings:
+            counts[f.rule] = counts.get(f.rule, 0) + 1
+        stats.update(
+            passes={k: round(v, 4) for k, v in passes.items()},
+            rule_counts=dict(sorted(counts.items())),
+            files=len(modules),
+            total_s=round(time.perf_counter() - t_start, 4),
+            # process-CPU seconds: the walk is single-threaded pure
+            # CPU, so this equals wall on an idle box but stays stable
+            # under concurrent load — the budget assertion uses it
+            # (wall flaked the moment the box ran anything else)
+            cpu_s=round(time.process_time() - c_start, 4))
 
     kept, suppressed = [], []
     used = [False] * len(baseline)

@@ -9,13 +9,14 @@ import argparse
 import json
 import sys
 
-from . import (DEFAULT_BASELINE, BaselineError, changed_paths, run_lint)
+from . import (DEFAULT_BASELINE, PASS_RULES, BaselineError, changed_paths,
+               run_lint)
 
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m tools.pht_lint",
-        description="JAX hot-path static analysis (PHT001-PHT008)")
+        description="JAX hot-path static analysis (PHT001-PHT010)")
     ap.add_argument("paths", nargs="*",
                     help="files to lint (default: package + tools + "
                          "bench.py)")
@@ -30,6 +31,12 @@ def main(argv=None) -> int:
     ap.add_argument("--no-baseline", action="store_true",
                     help="ignore the baseline (show everything)")
     ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--stats", action="store_true",
+                    help="report per-rule finding counts and per-pass "
+                         "wall time (rules sharing one AST walk share "
+                         "one honest time bucket) — the linter itself "
+                         "is tier-1 budgeted, so rule growth must stay "
+                         "measurable")
     args = ap.parse_args(argv)
 
     paths = args.paths or None
@@ -43,6 +50,7 @@ def main(argv=None) -> int:
             print("pht-lint: no changed files in scope; nothing to lint")
             return 0
 
+    stats = {} if args.stats else None
     try:
         findings, suppressed, unused = run_lint(
             paths=paths,
@@ -50,7 +58,8 @@ def main(argv=None) -> int:
             strict=bool(args.paths),
             # a cycle's two halves may straddle the diff and an
             # unchanged module: the pre-PR check runs PHT003 repo-wide
-            full_lock_graph=args.changed)
+            full_lock_graph=args.changed,
+            stats=stats)
     except BaselineError as e:
         print(f"pht-lint: baseline error: {e}", file=sys.stderr)
         return 2
@@ -63,11 +72,14 @@ def main(argv=None) -> int:
     # the entry points, and "fixed? delete it" advice would be wrong
     full_scope = paths is None
     if args.format == "json":
-        print(json.dumps({
+        doc = {
             "findings": [vars(f) for f in findings],
             "suppressed": [vars(f) for f in suppressed],
             "unused_baseline": unused if full_scope else [],
-        }, indent=2))
+        }
+        if stats is not None:
+            doc["stats"] = stats
+        print(json.dumps(doc, indent=2))
     else:
         for f in findings:
             print(f.render())
@@ -76,6 +88,16 @@ def main(argv=None) -> int:
                 print(f"pht-lint: warning: unused baseline entry "
                       f"{e['rule']} {e['file']} {e['func']} "
                       f"(fixed? delete it)", file=sys.stderr)
+        if stats is not None:
+            print(f"pht-lint stats: {stats['files']} file(s), "
+                  f"{stats['total_s']:.2f}s wall "
+                  f"({stats['cpu_s']:.2f}s cpu)")
+            for name, rules in PASS_RULES.items():
+                print(f"  pass {name:<5} ({' '.join(rules)}): "
+                      f"{stats['passes'][name]:.2f}s")
+            counts = " ".join(f"{r}={n}" for r, n in
+                              stats["rule_counts"].items())
+            print(f"  findings (incl. suppressed): {counts}")
         print(f"pht-lint: {len(findings)} finding(s), "
               f"{len(suppressed)} suppressed by baseline")
     return 1 if findings else 0

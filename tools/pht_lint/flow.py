@@ -671,6 +671,13 @@ def _lint_cached_program_keys(mi: ModuleInfo, findings: List[Finding]):
     sites — a fresh-per-call closure must carry a cache_key, and the key
     must mention every mutable outer variable the closure captures."""
     for fi in mi.funcs.values():
+        # early exit: the per-function scans below (own-store walk,
+        # call-result bindings, free-name closures) are walk-heavy and
+        # only matter at run_shard_map call sites — most functions in
+        # most modules have none
+        if not any(_tail(_call_dotted(mi, ref.node)) == "run_shard_map"
+                   for ref in fi.calls):
+            continue
         # names bound in THIS function's own scope (params + stores,
         # nested subtrees excluded so a nested def's locals don't count)
         a = getattr(fi.node, "args", None)
@@ -910,7 +917,16 @@ def _fn_positional_arity(mi: ModuleInfo, fi: FuncInfo,
     return len(a.posonlyargs + a.args)
 
 
+_SPEC_SITE_TAILS = ("NamedSharding",) + _SMAP_TAILS
+
+
 def _lint_spec_drift(mi: ModuleInfo, findings: List[Finding]):
+    # early exit: the rule only checks call sites recorded in fi.calls,
+    # so a module with none skips the (tree-walking) constant and mesh
+    # collection entirely
+    if not any(_tail(_call_dotted(mi, ref.node)) in _SPEC_SITE_TAILS
+               for fi in mi.funcs.values() for ref in fi.calls):
+        return
     consts = _module_constants(mi)
     known = _collect_known_meshes(mi, consts)
 

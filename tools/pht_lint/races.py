@@ -1,0 +1,668 @@
+"""pht-lint host-side data-race rules PHT009/PHT010 (catalog:
+docs/STATIC_ANALYSIS.md; runtime half: observability/sanitizers.py
+``race_sanitizer``).
+
+PHT009  unguarded-shared-state — per class, each attribute's guarded-by
+        discipline is INFERRED: an attribute written at least once under
+        a recognized lock (``with self._lock:`` — the PHT003 lock model:
+        ``threading.Lock/RLock/Condition`` ctors and the sanitizer's
+        ``make_lock``/``make_rlock``) is lock-guarded.  Reads or writes
+        of a guarded attribute with NO lock held, in a function
+        reachable from a thread entry point via a call path that holds
+        no lock, are data races the GIL does not excuse (check-then-act
+        on dict/queue state, torn multi-attribute invariants).  Thread
+        entry points: ``threading.Thread(target=...)`` targets,
+        ``executor.submit(fn)`` callables, ``do_GET``-style HTTP handler
+        methods, and ``run`` methods of ``threading.Thread`` subclasses.
+        Allowlist: an access whose line (or the line above) carries
+        ``# pht-lint: gil-atomic`` — the single-aligned-read /
+        single-``+=``-bump contract for counters the lock-free metrics
+        hot path relies on (the annotation is a CLAIM the reviewer can
+        grep; the runtime sanitizer's ``atomic=`` mirrors it).
+
+PHT010  check-then-act — a local assigned under a lock from an
+        expression reading a guarded attribute (admission headroom, a
+        free-slot test, a queue-empty probe) that is used as an
+        ``if``/``while`` condition AFTER the lock was released, where
+        the taken branch then ACTS (writes a guarded attribute, or
+        calls a method that takes a lock).  Between release and act the
+        state may change — the TOCTOU shape a least-loaded router
+        dispatch is full of.  Pure snapshot-and-report (no act) stays
+        clean: that is the designed /load pattern.
+
+Same design rules as rules.py/flow.py: pure stdlib ``ast``,
+conservative resolution — a shape we cannot prove is NOT flagged
+(misses are acceptable, false positives are not).  Both rules are
+per-module: cross-module thread entries (an engine method called from
+the HTTP server's handler thread) need the entry module's own analysis.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .callgraph import CallRef, FuncInfo, ModuleInfo, dotted_of, \
+    resolve_same_module
+from .rules import Finding
+
+GIL_ATOMIC_MARK = "pht-lint: gil-atomic"
+
+# container-mutator method names: `self.x.append(v)` under a lock is a
+# guard-establishing WRITE to x's state, same as `self.x = v`
+_MUTATOR_METHODS = frozenset((
+    "append", "appendleft", "add", "extend", "insert", "update",
+    "setdefault", "pop", "popleft", "popitem", "clear", "remove",
+    "discard", "put", "put_nowait",
+))
+
+_HTTP_HANDLER_METHODS = frozenset((
+    "do_GET", "do_POST", "do_PUT", "do_DELETE", "do_HEAD", "do_PATCH",
+))
+
+# with-context names treated as lock acquisitions even when the lock
+# object lives on another instance (`with self.inner.cv:`): the final
+# path segment either matches a lock DEFINED in this module, or follows
+# the naming convention.  Misclassifying a non-lock context manager as a
+# lock can only MISS findings — the safe direction.
+_LOCK_NAME_HINTS = ("lock", "cv", "cond", "mutex")
+
+
+def _gil_atomic(mi: ModuleInfo, lineno: int) -> bool:
+    return (GIL_ATOMIC_MARK in mi.source_line(lineno)
+            or GIL_ATOMIC_MARK in mi.source_line(lineno - 1))
+
+
+def _lock_attr_names(mi: ModuleInfo) -> Set[str]:
+    """Final-segment names of every lock this module defines (the
+    PHT003 model: threading ctors + make_lock/make_rlock), for
+    recognizing ``with self.<name>:`` / ``with self.other.<name>:``."""
+    out: Set[str] = set()
+    for key in mi.locks:
+        out.add(key.rsplit(".", 1)[-1])
+    return out
+
+
+def _is_lock_ctx(mi: ModuleInfo, expr: ast.expr,
+                 lock_names: Set[str]) -> Optional[str]:
+    """Lock display name when a with-item context expression is a lock
+    acquisition, else None."""
+    # `with self._lock:` / `with _module_lock:` / `with self.inner.cv:`
+    d = dotted_of(expr)
+    if d is None:
+        # `with self._lock_for(i):`-style calls: not recognized (miss)
+        return None
+    tail = d.rsplit(".", 1)[-1]
+    if tail in lock_names:
+        return d
+    low = tail.lower()
+    if any(h in low for h in _LOCK_NAME_HINTS):
+        return d
+    return None
+
+
+# --------------------------------------------------------------------------
+# guarded-by inference
+# --------------------------------------------------------------------------
+
+def _store_attr_root(target: ast.expr) -> Optional[str]:
+    """Attribute name X when ``target`` writes through ``self.X`` (the
+    binding itself, a subscript of it, or a deeper path under it)."""
+    e = target
+    while isinstance(e, (ast.Subscript, ast.Attribute)):
+        if isinstance(e, ast.Attribute) and isinstance(e.value, ast.Name) \
+                and e.value.id == "self":
+            return e.attr
+        e = e.value
+    return None
+
+
+class _GuardInference(ast.NodeVisitor):
+    """One pass over a function body: record self-attribute writes made
+    while a recognized lock is held.  ``guarded[cls][attr] = lock``."""
+
+    def __init__(self, mi: ModuleInfo, fi: FuncInfo, lock_names: Set[str],
+                 guarded: Dict[str, Dict[str, str]]):
+        self.mi = mi
+        self.fi = fi
+        self.lock_names = lock_names
+        self.guarded = guarded
+        self.held: List[str] = []
+
+    def run(self):
+        if self.fi.class_name is None:
+            return
+        for stmt in getattr(self.fi.node, "body", []):
+            self.visit(stmt)
+
+    def visit_FunctionDef(self, node):   # nested defs: their own FuncInfo
+        pass
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node):
+        pass
+
+    def visit_With(self, node: ast.With):
+        lks = [_is_lock_ctx(self.mi, it.context_expr, self.lock_names)
+               for it in node.items]
+        lks = [lk for lk in lks if lk]
+        self.held.extend(lks)
+        for s in node.body:
+            self.visit(s)
+        if lks:
+            del self.held[-len(lks):]
+
+    visit_AsyncWith = visit_With
+
+    def _mark(self, attr: str):
+        if self.held and attr:
+            cls = self.fi.class_name
+            self.guarded.setdefault(cls, {}).setdefault(
+                attr, self.held[-1])
+
+    def visit_Assign(self, node: ast.Assign):
+        if self.held:
+            for t in node.targets:
+                for e in ast.walk(t) if isinstance(
+                        t, (ast.Tuple, ast.List, ast.Starred)) else [t]:
+                    root = _store_attr_root(e)
+                    if root:
+                        self._mark(root)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign):
+        if self.held:
+            root = _store_attr_root(node.target)
+            if root:
+                self._mark(root)
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call):
+        # `self.x.append(v)` under the lock: a write to x's contents
+        if self.held and isinstance(node.func, ast.Attribute) \
+                and node.func.attr in _MUTATOR_METHODS:
+            root = _store_attr_root(node.func.value)
+            if root:
+                self._mark(root)
+        self.generic_visit(node)
+
+
+def infer_guarded(mi: ModuleInfo,
+                  lock_names: Set[str]) -> Dict[str, Dict[str, str]]:
+    guarded: Dict[str, Dict[str, str]] = {}
+    for fi in mi.funcs.values():
+        if not isinstance(fi.node, ast.Lambda):
+            _GuardInference(mi, fi, lock_names, guarded).run()
+    # lock attributes themselves are never "guarded data" (reading
+    # self._lock to acquire it is the discipline, not a race)
+    for cls, attrs in guarded.items():
+        for key in [a for a in attrs
+                    if f"{cls}.{a}" in mi.locks or a in lock_names]:
+            del attrs[key]
+    return guarded
+
+
+# --------------------------------------------------------------------------
+# thread entry points
+# --------------------------------------------------------------------------
+
+def _resolve_callable(mi: ModuleInfo, fi: FuncInfo,
+                      expr: ast.expr) -> Set[str]:
+    """Qualnames a callable-valued expression may name (same module)."""
+    if isinstance(expr, ast.Attribute) and isinstance(
+            expr.value, ast.Name) and expr.value.id == "self":
+        return resolve_same_module(
+            mi, fi, CallRef("self", expr.attr, None))
+    if isinstance(expr, ast.Name):
+        return resolve_same_module(
+            mi, fi, CallRef("bare", expr.id, None))
+    return set()
+
+
+def thread_entries(mi: ModuleInfo) -> Dict[str, str]:
+    """qualname -> how it becomes a thread entry."""
+    out: Dict[str, str] = {}
+
+    def _add(quals: Set[str], why: str):
+        for q in quals:
+            out.setdefault(q, why)
+
+    for fi in mi.funcs.values():
+        for node in ast.walk(fi.node):
+            if not isinstance(node, ast.Call):
+                continue
+            d = mi.resolve_dotted(node.func) or ""
+            if d == "threading.Thread" or d.endswith(".Thread") \
+                    and d.startswith("threading"):
+                target = None
+                for kw in node.keywords:
+                    if kw.arg == "target":
+                        target = kw.value
+                if target is None and len(node.args) >= 2:
+                    target = node.args[1]   # Thread(group, target)
+                if target is not None:
+                    _add(_resolve_callable(mi, fi, target),
+                         "threading.Thread(target=...)")
+            elif isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "submit" and node.args:
+                # pool.submit(fn, ...): only flag when the first arg
+                # provably names a same-module function (an engine's
+                # submit(prompt) request API never resolves)
+                _add(_resolve_callable(mi, fi, node.args[0]),
+                     "executor.submit(...)")
+
+    for qual, fi in mi.funcs.items():
+        if qual.rsplit(".", 1)[-1] in _HTTP_HANDLER_METHODS \
+                and fi.class_name is not None:
+            out.setdefault(qual, "HTTP handler thread")
+
+    # threading.Thread subclasses: run() is the entry
+    for node in ast.walk(mi.tree):
+        if isinstance(node, ast.ClassDef):
+            for base in node.bases:
+                if mi.resolve_dotted(base) == "threading.Thread":
+                    q = f"{node.name}.run"
+                    if q in mi.funcs:
+                        out.setdefault(q, "threading.Thread subclass run()")
+    return out
+
+
+# --------------------------------------------------------------------------
+# lock-free reachability from entries
+# --------------------------------------------------------------------------
+
+class _LockFreeCallCollector(ast.NodeVisitor):
+    """Call nodes (and nested def names) that execute with NO recognized
+    lock held, in one function body."""
+
+    def __init__(self, mi: ModuleInfo, lock_names: Set[str]):
+        self.mi = mi
+        self.lock_names = lock_names
+        self.held = 0
+        self.calls: List[ast.Call] = []
+        self.nested_defs: List[str] = []
+
+    def visit_With(self, node: ast.With):
+        lks = [lk for it in node.items
+               if (lk := _is_lock_ctx(self.mi, it.context_expr,
+                                      self.lock_names))]
+        for it in node.items:
+            self.visit(it.context_expr)
+        self.held += len(lks)
+        for s in node.body:
+            self.visit(s)
+        self.held -= len(lks)
+
+    visit_AsyncWith = visit_With
+
+    def visit_FunctionDef(self, node):
+        if self.held == 0:
+            self.nested_defs.append(node.name)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node):
+        pass
+
+    def visit_Call(self, node: ast.Call):
+        if self.held == 0:
+            self.calls.append(node)
+        self.generic_visit(node)
+
+
+def lockfree_reachable(mi: ModuleInfo, entries: Dict[str, str],
+                       lock_names: Set[str]) -> Dict[str, str]:
+    """Functions reachable from a thread entry via call paths holding no
+    lock: qualname -> the entry's description (first to reach)."""
+    reached: Dict[str, str] = {}
+    work = [(q, why) for q, why in entries.items() if q in mi.funcs]
+    while work:
+        q, why = work.pop()
+        if q in reached:
+            continue
+        reached[q] = why
+        fi = mi.funcs[q]
+        col = _LockFreeCallCollector(mi, lock_names)
+        for stmt in getattr(fi.node, "body", []):
+            col.visit(stmt)
+        by_id = {id(ref.node): ref for ref in fi.calls}
+        for call in col.calls:
+            ref = by_id.get(id(call))
+            if ref is None:
+                continue
+            for tgt in resolve_same_module(mi, fi, ref):
+                if tgt not in reached:
+                    work.append((tgt, why))
+        # nested defs declared outside any lock run in the entry's
+        # dynamic extent (worker-body closures)
+        for name in col.nested_defs:
+            cand = f"{q}.{name}"
+            if cand in mi.funcs and cand not in reached:
+                work.append((cand, why))
+    return reached
+
+
+# --------------------------------------------------------------------------
+# PHT009 flag pass
+# --------------------------------------------------------------------------
+
+class _UnguardedAccessWalker(ast.NodeVisitor):
+    def __init__(self, mi: ModuleInfo, fi: FuncInfo, entry_why: str,
+                 guarded: Dict[str, str], lock_names: Set[str],
+                 findings: List[Finding]):
+        self.mi = mi
+        self.fi = fi
+        self.entry_why = entry_why
+        self.guarded = guarded
+        self.lock_names = lock_names
+        self.findings = findings
+        self.held = 0
+        self._seen: Set[str] = set()
+
+    def run(self):
+        for stmt in getattr(self.fi.node, "body", []):
+            self.visit(stmt)
+
+    def visit_With(self, node: ast.With):
+        lks = [lk for it in node.items
+               if (lk := _is_lock_ctx(self.mi, it.context_expr,
+                                      self.lock_names))]
+        for it in node.items:
+            self.visit(it.context_expr)
+        self.held += len(lks)
+        for s in node.body:
+            self.visit(s)
+        self.held -= len(lks)
+
+    visit_AsyncWith = visit_With
+
+    def visit_FunctionDef(self, node):   # own FuncInfo, walked if reached
+        pass
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node):
+        pass
+
+    def visit_Attribute(self, node: ast.Attribute):
+        if self.held == 0 and isinstance(node.value, ast.Name) \
+                and node.value.id == "self" \
+                and node.attr in self.guarded \
+                and node.attr not in self._seen \
+                and not _gil_atomic(self.mi, node.lineno):
+            self._seen.add(node.attr)
+            kind = "written" if isinstance(
+                node.ctx, (ast.Store, ast.Del)) else "read"
+            lock = self.guarded[node.attr]
+            self.findings.append(Finding(
+                rule="PHT009", file=self.mi.relpath, line=node.lineno,
+                func=self.fi.qualname,
+                message=f"`self.{node.attr}` is written under "
+                        f"`{lock}` elsewhere in this class "
+                        f"(guarded-by inference) but {kind} here with "
+                        f"NO lock held — and this function is reachable "
+                        f"from a thread entry ({self.entry_why}) on a "
+                        "lock-free path: a concurrent locked writer "
+                        "makes this a data race (torn invariants, "
+                        "check-then-act on stale state)",
+                hint=f"take {lock} around the access, or — for a "
+                     "single GIL-atomic read / `+=` counter bump — "
+                     "annotate the line `# pht-lint: gil-atomic` and "
+                     "mirror it in the runtime sanitizer's `atomic=` "
+                     "list (docs/STATIC_ANALYSIS.md, PHT009)"))
+        self.generic_visit(node)
+
+
+# --------------------------------------------------------------------------
+# PHT010 check-then-act
+# --------------------------------------------------------------------------
+
+def _attr_reads_of_self(expr: ast.expr, guarded: Dict[str, str]) -> Set[str]:
+    out: Set[str] = set()
+    for n in ast.walk(expr):
+        if isinstance(n, ast.Attribute) and isinstance(
+                n.value, ast.Name) and n.value.id == "self" \
+                and n.attr in guarded:
+            out.add(n.attr)
+    return out
+
+
+def _guard_touchers(mi: ModuleInfo,
+                    guarded: Dict[str, Dict[str, str]]) -> Set[str]:
+    """Qualnames of methods that read or write any of their own class's
+    guarded attributes.  The PHT010 'act' criterion intersects this
+    with the locking methods: a helper that merely takes an UNRELATED
+    lock (a metrics bump under the registry lock) is not an act on the
+    checked state — flagging it false-positived the documented-clean
+    snapshot-and-report shape."""
+    out: Set[str] = set()
+    for qual, fi in mi.funcs.items():
+        cls = fi.class_name
+        attrs = guarded.get(cls or "", {})
+        if not attrs:
+            continue
+        for n in ast.walk(fi.node):
+            if isinstance(n, ast.Attribute) \
+                    and isinstance(n.value, ast.Name) \
+                    and n.value.id == "self" and n.attr in attrs:
+                out.add(qual)
+                break
+    return out
+
+
+def _locking_methods(mi: ModuleInfo, lock_names: Set[str]) -> Set[str]:
+    """Qualnames whose bodies acquire a recognized lock, closed over
+    same-module calls (2 hops is plenty for the repo's idioms)."""
+    direct: Set[str] = set()
+    for qual, fi in mi.funcs.items():
+        for n in ast.walk(fi.node):
+            if isinstance(n, (ast.With, ast.AsyncWith)):
+                if any(_is_lock_ctx(mi, it.context_expr, lock_names)
+                       for it in n.items):
+                    direct.add(qual)
+                    break
+    out = set(direct)
+    for _ in range(2):
+        grew = False
+        for qual, fi in mi.funcs.items():
+            if qual in out:
+                continue
+            for ref in fi.calls:
+                if resolve_same_module(mi, fi, ref) & out:
+                    out.add(qual)
+                    grew = True
+                    break
+        if not grew:
+            break
+    return out
+
+
+class _CheckThenActWalker:
+    """One function: find `with lock: v = <reads guarded attr>` followed
+    (after the with closes) by `if v:` / `while v:` whose branch acts."""
+
+    def __init__(self, mi: ModuleInfo, fi: FuncInfo,
+                 guarded: Dict[str, str], lock_names: Set[str],
+                 acting: Set[str], findings: List[Finding]):
+        self.mi = mi
+        self.fi = fi
+        self.guarded = guarded
+        self.lock_names = lock_names
+        # methods that BOTH take a lock and touch guarded state — the
+        # only calls that count as acting on the checked decision
+        self.acting = acting
+        self.findings = findings
+
+    def run(self):
+        self._walk_list(getattr(self.fi.node, "body", []), {})
+
+    @staticmethod
+    def _kill_bound(stmt, decisions) -> None:
+        """Drop decisions whose name this statement REBINDS — plain and
+        tuple-unpack assigns, aug-assigns, for-loop targets, `with ...
+        as x` — so a recycled name never flags as a stale decision (the
+        no-false-positives contract)."""
+        targets = []
+        if isinstance(stmt, ast.Assign):
+            targets = stmt.targets
+        elif isinstance(stmt, ast.AugAssign):
+            targets = [stmt.target]
+        elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+            targets = [stmt.target]
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            targets = [it.optional_vars for it in stmt.items
+                       if it.optional_vars is not None]
+        for t in targets:
+            for n in ast.walk(t):
+                if isinstance(n, ast.Name):
+                    decisions.pop(n.id, None)
+
+    # decisions: name -> (attr, with_lineno, lock_name)
+    def _walk_list(self, stmts, decisions: Dict[str, Tuple[str, int, str]]):
+        for stmt in stmts:
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                self._kill_bound(stmt, decisions)
+                lks = [lk for it in stmt.items
+                       if (lk := _is_lock_ctx(self.mi, it.context_expr,
+                                              self.lock_names))]
+                if lks:
+                    self._collect_decisions(stmt, decisions, lks[-1])
+                else:
+                    self._walk_list(stmt.body, decisions)
+                continue
+            self._kill_bound(stmt, decisions)
+            if isinstance(stmt, (ast.If, ast.While)):
+                self._check_test(stmt, decisions)
+                # branches see (and may add/kill) the same decisions
+                self._walk_list(stmt.body, decisions)
+                self._walk_list(stmt.orelse, decisions)
+                continue
+            if isinstance(stmt, (ast.For, ast.AsyncFor)):
+                self._walk_list(stmt.body, decisions)
+                self._walk_list(stmt.orelse, decisions)
+                continue
+            if isinstance(stmt, ast.Try):
+                self._walk_list(stmt.body, decisions)
+                for h in stmt.handlers:
+                    self._walk_list(h.body, decisions)
+                self._walk_list(stmt.orelse, decisions)
+                self._walk_list(stmt.finalbody, decisions)
+                continue
+
+    def _collect_decisions(self, with_node, decisions, lock_name):
+        """Walk a locked region: every rebind kills (via _kill_bound —
+        tuple unpacks, for-targets, with-as included, so a later-lock
+        rebind of the name never leaves a stale decision), and a
+        single-Name assign reading a guarded attribute records a
+        decision."""
+        def walk_body(stmts):
+            for n in stmts:
+                self._kill_bound(n, decisions)
+                if isinstance(n, (ast.With, ast.AsyncWith)):
+                    walk_body(n.body)
+                elif isinstance(n, ast.Assign) and len(n.targets) == 1 \
+                        and isinstance(n.targets[0], ast.Name):
+                    attrs = _attr_reads_of_self(n.value, self.guarded)
+                    if attrs:
+                        decisions[n.targets[0].id] = (
+                            sorted(attrs)[0], with_node.lineno, lock_name)
+                elif isinstance(n, (ast.If, ast.While, ast.For,
+                                    ast.AsyncFor)):
+                    walk_body(n.body)
+                    walk_body(n.orelse)
+                elif isinstance(n, ast.Try):
+                    walk_body(n.body)
+                    for h in n.handlers:
+                        walk_body(h.body)
+                    walk_body(n.orelse)
+                    walk_body(n.finalbody)
+        walk_body(with_node.body)
+
+    def _check_test(self, stmt, decisions):
+        used = [n.id for n in ast.walk(stmt.test)
+                if isinstance(n, ast.Name) and n.id in decisions]
+        if not used:
+            return
+        act = self._find_act(stmt.body) or self._find_act(stmt.orelse)
+        if act is None:
+            return
+        var = used[0]
+        attr, with_line, lock_name = decisions[var]
+        self.findings.append(Finding(
+            rule="PHT010", file=self.mi.relpath, line=stmt.lineno,
+            func=self.fi.qualname,
+            message=f"check-then-act: `{var}` was derived from "
+                    f"lock-guarded `self.{attr}` under `{lock_name}` "
+                    f"(line {with_line}), but the lock was RELEASED "
+                    f"before this test — the branch then {act}, acting "
+                    "on state that may have changed in between (TOCTOU)",
+            hint="re-validate under the lock at the point of action "
+                 "(read the attribute again inside the locked region "
+                 "that acts), or move the action into the original "
+                 "locked block"))
+
+    def _find_act(self, stmts) -> Optional[str]:
+        for s in stmts:
+            for n in ast.walk(s):
+                if isinstance(n, (ast.Assign, ast.AugAssign)):
+                    targets = n.targets if isinstance(n, ast.Assign) \
+                        else [n.target]
+                    for t in targets:
+                        root = _store_attr_root(t)
+                        if root in self.guarded:
+                            return f"writes guarded `self.{root}`"
+                elif isinstance(n, ast.Call):
+                    f = n.func
+                    if isinstance(f, ast.Attribute):
+                        if isinstance(f.value, ast.Name) \
+                                and f.value.id == "self":
+                            for q in resolve_same_module(
+                                    self.mi, self.fi,
+                                    CallRef("self", f.attr, n)):
+                                if q in self.acting:
+                                    return (f"calls `self.{f.attr}()` "
+                                            "which takes a lock and "
+                                            "touches the guarded state")
+                        if f.attr in _MUTATOR_METHODS:
+                            root = _store_attr_root(f.value)
+                            if root in self.guarded:
+                                return ("mutates guarded "
+                                        f"`self.{root}.{f.attr}(...)`")
+        return None
+
+
+# --------------------------------------------------------------------------
+# driver
+# --------------------------------------------------------------------------
+
+def lint_module_races(mi: ModuleInfo) -> List[Finding]:
+    findings: List[Finding] = []
+    lock_names = _lock_attr_names(mi)
+    guarded = infer_guarded(mi, lock_names)
+    if not guarded:
+        return findings
+
+    entries = thread_entries(mi)
+    if entries:
+        reached = lockfree_reachable(mi, entries, lock_names)
+        for qual, why in reached.items():
+            fi = mi.funcs[qual]
+            if isinstance(fi.node, ast.Lambda):
+                continue
+            if qual.rsplit(".", 1)[-1] == "__init__":
+                continue   # pre-publication writes precede thread start
+            cls_guarded = guarded.get(fi.class_name or "", {})
+            if cls_guarded:
+                _UnguardedAccessWalker(mi, fi, why, cls_guarded,
+                                       lock_names, findings).run()
+
+    acting = _locking_methods(mi, lock_names) & _guard_touchers(mi, guarded)
+    for qual, fi in mi.funcs.items():
+        if isinstance(fi.node, ast.Lambda):
+            continue
+        cls_guarded = guarded.get(fi.class_name or "", {})
+        if cls_guarded:
+            _CheckThenActWalker(mi, fi, cls_guarded, lock_names,
+                                acting, findings).run()
+    return findings

@@ -400,7 +400,13 @@ def _collect_jit_bindings(mi: ModuleInfo) -> Dict[str, Set[int]]:
 def _jit_targets(mi: ModuleInfo) -> Dict[str, Set[int]]:
     """qualname -> static positions, for functions that get jitted:
     decorated with @jax.jit / @functools.partial(jax.jit, ...), or
-    passed by name to jax.jit anywhere in the module."""
+    passed by name to jax.jit anywhere in the module.  Memoized on the
+    ModuleInfo: rules (PHT002/004) and flow (PHT007) both need it, and
+    the scan walks every function — computing it twice per module was
+    a measurable slice of the repo-wide walk."""
+    memo = getattr(mi, "_jit_targets_memo", None)
+    if memo is not None:
+        return memo
     out: Dict[str, Set[int]] = {}
 
     def _deco_statics(dec) -> Optional[Set[int]]:
@@ -461,6 +467,7 @@ def _jit_targets(mi: ModuleInfo) -> Dict[str, Set[int]]:
             self.generic_visit(node)
 
     _TopLevelCalls().visit(mi.tree)
+    mi._jit_targets_memo = out
     return out
 
 
@@ -681,6 +688,11 @@ class _LabelCardinalityWalker:
         return None
 
     def run(self):
+        # early exit: no `.labels(...)` call recorded in this function
+        # means nothing to check — skip the (walk-heavy) unbounded-name
+        # collection entirely (most functions, most modules)
+        if not any(ref.name == "labels" for ref in self.fi.calls):
+            return
         nodes = self._own_nodes()
         self._collect_unbounded(nodes)
         for node in nodes:

@@ -1,0 +1,109 @@
+"""precommit: the docs/STATIC_ANALYSIS.md pre-PR checklist as ONE command.
+
+    python tools/precommit.py [--durations /tmp/durations.log] [--stats]
+
+Chains, in order:
+
+1. **pht-lint --changed** — lints the .py files your change touches
+   (worktree + index + untracked + commits since the merge-base with
+   main); PHT003's lock graph still spans the whole scope.
+2. **test-budget drift** — ``tools/test_budget.py`` diffs a
+   ``pytest --durations=0`` log against ``tests/conftest.py _FILE_COST``
+   so budget drift fails HERE instead of as an RC=137 archaeology
+   session.  Runs when ``--durations`` is given or the default log
+   exists; otherwise SKIPPED with the command to produce one (a lint-only
+   change doesn't need a suite run, so a missing log is not a failure).
+3. **jaxcompat canary** — imports the bridge symbols in a subprocess
+   (``core/jaxcompat.py`` has been wiped by a re-seed before; a broken
+   bridge must fail the pre-PR check loudly, not as a downstream XLA
+   abort).
+
+Exit codes (perf_gate convention): 0 = every step that ran passed,
+1 = at least one step failed, 2 = usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_DURATIONS = "/tmp/durations.log"
+
+_CANARY = (
+    "from paddle_hackathon_tpu.core import jaxcompat\n"
+    "import jax\n"
+    "assert callable(jaxcompat.shard_map), 'jaxcompat.shard_map gone'\n"
+    "assert callable(jaxcompat.set_mesh), 'jaxcompat.set_mesh gone'\n"
+    "assert hasattr(jax, 'export'), 'jax.export bridge gone'\n"
+    "print('jaxcompat bridge symbols present')\n"
+)
+
+
+def _run_step(name: str, argv, results, display=None) -> None:
+    print(f"== {name}: {display or ' '.join(argv)}")
+    proc = subprocess.run(argv, cwd=REPO_ROOT)
+    ok = proc.returncode == 0
+    results.append((name, "PASS" if ok else f"FAIL (rc={proc.returncode})"))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python tools/precommit.py",
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+        description=__doc__)
+    ap.add_argument("--durations", default=None,
+                    help="pytest --durations=0 log for the budget drift "
+                         f"check (default: {DEFAULT_DURATIONS} when it "
+                         "exists; otherwise the step is skipped)")
+    ap.add_argument("--stats", action="store_true",
+                    help="pass --stats through to pht-lint (per-rule "
+                         "counts + per-pass wall time)")
+    ap.add_argument("--skip-canary", action="store_true",
+                    help="skip the jaxcompat import canary (it imports "
+                         "jax: ~10s)")
+    args = ap.parse_args(argv)
+
+    results = []
+
+    lint_cmd = [sys.executable, "-m", "tools.pht_lint", "--changed"]
+    if args.stats:
+        lint_cmd.append("--stats")
+    _run_step("pht-lint", lint_cmd, results)
+
+    durations = args.durations
+    if durations is None and os.path.exists(DEFAULT_DURATIONS):
+        durations = DEFAULT_DURATIONS
+    if durations is not None:
+        if not os.path.exists(durations):
+            print(f"precommit: durations log {durations!r} not found",
+                  file=sys.stderr)
+            return 2
+        _run_step("test-budget",
+                  [sys.executable, "tools/test_budget.py", durations],
+                  results)
+    else:
+        results.append(("test-budget", "SKIP (no durations log)"))
+        print("== test-budget: SKIPPED — to include it:\n"
+              "   python -m pytest tests/ -q -m 'not slow' --durations=0 "
+              "-p no:cacheprovider | tee /tmp/durations.log")
+
+    if args.skip_canary:
+        results.append(("jaxcompat-canary", "SKIP (--skip-canary)"))
+    else:
+        _run_step("jaxcompat-canary",
+                  [sys.executable, "-c", _CANARY], results,
+                  display="python -c '<import the jaxcompat bridge "
+                          "symbols>'")
+
+    print("\nprecommit summary:")
+    width = max(len(n) for n, _ in results)
+    for name, status in results:
+        print(f"  {name:<{width}}  {status}")
+    return 1 if any(s.startswith("FAIL") for _, s in results) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
