@@ -23,10 +23,15 @@ Architecture (TPU-first, not a port):
 from .config import Config, AnalysisConfig, PassBuilder
 from .predictor import (Predictor, PredictorPool, Tensor as InferTensor,
                         create_predictor, get_version)
-from .serving import Request, ServingEngine
+from .serving import (DeadlineExceededError, EngineDraining, Request,
+                      ServingEngine)
 # paged-KV host bookkeeping (ServingEngine(cache_mode="paged")): the
 # page-pool allocator and the radix prefix cache
-from .paged import PagePool, PrefixCache, pages_for
+from .paged import PagePool, PrefixCache, page_digests, pages_for
+# the serving fleet: health-driven replica router (failover, deadlines,
+# retry/backoff, graceful drain, per-token streaming)
+from .fleet import (CircuitBreaker, FleetRequest, FleetRouter,
+                    NoReplicaAvailableError, StreamInterruptedError)
 # speculative-decoding drafters (ServingEngine(spec_k=..., drafter=...) /
 # GPTForCausalLM.generate(spec_k=...)) — re-exported here because serving
 # is where users reach for them
@@ -36,5 +41,8 @@ __all__ = [
     "Config", "AnalysisConfig", "PassBuilder", "Predictor", "PredictorPool",
     "InferTensor", "create_predictor", "get_version",
     "Request", "ServingEngine", "NGramDrafter", "ModelDrafter",
-    "PagePool", "PrefixCache", "pages_for",
+    "PagePool", "PrefixCache", "pages_for", "page_digests",
+    "FleetRouter", "FleetRequest", "CircuitBreaker",
+    "DeadlineExceededError", "EngineDraining",
+    "NoReplicaAvailableError", "StreamInterruptedError",
 ]

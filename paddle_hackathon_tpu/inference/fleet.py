@@ -1,0 +1,974 @@
+"""Fault-tolerant serving fleet: a replica router over N engines.
+
+Ref: the reference framework serves multi-rank inference through the
+``fleet_executor`` actor pipeline (``dist_model.cc`` — a persistent
+runtime fronting per-stage worker processes).  This module is the
+TPU-native fleet half of that design, built on the groundwork the
+observability layer shipped for it: each :class:`ServingEngine` replica
+publishes a versioned ``/load`` capacity report (page-exact admission
+headroom, rolling SLO percentiles, goodput, a ``prefix_digest``
+cache-affinity block) and a ``/healthz`` liveness beacon — the
+:class:`FleetRouter` is the thing that finally READS them.
+
+Topology: in-process replica handles first.  A replica is anything
+speaking the engine surface (``submit``/``load_report``/``drain``/
+``shutdown`` + an ``engine_id``); the dispatch core is transport-
+agnostic, so the multi-process deployment puts the same router behind
+an HTTP shim polling ``/load`` instead of calling ``load_report()``
+(docs/SERVING.md, "Serving fleet").
+
+Dispatch (least-loaded + cache-affinity):
+
+- candidates are live, non-draining replicas whose circuit breaker
+  allows traffic and whose liveness beacon is not stale;
+- among candidates whose ``admission.headroom_tokens`` admits the
+  request RIGHT NOW, the deepest ``prefix_digest`` match wins (the
+  replica already holds the prompt's prefix pages — repeat tenants land
+  where their KV lives), then most headroom, then shortest queue;
+- when nobody has headroom the request queues on the least-loaded
+  replica (engines queue internally; FIFO admission bounds the wait).
+
+Robustness is the headline:
+
+- **deadlines** — ``submit(deadline_s=)`` is the request's TOTAL wall
+  budget; the engine aborts it in-queue or mid-decode
+  (``where="deadline"``), and a re-dispatch carries only the REMAINING
+  budget.
+- **bounded retry + backoff** — a failed placement (submit error,
+  injected dispatch fault) retries against other replicas with
+  exponential backoff; a replica DEATH re-dispatches its
+  not-yet-started requests to a healthy replica.  A request that has
+  streamed tokens is failed LOUDLY (:class:`StreamInterruptedError`
+  naming the replica and the token count) — never silently retried,
+  because a retry would duplicate output the caller already consumed.
+- **circuit breaker** — consecutive failures (submit errors, load-probe
+  errors, stale health) open a per-replica breaker; after a cool-down
+  one half-open probe dispatch tests recovery (success closes, failure
+  re-opens).
+- **graceful drain** — :meth:`FleetRouter.drain` stops dispatching to a
+  replica, lets its queued + inflight requests finish
+  (``ServingEngine.drain``), then ``shutdown()`` — zero requests lost
+  to a planned removal.
+- **streaming backpressure** — :meth:`FleetRouter.submit_stream` yields
+  tokens as the engine commits them through a BOUNDED queue: a slow
+  consumer stalls that replica's decode loop (the engine delivers
+  outside its lock), not the router or other requests.
+
+Fault drills ride the ``PHT_FAULTS`` harness (observability/faults.py):
+``fleet.dispatch`` fires per placement attempt,
+``fleet.load_probe[<replica>]`` per capacity poll,
+``fleet.stale_health[<replica>]`` inside the health gate, and the
+engine's per-replica ``serving.tick[<engine_id>]`` kills ONE replica of
+many deterministically — "kill a replica mid-flight" is a test, not a
+hope (tests/test_fleet.py).
+
+All shared router state is guarded by ``make_lock`` locks and declared
+via ``share_object`` so the PHT009/PHT010 lint rules and the runtime
+lockset sanitizer police it — this module is the first consumer the
+race tooling was built for.
+"""
+
+from __future__ import annotations
+
+import itertools
+import queue
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..observability import faults as _faults
+from ..observability import flight as _flight
+from ..observability import metrics as _obs
+from ..observability import tracing as _tr
+from ..observability.sanitizers import make_lock, make_rlock, share_object
+from .paged import page_digests
+from .serving import DeadlineExceededError, EngineDraining
+
+__all__ = ["FleetRouter", "FleetRequest", "CircuitBreaker",
+           "NoReplicaAvailableError", "StreamInterruptedError",
+           "pick_replica", "affinity_depth",
+           "BREAKER_CLOSED", "BREAKER_HALF_OPEN", "BREAKER_OPEN"]
+
+_FLEET_IDS = itertools.count()
+
+
+class NoReplicaAvailableError(RuntimeError):
+    """Every placement attempt failed: no live, non-draining,
+    breaker-closed replica accepted the request within the retry
+    budget.  Carries the last underlying failure as ``__cause__``."""
+
+
+class StreamInterruptedError(RuntimeError):
+    """A replica died AFTER streaming part of a request's output.  The
+    router never silently re-dispatches a started stream — the caller
+    has already consumed tokens, and a retry would duplicate them — so
+    the failure is loud and names the replica and how far it got.  The
+    replica's root cause rides ``__cause__``."""
+
+
+# breaker states, exported as the fleet_breaker_state gauge values
+BREAKER_CLOSED, BREAKER_HALF_OPEN, BREAKER_OPEN = 0, 1, 2
+
+
+class CircuitBreaker:
+    """Per-replica failure gate (closed → open → half-open → ...).
+
+    Pure host state with the clock INJECTED at every transition, so the
+    state machine unit-tests without sleeping.  The owner (the router)
+    serializes access under its own lock.
+
+    - ``failure_threshold`` consecutive failures open the breaker
+      (dispatch stops);
+    - after ``probe_interval_s`` the next :meth:`allows` turns it
+      half-open and admits exactly ONE probe dispatch
+      (:meth:`on_dispatch` marks it in flight — the owner must run the
+      ``allows`` + ``on_dispatch`` pair as one atomic step under its
+      lock at the dispatch decision, or two concurrent dispatches both
+      read the unclaimed probe);
+    - the probe's success closes the breaker (failure count reset), its
+      failure re-opens it and restarts the cool-down."""
+
+    __slots__ = ("failure_threshold", "probe_interval_s", "state",
+                 "consecutive_failures", "_opened_at", "_probing")
+
+    def __init__(self, failure_threshold: int = 3,
+                 probe_interval_s: float = 1.0):
+        self.failure_threshold = int(failure_threshold)
+        self.probe_interval_s = float(probe_interval_s)
+        self.state = BREAKER_CLOSED
+        self.consecutive_failures = 0
+        self._opened_at: Optional[float] = None
+        self._probing = False
+
+    def allows(self, now: float) -> bool:
+        """May the router dispatch to this replica right now?  An open
+        breaker past its cool-down transitions to half-open here (the
+        decision point) and admits a single probe."""
+        if self.state == BREAKER_CLOSED:
+            return True
+        if self.state == BREAKER_OPEN:
+            if now - self._opened_at < self.probe_interval_s:
+                return False
+            self.state = BREAKER_HALF_OPEN
+            self._probing = False
+        return not self._probing
+
+    def on_dispatch(self) -> None:
+        """The router is about to dispatch here; in half-open state
+        that dispatch IS the probe — no second one until it resolves."""
+        if self.state == BREAKER_HALF_OPEN:
+            self._probing = True
+
+    def record_failure(self, now: float) -> None:
+        self.consecutive_failures += 1
+        if (self.state == BREAKER_HALF_OPEN
+                or self.consecutive_failures >= self.failure_threshold):
+            self.state = BREAKER_OPEN
+            self._opened_at = now
+            self._probing = False
+
+    def record_success(self) -> None:
+        self.state = BREAKER_CLOSED
+        self.consecutive_failures = 0
+        self._probing = False
+
+
+def affinity_depth(report: dict, digests: List[int]) -> int:
+    """How many leading prompt pages this replica already holds: the
+    deepest entry of ``digests`` (the prompt's chain digests from
+    :func:`paged.page_digests`) present in the report's
+    ``prefix_digest`` block.  0 when the replica publishes no block
+    (dense replica) or nothing matches — chains hashed with a
+    different page size simply never match (the running crc covers
+    different byte spans), so a mixed fleet degrades to no affinity
+    rather than wrong affinity."""
+    pd = report.get("prefix_digest")
+    if not pd or not digests:
+        return 0
+    have = pd.get("digests") or ()
+    if not have:
+        return 0
+    have = set(have)
+    depth = 0
+    for k, d in enumerate(digests, 1):
+        if d in have:
+            depth = k
+    return depth
+
+
+def pick_replica(reports: Dict[str, dict], need: int,
+                 digests: Optional[List[int]] = None,
+                 exclude=()) -> Optional[str]:
+    """Pure dispatch scoring over ``/load`` reports (the router
+    contract, docs/OBSERVABILITY.md "SLO telemetry and the /load
+    report"); returns the chosen replica name, or None when no report
+    is a candidate.
+
+    Reading rules honored here: only ``version == 1`` documents count;
+    ``draining`` replicas are never candidates; ``headroom_tokens`` is
+    "would this request fit RIGHT NOW" as one comparison.  Scoring:
+    among replicas whose headroom admits ``need``, deepest
+    ``prefix_digest`` affinity match first (repeat tenants land on the
+    replica already holding their pages), then most headroom, then
+    shortest queue, then fewest active slots; when NOBODY has headroom
+    the request queues on the least-loaded replica (shortest queue
+    first — engines admit FIFO, so queue depth bounds the wait).  Name
+    order breaks remaining ties, so equal fleets dispatch
+    deterministically."""
+    cands = []
+    for name in sorted(reports):
+        rep = reports[name]
+        if name in exclude or not isinstance(rep, dict):
+            continue
+        if rep.get("version") != 1 or rep.get("draining"):
+            continue
+        adm = rep.get("admission") or {}
+        head = int(adm.get("headroom_tokens") or 0)
+        depth = int((rep.get("queue") or {}).get("depth") or 0)
+        active = int((rep.get("slots") or {}).get("active") or 0)
+        aff = affinity_depth(rep, digests) if digests else 0
+        cands.append((name, head, depth, active, aff))
+    if not cands:
+        return None
+    fits = [c for c in cands if c[1] >= need]
+    if fits:
+        best = min(fits, key=lambda c: (-c[4], -c[1], c[2], c[3], c[0]))
+    else:
+        best = min(cands, key=lambda c: (c[2], c[3], -c[1], c[0]))
+    return best[0]
+
+
+class _Replica:
+    """Router-side record for one replica handle."""
+
+    __slots__ = ("name", "handle", "breaker", "draining", "g_breaker",
+                 "beacon")
+
+    def __init__(self, name, handle, breaker, g_breaker):
+        self.name = name
+        self.handle = handle
+        self.breaker = breaker
+        self.draining = False
+        self.g_breaker = g_breaker     # fleet_breaker_state child
+        # liveness-beacon key: engines heartbeat under their OWN
+        # engine_id, which may differ from the router-side name
+        # (add_replica(name=...)) — keying the staleness gate on the
+        # wrong string would silently disable it for that replica
+        self.beacon = f"serving.{getattr(handle, 'engine_id', name)}"
+
+
+class FleetRequest:
+    """Router-side request handle: re-pointable across replicas until
+    the first token streams.
+
+    Mirrors the engine :class:`Request` surface — ``wait(timeout)`` →
+    done, ``result()`` raises-or-returns, ``.tokens``/``.done``/
+    ``.error`` — plus fleet provenance: ``.replica`` (current
+    placement) and ``.retries`` (re-dispatch count).  Terminal fleet
+    failures (:class:`NoReplicaAvailableError`,
+    :class:`StreamInterruptedError`) surface through ``.error`` /
+    ``result()`` exactly like engine failures.
+
+    Recovery runs lazily inside ``wait()``/the stream iterator: when
+    the current engine request dies, the waiter calls the router back
+    — the router re-dispatches a not-yet-started request (zero
+    committed tokens) to another replica, and fails a started one
+    loudly.  The per-request RLOCK is held across the whole recovery
+    (decision + re-placement), so concurrent waiters serialize on it
+    and exactly one performs the recovery — the rest observe the new
+    generation when it releases.  (No ``__slots__``: the race
+    sanitizer's ``share_object`` shim needs a swappable class
+    layout.)"""
+
+    def __init__(self, router, prompt, max_new_tokens, kw, deadline_s,
+                 stream):
+        self._router = router
+        self.prompt = np.asarray(prompt, np.int32).reshape(-1)
+        self.max_new_tokens = int(max_new_tokens)
+        self._kw = kw                      # sampling overrides
+        self.deadline_s = deadline_s
+        self._t_submit = time.perf_counter()
+        # RLock: _recover holds it across _place, which re-acquires it
+        # to install the new placement
+        self._lock = make_rlock("fleet.request")
+        self._req = None                   # current engine Request
+        self._replica = None
+        self._retries = 0
+        self._failed: Optional[BaseException] = None
+        self._stream_q = (queue.Queue(maxsize=router.stream_queue_tokens)
+                          if stream else None)
+        # consumer-gone latch: once set, on_token drops tokens instead
+        # of backpressuring a tick loop nobody is reading from.
+        # Written by the consumer/put-timeout, read by the engine's
+        # driver thread — single aligned bool, declared atomic to the
+        # race sanitizer below.
+        self._closed = False
+        share_object(self, f"fleet.request[{id(self)}]",
+                     atomic=("_closed",))
+
+    # -- engine-Request-compatible surface --------------------------------
+    def _settle(self):
+        """Resolve any terminal-looking engine error through the
+        router's recovery BEFORE exposing state: poll-style consumers
+        (``done``/``error``/``result``) must get the same failover
+        ``wait()``/``stream()`` perform, or a recoverable replica
+        death would leak out as terminal to anyone who didn't block.
+        Returns the settled ``(req, failed)`` pair."""
+        while True:
+            with self._lock:
+                req, failed = self._req, self._failed
+            if failed is not None or req is None or req.error is None:
+                return req, failed
+            # _recover serializes on the request lock and, by the time
+            # it returns, has either recorded a terminal _failed or
+            # installed a new placement — loop to look at that
+            self._router._recover(self, req)
+
+    @property
+    def done(self) -> bool:
+        req, failed = self._settle()
+        if failed is not None:
+            return True
+        return bool(req is not None and req.done)
+
+    @property
+    def error(self) -> Optional[BaseException]:
+        return self._settle()[1]
+
+    @property
+    def tokens(self) -> List[int]:
+        with self._lock:
+            req = self._req
+        return list(req.tokens) if req is not None else []
+
+    @property
+    def rid(self):
+        with self._lock:
+            return self._req.rid if self._req is not None else None
+
+    # provenance reads take the request lock like the rest of the
+    # surface: a recovery on another thread re-points these mid-flight
+    @property
+    def replica(self) -> Optional[str]:
+        with self._lock:
+            return self._replica
+
+    @property
+    def retries(self) -> int:
+        with self._lock:
+            return self._retries
+
+    def wait(self, timeout=None) -> bool:
+        """Block until the request is terminal (finished, or failed
+        beyond recovery); replica deaths are recovered HERE — the
+        waiter is the thread with nothing better to do."""
+        end = None if timeout is None else time.monotonic() + timeout
+        while True:
+            with self._lock:
+                req, failed = self._req, self._failed
+            if failed is not None:
+                return True
+            rem = None if end is None else max(0.0, end - time.monotonic())
+            req._event.wait(rem)
+            if not req._event.is_set():
+                return False               # caller's timeout
+            if req.error is None:
+                return True                # finished clean
+            self._router._recover(self, req)
+
+    def result(self):
+        """Full sequence (prompt + generated) or raise the terminal
+        error — same contract as ``Request.result`` (recoverable
+        replica deaths are settled through the router first)."""
+        req, failed = self._settle()
+        if failed is not None:
+            raise failed
+        if req is None:
+            raise RuntimeError("request was never placed")
+        return req.result()
+
+    # -- streaming --------------------------------------------------------
+    def _on_token(self, tok, gen):
+        """Engine-side hook (replica driver thread, engine lock NOT
+        held; ``_try_dispatch`` binds ``gen`` per placement).  The
+        BOUNDED blocking put is the backpressure: a slow consumer
+        stalls that replica's decode loop.  A consumer that stopped
+        reading entirely (put times out / generator closed) flips
+        ``_closed`` and the stream detaches — the engine finishes the
+        request normally rather than wedging its tick loop.
+
+        Entries are ``(generation, token-or-None)``: a failover leaves
+        the dead placement's terminal ``None`` in the queue with NO
+        ordering guarantee against the survivor's entries (two engine
+        threads flush independently), so the consumer needs the tag to
+        tell a stale terminal from the live generation's real end."""
+        if self._closed:
+            return
+        try:
+            self._stream_q.put((gen, tok),
+                               timeout=self._router.stream_put_timeout_s)
+        except queue.Full:
+            self._closed = True
+
+    def stream(self):
+        """Generator yielding committed token ids as the fleet produces
+        them; returns on clean finish, raises the terminal error
+        (recovering replica deaths for not-yet-started requests along
+        the way).  Closing the generator detaches the stream — the
+        request keeps running, ``wait()``/``result()`` still work."""
+        if self._stream_q is None:
+            raise RuntimeError("not a streaming request; use "
+                               "submit_stream()")
+        try:
+            while True:
+                try:
+                    gen, tok = self._stream_q.get(timeout=0.25)
+                except queue.Empty:
+                    if self._closed:
+                        # the backpressure timeout detached this stream
+                        # while the consumer was away: tokens (and the
+                        # terminal) were DROPPED, so resuming the
+                        # iterator can never deliver a complete stream
+                        # — fail loudly; wait()/result() still return
+                        # the full output
+                        raise StreamInterruptedError(
+                            "stream detached after the backpressure "
+                            "put timeout (consumer stopped reading); "
+                            "tokens were dropped — use wait()/result() "
+                            "for the complete output")
+                    with self._lock:
+                        failed = self._failed
+                    if failed is not None:
+                        raise failed
+                    continue
+                if tok is not None:
+                    yield tok
+                    continue
+                # a terminal: clean end, recoverable death, a loud
+                # failure — or STALE (a dead generation's, possibly
+                # enqueued out of order against the live placement's
+                # entries; the live placement feeds the same queue)
+                with self._lock:
+                    req, failed, cur = self._req, self._failed, \
+                        self._retries
+                if failed is not None:
+                    raise failed
+                if gen != cur:
+                    continue              # stale terminal: keep draining
+                if req.error is None:
+                    # the live generation's own terminal: its engine
+                    # appends it under the lock that set done/error and
+                    # flushes in order, so this really is the end
+                    return
+                self._router._recover(self, req)
+                with self._lock:
+                    failed = self._failed
+                if failed is not None:
+                    raise failed
+                # recovered onto a fresh replica: keep draining the
+                # same queue — the new placement feeds it
+        finally:
+            self._closed = True
+
+
+class FleetRouter:
+    """Health-driven replica router: least-loaded + cache-affinity
+    dispatch, deadlines/retry/backoff, circuit breaking, graceful
+    drain, per-token streaming (module docstring has the full design;
+    docs/SERVING.md "Serving fleet" the operator view).
+
+    Args:
+      replicas: engine handles to front (``add_replica`` adds more
+        later).  In-process ``ServingEngine`` objects, or anything
+        speaking the same surface.
+      max_retries: placement attempts per request beyond the first
+        (dispatch failures back off exponentially from ``backoff_s`` by
+        ``backoff_mult``).
+      health_max_age_s: a replica whose liveness beacon
+        (``serving.<engine_id>``) is older than this is treated as
+        wedged (same rule as ``/healthz?max_age``); an ABSENT beacon is
+        fine — idle engines drop theirs by design.
+      breaker_failures / breaker_probe_interval_s: circuit-breaker
+        threshold and cool-down (:class:`CircuitBreaker`).
+      policy: ``"least_loaded"`` (default; headroom + affinity scoring
+        via :func:`pick_replica`) or ``"round_robin"`` (rotation over
+        healthy replicas — the affinity A/B baseline, not a production
+        policy).
+      stream_queue_tokens / stream_put_timeout_s: streaming
+        backpressure bound and the consumer-gone detach timeout.
+    """
+
+    def __init__(self, replicas=(), *, max_retries: int = 2,
+                 backoff_s: float = 0.02, backoff_mult: float = 2.0,
+                 health_max_age_s: float = 10.0,
+                 breaker_failures: int = 3,
+                 breaker_probe_interval_s: float = 1.0,
+                 policy: str = "least_loaded",
+                 stream_queue_tokens: int = 64,
+                 stream_put_timeout_s: float = 30.0):
+        if policy not in ("least_loaded", "round_robin"):
+            raise ValueError(f"policy must be 'least_loaded' or "
+                             f"'round_robin', got {policy!r}")
+        self.max_retries = int(max_retries)
+        self.backoff_s = float(backoff_s)
+        self.backoff_mult = float(backoff_mult)
+        self.health_max_age_s = float(health_max_age_s)
+        self.breaker_failures = int(breaker_failures)
+        self.breaker_probe_interval_s = float(breaker_probe_interval_s)
+        self.policy = policy
+        self.stream_queue_tokens = int(stream_queue_tokens)
+        self.stream_put_timeout_s = float(stream_put_timeout_s)
+
+        self._lock = make_lock("fleet.router")
+        self._replicas: Dict[str, _Replica] = {}
+        self._rr = 0                      # round_robin rotation cursor
+        self.fleet_id = f"f{next(_FLEET_IDS)}"
+        self._flight = _flight.get_flight_recorder()
+
+        reg = self._registry = _obs.get_registry()
+        lbl = {"fleet": self.fleet_id}
+        self._fam_dispatch = reg.counter(
+            "fleet_dispatch_total",
+            "dispatch attempts by replica and outcome (ok / error / "
+            "stale / probe_error / draining)")
+        self._c_retries = reg.counter(
+            "fleet_retries_total",
+            "request re-dispatches (placement retries + replica-death "
+            "failovers)").labels(**lbl)
+        self._fam_breaker = reg.gauge(
+            "fleet_breaker_state",
+            "per-replica circuit breaker (0 closed / 1 half-open / "
+            "2 open)")
+        self._g_draining = reg.gauge(
+            "fleet_draining", "replicas currently draining").labels(**lbl)
+        self._g_draining.set(0)
+
+        for r in replicas:
+            self.add_replica(r)
+        # first consumer of the race tooling: every attr above is
+        # mutated under _lock; the registry/flight handles hold their
+        # own locks
+        share_object(self, f"fleet.router[{self.fleet_id}]")
+        _tr.register_introspection_source(self.fleet_id, self)
+
+    # ------------------------------------------------------------------
+    def add_replica(self, handle, name: Optional[str] = None) -> str:
+        """Register a replica; returns its fleet name (the engine's
+        ``engine_id`` unless overridden)."""
+        name = name or getattr(handle, "engine_id", None)
+        if name is None:
+            raise ValueError("replica has no engine_id; pass name=")
+        g = self._fam_breaker.labels(fleet=self.fleet_id, replica=name)
+        g.set(BREAKER_CLOSED)
+        rep = _Replica(name, handle,
+                       CircuitBreaker(self.breaker_failures,
+                                      self.breaker_probe_interval_s), g)
+        with self._lock:
+            if name in self._replicas:
+                raise ValueError(f"replica {name!r} already registered")
+            self._replicas[name] = rep
+        return name
+
+    def replica_names(self) -> List[str]:
+        with self._lock:
+            return list(self._replicas)
+
+    def _count(self, name: str, outcome: str) -> None:
+        self._fam_dispatch.labels(
+            fleet=self.fleet_id, replica=name, outcome=outcome).inc()
+
+    # ------------------------------------------------------------------
+    # health + capacity
+    def _health_ok(self, rep: _Replica) -> bool:
+        """Staleness gate, the ``/healthz?max_age`` rule: a beacon
+        older than ``health_max_age_s`` means the replica's loop is
+        wedged mid-work — don't feed it.  No beacon = idle or external
+        replica = fine (idle engines drop theirs by design).  The
+        per-replica ``fleet.stale_health[<name>]`` fault point makes
+        "replica goes stale" a deterministic drill."""
+        try:
+            _faults.point(f"fleet.stale_health[{rep.name}]")
+        except _faults.InjectedFault:
+            return False
+        age = _tr.beacon_ages().get(rep.beacon)
+        return age is None or age <= self.health_max_age_s
+
+    def _probe_load(self, rep: _Replica) -> Optional[dict]:
+        """One capacity poll (the in-process ``/load`` read).  None on
+        failure — the caller books it against the breaker."""
+        _faults.point(f"fleet.load_probe[{rep.name}]")
+        return rep.handle.load_report()
+
+    # pht-lint: hot-root (fleet dispatch path — every request crosses it)
+    def _candidates(self):
+        """Health- and breaker-gated replicas with their fresh load
+        reports.  Breaker decisions run under the router lock; the
+        probes run OUTSIDE it (a replica's load_report takes the
+        engine lock — never nest it under ours while other submitters
+        wait)."""
+        now = time.monotonic()
+        with self._lock:
+            reps = [r for r in self._replicas.values() if not r.draining]
+            allowed = [r for r in reps if r.breaker.allows(now)]
+            for r in allowed:
+                r.g_breaker.set(r.breaker.state)
+        out = []
+        for rep in allowed:
+            if not self._health_ok(rep):
+                self._count(rep.name, "stale")
+                self._record_failure(rep)
+                continue
+            try:
+                report = self._probe_load(rep)
+            except Exception:  # noqa: BLE001 — probe failure is data
+                self._count(rep.name, "probe_error")
+                self._record_failure(rep)
+                continue
+            if not isinstance(report, dict) or report.get("version") != 1:
+                # the router contract: consumers must check version
+                self._count(rep.name, "probe_error")
+                self._record_failure(rep)
+                continue
+            if report.get("draining"):
+                # replica-side drain (someone called engine.drain()
+                # directly): honor it without a breaker penalty.  The
+                # record is HELD as draining — dispatch stops now, and
+                # the operator completes the removal with
+                # router.drain(name) (idempotent against an already-
+                # draining engine), which also returns fleet_draining
+                # to 0.  Auto-removing here would shutdown() an engine
+                # the operator may still be watching drain.
+                self._mark_draining(rep)
+                continue
+            out.append((rep, report))
+        return out
+
+    def _mark_draining(self, rep: _Replica) -> None:
+        """Stop dispatching to ``rep`` and publish the fleet_draining
+        gauge — the one place the draining flag is set (router drain,
+        replica-side drain observed by a probe, EngineDraining on
+        submit)."""
+        with self._lock:
+            rep.draining = True
+            self._g_draining.set(
+                sum(r.draining for r in self._replicas.values()))
+
+    def _record_failure(self, rep: _Replica) -> None:
+        with self._lock:
+            rep.breaker.record_failure(time.monotonic())
+            rep.g_breaker.set(rep.breaker.state)
+
+    def _record_success(self, rep: _Replica) -> None:
+        with self._lock:
+            rep.breaker.record_success()
+            rep.g_breaker.set(rep.breaker.state)
+
+    # pht-lint: hot-root (fleet dispatch path)
+    def _try_dispatch(self, freq: FleetRequest, exclude) -> bool:
+        """One placement attempt; True when the request landed.  False
+        = no candidate right now (retry may help); raises on a submit
+        failure (booked against that replica's breaker) so the retry
+        loop backs off before trying again."""
+        _faults.point("fleet.dispatch")
+        cands = self._candidates()
+        by_name = {rep.name: (rep, report) for rep, report in cands
+                   if rep.name not in exclude}
+        if not by_name:
+            return False
+        need = int(len(freq.prompt)) + freq.max_new_tokens
+        if self.policy == "round_robin":
+            names = sorted(by_name)
+            with self._lock:
+                name = names[self._rr % len(names)]
+                self._rr += 1
+        else:
+            digests = None
+            sizes = {(rep.get("prefix_digest") or {}).get("page_size")
+                     for _, rep in by_name.values()}
+            sizes.discard(None)
+            if len(sizes) == 1:
+                # one fleet-wide page size (the deployment norm): hash
+                # the prompt once.  Mixed page sizes would need one
+                # chain per size — affinity is skipped instead of
+                # guessed (docs/SERVING.md).
+                digests = page_digests(freq.prompt, sizes.pop())
+            name = pick_replica(
+                {n: rep for n, (_, rep) in by_name.items()}, need,
+                digests=digests)
+            if name is None:
+                return False
+        rep, _report = by_name[name]
+        deadline_rem = None
+        if freq.deadline_s is not None:
+            # the engine measures from ITS submit stamp: hand the
+            # replica only what remains of the caller's total budget
+            deadline_rem = freq.deadline_s - (time.perf_counter()
+                                              - freq._t_submit)
+            if deadline_rem <= 0:
+                raise DeadlineExceededError(
+                    f"request spent its whole deadline_s="
+                    f"{freq.deadline_s} before a replica accepted it")
+        with self._lock:
+            # atomic re-check + probe claim: _candidates gated on
+            # allows() BEFORE the unlocked health/probe window, so a
+            # concurrent dispatch may have claimed the half-open probe
+            # (or re-opened the breaker) since — "exactly one probe"
+            # is enforced here, at the dispatch decision, under the
+            # router lock
+            if not rep.breaker.allows(time.monotonic()):
+                return False
+            rep.breaker.on_dispatch()     # half-open: this IS the probe
+        on_token = None
+        if freq._stream_q is not None:
+            # bind THIS placement's generation (the re-dispatch count:
+            # _recover bumps it before re-placing, and the initial
+            # placement happens-before any recovery) so the stream
+            # consumer can tell a dead generation's stale terminal
+            # from the live one's real end
+            with freq._lock:
+                gen = freq._retries
+
+            def on_token(tok, _freq=freq, _gen=gen):
+                _freq._on_token(tok, _gen)
+        try:
+            req = rep.handle.submit(
+                freq.prompt, freq.max_new_tokens,
+                deadline_s=deadline_rem,
+                on_token=on_token,
+                **freq._kw)
+        except EngineDraining:
+            # not a failure: mark and let the retry pick elsewhere
+            self._mark_draining(rep)
+            self._count(rep.name, "draining")
+            return False
+        except Exception as e:
+            self._count(rep.name, "error")
+            self._record_failure(rep)
+            # this episode must not re-pick the replica that just
+            # refused (the breaker only opens after a streak): the
+            # caller owns the mutable exclude set
+            exclude.add(rep.name)
+            raise e
+        self._count(rep.name, "ok")
+        self._record_success(rep)
+        with freq._lock:
+            freq._req = req
+            freq._replica = rep.name
+        self._flight.record(
+            "fleet", phase="dispatch", fleet=self.fleet_id,
+            replica=rep.name, rid=req.rid, retries=freq.retries)
+        return True
+
+    def _place(self, freq: FleetRequest, exclude=(),
+               is_retry: bool = False) -> None:
+        """Bounded retry-with-backoff around :meth:`_try_dispatch`.
+        A replica whose submit raised is excluded for the REST of this
+        placement episode (the breaker only opens after a streak — one
+        episode must not burn its whole budget on one broken replica).
+        When every replica is excluded the last attempts run
+        un-excluded: with the fleet degraded that far, a replica that
+        failed earlier in the episode beats refusing outright.  Raises
+        :class:`NoReplicaAvailableError` (last failure as cause) when
+        the budget is spent, :class:`DeadlineExceededError` when the
+        caller's budget died first."""
+        exclude = set(exclude)
+        last_err = None
+        delay = self.backoff_s
+        for attempt in range(self.max_retries + 1):
+            if attempt or is_retry:
+                self._c_retries.inc()
+            if attempt:
+                time.sleep(delay)
+                delay *= self.backoff_mult
+            if exclude >= set(self.replica_names()):
+                exclude = set()     # whole fleet excluded: start over
+            try:
+                if self._try_dispatch(freq, exclude):
+                    return
+            except DeadlineExceededError:
+                raise
+            except Exception as e:  # noqa: BLE001 — injected fault or
+                last_err = e        # submit error: retry elsewhere
+        raise NoReplicaAvailableError(
+            f"no replica accepted the request after "
+            f"{self.max_retries + 1} attempts "
+            f"(replicas={self.replica_names()}, excluded={sorted(exclude)})"
+        ) from last_err
+
+    # ------------------------------------------------------------------
+    # public submission surface
+    def submit(self, prompt, max_new_tokens: int = 32, *,
+               temperature=None, top_k=None, top_p=None,
+               deadline_s=None, stream: bool = False) -> FleetRequest:
+        """Dispatch a request to the best replica (module docstring has
+        the scoring); returns a :class:`FleetRequest`.  Raises
+        :class:`NoReplicaAvailableError` when no replica accepts within
+        the retry budget."""
+        freq = FleetRequest(
+            self, prompt, max_new_tokens,
+            {"temperature": temperature, "top_k": top_k, "top_p": top_p},
+            None if deadline_s is None else float(deadline_s), stream)
+        try:
+            self._place(freq)
+        except BaseException as e:
+            with freq._lock:
+                freq._failed = e
+            raise
+        return freq
+
+    def submit_stream(self, prompt, max_new_tokens: int = 32, **kw):
+        """Per-token streaming: returns a generator yielding token ids
+        as the serving engine commits them, through a bounded queue
+        whose blocking put is the backpressure (a slow consumer stalls
+        the producing replica's decode loop — never the router).  When
+        you also need the request handle (``.retries``, ``.replica``),
+        use ``submit(..., stream=True)`` and call ``.stream()`` on
+        it — this helper is the common one-liner."""
+        return self.submit(prompt, max_new_tokens, stream=True,
+                           **kw).stream()
+
+    def _recover(self, freq: FleetRequest, req) -> None:
+        """A replica failed ``req`` (engine loop death, deadline, ...):
+        decide the FleetRequest's fate.  Serialized per request by its
+        lock; idempotent — late waiters observing an already-recovered
+        generation return immediately.
+
+        - deadline aborts are terminal (the caller's budget died, not
+          the replica);
+        - a STARTED stream (committed tokens exist) fails loudly
+          (:class:`StreamInterruptedError`) — re-running it would
+          duplicate output;
+        - a not-yet-started request books a breaker failure against the
+          dead replica and re-dispatches everywhere else."""
+        with freq._lock:
+            if freq._req is not req or freq._failed is not None:
+                return                    # another waiter already did it
+            if req.error is None:
+                # nothing to recover: a stream consumer can get here on
+                # a STALE queue terminal (the dead generation's fail-all
+                # enqueued None, another waiter already re-placed the
+                # request) — recovering a healthy placement would book a
+                # breaker failure against a live replica and double-
+                # place the request
+                return
+            failed_on = freq._replica
+            if isinstance(req.error, DeadlineExceededError):
+                freq._failed = req.error
+                return
+            if req.tokens:
+                freq._failed = StreamInterruptedError(
+                    f"replica {failed_on} died after streaming "
+                    f"{len(req.tokens)} token(s) of this request; not "
+                    f"re-dispatched — a retry would silently duplicate "
+                    f"output the caller already consumed")
+                freq._failed.__cause__ = req.error
+                self._wake_stream(freq)
+                return
+            freq._retries += 1
+            # the replica broke a placed request: that is a health
+            # event even though the submit itself succeeded earlier
+            with self._lock:
+                rep = self._replicas.get(failed_on)
+            if rep is not None:
+                self._record_failure(rep)
+            self._flight.record(
+                "fleet", phase="failover", fleet=self.fleet_id,
+                replica=failed_on, rid=req.rid)
+            try:
+                # re-dispatch AWAY from the dead replica.  Still inside
+                # freq._lock (an RLock): concurrent waiters block here
+                # until the new generation is installed, so exactly one
+                # recovery runs.  Concurrent SUBMITS keep flowing —
+                # they never touch this request's lock.
+                self._place(freq, exclude=frozenset((failed_on,)),
+                            is_retry=True)
+            except BaseException as e:
+                freq._failed = e
+                self._wake_stream(freq)
+
+    @staticmethod
+    def _wake_stream(freq: FleetRequest) -> None:
+        """Wake a consumer blocked in the stream queue so it observes
+        the terminal state now, not at its next poll timeout.  The
+        generation-less tag never matches the consumer's current
+        generation — the entry exists only to unblock the get(); the
+        consumer reads the real terminal from ``_failed``."""
+        if freq._stream_q is not None:
+            try:
+                freq._stream_q.put_nowait((None, None))
+            except queue.Full:
+                pass
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    def drain(self, name: str, timeout: float = 60.0) -> None:
+        """Gracefully remove replica ``name``: stop dispatching to it
+        immediately, let its queued + inflight requests finish
+        (``handle.drain``), then ``handle.shutdown()`` and forget it —
+        a planned removal loses zero requests (the fault-drill twin is
+        the UNPLANNED removal, where failover does the work).
+
+        A FAILED drain (backlog outlived ``timeout``, or the engine
+        crashed mid-drain and raised) leaves the replica REGISTERED and
+        marked draining: the router keeps refusing to dispatch there,
+        the operator retries ``drain`` or escalates to the replica's
+        own ``shutdown`` — silently forgetting a live engine would
+        leave its daemon loop to die at interpreter exit mid-device
+        call.  Success removes the replica and drops its labelled
+        series (replica churn must not grow the registry forever)."""
+        with self._lock:
+            rep = self._replicas.get(name)
+            if rep is None:
+                raise KeyError(f"no replica {name!r} "
+                               f"(have {sorted(self._replicas)})")
+        self._mark_draining(rep)
+        self._flight.record("fleet", phase="drain", fleet=self.fleet_id,
+                            replica=name)
+        # ONE budget for the whole removal: shutdown gets what the
+        # backlog drain left, not a fresh full timeout (an operator
+        # watchdog sized to `timeout` must not fire mid-removal).  The
+        # small floor lets the engine's loop-stopped poll run at least
+        # once — after a completed drain it passes immediately.
+        end = time.monotonic() + float(timeout)
+        rep.handle.drain(timeout=timeout)
+        rep.handle.shutdown(timeout=max(0.05, end - time.monotonic()))
+        with self._lock:
+            self._replicas.pop(name, None)
+            self._g_draining.set(
+                sum(r.draining for r in self._replicas.values()))
+        self._registry.drop_labels(fleet=self.fleet_id, replica=name)
+
+    def shutdown(self, timeout: float = 60.0) -> None:
+        """Hard stop: shut every replica down (no drain — use
+        :meth:`drain` per replica for graceful removal), unregister the
+        router's introspection source and drop its labelled series
+        (router churn must not grow the process registry forever)."""
+        with self._lock:
+            reps = list(self._replicas.values())
+            self._replicas.clear()
+            self._g_draining.set(0)
+        for rep in reps:
+            try:
+                rep.handle.shutdown(timeout=timeout)
+            except Exception:  # noqa: BLE001 — best-effort teardown
+                pass
+        _tr.unregister_introspection_source(self.fleet_id)
+        self._registry.drop_labels(fleet=self.fleet_id)
+
+    def introspect_requests(self) -> dict:
+        """Router table for ``/debug/requests``: per-replica breaker
+        state, draining flag, failure streak (snapshot under the
+        router lock; host dicts only)."""
+        state_names = {BREAKER_CLOSED: "closed",
+                       BREAKER_HALF_OPEN: "half_open",
+                       BREAKER_OPEN: "open"}
+        with self._lock:
+            replicas = {
+                name: {"breaker": state_names[r.breaker.state],
+                       "consecutive_failures":
+                           r.breaker.consecutive_failures,
+                       "draining": r.draining}
+                for name, r in self._replicas.items()}
+        return {"fleet": self.fleet_id, "policy": self.policy,
+                "replicas": replicas}

@@ -30,6 +30,8 @@ side (pools, page tables, the gather/scatter attention) lives in
 
 from __future__ import annotations
 
+import heapq
+import zlib
 from typing import List, Optional
 
 import numpy as np
@@ -50,6 +52,30 @@ def pages_for(need: int, reserve: int, page_size: int) -> int:
     exactly when the window straddles)."""
     last_row = need + reserve - 2
     return last_row // page_size + 1
+
+
+def page_digests(prompt, page_size: int) -> List[int]:
+    """Running crc32 digest per page-aligned prefix of ``prompt``: entry
+    ``k-1`` covers tokens ``[0, k*page_size)``, capped at
+    ``(len(prompt) - 1) // page_size`` full pages (the same cap
+    :meth:`PrefixCache.match` applies — the engine must re-prefill at
+    least the last prompt token).
+
+    Bytes-identical to the chain digests :meth:`PrefixCache.digests`
+    publishes through the ``/load`` report's ``prefix_digest`` block
+    (each radix node's digest is the crc32 of the concatenated int32
+    page-key bytes from the root), so set membership answers "does this
+    replica already hold my prompt's first k pages" without shipping
+    token content — the fleet router's cache-affinity signal
+    (``inference/fleet.py``)."""
+    prompt = np.asarray(prompt, np.int32).reshape(-1)
+    P = int(page_size)
+    limit = max(0, (len(prompt) - 1) // P)
+    out, crc = [], 0
+    for k in range(limit):
+        crc = zlib.crc32(prompt[k * P:(k + 1) * P].tobytes(), crc)
+        out.append(crc)
+    return out
 
 
 def tokens_admittable(free_pages: int, reserve: int, page_size: int) -> int:
@@ -146,7 +172,7 @@ class PagePool:
 
 
 class _Node:
-    __slots__ = ("key", "page", "parent", "children", "stamp")
+    __slots__ = ("key", "page", "parent", "children", "stamp", "digest")
 
     def __init__(self, key, page, parent):
         self.key = key
@@ -154,6 +180,11 @@ class _Node:
         self.parent = parent
         self.children = {}
         self.stamp = 0
+        # chain digest root->node: crc32 over the concatenated page-key
+        # bytes, computed incrementally (crc32's running-start form) —
+        # equals page_digests(prompt, P)[depth-1] for the prompt whose
+        # pages this chain holds
+        self.digest = zlib.crc32(key, parent.digest if parent else 0)
 
 
 class PrefixCache:
@@ -242,6 +273,23 @@ class PrefixCache:
                 self._nodes.append(node)
             node.stamp = self._clock
             children, parent = node.children, node
+
+    def digests(self, limit: int = 64) -> List[int]:
+        """Chain digests (see :func:`page_digests`) of up to ``limit``
+        most-recently-touched nodes — the bounded ``prefix_digest``
+        block the engine's ``/load`` report publishes.  A router hashes
+        a prompt's page-aligned prefixes the same way and matches the
+        deepest digest present here: that replica already holds those
+        KV pages, so dispatching the request to it skips re-prefilling
+        them (cache-affinity).  Bounded so a huge cache never bloats the
+        capacity document; recency order keeps the entries that are
+        still likely resident when the routed request lands.  Runs
+        under the engine lock on every load probe (the router polls per
+        dispatch), so it selects the top ``limit`` by stamp in
+        O(n log limit) instead of fully sorting the node list."""
+        top = heapq.nlargest(int(limit), self._nodes,
+                             key=lambda nd: nd.stamp)
+        return [nd.digest for nd in top]
 
     def evict(self, n: int) -> int:
         """Free up to ``n`` pages by dropping LRU leaves nobody else

@@ -319,11 +319,21 @@ def load_for_serving(path):
 
 
 class DeadlineExceededError(RuntimeError):
-    """A request sat in the admission queue past its
-    ``submit(deadline_s=)`` budget and was aborted un-served: waiting
-    longer can only return an answer the caller has already given up
-    on.  Counted into ``serving_aborted_tokens_total`` and stamped
-    ``t_abort``/``where="queued"`` on the request's lifecycle record."""
+    """A request blew past its ``submit(deadline_s=)`` budget — either
+    still queued (queue-wait is where overload deadlines actually die)
+    or mid-decode — and was aborted: waiting longer can only return an
+    answer the caller has already given up on.  Generated-so-far tokens
+    are counted into ``serving_aborted_tokens_total`` and the lifecycle
+    record is stamped ``t_abort``/``where="deadline"`` (also visible in
+    ``/debug/requests`` under ``recent_aborts``)."""
+
+
+class EngineDraining(RuntimeError):
+    """:meth:`ServingEngine.submit` was called on a draining engine.
+    :meth:`ServingEngine.drain` stops admission while queued + inflight
+    requests run to completion — the graceful half of removal (hard
+    ``shutdown(timeout=)`` is the other half).  A fleet router treats
+    this as "place elsewhere", never as a replica failure."""
 
 
 class Request:
@@ -341,15 +351,24 @@ class Request:
     land next to them so callers never re-derive.  Plain data on the
     request object, NOT metric labels: per-request ids as labels would
     mint one time series per request and grow the registry without
-    bound (pht-lint PHT005)."""
+    bound (pht-lint PHT005).
+
+    ``on_token`` is the per-token streaming hand-off: a callable the
+    engine invokes with each committed token id, then exactly once with
+    ``None`` at the request's terminal (finish, abort, or loop
+    failure).  Calls run on the engine's driver thread AFTER the engine
+    lock is released, so a hook that blocks (a bounded queue doing
+    backpressure — the fleet router's ``submit_stream``) stalls only
+    the decode loop, never ``submit()``/introspection."""
 
     __slots__ = ("prompt", "max_new_tokens", "tokens", "done", "error",
                  "temperature", "top_k", "top_p", "_event",
                  "_t_submit", "_t_first", "rid", "_span_queue",
-                 "_span_life", "lifecycle", "_tick_mark", "deadline_s")
+                 "_span_life", "lifecycle", "_tick_mark", "deadline_s",
+                 "on_token")
 
     def __init__(self, prompt, max_new_tokens, temperature=None,
-                 top_k=None, top_p=None, deadline_s=None):
+                 top_k=None, top_p=None, deadline_s=None, on_token=None):
         self.rid = next(_REQ_IDS)   # process-wide request id (spans/flight)
         self.prompt = np.asarray(prompt, np.int32).reshape(-1)
         self.max_new_tokens = int(max_new_tokens)
@@ -357,6 +376,7 @@ class Request:
         self.top_k = None if top_k is None else int(top_k)
         self.top_p = None if top_p is None else float(top_p)
         self.deadline_s = None if deadline_s is None else float(deadline_s)
+        self.on_token = on_token
         self.tokens: List[int] = []  # generated so far
         self.done = False
         self.error: Optional[BaseException] = None
@@ -474,6 +494,11 @@ class ServingEngine:
         "SLO telemetry and the /load report").
     """
 
+    # bounded count of radix-cache chain digests the /load report's
+    # prefix_digest block carries (class attr so a deployment with a
+    # huge shared-prefix population can widen it)
+    PREFIX_DIGEST_LIMIT = 64
+
     def __init__(self, model, max_slots=8, max_len=512, chunk=16,
                  temperature=0.0, top_k=None, eos_token_id=None,
                  auto_run=True, decode_window=8, top_p=None, spec_k=0,
@@ -514,6 +539,24 @@ class ServingEngine:
 
         self._lock = make_lock("serving.engine")
         self._pending = collections.deque()
+        # graceful-removal flag (drain()): submit refuses, queued +
+        # inflight requests run to completion, then the loop idles out
+        self._draining = False
+        # terminal loop-crash record (the fail-all path stamps it): a
+        # drain() in progress must report the crash — the backlog was
+        # FAILED, not completed — instead of reading the emptied
+        # slots/queue as a clean drain
+        self._crashed = None
+        # per-token streaming hand-off buffer: (req, token|None) pairs
+        # appended under the engine lock by the commit/abort paths and
+        # delivered by _flush_streams on the driver thread AFTER the
+        # lock is released (a blocking on_token — bounded-queue
+        # backpressure — must stall only the decode loop)
+        self._stream_emit = []
+        # bounded terminal-abort ring for /debug/requests: aborted
+        # requests leave the slot table immediately, so the debug
+        # surface needs its own short memory of WHERE they died
+        self._recent_aborts = collections.deque(maxlen=32)
         # count of queued requests carrying a submit(deadline_s=): the
         # per-tick expiry sweep is gated on this, so the common
         # no-deadline case pays one int check, not an O(queue) scan
@@ -566,6 +609,8 @@ class ServingEngine:
             type(l).__name__ == "WeightOnlyLinear"
             for l in model.sublayers(include_self=True))
         self._init_metrics()
+        # per-replica fault point name, precomputed (probed every tick)
+        self._tick_fault_point = f"serving.tick[{self._engine_id}]"
         self._key = jax.random.key(0)
 
         self._spec = None
@@ -619,8 +664,19 @@ class ServingEngine:
         # lock-free by its only writer, the driver thread (the same
         # single-aligned-read contract the `# pht-lint: gil-atomic`
         # annotations on the _run_tick* read sites claim statically).
+        # _caches/_sampling_dev/_pt_dev/_xbuf are DRIVER-OWNED device
+        # staging: touched lock-free on the tick path by design
+        # (staging under _lock would be PHT003 lock-across-dispatch)
+        # and invalidated under the lock by admission/release — safe
+        # because the single-driver guard serializes every driver, and
+        # driver handoff (loop exit -> next burst's fresh loop thread,
+        # or sync step()) happens through _lock/_running; the Eraser
+        # model only tolerates ONE silent owner handoff, and fleet
+        # traffic restarts the loop thread per burst, so these are
+        # declared rather than false-flagged on the third driver.
         share_object(self, f"serving.engine[{self._engine_id}]",
-                     atomic=("_tickno",))
+                     atomic=("_tickno", "_caches", "_sampling_dev",
+                             "_pt_dev", "_xbuf"))
 
     # ------------------------------------------------------------------
     def _init_metrics(self):
@@ -750,6 +806,19 @@ class ServingEngine:
         self._load_debug = _LoadDebugSource(self)
         _tr.register_introspection_source(f"{self._engine_id}.load",
                                           self._load_debug)
+
+    @property
+    def engine_id(self) -> str:
+        """Stable per-process replica name (``e<N>``): the label on this
+        engine's metric series, its ``/load`` + ``/debug/requests``
+        registrations, its liveness beacon (``serving.<id>``) and its
+        per-replica fault point (``serving.tick[<id>]``) — the handle a
+        fleet router addresses this replica by."""
+        return self._engine_id
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
 
     # ------------------------------------------------------------------
     @staticmethod
@@ -1339,16 +1408,22 @@ class ServingEngine:
     # ------------------------------------------------------------------
     # scheduling
     def submit(self, prompt, max_new_tokens=32, temperature=None,
-               top_k=None, top_p=None, deadline_s=None) -> Request:
-        """Queue a request.  ``deadline_s`` bounds the ADMISSION wait: a
-        request still queued ``deadline_s`` after submit is aborted with
-        :class:`DeadlineExceededError` (``req.error``; ``req.wait()``
-        returns, ``result()`` raises) instead of waiting forever behind
-        a saturated engine — the caller has already timed out, serving
-        it would be wasted work the goodput accounting counts against
-        ``serving_aborted_tokens_total``."""
+               top_k=None, top_p=None, deadline_s=None,
+               on_token=None) -> Request:
+        """Queue a request.  ``deadline_s`` bounds the request's TOTAL
+        wall budget from submit: still queued past it (queue-wait is
+        where overload deadlines actually die) or still decoding past
+        it, the request is aborted with :class:`DeadlineExceededError`
+        (``req.error``; ``req.wait()`` returns, ``result()`` raises)
+        instead of finishing an answer the caller has already given up
+        on — aborted work counts against
+        ``serving_aborted_tokens_total``, the lifecycle record reads
+        ``where="deadline"``.  ``on_token`` streams committed tokens
+        per tick (see :class:`Request`).  A draining engine
+        (:meth:`drain`) refuses with :class:`EngineDraining`."""
         req = Request(prompt, max_new_tokens, temperature=temperature,
-                      top_k=top_k, top_p=top_p, deadline_s=deadline_s)
+                      top_k=top_k, top_p=top_p, deadline_s=deadline_s,
+                      on_token=on_token)
         need = len(req.prompt) + req.max_new_tokens
         # reserve headroom past the last committed row for the widest
         # in-flight write: a prefill chunk, or the (spec_k+1)-wide verify
@@ -1397,16 +1472,37 @@ class ServingEngine:
             "req", phase="submit", rid=req.rid, engine=self._engine_id,
             prompt_len=len(req.prompt), max_new=req.max_new_tokens)
         with self._lock:
-            self._pending.append(req)
-            if req.deadline_s is not None:
-                self._deadline_queued += 1
-            self._c["requests"].inc()
-            self._g_queue.set(len(self._pending))
-            if self.auto_run and not self._running:
-                self._running = True
-                t = threading.Thread(target=self._loop, daemon=True)
-                self._loop_thread = t
-                t.start()
+            draining = self._draining
+            if not draining:
+                self._pending.append(req)
+                if req.deadline_s is not None:
+                    self._deadline_queued += 1
+                self._c["requests"].inc()
+                self._g_queue.set(len(self._pending))
+                if self.auto_run and not self._running:
+                    # a fresh burst supersedes a PAST crash: its failed
+                    # requests already surfaced their errors, and a
+                    # later drain() must judge THIS backlog, not
+                    # history (the pinned stale beacon keeps alerting
+                    # regardless until the new burst's first tick)
+                    self._crashed = None
+                    self._running = True
+                    t = threading.Thread(target=self._loop, daemon=True)
+                    self._loop_thread = t
+                    t.start()
+        if draining:
+            # refuse OUTSIDE the lock: close the spans just opened and
+            # leave a flight mark, then raise the typed error a router
+            # reads as "place elsewhere" (drain is not a failure)
+            req._span_queue.end(error="EngineDraining")
+            req._span_life.end(error="EngineDraining")
+            self._flight.record(
+                "req", phase="reject", rid=req.rid,
+                engine=self._engine_id, error="EngineDraining")
+            raise EngineDraining(
+                f"engine {self._engine_id} is draining: admission is "
+                f"closed while queued + inflight requests finish "
+                f"(drain(); shutdown() completes removal)")
         return req
 
     def generate(self, prompt, max_new_tokens=32, timeout=None):
@@ -1501,15 +1597,92 @@ class ServingEngine:
             self._c["aborted_tokens"].inc(len(req.tokens))
             req.lifecycle.update(
                 t_abort=now, aborted=True, tokens=len(req.tokens),
-                where="queued", error="DeadlineExceededError")
+                where="deadline", error="DeadlineExceededError")
             req._span_queue.end(error="DeadlineExceededError")
             req._span_life.end(error="DeadlineExceededError")
             self._flight.record(
                 "req", phase="abort", rid=req.rid,
-                engine=self._engine_id, where="queued",
+                engine=self._engine_id, where="deadline",
                 wait_s=round(wait_s, 6), error="DeadlineExceededError")
+            self._record_abort_locked(req, "deadline",
+                                      "DeadlineExceededError", now)
+            if req.on_token is not None:
+                self._stream_emit.append((req, None))
             req._event.set()
         self._pending = keep
+
+    def _expire_slots_locked(self):
+        """The decode half of the ``submit(deadline_s=)`` budget: a
+        request STILL DECODING past its deadline is aborted mid-flight
+        (its slot frees this tick, its generated-so-far tokens count as
+        aborted work).  Queue-wait expiry alone would let a request
+        that squeaked into a slot overrun its caller's timeout by the
+        whole decode.  One ``is not None`` check per slot per tick when
+        nobody sets deadlines; the clock is read only when some slot
+        carries one."""
+        now = None
+        for i, slot in enumerate(self._slots):
+            req = slot.req
+            if req is None or req.deadline_s is None:
+                continue
+            if self._pp > 1:
+                # consult the record of the wave that OWNS slot i: every
+                # record snapshots all slots, so matching req against
+                # arbitrary records would defer forever under steady
+                # decode (some wave is always mid-pipeline)
+                rec = self._inflight.get(i // self._wave)
+                if rec is not None and rec[2][i] is req:
+                    # the slot's wave is mid-pipeline: freeing it now
+                    # would let admission reuse rows the in-flight wave
+                    # still writes — expire when the wave exits
+                    # (<= pp ticks, _commit_pp_exit skips the stale
+                    # commit either way)
+                    continue
+            if now is None:
+                now = time.perf_counter()
+            if now - req._t_submit <= req.deadline_s:
+                continue
+            self._abort_slot_locked(
+                i, req, DeadlineExceededError(
+                    f"request {req.rid} ran "
+                    f"{now - req._t_submit:.3f}s, past its "
+                    f"deadline_s={req.deadline_s}; aborted mid-decode "
+                    f"after {len(req.tokens)} tokens"),
+                "deadline", now)
+
+    def _abort_slot_locked(self, i, req, err, where, now):
+        """Terminal abort of an ADMITTED request (deadline expiry): free
+        the slot like :meth:`_finish`, but book the generated tokens as
+        aborted work and stamp the abort terminal on the lifecycle
+        record / flight ring / ``recent_aborts`` debug ring."""
+        req.error = err
+        self._slots[i].req = None
+        self._sampling_cache = None  # membership changed: restage
+        self._lengths[i] = 0
+        if self._paged:
+            self._release_pages_locked(i)
+        self._c["aborted_tokens"].inc(len(req.tokens))
+        req.lifecycle.update(
+            t_abort=now, aborted=True, tokens=len(req.tokens),
+            where=where, error=type(err).__name__)
+        req._span_life.end(error=type(err).__name__)
+        self._flight.record(
+            "req", phase="abort", rid=req.rid, engine=self._engine_id,
+            slot=i, where=where, tokens=len(req.tokens),
+            error=type(err).__name__)
+        self._record_abort_locked(req, where, type(err).__name__, now)
+        if req.on_token is not None:
+            self._stream_emit.append((req, None))
+        req._event.set()
+
+    def _record_abort_locked(self, req, where, error, now):
+        """One row in the bounded ``recent_aborts`` ring
+        (``/debug/requests``): aborted requests vanish from the slot
+        table immediately, so WHERE they died must be visible
+        somewhere curl can reach."""
+        self._recent_aborts.append(
+            {"rid": req.rid, "where": where, "error": error,
+             "tokens": len(req.tokens), "t_abort": round(now, 6)})
 
     def _paged_admit_locked(self, i, req):
         """Reserve slot ``i``'s whole page footprint up front (worst-case
@@ -1668,6 +1841,11 @@ class ServingEngine:
             "req", phase="finish", rid=req.rid, engine=self._engine_id,
             slot=slot_idx, tokens=len(req.tokens),
             e2e_s=round(now - req._t_submit, 6))
+        if req.on_token is not None:
+            # end-of-stream terminal, AFTER this tick's token emits in
+            # the same buffer — a streaming consumer sees every token,
+            # then exactly one None
+            self._stream_emit.append((req, None))
         req._event.set()
 
     def _tick_progress(self, req, t_ns):
@@ -1704,6 +1882,11 @@ class ServingEngine:
         req.tokens.append(tok)
         slot.last = tok
         self._c["tokens"].inc()
+        if req.on_token is not None:
+            # buffered under the lock, delivered by _flush_streams on
+            # this driver thread after release (the hook may block —
+            # that is the streaming backpressure)
+            self._stream_emit.append((req, tok))
         if (len(req.tokens) >= req.max_new_tokens
                 or (self.eos_token_id is not None
                     and tok == self.eos_token_id)):
@@ -1750,12 +1933,46 @@ class ServingEngine:
                          engine=self._engine_id, tickno=self._tickno,
                          committed=committed, **extra)
 
-    def _step_impl(self) -> bool:  # pht-lint: hot-root (tick body)
-        # fault-injection drill point (observability/faults.py): armed,
-        # it kills/fails/delays a tick deterministically — how the
-        # fail-all path below and the crash-dump post-mortem are
-        # drilled; disarmed it is one empty-dict probe per tick
+    def _step_impl(self) -> bool:
+        """Tick + streaming flush: committed tokens (and stream
+        terminals) buffered under the lock during :meth:`_step_inner`
+        are handed to their ``on_token`` hooks here, on the driver
+        thread, lock-free.  A raising tick skips the flush — the
+        auto_run loop's fail-all appends the terminal marks first and
+        flushes everything, in order, itself."""
+        busy = self._step_inner()
+        self._flush_streams()
+        return busy
+
+    def _flush_streams(self):
+        """Deliver buffered ``on_token`` emissions (driver thread only,
+        no lock held — a blocking hook is the backpressure design and
+        must never stall ``submit()``/introspection behind the engine
+        lock).  A hook that RAISES is dropped with a flight mark
+        instead of killing the tick loop: the stream consumer is the
+        broken party, the other slots' requests are not."""
+        with self._lock:
+            if not self._stream_emit:
+                return
+            buf, self._stream_emit = self._stream_emit, []
+        for req, tok in buf:
+            try:
+                req.on_token(tok)
+            except Exception as e:  # noqa: BLE001 — consumer's bug
+                self._flight.record(
+                    "stream", phase="hook_error", rid=req.rid,
+                    engine=self._engine_id, error=type(e).__name__)
+
+    def _step_inner(self) -> bool:  # pht-lint: hot-root (tick body)
+        # fault-injection drill points (observability/faults.py):
+        # armed, they kill/fail/delay a tick deterministically — how
+        # the fail-all path below and the crash-dump post-mortem are
+        # drilled; disarmed each is one empty-dict probe per tick.
+        # serving.step is the historical global point; the per-replica
+        # serving.tick[<engine_id>] point is how a fleet drill kills
+        # ONE replica of many in the same process.
         _faults.point("serving.step")
+        _faults.point(self._tick_fault_point)
         with self._lock:
             if self._running and \
                     threading.current_thread() is not self._loop_thread:
@@ -1767,6 +1984,10 @@ class ServingEngine:
                 err._pht_usage_error = True   # step(): no crash dump
                 raise err
             replays = self._admit()
+            # decode half of the deadline budget (queue half runs in
+            # _admit): a slot past its deadline frees before this tick
+            # wastes another program dispatch on it
+            self._expire_slots_locked()
             self._g_queue.set(len(self._pending))
             occ = sum(s.req is not None for s in self._slots)
             self._g_occupancy.set(occ)
@@ -2069,10 +2290,16 @@ class ServingEngine:
                         # never got — the /load report's goodput ratio
                         # reads completed/(completed+aborted)
                         self._c["aborted_tokens"].inc(len(req.tokens))
+                        now = time.perf_counter()
                         req.lifecycle.update(
-                            t_abort=time.perf_counter(), aborted=True,
+                            t_abort=now, aborted=True,
                             tokens=len(req.tokens), where=where,
                             error=type(e).__name__)
+                        self._record_abort_locked(
+                            req, where, type(e).__name__, now)
+                        if req.on_token is not None:
+                            # terminal AFTER any already-buffered tokens
+                            self._stream_emit.append((req, None))
                         # close the lifecycle spans (no-ops when tracing
                         # is off) and leave a terminal flight mark — the
                         # failing requests are the ones a post-mortem
@@ -2100,6 +2327,12 @@ class ServingEngine:
                                 _fail(req, "inflight")
                     self._inflight.clear()
                     self._running = False
+                    self._crashed = e
+                # deliver the failed requests' stream terminals (and any
+                # tokens the crashing tick had committed) — a streaming
+                # consumer blocked on its queue must learn the replica
+                # died, not hang until its own timeout
+                self._flush_streams()
                 # the loop thread dies on this raise: PIN the beacon so
                 # it survives the thread's exit and goes stale — the
                 # /healthz?max_age alert a crashed engine must leave
@@ -2148,7 +2381,12 @@ class ServingEngine:
                 slots.append(row)
             out = {"engine": self._engine_id, "tickno": self._tickno,
                    "running": self._running,
-                   "pending": len(self._pending), "slots": slots}
+                   "draining": self._draining,
+                   "pending": len(self._pending), "slots": slots,
+                   # bounded terminal ring: where recently-aborted
+                   # requests died (where="deadline" for budget aborts,
+                   # pending/slot/inflight for a loop failure)
+                   "recent_aborts": list(self._recent_aborts)}
             if self._paged:
                 out["kv_pages_in_use"] = self._pool.allocated
                 out["kv_pages_free"] = self._pool.free
@@ -2196,6 +2434,12 @@ class ServingEngine:
                 "engine": self._engine_id,
                 "ts": time.time(),
                 "running": self._running,
+                # a draining replica still finishes queued + inflight
+                # work but refuses submits — a router must not dispatch
+                # to it (field added within version 1: consumers that
+                # don't know it keep working, routers that do stop
+                # placing here the poll after drain() is called)
+                "draining": self._draining,
                 "tickno": self._tickno,
                 "slots": {"max": self.max_slots, "active": active,
                           "free": free_slots},
@@ -2249,6 +2493,19 @@ class ServingEngine:
                 admission["headroom_tokens"] = (slot_cap if free_slots
                                                 else 0)
             report["admission"] = admission
+            if self._prefix is not None:
+                # cache-affinity signal (added within version 1): chain
+                # digests of resident radix-cache nodes.  A router
+                # hashing a prompt's page-aligned prefixes the same way
+                # (paged.page_digests) matches the deepest digest here
+                # to find the replica already holding those KV pages.
+                # Bounded (most-recent first) so a warm cache never
+                # bloats the poll document.
+                report["prefix_digest"] = {
+                    "algo": "crc32-pages",
+                    "page_size": self._page_size,
+                    "digests": self._prefix.digests(
+                        self.PREFIX_DIGEST_LIMIT)}
             return report
 
     @property
@@ -2290,6 +2547,53 @@ class ServingEngine:
                         _tr.remove_beacon(f"serving.{self._engine_id}")
                 return
         raise RuntimeError("engine did not drain in max_ticks")
+
+    def drain(self, timeout=60.0):
+        """Graceful removal, the half hard ``shutdown(timeout=)`` does
+        not give: stop ADMITTING (``submit`` raises
+        :class:`EngineDraining`), let queued + inflight requests run to
+        completion, then drop the liveness beacon — the engine object
+        stays constructed (introspection/metrics keep answering) until
+        :meth:`shutdown` completes the teardown.  This is what a fleet
+        router calls to remove a replica without failing a single
+        request (``FleetRouter.drain``).
+
+        A sync-driven engine (``auto_run=False``, or an auto_run engine
+        whose loop has idled out) is driven to completion HERE — drain
+        becomes the driver, honoring the single-driver contract (it
+        only steps while the loop is not running).  Idempotent; raises
+        ``TimeoutError`` if the backlog outlives ``timeout``, and
+        ``RuntimeError`` (crash as ``__cause__``) if the engine's loop
+        CRASHED instead of draining — the emptied slots/queue then
+        mean the backlog was failed, not completed, and the pinned
+        crash beacon is left alone (going stale IS the alert)."""
+        with self._lock:
+            self._draining = True
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._lock:
+                running = self._running
+                crashed = self._crashed
+                idle = (not self._pending
+                        and all(s.req is None for s in self._slots)
+                        and not self._inflight_live())
+            if crashed is not None and not running:
+                raise RuntimeError(
+                    f"engine {self._engine_id} crashed while draining "
+                    f"({type(crashed).__name__}): its queued + inflight "
+                    f"requests were FAILED, not completed — this is not "
+                    f"a clean removal") from crashed
+            if idle and not running:
+                # same clean-drain contract as the loop's idle exit: a
+                # DRAINED engine must not 503 /healthz?max_age forever
+                _tr.remove_beacon(f"serving.{self._engine_id}")
+                return
+            if running:
+                time.sleep(0.005)   # the auto_run loop is finishing it
+            else:
+                self.step()         # sync-driven: drain is the driver
+        raise TimeoutError(
+            f"engine {self._engine_id} did not drain in {timeout}s")
 
     def shutdown(self, timeout=60.0):
         """Wait for the background loop to drain and stop — call before

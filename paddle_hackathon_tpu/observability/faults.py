@@ -49,7 +49,12 @@ post-mortem distinguishes an injected failure from a real one.
 
 Registered point names in-tree (grep ``faults.point`` for ground truth):
 ``ckpt.shard_write``, ``ckpt.manifest_write``, ``ckpt.commit``,
-``elastic.put``, ``elastic.refresh``, ``io.prefetch``, ``serving.step``.
+``elastic.put``, ``elastic.refresh``, ``io.prefetch``, ``serving.step``,
+``serving.tick[<engine_id>]`` (per-replica — how a fleet drill kills ONE
+engine of many in the same process), ``fleet.dispatch`` (per placement
+attempt), ``fleet.load_probe[<replica>]`` (per capacity poll) and
+``fleet.stale_health[<replica>]`` (inside the router's health gate — a
+``fail`` firing reads as "this replica's beacon went stale").
 """
 
 from __future__ import annotations

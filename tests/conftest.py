@@ -82,6 +82,10 @@ _FILE_COST = {
                             # for the two new rules, but the extra
                             # fixture/stats tests add ~2s)
     "test_checkpointing.py": 8,   # host-only protocol/fault units
+    "test_fleet.py": 10,    # host-only router/breaker/scoring units +
+                            # 2 engine constructions (no tick compiles);
+                            # the failover/drain/affinity drills are
+                            # slow-marked
     "test_zero_sharded.py": 6,    # spec/update units + 2 tiny jits;
                                   # fit/Engine drills are slow-marked
     "test_crash_drill.py": 1,     # fully slow-marked (subprocess drills)

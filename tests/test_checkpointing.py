@@ -539,7 +539,9 @@ class TestQueuedDeadline:
         time.sleep(0.005)
         assert eng.step() is False
         assert isinstance(req.error, DeadlineExceededError)
-        assert req.lifecycle["where"] == "queued"
+        # the fleet PR unified queue- and decode-budget aborts under
+        # one lifecycle terminal: where="deadline"
+        assert req.lifecycle["where"] == "deadline"
         assert req.lifecycle["aborted"] and "t_abort" in req.lifecycle
         assert req._event.is_set()          # wait() returns immediately
         with pytest.raises(RuntimeError):

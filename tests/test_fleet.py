@@ -1,0 +1,971 @@
+"""Serving fleet: router dispatch/affinity/breaker units + fault drills.
+
+Fast half (tier-1): pure scoring (`pick_replica`/`affinity_depth`/
+`page_digests`), the `CircuitBreaker` state machine with an injected
+clock, and `FleetRouter` behavior against host-only FAKE replicas —
+retry/backoff, draining, stale-health and load-probe fault points,
+replica-death recovery, streaming backpressure.  No tick program ever
+compiles here.
+
+Slow half (acceptance drills, 2 tiny paged replicas each with its OWN
+model instance — `functional_call` swaps state into the live layer
+tree, so concurrent replica traces must not share one model object):
+
+- deterministic failover: `serving.tick[<replica>]` kills one engine
+  mid-flight; every not-yet-started request completes on the survivor
+  with EXACT greedy tokens, started streams fail loudly
+  (StreamInterruptedError), zero pages leak on the survivor;
+- graceful drain under load: zero requests lost, dispatch moves off the
+  drained replica;
+- cache-affinity: a repeat-prefix workload shows a higher prefix-hit
+  ratio on the affine replica than round-robin dispatch;
+- the same fleet drive clean under the lock + race sanitizers.
+"""
+
+import itertools
+import queue
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_hackathon_tpu as paddle
+from paddle_hackathon_tpu.inference.fleet import (
+    BREAKER_CLOSED, BREAKER_HALF_OPEN, BREAKER_OPEN, CircuitBreaker,
+    FleetRouter, NoReplicaAvailableError, StreamInterruptedError,
+    affinity_depth, pick_replica)
+from paddle_hackathon_tpu.inference.paged import (PagePool, PrefixCache,
+                                                  page_digests)
+from paddle_hackathon_tpu.inference.serving import (DeadlineExceededError,
+                                                    EngineDraining)
+from paddle_hackathon_tpu.observability import faults, get_registry
+
+
+# ---------------------------------------------------------------------------
+# fakes (host-only replica handles speaking the engine surface)
+# ---------------------------------------------------------------------------
+
+_RIDS = itertools.count()
+
+
+class _FakeReq:
+    def __init__(self, prompt, max_new, on_token=None):
+        self.rid = next(_RIDS)
+        self.prompt = np.asarray(prompt, np.int32).reshape(-1)
+        self.max_new = int(max_new)
+        self.tokens = []
+        self.done = False
+        self.error = None
+        self._event = threading.Event()
+        self.on_token = on_token
+
+    def finish(self):
+        for t in range(self.max_new):
+            self.tokens.append(t)
+            if self.on_token is not None:
+                self.on_token(t)
+        self.done = True
+        if self.on_token is not None:
+            self.on_token(None)
+        self._event.set()
+
+    def die(self, err, streamed=0):
+        self.tokens = list(range(streamed))
+        self.error = err
+        if self.on_token is not None:
+            self.on_token(None)
+        self._event.set()
+
+    def result(self):
+        if self.error is not None:
+            raise RuntimeError("request failed") from self.error
+        return np.concatenate(
+            [self.prompt, np.asarray(self.tokens, np.int32)])
+
+
+class _FakeEngine:
+    """Host-only replica: a /load report knob per field, scripted
+    submit outcomes, manual finish/die control."""
+
+    def __init__(self, name, headroom=1000, queue_depth=0, active=0,
+                 digests=None, page_size=8, submit_error=None,
+                 auto_finish=True, version=1):
+        self.engine_id = name
+        self.headroom = headroom
+        self.queue_depth = queue_depth
+        self.active = active
+        self.digests = digests
+        self.page_size = page_size
+        self.submit_error = submit_error
+        self.auto_finish = auto_finish
+        self.version = version
+        self.draining = False
+        self.submitted = []
+        self.last_deadline_s = "unset"
+        self.drained = False
+        self.shut = False
+
+    def load_report(self):
+        rep = {"version": self.version, "engine": self.engine_id,
+               "draining": self.draining,
+               "slots": {"max": 8, "active": self.active,
+                         "free": 8 - self.active},
+               "queue": {"depth": self.queue_depth, "oldest_wait_s": 0.0},
+               "admission": {"headroom_tokens": self.headroom}}
+        if self.digests is not None:
+            rep["prefix_digest"] = {"algo": "crc32-pages",
+                                    "page_size": self.page_size,
+                                    "digests": list(self.digests)}
+        return rep
+
+    def submit(self, prompt, max_new_tokens, deadline_s=None,
+               on_token=None, **kw):
+        self.last_deadline_s = deadline_s
+        if self.submit_error is not None:
+            raise self.submit_error
+        req = _FakeReq(prompt, max_new_tokens, on_token)
+        self.submitted.append(req)
+        if self.auto_finish:
+            req.finish()
+        return req
+
+    def drain(self, timeout=None):
+        self.drained = True
+
+    def shutdown(self, timeout=None):
+        self.shut = True
+
+
+def _total(name, **labels):
+    return get_registry().total(name, **labels)
+
+
+# ---------------------------------------------------------------------------
+# digests
+# ---------------------------------------------------------------------------
+
+def test_page_digests_cap_and_determinism():
+    p = np.arange(40, dtype=np.int32)
+    d = page_digests(p, 8)
+    assert len(d) == (40 - 1) // 8 == 4      # last token never cached
+    assert d == page_digests(list(p), 8)     # list/array agree
+    assert page_digests(p[:8], 8) == []      # one page -> 0 full pages
+    assert page_digests(p[:9], 8) == d[:1]   # prefix chains are prefixes
+
+
+def test_page_digests_match_prefix_cache_chains():
+    """The router hashes prompts with page_digests; the engine publishes
+    PrefixCache.digests() — the two chains must be bytes-identical or
+    affinity silently never matches."""
+    pool = PagePool(num_pages=16, page_size=4)
+    cache = PrefixCache(pool)
+    prompt = np.arange(100, 117, dtype=np.int32)   # 17 tokens, 4 full pages
+    pages = pool.alloc(4)
+    cache.insert(prompt, pages, 4)
+    assert set(cache.digests()) == set(page_digests(prompt, 4))
+    # a different prompt shares no chain entry
+    other = np.arange(200, 217, dtype=np.int32)
+    assert not set(cache.digests()) & set(page_digests(other, 4))
+    # bounded: limit is honored, most-recent first
+    assert len(cache.digests(limit=2)) == 2
+
+
+def test_affinity_depth_matches_deepest():
+    p = np.arange(40, dtype=np.int32)
+    d = page_digests(p, 8)
+    rep = {"prefix_digest": {"page_size": 8, "digests": d[:3]}}
+    assert affinity_depth(rep, d) == 3
+    assert affinity_depth(rep, page_digests(np.arange(1, 41), 8)) == 0
+    assert affinity_depth({}, d) == 0
+    assert affinity_depth({"prefix_digest": {"digests": []}}, d) == 0
+
+
+# ---------------------------------------------------------------------------
+# pick_replica scoring
+# ---------------------------------------------------------------------------
+
+def _rep(headroom=100, depth=0, active=0, version=1, draining=False,
+         digests=None, page_size=8):
+    rep = {"version": version, "draining": draining,
+           "slots": {"max": 8, "active": active, "free": 8 - active},
+           "queue": {"depth": depth, "oldest_wait_s": 0.0},
+           "admission": {"headroom_tokens": headroom}}
+    if digests is not None:
+        rep["prefix_digest"] = {"page_size": page_size,
+                                "digests": digests}
+    return rep
+
+
+class TestPickReplica:
+    def test_most_headroom_wins_among_fits(self):
+        reps = {"a": _rep(headroom=100), "b": _rep(headroom=500)}
+        assert pick_replica(reps, 50) == "b"
+
+    def test_only_fitting_replica_wins_regardless_of_order(self):
+        reps = {"a": _rep(headroom=100), "b": _rep(headroom=500)}
+        assert pick_replica(reps, 400) == "b"
+        reps = {"a": _rep(headroom=500), "b": _rep(headroom=100)}
+        assert pick_replica(reps, 400) == "a"
+
+    def test_nobody_fits_queues_on_least_loaded(self):
+        reps = {"a": _rep(headroom=0, depth=5),
+                "b": _rep(headroom=0, depth=1)}
+        assert pick_replica(reps, 100) == "b"
+
+    def test_version_gate(self):
+        reps = {"a": _rep(), "b": _rep(headroom=9999, version=2)}
+        assert pick_replica(reps, 10) == "a"
+        assert pick_replica({"b": _rep(version=2)}, 10) is None
+
+    def test_draining_never_a_candidate(self):
+        reps = {"a": _rep(), "b": _rep(headroom=9999, draining=True)}
+        assert pick_replica(reps, 10) == "a"
+
+    def test_exclude(self):
+        reps = {"a": _rep(headroom=500), "b": _rep(headroom=100)}
+        assert pick_replica(reps, 10, exclude={"a"}) == "b"
+        assert pick_replica(reps, 10, exclude={"a", "b"}) is None
+
+    def test_affinity_wins_among_fits(self):
+        p = np.arange(40, dtype=np.int32)
+        d = page_digests(p, 8)
+        reps = {"cold": _rep(headroom=500, digests=[]),
+                "warm": _rep(headroom=100, digests=d)}
+        assert pick_replica(reps, 50, digests=d) == "warm"
+        # ...but only among replicas that can actually ADMIT the
+        # request: affinity must not queue a request on a full replica
+        assert pick_replica(reps, 400, digests=d) == "cold"
+
+    def test_deeper_affinity_beats_shallower(self):
+        p = np.arange(40, dtype=np.int32)
+        d = page_digests(p, 8)
+        reps = {"deep": _rep(headroom=100, digests=d),
+                "shallow": _rep(headroom=400, digests=d[:1])}
+        assert pick_replica(reps, 50, digests=d) == "deep"
+
+    def test_queue_depth_breaks_headroom_ties(self):
+        reps = {"a": _rep(headroom=100, depth=3),
+                "b": _rep(headroom=100, depth=0)}
+        assert pick_replica(reps, 50) == "b"
+
+    def test_garbage_reports_skipped(self):
+        reps = {"a": _rep(), "err": {"error": "TimeoutError: ..."},
+                "none": None}
+        assert pick_replica(reps, 10) == "a"
+
+
+# ---------------------------------------------------------------------------
+# circuit breaker (injected clock — no sleeps)
+# ---------------------------------------------------------------------------
+
+def test_breaker_state_machine():
+    b = CircuitBreaker(failure_threshold=2, probe_interval_s=1.0)
+    assert b.state == BREAKER_CLOSED and b.allows(0.0)
+    b.record_failure(0.0)
+    assert b.state == BREAKER_CLOSED and b.allows(0.1)   # under threshold
+    b.record_failure(0.1)
+    assert b.state == BREAKER_OPEN and not b.allows(0.5)
+    # cool-down elapsed: half-open, exactly one probe
+    assert b.allows(1.2) and b.state == BREAKER_HALF_OPEN
+    b.on_dispatch()
+    assert not b.allows(1.3)
+    # probe failed: re-open, cool-down restarts from the failure
+    b.record_failure(1.4)
+    assert b.state == BREAKER_OPEN and not b.allows(2.0)
+    # probe succeeded the second time: closed, streak reset
+    assert b.allows(2.5)
+    b.on_dispatch()
+    b.record_success()
+    assert b.state == BREAKER_CLOSED and b.consecutive_failures == 0
+
+
+# ---------------------------------------------------------------------------
+# router against fakes
+# ---------------------------------------------------------------------------
+
+class TestRouterDispatch:
+    def test_submit_lands_least_loaded_and_counts(self):
+        a, b = _FakeEngine("fa", headroom=10), _FakeEngine("fb",
+                                                           headroom=500)
+        r = FleetRouter([a, b], backoff_s=0.001)
+        fr = r.submit([1, 2, 3], 4)
+        assert fr.wait(5) and fr.error is None and fr.replica == "fb"
+        assert list(fr.result()) == [1, 2, 3, 0, 1, 2, 3]
+        assert _total("fleet_dispatch_total", fleet=r.fleet_id,
+                      replica="fb", outcome="ok") == 1
+
+    def test_submit_failure_retries_on_another_replica(self):
+        a = _FakeEngine("ra", headroom=9000,
+                        submit_error=RuntimeError("boom"))
+        b = _FakeEngine("rb", headroom=10)
+        r = FleetRouter([a, b], backoff_s=0.001)
+        before = _total("fleet_retries_total", fleet=r.fleet_id)
+        fr = r.submit([1], 4)
+        assert fr.replica == "rb"          # broken favorite excluded
+        assert _total("fleet_retries_total", fleet=r.fleet_id) == before + 1
+        assert _total("fleet_dispatch_total", fleet=r.fleet_id,
+                      replica="ra", outcome="error") == 1
+
+    def test_all_replicas_broken_raises_named(self):
+        r = FleetRouter(
+            [_FakeEngine("xa", submit_error=RuntimeError("a down")),
+             _FakeEngine("xb", submit_error=RuntimeError("b down"))],
+            backoff_s=0.001, max_retries=2)
+        with pytest.raises(NoReplicaAvailableError) as ei:
+            r.submit([1], 4)
+        assert ei.value.__cause__ is not None
+
+    def test_engine_draining_is_not_a_failure(self):
+        a = _FakeEngine("da", headroom=9000,
+                        submit_error=EngineDraining("draining"))
+        b = _FakeEngine("db", headroom=10)
+        r = FleetRouter([a, b], backoff_s=0.001)
+        fr = r.submit([1], 2)
+        assert fr.replica == "db"
+        info = r.introspect_requests()["replicas"]
+        assert info["da"]["draining"] is True
+        assert info["da"]["consecutive_failures"] == 0   # no penalty
+        assert _total("fleet_draining", fleet=r.fleet_id) == 1
+        # subsequent submits never even try the draining replica
+        a.submit_error = None
+        assert r.submit([1], 2).replica == "db"
+
+    def test_bad_report_version_counts_probe_error(self):
+        a = _FakeEngine("va", version=3)
+        b = _FakeEngine("vb")
+        r = FleetRouter([a, b], backoff_s=0.001)
+        assert r.submit([1], 2).replica == "vb"
+        assert _total("fleet_dispatch_total", fleet=r.fleet_id,
+                      replica="va", outcome="probe_error") >= 1
+
+    def test_stale_health_fault_point_skips_replica(self):
+        a, b = _FakeEngine("ha", headroom=9000), _FakeEngine("hb",
+                                                             headroom=10)
+        r = FleetRouter([a, b], backoff_s=0.001)
+        with faults.injected("fleet.stale_health[ha]=fail@1"):
+            fr = r.submit([1], 2)
+        assert fr.replica == "hb"
+        assert _total("fleet_dispatch_total", fleet=r.fleet_id,
+                      replica="ha", outcome="stale") == 1
+        # the point fired once; the replica recovers on the next submit
+        assert r.submit([1], 2).replica == "ha"
+
+    def test_load_probe_fault_point_skips_replica(self):
+        a, b = _FakeEngine("pa", headroom=9000), _FakeEngine("pb",
+                                                             headroom=10)
+        r = FleetRouter([a, b], backoff_s=0.001)
+        with faults.injected("fleet.load_probe[pa]=fail@1"):
+            fr = r.submit([1], 2)
+        assert fr.replica == "pb"
+        assert _total("fleet_dispatch_total", fleet=r.fleet_id,
+                      replica="pa", outcome="probe_error") == 1
+
+    def test_breaker_opens_then_half_open_probe_recovers(self):
+        a = _FakeEngine("ba", headroom=9000,
+                        submit_error=RuntimeError("down"))
+        b = _FakeEngine("bb", headroom=10)
+        r = FleetRouter([a, b], backoff_s=0.001, breaker_failures=2,
+                        breaker_probe_interval_s=0.05, max_retries=1)
+        r.submit([1], 2)
+        r.submit([1], 2)
+        info = r.introspect_requests()["replicas"]
+        assert info["ba"]["breaker"] == "open"
+        # while open, dispatch skips it entirely (favorite headroom
+        # notwithstanding) without burning retries
+        before = _total("fleet_dispatch_total", fleet=r.fleet_id,
+                        replica="ba", outcome="error")
+        assert r.submit([1], 2).replica == "bb"
+        assert _total("fleet_dispatch_total", fleet=r.fleet_id,
+                      replica="ba", outcome="error") == before
+        # cool-down passes, the replica recovered: one probe closes it
+        a.submit_error = None
+        time.sleep(0.06)
+        assert r.submit([1], 2).replica == "ba"
+        assert r.introspect_requests()["replicas"]["ba"]["breaker"] \
+            == "closed"
+
+    def test_round_robin_policy_rotates(self):
+        r = FleetRouter([_FakeEngine("qa"), _FakeEngine("qb")],
+                        policy="round_robin")
+        assert [r.submit([1], 1).replica for _ in range(4)] \
+            == ["qa", "qb", "qa", "qb"]
+
+    def test_affinity_routes_to_warm_replica(self):
+        p = np.arange(40, dtype=np.int32)
+        d = page_digests(p, 8)
+        warm = _FakeEngine("wa", headroom=500, digests=d)
+        cold = _FakeEngine("wb", headroom=500, digests=[])
+        r = FleetRouter([cold, warm])
+        assert r.submit(p, 4).replica == "wa"
+
+
+class TestRouterRecovery:
+    def test_unstarted_request_fails_over(self):
+        a = _FakeEngine("fo1", headroom=9000, auto_finish=False)
+        b = _FakeEngine("fo2", headroom=10)
+        r = FleetRouter([a, b], backoff_s=0.001)
+        fr = r.submit([5, 6], 3)
+        assert fr.replica == "fo1"
+        a.submitted[0].die(RuntimeError("replica crashed"), streamed=0)
+        assert fr.wait(5)
+        assert fr.error is None and fr.replica == "fo2" and fr.retries == 1
+        assert list(fr.result()) == [5, 6, 0, 1, 2]
+        # the death booked a breaker failure against the dead replica
+        assert r.introspect_requests()["replicas"]["fo1"][
+            "consecutive_failures"] >= 1
+
+    def test_poll_style_consumer_gets_failover_without_wait(self):
+        """done/error/result must settle a recoverable replica death
+        through the router — a consumer that polls instead of blocking
+        in wait() gets the same failover guarantee."""
+        a = _FakeEngine("pf1", headroom=9000, auto_finish=False)
+        b = _FakeEngine("pf2", headroom=10)
+        r = FleetRouter([a, b], backoff_s=0.001)
+        fr = r.submit([5, 6], 3)
+        a.submitted[0].die(RuntimeError("replica crashed"), streamed=0)
+        # no wait(): the first poll settles the death through the
+        # router — re-placed on pf2 (which auto-finishes) and done
+        assert fr.error is None
+        assert fr.replica == "pf2" and fr.retries == 1
+        assert fr.done and list(fr.result()) == [5, 6, 0, 1, 2]
+
+    def test_started_stream_fails_loudly_never_redispatched(self):
+        a = _FakeEngine("lo1", headroom=9000, auto_finish=False)
+        b = _FakeEngine("lo2", headroom=10)
+        r = FleetRouter([a, b], backoff_s=0.001)
+        fr = r.submit([7], 3)
+        a.submitted[0].die(RuntimeError("crash"), streamed=2)
+        assert fr.wait(5)
+        assert isinstance(fr.error, StreamInterruptedError)
+        assert "2 token(s)" in str(fr.error)
+        assert fr.error.__cause__ is not None
+        with pytest.raises(StreamInterruptedError):
+            fr.result()
+        assert not b.submitted                  # never re-dispatched
+
+    def test_deadline_abort_is_terminal_not_retried(self):
+        a = _FakeEngine("dl1", headroom=9000, auto_finish=False)
+        b = _FakeEngine("dl2", headroom=10)
+        r = FleetRouter([a, b], backoff_s=0.001)
+        fr = r.submit([1], 3, deadline_s=60.0)
+        assert a.last_deadline_s is not None and a.last_deadline_s <= 60.0
+        a.submitted[0].die(DeadlineExceededError("past deadline"))
+        assert fr.wait(5)
+        assert isinstance(fr.error, DeadlineExceededError)
+        assert not b.submitted
+
+    def test_failover_passes_remaining_deadline(self):
+        a = _FakeEngine("rd1", headroom=9000, auto_finish=False)
+        b = _FakeEngine("rd2", headroom=10)
+        r = FleetRouter([a, b], backoff_s=0.001)
+        fr = r.submit([1], 3, deadline_s=60.0)
+        first = a.last_deadline_s
+        time.sleep(0.01)
+        a.submitted[0].die(RuntimeError("crash"))
+        assert fr.wait(5) and fr.replica == "rd2"
+        # the re-dispatch hands the survivor only what REMAINS
+        assert b.last_deadline_s < first
+
+    def test_spent_deadline_fails_without_dispatch(self):
+        a = _FakeEngine("sd1", headroom=9000)
+        r = FleetRouter([a], backoff_s=0.001)
+        with pytest.raises(DeadlineExceededError):
+            r.submit([1], 3, deadline_s=-1.0)
+        assert not a.submitted
+
+
+class TestRouterStreaming:
+    def test_stream_yields_then_terminates(self):
+        r = FleetRouter([_FakeEngine("st1")])
+        assert list(r.submit_stream([1, 2], 5)) == [0, 1, 2, 3, 4]
+
+    def test_stream_death_before_tokens_recovers(self):
+        a = _FakeEngine("sf1", headroom=9000, auto_finish=False)
+        b = _FakeEngine("sf2", headroom=10, auto_finish=False)
+        r = FleetRouter([a, b], backoff_s=0.001)
+        fr = r.submit([1], 3, stream=True)
+        it = fr.stream()
+        a.submitted[0].die(RuntimeError("crash"), streamed=0)
+        # recovery happens inside the iterator; finish on the survivor
+        got = []
+        t = threading.Thread(target=lambda: got.extend(it))
+        t.start()
+        deadline = time.monotonic() + 5
+        while not b.submitted and time.monotonic() < deadline:
+            time.sleep(0.005)
+        b.submitted[0].finish()
+        t.join(5)
+        assert not t.is_alive() and got == [0, 1, 2]
+        assert fr.retries == 1 and fr.replica == "sf2"
+
+    def test_stale_stream_terminal_does_not_recover_healthy_placement(self):
+        """Regression: a replica death before any token enqueues a
+        stream terminal; when ANOTHER waiter performs the recovery
+        first, the stream consumer later dequeues that now-STALE
+        terminal against the healthy new placement — it must be a
+        no-op, not a second recovery (which booked a breaker failure
+        against the live replica and double-placed the request)."""
+        a = _FakeEngine("sg1", headroom=9000, auto_finish=False)
+        b = _FakeEngine("sg2", headroom=10, auto_finish=False)
+        r = FleetRouter([a, b], backoff_s=0.001)
+        fr = r.submit([1], 3, stream=True)
+        a.submitted[0].die(RuntimeError("crash"), streamed=0)
+        # this wait() performs the recovery (then times out: the new
+        # placement on the survivor is still running)
+        assert not fr.wait(0.05)
+        assert fr.replica == "sg2" and fr.retries == 1
+        b.submitted[0].finish()
+        # the queue now reads [stale terminal, 0, 1, 2, terminal]
+        assert list(fr.stream()) == [0, 1, 2]
+        assert fr.retries == 1 and len(b.submitted) == 1
+        assert fr.wait(5) and fr.error is None
+
+    def test_stream_death_after_tokens_raises_loudly(self):
+        a = _FakeEngine("sl1", auto_finish=False)
+        r = FleetRouter([a], backoff_s=0.001)
+        it = r.submit_stream([1], 4)
+        req = None
+        deadline = time.monotonic() + 5
+        while not a.submitted and time.monotonic() < deadline:
+            time.sleep(0.001)
+        req = a.submitted[0]
+        req.tokens.append(0)
+        req.on_token(0)
+        req.error = RuntimeError("crash mid-stream")
+        req.on_token(None)
+        req._event.set()
+        got = []
+        with pytest.raises(StreamInterruptedError):
+            for t in it:
+                got.append(t)
+        assert got == [0]        # everything streamed was delivered once
+
+    def test_backpressure_bounded_queue_detaches_dead_consumer(self):
+        """The producer blocks on a full queue (backpressure); when the
+        consumer never drains it, the put times out and the stream
+        detaches instead of wedging the engine's driver thread."""
+        a = _FakeEngine("bp1", auto_finish=False)
+        r = FleetRouter([a], stream_queue_tokens=2,
+                        stream_put_timeout_s=0.05)
+        fr = r.submit([1], 8, stream=True)
+        req = a.submitted[0]
+        t0 = time.monotonic()
+        for k in range(6):                   # nobody consumes
+            req.on_token(k)
+        dt = time.monotonic() - t0
+        assert fr._closed                    # detached after the timeout
+        assert dt < 5.0                      # ...not one timeout per token
+        # detached stream: further tokens drop instantly
+        t0 = time.monotonic()
+        req.on_token(99)
+        assert time.monotonic() - t0 < 0.05
+        # the engine finishes the request normally; a consumer that
+        # RESUMES the iterator must get a loud detach error (tokens
+        # were dropped — a silent short stream or an infinite poll
+        # loop would both lie), while result() still has everything
+        req.finish()
+        with pytest.raises(StreamInterruptedError, match="detached"):
+            list(fr.stream())
+        assert fr.done and list(fr.result())[-8:] == list(range(8))
+
+    def test_stale_health_keys_on_engine_id_not_router_alias(self):
+        """The staleness gate must read the beacon the ENGINE
+        heartbeats under (serving.<engine_id>), even when the replica
+        is registered under a router-side alias."""
+        from paddle_hackathon_tpu.observability import tracing
+        a = _FakeEngine("hb-real", headroom=9000)
+        b = _FakeEngine("hb-other", headroom=10)
+        r = FleetRouter([b], backoff_s=0.001)
+        r.add_replica(a, name="hb-alias")
+        assert r._replicas["hb-alias"].beacon == "serving.hb-real"
+        tracing.heartbeat("serving.hb-real")
+        try:
+            # any existing beacon reads stale under a negative max age:
+            # the aliased replica must be the one gated out
+            r.health_max_age_s = -1.0
+            fr = r.submit([1], 2)
+            assert fr.replica == "hb-other"
+            assert _total("fleet_dispatch_total", fleet=r.fleet_id,
+                          replica="hb-alias", outcome="stale") >= 1
+        finally:
+            tracing.remove_beacon("serving.hb-real")
+
+
+class TestRouterLifecycle:
+    def test_drain_removes_replica_and_calls_graceful_half(self):
+        a, b = _FakeEngine("dr1"), _FakeEngine("dr2")
+        r = FleetRouter([a, b])
+        r.submit([1], 1)                 # mint dr-labelled series
+        r.drain("dr1", timeout=5)
+        assert a.drained and a.shut
+        assert r.replica_names() == ["dr2"]
+        assert _total("fleet_draining", fleet=r.fleet_id) == 0
+        # replica churn must not grow the registry: the departed
+        # replica's labelled series are dropped with it
+        assert _total("fleet_dispatch_total", fleet=r.fleet_id,
+                      replica="dr1") == 0
+        assert r.submit([1], 1).replica == "dr2"
+        with pytest.raises(KeyError):
+            r.drain("dr1")
+
+    def test_failed_drain_keeps_replica_registered(self):
+        """A drain that times out (or crashes) must NOT forget a live
+        engine: the replica stays registered and draining so the
+        operator can retry or escalate — and a retry that succeeds
+        completes the removal."""
+        a, b = _FakeEngine("fd1"), _FakeEngine("fd2")
+        a.drain = lambda timeout=None: (_ for _ in ()).throw(
+            TimeoutError("backlog outlived timeout"))
+        r = FleetRouter([a, b])
+        with pytest.raises(TimeoutError):
+            r.drain("fd1", timeout=1)
+        assert not a.shut                          # shutdown never ran
+        assert "fd1" in r.replica_names()          # still ours to retry
+        info = r.introspect_requests()["replicas"]["fd1"]
+        assert info["draining"] is True
+        assert _total("fleet_draining", fleet=r.fleet_id) == 1
+        # dispatch keeps avoiding it meanwhile
+        assert r.submit([1], 1).replica == "fd2"
+        # the backlog cleared: the retry completes the removal
+        a.drain = lambda timeout=None: None
+        r.drain("fd1", timeout=5)
+        assert a.shut and r.replica_names() == ["fd2"]
+        assert _total("fleet_draining", fleet=r.fleet_id) == 0
+
+    def test_replica_side_drain_is_held_until_router_completes(self):
+        """engine.drain() called directly: the router observes it at
+        the next poll, stops dispatching, HOLDS the record, and
+        router.drain(name) completes the removal (gauge back to 0)."""
+        a, b = _FakeEngine("rs1", headroom=9000), _FakeEngine("rs2",
+                                                             headroom=10)
+        r = FleetRouter([a, b], backoff_s=0.001)
+        a.draining = True                # replica-side drain observed
+        assert r.submit([1], 1).replica == "rs2"
+        assert r.introspect_requests()["replicas"]["rs1"]["draining"]
+        assert _total("fleet_draining", fleet=r.fleet_id) == 1
+        assert "rs1" in r.replica_names()          # held, not forgotten
+        r.drain("rs1", timeout=5)                  # operator completes
+        assert a.shut and r.replica_names() == ["rs2"]
+        assert _total("fleet_draining", fleet=r.fleet_id) == 0
+
+    def test_half_open_admits_exactly_one_probe_via_router(self):
+        """While a half-open probe is IN FLIGHT, a second dispatch must
+        not also land on the suspect replica (the claim is atomic with
+        the dispatch decision, not with the earlier candidate gate)."""
+        a = _FakeEngine("hp1", headroom=9000,
+                        submit_error=RuntimeError("down"))
+        b = _FakeEngine("hp2", headroom=10)
+        r = FleetRouter([a, b], backoff_s=0.001, breaker_failures=1,
+                        breaker_probe_interval_s=0.01)
+        r.submit([1], 1)                  # opens the breaker on hp1
+        time.sleep(0.02)                  # cool-down elapses
+        a.submit_error = None
+        with r._lock:                     # claim the half-open probe,
+            rep = r._replicas["hp1"]      # as an in-flight dispatch
+            assert rep.breaker.allows(time.monotonic())
+            rep.breaker.on_dispatch()
+        # probe unresolved: the next dispatch must avoid hp1 entirely
+        assert r.submit([1], 1).replica == "hp2"
+        rep.breaker.record_success()
+        assert r.submit([1], 1).replica == "hp1"
+
+    def test_shutdown_drops_labels_and_unregisters(self):
+        from paddle_hackathon_tpu.observability import tracing
+        a = _FakeEngine("sh1")
+        r = FleetRouter([a])
+        r.submit([1], 1)
+        assert r.fleet_id in tracing.introspection_tables()
+        r.shutdown()
+        assert a.shut
+        assert r.fleet_id not in tracing.introspection_tables()
+        assert _total("fleet_dispatch_total", fleet=r.fleet_id) == 0
+
+    def test_duplicate_replica_name_rejected(self):
+        r = FleetRouter([_FakeEngine("dup")])
+        with pytest.raises(ValueError):
+            r.add_replica(_FakeEngine("dup"))
+        with pytest.raises(ValueError):
+            FleetRouter(policy="weird")
+
+
+# ---------------------------------------------------------------------------
+# engine-side fast checks (construction only — no tick ever compiles)
+# ---------------------------------------------------------------------------
+
+def _tiny_model():
+    from paddle_hackathon_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    paddle.seed(3)
+    cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=1,
+                    num_heads=2, max_position_embeddings=64,
+                    hidden_dropout_prob=0.0, attention_dropout_prob=0.0,
+                    use_flash_attention=False)
+    m = GPTForCausalLM(cfg)
+    m.eval()
+    return m
+
+
+def test_engine_crash_record_cleared_by_next_burst():
+    """A historical loop crash must not poison a later clean drain:
+    the failed requests already surfaced their errors, and a fresh
+    burst's loop start supersedes the record (white-box: submit's
+    loop-start path clears _crashed)."""
+    from paddle_hackathon_tpu.inference import ServingEngine
+    eng = ServingEngine(_tiny_model(), max_slots=2, max_len=32,
+                        chunk=4, auto_run=True)
+    eng._crashed = RuntimeError("old crash, requests already failed")
+    req = eng.submit([1, 2, 3], 2)       # new burst: record superseded
+    assert req.wait(60) and req.error is None
+    eng.drain(timeout=60)                # clean drain, no spurious raise
+    eng.shutdown()
+
+
+def test_engine_drain_raises_on_mid_drain_crash():
+    """A loop crash during drain empties slots/queue by FAILING the
+    backlog — drain() must report that loudly (crash as __cause__),
+    never as a clean removal, and must leave the pinned crash beacon
+    alone (white-box: the fail-all path stamps _crashed)."""
+    from paddle_hackathon_tpu.inference import ServingEngine
+    from paddle_hackathon_tpu.observability import tracing
+    eng = ServingEngine(_tiny_model(), max_slots=2, max_len=32,
+                        auto_run=False)
+    tracing.heartbeat(f"serving.{eng.engine_id}")
+    tracing.pin_beacon(f"serving.{eng.engine_id}")
+    eng._crashed = RuntimeError("tick blew up")
+    with pytest.raises(RuntimeError, match="FAILED, not completed"):
+        eng.drain(timeout=5)
+    # the stale-is-the-alert beacon survived the failed drain
+    assert f"serving.{eng.engine_id}" in tracing.beacon_ages()
+    tracing.remove_beacon(f"serving.{eng.engine_id}")
+
+
+def test_engine_drain_refuses_submit_and_reports_draining():
+    from paddle_hackathon_tpu.inference import ServingEngine
+    eng = ServingEngine(_tiny_model(), max_slots=2, max_len=32,
+                        auto_run=False)
+    rep = eng.load_report()
+    assert rep["draining"] is False
+    assert "prefix_digest" not in rep        # dense replica: no block
+    eng.drain(timeout=5)                     # idle: returns immediately
+    assert eng.draining
+    assert eng.load_report()["draining"] is True
+    with pytest.raises(EngineDraining):
+        eng.submit([1, 2], 2)
+    assert eng.introspect_requests()["draining"] is True
+    eng.drain(timeout=5)                     # idempotent
+    eng.shutdown()
+
+
+def test_paged_engine_load_report_has_prefix_digest_block():
+    from paddle_hackathon_tpu.inference import ServingEngine
+    eng = ServingEngine(_tiny_model(), max_slots=2, max_len=32,
+                        auto_run=False, cache_mode="paged", page_size=8)
+    pd = eng.load_report()["prefix_digest"]
+    assert pd["algo"] == "crc32-pages" and pd["page_size"] == 8
+    assert pd["digests"] == []               # no traffic yet
+    eng.shutdown()
+
+
+def test_pp_deadline_sweep_consults_owning_wave_only():
+    """Regression (white-box): every ``_inflight`` record snapshots ALL
+    slots, so matching a slot's request against ARBITRARY records
+    deferred mid-decode deadline expiry forever on pp>1 engines under
+    steady decode (some wave is always mid-pipeline).  The sweep must
+    consult only the record of the wave that OWNS the slot."""
+    from paddle_hackathon_tpu.inference import ServingEngine
+    eng = ServingEngine(_tiny_model(), max_slots=4, max_len=32,
+                        auto_run=False)
+    # stage a pp=2 layout by hand (a real pp engine needs an ambient
+    # pp mesh): waves own slots [0,1] and [2,3]
+    req = eng.submit([1, 2, 3], 2, deadline_s=0.0)     # already expired
+    with eng._lock:
+        eng._pending.clear()
+        eng._slots[1].req = req                        # slot 1: wave 0
+        eng._lengths[1] = 3
+        eng._pp = 2
+        eng._wave = 2
+        # a FOREIGN wave's record (wave 1 does not own slot 1) still
+        # snapshots all slots, including this req
+        eng._inflight[1] = (np.zeros(4, np.int32), [False] * 4,
+                            [s.req for s in eng._slots])
+        eng._expire_slots_locked()
+    assert isinstance(req.error, DeadlineExceededError)
+    assert req.lifecycle["where"] == "deadline"
+
+    req2 = eng.submit([1, 2, 3], 2, deadline_s=0.0)
+    with eng._lock:
+        eng._pending.clear()
+        eng._slots[0].req = req2                       # slot 0: wave 0
+        eng._lengths[0] = 3
+        # the OWNING wave's record defers (its rows are still written
+        # mid-pipeline); the wave exits within pp ticks either way
+        eng._inflight[0] = (np.zeros(4, np.int32), [False] * 4,
+                            [s.req for s in eng._slots])
+        eng._expire_slots_locked()
+    assert req2.error is None
+    eng._inflight.clear()
+    eng.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# slow acceptance drills (2 tiny replicas, real programs)
+# ---------------------------------------------------------------------------
+
+def _drill_model():
+    from paddle_hackathon_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    # per-replica model instance: functional_call swaps state into the
+    # live layer tree, so concurrent replica traces must not share one
+    # model object — same seed => bit-identical weights
+    paddle.seed(3)
+    cfg = GPTConfig(vocab_size=128, hidden_size=64, num_layers=2,
+                    num_heads=4, max_position_embeddings=128,
+                    hidden_dropout_prob=0.0, attention_dropout_prob=0.0,
+                    use_flash_attention=False)
+    m = GPTForCausalLM(cfg)
+    m.eval()
+    return m
+
+
+def _drill_engine(**kw):
+    from paddle_hackathon_tpu.inference import ServingEngine
+    kw.setdefault("cache_mode", "paged")
+    kw.setdefault("page_size", 8)
+    return ServingEngine(_drill_model(), max_slots=2, max_len=64,
+                        chunk=4, **kw)
+
+
+MAXNEW = 8
+
+
+def _prompts_and_refs(n=6):
+    m = _drill_model()
+    rs = np.random.RandomState(7)
+    # lengths repeat so generate() compiles a bounded set of shapes;
+    # content is distinct so the paged prefix cache gives no affinity
+    # pull and dispatch is purely load-driven
+    lens = [(6, 9, 7, 11, 8, 10)[k % 6] for k in range(n)]
+    prompts = [rs.randint(0, 128, (k,)).astype(np.int32) for k in lens]
+    refs = [np.asarray(m.generate(p[None], max_new_tokens=MAXNEW,
+                                  temperature=0.0))[0] for p in prompts]
+    return prompts, refs
+
+
+@pytest.mark.slow
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+def test_failover_drill_kill_replica_mid_flight():
+    """THE acceptance drill: PHT_FAULTS kills one of two replicas on
+    its 3rd tick.  Every not-yet-started request must complete on the
+    survivor with EXACT greedy tokens; started streams must fail
+    loudly; the survivor must leak zero pages.  10 requests over 2+2
+    slots guarantee the killed replica holds UNSTARTED work (queued
+    or mid-prefill) on its 3rd tick, whatever the dispatch split."""
+    prompts, refs = _prompts_and_refs(10)
+    e1, e2 = _drill_engine(), _drill_engine()
+    faults.arm_point(f"serving.tick[{e1.engine_id}]", "fail", nth=3)
+    try:
+        router = FleetRouter([e1, e2], backoff_s=0.01, breaker_failures=1)
+        frs = [router.submit(p, MAXNEW) for p in prompts]
+        ok = failed = failovers = 0
+        for fr, ref in zip(frs, refs):
+            assert fr.wait(180), "request hung"
+            if fr.error is None:
+                # zero lost AND zero duplicated tokens: completed
+                # output is bit-exact vs the single-model greedy run
+                assert np.array_equal(fr.result(), ref)
+                ok += 1
+                failovers += fr.retries > 0
+            else:
+                # loud failure: a STARTED stream names itself; its
+                # lifecycle carries a terminal record on the engine
+                assert isinstance(fr.error, StreamInterruptedError)
+                assert len(fr.tokens) > 0
+                failed += 1
+        assert ok + failed == len(prompts)
+        assert failovers >= 1          # somebody completed via failover
+        assert ok >= failed            # most requests survive the drill
+    finally:
+        faults.disarm()
+    # pool-leak tripwire on the survivor: drain, drop the prefix
+    # cache, every page must be home
+    e2.drain(timeout=120)
+    e2.drop_prefix_cache()
+    assert e2.kv_pages_in_use == 0
+    e2.shutdown()
+    # the dead replica's fail-all released its slot pages too
+    e1.drop_prefix_cache()
+    assert e1.kv_pages_in_use == 0
+
+
+@pytest.mark.slow
+def test_drain_under_load_loses_nothing():
+    prompts, refs = _prompts_and_refs()
+    e1, e2 = _drill_engine(), _drill_engine()
+    router = FleetRouter([e1, e2], backoff_s=0.01)
+    # streaming through the fleet: token-exact vs the reference
+    assert list(router.submit_stream(prompts[0], MAXNEW)) \
+        == list(refs[0][-MAXNEW:])
+    inflight = [router.submit(p, MAXNEW) for p in prompts]
+    router.drain(e1.engine_id, timeout=180)
+    for fr, ref in zip(inflight, refs):
+        assert fr.wait(180) and fr.error is None
+        assert np.array_equal(fr.result(), ref)
+    assert router.replica_names() == [e2.engine_id]
+    with pytest.raises(EngineDraining):
+        e1.submit([1, 2], 2)
+    # new traffic lands on the survivor
+    fr = router.submit(prompts[1], MAXNEW)
+    assert fr.wait(120) and fr.replica == e2.engine_id
+    router.shutdown()
+
+
+@pytest.mark.slow
+def test_affinity_beats_round_robin_on_repeat_prefix_workload():
+    """Acceptance: a repeat-prefix workload routed with affinity shows
+    a higher prefix-hit ratio on the affine replica than round-robin
+    dispatch gives any replica (no wall-clock gate — hit counters
+    only)."""
+    rs = np.random.RandomState(11)
+    shared = rs.randint(0, 128, (24,)).astype(np.int32)   # 3 full pages
+    prompts = [np.concatenate([shared,
+                               rs.randint(0, 128, (4,)).astype(np.int32)])
+               for _ in range(6)]
+
+    def run(policy):
+        e1, e2 = _drill_engine(), _drill_engine()
+        router = FleetRouter([e1, e2], policy=policy)
+        for p in prompts:
+            fr = router.submit(p, 4)
+            assert fr.wait(180) and fr.error is None
+        ratios = [e.stats["prefix_hit_rate"] for e in (e1, e2)]
+        router.shutdown()
+        return ratios
+
+    affine = run("least_loaded")
+    rr = run("round_robin")
+    # the affine replica saw (nearly) every repeat and re-used pages
+    assert max(affine) > max(rr)
+    # and in absolute terms the affinity fleet recycled most prompt
+    # tokens on its hot replica (5 of 6 prompts hit 3 of 3.5 pages)
+    assert max(affine) > 0.5
+
+
+@pytest.mark.slow
+def test_fleet_drive_clean_under_sanitizers():
+    """Router acceptance under the runtime race + lock sanitizers: the
+    shared state discipline (make_lock + share_object) must hold on a
+    real concurrent drive — engines constructed INSIDE the contexts so
+    their locks are instrumented."""
+    from paddle_hackathon_tpu.observability import sanitizers
+    prompts, refs = _prompts_and_refs(4)
+    with sanitizers.lock_sanitizer(), sanitizers.race_sanitizer():
+        e1, e2 = _drill_engine(), _drill_engine()
+        router = FleetRouter([e1, e2], backoff_s=0.01)
+        frs = [router.submit(p, MAXNEW, stream=(i == 0))
+               for i, p in enumerate(prompts)]
+        assert list(frs[0].stream()) == list(refs[0][-MAXNEW:])
+        for fr, ref in zip(frs, refs):
+            assert fr.wait(180) and fr.error is None
+            assert np.array_equal(fr.result(), ref)
+        router.drain(e1.engine_id, timeout=180)
+        router.shutdown()

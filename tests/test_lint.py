@@ -108,10 +108,14 @@ def test_repo_wide_lint_is_clean():
     for rule in ("PHT001", "PHT003", "PHT006", "PHT009", "PHT010"):
         assert rule in stats["rule_counts"], stats["rule_counts"]
     assert stats["files"] > 100   # whole scope, not a partial walk
-    # budget on process-CPU seconds, not wall: the walk is
+    # budget on process-CPU seconds net of GC, not wall: the walk is
     # single-threaded pure CPU, so cpu_s == wall on an idle box but —
     # unlike wall — does not flake when the (already over-budget)
-    # tier-1 suite shares the box with other load
+    # tier-1 suite shares the box with other load, and — unlike gross
+    # CPU — does not flake when this test runs INSIDE the suite, where
+    # every collection triggered by the walk's allocations scans the
+    # jax + compiled-program heap the suite has piled up
+    assert stats["gc_cpu_s"] >= 0.0
     assert stats["cpu_s"] < 10.0, (
         f"repo-wide pht-lint burned {stats['cpu_s']:.1f} CPU-s — over "
         "the ~10s budget; profile the passes (python -m tools.pht_lint "

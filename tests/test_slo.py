@@ -318,8 +318,10 @@ def test_mfu_and_phase_gauges_from_compiled_fit(monkeypatch):
 # /load golden schema (HTTP and direct), goodput, SLO windows
 # ---------------------------------------------------------------------------
 
-_LOAD_KEYS = {"version", "engine", "ts", "running", "tickno", "slots",
-              "queue", "modes", "slo", "goodput", "admission"}
+# "draining" joined in the fleet PR (router contract bump within
+# version 1); paged engines additionally carry a "prefix_digest" block
+_LOAD_KEYS = {"version", "engine", "ts", "running", "draining", "tickno",
+              "slots", "queue", "modes", "slo", "goodput", "admission"}
 _SLO_SERIES = {"ttft", "tpot", "e2e", "queue_wait"}
 
 

@@ -91,7 +91,8 @@ def main(argv=None) -> int:
         if stats is not None:
             print(f"pht-lint stats: {stats['files']} file(s), "
                   f"{stats['total_s']:.2f}s wall "
-                  f"({stats['cpu_s']:.2f}s cpu)")
+                  f"({stats['cpu_s']:.2f}s cpu net of "
+                  f"{stats['gc_cpu_s']:.2f}s gc)")
             for name, rules in PASS_RULES.items():
                 print(f"  pass {name:<5} ({' '.join(rules)}): "
                       f"{stats['passes'][name]:.2f}s")

@@ -17,6 +17,13 @@ Chains, in order:
    (``core/jaxcompat.py`` has been wiped by a re-seed before; a broken
    bridge must fail the pre-PR check loudly, not as a downstream XLA
    abort).
+4. **fault drills** — deterministic ``PHT_FAULTS`` drills against
+   host-only stubs (no tick program compiles).  Currently one: the
+   fleet dispatch-failover drill — an injected ``fleet.dispatch`` fault
+   plus a submit-time replica death must re-dispatch cleanly (retry
+   books, survivor completes).  The started-stream loud-failure path
+   and mid-flight kills live in ``tests/test_fleet.py``'s acceptance
+   drills, not here.  Add new drills to ``_DRILLS``.
 
 Exit codes (perf_gate convention): 0 = every step that ran passed,
 1 = at least one step failed, 2 = usage error.
@@ -42,9 +49,77 @@ _CANARY = (
 )
 
 
-def _run_step(name: str, argv, results, display=None) -> None:
+# ``PHT_FAULTS`` fault drills run as step 4: (name, env-spec, script).
+# Each script runs in a fresh interpreter with the spec armed through
+# the environment (the same delivery the crash drills use), against
+# host-only stubs — no tick program compiles, so the step stays cheap.
+_FLEET_DRILL = """
+import numpy as np, threading, itertools
+from paddle_hackathon_tpu.inference.fleet import (
+    FleetRouter, StreamInterruptedError)
+
+_ids = itertools.count()
+class Req:
+    def __init__(self, prompt, n, on_token=None):
+        self.rid = next(_ids); self.prompt = np.asarray(prompt, np.int32)
+        self.tokens = []; self.done = False; self.error = None
+        self._event = threading.Event(); self.on_token = on_token; self.n = n
+    def finish(self):
+        self.tokens = list(range(self.n)); self.done = True
+        self._event.set()
+    def result(self):
+        if self.error is not None:
+            raise RuntimeError('failed') from self.error
+        return np.concatenate([self.prompt, np.asarray(self.tokens, np.int32)])
+
+class Stub:
+    def __init__(self, name, headroom):
+        self.engine_id = name; self.headroom = headroom; self.submitted = []
+    def load_report(self):
+        return {'version': 1, 'engine': self.engine_id, 'draining': False,
+                'slots': {'max': 8, 'active': 0, 'free': 8},
+                'queue': {'depth': 0, 'oldest_wait_s': 0.0},
+                'admission': {'headroom_tokens': self.headroom}}
+    def submit(self, prompt, max_new_tokens, deadline_s=None,
+               on_token=None, **kw):
+        r = Req(prompt, max_new_tokens, on_token)
+        self.submitted.append(r); r.finish(); return r
+    def drain(self, timeout=None): pass
+    def shutdown(self, timeout=None): pass
+
+a, b = Stub('drill-a', 9000), Stub('drill-b', 100)
+router = FleetRouter([a, b], backoff_s=0.001, breaker_failures=1)
+# PHT_FAULTS fleet.dispatch=fail@1 kills the FIRST placement attempt:
+# the retry must land the request anyway and book exactly one retry
+fr = router.submit([1, 2, 3], 4)
+assert fr.wait(10) and fr.error is None, fr.error
+assert list(fr.result()) == [1, 2, 3, 0, 1, 2, 3]
+assert fr.retries == 0  # placement retry, not a failover
+from paddle_hackathon_tpu.observability import get_registry
+assert get_registry().total('fleet_retries_total',
+                            fleet=router.fleet_id) == 1
+# replica death before any token: failover to the survivor
+dead = Stub('drill-c', 9000); live = Stub('drill-d', 10)
+dead.submit = lambda *a, **k: (_ for _ in ()).throw(
+    RuntimeError('replica down'))
+r2 = FleetRouter([dead, live], backoff_s=0.001, breaker_failures=1)
+fr2 = r2.submit([7], 2)
+assert fr2.wait(10) and fr2.replica == 'drill-d'
+print('fleet drill: dispatch-fault retry + failover OK')
+"""
+
+_DRILLS = [
+    ("fleet-drill", "fleet.dispatch=fail@1", _FLEET_DRILL),
+]
+
+
+def _run_step(name: str, argv, results, display=None, env=None) -> None:
     print(f"== {name}: {display or ' '.join(argv)}")
-    proc = subprocess.run(argv, cwd=REPO_ROOT)
+    run_env = None
+    if env:
+        run_env = dict(os.environ)
+        run_env.update(env)
+    proc = subprocess.run(argv, cwd=REPO_ROOT, env=run_env)
     ok = proc.returncode == 0
     results.append((name, "PASS" if ok else f"FAIL (rc={proc.returncode})"))
 
@@ -97,6 +172,12 @@ def main(argv=None) -> int:
                   [sys.executable, "-c", _CANARY], results,
                   display="python -c '<import the jaxcompat bridge "
                           "symbols>'")
+
+    for name, spec, script in _DRILLS:
+        _run_step(name, [sys.executable, "-c", script], results,
+                  display=f"PHT_FAULTS='{spec}' python -c "
+                          f"'<host-only {name}>'",
+                  env={"PHT_FAULTS": spec})
 
     print("\nprecommit summary:")
     width = max(len(n) for n, _ in results)
