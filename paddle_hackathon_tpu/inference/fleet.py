@@ -26,7 +26,12 @@ Dispatch (least-loaded + cache-affinity):
   replica already holds the prompt's prefix pages — repeat tenants land
   where their KV lives), then most headroom, then shortest queue;
 - when nobody has headroom the request queues on the least-loaded
-  replica (engines queue internally; FIFO admission bounds the wait).
+  replica (engines queue internally; FIFO admission bounds the wait);
+- ``submit(session=)`` turns pin to the replica that served the last
+  turn (it retains the conversation's KV pages for a suffix-cache
+  resume); a draining/unhealthy pin target is skipped and the turn
+  migrates — the pin is a fast path over the ``prefix_digest``
+  affinity, never load-bearing for correctness.
 
 Robustness is the headline:
 
@@ -91,6 +96,11 @@ __all__ = ["FleetRouter", "FleetRequest", "CircuitBreaker",
            "BREAKER_CLOSED", "BREAKER_HALF_OPEN", "BREAKER_OPEN"]
 
 _FLEET_IDS = itertools.count()
+
+# session-pin map bound: pins past this evict oldest-first (the evicted
+# conversation still routes right via prefix_digest affinity — a pin is
+# a fast path, never load-bearing for correctness)
+MAX_SESSION_PINS = 4096
 
 
 class NoReplicaAvailableError(RuntimeError):
@@ -282,11 +292,12 @@ class FleetRequest:
     layout.)"""
 
     def __init__(self, router, prompt, max_new_tokens, kw, deadline_s,
-                 stream):
+                 stream, session=None):
         self._router = router
         self.prompt = np.asarray(prompt, np.int32).reshape(-1)
         self.max_new_tokens = int(max_new_tokens)
         self._kw = kw                      # sampling overrides
+        self.session = session             # multi-turn KV session key
         self.deadline_s = deadline_s
         self._t_submit = time.perf_counter()
         # RLock: _recover holds it across _place, which re-acquires it
@@ -523,6 +534,13 @@ class FleetRouter:
         self._lock = make_lock("fleet.router")
         self._replicas: Dict[str, _Replica] = {}
         self._rr = 0                      # round_robin rotation cursor
+        # session stickiness: session key -> last replica that served a
+        # turn of it (that replica retains the conversation's KV pages,
+        # so a returning turn must land there to resume — the pin is a
+        # fast path over the prefix_digest affinity scoring, which
+        # still catches pin misses).  Bounded LRU: a chat fleet sees
+        # unbounded session churn and the map must not grow with it.
+        self._session_pins: Dict[str, str] = {}
         self.fleet_id = f"f{next(_FLEET_IDS)}"
         self._flight = _flight.get_flight_recorder()
 
@@ -653,6 +671,15 @@ class FleetRouter:
             rep.draining = True
             self._g_draining.set(
                 sum(r.draining for r in self._replicas.values()))
+            # unpin its sessions NOW: the next turn of each migrates to
+            # a survivor instead of queuing behind a drain (the drained
+            # engine donates retained chains to its prefix cache, so a
+            # same-replica re-admission would have replayed — but the
+            # replica is leaving; the survivor re-prefilles, tokens
+            # stay exact)
+            for sid in [s for s, n in self._session_pins.items()
+                        if n == rep.name]:
+                del self._session_pins[sid]
 
     def _record_failure(self, rep: _Replica) -> None:
         with self._lock:
@@ -677,12 +704,24 @@ class FleetRouter:
         if not by_name:
             return False
         need = int(len(freq.prompt)) + freq.max_new_tokens
-        if self.policy == "round_robin":
-            names = sorted(by_name)
-            with self._lock:
+        name = None
+        with self._lock:
+            # session stickiness: resolve the pin and ACT on it under
+            # ONE lock hold (no TOCTOU window against _mark_draining's
+            # pin purge) — the pinned replica retains this
+            # conversation's KV pages, so land there while it is a
+            # live candidate; a draining/unhealthy/excluded pin fell
+            # out of by_name above, so the turn migrates via the
+            # normal pick below
+            if freq.session is not None:
+                pinned = self._session_pins.get(freq.session)
+                if pinned is not None and pinned in by_name:
+                    name = pinned
+            if name is None and self.policy == "round_robin":
+                names = sorted(by_name)
                 name = names[self._rr % len(names)]
                 self._rr += 1
-        else:
+        if name is None:
             digests = None
             sizes = {(rep.get("prefix_digest") or {}).get("page_size")
                      for _, rep in by_name.values()}
@@ -752,6 +791,15 @@ class FleetRouter:
             raise e
         self._count(rep.name, "ok")
         self._record_success(rep)
+        if freq.session is not None:
+            with self._lock:
+                # (re)pin last-wins; re-insert for LRU recency so hot
+                # conversations survive the bound
+                self._session_pins.pop(freq.session, None)
+                self._session_pins[freq.session] = rep.name
+                while len(self._session_pins) > MAX_SESSION_PINS:
+                    self._session_pins.pop(
+                        next(iter(self._session_pins)))
         with freq._lock:
             freq._req = req
             freq._replica = rep.name
@@ -800,15 +848,28 @@ class FleetRouter:
     # public submission surface
     def submit(self, prompt, max_new_tokens: int = 32, *,
                temperature=None, top_k=None, top_p=None,
-               deadline_s=None, stream: bool = False) -> FleetRequest:
+               deadline_s=None, stream: bool = False,
+               session=None) -> FleetRequest:
         """Dispatch a request to the best replica (module docstring has
         the scoring); returns a :class:`FleetRequest`.  Raises
         :class:`NoReplicaAvailableError` when no replica accepts within
-        the retry budget."""
+        the retry budget.
+
+        ``session=`` names a multi-turn conversation: the key is handed
+        through to the replica (``ServingEngine.submit(session=)``
+        resumes the retained KV chain there) and the router PINS the
+        session to the replica that served it, so the next turn lands
+        where its pages live.  A pinned replica that is draining,
+        unhealthy or breaker-open is simply skipped — the turn migrates
+        (the survivor replays from its prefix cache at best, a cold
+        prefill at worst; tokens stay exact either way) and the pin
+        follows the new placement."""
         freq = FleetRequest(
             self, prompt, max_new_tokens,
-            {"temperature": temperature, "top_k": top_k, "top_p": top_p},
-            None if deadline_s is None else float(deadline_s), stream)
+            {"temperature": temperature, "top_k": top_k, "top_p": top_p,
+             "session": session},
+            None if deadline_s is None else float(deadline_s), stream,
+            session=session)
         try:
             self._place(freq)
         except BaseException as e:
@@ -947,6 +1008,7 @@ class FleetRouter:
         with self._lock:
             reps = list(self._replicas.values())
             self._replicas.clear()
+            self._session_pins.clear()
             self._g_draining.set(0)
         for rep in reps:
             try:
@@ -970,5 +1032,6 @@ class FleetRouter:
                            r.breaker.consecutive_failures,
                        "draining": r.draining}
                 for name, r in self._replicas.items()}
+            pins = len(self._session_pins)
         return {"fleet": self.fleet_id, "policy": self.policy,
-                "replicas": replicas}
+                "session_pins": pins, "replicas": replicas}

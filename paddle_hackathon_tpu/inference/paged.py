@@ -145,6 +145,59 @@ class PagePool:
     def refcount(self, page: int) -> int:
         return int(self._ref[page])
 
+    def allocated_ids(self) -> List[int]:
+        """Ascending ids of every allocated page (refcount > 0, null page
+        excluded) — the compaction planner's input."""
+        return [int(p) for p in np.nonzero(self._ref[1:])[0] + 1]
+
+    def highest_allocated(self) -> int:
+        """Highest allocated page id, or 0 when the pool is empty — the
+        fragmentation signal: ``highest_allocated() + 1`` much larger
+        than ``allocated`` means live pages are scattered across a
+        mostly-free pool and a compaction would shrink the touched
+        footprint."""
+        ids = np.nonzero(self._ref[1:])[0]
+        return int(ids[-1] + 1) if len(ids) else 0
+
+    def compaction_plan(self) -> List[tuple]:
+        """``[(src, dst), ...]`` moves that pack every allocated page
+        into the lowest ids ``1..allocated`` (null page stays put).
+        Sources and destinations are provably disjoint: dsts are the
+        FREE ids among ``1..allocated`` and srcs are the allocated ids
+        above ``allocated``, so applying the moves in any order is safe
+        and the device copy can be one batched gather/scatter.  Empty
+        when the pool is already packed."""
+        ids = self.allocated_ids()
+        n = len(ids)
+        dsts = [p for p in range(1, n + 1) if self._ref[p] == 0]
+        srcs = [p for p in ids if p > n]
+        assert len(srcs) == len(dsts)
+        return list(zip(srcs, dsts))
+
+    def apply_moves(self, moves) -> List[tuple]:
+        """Commit a :meth:`compaction_plan` to the host bookkeeping:
+        refcounts move ``src -> dst`` and the free list is rebuilt.
+        Each pair is re-validated (``src`` still allocated, ``dst``
+        still free) so a page freed between planning and commit — e.g. a
+        concurrent :meth:`PrefixCache.drop` from another thread — is
+        skipped rather than corrupting the pool; the device copy wrote
+        garbage into a free page, which is harmless.  Returns the pairs
+        actually applied (the caller remaps its page tables from
+        these)."""
+        applied = []
+        for src, dst in moves:
+            src, dst = int(src), int(dst)
+            if self._ref[src] <= 0 or self._ref[dst] != 0:
+                continue
+            self._ref[dst] = self._ref[src]
+            self._ref[src] = 0
+            applied.append((src, dst))
+        # LIFO order with the lowest ids last keeps the packed tail of
+        # the pool as the first pages handed out next
+        self._free = [p for p in range(self.num_pages - 1, 0, -1)
+                      if self._ref[p] == 0]
+        return applied
+
     def cow(self, page: int):
         """Copy-on-write fork of ``page``: exclusively-owned pages are
         returned as-is; shared pages trade this caller's reference for a
@@ -290,6 +343,20 @@ class PrefixCache:
         top = heapq.nlargest(int(limit), self._nodes,
                              key=lambda nd: nd.stamp)
         return [nd.digest for nd in top]
+
+    def remap_pages(self, remap: dict) -> int:
+        """Rewrite cached physical page ids after a pool compaction
+        (``remap`` maps old id -> new id, from
+        :meth:`PagePool.apply_moves`).  Refcounts already moved with the
+        pool commit; this keeps the radix tree pointing at the pages'
+        new homes.  Returns how many nodes were rewritten."""
+        n = 0
+        for node in self._nodes:
+            new = remap.get(node.page)
+            if new is not None:
+                node.page = int(new)
+                n += 1
+        return n
 
     def evict(self, n: int) -> int:
         """Free up to ``n`` pages by dropping LRU leaves nobody else

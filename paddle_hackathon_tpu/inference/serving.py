@@ -45,6 +45,7 @@ import collections.abc
 import itertools
 import threading
 import time
+import zlib
 from typing import List, Optional
 
 import numpy as np
@@ -68,7 +69,8 @@ class _EngineStats(collections.abc.Mapping):
 
     _KEYS = ("ticks", "tokens", "requests",
              "spec_ticks", "spec_drafted", "spec_accepted",
-             "prefix_hit_tokens", "prompt_tokens", "prefix_hit_rate")
+             "prefix_hit_tokens", "prompt_tokens", "prefix_hit_rate",
+             "session_resumes", "session_hit_tokens")
 
     def __init__(self, counters):
         self._counters = counters   # key -> Counter child
@@ -365,10 +367,11 @@ class Request:
                  "temperature", "top_k", "top_p", "_event",
                  "_t_submit", "_t_first", "rid", "_span_queue",
                  "_span_life", "lifecycle", "_tick_mark", "deadline_s",
-                 "on_token")
+                 "on_token", "session")
 
     def __init__(self, prompt, max_new_tokens, temperature=None,
-                 top_k=None, top_p=None, deadline_s=None, on_token=None):
+                 top_k=None, top_p=None, deadline_s=None, on_token=None,
+                 session=None):
         self.rid = next(_REQ_IDS)   # process-wide request id (spans/flight)
         self.prompt = np.asarray(prompt, np.int32).reshape(-1)
         self.max_new_tokens = int(max_new_tokens)
@@ -377,6 +380,7 @@ class Request:
         self.top_p = None if top_p is None else float(top_p)
         self.deadline_s = None if deadline_s is None else float(deadline_s)
         self.on_token = on_token
+        self.session = session   # multi-turn KV session key (or None)
         self.tokens: List[int] = []  # generated so far
         self.done = False
         self.error: Optional[BaseException] = None
@@ -431,6 +435,40 @@ class _Slot:
         self.req: Optional[Request] = None
         self.off = 0      # prompt tokens consumed
         self.last = 0     # last sampled token (decode feed)
+
+
+class _Session:
+    """One retained multi-turn KV session (``submit(session=)``).
+
+    After a turn finishes, the engine keeps the request's page chain
+    alive here (the session holds the refs a slot normally drops at
+    release): ``tokens`` is the full conversation so far (prompt +
+    generated), ``pages`` its page chain, and ``kv_len`` the rows of
+    that chain holding token-exact KV of ``tokens[:kv_len]`` — a
+    returning turn whose prompt extends the conversation resumes from
+    that tail instead of re-prefilling the history.  ``digests`` are
+    the crc32 chain digests of the full retained pages (same form as
+    ``paged.page_digests``), published through ``/load`` so the fleet
+    router's cache-affinity scoring lands returning turns here.
+
+    ``busy``/``owner``: while a resumed turn is in flight the refs
+    live on its slot (``pages`` is empty) and only that owner's finish
+    installs the session's next state — a concurrently forked
+    regeneration (same session key while busy) serves independently
+    off the prefix cache and never clobbers the owner's install."""
+
+    __slots__ = ("sid", "tokens", "pages", "kv_len", "digests",
+                 "last_used", "busy", "owner")
+
+    def __init__(self, sid):
+        self.sid = sid
+        self.tokens = np.zeros(0, np.int32)
+        self.pages: List[int] = []
+        self.kv_len = 0
+        self.digests: List[int] = []
+        self.last_used = time.perf_counter()
+        self.busy = False
+        self.owner: Optional[int] = None   # owning request's rid
 
 
 class ServingEngine:
@@ -492,6 +530,12 @@ class ServingEngine:
         endpoint) publishes — "p99 over the last N seconds", the signal
         a least-loaded router dispatches on (docs/OBSERVABILITY.md,
         "SLO telemetry and the /load report").
+      session_ttl_s: idle lifetime of a retained multi-turn session
+        (``submit(session=)``); ``None`` (default) disables the TTL
+        sweep — sessions then live until LRU/admission-pressure
+        eviction, :meth:`drain`, or :meth:`drop_sessions`.
+      max_sessions: LRU cap on retained sessions (docs/SERVING.md,
+        "Multi-turn sessions").
     """
 
     # bounded count of radix-cache chain digests the /load report's
@@ -503,7 +547,8 @@ class ServingEngine:
                  temperature=0.0, top_k=None, eos_token_id=None,
                  auto_run=True, decode_window=8, top_p=None, spec_k=0,
                  drafter="ngram", cache_mode="dense", page_size=16,
-                 num_pages=None, prefix_cache=True, slo_window_s=60.0):
+                 num_pages=None, prefix_cache=True, slo_window_s=60.0,
+                 session_ttl_s=None, max_sessions=64):
         import jax
         import jax.numpy as jnp
 
@@ -638,6 +683,18 @@ class ServingEngine:
         self._paged = cache_mode == "paged"
         self._pool = self._prefix = None
         self._peak_occupancy = 0
+        # multi-turn KV sessions (submit(session=)): sid -> _Session.
+        # Works in dense mode too (conversation tokens + fleet
+        # stickiness; only paged mode retains KV pages to resume from)
+        self._sessions = {}
+        self._session_ttl_s = (None if session_ttl_s is None
+                               else float(session_ttl_s))
+        self._max_sessions = int(max_sessions)
+        # page-pool defrag/compaction: while a compaction's device copy
+        # is in flight (driver thread, unlocked), admission must not
+        # hand out pages the move plan treats as free
+        self._defrag_busy = False
+        self._defrag_fn = None
         if self._paged:
             from .paged import PagePool, PrefixCache
             self._page_size = int(page_size)
@@ -653,6 +710,7 @@ class ServingEngine:
                 (self.max_slots, self._pages_per_slot), np.int32)
             self._slot_pages = [[] for _ in range(self.max_slots)]
             self._g_pages_free.set(self._pool.free)
+            self._defrag_fn = self._build_defrag_fn()
 
         if self._pp > 1:
             self._build_pp_tick()
@@ -720,6 +778,26 @@ class ServingEngine:
                 "serving_aborted_tokens_total",
                 "generated tokens of requests that failed/aborted "
                 "(work the caller never got)"),
+            # multi-turn sessions (submit(session=)): resumes and the
+            # history tokens those resumes served straight from retained
+            # pages — the turn-N TTFT win the serving_chat bench gates
+            "session_resumes": reg.counter(
+                "serving_session_resumes_total",
+                "turns resumed from a retained session's KV pages"),
+            "session_hit_tokens": reg.counter(
+                "serving_session_hit_tokens_total",
+                "prompt tokens served from retained session pages "
+                "(re-prefill skipped; paged cache mode only)"),
+            "sessions_evicted": reg.counter(
+                "serving_sessions_evicted_total",
+                "retained sessions evicted (TTL/LRU/admission "
+                "pressure/drain/drop)"),
+            "defrag_total": reg.counter(
+                "serving_defrag_total",
+                "KV page-pool compactions run"),
+            "defrag_pages_moved": reg.counter(
+                "serving_defrag_pages_moved_total",
+                "KV pages relocated by pool compactions"),
         }
         self._c = {k: fam.labels(**lbl) for k, fam in counters.items()}
         self.stats = _EngineStats(self._c)
@@ -766,6 +844,15 @@ class ServingEngine:
         self._g_pages_free = reg.gauge(
             "serving_kv_pages_free",
             "KV pool pages on the free list").labels(**lbl)
+        # multi-turn session retention (docs/SERVING.md): how many
+        # conversations this replica holds warm, and the pages they pin
+        # (distinct — sessions can share prompt pages via the cache)
+        self._g_sessions = reg.gauge(
+            "serving_sessions_retained",
+            "multi-turn KV sessions currently retained").labels(**lbl)
+        self._g_session_pages = reg.gauge(
+            "serving_session_pages_retained",
+            "distinct KV pages pinned by retained sessions").labels(**lbl)
         # MoE router telemetry (registered only for MoE engines so dense
         # engines don't grow empty series): entropy distribution + one
         # per-expert load-share histogram — a hot expert shows up as its
@@ -1144,9 +1231,13 @@ class ServingEngine:
         decode steady state reuses the resident copy."""
         if not self._paged:
             return {}
-        if self._pt_dev is None:
+        # driver-owned staging, read lock-free by design: writers that
+        # INVALIDATE (_pt_dev = None on admission/release/defrag) hold
+        # the lock, but the restage here runs only on the single-driver
+        # tick path — mirrored in share_object's atomic= declaration
+        if self._pt_dev is None:  # pht-lint: gil-atomic
             import jax.numpy as jnp
-            self._pt_dev = jnp.asarray(self._page_tables)
+            self._pt_dev = jnp.asarray(self._page_tables)  # pht-lint: gil-atomic
         return {"pt": self._pt_dev}
 
     # pht-lint: hot-root (MoE decode tick path — per-tick stats observe)
@@ -1409,7 +1500,7 @@ class ServingEngine:
     # scheduling
     def submit(self, prompt, max_new_tokens=32, temperature=None,
                top_k=None, top_p=None, deadline_s=None,
-               on_token=None) -> Request:
+               on_token=None, session=None) -> Request:
         """Queue a request.  ``deadline_s`` bounds the request's TOTAL
         wall budget from submit: still queued past it (queue-wait is
         where overload deadlines actually die) or still decoding past
@@ -1420,10 +1511,23 @@ class ServingEngine:
         ``serving_aborted_tokens_total``, the lifecycle record reads
         ``where="deadline"``.  ``on_token`` streams committed tokens
         per tick (see :class:`Request`).  A draining engine
-        (:meth:`drain`) refuses with :class:`EngineDraining`."""
+        (:meth:`drain`) refuses with :class:`EngineDraining`.
+
+        ``session`` (any hashable key) makes this turn part of a
+        multi-turn KV session: when the request finishes, its page
+        chain is RETAINED under the key instead of released, and a
+        later submit with the same key whose prompt extends the
+        conversation (prompt + generated tokens of the last turn)
+        resumes decoding from the retained tail — the history's pages
+        are re-mapped, not re-prefilled, so turn-N TTFT is
+        page-hit-dominated.  A prompt that diverges from the retained
+        conversation keeps the longest common prefix (partial tail
+        pages fork copy-on-write via ``PagePool.cow``).  Sessions are
+        evicted LRU/TTL and under admission pressure — retention never
+        starves admission (docs/SERVING.md, "Multi-turn sessions")."""
         req = Request(prompt, max_new_tokens, temperature=temperature,
                       top_k=top_k, top_p=top_p, deadline_s=deadline_s,
-                      on_token=on_token)
+                      on_token=on_token, session=session)
         need = len(req.prompt) + req.max_new_tokens
         # reserve headroom past the last committed row for the widest
         # in-flight write: a prefill chunk, or the (spec_k+1)-wide verify
@@ -1537,7 +1641,14 @@ class ServingEngine:
         caught this).  Deferral is safe — only the driver thread touches
         slot state, and the replay only needs to land before this tick's
         post-verify ingest, which runs later on this same thread."""
+        if self._defrag_busy:
+            # a compaction's device copy is in flight: the move plan
+            # treats low free pages as copy destinations, so admission
+            # must not hand them out mid-copy — requests stay queued
+            # for the tick after the commit
+            return []
         self._expire_queued_locked()
+        self._sweep_sessions_locked()
         replays = []
         for i, slot in enumerate(self._slots):
             if slot.req is not None or not self._pending:
@@ -1656,6 +1767,12 @@ class ServingEngine:
         aborted work and stamp the abort terminal on the lifecycle
         record / flight ring / ``recent_aborts`` debug ring."""
         req.error = err
+        if req.session is not None:
+            # retain what decoded before the abort: the next turn of
+            # the conversation resumes from the partial chain instead
+            # of a cold re-prefill (install takes the page refs BEFORE
+            # the release below resets the slot's table)
+            self._session_install_locked(i, req)
         self._slots[i].req = None
         self._sampling_cache = None  # membership changed: restage
         self._lengths[i] = 0
@@ -1697,6 +1814,26 @@ class ServingEngine:
         P = self._page_size
         reserve = max(self.chunk, self.spec_k + 1)
         total = pages_for(len(req.prompt) + req.max_new_tokens, reserve, P)
+        if req.session is not None:
+            # returning turn of a retained session: resume from the
+            # retained page chain instead of re-prefilling the history
+            # (a busy session — its owner turn still decoding — falls
+            # through to normal admission: the fork serves off the
+            # prefix cache and never touches the owner's pages)
+            sess = self._sessions.get(req.session)
+            if sess is not None and not sess.busy and sess.pages:
+                n = min(sess.kv_len, len(req.prompt) - 1)
+                diff = np.nonzero(sess.tokens[:n]
+                                  != req.prompt[:n])[0]
+                common = int(diff[0]) if len(diff) else int(n)
+                if common > 0:
+                    skip = self._session_resume_locked(i, req, sess,
+                                                       total, common)
+                    # None: the pool cannot cover the resume right now
+                    # even after eviction — keep the head queued (FIFO;
+                    # normal admission needs at least as many fresh
+                    # pages, so falling through could not admit either)
+                    return skip
         hit = (self._prefix.match(req.prompt)
                if self._prefix is not None else [])
         fresh_n = total - len(hit)
@@ -1705,14 +1842,20 @@ class ServingEngine:
             # evict ONLY when eviction can actually cover the shortfall
             # (cached_only counts exactly what evict can free leaf-up
             # right now, excluding cache-only nodes pinned under a live
-            # slot's tail) — otherwise an unadmittable head would flush
-            # a hot prefix cache for nothing and still not admit
-            if (self._prefix is None
-                    or self._prefix.cached_only() < short):
+            # slot's tail; session-evictable pages are the non-busy
+            # sessions' exclusively-held pages — retention must never
+            # starve admission) — otherwise an unadmittable head would
+            # flush a hot prefix cache for nothing and still not admit
+            cache_ev = (self._prefix.cached_only()
+                        if self._prefix is not None else 0)
+            if cache_ev + self._session_evictable_pages_locked() < short:
                 if hit:
                     self._pool.decref(hit)  # hand the matched refs back
                 return None
-            self._prefix.evict(short)
+            if cache_ev:
+                short -= self._prefix.evict(min(short, cache_ev))
+            if short > 0:
+                self._evict_sessions_for_locked(short)
         fresh = self._pool.alloc(fresh_n)
         if fresh is None:
             if hit:
@@ -1764,6 +1907,340 @@ class ServingEngine:
         self._pt_dev = None   # table changed: restage on next tick
         self._g_pages_used.set(self._pool.allocated)
         self._g_pages_free.set(self._pool.free)
+
+    # ------------------------------------------------------------------
+    # multi-turn KV sessions (submit(session=)) — docs/SERVING.md
+    # pht-lint: hot-root (session resume runs on the admission tick path)
+    def _session_resume_locked(self, i, req, sess, total, common):
+        """Admit slot ``i`` by resuming session ``sess``: the first
+        ``common`` conversation tokens' KV rows are already resident in
+        the session's retained page chain, so the slot takes those
+        pages over (the session's refs transfer — no incref/decref
+        churn) and prefills only the suffix.  A partial tail page that
+        is SHARED (prompt pages the prefix cache also references, or a
+        diverged turn cutting into cache-registered history) forks
+        copy-on-write via ``PagePool.cow`` — the fork's rows re-prefill
+        ("copy" by recompute), so the write-window invariant (no shared
+        page in ``[start, start+reserve)``) holds by construction.
+
+        Returns the skipped token count, or ``None`` when the pool
+        cannot cover the resume even after evicting LRU sessions and
+        prefix-cache pages (the request stays queued; nothing was
+        mutated)."""
+        P = self._page_size
+        kept_n = -(-common // P)          # ceil: pages holding [0, common)
+        keep = sess.pages[:kept_n]
+        fresh_n = total - kept_n
+        tail_shared = (common % P != 0
+                       and self._pool.refcount(keep[-1]) > 1)
+        need_free = fresh_n + (1 if tail_shared else 0)
+        short = need_free - self._pool.free
+        if short > 0:
+            short -= self._evict_sessions_for_locked(
+                short, exclude=sess.sid)
+            if short > 0:
+                if (self._prefix is None
+                        or self._prefix.cached_only() < short):
+                    return None
+                self._prefix.evict(short)
+        # commit point: the allocations below cannot fail (free pages
+        # verified above; one lock hold, nothing runs in between)
+        extra = sess.pages[kept_n:]
+        if extra:
+            # rows past the common prefix are a dead branch of the
+            # conversation (diverged turn): the transfer takes ALL the
+            # session's refs, the unused tail goes straight back
+            self._pool.decref(extra)
+        pages = list(keep)
+        skip = common
+        if common % P:
+            page, forked = self._pool.cow(pages[-1])
+            pages[-1] = page
+            if forked:
+                # shared tail forked to a private page: its rows are
+                # re-prefilled, so round the skip down to the boundary
+                skip = (common // P) * P
+        from .paged import NULL_PAGE
+        pages += self._pool.alloc(fresh_n)
+        sess.busy = True
+        sess.owner = req.rid
+        sess.pages = []               # refs now live on the slot
+        self._slot_pages[i] = pages
+        self._page_tables[i] = NULL_PAGE
+        self._page_tables[i, :len(pages)] = pages
+        self._pt_dev = None   # table changed: restage on next tick
+        self._c["session_resumes"].inc()
+        self._c["session_hit_tokens"].inc(skip)
+        self._g_pages_used.set(self._pool.allocated)
+        self._g_pages_free.set(self._pool.free)
+        self._update_session_gauges_locked()
+        self._flight.record(
+            "session", phase="resume", rid=req.rid,
+            engine=self._engine_id, slot=i, hit_tokens=skip,
+            kept_pages=kept_n)
+        return skip
+
+    # pht-lint: hot-root (session install runs on the tick commit path)
+    def _session_install_locked(self, i, req):
+        """Retain the finishing/aborting request's state as its session
+        (called from ``_finish``/``_abort_slot_locked`` BEFORE the slot's
+        lengths are zeroed and its pages released — the install takes
+        the page refs the release would drop).  Rules: a session busy
+        under ANOTHER owner is left alone (a forked regeneration must
+        not clobber the owner's in-flight turn); otherwise the last
+        finisher wins — previously retained pages are dropped and this
+        turn's chain replaces them."""
+        sid = req.session
+        sess = self._sessions.get(sid)
+        if sess is not None and sess.busy and sess.owner != req.rid:
+            return
+        if sess is None:
+            if len(self._sessions) >= self._max_sessions:
+                self._evict_lru_session_locked()
+            sess = self._sessions[sid] = _Session(sid)
+        sess.tokens = np.concatenate(
+            [req.prompt, np.asarray(req.tokens, np.int32)])
+        kv_len = 0
+        if self._paged:
+            # committed rows holding token-exact KV: the last generated
+            # token is never fed back, and lengths can overrun actual
+            # commits in multi/spec modes (window/verify advance, then
+            # an early finish discards the tail) — rows [0, p + N - 1)
+            # are valid in ALL modes, so clamp to that
+            kv_len = min(int(self._lengths[i]),
+                         len(req.prompt) + max(0, len(req.tokens) - 1))
+            if sess.pages:
+                # last-wins: a regeneration replaces the retained chain
+                self._pool.decref(sess.pages)
+                sess.pages = []
+            n_keep = -(-kv_len // self._page_size)
+            pages = self._slot_pages[i]
+            sess.pages = pages[:n_keep]
+            extra = pages[n_keep:]
+            if extra:
+                self._pool.decref(extra)   # write-window slack pages
+            self._slot_pages[i] = []       # refs transferred to session
+            P = self._page_size
+            crc, digs = 0, []
+            for k in range(kv_len // P):
+                crc = zlib.crc32(
+                    sess.tokens[k * P:(k + 1) * P].tobytes(), crc)
+                digs.append(crc)
+            sess.digests = digs
+        sess.kv_len = kv_len
+        sess.busy = False
+        sess.owner = None
+        sess.last_used = time.perf_counter()
+        self._update_session_gauges_locked()
+
+    def _evict_session_locked(self, sid, donate=True):
+        """Evict one non-busy session.  ``donate=True`` (graceful: TTL,
+        drain) hands the full retained pages to the prefix cache keyed
+        by their token content first, so a turn re-admitted after the
+        eviction replays from the cache instead of a cold re-prefill;
+        ``donate=False`` (admission pressure, leak checks) drops the
+        refs outright — the pool needs the pages NOW."""
+        sess = self._sessions.pop(sid)
+        if sess.pages:
+            if donate and self._prefix is not None:
+                self._prefix.insert(sess.tokens, sess.pages,
+                                    sess.kv_len // self._page_size)
+            self._pool.decref(sess.pages)
+            self._g_pages_used.set(self._pool.allocated)
+            self._g_pages_free.set(self._pool.free)
+        self._c["sessions_evicted"].inc()
+        self._flight.record(
+            "session", phase="evict", engine=self._engine_id,
+            donated=bool(donate and sess.pages is not None))
+        self._update_session_gauges_locked()
+
+    def _evict_lru_session_locked(self):
+        cands = [s for s in self._sessions.values() if not s.busy]
+        if cands:
+            self._evict_session_locked(
+                min(cands, key=lambda s: s.last_used).sid)
+
+    def _evict_sessions_for_locked(self, need, exclude=None):
+        """Evict LRU non-busy sessions (dropping, not donating — this
+        runs under admission pressure and must FREE pages) until
+        ``need`` pages came free or no candidates remain; returns the
+        pages actually freed."""
+        freed = 0
+        while freed < need:
+            cands = [s for s in self._sessions.values()
+                     if not s.busy and s.sid != exclude]
+            if not cands:
+                break
+            victim = min(cands, key=lambda s: s.last_used)
+            before = self._pool.free
+            self._evict_session_locked(victim.sid, donate=False)
+            freed += self._pool.free - before
+        return freed
+
+    def _session_evictable_pages_locked(self):
+        """Pages evicting every non-busy session would free RIGHT NOW:
+        pages whose ONLY reference is a session (a refcount-1 page
+        belongs to exactly one holder, so no dedup) — the session half
+        of the admission headroom ``/load`` publishes next to the
+        prefix cache's ``cached_only``; the two are disjoint (a page
+        referenced by both has refcount >= 2 and counts in neither)."""
+        if not self._paged:
+            return 0
+        return sum(1 for s in self._sessions.values() if not s.busy
+                   for p in s.pages if self._pool.refcount(p) == 1)
+
+    def _sweep_sessions_locked(self):
+        """TTL sweep (every _admit): evict non-busy sessions idle past
+        ``session_ttl_s``.  One dict check when the feature is off."""
+        if self._session_ttl_s is None or not self._sessions:
+            return
+        now = time.perf_counter()
+        for sid in [sid for sid, s in self._sessions.items()
+                    if not s.busy
+                    and now - s.last_used > self._session_ttl_s]:
+            self._evict_session_locked(sid)
+
+    def _update_session_gauges_locked(self):
+        self._g_sessions.set(len(self._sessions))
+        if self._paged:
+            pages = set()
+            for s in self._sessions.values():
+                pages.update(s.pages)
+            self._g_session_pages.set(len(pages))
+
+    def drop_sessions(self) -> int:
+        """Evict every non-busy retained session WITHOUT donating to
+        the prefix cache (HBM reclaim / pool-leak checks — the bench
+        rows call this before asserting ``kv_pages_in_use == 0``);
+        returns how many sessions were dropped."""
+        with self._lock:
+            n = 0
+            for sid in list(self._sessions):
+                if not self._sessions[sid].busy:
+                    self._evict_session_locked(sid, donate=False)
+                    n += 1
+            return n
+
+    # ------------------------------------------------------------------
+    # on-device page defrag / compaction — docs/SERVING.md
+    #
+    # A long-lived pool fragments: sessions and cache nodes free pages
+    # scattered across the address range, so ``allocated`` stays small
+    # while ``highest_allocated`` stays large — the region the tick's
+    # gather actually touches.  Compaction moves every allocated page
+    # into the low end in three phases: PLAN under the lock (pool is
+    # idle-checked, ``_defrag_busy`` set so _admit stays out), device
+    # COPY unlocked (PHT003: never dispatch under the lock), COMMIT
+    # under the lock (``apply_moves`` re-validates per pair, then the
+    # prefix cache, retained sessions and page tables remap).
+    def defrag(self) -> int:
+        """Compact the paged KV pool (no-op in dense mode or when the
+        pool is already dense-packed); returns pages moved.  Runs only
+        at a quiet point — zero active slots, empty queue, no inflight
+        pp waves — and respects the single-driver contract (raises if
+        the auto_run loop is concurrently driving; the loop runs
+        compaction itself on idle ticks, see ``_maybe_defrag``)."""
+        if not self._paged:
+            return 0
+        with self._lock:
+            if self._running and \
+                    threading.current_thread() is not self._loop_thread:
+                err = RuntimeError(
+                    "engine is being driven by its auto_run loop; "
+                    "defrag() from another thread would touch donated "
+                    "caches mid-tick — the loop compacts on idle ticks "
+                    "itself")
+                err._pht_usage_error = True
+                raise err
+        return self._defrag_impl()
+
+    # pht-lint: hot-root (auto-defrag check runs on every idle tick)
+    def _maybe_defrag(self):
+        """Idle-tick auto-compaction (driver thread): trigger only when
+        the touched region is more than twice the live page count —
+        cheap two-int predicate, so probing every idle tick is free."""
+        with self._lock:
+            if (self._pool is None or self._defrag_busy
+                    or self._pool.highest_allocated()
+                    <= 2 * self._pool.allocated):
+                return 0
+        return self._defrag_impl()
+
+    def _defrag_impl(self) -> int:
+        moves = None
+        try:
+            with self._lock:
+                if self._defrag_busy:
+                    return 0
+                # quiet point required: a live slot's page table (or a
+                # pp wave's entry-time snapshot) would go stale under a
+                # move; admission is re-gated below via _defrag_busy
+                if (self._pending
+                        or any(s.req is not None for s in self._slots)
+                        or self._inflight_live()):
+                    return 0
+                moves = self._pool.compaction_plan()
+                if not moves:
+                    return 0
+                self._defrag_busy = True
+            # device copy OUTSIDE the lock (PHT003) — _admit returns []
+            # while _defrag_busy, so no slot can map a moving page
+            self._dispatch_defrag_moves(moves)
+            with self._lock:
+                applied = self._pool.apply_moves(moves)
+                remap = dict(applied)
+                if self._prefix is not None:
+                    self._prefix.remap_pages(remap)
+                for sess in self._sessions.values():
+                    sess.pages = [remap.get(p, p) for p in sess.pages]
+                self._pt_dev = None   # tables restage from the remap
+                self._c["defrag_total"].inc()
+                self._c["defrag_pages_moved"].inc(len(applied))
+                self._g_pages_used.set(self._pool.allocated)
+                self._g_pages_free.set(self._pool.free)
+                self._flight.record(
+                    "defrag", phase="commit", engine=self._engine_id,
+                    moved=len(applied),
+                    high=self._pool.highest_allocated())
+                return len(applied)
+        finally:
+            if moves:
+                with self._lock:
+                    self._defrag_busy = False
+
+    def _build_defrag_fn(self):
+        """CONSTRUCT (not trace) the compaction copy program, called
+        once from ``__init__`` — construction inside the defrag path
+        itself would be a per-pass retrace hazard (PHT002); the actual
+        trace happens on the first executed plan."""
+        import jax
+
+        def move(caches, srcs, dsts):
+            return [(k.at[dsts].set(k[srcs]),
+                     v.at[dsts].set(v[srcs])) for k, v in caches]
+
+        return _obs.instrument_jit(
+            sanitize_donation(jax.jit(move, donate_argnums=(0,)),
+                              donate_argnums=(0,), site="serving.defrag"),
+            site="serving.defrag", engine=self._engine_id)
+
+    def _dispatch_defrag_moves(self, moves):
+        """One jitted gather-scatter per cache layer copies every
+        moving page's K and V rows src→dst in a single dispatch.  The
+        src/dst vectors pad to the next power of two with (0, 0) pairs
+        so plans of different sizes reuse one trace: page 0 is the
+        NULL page — duplicate dst-0 writes all carry page 0's own rows,
+        so the no-op padding is write-write safe."""
+        import jax.numpy as jnp
+        n = 1
+        while n < len(moves):
+            n *= 2
+        srcs = np.zeros(n, np.int32)
+        dsts = np.zeros(n, np.int32)
+        for j, (s, d) in enumerate(moves):
+            srcs[j], dsts[j] = s, d
+        self._caches = self._defrag_fn(
+            self._caches, jnp.asarray(srcs), jnp.asarray(dsts))
 
     def _check_write_windows_locked(self, starts):
         """Tripwire for the paged no-shared-writes invariant: no active
@@ -1820,6 +2297,11 @@ class ServingEngine:
 
     def _finish(self, slot_idx, req):
         req.done = True
+        if req.session is not None:
+            # retain the finished turn's page chain as its session
+            # BEFORE the release below drops the slot's refs — the next
+            # turn resumes decoding from this tail
+            self._session_install_locked(slot_idx, req)
         self._slots[slot_idx].req = None
         self._sampling_cache = None  # membership changed: restage
         self._lengths[slot_idx] = 0
@@ -1942,6 +2424,10 @@ class ServingEngine:
         flushes everything, in order, itself."""
         busy = self._step_inner()
         self._flush_streams()
+        if not busy and self._paged:
+            # idle tick on the driver: cheap two-int fragmentation
+            # check, compaction only when the pool is badly scattered
+            self._maybe_defrag()
         return busy
 
     def _flush_streams(self):
@@ -2326,6 +2812,14 @@ class ServingEngine:
                             if req is not None and not req._event.is_set():
                                 _fail(req, "inflight")
                     self._inflight.clear()
+                    # retained sessions die with the engine (their pages
+                    # live in the donated caches that may be gone); busy
+                    # sessions hold no refs — their pages were on slots
+                    for sess in list(self._sessions.values()):
+                        if self._paged and sess.pages:
+                            self._pool.decref(sess.pages)
+                    self._sessions.clear()
+                    self._update_session_gauges_locked()
                     self._running = False
                     self._crashed = e
                 # deliver the failed requests' stream terminals (and any
@@ -2387,6 +2881,7 @@ class ServingEngine:
                    # requests died (where="deadline" for budget aborts,
                    # pending/slot/inflight for a loop failure)
                    "recent_aborts": list(self._recent_aborts)}
+            out["sessions"] = len(self._sessions)
             if self._paged:
                 out["kv_pages_in_use"] = self._pool.allocated
                 out["kv_pages_free"] = self._pool.free
@@ -2468,18 +2963,31 @@ class ServingEngine:
                               "max_position_embeddings", None)
             if max_pos is not None:
                 slot_cap = min(slot_cap, int(max_pos))
+            sess_pages = set()
+            for s in self._sessions.values():
+                sess_pages.update(s.pages)
+            sess_evictable = self._session_evictable_pages_locked()
+            # sessions block (added within version 1): how much of the
+            # pool conversation retention is pinning, and how much of
+            # that admission pressure could take back RIGHT NOW
+            report["sessions"] = {
+                "count": len(self._sessions),
+                "retained_pages": len(sess_pages),
+                "evictable_pages": sess_evictable}
             if self._paged:
                 from .paged import tokens_admittable
-                # admission evicts cache-only prefix pages to cover a
-                # shortfall (_paged_admit_locked), so the free list
-                # alone UNDERSTATES what would actually admit — the
-                # router contract is "would this request fit RIGHT
-                # NOW", eviction included
+                # admission evicts cache-only prefix pages AND LRU
+                # sessions' exclusively-held pages to cover a shortfall
+                # (_paged_admit_locked), so the free list alone
+                # UNDERSTATES what would actually admit — the router
+                # contract is "would this request fit RIGHT NOW",
+                # eviction included (sessions never starve admission)
                 evictable = (self._prefix.cached_only()
                              if self._prefix is not None else 0)
                 headroom = min(
-                    tokens_admittable(self._pool.free + evictable,
-                                      reserve, self._page_size),
+                    tokens_admittable(
+                        self._pool.free + evictable + sess_evictable,
+                        reserve, self._page_size),
                     slot_cap)
                 admission.update(
                     kv_pages_free=self._pool.free,
@@ -2501,19 +3009,34 @@ class ServingEngine:
                 # to find the replica already holding those KV pages.
                 # Bounded (most-recent first) so a warm cache never
                 # bloats the poll document.
+                # retained sessions' chain digests lead: a returning
+                # turn's page_digests match them deepest here, which is
+                # exactly the fleet-tier session stickiness signal —
+                # then the cache's recency-ordered digests fill the cap
+                digs = []
+                seen = set()
+                for s in self._sessions.values():
+                    for d in s.digests:
+                        if d not in seen:
+                            seen.add(d)
+                            digs.append(d)
+                for d in self._prefix.digests(self.PREFIX_DIGEST_LIMIT):
+                    if d not in seen:
+                        seen.add(d)
+                        digs.append(d)
                 report["prefix_digest"] = {
                     "algo": "crc32-pages",
                     "page_size": self._page_size,
-                    "digests": self._prefix.digests(
-                        self.PREFIX_DIGEST_LIMIT)}
+                    "digests": digs[:self.PREFIX_DIGEST_LIMIT]}
             return report
 
     @property
     def kv_pages_in_use(self) -> int:
         """Allocated pool pages (0 in dense mode) — includes pages held
-        only by the prefix cache; :meth:`drop_prefix_cache` reclaims
-        those, after which a drained engine must read 0 (the pool-leak
-        assert tools/perf_gate.py gates via the bench row)."""
+        only by the prefix cache or by retained sessions;
+        :meth:`drop_prefix_cache` + :meth:`drop_sessions` reclaim those,
+        after which a drained engine must read 0 (the pool-leak assert
+        tools/perf_gate.py gates via the bench row)."""
         return self._pool.allocated if self._paged else 0
 
     @property
@@ -2584,6 +3107,15 @@ class ServingEngine:
                     f"requests were FAILED, not completed — this is not "
                     f"a clean removal") from crashed
             if idle and not running:
+                with self._lock:
+                    # graceful session eviction: a draining replica
+                    # DONATES every retained chain to the prefix cache,
+                    # so a conversation re-admitted elsewhere-then-back
+                    # (or replayed by the router on a survivor) replays
+                    # from cached pages instead of dying mid-dialogue
+                    for sid in list(self._sessions):
+                        if not self._sessions[sid].busy:
+                            self._evict_session_locked(sid, donate=True)
                 # same clean-drain contract as the loop's idle exit: a
                 # DRAINED engine must not 503 /healthz?max_age forever
                 _tr.remove_beacon(f"serving.{self._engine_id}")

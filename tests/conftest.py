@@ -92,6 +92,10 @@ _FILE_COST = {
     "test_sanitizers.py": 5,  # lock/guard/race units + one thread-only
                               # dataloader epoch; engine runs slow-marked
     "test_paged.py": 16,    # allocator units + 2 tiny-GPT engine runs
+    "test_serving_sessions.py": 12,  # allocator/router units + 2 engine
+                                     # CONSTRUCTIONS (no tick compiles);
+                                     # session/defrag/drain drills are
+                                     # slow-marked
     "test_quant_serving.py": 12,  # kernel/quantizer units + 2 tiny fwd
                                   # compiles; engine runs are slow-marked
     "test_moe.py": 30,      # gate/dispatch units, eager-only (no engine)

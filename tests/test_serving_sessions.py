@@ -1,0 +1,371 @@
+"""Multi-turn KV sessions (PR 16): suffix-cache resume, TTL/LRU
+eviction under admission pressure, page defrag, fleet stickiness.
+
+Acceptance hinges on token-exactness: a turn resumed from a retained
+session chain must produce EXACTLY the tokens a one-shot full-history
+resubmission produces (dense + paged + spec modes), sessions must never
+leak pool pages, and defrag must preserve both refcounts and output.
+Host-only allocator/router units run in tier-1; everything that
+compiles an engine tick is slow-marked (tests/conftest.py budget).
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_hackathon_tpu as paddle
+from paddle_hackathon_tpu.inference.paged import PagePool, PrefixCache
+from paddle_hackathon_tpu.inference.serving import ServingEngine
+from paddle_hackathon_tpu.models.gpt import GPTConfig, GPTForCausalLM
+
+
+# ------------------------------------------------------------ allocator
+def test_compaction_plan_packs_low_and_is_disjoint():
+    pool = PagePool(num_pages=17, page_size=8)
+    pages = pool.alloc(10)
+    # free a scattered subset so the allocated set has holes
+    pool.decref([pages[0], pages[2], pages[3], pages[7]])
+    n = pool.allocated
+    moves = pool.compaction_plan()
+    srcs = {s for s, _ in moves}
+    dsts = {d for _, d in moves}
+    assert not (srcs & dsts)                  # disjoint by construction
+    assert all(s > n for s in srcs)           # only high pages move
+    assert all(1 <= d <= n for d in dsts)     # into the low holes
+    applied = pool.apply_moves(moves)
+    assert applied == moves
+    assert pool.allocated == n                # refcounts conserved
+    assert pool.highest_allocated() == n      # densely packed now
+    # freed sources are allocatable again
+    assert pool.alloc(pool.free) is not None
+
+
+def test_apply_moves_revalidates_stale_pairs():
+    pool = PagePool(num_pages=9, page_size=8)
+    pages = pool.alloc(5)
+    pool.decref(pages[:2])
+    moves = pool.compaction_plan()
+    assert moves
+    # a page freed between plan and commit (concurrent drop) must be
+    # skipped, not corrupt the pool
+    stale_src = moves[0][0]
+    pool.decref([stale_src])
+    applied = pool.apply_moves(moves)
+    assert (stale_src, moves[0][1]) not in applied
+    assert all(pool.refcount(d) > 0 for _, d in applied)
+    assert pool.refcount(moves[0][1]) == 0    # dst of the skipped pair
+
+
+def test_prefix_remap_pages_rewrites_nodes():
+    pool = PagePool(num_pages=33, page_size=4)
+    cache = PrefixCache(pool)
+    prompt = np.arange(12, dtype=np.int32)
+    pages = pool.alloc(3)
+    cache.insert(prompt, pages, 3)
+    remap = {pages[1]: 30}
+    assert cache.remap_pages(remap) == 1
+    # the cache now hands out the remapped id on a hit
+    pool._ref[30] = pool._ref[pages[1]]       # simulate the pool commit
+    pool._ref[pages[1]] = 0
+    hit = cache.match(np.concatenate([prompt, [99]]).astype(np.int32))
+    assert 30 in hit and pages[1] not in hit
+    pool.decref(hit)
+
+
+# ------------------------------------------------------------- fleet
+class _Req:
+    _ids = iter(range(10**6))
+
+    def __init__(self, prompt, n):
+        self.rid = next(self._ids)
+        self.prompt = np.asarray(prompt, np.int32)
+        self.tokens = list(range(n))
+        self.done = True
+        self.error = None
+        self.lifecycle = {}
+        self._event = threading.Event()
+        self._event.set()
+
+    def result(self):
+        return np.concatenate([self.prompt,
+                               np.asarray(self.tokens, np.int32)])
+
+
+class _Stub:
+    """Host-only replica speaking the engine surface (the precommit
+    fault-drill stub, plus session bookkeeping)."""
+
+    def __init__(self, name, headroom):
+        self.engine_id = name
+        self.headroom = headroom
+        self.sessions_seen = []
+
+    def load_report(self):
+        return {"version": 1, "engine": self.engine_id, "draining": False,
+                "slots": {"max": 8, "active": 0, "free": 8},
+                "queue": {"depth": 0, "oldest_wait_s": 0.0},
+                "admission": {"headroom_tokens": self.headroom}}
+
+    def submit(self, prompt, max_new_tokens, deadline_s=None,
+               on_token=None, session=None, **kw):
+        self.sessions_seen.append(session)
+        return _Req(prompt, max_new_tokens)
+
+    def drain(self, timeout=None):
+        pass
+
+    def shutdown(self, timeout=None):
+        pass
+
+
+def test_fleet_session_pin_sticks_and_migrates_on_drain():
+    from paddle_hackathon_tpu.inference.fleet import FleetRouter
+    small = _Stub("rep-a", 100)
+    big = _Stub("rep-b", 9000)
+    router = FleetRouter([small, big])
+    try:
+        # first turn lands by headroom; the session pins there
+        fr = router.submit([1, 2, 3], 4, session="conv")
+        assert fr.replica == "rep-b"
+        assert router.introspect_requests()["session_pins"] == 1
+        # flip the headroom order: an unpinned request would now pick
+        # rep-a, but the pinned session must stick to rep-b
+        small.headroom, big.headroom = 9000, 100
+        fr2 = router.submit([1, 2, 3, 4], 4, session="conv")
+        assert fr2.replica == "rep-b"
+        assert big.sessions_seen == ["conv", "conv"]
+        # sessionless traffic is unaffected by pins
+        fr3 = router.submit([9], 4)
+        assert fr3.replica == "rep-a"
+        # drain the pinned replica: the pin clears immediately and the
+        # next turn migrates to the survivor (and re-pins there)
+        router.drain("rep-b")
+        fr4 = router.submit([1, 2, 3, 4, 5], 4, session="conv")
+        assert fr4.replica == "rep-a"
+        assert small.sessions_seen[-1] == "conv"
+        assert router.introspect_requests()["session_pins"] == 1
+    finally:
+        router.shutdown()
+
+
+def test_fleet_session_pin_map_is_bounded():
+    from paddle_hackathon_tpu import inference
+    from paddle_hackathon_tpu.inference import fleet as fleet_mod
+    router = fleet_mod.FleetRouter([_Stub("rep-a", 9000)])
+    old = fleet_mod.MAX_SESSION_PINS
+    fleet_mod.MAX_SESSION_PINS = 4
+    try:
+        for i in range(8):
+            router.submit([1], 2, session=f"s{i}")
+        assert router.introspect_requests()["session_pins"] == 4
+        # oldest evicted, newest kept
+        assert "s7" in router._session_pins
+        assert "s0" not in router._session_pins
+    finally:
+        fleet_mod.MAX_SESSION_PINS = old
+        router.shutdown()
+    assert inference  # silence unused-import pedantry
+
+
+# ------------------------------------------------------------- engines
+def _model(num_layers=2):
+    paddle.seed(3)
+    cfg = GPTConfig(vocab_size=128, hidden_size=64, num_layers=num_layers,
+                    num_heads=4, max_position_embeddings=128,
+                    hidden_dropout_prob=0.0, attention_dropout_prob=0.0,
+                    use_flash_attention=False)
+    m = GPTForCausalLM(cfg)
+    m.eval()
+    return m
+
+
+def test_load_report_sessions_block_dense_no_engine_run():
+    # engine CONSTRUCTION compiles nothing: the sessions block must be
+    # present (zeros) on a dense replica so /load consumers see one
+    # schema fleet-wide
+    eng = ServingEngine(_model(), max_slots=2, max_len=64, chunk=4,
+                        auto_run=False)
+    rep = eng.load_report()
+    assert rep["sessions"] == {"count": 0, "retained_pages": 0,
+                               "evictable_pages": 0}
+    assert eng.introspect_requests()["sessions"] == 0
+    assert eng.drop_sessions() == 0
+
+
+@pytest.mark.slow
+def test_session_resume_token_exact_dense_paged_spec():
+    """THE acceptance test: a 3-turn conversation through
+    ``submit(session=)`` produces exactly what one-shot full-history
+    resubmissions produce — dense, paged, and speculative engines."""
+    m = _model()
+    rs = np.random.RandomState(11)
+    t1 = rs.randint(0, 128, (13,)).astype(np.int32)
+    follows = [rs.randint(0, 128, (5,)).astype(np.int32) for _ in range(2)]
+
+    # reference: fresh full-history submissions on a dense engine
+    ref_eng = ServingEngine(m, max_slots=2, max_len=128, chunk=4,
+                            auto_run=False)
+    refs, hist = [], t1
+    for fu in [None] + follows:
+        if fu is not None:
+            hist = np.concatenate([refs[-1], fu])
+        r = ref_eng.submit(hist, 6)
+        ref_eng.run_until_idle()
+        refs.append(r.result())
+
+    for mode_kw in (dict(),
+                    dict(cache_mode="paged", page_size=8),
+                    dict(cache_mode="paged", page_size=8, spec_k=4)):
+        eng = ServingEngine(m, max_slots=2, max_len=128, chunk=4,
+                            auto_run=False, **mode_kw)
+        hist = t1
+        for turn, fu in enumerate([None] + follows):
+            if fu is not None:
+                hist = np.concatenate([hist, fu])
+            r = eng.submit(hist, 6, session="conv")
+            eng.run_until_idle()
+            np.testing.assert_array_equal(r.result(), refs[turn])
+            hist = r.result()
+        if mode_kw.get("cache_mode") == "paged":
+            # returning turns resumed (not re-prefilled): both resumes
+            # hit, and the retained chain is alive between turns
+            assert eng.stats["session_resumes"] == 2
+            assert eng.stats["session_hit_tokens"] > 0
+            assert len(eng._sessions["conv"].pages) > 0
+            # zero-leak: sessions + cache dropped -> empty pool
+            assert eng.drop_sessions() == 1
+            eng.drop_prefix_cache()
+            assert eng.kv_pages_in_use == 0
+
+
+@pytest.mark.slow
+def test_session_ttl_and_lru_eviction_under_pressure():
+    m = _model()
+    rs = np.random.RandomState(12)
+    # pool sized so two retained sessions + a big admission cannot
+    # coexist: the LRU session must be evicted to admit.  Keep the pool
+    # SMALL — the big request must outgrow the free list while staying
+    # under the per-request capacity max_len - chunk = 92 rows.
+    eng = ServingEngine(m, max_slots=2, max_len=96, chunk=4,
+                        auto_run=False, cache_mode="paged", page_size=8,
+                        num_pages=15)
+    pa = rs.randint(0, 128, (17,)).astype(np.int32)
+    pb = rs.randint(0, 128, (18,)).astype(np.int32)
+    ra = eng.submit(pa, 4, session="a")
+    eng.run_until_idle()
+    rb = eng.submit(pb, 4, session="b")
+    eng.run_until_idle()
+    assert len(eng._sessions) == 2
+    eng.drop_prefix_cache()
+    free0 = eng.kv_pages_free
+    # a request needing more than the free pages forces session
+    # eviction (LRU first: session "a"); admission must NOT starve
+    big = eng.submit(rs.randint(0, 128, (40,)).astype(np.int32),
+                     8 * (free0 // 2) + 8)
+    eng.run_until_idle()
+    assert big.done and big.error is None
+    assert "a" not in eng._sessions          # LRU victim
+    assert int(eng._c["sessions_evicted"].value) >= 1
+
+    # TTL sweep: an idle session past its ttl is donated to the prefix
+    # cache, so a returning turn replays from cached pages
+    eng2 = ServingEngine(m, max_slots=2, max_len=96, chunk=4,
+                         auto_run=False, cache_mode="paged", page_size=8,
+                         session_ttl_s=0.01)
+    r1 = eng2.submit(pa, 4, session="ttl")
+    eng2.run_until_idle()
+    assert "ttl" in eng2._sessions
+    time.sleep(0.05)
+    r2 = eng2.submit(pb, 4)                   # any submit runs the sweep
+    eng2.run_until_idle()
+    assert "ttl" not in eng2._sessions
+    # the donated chain is in the cache: resubmitting the conversation
+    # prefix-hits instead of cold-prefilling
+    hits0 = eng2.stats["prefix_hit_tokens"]
+    r3 = eng2.submit(np.concatenate([r1.result(), [5]]).astype(np.int32),
+                     4, session="ttl")
+    eng2.run_until_idle()
+    assert eng2.stats["prefix_hit_tokens"] > hits0
+    assert r3.done
+    # zero-leak across all of it
+    eng2.drop_sessions()
+    eng2.drop_prefix_cache()
+    assert eng2.kv_pages_in_use == 0
+
+
+@pytest.mark.slow
+def test_defrag_preserves_refcounts_and_token_exactness():
+    m = _model()
+    rs = np.random.RandomState(13)
+    p1 = rs.randint(0, 128, (17,)).astype(np.int32)
+    p2 = rs.randint(0, 128, (22,)).astype(np.int32)
+    eng = ServingEngine(m, max_slots=2, max_len=96, chunk=4,
+                        auto_run=False, cache_mode="paged", page_size=8,
+                        num_pages=49)
+    r1 = eng.submit(p1, 4, session="a")
+    eng.run_until_idle()
+    r2 = eng.submit(p2, 4, session="b")
+    eng.run_until_idle()
+    # fragment: drop the cache and the first session so low page ids
+    # free up while "b"'s chain sits high
+    eng.drop_prefix_cache()
+    with eng._lock:
+        eng._evict_session_locked("a", donate=False)
+    pool = eng._pool
+    before = sorted(int(pool._ref[p]) for p in pool.allocated_ids())
+    assert pool.highest_allocated() > pool.allocated  # fragmented
+    moved = eng.defrag()
+    assert moved > 0
+    assert pool.highest_allocated() == pool.allocated  # packed
+    after = sorted(int(pool._ref[p]) for p in pool.allocated_ids())
+    assert after == before                    # refcounts preserved
+    assert int(eng._c["defrag_pages_moved"].value) == moved
+    # the remapped session still resumes token-exactly
+    hist = eng._sessions["b"].tokens.copy()
+    fu = rs.randint(0, 128, (4,)).astype(np.int32)
+    ref_eng = ServingEngine(m, max_slots=2, max_len=96, chunk=4,
+                            auto_run=False)
+    ref = ref_eng.submit(np.concatenate([hist, fu]), 4)
+    ref_eng.run_until_idle()
+    rb = eng.submit(np.concatenate([hist, fu]), 4, session="b")
+    eng.run_until_idle()
+    np.testing.assert_array_equal(rb.result(), ref.result())
+    assert eng.stats["session_resumes"] == 1
+    eng.drop_sessions()
+    eng.drop_prefix_cache()
+    assert eng.kv_pages_in_use == 0
+
+
+@pytest.mark.slow
+def test_fleet_drain_migrates_session_token_exact():
+    """Drain drill on REAL engines: turn 1 pins to replica A; draining
+    A donates the session to its prefix cache and clears the pin; turn
+    2 migrates to B and stays token-exact (cold re-prefill there)."""
+    from paddle_hackathon_tpu.inference.fleet import FleetRouter
+    m = _model()
+    rs = np.random.RandomState(14)
+    prompt = rs.randint(0, 128, (13,)).astype(np.int32)
+    ea = ServingEngine(m, max_slots=2, max_len=96, chunk=4,
+                       cache_mode="paged", page_size=8)
+    eb = ServingEngine(m, max_slots=2, max_len=96, chunk=4,
+                       cache_mode="paged", page_size=8)
+    ref_eng = ServingEngine(m, max_slots=2, max_len=96, chunk=4,
+                            auto_run=False)
+    router = FleetRouter([ea, eb])
+    try:
+        fr1 = router.submit(prompt, 4, session="conv")
+        assert fr1.wait(60) and fr1.error is None
+        first = fr1.replica
+        hist = fr1.result()
+        router.drain(first)
+        fu = rs.randint(0, 128, (4,)).astype(np.int32)
+        fr2 = router.submit(np.concatenate([hist, fu]), 4, session="conv")
+        assert fr2.wait(60) and fr2.error is None
+        assert fr2.replica != first            # migrated off the drain
+        ref = ref_eng.submit(np.concatenate([hist, fu]), 4)
+        ref_eng.run_until_idle()
+        np.testing.assert_array_equal(fr2.result(), ref.result())
+    finally:
+        router.shutdown()

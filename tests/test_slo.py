@@ -321,7 +321,8 @@ def test_mfu_and_phase_gauges_from_compiled_fit(monkeypatch):
 # "draining" joined in the fleet PR (router contract bump within
 # version 1); paged engines additionally carry a "prefix_digest" block
 _LOAD_KEYS = {"version", "engine", "ts", "running", "draining", "tickno",
-              "slots", "queue", "modes", "slo", "goodput", "admission"}
+              "slots", "queue", "modes", "slo", "goodput", "admission",
+              "sessions"}
 _SLO_SERIES = {"ttft", "tpot", "e2e", "queue_wait"}
 
 

@@ -214,6 +214,14 @@ RATIO_GATES = [
     # host syncs), not parity with dense
     ("gpt2_moe_serving_8stream_device_tokens_per_sec_per_chip",
      "gpt2_serving_8stream_device_tokens_per_sec_per_chip", 0.25),
+    # multi-turn conversational serving: session suffix-caching removes
+    # the per-turn history re-prefill, which must pay for the paged
+    # indirection — aggregate tokens/s holds >= 1.0x the same-run dense
+    # serving row (the turn-N TTFT improvement itself is gated by
+    # compare_chat_ttft below, which works on host-timed runs too: both
+    # TTFTs come from the same clock in the same process)
+    ("gpt2_serving_chat_8conv_device_tokens_per_sec_per_chip",
+     "gpt2_serving_8stream_device_tokens_per_sec_per_chip", 1.00),
 ]
 
 
@@ -282,6 +290,34 @@ def compare_timing_fallbacks(rows):
             if r.get("timing") == "host" and "device" in r.get("metric", "")]
 
 
+# A returning turn that resumes its retained session skips the whole
+# conversation-history prefill, so its TTFT must sit well below turn
+# 1's full-prefill TTFT.  The floor is deliberately loose (turn-1
+# prefills ~4x the suffix a resumed turn does, so a healthy run lands
+# far under it) — it catches the resume path silently degrading to
+# re-prefill, not timing noise.
+CHAT_TTFT_RATIO_CEILING = 0.80
+
+
+def compare_chat_ttft(rows):
+    """[(metric, turn1_ms, turnN_ms)] for conversational serving rows
+    whose returning-turn TTFT is NOT measurably below the turn-1 TTFT
+    (``metrics.ttft_turnN_ms`` must be <= CHAT_TTFT_RATIO_CEILING x
+    ``metrics.ttft_turn1_ms``): session resume fell back to
+    re-prefilling the conversation.  Both stamps come from the same
+    process and clock, so this gate holds on host-timed (CPU) runs
+    too; rows without the keys are skipped."""
+    bad = []
+    for r in rows:
+        m = r.get("metrics") or {}
+        t1, tn = m.get("ttft_turn1_ms"), m.get("ttft_turnN_ms")
+        if t1 is None or tn is None:
+            continue
+        if float(tn) > float(t1) * CHAT_TTFT_RATIO_CEILING:
+            bad.append((r["metric"], float(t1), float(tn)))
+    return bad
+
+
 def compare_pool_leaks(rows):
     """[(metric, leaked)] for paged serving rows whose KV page pool did
     not return to 0 allocated after the drain + prefix-cache drop
@@ -323,8 +359,9 @@ def suite_gate(tolerance, rows=None):
     bad_errors = compare_error_rows(rows)
     bad_moe = compare_moe_active_ratio(rows)
     bad_zero = compare_zero_sharding(rows)
+    bad_chat = compare_chat_ttft(rows)
     if (bad or bad_ratio or bad_metrics or bad_leaks or bad_timing
-            or bad_errors or bad_moe or bad_zero):
+            or bad_errors or bad_moe or bad_zero or bad_chat):
         if bad:
             print(f"perf_gate[suite] FAIL: {len(bad)} configs regressed "
                   f">{tolerance:.0%}:")
@@ -348,6 +385,12 @@ def suite_gate(tolerance, rows=None):
         for metric, reason in bad_zero:
             print(f"perf_gate[suite] FAIL: {metric} ZeRO evidence is "
                   f"vacuous ({reason})")
+        for metric, t1, tn in bad_chat:
+            print(f"perf_gate[suite] FAIL: {metric} turn-N TTFT "
+                  f"{tn:.1f}ms is not measurably below turn-1 "
+                  f"{t1:.1f}ms (ceiling "
+                  f"{CHAT_TTFT_RATIO_CEILING:.2f}x) — session resume "
+                  f"degraded to re-prefilling the conversation")
         for metric, leaked in bad_leaks:
             print(f"perf_gate[suite] FAIL: {metric} leaked {leaked} KV "
                   f"pool pages (pages_in_use != 0 after drain + "

@@ -108,8 +108,106 @@ assert fr2.wait(10) and fr2.replica == 'drill-d'
 print('fleet drill: dispatch-fault retry + failover OK')
 """
 
+# Session eviction under drain, both layers.  Engine side: a draining
+# replica must DONATE every retained session chain to its prefix cache
+# (returning conversations replay from cached pages, and nothing leaks
+# — construction-only, no tick compiles: the session record is
+# fabricated white-box and drain() on an idle sync engine is pure
+# host work).  Fleet side: the armed fleet.dispatch fault kills the
+# session turn's first placement; the retry must still land AND pin,
+# the pin must stick, and draining the pinned replica must clear it so
+# the next turn migrates to the survivor carrying the session kwarg.
+_SESSION_DRILL = """
+import numpy as np, threading, itertools
+from paddle_hackathon_tpu.models.gpt import GPTConfig, GPTForCausalLM
+from paddle_hackathon_tpu.inference.serving import ServingEngine, _Session
+
+cfg = GPTConfig(vocab_size=128, hidden_size=64, num_layers=2,
+                num_heads=4, max_position_embeddings=128,
+                hidden_dropout_prob=0.0, attention_dropout_prob=0.0,
+                use_flash_attention=False)
+m = GPTForCausalLM(cfg); m.eval()
+eng = ServingEngine(m, max_slots=2, max_len=64, chunk=4, auto_run=False,
+                    cache_mode="paged", page_size=8, num_pages=12)
+# fabricate a retained 20-token session (3 pages, 2 of them full)
+pages = eng._pool.alloc(3)
+sess = _Session("drill")
+sess.tokens = np.arange(20, dtype=np.int32)
+sess.kv_len = 20
+sess.pages = list(pages)
+eng._sessions["drill"] = sess
+assert eng.kv_pages_in_use == 3
+eng.drain(timeout=10)
+# drain donated the chain: session record gone, the 2 FULL pages now
+# live in the prefix cache, the partial tail page was freed
+assert not eng._sessions
+assert int(eng._c["sessions_evicted"].value) == 1
+assert eng.kv_pages_in_use == 2
+eng.drop_prefix_cache()
+assert eng.kv_pages_in_use == 0     # zero leak
+eng.shutdown(timeout=5)
+
+from paddle_hackathon_tpu.inference.fleet import FleetRouter
+_ids = itertools.count()
+class Req:
+    def __init__(self, prompt, n, on_token=None):
+        self.rid = next(_ids); self.prompt = np.asarray(prompt, np.int32)
+        self.tokens = []; self.done = False; self.error = None
+        self._event = threading.Event(); self.on_token = on_token; self.n = n
+    def finish(self):
+        self.tokens = list(range(self.n)); self.done = True
+        self._event.set()
+    def result(self):
+        return np.concatenate([self.prompt, np.asarray(self.tokens,
+                                                       np.int32)])
+
+class Stub:
+    def __init__(self, name, headroom):
+        self.engine_id = name; self.headroom = headroom
+        self.sessions_seen = []
+    def load_report(self):
+        return {'version': 1, 'engine': self.engine_id, 'draining': False,
+                'slots': {'max': 8, 'active': 0, 'free': 8},
+                'queue': {'depth': 0, 'oldest_wait_s': 0.0},
+                'admission': {'headroom_tokens': self.headroom}}
+    def submit(self, prompt, max_new_tokens, deadline_s=None,
+               on_token=None, **kw):
+        self.sessions_seen.append(kw.get('session'))
+        r = Req(prompt, max_new_tokens, on_token)
+        r.finish(); return r
+    def drain(self, timeout=None): pass
+    def shutdown(self, timeout=None): pass
+
+a, b = Stub('sess-a', 9000), Stub('sess-b', 100)
+router = FleetRouter([a, b], backoff_s=0.001, breaker_failures=3)
+# the armed fleet.dispatch=fail@1 kills THIS turn's first placement:
+# the retry must land it anyway and still record the pin
+fr = router.submit([1, 2, 3], 4, session='chat')
+assert fr.wait(10) and fr.error is None, fr.error
+pinned = router._session_pins.get('chat')
+assert pinned == fr.replica and pinned in ('sess-a', 'sess-b')
+assert router.introspect_requests()['session_pins'] == 1
+# second turn sticks to the pin regardless of headroom
+fr2 = router.submit([1, 2, 3, 9], 4, session='chat')
+assert fr2.wait(10) and fr2.replica == pinned
+# draining the pinned replica clears the pin; the next turn migrates
+# to the survivor and re-pins there, session kwarg intact
+router.drain(pinned)
+assert 'chat' not in router._session_pins
+other = 'sess-b' if pinned == 'sess-a' else 'sess-a'
+fr3 = router.submit([1, 2, 3, 9, 9], 4, session='chat')
+assert fr3.wait(10) and fr3.replica == other
+assert router._session_pins.get('chat') == other
+survivor = a if other == 'sess-a' else b
+assert survivor.sessions_seen[-1] == 'chat'
+router.shutdown()
+print('session drill: drain donation + pin migration under '
+      'dispatch fault OK')
+"""
+
 _DRILLS = [
     ("fleet-drill", "fleet.dispatch=fail@1", _FLEET_DRILL),
+    ("session-drill", "fleet.dispatch=fail@1", _SESSION_DRILL),
 ]
 
 
