@@ -27,15 +27,26 @@ def launch(argv: Optional[List[str]] = None) -> int:
         c.stop()
         sys.exit(128 + signum)
 
+    prev = {}
     try:
-        signal.signal(signal.SIGTERM, _sig)
-        signal.signal(signal.SIGINT, _sig)
+        for s in (signal.SIGTERM, signal.SIGINT):
+            prev[s] = signal.signal(s, _sig)
     except ValueError:
         pass  # not main thread (tests)
     try:
         return c.run()
     finally:
         c.stop()
+        # restore the caller's handlers: leaving _sig installed after
+        # this controller is stopped turns any later SIGTERM into a
+        # SystemExit inside unrelated code (a programmatic launch()
+        # caller — or the timed test suite, where the budget kill was
+        # recorded as a failure of whatever test it interrupted)
+        for s, h in prev.items():
+            try:
+                signal.signal(s, h)
+            except ValueError:
+                pass
 
 
 def main() -> None:

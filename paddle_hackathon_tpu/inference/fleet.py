@@ -88,7 +88,14 @@ from ..observability import metrics as _obs
 from ..observability import tracing as _tr
 from ..observability.sanitizers import make_lock, make_rlock, share_object
 from .paged import page_digests
-from .serving import DeadlineExceededError, EngineDraining
+from .serving import (PRIORITY_RANK, DeadlineExceededError,
+                      EngineDraining)
+
+# placement-retry pacing per class: an interactive request backs off
+# half as long between attempts (its SLO is the tightest), a batch
+# request twice as long (it can wait; its retries must not crowd the
+# dispatch path while the fleet is degraded)
+_BACKOFF_FACTOR = {"interactive": 0.5, "default": 1.0, "batch": 2.0}
 
 __all__ = ["FleetRouter", "FleetRequest", "CircuitBreaker",
            "NoReplicaAvailableError", "StreamInterruptedError",
@@ -207,9 +214,25 @@ def affinity_depth(report: dict, digests: List[int]) -> int:
     return depth
 
 
+def _queue_depth_for(report: dict, priority=None) -> int:
+    """Queue depth AS SEEN BY a request of ``priority``: only classes
+    scheduled at or before its own (the engine admits best effective
+    class first), read from the ``queue.classes`` block.  Falls back to
+    the total depth when no priority is given or the replica predates
+    the block — an interactive queue starving behind a deep batch
+    queue stops being invisible to least-loaded scoring."""
+    q = report.get("queue") or {}
+    classes = q.get("classes")
+    if priority is None or not isinstance(classes, dict):
+        return int(q.get("depth") or 0)
+    r = PRIORITY_RANK.get(priority, 1)
+    return sum(int(((classes.get(c) or {}).get("depth")) or 0)
+               for c, rank in PRIORITY_RANK.items() if rank <= r)
+
+
 def pick_replica(reports: Dict[str, dict], need: int,
                  digests: Optional[List[int]] = None,
-                 exclude=()) -> Optional[str]:
+                 exclude=(), priority=None) -> Optional[str]:
     """Pure dispatch scoring over ``/load`` reports (the router
     contract, docs/OBSERVABILITY.md "SLO telemetry and the /load
     report"); returns the chosen replica name, or None when no report
@@ -223,7 +246,9 @@ def pick_replica(reports: Dict[str, dict], need: int,
     replica already holding their pages), then most headroom, then
     shortest queue, then fewest active slots; when NOBODY has headroom
     the request queues on the least-loaded replica (shortest queue
-    first — engines admit FIFO, so queue depth bounds the wait).  Name
+    first — engines admit best-class-first, so the depth a request
+    compares is only the classes scheduled at or before its own, via
+    ``queue.classes`` when the replica publishes it).  Name
     order breaks remaining ties, so equal fleets dispatch
     deterministically."""
     cands = []
@@ -235,7 +260,7 @@ def pick_replica(reports: Dict[str, dict], need: int,
             continue
         adm = rep.get("admission") or {}
         head = int(adm.get("headroom_tokens") or 0)
-        depth = int((rep.get("queue") or {}).get("depth") or 0)
+        depth = _queue_depth_for(rep, priority)
         active = int((rep.get("slots") or {}).get("active") or 0)
         aff = affinity_depth(rep, digests) if digests else 0
         cands.append((name, head, depth, active, aff))
@@ -292,12 +317,13 @@ class FleetRequest:
     layout.)"""
 
     def __init__(self, router, prompt, max_new_tokens, kw, deadline_s,
-                 stream, session=None):
+                 stream, session=None, priority=None):
         self._router = router
         self.prompt = np.asarray(prompt, np.int32).reshape(-1)
         self.max_new_tokens = int(max_new_tokens)
         self._kw = kw                      # sampling overrides
         self.session = session             # multi-turn KV session key
+        self.priority = "default" if priority is None else priority
         self.deadline_s = deadline_s
         self._t_submit = time.perf_counter()
         # RLock: _recover holds it across _place, which re-acquires it
@@ -734,7 +760,7 @@ class FleetRouter:
                 digests = page_digests(freq.prompt, sizes.pop())
             name = pick_replica(
                 {n: rep for n, (_, rep) in by_name.items()}, need,
-                digests=digests)
+                digests=digests, priority=freq.priority)
             if name is None:
                 return False
         rep, _report = by_name[name]
@@ -822,7 +848,7 @@ class FleetRouter:
         caller's budget died first."""
         exclude = set(exclude)
         last_err = None
-        delay = self.backoff_s
+        delay = self.backoff_s * _BACKOFF_FACTOR.get(freq.priority, 1.0)
         for attempt in range(self.max_retries + 1):
             if attempt or is_retry:
                 self._c_retries.inc()
@@ -849,7 +875,7 @@ class FleetRouter:
     def submit(self, prompt, max_new_tokens: int = 32, *,
                temperature=None, top_k=None, top_p=None,
                deadline_s=None, stream: bool = False,
-               session=None) -> FleetRequest:
+               session=None, priority=None) -> FleetRequest:
         """Dispatch a request to the best replica (module docstring has
         the scoring); returns a :class:`FleetRequest`.  Raises
         :class:`NoReplicaAvailableError` when no replica accepts within
@@ -863,13 +889,25 @@ class FleetRouter:
         unhealthy or breaker-open is simply skipped — the turn migrates
         (the survivor replays from its prefix cache at best, a cold
         prefill at worst; tokens stay exact either way) and the pin
-        follows the new placement."""
+        follows the new placement.
+
+        ``priority=`` (interactive/default/batch) rides to the replica
+        verbatim (``ServingEngine.submit(priority=)`` — class-ordered
+        admission, preemption) and shapes the ROUTER side too: queue
+        scoring counts only the classes scheduled at or before this
+        one (``_queue_depth_for``), and placement-retry backoff scales
+        by class (``_BACKOFF_FACTOR``) so a degraded fleet serves its
+        tightest SLOs first."""
+        if priority is not None and priority not in PRIORITY_RANK:
+            raise ValueError(
+                f"priority must be one of {sorted(PRIORITY_RANK)}, "
+                f"got {priority!r}")
         freq = FleetRequest(
             self, prompt, max_new_tokens,
             {"temperature": temperature, "top_k": top_k, "top_p": top_p,
-             "session": session},
+             "session": session, "priority": priority},
             None if deadline_s is None else float(deadline_s), stream,
-            session=session)
+            session=session, priority=priority)
         try:
             self._place(freq)
         except BaseException as e:

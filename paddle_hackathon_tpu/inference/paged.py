@@ -284,14 +284,20 @@ class PrefixCache:
     def _key(prompt, k, P):
         return np.asarray(prompt[k * P:(k + 1) * P], np.int32).tobytes()
 
-    def match(self, prompt) -> List[int]:
+    def match(self, prompt, allow_full: bool = False) -> List[int]:
         """Longest cached page-prefix of ``prompt``, capped at
         ``(len(prompt) - 1) // page_size`` full pages (the engine must
         re-prefill at least the last prompt token — see module
-        docstring).  Matched pages are increffed for the caller; the
-        caller owns releasing them (decref) when the slot frees."""
+        docstring).  ``allow_full=True`` lifts that cap to
+        ``len(prompt) // page_size``: a preempted stream re-admitting
+        feeds its NEXT token from its last committed one, so every row
+        of its replay source is consumable KV and a full-cover hit
+        skips prefill entirely.  Matched pages are increffed for the
+        caller; the caller owns releasing them (decref) when the slot
+        frees."""
         P = self._pool.page_size
-        limit = (len(prompt) - 1) // P
+        limit = (len(prompt) // P if allow_full
+                 else (len(prompt) - 1) // P)
         pages, children = [], self._root
         self._clock += 1
         for k in range(limit):

@@ -60,6 +60,12 @@ from ..observability import tracing as _tr
 _ENGINE_IDS = itertools.count()
 _REQ_IDS = itertools.count()
 
+# SLO priority classes (submit(priority=)): lower rank schedules first.
+# Aging (ServingEngine priority_aging_s) promotes a waiting request one
+# rank per interval, so batch work cannot starve forever under a
+# sustained interactive load.
+PRIORITY_RANK = {"interactive": 0, "default": 1, "batch": 2}
+
 
 class _EngineStats(collections.abc.Mapping):
     """Back-compat dict view over the engine's registry counters: the
@@ -70,7 +76,7 @@ class _EngineStats(collections.abc.Mapping):
     _KEYS = ("ticks", "tokens", "requests",
              "spec_ticks", "spec_drafted", "spec_accepted",
              "prefix_hit_tokens", "prompt_tokens", "prefix_hit_rate",
-             "session_resumes", "session_hit_tokens")
+             "session_resumes", "session_hit_tokens", "preemptions")
 
     def __init__(self, counters):
         self._counters = counters   # key -> Counter child
@@ -367,11 +373,12 @@ class Request:
                  "temperature", "top_k", "top_p", "_event",
                  "_t_submit", "_t_first", "rid", "_span_queue",
                  "_span_life", "lifecycle", "_tick_mark", "deadline_s",
-                 "on_token", "session")
+                 "on_token", "session", "priority", "_prank",
+                 "_preempts", "_t_queued")
 
     def __init__(self, prompt, max_new_tokens, temperature=None,
                  top_k=None, top_p=None, deadline_s=None, on_token=None,
-                 session=None):
+                 session=None, priority=None):
         self.rid = next(_REQ_IDS)   # process-wide request id (spans/flight)
         self.prompt = np.asarray(prompt, np.int32).reshape(-1)
         self.max_new_tokens = int(max_new_tokens)
@@ -381,18 +388,31 @@ class Request:
         self.deadline_s = None if deadline_s is None else float(deadline_s)
         self.on_token = on_token
         self.session = session   # multi-turn KV session key (or None)
+        self.priority = "default" if priority is None else priority
+        if self.priority not in PRIORITY_RANK:
+            raise ValueError(
+                f"priority must be one of {sorted(PRIORITY_RANK)}, "
+                f"got {priority!r}")
+        self._prank = PRIORITY_RANK[self.priority]
+        self._preempts = 0   # times this request was preempted (cap)
         self.tokens: List[int] = []  # generated so far
         self.done = False
         self.error: Optional[BaseException] = None
         self._event = threading.Event()
         self._t_submit = time.perf_counter()   # TTFT/e2e reference point
+        # last time the request (re-)entered the queue: submit, or a
+        # preemption's re-queue — the queue-wait the SLO windows and
+        # /load's oldest_wait_s measure (deadlines/aging stay on
+        # _t_submit: total-budget semantics)
+        self._t_queued = self._t_submit
         self._t_first: Optional[float] = None  # first generated token
         # (last commit time, tokens then) — the per-tick TPOT sample base
         self._tick_mark: Optional[tuple] = None
         self.lifecycle = {"rid": self.rid,
                           "prompt_len": int(self.prompt.shape[0]),
                           "max_new_tokens": self.max_new_tokens,
-                          "t_submit": self._t_submit}
+                          "t_submit": self._t_submit,
+                          "priority": self.priority}
         if self.deadline_s is not None:
             self.lifecycle["deadline_s"] = self.deadline_s
         # lifecycle spans (no-ops while tracing is disabled): queued =
@@ -429,12 +449,21 @@ class _LoadDebugSource:
 
 
 class _Slot:
-    __slots__ = ("req", "off", "last")
+    __slots__ = ("req", "off", "last", "seq", "resume")
 
     def __init__(self):
         self.req: Optional[Request] = None
-        self.off = 0      # prompt tokens consumed
+        self.off = 0      # prefill-source tokens consumed
         self.last = 0     # last sampled token (decode feed)
+        # the slot's prefill source: the request's prompt, or — for a
+        # request resuming after preemption — prompt + committed tokens
+        # minus the last one (the rows whose KV must be resident before
+        # decode continues; the last committed token is the decode feed)
+        self.seq = None
+        # resume=True: the final prefill chunk's sample must NOT commit
+        # (it would re-predict an already-committed token); decode
+        # restarts from the preset ``last`` instead
+        self.resume = False
 
 
 class _Session:
@@ -536,6 +565,24 @@ class ServingEngine:
         eviction, :meth:`drain`, or :meth:`drop_sessions`.
       max_sessions: LRU cap on retained sessions (docs/SERVING.md,
         "Multi-turn sessions").
+      priority_aging_s: seconds of queue wait that promote a request
+        one priority class (batch → default → interactive) — the
+        anti-starvation guarantee under sustained higher-priority
+        load; ``None`` disables aging (strict class order).
+      prefill_budget: per-tick PREFILL token budget across slots
+        (chunked-prefill fairness): prefill chunks are granted in
+        priority order up to this many tokens per tick, the rest
+        defer — a long batch prompt then interleaves with decode
+        ticks instead of monopolizing every tick's width.  ``None``
+        (default) = unbounded, the historical behavior.
+      preempt: allow admission pressure to preempt a strictly
+        lower-priority in-flight stream (release its pages, re-queue
+        it; re-admission replays the committed tokens through the
+        prefix/session cache — token-exact for greedy requests).
+        Disabled automatically while draining and under pp.
+      preempt_limit: max preemptions of one request (thrash bound);
+        past it the request is never picked as a victim again.
+        docs/SERVING.md, "Priority and preemption".
     """
 
     # bounded count of radix-cache chain digests the /load report's
@@ -548,7 +595,9 @@ class ServingEngine:
                  auto_run=True, decode_window=8, top_p=None, spec_k=0,
                  drafter="ngram", cache_mode="dense", page_size=16,
                  num_pages=None, prefix_cache=True, slo_window_s=60.0,
-                 session_ttl_s=None, max_sessions=64):
+                 session_ttl_s=None, max_sessions=64,
+                 priority_aging_s=30.0, prefill_budget=None,
+                 preempt=True, preempt_limit=2):
         import jax
         import jax.numpy as jnp
 
@@ -564,6 +613,14 @@ class ServingEngine:
         self.auto_run = bool(auto_run)
         self._decode_window = max(1, min(int(decode_window), self.chunk))
         self.spec_k = int(spec_k)
+        self._aging_s = (None if priority_aging_s is None
+                         else float(priority_aging_s))
+        # >= 1 so the highest-priority prefilling slot always makes
+        # progress — a zero budget would stall every prefill forever
+        self._prefill_budget = (None if prefill_budget is None
+                                else max(1, int(prefill_budget)))
+        self._preempt = bool(preempt)
+        self._preempt_limit = max(0, int(preempt_limit))
 
         cfg = model.config
         self._head_dim = cfg.hidden_size // cfg.num_heads
@@ -798,6 +855,18 @@ class ServingEngine:
             "defrag_pages_moved": reg.counter(
                 "serving_defrag_pages_moved_total",
                 "KV pages relocated by pool compactions"),
+            # SLO scheduler (submit(priority=)): preempted streams are
+            # RE-QUEUED, not aborted — their committed tokens replay on
+            # resume, so goodput (completed vs aborted) must not move
+            "preemptions": reg.counter(
+                "serving_preemptions_total",
+                "in-flight streams preempted by higher-priority "
+                "admission (pages released/demoted, request re-queued)"),
+            "preempt_replay_tokens": reg.counter(
+                "serving_preempt_replay_tokens_total",
+                "committed rows re-prefilled when a preempted stream "
+                "resumed (rows the prefix/session cache did not cover "
+                "— the preemption cost the cache could not absorb)"),
         }
         self._c = {k: fam.labels(**lbl) for k, fam in counters.items()}
         self.stats = _EngineStats(self._c)
@@ -825,6 +894,15 @@ class ServingEngine:
             "slots holding an active request this tick").labels(**lbl)
         self._g_queue = reg.gauge(
             "serving_queue_depth", "requests waiting for a slot").labels(**lbl)
+        # per-priority-class queue depth: a shallow TOTAL queue can hide
+        # an interactive queue starving behind a deep batch queue — the
+        # router's least-loaded scoring needs the split (three bounded
+        # children per engine, not a per-request series)
+        cls_fam = reg.gauge(
+            "serving_class_queue_depth",
+            "queued requests per priority class")
+        self._g_class_queue = {
+            c: cls_fam.labels(**{"class": c}, **lbl) for c in PRIORITY_RANK}
         # achieved weight HBM: every param/buffer array the tick programs
         # stream per token (int8 quantization should read ~half the bf16
         # bytes — the serving_int8 bench row embeds this as evidence).
@@ -885,6 +963,13 @@ class ServingEngine:
         self._slo = {k: _obs.SlidingWindowHistogram(
             window_s=self._slo_window_s)
             for k in ("ttft", "tpot", "e2e", "queue_wait")}
+        # per-priority-class ttft/queue-wait windows: the control signal
+        # the SLO scheduler is judged by ("interactive ttft p99 under
+        # mixed load"), published via /load's slo.classes block — 3x2
+        # bounded windows, same exact last-N-seconds semantics
+        self._slo_cls = {c: {k: _obs.SlidingWindowHistogram(
+            window_s=self._slo_window_s) for k in ("ttft", "queue_wait")}
+            for c in PRIORITY_RANK}
         # /load registration: the engine IS its own load source, and the
         # same report rides /debug/requests under "<eid>.load" via a
         # strongly-held adapter (both registries are weak — a dropped
@@ -1500,7 +1585,7 @@ class ServingEngine:
     # scheduling
     def submit(self, prompt, max_new_tokens=32, temperature=None,
                top_k=None, top_p=None, deadline_s=None,
-               on_token=None, session=None) -> Request:
+               on_token=None, session=None, priority=None) -> Request:
         """Queue a request.  ``deadline_s`` bounds the request's TOTAL
         wall budget from submit: still queued past it (queue-wait is
         where overload deadlines actually die) or still decoding past
@@ -1524,10 +1609,20 @@ class ServingEngine:
         conversation keeps the longest common prefix (partial tail
         pages fork copy-on-write via ``PagePool.cow``).  Sessions are
         evicted LRU/TTL and under admission pressure — retention never
-        starves admission (docs/SERVING.md, "Multi-turn sessions")."""
+        starves admission (docs/SERVING.md, "Multi-turn sessions").
+
+        ``priority`` ("interactive" | "default" | "batch", default
+        "default") sets the request's SLO class: admission picks the
+        best effective class first (FIFO within a class; queue wait
+        ages a request upward every ``priority_aging_s``), and under
+        admission pressure a strictly lower-priority in-flight stream
+        may be PREEMPTED — re-queued, not aborted; its committed
+        tokens replay through the prefix/session cache on re-admission
+        (docs/SERVING.md, "Priority and preemption")."""
         req = Request(prompt, max_new_tokens, temperature=temperature,
                       top_k=top_k, top_p=top_p, deadline_s=deadline_s,
-                      on_token=on_token, session=session)
+                      on_token=on_token, session=session,
+                      priority=priority)
         need = len(req.prompt) + req.max_new_tokens
         # reserve headroom past the last committed row for the widest
         # in-flight write: a prefill chunk, or the (spec_k+1)-wide verify
@@ -1582,7 +1677,7 @@ class ServingEngine:
                 if req.deadline_s is not None:
                     self._deadline_queued += 1
                 self._c["requests"].inc()
-                self._g_queue.set(len(self._pending))
+                self._set_queue_gauges_locked()
                 if self.auto_run and not self._running:
                     # a fresh burst supersedes a PAST crash: its failed
                     # requests already surfaced their errors, and a
@@ -1622,25 +1717,143 @@ class ServingEngine:
             raise TimeoutError("generation did not finish in time")
         return req.result()
 
+    def _eff_rank_locked(self, req, now):
+        """Effective priority class of a waiting request: its static
+        rank, promoted one class per ``priority_aging_s`` of wait since
+        SUBMIT (not the last re-queue — a preempted request keeps its
+        accrued age).  The anti-starvation guarantee: any batch request
+        eventually reaches rank 0 and outranks every fresh interactive
+        arrival (ties break FIFO)."""
+        r = req._prank
+        if r and self._aging_s is not None:
+            r -= int((now - req._t_submit) / self._aging_s)
+            if r < 0:
+                r = 0
+        return r
+
+    def _next_pending_idx_locked(self, now):
+        """Index of the next request admission should try: best
+        effective class first, FIFO within it (queue position breaks
+        ties, so an all-default workload schedules exactly like the
+        historical FIFO deque)."""
+        best_i, best_k = 0, None
+        for i, req in enumerate(self._pending):
+            k = (self._eff_rank_locked(req, now), i)
+            if best_k is None or k < best_k:
+                best_i, best_k = i, k
+        return best_i
+
+    def _pick_victim_locked(self, cand, now):
+        """Slot to preempt so ``cand`` can admit, or None.  A victim
+        must be strictly lower effective priority than the candidate
+        (so a just-preempted stream can never immediately evict its
+        evictor back — no livelock) and under its preemption cap.
+        Among victims: lowest effective class first, then least work
+        to replay (committed rows), then the highest slot index."""
+        if (not self._preempt or self._draining or self._pp > 1
+                or not self._preempt_limit):
+            return None
+        ce = self._eff_rank_locked(cand, now)
+        best = None
+        for i, slot in enumerate(self._slots):
+            req = slot.req
+            if req is None or req._preempts >= self._preempt_limit:
+                continue
+            ve = self._eff_rank_locked(req, now)
+            if ve <= ce:
+                continue
+            key = (-ve, int(self._lengths[i]), -i)
+            if best is None or key < best[0]:
+                best = (key, i)
+        return None if best is None else best[1]
+
+    def _preempt_slot_locked(self, i, now):
+        """Preempt slot ``i``'s in-flight stream: retain its committed
+        KV where a cache can hold it (session install for session
+        streams — the chain must survive for the PR 16 leak/dead-session
+        tripwires to stay meaningful; prefix-cache donation otherwise),
+        release the slot, and RE-QUEUE the request at the front of the
+        queue.  Nothing terminal happens: no error, no event, no abort
+        books — re-admission replays the committed tokens (slot.seq)
+        and decode continues token-exact from the last committed one."""
+        slot = self._slots[i]
+        req = slot.req
+        if self._paged:
+            if req.session is not None:
+                # demote to session-retained, NOT released: the session
+                # keeps the chain refs, re-admission session-resumes
+                self._session_install_locked(i, req)
+            elif self._prefix is not None:
+                # donate the committed rows' full pages keyed by their
+                # token content (prompt + generated): re-admission
+                # matches them back; admission pressure can still evict
+                # them (cached_only), so donation never blocks anyone
+                kv_len = min(int(self._lengths[i]),
+                             len(req.prompt)
+                             + max(0, len(req.tokens) - 1))
+                if kv_len >= self._page_size:
+                    seq = np.concatenate(
+                        [req.prompt, np.asarray(req.tokens, np.int32)])
+                    self._prefix.insert(seq[:kv_len],
+                                        self._page_tables[i],
+                                        kv_len // self._page_size)
+            self._release_pages_locked(i)
+        slot.req = None
+        slot.seq = None
+        slot.resume = False
+        self._sampling_cache = None  # membership changed: restage
+        self._lengths[i] = 0
+        req._preempts += 1
+        req._t_queued = now
+        self._pending.appendleft(req)
+        if req.deadline_s is not None:
+            self._deadline_queued += 1
+        self._c["preemptions"].inc()
+        req.lifecycle["preemptions"] = req._preempts
+        req._span_queue = _tr.start_span(
+            "serving.request.queued", _tid=req.rid, rid=req.rid,
+            engine=self._engine_id, preempted=True)
+        self._flight.record(
+            "req", phase="preempt", rid=req.rid, engine=self._engine_id,
+            slot=i, tokens=len(req.tokens), preempts=req._preempts)
+
+    def _set_queue_gauges_locked(self):
+        self._g_queue.set(len(self._pending))
+        counts = dict.fromkeys(PRIORITY_RANK, 0)
+        for r in self._pending:
+            counts[r.priority] += 1
+        for c, g in self._g_class_queue.items():
+            g.set(counts[c])
+
     def _admit(self):
-        """Move pending requests into free slots.  Under pp a request
-        admits into any free slot (its wave is slot // wave_size); its
-        staged prompt is consumed when that wave next enters stage 0.
+        """Move pending requests into free slots — best effective
+        priority class first, FIFO within a class (aging promotes
+        waiters, see ``_eff_rank_locked``).  Under pp a request admits
+        into any free slot (its wave is slot // wave_size); its staged
+        prompt is consumed when that wave next enters stage 0.
 
         Paged mode additionally requires the request's PAGE footprint to
-        fit the pool — a free slot alone is not capacity.  Admission
-        stays FIFO: when the queue head's pages don't fit, later (maybe
-        smaller) requests wait behind it rather than starving it.
+        fit the pool — a free slot alone is not capacity.  When the
+        pick cannot admit (no slot, or pages short), admission may
+        PREEMPT a strictly lower-priority in-flight stream
+        (``_preempt_slot_locked``) and retry; otherwise it stops —
+        later same-or-lower-priority requests wait behind the pick
+        rather than starving it (per-class FIFO preserved).
+
+        A re-admitted (preempted) request resumes: its slot prefills
+        ``prompt + tokens[:-1]`` (``slot.seq``) with the final chunk's
+        sample discarded, and decode restarts from the last committed
+        token — token-exact for greedy requests.
 
         Returns the prefix-hit drafter replays ``[(slot, req, skip,
-        lengths_snapshot)]`` for the CALLER to run after releasing the
-        engine lock: the
-        replay dispatches the drafter's jitted ingest program, and
-        dispatching device work under ``_lock`` stalls every concurrent
-        submit()/introspection call behind the device (pht-lint PHT003
-        caught this).  Deferral is safe — only the driver thread touches
-        slot state, and the replay only needs to land before this tick's
-        post-verify ingest, which runs later on this same thread."""
+        lengths_snapshot, seq)]`` for the CALLER to run after releasing
+        the engine lock: the replay dispatches the drafter's jitted
+        ingest program, and dispatching device work under ``_lock``
+        stalls every concurrent submit()/introspection call behind the
+        device (pht-lint PHT003 caught this).  Deferral is safe — only
+        the driver thread touches slot state, and the replay only needs
+        to land before this tick's post-verify ingest, which runs later
+        on this same thread."""
         if self._defrag_busy:
             # a compaction's device copy is in flight: the move plan
             # treats low free pages as copy destinations, so admission
@@ -1650,22 +1863,55 @@ class ServingEngine:
         self._expire_queued_locked()
         self._sweep_sessions_locked()
         replays = []
-        for i, slot in enumerate(self._slots):
-            if slot.req is not None or not self._pending:
-                continue
+        free = [i for i, s in enumerate(self._slots) if s.req is None]
+        while self._pending:
+            now = time.perf_counter()
+            idx = self._next_pending_idx_locked(now)
+            req = self._pending[idx]
+            if not free:
+                v = self._pick_victim_locked(req, now)
+                if v is None:
+                    break
+                self._preempt_slot_locked(v, now)
+                free.append(v)
+                continue   # re-pick: the victim joined the queue
+            i = min(free)
+            resume = bool(req.tokens)
+            seq = (np.concatenate(
+                [req.prompt, np.asarray(req.tokens[:-1], np.int32)])
+                if resume else req.prompt)
             skip = 0
             if self._paged:
-                skip = self._paged_admit_locked(i, self._pending[0])
+                skip = self._paged_admit_locked(i, req, seq, resume)
                 if skip is None:
-                    break  # pool exhausted for the FIFO head
-            slot.req = req = self._pending.popleft()
+                    # pool exhausted for the pick: preempt a strictly
+                    # lower-priority stream to free pages and retry, or
+                    # stop admitting this tick
+                    v = self._pick_victim_locked(req, now)
+                    if v is None:
+                        break
+                    self._preempt_slot_locked(v, now)
+                    free.append(v)
+                    continue
+            free.remove(i)
+            del self._pending[idx]
+            slot = self._slots[i]
+            slot.req = req
             if req.deadline_s is not None:
                 self._deadline_queued -= 1
             self._sampling_cache = None  # membership changed: restage
-            slot.off = skip   # prefix-cache hit: those rows are already KV
-            slot.last = 0
+            slot.seq = seq
+            slot.resume = resume
+            slot.off = skip   # cache hit: those rows are already KV
+            # a resumed stream decodes from its last committed token
+            # (never re-sampled — the final replay chunk's sample is
+            # discarded, see _stage)
+            slot.last = int(req.tokens[-1]) if resume else 0
             self._lengths[i] = skip
-            self._c["prompt_tokens"].inc(len(req.prompt))
+            self._c["prompt_tokens"].inc(len(seq))
+            if resume:
+                self._c["preempt_replay_tokens"].inc(
+                    max(0, len(seq) - skip))
             if skip and self._spec is not None:
                 # snapshot the committed lengths UNDER the lock: the
                 # replay itself runs after release (device dispatch must
@@ -1673,11 +1919,11 @@ class ServingEngine:
                 # self._lengths there would be an unguarded read of
                 # lock-guarded state (PHT009); only slot i's row is
                 # consumed (other slots replay zero tokens)
-                replays.append((i, req, skip, self._lengths.copy()))
-            now = time.perf_counter()
-            queue_s = now - req._t_submit
+                replays.append((i, req, skip, self._lengths.copy(), seq))
+            queue_s = now - req._t_queued
             req.lifecycle.update(t_admit=now, queue_s=queue_s, slot=i)
             self._slo["queue_wait"].observe(queue_s)
+            self._slo_cls[req.priority]["queue_wait"].observe(queue_s)
             req._span_queue.end(slot=i)
             self._flight.record(
                 "req", phase="admit", rid=req.rid, engine=self._engine_id,
@@ -1801,13 +2047,17 @@ class ServingEngine:
             {"rid": req.rid, "where": where, "error": error,
              "tokens": len(req.tokens), "t_abort": round(now, 6)})
 
-    def _paged_admit_locked(self, i, req):
+    def _paged_admit_locked(self, i, req, seq, resume):
         """Reserve slot ``i``'s whole page footprint up front (worst-case
         rows = prompt + max_new + the write-window reserve, in pages):
-        no mid-flight exhaustion, no preemption machinery, and the
+        no mid-flight exhaustion, and the
         concurrency win is intact because the footprint tracks the
         REQUEST's need, not ``max_len``.  Cached prefix pages are mapped
         shared (refcount++) and their tokens skipped from prefill.
+        ``seq`` is the prefill source (``req.prompt``, or ``prompt +
+        tokens[:-1]`` when ``resume`` — a preempted stream re-admitting;
+        its committed rows were donated to the prefix/session cache at
+        preemption, so the match below is what makes preemption cheap).
         Returns the skipped token count, or None when the pool cannot
         fit the request yet (caller leaves it queued)."""
         from .paged import NULL_PAGE, pages_for
@@ -1819,12 +2069,15 @@ class ServingEngine:
             # retained page chain instead of re-prefilling the history
             # (a busy session — its owner turn still decoding — falls
             # through to normal admission: the fork serves off the
-            # prefix cache and never touches the owner's pages)
+            # prefix cache and never touches the owner's pages).  A
+            # preempt-resume may take back every retained row (its last
+            # committed token feeds decode, so seq's final row IS
+            # consumable KV — no len-1 cap needed).
             sess = self._sessions.get(req.session)
             if sess is not None and not sess.busy and sess.pages:
-                n = min(sess.kv_len, len(req.prompt) - 1)
+                n = min(sess.kv_len, len(seq) - (0 if resume else 1))
                 diff = np.nonzero(sess.tokens[:n]
-                                  != req.prompt[:n])[0]
+                                  != seq[:n])[0]
                 common = int(diff[0]) if len(diff) else int(n)
                 if common > 0:
                     skip = self._session_resume_locked(i, req, sess,
@@ -1834,7 +2087,7 @@ class ServingEngine:
                     # normal admission needs at least as many fresh
                     # pages, so falling through could not admit either)
                     return skip
-        hit = (self._prefix.match(req.prompt)
+        hit = (self._prefix.match(seq, allow_full=resume)
                if self._prefix is not None else [])
         fresh_n = total - len(hit)
         short = fresh_n - self._pool.free
@@ -1871,14 +2124,17 @@ class ServingEngine:
         self._g_pages_free.set(self._pool.free)
         return len(hit) * P
 
-    def _replay_skipped_to_drafter(self, i, req, skip, lengths):
+    def _replay_skipped_to_drafter(self, i, req, skip, lengths, seq):
         """A prefix-cache hit skips re-prefilling rows [0, skip) — but
         the drafter's mirror only ever sees what the target tick feeds
         it, so without this replay it would propose from a hole in its
         history (never *wrong* tokens — verify rejects — just a silently
         degraded acceptance rate).  Replay in chunk-wide pieces: the
         width the drafter's ingest program is already compiled for, so
-        no new trace per distinct hit length.  ``lengths`` is the
+        no new trace per distinct hit length.  ``seq`` is the slot's
+        prefill source (prompt, or prompt + committed tokens on a
+        preempt-resume — the drafter must mirror the RESUMED history,
+        not just the prompt).  ``lengths`` is the
         committed-lengths snapshot ``_admit`` took under the engine
         lock (this runs after release); other slots' rows follow the
         normal ingest convention (zero tokens written past their
@@ -1887,7 +2143,7 @@ class ServingEngine:
         for ofs in range(0, skip, C):
             n = min(C, skip - ofs)
             buf = np.zeros((self.max_slots, C), np.int32)
-            buf[i, :n] = req.prompt[ofs:ofs + n]
+            buf[i, :n] = seq[ofs:ofs + n]
             starts = lengths.copy()
             starts[i] = ofs
             nvalid = np.zeros(self.max_slots, np.int32)
@@ -2271,28 +2527,54 @@ class ServingEngine:
         """Build (tokens, starts, nvalid, consumed, finishing) for this
         tick from current slot state. ``consumed[i]``: tokens written for
         slot i (its length advance); ``finishing[i]``: the tick's sample
-        for slot i is a real next token (prompt fully consumed)."""
+        for slot i is a real next token.  The prefill source is
+        ``slot.seq`` (the prompt, or ``prompt + tokens[:-1]`` on a
+        preempt-resume); a resume slot's final replay chunk stages with
+        ``finishing`` FALSE — its sample would be a re-prediction of the
+        already-committed last token, so it is discarded and decode
+        restarts from ``slot.last`` next tick (token-exact for greedy).
+
+        ``prefill_budget`` bounds the PREFILL tokens staged per tick
+        (decode feeds are never deferred): chunks are granted in
+        priority order and may be narrowed (nvalid is runtime data —
+        no retrace); a slot past the budget stages a scratch token at
+        its current length with ``consumed`` 0 — the row is rewritten
+        by the real chunk before any of that chunk's queries attend it,
+        the same rollback argument spec-verify relies on.  This bounds
+        how long a wall of batch prefill can displace an interactive
+        slot's decode ticks — the chunked-prefill fairness knob
+        (docs/SERVING.md)."""
         B, C = self.max_slots, self.chunk
         tokens = np.zeros((B, C), np.int32)
         starts = self._lengths.copy()
         nvalid = np.ones(B, np.int32)
         consumed = np.zeros(B, np.int32)
         finishing = [False] * B
+        prefilling = [i for i, s in enumerate(self._slots)
+                      if s.req is not None and s.off < len(s.seq)]
+        rem = self._prefill_budget
+        if rem is not None:
+            prefilling.sort(key=lambda i: (self._slots[i].req._prank, i))
+        for i in prefilling:
+            slot = self._slots[i]
+            w = min(C, len(slot.seq) - slot.off)
+            if rem is not None:
+                w = min(w, rem)
+                rem -= w
+            if w <= 0:
+                continue   # budget spent: deferred (scratch, no advance)
+            tokens[i, :w] = slot.seq[slot.off:slot.off + w]
+            nvalid[i] = w
+            consumed[i] = w
+            finishing[i] = (not slot.resume
+                            and slot.off + w >= len(slot.seq))
         for i, slot in enumerate(self._slots):
-            req = slot.req
-            if req is None:
+            if slot.req is None or slot.off < len(slot.seq):
                 continue
-            if slot.off < len(req.prompt):
-                chunk = req.prompt[slot.off:slot.off + C]
-                tokens[i, :len(chunk)] = chunk
-                nvalid[i] = len(chunk)
-                consumed[i] = len(chunk)
-                finishing[i] = slot.off + len(chunk) >= len(req.prompt)
-            else:
-                tokens[i, 0] = slot.last
-                nvalid[i] = 1
-                consumed[i] = 1
-                finishing[i] = True
+            tokens[i, 0] = slot.last
+            nvalid[i] = 1
+            consumed[i] = 1
+            finishing[i] = True
         return tokens, starts, nvalid, consumed, finishing
 
     def _finish(self, slot_idx, req):
@@ -2361,6 +2643,7 @@ class ServingEngine:
             req.lifecycle.update(t_first_token=req._t_first, ttft_s=ttft)
             self._h_ttft.observe(ttft)
             self._slo["ttft"].observe(ttft)
+            self._slo_cls[req.priority]["ttft"].observe(ttft)
         req.tokens.append(tok)
         slot.last = tok
         self._c["tokens"].inc()
@@ -2474,7 +2757,7 @@ class ServingEngine:
             # _admit): a slot past its deadline frees before this tick
             # wastes another program dispatch on it
             self._expire_slots_locked()
-            self._g_queue.set(len(self._pending))
+            self._set_queue_gauges_locked()
             occ = sum(s.req is not None for s in self._slots)
             self._g_occupancy.set(occ)
             if occ > self._peak_occupancy:
@@ -2495,7 +2778,7 @@ class ServingEngine:
                 return False
             # after _admit, a pending request implies no free slot — so
             # "every active slot is decoding" is the spec/multi-window gate
-            elif all(s.req is None or s.off >= len(s.req.prompt)
+            elif all(s.req is None or s.off >= len(s.seq)
                      for s in self._slots):
                 last_toks = np.asarray([s.last for s in self._slots],
                                        np.int32)
@@ -2512,13 +2795,13 @@ class ServingEngine:
             if self._paged:
                 self._check_write_windows_locked(starts)
 
-        for i, req, skip, lengths in replays:
+        for i, req, skip, lengths, seq in replays:
             # deferred from _admit: the drafter's jitted ingest must not
             # dispatch under the engine lock (only this driver thread
             # mutates slot state, so running it here — before this
             # tick's device program and its post-verify ingest — is
             # order-equivalent to replaying inside _admit)
-            self._replay_skipped_to_drafter(i, req, skip, lengths)
+            self._replay_skipped_to_drafter(i, req, skip, lengths, seq)
 
         if mode == "pp":
             t0n = time.perf_counter_ns()
@@ -2661,19 +2944,21 @@ class ServingEngine:
                     continue
                 req = slot.req   # _commit_token may free the slot
                 rid = req.rid
-                was_prefill = slot.off < len(req.prompt)
+                was_prefill = slot.off < len(slot.seq)
                 if was_prefill:
                     slot.off += int(consumed[i])
                     if (self._prefix is not None
-                            and slot.off >= len(slot.req.prompt)):
-                        # prompt fully prefilled: register its FULL pages
-                        # so later requests sharing the prefix skip them.
+                            and slot.off >= len(slot.seq)):
+                        # prefill source fully consumed: register its
+                        # FULL pages so later requests sharing the
+                        # prefix (or this stream's own re-admission
+                        # after another preemption) skip them.
                         # Before _commit_token — a request that finishes
                         # this very tick must donate its pages to the
                         # cache before _finish releases the slot's refs.
                         self._prefix.insert(
-                            slot.req.prompt, self._page_tables[i],
-                            len(slot.req.prompt) // self._page_size)
+                            slot.seq, self._page_tables[i],
+                            len(slot.seq) // self._page_size)
                 self._lengths[i] += int(consumed[i])
                 if finishing[i]:
                     self._commit_token(i, int(nxt[i]))
@@ -2747,7 +3032,7 @@ class ServingEngine:
             if slot.req is None or slot.req is not reqs_e[i]:
                 continue
             req = slot.req   # _commit_token may free the slot
-            if slot.off < len(req.prompt):
+            if slot.off < len(slot.seq):
                 slot.off += int(consumed_e[i])
             self._lengths[i] += int(consumed_e[i])
             if finishing_e[i]:
@@ -2869,6 +3154,8 @@ class ServingEngine:
                     "generated": len(req.tokens),
                     "max_new_tokens": req.max_new_tokens,
                     "cache_len": int(self._lengths[i]),
+                    "priority": req.priority,
+                    "preempted": req._preempts,
                 }
                 if self._paged:
                     row["pages"] = len(self._slot_pages[i])
@@ -2898,9 +3185,12 @@ class ServingEngine:
         One snapshot under the engine lock (host dicts and counters
         only — no device touch), so polling never stalls a tick:
 
-        - ``slots``/``queue``: free capacity and how long the queue
-          head has been waiting (admission is FIFO, so ``oldest_wait_s``
-          bounds every queued request's wait).
+        - ``slots``/``queue``: free capacity and how long the
+          longest-waiting queued request has been waiting since its
+          last enqueue (submit or preemption re-queue), plus the
+          per-priority-class breakdown (``queue.classes``) — a
+          least-loaded router scoring total depth alone would let an
+          interactive queue starve unseen behind a deep batch queue.
         - ``admission``: the headroom a router sizes a request against —
           largest admissible ``prompt + max_new`` right now (page-exact
           in paged mode via ``paged.tokens_admittable``, ``max_len``
@@ -2920,8 +3210,16 @@ class ServingEngine:
             now = time.perf_counter()
             active = sum(s.req is not None for s in self._slots)
             free_slots = self.max_slots - active
-            oldest = max((now - r._t_submit for r in self._pending),
+            oldest = max((now - r._t_queued for r in self._pending),
                          default=0.0)
+            cls_q = {c: {"depth": 0, "oldest_wait_s": 0.0}
+                     for c in PRIORITY_RANK}
+            for r in self._pending:
+                row = cls_q[r.priority]
+                row["depth"] += 1
+                w = round(now - r._t_queued, 6)
+                if w > row["oldest_wait_s"]:
+                    row["oldest_wait_s"] = w
             completed = int(self._c["completed_tokens"].value)
             aborted = int(self._c["aborted_tokens"].value)
             report = {
@@ -2939,7 +3237,11 @@ class ServingEngine:
                 "slots": {"max": self.max_slots, "active": active,
                           "free": free_slots},
                 "queue": {"depth": len(self._pending),
-                          "oldest_wait_s": round(oldest, 6)},
+                          "oldest_wait_s": round(oldest, 6),
+                          # per-class block (added within version 1):
+                          # all classes always present, zeroed when
+                          # idle, so router code never key-checks
+                          "classes": cls_q},
                 "modes": {"cache": self.cache_mode,
                           "spec_k": self.spec_k,
                           "quant": self._quantized,
@@ -2947,7 +3249,25 @@ class ServingEngine:
                           "pp": self._pp},
                 "slo": {"window_s": self._slo_window_s,
                         **{k: h.percentiles()
-                           for k, h in self._slo.items()}},
+                           for k, h in self._slo.items()},
+                        # per-class TTFT/queue-wait percentiles (added
+                        # within version 1): the control signal the
+                        # scheduler exists to move — aggregate p99
+                        # launders an interactive tail under batch bulk
+                        "classes": {c: {k: h.percentiles()
+                                        for k, h in hs.items()}
+                                    for c, hs in self._slo_cls.items()}},
+                # scheduler block (added within version 1): the knobs a
+                # fleet operator tunes + the preemption count goodput
+                # regressions get correlated against
+                "scheduler": {
+                    "preemptions": int(self._c["preemptions"].value),
+                    "preempt_replay_tokens": int(
+                        self._c["preempt_replay_tokens"].value),
+                    "preempt": self._preempt,
+                    "preempt_limit": self._preempt_limit,
+                    "prefill_budget": self._prefill_budget,
+                    "priority_aging_s": self._aging_s},
                 "goodput": {
                     "completed_tokens": completed,
                     "aborted_tokens": aborted,

@@ -92,6 +92,9 @@ _FILE_COST = {
     "test_sanitizers.py": 5,  # lock/guard/race units + one thread-only
                               # dataloader epoch; engine runs slow-marked
     "test_paged.py": 16,    # allocator units + 2 tiny-GPT engine runs
+    "test_priority.py": 25,  # scheduler/fleet units + tiny-GPT preempt
+                             # and aging runs; dense/spec token-exact
+                             # preempt drills are slow-marked
     "test_serving_sessions.py": 12,  # allocator/router units + 2 engine
                                      # CONSTRUCTIONS (no tick compiles);
                                      # session/defrag/drain drills are

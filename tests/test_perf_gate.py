@@ -220,6 +220,39 @@ def test_ratio_gate_holds_moe_serving_to_dense():
     assert perf_gate.compare_ratios(rows) == []
 
 
+def _slo_row(ti_p=50.0, ti_f=100.0, gp_p=90.0, gp_f=100.0, lossless=True):
+    return {"metric": "gpt2_serving_slo_mixed_priority_x",
+            "value": 1.0,
+            "metrics": {"interactive_ttft_p99_ms_priority": ti_p,
+                        "interactive_ttft_p99_ms_fifo": ti_f,
+                        "batch_goodput_tokens_per_s_priority": gp_p,
+                        "batch_goodput_tokens_per_s_fifo": gp_f,
+                        "scheduling_lossless": lossless}}
+
+
+def test_slo_scheduling_gate():
+    """serving_slo embeds its own same-run FIFO baseline: interactive
+    ttft_p99 must land <= 0.75x FIFO, batch goodput must hold >= 0.8x
+    FIFO, and no request may finish short of its token budget."""
+    assert perf_gate.compare_slo_scheduling([_slo_row()]) == []
+    # scheduler degraded to FIFO: interactive saw no benefit
+    bad = perf_gate.compare_slo_scheduling([_slo_row(ti_p=80.0)])
+    assert len(bad) == 1 and "FIFO" in bad[0][1]
+    # preemption/replay cratered batch throughput below the floor
+    bad = perf_gate.compare_slo_scheduling([_slo_row(gp_p=70.0)])
+    assert len(bad) == 1 and "goodput" in bad[0][1]
+    # a stream finished short (or errored): work was dropped, not
+    # re-queued — hard fail regardless of the latency numbers
+    bad = perf_gate.compare_slo_scheduling([_slo_row(lossless=False)])
+    assert len(bad) == 1 and "token budget" in bad[0][1]
+    # boundary: exactly at ceiling and floor passes
+    assert perf_gate.compare_slo_scheduling(
+        [_slo_row(ti_p=75.0, gp_p=80.0)]) == []
+    # rows without the embedded evidence (every other suite row) skip
+    assert perf_gate.compare_slo_scheduling(
+        [{"metric": "x", "value": 1.0}]) == []
+
+
 # ---------------------------------------------------- tools/test_budget.py
 import test_budget  # noqa: E402  (tools/ already on sys.path above)
 

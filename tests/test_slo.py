@@ -322,8 +322,9 @@ def test_mfu_and_phase_gauges_from_compiled_fit(monkeypatch):
 # version 1); paged engines additionally carry a "prefix_digest" block
 _LOAD_KEYS = {"version", "engine", "ts", "running", "draining", "tickno",
               "slots", "queue", "modes", "slo", "goodput", "admission",
-              "sessions"}
+              "sessions", "scheduler"}
 _SLO_SERIES = {"ttft", "tpot", "e2e", "queue_wait"}
+_CLASSES = {"interactive", "default", "batch"}
 
 
 def _tiny_engine(auto_run=False, **kw):
@@ -385,11 +386,27 @@ def test_request_lifecycle_and_load_report_golden(srv):
     assert set(rep) == _LOAD_KEYS
     assert rep["version"] == 1 and rep["engine"] == eid
     assert set(rep["slots"]) == {"max", "active", "free"}
-    assert set(rep["queue"]) == {"depth", "oldest_wait_s"}
+    assert set(rep["queue"]) == {"depth", "oldest_wait_s", "classes"}
+    # per-priority-class queue split (the fleet router's class-aware
+    # scoring input): always all three classes, zero when idle
+    assert set(rep["queue"]["classes"]) == _CLASSES
+    for c in _CLASSES:
+        assert set(rep["queue"]["classes"][c]) == {"depth",
+                                                   "oldest_wait_s"}
     assert set(rep["modes"]) == {"cache", "spec_k", "quant", "moe", "pp"}
     assert rep["modes"] == {"cache": "dense", "spec_k": 0, "quant": False,
                             "moe": False, "pp": 1}
-    assert set(rep["slo"]) == {"window_s"} | _SLO_SERIES
+    assert set(rep["slo"]) == {"window_s", "classes"} | _SLO_SERIES
+    assert set(rep["slo"]["classes"]) == _CLASSES
+    for c in _CLASSES:
+        assert set(rep["slo"]["classes"][c]) == {"ttft", "queue_wait"}
+    # default-class traffic landed in the default per-class windows
+    assert rep["slo"]["classes"]["default"]["ttft"]["count"] == 2
+    assert rep["slo"]["classes"]["interactive"]["ttft"] is None
+    assert set(rep["scheduler"]) == {"preemptions", "preempt_replay_tokens",
+                                     "preempt", "preempt_limit",
+                                     "prefill_budget", "priority_aging_s"}
+    assert rep["scheduler"]["preemptions"] == 0
     for k in _SLO_SERIES:
         series = rep["slo"][k]
         assert set(series) == {"count", "mean", "max", "p50", "p95", "p99"}

@@ -318,6 +318,56 @@ def compare_chat_ttft(rows):
     return bad
 
 
+# SLO-aware scheduling gates (PR 17), over the serving_slo row's
+# embedded same-run FIFO-vs-priority pair (both runs in one process on
+# one clock, so the gates hold on host-timed CPU runs too).  Batch
+# goodput is batch tokens per wall second: preempted work re-queues
+# rather than aborting, so completed COUNTS always match — what
+# preemption can crater is the time those tokens take (replay cost),
+# and the floor holds that to 20%.  The interactive ceiling is loose
+# by design (a healthy run lands far under it): it catches the
+# scheduler degrading to FIFO, not timing noise.
+SLO_BATCH_GOODPUT_FLOOR = 0.80
+SLO_INTERACTIVE_TTFT_CEILING = 0.75
+
+
+def compare_slo_scheduling(rows):
+    """[(metric, reason)] for mixed-priority serving rows whose embedded
+    FIFO-vs-priority evidence fails: interactive ttft_p99 must land at
+    <= SLO_INTERACTIVE_TTFT_CEILING x the FIFO run's, batch goodput
+    must hold >= SLO_BATCH_GOODPUT_FLOOR x FIFO, and scheduling must
+    be lossless: every request in both runs delivers its full token
+    budget (preemption re-queues and replays, never truncates).  Rows
+    without the keys are skipped."""
+    bad = []
+    for r in rows:
+        m = r.get("metrics") or {}
+        ti_p = m.get("interactive_ttft_p99_ms_priority")
+        ti_f = m.get("interactive_ttft_p99_ms_fifo")
+        gp_p = m.get("batch_goodput_tokens_per_s_priority")
+        gp_f = m.get("batch_goodput_tokens_per_s_fifo")
+        if ti_p is None or ti_f is None or gp_p is None or gp_f is None:
+            continue
+        if float(ti_p) > float(ti_f) * SLO_INTERACTIVE_TTFT_CEILING:
+            bad.append((r["metric"],
+                        f"interactive ttft_p99 {float(ti_p):.1f}ms is "
+                        f"not materially below FIFO's {float(ti_f):.1f}ms "
+                        f"(ceiling {SLO_INTERACTIVE_TTFT_CEILING:.2f}x) "
+                        f"— the scheduler degraded to FIFO"))
+        if float(gp_p) < float(gp_f) * SLO_BATCH_GOODPUT_FLOOR:
+            bad.append((r["metric"],
+                        f"batch goodput {float(gp_p):.1f} tok/s fell "
+                        f"below {SLO_BATCH_GOODPUT_FLOOR:.2f}x FIFO's "
+                        f"{float(gp_f):.1f} tok/s — preemption/replay "
+                        f"is cratering batch throughput"))
+        if m.get("scheduling_lossless") is False:
+            bad.append((r["metric"],
+                        "a request finished short of its token budget "
+                        "or errored — preemption/priority scheduling "
+                        "dropped work instead of re-queueing it"))
+    return bad
+
+
 def compare_pool_leaks(rows):
     """[(metric, leaked)] for paged serving rows whose KV page pool did
     not return to 0 allocated after the drain + prefix-cache drop
@@ -360,8 +410,9 @@ def suite_gate(tolerance, rows=None):
     bad_moe = compare_moe_active_ratio(rows)
     bad_zero = compare_zero_sharding(rows)
     bad_chat = compare_chat_ttft(rows)
+    bad_slo = compare_slo_scheduling(rows)
     if (bad or bad_ratio or bad_metrics or bad_leaks or bad_timing
-            or bad_errors or bad_moe or bad_zero or bad_chat):
+            or bad_errors or bad_moe or bad_zero or bad_chat or bad_slo):
         if bad:
             print(f"perf_gate[suite] FAIL: {len(bad)} configs regressed "
                   f">{tolerance:.0%}:")
@@ -391,6 +442,8 @@ def suite_gate(tolerance, rows=None):
                   f"{t1:.1f}ms (ceiling "
                   f"{CHAT_TTFT_RATIO_CEILING:.2f}x) — session resume "
                   f"degraded to re-prefilling the conversation")
+        for metric, reason in bad_slo:
+            print(f"perf_gate[suite] FAIL: {metric} {reason}")
         for metric, leaked in bad_leaks:
             print(f"perf_gate[suite] FAIL: {metric} leaked {leaked} KV "
                   f"pool pages (pages_in_use != 0 after drain + "

@@ -205,9 +205,63 @@ print('session drill: drain donation + pin migration under '
       'dispatch fault OK')
 """
 
+# Priority-inversion drain drill (PR 17).  A real (tiny, CPU) engine
+# behind a FleetRouter: a batch stream fills the page pool, an
+# interactive arrival preempts it mid-decode (pages released, request
+# re-queued), and the replica is drained WHILE the preempted stream
+# sits in the queue.  Drain must complete — a scheduler that refused to
+# re-admit the demoted request while draining would wedge the drain on
+# a priority inversion — the preempted stream must still produce its
+# full token count (re-queued work is never lost), and the pool must
+# read zero after the cache drop (preemption releases/donates pages,
+# never leaks them).  The one drill that compiles tick programs
+# (~tens of seconds): preempt-while-draining needs real ticks.
+_PRIORITY_DRILL = """
+import time
+import numpy as np
+from paddle_hackathon_tpu.models.gpt import GPTConfig, GPTForCausalLM
+from paddle_hackathon_tpu.inference.serving import ServingEngine
+from paddle_hackathon_tpu.inference.fleet import FleetRouter
+
+cfg = GPTConfig(vocab_size=128, hidden_size=64, num_layers=2,
+                num_heads=4, max_position_embeddings=128,
+                hidden_dropout_prob=0.0, attention_dropout_prob=0.0,
+                use_flash_attention=False)
+m = GPTForCausalLM(cfg); m.eval()
+# pool sized so the batch request's footprint (8 pages) fills the
+# usable pool: the interactive arrival (3 pages) can only admit by
+# preempting it
+eng = ServingEngine(m, max_slots=2, max_len=64, chunk=4,
+                    cache_mode="paged", page_size=8, num_pages=9)
+router = FleetRouter([eng])
+name = eng._engine_id
+rb = router.submit(np.arange(16, dtype=np.int32), 40, priority="batch")
+end = time.monotonic() + 120
+while not rb.tokens and time.monotonic() < end:
+    time.sleep(0.01)
+assert rb.tokens, "batch stream never started decoding"
+ri = router.submit(np.arange(8, dtype=np.int32) + 3, 8,
+                   priority="interactive")
+while int(eng._c["preemptions"].value) < 1 and time.monotonic() < end:
+    time.sleep(0.01)
+assert int(eng._c["preemptions"].value) >= 1, "no preemption fired"
+# drain WHILE the preempted batch stream sits re-queued: the drain
+# must re-admit and finish it, not wedge on the inversion
+router.drain(name, timeout=120)
+assert rb.done and rb.error is None, rb.error
+assert ri.done and ri.error is None, ri.error
+assert len(rb.tokens) == 40, (len(rb.tokens), "preempted work lost")
+assert len(ri.tokens) == 8
+eng.drop_prefix_cache()
+assert eng.kv_pages_in_use == 0, eng.kv_pages_in_use
+print('priority drill: preempt mid-decode + drain-under-inversion '
+      'completed, zero page leak OK')
+"""
+
 _DRILLS = [
     ("fleet-drill", "fleet.dispatch=fail@1", _FLEET_DRILL),
     ("session-drill", "fleet.dispatch=fail@1", _SESSION_DRILL),
+    ("priority-drill", "", _PRIORITY_DRILL),
 ]
 
 
