@@ -109,7 +109,9 @@ class CompiledTrainer:
     changed dp size for free.
     """
 
-    def __init__(self, model, seed=0, zero_stage=0, master_weights=False):
+    def __init__(self, model, seed=0, zero_stage=0, master_weights=False,
+                 zero_offload=False, grad_overlap=False,
+                 offload_depth=2):
         import warnings
 
         network, opt, loss = model.network, model._optimizer, model._loss
@@ -125,6 +127,8 @@ class CompiledTrainer:
         self._zero_jits = {}
         self._armed_prog = None
         self._n_data = 1
+        self._offload = None
+        self._offload_depth = int(offload_depth)
         step0 = jnp.asarray(opt._step_count, jnp.int32)
         opt_states = opt.functional_state(plist)
         if int(zero_stage or 0) >= 1:
@@ -157,13 +161,30 @@ class CompiledTrainer:
                 for t in self._param_tensors.values():
                     t._set_value(jax.device_put(t._value, repl))
                 step0 = jax.device_put(step0, repl)
-                from ..parallel.sharding import place_zero_state
-                opt_states = place_zero_state(
-                    si, [p._value for p in plist], opt_states)
+                if zero_offload:
+                    # moments (+ masters) live in host RAM; the update
+                    # streams shard-at-a-time (parallel.offload) — no
+                    # device placement of the optimizer state at all
+                    from ..parallel.offload import ZeroOffloadUpdater
+                    opt_states = ZeroOffloadUpdater.host_state_for_optimizer(
+                        opt, plist, si)
+                    self._offload = ZeroOffloadUpdater.for_optimizer(
+                        opt, plist, si, depth=self._offload_depth,
+                        site="hapi.zero_offload")
+                else:
+                    from ..parallel.sharding import place_zero_state
+                    opt_states = place_zero_state(
+                        si, [p._value for p in plist], opt_states)
         if self._zero is None and master_weights:
             warnings.warn(
                 "Model.fit(master_weights=True) only takes effect with "
                 "zero_stage>=1 on a mesh; ignored", RuntimeWarning,
+                stacklevel=3)
+        if self._zero is None and zero_offload:
+            warnings.warn(
+                "Model.fit(zero_offload=True) needs zero_stage>=1 on an "
+                "ambient mesh with a >1 data axis; optimizer state stays "
+                "device-resident for this fit", RuntimeWarning,
                 stacklevel=3)
 
         params = {k: p._value for k, p in network.named_parameters()}
@@ -174,7 +195,11 @@ class CompiledTrainer:
             "step": step0,
         }
         from ..parallel.sharding import observe_opt_state_bytes
-        observe_opt_state_bytes("hapi_compiled", opt_states)
+        if self._offload is not None:
+            observe_opt_state_bytes("hapi_compiled", [],
+                                    host_tree=opt_states)
+        else:
+            observe_opt_state_bytes("hapi_compiled", opt_states)
         self.ever_ran = False
         # MoE: thread the load-balance aux INTO the donated program's
         # loss (the PR 2 contract — no extra dispatches) and return it
@@ -224,9 +249,35 @@ class CompiledTrainer:
                 return jax.value_and_grad(
                     lambda pp: forward_loss(pp, xs, ys, step))(p)
 
+        if self._offload is not None:
+            # grads-only device program: forward + backward + the grad
+            # preamble (f32 cast / decay / clip — the exact code the
+            # resident ZeRO preamble runs, on the replicated grads), no
+            # update.  The update streams through the host pipe in
+            # ``run``'s per-step Python loop instead of a lax.scan.
+            mw = bool(master_weights)
+            has_moe = self._has_moe
+
+            def grads_step(p, step, batch):
+                xs, ys = batch
+                if has_moe:
+                    (total, aux), g = grads_of(p, xs, ys, step)
+                else:
+                    total, g = grads_of(p, xs, ys, step)
+                    aux = jnp.zeros((), jnp.float32)
+                vals = [p[k] for k in order]
+                gs = opt.preprocess_grads_offload(
+                    vals, [g[k] for k in order], master_weights=mw)
+                return total, aux, gs, step + 1
+
+            self._grads_step = grads_step
+            self._train_step = None
+            self._jit = None
+            return
         train_step = make_functional_train_step(opt, plist, order, grads_of,
                                                 scan_batch=True,
-                                                shard_info=self._zero)
+                                                shard_info=self._zero,
+                                                grad_overlap=grad_overlap)
         self._train_step = train_step
         # donate the ENTIRE train state: params + accumulators + step all
         # update in place on device; the live network's Tensors rebind to
@@ -294,6 +345,27 @@ class CompiledTrainer:
             bax = (bspec[0] if len(bspec) else None) \
                 if not key[2] else None
             repl = NamedSharding(mesh, P())
+            param_sh = jax.tree.map(lambda a: a.sharding,
+                                    self.state["params"])
+            if self._offload is not None:
+                # grads-only program over ONE step's batch slice (run's
+                # Python loop peels the K dim): nothing donated — params
+                # are reused by the streaming update right after
+                def leaf_sh1(l):
+                    nd = max(np.ndim(l) - 1, 0)
+                    spec = ((bax,) + (None,) * (nd - 1))[:nd]
+                    return NamedSharding(mesh, P(*spec))
+
+                bsh = jax.tree.unflatten(
+                    treedef, [leaf_sh1(l) for l in leaves])
+                fn = _obs.instrument_jit(
+                    jax.jit(self._grads_step,
+                            in_shardings=(param_sh, repl, bsh),
+                            out_shardings=repl),
+                    site="hapi.compiled_trainer")
+                self._zero_jits[key[:3]] = fn
+                self._armed_prog = fn
+                return fn
 
             def leaf_sh(l):
                 nd = np.ndim(l)
@@ -303,8 +375,6 @@ class CompiledTrainer:
                 return NamedSharding(mesh, P(*spec))
 
             bsh = jax.tree.unflatten(treedef, [leaf_sh(l) for l in leaves])
-            param_sh = jax.tree.map(lambda a: a.sharding,
-                                    self.state["params"])
             opt_sh = jax.tree.map(lambda a: a.sharding, self.state["opt"])
             fn = sanitize_donation(_obs.instrument_jit(
                 jax.jit(self._train_step, donate_argnums=(0, 1, 2),
@@ -341,6 +411,8 @@ class CompiledTrainer:
                     "CompiledTrainer.run: no program for this batch "
                     "structure — call ensure_program(xs, ys) first")
         lr = jnp.asarray(self._opt.get_lr(), jnp.float32)
+        if self._offload is not None:
+            return self._run_offload(fn, lr, xs, ys)
         p, s, t, losses = fn(self.state["params"], self.state["opt"],
                              self.state["step"], lr, (xs, ys))
         if self._has_moe:
@@ -349,6 +421,37 @@ class CompiledTrainer:
             losses, self.last_aux = losses
         self.state.update(params=p, opt=s, step=t)
         for k, v in p.items():
+            self._param_tensors[k]._set_value(v)
+        self.ever_ran = True
+        return losses
+
+    def _run_offload(self, fn, lr, xs, ys):
+        """The offload flavor of one superstep: a Python loop over the K
+        stacked batches — each iteration runs the grads-only device
+        program, then streams the sharded update through the host pipe
+        (``parallel.offload.ZeroOffloadUpdater``).  The host state list
+        is REBOUND to fresh arrays every step (never mutated), so a
+        checkpoint writer thread holding the previous step's arrays
+        stays consistent."""
+        k_steps = int(np.shape(jax.tree.leaves(xs)[0])[0])
+        params, hstate = self.state["params"], self.state["opt"]
+        step = self.state["step"]
+        losses, auxes = [], []
+        for k in range(k_steps):
+            bk = jax.tree.map(lambda a: a[k], (xs, ys))
+            total, aux, gs, step = fn(params, step, bk)
+            vals = [params[n] for n in self._order]
+            new_vals, hstate = self._offload.apply(vals, gs, hstate, lr,
+                                                   step)
+            params = dict(params)
+            params.update(zip(self._order, new_vals))
+            losses.append(total)
+            auxes.append(aux)
+        self.state.update(params=params, opt=hstate, step=step)
+        losses = jnp.stack(losses)
+        if self._has_moe:
+            self.last_aux = jnp.stack(auxes)
+        for k, v in params.items():
             self._param_tensors[k]._set_value(v)
         self.ever_ran = True
         return losses

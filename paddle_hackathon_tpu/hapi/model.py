@@ -149,7 +149,8 @@ class Model:
             drop_last=False, shuffle=True, num_workers=0, callbacks=None,
             accumulate_grad_batches=1, num_iters=None, jit_compile=None,
             steps_per_execution=1, prefetch_buffer=2, nan_policy="record",
-            checkpoint=None, zero_stage=0, master_weights=False):
+            checkpoint=None, zero_stage=0, master_weights=False,
+            zero_offload=False, grad_overlap=False):
         """Train loop.  ``jit_compile=None`` (default) tries the compiled
         fast path — one donated jitted program per step (see
         ``hapi/compiled.py``) — and falls back to the eager
@@ -197,7 +198,17 @@ class Model:
         sharded alongside the moments (params may then be bf16).
         Checkpoints flow through ``parallel/checkpointing.py``
         unchanged, so resume across a changed dp size re-shards the
-        ZeRO state automatically (docs/PARALLELISM.md)."""
+        ZeRO state automatically (docs/PARALLELISM.md).
+
+        ``zero_offload=True`` (with ``zero_stage>=1``) parks the
+        moments (+ f32 masters) in host RAM and streams the update
+        shard-at-a-time through a double-buffered h2d/d2h pipe —
+        opt-state HBM goes to ~0 for a stated tokens/s cost
+        (docs/PARALLELISM.md "Optimizer offload & overlap").
+        ``grad_overlap=True`` schedules each scanned microstep's grad
+        reduce-scatter as the grads materialize instead of relying on
+        sharding propagation alone — numerics match the fused path to
+        f32 reassociation."""
         train_loader = self._to_loader(train_data, batch_size, shuffle,
                                        drop_last, num_workers)
         eval_loader = (self._to_loader(eval_data, batch_size, False, False,
@@ -223,7 +234,9 @@ class Model:
             reason = unsupported_reason(self, accumulate_grad_batches)
             if reason is None:
                 trainer = CompiledTrainer(self, zero_stage=zero_stage,
-                                          master_weights=master_weights)
+                                          master_weights=master_weights,
+                                          zero_offload=zero_offload,
+                                          grad_overlap=grad_overlap)
             elif jit_compile:
                 raise ValueError(
                     f"jit_compile=True, but the compiled fit path is "

@@ -16,3 +16,4 @@ from .sampler import (BatchSampler, DistributedBatchSampler, RandomSampler,  # n
                       WeightedRandomSampler)
 from .dataloader import (DataLoader, default_collate_fn, device_prefetch,  # noqa: F401
                          get_worker_info)
+from .transfer import TransferRing, finish_d2h, start_d2h  # noqa: F401

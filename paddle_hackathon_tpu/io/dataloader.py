@@ -145,7 +145,6 @@ def device_prefetch(iterator, size=2, device=None):
     consumer outruns the producer, this distribution fattening is the
     input-starvation signal (docs/OBSERVABILITY.md).
     """
-    import collections
     import time as _time
 
     import jax
@@ -168,25 +167,28 @@ def device_prefetch(iterator, size=2, device=None):
                             is_leaf=lambda t: isinstance(t, Tensor))
 
     from ..observability import faults as _faults
+    from .transfer import TransferRing
 
     it = iter(iterator)
-    buf = collections.deque()
     size = max(int(size), 1)
+    # a buffer of ``size`` batches = ``size - 1`` still in flight after
+    # each yield (the ring pops the oldest once it is over depth)
+    ring = TransferRing(depth=size - 1)
     while True:
-        while len(buf) < size:
-            try:
-                # drill point for the crash harness: a dataloader dying
-                # (or stalling) mid-fit is a canonical training failure
-                _faults.point("io.prefetch")
-                t0 = _time.perf_counter()
-                nxt = next(it)
-                wait_hist.observe(_time.perf_counter() - t0)
-                buf.append(_put(nxt))
-            except StopIteration:
-                while buf:
-                    yield buf.popleft()
-                return
-        yield buf.popleft()
+        try:
+            # drill point for the crash harness: a dataloader dying
+            # (or stalling) mid-fit is a canonical training failure
+            _faults.point("io.prefetch")
+            t0 = _time.perf_counter()
+            nxt = next(it)
+            wait_hist.observe(_time.perf_counter() - t0)
+        except StopIteration:
+            for b in ring.drain():
+                yield b
+            return
+        ready = ring.push(_put(nxt))
+        if ready is not None:
+            yield ready
 
 
 class _PrefetchIter:

@@ -1,0 +1,84 @@
+"""Depth-bounded in-order async-transfer ring.
+
+The overlap pattern ``device_prefetch`` has always used — start a
+transfer, keep consuming only once ``depth`` more are in flight, pop in
+FIFO order — generalized so the dataloader's h2d staging and the
+ZeRO-offload optimizer pipe (``parallel.offload``) share one
+implementation instead of two copies of the same deque loop.
+
+The ring itself never touches device APIs: entries are opaque handles
+for *already started* work (a ``jax.device_put`` result, a
+``copy_to_host_async``'d array, a (key, arrays) tuple...).  ``push``
+returns the oldest entry once more than ``depth`` are outstanding —
+the caller then performs whatever blocking completion step the entry
+needs (``np.asarray``, feeding a jit, yielding a batch) while the
+younger transfers stream underneath.
+
+Donation safety: the ring holds a strong reference to every pushed
+entry until it is popped, so a buffer handed to an async copy cannot
+be garbage-collected (and its storage donated/reused by a jitted call)
+while the DMA is still in flight.
+"""
+
+from __future__ import annotations
+
+import collections
+
+import jax
+import numpy as np
+
+__all__ = ["TransferRing", "start_d2h", "finish_d2h"]
+
+
+class TransferRing:
+    """FIFO pipeline of in-flight transfers, at most ``depth`` deep.
+
+    ``depth=1`` is classic double-buffering (one transfer hides behind
+    one completion); ``depth=0`` degenerates to fully synchronous
+    (``push`` returns its own argument) so callers can expose the knob
+    without branching.
+    """
+
+    def __init__(self, depth: int = 1):
+        self._depth = max(int(depth), 0)
+        self._buf = collections.deque()
+
+    @property
+    def depth(self) -> int:
+        return self._depth
+
+    def __len__(self) -> int:
+        return len(self._buf)
+
+    def push(self, entry):
+        """Enqueue a started transfer; returns the oldest entry when the
+        ring is over depth (the caller completes it), else ``None``."""
+        self._buf.append(entry)
+        if len(self._buf) > self._depth:
+            return self._buf.popleft()
+        return None
+
+    def drain(self):
+        """Yield the remaining in-flight entries, oldest first."""
+        while self._buf:
+            yield self._buf.popleft()
+
+
+def start_d2h(tree):
+    """Kick off device→host copies for every ``jax.Array`` leaf (PJRT
+    ``copy_to_host_async``) without blocking; returns ``tree`` unchanged
+    so it can ride through a ``TransferRing``."""
+    for a in jax.tree.leaves(tree):
+        if isinstance(a, jax.Array):
+            try:
+                a.copy_to_host_async()
+            except (AttributeError, RuntimeError):
+                pass  # backend without async d2h: finish_d2h still works
+    return tree
+
+
+def finish_d2h(tree):
+    """Materialize a (previously ``start_d2h``'d) tree as host numpy —
+    the only blocking step of the d2h pipe."""
+    return jax.tree.map(
+        lambda a: np.asarray(a) if isinstance(a, jax.Array) else a, tree)

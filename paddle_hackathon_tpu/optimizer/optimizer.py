@@ -304,6 +304,42 @@ class Optimizer:
             for nv, v, ps in zip(new_vals, vals, pspecs)]
         return out_vals, out_states
 
+    def preprocess_grads_offload(self, vals, grads, master_weights=False):
+        """Grad preamble for the ZeRO-offload path — runs inside the
+        grads-only device program on the REPLICATED gradients, exactly
+        the code/order ``_sharded_update`` uses, so the streamed update
+        that follows stays bit-exact vs the resident ZeRO path for the
+        non-master case.
+
+        Under ``master_weights`` the resident path feeds the f32 masters
+        to the preamble; those live in host RAM here, so the cast of the
+        device param stands in: the f32-cast *selector* matches exactly
+        (cast-of-param is f32 whenever the master is), only the coupled
+        weight-decay term sees cast-of-param instead of the master —
+        identical until param and master diverge in the low bits, and a
+        non-issue for decoupled-decay optimizers (AdamW)."""
+        if master_weights:
+            vals = [v.astype(jnp.float32) for v in vals]
+        return self._preprocess_grads(vals, grads)
+
+    def _sharded_tensor_update(self, val, grad, state, lr, step_t,
+                               shard_info, param_lr=1.0):
+        """One tensor of the ZeRO update, for the offload streaming pipe:
+        ``grad`` is already preprocessed (``preprocess_grads_offload``),
+        so clip/decay are nulled and ``_sharded_update`` runs on
+        single-element lists — the identical per-tensor core the
+        resident path traces.  ``shard_info.param_specs`` must carry
+        exactly this tensor's spec.  Returns ``(new_val, new_state)``."""
+        saved_clip, saved_wd = self._grad_clip, self._weight_decay
+        self._grad_clip = None
+        self._weight_decay = None
+        try:
+            nvs, nss = self._sharded_update(
+                [val], [grad], [state], lr, step_t, (param_lr,), shard_info)
+        finally:
+            self._grad_clip, self._weight_decay = saved_clip, saved_wd
+        return nvs[0], nss[0]
+
     def _decoupled_weight_decay(self) -> bool:
         return False
 

@@ -331,7 +331,7 @@ def _make_pipeline_loss(mesh: Mesh, pp_spec: dict, pp_degree: int,
 
 def make_functional_train_step(optimizer, plist, order, grads_of,
                                merge_k: int = 1, scan_batch: bool = False,
-                               shard_info=None):
+                               shard_info=None, grad_overlap: bool = False):
     """Compose a loss-gradient function with the optimizer's pure
     ``Optimizer.functional_update`` into
 
@@ -357,7 +357,31 @@ def make_functional_train_step(optimizer, plist, order, grads_of,
       so the scanned program's scheduler overlaps step k+1's gathers
       with the tail of step k's update instead of serializing on one
       fused gather (``Optimizer.functional_update`` shard-aware path).
+    - ``grad_overlap`` (with ``shard_info``): pin every gradient to its
+      moment sharding the moment the backward produces it — per
+      microbatch inside the ``merge_k`` accumulation scan, and straight
+      after the backward in the per-step body — so each tensor's
+      reduce-scatter is an independent collective the XLA scheduler can
+      overlap with the remaining backward/accumulation compute, instead
+      of the whole grad set staying logically replicated until the
+      update's fused preamble.  The global-norm clip then runs on the
+      scattered shards (GSPMD cross-shard reductions — globally
+      correct, reassociated), so the loss series matches the fused path
+      to f32 reassociation tolerance rather than bit-exactly.
     """
+    if grad_overlap and shard_info is None:
+        grad_overlap = False  # nothing to scatter onto — inert
+
+    def _pin_to_moments(grads):
+        """Constraint-pin each ordered grad to its ZeRO moment sharding
+        (the explicit per-tensor reduce-scatter schedule)."""
+        pspecs = shard_info.param_specs or (None,) * len(order)
+        out = dict(grads)
+        for k, ps in zip(order, pspecs):
+            ms = shard_info.moment_spec(out[k].shape, existing=ps)
+            out[k] = jax.lax.with_sharding_constraint(
+                out[k], NamedSharding(shard_info.mesh, P(*ms)))
+        return out
 
     def one_step(params, opt_states, step, lr, xs, ys):
         if merge_k > 1:
@@ -368,11 +392,15 @@ def make_functional_train_step(optimizer, plist, order, grads_of,
             def body(carry, mb):
                 mx, my = mb
                 l, g = grads_of(params, mx, my, step)
+                if grad_overlap:
+                    g = _pin_to_moments(g)
                 acc_l, acc_g = carry
                 return (acc_l + l, jax.tree.map(jnp.add, acc_g, g)), None
 
             zero_g = jax.tree.map(
                 lambda a: jnp.zeros(a.shape, jnp.float32), params)
+            if grad_overlap:
+                zero_g = _pin_to_moments(zero_g)
             (loss_sum, grad_sum), _ = jax.lax.scan(
                 body, (jnp.zeros((), jnp.float32), zero_g),
                 (jax.tree.map(split, xs), jax.tree.map(split, ys)))
@@ -380,6 +408,8 @@ def make_functional_train_step(optimizer, plist, order, grads_of,
             grads = jax.tree.map(lambda g: g / merge_k, grad_sum)
         else:
             loss, grads = grads_of(params, xs, ys, step)
+            if grad_overlap:
+                grads = _pin_to_moments(grads)
         vals = [params[k] for k in order]
         gs = [grads[k] for k in order]
         new_vals, new_states = optimizer.functional_update(
@@ -421,7 +451,10 @@ def make_sharded_train_step(model: Layer, mesh: Mesh,
                             sp_mode: str = "auto",
                             optimizer: str = "adam",
                             optimizer_kwargs: Optional[dict] = None,
-                            master_weights: bool = False):
+                            master_weights: bool = False,
+                            zero_offload: bool = False,
+                            grad_overlap: bool = False,
+                            offload_depth: int = 2):
     """Build (step_fn, state) — one compiled SPMD program per step covering
     forward, backward, grad psum over dp, Adam update on (optionally
     'sharding'/'dp'-sharded) optimizer state.
@@ -455,6 +488,23 @@ def make_sharded_train_step(model: Layer, mesh: Mesh,
     The pp path requires the model to implement ``pipeline_stage_spec()``
     (see ``models/gpt.py``); ``pp_microbatches`` sets the microbatch count
     (default: the pp degree).
+
+    ``zero_offload=True`` (with an active ZeRO axis) keeps the moments
+    (+ f32 masters) in host RAM: the step splits into a grads-only
+    device program (forward + backward + the replicated global clip —
+    bit-identical preamble to the resident path) and a per-tensor
+    streamed update through ``parallel.offload.ZeroOffloadUpdater``
+    (h2d → the SAME per-tensor pinned update body → d2h, ``offload_depth``
+    tensors in flight).  Opt-state HBM ~0; update math bit-exact vs the
+    resident ZeRO step; tokens/s pays the stream (docs/PARALLELISM.md).
+
+    ``grad_overlap=True`` (with an active ZeRO axis; composes with
+    ``zero_offload``) pins every gradient to
+    its moment sharding IMMEDIATELY after the backward — per-tensor
+    reduce-scatters the scheduler can overlap with the remaining
+    backward — and computes the global clip norm on the scattered
+    shards (reassociated, series-tolerance vs the default
+    clip-then-scatter order which stays bit-exact vs replicated).
     """
     from ..nn.layer import functional_call
 
@@ -542,6 +592,16 @@ def make_sharded_train_step(model: Layer, mesh: Mesh,
             f"with no >1 'sharding'/'dp' axis ({dict(mesh.shape)}); "
             "optimizer state stays REPLICATED", RuntimeWarning,
             stacklevel=2)
+    offload_on = bool(zero_offload) and zero_on
+    if zero_offload and not zero_on:
+        import warnings
+        warnings.warn(
+            "make_sharded_train_step(zero_offload=True) needs an active "
+            "ZeRO axis (zero_stage>=1 on a mesh with a >1 'sharding'/'dp' "
+            "axis); optimizer state stays device-resident", RuntimeWarning,
+            stacklevel=2)
+    if grad_overlap and not zero_on:
+        grad_overlap = False  # nothing to scatter onto — inert
 
     def opt_state_spec(name, arr):
         if pp_degree > 1 and name.startswith(
@@ -581,8 +641,21 @@ def make_sharded_train_step(model: Layer, mesh: Mesh,
             st["master"] = jax.device_put(master_copy(v), m_sh[k])
         return st
 
-    opt_state = {k: _init_slots(k, v) for k, v in params.items()}
-    observe_opt_state_bytes("sharded_step", opt_state)
+    def _init_slots_host(k, v):
+        # offload: same slots, same zeros, same f32 master values — just
+        # parked in host RAM (the h2d stream scatters them to m_sh[k]
+        # while each tensor's update is in flight)
+        st = {s: np.zeros(v.shape, mdt) for s in slots}
+        if master_weights and jnp.issubdtype(v.dtype, jnp.floating):
+            st["master"] = np.asarray(v).astype(np.float32)
+        return st
+
+    if offload_on:
+        opt_state = {k: _init_slots_host(k, v) for k, v in params.items()}
+        observe_opt_state_bytes("sharded_step", {}, host_tree=opt_state)
+    else:
+        opt_state = {k: _init_slots(k, v) for k, v in params.items()}
+        observe_opt_state_bytes("sharded_step", opt_state)
     step_no = jnp.zeros((), jnp.int32)
 
     if pp_degree > 1:
@@ -671,10 +744,20 @@ def make_sharded_train_step(model: Layer, mesh: Mesh,
             from .recompute import jit_recompute
             pure_loss = jit_recompute(pure_loss, policy=recompute_policy)
         loss, grads = jax.value_and_grad(pure_loss)(params)
+        if zero_on and grad_overlap:
+            # overlap schedule: pin every grad to its moment sharding
+            # the moment the backward produces it — per-tensor
+            # reduce-scatters with no dependence on the clip scalar, so
+            # the scheduler interleaves them with the remaining backward
+            # compute; the clip norm below then reduces over the
+            # SCATTERED shards (reassociated — series tolerance vs the
+            # default order, which clips first and stays bit-exact)
+            grads = {k: jax.lax.with_sharding_constraint(g, m_sh[k])
+                     for k, g in grads.items()}
         if grad_clip_norm is not None:
-            # the global clip norm is computed BEFORE the ZeRO grad pins
-            # (on the replicated grads) so sharded-vs-replicated runs
-            # clip by the bit-identical scale
+            # without grad_overlap the global clip norm is computed
+            # BEFORE the ZeRO grad pins (on the replicated grads) so
+            # sharded-vs-replicated runs clip by the bit-identical scale
             gnorm = jnp.sqrt(sum(
                 jnp.sum(jnp.square(g.astype(jnp.float32)))
                 for g in jax.tree.leaves(grads)))
@@ -724,7 +807,10 @@ def make_sharded_train_step(model: Layer, mesh: Mesh,
         # (batch, seq): seq dim additionally sharded over 'sp'
         bspec = P(bspec[0] if len(bspec) else None, "sp")
     param_sh = jax.tree.map(lambda a: a.sharding, params)
-    opt_sh = jax.tree.map(lambda a: a.sharding, opt_state)
+    # offload: the opt state is host numpy — it has no device shardings
+    # and never enters the device program
+    opt_sh = None if offload_on else jax.tree.map(
+        lambda a: a.sharding, opt_state)
     scalar_sh = NamedSharding(mesh, P())
 
     def _make_jitted(batch_sh):
@@ -744,8 +830,8 @@ def make_sharded_train_step(model: Layer, mesh: Mesh,
         ), site="parallel.sharded_train_step"),
             donate_argnums=(0, 1, 2), site="parallel.sharded_train_step")
 
-    jitted = _make_jitted((NamedSharding(mesh, bspec),
-                           NamedSharding(mesh, bspec)))
+    jitted = None if offload_on else _make_jitted(
+        (NamedSharding(mesh, bspec), NamedSharding(mesh, bspec)))
 
     # Batch elements may be pytrees (e.g. (ids, masked_positions) feeding a
     # custom loss_fn — the reference's pretraining-heads contract passes the
@@ -811,6 +897,116 @@ def make_sharded_train_step(model: Layer, mesh: Mesh,
                 rel = k[len(prefix) + len("$stacked."):]
                 for i in range(pp_spec["num_layers"]):
                     param_tensors[f"{prefix}{i}.{rel}"]._set_value(v[i])
+
+    if offload_on:
+        from .offload import ZeroOffloadUpdater
+        key_order = list(params)
+
+        def grads_step(params_, step_no_, batch, rng, lr):
+            def pure_loss(p):
+                return loss_fn(model, p, buffers, batch, rng)
+
+            if recompute:
+                from .recompute import jit_recompute
+                pure_loss = jit_recompute(pure_loss,
+                                          policy=recompute_policy)
+            loss, grads = jax.value_and_grad(pure_loss)(params_)
+            if grad_overlap:
+                # same overlap schedule as the resident step: per-tensor
+                # scatter pins before the clip (series tolerance)
+                grads = {k: jax.lax.with_sharding_constraint(g, m_sh[k])
+                         for k, g in grads.items()}
+            if grad_clip_norm is not None:
+                # replicated-grads global clip — the bit-identical
+                # preamble of the resident (non-overlap) ZeRO step
+                gnorm = jnp.sqrt(sum(
+                    jnp.sum(jnp.square(g.astype(jnp.float32)))
+                    for g in jax.tree.leaves(grads)))
+                scale = grad_clip_norm / jnp.maximum(gnorm,
+                                                     grad_clip_norm)
+                grads = jax.tree.map(
+                    lambda g: g * scale.astype(g.dtype), grads)
+            return loss, grads, step_no_ + 1
+
+        def _offload_tensor_update(i, p, g, st, lr, t):
+            # the EXACT per-tensor body of the resident train_step's
+            # update loop — bit-exact offload is this sharing
+            k = key_order[i]
+            st = dict(st)
+            master = st.pop("master", None)
+            msh = m_sh[k]
+
+            def wsc(a, _m=msh):
+                return jax.lax.with_sharding_constraint(a, _m)
+
+            g = wsc(g)
+            st = {s: wsc(v) for s, v in st.items()}
+            p_upd = wsc(master) if master is not None else wsc(p)
+            new_v, new_st = _apply_update(k, p_upd, g, st, lr, t)
+            new_st = {s: wsc(v) for s, v in new_st.items()}
+            if master is not None:
+                new_st["master"] = wsc(new_v)
+            nv = jax.lax.with_sharding_constraint(
+                new_v.astype(p.dtype), param_shardings[k])
+            return nv, new_st
+
+        updater = ZeroOffloadUpdater(
+            _offload_tensor_update, [m_sh[k] for k in key_order],
+            depth=offload_depth, site="parallel.zero_offload")
+
+        def _make_grads_jitted(batch_sh):
+            return _obs.instrument_jit(jax.jit(
+                grads_step,
+                in_shardings=(param_sh, scalar_sh, batch_sh, None, None),
+                out_shardings=(scalar_sh, param_sh, scalar_sh)),
+                site="parallel.sharded_train_step")
+
+        grads_jitted = _make_grads_jitted(
+            (NamedSharding(mesh, bspec), NamedSharding(mesh, bspec)))
+        _grads_cache = {}
+
+        def _get_grads_jitted(batch):
+            leaves, treedef = jax.tree.flatten(batch)
+            key = (treedef, tuple(l.ndim for l in leaves))
+            if key not in _grads_cache:
+                bsh = jax.tree.unflatten(treedef, [
+                    NamedSharding(mesh, P(*tuple(bspec)[:l.ndim]))
+                    for l in leaves])
+                _grads_cache[key] = _make_grads_jitted(bsh)
+            return _grads_cache[key]
+
+        def step(state, ids, labels, rng, lr=None):  # noqa: F811
+            if sp_degree > 1:
+                for leaf in jax.tree.leaves((ids, labels)):
+                    if getattr(leaf, "ndim", 0) >= 2 and \
+                            leaf.shape[1] % sp_degree:
+                        raise ValueError(
+                            f"sequence length {leaf.shape[1]} must "
+                            f"divide evenly over the 'sp' axis "
+                            f"(degree {sp_degree})")
+            lr_now = jnp.float32(learning_rate if lr is None else lr)
+            fn = grads_jitted if (hasattr(ids, "ndim")
+                                  and hasattr(labels, "ndim")) \
+                else _get_grads_jitted((ids, labels))
+            with _set_mesh(mesh):
+                loss, grads, t = fn(state["params"], state["step"],
+                                    (ids, labels), rng, lr_now)
+            vals = [state["params"][k] for k in key_order]
+            gs = [grads[k] for k in key_order]
+            hst = [state["opt_state"][k] for k in key_order]
+            new_vals, new_hst = updater.apply(vals, gs, hst, lr_now, t)
+            new_params = dict(zip(key_order, new_vals))
+            for k, v in new_params.items():
+                tn = param_tensors.get(k)
+                if tn is not None:
+                    tn._set_value(v)
+            return ({"params": new_params,
+                     "opt_state": dict(zip(key_order, new_hst)),
+                     "step": t}, loss)
+
+        step._jitted = grads_jitted._jit_fn
+        step.sync_model = sync_model
+        return step, state
 
     # exposed for AOT lowering / HLO inspection (the RAW jit function —
     # the instrumentation wrapper has no .lower)

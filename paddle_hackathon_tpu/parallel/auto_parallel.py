@@ -215,7 +215,16 @@ class Strategy:
     tensor (stage 2 is the same one-program lowering — grads only ever
     materialize scattered; stage 3 additionally shards params).
     ``master_weights=True`` keeps f32 master copies sharded alongside
-    the moments (useful with amp/bf16 params)."""
+    the moments (useful with amp/bf16 params).
+
+    ``zero_offload=True`` (with ``sharding_stage>=1`` on a mesh with a
+    data axis) parks the moments (+ masters) in host RAM and streams
+    the update per tensor through the h2d/d2h pipe
+    (``parallel.offload``) — opt-state HBM ~0, bit-exact update, a
+    stated tokens/s cost.  ``grad_overlap=True`` pins each (micro)batch
+    gradient to its moment sharding as the backward produces it —
+    explicit per-tensor reduce-scatters the scheduler overlaps with
+    remaining compute (series-tolerance vs the fused order)."""
     amp: bool = False
     amp_dtype: str = "bfloat16"
     sharding: bool = False
@@ -224,6 +233,8 @@ class Strategy:
     gradient_merge_k: int = 1
     seed: int = 0
     master_weights: bool = False
+    zero_offload: bool = False
+    grad_overlap: bool = False
 
 
 class Engine:
@@ -299,6 +310,7 @@ class Engine:
         opt = self.optimizer
         opt_states = None
         self._zero_info = None
+        self._offload = None
         if opt is not None:
             plist = opt._parameter_list
             opt_states = opt.functional_state(plist)
@@ -334,17 +346,39 @@ class Engine:
                     master_weights=bool(self.strategy.master_weights)
                 ).with_param_specs([_pspec(p) for p in plist])
                 self._zero_info = si
-                # moments extend the param's OWN spec (TP dims kept) so
-                # the placement agrees with the in-program pins — a
-                # mismatch would force a reshard at program entry
-                from .sharding import place_zero_state
-                opt_states = place_zero_state(
-                    si, [p._value for p in plist], opt_states)
+                if self.strategy.zero_offload:
+                    # ZeRO-offload: moments (+ masters) live in pinned
+                    # host numpy; the composite train step streams the
+                    # update shard-at-a-time through the h2d/d2h pipe
+                    from .offload import ZeroOffloadUpdater
+                    opt_states = ZeroOffloadUpdater.host_state_for_optimizer(
+                        opt, plist, si)
+                    self._offload = ZeroOffloadUpdater.for_optimizer(
+                        opt, plist, si, site="engine.zero_offload")
+                else:
+                    # moments extend the param's OWN spec (TP dims kept)
+                    # so the placement agrees with the in-program pins —
+                    # a mismatch would force a reshard at program entry
+                    from .sharding import place_zero_state
+                    opt_states = place_zero_state(
+                        si, [p._value for p in plist], opt_states)
             else:
                 opt_states = [{k: jax.device_put(v, repl)
                                for k, v in st.items()} for st in opt_states]
+            if self.strategy.zero_offload and self._offload is None:
+                # asked to park state in host RAM but ZeRO is inert —
+                # state stays device-resident; never silent
+                import warnings
+                warnings.warn(
+                    "Strategy(zero_offload=True) needs sharding_stage>=1 "
+                    "on a mesh with a >1 'sharding' or 'dp' axis; "
+                    "optimizer state stays device-resident for this run",
+                    RuntimeWarning, stacklevel=3)
             from .sharding import observe_opt_state_bytes
-            observe_opt_state_bytes("engine", opt_states)
+            if self._offload is not None:
+                observe_opt_state_bytes("engine", [], host_tree=opt_states)
+            else:
+                observe_opt_state_bytes("engine", opt_states)
         self._buffers = buffers
         # step replicated ONTO the mesh (not default-device): checkpoint
         # resume places arrays with these shardings, and a single-device
@@ -391,6 +425,86 @@ class Engine:
             return jax.value_and_grad(
                 lambda p: forward_loss(p, x, y, step))(params)
 
+        state = self._state
+        param_sh = jax.tree.map(lambda a: a.sharding, state["params"])
+        bsh = self._batch_sharding()
+        mesh = self.mesh
+
+        if getattr(self, "_offload", None) is not None:
+            # ZeRO-offload: the device program ends at preprocessed
+            # grads (global clip + coupled decay on replicated grads —
+            # IDENTICAL preamble to the resident update, so the
+            # per-tensor core stays bit-exact); the moments never enter
+            # it.  The streaming update runs per tensor through the
+            # h2d/d2h pipe (parallel.offload).
+            si = self._zero_info
+            mw = bool(si.master_weights)
+            go = bool(self.strategy.grad_overlap)
+
+            def _pin(g):
+                pspecs = si.param_specs or (None,) * len(order)
+                out = dict(g)
+                for k, ps in zip(order, pspecs):
+                    ms = si.moment_spec(out[k].shape, existing=ps)
+                    out[k] = jax.lax.with_sharding_constraint(
+                        out[k], NamedSharding(mesh, P(*ms)))
+                return out
+
+            def grads_step(params, step, batch):
+                xs, ys = batch
+                if merge_k > 1:
+                    def split(a):
+                        return a.reshape((merge_k, a.shape[0] // merge_k)
+                                         + a.shape[1:])
+
+                    def body(carry, mb):
+                        mx, my = mb
+                        l, g = grads_of(params, mx, my, step)
+                        if go:
+                            g = _pin(g)
+                        acc_l, acc_g = carry
+                        return (acc_l + l,
+                                jax.tree.map(jnp.add, acc_g, g)), None
+
+                    zero_g = jax.tree.map(
+                        lambda a: jnp.zeros(a.shape, jnp.float32), params)
+                    if go:
+                        zero_g = _pin(zero_g)
+                    (loss_sum, grad_sum), _ = jax.lax.scan(
+                        body, (jnp.zeros((), jnp.float32), zero_g),
+                        (jax.tree.map(split, xs), jax.tree.map(split, ys)))
+                    loss = loss_sum / merge_k
+                    grads = jax.tree.map(lambda g: g / merge_k, grad_sum)
+                else:
+                    loss, grads = grads_of(params, xs, ys, step)
+                    if go:
+                        grads = _pin(grads)
+                vals = [params[k] for k in order]
+                gs = opt.preprocess_grads_offload(
+                    vals, [grads[k] for k in order], master_weights=mw)
+                return loss, gs, step + 1
+
+            repl = NamedSharding(mesh, P())
+            from ..observability import metrics as _obs
+            gjit = _obs.instrument_jit(jax.jit(
+                grads_step,
+                in_shardings=(param_sh, repl, (bsh, bsh)),
+                out_shardings=repl),
+                site="parallel.engine_train_step")
+            updater = self._offload
+
+            def step_fn(params, opt_states, step, lr, batch):
+                loss, gs, t = gjit(params, step, batch)
+                vals = [params[k] for k in order]
+                new_vals, new_states = updater.apply(
+                    vals, gs, opt_states, lr, t)
+                new_params = dict(params)
+                new_params.update(zip(order, new_vals))
+                return new_params, new_states, t, loss
+
+            step_fn._jit_fn = gjit._jit_fn
+            return step_fn
+
         # gradient_merge (ref gradient_merge_optimizer.py) is composed by
         # the shared builder: split into k micro-batches, average grads,
         # single functional optimizer update; Strategy.sharding_stage>=1
@@ -401,12 +515,10 @@ class Engine:
         from .api import make_functional_train_step
         train_step = make_functional_train_step(
             opt, plist, order, grads_of, merge_k=merge_k,
-            shard_info=getattr(self, "_zero_info", None))
+            shard_info=getattr(self, "_zero_info", None),
+            grad_overlap=bool(self.strategy.grad_overlap))
 
-        state = self._state
-        param_sh = jax.tree.map(lambda a: a.sharding, state["params"])
         opt_sh = jax.tree.map(lambda a: a.sharding, state["opt_states"])
-        bsh = self._batch_sharding()
         # Donate only optimizer state: the param buffers are still referenced
         # by the live model's Parameters (same invariant as Optimizer.step,
         # optimizer.py — donating them would invalidate the model mid-fit).

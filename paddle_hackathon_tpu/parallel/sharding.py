@@ -157,28 +157,42 @@ def state_bytes(tree):
     return logical, per_dev
 
 
-def observe_opt_state_bytes(path: str, tree) -> int:
-    """Set ``train_opt_state_bytes{path,sharded}`` at trainer build
+def observe_opt_state_bytes(path: str, tree, host_tree=None) -> int:
+    """Set ``train_opt_state_bytes{path,sharded}`` and
+    ``train_opt_state_bytes{path,placement}`` at trainer build
     (docs/OBSERVABILITY.md) — sharding metadata only, no transfer.
 
     ``sharded="false"`` carries what a REPLICATED placement holds per
     device (the state's logical bytes); ``sharded="true"`` carries the
     ACTUAL placed per-device bytes — equal to the replicated value when
     ZeRO is off, so the true/false ratio IS the measured shrink (~1/dp
-    under ZeRO, 1.0 otherwise).  BOTH children are written on every
-    build: a non-sharded rebuild on the same path must overwrite a
-    previous ZeRO build's value, never leave a stale shrink exported.
-    Returns the per-device bytes."""
+    under ZeRO, 1.0 otherwise).  ``placement="device"`` is the placed
+    per-device bytes again and ``placement="host"`` the numpy bytes of
+    ``host_tree`` (the ZeRO-offload state) — together they export the
+    offload HBM win AND its host-RAM cost honestly.  ALL children are
+    written on every build: a non-sharded (or non-offloaded) rebuild on
+    the same path must overwrite a previous build's values, never leave
+    a stale shrink/offload exported.  Returns the per-device bytes."""
     from ..observability import metrics as _obs
     logical, per_dev = state_bytes(tree)
+    host = 0 if host_tree is None else sum(
+        int(a.nbytes) for a in jax.tree.leaves(host_tree)
+        if isinstance(a, np.ndarray))
+    # the replicated-footprint baseline must count the offloaded slots
+    # too (they ARE optimizer state a resident build would hold in HBM)
+    logical += host
     fam = _obs.get_registry().gauge(
         "train_opt_state_bytes",
         "optimizer-state bytes per device at trainer build (placement "
         "metadata, no transfer): sharded=false = the replicated "
         "footprint, sharded=true = the actual placed footprint; their "
-        "ratio is the ZeRO shrink (~1/dp; 1.0 when not sharded)")
+        "ratio is the ZeRO shrink (~1/dp; 1.0 when not sharded); "
+        "placement=device|host split the placed bytes by residency "
+        "(host > 0 only under ZeRO-offload)")
     fam.labels(path=path, sharded="false").set(logical)
     fam.labels(path=path, sharded="true").set(per_dev)
+    fam.labels(path=path, placement="device").set(per_dev)
+    fam.labels(path=path, placement="host").set(host)
     return per_dev
 
 
@@ -197,6 +211,20 @@ def group_sharded_parallel(model: Layer, optimizer, level: str = "os_g",
     """
     if level not in _LEVELS:
         raise ValueError(f"level must be one of {_LEVELS}, got {level!r}")
+    if offload:
+        # the reference's offload=True parks moments+masters in host RAM
+        # inside this eager wrapper; here host offload is a property of
+        # the compiled train step (the streaming pipe in
+        # ``parallel.offload``), not of eager placement — say so instead
+        # of silently accepting the flag
+        import warnings
+        warnings.warn(
+            "group_sharded_parallel(offload=True): eager offload is not "
+            "supported — use zero_offload=True on Model.fit / "
+            "Strategy(zero_offload=True) / make_sharded_train_step "
+            "(docs/PARALLELISM.md 'Optimizer offload & overlap'); "
+            "continuing with device-resident sharded state",
+            stacklevel=2)
     mesh = _mesh_api.get_mesh()
     if mesh is None or mesh.shape.get("sharding", 1) <= 1:
         return model, optimizer, scaler  # degenerate: nothing to shard over

@@ -88,6 +88,11 @@ _FILE_COST = {
                             # slow-marked
     "test_zero_sharded.py": 6,    # spec/update units + 2 tiny jits;
                                   # fit/Engine drills are slow-marked
+    "test_zero_offload.py": 8,    # ring units free; 2-step offload +
+                                  # resident sharded builds, 2 overlap
+                                  # lowerings + 1 compile, 3 tiny-GPT
+                                  # pp-zero constructions; series/fit/
+                                  # Engine/superstep drills slow-marked
     "test_crash_drill.py": 1,     # fully slow-marked (subprocess drills)
     "test_sanitizers.py": 5,  # lock/guard/race units + one thread-only
                               # dataloader epoch; engine runs slow-marked

@@ -63,7 +63,7 @@ class _DS(io.Dataset):
 
 
 def _fit(zero_stage=0, k=4, master=False, dp=4, epochs=1, seed=7,
-         checkpoint=None, num_iters=None, log_freq=4):
+         checkpoint=None, num_iters=None, log_freq=4, zero_offload=False):
     parallel.create_mesh({"dp": dp}, devices=jax.devices()[:dp])
     np.random.seed(0)
     net = _mlp(seed)
@@ -80,7 +80,8 @@ def _fit(zero_stage=0, k=4, master=False, dp=4, epochs=1, seed=7,
     m.fit(_DS(), epochs=epochs, batch_size=8, verbose=0, shuffle=False,
           jit_compile=True, steps_per_execution=k, log_freq=log_freq,
           callbacks=[Rec()], zero_stage=zero_stage, master_weights=master,
-          checkpoint=checkpoint, num_iters=num_iters)
+          checkpoint=checkpoint, num_iters=num_iters,
+          zero_offload=zero_offload)
     assert m._fit_used_compiled
     return losses, m
 
@@ -209,8 +210,14 @@ def test_sharded_step_state_bytes_and_gauge():
     from paddle_hackathon_tpu.observability import get_registry
     fam = get_registry().get("train_opt_state_bytes")
     vals = {dict(c.labels)["sharded"]: c.value for c in fam.children()
-            if dict(c.labels).get("path") == "sharded_step"}
+            if dict(c.labels).get("path") == "sharded_step"
+            and "sharded" in dict(c.labels)}
     assert vals["false"] == logical and vals["true"] == per_dev
+    # the placement split (PR 18): everything device-resident here
+    pl = {dict(c.labels)["placement"]: c.value for c in fam.children()
+          if dict(c.labels).get("path") == "sharded_step"
+          and "placement" in dict(c.labels)}
+    assert pl["device"] == per_dev and pl["host"] == 0
 
 
 def test_compiled_trainer_zero_state_flows_through_checkpoint_flat():
@@ -508,6 +515,50 @@ def test_zero_checkpoint_resumes_across_changed_dp(tmp_path):
     l_resumed, _ = _fit(zero_stage=1, dp=2, checkpoint=str(ckdir),
                         num_iters=8, k=2, log_freq=2)
     l_full, _ = _fit(zero_stage=1, dp=2, num_iters=8, k=2, log_freq=2)
+    assert len(l_resumed) == 4  # steps 4..7 only; 0..3 fast-forwarded
+    np.testing.assert_allclose(l_resumed, l_full[4:], rtol=1e-4)
+
+
+@pytest.mark.slow
+def test_zero_offload_checkpoint_resumes_across_changed_dp(tmp_path):
+    """The PR 11 crash-drill shape on OFFLOADED ZeRO state: a dp=4
+    `Model.fit(zero_stage=1, zero_offload=True)` checkpoints its host
+    numpy moments through the UNCHANGED flat namespace
+    (`opt::i::slot`); a dp=2 offloaded trainer resumes from it —
+    `restore_like` keeps numpy likes on the host (bitwise the
+    checkpointed bytes, no device placement), and the continued series
+    tracks an uninterrupted dp=2 offloaded run."""
+    ckdir = tmp_path / "zoffck"
+    l_head, _ = _fit(zero_stage=1, zero_offload=True, dp=4,
+                     checkpoint=str(ckdir), num_iters=4, k=2, log_freq=2)
+    from paddle_hackathon_tpu.parallel.checkpointing import load_latest
+    flat_host, manifest = load_latest(str(ckdir))
+    assert manifest["step"] == 4 and "opt::0::moment1" in flat_host
+
+    # resume on dp=2: the offloaded trainer's checkpoint template offers
+    # numpy likes, so restore_like must hand back HOST numpy bitwise
+    parallel.create_mesh({"dp": 2}, devices=jax.devices()[:2])
+    net = _mlp(7)
+    m = hapi.Model(net)
+    m.prepare(optimizer=optim.Adam(learning_rate=1e-2,
+                                   parameters=net.parameters()),
+              loss=nn.CrossEntropyLoss())
+    from paddle_hackathon_tpu.hapi.compiled import CompiledTrainer
+    tr = CompiledTrainer(m, zero_stage=1, zero_offload=True)
+    flat = tr.checkpoint_flat()
+    assert isinstance(flat["opt::0::moment1"], np.ndarray)
+    from paddle_hackathon_tpu.parallel.checkpointing import restore_like
+    placed, _ = restore_like(str(ckdir), flat)
+    mom = placed["opt::0::moment1"]
+    assert isinstance(mom, np.ndarray) and not isinstance(mom, jax.Array)
+    np.testing.assert_array_equal(mom, flat_host["opt::0::moment1"])
+
+    # ...and the resumed offloaded fit continues the series
+    l_resumed, _ = _fit(zero_stage=1, zero_offload=True, dp=2,
+                        checkpoint=str(ckdir), num_iters=8, k=2,
+                        log_freq=2)
+    l_full, _ = _fit(zero_stage=1, zero_offload=True, dp=2, num_iters=8,
+                     k=2, log_freq=2)
     assert len(l_resumed) == 4  # steps 4..7 only; 0..3 fast-forwarded
     np.testing.assert_allclose(l_resumed, l_full[4:], rtol=1e-4)
 

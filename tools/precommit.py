@@ -258,10 +258,94 @@ print('priority drill: preempt mid-decode + drain-under-inversion '
       'completed, zero page leak OK')
 """
 
+# ZeRO x pp composition smoke (PR 18).  zero_stage>=1 must compose
+# with the pipeline trainer: moments dp-sharded WITHIN each stage (or
+# host numpy under zero_offload), and the composed flat namespace must
+# dp-reshard through restore_like.  On jax>=0.6 (partial-manual
+# shard_map available) the drill also runs one composed superstep
+# under the donation sanitizer; on this container's jax<0.6 the
+# superstep path is structurally gated (same gate as the pp test
+# files), so the drill exercises construction, placement, and the
+# dp2->dp4 reshard-resume instead — the pieces that run everywhere.
+_ZERO_PP_SMOKE = """
+import os
+import tempfile
+# the pp2 x dp2 mesh needs the virtual 8-device CPU topology the test
+# conftest arranges; this subprocess must arrange it before jax imports
+_flags = os.environ.get('XLA_FLAGS', '')
+if 'xla_force_host_platform_device_count' not in _flags:
+    os.environ['XLA_FLAGS'] = (
+        _flags + ' --xla_force_host_platform_device_count=8').strip()
+import jax
+import numpy as np
+import paddle_hackathon_tpu as paddle
+from paddle_hackathon_tpu import parallel
+from paddle_hackathon_tpu.models import (GPTConfig, GPTForCausalLM,
+                                         param_sharding_spec)
+from paddle_hackathon_tpu.observability import sanitizers
+from paddle_hackathon_tpu.parallel.checkpointing import (
+    CheckpointManager, flatten_train_state, restore_like)
+
+def build(mesh_dims, **kw):
+    n = int(np.prod(list(mesh_dims.values())))
+    mesh = parallel.create_mesh(mesh_dims, devices=jax.devices()[:n])
+    paddle.seed(123)
+    model = GPTForCausalLM(GPTConfig(
+        vocab_size=64, hidden_size=16, num_layers=4, num_heads=2,
+        intermediate_size=32, max_position_embeddings=32,
+        hidden_dropout_prob=0.0, attention_dropout_prob=0.0,
+        use_flash_attention=False))
+    step, state = parallel.make_sharded_train_step(
+        model, mesh, rule=param_sharding_spec, learning_rate=1e-3,
+        zero_stage=1, grad_clip_norm=None, **kw)
+    return step, state
+
+k = 'gpt.blocks.$stacked.attn.qkv_proj.weight'
+with sanitizers.donation_sanitizer():
+    step, state = build({'pp': 2, 'dp': 2})
+    mom = state['opt_state'][k]['m']
+    spec = tuple(mom.sharding.spec)
+    axes = [a for s in spec if s is not None
+            for a in (s if isinstance(s, tuple) else (s,))]
+    assert spec[0] == 'pp' and 'dp' in axes, spec
+    if hasattr(jax, 'set_mesh'):
+        r = np.random.RandomState(0)
+        ids = np.asarray(r.randint(0, 64, (8, 16)))
+        labels = np.asarray(r.randint(0, 64, (8, 16)))
+        state, loss = step(state, ids, labels, jax.random.key(0))
+        assert np.isfinite(float(loss)), loss
+        mode = 'superstep loss %.4f' % float(loss)
+    else:
+        _, st_off = build({'pp': 2, 'dp': 2}, zero_offload=True)
+        assert isinstance(st_off['opt_state'][k]['m'], np.ndarray)
+        key_order = list(state['params'])
+        flat = flatten_train_state(
+            state['params'],
+            [state['opt_state'][q] for q in key_order], state['step'])
+        with tempfile.TemporaryDirectory() as d:
+            mgr = CheckpointManager(d, async_save=False)
+            mgr.save(flat, step=0, block=True)
+            mgr.close()
+            _, state2 = build({'pp': 2, 'dp': 4})
+            flat2 = flatten_train_state(
+                state2['params'],
+                [state2['opt_state'][q] for q in key_order],
+                state2['step'])
+            placed, _ = restore_like(d, flat2)
+        i = key_order.index(k)
+        np.testing.assert_array_equal(
+            np.asarray(placed['opt::%d::m' % i]),
+            np.asarray(flat['opt::%d::m' % i]))
+        mode = 'placement + dp2->dp4 reshard (superstep gated)'
+print('zero-pp smoke: composed state sharded pp x dp, ' + mode
+      + ', donation-sanitizer clean OK')
+"""
+
 _DRILLS = [
     ("fleet-drill", "fleet.dispatch=fail@1", _FLEET_DRILL),
     ("session-drill", "fleet.dispatch=fail@1", _SESSION_DRILL),
     ("priority-drill", "", _PRIORITY_DRILL),
+    ("zero-pp-smoke", "", _ZERO_PP_SMOKE),
 ]
 
 
