@@ -1215,6 +1215,96 @@ def bench_serving_slo(
     return row
 
 
+def bench_serving_fleet(
+        streams=8, prompt=32, new_tokens=32, chunk=16,
+        metric="gpt2tiny_serving_fleet_2replica_host_tokens_per_sec"):
+    """Fleet-tier serving with the observability plane ARMED (PR 19):
+    two small engines behind a FleetRouter, tracing + span sink live
+    for the whole measured phase.  The row is telemetry evidence, not
+    a throughput flagship — a deliberately tiny model keeps the two
+    replicas' compiles cheap, and HOST wall time is the honest clock
+    for a row whose work spans two engines' background loops (the
+    metric name carries no "device", so compare_timing_fallbacks never
+    mistakes it for a degraded device row).
+
+    Embeds what tools/perf_gate.py gates (``compare_fleet_telemetry``):
+    ``jit_builds_warm == jit_builds_total`` summed over BOTH replicas —
+    armed tracing/federation must add ZERO program builds (spans,
+    trace-context plumbing and metric labels are host-side only) — plus
+    the router's own dispatch percentiles and retry rate as the
+    fleet-health record."""
+    import paddle_hackathon_tpu as paddle
+    from paddle_hackathon_tpu.inference.fleet import FleetRouter
+    from paddle_hackathon_tpu.inference.serving import ServingEngine
+    from paddle_hackathon_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    from paddle_hackathon_tpu.observability import get_registry, tracing
+
+    paddle.seed(0)
+    max_len = prompt + new_tokens + chunk
+    cfg = GPTConfig(vocab_size=1024, hidden_size=128, num_layers=2,
+                    num_heads=4, max_position_embeddings=max_len,
+                    hidden_dropout_prob=0.0, attention_dropout_prob=0.0,
+                    use_flash_attention=False)
+    engines = []
+    for _ in range(2):
+        m = GPTForCausalLM(cfg)
+        m.eval()
+        engines.append(ServingEngine(m, max_slots=streams, max_len=max_len,
+                                     chunk=chunk, decode_window=8))
+    reg = get_registry()
+
+    def builds():
+        return sum(int(reg.total("jit_builds_total", engine=e._engine_id))
+                   for e in engines)
+
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(0, cfg.vocab_size, (prompt,)).astype(np.int32)
+               for _ in range(streams)]
+    # warm EVERY replica directly (the router's least-loaded pick could
+    # send all warmup to one engine and leave the other to compile
+    # mid-measurement, which is exactly what the gate must not excuse)
+    for e in engines:
+        w = e.submit(prompts[0], 2)
+        assert w.wait(300) and w.error is None, w.error
+    builds_warm = builds()
+    router = FleetRouter(engines)
+    spans = []
+    tracing.set_span_sink(
+        lambda name, t0, t1, tid, attrs: spans.append(name))
+    tracing.enable_tracing()
+    try:
+        t0 = time.perf_counter()
+        frs = [router.submit(p, new_tokens) for p in prompts]
+        for fr in frs:
+            assert fr.wait(300), "fleet request timed out"
+        wall_s = time.perf_counter() - t0
+    finally:
+        tracing.disable_tracing()
+        tracing.set_span_sink(None)
+    assert all(fr.error is None for fr in frs)
+    rep = router.load_report()
+    disp = (rep.get("dispatch") or {}).get("hit") or {}
+    retries = sum(fr.retries for fr in frs)
+    row = {"metric": metric,
+           "value": round(streams * new_tokens / wall_s, 1),
+           "unit": "tokens/s", "timing": "host"}
+    row["metrics"] = {
+        "jit_builds_warm": builds_warm,
+        "jit_builds_total": builds(),
+        "fleet_dispatch_p50_ms": (round(disp["p50_s"] * 1e3, 3)
+                                  if disp.get("p50_s") is not None
+                                  else None),
+        "fleet_dispatch_p99_ms": (round(disp["p99_s"] * 1e3, 3)
+                                  if disp.get("p99_s") is not None
+                                  else None),
+        "fleet_retry_rate": round(retries / len(frs), 4),
+        "fleet_replicas": len(engines),
+        "fleet_spans_recorded": len(spans),
+    }
+    router.shutdown()
+    return row
+
+
 SUITE = {
     "gpt2": lambda: bench_gpt2(),
     "ernie": lambda: bench_ernie(),
@@ -1266,6 +1356,12 @@ SUITE = {
     # embedded interactive ttft_p99 <= 0.75x FIFO, batch goodput
     # >= 0.8x FIFO, token-exact preemption, and zero leaked pages
     "serving_slo": lambda: bench_serving_slo(),
+    # fleet observability plane (PR 19): 2 replicas behind a FleetRouter
+    # with tracing armed for the whole measured phase —
+    # compare_fleet_telemetry gates jit_builds_total == jit_builds_warm
+    # across both replicas (armed telemetry compiles NOTHING) and
+    # requires the dispatch-latency percentiles to be present
+    "serving_fleet": lambda: bench_serving_fleet(),
     # weight-only int8 serving (PR 8): identical workload to `serving`
     # through the quantized artifact (save -> quantize-at-load ->
     # fused dequant GEMM ticks); decode streams half the weight bytes

@@ -71,6 +71,21 @@ All shared router state is guarded by ``make_lock`` locks and declared
 via ``share_object`` so the PHT009/PHT010 lint rules and the runtime
 lockset sanitizer police it — this module is the first consumer the
 race tooling was built for.
+
+Fleet observability (docs/OBSERVABILITY.md, "Fleet telemetry"): every
+dispatch mints a fleet-wide trace context (:meth:`FleetRequest.
+trace_context` — fleet id, fleet rid, attempt ordinal; a plain dict
+designed to ride an HTTP header later) that the replica stamps into its
+lifecycle record and spans, while the router emits its own spans
+(``fleet.route``/``fleet.dispatch``/``fleet.backoff``/
+``fleet.failover``/``fleet.drain_migration``) on a per-fleet-request
+lane — ``cross_stack.merge_traces(stitch_fleet=True)`` fuses both sides
+into one swimlane per request.  :meth:`FleetRouter.load_report` /
+``/fleet`` federates every replica's ``/load`` (version-gated, with
+staleness ages), :meth:`FleetRouter.expose_text` federates their metric
+text under a bounded ``replica=`` label, and a rules-driven watchdog
+over the replicas' rolling SLO windows surfaces named degradation
+reasons in :meth:`FleetRouter.health_report` / ``/healthz``.
 """
 
 from __future__ import annotations
@@ -78,6 +93,8 @@ from __future__ import annotations
 import itertools
 import queue
 import time
+import warnings
+import weakref
 from typing import Dict, List, Optional
 
 import numpy as np
@@ -103,11 +120,23 @@ __all__ = ["FleetRouter", "FleetRequest", "CircuitBreaker",
            "BREAKER_CLOSED", "BREAKER_HALF_OPEN", "BREAKER_OPEN"]
 
 _FLEET_IDS = itertools.count()
+# fleet-wide request ids: process-wide like the engine's rids, but a
+# SEPARATE sequence — one fleet request may burn several engine rids
+# across failovers, and the merged-trace stitcher keys lanes on this
+_FLEET_RIDS = itertools.count(1)
+# chrome-trace lane base for router spans: engine spans lane on the
+# (small-int) engine rid, router spans on _FLEET_LANE + fleet_rid so
+# the two sequences never collide in an unstitched trace
+_FLEET_LANE = 1 << 20
 
 # session-pin map bound: pins past this evict oldest-first (the evicted
 # conversation still routes right via prefix_digest affinity — a pin is
 # a fast path, never load-bearing for correctness)
 MAX_SESSION_PINS = 4096
+
+# bound on the /debug/requests live-request table (the registry itself
+# is weak — this caps only the rendered rows)
+MAX_FORENSICS_ROWS = 256
 
 
 class NoReplicaAvailableError(RuntimeError):
@@ -232,7 +261,8 @@ def _queue_depth_for(report: dict, priority=None) -> int:
 
 def pick_replica(reports: Dict[str, dict], need: int,
                  digests: Optional[List[int]] = None,
-                 exclude=(), priority=None) -> Optional[str]:
+                 exclude=(), priority=None,
+                 explain: Optional[dict] = None) -> Optional[str]:
     """Pure dispatch scoring over ``/load`` reports (the router
     contract, docs/OBSERVABILITY.md "SLO telemetry and the /load
     report"); returns the chosen replica name, or None when no report
@@ -250,7 +280,14 @@ def pick_replica(reports: Dict[str, dict], need: int,
     compares is only the classes scheduled at or before its own, via
     ``queue.classes`` when the replica publishes it).  Name
     order breaks remaining ties, so equal fleets dispatch
-    deterministically."""
+    deterministically.
+
+    ``explain``, when a dict is passed, is filled in place with WHY the
+    winner won — ``{"why": "affinity" | "headroom" | "queued_least_
+    loaded", "affinity_depth": int, "headroom": int, "queue_depth":
+    int}`` — the per-hop forensics record ``/debug/requests`` shows
+    (an out-param so the scoring stays a pure single-return function
+    for every existing caller)."""
     cands = []
     for name in sorted(reports):
         rep = reports[name]
@@ -269,8 +306,13 @@ def pick_replica(reports: Dict[str, dict], need: int,
     fits = [c for c in cands if c[1] >= need]
     if fits:
         best = min(fits, key=lambda c: (-c[4], -c[1], c[2], c[3], c[0]))
+        why = "affinity" if best[4] else "headroom"
     else:
         best = min(cands, key=lambda c: (c[2], c[3], -c[1], c[0]))
+        why = "queued_least_loaded"
+    if explain is not None:
+        explain.update(why=why, affinity_depth=best[4],
+                       headroom=best[1], queue_depth=best[2])
     return best[0]
 
 
@@ -278,7 +320,8 @@ class _Replica:
     """Router-side record for one replica handle."""
 
     __slots__ = ("name", "handle", "breaker", "draining", "g_breaker",
-                 "beacon")
+                 "beacon", "last_report", "last_report_ts",
+                 "version_warned")
 
     def __init__(self, name, handle, breaker, g_breaker):
         self.name = name
@@ -291,6 +334,14 @@ class _Replica:
         # (add_replica(name=...)) — keying the staleness gate on the
         # wrong string would silently disable it for that replica
         self.beacon = f"serving.{getattr(handle, 'engine_id', name)}"
+        # last GOOD (version-1) /load report + its monotonic stamp: the
+        # fleet load_report serves this with its staleness age when a
+        # fresh probe fails, so a federated scrape shows "stale since"
+        # instead of a hole
+        self.last_report: Optional[dict] = None
+        self.last_report_ts: Optional[float] = None
+        # warn-once latch for an unknown /load envelope version
+        self.version_warned = False
 
 
 class FleetRequest:
@@ -326,6 +377,25 @@ class FleetRequest:
         self.priority = "default" if priority is None else priority
         self.deadline_s = deadline_s
         self._t_submit = time.perf_counter()
+        # fleet-wide trace identity: survives failovers (each placement
+        # burns a fresh engine rid; this one names the REQUEST) — the
+        # lane key cross_stack's --stitch-fleet merges swimlanes on
+        self.fleet_rid = next(_FLEET_RIDS)
+        # dispatch attempt ordinal (every _try_dispatch bumps it,
+        # including failover re-placements) — rides the trace context
+        self._attempts = 0
+        # per-hop forensics, appended under _lock per placement attempt:
+        # which replica, why chosen, outcome/cause — the hop history
+        # /debug/requests renders (bounded by the retry budget per
+        # placement episode plus one failover marker per recovery)
+        self.hops: List[dict] = []
+        # queue-at-router span: submit() ends it at first successful
+        # placement (or terminal failure) — router-side queueing +
+        # retries are exactly the TTFT the replica cannot see
+        self._span_route = _tr.start_span(
+            "fleet.route", _tid=_FLEET_LANE + self.fleet_rid,
+            fleet=router.fleet_id, fleet_rid=self.fleet_rid,
+            priority=self.priority)
         # RLock: _recover holds it across _place, which re-acquires it
         # to install the new placement
         self._lock = make_rlock("fleet.request")
@@ -343,6 +413,17 @@ class FleetRequest:
         self._closed = False
         share_object(self, f"fleet.request[{id(self)}]",
                      atomic=("_closed",))
+
+    def trace_context(self) -> dict:
+        """The fleet trace context this request's NEXT/current placement
+        carries to its replica: ``{"fleet", "fleet_rid", "attempt"}``.
+        A plain JSON-able dict by design — when replicas move behind
+        HTTP this is the header payload, unchanged
+        (docs/OBSERVABILITY.md, "Fleet telemetry")."""
+        with self._lock:
+            return {"fleet": self._router.fleet_id,
+                    "fleet_rid": self.fleet_rid,
+                    "attempt": self._attempts}
 
     # -- engine-Request-compatible surface --------------------------------
     def _settle(self):
@@ -534,6 +615,15 @@ class FleetRouter:
         policy).
       stream_queue_tokens / stream_put_timeout_s: streaming
         backpressure bound and the consumer-gone detach timeout.
+      watchdog_ttft_p99_s / watchdog_goodput_ratio / watchdog_skew:
+        rules-driven degradation watchdog thresholds evaluated at every
+        :meth:`load_report`/:meth:`health_report` over the replicas'
+        rolling SLO windows — an interactive TTFT p99 past
+        ``watchdog_ttft_p99_s``, a goodput ratio under
+        ``watchdog_goodput_ratio`` right after preemptions grew, or a
+        max/min load spread past ``watchdog_skew`` fires a named
+        degradation (flight-recorder event on each transition, reason
+        strings in ``/healthz``).
     """
 
     def __init__(self, replicas=(), *, max_retries: int = 2,
@@ -543,7 +633,10 @@ class FleetRouter:
                  breaker_probe_interval_s: float = 1.0,
                  policy: str = "least_loaded",
                  stream_queue_tokens: int = 64,
-                 stream_put_timeout_s: float = 30.0):
+                 stream_put_timeout_s: float = 30.0,
+                 watchdog_ttft_p99_s: float = 2.0,
+                 watchdog_goodput_ratio: float = 0.5,
+                 watchdog_skew: int = 64):
         if policy not in ("least_loaded", "round_robin"):
             raise ValueError(f"policy must be 'least_loaded' or "
                              f"'round_robin', got {policy!r}")
@@ -556,6 +649,9 @@ class FleetRouter:
         self.policy = policy
         self.stream_queue_tokens = int(stream_queue_tokens)
         self.stream_put_timeout_s = float(stream_put_timeout_s)
+        self.watchdog_ttft_p99_s = float(watchdog_ttft_p99_s)
+        self.watchdog_goodput_ratio = float(watchdog_goodput_ratio)
+        self.watchdog_skew = int(watchdog_skew)
 
         self._lock = make_lock("fleet.router")
         self._replicas: Dict[str, _Replica] = {}
@@ -569,6 +665,18 @@ class FleetRouter:
         self._session_pins: Dict[str, str] = {}
         self.fleet_id = f"f{next(_FLEET_IDS)}"
         self._flight = _flight.get_flight_recorder()
+        # live-request forensics registry: fleet_rid -> FleetRequest,
+        # weak so a dropped handle vanishes from /debug/requests on its
+        # own (mutation vs snapshot serialized under _lock, same
+        # discipline as the tracing registries)
+        self._requests: "weakref.WeakValueDictionary[int, FleetRequest]" \
+            = weakref.WeakValueDictionary()
+        # watchdog state: active rule key -> {"since", "reason"}; the
+        # per-replica preemption counts from the previous evaluation
+        # (the goodput rule fires on a crater RIGHT AFTER preemptions
+        # grew, so it needs the delta)
+        self._wd_state: Dict[str, dict] = {}
+        self._wd_prev_preempt: Dict[str, int] = {}
 
         reg = self._registry = _obs.get_registry()
         lbl = {"fleet": self.fleet_id}
@@ -576,10 +684,20 @@ class FleetRouter:
             "fleet_dispatch_total",
             "dispatch attempts by replica and outcome (ok / error / "
             "stale / probe_error / draining)")
-        self._c_retries = reg.counter(
+        self._fam_retries = reg.counter(
             "fleet_retries_total",
-            "request re-dispatches (placement retries + replica-death "
-            "failovers)").labels(**lbl)
+            "request re-dispatches by reason (backoff_retry = placement "
+            "retry within an episode, failover = replica-death "
+            "re-dispatch)")
+        self._fam_dispatch_s = reg.histogram(
+            "fleet_dispatch_seconds",
+            "submit-to-placed latency by outcome (hit = first attempt, "
+            "retry = placed after backoff, failover = re-placed after a "
+            "replica death)", unit="s")
+        self._fam_vmismatch = reg.counter(
+            "fleet_load_version_mismatch_total",
+            "/load reports skipped for an unknown envelope version "
+            "(deployment skew, not ill health: no breaker penalty)")
         self._fam_breaker = reg.gauge(
             "fleet_breaker_state",
             "per-replica circuit breaker (0 closed / 1 half-open / "
@@ -587,6 +705,11 @@ class FleetRouter:
         self._g_draining = reg.gauge(
             "fleet_draining", "replicas currently draining").labels(**lbl)
         self._g_draining.set(0)
+        self._g_skew = reg.gauge(
+            "fleet_replica_skew",
+            "max-min spread of per-replica load (queue depth + active "
+            "slots) across live candidates").labels(**lbl)
+        self._g_skew.set(0)
 
         for r in replicas:
             self.add_replica(r)
@@ -595,6 +718,7 @@ class FleetRouter:
         # own locks
         share_object(self, f"fleet.router[{self.fleet_id}]")
         _tr.register_introspection_source(self.fleet_id, self)
+        _tr.register_fleet_source(self.fleet_id, self)
 
     # ------------------------------------------------------------------
     def add_replica(self, handle, name: Optional[str] = None) -> str:
@@ -618,9 +742,39 @@ class FleetRouter:
         with self._lock:
             return list(self._replicas)
 
+    # labelled-inc helpers: label VALUES arrive as parameters (replica
+    # names and small outcome/reason enums — bounded), never loop
+    # targets or request ids, which keeps PHT005 provably clean on the
+    # dispatch hot path
     def _count(self, name: str, outcome: str) -> None:
         self._fam_dispatch.labels(
             fleet=self.fleet_id, replica=name, outcome=outcome).inc()
+
+    def _count_retry(self, reason: str) -> None:
+        self._fam_retries.labels(fleet=self.fleet_id, reason=reason).inc()
+
+    def _observe_dispatch(self, outcome: str, seconds: float) -> None:
+        self._fam_dispatch_s.labels(
+            fleet=self.fleet_id, outcome=outcome).observe(seconds)
+
+    def _version_mismatch(self, rep: _Replica, version) -> None:
+        """An unknown /load envelope version: count it, warn ONCE per
+        replica, and skip the report for scoring — deployment skew is
+        not ill health, so no breaker penalty (a mixed-version rollout
+        must not open breakers fleet-wide)."""
+        self._fam_vmismatch.labels(
+            fleet=self.fleet_id, replica=rep.name).inc()
+        warn = False
+        with self._lock:
+            if not rep.version_warned:
+                rep.version_warned = True
+                warn = True
+        if warn:
+            warnings.warn(
+                f"fleet {self.fleet_id}: replica {rep.name!r} publishes "
+                f"/load envelope version {version!r} (expected 1); its "
+                f"reports are skipped for dispatch scoring until it "
+                f"speaks version 1", RuntimeWarning, stacklevel=3)
 
     # ------------------------------------------------------------------
     # health + capacity
@@ -669,10 +823,16 @@ class FleetRouter:
                 self._count(rep.name, "probe_error")
                 self._record_failure(rep)
                 continue
-            if not isinstance(report, dict) or report.get("version") != 1:
-                # the router contract: consumers must check version
+            if not isinstance(report, dict):
+                # a non-dict "report" is a broken probe, not skew
                 self._count(rep.name, "probe_error")
                 self._record_failure(rep)
+                continue
+            if report.get("version") != 1:
+                # the router contract: consumers must check version.
+                # Unknown version = deployment skew — counted + warned
+                # (once) and skipped for scoring; NOT a breaker failure
+                self._version_mismatch(rep, report.get("version"))
                 continue
             if report.get("draining"):
                 # replica-side drain (someone called engine.drain()
@@ -685,14 +845,29 @@ class FleetRouter:
                 # the operator may still be watching drain.
                 self._mark_draining(rep)
                 continue
+            with self._lock:
+                # cache the good report: the fleet load_report serves
+                # it with a staleness age when a later probe fails
+                rep.last_report = report
+                rep.last_report_ts = time.monotonic()
             out.append((rep, report))
+        if len(out) >= 2:
+            # max-min spread of (class-blind) load across the live
+            # candidates — the skew series the one-hot-replica watchdog
+            # rule and dashboards read.  Host arithmetic on reports
+            # already in hand: no extra probe.
+            loads = [_queue_depth_for(rep) +
+                     int((rep.get("slots") or {}).get("active") or 0)
+                     for _, rep in out]
+            self._g_skew.set(max(loads) - min(loads))
         return out
 
-    def _mark_draining(self, rep: _Replica) -> None:
+    def _mark_draining(self, rep: _Replica) -> int:
         """Stop dispatching to ``rep`` and publish the fleet_draining
         gauge — the one place the draining flag is set (router drain,
         replica-side drain observed by a probe, EngineDraining on
-        submit)."""
+        submit).  Returns how many session pins were migrated off the
+        replica (the ``fleet.drain_migration`` span reports it)."""
         with self._lock:
             rep.draining = True
             self._g_draining.set(
@@ -703,9 +878,11 @@ class FleetRouter:
             # same-replica re-admission would have replayed — but the
             # replica is leaving; the survivor re-prefilles, tokens
             # stay exact)
-            for sid in [s for s, n in self._session_pins.items()
-                        if n == rep.name]:
+            stale_pins = [s for s, n in self._session_pins.items()
+                          if n == rep.name]
+            for sid in stale_pins:
                 del self._session_pins[sid]
+            return len(stale_pins)
 
     def _record_failure(self, rep: _Replica) -> None:
         with self._lock:
@@ -722,7 +899,42 @@ class FleetRouter:
         """One placement attempt; True when the request landed.  False
         = no candidate right now (retry may help); raises on a submit
         failure (booked against that replica's breaker) so the retry
-        loop backs off before trying again."""
+        loop backs off before trying again.
+
+        Observability bracket around :meth:`_dispatch_once` (the actual
+        pick+submit): bumps the request's attempt ordinal, emits the
+        ``fleet.dispatch`` span on the request's fleet lane, and
+        appends the hop record (replica, why chosen, outcome, cause)
+        the ``/debug/requests`` forensics table renders.  Both are
+        host-side dict work — nothing here touches the device or mints
+        a metric label from an id."""
+        with freq._lock:
+            freq._attempts += 1
+            attempt = freq._attempts
+        sp = _tr.start_span(
+            "fleet.dispatch", _tid=_FLEET_LANE + freq.fleet_rid,
+            fleet=self.fleet_id, fleet_rid=freq.fleet_rid,
+            attempt=attempt)
+        hop = {"attempt": attempt}
+        try:
+            placed = self._dispatch_once(freq, exclude, hop)
+            hop.setdefault("outcome", "ok" if placed else "no_candidate")
+            return placed
+        except BaseException as e:
+            hop.setdefault("outcome", "error")
+            hop["cause"] = f"{type(e).__name__}: {e}"
+            raise
+        finally:
+            with freq._lock:
+                freq.hops.append(hop)
+            sp.end(**{k: v for k, v in hop.items() if k != "attempt"})
+
+    # pht-lint: hot-root (fleet dispatch path)
+    def _dispatch_once(self, freq: FleetRequest, exclude,
+                       hop: dict) -> bool:
+        """The pick + submit of one placement attempt (see
+        :meth:`_try_dispatch` for the contract); fills ``hop`` with the
+        forensics of what it did."""
         _faults.point("fleet.dispatch")
         cands = self._candidates()
         by_name = {rep.name: (rep, report) for rep, report in cands
@@ -743,10 +955,12 @@ class FleetRouter:
                 pinned = self._session_pins.get(freq.session)
                 if pinned is not None and pinned in by_name:
                     name = pinned
+                    hop["why"] = "session_pin"
             if name is None and self.policy == "round_robin":
                 names = sorted(by_name)
                 name = names[self._rr % len(names)]
                 self._rr += 1
+                hop["why"] = "round_robin"
         if name is None:
             digests = None
             sizes = {(rep.get("prefix_digest") or {}).get("page_size")
@@ -758,12 +972,15 @@ class FleetRouter:
                 # chain per size — affinity is skipped instead of
                 # guessed (docs/SERVING.md).
                 digests = page_digests(freq.prompt, sizes.pop())
+            explain = {}
             name = pick_replica(
                 {n: rep for n, (_, rep) in by_name.items()}, need,
-                digests=digests, priority=freq.priority)
+                digests=digests, priority=freq.priority, explain=explain)
             if name is None:
                 return False
+            hop.update(explain)
         rep, _report = by_name[name]
+        hop["replica"] = name
         deadline_rem = None
         if freq.deadline_s is not None:
             # the engine measures from ITS submit stamp: hand the
@@ -782,6 +999,7 @@ class FleetRouter:
             # is enforced here, at the dispatch decision, under the
             # router lock
             if not rep.breaker.allows(time.monotonic()):
+                hop["outcome"] = "breaker_lost_race"
                 return False
             rep.breaker.on_dispatch()     # half-open: this IS the probe
         on_token = None
@@ -801,11 +1019,13 @@ class FleetRouter:
                 freq.prompt, freq.max_new_tokens,
                 deadline_s=deadline_rem,
                 on_token=on_token,
+                trace_ctx=freq.trace_context(),
                 **freq._kw)
         except EngineDraining:
             # not a failure: mark and let the retry pick elsewhere
             self._mark_draining(rep)
             self._count(rep.name, "draining")
+            hop["outcome"] = "draining"
             return False
         except Exception as e:
             self._count(rep.name, "error")
@@ -831,7 +1051,8 @@ class FleetRouter:
             freq._replica = rep.name
         self._flight.record(
             "fleet", phase="dispatch", fleet=self.fleet_id,
-            replica=rep.name, rid=req.rid, retries=freq.retries)
+            replica=rep.name, rid=req.rid, fleet_rid=freq.fleet_rid,
+            retries=freq.retries)
         return True
 
     def _place(self, freq: FleetRequest, exclude=(),
@@ -848,17 +1069,34 @@ class FleetRouter:
         caller's budget died first."""
         exclude = set(exclude)
         last_err = None
+        t0 = time.perf_counter()
         delay = self.backoff_s * _BACKOFF_FACTOR.get(freq.priority, 1.0)
         for attempt in range(self.max_retries + 1):
             if attempt or is_retry:
-                self._c_retries.inc()
+                # reason taxonomy: a failover episode's first attempt is
+                # the failover itself; later attempts (either episode
+                # kind) are backoff retries
+                self._count_retry("failover" if is_retry and not attempt
+                                  else "backoff_retry")
             if attempt:
+                bsp = _tr.start_span(
+                    "fleet.backoff", _tid=_FLEET_LANE + freq.fleet_rid,
+                    fleet=self.fleet_id, fleet_rid=freq.fleet_rid,
+                    attempt=attempt, delay_s=delay)
                 time.sleep(delay)
+                bsp.end()
                 delay *= self.backoff_mult
             if exclude >= set(self.replica_names()):
                 exclude = set()     # whole fleet excluded: start over
             try:
                 if self._try_dispatch(freq, exclude):
+                    # episode latency by how hard placement was: first
+                    # attempt = hit, placed after backoff = retry, any
+                    # failover re-placement = failover
+                    self._observe_dispatch(
+                        "failover" if is_retry
+                        else ("retry" if attempt else "hit"),
+                        time.perf_counter() - t0)
                     return
             except DeadlineExceededError:
                 raise
@@ -908,12 +1146,18 @@ class FleetRouter:
              "session": session, "priority": priority},
             None if deadline_s is None else float(deadline_s), stream,
             session=session, priority=priority)
+        with self._lock:
+            # forensics registry (weak): /debug/requests renders the
+            # live handles' hop histories; a dropped handle vanishes
+            self._requests[freq.fleet_rid] = freq
         try:
             self._place(freq)
         except BaseException as e:
             with freq._lock:
                 freq._failed = e
+            freq._span_route.end(error=type(e).__name__)
             raise
+        freq._span_route.end(replica=freq.replica, retries=freq.retries)
         return freq
 
     def submit_stream(self, prompt, max_new_tokens: int = 32, **kw):
@@ -965,6 +1209,10 @@ class FleetRouter:
                 self._wake_stream(freq)
                 return
             freq._retries += 1
+            freq.hops.append({
+                "attempt": freq._attempts, "outcome": "failover",
+                "replica": failed_on,
+                "cause": f"{type(req.error).__name__}: {req.error}"})
             # the replica broke a placed request: that is a health
             # event even though the submit itself succeeded earlier
             with self._lock:
@@ -973,7 +1221,13 @@ class FleetRouter:
                 self._record_failure(rep)
             self._flight.record(
                 "fleet", phase="failover", fleet=self.fleet_id,
-                replica=failed_on, rid=req.rid)
+                replica=failed_on, rid=req.rid,
+                fleet_rid=freq.fleet_rid)
+            fsp = _tr.start_span(
+                "fleet.failover", _tid=_FLEET_LANE + freq.fleet_rid,
+                fleet=self.fleet_id, fleet_rid=freq.fleet_rid,
+                from_replica=failed_on,
+                cause=type(req.error).__name__)
             try:
                 # re-dispatch AWAY from the dead replica.  Still inside
                 # freq._lock (an RLock): concurrent waiters block here
@@ -985,6 +1239,9 @@ class FleetRouter:
             except BaseException as e:
                 freq._failed = e
                 self._wake_stream(freq)
+                fsp.end(outcome="failed", error=type(e).__name__)
+            else:
+                fsp.end(outcome="re_placed", replica=freq._replica)
 
     @staticmethod
     def _wake_stream(freq: FleetRequest) -> None:
@@ -1021,22 +1278,32 @@ class FleetRouter:
             if rep is None:
                 raise KeyError(f"no replica {name!r} "
                                f"(have {sorted(self._replicas)})")
-        self._mark_draining(rep)
+        migrated = self._mark_draining(rep)
         self._flight.record("fleet", phase="drain", fleet=self.fleet_id,
                             replica=name)
+        # drain-migration span on the router's own (fleet-id) lane: a
+        # removal is fleet-scoped work, not one request's
+        dsp = _tr.start_span("fleet.drain_migration", _tid=_FLEET_LANE,
+                             fleet=self.fleet_id, replica=name,
+                             migrated_pins=migrated)
         # ONE budget for the whole removal: shutdown gets what the
         # backlog drain left, not a fresh full timeout (an operator
         # watchdog sized to `timeout` must not fire mid-removal).  The
         # small floor lets the engine's loop-stopped poll run at least
         # once — after a completed drain it passes immediately.
         end = time.monotonic() + float(timeout)
-        rep.handle.drain(timeout=timeout)
-        rep.handle.shutdown(timeout=max(0.05, end - time.monotonic()))
+        try:
+            rep.handle.drain(timeout=timeout)
+            rep.handle.shutdown(timeout=max(0.05, end - time.monotonic()))
+        except BaseException as e:
+            dsp.end(outcome="failed", error=type(e).__name__)
+            raise
         with self._lock:
             self._replicas.pop(name, None)
             self._g_draining.set(
                 sum(r.draining for r in self._replicas.values()))
         self._registry.drop_labels(fleet=self.fleet_id, replica=name)
+        dsp.end(outcome="removed")
 
     def shutdown(self, timeout: float = 60.0) -> None:
         """Hard stop: shut every replica down (no drain — use
@@ -1054,12 +1321,15 @@ class FleetRouter:
             except Exception:  # noqa: BLE001 — best-effort teardown
                 pass
         _tr.unregister_introspection_source(self.fleet_id)
+        _tr.unregister_fleet_source(self.fleet_id)
         self._registry.drop_labels(fleet=self.fleet_id)
 
     def introspect_requests(self) -> dict:
         """Router table for ``/debug/requests``: per-replica breaker
         state, draining flag, failure streak (snapshot under the
-        router lock; host dicts only)."""
+        router lock; host dicts only) — plus per-request hop forensics
+        (which replica each attempt picked and why, each retry's
+        cause) and the active watchdog degradations."""
         state_names = {BREAKER_CLOSED: "closed",
                        BREAKER_HALF_OPEN: "half_open",
                        BREAKER_OPEN: "open"}
@@ -1071,5 +1341,252 @@ class FleetRouter:
                        "draining": r.draining}
                 for name, r in self._replicas.items()}
             pins = len(self._session_pins)
+            degraded = [dict(v, rule=k)
+                        for k, v in sorted(self._wd_state.items())]
         return {"fleet": self.fleet_id, "policy": self.policy,
-                "session_pins": pins, "replicas": replicas}
+                "session_pins": pins, "replicas": replicas,
+                "requests": self._forensics(), "watchdog": degraded}
+
+    def _forensics(self, limit: int = MAX_FORENSICS_ROWS) -> dict:
+        """Hop histories of the live fleet requests (weak registry:
+        dropped handles have already vanished).  Reads raw fields under
+        each request's lock — deliberately NOT ``_settle()``:
+        introspection must never trigger a recovery/re-placement as a
+        side effect of being looked at."""
+        with self._lock:
+            items = sorted(self._requests.items())[:limit]
+        out = {}
+        # no router lock held here: freq._lock nests router->request
+        # nowhere (dispatch nests request->router), so taking it after
+        # releasing ours keeps the lock order acyclic
+        for frid, freq in items:
+            with freq._lock:
+                req = freq._req
+                out[str(frid)] = {
+                    "rid": req.rid if req is not None else None,
+                    "replica": freq._replica,
+                    "priority": freq.priority,
+                    "retries": freq._retries,
+                    "attempts": freq._attempts,
+                    "done": bool(freq._failed is not None
+                                 or (req is not None and req.done)),
+                    "error": (type(freq._failed).__name__
+                              if freq._failed is not None else None),
+                    "hops": [dict(h) for h in freq.hops]}
+        return out
+
+    # ------------------------------------------------------------------
+    # fleet telemetry: federation, health, watchdog
+    # (docs/OBSERVABILITY.md, "Fleet telemetry")
+    def load_report(self) -> dict:
+        """The federated fleet capacity document — the ``/fleet``
+        endpoint body (registered via ``tracing.register_fleet_source``
+        at construction).  One fresh ``/load`` probe per replica
+        (version-gated; an unknown version counts
+        ``fleet_load_version_mismatch_total`` and the replica's entry
+        carries no trusted fields), each entry labelled with its
+        staleness: ``age_s`` is 0 for a fresh report, the cache age
+        when the probe failed and the last GOOD report is served
+        instead (``stale: true``).  Plus fleet-only aggregates:
+        per-outcome dispatch percentiles, replica skew, merged SLO
+        percentiles over in-process replicas' rolling windows, and the
+        active watchdog degradations."""
+        now = time.monotonic()
+        ages = _tr.beacon_ages()
+        with self._lock:
+            reps = list(self._replicas.values())
+        replicas = {}
+        loads = []
+        slo_wins: Dict[str, list] = {}
+        for rep in reps:
+            entry = {"draining": rep.draining,
+                     "breaker": rep.breaker.state,
+                     "beacon_age_s": (round(ages[rep.beacon], 3)
+                                      if rep.beacon in ages else None)}
+            report = None
+            try:
+                report = self._probe_load(rep)
+            except Exception as e:  # noqa: BLE001 — probe failure is data
+                entry["probe_error"] = f"{type(e).__name__}: {e}"
+            if isinstance(report, dict) and report.get("version") == 1:
+                with self._lock:
+                    rep.last_report = report
+                    rep.last_report_ts = now
+                entry["report"] = report
+                entry["age_s"] = 0.0
+                entry["version_ok"] = True
+                if not rep.draining:
+                    loads.append(_queue_depth_for(report) + int(
+                        (report.get("slots") or {}).get("active") or 0))
+            else:
+                if isinstance(report, dict):
+                    self._version_mismatch(rep, report.get("version"))
+                    entry["version_ok"] = False
+                with self._lock:
+                    stale, ts = rep.last_report, rep.last_report_ts
+                if stale is not None:
+                    # serve the cached good report WITH its age — a
+                    # scrape shows "stale since", never silently-fresh
+                    # numbers from a replica that stopped answering
+                    entry["report"] = stale
+                    entry["age_s"] = round(now - ts, 3)
+                    entry["stale"] = True
+            sw = getattr(rep.handle, "slo_windows", None)
+            if callable(sw) and not entry.get("stale"):
+                try:
+                    for k, h in sw().items():
+                        slo_wins.setdefault(k, []).append(h)
+                except Exception:  # noqa: BLE001 — aggregation is best-effort
+                    pass
+            replicas[rep.name] = entry
+        skew = (max(loads) - min(loads)) if len(loads) >= 2 else 0
+        self._g_skew.set(skew)
+        slo_merged = {}
+        for k, wins in sorted(slo_wins.items()):
+            try:
+                slo_merged[k] = _obs.merged_percentiles(wins)
+            except ValueError:
+                # mixed bucket bounds across replicas: skip the merge
+                # rather than publish a wrong percentile
+                slo_merged[k] = None
+        dispatch = {}
+        for outcome in ("hit", "retry", "failover"):
+            h = self._fam_dispatch_s.labels(
+                fleet=self.fleet_id, outcome=outcome)
+            if h.count:
+                dispatch[outcome] = {
+                    "count": int(h.count),
+                    "p50_s": h.quantile(0.5), "p99_s": h.quantile(0.99)}
+        return {"version": 1, "kind": "fleet", "fleet": self.fleet_id,
+                "ts": time.time(), "policy": self.policy,
+                "replicas": replicas, "replica_skew": skew,
+                "dispatch": dispatch,
+                "slo_merged": slo_merged or None,
+                "watchdog": self._watchdog_eval(replicas)}
+
+    def health_report(self) -> dict:
+        """The fleet block of ``/healthz``: per-replica beacon ages
+        sorted STALEST FIRST (the wedged replica is the first thing a
+        probe reader sees), breaker/draining state, and the active
+        watchdog degradation reasons.  ``ok`` is false when any beacon
+        breaches ``health_max_age_s`` or a degradation is active — one
+        fleet probe trips instead of N per-replica ones."""
+        ages = _tr.beacon_ages()
+        state_names = {BREAKER_CLOSED: "closed",
+                       BREAKER_HALF_OPEN: "half_open",
+                       BREAKER_OPEN: "open"}
+        with self._lock:
+            reps = [(r.name, r.beacon, r.draining, r.breaker.state)
+                    for r in self._replicas.values()]
+            degraded = [dict(v, rule=k)
+                        for k, v in sorted(self._wd_state.items())]
+        rows = []
+        for name, beacon, draining, bstate in reps:
+            rows.append({"replica": name,
+                         "beacon_age_s": (round(ages[beacon], 3)
+                                          if beacon in ages else None),
+                         "draining": draining,
+                         "breaker": state_names[bstate]})
+        # stalest first; beacon-less replicas (idle engines drop
+        # theirs by design) sort last — they are fine, not unknown
+        rows.sort(key=lambda r: (r["beacon_age_s"] is not None,
+                                 r["beacon_age_s"] or 0.0), reverse=True)
+        stale = [r["replica"] for r in rows
+                 if r["beacon_age_s"] is not None
+                 and r["beacon_age_s"] > self.health_max_age_s]
+        return {"fleet": self.fleet_id,
+                "ok": not stale and not degraded,
+                "stale_replicas": stale, "replicas": rows,
+                "degraded": degraded}
+
+    def _watchdog_eval(self, replicas: dict) -> list:
+        """Evaluate the degradation rules over fresh per-replica
+        entries (called from :meth:`load_report` with the probe results
+        already in hand — the watchdog never adds probes).  Rule keys
+        embed replica NAMES (bounded) and live in ``_wd_state``;
+        each fired/cleared transition emits a flight-recorder event so
+        the forensics timeline shows WHEN the fleet degraded.  Returns
+        the active degradations, named."""
+        now = time.time()
+        fired: Dict[str, str] = {}
+        loads = []
+        for name, entry in sorted(replicas.items()):
+            doc = entry.get("report")
+            if not isinstance(doc, dict) or entry.get("stale"):
+                continue
+            slo_cls = (doc.get("slo") or {}).get("classes") or {}
+            ttft = (slo_cls.get("interactive") or {}).get("ttft") or None
+            if ttft and ttft.get("p99") is not None \
+                    and ttft["p99"] > self.watchdog_ttft_p99_s:
+                fired[f"ttft_p99[{name}]"] = (
+                    f"interactive ttft p99 {ttft['p99']:.3f}s breaches "
+                    f"{self.watchdog_ttft_p99_s}s on {name}")
+            gp = (doc.get("goodput") or {}).get("ratio")
+            pre = int(((doc.get("scheduler") or {})
+                       .get("preemptions")) or 0)
+            with self._lock:
+                prev = self._wd_prev_preempt.get(name, 0)
+                self._wd_prev_preempt[name] = pre
+            if gp is not None and gp < self.watchdog_goodput_ratio \
+                    and pre > prev:
+                fired[f"goodput[{name}]"] = (
+                    f"goodput ratio {gp:.2f} cratered below "
+                    f"{self.watchdog_goodput_ratio} right after "
+                    f"preemptions grew ({prev} -> {pre}) on {name}")
+            if not entry.get("draining"):
+                loads.append(_queue_depth_for(doc) + int(
+                    (doc.get("slots") or {}).get("active") or 0))
+        if len(loads) >= 2 and max(loads) - min(loads) > self.watchdog_skew:
+            fired["replica_skew"] = (
+                f"replica load spread {max(loads) - min(loads)} exceeds "
+                f"{self.watchdog_skew} (one replica is hoarding or "
+                f"starving)")
+        events = []
+        with self._lock:
+            for key in sorted(fired):
+                if key not in self._wd_state:
+                    self._wd_state[key] = {"since": now,
+                                           "reason": fired[key]}
+                    events.append((key, "fired", fired[key]))
+                else:
+                    self._wd_state[key]["reason"] = fired[key]
+            for key in [k for k in sorted(self._wd_state)
+                        if k not in fired]:
+                events.append((key, "cleared",
+                               self._wd_state[key]["reason"]))
+                del self._wd_state[key]
+            active = [dict(v, rule=k)
+                      for k, v in sorted(self._wd_state.items())]
+        # flight records outside the router lock (locks are leaves)
+        for key, state, reason in events:
+            self._flight.record(
+                "fleet", phase="watchdog", fleet=self.fleet_id,
+                rule=key, state=state, reason=reason)
+        return active
+
+    def expose_text(self) -> str:
+        """One federated Prometheus scrape for the whole fleet: every
+        replica's series re-labelled ``replica="<name>"`` (bounded by
+        fleet size — the PHT005 rule for the injected label) plus the
+        router's own ``fleet_*`` series.  A replica handle exposing
+        ``metrics_text()`` (the HTTP shim contract) is scraped through
+        it; in-process engines are sliced out of the shared registry by
+        their ``engine=`` label."""
+        with self._lock:
+            reps = [(r.name, r.handle) for r in self._replicas.values()]
+        parts = {}
+        for name, handle in reps:
+            mt = getattr(handle, "metrics_text", None)
+            try:
+                if callable(mt):
+                    parts[name] = mt()
+                else:
+                    parts[name] = self._registry.expose_text(
+                        label_filter={
+                            "engine": getattr(handle, "engine_id", name)})
+            except Exception as e:  # noqa: BLE001 — scrape must not die
+                parts[name] = (f"# replica scrape failed: "
+                               f"{type(e).__name__}\n")
+        return (_obs.federate_text(parts)
+                + self._registry.expose_text(
+                    label_filter={"fleet": self.fleet_id}))

@@ -374,11 +374,11 @@ class Request:
                  "_t_submit", "_t_first", "rid", "_span_queue",
                  "_span_life", "lifecycle", "_tick_mark", "deadline_s",
                  "on_token", "session", "priority", "_prank",
-                 "_preempts", "_t_queued")
+                 "_preempts", "_t_queued", "trace_ctx")
 
     def __init__(self, prompt, max_new_tokens, temperature=None,
                  top_k=None, top_p=None, deadline_s=None, on_token=None,
-                 session=None, priority=None):
+                 session=None, priority=None, trace_ctx=None):
         self.rid = next(_REQ_IDS)   # process-wide request id (spans/flight)
         self.prompt = np.asarray(prompt, np.int32).reshape(-1)
         self.max_new_tokens = int(max_new_tokens)
@@ -415,6 +415,19 @@ class Request:
                           "priority": self.priority}
         if self.deadline_s is not None:
             self.lifecycle["deadline_s"] = self.deadline_s
+        # fleet trace context (docs/OBSERVABILITY.md, "Fleet telemetry"):
+        # a plain dict minted by the router — fleet id, fleet-wide
+        # request id, dispatch attempt ordinal.  Stamped into the
+        # lifecycle record so this replica's view of the request links
+        # back to the router decision that placed it (and, post-HTTP,
+        # to the header the context will ride in).
+        self.trace_ctx = dict(trace_ctx) if trace_ctx else None
+        if self.trace_ctx is not None:
+            if self.trace_ctx.get("fleet_rid") is not None:
+                self.lifecycle["fleet_rid"] = self.trace_ctx["fleet_rid"]
+            if self.trace_ctx.get("attempt") is not None:
+                self.lifecycle["dispatch_attempt"] = \
+                    self.trace_ctx["attempt"]
         # lifecycle spans (no-ops while tracing is disabled): queued =
         # submit->admit, life = submit->finish/EOS
         self._span_queue = self._span_life = _tr._NOOP
@@ -1585,7 +1598,8 @@ class ServingEngine:
     # scheduling
     def submit(self, prompt, max_new_tokens=32, temperature=None,
                top_k=None, top_p=None, deadline_s=None,
-               on_token=None, session=None, priority=None) -> Request:
+               on_token=None, session=None, priority=None,
+               trace_ctx=None) -> Request:
         """Queue a request.  ``deadline_s`` bounds the request's TOTAL
         wall budget from submit: still queued past it (queue-wait is
         where overload deadlines actually die) or still decoding past
@@ -1618,11 +1632,20 @@ class ServingEngine:
         admission pressure a strictly lower-priority in-flight stream
         may be PREEMPTED — re-queued, not aborted; its committed
         tokens replay through the prefix/session cache on re-admission
-        (docs/SERVING.md, "Priority and preemption")."""
+        (docs/SERVING.md, "Priority and preemption").
+
+        ``trace_ctx`` (optional plain dict, minted by a fleet router —
+        ``{"fleet", "fleet_rid", "attempt"}``) links this replica-local
+        request back to the fleet-wide one that dispatched it: stamped
+        into the lifecycle record and onto the lifecycle spans so a
+        merged chrome trace shows router decision → replica ticks as
+        one swimlane (docs/OBSERVABILITY.md, "Fleet telemetry").  The
+        dict is the future HTTP header contract — an HTTP replica shim
+        passes it through unchanged."""
         req = Request(prompt, max_new_tokens, temperature=temperature,
                       top_k=top_k, top_p=top_p, deadline_s=deadline_s,
                       on_token=on_token, session=session,
-                      priority=priority)
+                      priority=priority, trace_ctx=trace_ctx)
         need = len(req.prompt) + req.max_new_tokens
         # reserve headroom past the last committed row for the widest
         # in-flight write: a prefill chunk, or the (spec_k+1)-wide verify
@@ -1660,16 +1683,26 @@ class ServingEngine:
         # chrome-trace lane, so a request reads as a single swimlane
         # from submit to finish (slots are reused across requests, so a
         # slot-keyed lane would interleave strangers)
+        # fleet trace context rides ONLY the lifecycle spans (they carry
+        # both rid and fleet_rid, which is all the cross_stack stitcher
+        # needs to re-lane the per-tick spans) — the per-token hot path
+        # stays untouched, so armed fleet tracing adds zero per-tick cost
+        fleet_attrs = ({"fleet_rid": req.trace_ctx["fleet_rid"]}
+                       if req.trace_ctx is not None
+                       and req.trace_ctx.get("fleet_rid") is not None
+                       else {})
         req._span_life = _tr.start_span(
             "serving.request", _tid=req.rid, rid=req.rid,
             engine=self._engine_id,
-            prompt_len=len(req.prompt), max_new=req.max_new_tokens)
+            prompt_len=len(req.prompt), max_new=req.max_new_tokens,
+            **fleet_attrs)
         req._span_queue = _tr.start_span(
             "serving.request.queued", _tid=req.rid, rid=req.rid,
-            engine=self._engine_id)
+            engine=self._engine_id, **fleet_attrs)
         self._flight.record(
             "req", phase="submit", rid=req.rid, engine=self._engine_id,
-            prompt_len=len(req.prompt), max_new=req.max_new_tokens)
+            prompt_len=len(req.prompt), max_new=req.max_new_tokens,
+            **fleet_attrs)
         with self._lock:
             draining = self._draining
             if not draining:
@@ -3175,6 +3208,17 @@ class ServingEngine:
                 out["prefix_cached_pages"] = (
                     len(self._prefix) if self._prefix is not None else 0)
             return out
+
+    def slo_windows(self) -> dict:
+        """The live rolling SLO windows (``{"ttft", "tpot", "e2e",
+        "queue_wait"} -> SlidingWindowHistogram``) — the percentile
+        source behind :meth:`load_report`'s ``slo`` block, exposed for
+        in-process fleet aggregation (``metrics.merged_percentiles``
+        pools several replicas' windows without losing the
+        never-exceeds-observed-max clamp).  In-process only: HTTP
+        replicas federate through ``/load``'s serialized percentiles
+        instead."""
+        return dict(self._slo)
 
     def load_report(self) -> dict:
         """The machine-readable load/capacity report — the versioned

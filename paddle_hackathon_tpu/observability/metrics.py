@@ -34,7 +34,7 @@ from .sanitizers import make_lock, share_object
 __all__ = ["MetricRegistry", "Counter", "Gauge", "Histogram",
            "SlidingWindowHistogram", "get_registry", "instrument_jit",
            "log_buckets", "record_device_memory", "set_trace_sink",
-           "snapshot_delta"]
+           "snapshot_delta", "federate_text", "merged_percentiles"]
 
 
 def log_buckets(lo: float = 1e-6, hi: float = 64.0, per_decade: int = 3):
@@ -511,12 +511,24 @@ class MetricRegistry:
                          .replace("\n", r"\n")
         return "{" + ",".join(f'{k}="{esc(v)}"' for k, v in items) + "}"
 
-    def expose_text(self) -> str:
-        """Prometheus text exposition format (version 0.0.4)."""
+    def expose_text(self, label_filter: Optional[dict] = None) -> str:
+        """Prometheus text exposition format (version 0.0.4).
+
+        ``label_filter`` keeps only series whose labels are a superset of
+        the given ``{key: value}`` pairs (same subset semantics as
+        :meth:`total`) — the per-replica slice a fleet router federates
+        when replicas share one in-process registry.  Families with no
+        surviving series are omitted entirely (no orphan HELP/TYPE)."""
+        want = ({(k, str(v)) for k, v in label_filter.items()}
+                if label_filter else None)
         lines = []
         with self._lock:
             fams = list(self._families.values())
         for fam in fams:
+            children = [c for c in fam.children()
+                        if want is None or want <= set(c.labels)]
+            if want is not None and not children:
+                continue
             help = fam.help + (f" [{fam.unit}]" if fam.unit else "")
             if help:
                 # HELP escaping per the text format: backslash and
@@ -525,7 +537,7 @@ class MetricRegistry:
                 help = help.replace("\\", r"\\").replace("\n", r"\n")
                 lines.append(f"# HELP {fam.name} {help}")
             lines.append(f"# TYPE {fam.name} {fam.kind}")
-            for c in fam.children():
+            for c in children:
                 if isinstance(c, Histogram):
                     with c._lock:
                         counts = list(c._counts)
@@ -616,6 +628,93 @@ def snapshot_delta(prev: dict, cur: dict) -> dict:
                                 "help": fam.get("help", ""),
                                 "unit": fam.get("unit", ""),
                                 "series": series}
+    return out
+
+
+def federate_text(parts: Dict[str, str], label: str = "replica") -> str:
+    """Merge several Prometheus text expositions into one fleet scrape.
+
+    ``parts`` maps an instance name (e.g. a replica's engine id) to that
+    instance's ``expose_text()`` output.  Every sample line gains a
+    ``<label>="<instance>"`` label (injected FIRST, so a replica's own
+    labels stay intact after it), and repeated ``# HELP``/``# TYPE``
+    headers for the same family collapse to the first occurrence — the
+    merged text stays valid exposition format.  Pure text transform: it
+    never touches the source registries, so replicas behind HTTP
+    federate exactly the same way as in-process ones.
+
+    Cardinality note: the injected label's values are the fleet's
+    replica names — bounded by fleet size, never request-derived."""
+    def esc(v):
+        return str(v).replace("\\", r"\\").replace('"', r'\"') \
+                     .replace("\n", r"\n")
+
+    out = []
+    seen_meta = set()
+    for inst in sorted(parts):
+        inj = f'{label}="{esc(inst)}"'
+        for line in parts[inst].splitlines():
+            if not line.strip():
+                continue
+            if line.startswith("#"):
+                # "# HELP <name> ..." / "# TYPE <name> <kind>" — dedupe
+                # per (directive, family): N replicas of one build emit
+                # identical headers
+                bits = line.split(None, 3)
+                key = tuple(bits[:3])
+                if key in seen_meta:
+                    continue
+                seen_meta.add(key)
+                out.append(line)
+                continue
+            brace = line.find("{")
+            space = line.find(" ")
+            if brace != -1 and (space == -1 or brace < space):
+                close = line.rfind("}")
+                labels = line[brace + 1:close]
+                out.append(line[:brace] + "{" + inj
+                           + ("," + labels if labels else "")
+                           + "}" + line[close + 1:])
+            else:
+                name, _, tail = line.partition(" ")
+                out.append(f"{name}{{{inj}}} {tail}")
+    return "\n".join(out) + ("\n" if out else "")
+
+
+def merged_percentiles(windows, qs=(0.5, 0.95, 0.99)):
+    """Fleet-merged rolling summary over several replicas'
+    :class:`SlidingWindowHistogram` windows (same shape as
+    :meth:`SlidingWindowHistogram.percentiles`; None when every window
+    is empty).  Bucket counts add; the merged max is the max of the
+    observed maxes — and because :func:`_quantile_from_counts` clamps
+    interpolation to that max, a merged quantile can NEVER exceed the
+    largest value any single replica actually observed.  Requires
+    identical bucket bounds (all built-in SLO windows share the default
+    log buckets)."""
+    windows = [w for w in windows if w is not None]
+    if not windows:
+        return None
+    buckets = windows[0].buckets
+    for w in windows[1:]:
+        if w.buckets != buckets:
+            raise ValueError("merged_percentiles needs identical buckets")
+    counts = [0] * (len(buckets) + 1)
+    total, s, vmax = 0, 0.0, float("-inf")
+    for w in windows:
+        wc, wt, ws, wm = w._merged()
+        if not wt:
+            continue
+        for j, c in enumerate(wc):
+            counts[j] += c
+        total += wt
+        s += ws
+        vmax = max(vmax, wm)
+    if not total:
+        return None
+    out = {"count": total, "mean": s / total, "max": vmax}
+    for q in qs:
+        out[f"p{int(q * 100)}"] = _quantile_from_counts(
+            buckets, counts, total, vmax, q)
     return out
 
 

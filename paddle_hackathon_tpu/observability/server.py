@@ -9,6 +9,7 @@ process is live:
     curl localhost:9200/metrics          # Prometheus exposition
     curl localhost:9200/healthz          # liveness beacons (tick/step age)
     curl localhost:9200/load             # machine-readable load/capacity
+    curl localhost:9200/fleet            # federated fleet report(s)
     curl localhost:9200/debug/flight     # flight-recorder ring as JSON
     curl localhost:9200/debug/requests   # in-flight serving slot tables
     srv.stop()
@@ -76,6 +77,13 @@ class _Handler(BaseHTTPRequestHandler):
                 # calls, so a scrape never blocks the serving tick)
                 self._send_json({"version": 1, "ts": time.time(),
                                  "engines": _tracing.load_reports()})
+            elif url.path == "/fleet":
+                # the fleet-tier federation: every live FleetRouter's
+                # aggregated document — per-replica /load bodies with
+                # staleness ages, dispatch percentiles, watchdog state
+                # (docs/OBSERVABILITY.md, "Fleet telemetry")
+                self._send_json({"version": 1, "ts": time.time(),
+                                 "fleets": _tracing.fleet_reports()})
             elif url.path == "/debug/flight":
                 self._send_json(_flight.get_flight_recorder().dump())
             elif url.path == "/debug/requests":
@@ -84,7 +92,7 @@ class _Handler(BaseHTTPRequestHandler):
             else:
                 self._send_json({"error": "not found",
                                  "endpoints": ["/metrics", "/healthz",
-                                               "/load",
+                                               "/load", "/fleet",
                                                "/debug/flight",
                                                "/debug/requests"]}, 404)
         except Exception as e:  # noqa: BLE001 — introspection must not die
@@ -100,6 +108,16 @@ class _Handler(BaseHTTPRequestHandler):
         payload = {"ok": True, "ts": time.time(),
                    "uptime_s": round(time.time() - self.server._t_start, 3),
                    "beacons": ages}
+        fleets = _tracing.fleet_health_reports()
+        if fleets:
+            # fleet tier: per-replica beacon ages aggregated per router
+            # (stalest replica first), named watchdog degradations — a
+            # wedged replica trips THIS one probe instead of N
+            # per-replica ones.  Body-only: the top-level ok/503
+            # judgment stays with ?max_age (the beacons above already
+            # include every replica's) so existing probes keep their
+            # exact semantics.
+            payload["fleets"] = fleets
         # keep_blank_values: '?max_age=' (an unset template variable) must
         # hit the 400 below, not vanish from q and silently disable the
         # staleness alert the probe exists for

@@ -50,7 +50,9 @@ __all__ = ["span", "start_span", "end_span", "add_span", "Span",
            "register_introspection_source",
            "unregister_introspection_source", "introspection_tables",
            "register_load_source", "unregister_load_source",
-           "load_reports"]
+           "load_reports",
+           "register_fleet_source", "unregister_fleet_source",
+           "fleet_reports", "fleet_health_reports"]
 
 _enabled = False
 # Armed by profiler.Profiler while recording:
@@ -320,5 +322,57 @@ def load_reports() -> dict:
         try:
             out[name] = obj.load_report()
         except Exception as e:  # noqa: BLE001 — the router poll must not die
+            out[name] = {"error": f"{type(e).__name__}: {e}"}
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Fleet report sources (for /fleet and the fleet block of /healthz)
+# ---------------------------------------------------------------------------
+
+_fleet_sources: "weakref.WeakValueDictionary[str, object]" = \
+    weakref.WeakValueDictionary()
+_fleet_sources_lock = make_lock("tracing.fleet_sources")
+
+
+def register_fleet_source(name: str, obj) -> None:
+    """Register a live fleet router exposing ``load_report() -> dict``
+    (the federated fleet document) and ``health_report() -> dict`` (the
+    per-replica beacon digest).  Held weakly, same as the load sources:
+    a dropped router vanishes from ``/fleet`` without unregister."""
+    with _fleet_sources_lock:
+        _fleet_sources[name] = obj
+
+
+def unregister_fleet_source(name: str) -> None:
+    with _fleet_sources_lock:
+        _fleet_sources.pop(name, None)
+
+
+def fleet_reports() -> dict:
+    """``{fleet: router.load_report()}`` over live routers — the body of
+    the ``/fleet`` endpoint.  Snapshot-then-call, same lock discipline
+    as :func:`load_reports` (a router's report takes its own lock)."""
+    with _fleet_sources_lock:
+        items = sorted(_fleet_sources.items())
+    out = {}
+    for name, obj in items:
+        try:
+            out[name] = obj.load_report()
+        except Exception as e:  # noqa: BLE001 — the fleet poll must not die
+            out[name] = {"error": f"{type(e).__name__}: {e}"}
+    return out
+
+
+def fleet_health_reports() -> dict:
+    """``{fleet: router.health_report()}`` over live routers — the fleet
+    block of ``/healthz`` (stalest replica named first in each)."""
+    with _fleet_sources_lock:
+        items = sorted(_fleet_sources.items())
+    out = {}
+    for name, obj in items:
+        try:
+            out[name] = obj.health_report()
+        except Exception as e:  # noqa: BLE001 — a health probe must not die
             out[name] = {"error": f"{type(e).__name__}: {e}"}
     return out

@@ -9,6 +9,17 @@ Here each rank's ``profiler.export_chrome_tracing`` JSON becomes one
 process row in a merged chrome trace: pid = rank, thread rows preserved,
 optional time alignment on a named sync marker (e.g. the per-step
 ``RecordEvent("step")``) so ranks with skewed host clocks line up.
+
+``stitch_fleet=True`` (CLI ``--stitch-fleet``) adds a serving-fleet
+pass: events carrying a fleet trace context (``fleet_rid`` in their
+args — the router's ``fleet.*`` spans emit it directly, and the
+replicas' ``serving.request`` lifecycle spans carry both ``rid`` and
+``fleet_rid``, which maps every other rid-keyed replica span) are
+re-homed onto one synthesized "fleet requests" process with one thread
+lane per fleet rid — router decision, each placement attempt, and the
+replica's per-tick spans read as ONE swimlane per request, across
+however many replicas (and, later, processes) served it
+(docs/OBSERVABILITY.md, "Fleet telemetry").
 """
 
 from __future__ import annotations
@@ -86,9 +97,56 @@ def _flight_rows(path: str, pid: int) -> List[dict]:
     return rows
 
 
+def _stitch_fleet(merged: dict) -> dict:
+    """Re-home fleet-request events onto per-``fleet_rid`` swimlanes.
+
+    Pass 1 learns ``(pid, rid) -> fleet_rid`` from events whose args
+    carry BOTH (the replica lifecycle spans; pid-scoped because engine
+    rids are only unique within a process).  Pass 2 moves every event
+    that resolves to a fleet rid — directly or via its rid — onto a
+    synthesized process (one pid above the ranks) with ``tid =
+    fleet_rid``, leaving unrelated events (ticks serving other
+    requests, counters, flight rows without a rid) untouched on their
+    original rank rows.  Mutates and returns ``merged``."""
+    events = merged.get("traceEvents", [])
+    rid_map = {}
+    for e in events:
+        a = e.get("args") or {}
+        if a.get("fleet_rid") is not None and a.get("rid") is not None:
+            rid_map[(e.get("pid"), a["rid"])] = a["fleet_rid"]
+    fleet_pid = max((e["pid"] for e in events
+                     if isinstance(e.get("pid"), int)), default=-1) + 1
+    lanes = set()
+    for e in events:
+        if e.get("ph") == "M":
+            continue
+        a = e.get("args") or {}
+        frid = a.get("fleet_rid")
+        if frid is None:
+            frid = rid_map.get((e.get("pid"), a.get("rid")))
+            if frid is None:
+                continue
+        e["pid"] = fleet_pid
+        e["tid"] = frid
+        lanes.add(frid)
+    if lanes:
+        events.append({"ph": "M", "name": "process_name",
+                       "pid": fleet_pid,
+                       "args": {"name": "fleet requests (rid-stitched)"}})
+        events.append({"ph": "M", "name": "process_sort_index",
+                       "pid": fleet_pid,
+                       "args": {"sort_index": fleet_pid}})
+        for frid in sorted(lanes):
+            events.append({"ph": "M", "name": "thread_name",
+                           "pid": fleet_pid, "tid": frid,
+                           "args": {"name": f"fleet_rid={frid}"}})
+    return merged
+
+
 def merge_traces(paths: List[str], align_marker: Optional[str] = None,
                  out_path: Optional[str] = None,
-                 flight_paths: Optional[List[str]] = None) -> dict:
+                 flight_paths: Optional[List[str]] = None,
+                 stitch_fleet: bool = False) -> dict:
     """Merge per-rank chrome traces into one cluster timeline.
 
     ``align_marker``: event name whose first occurrence is treated as t=0
@@ -101,6 +159,10 @@ def merge_traces(paths: List[str], align_marker: Optional[str] = None,
     the same timeline as the spans leading up to it.  Incompatible with
     ``align_marker`` rebasing (the dumps carry no marker), so flight
     rows keep absolute perf-clock time.
+
+    ``stitch_fleet``: run the fleet-request stitching pass (module
+    docstring) after the merge — one swimlane per ``fleet_rid``
+    spanning router spans and every replica's share of the request.
     """
     if align_marker and flight_paths:
         raise ValueError(
@@ -160,6 +222,8 @@ def merge_traces(paths: List[str], align_marker: Optional[str] = None,
         next_pid = (max(ranks) + 1) if ranks else 0
         for j, fp in enumerate(sorted(flight_paths)):
             merged["traceEvents"].extend(_flight_rows(fp, next_pid + j))
+    if stitch_fleet:
+        _stitch_fleet(merged)
     if out_path:
         with open(out_path, "w") as f:
             json.dump(merged, f)
@@ -178,6 +242,9 @@ def main(argv=None):
     ap.add_argument("--flight", nargs="*", default=None,
                     help="flight-recorder dump(s) to overlay as instant "
                          "events (incompatible with --align)")
+    ap.add_argument("--stitch-fleet", action="store_true",
+                    help="re-home fleet-request events (fleet_rid/rid "
+                         "args) onto one swimlane per fleet request")
     args = ap.parse_args(argv)
     if args.align and args.flight:
         raise SystemExit("--flight rows keep absolute perf-clock time and "
@@ -187,7 +254,7 @@ def main(argv=None):
     if not paths:
         raise SystemExit(f"no traces found under {args.trace_dir}")
     merge_traces(paths, align_marker=args.align, out_path=args.out,
-                 flight_paths=args.flight)
+                 flight_paths=args.flight, stitch_fleet=args.stitch_fleet)
     print(f"merged {len(paths)} rank traces -> {args.out}")
 
 

@@ -82,6 +82,9 @@ _FILE_COST = {
                             # for the two new rules, but the extra
                             # fixture/stats tests add ~2s)
     "test_checkpointing.py": 8,   # host-only protocol/fault units
+    "test_fleet_observability.py": 6,  # host-only fakes: trace ctx,
+                                       # federation, forensics, watchdog,
+                                       # stitch; no engine ever built
     "test_fleet.py": 10,    # host-only router/breaker/scoring units +
                             # 2 engine constructions (no tick compiles);
                             # the failover/drain/affinity drills are

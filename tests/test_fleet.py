@@ -331,13 +331,29 @@ class TestRouterDispatch:
         a.submit_error = None
         assert r.submit([1], 2).replica == "db"
 
-    def test_bad_report_version_counts_probe_error(self):
+    def test_bad_report_version_skipped_counted_warned_once(self):
+        """An unknown /load version is a deploy-skew signal, not a
+        replica failure: the replica is skipped for scoring, the
+        mismatch books on its own labeled counter (NOT probe_error —
+        no breaker penalty: the replica is healthy, just newer/older),
+        and the operator warning fires once per replica, not per
+        probe."""
+        import warnings as _w
         a = _FakeEngine("va", version=3)
         b = _FakeEngine("vb")
         r = FleetRouter([a, b], backoff_s=0.001)
-        assert r.submit([1], 2).replica == "vb"
+        with _w.catch_warnings(record=True) as rec:
+            _w.simplefilter("always")
+            assert r.submit([1], 2).replica == "vb"
+            assert r.submit([1], 2).replica == "vb"
+        skew = [w for w in rec if "va" in str(w.message)]
+        assert len(skew) == 1                      # warn-once per replica
+        assert _total("fleet_load_version_mismatch_total",
+                      fleet=r.fleet_id, replica="va") >= 2
         assert _total("fleet_dispatch_total", fleet=r.fleet_id,
-                      replica="va", outcome="probe_error") >= 1
+                      replica="va", outcome="probe_error") == 0
+        info = r.introspect_requests()["replicas"]
+        assert info["va"]["consecutive_failures"] == 0   # no penalty
 
     def test_stale_health_fault_point_skips_replica(self):
         a, b = _FakeEngine("ha", headroom=9000), _FakeEngine("hb",

@@ -12,11 +12,19 @@ Usage::
 
     python tools/metrics_dump.py snap.json            # pretty-print
     python tools/metrics_dump.py before.json after.json   # diff
+    python tools/metrics_dump.py --group replica fleet.json   # federated
 
 The diff subtracts counters and histogram counts/sums (what HAPPENED
 between the snapshots) and shows gauges as old -> new; bench rows'
 embedded ``"metrics"`` dicts are a separate compact format gated by
 ``tools/perf_gate.py``, not this tool's input.
+
+``--group LABEL`` partitions the output into one section per value of
+that label — the federated-fleet read: a snapshot taken through
+``FleetRouter.expose_text()`` carries a bounded ``replica=`` label on
+every replica-sourced series, and grouping by it answers "what did
+replica X do" without grep (docs/OBSERVABILITY.md, "Fleet telemetry").
+Series without the label land in a trailing ``(no LABEL)`` section.
 """
 
 import argparse
@@ -38,8 +46,34 @@ def _fmt(v):
     return f"{int(v):,}"
 
 
-def render(snap, out=None):
-    """One aligned line per series: NAME{labels} TYPE VALUE [detail]."""
+def _group_key(s, group):
+    """Section a series belongs to under ``--group LABEL`` (None = flat)."""
+    if group is None:
+        return None
+    return (s.get("labels") or {}).get(group)
+
+
+def _emit_grouped(rows, group, out):
+    """rows: (group_value, key, col2, col3).  Flat when group is None;
+    otherwise one header per label value (sorted, ungrouped last)."""
+    width = max((len(r[1]) for r in rows), default=0)
+    if group is None:
+        for _, key, a, b in rows:
+            out.write(f"{key:<{width}}  {a:<9}  {b}\n".rstrip() + "\n")
+        return
+    rows.sort(key=lambda r: (r[0] is None, r[0] or "", r[1]))
+    current = object()
+    for gv, key, a, b in rows:
+        if gv != current:
+            current = gv
+            head = f'{group}="{gv}"' if gv is not None else f"(no {group})"
+            out.write(f"== {head} ==\n")
+        out.write(f"  {key:<{width}}  {a:<9}  {b}\n".rstrip() + "\n")
+
+
+def render(snap, out=None, group=None):
+    """One aligned line per series: NAME{labels} TYPE VALUE [detail].
+    ``group``: label name to section the output by (module docstring)."""
     out = out or sys.stdout   # resolved at call time: a captured/replaced
     rows = []                 # stdout must not be baked in at import
     for name, fam in sorted(snap.get("metrics", {}).items()):
@@ -53,21 +87,21 @@ def render(snap, out=None):
                         detail += f" {q}={s[q]:.6g}"
                 if s.get("max") is not None:
                     detail += f" max={s['max']:.6g}"
-                rows.append((key, fam["type"], detail))
+                rows.append((_group_key(s, group), key, fam["type"], detail))
             else:
-                rows.append((key, fam["type"], _fmt(s.get("value"))))
-    width = max((len(r[0]) for r in rows), default=0)
-    for key, kind, val in rows:
-        out.write(f"{key:<{width}}  {kind:<9}  {val}\n")
+                rows.append((_group_key(s, group), key, fam["type"],
+                             _fmt(s.get("value"))))
+    _emit_grouped(rows, group, out)
     return len(rows)
 
 
-def render_diff(prev, cur, out=None):
+def render_diff(prev, cur, out=None, group=None):
     """Changed series only, prev -> cur (via observability.snapshot_delta
     for the counter/histogram subtraction semantics).  Series present in
     only one snapshot — engine churn drops labelled series, new sites
     register fresh families mid-run — render as added/removed instead of
-    raising or silently vanishing."""
+    raising or silently vanishing.  ``group``: section by a label value
+    (module docstring) — per-replica "what changed" in a federated diff."""
     out = out or sys.stdout
     sys.path.insert(0, __file__.rsplit("/", 2)[0])
     from paddle_hackathon_tpu.observability import snapshot_delta
@@ -85,33 +119,41 @@ def render_diff(prev, cur, out=None):
     for name, fam in sorted(delta["metrics"].items()):
         for s in fam["series"]:
             key = name + _labels(s.get("labels"))
+            gv = _group_key(s, group)
             old = prev_series(name, s.get("labels", {}))
             tag = " (added)" if old is None else ""
             if fam["type"] == "histogram":
                 if not s.get("count") and not tag:
                     continue
-                rows.append((key, f"+{_fmt(s.get('count'))} obs{tag}",
+                rows.append((gv, key, f"+{_fmt(s.get('count'))} obs{tag}",
                              f"sum +{s.get('sum', 0.0):.6g}"))
             elif fam["type"] == "counter":
                 if not s.get("value") and not tag:
                     continue
-                rows.append((key, f"+{_fmt(s.get('value'))}{tag}", ""))
+                rows.append((gv, key, f"+{_fmt(s.get('value'))}{tag}", ""))
             else:
                 oldv = old.get("value") if old else None
                 if old is not None and oldv == s.get("value"):
                     continue
-                rows.append((key, f"{_fmt(oldv)} -> {_fmt(s.get('value'))}"
-                                  f"{tag}", ""))
+                rows.append((gv, key,
+                             f"{_fmt(oldv)} -> {_fmt(s.get('value'))}{tag}",
+                             ""))
 
     def series_keys(m):
         return {(name, tuple(sorted(s.get("labels", {}).items())))
                 for name, fam in m.items() for s in fam.get("series", [])}
 
     for name, lk in sorted(series_keys(pm) - series_keys(cm)):
-        rows.append((name + _labels(dict(lk)), "(removed)", ""))
-    width = max((len(r[0]) for r in rows), default=0)
-    for key, change, extra in rows:
-        out.write(f"{key:<{width}}  {change}{'  ' + extra if extra else ''}\n")
+        lbl = dict(lk)
+        rows.append((lbl.get(group) if group else None,
+                     name + _labels(lbl), "(removed)", ""))
+    if group is not None:
+        _emit_grouped(rows, group, out)
+    else:
+        width = max((len(r[1]) for r in rows), default=0)
+        for _, key, change, extra in rows:
+            out.write(f"{key:<{width}}  "
+                      f"{change}{'  ' + extra if extra else ''}\n")
     if not rows:
         out.write("(no changes)\n")
     return len(rows)
@@ -123,15 +165,18 @@ def main(argv=None):
     ap.add_argument("snapshot", help="registry snapshot JSON")
     ap.add_argument("snapshot2", nargs="?",
                     help="later snapshot: show what changed in between")
+    ap.add_argument("--group", default=None, metavar="LABEL",
+                    help="section output by this label's value (e.g. "
+                         "--group replica for a federated fleet snapshot)")
     args = ap.parse_args(argv)
     with open(args.snapshot) as f:
         snap = json.load(f)
     if args.snapshot2 is None:
-        render(snap)
+        render(snap, group=args.group)
         return 0
     with open(args.snapshot2) as f:
         snap2 = json.load(f)
-    render_diff(snap, snap2)
+    render_diff(snap, snap2, group=args.group)
     return 0
 
 

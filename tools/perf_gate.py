@@ -411,6 +411,41 @@ def compare_slo_scheduling(rows):
     return bad
 
 
+def compare_fleet_telemetry(rows):
+    """[(metric, reason)] for fleet serving rows (``metrics.
+    fleet_replicas`` present) whose armed-telemetry evidence is
+    vacuous: the row must carry real dispatch-latency percentiles (the
+    router's own ``fleet_dispatch_seconds`` histogram observed every
+    placement), a stated retry rate, and the jit_builds_warm/total
+    pair — compare_metrics holds that pair to zero growth, which for
+    THIS row is the claim that the armed observability plane (spans,
+    trace-context plumbing, federation labels) compiled nothing.  A
+    row missing the builds pair would silently exempt itself from that
+    gate, so its absence fails here by name.  Non-fleet rows are
+    skipped."""
+    bad = []
+    for r in rows:
+        m = r.get("metrics") or {}
+        if m.get("fleet_replicas") is None:
+            continue
+        if (m.get("fleet_dispatch_p50_ms") is None
+                or m.get("fleet_dispatch_p99_ms") is None):
+            bad.append((r["metric"],
+                        "no dispatch-latency percentiles — the router's "
+                        "fleet_dispatch_seconds histogram observed no "
+                        "placement"))
+        if m.get("fleet_retry_rate") is None:
+            bad.append((r["metric"],
+                        "fleet_retry_rate missing from the embedded "
+                        "telemetry"))
+        if (m.get("jit_builds_warm") is None
+                or m.get("jit_builds_total") is None):
+            bad.append((r["metric"],
+                        "jit_builds_warm/total missing — cannot prove "
+                        "the armed telemetry plane compiled nothing"))
+    return bad
+
+
 def compare_pool_leaks(rows):
     """[(metric, leaked)] for paged serving rows whose KV page pool did
     not return to 0 allocated after the drain + prefix-cache drop
@@ -455,9 +490,10 @@ def suite_gate(tolerance, rows=None):
     bad_offload = compare_zero_offload(rows)
     bad_chat = compare_chat_ttft(rows)
     bad_slo = compare_slo_scheduling(rows)
+    bad_fleet = compare_fleet_telemetry(rows)
     if (bad or bad_ratio or bad_metrics or bad_leaks or bad_timing
             or bad_errors or bad_moe or bad_zero or bad_offload
-            or bad_chat or bad_slo):
+            or bad_chat or bad_slo or bad_fleet):
         if bad:
             print(f"perf_gate[suite] FAIL: {len(bad)} configs regressed "
                   f">{tolerance:.0%}:")
@@ -492,6 +528,9 @@ def suite_gate(tolerance, rows=None):
                   f"degraded to re-prefilling the conversation")
         for metric, reason in bad_slo:
             print(f"perf_gate[suite] FAIL: {metric} {reason}")
+        for metric, reason in bad_fleet:
+            print(f"perf_gate[suite] FAIL: {metric} fleet telemetry "
+                  f"evidence is vacuous ({reason})")
         for metric, leaked in bad_leaks:
             print(f"perf_gate[suite] FAIL: {metric} leaked {leaked} KV "
                   f"pool pages (pages_in_use != 0 after drain + "

@@ -18,12 +18,16 @@ Chains, in order:
    bridge must fail the pre-PR check loudly, not as a downstream XLA
    abort).
 4. **fault drills** — deterministic ``PHT_FAULTS`` drills against
-   host-only stubs (no tick program compiles).  Currently one: the
-   fleet dispatch-failover drill — an injected ``fleet.dispatch`` fault
+   host-only stubs (no tick program compiles).  The fleet
+   dispatch-failover drill — an injected ``fleet.dispatch`` fault
    plus a submit-time replica death must re-dispatch cleanly (retry
-   books, survivor completes).  The started-stream loud-failure path
-   and mid-flight kills live in ``tests/test_fleet.py``'s acceptance
-   drills, not here.  Add new drills to ``_DRILLS``.
+   books, survivor completes); the fleet-telemetry drill — a forced
+   mid-request failover must land router + both replicas' spans on ONE
+   rid-stitched swimlane in the merged chrome trace, with the
+   federated exposition labeled per replica and zero leaked pages.
+   The started-stream loud-failure path and mid-flight kills live in
+   ``tests/test_fleet.py``'s acceptance drills, not here.  Add new
+   drills to ``_DRILLS``.
 
 Exit codes (perf_gate convention): 0 = every step that ran passed,
 1 = at least one step failed, 2 = usage error.
@@ -205,6 +209,120 @@ print('session drill: drain donation + pin migration under '
       'dispatch fault OK')
 """
 
+# Fleet-telemetry drill (PR 19).  Two host-only stub replicas behind a
+# FleetRouter, span sink armed; the PHT_FAULTS ``serving.tick[tele-a]``
+# point (which the stub fires after accepting a request, the same point
+# a real engine's tick loop owns) kills the first placement AFTER
+# submit succeeded — a genuine failover, not a placement retry.  The
+# drill then closes the whole observability loop: federated exposition
+# carries both replicas under bounded ``replica=`` labels plus the
+# fleet-only series, ``load_report()`` serializes, and the merged
+# chrome trace (``--stitch-fleet`` pass) shows router dispatch +
+# failover spans AND both replicas' lifecycle spans — including a
+# rid-only tick span mapped via the rid bridge — on ONE
+# ``fleet_rid`` swimlane.  Fake KV page accounting on the stubs must
+# read zero after the failover (the dead attempt released its pages).
+_TELEMETRY_DRILL = """
+import itertools, json, os, tempfile, threading, time
+import numpy as np
+from paddle_hackathon_tpu.observability import faults as _faults
+from paddle_hackathon_tpu.observability import tracing as tr
+from paddle_hackathon_tpu.inference.fleet import FleetRouter
+from paddle_hackathon_tpu.profiler.cross_stack import merge_traces
+
+_ids = itertools.count(100)
+class Req:
+    def __init__(self, prompt, n):
+        self.rid = next(_ids); self.prompt = np.asarray(prompt, np.int32)
+        self.tokens = []; self.done = False; self.error = None
+        self._event = threading.Event()
+
+class Stub:
+    # host-only replica with fake KV page accounting, a per-replica
+    # exposition, and the same lifecycle spans ServingEngine emits:
+    # serving.request carries rid + fleet_rid, the per-tick span
+    # carries rid ONLY (the stitch pass must bridge it via the rid map)
+    def __init__(self, name, headroom):
+        self.engine_id = name; self.headroom = headroom
+        self.pages_in_use = 0
+    def load_report(self):
+        return {'version': 1, 'engine': self.engine_id, 'draining': False,
+                'slots': {'max': 8, 'active': 0, 'free': 8},
+                'queue': {'depth': 0, 'oldest_wait_s': 0.0},
+                'admission': {'headroom_tokens': self.headroom}}
+    def metrics_text(self):
+        return ('# HELP pht_stub_pages fake page gauge\\n'
+                '# TYPE pht_stub_pages gauge\\n'
+                'pht_stub_pages{engine="%s"} %d\\n'
+                % (self.engine_id, self.pages_in_use))
+    def submit(self, prompt, max_new_tokens, deadline_s=None,
+               on_token=None, trace_ctx=None, **kw):
+        r = Req(prompt, max_new_tokens)
+        self.pages_in_use += 2
+        fa = ({'fleet_rid': trace_ctx['fleet_rid']} if trace_ctx else {})
+        sp = tr.start_span('serving.request', _tid=r.rid, rid=r.rid,
+                           engine=self.engine_id, **fa)
+        t0 = time.perf_counter_ns()
+        tr.add_span('serving.decode', t0, t0 + 1000, _tid=r.rid,
+                    rid=r.rid, engine=self.engine_id, slot=0)
+        try:
+            _faults.point('serving.tick[%s]' % self.engine_id)
+        except Exception as e:
+            # armed tick fault kills the request AFTER placement with
+            # zero tokens streamed: the router must fail it over
+            r.error = e; self.pages_in_use -= 2
+            sp.end(error=type(e).__name__); r._event.set(); return r
+        r.tokens = list(range(max_new_tokens)); r.done = True
+        self.pages_in_use -= 2
+        sp.end(tokens=len(r.tokens)); r._event.set(); return r
+    def drain(self, timeout=None): pass
+    def shutdown(self, timeout=None): pass
+
+spans = []
+tr.set_span_sink(lambda name, t0, t1, tid, attrs: spans.append(
+    {'name': name, 'ph': 'X', 'pid': 0, 'tid': tid, 'ts': t0 / 1e3,
+     'dur': max((t1 - t0) / 1e3, 0.001), 'args': dict(attrs or {})}))
+tr.enable_tracing()
+# headroom skew makes tele-a the deterministic first pick: the armed
+# serving.tick[tele-a] fault then forces the failover onto tele-b
+a, b = Stub('tele-a', 9000), Stub('tele-b', 100)
+router = FleetRouter([a, b], backoff_s=0.001)
+fr = router.submit([1, 2, 3], 4)
+assert fr.wait(10) and fr.error is None, fr.error
+assert fr.replica == 'tele-b' and fr.retries == 1, (fr.replica, fr.retries)
+tr.disable_tracing(); tr.set_span_sink(None)
+
+# federation: both replicas under bounded replica= labels + fleet series
+text = router.expose_text()
+assert 'replica="tele-a"' in text and 'replica="tele-b"' in text, text
+assert 'fleet_dispatch_seconds' in text and 'fleet_retries_total' in text
+json.dumps(router.load_report())      # aggregated report serializes
+
+d = tempfile.mkdtemp()
+p = os.path.join(d, 'trace.json')
+with open(p, 'w') as f:
+    json.dump({'traceEvents': spans}, f)
+merged = merge_traces([p], stitch_fleet=True)
+ev = merged['traceEvents']
+meta = [e for e in ev if e.get('ph') == 'M'
+        and e.get('name') == 'process_name'
+        and 'rid-stitched' in (e.get('args') or {}).get('name', '')]
+assert meta, 'stitched fleet process missing'
+fpid = meta[0]['pid']
+lane = [e for e in ev if e.get('ph') != 'M' and e['pid'] == fpid
+        and e['tid'] == fr.fleet_rid]
+names = set(e['name'] for e in lane)
+assert {'fleet.route', 'fleet.dispatch', 'fleet.failover',
+        'serving.request', 'serving.decode'} <= names, names
+engines = set((e.get('args') or {}).get('engine') for e in lane
+              if e['name'] == 'serving.request')
+assert engines == {'tele-a', 'tele-b'}, engines
+assert a.pages_in_use == 0 and b.pages_in_use == 0, 'page leak'
+router.shutdown()
+print('telemetry drill: failover stitched onto one fleet lane, '
+      'federation labeled per replica, zero page leak OK')
+"""
+
 # Priority-inversion drain drill (PR 17).  A real (tiny, CPU) engine
 # behind a FleetRouter: a batch stream fills the page pool, an
 # interactive arrival preempts it mid-decode (pages released, request
@@ -344,6 +462,7 @@ print('zero-pp smoke: composed state sharded pp x dp, ' + mode
 _DRILLS = [
     ("fleet-drill", "fleet.dispatch=fail@1", _FLEET_DRILL),
     ("session-drill", "fleet.dispatch=fail@1", _SESSION_DRILL),
+    ("telemetry-drill", "serving.tick[tele-a]=fail@1", _TELEMETRY_DRILL),
     ("priority-drill", "", _PRIORITY_DRILL),
     ("zero-pp-smoke", "", _ZERO_PP_SMOKE),
 ]
