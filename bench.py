@@ -30,8 +30,29 @@ import jax
 if os.environ.get("JAX_PLATFORMS"):
     jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 
+# Program-observatory deep pass is always-on in bench (its per-build
+# AOT memory/cost harvest is exactly the evidence a perf row should
+# carry; builds happen during warm-up, so steady-state timing is
+# unaffected).  setdefault: an explicit =0 still wins.  Inherited by
+# the --one row subprocesses run_suite spawns.
+os.environ.setdefault("PHT_PROGRAM_ANALYSIS", "1")
+
 import jax.numpy as jnp
 import numpy as np
+
+
+def _programs_block():
+    """The program-observatory evidence a bench row embeds:
+    compile_seconds_total plus per-site builds/evictions and recent
+    retrace causes — what ``perf_gate.suite_gate`` prints when the
+    builds_warm/total tripwire fires, so a tripped gate names the site
+    and the exact signature delta instead of just "a build happened"."""
+    try:
+        from paddle_hackathon_tpu.observability.programs import \
+            get_program_registry
+        return get_program_registry().bench_block()
+    except Exception:
+        return None
 
 
 def load_bench_history(root=None):
@@ -1659,7 +1680,10 @@ def main():
         return
     if "--one" in sys.argv:
         name = sys.argv[sys.argv.index("--one") + 1]
-        print(json.dumps(SUITE[name]()))
+        row = SUITE[name]()
+        if isinstance(row, dict):
+            row.setdefault("programs", _programs_block())
+        print(json.dumps(row))
         return
     if "--headline-trace" in sys.argv:
         headline_trace()
@@ -1687,6 +1711,7 @@ def main():
     prev = history[-1][1] if history else None
     row["vs_baseline"] = round(row["value"] / prev, 4) if (
         prev and on_tpu) else 1.0
+    row.setdefault("programs", _programs_block())
     print(json.dumps(row))
 
 

@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import functools
 import threading
+import time
 from typing import Any, Optional, Sequence
 
 import jax
@@ -79,7 +80,30 @@ class StaticFunction:
         self._input_spec = input_spec
         self._cache = {}
         self._layer: Optional[Layer] = getattr(function, "__self__", None)
+        # program-cache key of a just-traced build, consumed by __call__ to
+        # time the (lazy) first compile+run and report it to the program
+        # observatory
+        self._pending_build = None
         functools.update_wrapper(self, function)
+
+    def _site_label(self) -> str:
+        """Observatory site label: the layer class when bound (one label
+        per user Layer type — bounded, PHT005), else the function name."""
+        if self._layer is not None:
+            return f"to_static.{type(self._layer).__name__}"
+        return f"to_static.{getattr(self._raw_fn, '__name__', 'fn')}"
+
+    def _report_build(self, key, t0) -> None:
+        """Report a program build (cache-miss trace + lazy compile) to the
+        program observatory; best-effort — telemetry never fails user code."""
+        if key is None:
+            return
+        try:
+            from ..observability.programs import observe_static_build
+            observe_static_build(self._site_label(), key,
+                                 time.perf_counter() - t0)
+        except Exception:
+            pass
 
     @property
     def _fn(self):
@@ -142,7 +166,14 @@ class StaticFunction:
         if key not in self._cache:
             if len(self._cache) >= flags.flag("jit_cache_size"):
                 self._cache.pop(next(iter(self._cache)))  # evict oldest
+                try:
+                    from ..observability.programs import \
+                        observe_static_eviction
+                    observe_static_eviction(self._site_label())
+                except Exception:
+                    pass
             self._cache[key] = self._build(key, len(args), training)
+            self._pending_build = key
         return self._cache[key]
 
     # -- execution ---------------------------------------------------------
@@ -172,6 +203,8 @@ class StaticFunction:
                     if isinstance(b._value, jax.core.Tracer):
                         b._value = old
         jitted, (param_keys, buffer_keys) = self.get_concrete_program(*args)
+        build_key, self._pending_build = self._pending_build, None
+        t_build = time.perf_counter()
         if layer is not None:
             params, buffers = layer.functional_state()
             param_list = [params[k] for k in param_keys]
@@ -194,6 +227,7 @@ class StaticFunction:
         if not diff_params and not diff_args:
             out_vals, new_buffers = jitted(param_list, buffer_list, rng_key,
                                            *jax_args)
+            self._report_build(build_key, t_build)
             self._write_buffers(buffer_keys, new_buffers)
             return _wrap_tree(out_vals, None)
 
@@ -210,6 +244,7 @@ class StaticFunction:
             return jitted(plist, buffer_list, rng_key, *alist)
 
         (out_vals, new_buffers), vjp_fn = jax.vjp(closed, dp_vals, da_vals)
+        self._report_build(build_key, t_build)
         self._write_buffers(buffer_keys, new_buffers)
 
         flat_out, treedef = jax.tree.flatten(out_vals)

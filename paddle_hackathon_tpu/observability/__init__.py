@@ -21,6 +21,11 @@ side).  This package is that metrics half:
   ``profiler.export_chrome_tracing``).
 - :func:`instrument_jit` — wraps a jitted callable so program builds and
   compile wall-time are counted at every jit-build site.
+- ``programs`` — the program observatory: a process-wide
+  :class:`ProgramRegistry` every jit-build site (and the to_static
+  program cache) reports into on the build path — abstract call
+  signatures, human-readable retrace causes, ``jit_compile_seconds``,
+  opt-in per-program HBM/flops accounting, ``/debug/programs``.
 - :func:`record_device_memory` — guarded live-buffer / device-memory
   gauges (degrades silently where jaxlib lacks the stats).
 
@@ -47,13 +52,15 @@ The event-level half lives next door and completes the triad:
 Metric catalog and endpoint reference: ``docs/OBSERVABILITY.md``.
 """
 
-from . import faults, flight, sanitizers, tracing
+from . import faults, flight, programs, sanitizers, tracing
 from .faults import InjectedFault
 from .flight import FlightRecorder, get_flight_recorder
 from .metrics import (Counter, Gauge, Histogram, MetricRegistry,
                       SlidingWindowHistogram, get_registry, instrument_jit,
                       log_buckets, record_device_memory, set_trace_sink,
                       snapshot_delta)
+from .programs import (ProgramRegistry, capture_signature, diff_signatures,
+                       get_program_registry, program_analysis)
 from .sanitizers import (DataRaceError, HostTransferError, LockOrderError,
                          UseAfterDonateError, donation_sanitizer,
                          forbid_host_transfers, make_lock, make_rlock,
@@ -73,7 +80,9 @@ __all__ = ["MetricRegistry", "Counter", "Gauge", "Histogram",
            "race_sanitizer", "share_object",
            "HostTransferError", "LockOrderError", "UseAfterDonateError",
            "DataRaceError",
-           "InjectedFault", "faults", "flight", "sanitizers", "tracing"]
+           "InjectedFault", "faults", "flight", "sanitizers", "tracing",
+           "ProgramRegistry", "get_program_registry", "capture_signature",
+           "diff_signatures", "program_analysis", "programs"]
 
 
 def start_introspection_server(*args, **kwargs):

@@ -745,9 +745,18 @@ def instrument_jit(fn, site: str, registry: Optional[MetricRegistry] = None,
     (``_cache_size`` growing across a call — jax compiles eagerly at
     call time even though execution is async, so the call's wall clock
     IS trace+compile+dispatch).  Where ``_cache_size`` is unavailable
-    only the first call is recorded.  The raw jitted function stays on
-    ``wrapped._jit_fn`` (AOT lowering / HLO inspection)."""
+    the call's abstract signature is probed against the program
+    registry's signature set, so every distinct-signature build is
+    counted (the old fallback recorded only the first call).  Each
+    detected build also reports into the process-wide
+    :class:`programs.ProgramRegistry` — site label, build index,
+    compile wall, signature, retrace cause, and (when
+    ``PHT_PROGRAM_ANALYSIS`` is armed) the AOT memory/cost harvest.
+    The raw jitted function stays on ``wrapped._jit_fn`` (AOT
+    lowering / HLO inspection)."""
+    from . import programs as _programs
     reg = registry or get_registry()
+    prog = _programs.get_program_registry()
     builds = reg.counter(
         "jit_builds_total",
         "program trace+compile events per jit-build site").labels(
@@ -756,7 +765,6 @@ def instrument_jit(fn, site: str, registry: Optional[MetricRegistry] = None,
         "jit_build_seconds",
         "wall time of calls that trace+compile a new program",
         unit="s").labels(site=site, **labels)
-    state = {"calls": 0}
 
     def cache_size():
         try:
@@ -770,13 +778,24 @@ def instrument_jit(fn, site: str, registry: Optional[MetricRegistry] = None,
         n0 = cache_size()
         t0 = time.perf_counter()
         out = fn(*a, **k)
-        state["calls"] += 1
         n1 = cache_size()
-        grew = (n1 > n0) if (n0 is not None and n1 is not None) \
-            else state["calls"] == 1
+        sig = None
+        if n0 is not None and n1 is not None:
+            grew = n1 > n0
+        else:
+            sig = _programs.capture_signature(
+                a, k, fn=fn,
+                donated=getattr(fn, "_pht_donate_argnums", None))
+            grew = prog.is_new_signature(site, sig)
         if grew:
+            wall = time.perf_counter() - t0
             builds.inc()
-            seconds.observe(time.perf_counter() - t0)
+            seconds.observe(wall)
+            prog.record_build(
+                site, args=a, kwargs=k, fn=fn, signature=sig,
+                compile_s=wall, t_end_ns=time.perf_counter_ns(),
+                registry=reg, labels=labels,
+                donated=getattr(fn, "_pht_donate_argnums", None))
         return out
 
     wrapped._jit_fn = fn

@@ -1,0 +1,568 @@
+"""Program observatory: per-build compile telemetry, retrace-cause
+forensics, and per-program HBM accounting.
+
+``instrument_jit`` (and the ``jit/api.py`` to_static program cache) can
+say *a* build happened; this module records *why*.  Every jit-build
+site reports each trace+compile into the process-wide
+:class:`ProgramRegistry` on the **build path only** — steady-state
+calls never touch it — with:
+
+- the site label, 1-based build index and compile wall time
+  (also exported as the ``jit_compile_seconds{site}`` histogram, next
+  to the existing ``jit_builds_total``);
+- an abstract **call signature**: per-arg aval shape/dtype/weak_type,
+  sharding spec when known, static-arg fingerprints and the donation
+  map.  Signature capture is host-metadata-only (aval walks — never a
+  device read), so instrumented hot paths stay PHT001-clean;
+- on build N>1 at a site, the **retrace cause** — the signature diff
+  rendered human-readable ("arg[2] `ids`: f32[8,512]→f32[8,640]",
+  "static `spec_k`: 4→6", "dtype/weak_type flip", "new arg tree
+  structure") — emitted as a ``program_build`` flight-recorder event
+  and retained in a bounded per-site history;
+- a compile span on the dedicated "compiles" chrome-trace lane
+  (:data:`COMPILES_LANE_TID`; ``profiler/cross_stack.merge_traces``
+  carries the lane through per rank);
+- opt-in (``PHT_PROGRAM_ANALYSIS=1``, or :func:`program_analysis`;
+  always on in ``bench.py``) per-program ``memory_analysis()`` bytes
+  and ``cost_analysis()`` flops harvested through the AOT ``lower()``
+  handle the wrappers preserve — exported as
+  ``program_hbm_bytes{site,kind}`` / ``program_flops{site}`` gauges.
+  The deeper pass re-lowers and re-compiles the program once per
+  build (that is its cost contract — never pay it in a serving hot
+  loop without opting in).
+
+Surfaces: ``/debug/programs`` (``observability/server.py``), the
+``programs`` summary in ``/debug/requests`` (registered via
+``tracing.register_introspection_source``), ``tools/program_report.py``
+(top compile-time sites, cause history, snapshot diffs), and the
+``programs`` block bench rows embed for ``tools/perf_gate.py`` — a
+build-growth gate failure prints the recorded causes.
+
+Site labels are code-derived (call-site constants, layer class names),
+never request-derived — the PHT005 label-boundedness contract.
+Catalog and reading rules: ``docs/OBSERVABILITY.md``, "Program
+observatory".
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import inspect
+import os
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from . import tracing as _tracing
+from .sanitizers import make_lock
+
+__all__ = ["ProgramRegistry", "get_program_registry", "capture_signature",
+           "diff_signatures", "signature_from_spec_key", "program_analysis",
+           "analysis_enabled", "observe_static_build",
+           "observe_static_eviction", "COMPILES_LANE_TID",
+           "HISTORY_PER_SITE"]
+
+# Dedicated chrome-trace lane for compile spans: a fixed synthetic tid
+# far outside both real thread idents' low range and the fleet's
+# 2^20+fleet_rid lane space, so every build at every site lands on ONE
+# "compiles" row (profiler.export_chrome_tracing names the lane;
+# cross_stack.merge_traces preserves tids, so merged multi-rank traces
+# keep one compiles lane per rank).
+COMPILES_LANE_TID = 2 ** 21
+
+# Bounded per-site build/cause history (the forensic window: recent
+# retraces are the actionable ones; totals cover the rest).
+HISTORY_PER_SITE = 16
+
+_ENV_ANALYSIS = "PHT_PROGRAM_ANALYSIS"
+_analysis_forced = 0
+
+_DTYPE_SHORT = {"float32": "f32", "float64": "f64", "float16": "f16",
+                "bfloat16": "bf16", "float8_e4m3fn": "f8e4m3",
+                "float8_e5m2": "f8e5m2",
+                "int64": "i64", "int32": "i32", "int16": "i16",
+                "int8": "i8", "uint64": "u64", "uint32": "u32",
+                "uint16": "u16", "uint8": "u8", "bool": "bool",
+                "complex64": "c64", "complex128": "c128"}
+
+
+def analysis_enabled() -> bool:
+    """True when the deeper memory/cost harvest runs per build — the
+    ``PHT_PROGRAM_ANALYSIS=1`` environment opt-in or an active
+    :func:`program_analysis` context (bench.py arms the env form)."""
+    return _analysis_forced > 0 \
+        or os.environ.get(_ENV_ANALYSIS, "") not in ("", "0")
+
+
+@contextlib.contextmanager
+def program_analysis():
+    """Force-enable the per-build memory/cost harvest for this block
+    (test fixture path — no environment mutation, nests fine)."""
+    global _analysis_forced
+    _analysis_forced += 1
+    try:
+        yield
+    finally:
+        _analysis_forced -= 1
+
+
+# ---------------------------------------------------------------------------
+# Abstract call signatures (host metadata only — never a device read)
+# ---------------------------------------------------------------------------
+
+def _short_dtype(dt) -> str:
+    name = getattr(dt, "name", None) or str(dt)
+    return _DTYPE_SHORT.get(name, name)
+
+
+def _sharding_str(x) -> Optional[str]:
+    # .sharding/.spec are host metadata on a jax Array — reading them
+    # never syncs; only a NamedSharding's spec is informative (every
+    # single-device array would otherwise stamp identical noise)
+    try:
+        spec = getattr(getattr(x, "sharding", None), "spec", None)
+        return str(spec) if spec is not None else None
+    except Exception:  # noqa: BLE001 — signature capture is best-effort
+        return None
+
+
+def _static_fp(x) -> str:
+    try:
+        r = repr(x)
+    except Exception:  # noqa: BLE001
+        r = f"<unreprable {type(x).__name__}>"
+    return r if len(r) <= 80 else r[:77] + "..."
+
+
+def _leaf_entry(label: str, x) -> tuple:
+    """One signature entry: ``("aval", label, shape, dtype, weak,
+    sharding)`` for array-likes (aval metadata only), else
+    ``("static", label, fingerprint)``."""
+    shape = getattr(x, "shape", None)
+    dtype = getattr(x, "dtype", None)
+    if shape is not None and dtype is not None and not callable(shape):
+        try:
+            return ("aval", label, tuple(int(d) for d in shape),
+                    _short_dtype(dtype), bool(getattr(x, "weak_type", False)),
+                    _sharding_str(x))
+        except Exception:  # noqa: BLE001 — fall through to the static path
+            pass
+    return ("static", label, _static_fp(x))
+
+
+def _arg_names(fn, n: int) -> List[Optional[str]]:
+    """Best-effort positional parameter names of the traced callable
+    (``inspect.signature`` unwraps ``functools.wraps`` chains, so a
+    jit/sanitizer wrapper still yields the user function's names)."""
+    names: List[Optional[str]] = [None] * n
+    if fn is None:
+        return names
+    try:
+        params = list(inspect.signature(fn).parameters.values())
+    except (TypeError, ValueError):
+        return names
+    for i in range(min(n, len(params))):
+        if params[i].kind in (params[i].POSITIONAL_ONLY,
+                              params[i].POSITIONAL_OR_KEYWORD):
+            names[i] = params[i].name
+    return names
+
+
+def _tree_entries(label: str, tree) -> List[tuple]:
+    try:
+        import jax
+        flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    except Exception:  # noqa: BLE001 — no-jax fallback: one opaque leaf
+        return [_leaf_entry(label, tree)]
+    out = []
+    for path, leaf in flat:
+        suffix = jax.tree_util.keystr(path) if path else ""
+        out.append(_leaf_entry(label + suffix, leaf))
+    return out
+
+
+def capture_signature(args: Sequence = (), kwargs: Optional[dict] = None,
+                      fn=None, donated=None) -> tuple:
+    """The abstract signature of one call: a tuple of per-leaf entries
+    over every positional/keyword arg's pytree.  Host-metadata-only by
+    construction — aval walks (shape/dtype/weak_type/sharding spec) and
+    ``repr`` of static python values; device buffers are never read, so
+    the capture is PHT001-clean on any hot path that reaches it."""
+    entries: List[tuple] = []
+    names = _arg_names(fn, len(args))
+    for i, a in enumerate(args):
+        label = f"arg[{i}]" + (f" `{names[i]}`" if names[i] else "")
+        entries.extend(_tree_entries(label, a))
+    for k in sorted(kwargs or ()):
+        entries.extend(_tree_entries(f"kw `{k}`", kwargs[k]))
+    if donated:
+        entries.append(("static", "donated", _static_fp(tuple(donated))))
+    return tuple(entries)
+
+
+def signature_from_spec_key(spec_key, training: bool) -> tuple:
+    """Signature equivalent of ``jit/api.py``'s ``_spec_key`` tuples
+    (the to_static program-cache key), so user-level retraces diff
+    through the same taxonomy as instrument_jit sites."""
+    entries: List[tuple] = []
+    for i, part in enumerate(spec_key):
+        label = f"arg[{i}]"
+        if part[0] in ("T", "A"):
+            entries.append(("aval", label, tuple(int(d) for d in part[1]),
+                            _short_dtype(part[2]), False, None))
+        elif part[0] == "S":
+            entries.append(("static", label, _static_fp(part[1])))
+        else:
+            entries.append(("static", label, f"<{part[1]}>"))
+    entries.append(("static", "training", repr(bool(training))))
+    return tuple(entries)
+
+
+def _fmt_aval(e: tuple) -> str:
+    _, _, shape, dtype, weak, sharding = e
+    s = f"{dtype}[{','.join(str(d) for d in shape)}]"
+    if weak:
+        s += "~w"
+    if sharding:
+        s += f"@{sharding}"
+    return s
+
+
+def _fmt_entry(e: tuple) -> str:
+    if e[0] == "aval":
+        return f"{e[1]}: {_fmt_aval(e)}"
+    return f"static {e[1]}: {e[2]}"
+
+
+def diff_signatures(prev: Optional[tuple], cur: tuple) -> List[str]:
+    """Human-readable retrace causes, new signature vs the retained
+    previous one.  Taxonomy (docs/OBSERVABILITY.md): tree-structure
+    change, per-leaf shape change, dtype/weak_type flip, sharding
+    change, static-value change — or, with an identical signature, a
+    rebuild the signature cannot explain (cache eviction / flush)."""
+    if prev is None:
+        return []
+    if [e[:2] for e in prev] != [e[:2] for e in cur]:
+        return [f"new arg tree structure ({len(prev)}→{len(cur)} leaves)"]
+    causes = []
+    for pe, ce in zip(prev, cur):
+        if pe == ce:
+            continue
+        label = pe[1]
+        if pe[0] == "static":
+            causes.append(f"static {label}: {pe[2]}→{ce[2]}")
+        elif pe[3] != ce[3] or pe[4] != ce[4]:
+            causes.append(f"{label}: dtype/weak_type flip "
+                          f"{_fmt_aval(pe)}→{_fmt_aval(ce)}")
+        elif pe[2] != ce[2]:
+            causes.append(f"{label}: {_fmt_aval(pe)}→{_fmt_aval(ce)}")
+        else:
+            causes.append(f"{label}: sharding {pe[5]}→{ce[5]}")
+    return causes or ["signature unchanged (program-cache eviction or "
+                      "flush rebuilt an already-seen signature)"]
+
+
+# ---------------------------------------------------------------------------
+# AOT memory/cost harvest (the opt-in deeper pass)
+# ---------------------------------------------------------------------------
+
+_MEM_KINDS = (("args", "argument_size_in_bytes"),
+              ("outputs", "output_size_in_bytes"),
+              ("temp", "temp_size_in_bytes"),
+              ("generated", "generated_code_size_in_bytes"))
+
+
+def _harvest_analysis(fn, args, kwargs) -> Optional[dict]:
+    """Per-program ``memory_analysis()`` bytes and ``cost_analysis()``
+    flops via the AOT ``lower()`` handle (the ``parallel/planner.py``
+    harvesting shape).  Re-lowers and re-compiles once — the stated
+    cost of ``PHT_PROGRAM_ANALYSIS`` — and degrades to ``None`` on any
+    backend that lacks the analyses."""
+    lower = getattr(fn, "lower", None)
+    if lower is None:
+        return None
+    try:
+        compiled = lower(*args, **(kwargs or {})).compile()
+    except Exception:  # noqa: BLE001 — analysis is best-effort evidence
+        return None
+    out: Dict[str, Any] = {}
+    try:
+        mem = compiled.memory_analysis()
+        for kind, attr in _MEM_KINDS:
+            v = getattr(mem, attr, None)
+            if v is not None:
+                out[f"{kind}_bytes"] = int(v)
+    except Exception:  # noqa: BLE001
+        pass
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        flops = float(ca.get("flops", 0.0)) if hasattr(ca, "get") else 0.0
+        if flops:
+            out["flops"] = flops
+    except Exception:  # noqa: BLE001
+        pass
+    return out or None
+
+
+# ---------------------------------------------------------------------------
+# The registry
+# ---------------------------------------------------------------------------
+
+class _Site:
+    __slots__ = ("kind", "builds", "evictions", "compile_seconds_total",
+                 "signatures", "last_signature", "history", "last_ts",
+                 "analysis")
+
+    def __init__(self, kind: str, history: int):
+        self.kind = kind
+        self.builds = 0
+        self.evictions = 0
+        self.compile_seconds_total = 0.0
+        self.signatures: set = set()
+        self.last_signature: Optional[tuple] = None
+        self.history: collections.deque = collections.deque(maxlen=history)
+        self.last_ts = 0.0
+        self.analysis: Optional[dict] = None
+
+
+class ProgramRegistry:
+    """Process-wide program-build ledger, one :class:`_Site` per site
+    label.  Lock-disciplined (:func:`sanitizers.make_lock`; the lock is
+    a leaf — flight/metrics/tracing emission happens outside it) and
+    build-path-only: nothing here runs on a steady-state call."""
+
+    def __init__(self, history: int = HISTORY_PER_SITE):
+        self._lock = make_lock("observability.programs")
+        self._sites: Dict[str, _Site] = {}
+        self._history = int(history)
+
+    # -- build-path reporting ----------------------------------------------
+
+    def is_new_signature(self, site: str, signature: tuple) -> bool:
+        """Membership probe for ``instrument_jit``'s ``_cache_size``-less
+        fallback: a call whose abstract signature the site has not seen
+        is a build (the old first-call-only heuristic missed every
+        later retrace)."""
+        with self._lock:
+            rec = self._sites.get(site)
+            return rec is None or tuple(signature) not in rec.signatures
+
+    def record_build(self, site: str, *, args: Sequence = (),
+                     kwargs: Optional[dict] = None, fn=None,
+                     signature: Optional[tuple] = None,
+                     compile_s: float = 0.0, t_end_ns: Optional[int] = None,
+                     kind: str = "jit", registry=None,
+                     labels: Optional[dict] = None,
+                     donated=None) -> dict:
+        """Record one trace+compile at ``site`` and return the build
+        record.  Computes the signature (host metadata only) unless the
+        caller already did, diffs it against the site's retained
+        previous signature into a retrace cause, and emits the flight
+        event / ``jit_compile_seconds`` observation / compile span —
+        plus the AOT memory/cost harvest when :func:`analysis_enabled`."""
+        sig = tuple(signature) if signature is not None \
+            else capture_signature(args, kwargs, fn=fn, donated=donated)
+        analysis = _harvest_analysis(fn, args, kwargs) \
+            if analysis_enabled() and fn is not None else None
+        now = time.time()
+        with self._lock:
+            rec = self._sites.get(site)
+            if rec is None:
+                rec = self._sites[site] = _Site(kind, self._history)
+            rec.builds += 1
+            n = rec.builds
+            causes = diff_signatures(rec.last_signature, sig) if n > 1 else []
+            cause = "; ".join(causes) if causes else None
+            rec.last_signature = sig
+            rec.signatures.add(sig)
+            rec.compile_seconds_total += float(compile_s)
+            rec.last_ts = now
+            if analysis is not None:
+                rec.analysis = analysis
+            record = {"build": n, "ts": now,
+                      "compile_s": round(float(compile_s), 6),
+                      "cause": cause, "analysis": analysis}
+            rec.history.append(record)
+        self._emit(site, record, compile_s, t_end_ns, kind, registry, labels)
+        return record
+
+    def record_eviction(self, site: str, registry=None) -> None:
+        """Count a program-cache eviction at ``site`` (the to_static
+        cache's oldest-entry pop) — ``jit_cache_evictions_total{site}``
+        plus a flight event; an evicted signature is forgotten so its
+        inevitable rebuild diffs as a cause, not a silent no-op."""
+        with self._lock:
+            rec = self._sites.get(site)
+            if rec is None:
+                rec = self._sites[site] = _Site("to_static", self._history)
+            rec.evictions += 1
+            n = rec.evictions
+        reg = self._metric_registry(registry)
+        if reg is not None and reg.enabled:
+            reg.counter(
+                "jit_cache_evictions_total",
+                "to_static program-cache evictions by site").labels(
+                    site=site).inc()
+        from . import flight as _flight
+        _flight.get_flight_recorder().record("program_evict", site=site,
+                                             evictions=n)
+
+    # -- emission (outside the lock: the registry lock is a leaf) ----------
+
+    @staticmethod
+    def _metric_registry(registry):
+        if registry is not None:
+            return registry
+        from . import metrics as _metrics
+        return _metrics.get_registry()
+
+    def _emit(self, site, record, compile_s, t_end_ns, kind, registry,
+              labels):
+        from . import flight as _flight
+        _flight.get_flight_recorder().record(
+            "program_build", site=site, build=record["build"], kind=kind,
+            compile_ms=round(float(compile_s) * 1e3, 3),
+            cause=record["cause"])
+        reg = self._metric_registry(registry)
+        if reg is not None and reg.enabled:
+            reg.histogram(
+                "jit_compile_seconds",
+                "compile wall per program build, by jit-build site",
+                unit="s").labels(site=site, **(labels or {})).observe(
+                    float(compile_s))
+            analysis = record["analysis"]
+            if analysis:
+                hbm = reg.gauge(
+                    "program_hbm_bytes",
+                    "per-program memory_analysis bytes by site and kind "
+                    "(args/outputs/temp/generated)", unit="B")
+                # kind is the literal 4-value enum (PHT005-bounded)
+                for mkind in ("args", "outputs", "temp", "generated"):
+                    v = analysis.get(mkind + "_bytes")
+                    if v is not None:
+                        hbm.labels(site=site, kind=mkind).set(v)
+                if analysis.get("flops"):
+                    reg.gauge("program_flops",
+                              "per-program cost_analysis flops by site"
+                              ).labels(site=site).set(analysis["flops"])
+        if t_end_ns is None:
+            t_end_ns = time.perf_counter_ns()
+        attrs = {"site": site, "build": record["build"], "lane": "compiles"}
+        if record["cause"]:
+            attrs["cause"] = record["cause"]
+        _tracing.add_span(f"compile:{site}",
+                          int(t_end_ns - float(compile_s) * 1e9),
+                          int(t_end_ns), _tid=COMPILES_LANE_TID, **attrs)
+
+    # -- snapshots ---------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """JSON-able registry dump — the ``/debug/programs`` body and
+        ``tools/program_report.py`` input."""
+        with self._lock:
+            sites = {}
+            for name, rec in self._sites.items():
+                sites[name] = {
+                    "kind": rec.kind,
+                    "builds": rec.builds,
+                    "evictions": rec.evictions,
+                    "compile_seconds_total":
+                        round(rec.compile_seconds_total, 6),
+                    "last_build_ts": rec.last_ts,
+                    "signature": [_fmt_entry(e)
+                                  for e in (rec.last_signature or ())],
+                    "history": [dict(h) for h in rec.history],
+                    "analysis": dict(rec.analysis) if rec.analysis else None,
+                }
+        return {"version": 1, "ts": time.time(),
+                "builds_total": sum(s["builds"] for s in sites.values()),
+                "compile_seconds_total": round(
+                    sum(s["compile_seconds_total"] for s in sites.values()),
+                    6),
+                "sites": sites}
+
+    def bench_block(self) -> dict:
+        """The compact per-row evidence bench rows embed:
+        ``compile_seconds_total`` plus per-site builds/evictions and the
+        recent retrace causes ``perf_gate.suite_gate`` prints when the
+        build-growth gate trips."""
+        snap = self.snapshot()
+        return {"compile_seconds_total": snap["compile_seconds_total"],
+                "sites": {
+                    name: {"builds": s["builds"],
+                           "evictions": s["evictions"],
+                           "compile_seconds_total":
+                               s["compile_seconds_total"],
+                           "causes": [f"build {h['build']}: {h['cause']}"
+                                      for h in s["history"]
+                                      if h.get("cause")][-4:]}
+                    for name, s in snap["sites"].items()}}
+
+    def introspect_requests(self) -> dict:
+        """Compact table for ``/debug/requests`` (the registry is also
+        a ``tracing.register_introspection_source`` source); the full
+        forensic dump lives at ``/debug/programs``."""
+        snap = self.snapshot()
+        return {"builds_total": snap["builds_total"],
+                "compile_seconds_total": snap["compile_seconds_total"],
+                "sites": {
+                    name: {"builds": s["builds"],
+                           "evictions": s["evictions"],
+                           "compile_seconds_total":
+                               s["compile_seconds_total"],
+                           "last_cause": next(
+                               (h["cause"] for h in reversed(s["history"])
+                                if h.get("cause")), None)}
+                    for name, s in snap["sites"].items()}}
+
+    def reset(self) -> None:
+        """Drop every site (test isolation)."""
+        with self._lock:
+            self._sites.clear()
+
+
+# ---------------------------------------------------------------------------
+# Default (process-wide) registry + the to_static reporting hooks
+# ---------------------------------------------------------------------------
+
+_default_registry = ProgramRegistry()
+# weakly held by tracing; this module's strong ref keeps it live
+_tracing.register_introspection_source("programs", _default_registry)
+
+
+def get_program_registry() -> ProgramRegistry:
+    """The process-wide registry every built-in jit-build site reports
+    into (``instrument_jit`` and the to_static program cache)."""
+    return _default_registry
+
+
+def observe_static_build(site: str, cache_key, compile_s: float) -> None:
+    """Report one to_static program build (``jit/api.py`` cache-miss
+    path): counts ``jit_builds_total{site}`` / ``jit_build_seconds``
+    like an instrument_jit site and records the spec-key signature so
+    user-level retraces get cause forensics too."""
+    from . import metrics as _metrics
+    reg = _metrics.get_registry()
+    if not reg.enabled:
+        return
+    reg.counter("jit_builds_total",
+                "program trace+compile events per jit-build site").labels(
+                    site=site).inc()
+    reg.histogram("jit_build_seconds",
+                  "wall time of calls that trace+compile a new program",
+                  unit="s").labels(site=site).observe(float(compile_s))
+    spec_key, training = cache_key
+    _default_registry.record_build(
+        site, signature=signature_from_spec_key(spec_key, training),
+        compile_s=compile_s, kind="to_static", registry=reg)
+
+
+def observe_static_eviction(site: str) -> None:
+    """Report one to_static program-cache eviction (``jit/api.py``)."""
+    from . import metrics as _metrics
+    reg = _metrics.get_registry()
+    if not reg.enabled:
+        return
+    _default_registry.record_eviction(site, registry=reg)

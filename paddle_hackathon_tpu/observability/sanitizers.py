@@ -634,8 +634,16 @@ def sanitize_donation(fn, donate_argnums=(), donate_argnames=(),
 
     Disabled (the default): returns ``fn`` unchanged — a plain call,
     zero added cost.  Decided at creation; see
-    :func:`donation_sanitizer_enabled`."""
+    :func:`donation_sanitizer_enabled`.
+
+    Either way the restated donation map is stamped on the returned
+    callable (``_pht_donate_argnums``) so the program observatory can
+    record it in build signatures."""
     if not donation_sanitizer_enabled():
+        try:
+            fn._pht_donate_argnums = tuple(donate_argnums)
+        except (AttributeError, TypeError):
+            pass  # jit callables that refuse attributes: map stays unknown
         return fn
     import jax
     global _don_env_armed
@@ -685,6 +693,7 @@ def sanitize_donation(fn, donate_argnums=(), donate_argnames=(),
         return out
 
     wrapped._pht_donation_guard = True
+    wrapped._pht_donate_argnums = nums
     # instrument_jit (and AOT tooling) reach through to the raw jit
     wrapped._jit_fn = getattr(fn, "_jit_fn", fn)
     if hasattr(fn, "_cache_size"):

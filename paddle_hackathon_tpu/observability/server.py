@@ -12,6 +12,7 @@ process is live:
     curl localhost:9200/fleet            # federated fleet report(s)
     curl localhost:9200/debug/flight     # flight-recorder ring as JSON
     curl localhost:9200/debug/requests   # in-flight serving slot tables
+    curl localhost:9200/debug/programs   # program observatory registry
     srv.stop()
 
 ``/load`` is the router contract (ROADMAP item 2): a VERSIONED JSON
@@ -89,12 +90,19 @@ class _Handler(BaseHTTPRequestHandler):
             elif url.path == "/debug/requests":
                 self._send_json({"ts": time.time(),
                                  "sources": _tracing.introspection_tables()})
+            elif url.path == "/debug/programs":
+                # the program observatory: per-site build counts, compile
+                # wall, retrace-cause history, HBM/flops analysis rows
+                # (docs/OBSERVABILITY.md, "Program observatory")
+                from . import programs as _programs
+                self._send_json(_programs.get_program_registry().snapshot())
             else:
                 self._send_json({"error": "not found",
                                  "endpoints": ["/metrics", "/healthz",
                                                "/load", "/fleet",
                                                "/debug/flight",
-                                               "/debug/requests"]}, 404)
+                                               "/debug/requests",
+                                               "/debug/programs"]}, 404)
         except Exception as e:  # noqa: BLE001 — introspection must not die
             self._send_json({"error": f"{type(e).__name__}: {e}"}, 500)
 

@@ -223,6 +223,15 @@ def export_chrome_tracing(dir_name: str, worker_name: Optional[str] = None):
                 "pid": os.getpid(), "ts": t_ns / 1000.0,
                 "args": {"value": value},
             })
+        # compile spans ride a dedicated synthetic lane; name it so the
+        # chrome/perfetto row reads "compiles", not a raw tid number
+        # (cross_stack.merge_traces preserves tids, so merged traces keep
+        # one named compiles lane per rank)
+        from ..observability.programs import COMPILES_LANE_TID
+        if any(e.get("tid") == COMPILES_LANE_TID for e in events):
+            events.append({"name": "thread_name", "ph": "M",
+                           "pid": os.getpid(), "tid": COMPILES_LANE_TID,
+                           "args": {"name": "compiles"}})
         with open(path, "w") as f:
             json.dump({"traceEvents": events,
                        "displayTimeUnit": "ms"}, f)

@@ -99,6 +99,9 @@ _FILE_COST = {
     "test_crash_drill.py": 1,     # fully slow-marked (subprocess drills)
     "test_sanitizers.py": 5,  # lock/guard/race units + one thread-only
                               # dataloader epoch; engine runs slow-marked
+    "test_programs.py": 5,  # signature/cause/registry units on numpy
+                            # callables + fake AOT handles; the one real
+                            # compile is a to_static scalar multiply
     "test_paged.py": 16,    # allocator units + 2 tiny-GPT engine runs
     "test_priority.py": 25,  # scheduler/fleet units + tiny-GPT preempt
                              # and aging runs; dense/spec token-exact

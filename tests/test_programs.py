@@ -1,0 +1,497 @@
+"""Program observatory (observability/programs.py): signature capture,
+retrace-cause taxonomy, registry semantics, the instrument_jit fallback
+fix, to_static wiring, the /debug/programs endpoint, and the
+gate/report/dump surfaces.
+
+Lean by design (tier-1 runs near its 870 s budget): almost everything
+here is pure-host — numpy callables through instrument_jit's
+signature-probe fallback, fake AOT handles for the analysis harvest —
+and the one test that really compiles (to_static) traces a scalar
+multiply."""
+
+import io
+import json
+import os
+import sys
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_hackathon_tpu as paddle
+from paddle_hackathon_tpu.core import flags
+from paddle_hackathon_tpu.observability import (MetricRegistry,
+                                                get_flight_recorder,
+                                                get_registry, instrument_jit,
+                                                programs, sanitizers,
+                                                tracing)
+from paddle_hackathon_tpu.observability.programs import (
+    ProgramRegistry, capture_signature, diff_signatures,
+    get_program_registry, program_analysis, signature_from_spec_key)
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"))
+
+_SITE_N = [0]
+
+
+def _site(prefix="t"):
+    """Unique site label per test: the program registry and the default
+    metric registry are process-global."""
+    _SITE_N[0] += 1
+    return f"{prefix}.programs_test.{_SITE_N[0]}"
+
+
+# ---------------------------------------------------------------------------
+# signature capture + cause taxonomy
+# ---------------------------------------------------------------------------
+
+def test_capture_signature_names_and_avals():
+    def fn(ids, mask):
+        return ids
+
+    sig = capture_signature(
+        (np.zeros((8, 512), np.float32), np.ones((8,), np.int32)),
+        {"temp": 0.7}, fn=fn)
+    assert sig[0][:2] == ("aval", "arg[0] `ids`")
+    assert sig[0][2:4] == ((8, 512), "f32")
+    assert sig[1][:2] == ("aval", "arg[1] `mask`")
+    assert sig[1][3] == "i32"
+    assert sig[2][:3] == ("static", "kw `temp`", "0.7")
+
+
+def test_capture_signature_nested_tree_paths():
+    tree = {"w": np.zeros((4, 4), np.float32), "b": np.zeros((4,))}
+    sig = capture_signature((tree,))
+    labels = [e[1] for e in sig]
+    assert any("arg[0]" in l and "'w'" in l for l in labels), labels
+    assert any("'b'" in l for l in labels), labels
+
+
+def test_cause_shape_change():
+    def fn(ids):
+        return ids
+
+    prev = capture_signature((np.zeros((8, 512), np.float32),), fn=fn)
+    cur = capture_signature((np.zeros((8, 640), np.float32),), fn=fn)
+    assert diff_signatures(prev, cur) == \
+        ["arg[0] `ids`: f32[8,512]→f32[8,640]"]
+
+
+def test_cause_static_value_change():
+    prev = capture_signature((np.zeros((2,), np.float32),), {"spec_k": 4})
+    cur = capture_signature((np.zeros((2,), np.float32),), {"spec_k": 6})
+    assert diff_signatures(prev, cur) == ["static kw `spec_k`: 4→6"]
+
+
+def test_cause_dtype_flip():
+    prev = capture_signature((np.zeros((4,), np.float32),))
+    cur = capture_signature((np.zeros((4,), np.int32),))
+    (cause,) = diff_signatures(prev, cur)
+    assert "dtype/weak_type flip" in cause and "f32[4]" in cause \
+        and "i32[4]" in cause
+
+
+def test_cause_tree_structure_change():
+    prev = capture_signature(({"a": np.zeros((2,))},))
+    cur = capture_signature(({"a": np.zeros((2,)), "b": np.zeros((2,))},))
+    (cause,) = diff_signatures(prev, cur)
+    assert cause == "new arg tree structure (1→2 leaves)"
+
+
+def test_cause_identical_signature_names_eviction():
+    sig = capture_signature((np.zeros((2,)),))
+    (cause,) = diff_signatures(sig, sig)
+    assert "eviction" in cause
+
+
+def test_first_build_has_no_cause():
+    assert diff_signatures(None, capture_signature((1,))) == []
+
+
+def test_signature_from_spec_key():
+    key = (("T", (8, 512), "float32"), ("S", 4), ("O", "Mesh"))
+    sig = signature_from_spec_key(key, training=True)
+    assert sig[0] == ("aval", "arg[0]", (8, 512), "f32", False, None)
+    assert sig[1] == ("static", "arg[1]", "4")
+    assert sig[2] == ("static", "arg[2]", "<Mesh>")
+    assert sig[3] == ("static", "training", "True")
+    # training-mode flip is a diffable cause
+    (cause,) = diff_signatures(
+        sig, signature_from_spec_key(key, training=False))
+    assert cause == "static training: True→False"
+
+
+# ---------------------------------------------------------------------------
+# registry semantics
+# ---------------------------------------------------------------------------
+
+def test_record_build_history_bounded_and_totals():
+    prog = ProgramRegistry(history=4)
+    site = _site()
+    reg = MetricRegistry()
+    for n in (8, 16, 24, 32, 40, 48):
+        prog.record_build(
+            site, signature=capture_signature((np.zeros((n,)),)),
+            compile_s=0.5, registry=reg)
+    s = prog.snapshot()["sites"][site]
+    assert s["builds"] == 6
+    assert len(s["history"]) == 4            # bounded window
+    assert s["history"][0]["build"] == 3     # oldest retained
+    assert abs(s["compile_seconds_total"] - 3.0) < 1e-9
+    assert "f64[40]" in s["history"][-1]["cause"]
+    # jit_compile_seconds rode along
+    fam = reg.snapshot()["metrics"]["jit_compile_seconds"]
+    assert fam["series"][0]["count"] == 6
+
+
+def test_registry_thread_safety_under_lock_sanitizer():
+    with sanitizers.lock_sanitizer():
+        prog = ProgramRegistry()   # lock created while sanitizer armed
+        reg = MetricRegistry(enabled=False)
+        sites = [_site("thr") for _ in range(4)]
+        sigs = [capture_signature((np.zeros((n,)),)) for n in range(50)]
+
+        def worker(site):
+            for sig in sigs:
+                if prog.is_new_signature(site, sig):
+                    prog.record_build(site, signature=sig, registry=reg)
+
+        threads = [threading.Thread(target=worker, args=(s,))
+                   for s in sites]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        snap = prog.snapshot()
+        assert sum(s["builds"] for s in snap["sites"].values()) == 200
+    sanitizers.reset_lock_graph()
+
+
+def test_eviction_counts_and_forgets_signature():
+    prog = ProgramRegistry()
+    site = _site()
+    reg = MetricRegistry()
+    sig = capture_signature((np.zeros((4,)),))
+    prog.record_build(site, signature=sig, registry=reg)
+    assert not prog.is_new_signature(site, sig)
+    prog.record_eviction(site, registry=reg)
+    s = prog.snapshot()["sites"][site]
+    assert s["evictions"] == 1
+    assert reg.total("jit_cache_evictions_total", site=site) == 1.0
+    assert any(e.get("kind") == "program_evict" and e.get("site") == site
+               for e in get_flight_recorder().events())
+
+
+# ---------------------------------------------------------------------------
+# instrument_jit: the fallback bugfix (satellite) + observatory reporting
+# ---------------------------------------------------------------------------
+
+def test_fallback_counts_every_distinct_signature():
+    """Pin the bugfix: without ``_cache_size`` the old wrapper recorded
+    only the FIRST call — now the registry's signature set detects
+    every distinct-signature build, and steady-state repeats stay
+    uncounted."""
+    reg = MetricRegistry()
+    site = _site("fb")
+
+    def tick(ids, mask):         # numpy callable: no _cache_size
+        return ids.sum() + mask.sum()
+
+    w = instrument_jit(tick, site=site, registry=reg)
+    a, m = np.zeros((8, 16), np.float32), np.ones((8,), np.float32)
+    w(a, m)
+    w(a, m)
+    w(a, m)
+    assert reg.total("jit_builds_total", site=site) == 1.0
+    w(np.zeros((8, 24), np.float32), m)     # distinct signature: build 2
+    assert reg.total("jit_builds_total", site=site) == 2.0
+    w(np.zeros((8, 24), np.float32), m)     # seen again: steady state
+    assert reg.total("jit_builds_total", site=site) == 2.0
+    s = get_program_registry().snapshot()["sites"][site]
+    assert s["builds"] == 2
+    assert s["history"][-1]["cause"] == "arg[0] `ids`: f32[8,16]→f32[8,24]"
+    ev = [e for e in get_flight_recorder().events()
+          if e.get("kind") == "program_build" and e.get("site") == site]
+    assert [e["build"] for e in ev] == [1, 2]
+    assert ev[-1]["cause"] == s["history"][-1]["cause"]
+
+
+def test_instrument_jit_real_jit_cache_path():
+    import jax
+    import jax.numpy as jnp
+    reg = MetricRegistry()
+    site = _site("jit")
+    w = instrument_jit(jax.jit(lambda x: x * 2), site=site, registry=reg)
+    w(jnp.ones((4,)))
+    w(jnp.ones((4,)))
+    w(jnp.ones((8,)))
+    assert reg.total("jit_builds_total", site=site) == 2.0
+    s = get_program_registry().snapshot()["sites"][site]
+    assert s["builds"] == 2 and "f32[4]" in s["history"][-1]["cause"]
+
+
+def test_disabled_registry_pays_nothing():
+    reg = MetricRegistry(enabled=False)
+    site = _site("off")
+    w = instrument_jit(lambda x: x, site=site, registry=reg)
+    w(np.zeros((2,)))
+    assert site not in get_program_registry().snapshot()["sites"]
+
+
+# ---------------------------------------------------------------------------
+# analysis harvest (PHT_PROGRAM_ANALYSIS)
+# ---------------------------------------------------------------------------
+
+class _FakeMem:
+    argument_size_in_bytes = 1024
+    output_size_in_bytes = 256
+    temp_size_in_bytes = 4096
+    generated_code_size_in_bytes = 512
+
+
+class _FakeCompiled:
+    def memory_analysis(self):
+        return _FakeMem()
+
+    def cost_analysis(self):
+        return [{"flops": 99.0}]
+
+
+class _FakeLowered:
+    def compile(self):
+        return _FakeCompiled()
+
+
+def _fake_fn(x):
+    return x
+
+
+_fake_fn.lower = lambda *a, **k: _FakeLowered()
+
+
+def test_analysis_harvest_gauges_and_rows():
+    reg = MetricRegistry()
+    site = _site("an")
+    with program_analysis():
+        assert programs.analysis_enabled()
+        get_program_registry().record_build(
+            site, args=(np.zeros((4,)),), fn=_fake_fn, registry=reg)
+    s = get_program_registry().snapshot()["sites"][site]
+    assert s["analysis"] == {"args_bytes": 1024, "outputs_bytes": 256,
+                             "temp_bytes": 4096, "generated_bytes": 512,
+                             "flops": 99.0}
+    assert reg.total("program_hbm_bytes", site=site, kind="temp") == 4096
+    assert reg.total("program_flops", site=site) == 99.0
+
+
+def test_analysis_off_by_default(monkeypatch):
+    monkeypatch.delenv("PHT_PROGRAM_ANALYSIS", raising=False)
+    assert not programs.analysis_enabled()
+    reg = MetricRegistry()
+    site = _site("anoff")
+    get_program_registry().record_build(
+        site, args=(np.zeros((4,)),), fn=_fake_fn, registry=reg)
+    assert get_program_registry().snapshot()["sites"][site]["analysis"] \
+        is None
+
+
+# ---------------------------------------------------------------------------
+# compile spans on the dedicated lane
+# ---------------------------------------------------------------------------
+
+def test_compile_span_rides_compiles_lane():
+    spans = []
+    tracing.set_span_sink(
+        lambda name, t0, t1, tid, attrs: spans.append((name, tid, attrs)))
+    tracing.enable_tracing()
+    try:
+        site = _site("lane")
+        get_program_registry().record_build(
+            site, signature=capture_signature((np.zeros((2,)),)),
+            compile_s=0.25, registry=MetricRegistry(enabled=False))
+    finally:
+        tracing.disable_tracing()
+        tracing.set_span_sink(None)
+    (name, tid, attrs) = [s for s in spans if s[0] == f"compile:{site}"][0]
+    assert tid == programs.COMPILES_LANE_TID
+    assert attrs["lane"] == "compiles" and attrs["build"] == 1
+
+
+def test_chrome_export_names_compiles_lane(tmp_path):
+    from paddle_hackathon_tpu import profiler
+
+    class _Prof:
+        step_num = 0
+        _events = [type("E", (), {
+            "name": "compile:x", "event_type": "Compile",
+            "tid": programs.COMPILES_LANE_TID, "start": 0, "end": 1000,
+            "args": None})()]
+        _counter_events = ()
+
+    handler = profiler.export_chrome_tracing(str(tmp_path))
+    path = handler(_Prof())
+    evs = json.load(open(path))["traceEvents"]
+    meta = [e for e in evs if e.get("ph") == "M"]
+    assert meta and meta[0]["args"]["name"] == "compiles"
+    assert meta[0]["tid"] == programs.COMPILES_LANE_TID
+
+
+# ---------------------------------------------------------------------------
+# to_static wiring (satellite): user-level retraces + evictions
+# ---------------------------------------------------------------------------
+
+def test_to_static_builds_and_evictions_reach_registry():
+    @paddle.jit.to_static
+    def double(x):
+        return x * 2
+
+    site = "to_static.double"
+    prog = get_program_registry()
+    reg = get_registry()
+    b0 = reg.total("jit_builds_total", site=site)
+    base = prog.snapshot()["sites"].get(site, {}).get("builds", 0)
+    t = paddle.to_tensor(np.ones((4, 4), np.float32))
+    double(t)
+    double(t)                                     # steady state
+    double(paddle.to_tensor(np.ones((4, 8), np.float32)))   # retrace
+    s = prog.snapshot()["sites"][site]
+    assert s["builds"] == base + 2 and s["kind"] == "to_static"
+    assert s["history"][-1]["cause"] == "arg[0]: f32[4,4]→f32[4,8]"
+    assert reg.total("jit_builds_total", site=site) == b0 + 2.0
+    # a 1-entry cache turns every new signature into an eviction
+    e0 = prog.snapshot()["sites"][site]["evictions"]
+    flags.set_flags({"jit_cache_size": 1})
+    try:
+        double(paddle.to_tensor(np.ones((2, 2), np.float32)))
+        double(paddle.to_tensor(np.ones((3, 3), np.float32)))
+    finally:
+        flags.set_flags({"jit_cache_size": 256})
+    assert prog.snapshot()["sites"][site]["evictions"] > e0
+    assert reg.total("jit_cache_evictions_total", site=site) > 0
+
+
+# ---------------------------------------------------------------------------
+# HTTP + introspection surfaces
+# ---------------------------------------------------------------------------
+
+def test_debug_programs_endpoint():
+    from paddle_hackathon_tpu.observability.server import \
+        start_introspection_server
+    site = _site("http")
+    get_program_registry().record_build(
+        site, signature=capture_signature((np.zeros((8, 16)),)),
+        compile_s=0.1, registry=MetricRegistry(enabled=False))
+    srv = start_introspection_server(0)
+    try:
+        doc = json.load(urllib.request.urlopen(
+            f"{srv.url}/debug/programs"))
+        assert doc["version"] == 1 and site in doc["sites"]
+        assert doc["sites"][site]["builds"] == 1
+        # 404 body advertises the endpoint
+        try:
+            urllib.request.urlopen(f"{srv.url}/nope")
+            raise AssertionError("expected 404")
+        except urllib.error.HTTPError as e:
+            assert "/debug/programs" in json.load(e)["endpoints"]
+    finally:
+        srv.stop()
+
+
+def test_registry_is_introspection_source():
+    site = _site("intro")
+    get_program_registry().record_build(
+        site, signature=capture_signature((np.zeros((2,)),)),
+        registry=MetricRegistry(enabled=False))
+    tables = tracing.introspection_tables()
+    assert "programs" in tables
+    assert site in tables["programs"]["sites"]
+
+
+# ---------------------------------------------------------------------------
+# gate + report + dump surfaces
+# ---------------------------------------------------------------------------
+
+def test_gate_failure_prints_recorded_cause(capsys):
+    import perf_gate
+    cause = "arg[0] `ids`: f32[8,512]→f32[8,640]"
+    rows = [{"metric": "serving_spec", "value": 1.0,
+             "metrics": {"jit_builds_warm": 4, "jit_builds_total": 6},
+             "programs": {"compile_seconds_total": 1.5,
+                          "sites": {"serving.tick_b8": {
+                              "builds": 6,
+                              "causes": [f"build 6: {cause}"]}}}}]
+    assert perf_gate.retrace_causes(rows, "serving_spec") == \
+        [("serving.tick_b8", f"build 6: {cause}")]
+    assert perf_gate.suite_gate(0.07, rows=rows) == 1
+    out = capsys.readouterr().out
+    assert "recompiled in steady state" in out
+    assert f"retrace cause: serving.tick_b8: build 6: {cause}" in out
+    # rows without a programs block degrade to a pointer, not a crash
+    del rows[0]["programs"]
+    assert perf_gate.suite_gate(0.07, rows=rows) == 1
+    assert "no recorded causes" in capsys.readouterr().out
+
+
+def test_program_report_render_causes_and_diff(capsys):
+    import program_report
+    prog = ProgramRegistry()
+    reg = MetricRegistry(enabled=False)
+    prog.record_build("a.site", compile_s=2.0,
+                      signature=capture_signature((np.zeros((8, 16)),)),
+                      registry=reg)
+    snap1 = prog.snapshot()
+    prog.record_build("a.site", compile_s=1.0,
+                      signature=capture_signature((np.zeros((8, 24)),)),
+                      registry=reg)
+    prog.record_build("b.site", compile_s=0.5,
+                      signature=capture_signature((np.ones((2,)),)),
+                      registry=reg)
+    snap2 = prog.snapshot()
+    assert program_report.render(snap2) == 2
+    out = capsys.readouterr().out
+    assert "2 sites" in out
+    assert out.index("a.site") < out.index("b.site")   # compile-time rank
+    program_report.render_causes(snap2, site="a.site")
+    assert "f64[8,16]→f64[8,24]" in capsys.readouterr().out
+    assert program_report.render_diff(snap1, snap2) == 2
+    out = capsys.readouterr().out
+    assert "a.site: +1 builds" in out and "(new site)" in out
+    assert "build 2:" in out
+    program_report.render_diff(snap2, snap2)
+    assert "no program builds" in capsys.readouterr().out
+
+
+def test_metrics_dump_humanizes_bytes(capsys):
+    import metrics_dump
+    r = MetricRegistry()
+    r.gauge("program_hbm_bytes", unit="B").labels(
+        site="s", kind="temp").set(1536)
+    metrics_dump.render(r.snapshot())
+    out = capsys.readouterr().out
+    assert "1,536 (1.5KiB)" in out
+
+
+def test_analysis_row_renders_human_bytes(capsys):
+    import program_report
+    prog = ProgramRegistry()
+    with program_analysis():
+        prog.record_build(_site("hb"), args=(np.zeros((4,)),), fn=_fake_fn,
+                          registry=MetricRegistry(enabled=False))
+    program_report.render(prog.snapshot())
+    out = capsys.readouterr().out
+    assert "temp=4.0KiB" in out and "flops=99" in out
+
+
+# ---------------------------------------------------------------------------
+# donation map in signatures
+# ---------------------------------------------------------------------------
+
+def test_donation_map_recorded_in_signature():
+    with sanitizers.donation_sanitizer():
+        w = sanitizers.sanitize_donation(lambda x: x, donate_argnums=(0,))
+        assert w._pht_donate_argnums == (0,)
+    sig = capture_signature((np.zeros((2,)),),
+                            donated=w._pht_donate_argnums)
+    assert sig[-1] == ("static", "donated", "(0,)")

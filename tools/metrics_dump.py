@@ -19,6 +19,14 @@ between the snapshots) and shows gauges as old -> new; bench rows'
 embedded ``"metrics"`` dicts are a separate compact format gated by
 ``tools/perf_gate.py``, not this tool's input.
 
+Byte-valued series (``*_bytes`` — e.g. the program observatory's
+``program_hbm_bytes{site,kind}`` gauges) render the raw value plus a
+humanized form (``1.5KiB``).  Program-registry snapshots
+(``/debug/programs``) are ``tools/program_report.py``'s input, not
+this tool's — this tool reads METRIC registry snapshots, where the
+observatory shows up as ``jit_compile_seconds``/``program_hbm_bytes``
+series.
+
 ``--group LABEL`` partitions the output into one section per value of
 that label — the federated-fleet read: a snapshot taken through
 ``FleetRouter.expose_text()`` carries a bounded ``replica=`` label on
@@ -44,6 +52,16 @@ def _fmt(v):
     if isinstance(v, float) and v != int(v):
         return f"{v:.6g}"
     return f"{int(v):,}"
+
+
+def _human_bytes(v):
+    """1536 -> '1.5KiB'; byte-valued series (program_hbm_bytes,
+    pool/page accounting) get this next to the raw number."""
+    v = float(v)
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(v) < 1024.0 or unit == "TiB":
+            return f"{v:.0f}{unit}" if unit == "B" else f"{v:.1f}{unit}"
+        v /= 1024.0
 
 
 def _group_key(s, group):
@@ -89,8 +107,11 @@ def render(snap, out=None, group=None):
                     detail += f" max={s['max']:.6g}"
                 rows.append((_group_key(s, group), key, fam["type"], detail))
             else:
-                rows.append((_group_key(s, group), key, fam["type"],
-                             _fmt(s.get("value"))))
+                val = _fmt(s.get("value"))
+                if name.endswith("_bytes") and \
+                        isinstance(s.get("value"), (int, float)):
+                    val += f" ({_human_bytes(s['value'])})"
+                rows.append((_group_key(s, group), key, fam["type"], val))
     _emit_grouped(rows, group, out)
     return len(rows)
 

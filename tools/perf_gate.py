@@ -266,6 +266,21 @@ def compare_metrics(rows):
     return bad
 
 
+def retrace_causes(rows, metric):
+    """Recorded retrace causes for a failing row's ``programs`` block
+    (the program-observatory evidence bench rows embed): ``(site,
+    cause)`` pairs, build order.  Empty when the row predates the
+    observatory — the caller prints a pointer instead of guessing."""
+    for r in rows:
+        if r.get("metric") != metric:
+            continue
+        out = []
+        for site, s in ((r.get("programs") or {}).get("sites") or {}).items():
+            out.extend((site, c) for c in s.get("causes") or ())
+        return out
+    return []
+
+
 def compare_zero_sharding(rows):
     """[(metric, reason)] for ZeRO bench rows whose sharding evidence is
     vacuous or absent: a row claiming ``zero_stage>=1`` must have run on
@@ -514,6 +529,12 @@ def suite_gate(tolerance, rows=None):
             print(f"perf_gate[suite] FAIL: {metric} recompiled in steady "
                   f"state ({warm} jit builds after warm-up, {total} after "
                   f"the measured run)")
+            causes = retrace_causes(rows, metric)
+            for site, cause in causes:
+                print(f"    retrace cause: {site}: {cause}")
+            if not causes:
+                print("    (no recorded causes — row carries no programs "
+                      "block; see /debug/programs on a live run)")
         for metric, reason in bad_zero:
             print(f"perf_gate[suite] FAIL: {metric} ZeRO evidence is "
                   f"vacuous ({reason})")

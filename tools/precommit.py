@@ -459,12 +459,59 @@ print('zero-pp smoke: composed state sharded pp x dp, ' + mode
       + ', donation-sanitizer clean OK')
 """
 
+# Program-observatory retrace drill: drive one instrumented site with a
+# changed shape (numpy callable — construction only, no jax compile) and
+# assert the forensics landed end-to-end: the registry's cause record
+# names the changed argument, the flight event carries the same cause,
+# the jit_builds_total/jit_compile_seconds series exist, and both CLI
+# renderers (metrics_dump over the metric snapshot, program_report over
+# the registry snapshot) show the new rows.
+_PROGRAM_DRILL = """
+import io
+import numpy as np
+from paddle_hackathon_tpu import observability as obs
+from paddle_hackathon_tpu.observability import metrics, programs
+from tools import metrics_dump, program_report
+
+prog = programs.get_program_registry()
+
+def tick(ids, mask):
+    return ids.sum() + mask.sum()
+
+w = obs.instrument_jit(tick, site='drill.tick')
+a = np.zeros((8, 16), np.float32)
+m = np.ones((8,), np.float32)
+w(a, m); w(a, m)                       # build 1, then steady-state
+w(np.zeros((8, 24), np.float32), m)    # forced retrace: seqlen change
+
+site = prog.snapshot()['sites']['drill.tick']
+assert site['builds'] == 2, site
+cause = site['history'][-1]['cause']
+for frag in ('arg[0]', '`ids`', '8,16', '8,24'):
+    assert frag in cause, (frag, cause)
+ev = [e for e in obs.get_flight_recorder().events()
+      if e.get('kind') == 'program_build' and e.get('site') == 'drill.tick']
+assert len(ev) == 2 and ev[-1]['cause'] == cause, ev
+reg = metrics.get_registry()
+assert reg.total('jit_builds_total', site='drill.tick') == 2.0
+out = io.StringIO()
+metrics_dump.render(reg.snapshot(), out=out)
+assert 'jit_compile_seconds{site=drill.tick}' in out.getvalue()
+out = io.StringIO()
+program_report.render(prog.snapshot(), out=out)
+program_report.render_causes(prog.snapshot(), out=out, site='drill.tick')
+assert 'drill.tick' in out.getvalue() and cause in out.getvalue()
+print('program drill: retrace cause %r recorded, flight + metrics + '
+      'reports agree OK' % cause)
+"""
+
 _DRILLS = [
     ("fleet-drill", "fleet.dispatch=fail@1", _FLEET_DRILL),
     ("session-drill", "fleet.dispatch=fail@1", _SESSION_DRILL),
     ("telemetry-drill", "serving.tick[tele-a]=fail@1", _TELEMETRY_DRILL),
     ("priority-drill", "", _PRIORITY_DRILL),
     ("zero-pp-smoke", "", _ZERO_PP_SMOKE),
+    ("program-drill", "", _PROGRAM_DRILL),
 ]
 
 
